@@ -1,0 +1,55 @@
+"""paddle_tpu — a TPU-native deep learning framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of PaddlePaddle
+(mid-2017, the "v2 API + v1 trainer" generation): the same layer vocabulary,
+config DSL, trainer semantics, and distributed-training behaviors, built
+TPU-first:
+
+- compute is jax.numpy / lax / Pallas, compiled by XLA onto the MXU;
+- the per-batch train step is one jitted pure function
+  ``(params, opt_state, batch) -> (params, opt_state, metrics)``;
+- parallelism is expressed as shardings over a ``jax.sharding.Mesh``
+  (data/model axes) with XLA collectives over ICI, replacing the reference's
+  thread-ring (``paddle/gserver/gradientmachines/MultiGradientMachine.h``)
+  and parameter-server (``paddle/pserver``) paths;
+- ragged sequences become padded+masked batches with ``lax.scan`` recurrence,
+  replacing offset-based ragged batching (``paddle/parameter/Argument.h:84``).
+
+Top-level namespaces mirror the reference's Python v2 API
+(``/root/reference/python/paddle/v2/__init__.py``).
+"""
+
+from paddle_tpu import config  # noqa: F401
+from paddle_tpu import core  # noqa: F401
+from paddle_tpu import data  # noqa: F401
+from paddle_tpu import layers  # noqa: F401
+from paddle_tpu import optim  # noqa: F401
+from paddle_tpu import parallel  # noqa: F401
+from paddle_tpu import trainer  # noqa: F401
+
+__version__ = "0.1.0"
+
+_GLOBAL_SETTINGS = {
+    "use_tpu": True,
+    "trainer_count": 1,
+    "seed": 0,
+    "compute_dtype": "float32",
+    "log_period": 100,
+}
+
+
+def init(**kwargs):
+    """Process-level initialization, mirroring ``paddle.init(**kwargs)``.
+
+    The reference turns kwargs into gflags consumed by the C++ trainer
+    (``python/paddle/v2/__init__.py`` -> ``utils/Flags.cpp:18-80``). Here the
+    engine is JAX, so flags become a settings dict read by the trainer and
+    parallel layers. Unknown kwargs are accepted and stored (the reference
+    accepts any registered gflag).
+    """
+    _GLOBAL_SETTINGS.update(kwargs)
+    return _GLOBAL_SETTINGS
+
+
+def settings():
+    return _GLOBAL_SETTINGS

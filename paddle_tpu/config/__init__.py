@@ -1,0 +1,1 @@
+from paddle_tpu.config.model_config import Input, LayerDef, ModelDef  # noqa: F401

@@ -1,0 +1,333 @@
+"""Layer-construction DSL — the user API for building model graphs.
+
+Role of ``python/paddle/trainer_config_helpers/layers.py`` (the v1 DSL) and
+``python/paddle/v2/layer.py`` (its v2 graph-object wrapper): each function
+appends a ``LayerDef`` to the active ``ModelDef`` and returns a
+``LayerOutput`` handle usable as ``input=`` of later calls. Auto-generated
+names follow the reference convention (``__fc_layer_0__``).
+
+Unlike the reference there is no protobuf round-trip: the ModelDef *is* the
+config; ``Topology``/``Network`` consume it directly.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import itertools
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from paddle_tpu.config.model_config import (Input, LayerDef, ModelDef,
+                                            ParamAttr)
+
+_GRAPH = ModelDef()
+_COUNTERS: Dict[str, itertools.count] = {}
+
+
+def reset():
+    """Start a fresh graph (the reference resets config_parser globals per
+    parse_config call)."""
+    global _GRAPH, _COUNTERS
+    _GRAPH = ModelDef()
+    _COUNTERS = {}
+    _SHAPES.clear()
+
+
+def current_graph() -> ModelDef:
+    return _GRAPH
+
+
+def _auto_name(type_name: str) -> str:
+    c = _COUNTERS.setdefault(type_name, itertools.count())
+    return f"__{type_name}_layer_{next(c)}__"
+
+
+@dataclasses.dataclass(frozen=True)
+class LayerOutput:
+    name: str
+    size: int
+
+    def __repr__(self):
+        return f"LayerOutput({self.name!r}, size={self.size})"
+
+
+def _in(x) -> List[LayerOutput]:
+    if isinstance(x, LayerOutput):
+        return [x]
+    return list(x)
+
+
+def _add(ldef: LayerDef) -> LayerOutput:
+    _GRAPH.add(ldef)
+    from paddle_tpu.core.registry import get_layer_impl
+    # resolve output size via the impl's shape inference
+    net_order = [i.layer_name for i in ldef.inputs]
+    infos = []
+    for n in net_order:
+        infos.append(_shape_of(n))
+    info = get_layer_impl(ldef.type).infer(ldef, infos)
+    _SHAPES[ldef.name] = info
+    return LayerOutput(ldef.name, info.size)
+
+
+_SHAPES: Dict[str, Any] = {}
+
+
+def _shape_of(name: str):
+    return _SHAPES[name]
+
+
+def _param(attr) -> Optional[ParamAttr]:
+    if attr is None or isinstance(attr, ParamAttr):
+        return attr
+    if isinstance(attr, dict):
+        return ParamAttr(**attr)
+    raise TypeError(f"bad param attr {attr!r}")
+
+
+# ----------------------------------------------------------------- layers
+def data(name: str, size: int, *, height: int = None, width: int = None,
+         channels: int = None, is_sequence: bool = False) -> LayerOutput:
+    ldef = LayerDef(name=name, type="data", size=size, bias=False,
+                    attrs={"height": height, "width": width,
+                           "channels": channels, "is_sequence": is_sequence})
+    return _add(ldef)
+
+
+def fc(input, size: int, *, act: str = "tanh", name: str = None,
+       bias_attr=True, param_attr=None, layer_attr: dict = None) -> LayerOutput:
+    ins = [Input(i.name, param_attr=_param(param_attr)) for i in _in(input)]
+    ldef = LayerDef(name=name or _auto_name("fc"), type="fc", inputs=ins,
+                    size=size, act=act, bias=_bias(bias_attr),
+                    **_layer_attr(layer_attr))
+    return _add(ldef)
+
+
+def embedding(input, size: int, *, vocab_size: int = None, name: str = None,
+              param_attr=None) -> LayerOutput:
+    src = _in(input)[0]
+    vocab = vocab_size or _shape_of(src.name).size
+    ldef = LayerDef(name=name or _auto_name("embedding"), type="embedding",
+                    inputs=[Input(src.name, param_attr=_param(param_attr))],
+                    size=size, bias=False, attrs={"vocab_size": vocab})
+    return _add(ldef)
+
+
+def mixed(inputs: Sequence, size: int, *, projections: Sequence[dict],
+          act: str = "linear", name: str = None, bias_attr=False) -> LayerOutput:
+    ins = [Input(i.name, param_attr=_param(p.pop("param_attr", None)))
+           for i, p in zip(_in(inputs), [dict(p) for p in projections])]
+    ldef = LayerDef(name=name or _auto_name("mixed"), type="mixed",
+                    inputs=ins, size=size, act=act, bias=_bias(bias_attr),
+                    attrs={"projections": list(projections)})
+    return _add(ldef)
+
+
+def conv(input, *, num_filters: int, filter_size: int, stride: int = 1,
+         padding: int = 0, groups: int = 1, channels: int = None,
+         act: str = "relu", name: str = None, bias_attr=True,
+         param_attr=None, layer_type: str = "exconv") -> LayerOutput:
+    src = _in(input)[0]
+    extra = {"filter_size": filter_size, "stride": stride,
+             "padding": padding, "groups": groups}
+    if channels:
+        extra["channels"] = channels
+    ldef = LayerDef(name=name or _auto_name("conv"), type=layer_type,
+                    inputs=[Input(src.name, param_attr=_param(param_attr),
+                                  extra=extra)],
+                    act=act, bias=_bias(bias_attr),
+                    attrs={"num_filters": num_filters})
+    return _add(ldef)
+
+
+def img_pool(input, *, pool_size: int, stride: int, padding: int = 0,
+             pool_type: str = "max-projection", name: str = None) -> LayerOutput:
+    src = _in(input)[0]
+    extra = {"filter_size": pool_size, "stride": stride, "padding": padding,
+             "pool_type": pool_type}
+    ldef = LayerDef(name=name or _auto_name("pool"), type="pool", bias=False,
+                    inputs=[Input(src.name, extra=extra)])
+    return _add(ldef)
+
+
+def batch_norm(input, *, act: str = "linear", name: str = None,
+               use_global_stats: bool = None,
+               moving_average_fraction: float = 0.9,
+               epsilon: float = 1e-5, bias_attr=True) -> LayerOutput:
+    src = _in(input)[0]
+    ldef = LayerDef(name=name or _auto_name("batch_norm"), type="batch_norm",
+                    inputs=[Input(src.name)], act=act, bias=_bias(bias_attr),
+                    attrs={"use_global_stats": use_global_stats,
+                           "moving_average_fraction": moving_average_fraction,
+                           "epsilon": epsilon})
+    return _add(ldef)
+
+
+def img_cmrnorm(input, *, size: int = 5, scale: float = 1e-4,
+                power: float = 0.75, name: str = None) -> LayerOutput:
+    src = _in(input)[0]
+    ldef = LayerDef(name=name or _auto_name("norm"), type="norm", bias=False,
+                    inputs=[Input(src.name, extra={"size": size,
+                                                   "scale": scale,
+                                                   "pow": power})])
+    return _add(ldef)
+
+
+def addto(inputs, *, act: str = "linear", name: str = None,
+          bias_attr=False) -> LayerOutput:
+    ldef = LayerDef(name=name or _auto_name("addto"), type="addto",
+                    inputs=[Input(i.name) for i in _in(inputs)], act=act,
+                    bias=_bias(bias_attr))
+    return _add(ldef)
+
+
+def concat(inputs, *, name: str = None, act: str = "linear") -> LayerOutput:
+    ldef = LayerDef(name=name or _auto_name("concat"), type="concat",
+                    inputs=[Input(i.name) for i in _in(inputs)], act=act,
+                    bias=False)
+    return _add(ldef)
+
+
+def dropout(input, rate: float, *, name: str = None) -> LayerOutput:
+    """Reference expresses dropout as a layer attr; standalone helper adds
+    an identity addto carrying drop_rate."""
+    src = _in(input)[0]
+    ldef = LayerDef(name=name or _auto_name("dropout"), type="addto",
+                    inputs=[Input(src.name)], bias=False, drop_rate=rate)
+    return _add(ldef)
+
+
+def lstmemory(input, *, name: str = None, reverse: bool = False,
+              act: str = "tanh", gate_act: str = "sigmoid",
+              state_act: str = "tanh", bias_attr=True,
+              param_attr=None) -> LayerOutput:
+    src = _in(input)[0]
+    ldef = LayerDef(name=name or _auto_name("lstmemory"), type="lstmemory",
+                    inputs=[Input(src.name, param_attr=_param(param_attr))],
+                    bias=_bias(bias_attr),
+                    attrs={"reversed": reverse, "active_type": act,
+                           "active_gate_type": gate_act,
+                           "active_state_type": state_act})
+    return _add(ldef)
+
+
+def grumemory(input, *, name: str = None, reverse: bool = False,
+              act: str = "tanh", gate_act: str = "sigmoid",
+              bias_attr=True, param_attr=None) -> LayerOutput:
+    src = _in(input)[0]
+    ldef = LayerDef(name=name or _auto_name("gru"), type="gated_recurrent",
+                    inputs=[Input(src.name, param_attr=_param(param_attr))],
+                    bias=_bias(bias_attr),
+                    attrs={"reversed": reverse, "active_type": act,
+                           "active_gate_type": gate_act})
+    return _add(ldef)
+
+
+def recurrent(input, *, name: str = None, reverse: bool = False,
+              act: str = "tanh", bias_attr=True, param_attr=None) -> LayerOutput:
+    src = _in(input)[0]
+    ldef = LayerDef(name=name or _auto_name("recurrent"), type="recurrent",
+                    inputs=[Input(src.name, param_attr=_param(param_attr))],
+                    bias=_bias(bias_attr), act="linear",
+                    attrs={"reversed": reverse, "active_type": act})
+    return _add(ldef)
+
+
+_POOL_TYPES = {"max": "max", "avg": "average", "average": "average",
+               "sum": "average", "sqrt": "average", "last": "seqlastins",
+               "first": "seqlastins"}
+
+
+def pooling(input, *, pooling_type: str = "max", name: str = None) -> LayerOutput:
+    """Sequence pooling (``pooling_layer`` in the reference DSL)."""
+    src = _in(input)[0]
+    ltype = _POOL_TYPES[pooling_type]
+    attrs = {}
+    if pooling_type == "sum":
+        attrs["average_strategy"] = "sum"
+    if pooling_type == "sqrt":
+        attrs["average_strategy"] = "squarerootn"
+    if pooling_type == "first":
+        attrs["select_first"] = True
+    ldef = LayerDef(name=name or _auto_name(f"seq_{pooling_type}"),
+                    type=ltype, inputs=[Input(src.name)], bias=False,
+                    attrs=attrs)
+    return _add(ldef)
+
+
+def last_seq(input, **kw):
+    return pooling(input, pooling_type="last", **kw)
+
+
+def first_seq(input, **kw):
+    return pooling(input, pooling_type="first", **kw)
+
+
+def expand(input, expand_as, *, name: str = None) -> LayerOutput:
+    ldef = LayerDef(name=name or _auto_name("expand"), type="expand",
+                    inputs=[Input(_in(input)[0].name),
+                            Input(_in(expand_as)[0].name)], bias=False)
+    return _add(ldef)
+
+
+def maxid(input, *, name: str = None) -> LayerOutput:
+    ldef = LayerDef(name=name or _auto_name("maxid"), type="maxid",
+                    inputs=[Input(_in(input)[0].name)], bias=False)
+    return _add(ldef)
+
+
+def cos_sim(a, b, *, scale: float = 1.0, name: str = None) -> LayerOutput:
+    ldef = LayerDef(name=name or _auto_name("cos"), type="cos",
+                    inputs=[Input(_in(a)[0].name), Input(_in(b)[0].name)],
+                    bias=False, attrs={"cos_scale": scale})
+    return _add(ldef)
+
+
+# ------------------------------------------------------------------ costs
+def classification_cost(input, label, *, name: str = None) -> LayerOutput:
+    """Cross-entropy on post-softmax input (the reference's
+    ``classification_cost`` attaches a classification-error evaluator too —
+    the trainer does that by layer type)."""
+    ldef = LayerDef(name=name or _auto_name("cost"),
+                    type="multi-class-cross-entropy",
+                    inputs=[Input(_in(input)[0].name),
+                            Input(_in(label)[0].name)], bias=False)
+    return _add(ldef)
+
+
+cross_entropy_cost = classification_cost
+
+
+def square_error_cost(input, label, *, name: str = None) -> LayerOutput:
+    ldef = LayerDef(name=name or _auto_name("cost"), type="square_error",
+                    inputs=[Input(_in(input)[0].name),
+                            Input(_in(label)[0].name)], bias=False)
+    return _add(ldef)
+
+
+mse_cost = square_error_cost
+
+
+def rank_cost(left, right, label, *, name: str = None) -> LayerOutput:
+    ldef = LayerDef(name=name or _auto_name("cost"), type="rank-cost",
+                    inputs=[Input(_in(left)[0].name),
+                            Input(_in(right)[0].name),
+                            Input(_in(label)[0].name)], bias=False)
+    return _add(ldef)
+
+
+# ---------------------------------------------------------------- helpers
+def _bias(bias_attr):
+    if bias_attr is True or bias_attr is None:
+        return True
+    if bias_attr is False:
+        return False
+    return _param(bias_attr) or True
+
+
+def _layer_attr(layer_attr: Optional[dict]):
+    out = {}
+    if layer_attr:
+        if "drop_rate" in layer_attr:
+            out["drop_rate"] = layer_attr["drop_rate"]
+    return out

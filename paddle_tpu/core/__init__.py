@@ -1,0 +1,11 @@
+from paddle_tpu.core.argument import Argument  # noqa: F401
+from paddle_tpu.core.initializers import init_param  # noqa: F401
+from paddle_tpu.core.registry import (  # noqa: F401
+    LayerImpl,
+    ParamSpec,
+    ShapeInfo,
+    get_layer_impl,
+    register_layer,
+    registered_layer_types,
+)
+from paddle_tpu.core.network import Network  # noqa: F401

@@ -1,0 +1,96 @@
+"""Argument: the inter-layer value type.
+
+The reference's ``Argument`` (``paddle/parameter/Argument.h:29``) carries a
+dense value matrix plus ragged-sequence metadata (``sequenceStartPositions``
+at ``:84``, ``subSequenceStartPositions`` at ``:90``): a batch of sequences is
+a flat ``(totalTokens, dim)`` matrix with offset vectors.
+
+On TPU, XLA wants static shapes, so the native representation is
+**padded + masked**: a sequence batch is ``value[B, T, D]`` with a boolean
+``mask[B, T]`` (True = real token). Non-sequence batches are ``value[B, ...]``
+with ``mask=None``. Two-level nested sequences keep an extra ``sub_mask``
+marking sub-sequence boundaries. Conversion helpers translate between the
+offset world (Python data providers produce lists of variable-length
+sequences) and the padded world at the host boundary only — on device
+everything is static.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+
+@struct.dataclass
+class Argument:
+    """A batch flowing between layers.
+
+    value: [B, ...] dense data; for sequence data [B, T, D] (or [B, T] for ids).
+    mask:  [B, T] float32 (1.0 = real token), None for non-sequence data.
+    sub_starts_mask: [B, T] float32 marking positions that begin a sub-sequence
+        (nested/2-level sequences), None unless nested.
+    state: optional carried recurrent state (cross-batch, --prev_batch_state).
+    """
+
+    value: jnp.ndarray
+    mask: Optional[jnp.ndarray] = None
+    sub_starts_mask: Optional[jnp.ndarray] = None
+    state: Any = None
+
+    # ---- helpers -----------------------------------------------------------
+    @property
+    def is_sequence(self) -> bool:
+        return self.mask is not None
+
+    @property
+    def batch_size(self) -> int:
+        return self.value.shape[0]
+
+    def seq_lengths(self) -> jnp.ndarray:
+        """[B] int32 true lengths."""
+        if self.mask is None:
+            raise ValueError("not a sequence Argument")
+        return jnp.sum(self.mask.astype(jnp.int32), axis=1)
+
+    def num_tokens(self) -> jnp.ndarray:
+        return jnp.sum(self.mask) if self.mask is not None else self.value.shape[0]
+
+    def with_value(self, value: jnp.ndarray) -> "Argument":
+        return self.replace(value=value)
+
+
+def from_ragged(sequences, dtype=np.float32, pad_to: Optional[int] = None) -> Argument:
+    """Host-side: list of per-example arrays (len Ti, each [Ti, D] or [Ti])
+    -> padded Argument. Mirrors how ``PyDataProvider2`` assembles ragged
+    batches into (totalTokens, dim)+offsets (``paddle/gserver/dataproviders/
+    PyDataProvider2.cpp``), but emits the TPU-native padded layout.
+    """
+    seqs = [np.asarray(s, dtype=dtype) for s in sequences]
+    bsz = len(seqs)
+    max_len = max((s.shape[0] for s in seqs), default=0)
+    if pad_to is not None:
+        max_len = max(max_len, pad_to)
+    feat = seqs[0].shape[1:] if seqs and seqs[0].ndim > 1 else ()
+    value = np.zeros((bsz, max_len) + feat, dtype=dtype)
+    mask = np.zeros((bsz, max_len), dtype=np.float32)
+    for i, s in enumerate(seqs):
+        value[i, : s.shape[0]] = s
+        mask[i, : s.shape[0]] = 1.0
+    return Argument(value=jnp.asarray(value), mask=jnp.asarray(mask))
+
+
+def to_ragged(arg: Argument) -> list:
+    """Host-side inverse of :func:`from_ragged` (device -> lists)."""
+    value = np.asarray(arg.value)
+    if arg.mask is None:
+        return [value[i] for i in range(value.shape[0])]
+    lengths = np.asarray(jax.device_get(arg.seq_lengths()))
+    return [value[i, : lengths[i]] for i in range(value.shape[0])]
+
+
+def dense(value) -> Argument:
+    return Argument(value=jnp.asarray(value))

@@ -1,0 +1,49 @@
+"""Parameter initialization matching reference semantics.
+
+The reference initializes weights from ``ParameterConfig`` (``proto/
+ParameterConfig.proto``): normal(initial_mean, initial_std) by default with
+``initial_std = 1/sqrt(fan_in)`` filled in by the config parser
+(``python/paddle/trainer/config_parser.py`` Parameter handling), uniform when
+``initial_strategy=1``, constant bias init 0.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def default_std(shape: Sequence[int]) -> float:
+    """1/sqrt(fan_in); fan_in = first dim for matrices (reference layout is
+    [in, out] for fc weights), product of all-but-last for conv filters."""
+    if len(shape) <= 1:
+        return 1.0
+    fan_in = 1
+    for d in shape[:-1]:
+        fan_in *= d
+    return 1.0 / math.sqrt(max(fan_in, 1))
+
+
+def init_param(
+    key: jax.Array,
+    shape: Sequence[int],
+    *,
+    init: str = "normal",
+    initial_mean: float = 0.0,
+    initial_std: Optional[float] = None,
+    dtype=jnp.float32,
+) -> jnp.ndarray:
+    shape = tuple(shape)
+    if init == "zeros" or init == "const":
+        return jnp.full(shape, initial_mean, dtype=dtype)
+    if initial_std is None:
+        initial_std = default_std(shape)
+    if init == "uniform":
+        return jax.random.uniform(
+            key, shape, dtype=dtype, minval=initial_mean - initial_std,
+            maxval=initial_mean + initial_std)
+    # default: normal
+    return initial_mean + initial_std * jax.random.normal(key, shape, dtype=dtype)

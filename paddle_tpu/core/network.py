@@ -1,0 +1,179 @@
+"""Network: the proto-driven graph executor.
+
+TPU-native replacement for ``NeuralNetwork`` (``paddle/gserver/
+gradientmachines/NeuralNetwork.cpp``): where the reference walks a layer list
+calling virtual ``forward``/``backward`` per layer (hot loops at ``:235`` and
+``:285``), here the *whole* forward (and loss) is built as one pure function
+``(params, feed, rng) -> outputs`` which is jitted once and differentiated by
+``jax.grad`` — no hand-written backward, and XLA fuses across layer
+boundaries instead of materializing every intermediate in HBM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.config.model_config import LayerDef, ModelDef, ParamAttr
+from paddle_tpu.core.argument import Argument
+from paddle_tpu.core.initializers import init_param
+from paddle_tpu.core.registry import ParamSpec, ShapeInfo, get_layer_impl
+
+
+@dataclasses.dataclass
+class Context:
+    """Per-apply execution context handed to layer impls."""
+
+    train: bool = False
+    rng: Optional[jax.Array] = None
+    in_infos: List[ShapeInfo] = dataclasses.field(default_factory=list)
+    out_info: Optional[ShapeInfo] = None
+    outputs: Dict[str, Argument] = dataclasses.field(default_factory=dict)
+    # functional side-channel for moving statistics (batch_norm): param name
+    # -> new value; applied by the train step after the gradient update.
+    state_updates: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def layer_rng(self, layer_name: str) -> jax.Array:
+        if self.rng is None:
+            raise ValueError("this apply needs an rng (dropout/sampling)")
+        return jax.random.fold_in(self.rng, zlib.crc32(layer_name.encode()))
+
+
+def _resolve_param_name(layer: LayerDef, suffix: str, spec: ParamSpec,
+                        attr: Optional[ParamAttr]) -> str:
+    if attr is not None and attr.name:
+        return attr.name
+    return f"_{layer.name}.{suffix}"
+
+
+def _apply_attr(spec: ParamSpec, attr: Optional[ParamAttr]) -> ParamSpec:
+    if attr is None:
+        return spec
+    return dataclasses.replace(
+        spec,
+        init=attr.init if attr.init != "normal" or attr.initial_std is not None
+        else spec.init,
+        initial_mean=attr.initial_mean,
+        initial_std=attr.initial_std if attr.initial_std is not None
+        else spec.initial_std,
+        is_static=attr.is_static or spec.is_static,
+        learning_rate=attr.learning_rate,
+        sparse_grad=attr.sparse_grad or spec.sparse_grad,
+        l1_rate=attr.l1_rate,
+        l2_rate=attr.l2_rate,
+    )
+
+
+class Network:
+    """Compiled view of a ModelDef: shape inference, parameter table, and a
+    pure ``apply``. Construction = the work ``GradientMachine::create`` +
+    config_parser shape inference do in the reference."""
+
+    def __init__(self, model: ModelDef,
+                 outputs: Optional[List[str]] = None):
+        self.model = model
+        self.order = model.topo_order(outputs)
+        self.shape_infos: Dict[str, ShapeInfo] = {}
+        # param name -> (spec, owning layer, suffix)
+        self.param_specs: Dict[str, ParamSpec] = {}
+        self._layer_params: Dict[str, Dict[str, str]] = {}  # layer -> suffix -> pname
+
+        for name in self.order:
+            layer = model.layers[name]
+            impl = get_layer_impl(layer.type)
+            in_infos = [self.shape_infos[i] for i in layer.input_names()]
+            self.shape_infos[name] = impl.infer(layer, in_infos)
+            specs = impl.params(layer, in_infos)
+            self._layer_params[name] = {}
+            for suffix, spec in specs.items():
+                if spec.is_bias:
+                    attr = layer.bias if isinstance(layer.bias, ParamAttr) else None
+                else:
+                    # weight i takes input i's param_attr
+                    idx = _weight_index(suffix)
+                    attr = (layer.inputs[idx].param_attr
+                            if idx is not None and idx < len(layer.inputs) else None)
+                pname = _resolve_param_name(layer, suffix, spec, attr)
+                spec = _apply_attr(spec, attr)
+                if pname in self.param_specs:
+                    if self.param_specs[pname].shape != spec.shape:
+                        raise ValueError(
+                            f"shared parameter {pname!r} shape mismatch: "
+                            f"{self.param_specs[pname].shape} vs {spec.shape}")
+                else:
+                    self.param_specs[pname] = spec
+                self._layer_params[name][suffix] = pname
+
+    # ------------------------------------------------------------------ init
+    def init_params(self, key: jax.Array, dtype=jnp.float32) -> Dict[str, jnp.ndarray]:
+        params = {}
+        for i, (pname, spec) in enumerate(sorted(self.param_specs.items())):
+            params[pname] = init_param(
+                jax.random.fold_in(key, i), spec.shape, init=spec.init,
+                initial_mean=spec.initial_mean, initial_std=spec.initial_std,
+                dtype=dtype)
+        return params
+
+    # ----------------------------------------------------------------- apply
+    def apply(self, params: Dict[str, jnp.ndarray],
+              feed: Dict[str, Argument], *, train: bool = False,
+              rng: Optional[jax.Array] = None) -> Dict[str, Argument]:
+        outs, _ = self.apply_with_state(params, feed, train=train, rng=rng)
+        return outs
+
+    def apply_with_state(
+            self, params: Dict[str, jnp.ndarray],
+            feed: Dict[str, Argument], *, train: bool = False,
+            rng: Optional[jax.Array] = None,
+    ) -> Tuple[Dict[str, Argument], Dict[str, jnp.ndarray]]:
+        """Pure forward over the whole graph. ``feed`` maps data-layer names
+        to Arguments. Returns (every layer's output keyed by layer name,
+        state updates for moving statistics)."""
+        ctx = Context(train=train, rng=rng)
+        from paddle_tpu.layers.activations import apply_activation  # cycle-free
+
+        for name in self.order:
+            layer = self.model.layers[name]
+            impl = get_layer_impl(layer.type)
+            if layer.type == "data":
+                if name not in feed:
+                    raise KeyError(f"missing feed for data layer {name!r}")
+                ctx.outputs[name] = feed[name]
+                continue
+            ins = [ctx.outputs[i] for i in layer.input_names()]
+            lparams = {s: params[p] for s, p in self._layer_params[name].items()}
+            ctx.in_infos = [self.shape_infos[i] for i in layer.input_names()]
+            ctx.out_info = self.shape_infos[name]
+            out = impl.apply(layer, lparams, ins, ctx)
+            if layer.act and layer.act not in ("linear", ""):
+                out = out.with_value(
+                    apply_activation(layer.act, out.value, out.mask))
+            if layer.drop_rate > 0.0:
+                out = out.with_value(
+                    _dropout(out.value, layer.drop_rate, ctx, name))
+            ctx.outputs[name] = out
+        return ctx.outputs, ctx.state_updates
+
+    def param_meta(self) -> Dict[str, ParamSpec]:
+        return dict(self.param_specs)
+
+
+def _weight_index(suffix: str) -> Optional[int]:
+    if suffix.startswith("w") and suffix[1:].isdigit():
+        return int(suffix[1:])
+    return None
+
+
+def _dropout(x: jnp.ndarray, rate: float, ctx: Context, layer_name: str):
+    """Reference-style (non-inverted) dropout: train multiplies by a 0/1
+    keep mask; test scales by (1-rate). See ``Layer::forwardDropOut``
+    (``paddle/gserver/layers/Layer.cpp``)."""
+    if not ctx.train:
+        return x * (1.0 - rate)
+    keep = jax.random.bernoulli(
+        ctx.layer_rng(layer_name + "/drop"), 1.0 - rate, x.shape)
+    return x * keep.astype(x.dtype)

@@ -1,0 +1,6 @@
+from paddle_tpu.data import reader  # noqa: F401
+from paddle_tpu.data.feeder import DataFeeder  # noqa: F401
+from paddle_tpu.data.types import (  # noqa: F401
+    dense_vector, dense_vector_sequence, integer_value,
+    integer_value_sequence, sparse_binary_vector, sparse_float_vector)
+from paddle_tpu.data.reader import batch  # noqa: F401

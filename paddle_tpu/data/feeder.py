@@ -1,0 +1,81 @@
+"""DataFeeder: python samples -> device Arguments.
+
+Replaces ``py_paddle.DataProviderConverter`` (``paddle/py_paddle/
+dataprovider_converter.py``) + the SWIG ``Arguments`` assembly: given input
+type declarations, converts a minibatch (list of tuples) into a feed dict of
+padded Arguments. Sequence inputs are padded to ``pad_multiple`` to bound
+XLA recompilation (bucketed static shapes) — the TPU answer to ragged
+offset batches.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu.core.argument import Argument
+from paddle_tpu.data import types as T
+
+
+def _ceil_to(n: int, m: int) -> int:
+    return ((max(n, 1) + m - 1) // m) * m
+
+
+class DataFeeder:
+    def __init__(self, feeding: Dict[str, T.InputType],
+                 pad_multiple: int = 32):
+        """feeding: data-layer name -> InputType, in feed order if the
+        reader yields tuples."""
+        self.feeding = feeding
+        self.names = list(feeding)
+        self.pad_multiple = pad_multiple
+
+    def convert(self, batch: List[Tuple]) -> Dict[str, Argument]:
+        cols = list(zip(*batch))
+        if len(cols) != len(self.names):
+            raise ValueError(
+                f"batch has {len(cols)} columns, feeder expects "
+                f"{len(self.names)} ({self.names})")
+        feed = {}
+        for name, col in zip(self.names, cols):
+            feed[name] = self._convert_one(self.feeding[name], col)
+        return feed
+
+    __call__ = convert
+
+    def _convert_one(self, itype: T.InputType, col: Sequence) -> Argument:
+        if itype.seq_type == T.NO_SEQUENCE:
+            if itype.type == T.INDEX:
+                return Argument(value=jnp.asarray(
+                    np.asarray(col, dtype=np.int32)))
+            if itype.type == T.DENSE:
+                return Argument(value=jnp.asarray(
+                    np.asarray(col, dtype=np.float32)))
+            if itype.type in (T.SPARSE_BINARY, T.SPARSE_FLOAT):
+                dense = np.zeros((len(col), itype.dim), dtype=np.float32)
+                for i, idxs in enumerate(col):
+                    if itype.type == T.SPARSE_BINARY:
+                        dense[i, np.asarray(idxs, dtype=np.int64)] = 1.0
+                    else:
+                        for j, v in idxs:
+                            dense[i, j] = v
+                return Argument(value=jnp.asarray(dense))
+            raise KeyError(itype.type)
+        # sequences: pad to multiple for shape bucketing
+        max_len = _ceil_to(max(len(s) for s in col), self.pad_multiple)
+        bsz = len(col)
+        mask = np.zeros((bsz, max_len), dtype=np.float32)
+        if itype.type == T.INDEX:
+            value = np.zeros((bsz, max_len), dtype=np.int32)
+            for i, s in enumerate(col):
+                value[i, : len(s)] = np.asarray(s, dtype=np.int32)
+                mask[i, : len(s)] = 1.0
+        else:
+            value = np.zeros((bsz, max_len, itype.dim), dtype=np.float32)
+            for i, s in enumerate(col):
+                arr = np.asarray(s, dtype=np.float32).reshape(len(s), itype.dim)
+                value[i, : len(s)] = arr
+                mask[i, : len(s)] = 1.0
+        return Argument(value=jnp.asarray(value), mask=jnp.asarray(mask))

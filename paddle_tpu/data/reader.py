@@ -1,0 +1,114 @@
+"""Reader creators and decorators.
+
+Behavior-compatible with ``python/paddle/v2/reader/decorator.py`` and
+``minibatch.py``: a *reader* is a zero-arg callable returning an iterable of
+samples; decorators wrap readers. These are host-side and framework-agnostic
+by design — the TPU enters only at the feeder/prefetch boundary.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random as _random
+from queue import Queue
+from threading import Thread
+
+
+def map_readers(func, *readers):
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+    return reader
+
+
+def shuffle(reader, buf_size):
+    def data_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                for b in buf:
+                    yield b
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            for b in buf:
+                yield b
+    return data_reader
+
+
+def chain(*readers):
+    def reader():
+        for r in readers:
+            for e in r():
+                yield e
+    return reader
+
+
+def compose(*readers, check_alignment=True):
+    """Yield tuples drawing one sample from each reader, flattening
+    tuple-samples like the reference compose."""
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if check_alignment:
+            for outputs in zip(*rs):
+                yield sum((make_tuple(o) for o in outputs), ())
+        else:
+            for outputs in itertools.zip_longest(*rs):
+                yield sum((make_tuple(o) for o in outputs if o is not None), ())
+    return reader
+
+
+def buffered(reader, size):
+    """Background-thread prefetch — the host-side analogue of the
+    double-buffer thread in ``DataProvider.h:343``."""
+
+    end = object()
+
+    def read_worker(r, q):
+        for d in r:
+            q.put(d)
+        q.put(end)
+
+    def data_reader():
+        r = reader()
+        q = Queue(maxsize=size)
+        t = Thread(target=read_worker, args=(r, q))
+        t.daemon = True
+        t.start()
+        e = q.get()
+        while e is not end:
+            yield e
+            e = q.get()
+    return data_reader
+
+
+def firstn(reader, n):
+    def data_reader():
+        for i, item in enumerate(reader()):
+            if i == n:
+                break
+            yield item
+    return data_reader
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Group samples into lists of batch_size
+    (``python/paddle/v2/minibatch.py``)."""
+
+    def batch_reader():
+        b = []
+        for instance in reader():
+            b.append(instance)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+    return batch_reader

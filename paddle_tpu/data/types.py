@@ -1,0 +1,47 @@
+"""Input type declarations, mirroring ``python/paddle/trainer/
+PyDataProvider2.py`` (dense_vector/integer_value/... and their _sequence
+variants) used by feeders to turn Python data into device Arguments."""
+
+from __future__ import annotations
+
+import dataclasses
+
+NO_SEQUENCE = 0
+SEQUENCE = 1
+SUB_SEQUENCE = 2
+
+DENSE = "dense"
+SPARSE_BINARY = "sparse_binary"
+SPARSE_FLOAT = "sparse_float"
+INDEX = "index"
+
+
+@dataclasses.dataclass(frozen=True)
+class InputType:
+    dim: int
+    seq_type: int = NO_SEQUENCE
+    type: str = DENSE
+
+
+def dense_vector(dim):
+    return InputType(dim, NO_SEQUENCE, DENSE)
+
+
+def dense_vector_sequence(dim):
+    return InputType(dim, SEQUENCE, DENSE)
+
+
+def integer_value(value_range):
+    return InputType(value_range, NO_SEQUENCE, INDEX)
+
+
+def integer_value_sequence(value_range):
+    return InputType(value_range, SEQUENCE, INDEX)
+
+
+def sparse_binary_vector(dim):
+    return InputType(dim, NO_SEQUENCE, SPARSE_BINARY)
+
+
+def sparse_float_vector(dim):
+    return InputType(dim, NO_SEQUENCE, SPARSE_FLOAT)

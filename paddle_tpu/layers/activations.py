@@ -1,0 +1,67 @@
+"""Activation functions.
+
+Covers the registry in ``paddle/gserver/activations/ActivationFunction.cpp``
+(``BEGIN_DEFINE_ACTIVATION`` blocks at ``:94+``): linear, sigmoid, softmax,
+sequence_softmax, relu, brelu, tanh, stanh, softrelu, abs, square,
+exponential, reciprocal, sqrt, log. Backward passes come from ``jax.grad``;
+the reference hand-writes each (e.g. tanh backward ``:94-120``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _softmax(x, mask=None):
+    return jax.nn.softmax(x, axis=-1)
+
+
+def _sequence_softmax(x, mask):
+    """Softmax across the *time* dimension of each sequence. Input is
+    [B, T, 1] or [B, T]; padded steps are excluded via the mask (the
+    reference operates on ragged offsets, ``ActivationFunction.cpp``
+    sequence_softmax)."""
+    if mask is None:
+        raise ValueError("sequence_softmax requires sequence input")
+    squeeze = x.ndim == 3
+    v = x[..., 0] if squeeze else x
+    v = jnp.where(mask > 0, v, _NEG_INF)
+    v = jax.nn.softmax(v, axis=-1)
+    v = v * mask
+    return v[..., None] if squeeze else v
+
+
+_ACTIVATIONS: Dict[str, Callable] = {
+    "linear": lambda x, m=None: x,
+    "": lambda x, m=None: x,
+    "sigmoid": lambda x, m=None: jax.nn.sigmoid(x),
+    "softmax": _softmax,
+    "sequence_softmax": _sequence_softmax,
+    "relu": lambda x, m=None: jax.nn.relu(x),
+    "brelu": lambda x, m=None: jnp.clip(x, 0.0, 24.0),
+    "tanh": lambda x, m=None: jnp.tanh(x),
+    "stanh": lambda x, m=None: 1.7159 * jnp.tanh((2.0 / 3.0) * x),
+    "softrelu": lambda x, m=None: jnp.log1p(jnp.exp(jnp.clip(x, -40.0, 40.0))),
+    "abs": lambda x, m=None: jnp.abs(x),
+    "square": lambda x, m=None: jnp.square(x),
+    "exponential": lambda x, m=None: jnp.exp(x),
+    "reciprocal": lambda x, m=None: 1.0 / x,
+    "sqrt": lambda x, m=None: jnp.sqrt(x),
+    "log": lambda x, m=None: jnp.log(x),
+}
+
+
+def apply_activation(name: str, x: jnp.ndarray,
+                     mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    if name not in _ACTIVATIONS:
+        raise KeyError(f"unknown activation {name!r}")
+    return _ACTIVATIONS[name](x, mask)
+
+
+def activation_names():
+    return sorted(k for k in _ACTIVATIONS if k)

@@ -1,0 +1,278 @@
+"""Core dense layers: data, fc, embedding, mixed/projections, elementwise.
+
+Reference implementations these mirror (behavior, not code):
+``paddle/gserver/layers/{DataLayer,FullyConnectedLayer,TableProjection,
+MixedLayer,AddtoLayer,ConcatenateLayer,SlopeInterceptLayer,ScalingLayer,
+InterpolationLayer,MaxIdLayer,CosSimLayer,TransLayer}.cpp``.
+
+TPU notes: fc over a sequence input is a single [B*T, D]x[D, O] matmul that
+XLA tiles onto the MXU — no per-timestep loop. All layers are pure; gradients
+come from jax.grad.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.argument import Argument
+from paddle_tpu.core.registry import (LayerImpl, ParamSpec, ShapeInfo,
+                                      register_layer)
+
+
+def _first_mask(ins: List[Argument]):
+    for a in ins:
+        if a.mask is not None:
+            return a.mask
+    return None
+
+
+def _flat(a: Argument) -> jnp.ndarray:
+    """Flatten image (non-sequence >2D) inputs to [B, features] for
+    matmul consumers. NHWC flatten order — internal to this framework;
+    the reference flattens channel-major ([B, C*H*W])."""
+    if a.mask is None and a.value.ndim > 2:
+        return a.value.reshape(a.value.shape[0], -1)
+    return a.value
+
+
+@register_layer("data")
+class DataLayer(LayerImpl):
+    """Pass-through input layer (``DataLayer.cpp``). apply is never called —
+    the executor feeds it directly."""
+
+    def infer(self, cfg, in_infos):
+        return ShapeInfo(size=cfg.size or 0,
+                         channels=cfg.attrs.get("channels"),
+                         height=cfg.attrs.get("height"),
+                         width=cfg.attrs.get("width"),
+                         is_sequence=cfg.attrs.get("is_sequence", False))
+
+
+@register_layer("fc")
+class FcLayer(LayerImpl):
+    """y = act(sum_i x_i W_i + b). Weight layout [in, out] as in the
+    reference (``FullyConnectedLayer.cpp`` forward: out += in * W)."""
+
+    def infer(self, cfg, in_infos):
+        return ShapeInfo(size=cfg.size,
+                         is_sequence=any(i.is_sequence for i in in_infos))
+
+    def params(self, cfg, in_infos):
+        specs: Dict[str, ParamSpec] = {}
+        for i, info in enumerate(in_infos):
+            specs[f"w{i}"] = ParamSpec(shape=(info.size, cfg.size))
+        if cfg.bias:
+            specs["wbias"] = ParamSpec(shape=(cfg.size,), init="zeros",
+                                       is_bias=True)
+        return specs
+
+    def apply(self, cfg, params, ins, ctx):
+        out = None
+        for i, a in enumerate(ins):
+            y = _flat(a) @ params[f"w{i}"]
+            out = y if out is None else out + y
+        if "wbias" in params:
+            out = out + params["wbias"]
+        return Argument(value=out, mask=_first_mask(ins))
+
+
+@register_layer("embedding")
+class EmbeddingLayer(LayerImpl):
+    """Table lookup. The reference expresses this as a MixedLayer with a
+    TableProjection (``TableProjection.cpp``); row-sparse gradient handling
+    maps to sparse_grad on the table spec (``SparseRowMatrix.h:204``)."""
+
+    def infer(self, cfg, in_infos):
+        return ShapeInfo(size=cfg.size, is_sequence=in_infos[0].is_sequence)
+
+    def params(self, cfg, in_infos):
+        vocab = cfg.attrs["vocab_size"]
+        return {"w0": ParamSpec(shape=(vocab, cfg.size), sparse_grad=True)}
+
+    def apply(self, cfg, params, ins, ctx):
+        ids = ins[0].value.astype(jnp.int32)
+        out = jnp.take(params["w0"], ids, axis=0)
+        return Argument(value=out, mask=ins[0].mask)
+
+
+# --------------------------------------------------------------------- mixed
+def _project(proj: dict, x: jnp.ndarray, w) -> jnp.ndarray:
+    kind = proj.get("type", "full_matrix")
+    if kind == "full_matrix":
+        return x @ w
+    if kind == "trans_full_matrix":
+        return x @ w.T
+    if kind == "identity":
+        return x
+    if kind == "dot_mul":
+        return x * w
+    if kind == "table":
+        return jnp.take(w, x.astype(jnp.int32), axis=0)
+    if kind == "scaling":
+        return x * w[0]
+    raise KeyError(f"unknown projection type {kind!r}")
+
+
+@register_layer("mixed")
+class MixedLayer(LayerImpl):
+    """Sum of per-input projections (``MixedLayer.cpp``). Each input's
+    ``extra`` dict holds {"type": projection_type, ...}. Supported:
+    full_matrix, trans_full_matrix, identity, dot_mul, table, scaling —
+    the projection set in ``paddle/gserver/layers/*Projection.cpp``."""
+
+    def infer(self, cfg, in_infos):
+        return ShapeInfo(size=cfg.size,
+                         is_sequence=any(i.is_sequence for i in in_infos))
+
+    def params(self, cfg, in_infos):
+        projs = cfg.attrs.get("projections") or [
+            {"type": "full_matrix"} for _ in in_infos]
+        specs: Dict[str, ParamSpec] = {}
+        for i, info in enumerate(in_infos):
+            specs.update(self._param_for(i, projs[i] or {}, info, cfg))
+        if cfg.bias:
+            specs["wbias"] = ParamSpec(shape=(cfg.size,), init="zeros",
+                                       is_bias=True)
+        return specs
+
+    def _param_for(self, i, proj, info, cfg):
+        kind = proj.get("type", "full_matrix")
+        if kind == "full_matrix":
+            return {f"w{i}": ParamSpec(shape=(info.size, cfg.size))}
+        if kind == "trans_full_matrix":
+            return {f"w{i}": ParamSpec(shape=(cfg.size, info.size))}
+        if kind == "dot_mul":
+            return {f"w{i}": ParamSpec(shape=(cfg.size,), initial_mean=1.0,
+                                       initial_std=0.0, init="const")}
+        if kind == "table":
+            return {f"w{i}": ParamSpec(shape=(proj["vocab_size"], cfg.size),
+                                       sparse_grad=True)}
+        if kind == "scaling":
+            return {f"w{i}": ParamSpec(shape=(1,))}
+        return {}  # identity
+
+    def apply(self, cfg, params, ins, ctx):
+        projs = cfg.attrs.get("projections") or [
+            {"type": "full_matrix"} for _ in ins]
+        out = None
+        for i, (a, proj) in enumerate(zip(ins, projs)):
+            x = a.value if proj.get("type") == "table" else _flat(a)
+            y = _project(proj, x, params.get(f"w{i}"))
+            out = y if out is None else out + y
+        if "wbias" in params:
+            out = out + params["wbias"]
+        return Argument(value=out, mask=_first_mask(ins))
+
+
+# ------------------------------------------------------------- element-wise
+@register_layer("addto")
+class AddtoLayer(LayerImpl):
+    def infer(self, cfg, in_infos):
+        return ShapeInfo(size=in_infos[0].size,
+                         channels=in_infos[0].channels,
+                         height=in_infos[0].height, width=in_infos[0].width,
+                         is_sequence=any(i.is_sequence for i in in_infos))
+
+    def params(self, cfg, in_infos):
+        if cfg.bias:
+            return {"wbias": ParamSpec(shape=(in_infos[0].size,),
+                                       init="zeros", is_bias=True)}
+        return {}
+
+    def apply(self, cfg, params, ins, ctx):
+        out = ins[0].value
+        for a in ins[1:]:
+            out = out + a.value
+        if "wbias" in params:
+            out = out + params["wbias"]
+        return Argument(value=out, mask=_first_mask(ins))
+
+
+@register_layer("concat")
+class ConcatLayer(LayerImpl):
+    def infer(self, cfg, in_infos):
+        return ShapeInfo(size=sum(i.size for i in in_infos),
+                         is_sequence=any(i.is_sequence for i in in_infos))
+
+    def apply(self, cfg, params, ins, ctx):
+        return Argument(value=jnp.concatenate([a.value for a in ins], axis=-1),
+                        mask=_first_mask(ins))
+
+
+@register_layer("slope_intercept")
+class SlopeInterceptLayer(LayerImpl):
+    def infer(self, cfg, in_infos):
+        return in_infos[0]
+
+    def apply(self, cfg, params, ins, ctx):
+        slope = cfg.attrs.get("slope", 1.0)
+        intercept = cfg.attrs.get("intercept", 0.0)
+        return ins[0].with_value(slope * ins[0].value + intercept)
+
+
+@register_layer("scaling")
+class ScalingLayer(LayerImpl):
+    """out[i] = w[i] * x[i], weight input first ([B,1]), data input second
+    (``ScalingLayer.cpp``)."""
+
+    def infer(self, cfg, in_infos):
+        return in_infos[1]
+
+    def apply(self, cfg, params, ins, ctx):
+        w, x = ins
+        return Argument(value=w.value * x.value, mask=x.mask)
+
+
+@register_layer("interpolation")
+class InterpolationLayer(LayerImpl):
+    """out = w*x1 + (1-w)*x2; inputs [w [B,1], x1, x2]
+    (``InterpolationLayer.cpp``)."""
+
+    def infer(self, cfg, in_infos):
+        return in_infos[1]
+
+    def apply(self, cfg, params, ins, ctx):
+        w, x1, x2 = ins
+        return Argument(value=w.value * x1.value + (1.0 - w.value) * x2.value,
+                        mask=x1.mask)
+
+
+@register_layer("maxid")
+class MaxIdLayer(LayerImpl):
+    def infer(self, cfg, in_infos):
+        return ShapeInfo(size=1, is_sequence=in_infos[0].is_sequence)
+
+    def apply(self, cfg, params, ins, ctx):
+        ids = jnp.argmax(ins[0].value, axis=-1)
+        return Argument(value=ids, mask=ins[0].mask)
+
+
+@register_layer("cos")
+class CosSimLayer(LayerImpl):
+    """Row-wise cosine similarity scaled by ``cos_scale``
+    (``CosSimLayer.cpp``)."""
+
+    def infer(self, cfg, in_infos):
+        return ShapeInfo(size=1, is_sequence=any(i.is_sequence for i in in_infos))
+
+    def apply(self, cfg, params, ins, ctx):
+        a, b = ins[0].value, ins[1].value
+        scale = cfg.attrs.get("cos_scale", 1.0)
+        dot = jnp.sum(a * b, axis=-1, keepdims=True)
+        na = jnp.sqrt(jnp.sum(a * a, axis=-1, keepdims=True) + 1e-12)
+        nb = jnp.sqrt(jnp.sum(b * b, axis=-1, keepdims=True) + 1e-12)
+        return Argument(value=scale * dot / (na * nb), mask=_first_mask(ins))
+
+
+@register_layer("trans")
+class TransLayer(LayerImpl):
+    """Matrix transpose of the [B, N] batch viewed as a matrix
+    (``TransLayer.cpp``); used by attention-style constructs."""
+
+    def infer(self, cfg, in_infos):
+        return ShapeInfo(size=in_infos[0].size)
+
+    def apply(self, cfg, params, ins, ctx):
+        return Argument(value=ins[0].value.T)

@@ -1,0 +1,136 @@
+"""Convolution layers.
+
+Covers the reference's conv family — ``ExpandConvLayer`` (im2col+gemm),
+``CudnnConvLayer``, ``ExpandConvTransLayer``, depthwise — registered there as
+"exconv"/"cudnn_conv"/"exconvt" (``paddle/gserver/layers/ExpandConvLayer.cpp``,
+``paddle/function/ConvOp*``). On TPU all of them are one primitive:
+``lax.conv_general_dilated``, which XLA lowers straight onto the MXU; groups
+map to ``feature_group_count`` (depthwise = groups == channels).
+
+Layout: images flow between layers as NHWC (TPU-native). The reference's
+flat ``[B, C*H*W]`` channel-major rows (how DataProviders feed images) are
+accepted at any image layer and reshaped once.
+
+Input ``extra`` keys (the reference's ``ConvConfig`` in ModelConfig.proto):
+filter_size[_y], stride[_y], padding[_y], groups, channels.
+Layer ``attrs``: num_filters, and for conv-trans output geometry.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.argument import Argument
+from paddle_tpu.core.registry import (LayerImpl, ParamSpec, ShapeInfo,
+                                      register_layer)
+
+
+def to_nhwc(x: jnp.ndarray, channels: int, height: int, width: int):
+    """Accept [B, C*H*W] (reference channel-major rows) or [B,H,W,C]."""
+    if x.ndim == 2:
+        b = x.shape[0]
+        return x.reshape(b, channels, height, width).transpose(0, 2, 3, 1)
+    return x
+
+
+def _conv_geom(in_sz: int, filt: int, pad: int, stride: int) -> int:
+    # reference formula, caffe-style (config_parser.cg_image_size)
+    return (in_sz + 2 * pad - filt) // stride + 1
+
+
+def _conv_spec(inp_extra: dict, in_info: ShapeInfo):
+    fs = inp_extra["filter_size"]
+    fsy = inp_extra.get("filter_size_y", fs)
+    st = inp_extra.get("stride", 1)
+    sty = inp_extra.get("stride_y", st)
+    pad = inp_extra.get("padding", 0)
+    pady = inp_extra.get("padding_y", pad)
+    groups = inp_extra.get("groups", 1)
+    c = inp_extra.get("channels") or in_info.channels
+    return fs, fsy, st, sty, pad, pady, groups, c
+
+
+@register_layer("exconv", "cudnn_conv", "conv")
+class ConvLayer(LayerImpl):
+    def infer(self, cfg, in_infos):
+        nf = cfg.attrs["num_filters"]
+        fs, fsy, st, sty, pad, pady, groups, c = _conv_spec(
+            cfg.inputs[0].extra, in_infos[0])
+        h = _conv_geom(in_infos[0].height, fsy, pady, sty)
+        w = _conv_geom(in_infos[0].width, fs, pad, st)
+        return ShapeInfo(size=nf * h * w, channels=nf, height=h, width=w)
+
+    def params(self, cfg, in_infos):
+        nf = cfg.attrs["num_filters"]
+        specs = {}
+        for i, info in enumerate(in_infos):
+            fs, fsy, st, sty, pad, pady, groups, c = _conv_spec(
+                cfg.inputs[i].extra, info)
+            specs[f"w{i}"] = ParamSpec(shape=(fsy, fs, c // groups, nf))
+        if cfg.bias:
+            specs["wbias"] = ParamSpec(shape=(nf,), init="zeros", is_bias=True)
+        return specs
+
+    def apply(self, cfg, params, ins, ctx):
+        out = None
+        for i, a in enumerate(ins):
+            info = ctx.in_infos[i]
+            fs, fsy, st, sty, pad, pady, groups, c = _conv_spec(
+                cfg.inputs[i].extra, info)
+            x = to_nhwc(a.value, c, info.height, info.width)
+            y = lax.conv_general_dilated(
+                x, params[f"w{i}"],
+                window_strides=(sty, st),
+                padding=((pady, pady), (pad, pad)),
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                feature_group_count=groups,
+            )
+            out = y if out is None else out + y
+        if "wbias" in params:
+            out = out + params["wbias"]
+        return Argument(value=out)
+
+
+@register_layer("exconvt", "cudnn_convt")
+class ConvTransLayer(LayerImpl):
+    """Transposed conv (``ExpandConvTransLayer.cpp``); output geometry is the
+    conv-geometry inverse, as the reference computes in config_parser."""
+
+    def infer(self, cfg, in_infos):
+        nf = cfg.attrs["num_filters"]
+        fs, fsy, st, sty, pad, pady, groups, c = _conv_spec(
+            cfg.inputs[0].extra, in_infos[0])
+        h = (in_infos[0].height - 1) * sty + fsy - 2 * pady
+        w = (in_infos[0].width - 1) * st + fs - 2 * pad
+        return ShapeInfo(size=nf * h * w, channels=nf, height=h, width=w)
+
+    def params(self, cfg, in_infos):
+        nf = cfg.attrs["num_filters"]
+        specs = {}
+        for i, info in enumerate(in_infos):
+            fs, fsy, st, sty, pad, pady, groups, c = _conv_spec(
+                cfg.inputs[i].extra, info)
+            # gradient-of-conv layout: treat as conv from nf -> c
+            specs[f"w{i}"] = ParamSpec(shape=(fsy, fs, nf // groups, c))
+        if cfg.bias:
+            specs["wbias"] = ParamSpec(shape=(nf,), init="zeros", is_bias=True)
+        return specs
+
+    def apply(self, cfg, params, ins, ctx):
+        out = None
+        for i, a in enumerate(ins):
+            info = ctx.in_infos[i]
+            fs, fsy, st, sty, pad, pady, groups, c = _conv_spec(
+                cfg.inputs[i].extra, info)
+            x = to_nhwc(a.value, c, info.height, info.width)
+            y = lax.conv_transpose(
+                x, params[f"w{i}"],
+                strides=(sty, st),
+                padding=((pady, pady), (pad, pad)),
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            )
+            out = y if out is None else out + y
+        if "wbias" in params:
+            out = out + params["wbias"]
+        return Argument(value=out)

@@ -1,0 +1,138 @@
+"""Cost layers. Mirrors ``paddle/gserver/layers/CostLayer.cpp``.
+
+Every cost layer emits a per-sample cost ``[B, 1]``; for sequence inputs the
+per-token cost is mask-summed over time first (the reference sums over the
+ragged token rows). The trainer averages over the batch — matching
+``Argument::sum(outArgs)/batchSize`` in ``TrainerInternal.cpp``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.argument import Argument
+from paddle_tpu.core.registry import LayerImpl, ParamSpec, ShapeInfo, register_layer
+
+_EPS = 1e-10
+
+
+def _reduce_tokens(cost, mask):
+    """[B,T] token costs + mask -> [B,1]; [B] -> [B,1]."""
+    if cost.ndim == 2 and mask is not None:
+        cost = jnp.sum(cost * mask, axis=1)
+    return cost.reshape(-1, 1)
+
+
+class _CostBase(LayerImpl):
+    def infer(self, cfg, in_infos):
+        return ShapeInfo(size=1)
+
+
+@register_layer("multi-class-cross-entropy")
+class CrossEntropyCost(_CostBase):
+    """-log p[label]; input 0 = probabilities (post-softmax), input 1 = int
+    labels (``CostLayer.cpp`` MultiClassCrossEntropy)."""
+
+    def apply(self, cfg, params, ins, ctx):
+        prob, label = ins[0], ins[1]
+        p = jnp.clip(prob.value, _EPS, 1.0)
+        lab = label.value.astype(jnp.int32)
+        ll = jnp.take_along_axis(p, lab[..., None], axis=-1)[..., 0]
+        cost = -jnp.log(ll)
+        return Argument(value=_reduce_tokens(cost, prob.mask))
+
+
+@register_layer("soft_binary_class_cross_entropy")
+class SoftBinaryCrossEntropyCost(_CostBase):
+    """sum_j -(t log p + (1-t) log(1-p)); soft targets same shape as input."""
+
+    def apply(self, cfg, params, ins, ctx):
+        p = jnp.clip(ins[0].value, _EPS, 1.0 - _EPS)
+        t = ins[1].value
+        cost = -jnp.sum(t * jnp.log(p) + (1 - t) * jnp.log1p(-p), axis=-1)
+        return Argument(value=_reduce_tokens(cost, ins[0].mask))
+
+
+@register_layer("multi_binary_label_cross_entropy")
+class MultiBinaryLabelCrossEntropyCost(_CostBase):
+    """Multi-label: input sigmoid probs, labels 0/1 matrix."""
+
+    def apply(self, cfg, params, ins, ctx):
+        p = jnp.clip(ins[0].value, _EPS, 1.0 - _EPS)
+        t = ins[1].value
+        cost = -jnp.sum(t * jnp.log(p) + (1 - t) * jnp.log1p(-p), axis=-1)
+        return Argument(value=_reduce_tokens(cost, ins[0].mask))
+
+
+@register_layer("square_error")
+class SquareErrorCost(_CostBase):
+    """0.5 * ||x - y||^2 per sample (SumOfSquaresCostLayer)."""
+
+    def apply(self, cfg, params, ins, ctx):
+        d = ins[0].value - ins[1].value
+        cost = 0.5 * jnp.sum(jnp.square(d), axis=-1)
+        return Argument(value=_reduce_tokens(cost, ins[0].mask))
+
+
+@register_layer("smooth_l1")
+class SmoothL1Cost(_CostBase):
+    """Smooth-L1 (Huber with delta=1) summed over features
+    (``SmoothL1CostLayer``)."""
+
+    def apply(self, cfg, params, ins, ctx):
+        d = ins[0].value - ins[1].value
+        a = jnp.abs(d)
+        cost = jnp.sum(jnp.where(a < 1.0, 0.5 * d * d, a - 0.5), axis=-1)
+        return Argument(value=_reduce_tokens(cost, ins[0].mask))
+
+
+@register_layer("huber_classification")
+class HuberTwoClassCost(_CostBase):
+    """Huber loss for binary classification with labels {0,1} mapped to
+    y in {-1,+1} (``HuberTwoClassification`` in CostLayer.cpp)."""
+
+    def apply(self, cfg, params, ins, ctx):
+        x = ins[0].value[..., 0]
+        y = 2.0 * ins[1].value.astype(x.dtype) - 1.0
+        yx = y * x
+        cost = jnp.where(yx < -1.0, -4.0 * yx,
+                         jnp.where(yx < 1.0, jnp.square(1.0 - yx), 0.0))
+        return Argument(value=_reduce_tokens(cost, ins[0].mask))
+
+
+@register_layer("rank-cost")
+class RankCost(_CostBase):
+    """Pairwise ranking cost (RankingCost in CostLayer.cpp): inputs
+    (score_left, score_right, label in [0,1]); cost = cross-entropy of
+    sigmoid(left-right) vs label."""
+
+    def apply(self, cfg, params, ins, ctx):
+        o = ins[0].value[..., 0] - ins[1].value[..., 0]
+        t = ins[2].value.astype(o.dtype)
+        if t.ndim > o.ndim:
+            t = t[..., 0]
+        cost = jax.nn.softplus(o) - t * o  # -t*o + log(1+e^o)
+        return Argument(value=_reduce_tokens(cost, ins[0].mask))
+
+
+@register_layer("lambda_cost")
+class LambdaCost(_CostBase):
+    """LambdaRank NDCG cost (``LambdaCost.cpp``): one "sample" per list
+    (sequence); score input + relevance-label input. Differentiable
+    surrogate: pairwise logistic weighted by |delta NDCG| is deferred; this
+    implements the standard pairwise-logistic lambda loss over the masked
+    list, which matches the reference's gradient structure."""
+
+    def apply(self, cfg, params, ins, ctx):
+        score = ins[0].value[..., 0]  # [B, T]
+        rel = ins[1].value
+        if rel.ndim == 3:
+            rel = rel[..., 0]
+        mask = ins[0].mask
+        pair_valid = mask[:, :, None] * mask[:, None, :]
+        s_diff = score[:, :, None] - score[:, None, :]
+        r_diff = rel[:, :, None] - rel[:, None, :]
+        better = (r_diff > 0).astype(score.dtype) * pair_valid
+        cost = jnp.sum(better * jax.nn.softplus(-s_diff), axis=(1, 2))
+        return Argument(value=cost.reshape(-1, 1))

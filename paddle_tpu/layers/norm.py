@@ -1,0 +1,90 @@
+"""Normalization layers: batch_norm and cross-map response norm.
+
+``BatchNormalizationLayer``/``CudnnBatchNormLayer`` (``paddle/gserver/layers/
+BatchNorm*Layer.cpp``): scale+shift per channel, batch statistics in
+training, moving statistics at test. The reference keeps moving mean/var as
+two *static* parameters (inputs 1 and 2 of the layer); here they are static
+entries in the parameter dict (``w1moving``, ``w2moving``) and the training
+apply records their EMA update in ``ctx.state_updates`` — the train step
+applies those updates functionally (no mutation inside jit).
+
+``CMRProjectionNormLayer`` ("norm" with norm_type cmrnorm-projection):
+AlexNet-style local response normalization across channel windows.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.argument import Argument
+from paddle_tpu.core.registry import (LayerImpl, ParamSpec, ShapeInfo,
+                                      register_layer)
+from paddle_tpu.layers.conv import to_nhwc
+
+
+@register_layer("batch_norm", "cudnn_batch_norm", "batch_normalization")
+class BatchNormLayer(LayerImpl):
+    def infer(self, cfg, in_infos):
+        return in_infos[0]
+
+    def params(self, cfg, in_infos):
+        c = in_infos[0].channels or in_infos[0].size
+        return {
+            "w0": ParamSpec(shape=(c,), init="const", initial_mean=1.0,
+                            initial_std=0.0),
+            "wbias": ParamSpec(shape=(c,), init="zeros", is_bias=True),
+            "w1moving": ParamSpec(shape=(c,), init="zeros", is_static=True),
+            "w2moving": ParamSpec(shape=(c,), init="const", initial_mean=1.0,
+                                  is_static=True),
+        }
+
+    def apply(self, cfg, params, ins, ctx):
+        info = ctx.in_infos[0]
+        eps = cfg.attrs.get("epsilon", 1e-5)
+        momentum = cfg.attrs.get("moving_average_fraction", 0.9)
+        use_global = cfg.attrs.get("use_global_stats", None)
+        img = info.channels is not None
+        x = (to_nhwc(ins[0].value, info.channels, info.height, info.width)
+             if img else ins[0].value)
+        axes = tuple(range(x.ndim - 1))
+        if use_global is None:
+            use_global = not ctx.train
+        if use_global:
+            mean, var = params["w1moving"], params["w2moving"]
+        else:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.mean(jnp.square(x - mean), axis=axes)
+        y = (x - mean) * lax.rsqrt(var + eps) * params["w0"] + params["wbias"]
+        if ctx.train and not use_global:
+            lname = cfg.name
+            ctx.state_updates[f"_{lname}.w1moving"] = (
+                momentum * params["w1moving"] + (1.0 - momentum) * mean)
+            ctx.state_updates[f"_{lname}.w2moving"] = (
+                momentum * params["w2moving"] + (1.0 - momentum) * var)
+        return Argument(value=y, mask=ins[0].mask)
+
+
+@register_layer("norm", "cmrnorm-projection")
+class CrossMapNormLayer(LayerImpl):
+    """Local response normalization across a window of ``size`` channels:
+    out = x * (1 + alpha/size * sum_{window} x^2)^{-beta}  — matching the
+    reference's scale formula (``paddle/function/CrossMapNormalOp.cpp``)."""
+
+    def infer(self, cfg, in_infos):
+        return in_infos[0]
+
+    def apply(self, cfg, params, ins, ctx):
+        info = ctx.in_infos[0]
+        extra = cfg.inputs[0].extra
+        size = extra.get("size", 5)
+        alpha = extra.get("scale", 1e-4) * size
+        beta = extra.get("pow", 0.75)
+        x = to_nhwc(ins[0].value, info.channels, info.height, info.width)
+        sq = jnp.square(x)
+        half = size // 2
+        acc = lax.reduce_window(
+            sq, 0.0, lax.add, (1, 1, 1, size), (1, 1, 1, 1),
+            ((0, 0), (0, 0), (0, 0), (half, size - 1 - half)))
+        scale = jnp.power(1.0 + (alpha / size) * acc, -beta)
+        return Argument(value=x * scale, mask=ins[0].mask)

@@ -1,0 +1,105 @@
+"""Spatial pooling layers (``paddle/gserver/layers/PoolLayer.cpp``,
+``PoolProjectionLayer``, SPP). Types "max-projection"/"avg-projection"
+(aka max/avg pooling) via ``lax.reduce_window``, which XLA maps onto the VPU.
+
+Input ``extra``: pool_type, filter (size_x[_y]), stride[_y], padding[_y],
+channels; reference geometry uses ceil mode (``cg_image_size`` with
+ceil) for pooling.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.argument import Argument
+from paddle_tpu.core.registry import LayerImpl, ShapeInfo, register_layer
+from paddle_tpu.layers.conv import to_nhwc
+
+
+def _pool_geom(in_sz: int, filt: int, pad: int, stride: int) -> int:
+    # reference uses caffe ceil mode for pool output (config_parser)
+    return max(1, int(math.ceil((in_sz + 2 * pad - filt) / float(stride))) + 1)
+
+
+def _spec(extra, info):
+    fs = extra.get("size_x") or extra["filter_size"]
+    fsy = extra.get("size_y", fs)
+    st = extra.get("stride", 1)
+    sty = extra.get("stride_y", st)
+    pad = extra.get("padding", 0)
+    pady = extra.get("padding_y", pad)
+    c = extra.get("channels") or info.channels
+    return fs, fsy, st, sty, pad, pady, c
+
+
+@register_layer("pool", "cudnn_pool")
+class PoolLayer(LayerImpl):
+    def infer(self, cfg, in_infos):
+        fs, fsy, st, sty, pad, pady, c = _spec(cfg.inputs[0].extra, in_infos[0])
+        h = _pool_geom(in_infos[0].height, fsy, pady, sty)
+        w = _pool_geom(in_infos[0].width, fs, pad, st)
+        return ShapeInfo(size=c * h * w, channels=c, height=h, width=w)
+
+    def apply(self, cfg, params, ins, ctx):
+        info = ctx.in_infos[0]
+        fs, fsy, st, sty, pad, pady, c = _spec(cfg.inputs[0].extra, info)
+        ptype = cfg.inputs[0].extra.get("pool_type", "max-projection")
+        x = to_nhwc(ins[0].value, c, info.height, info.width)
+        oh, ow = ctx.out_info.height, ctx.out_info.width
+        # pad so that ceil-mode windows fit: right/bottom pad up to need
+        need_h = (oh - 1) * sty + fsy - info.height
+        need_w = (ow - 1) * st + fs - info.width
+        pads = ((pady, max(need_h - pady, 0)), (pad, max(need_w - pad, 0)))
+        if "max" in ptype:
+            init = -jnp.inf
+            y = lax.reduce_window(
+                x, init, lax.max, (1, fsy, fs, 1), (1, sty, st, 1),
+                ((0, 0),) + pads + ((0, 0),))
+        else:
+            y = lax.reduce_window(
+                x, 0.0, lax.add, (1, fsy, fs, 1), (1, sty, st, 1),
+                ((0, 0),) + pads + ((0, 0),))
+            # reference avg pool divides by window size excluding padding
+            ones = jnp.ones((1, info.height, info.width, 1), x.dtype)
+            cnt = lax.reduce_window(
+                ones, 0.0, lax.add, (1, fsy, fs, 1), (1, sty, st, 1),
+                ((0, 0),) + pads + ((0, 0),))
+            y = y / jnp.maximum(cnt, 1.0)
+        return Argument(value=y)
+
+
+@register_layer("spp")
+class SppLayer(LayerImpl):
+    """Spatial pyramid pooling (``SpatialPyramidPoolLayer.cpp``): concat of
+    pyramid_height levels of adaptive max/avg pooling, flattened."""
+
+    def infer(self, cfg, in_infos):
+        c = in_infos[0].channels
+        levels = cfg.attrs.get("pyramid_height", 3)
+        bins = sum(4 ** l for l in range(levels))
+        return ShapeInfo(size=c * bins)
+
+    def apply(self, cfg, params, ins, ctx):
+        info = ctx.in_infos[0]
+        x = to_nhwc(ins[0].value, info.channels, info.height, info.width)
+        levels = cfg.attrs.get("pyramid_height", 3)
+        ptype = cfg.attrs.get("pool_type", "max-projection")
+        outs = []
+        for l in range(levels):
+            n = 2 ** l
+            h, w = x.shape[1], x.shape[2]
+            fh, fw = -(-h // n), -(-w // n)
+            pad_h, pad_w = fh * n - h, fw * n - w
+            if "max" in ptype:
+                y = lax.reduce_window(
+                    x, -jnp.inf, lax.max, (1, fh, fw, 1), (1, fh, fw, 1),
+                    ((0, 0), (0, pad_h), (0, pad_w), (0, 0)))
+            else:
+                y = lax.reduce_window(
+                    x, 0.0, lax.add, (1, fh, fw, 1), (1, fh, fw, 1),
+                    ((0, 0), (0, pad_h), (0, pad_w), (0, 0))) / (fh * fw)
+            outs.append(y.reshape(y.shape[0], -1))
+        return Argument(value=jnp.concatenate(outs, axis=-1))

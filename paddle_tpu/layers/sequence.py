@@ -1,0 +1,139 @@
+"""Sequence aggregation / manipulation layers.
+
+The reference implements these over ragged offset vectors
+(``paddle/gserver/layers/{MaxLayer,AverageLayer,SequenceLastInstanceLayer,
+ExpandLayer,SequencePoolLayer}.cpp`` on ``sequenceStartPositions``); here they
+are masked reductions over the padded [B, T, D] layout — embarrassingly
+parallel on the VPU, no scatter/gather.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.argument import Argument
+from paddle_tpu.core.registry import LayerImpl, ShapeInfo, register_layer
+
+_NEG_INF = -1e30
+
+
+def _pooled_info(cfg, in_infos):
+    return ShapeInfo(size=in_infos[0].size, is_sequence=False)
+
+
+@register_layer("max")
+class MaxLayer(LayerImpl):
+    """Max over time of each sequence (``MaxLayer.cpp``)."""
+
+    def infer(self, cfg, in_infos):
+        return _pooled_info(cfg, in_infos)
+
+    def apply(self, cfg, params, ins, ctx):
+        a = ins[0]
+        v = jnp.where(a.mask[..., None] > 0, a.value, _NEG_INF)
+        return Argument(value=jnp.max(v, axis=1))
+
+
+@register_layer("average")
+class AverageLayer(LayerImpl):
+    """Mean/sum/sqrt-n over time (``AverageLayer.cpp``; average_strategy in
+    ModelConfig)."""
+
+    def infer(self, cfg, in_infos):
+        return _pooled_info(cfg, in_infos)
+
+    def apply(self, cfg, params, ins, ctx):
+        a = ins[0]
+        strategy = cfg.attrs.get("average_strategy", "average")
+        s = jnp.sum(a.value * a.mask[..., None], axis=1)
+        n = jnp.maximum(jnp.sum(a.mask, axis=1, keepdims=True), 1.0)
+        if strategy == "sum":
+            return Argument(value=s)
+        if strategy == "squarerootn":
+            return Argument(value=s / jnp.sqrt(n))
+        return Argument(value=s / n)
+
+
+@register_layer("seqlastins")
+class SeqLastInsLayer(LayerImpl):
+    """Last (or first, with select_first) token of each sequence
+    (``SequenceLastInstanceLayer.cpp``)."""
+
+    def infer(self, cfg, in_infos):
+        return _pooled_info(cfg, in_infos)
+
+    def apply(self, cfg, params, ins, ctx):
+        a = ins[0]
+        if cfg.attrs.get("select_first", False):
+            idx = jnp.zeros((a.batch_size,), jnp.int32)
+        else:
+            idx = jnp.maximum(a.seq_lengths() - 1, 0)
+        v = jnp.take_along_axis(
+            a.value, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]
+        return Argument(value=v)
+
+
+@register_layer("expand")
+class ExpandLayer(LayerImpl):
+    """Broadcast a per-sequence vector (input 0, non-seq) across the
+    timesteps of input 1 (``ExpandLayer.cpp``)."""
+
+    def infer(self, cfg, in_infos):
+        return ShapeInfo(size=in_infos[0].size, is_sequence=True)
+
+    def apply(self, cfg, params, ins, ctx):
+        src, ref = ins
+        T = ref.value.shape[1]
+        v = jnp.broadcast_to(
+            src.value[:, None, :],
+            (src.value.shape[0], T, src.value.shape[-1]))
+        return Argument(value=v * ref.mask[..., None], mask=ref.mask)
+
+
+@register_layer("seqreshape")
+class SeqReshapeLayer(LayerImpl):
+    """Reshape the feature dim of a sequence (``SequenceReshapeLayer.cpp``):
+    [B, T, D] -> [B, T*D//size, size] with the mask recomputed from true
+    token counts (token count * D must divide size)."""
+
+    def infer(self, cfg, in_infos):
+        return ShapeInfo(size=cfg.size, is_sequence=True)
+
+    def apply(self, cfg, params, ins, ctx):
+        a = ins[0]
+        b, t, d = a.value.shape
+        new_t = t * d // cfg.size
+        v = a.value.reshape(b, new_t, cfg.size)
+        toks = a.seq_lengths() * d // cfg.size
+        mask = (jnp.arange(new_t)[None, :] < toks[:, None]).astype(a.mask.dtype)
+        return Argument(value=v, mask=mask)
+
+
+@register_layer("seqconcat")
+class SeqConcatLayer(LayerImpl):
+    """Concatenate two equal-length sequence inputs feature-wise per step
+    — reference "seqconcat" concatenates *in time*; time-concat of padded
+    batches: place seq2 after seq1's true length."""
+
+    def infer(self, cfg, in_infos):
+        return ShapeInfo(size=in_infos[0].size, is_sequence=True)
+
+    def apply(self, cfg, params, ins, ctx):
+        a, b = ins
+        B, Ta, D = a.value.shape
+        Tb = b.value.shape[1]
+        la = a.seq_lengths()
+        lb = b.seq_lengths()
+        T = Ta + Tb
+        pos = jnp.arange(T)[None, :]
+        total = (la + lb)[:, None]
+        mask = (pos < total).astype(a.mask.dtype)
+        # index map: for pos < la -> a[pos]; else -> b[pos - la]
+        idx_a = jnp.clip(pos, 0, Ta - 1)
+        idx_b = jnp.clip(pos - la[:, None], 0, Tb - 1)
+        va = jnp.take_along_axis(a.value, idx_a[..., None].astype(jnp.int32)
+                                 .repeat(D, -1), axis=1)
+        vb = jnp.take_along_axis(b.value, idx_b[..., None].astype(jnp.int32)
+                                 .repeat(D, -1), axis=1)
+        v = jnp.where((pos < la[:, None])[..., None], va, vb) * mask[..., None]
+        return Argument(value=v, mask=mask)

@@ -1,0 +1,260 @@
+"""Optimizers with reference v1 semantics, as pure pytree transforms.
+
+Update formulas match the fused kernels in
+``paddle/math/TrainingAlgorithmOp.cu`` (adadelta ``:43``, adagrad ``:66``,
+rmsprop ``:86``, decayed-adagrad ``:117``, adam ``:146``, adamax ``:166``)
+and the optimizer classes in ``paddle/parameter/FirstOrderOptimizer.h``.
+L2 regularization enters the update as ``decayRate`` exactly as there
+(``grad + value*decayRate``); L1 is a post-update shrink
+(``OptimizerWithRegularizer``). Per-parameter lr multipliers and static
+params mirror ``ParameterConfig.learning_rate`` / ``is_static``.
+
+The whole update is one jitted pytree map — the TPU replacement for the
+reference's per-block pserver/threaded updaters.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.registry import ParamSpec
+
+
+@dataclasses.dataclass
+class Optimizer:
+    """Base: shared hyper-parameters (``OptimizationConfig`` in
+    proto/TrainerConfig.proto)."""
+
+    learning_rate: float = 1e-3
+    learning_rate_schedule: str = "constant"
+    learning_rate_decay_a: float = 0.0
+    learning_rate_decay_b: float = 0.0
+    l1_rate: float = 0.0
+    l2_rate: float = 0.0
+    gradient_clipping_threshold: float = 0.0
+    # model averaging (``AverageOptimizer``): do_average window in [0, +)
+    average_window: float = 0.0
+
+    # -- per-subclass ---------------------------------------------------
+    def slot_names(self):
+        return []
+
+    def _apply_one(self, p, g, slots, lr, decay, t):
+        raise NotImplementedError
+
+    # -- public ---------------------------------------------------------
+    def init(self, params: Dict[str, jnp.ndarray],
+             meta: Optional[Dict[str, ParamSpec]] = None) -> Dict[str, Any]:
+        slots = {
+            name: {s: jnp.zeros_like(p) for s in self.slot_names()}
+            for name, p in params.items()
+            if not (meta and meta.get(name) and meta[name].is_static)
+        }
+        state = {"slots": slots, "t": jnp.zeros((), jnp.int32),
+                 "num_samples": jnp.zeros((), jnp.float32)}
+        if self.average_window > 0:
+            state["avg"] = {n: jnp.zeros_like(p) for n, p in params.items()
+                            if n in slots}
+        return state
+
+    def update(self, grads, state, params,
+               meta: Optional[Dict[str, ParamSpec]] = None,
+               batch_size=1):
+        """(grads, state, params) -> (new_params, new_state). meta carries
+        per-param lr multipliers / static flags / l1-l2 overrides."""
+        from paddle_tpu.optim.schedules import learning_rate_at
+
+        t = state["t"] + 1
+        num_samples = state["num_samples"] + batch_size
+        lr_t = learning_rate_at(
+            self.learning_rate_schedule, self.learning_rate,
+            self.learning_rate_decay_a, self.learning_rate_decay_b,
+            num_samples)
+
+        new_params = dict(params)
+        new_slots = {}
+        for name, g in grads.items():
+            if name not in state["slots"]:
+                new_params[name] = params[name]
+                continue
+            spec = meta.get(name) if meta else None
+            lr_mult = spec.learning_rate if spec else 1.0
+            l2 = spec.l2_rate if spec and spec.l2_rate is not None else self.l2_rate
+            l1 = spec.l1_rate if spec and spec.l1_rate is not None else self.l1_rate
+            p = params[name]
+            if self.gradient_clipping_threshold > 0:
+                # reference clips per-parameter by value threshold
+                # (FirstOrderOptimizer.h, clipping in SgdOptimizer variants)
+                th = self.gradient_clipping_threshold
+                g = jnp.clip(g, -th, th)
+            p_new, slots_new = self._apply_one(
+                p, g, state["slots"][name], lr_t * lr_mult, l2, t)
+            if l1 > 0:
+                shrink = l1 * lr_t * lr_mult
+                p_new = jnp.sign(p_new) * jnp.maximum(
+                    jnp.abs(p_new) - shrink, 0.0)
+            new_params[name] = p_new
+            new_slots[name] = slots_new
+
+        new_state = {"slots": new_slots, "t": t, "num_samples": num_samples}
+        if "avg" in state:
+            # AverageOptimizer.h:23 — running average of parameter values
+            w = jnp.minimum(jnp.float32(t), jnp.float32(
+                max(self.average_window, 1.0)))
+            new_state["avg"] = {
+                n: state["avg"][n] + (new_params[n] - state["avg"][n]) / w
+                for n in new_slots}
+        return new_params, new_state
+
+
+@dataclasses.dataclass
+class Momentum(Optimizer):
+    """Classic v1 SGD+momentum (``sgdUpdate``):
+    mom = momentum*mom - lr*(grad + decayRate*value); value += mom."""
+
+    momentum: float = 0.0
+
+    def slot_names(self):
+        return ["mom"]
+
+    def _apply_one(self, p, g, slots, lr, decay, t):
+        mom = self.momentum * slots["mom"] - lr * (g + decay * p)
+        return p + mom, {"mom": mom}
+
+
+@dataclasses.dataclass
+class AdaGrad(Optimizer):
+    """``adagradApply`` (TrainingAlgorithmOp.cu:66)."""
+
+    momentum: float = 0.0
+    epsilon: float = 1e-6
+
+    def slot_names(self):
+        return ["mom", "accum"]
+
+    def _apply_one(self, p, g, slots, lr, decay, t):
+        accum = slots["accum"] + jnp.square(g)
+        scale = jax.lax.rsqrt(accum + self.epsilon)
+        mom = self.momentum * slots["mom"] - lr * scale * (g + decay * p)
+        return p + mom, {"mom": mom, "accum": accum}
+
+
+@dataclasses.dataclass
+class AdaDelta(Optimizer):
+    """``adadeltaApply`` (TrainingAlgorithmOp.cu:43)."""
+
+    rou: float = 0.95
+    epsilon: float = 1e-6
+    momentum: float = 0.0
+
+    def slot_names(self):
+        return ["mom", "accum", "accum_update"]
+
+    def _apply_one(self, p, g, slots, lr, decay, t):
+        accum = self.rou * slots["accum"] + (1 - self.rou) * jnp.square(g)
+        lr_vec = jnp.sqrt((slots["accum_update"] + self.epsilon)
+                          / (accum + self.epsilon))
+        accum_update = (self.rou * slots["accum_update"]
+                        + (1 - self.rou) * jnp.square(g * lr_vec))
+        mom = self.momentum * slots["mom"] - lr * lr_vec * (g + decay * p)
+        return p + mom, {"mom": mom, "accum": accum,
+                         "accum_update": accum_update}
+
+
+@dataclasses.dataclass
+class RMSProp(Optimizer):
+    """``rmspropApply`` (TrainingAlgorithmOp.cu:86): centered RMSProp with
+    mean-subtracted second moment."""
+
+    rou: float = 0.95
+    epsilon: float = 1e-6
+    momentum: float = 0.0
+
+    def slot_names(self):
+        return ["mom", "g", "f"]
+
+    def _apply_one(self, p, g, slots, lr, decay, t):
+        acc_g = self.rou * slots["g"] + (1 - self.rou) * jnp.square(g)
+        acc_f = self.rou * slots["f"] + (1 - self.rou) * g
+        scale = jax.lax.rsqrt(acc_g - jnp.square(acc_f) + self.epsilon)
+        mom = self.momentum * slots["mom"] - lr * scale * (g + decay * p)
+        return p + mom, {"mom": mom, "g": acc_g, "f": acc_f}
+
+
+@dataclasses.dataclass
+class DecayedAdaGrad(Optimizer):
+    """``decayedAdagradApply`` (TrainingAlgorithmOp.cu:117)."""
+
+    rou: float = 0.95
+    epsilon: float = 1e-6
+    momentum: float = 0.0
+
+    def slot_names(self):
+        return ["mom", "accum"]
+
+    def _apply_one(self, p, g, slots, lr, decay, t):
+        accum = self.rou * slots["accum"] + (1 - self.rou) * jnp.square(g)
+        scale = jax.lax.rsqrt(accum + self.epsilon)
+        mom = self.momentum * slots["mom"] - lr * scale * (g + decay * p)
+        return p + mom, {"mom": mom, "accum": accum}
+
+
+@dataclasses.dataclass
+class Adam(Optimizer):
+    """``adamApply`` (TrainingAlgorithmOp.cu:146). decay enters via grad as
+    in ``AdamOptimizer::update`` (FirstOrderOptimizer.h)."""
+
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def slot_names(self):
+        return ["mom", "v"]
+
+    def _apply_one(self, p, g, slots, lr, decay, t):
+        g = g + decay * p
+        mom = self.beta1 * slots["mom"] + (1 - self.beta1) * g
+        v = self.beta2 * slots["v"] + (1 - self.beta2) * jnp.square(g)
+        tf = t.astype(jnp.float32)
+        alpha = lr * jnp.sqrt(1 - jnp.power(self.beta2, tf)) \
+            / (1 - jnp.power(self.beta1, tf))
+        return p - alpha * mom / (jnp.sqrt(v) + self.epsilon), \
+            {"mom": mom, "v": v}
+
+
+@dataclasses.dataclass
+class Adamax(Optimizer):
+    """``adamaxApply`` (TrainingAlgorithmOp.cu:166)."""
+
+    beta1: float = 0.9
+    beta2: float = 0.999
+
+    def slot_names(self):
+        return ["mom", "u"]
+
+    def _apply_one(self, p, g, slots, lr, decay, t):
+        g = g + decay * p
+        mom = self.beta1 * slots["mom"] + (1 - self.beta1) * g
+        u = jnp.maximum(self.beta2 * slots["u"], jnp.abs(g))
+        tf = t.astype(jnp.float32)
+        step = lr / (1 - jnp.power(self.beta1, tf))
+        return p - step * mom / jnp.maximum(u, 1e-12), {"mom": mom, "u": u}
+
+
+_BY_NAME = {
+    "momentum": Momentum, "sgd": Momentum, "adagrad": AdaGrad,
+    "adadelta": AdaDelta, "rmsprop": RMSProp,
+    "decayed_adagrad": DecayedAdaGrad, "adam": Adam, "adamax": Adamax,
+}
+
+
+def create_optimizer(name: str, **kwargs) -> Optimizer:
+    """Factory mirroring ``ParameterOptimizer::create``
+    (``paddle/parameter/ParameterOptimizer.cpp``)."""
+    if name not in _BY_NAME:
+        raise KeyError(f"unknown optimizer {name!r}; have {sorted(_BY_NAME)}")
+    return _BY_NAME[name](**kwargs)

@@ -1,0 +1,28 @@
+"""Learning-rate schedules.
+
+Mirrors ``paddle/parameter/LearningRateScheduler.cpp`` (created from
+``OptimizationConfig.learning_rate_schedule`` with args ``decay_a``/
+``decay_b``): constant, poly, caffe_poly, exp, discexp, linear. ``t`` is the
+number of samples processed, as in the reference.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def learning_rate_at(schedule: str, lr0: float, a: float, b: float, t):
+    t = jnp.asarray(t, jnp.float32)
+    if schedule in ("constant", "", None):
+        return jnp.asarray(lr0, jnp.float32)
+    if schedule == "poly":
+        return lr0 * jnp.power(1.0 + a * t, -b)
+    if schedule == "caffe_poly":
+        return lr0 * jnp.power(1.0 - t / a, b)
+    if schedule == "exp":
+        return lr0 * jnp.power(a, t / b)
+    if schedule == "discexp":
+        return lr0 * jnp.power(a, jnp.floor(t / b))
+    if schedule == "linear":
+        return jnp.maximum(lr0 - a * t, b)
+    raise KeyError(f"unknown learning_rate_schedule {schedule!r}")

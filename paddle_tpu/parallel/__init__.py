@@ -1,0 +1,2 @@
+from paddle_tpu.parallel.mesh import (  # noqa: F401
+    create_mesh, replicate, shard_batch, shard_params)

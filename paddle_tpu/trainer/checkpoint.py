@@ -1,0 +1,63 @@
+"""Checkpoint save/load.
+
+Covers ``ParamUtil::saveParametersOnePass`` / ``Parameter::save/load``
+(``paddle/trainer/ParamUtil.cpp``, ``paddle/parameter/Parameter.cpp``) and
+v2's ``Parameters.to_tar/from_tar``: parameters (+ optional optimizer slot
+state) to one .npz with an MD5 integrity sidecar — the integrity-checked
+checkpoint style of the Go pserver (``go/pserver/service.go:75-84``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+
+def _flatten(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
+    flat = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            flat.update(_flatten(v, f"{prefix}{k}/"))
+    else:
+        flat[prefix.rstrip("/")] = np.asarray(jax.device_get(tree))
+    return flat
+
+
+def save_params(path: str, params: Dict[str, Any],
+                opt_state: Optional[Any] = None, meta: Optional[dict] = None):
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    arrays = {f"param::{k}": np.asarray(jax.device_get(v))
+              for k, v in params.items()}
+    if opt_state is not None:
+        arrays.update({f"opt::{k}": v
+                       for k, v in _flatten(opt_state).items()})
+    np.savez(path, **arrays)
+    real_path = path if path.endswith(".npz") else path + ".npz"
+    md5 = hashlib.md5(open(real_path, "rb").read()).hexdigest()
+    with open(real_path + ".meta", "w") as f:
+        json.dump({"md5": md5, **(meta or {})}, f)
+
+
+def load_params(path: str, check_integrity: bool = True):
+    real_path = path if path.endswith(".npz") else path + ".npz"
+    if check_integrity and os.path.exists(real_path + ".meta"):
+        with open(real_path + ".meta") as f:
+            meta = json.load(f)
+        md5 = hashlib.md5(open(real_path, "rb").read()).hexdigest()
+        if md5 != meta.get("md5"):
+            raise IOError(f"checkpoint {real_path} failed MD5 integrity check"
+                          " (WrongChecksum, go/pserver/service.go:49)")
+    data = np.load(real_path)
+    params = {}
+    opt_flat = {}
+    for k in data.files:
+        if k.startswith("param::"):
+            params[k[len("param::"):]] = data[k]
+        elif k.startswith("opt::"):
+            opt_flat[k[len("opt::"):]] = data[k]
+    return params, opt_flat

@@ -1,0 +1,51 @@
+"""Evaluators: metric computation over layer outputs.
+
+Mirrors ``paddle/gserver/evaluators/Evaluator.{h,cpp}`` (classification
+error, sum, column-sum; AUC/chunk/CTC land with the sequence phase). Each
+evaluator is a pure function of the outputs dict, aggregated host-side
+across batches the way ``Evaluator::start/eval/finish`` accumulates.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.argument import Argument
+
+
+def classification_error(output: Argument, label: Argument) -> jnp.ndarray:
+    """Fraction of rows whose argmax != label
+    (``ClassificationErrorEvaluator``, Evaluator.cpp). Returns (errors,
+    count) so the trainer can aggregate across batches."""
+    pred = jnp.argmax(output.value, axis=-1)
+    lab = label.value.astype(pred.dtype)
+    wrong = (pred != lab).astype(jnp.float32)
+    if output.mask is not None:
+        wrong = wrong * output.mask
+        count = jnp.sum(output.mask)
+    else:
+        count = jnp.float32(wrong.shape[0])
+    return jnp.sum(wrong), count
+
+
+class Accumulator:
+    """Host-side metric accumulation (the CurrentEval/TotalEval split in
+    ``TrainerInternal.cpp:160-170``)."""
+
+    def __init__(self):
+        self.totals: Dict[str, float] = {}
+        self.counts: Dict[str, float] = {}
+
+    def add(self, name: str, total, count):
+        self.totals[name] = self.totals.get(name, 0.0) + float(total)
+        self.counts[name] = self.counts.get(name, 0.0) + float(count)
+
+    def result(self) -> Dict[str, float]:
+        return {k: self.totals[k] / max(self.counts[k], 1.0)
+                for k in self.totals}
+
+    def reset(self):
+        self.totals.clear()
+        self.counts.clear()

@@ -1,0 +1,172 @@
+"""The training driver.
+
+Replaces the reference's whole driver column — ``Trainer::train ->
+trainOnePass -> trainOneDataBatch -> TrainerInternal::trainOneBatch``
+(``paddle/trainer/Trainer.cpp:261,492,402``, ``TrainerInternal.cpp:66``) and
+the Python v2 loop (``python/paddle/v2/trainer.py:108-175``) — with one
+jitted train step:
+
+    (params, opt_state, batch, rng) -> (params, opt_state, metrics)
+
+The reference pipelines parameter updates *during* backward via per-parameter
+callbacks (``TrainerInternal.cpp:70-74``); under XLA the fused step gives the
+same overlap automatically (grad+update compile into one program). Data
+parallelism: pass a ``Mesh`` — the batch is sharded on the ``data`` axis and
+XLA inserts the gradient all-reduce, the ICI equivalent of
+``MultiGradientMachine``'s ring and the pserver's ``addGradient``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.config import dsl as _dsl
+from paddle_tpu.config.model_config import ModelDef
+from paddle_tpu.core.argument import Argument
+from paddle_tpu.core.network import Network
+from paddle_tpu.optim.optimizers import Optimizer
+from paddle_tpu.parallel import mesh as mesh_lib
+from paddle_tpu.trainer import events as ev
+from paddle_tpu.trainer.evaluators import Accumulator, classification_error
+
+_CLASSIFICATION_COSTS = {"multi-class-cross-entropy"}
+
+
+class Topology:
+    """cost LayerOutput -> executable Network (``python/paddle/v2/
+    topology.py:44``)."""
+
+    def __init__(self, cost, extra_outputs: Optional[List] = None,
+                 graph: Optional[ModelDef] = None):
+        graph = graph or _dsl.current_graph()
+        names = [c.name if hasattr(c, "name") else c
+                 for c in ([cost] + list(extra_outputs or []))]
+        self.cost_name = names[0]
+        graph.output_layer_names = names
+        self.network = Network(graph, outputs=names)
+        self.graph = graph
+
+
+class SGD:
+    """v2 ``trainer.SGD``: holds topology + parameters + optimizer and runs
+    the training loop."""
+
+    def __init__(self, cost, parameters: Optional[Dict[str, Any]] = None,
+                 update_equation: Optimizer = None, *,
+                 extra_layers: Optional[List] = None,
+                 mesh=None, seed: int = 0, is_local: bool = True):
+        if update_equation is None:
+            raise ValueError("update_equation (an Optimizer) is required")
+        self.topology = (cost if isinstance(cost, Topology)
+                         else Topology(cost, extra_outputs=extra_layers))
+        self.network = self.topology.network
+        self.optimizer = update_equation
+        self.mesh = mesh
+        key = jax.random.PRNGKey(seed)
+        self.params = (parameters if parameters is not None
+                       else self.network.init_params(key))
+        self.meta = self.network.param_meta()
+        self.opt_state = self.optimizer.init(self.params, self.meta)
+        if mesh is not None:
+            self.params = mesh_lib.replicate(self.params, mesh)
+            self.opt_state = mesh_lib.replicate(self.opt_state, mesh)
+        self._rng = jax.random.PRNGKey(seed + 1)
+        self._train_step = self._build_train_step()
+        self._eval_step = self._build_eval_step()
+
+    # ------------------------------------------------------------ builders
+    def _metrics(self, outputs, feed):
+        cost_name = self.topology.cost_name
+        cdef = self.topology.graph.layers[cost_name]
+        cost_val = outputs[cost_name].value
+        bsz = cost_val.shape[0]
+        metrics = {"cost": jnp.sum(cost_val) / bsz}
+        if cdef.type in _CLASSIFICATION_COSTS:
+            out_l, lab_l = cdef.input_names()[0], cdef.input_names()[1]
+            errs, cnt = classification_error(outputs[out_l], outputs[lab_l])
+            metrics["classification_error"] = (errs, cnt)
+        return metrics
+
+    def _build_train_step(self):
+        network, optimizer, meta = self.network, self.optimizer, self.meta
+        cost_name = self.topology.cost_name
+
+        def loss_fn(params, feed, rng):
+            outputs, updates = network.apply_with_state(
+                params, feed, train=True, rng=rng)
+            cost_val = outputs[cost_name].value
+            loss = jnp.sum(cost_val) / cost_val.shape[0]
+            return loss, (outputs, updates)
+
+        def step(params, opt_state, feed, rng):
+            (_, (outputs, updates)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, feed, rng)
+            bsz = outputs[cost_name].value.shape[0]
+            new_params, new_opt = optimizer.update(
+                grads, opt_state, params, meta, batch_size=bsz)
+            new_params.update(updates)  # moving statistics (batch_norm)
+            return new_params, new_opt, self._metrics(outputs, feed)
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def _build_eval_step(self):
+        network = self.network
+
+        def step(params, feed):
+            outputs = network.apply(params, feed, train=False)
+            return self._metrics(outputs, feed)
+
+        return jax.jit(step)
+
+    # ---------------------------------------------------------------- loop
+    def train(self, reader, *, feeder=None, num_passes: int = 1,
+              event_handler: Optional[Callable] = None):
+        """reader yields minibatches (lists of sample tuples); feeder
+        converts them to Arguments (or pass feed dicts directly)."""
+        event_handler = event_handler or (lambda e: None)
+        acc = Accumulator()
+        for pass_id in range(num_passes):
+            event_handler(ev.BeginPass(pass_id))
+            acc.reset()
+            for batch_id, data in enumerate(reader()):
+                event_handler(ev.BeginIteration(pass_id, batch_id))
+                feed = feeder(data) if feeder is not None else data
+                if self.mesh is not None:
+                    feed = mesh_lib.shard_batch(feed, self.mesh)
+                self._rng, step_rng = jax.random.split(self._rng)
+                self.params, self.opt_state, metrics = self._train_step(
+                    self.params, self.opt_state, feed, step_rng)
+                cost = float(metrics["cost"])
+                evals = self._accumulate(acc, metrics)
+                event_handler(ev.EndIteration(pass_id, batch_id, cost, evals))
+            event_handler(ev.EndPass(pass_id, acc.result()))
+
+    def test(self, reader, *, feeder=None) -> ev.TestResult:
+        acc = Accumulator()
+        total_cost, batches = 0.0, 0
+        for data in reader():
+            feed = feeder(data) if feeder is not None else data
+            if self.mesh is not None:
+                feed = mesh_lib.shard_batch(feed, self.mesh)
+            metrics = self._eval_step(self.params, feed)
+            total_cost += float(metrics["cost"])
+            batches += 1
+            self._accumulate(acc, metrics)
+        return ev.TestResult(0, total_cost / max(batches, 1), acc.result())
+
+    def _accumulate(self, acc: Accumulator, metrics) -> Dict[str, float]:
+        for k, v in metrics.items():
+            if isinstance(v, tuple):
+                acc.add(k, *(jax.device_get(x) for x in v))
+        return acc.result()
+
+    # ------------------------------------------------------------ forward
+    def forward(self, feed, output_names: Optional[List[str]] = None):
+        outputs = self.network.apply(self.params, feed, train=False)
+        if output_names is None:
+            return outputs
+        return {n: outputs[n] for n in output_names}

@@ -1,0 +1,112 @@
+"""End-to-end smoke: build small nets with the DSL, train a few steps, and
+verify cost decreases — the shape of the reference's
+``test_TrainerOnePass.cpp`` assertions."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu
+from paddle_tpu.config import dsl
+from paddle_tpu.data import DataFeeder, dense_vector, integer_value
+from paddle_tpu.optim import Momentum, Adam
+from paddle_tpu.trainer import SGD
+
+
+def _toy_classification(n=256, dim=8, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(dim, classes)
+    x = rng.randn(n, dim).astype(np.float32)
+    y = np.argmax(x @ w + 0.1 * rng.randn(n, classes), axis=1)
+    return x, y.astype(np.int64)
+
+
+def _batches(x, y, bs):
+    def reader():
+        for i in range(0, len(x), bs):
+            yield [(x[j], int(y[j])) for j in range(i, min(i + bs, len(x)))]
+    return reader
+
+
+def test_mlp_trains():
+    dsl.reset()
+    img = dsl.data(name="x", size=8)
+    lab = dsl.data(name="label", size=4)
+    h = dsl.fc(input=img, size=32, act="relu")
+    out = dsl.fc(input=h, size=4, act="softmax")
+    cost = dsl.classification_cost(input=out, label=lab)
+
+    trainer = SGD(cost=cost, update_equation=Momentum(
+        learning_rate=0.1, momentum=0.9))
+    x, y = _toy_classification()
+    feeder = DataFeeder({"x": dense_vector(8), "label": integer_value(4)})
+
+    costs = []
+    trainer.train(_batches(x, y, 64), feeder=feeder, num_passes=8,
+                  event_handler=lambda e: costs.append(e.cost)
+                  if hasattr(e, "cost") else None)
+    assert costs[0] > costs[-1], (costs[0], costs[-1])
+    assert costs[-1] < 0.7 * costs[0]
+
+    res = trainer.test(_batches(x, y, 64), feeder=feeder)
+    assert res.evaluator["classification_error"] < 0.25
+
+
+def test_regression_mse():
+    dsl.reset()
+    x_l = dsl.data(name="x", size=4)
+    y_l = dsl.data(name="y", size=1)
+    pred = dsl.fc(input=x_l, size=1, act="linear")
+    cost = dsl.square_error_cost(input=pred, label=y_l)
+
+    rng = np.random.RandomState(1)
+    w = rng.randn(4, 1)
+    x = rng.randn(512, 4).astype(np.float32)
+    y = (x @ w).astype(np.float32)
+
+    def reader():
+        for i in range(0, len(x), 128):
+            yield [(x[j], y[j]) for j in range(i, min(i + 128, len(x)))]
+
+    trainer = SGD(cost=cost, update_equation=Adam(learning_rate=0.05))
+    feeder = DataFeeder({"x": dense_vector(4), "y": dense_vector(1)})
+    costs = []
+    trainer.train(reader, feeder=feeder, num_passes=20,
+                  event_handler=lambda e: costs.append(e.cost)
+                  if hasattr(e, "cost") else None)
+    assert costs[-1] < 0.05 * costs[0]
+
+
+def test_lstm_sequence_classification():
+    dsl.reset()
+    # variable-length sequences of token ids; class = parity of max token
+    vocab, emb, hidden, classes = 20, 16, 32, 2
+    words = dsl.data(name="words", size=vocab, is_sequence=True)
+    lab = dsl.data(name="label", size=classes)
+    e = dsl.embedding(input=words, size=emb, vocab_size=vocab)
+    proj = dsl.fc(input=e, size=hidden * 4, act="linear")
+    lstm = dsl.lstmemory(input=proj)
+    pooled = dsl.pooling(input=lstm, pooling_type="max")
+    out = dsl.fc(input=pooled, size=classes, act="softmax")
+    cost = dsl.classification_cost(input=out, label=lab)
+
+    rng = np.random.RandomState(2)
+    data = []
+    for _ in range(256):
+        L = rng.randint(3, 12)
+        seq = rng.randint(0, vocab, size=L)
+        data.append((list(seq), int(seq.max() % 2)))
+
+    from paddle_tpu.data import integer_value_sequence
+    feeder = DataFeeder({"words": integer_value_sequence(vocab),
+                         "label": integer_value(classes)}, pad_multiple=16)
+
+    def reader():
+        for i in range(0, len(data), 64):
+            yield data[i:i + 64]
+
+    trainer = SGD(cost=cost, update_equation=Adam(learning_rate=0.01))
+    costs = []
+    trainer.train(reader, feeder=feeder, num_passes=12,
+                  event_handler=lambda e: costs.append(e.cost)
+                  if hasattr(e, "cost") else None)
+    assert costs[-1] < 0.8 * costs[0], (costs[0], costs[-1])
