@@ -1,0 +1,73 @@
+"""Benchmark harness. Prints ONE JSON line.
+
+Round-1 metric: the reference's headline RNN benchmark — IMDB-style LSTM
+text classification, batch 64, hidden 256, seqlen 100, dict 30k
+(``/root/reference/benchmark/paddle/rnn/rnn.py``; published number
+83 ms/batch on a K40m, ``benchmark/README.md:110-120``). We time the full
+jitted train step (forward+backward+update, the same thing
+``paddle_trainer --job=time`` measures) in steady state on one TPU chip.
+
+vs_baseline = reference_ms / our_ms (>1 means faster than the reference).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+REFERENCE_MS = 83.0  # Paddle on K40m, benchmark/README.md:110-120
+BATCH, HIDDEN, SEQLEN, VOCAB = 64, 256, 100, 30000
+ITERS = int(os.environ.get("BENCH_ITERS", "30"))
+
+
+def main():
+    import jax
+    from paddle_tpu.config import dsl
+    from paddle_tpu.data import DataFeeder, integer_value, integer_value_sequence
+    from paddle_tpu.models import lstm_text_classifier
+    from paddle_tpu.optim import Adam
+    from paddle_tpu.trainer import SGD
+
+    dsl.reset()
+    cost, out, _ = lstm_text_classifier(
+        vocab_size=VOCAB, embed_dim=128, hidden=HIDDEN, num_layers=2,
+        classes=2)
+    trainer = SGD(cost=cost, update_equation=Adam(learning_rate=2e-3))
+
+    rng = np.random.RandomState(0)
+    feeder = DataFeeder({"words": integer_value_sequence(VOCAB),
+                         "label": integer_value(2)}, pad_multiple=SEQLEN)
+    batch = [(list(rng.randint(0, VOCAB, size=SEQLEN)), int(rng.randint(0, 2)))
+             for _ in range(BATCH)]
+    feed = feeder(batch)
+
+    # warmup / compile
+    rng_key = jax.random.PRNGKey(0)
+    for _ in range(3):
+        rng_key, step_key = jax.random.split(rng_key)
+        trainer.params, trainer.opt_state, metrics = trainer._train_step(
+            trainer.params, trainer.opt_state, feed, step_key)
+    jax.block_until_ready(metrics["cost"])
+
+    iters = ITERS
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        rng_key, step_key = jax.random.split(rng_key)
+        trainer.params, trainer.opt_state, metrics = trainer._train_step(
+            trainer.params, trainer.opt_state, feed, step_key)
+    jax.block_until_ready(metrics["cost"])
+    ms = (time.perf_counter() - t0) / iters * 1000.0
+
+    print(json.dumps({
+        "metric": "lstm_imdb_train_ms_per_batch_bs64_h256_seq100",
+        "value": round(ms, 3),
+        "unit": "ms/batch",
+        "vs_baseline": round(REFERENCE_MS / ms, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

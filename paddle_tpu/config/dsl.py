@@ -140,9 +140,19 @@ def conv(input, *, num_filters: int, filter_size: int, stride: int = 1,
     return _add(ldef)
 
 
-def img_pool(input, *, pool_size: int, stride: int, padding: int = 0,
-             pool_type: str = "max-projection", name: str = None) -> LayerOutput:
+def img_pool(input, *, pool_size: Optional[int] = None, stride: int = 1,
+             padding: int = 0, pool_type: str = "max-projection",
+             name: str = None) -> LayerOutput:
+    """pool_size=None pools over the full spatial extent (global pooling)."""
     src = _in(input)[0]
+    if pool_size is None:
+        info = _shape_of(src.name)
+        extra = {"filter_size": info.width, "size_y": info.height,
+                 "stride": info.width, "stride_y": info.height,
+                 "padding": 0, "pool_type": pool_type}
+        ldef = LayerDef(name=name or _auto_name("pool"), type="pool",
+                        bias=False, inputs=[Input(src.name, extra=extra)])
+        return _add(ldef)
     extra = {"filter_size": pool_size, "stride": stride, "padding": padding,
              "pool_type": pool_type}
     ldef = LayerDef(name=name or _auto_name("pool"), type="pool", bias=False,

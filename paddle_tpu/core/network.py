@@ -109,14 +109,27 @@ class Network:
                 self._layer_params[name][suffix] = pname
 
     # ------------------------------------------------------------------ init
-    def init_params(self, key: jax.Array, dtype=jnp.float32) -> Dict[str, jnp.ndarray]:
-        params = {}
-        for i, (pname, spec) in enumerate(sorted(self.param_specs.items())):
-            params[pname] = init_param(
-                jax.random.fold_in(key, i), spec.shape, init=spec.init,
-                initial_mean=spec.initial_mean, initial_std=spec.initial_std,
-                dtype=dtype)
-        return params
+    def init_params(self, key: jax.Array, dtype=jnp.float32,
+                    shardings: Optional[Dict[str, Any]] = None
+                    ) -> Dict[str, jnp.ndarray]:
+        # One jitted program for the whole table: per-parameter eager init
+        # would trigger hundreds of tiny XLA compilations. With shardings
+        # (name -> NamedSharding), each parameter is created directly in its
+        # final placement — a model-sharded embedding table never
+        # materializes whole on one device.
+        def _init(key):
+            params = {}
+            for i, (pname, spec) in enumerate(sorted(self.param_specs.items())):
+                params[pname] = init_param(
+                    jax.random.fold_in(key, i), spec.shape, init=spec.init,
+                    initial_mean=spec.initial_mean, initial_std=spec.initial_std,
+                    dtype=dtype)
+            return params
+
+        out_shardings = (
+            {name: shardings[name] for name in self.param_specs}
+            if shardings else None)
+        return jax.jit(_init, out_shardings=out_shardings)(key)
 
     # ----------------------------------------------------------------- apply
     def apply(self, params: Dict[str, jnp.ndarray],

@@ -1,0 +1,3 @@
+from paddle_tpu.models.lenet import lenet_mnist  # noqa: F401
+from paddle_tpu.models.resnet import resnet  # noqa: F401
+from paddle_tpu.models.lstm_text import lstm_text_classifier  # noqa: F401

@@ -1,2 +1,3 @@
 from paddle_tpu.parallel.mesh import (  # noqa: F401
-    create_mesh, replicate, shard_batch, shard_params)
+    create_mesh, param_shardings, replicate, shard_batch, shard_opt_state,
+    shard_params)

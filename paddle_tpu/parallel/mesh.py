@@ -49,7 +49,15 @@ def create_mesh(n_data: Optional[int] = None, n_model: int = 1,
 def shard_batch(feed: Dict[str, Argument], mesh: Mesh) -> Dict[str, Argument]:
     """Place a feed dict with the batch dim split over the data axis."""
 
+    n_data = mesh.shape[DATA_AXIS]
+
     def place(x):
+        if x.shape[0] % n_data != 0:
+            raise ValueError(
+                f"batch size {x.shape[0]} not divisible by data-parallel "
+                f"degree {n_data}; pad or resize the batch (the reference "
+                "splits remainders unevenly across TrainerThreads — on a "
+                "SPMD mesh the split must be exact)")
         spec = P(DATA_AXIS, *([None] * (x.ndim - 1)))
         return jax.device_put(x, NamedSharding(mesh, spec))
 
@@ -62,18 +70,45 @@ def replicate(tree, mesh: Mesh):
         lambda x: jax.device_put(x, NamedSharding(mesh, P())), tree)
 
 
+def rule_for(name: str, rules: Optional[Dict[str, P]]) -> P:
+    """First rule whose key is a substring of ``name``; replicated default."""
+    if rules:
+        for pat, s in rules.items():
+            if pat in name:
+                return s
+    return P()
+
+
 def shard_params(params: Dict[str, jax.Array], mesh: Mesh,
                  rules: Optional[Dict[str, P]] = None):
     """Place parameters: replicated by default; ``rules`` maps param-name
     substrings to PartitionSpecs (e.g. shard embedding rows on MODEL_AXIS,
     the sparse-embedding model parallelism of SURVEY §2 #5)."""
+    return {name: jax.device_put(p, NamedSharding(mesh, rule_for(name, rules)))
+            for name, p in params.items()}
+
+
+def param_shardings(param_names, mesh: Mesh,
+                    rules: Optional[Dict[str, P]] = None):
+    """NamedSharding per parameter name (for jit out_shardings so big
+    sharded tables are *created* in place, never materialized whole)."""
+    return {name: NamedSharding(mesh, rule_for(name, rules))
+            for name in param_names}
+
+
+def shard_opt_state(opt_state, mesh: Mesh,
+                    rules: Optional[Dict[str, P]] = None):
+    """Shard any optimizer-state pytree: entries of per-parameter dicts
+    ("slots", "avg", or any future key whose value is {param_name: ...})
+    follow their owning parameter's rule; everything else replicates."""
     out = {}
-    for name, p in params.items():
-        spec = P()
-        if rules:
-            for pat, s in rules.items():
-                if pat in name:
-                    spec = s
-                    break
-        out[name] = jax.device_put(p, NamedSharding(mesh, spec))
+    for key, val in opt_state.items():
+        if isinstance(val, dict):
+            out[key] = {
+                name: jax.tree_util.tree_map(
+                    lambda x, n=name: jax.device_put(
+                        x, NamedSharding(mesh, rule_for(n, rules))), sub)
+                for name, sub in val.items()}
+        else:
+            out[key] = jax.device_put(val, NamedSharding(mesh, P()))
     return out

@@ -58,7 +58,8 @@ class SGD:
     def __init__(self, cost, parameters: Optional[Dict[str, Any]] = None,
                  update_equation: Optimizer = None, *,
                  extra_layers: Optional[List] = None,
-                 mesh=None, seed: int = 0, is_local: bool = True):
+                 mesh=None, shard_rules: Optional[Dict[str, Any]] = None,
+                 seed: int = 0, is_local: bool = True):
         if update_equation is None:
             raise ValueError("update_equation (an Optimizer) is required")
         self.topology = (cost if isinstance(cost, Topology)
@@ -67,13 +68,22 @@ class SGD:
         self.optimizer = update_equation
         self.mesh = mesh
         key = jax.random.PRNGKey(seed)
-        self.params = (parameters if parameters is not None
-                       else self.network.init_params(key))
         self.meta = self.network.param_meta()
+        if parameters is not None:
+            self.params = (mesh_lib.shard_params(parameters, mesh, shard_rules)
+                           if mesh is not None else parameters)
+        else:
+            # with a mesh, create parameters directly in their final
+            # sharding (big tables never materialize on one device)
+            shardings = (mesh_lib.param_shardings(
+                self.network.param_specs, mesh, shard_rules)
+                if mesh is not None else None)
+            self.params = self.network.init_params(key, shardings=shardings)
         self.opt_state = self.optimizer.init(self.params, self.meta)
         if mesh is not None:
-            self.params = mesh_lib.replicate(self.params, mesh)
-            self.opt_state = mesh_lib.replicate(self.opt_state, mesh)
+            # slots/avg follow their owning parameter; scalars replicate
+            self.opt_state = mesh_lib.shard_opt_state(
+                self.opt_state, mesh, shard_rules)
         self._rng = jax.random.PRNGKey(seed + 1)
         self._train_step = self._build_train_step()
         self._eval_step = self._build_eval_step()

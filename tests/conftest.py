@@ -2,13 +2,19 @@
 and collective paths are exercised without TPU hardware (the analogue of the
 reference's in-process pserver trick, ``test_TrainerOnePass.cpp:246-251``).
 
-Must run before jax is imported anywhere in the test process.
+Note: this host's sitecustomize pre-imports jax with the axon TPU platform,
+so env vars alone don't stick — we must also flip jax_platforms before the
+first backend client is created.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,133 @@
+"""Optimizer semantics tests — the analogue of
+``paddle/math/tests/test_TrainingAlgorithm.cpp``, which checks the fused
+kernels against reference implementations (``OriginalOptimizerApi.h``):
+here each Optimizer is checked against a hand-written numpy step of the
+formulas in TrainingAlgorithmOp.cu."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.optim import (AdaDelta, AdaGrad, Adam, Adamax,
+                              DecayedAdaGrad, Momentum, RMSProp,
+                              create_optimizer)
+
+
+def _run(opt, p0, grads_seq):
+    params = {"w": jnp.asarray(p0)}
+    state = opt.init(params)
+    for g in grads_seq:
+        params, state = opt.update({"w": jnp.asarray(g)}, state, params,
+                                   batch_size=4)
+    return np.asarray(params["w"]), state
+
+
+def test_momentum_matches_reference_formula():
+    p0 = np.array([1.0, -2.0, 3.0], np.float32)
+    gs = [np.array([0.1, 0.2, -0.3], np.float32),
+          np.array([-0.05, 0.1, 0.2], np.float32)]
+    lr, mu, decay = 0.1, 0.9, 0.01
+    opt = Momentum(learning_rate=lr, momentum=mu, l2_rate=decay)
+    got, _ = _run(opt, p0, gs)
+    # sgdUpdate: mom = mu*mom - lr*(g + decay*p); p += mom
+    p, mom = p0.copy(), np.zeros_like(p0)
+    for g in gs:
+        mom = mu * mom - lr * (g + decay * p)
+        p = p + mom
+    np.testing.assert_allclose(got, p, rtol=1e-6)
+
+
+def test_adagrad_formula():
+    p0 = np.array([0.5, -0.5], np.float32)
+    gs = [np.array([0.3, -0.1], np.float32),
+          np.array([0.2, 0.4], np.float32)]
+    opt = AdaGrad(learning_rate=0.1, epsilon=1e-6)
+    got, _ = _run(opt, p0, gs)
+    p, accum, mom = p0.copy(), np.zeros_like(p0), np.zeros_like(p0)
+    for g in gs:
+        accum = accum + g * g
+        lr_vec = 1.0 / np.sqrt(accum + 1e-6)
+        mom = 0.0 * mom - 0.1 * lr_vec * g
+        p = p + mom
+    np.testing.assert_allclose(got, p, rtol=1e-5)
+
+
+def test_adam_formula():
+    p0 = np.array([1.0, 2.0], np.float32)
+    gs = [np.array([0.1, -0.2], np.float32)] * 3
+    b1, b2, eps, lr = 0.9, 0.999, 1e-8, 0.01
+    opt = Adam(learning_rate=lr, beta1=b1, beta2=b2, epsilon=eps)
+    got, _ = _run(opt, p0, gs)
+    p, m, v = p0.copy(), np.zeros_like(p0), np.zeros_like(p0)
+    for t, g in enumerate(gs, start=1):
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        alpha = lr * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        p = p - alpha * m / (np.sqrt(v) + eps)
+    np.testing.assert_allclose(got, p, rtol=1e-5)
+
+
+def test_rmsprop_formula():
+    p0 = np.array([0.3, -0.7], np.float32)
+    gs = [np.array([0.2, 0.1], np.float32),
+          np.array([-0.1, 0.3], np.float32)]
+    rou, eps, lr = 0.95, 1e-6, 0.05
+    opt = RMSProp(learning_rate=lr, rou=rou, epsilon=eps)
+    got, _ = _run(opt, p0, gs)
+    p = p0.copy()
+    G = np.zeros_like(p0); F = np.zeros_like(p0); mom = np.zeros_like(p0)
+    for g in gs:
+        G = rou * G + (1 - rou) * g * g
+        F = rou * F + (1 - rou) * g
+        scale = 1.0 / np.sqrt(G - F * F + eps)
+        mom = 0.0 * mom - lr * scale * g
+        p = p + mom
+    np.testing.assert_allclose(got, p, rtol=1e-5)
+
+
+def test_l1_shrink():
+    opt = Momentum(learning_rate=0.1, l1_rate=0.5)
+    p0 = np.array([0.04, -0.03, 1.0], np.float32)
+    got, _ = _run(opt, p0, [np.zeros(3, np.float32)])
+    # after zero-grad step, |p| shrinks by l1*lr = 0.05, clamped at 0
+    np.testing.assert_allclose(got, [0.0, 0.0, 0.95], atol=1e-6)
+
+
+def test_static_params_skipped():
+    opt = Momentum(learning_rate=1.0)
+    from paddle_tpu.core.registry import ParamSpec
+    params = {"w": jnp.ones(3), "frozen": jnp.ones(3)}
+    meta = {"w": ParamSpec(shape=(3,)),
+            "frozen": ParamSpec(shape=(3,), is_static=True)}
+    state = opt.init(params, meta)
+    assert "frozen" not in state["slots"]
+    new_p, _ = opt.update({"w": jnp.ones(3), "frozen": jnp.ones(3)},
+                          state, params, meta)
+    np.testing.assert_allclose(np.asarray(new_p["frozen"]), 1.0)
+    assert not np.allclose(np.asarray(new_p["w"]), 1.0)
+
+
+def test_lr_schedules():
+    from paddle_tpu.optim.schedules import learning_rate_at
+    assert float(learning_rate_at("constant", 0.1, 0, 0, 100)) == pytest.approx(0.1)
+    assert float(learning_rate_at("poly", 0.1, 0.01, 0.5, 100)) == pytest.approx(
+        0.1 * (1 + 0.01 * 100) ** -0.5)
+    assert float(learning_rate_at("linear", 0.1, 1e-4, 0.01, 500)) == pytest.approx(
+        0.1 - 1e-4 * 500)
+    assert float(learning_rate_at("discexp", 0.1, 0.5, 100, 250)) == pytest.approx(
+        0.1 * 0.5 ** 2)
+
+
+def test_factory():
+    assert isinstance(create_optimizer("adam", learning_rate=0.1), Adam)
+    assert isinstance(create_optimizer("sgd"), Momentum)
+    with pytest.raises(KeyError):
+        create_optimizer("nope")
+
+
+def test_model_averaging():
+    opt = Momentum(learning_rate=0.1, average_window=2.0)
+    p0 = np.array([1.0], np.float32)
+    got, state = _run(opt, p0, [np.array([1.0], np.float32)] * 3)
+    assert "avg" in state
+    assert np.isfinite(np.asarray(state["avg"]["w"])).all()
