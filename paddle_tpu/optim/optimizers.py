@@ -33,6 +33,7 @@ class Optimizer:
     learning_rate_schedule: str = "constant"
     learning_rate_decay_a: float = 0.0
     learning_rate_decay_b: float = 0.0
+    learning_rate_args: str = ""
     l1_rate: float = 0.0
     l2_rate: float = 0.0
     gradient_clipping_threshold: float = 0.0
@@ -73,7 +74,7 @@ class Optimizer:
         lr_t = learning_rate_at(
             self.learning_rate_schedule, self.learning_rate,
             self.learning_rate_decay_a, self.learning_rate_decay_b,
-            num_samples)
+            num_samples, args=self.learning_rate_args)
 
         new_params = dict(params)
         new_slots = {}
@@ -110,19 +111,36 @@ class Optimizer:
                 for n in new_slots}
         return new_params, new_state
 
+    def averaged_params(self, state, params):
+        """``AverageOptimizer::apply`` (AverageOptimizer.h:23): swap in the
+        windowed average of each learnable parameter for evaluation; the raw
+        trained values stay in ``params`` (≡ ``restore``)."""
+        if "avg" not in state:
+            return params
+        out = dict(params)
+        out.update(state["avg"])
+        return out
+
 
 @dataclasses.dataclass
 class Momentum(Optimizer):
     """Classic v1 SGD+momentum (``sgdUpdate``):
-    mom = momentum*mom - lr*(grad + decayRate*value); value += mom."""
+    mom = momentum*mom - lr*(grad + decayRate*value); value += mom.
+    ``nesterov`` mirrors ``SparseMomentumParameterOptimizer``'s
+    lookahead formulation (FirstOrderOptimizer.h:64-122) collapsed to its
+    dense equivalent."""
 
     momentum: float = 0.0
+    nesterov: bool = False
 
     def slot_names(self):
         return ["mom"]
 
     def _apply_one(self, p, g, slots, lr, decay, t):
         mom = self.momentum * slots["mom"] - lr * (g + decay * p)
+        if self.nesterov:
+            return p + self.momentum * mom - lr * (g + decay * p), \
+                {"mom": mom}
         return p + mom, {"mom": mom}
 
 

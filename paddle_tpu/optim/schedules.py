@@ -11,7 +11,20 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
-def learning_rate_at(schedule: str, lr0: float, a: float, b: float, t):
+def parse_manual_segments(args: str):
+    """Parse ``learning_rate_args`` for the ``manual``/``pass_manual``
+    schedules: ``"seg0:lr0,seg1:lr1,..."`` where segN is a cumulative
+    sample (manual) or pass (pass_manual) boundary
+    (``LearningRateScheduler.cpp``, SegmentsScheduler)."""
+    segs = []
+    for part in args.split(","):
+        boundary, factor = part.split(":")
+        segs.append((float(boundary), float(factor)))
+    return segs
+
+
+def learning_rate_at(schedule: str, lr0: float, a: float, b: float, t,
+                     args: str = "", num_passes=0):
     t = jnp.asarray(t, jnp.float32)
     if schedule in ("constant", "", None):
         return jnp.asarray(lr0, jnp.float32)
@@ -25,4 +38,15 @@ def learning_rate_at(schedule: str, lr0: float, a: float, b: float, t):
         return lr0 * jnp.power(a, jnp.floor(t / b))
     if schedule == "linear":
         return jnp.maximum(lr0 - a * t, b)
+    if schedule in ("manual", "pass_manual"):
+        # piecewise-constant over cumulative samples (manual) or pass id
+        # (pass_manual); last segment extends to infinity as in the
+        # reference (SegmentsScheduler falls through to the final value).
+        key = jnp.asarray(num_passes, jnp.float32) \
+            if schedule == "pass_manual" else t
+        segs = parse_manual_segments(args)
+        lr = jnp.asarray(lr0 * segs[-1][1], jnp.float32)
+        for boundary, factor in reversed(segs[:-1]):
+            lr = jnp.where(key < boundary, lr0 * factor, lr)
+        return lr
     raise KeyError(f"unknown learning_rate_schedule {schedule!r}")

@@ -2,3 +2,5 @@ from paddle_tpu.trainer import events  # noqa: F401
 from paddle_tpu.trainer.trainer import SGD, Topology  # noqa: F401
 from paddle_tpu.trainer.checkpoint import load_params, save_params  # noqa: F401
 from paddle_tpu.trainer.evaluators import classification_error  # noqa: F401
+from paddle_tpu.trainer.metrics import (create_evaluator,  # noqa: F401
+                                        register_evaluator)

@@ -1,0 +1,521 @@
+"""Metric evaluators: the full ``gserver/evaluators`` family.
+
+Mirrors ``paddle/gserver/evaluators/Evaluator.{h,cpp}`` (+
+``ChunkEvaluator.cpp``, ``CTCErrorEvaluator.cpp``): classification error,
+AUC (``AucEvaluator``, Evaluator.h:252), precision/recall, positive-negative
+pair (pnpair), chunk F1 (NER), CTC sequence error, sum/column-sum, and the
+printer evaluators. Each evaluator follows the reference's
+``start/eval(batch)/finish`` accumulation protocol, but split TPU-style:
+``batch_stats`` is a cheap device-side reduction (jit-friendly) where
+possible, and accumulation/finalization runs host-side on small arrays.
+
+Registered by name the way ``REGISTER_EVALUATOR`` does (Evaluator.h:28-42).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+_EVALUATORS: Dict[str, type] = {}
+
+
+def register_evaluator(name: str):
+    def deco(cls):
+        _EVALUATORS[name] = cls
+        cls.type_name = name
+        return cls
+    return deco
+
+
+def create_evaluator(name: str, **kwargs) -> "EvaluatorBase":
+    if name not in _EVALUATORS:
+        raise KeyError(f"unknown evaluator {name!r}; have {sorted(_EVALUATORS)}")
+    return _EVALUATORS[name](**kwargs)
+
+
+class EvaluatorBase:
+    """start/eval/finish protocol (``Evaluator.h``). Subclasses implement
+    ``eval_batch(output, label, weight=None, mask=None)`` with numpy arrays
+    (already fetched from device) and ``value()``."""
+
+    type_name = "?"
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name or self.type_name
+        self.start()
+
+    def start(self):
+        raise NotImplementedError
+
+    def eval_batch(self, output, label=None, weight=None, mask=None):
+        raise NotImplementedError
+
+    def value(self) -> float:
+        raise NotImplementedError
+
+    def finish(self) -> float:
+        return self.value()
+
+
+@register_evaluator("classification_error")
+class ClassificationErrorEvaluator(EvaluatorBase):
+    """``ClassificationErrorEvaluator`` — fraction argmax(output) != label;
+    honors sample weights and sequence masks."""
+
+    def __init__(self, name=None, top_k: int = 1):
+        self.top_k = top_k
+        super().__init__(name)
+
+    def start(self):
+        self.wrong = 0.0
+        self.count = 0.0
+
+    def eval_batch(self, output, label=None, weight=None, mask=None):
+        output = np.asarray(output)
+        label = np.asarray(label)
+        if self.top_k == 1:
+            hit = np.argmax(output, axis=-1) == label
+        else:
+            topk = np.argsort(-output, axis=-1)[..., :self.top_k]
+            hit = (topk == label[..., None]).any(axis=-1)
+        wrong = (~hit).astype(np.float64)
+        w = np.ones_like(wrong) if weight is None else np.asarray(weight)
+        if mask is not None:
+            w = w * np.asarray(mask)
+        self.wrong += float((wrong * w).sum())
+        self.count += float(w.sum())
+
+    def value(self):
+        return self.wrong / max(self.count, 1.0)
+
+
+@register_evaluator("auc")
+class AucEvaluator(EvaluatorBase):
+    """``AucEvaluator`` (Evaluator.h:252): bucketed ROC-AUC. The reference
+    histograms P(positive) into fixed bins (statPos_/statNeg_) and
+    integrates by trapezoid; identical scheme here with ``num_bins``."""
+
+    def __init__(self, name=None, num_bins: int = 4096, column: int = -1):
+        self.num_bins = num_bins
+        self.column = column
+        super().__init__(name)
+
+    def start(self):
+        self.stat_pos = np.zeros(self.num_bins, np.float64)
+        self.stat_neg = np.zeros(self.num_bins, np.float64)
+
+    def eval_batch(self, output, label=None, weight=None, mask=None):
+        output = np.asarray(output)
+        if output.ndim > 1:
+            col = self.column if self.column >= 0 else output.shape[-1] - 1
+            score = output[..., col]
+        else:
+            score = output
+        score = score.reshape(-1)
+        label = np.asarray(label).reshape(-1)
+        w = (np.ones_like(score, np.float64) if weight is None
+             else np.asarray(weight, np.float64).reshape(-1))
+        if mask is not None:
+            keep = np.asarray(mask).reshape(-1) > 0
+            score, label, w = score[keep], label[keep], w[keep]
+        idx = np.clip((score * self.num_bins).astype(np.int64),
+                      0, self.num_bins - 1)
+        np.add.at(self.stat_pos, idx[label > 0], w[label > 0])
+        np.add.at(self.stat_neg, idx[label <= 0], w[label <= 0])
+
+    def value(self):
+        # walk bins from high score to low, trapezoid over (FP, TP) curve —
+        # same calcAuc as the reference.
+        tot_pos = self.stat_pos.sum()
+        tot_neg = self.stat_neg.sum()
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.5
+        tp = np.cumsum(self.stat_pos[::-1])
+        fp = np.cumsum(self.stat_neg[::-1])
+        tpr = np.concatenate([[0.0], tp / tot_pos])
+        fpr = np.concatenate([[0.0], fp / tot_neg])
+        trapz = getattr(np, "trapezoid", np.trapz)
+        return float(trapz(tpr, fpr))
+
+
+@register_evaluator("precision_recall")
+class PrecisionRecallEvaluator(EvaluatorBase):
+    """``PrecisionRecallEvaluator``: per-class TP/FP/FN with macro-averaged
+    precision/recall/F1; ``positive_label`` selects single-class mode as in
+    the reference config."""
+
+    def __init__(self, name=None, positive_label: int = -1):
+        self.positive_label = positive_label
+        super().__init__(name)
+
+    def start(self):
+        self.tp: Dict[int, float] = {}
+        self.fp: Dict[int, float] = {}
+        self.fn: Dict[int, float] = {}
+
+    def eval_batch(self, output, label=None, weight=None, mask=None):
+        pred = np.argmax(np.asarray(output), axis=-1).reshape(-1)
+        label = np.asarray(label).reshape(-1)
+        w = (np.ones_like(pred, np.float64) if weight is None
+             else np.asarray(weight, np.float64).reshape(-1))
+        if mask is not None:
+            keep = np.asarray(mask).reshape(-1) > 0
+            pred, label, w = pred[keep], label[keep], w[keep]
+        for c in np.unique(np.concatenate([pred, label])):
+            c = int(c)
+            self.tp[c] = self.tp.get(c, 0.0) + float(
+                w[(pred == c) & (label == c)].sum())
+            self.fp[c] = self.fp.get(c, 0.0) + float(
+                w[(pred == c) & (label != c)].sum())
+            self.fn[c] = self.fn.get(c, 0.0) + float(
+                w[(pred != c) & (label == c)].sum())
+
+    def _prf(self, c):
+        tp, fp, fn = self.tp.get(c, 0), self.fp.get(c, 0), self.fn.get(c, 0)
+        p = tp / max(tp + fp, 1e-12)
+        r = tp / max(tp + fn, 1e-12)
+        f = 2 * p * r / max(p + r, 1e-12)
+        return p, r, f
+
+    def value(self):
+        if self.positive_label >= 0:
+            return self._prf(self.positive_label)[2]
+        classes = sorted(set(self.tp) | set(self.fp) | set(self.fn))
+        if not classes:
+            return 0.0
+        return float(np.mean([self._prf(c)[2] for c in classes]))
+
+    def detail(self):
+        classes = sorted(set(self.tp) | set(self.fp) | set(self.fn))
+        return {c: dict(zip(("precision", "recall", "f1"), self._prf(c)))
+                for c in classes}
+
+
+@register_evaluator("pnpair")
+class PnpairEvaluator(EvaluatorBase):
+    """``PnpairEvaluator``: for ranking — over all pairs within a query
+    group, count pairs ordered correctly (pos scored above neg) vs
+    incorrectly; value = correct/incorrect ratio."""
+
+    def start(self):
+        self.records: List = []
+
+    def eval_batch(self, output, label=None, weight=None, mask=None,
+                   query_id=None):
+        score = np.asarray(output)
+        if score.ndim > 1:
+            score = score[..., -1]
+        score = score.reshape(-1)
+        label = np.asarray(label).reshape(-1)
+        qid = (np.zeros_like(label) if query_id is None
+               else np.asarray(query_id).reshape(-1))
+        w = (np.ones_like(score, np.float64) if weight is None
+             else np.asarray(weight, np.float64).reshape(-1))
+        for s, l, q, ww in zip(score, label, qid, w):
+            self.records.append((int(q), float(s), float(l), float(ww)))
+
+    def value(self):
+        pos, neg, tie = 0.0, 0.0, 0.0
+        from collections import defaultdict
+        groups = defaultdict(list)
+        for q, s, l, w in self.records:
+            groups[q].append((s, l, w))
+        for items in groups.values():
+            for i in range(len(items)):
+                for j in range(i + 1, len(items)):
+                    (s1, l1, w1), (s2, l2, w2) = items[i], items[j]
+                    if l1 == l2:
+                        continue
+                    w = (w1 + w2) / 2
+                    hi, lo = (s1, s2) if l1 > l2 else (s2, s1)
+                    if hi > lo:
+                        pos += w
+                    elif hi < lo:
+                        neg += w
+                    else:
+                        tie += w
+        return (pos + 0.5 * tie) / max(neg + 0.5 * tie, 1e-12)
+
+
+@register_evaluator("chunk")
+class ChunkEvaluator(EvaluatorBase):
+    """``ChunkEvaluator.cpp``: F1 over chunks decoded from tag sequences.
+
+    Encoding matches the reference: with ``tag_num`` tags per scheme
+    (IOB: B,I / IOE: I,E / IOBES: B,I,E,S / plain: single tag), a label is
+    ``chunk_type * tag_num + tag`` and the "other" (outside) label is
+    ``num_chunk_types * tag_num``.
+    """
+
+    SCHEMES = {"plain": 1, "IOB": 2, "IOE": 2, "IOBES": 4}
+
+    def __init__(self, name=None, chunk_scheme: str = "IOB",
+                 num_chunk_types: int = 1, excluded_chunk_types=()):
+        if chunk_scheme not in self.SCHEMES:
+            raise ValueError(f"bad chunk_scheme {chunk_scheme}")
+        self.scheme = chunk_scheme
+        self.tag_num = self.SCHEMES[chunk_scheme]
+        self.num_chunk_types = num_chunk_types
+        self.excluded = set(excluded_chunk_types)
+        super().__init__(name)
+
+    def start(self):
+        self.num_label = 0.0
+        self.num_output = 0.0
+        self.num_correct = 0.0
+
+    def _decode(self, t: int):
+        """label id -> (tag, chunk_type) or None for the outside label."""
+        other = self.num_chunk_types * self.tag_num
+        if t < 0 or t >= other:
+            return None
+        ctype, tag = divmod(int(t), self.tag_num)
+        return tag, ctype
+
+    def _is_start(self, prev, cur):
+        """Does ``cur`` begin a new chunk given the previous position?
+        (isChunkBegin in ChunkEvaluator.cpp)."""
+        if cur is None:
+            return False
+        tag, ctype = cur
+        if self.scheme == "plain":
+            return True
+        if prev is None or prev[1] != ctype:
+            return True
+        if self.scheme == "IOB":
+            return tag == 0                       # B
+        if self.scheme == "IOE":
+            return prev[0] == 1                   # previous was E
+        # IOBES: B=0, I=1, E=2, S=3
+        return tag in (0, 3) or prev[0] in (2, 3)
+
+    def _is_end(self, cur, nxt):
+        """Does ``cur`` end its chunk given the next position?
+        (isChunkEnd)."""
+        if cur is None:
+            return False
+        tag, ctype = cur
+        if self.scheme == "plain":
+            return True
+        if nxt is None or nxt[1] != ctype:
+            return True
+        if self.scheme == "IOB":
+            return nxt[0] == 0                    # next is B
+        if self.scheme == "IOE":
+            return tag == 1                       # E
+        return tag in (2, 3) or nxt[0] in (0, 3)  # IOBES
+
+    def _segments(self, tags: Sequence[int]):
+        """Decode (begin, end, type) chunks; mirrors getSegments in
+        ChunkEvaluator.cpp."""
+        decoded = [self._decode(t) for t in tags]
+        out = []
+        start = None
+        for i, cur in enumerate(decoded):
+            prev = decoded[i - 1] if i > 0 else None
+            nxt = decoded[i + 1] if i + 1 < len(decoded) else None
+            if self._is_start(prev, cur):
+                start = i
+            if cur is not None and start is None:
+                start = i  # tolerate malformed prediction (I without B)
+            if self._is_end(cur, nxt) and start is not None:
+                out.append((start, i, cur[1]))
+                start = None
+            if cur is None:
+                start = None
+        return [(b, e, c) for (b, e, c) in out if c not in self.excluded]
+
+    def eval_batch(self, output, label=None, weight=None, mask=None):
+        """output: predicted tag ids [B, T] (or list of lists); label same."""
+        pred = np.asarray(output)
+        lab = np.asarray(label)
+        if pred.ndim == 1:
+            pred, lab = pred[None], lab[None]
+            mask = None if mask is None else np.asarray(mask)[None]
+        for b in range(pred.shape[0]):
+            if mask is not None:
+                n = int(np.asarray(mask)[b].sum())
+            else:
+                n = pred.shape[1]
+            p_chunks = set(self._segments(pred[b, :n].tolist()))
+            l_chunks = set(self._segments(lab[b, :n].tolist()))
+            self.num_output += len(p_chunks)
+            self.num_label += len(l_chunks)
+            self.num_correct += len(p_chunks & l_chunks)
+
+    def value(self):
+        p = self.num_correct / max(self.num_output, 1e-12)
+        r = self.num_correct / max(self.num_label, 1e-12)
+        return 2 * p * r / max(p + r, 1e-12)
+
+
+def edit_distance(a: Sequence[int], b: Sequence[int]) -> int:
+    """Levenshtein distance (the core of ``CTCErrorEvaluator.cpp``)."""
+    la, lb = len(a), len(b)
+    prev = np.arange(lb + 1)
+    for i in range(1, la + 1):
+        cur = np.empty(lb + 1, np.int64)
+        cur[0] = i
+        for j in range(1, lb + 1):
+            cur[j] = min(prev[j] + 1, cur[j - 1] + 1,
+                         prev[j - 1] + (a[i - 1] != b[j - 1]))
+        prev = cur
+    return int(prev[lb])
+
+
+def ctc_best_path(log_probs: np.ndarray, blank: int) -> List[int]:
+    """Greedy best-path decoding: argmax per frame, collapse repeats,
+    drop blanks."""
+    path = np.argmax(log_probs, axis=-1)
+    out: List[int] = []
+    prev = -1
+    for t in path:
+        t = int(t)
+        if t != prev and t != blank:
+            out.append(t)
+        prev = t
+    return out
+
+
+@register_evaluator("ctc_edit_distance")
+class CTCErrorEvaluator(EvaluatorBase):
+    """``CTCErrorEvaluator.cpp``: normalized edit distance between the
+    best-path-decoded CTC output and the label sequence."""
+
+    def __init__(self, name=None, blank: Optional[int] = None):
+        self.blank = blank
+        super().__init__(name)
+
+    def start(self):
+        self.total_dist = 0.0
+        self.total_len = 0.0
+        self.seqs = 0
+
+    def eval_batch(self, output, label=None, weight=None, mask=None,
+                   label_mask=None):
+        """output: [B, T, C] frame scores; label: [B, L] int ids."""
+        out = np.asarray(output)
+        lab = np.asarray(label)
+        if out.ndim == 2:
+            out, lab = out[None], lab[None]
+        blank = self.blank if self.blank is not None else out.shape[-1] - 1
+        for b in range(out.shape[0]):
+            T = (int(np.asarray(mask)[b].sum()) if mask is not None
+                 else out.shape[1])
+            L = (int(np.asarray(label_mask)[b].sum())
+                 if label_mask is not None else lab.shape[1])
+            hyp = ctc_best_path(out[b, :T], blank)
+            ref = [int(x) for x in lab[b, :L]]
+            self.total_dist += edit_distance(hyp, ref)
+            self.total_len += max(len(ref), 1)
+            self.seqs += 1
+
+    def value(self):
+        return self.total_dist / max(self.total_len, 1e-12)
+
+
+@register_evaluator("sum")
+class SumEvaluator(EvaluatorBase):
+    def start(self):
+        self.total = 0.0
+        self.count = 0.0
+
+    def eval_batch(self, output, label=None, weight=None, mask=None):
+        out = np.asarray(output, np.float64)
+        w = 1.0 if weight is None else np.asarray(weight, np.float64)
+        if mask is not None:
+            out = out * np.asarray(mask)[..., None]
+        self.total += float((out * w).sum()) if weight is not None \
+            else float(out.sum())
+        self.count += (float(np.asarray(mask).sum()) if mask is not None
+                       else out.shape[0])
+
+    def value(self):
+        return self.total / max(self.count, 1.0)
+
+
+@register_evaluator("column_sum")
+class ColumnSumEvaluator(EvaluatorBase):
+    def __init__(self, name=None, column: int = 0):
+        self.column = column
+        super().__init__(name)
+
+    def start(self):
+        self.total = 0.0
+        self.count = 0.0
+
+    def eval_batch(self, output, label=None, weight=None, mask=None):
+        out = np.asarray(output, np.float64)
+        col = out[..., self.column].reshape(-1)
+        w = (np.ones_like(col) if weight is None
+             else np.asarray(weight, np.float64).reshape(-1))
+        if mask is not None:
+            w = w * np.asarray(mask).reshape(-1)
+        self.total += float((col * w).sum())
+        self.count += float(w.sum())
+
+    def value(self):
+        return self.total / max(self.count, 1.0)
+
+
+@register_evaluator("value_printer")
+class ValuePrinter(EvaluatorBase):
+    """``ValuePrinter`` — debug printer; keeps last batch, prints on
+    finish (the reference prints every eval)."""
+
+    def start(self):
+        self.last = None
+
+    def eval_batch(self, output, label=None, weight=None, mask=None):
+        self.last = np.asarray(output)
+
+    def value(self):
+        print(f"[{self.name}] value:\n{self.last}")
+        return 0.0
+
+
+@register_evaluator("maxid_printer")
+class MaxIdPrinter(EvaluatorBase):
+    def start(self):
+        self.last = None
+
+    def eval_batch(self, output, label=None, weight=None, mask=None):
+        self.last = np.argmax(np.asarray(output), axis=-1)
+
+    def value(self):
+        print(f"[{self.name}] maxid:\n{self.last}")
+        return 0.0
+
+
+@register_evaluator("seq_text_printer")
+class SeqTextPrinter(EvaluatorBase):
+    """``utils/SeqTextPrinter`` analogue: map id sequences through a dict
+    file and print."""
+
+    def __init__(self, name=None, dict_file: Optional[str] = None,
+                 id_input=None):
+        self.vocab = None
+        if dict_file:
+            with open(dict_file) as f:
+                self.vocab = [line.rstrip("\n") for line in f]
+        super().__init__(name)
+
+    def start(self):
+        self.lines: List[str] = []
+
+    def eval_batch(self, output, label=None, weight=None, mask=None):
+        ids = np.asarray(output)
+        if ids.ndim == 1:
+            ids = ids[None]
+        for b in range(ids.shape[0]):
+            n = int(np.asarray(mask)[b].sum()) if mask is not None \
+                else ids.shape[1]
+            toks = [self.vocab[int(i)] if self.vocab else str(int(i))
+                    for i in ids[b, :n]]
+            self.lines.append(" ".join(toks))
+
+    def value(self):
+        print("\n".join(self.lines))
+        return 0.0

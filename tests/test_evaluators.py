@@ -1,0 +1,143 @@
+"""Evaluator known-answer tests — the analogue of the reference's
+``paddle/gserver/tests/test_Evaluator.cpp`` (which exercises each
+REGISTER_EVALUATOR type on synthesized data)."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.trainer.metrics import (AucEvaluator, ChunkEvaluator,
+                                        ClassificationErrorEvaluator,
+                                        CTCErrorEvaluator,
+                                        PnpairEvaluator,
+                                        PrecisionRecallEvaluator,
+                                        SumEvaluator, create_evaluator,
+                                        ctc_best_path, edit_distance)
+
+
+def test_classification_error_basic_and_topk():
+    out = np.array([[0.9, 0.1], [0.2, 0.8], [0.6, 0.4]], np.float32)
+    lab = np.array([0, 1, 1])
+    e = ClassificationErrorEvaluator()
+    e.eval_batch(out, lab)
+    assert e.value() == pytest.approx(1 / 3)
+    e2 = ClassificationErrorEvaluator(top_k=2)
+    e2.eval_batch(out, lab)
+    assert e2.value() == 0.0
+
+
+def test_auc_perfect_and_random():
+    rng = np.random.RandomState(0)
+    lab = rng.randint(0, 2, size=2000)
+    # perfectly separating score
+    score = lab * 0.5 + 0.25
+    e = AucEvaluator()
+    e.eval_batch(score, lab)
+    assert e.value() == pytest.approx(1.0, abs=1e-3)
+    # score independent of label -> ~0.5
+    e2 = AucEvaluator()
+    e2.eval_batch(rng.rand(2000), lab)
+    assert e2.value() == pytest.approx(0.5, abs=0.05)
+
+
+def test_auc_matches_exact_rank_formula():
+    rng = np.random.RandomState(1)
+    score = rng.rand(500)
+    lab = (rng.rand(500) < 0.4).astype(int)
+    e = AucEvaluator(num_bins=1 << 16)
+    e.eval_batch(score, lab)
+    # exact AUC by rank statistic
+    order = np.argsort(score)
+    ranks = np.empty_like(order, dtype=float)
+    ranks[order] = np.arange(1, len(score) + 1)
+    n_pos, n_neg = lab.sum(), (1 - lab).sum()
+    exact = (ranks[lab == 1].sum() - n_pos * (n_pos + 1) / 2) / (n_pos * n_neg)
+    assert e.value() == pytest.approx(exact, abs=2e-3)
+
+
+def test_precision_recall_single_class():
+    out = np.array([[0.8, 0.2], [0.3, 0.7], [0.1, 0.9], [0.6, 0.4]])
+    lab = np.array([0, 1, 0, 1])
+    e = PrecisionRecallEvaluator(positive_label=1)
+    e.eval_batch(out, lab)
+    # predictions: 0,1,1,0 ; tp=1 fp=1 fn=1 -> p=r=f=0.5
+    assert e.value() == pytest.approx(0.5)
+
+
+def test_pnpair_ratio():
+    e = PnpairEvaluator()
+    # one query: pos scored above neg twice, below once
+    e.eval_batch(np.array([0.9, 0.8, 0.1]), np.array([1, 0, 0]),
+                 query_id=np.array([7, 7, 7]))
+    # pairs: (pos.9,neg.8) correct, (pos.9,neg.1) correct -> ratio 2/eps
+    assert e.value() > 100
+
+
+def test_chunk_f1_iob_perfect():
+    # 2 chunk types, IOB: labels B0=0 I0=1 B1=2 I1=3 O=4
+    tags = [0, 1, 4, 2, 3, 3, 4]
+    e = ChunkEvaluator(chunk_scheme="IOB", num_chunk_types=2)
+    e.eval_batch(np.array(tags), np.array(tags))
+    assert e.value() == pytest.approx(1.0)
+    assert e.num_label == 2
+
+
+def test_chunk_f1_iob_partial():
+    gold = [0, 1, 4, 2, 3, 4]   # chunks (0,1,t0) (3,4,t1)
+    pred = [0, 1, 4, 4, 2, 4]   # chunks (0,1,t0) (4,4,t1) -> 1 correct of 2
+    e = ChunkEvaluator(chunk_scheme="IOB", num_chunk_types=2)
+    e.eval_batch(np.array(pred), np.array(gold))
+    assert e.value() == pytest.approx(2 * 0.5 * 0.5 / (0.5 + 0.5))
+
+
+def test_chunk_iobes():
+    # 1 chunk type: B=0 I=1 E=2 S=3 O=4
+    gold = [3, 4, 0, 1, 2]      # chunks (0,0) (2,4)
+    e = ChunkEvaluator(chunk_scheme="IOBES", num_chunk_types=1)
+    e.eval_batch(np.array(gold), np.array(gold))
+    assert e.num_label == 2 and e.value() == pytest.approx(1.0)
+
+
+def test_chunk_ioe():
+    # 1 chunk type: I=0 E=1 O=2
+    gold = [0, 1, 2, 0, 0, 1]   # chunks (0,1) (3,5)
+    e = ChunkEvaluator(chunk_scheme="IOE", num_chunk_types=1)
+    e.eval_batch(np.array(gold), np.array(gold))
+    assert e.num_label == 2 and e.value() == pytest.approx(1.0)
+
+
+def test_edit_distance_and_best_path():
+    assert edit_distance([1, 2, 3], [1, 3]) == 1
+    assert edit_distance([], [1, 2]) == 2
+    assert edit_distance([1, 2], [1, 2]) == 0
+    # frames: [a a blank a] with blank=2 -> collapse to [a, a]
+    lp = np.log(np.array([[0.9, .05, .05], [0.9, .05, .05],
+                          [.05, .05, 0.9], [0.9, .05, .05]]))
+    assert ctc_best_path(lp, blank=2) == [0, 0]
+
+
+def test_ctc_error_evaluator():
+    # perfect decoding -> 0 error
+    C = 4  # classes incl blank=3
+    T = 6
+    out = np.full((1, T, C), -5.0)
+    # emit 1, blank, 2
+    for t, c in enumerate([1, 3, 2, 3, 3, 3]):
+        out[0, t, c] = 5.0
+    e = CTCErrorEvaluator(blank=3)
+    e.eval_batch(out, np.array([[1, 2]]))
+    assert e.value() == 0.0
+
+
+def test_registry_create():
+    e = create_evaluator("auc", num_bins=64)
+    assert isinstance(e, AucEvaluator)
+    with pytest.raises(KeyError):
+        create_evaluator("nope")
+
+
+def test_sum_evaluator_masked():
+    e = SumEvaluator()
+    out = np.ones((2, 3, 1))
+    mask = np.array([[1, 1, 0], [1, 0, 0]], np.float32)
+    e.eval_batch(out, mask=mask)
+    assert e.value() == pytest.approx(1.0)

@@ -131,3 +131,46 @@ def test_model_averaging():
     got, state = _run(opt, p0, [np.array([1.0], np.float32)] * 3)
     assert "avg" in state
     assert np.isfinite(np.asarray(state["avg"]["w"])).all()
+
+
+def test_manual_schedule_piecewise():
+    from paddle_tpu.optim.schedules import learning_rate_at
+    # boundaries at 100 and 200 samples; factors 1.0 / 0.5 / 0.1
+    lr = learning_rate_at("manual", 0.2, 0, 0, 50, args="100:1.0,200:0.5,300:0.1")
+    np.testing.assert_allclose(float(lr), 0.2, rtol=1e-6)
+    lr = learning_rate_at("manual", 0.2, 0, 0, 150, args="100:1.0,200:0.5,300:0.1")
+    np.testing.assert_allclose(float(lr), 0.1, rtol=1e-6)
+    lr = learning_rate_at("manual", 0.2, 0, 0, 999, args="100:1.0,200:0.5,300:0.1")
+    np.testing.assert_allclose(float(lr), 0.02, rtol=1e-6)
+
+
+def test_pass_manual_schedule():
+    from paddle_tpu.optim.schedules import learning_rate_at
+    lr = learning_rate_at("pass_manual", 1.0, 0, 0, 0,
+                          args="1:1.0,2:0.5", num_passes=0)
+    assert float(lr) == 1.0
+    lr = learning_rate_at("pass_manual", 1.0, 0, 0, 0,
+                          args="1:1.0,2:0.5", num_passes=5)
+    assert float(lr) == 0.5
+
+
+def test_nesterov_momentum_differs_and_converges():
+    p0 = np.array([1.0, -1.0], np.float32)
+    gs = [p0.copy() * 0.5] * 5
+    plain, _ = _run(Momentum(learning_rate=0.1, momentum=0.9), p0, gs)
+    nest, _ = _run(Momentum(learning_rate=0.1, momentum=0.9, nesterov=True),
+                   p0, gs)
+    assert not np.allclose(plain, nest)
+
+
+def test_model_averaging_apply():
+    opt = Momentum(learning_rate=0.5, average_window=10)
+    params = {"w": jnp.asarray(np.array([0.0], np.float32))}
+    state = opt.init(params)
+    for _ in range(4):
+        params, state = opt.update(
+            {"w": jnp.asarray(np.array([1.0], np.float32))}, state, params)
+    avg = opt.averaged_params(state, params)
+    # averaged value lags the raw trained value (running mean of iterates)
+    assert float(avg["w"][0]) > float(params["w"][0])
+    assert float(avg["w"][0]) < 0.0  # moved in the gradient direction
