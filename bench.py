@@ -49,7 +49,7 @@ def main():
     for _ in range(3):
         rng_key, step_key = jax.random.split(rng_key)
         trainer.params, trainer.opt_state, metrics = trainer._train_step(
-            trainer.params, trainer.opt_state, feed, step_key)
+            trainer.params, trainer.opt_state, feed, step_key, 0)
     jax.block_until_ready(metrics["cost"])
 
     iters = ITERS
@@ -57,7 +57,7 @@ def main():
     for _ in range(iters):
         rng_key, step_key = jax.random.split(rng_key)
         trainer.params, trainer.opt_state, metrics = trainer._train_step(
-            trainer.params, trainer.opt_state, feed, step_key)
+            trainer.params, trainer.opt_state, feed, step_key, 0)
     jax.block_until_ready(metrics["cost"])
     ms = (time.perf_counter() - t0) / iters * 1000.0
 

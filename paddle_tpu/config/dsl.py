@@ -341,3 +341,244 @@ def _layer_attr(layer_attr: Optional[dict]):
         if "drop_rate" in layer_attr:
             out["drop_rate"] = layer_attr["drop_rate"]
     return out
+
+
+# ------------------------------------------------- recurrent groups (§3.5)
+@dataclasses.dataclass
+class StaticInput:
+    """Non-time-varying input to a recurrent_group (the reference's
+    StaticInput: read whole each timestep, not sliced)."""
+
+    input: LayerOutput
+
+
+@dataclasses.dataclass
+class GeneratedInput:
+    """Generation-mode input: at each step the previous step's generated
+    word id is embedded and fed (reference GeneratedInput in
+    trainer_config_helpers/layers.py; consumed by beam search,
+    RecurrentGradientMachine.cpp:964+)."""
+
+    size: int                      # vocabulary size
+    embedding_name: str            # shared embedding parameter name
+    embedding_size: int
+    bos_id: int = 0
+    eos_id: int = 1
+
+
+_GROUP_CTX: Optional[Dict[str, Any]] = None
+
+
+def memory(*, name: str, size: int, boot_layer: Optional[LayerOutput] = None,
+           boot_with_const_value: float = 0.0) -> LayerOutput:
+    """Declare a recurrent memory inside a recurrent_group step function:
+    the previous timestep's output of the layer called ``name`` (zero /
+    constant / boot-layer initialized). Mirrors the DSL ``memory()`` that
+    becomes an in_link on the reference's recurrent sub-model."""
+    global _GROUP_CTX
+    if _GROUP_CTX is None:
+        raise RuntimeError(
+            "memory() must be called inside a recurrent_group step function")
+    bname = f"{_GROUP_CTX['name']}@mem_{name}"
+    out = _add(LayerDef(name=bname, type="data", size=size, bias=False))
+    _GROUP_CTX["memories"].append({
+        "boundary": bname, "link": name, "boot_layer": boot_layer,
+        "init": boot_with_const_value})
+    return out
+
+
+def recurrent_group(step, input, *, reverse: bool = False,
+                    name: str = None):
+    """Unroll a user step network over the timesteps of the sequence
+    inputs (the TPU-native ``RecurrentGradientMachine`` training path —
+    see paddle_tpu/layers/group.py). ``input`` items: sequence
+    LayerOutputs (sliced per step), StaticInput (whole every step).
+    The step function may call memory() and returns one LayerOutput or a
+    tuple (first = main out_link)."""
+    global _GRAPH, _GROUP_CTX
+    from paddle_tpu.config.model_config import ModelDef as _ModelDef
+    inputs = [input] if isinstance(input, (LayerOutput, StaticInput)) \
+        else list(input)
+    gname = name or _auto_name("recurrent_group")
+    outer = _GRAPH
+    sub = _ModelDef()
+    ins_meta: List[Dict[str, Any]] = []
+    outer_in_names: List[str] = []
+    proxies: List[LayerOutput] = []
+    prev_ctx = _GROUP_CTX
+    _GRAPH = sub
+    _GROUP_CTX = {"name": gname, "memories": []}
+    try:
+        for i, x in enumerate(inputs):
+            if isinstance(x, StaticInput):
+                src = x.input
+                bname = f"{gname}@static{i}"
+                ldef = LayerDef(name=bname, type="data", size=src.size,
+                                bias=False)
+            else:
+                src = x
+                bname = f"{gname}@seq{i}"
+                ldef = LayerDef(name=bname, type="data", size=src.size,
+                                bias=False)
+            proxies.append(_add(ldef))
+            ins_meta.append({"boundary": bname,
+                             "kind": "static" if isinstance(x, StaticInput)
+                             else "seq"})
+            outer_in_names.append(src.name)
+        traced = step(*proxies)
+        memories = _GROUP_CTX["memories"]
+    finally:
+        _GRAPH = outer
+        _GROUP_CTX = prev_ctx
+
+    out_handles = list(traced) if isinstance(traced, (tuple, list)) \
+        else [traced]
+    for mem in memories:
+        if mem["link"] not in sub.layers:
+            raise ValueError(
+                f"memory(name={mem['link']!r}) has no matching layer "
+                f"inside recurrent_group {gname!r}")
+        bl = mem.pop("boot_layer")
+        if bl is not None:
+            ins_meta.append({"boundary": mem["boundary"], "kind": "boot"})
+            outer_in_names.append(bl.name)
+    ldef = LayerDef(
+        name=gname, type="recurrent_layer_group",
+        inputs=[Input(n) for n in outer_in_names], bias=False,
+        attrs={"sub_model": sub, "ins": ins_meta, "memories": memories,
+               "outputs": [h.name for h in out_handles],
+               "reverse": reverse})
+    main = _add(ldef)
+    if len(out_handles) == 1:
+        return main
+    extras = []
+    for h in out_handles[1:]:
+        odef = LayerDef(name=f"{gname}@out_{h.name}", type="group_output",
+                        inputs=[Input(main.name)], size=h.size, bias=False,
+                        attrs={"sub_name": h.name})
+        extras.append(_add(odef))
+    return (main, *extras)
+
+
+def slope_intercept(input, *, slope: float = 1.0, intercept: float = 0.0,
+                    name: str = None) -> LayerOutput:
+    ldef = LayerDef(name=name or _auto_name("slope_intercept"),
+                    type="slope_intercept", inputs=[Input(_in(input)[0].name)],
+                    bias=False, attrs={"slope": slope, "intercept": intercept})
+    return _add(ldef)
+
+
+def beam_search(step, input, *, bos_id: int = None, eos_id: int = None,
+                beam_size: int = 5, max_length: int = 100,
+                name: str = None) -> LayerOutput:
+    """Generation-mode recurrent group (``beam_search`` in the reference
+    DSL; executed by ``RecurrentGradientMachine::generateSequence``). The
+    step function receives the embedding of the previously generated word
+    for the GeneratedInput slot and must return post-softmax probabilities
+    over the vocabulary. Run it with
+    ``paddle_tpu.core.generation.SequenceGenerator``."""
+    global _GRAPH, _GROUP_CTX
+    from paddle_tpu.config.model_config import ModelDef as _ModelDef
+    inputs = list(input) if isinstance(input, (list, tuple)) else [input]
+    gname = name or _auto_name("beam_search")
+    outer = _GRAPH
+    sub = _ModelDef()
+    ins_meta: List[Dict[str, Any]] = []
+    outer_in_names: List[str] = []
+    proxies: List[LayerOutput] = []
+    gen_spec = None
+    prev_ctx = _GROUP_CTX
+    _GRAPH = sub
+    _GROUP_CTX = {"name": gname, "memories": []}
+    try:
+        for i, x in enumerate(inputs):
+            if isinstance(x, GeneratedInput):
+                if gen_spec is not None:
+                    raise ValueError("only one GeneratedInput allowed")
+                bname = f"{gname}@gen{i}"
+                proxies.append(_add(LayerDef(
+                    name=bname, type="data", size=x.embedding_size,
+                    bias=False)))
+                gen_spec = {"boundary": bname, "size": x.size,
+                            "embedding_name": x.embedding_name,
+                            "embedding_size": x.embedding_size,
+                            "bos_id": bos_id if bos_id is not None else x.bos_id,
+                            "eos_id": eos_id if eos_id is not None else x.eos_id}
+            elif isinstance(x, StaticInput):
+                bname = f"{gname}@static{i}"
+                proxies.append(_add(LayerDef(
+                    name=bname, type="data", size=x.input.size, bias=False)))
+                ins_meta.append({"boundary": bname, "kind": "static"})
+                outer_in_names.append(x.input.name)
+            else:
+                raise TypeError(
+                    "beam_search inputs must be GeneratedInput/StaticInput")
+        traced = step(*proxies)
+        memories = _GROUP_CTX["memories"]
+    finally:
+        _GRAPH = outer
+        _GROUP_CTX = prev_ctx
+    if gen_spec is None:
+        raise ValueError("beam_search needs a GeneratedInput")
+    out_handles = list(traced) if isinstance(traced, (tuple, list)) \
+        else [traced]
+    for mem in memories:
+        if mem["link"] not in sub.layers:
+            raise ValueError(
+                f"memory(name={mem['link']!r}) has no matching layer "
+                f"inside beam_search group {gname!r}")
+        bl = mem.pop("boot_layer")
+        if bl is not None:
+            ins_meta.append({"boundary": mem["boundary"], "kind": "boot"})
+            outer_in_names.append(bl.name)
+    ldef = LayerDef(
+        name=gname, type="beam_search_group",
+        inputs=[Input(n) for n in outer_in_names], bias=False,
+        attrs={"sub_model": sub, "ins": ins_meta, "memories": memories,
+               "outputs": [h.name for h in out_handles], "gen": gen_spec,
+               "beam_size": beam_size, "max_length": max_length})
+    return _add(ldef)
+
+
+def crf_layer(input, label, *, size: int = None, weight=None,
+              param_attr=None, name: str = None) -> LayerOutput:
+    ins = [Input(_in(input)[0].name, param_attr=_param(param_attr)),
+           Input(_in(label)[0].name)]
+    if weight is not None:
+        ins.append(Input(_in(weight)[0].name))
+    ldef = LayerDef(name=name or _auto_name("crf"), type="crf",
+                    inputs=ins, bias=False)
+    return _add(ldef)
+
+
+def crf_decoding_layer(input, *, size: int = None, label=None,
+                       param_attr=None, name: str = None) -> LayerOutput:
+    ins = [Input(_in(input)[0].name, param_attr=_param(param_attr))]
+    if label is not None:
+        ins.append(Input(_in(label)[0].name))
+    ldef = LayerDef(name=name or _auto_name("crf_decoding"),
+                    type="crf_decoding", inputs=ins, bias=False)
+    return _add(ldef)
+
+
+def ctc_layer(input, label, *, size: int = None, norm_by_times: bool = False,
+              blank: int = None, name: str = None) -> LayerOutput:
+    attrs = {"norm_by_times": norm_by_times}
+    if blank is not None:
+        attrs["blank"] = blank
+    ldef = LayerDef(name=name or _auto_name("ctc"), type="ctc",
+                    inputs=[Input(_in(input)[0].name),
+                            Input(_in(label)[0].name)],
+                    bias=False, attrs=attrs)
+    return _add(ldef)
+
+
+def warp_ctc_layer(input, label, *, size: int = None,
+                   norm_by_times: bool = False, blank: int = 0,
+                   name: str = None) -> LayerOutput:
+    ldef = LayerDef(name=name or _auto_name("warp_ctc"), type="warp_ctc",
+                    inputs=[Input(_in(input)[0].name),
+                            Input(_in(label)[0].name)],
+                    bias=False,
+                    attrs={"norm_by_times": norm_by_times, "blank": blank})
+    return _add(ldef)

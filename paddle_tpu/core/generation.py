@@ -1,0 +1,191 @@
+"""Sequence generation: greedy and beam search over a recurrent step net.
+
+TPU-native ``RecurrentGradientMachine::generateSequence``
+(``RecurrentGradientMachine.cpp:964``): greedy ``oneWaySearch`` (``:1042``)
+is the beam_size=1 case of ``beamSearch`` (``:1393``). Where the reference
+expands/prunes beams with host-side std::vector bookkeeping per step, here
+the whole search is ONE jitted ``lax.scan`` with static beam and length
+dims: beams live as a [B, K] axis, finished beams are frozen by masking
+(-inf over non-EOS continuations), and parent-beam reordering is a gather.
+
+The user beam-control hooks (``beamSearchCandidateAdjust`` /
+``DropCallback``, RecurrentGradientMachine.h:101-133) survive as the
+``candidate_adjust`` callable traced into the step.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.argument import Argument
+
+
+def _flatten_beams(x):
+    return x.reshape((-1,) + x.shape[2:])
+
+
+def _unflatten_beams(x, B, K):
+    return x.reshape((B, K) + x.shape[1:])
+
+
+class SequenceGenerator:
+    """Drives a generation-mode recurrent group (``beam_search`` in the
+    DSL). Mirrors the SWIG ``SequenceGenerator`` (api/PaddleAPI.h) surface:
+    construct from the model + generating layer, call ``generate``."""
+
+    def __init__(self, model, gen_layer: str):
+        from paddle_tpu.layers.group import _group_subnet
+
+        self.cfg = model.layers[gen_layer]
+        if self.cfg.type != "beam_search_group":
+            raise ValueError(f"{gen_layer!r} is not a beam_search group")
+        self.net = _group_subnet(self.cfg)
+        self.gen = self.cfg.attrs["gen"]  # GeneratedInput spec dict
+        self._jitted: Dict[Any, Callable] = {}
+
+    # ------------------------------------------------------------------
+    def generate(self, params, outer_outputs: Dict[str, Argument], *,
+                 beam_size: Optional[int] = None,
+                 max_length: Optional[int] = None,
+                 candidate_adjust: Optional[Callable] = None):
+        """Run the search.
+
+        params: global parameter table (sub-net params are hoisted names).
+        outer_outputs: outer-layer Arguments for static/boot inputs, keyed
+            by outer layer name (run your encoder Network first).
+        Returns (tokens [B, K, L] int32, scores [B, K], lengths [B, K]) —
+        beams sorted best-first, EOS included in the length.
+        """
+        if beam_size is None:
+            beam_size = self.cfg.attrs.get("beam_size", 1)
+        if max_length is None:
+            max_length = self.cfg.attrs.get("max_length", 100)
+        # key by the callable itself (strong ref) — an id() key could be
+        # recycled after GC and silently serve a stale traced search
+        key = (beam_size, max_length, candidate_adjust)
+        if key not in self._jitted:
+            self._jitted[key] = jax.jit(
+                lambda p, feed: self._search(
+                    p, feed, beam_size, max_length, candidate_adjust))
+        static_feed = {}
+        for inp, meta in zip(self.cfg.inputs, self.cfg.attrs["ins"]):
+            if meta["kind"] in ("static", "boot"):
+                static_feed[meta["boundary"]] = outer_outputs[inp.layer_name]
+        return self._jitted[key](params, static_feed)
+
+    # ------------------------------------------------------------------
+    def _search(self, params, static_feed, K: int, L: int, adjust):
+        cfg, net, gen = self.cfg, self.net, self.gen
+        memories = cfg.attrs["memories"]
+        out_name = cfg.attrs["outputs"][0]
+        emb = params[gen["embedding_name"]]
+        bos, eos = gen["bos_id"], gen["eos_id"]
+        gen_boundary = gen["boundary"]
+
+        boots = {m["boundary"]: static_feed[m["boundary"]].value
+                 for m in memories if m["boundary"] in static_feed}
+        some_static = next((a for a in static_feed.values()), None)
+        if some_static is None:
+            raise ValueError("generation needs at least one static/boot "
+                             "input to define the batch size")
+        B = some_static.value.shape[0]
+
+        # beams: replicate statics over K and flatten to a [B*K] batch
+        def rep(a: Argument) -> Argument:
+            def r(x):
+                return _flatten_beams(
+                    jnp.broadcast_to(x[:, None], (B, K) + x.shape[1:]))
+            return Argument(value=r(a.value),
+                            mask=None if a.mask is None else r(a.mask))
+
+        flat_static = {
+            b: rep(a) for b, a in static_feed.items()
+            if b not in boots}
+
+        carry0 = {}
+        for m in memories:
+            bname = m["boundary"]
+            if bname in boots:
+                v = boots[bname]
+            else:
+                size = net.shape_infos[bname].size
+                v = jnp.full((B, size), m.get("init", 0.0), jnp.float32)
+            carry0[bname] = _flatten_beams(
+                jnp.broadcast_to(v[:, None], (B, K) + v.shape[1:]))
+
+        NEG = jnp.float32(-1e9)
+        state0 = {
+            "tokens": jnp.full((B, K, L), eos, jnp.int32),
+            "prev": jnp.full((B, K), bos, jnp.int32),
+            # only beam 0 is live at t=0 so duplicates don't fill the beam
+            "scores": jnp.concatenate(
+                [jnp.zeros((B, 1)), jnp.full((B, K - 1), NEG)], axis=1)
+            if K > 1 else jnp.zeros((B, K)),
+            "finished": jnp.zeros((B, K), bool),
+            "mem": carry0,
+        }
+
+        def step(state, t):
+            prev_emb = emb[state["prev"].reshape(-1)]  # [B*K, E]
+            feed = dict(flat_static)
+            feed[gen_boundary] = Argument(value=prev_emb)
+            for m in memories:
+                feed[m["boundary"]] = Argument(value=state["mem"][m["boundary"]])
+            outs = net.apply(params, feed, train=False)
+            prob = outs[out_name].value  # [B*K, V] post-softmax
+            logp = jnp.log(jnp.maximum(prob, 1e-20))
+            if adjust is not None:
+                logp = adjust(logp, state)
+            V = logp.shape[-1]
+            logp = _unflatten_beams(logp, B, K)  # [B, K, V]
+            # finished beams may only "continue" with EOS at zero cost
+            fin = state["finished"][:, :, None]
+            eos_only = jnp.full((1, 1, V), NEG).at[0, 0, eos].set(0.0)
+            logp = jnp.where(fin, eos_only, logp)
+            total = state["scores"][:, :, None] + logp  # [B, K, V]
+            flat = total.reshape(B, K * V)
+            top_scores, top_idx = lax.top_k(flat, K)     # [B, K]
+            parent = top_idx // V
+            token = (top_idx % V).astype(jnp.int32)
+
+            def gather_parents(x):
+                # x: [B*K, ...] -> per-batch gather along beam axis
+                xb = _unflatten_beams(x, B, K)
+                return _flatten_beams(
+                    jnp.take_along_axis(
+                        xb, parent.reshape((B, K) + (1,) * (xb.ndim - 2)),
+                        axis=1))
+
+            new_mem = {
+                m["boundary"]: gather_parents(
+                    outs[m["link"]].value) for m in memories}
+            # frozen memories for finished beams
+            old_mem_g = {b: gather_parents(v) for b, v in state["mem"].items()}
+            fin_parent = jnp.take_along_axis(state["finished"], parent, axis=1)
+            finf = _flatten_beams(fin_parent)  # [B*K]
+            new_mem = {
+                b: jnp.where(finf.reshape((-1,) + (1,) * (v.ndim - 1)),
+                             old_mem_g[b], v)
+                for b, v in new_mem.items()}
+            tokens = jnp.take_along_axis(
+                state["tokens"], parent[:, :, None], axis=1)
+            tokens = tokens.at[:, :, t].set(token)
+            finished = fin_parent | (token == eos)
+            new_state = {"tokens": tokens, "prev": token,
+                         "scores": top_scores, "finished": finished,
+                         "mem": new_mem}
+            return new_state, None
+
+        state, _ = lax.scan(step, state0, jnp.arange(L))
+        tokens = state["tokens"]
+        # length = index of first EOS + 1 (EOS kept, as the reference's
+        # sequence results include the end mark), else L
+        is_eos = tokens == eos
+        first = jnp.argmax(is_eos, axis=-1)
+        has = jnp.any(is_eos, axis=-1)
+        lengths = jnp.where(has, first + 1, L)
+        return tokens, state["scores"], lengths

@@ -45,6 +45,8 @@ class Context:
 
 def _resolve_param_name(layer: LayerDef, suffix: str, spec: ParamSpec,
                         attr: Optional[ParamAttr]) -> str:
+    if spec.absolute_name:
+        return spec.absolute_name
     if attr is not None and attr.name:
         return attr.name
     return f"_{layer.name}.{suffix}"

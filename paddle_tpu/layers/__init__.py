@@ -12,3 +12,5 @@ from paddle_tpu.layers import norm  # noqa: F401
 from paddle_tpu.layers import pool  # noqa: F401
 from paddle_tpu.layers import recurrent  # noqa: F401
 from paddle_tpu.layers import sequence  # noqa: F401
+from paddle_tpu.layers import group  # noqa: F401
+from paddle_tpu.layers import chain  # noqa: F401

@@ -1,0 +1,173 @@
+"""Recurrent layer group: user-defined step networks unrolled over time.
+
+TPU-native ``RecurrentGradientMachine`` (``paddle/gserver/gradientmachines/
+RecurrentGradientMachine.cpp``): the reference clones a per-timestep
+sub-network ("frame", ``resizeOrCreateFrames`` at ``:294-346``) with shared
+parameters and walks frames sequentially; here the step sub-network is
+traced ONCE and driven by ``lax.scan``, so XLA sees a single fused loop
+body and the per-step matmuls stay on the MXU. Memories (``memory()`` in
+the config DSL) become scan carries; padded timesteps are mask-guarded so
+ragged batches keep reference semantics without dynamic shapes.
+
+Sub-network parameters are hoisted into the global parameter table under
+their sub-layer names (``ParamSpec.absolute_name``) — one set of weights
+shared by every timestep, exactly like the reference's frame sharing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.argument import Argument
+from paddle_tpu.core.network import Network
+from paddle_tpu.core.registry import (LayerImpl, ShapeInfo, register_layer)
+
+
+def _group_subnet(cfg) -> Network:
+    """Build (once) the step sub-network covering the group outputs and
+    every memory link layer."""
+    if "_subnet" not in cfg.attrs:
+        targets = list(cfg.attrs["outputs"])
+        for mem in cfg.attrs["memories"]:
+            if mem["link"] not in targets:
+                targets.append(mem["link"])
+        cfg.attrs["_subnet"] = Network(cfg.attrs["sub_model"],
+                                       outputs=targets)
+    return cfg.attrs["_subnet"]
+
+
+@register_layer("recurrent_layer_group")
+class RecurrentLayerGroup(LayerImpl):
+    """Training/eval path of the recurrent group (the generating path lives
+    in ``paddle_tpu/core/generation.py``)."""
+
+    def infer(self, cfg, in_infos):
+        net = _group_subnet(cfg)
+        main = cfg.attrs["outputs"][0]
+        info = net.shape_infos[main]
+        return dataclasses.replace(info, is_sequence=True)
+
+    def params(self, cfg, in_infos):
+        net = _group_subnet(cfg)
+        return {f"sub:{p}": dataclasses.replace(spec, absolute_name=p)
+                for p, spec in net.param_specs.items()}
+
+    def apply(self, cfg, params, ins, ctx):
+        net = _group_subnet(cfg)
+        sub_params = {k[len("sub:"):]: v for k, v in params.items()}
+        ins_meta: List[Dict[str, Any]] = cfg.attrs["ins"]
+        memories: List[Dict[str, Any]] = cfg.attrs["memories"]
+        reverse = bool(cfg.attrs.get("reverse", False))
+
+        xs: Dict[str, jnp.ndarray] = {}
+        static_feed: Dict[str, Argument] = {}
+        boot: Dict[str, jnp.ndarray] = {}
+        mask = None
+        for a, m in zip(ins, ins_meta):
+            if m["kind"] == "seq":
+                xs[m["boundary"]] = jnp.swapaxes(a.value, 0, 1)
+                if mask is None and a.mask is not None:
+                    mask = a.mask
+            elif m["kind"] == "static":
+                static_feed[m["boundary"]] = a
+            elif m["kind"] == "boot":
+                boot[m["boundary"]] = a.value
+        if not xs:
+            raise ValueError(
+                f"recurrent group {cfg.name!r} has no sequence input; "
+                "use beam_search/generation for input-free unrolling")
+        T = next(iter(xs.values())).shape[0]
+        B = next(iter(xs.values())).shape[1]
+        if mask is None:
+            mask = jnp.ones((B, T), jnp.float32)
+        mask_tb = jnp.swapaxes(mask, 0, 1)
+
+        carry0: Dict[str, jnp.ndarray] = {}
+        for mem in memories:
+            bname = mem["boundary"]
+            if bname in boot:
+                carry0[bname] = boot[bname]
+            else:
+                size = net.shape_infos[bname].size
+                carry0[bname] = jnp.full((B, size), mem.get("init", 0.0),
+                                         jnp.float32)
+
+        out_names = cfg.attrs["outputs"]
+        scan_in: Dict[str, Any] = {"x": xs, "m": mask_tb}
+        if ctx.rng is not None:
+            scan_in["rng"] = jax.random.split(
+                ctx.layer_rng(cfg.name + "/group"), T)
+        train = ctx.train
+
+        def body(carry, inp):
+            feed = dict(static_feed)
+            for k, v in inp["x"].items():
+                feed[k] = Argument(value=v)
+            for mem in memories:
+                feed[mem["boundary"]] = Argument(value=carry[mem["boundary"]])
+            outs = net.apply(sub_params, feed, train=train,
+                             rng=inp.get("rng"))
+            m_t = inp["m"]
+
+            def guard(new, old):
+                m = m_t.reshape(m_t.shape + (1,) * (new.ndim - 1))
+                return jnp.where(m > 0, new, old)
+
+            new_carry = {
+                mem["boundary"]: guard(outs[mem["link"]].value,
+                                       carry[mem["boundary"]])
+                for mem in memories}
+            ys = {}
+            for o in out_names:
+                y = outs[o].value
+                m = m_t.reshape(m_t.shape + (1,) * (y.ndim - 1))
+                ys[o] = y * m.astype(y.dtype)
+            return new_carry, ys
+
+        carry, ys = lax.scan(body, carry0, scan_in, reverse=reverse)
+        main = out_names[0]
+        extras = {o: jnp.swapaxes(ys[o], 0, 1) for o in out_names[1:]}
+        return Argument(value=jnp.swapaxes(ys[main], 0, 1), mask=mask,
+                        state={"group_outputs": extras, "final": carry})
+
+
+@register_layer("group_output")
+class GroupOutput(LayerImpl):
+    """Exposes a non-main output of a recurrent group (the reference allows
+    multiple out_links on a recurrent_group)."""
+
+    def infer(self, cfg, in_infos):
+        return ShapeInfo(size=cfg.size, is_sequence=True)
+
+    def apply(self, cfg, params, ins, ctx):
+        a = ins[0]
+        return Argument(value=a.state["group_outputs"][cfg.attrs["sub_name"]],
+                        mask=a.mask)
+
+
+@register_layer("beam_search_group")
+class BeamSearchGroup(LayerImpl):
+    """Config-time node for a generating recurrent group. Not executable by
+    the forward pass — drive it with
+    ``paddle_tpu.core.generation.SequenceGenerator`` (the reference
+    likewise switches RecurrentGradientMachine into generating mode only
+    under ``--job=test``/Inference)."""
+
+    def infer(self, cfg, in_infos):
+        _group_subnet(cfg)  # validate the step net early
+        return ShapeInfo(size=1, is_sequence=True)
+
+    def params(self, cfg, in_infos):
+        net = _group_subnet(cfg)
+        return {f"sub:{p}": dataclasses.replace(spec, absolute_name=p)
+                for p, spec in net.param_specs.items()}
+
+    def apply(self, cfg, params, ins, ctx):
+        raise RuntimeError(
+            f"beam_search group {cfg.name!r} cannot run in a training "
+            "forward pass; use SequenceGenerator.generate")

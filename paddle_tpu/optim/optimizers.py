@@ -64,9 +64,10 @@ class Optimizer:
 
     def update(self, grads, state, params,
                meta: Optional[Dict[str, ParamSpec]] = None,
-               batch_size=1):
+               batch_size=1, num_passes=0):
         """(grads, state, params) -> (new_params, new_state). meta carries
-        per-param lr multipliers / static flags / l1-l2 overrides."""
+        per-param lr multipliers / static flags / l1-l2 overrides;
+        ``num_passes`` (current pass id) drives the pass_manual schedule."""
         from paddle_tpu.optim.schedules import learning_rate_at
 
         t = state["t"] + 1
@@ -74,7 +75,8 @@ class Optimizer:
         lr_t = learning_rate_at(
             self.learning_rate_schedule, self.learning_rate,
             self.learning_rate_decay_a, self.learning_rate_decay_b,
-            num_samples, args=self.learning_rate_args)
+            num_samples, args=self.learning_rate_args,
+            num_passes=num_passes)
 
         new_params = dict(params)
         new_slots = {}

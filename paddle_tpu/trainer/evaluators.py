@@ -18,7 +18,12 @@ from paddle_tpu.core.argument import Argument
 def classification_error(output: Argument, label: Argument) -> jnp.ndarray:
     """Fraction of rows whose argmax != label
     (``ClassificationErrorEvaluator``, Evaluator.cpp). Returns (errors,
-    count) so the trainer can aggregate across batches."""
+    count) so the trainer can aggregate across batches.
+
+    This is the *device-side* stat producer used inside the jitted train/
+    eval step; the host-side evaluator framework (including the richer
+    top_k/weight variant of this metric) lives in
+    ``paddle_tpu.trainer.metrics`` — same semantics when weight is None."""
     pred = jnp.argmax(output.value, axis=-1)
     lab = label.value.astype(pred.dtype)
     wrong = (pred != lab).astype(jnp.float32)

@@ -424,11 +424,14 @@ class SumEvaluator(EvaluatorBase):
 
     def eval_batch(self, output, label=None, weight=None, mask=None):
         out = np.asarray(output, np.float64)
-        w = 1.0 if weight is None else np.asarray(weight, np.float64)
         if mask is not None:
             out = out * np.asarray(mask)[..., None]
-        self.total += float((out * w).sum()) if weight is not None \
-            else float(out.sum())
+        if weight is not None:
+            # per-sample weight [B] aligned against out [B, ...]
+            w = np.asarray(weight, np.float64).reshape(
+                (-1,) + (1,) * (out.ndim - 1))
+            out = out * w
+        self.total += float(out.sum())
         self.count += (float(np.asarray(mask).sum()) if mask is not None
                        else out.shape[0])
 

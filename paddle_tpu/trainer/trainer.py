@@ -112,12 +112,13 @@ class SGD:
             loss = jnp.sum(cost_val) / cost_val.shape[0]
             return loss, (outputs, updates)
 
-        def step(params, opt_state, feed, rng):
+        def step(params, opt_state, feed, rng, num_passes):
             (_, (outputs, updates)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params, feed, rng)
             bsz = outputs[cost_name].value.shape[0]
             new_params, new_opt = optimizer.update(
-                grads, opt_state, params, meta, batch_size=bsz)
+                grads, opt_state, params, meta, batch_size=bsz,
+                num_passes=num_passes)
             new_params.update(updates)  # moving statistics (batch_norm)
             return new_params, new_opt, self._metrics(outputs, feed)
 
@@ -149,7 +150,8 @@ class SGD:
                     feed = mesh_lib.shard_batch(feed, self.mesh)
                 self._rng, step_rng = jax.random.split(self._rng)
                 self.params, self.opt_state, metrics = self._train_step(
-                    self.params, self.opt_state, feed, step_rng)
+                    self.params, self.opt_state, feed, step_rng,
+                    jnp.int32(pass_id))
                 cost = float(metrics["cost"])
                 evals = self._accumulate(acc, metrics)
                 event_handler(ev.EndIteration(pass_id, batch_id, cost, evals))

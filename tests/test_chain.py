@@ -1,0 +1,181 @@
+"""CRF / CTC correctness vs brute-force enumeration — the analogue of
+``test_CRFLayerGrad.cpp`` / ``test_LinearChainCRF.cpp`` /
+``test_WarpCTCLayer.cpp`` in the reference."""
+
+import itertools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.layers.chain import (crf_decode, crf_log_likelihood,
+                                     ctc_loss)
+
+
+def _brute_crf(x, labels, lens, w):
+    """Enumerate all paths for log Z; score gold path."""
+    B, T, C = x.shape
+    a, b, trans = w[0], w[1], w[2:]
+    out = []
+    for s in range(B):
+        n = lens[s]
+
+        def path_score(p):
+            sc = a[p[0]] + x[s, 0, p[0]] + b[p[n - 1]]
+            for t in range(1, n):
+                sc += trans[p[t - 1], p[t]] + x[s, t, p[t]]
+            return sc
+
+        logz = np.logaddexp.reduce(
+            [path_score(p) for p in itertools.product(range(C), repeat=n)])
+        out.append(path_score(labels[s, :n]) - logz)
+    return np.array(out)
+
+
+def test_crf_log_likelihood_matches_bruteforce():
+    rng = np.random.RandomState(0)
+    B, T, C = 3, 4, 3
+    lens = [4, 3, 1]
+    x = rng.randn(B, T, C).astype(np.float32)
+    labels = rng.randint(0, C, size=(B, T))
+    w = rng.randn(C + 2, C).astype(np.float32) * 0.5
+    mask = np.zeros((B, T), np.float32)
+    for i, n in enumerate(lens):
+        mask[i, :n] = 1
+    got = np.asarray(crf_log_likelihood(
+        jnp.asarray(x), jnp.asarray(labels), jnp.asarray(mask),
+        jnp.asarray(w)))
+    want = _brute_crf(x, labels, lens, w)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_crf_decode_matches_bruteforce():
+    rng = np.random.RandomState(1)
+    B, T, C = 2, 4, 3
+    lens = [4, 2]
+    x = rng.randn(B, T, C).astype(np.float32)
+    w = rng.randn(C + 2, C).astype(np.float32) * 0.5
+    mask = np.zeros((B, T), np.float32)
+    for i, n in enumerate(lens):
+        mask[i, :n] = 1
+    path, score = crf_decode(jnp.asarray(x), jnp.asarray(mask), jnp.asarray(w))
+    path = np.asarray(path)
+    a, b, trans = w[0], w[1], w[2:]
+    for s in range(B):
+        n = lens[s]
+        best, best_p = -1e30, None
+        for p in itertools.product(range(C), repeat=n):
+            sc = a[p[0]] + x[s, 0, p[0]] + b[p[n - 1]]
+            for t in range(1, n):
+                sc += trans[p[t - 1], p[t]] + x[s, t, p[t]]
+            if sc > best:
+                best, best_p = sc, p
+        assert tuple(path[s, :n]) == best_p
+        np.testing.assert_allclose(float(score[s]), best, rtol=1e-4)
+
+
+def test_crf_gradient_numeric():
+    rng = np.random.RandomState(2)
+    B, T, C = 2, 3, 3
+    x = jnp.asarray(rng.randn(B, T, C).astype(np.float32))
+    labels = jnp.asarray(rng.randint(0, C, size=(B, T)))
+    mask = jnp.asarray(np.array([[1, 1, 1], [1, 1, 0]], np.float32))
+    w = jnp.asarray(rng.randn(C + 2, C).astype(np.float32) * 0.3)
+
+    def loss(w):
+        return -jnp.sum(crf_log_likelihood(x, labels, mask, w))
+
+    g = np.asarray(jax.grad(loss)(w))
+    eps = 1e-3
+    wn = np.asarray(w)
+    for idx in [(0, 1), (1, 2), (3, 0), (4, 2)]:
+        wp = wn.copy(); wp[idx] += eps
+        wm = wn.copy(); wm[idx] -= eps
+        num = (float(loss(jnp.asarray(wp))) - float(loss(jnp.asarray(wm)))) \
+            / (2 * eps)
+        np.testing.assert_allclose(g[idx], num, rtol=2e-2, atol=2e-3)
+
+
+def _brute_ctc(lp, label, blank):
+    """Sum over all alignments of length T mapping to label."""
+    T, C = lp.shape
+
+    def collapse(path):
+        out, prev = [], -1
+        for p in path:
+            if p != prev and p != blank:
+                out.append(p)
+            prev = p
+        return tuple(out)
+
+    tot = -np.inf
+    for path in itertools.product(range(C), repeat=T):
+        if collapse(path) == tuple(label):
+            tot = np.logaddexp(tot, sum(lp[t, path[t]] for t in range(T)))
+    return -tot
+
+
+def test_ctc_matches_bruteforce():
+    rng = np.random.RandomState(3)
+    B, T, C, L = 2, 4, 3, 2
+    blank = C - 1
+    logits = rng.randn(B, T, C).astype(np.float32)
+    lp = np.asarray(jax.nn.log_softmax(jnp.asarray(logits), axis=-1))
+    labels = np.array([[0, 1], [1, 0]])
+    in_mask = np.ones((B, T), np.float32)
+    in_mask[1, 3] = 0  # second sequence has T=3
+    label_mask = np.array([[1, 1], [1, 0]], np.float32)  # second has L=1
+    got = np.asarray(ctc_loss(
+        jnp.asarray(lp), jnp.asarray(labels), jnp.asarray(in_mask),
+        jnp.asarray(label_mask), blank))
+    want0 = _brute_ctc(lp[0], [0, 1], blank)
+    want1 = _brute_ctc(lp[1, :3], [1], blank)
+    np.testing.assert_allclose(got, [want0, want1], rtol=1e-4, atol=1e-4)
+
+
+def test_ctc_gradient_flows():
+    rng = np.random.RandomState(4)
+    B, T, C = 1, 5, 4
+    logits = jnp.asarray(rng.randn(B, T, C).astype(np.float32))
+    labels = jnp.asarray(np.array([[0, 1, 2]]))
+    masks = jnp.ones((B, T)), jnp.ones((B, 3))
+
+    def loss(z):
+        lp = jax.nn.log_softmax(z, axis=-1)
+        return jnp.sum(ctc_loss(lp, labels, masks[0], masks[1], C - 1))
+
+    g = jax.grad(loss)(logits)
+    assert np.isfinite(np.asarray(g)).all()
+    assert float(jnp.abs(g).sum()) > 0
+
+
+def test_crf_layers_in_network():
+    from paddle_tpu.config import dsl
+    from paddle_tpu.core.argument import Argument
+    from paddle_tpu.core.network import Network
+
+    rng = np.random.RandomState(5)
+    B, T, D, C = 2, 4, 5, 3
+    dsl.reset()
+    x = dsl.data("x", size=D, is_sequence=True)
+    lab = dsl.data("label", size=C, is_sequence=True)
+    feat = dsl.fc(x, size=C, act="linear", name="feat")
+    # share the transition matrix between cost and decoding as the
+    # reference does via param name
+    cost = dsl.crf_layer(feat, lab, param_attr={"name": "crfw"}, name="cost")
+    dec = dsl.crf_decoding_layer(feat, param_attr={"name": "crfw"},
+                                 name="dec")
+    net = Network(dsl.current_graph(), outputs=["cost", "dec"])
+    params = net.init_params(jax.random.PRNGKey(0))
+    assert "crfw" in params
+    mask = np.ones((B, T), np.float32)
+    feed = {
+        "x": Argument(value=jnp.asarray(rng.randn(B, T, D), jnp.float32),
+                      mask=jnp.asarray(mask)),
+        "label": Argument(value=jnp.asarray(rng.randint(0, C, (B, T))),
+                          mask=jnp.asarray(mask)),
+    }
+    outs = net.apply(params, feed)
+    assert outs["cost"].value.shape == (B, 1)
+    assert outs["dec"].value.shape == (B, T, 1)

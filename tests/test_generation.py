@@ -1,0 +1,133 @@
+"""Beam-search generation tests — the analogue of the reference's
+``test_recurrent_machine_generation.cpp`` (greedy vs beam consistency,
+golden sequences)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.config import dsl
+from paddle_tpu.core.argument import Argument
+from paddle_tpu.core.generation import SequenceGenerator
+from paddle_tpu.core.network import Network
+
+V, E, H = 6, 4, 5
+EOS = 1
+
+
+def _build_gen_model(beam_size=3, max_length=8):
+    """Tiny LM: h_t = tanh(W [emb;h]); p = softmax(U h). Deterministic
+    weights so generation is reproducible."""
+    dsl.reset()
+    # an outer "encoder": context vector boots the memory
+    src = dsl.data("src", size=H)
+    boot = dsl.fc(src, size=H, act="tanh", name="boot", bias_attr=False)
+
+    def step(prev_emb):
+        m = dsl.memory(name="h", size=H, boot_layer=boot)
+        h = dsl.fc([prev_emb, m], size=H, act="tanh", name="h",
+                   bias_attr=False)
+        p = dsl.fc(h, size=V, act="softmax", name="prob", bias_attr=False)
+        return p
+
+    out = dsl.beam_search(
+        step,
+        [dsl.GeneratedInput(size=V, embedding_name="gen_emb",
+                            embedding_size=E)],
+        bos_id=0, eos_id=EOS, beam_size=beam_size, max_length=max_length,
+        name="gen")
+    graph = dsl.current_graph()
+    return graph, out
+
+
+def _params(graph, out, seed=0):
+    net = Network(graph, outputs=["boot"])
+    params = dict(net.init_params(jax.random.PRNGKey(seed)))
+    # beam group params are hoisted; add them + the shared embedding
+    from paddle_tpu.core.registry import get_layer_impl
+    cfg = graph.layers["gen"]
+    impl = get_layer_impl("beam_search_group")
+    rng = np.random.RandomState(seed)
+    for suffix, spec in impl.params(cfg, []).items():
+        name = spec.absolute_name
+        params[name] = jnp.asarray(
+            rng.randn(*spec.shape).astype(np.float32) * 0.7)
+    params["gen_emb"] = jnp.asarray(
+        rng.randn(V, E).astype(np.float32))
+    return net, params
+
+
+def test_greedy_matches_manual_unroll():
+    graph, out = _build_gen_model()
+    net, params = _params(graph, out)
+    B = 2
+    srcv = np.random.RandomState(7).randn(B, H).astype(np.float32)
+    outer = net.apply(params, {"src": Argument(value=jnp.asarray(srcv))})
+    gen = SequenceGenerator(graph, "gen")
+    tokens, scores, lengths = gen.generate(params, outer, beam_size=1,
+                                           max_length=8)
+    tokens = np.asarray(tokens)
+
+    # manual greedy unroll in numpy
+    emb = np.asarray(params["gen_emb"])
+    Wh = np.asarray(params["_h.w0"])   # [E, H]
+    Wm = np.asarray(params["_h.w1"])   # [H, H]
+    U = np.asarray(params["_prob.w0"])  # [H, V]
+    h = np.asarray(outer["boot"].value)
+    prev = np.zeros(B, np.int64)  # bos
+    done = np.zeros(B, bool)
+    for t in range(8):
+        hn = np.tanh(emb[prev] @ Wh + h @ Wm)
+        logits = hn @ U
+        nxt = np.argmax(logits, axis=-1)
+        for b in range(B):
+            if not done[b]:
+                assert tokens[b, 0, t] == nxt[b], (b, t)
+        h = hn
+        prev = nxt
+        done |= nxt == EOS
+        if done.all():
+            break
+
+
+def test_beam_search_top_beam_at_least_greedy():
+    graph, out = _build_gen_model()
+    net, params = _params(graph, out, seed=3)
+    B = 3
+    srcv = np.random.RandomState(11).randn(B, H).astype(np.float32)
+    outer = net.apply(params, {"src": Argument(value=jnp.asarray(srcv))})
+    gen = SequenceGenerator(graph, "gen")
+    t1, s1, l1 = gen.generate(params, outer, beam_size=1, max_length=6)
+    t4, s4, l4 = gen.generate(params, outer, beam_size=4, max_length=6)
+    s1, s4 = np.asarray(s1), np.asarray(s4)
+    # beam search can only improve on greedy
+    assert (s4[:, 0] >= s1[:, 0] - 1e-5).all()
+    # beams come back sorted best-first
+    assert (np.diff(s4, axis=1) <= 1e-6).all()
+    # all beams are distinct token sequences
+    t4 = np.asarray(t4)
+    for b in range(B):
+        seqs = {tuple(t4[b, k]) for k in range(4)}
+        assert len(seqs) == 4
+
+
+def test_eos_terminates_and_lengths():
+    graph, out = _build_gen_model()
+    net, params = _params(graph, out, seed=5)
+    # force EOS to dominate: bias the prob layer toward EOS via the
+    # embedding column trick — instead just check length bookkeeping
+    B = 2
+    srcv = np.zeros((B, H), np.float32)
+    outer = net.apply(params, {"src": Argument(value=jnp.asarray(srcv))})
+    gen = SequenceGenerator(graph, "gen")
+    tokens, scores, lengths = gen.generate(params, outer, beam_size=2,
+                                           max_length=5)
+    tokens, lengths = np.asarray(tokens), np.asarray(lengths)
+    for b in range(B):
+        for k in range(2):
+            L = lengths[b, k]
+            if L < 5:
+                assert tokens[b, k, L - 1] == EOS
+                # everything after first EOS stays EOS (frozen beams)
+                assert (tokens[b, k, L - 1:] == EOS).all()
