@@ -582,3 +582,242 @@ def warp_ctc_layer(input, label, *, size: int = None,
                     bias=False,
                     attrs={"norm_by_times": norm_by_times, "blank": blank})
     return _add(ldef)
+
+
+# ------------------------------------------------ long-tail layer wrappers
+def _simple(type_name, input, name=None, *, attrs=None, size=None,
+            extra_inputs=(), act="linear", bias=False, param_attr=None):
+    ins = [Input(_in(input)[0].name, param_attr=_param(param_attr))]
+    ins += [Input(_in(e)[0].name) for e in extra_inputs]
+    ldef = LayerDef(name=name or _auto_name(type_name), type=type_name,
+                    inputs=ins, size=size, act=act, bias=bias,
+                    attrs=attrs or {})
+    return _add(ldef)
+
+
+def clip_layer(input, *, min: float, max: float, name=None):
+    return _simple("clip", input, name, attrs={"min": min, "max": max})
+
+
+def power_layer(input, weight, *, name=None):
+    ldef = LayerDef(name=name or _auto_name("power"), type="power",
+                    inputs=[Input(_in(weight)[0].name),
+                            Input(_in(input)[0].name)], bias=False)
+    return _add(ldef)
+
+
+def prelu_layer(input, *, partial_sum: int = 1, name=None, param_attr=None):
+    return _simple("prelu", input, name, attrs={"partial_sum": partial_sum},
+                   param_attr=param_attr)
+
+
+def maxout_layer(input, *, groups: int, name=None):
+    return _simple("maxout", input, name, attrs={"groups": groups})
+
+
+def multiplex_layer(index, inputs, *, name=None):
+    ins = [Input(_in(index)[0].name)] + [Input(_in(i)[0].name)
+                                         for i in inputs]
+    return _add(LayerDef(name=name or _auto_name("multiplex"),
+                         type="multiplex", inputs=ins, bias=False))
+
+
+def eos_id_layer(input, *, eos_id: int, name=None):
+    return _simple("eos_id", input, name, attrs={"eos_id": eos_id})
+
+
+def sampling_id_layer(input, *, name=None):
+    return _simple("sampling_id", input, name)
+
+
+def print_layer(input, *, name=None):
+    return _simple("print", input, name)
+
+
+def resize_layer(input, *, size: int, name=None):
+    return _simple("resize", input, name, size=size)
+
+
+def rotate_layer(input, *, name=None):
+    return _simple("rotate", input, name)
+
+
+def bilinear_interp_layer(input, *, out_size_x: int, out_size_y: int,
+                          name=None):
+    return _simple("bilinear_interp", input, name,
+                   attrs={"out_size_x": out_size_x, "out_size_y": out_size_y})
+
+
+def pad_layer(input, *, pad_c=(0, 0), pad_h=(0, 0), pad_w=(0, 0), name=None):
+    return _simple("pad", input, name,
+                   attrs={"pad_c": list(pad_c), "pad_h": list(pad_h),
+                          "pad_w": list(pad_w)})
+
+
+def crop_layer(input, *, axis: int = 2, offset=None, shape=None,
+               reference=None, name=None):
+    attrs = {"axis": axis}
+    if offset is not None:
+        attrs["offset"] = list(offset)
+    if shape is not None:
+        attrs["shape"] = list(shape)
+    extra = [reference] if reference is not None else []
+    return _simple("crop", input, name, attrs=attrs, extra_inputs=extra)
+
+
+def conv_shift_layer(a, b, *, name=None):
+    ldef = LayerDef(name=name or _auto_name("conv_shift"), type="conv_shift",
+                    inputs=[Input(_in(a)[0].name), Input(_in(b)[0].name)],
+                    bias=False)
+    return _add(ldef)
+
+
+def row_conv_layer(input, *, context_length: int, name=None,
+                   param_attr=None):
+    return _simple("row_conv", input, name,
+                   attrs={"context_length": context_length},
+                   param_attr=param_attr)
+
+
+def tensor_layer(a, b, *, size: int, act: str = "linear", name=None,
+                 bias_attr=True, param_attr=None):
+    ldef = LayerDef(name=name or _auto_name("tensor"), type="tensor",
+                    inputs=[Input(_in(a)[0].name, param_attr=_param(param_attr)),
+                            Input(_in(b)[0].name)],
+                    size=size, act=act, bias=_bias(bias_attr))
+    return _add(ldef)
+
+
+def selective_fc_layer(input, *, size: int, select=None, act: str = "tanh",
+                       name=None, bias_attr=True, param_attr=None):
+    # the layer consumes the activation itself (mask applied post-act)
+    extra = [select] if select is not None else []
+    return _simple("selective_fc", input, name, size=size, act="linear",
+                   bias=_bias(bias_attr), extra_inputs=extra,
+                   param_attr=param_attr, attrs={"active_type": act})
+
+
+def mdlstm_layer(input, *, name=None, act: str = "tanh",
+                 gate_act: str = "sigmoid", state_act: str = "tanh",
+                 bias_attr=True, param_attr=None):
+    """2-D multi-dimensional LSTM over an image-shaped gate projection
+    (input channels = 5*size)."""
+    return _simple("mdlstmemory", input, name, bias=_bias(bias_attr),
+                   param_attr=param_attr,
+                   attrs={"active_type": act, "active_gate_type": gate_act,
+                          "active_state_type": state_act})
+
+
+def block_expand_layer(input, *, block_x: int, block_y: int,
+                       stride_x: int = 1, stride_y: int = 1,
+                       padding_x: int = 0, padding_y: int = 0, name=None):
+    return _simple("blockexpand", input, name,
+                   attrs={"block_x": block_x, "block_y": block_y,
+                          "stride_x": stride_x, "stride_y": stride_y,
+                          "padding_x": padding_x, "padding_y": padding_y})
+
+
+def sub_nested_seq_layer(input, selection, *, name=None):
+    return _simple("sub_nested_seq", input, name, extra_inputs=[selection])
+
+
+def get_output_layer(input, *, arg_name: str = "state", size: int = None,
+                     name=None):
+    return _simple("get_output", input, name, size=size,
+                   attrs={"arg_name": arg_name})
+
+
+def gru_step_layer(input, output_mem, *, size: int = None, act: str = "tanh",
+                   gate_act: str = "sigmoid", name=None, bias_attr=True,
+                   param_attr=None):
+    ldef = LayerDef(name=name or _auto_name("gru_step"), type="gru_step",
+                    inputs=[Input(_in(input)[0].name,
+                                  param_attr=_param(param_attr)),
+                            Input(_in(output_mem)[0].name)],
+                    bias=_bias(bias_attr),
+                    attrs={"active_type": act,
+                           "active_gate_type": gate_act})
+    return _add(ldef)
+
+
+def lstm_step_layer(input, state_mem, *, size: int = None, act: str = "tanh",
+                    gate_act: str = "sigmoid", state_act: str = "tanh",
+                    name=None, bias_attr=True):
+    ldef = LayerDef(name=name or _auto_name("lstm_step"), type="lstm_step",
+                    inputs=[Input(_in(input)[0].name),
+                            Input(_in(state_mem)[0].name)],
+                    bias=_bias(bias_attr),
+                    attrs={"active_type": act, "active_gate_type": gate_act,
+                           "active_state_type": state_act})
+    return _add(ldef)
+
+
+def nce_layer(input, label, *, num_classes: int, num_neg_samples: int = 10,
+              weight=None, name=None, bias_attr=True, param_attr=None):
+    ins = [Input(_in(input)[0].name, param_attr=_param(param_attr)),
+           Input(_in(label)[0].name)]
+    if weight is not None:
+        ins.append(Input(_in(weight)[0].name))
+    ldef = LayerDef(name=name or _auto_name("nce"), type="nce", inputs=ins,
+                    bias=_bias(bias_attr),
+                    attrs={"num_classes": num_classes,
+                           "num_neg_samples": num_neg_samples})
+    return _add(ldef)
+
+
+def hsigmoid(input, label, *, num_classes: int, name=None, bias_attr=True,
+             param_attr=None):
+    srcs = _in(input)
+    ins = [Input(s.name, param_attr=_param(param_attr)) for s in srcs]
+    ins.append(Input(_in(label)[0].name))
+    ldef = LayerDef(name=name or _auto_name("hsigmoid"), type="hsigmoid",
+                    inputs=ins, bias=_bias(bias_attr),
+                    attrs={"num_classes": num_classes})
+    return _add(ldef)
+
+
+def priorbox_layer(input, image, *, min_size, max_size=(), aspect_ratio=(1.0,),
+                   variance=(0.1, 0.1, 0.2, 0.2), name=None):
+    ldef = LayerDef(name=name or _auto_name("priorbox"), type="priorbox",
+                    inputs=[Input(_in(input)[0].name),
+                            Input(_in(image)[0].name)], bias=False,
+                    attrs={"min_size": list(min_size),
+                           "max_size": list(max_size),
+                           "aspect_ratio": list(aspect_ratio),
+                           "variance": list(variance)})
+    return _add(ldef)
+
+
+def multibox_loss_layer(priorbox, label, conf, loc, *, num_classes: int,
+                        overlap_threshold: float = 0.5,
+                        neg_pos_ratio: float = 3.0,
+                        background_id: int = 0, name=None):
+    ldef = LayerDef(name=name or _auto_name("multibox_loss"),
+                    type="multibox_loss",
+                    inputs=[Input(_in(priorbox)[0].name),
+                            Input(_in(label)[0].name),
+                            Input(_in(conf)[0].name),
+                            Input(_in(loc)[0].name)], bias=False,
+                    attrs={"num_classes": num_classes,
+                           "overlap_threshold": overlap_threshold,
+                           "neg_pos_ratio": neg_pos_ratio,
+                           "background_id": background_id})
+    return _add(ldef)
+
+
+def detection_output_layer(priorbox, conf, loc, *, num_classes: int,
+                           nms_threshold: float = 0.45,
+                           nms_top_k: int = 100, keep_top_k: int = 200,
+                           confidence_threshold: float = 0.01,
+                           background_id: int = 0, name=None):
+    ldef = LayerDef(name=name or _auto_name("detection_output"),
+                    type="detection_output",
+                    inputs=[Input(_in(priorbox)[0].name),
+                            Input(_in(conf)[0].name),
+                            Input(_in(loc)[0].name)], bias=False,
+                    attrs={"num_classes": num_classes,
+                           "nms_threshold": nms_threshold,
+                           "nms_top_k": nms_top_k, "keep_top_k": keep_top_k,
+                           "confidence_threshold": confidence_threshold,
+                           "background_id": background_id})
+    return _add(ldef)

@@ -14,3 +14,6 @@ from paddle_tpu.layers import recurrent  # noqa: F401
 from paddle_tpu.layers import sequence  # noqa: F401
 from paddle_tpu.layers import group  # noqa: F401
 from paddle_tpu.layers import chain  # noqa: F401
+from paddle_tpu.layers import misc  # noqa: F401
+from paddle_tpu.layers import sampling  # noqa: F401
+from paddle_tpu.layers import detection  # noqa: F401

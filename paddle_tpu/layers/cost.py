@@ -87,7 +87,7 @@ class SmoothL1Cost(_CostBase):
         return Argument(value=_reduce_tokens(cost, ins[0].mask))
 
 
-@register_layer("huber_classification")
+@register_layer("huber_classification", "huber")
 class HuberTwoClassCost(_CostBase):
     """Huber loss for binary classification with labels {0,1} mapped to
     y in {-1,+1} (``HuberTwoClassification`` in CostLayer.cpp)."""

@@ -1,0 +1,261 @@
+"""SSD detection layers: priorbox, multibox_loss, detection_output.
+
+References: ``paddle/gserver/layers/PriorBox.cpp``,
+``MultiBoxLossLayer.cpp``, ``DetectionOutputLayer.cpp`` (+
+``DetectionUtil.cpp``). TPU design notes: matching, mining, and NMS are
+reformulated as fixed-shape sort/top-k programs (no host loops, no dynamic
+box counts) — hard-negative mining is a rank threshold, NMS a fixed-trip
+suppression loop.
+
+Box encoding matches the reference (corner boxes normalized to [0,1];
+offsets encoded relative to prior center/size scaled by variance).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.argument import Argument
+from paddle_tpu.core.registry import (LayerImpl, ShapeInfo, register_layer)
+
+
+def make_prior_boxes(fh, fw, img_h, img_w, min_sizes, max_sizes,
+                     aspect_ratios, variance):
+    """[N, 4] corner boxes + [N, 4] variances for an fh x fw feature map
+    (PriorBox.cpp forward)."""
+    boxes = []
+    step_x, step_y = 1.0 / fw, 1.0 / fh
+    for i in range(fh):
+        for j in range(fw):
+            cx, cy = (j + 0.5) * step_x, (i + 0.5) * step_y
+            for k, ms in enumerate(min_sizes):
+                bw, bh = ms / img_w, ms / img_h
+                boxes.append([cx - bw / 2, cy - bh / 2,
+                              cx + bw / 2, cy + bh / 2])
+                if max_sizes:
+                    s = math.sqrt(ms * max_sizes[k])
+                    bw, bh = s / img_w, s / img_h
+                    boxes.append([cx - bw / 2, cy - bh / 2,
+                                  cx + bw / 2, cy + bh / 2])
+                for ar in aspect_ratios:
+                    if abs(ar - 1.0) < 1e-6:
+                        continue
+                    for a in (ar, 1.0 / ar):
+                        bw = ms * math.sqrt(a) / img_w
+                        bh = ms / math.sqrt(a) / img_h
+                        boxes.append([cx - bw / 2, cy - bh / 2,
+                                      cx + bw / 2, cy + bh / 2])
+    b = jnp.clip(jnp.asarray(boxes, jnp.float32), 0.0, 1.0)
+    v = jnp.broadcast_to(jnp.asarray(variance, jnp.float32), b.shape)
+    return b, v
+
+
+def iou_matrix(a, b):
+    """IoU between [N,4] and [M,4] corner boxes -> [N, M]."""
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.maximum((a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1]), 0.0)
+    area_b = jnp.maximum((b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1]), 0.0)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def encode_box(gt, prior, var):
+    """Encode gt corner boxes w.r.t. priors (DetectionUtil encodeBBox)."""
+    pw = prior[..., 2] - prior[..., 0]
+    ph = prior[..., 3] - prior[..., 1]
+    pcx = (prior[..., 0] + prior[..., 2]) / 2
+    pcy = (prior[..., 1] + prior[..., 3]) / 2
+    gw = gt[..., 2] - gt[..., 0]
+    gh = gt[..., 3] - gt[..., 1]
+    gcx = (gt[..., 0] + gt[..., 2]) / 2
+    gcy = (gt[..., 1] + gt[..., 3]) / 2
+    return jnp.stack([
+        (gcx - pcx) / pw / var[..., 0],
+        (gcy - pcy) / ph / var[..., 1],
+        jnp.log(jnp.maximum(gw / pw, 1e-10)) / var[..., 2],
+        jnp.log(jnp.maximum(gh / ph, 1e-10)) / var[..., 3]], axis=-1)
+
+
+def decode_box(loc, prior, var):
+    pw = prior[..., 2] - prior[..., 0]
+    ph = prior[..., 3] - prior[..., 1]
+    pcx = (prior[..., 0] + prior[..., 2]) / 2
+    pcy = (prior[..., 1] + prior[..., 3]) / 2
+    cx = loc[..., 0] * var[..., 0] * pw + pcx
+    cy = loc[..., 1] * var[..., 1] * ph + pcy
+    w = jnp.exp(loc[..., 2] * var[..., 2]) * pw
+    h = jnp.exp(loc[..., 3] * var[..., 3]) * ph
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                     axis=-1)
+
+
+@register_layer("priorbox")
+class PriorBoxLayer(LayerImpl):
+    """Inputs = (feature layer, image layer); attrs: min_size, max_size,
+    aspect_ratio, variance. Output [N, 8]: box corners + variances."""
+
+    def _count(self, cfg, info):
+        n_min = len(cfg.attrs["min_size"])
+        n_max = len(cfg.attrs.get("max_size", []))
+        n_ar = len([a for a in cfg.attrs.get("aspect_ratio", [])
+                    if abs(a - 1.0) > 1e-6])
+        per_cell = n_min * (1 + 2 * n_ar) + n_max
+        return info.height * info.width * per_cell
+
+    def infer(self, cfg, in_infos):
+        return ShapeInfo(size=self._count(cfg, in_infos[0]) * 8)
+
+    def apply(self, cfg, params, ins, ctx):
+        info = ctx.in_infos[0]
+        img = ctx.in_infos[1]
+        b, v = make_prior_boxes(
+            info.height, info.width, img.height, img.width,
+            cfg.attrs["min_size"], cfg.attrs.get("max_size", []),
+            cfg.attrs.get("aspect_ratio", [1.0]),
+            cfg.attrs.get("variance", [0.1, 0.1, 0.2, 0.2]))
+        return Argument(value=jnp.concatenate([b, v], axis=-1))
+
+
+@register_layer("multibox_loss")
+class MultiBoxLossLayer(LayerImpl):
+    """Inputs = (priorbox [N,8], gt label sequence [B, G, 5]
+    (class, xmin, ymin, xmax, ymax) with mask, conf pred [B, N*C],
+    loc pred [B, N*4]). attrs: num_classes (incl background 0),
+    overlap_threshold, neg_pos_ratio, background_id.
+    Output: per-sample cost [B, 1]."""
+
+    def infer(self, cfg, in_infos):
+        return ShapeInfo(size=1)
+
+    def apply(self, cfg, params, ins, ctx):
+        prior_a, gt_a, conf_a, loc_a = ins
+        C = cfg.attrs["num_classes"]
+        thresh = cfg.attrs.get("overlap_threshold", 0.5)
+        neg_ratio = cfg.attrs.get("neg_pos_ratio", 3.0)
+        bg = cfg.attrs.get("background_id", 0)
+        priors = prior_a.value[:, :4]
+        var = prior_a.value[:, 4:]
+        N = priors.shape[0]
+        gt = gt_a.value  # [B, G, 5]
+        gt_mask = gt_a.mask if gt_a.mask is not None else \
+            jnp.ones(gt.shape[:2], jnp.float32)
+        B = gt.shape[0]
+        conf = conf_a.value.reshape(B, N, C)
+        loc = loc_a.value.reshape(B, N, 4)
+
+        def one(gt_b, gtm_b, conf_b, loc_b):
+            iou = iou_matrix(priors, gt_b[:, 1:])          # [N, G]
+            iou = iou * gtm_b[None, :]
+            best_gt = jnp.argmax(iou, axis=1)              # [N]
+            best_iou = jnp.max(iou, axis=1)
+            # force-match: each gt's best prior is positive (reference
+            # bipartite step)
+            best_prior = jnp.argmax(iou, axis=0)           # [G]
+            # scatter-max so a padded gt (mask 0, argmax degenerates to
+            # prior 0) can never clobber a real gt's forced positive
+            forced = jnp.zeros((N,), jnp.int32).at[best_prior].max(
+                (gtm_b > 0).astype(jnp.int32)) > 0
+            pos = (best_iou > thresh) | forced
+            matched = gt_b[best_gt]                        # [N, 5]
+            target_loc = encode_box(matched[:, 1:], priors, var)
+            target_cls = jnp.where(pos, matched[:, 0].astype(jnp.int32), bg)
+            # smooth-L1 localization loss over positives
+            d = loc_b - target_loc
+            sl1 = jnp.where(jnp.abs(d) < 1.0, 0.5 * d * d,
+                            jnp.abs(d) - 0.5).sum(-1)
+            loc_loss = jnp.sum(sl1 * pos)
+            # softmax conf loss
+            logp = jax.nn.log_softmax(conf_b, axis=-1)
+            ce = -jnp.take_along_axis(logp, target_cls[:, None], 1)[:, 0]
+            num_pos = jnp.sum(pos)
+            # hard negative mining: top (neg_ratio * num_pos) negatives
+            neg_score = jnp.where(pos, -jnp.inf, ce)
+            order = jnp.argsort(-neg_score)
+            rank = jnp.zeros((N,), jnp.int32).at[order].set(jnp.arange(N))
+            neg = (~pos) & (rank < (neg_ratio * num_pos).astype(jnp.int32))
+            conf_loss = jnp.sum(ce * (pos | neg))
+            denom = jnp.maximum(num_pos, 1.0)
+            return (loc_loss + conf_loss) / denom
+
+        cost = jax.vmap(one)(gt, gt_mask, conf, loc)
+        return Argument(value=cost[:, None])
+
+
+def nms_fixed(boxes, scores, iou_thresh, max_out):
+    """Greedy NMS with a fixed trip count: returns (indices [max_out],
+    valid mask [max_out]). Scores of suppressed boxes are driven to -inf."""
+    def body(i, carry):
+        sc, keep_idx, keep_ok = carry
+        best = jnp.argmax(sc)
+        ok = sc[best] > -jnp.inf
+        keep_idx = keep_idx.at[i].set(best)
+        keep_ok = keep_ok.at[i].set(ok)
+        ious = iou_matrix(boxes[best][None], boxes)[0]
+        sc = jnp.where(ious > iou_thresh, -jnp.inf, sc)
+        sc = sc.at[best].set(-jnp.inf)
+        return sc, keep_idx, keep_ok
+
+    init = (scores, jnp.zeros((max_out,), jnp.int32),
+            jnp.zeros((max_out,), bool))
+    _, idx, ok = lax.fori_loop(0, max_out, body, init)
+    return idx, ok
+
+
+@register_layer("detection_output")
+class DetectionOutputLayer(LayerImpl):
+    """Inputs = (priorbox, conf pred, loc pred). Decode + per-class NMS +
+    keep_top_k. Output [B, keep_top_k, 7]:
+    (label, score, xmin, ymin, xmax, ymax, valid)."""
+
+    def infer(self, cfg, in_infos):
+        return ShapeInfo(size=cfg.attrs.get("keep_top_k", 200) * 7)
+
+    def apply(self, cfg, params, ins, ctx):
+        prior_a, conf_a, loc_a = ins
+        C = cfg.attrs["num_classes"]
+        bg = cfg.attrs.get("background_id", 0)
+        conf_th = cfg.attrs.get("confidence_threshold", 0.01)
+        nms_th = cfg.attrs.get("nms_threshold", 0.45)
+        nms_top = cfg.attrs.get("nms_top_k", 100)
+        keep_top = cfg.attrs.get("keep_top_k", 200)
+        priors = prior_a.value[:, :4]
+        var = prior_a.value[:, 4:]
+        N = priors.shape[0]
+        B = conf_a.value.shape[0]
+        conf = jax.nn.softmax(conf_a.value.reshape(B, N, C), axis=-1)
+        loc = loc_a.value.reshape(B, N, 4)
+        per_cls = min(nms_top, N)
+
+        def one(conf_b, loc_b):
+            boxes = decode_box(loc_b, priors, var)
+            all_scores, all_labels, all_boxes, all_ok = [], [], [], []
+            for c in range(C):
+                if c == bg:
+                    continue
+                sc = jnp.where(conf_b[:, c] > conf_th, conf_b[:, c], -jnp.inf)
+                idx, ok = nms_fixed(boxes, sc, nms_th, per_cls)
+                all_scores.append(jnp.where(ok, conf_b[idx, c], 0.0))
+                all_labels.append(jnp.full((per_cls,), c, jnp.float32))
+                all_boxes.append(boxes[idx])
+                all_ok.append(ok)
+            scores = jnp.concatenate(all_scores)
+            labels = jnp.concatenate(all_labels)
+            bxs = jnp.concatenate(all_boxes)
+            oks = jnp.concatenate(all_ok)
+            k = min(keep_top, scores.shape[0])
+            top, ti = lax.top_k(jnp.where(oks, scores, -1.0), k)
+            out = jnp.concatenate([
+                labels[ti][:, None], top[:, None], bxs[ti],
+                (top > 0)[:, None].astype(jnp.float32)], axis=-1)
+            if k < keep_top:
+                out = jnp.pad(out, ((0, keep_top - k), (0, 0)))
+            return out
+
+        return Argument(value=jax.vmap(one)(conf, loc))

@@ -1,0 +1,468 @@
+"""Long-tail layer types: elementwise, shape, and image utility layers.
+
+Each class cites its reference implementation in
+``paddle/gserver/layers/``. All are pure jnp functions — gradients come
+from ``jax.grad``; anything image-shaped flows NHWC (see conv.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.argument import Argument
+from paddle_tpu.core.registry import (LayerImpl, ParamSpec, ShapeInfo,
+                                      register_layer)
+from paddle_tpu.layers.conv import to_nhwc
+
+
+@register_layer("agent")
+class AgentLayer(LayerImpl):
+    """``AgentLayer.cpp``: forwards another layer's output unchanged (the
+    reference wires it by name across sub-model boundaries; here groups
+    pass boundaries explicitly, so agent is identity)."""
+
+    def infer(self, cfg, in_infos):
+        return in_infos[0]
+
+    def apply(self, cfg, params, ins, ctx):
+        return ins[0]
+
+
+@register_layer("clip")
+class ClipLayer(LayerImpl):
+    """``ClipLayer.cpp``: elementwise clamp to [min, max]."""
+
+    def infer(self, cfg, in_infos):
+        return in_infos[0]
+
+    def apply(self, cfg, params, ins, ctx):
+        lo = cfg.attrs.get("min", -1.0)
+        hi = cfg.attrs.get("max", 1.0)
+        return ins[0].with_value(jnp.clip(ins[0].value, lo, hi))
+
+
+@register_layer("power")
+class PowerLayer(LayerImpl):
+    """``PowerLayer.cpp``: out = x ** p with a per-sample exponent; weight
+    input first ([B,1]), data second — same convention as scaling."""
+
+    def infer(self, cfg, in_infos):
+        return in_infos[1]
+
+    def apply(self, cfg, params, ins, ctx):
+        p, x = ins[0].value, ins[1].value
+        p = p.reshape((p.shape[0],) + (1,) * (x.ndim - 1))
+        return ins[1].with_value(x ** p)
+
+
+@register_layer("prelu")
+class PReluLayer(LayerImpl):
+    """``ParameterReluLayer.cpp``: out = max(0,x) + alpha*min(0,x); alpha
+    learned. ``partial_sum`` groups features sharing one alpha (1 =
+    per-feature, size = one shared alpha), as in the reference config."""
+
+    def infer(self, cfg, in_infos):
+        return in_infos[0]
+
+    def params(self, cfg, in_infos):
+        partial = cfg.attrs.get("partial_sum", 1)
+        n = in_infos[0].size // partial
+        return {"w0": ParamSpec(shape=(n,), init="const", initial_mean=0.25)}
+
+    def apply(self, cfg, params, ins, ctx):
+        x = ins[0].value
+        partial = cfg.attrs.get("partial_sum", 1)
+        alpha = jnp.repeat(params["w0"], partial)
+        return ins[0].with_value(
+            jnp.maximum(x, 0.0) + alpha * jnp.minimum(x, 0.0))
+
+
+@register_layer("maxout")
+class MaxOutLayer(LayerImpl):
+    """``MaxOutLayer.cpp``: channels split into groups, max over the group
+    axis. Image layers: C -> C/groups."""
+
+    def infer(self, cfg, in_infos):
+        g = cfg.attrs["groups"]
+        info = in_infos[0]
+        if info.channels:
+            return ShapeInfo(size=info.size // g, channels=info.channels // g,
+                             height=info.height, width=info.width)
+        return ShapeInfo(size=info.size // g)
+
+    def apply(self, cfg, params, ins, ctx):
+        g = cfg.attrs["groups"]
+        info = ctx.in_infos[0]
+        x = ins[0].value
+        if info.channels:
+            x = to_nhwc(x, info.channels, info.height, info.width)
+            b, h, w, c = x.shape
+            # reference groups ADJACENT channels: out i = max over input
+            # channels [i*g, i*g + g)  (Matrix.cpp maxoutForward)
+            x = x.reshape(b, h, w, c // g, g).max(axis=4)
+            return Argument(value=x)
+        b = x.shape[0]
+        return ins[0].with_value(x.reshape(b, -1, g).max(axis=2))
+
+
+@register_layer("multiplex")
+class MultiplexLayer(LayerImpl):
+    """``MultiplexLayer.cpp``: first input is an index column; output row b
+    copies row b of data input index[b]."""
+
+    def infer(self, cfg, in_infos):
+        return in_infos[1]
+
+    def apply(self, cfg, params, ins, ctx):
+        idx = ins[0].value.reshape(-1).astype(jnp.int32)
+        stack = jnp.stack([a.value for a in ins[1:]], axis=0)  # [N, B, D]
+        out = jnp.take_along_axis(
+            stack, idx[None, :, None], axis=0)[0]
+        return ins[1].with_value(out)
+
+
+@register_layer("eos_id")
+class EosIdCheckLayer(LayerImpl):
+    """``EosIdCheckLayer.cpp``: 1.0 where the input id equals eos_id."""
+
+    def infer(self, cfg, in_infos):
+        return ShapeInfo(size=1, is_sequence=in_infos[0].is_sequence)
+
+    def apply(self, cfg, params, ins, ctx):
+        eos = cfg.attrs["eos_id"]
+        ids = ins[0].value
+        if ids.ndim > 2:
+            ids = ids[..., 0]
+        out = (ids == eos).astype(jnp.float32)[..., None]
+        return Argument(value=out, mask=ins[0].mask)
+
+
+@register_layer("sampling_id")
+class SamplingIdLayer(LayerImpl):
+    """``SamplingIdLayer.cpp``: sample one id per row from the input
+    distribution (used by stochastic generation)."""
+
+    needs_rng = True
+
+    def infer(self, cfg, in_infos):
+        return ShapeInfo(size=in_infos[0].size,
+                         is_sequence=in_infos[0].is_sequence)
+
+    def apply(self, cfg, params, ins, ctx):
+        logits = jnp.log(jnp.maximum(ins[0].value, 1e-20))
+        ids = jax.random.categorical(ctx.layer_rng(cfg.name), logits, axis=-1)
+        return Argument(value=ids.astype(jnp.int32), mask=ins[0].mask)
+
+
+@register_layer("print")
+class PrintLayer(LayerImpl):
+    """``PrintLayer.cpp``: debug-print the input on every forward, pass it
+    through unchanged (host callback via jax.debug.print)."""
+
+    def infer(self, cfg, in_infos):
+        return in_infos[0]
+
+    def apply(self, cfg, params, ins, ctx):
+        jax.debug.print(cfg.name + ": {}", ins[0].value)
+        return ins[0]
+
+
+@register_layer("resize")
+class ResizeLayer(LayerImpl):
+    """``ResizeLayer.cpp``: reinterpret the batch as rows of ``size``
+    (total element count preserved, batch dim changes)."""
+
+    def infer(self, cfg, in_infos):
+        return ShapeInfo(size=cfg.size)
+
+    def apply(self, cfg, params, ins, ctx):
+        return Argument(value=ins[0].value.reshape(-1, cfg.size))
+
+
+@register_layer("rotate")
+class RotateLayer(LayerImpl):
+    """``RotateLayer.cpp``: rotate each CHW image 90 degrees clockwise
+    (the reference calls ``Matrix::rotate(..., true /*clock-wise*/)``:
+    out[j, i] = in[H-1-i, j])."""
+
+    def infer(self, cfg, in_infos):
+        info = in_infos[0]
+        return ShapeInfo(size=info.size, channels=info.channels,
+                         height=info.width, width=info.height)
+
+    def apply(self, cfg, params, ins, ctx):
+        info = ctx.in_infos[0]
+        x = to_nhwc(ins[0].value, info.channels, info.height, info.width)
+        # clockwise: out[a, b] = in[H-1-b, a]
+        x = jnp.swapaxes(jnp.flip(x, axis=1), 1, 2)
+        return Argument(value=x)
+
+
+@register_layer("bilinear_interp")
+class BilinearInterpLayer(LayerImpl):
+    """``BilinearInterpLayer.cpp``: bilinear resize to (out_size_y,
+    out_size_x); XLA gather/weighted-sum via jax.image.resize."""
+
+    def infer(self, cfg, in_infos):
+        info = in_infos[0]
+        oh = cfg.attrs["out_size_y"]
+        ow = cfg.attrs["out_size_x"]
+        return ShapeInfo(size=info.channels * oh * ow, channels=info.channels,
+                         height=oh, width=ow)
+
+    def apply(self, cfg, params, ins, ctx):
+        info = ctx.in_infos[0]
+        x = to_nhwc(ins[0].value, info.channels, info.height, info.width)
+        oh, ow = cfg.attrs["out_size_y"], cfg.attrs["out_size_x"]
+        out = jax.image.resize(x, (x.shape[0], oh, ow, x.shape[3]),
+                               method="bilinear")
+        return Argument(value=out)
+
+
+@register_layer("pad")
+class PadLayer(LayerImpl):
+    """``PadLayer.cpp`` / ``function/PadOp``: zero-pad along C/H/W with
+    [before, after] pairs (pad_c, pad_h, pad_w attrs)."""
+
+    def infer(self, cfg, in_infos):
+        info = in_infos[0]
+        pc = cfg.attrs.get("pad_c", [0, 0])
+        ph = cfg.attrs.get("pad_h", [0, 0])
+        pw = cfg.attrs.get("pad_w", [0, 0])
+        c = info.channels + sum(pc)
+        h = info.height + sum(ph)
+        w = info.width + sum(pw)
+        return ShapeInfo(size=c * h * w, channels=c, height=h, width=w)
+
+    def apply(self, cfg, params, ins, ctx):
+        info = ctx.in_infos[0]
+        x = to_nhwc(ins[0].value, info.channels, info.height, info.width)
+        pc = cfg.attrs.get("pad_c", [0, 0])
+        ph = cfg.attrs.get("pad_h", [0, 0])
+        pw = cfg.attrs.get("pad_w", [0, 0])
+        out = jnp.pad(x, ((0, 0), tuple(ph), tuple(pw), tuple(pc)))
+        return Argument(value=out)
+
+
+@register_layer("crop")
+class CropLayer(LayerImpl):
+    """``CropLayer.cpp``: crop from ``axis`` onward with per-axis offsets;
+    target geometry from the second input (reference semantics) or the
+    ``shape`` attr. Axes follow the reference's NCHW numbering
+    (0=batch 1=C 2=H 3=W)."""
+
+    def infer(self, cfg, in_infos):
+        if len(in_infos) > 1:
+            ref = in_infos[1]
+            c, h, w = ref.channels, ref.height, ref.width
+        else:
+            c, h, w = cfg.attrs["shape"]
+        info = in_infos[0]
+        axis = cfg.attrs.get("axis", 2)
+        c = c if axis <= 1 else info.channels
+        h = h if axis <= 2 else info.height
+        w = w if axis <= 3 else info.width
+        return ShapeInfo(size=c * h * w, channels=c, height=h, width=w)
+
+    def apply(self, cfg, params, ins, ctx):
+        info = ctx.in_infos[0]
+        out = ctx.out_info
+        x = to_nhwc(ins[0].value, info.channels, info.height, info.width)
+        axis = cfg.attrs.get("axis", 2)
+        offs = cfg.attrs.get("offset", [0] * (4 - axis))
+        # offsets are listed for axes [axis..3] in NCHW order
+        oc = oh = ow = 0
+        for ax, off in zip(range(axis, 4), offs):
+            if ax == 1:
+                oc = off
+            elif ax == 2:
+                oh = off
+            elif ax == 3:
+                ow = off
+        return Argument(value=lax.dynamic_slice(
+            x, (0, oh, ow, oc),
+            (x.shape[0], out.height, out.width, out.channels)))
+
+
+@register_layer("conv_shift")
+class ConvShiftLayer(LayerImpl):
+    """``ConvShiftLayer.cpp``: circular correlation — out[i] = sum_j
+    a[(i + j - (M-1)/2) mod N] * b[j], b per-sample of odd length M (NTM
+    attention-shift style)."""
+
+    def infer(self, cfg, in_infos):
+        return in_infos[0]
+
+    def apply(self, cfg, params, ins, ctx):
+        a, b = ins[0].value, ins[1].value
+        N, M = a.shape[1], b.shape[1]
+        half = (M - 1) // 2
+        idx = (jnp.arange(N)[:, None] + jnp.arange(M)[None, :] - half) % N
+        # gathered[b_, i, j] = a[b_, idx[i, j]]
+        gathered = a[:, idx]
+        return ins[0].with_value(jnp.einsum("bij,bj->bi", gathered, b))
+
+
+@register_layer("row_conv")
+class RowConvLayer(LayerImpl):
+    """``RowConvLayer.cpp`` / ``function/RowConvOp``: lookahead row
+    convolution over future timesteps (DeepSpeech2): out[t] = sum_{j<k}
+    x[t+j] * w[j] elementwise per feature."""
+
+    def infer(self, cfg, in_infos):
+        return in_infos[0]
+
+    def params(self, cfg, in_infos):
+        k = cfg.attrs["context_length"]
+        return {"w0": ParamSpec(shape=(k, in_infos[0].size))}
+
+    def apply(self, cfg, params, ins, ctx):
+        x, mask = ins[0].value, ins[0].mask  # [B, T, D]
+        k = cfg.attrs["context_length"]
+        w = params["w0"]
+        B, T, D = x.shape
+        xm = x if mask is None else x * mask[:, :, None]
+        pad = jnp.zeros((B, k - 1, D), x.dtype)
+        xp = jnp.concatenate([xm, pad], axis=1)
+        out = jnp.zeros_like(x)
+        for j in range(k):  # k is small and static: unrolled adds fuse
+            out = out + xp[:, j:j + T] * w[j]
+        if mask is not None:
+            out = out * mask[:, :, None]
+        return Argument(value=out, mask=mask)
+
+
+@register_layer("tensor")
+class TensorLayer(LayerImpl):
+    """``TensorLayer.cpp``: bilinear form out[k] = x W_k y^T, parameter
+    stored [Dx, size*Dy] as in the reference."""
+
+    def infer(self, cfg, in_infos):
+        return ShapeInfo(size=cfg.size)
+
+    def params(self, cfg, in_infos):
+        dx, dy = in_infos[0].size, in_infos[1].size
+        specs = {"w0": ParamSpec(shape=(dx, cfg.size * dy))}
+        if cfg.bias:
+            specs["wbias"] = ParamSpec(shape=(cfg.size,), init="zeros",
+                                       is_bias=True)
+        return specs
+
+    def apply(self, cfg, params, ins, ctx):
+        x, y = ins[0].value, ins[1].value
+        dy = y.shape[-1]
+        w = params["w0"].reshape(x.shape[-1], cfg.size, dy)
+        out = jnp.einsum("bi,ikj,bj->bk", x, w, y)
+        if "wbias" in params:
+            out = out + params["wbias"]
+        return Argument(value=out)
+
+
+@register_layer("selective_fc")
+class SelectiveFcLayer(LayerImpl):
+    """``SelectiveFullyConnectedLayer.cpp``: fc where only selected output
+    columns are meaningful; selection is the (optional) second input as a
+    0/1 row mask. On TPU the dense matmul runs whole (MXU-friendly) and the
+    mask zeroes non-selected columns AFTER the activation (the reference
+    computes only selected columns, leaving the rest exactly zero), so the
+    activation is consumed here, not by the executor."""
+
+    def infer(self, cfg, in_infos):
+        return ShapeInfo(size=cfg.size)
+
+    def params(self, cfg, in_infos):
+        specs = {"w0": ParamSpec(shape=(in_infos[0].size, cfg.size))}
+        if cfg.bias:
+            specs["wbias"] = ParamSpec(shape=(cfg.size,), init="zeros",
+                                       is_bias=True)
+        return specs
+
+    def apply(self, cfg, params, ins, ctx):
+        from paddle_tpu.layers.activations import apply_activation
+        out = ins[0].value @ params["w0"]
+        if "wbias" in params:
+            out = out + params["wbias"]
+        act = cfg.attrs.get("active_type", "linear")
+        if act and act != "linear":
+            out = apply_activation(act, out)
+        if len(ins) > 1:
+            out = out * ins[1].value
+        return Argument(value=out)
+
+
+@register_layer("blockexpand")
+class BlockExpandLayer(LayerImpl):
+    """``BlockExpandLayer.cpp``: slide a block window over the image and
+    emit one sequence element per block position (im2col-as-sequence)."""
+
+    def _geom(self, cfg, info):
+        bx, by = cfg.attrs["block_x"], cfg.attrs["block_y"]
+        sx = cfg.attrs.get("stride_x", 1)
+        sy = cfg.attrs.get("stride_y", 1)
+        px = cfg.attrs.get("padding_x", 0)
+        py = cfg.attrs.get("padding_y", 0)
+        ow = (info.width + 2 * px - bx) // sx + 1
+        oh = (info.height + 2 * py - by) // sy + 1
+        return bx, by, sx, sy, px, py, ow, oh
+
+    def infer(self, cfg, in_infos):
+        info = in_infos[0]
+        bx, by, _, _, _, _, ow, oh = self._geom(cfg, info)
+        return ShapeInfo(size=info.channels * bx * by, is_sequence=True)
+
+    def apply(self, cfg, params, ins, ctx):
+        info = ctx.in_infos[0]
+        x = to_nhwc(ins[0].value, info.channels, info.height, info.width)
+        bx, by, sx, sy, px, py, ow, oh = self._geom(cfg, info)
+        patches = lax.conv_general_dilated_patches(
+            x, (by, bx), (sy, sx), [(py, py), (px, px)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        B = x.shape[0]
+        seq = patches.reshape(B, oh * ow, -1)
+        return Argument(value=seq,
+                        mask=jnp.ones((B, oh * ow), jnp.float32))
+
+
+@register_layer("sub_nested_seq")
+class SubNestedSequenceLayer(LayerImpl):
+    """``SubNestedSequenceLayer.cpp``: from a 2-level nested sequence,
+    select one sub-sequence per outer sequence (selection index = second
+    input). Padded layout: positions of the chosen sub-sequence are
+    compacted to the front via an argsort-gather."""
+
+    def infer(self, cfg, in_infos):
+        return ShapeInfo(size=in_infos[0].size, is_sequence=True)
+
+    def apply(self, cfg, params, ins, ctx):
+        a, sel = ins[0], ins[1]
+        x, mask, starts = a.value, a.mask, a.sub_starts_mask
+        if starts is None:
+            raise ValueError("sub_nested_seq input must be a nested sequence")
+        idx = sel.value.reshape(-1).astype(jnp.int32)  # [B]
+        sub_id = jnp.cumsum(starts, axis=1) - 1  # [B, T]
+        keep = (sub_id == idx[:, None]) & (mask > 0)
+        T = x.shape[1]
+        # stable compaction: kept positions first, original order preserved
+        order = jnp.argsort(jnp.where(keep, 0, 1) * T + jnp.arange(T)[None, :],
+                            axis=1)
+        out = jnp.take_along_axis(x, order[:, :, None], axis=1)
+        new_mask = jnp.take_along_axis(keep.astype(jnp.float32), order, axis=1)
+        return Argument(value=out * new_mask[:, :, None], mask=new_mask)
+
+
+@register_layer("get_output")
+class GetOutputLayer(LayerImpl):
+    """Reads a named auxiliary output of the previous layer (the
+    reference's ``get_output_layer`` for e.g. lstm_step's state)."""
+
+    def infer(self, cfg, in_infos):
+        return dataclasses.replace(in_infos[0], size=cfg.size
+                                   or in_infos[0].size)
+
+    def apply(self, cfg, params, ins, ctx):
+        arg = cfg.attrs.get("arg_name", "state")
+        return Argument(value=ins[0].state[arg], mask=ins[0].mask)

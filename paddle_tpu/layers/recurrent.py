@@ -194,3 +194,149 @@ class SimpleRecurrentLayer(LayerImpl):
         h0 = jnp.zeros((B, D), a.value.dtype)
         (hT,), ys = _scan_time(step, (h0,), xs, mask, reverse)
         return Argument(value=jnp.swapaxes(ys, 0, 1), mask=a.mask, state=hT)
+
+
+@register_layer("gru_step")
+class GruStepLayer(LayerImpl):
+    """Single GRU step for use inside recurrent groups
+    (``GruStepLayer.cpp``): inputs = (gate projection x [B, 3*size],
+    previous output [B, size]); the recurrent weight lives here."""
+
+    def infer(self, cfg, in_infos):
+        assert in_infos[0].size % 3 == 0
+        return ShapeInfo(size=in_infos[0].size // 3)
+
+    def params(self, cfg, in_infos):
+        size = in_infos[0].size // 3
+        specs = {"w0": ParamSpec(shape=(size, 3 * size))}
+        if cfg.bias:
+            specs["wbias"] = ParamSpec(shape=(3 * size,), init="zeros",
+                                       is_bias=True)
+        return specs
+
+    def apply(self, cfg, params, ins, ctx):
+        x, h = ins[0].value, ins[1].value
+        size = ctx.out_info.size
+        act_in = _act(cfg.attrs.get("active_type", "tanh"))
+        act_gate = _act(cfg.attrs.get("active_gate_type", "sigmoid"))
+        if "wbias" in params:
+            x = x + params["wbias"]
+        w_gate = params["w0"][:, : 2 * size]
+        w_state = params["w0"][:, 2 * size:]
+        zr = x[:, : 2 * size] + h @ w_gate
+        z = act_gate(zr[:, :size])
+        r = act_gate(zr[:, size:])
+        c = act_in(x[:, 2 * size:] + (r * h) @ w_state)
+        return Argument(value=h - z * h + z * c)
+
+
+@register_layer("lstm_step")
+class LstmStepLayer(LayerImpl):
+    """Single LSTM step (``LstmStepLayer.cpp``): inputs = (combined gate
+    input [B, 4*size] — the recurrent projection is a separate fc over the
+    output memory — and previous cell state [B, size]). Outputs the hidden
+    value; the new cell state is exposed via get_output(arg_name="state"),
+    as in the reference."""
+
+    def infer(self, cfg, in_infos):
+        assert in_infos[0].size % 4 == 0
+        return ShapeInfo(size=in_infos[0].size // 4)
+
+    def params(self, cfg, in_infos):
+        size = in_infos[0].size // 4
+        if cfg.bias:
+            return {"wbias": ParamSpec(shape=(7 * size,), init="zeros",
+                                       is_bias=True)}
+        return {}
+
+    def apply(self, cfg, params, ins, ctx):
+        gates, c_prev = ins[0].value, ins[1].value
+        size = ctx.out_info.size
+        act_in = _act(cfg.attrs.get("active_type", "tanh"))
+        act_gate = _act(cfg.attrs.get("active_gate_type", "sigmoid"))
+        act_state = _act(cfg.attrs.get("active_state_type", "tanh"))
+        if "wbias" in params:
+            b = params["wbias"]
+            gates = gates + b[: 4 * size]
+            check_i = b[4 * size: 5 * size]
+            check_f = b[5 * size: 6 * size]
+            check_o = b[6 * size: 7 * size]
+        else:
+            z = jnp.zeros((size,), gates.dtype)
+            check_i = check_f = check_o = z
+        g_in, g_ig, g_fg, g_og = jnp.split(gates, 4, axis=-1)
+        g_in = act_in(g_in)
+        g_ig = act_gate(g_ig + c_prev * check_i)
+        g_fg = act_gate(g_fg + c_prev * check_f)
+        state = g_in * g_ig + c_prev * g_fg
+        g_og = act_gate(g_og + state * check_o)
+        out = g_og * act_state(state)
+        return Argument(value=out, state={"state": state})
+
+
+@register_layer("mdlstmemory")
+class MDLstmLayer(LayerImpl):
+    """2-D multi-dimensional LSTM (``MDLstmLayer.cpp``): cell (i,j) sees
+    neighbours (i-1,j) and (i,j-1), with one forget gate per direction.
+    Input: image-shaped sequence [B, H, W, 5*size] gate projections
+    (in, ig, fg_h, fg_w, og). Scanned row-by-row (lax.scan over rows; the
+    column recurrence is an inner scan), which XLA pipelines; the
+    reference walks the grid cell-by-cell on the host."""
+
+    def infer(self, cfg, in_infos):
+        info = in_infos[0]
+        assert info.channels % 5 == 0
+        size = info.channels // 5
+        return ShapeInfo(size=size * info.height * info.width, channels=size,
+                         height=info.height, width=info.width)
+
+    def params(self, cfg, in_infos):
+        size = in_infos[0].channels // 5
+        specs = {"w0": ParamSpec(shape=(2, size, 5 * size))}
+        if cfg.bias:
+            specs["wbias"] = ParamSpec(shape=(5 * size,), init="zeros",
+                                       is_bias=True)
+        return specs
+
+    def apply(self, cfg, params, ins, ctx):
+        from paddle_tpu.layers.conv import to_nhwc
+        info = ctx.in_infos[0]
+        x = to_nhwc(ins[0].value, info.channels, info.height, info.width)
+        size = ctx.out_info.channels
+        w_h, w_w = params["w0"][0], params["w0"][1]
+        bias = params.get("wbias", jnp.zeros((5 * size,), x.dtype))
+        act_in = _act(cfg.attrs.get("active_type", "tanh"))
+        act_gate = _act(cfg.attrs.get("active_gate_type", "sigmoid"))
+        act_state = _act(cfg.attrs.get("active_state_type", "tanh"))
+        B, H, W, _ = x.shape
+
+        def cell(gates, h_up, c_up, h_left, c_left):
+            gates = gates + h_up @ w_h + h_left @ w_w + bias
+            g_in, g_ig, g_fh, g_fw, g_og = jnp.split(gates, 5, axis=-1)
+            state = (act_in(g_in) * act_gate(g_ig)
+                     + c_up * act_gate(g_fh) + c_left * act_gate(g_fw))
+            out = act_gate(g_og) * act_state(state)
+            return out, state
+
+        def row_step(carry, x_row):
+            h_up_row, c_up_row = carry  # [B, W, size]
+
+            def col_step(col_carry, inp):
+                h_left, c_left = col_carry
+                gates, h_up, c_up = inp
+                out, state = cell(gates, h_up, c_up, h_left, c_left)
+                return (out, state), (out, state)
+
+            z = jnp.zeros((B, size), x.dtype)
+            (_, _), (h_row, c_row) = lax.scan(
+                col_step, (z, z),
+                (jnp.swapaxes(x_row, 0, 1),
+                 jnp.swapaxes(h_up_row, 0, 1),
+                 jnp.swapaxes(c_up_row, 0, 1)))
+            h_row = jnp.swapaxes(h_row, 0, 1)
+            c_row = jnp.swapaxes(c_row, 0, 1)
+            return (h_row, c_row), h_row
+
+        z_row = jnp.zeros((B, W, size), x.dtype)
+        _, hs = lax.scan(row_step, (z_row, z_row), jnp.swapaxes(x, 0, 1))
+        return Argument(value=jnp.swapaxes(hs, 0, 1))  # [B, H, W, size]

@@ -1,0 +1,108 @@
+"""Sampled / hierarchical output layers: NCE and hierarchical sigmoid.
+
+References: ``paddle/gserver/layers/NCELayer.cpp`` and
+``HierarchicalSigmoidLayer.cpp``. Both avoid a full-vocab softmax; on TPU
+the sampled scores stay as one [B, K] gather + matmul so the MXU path is
+preserved and gradients flow only to touched rows.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.argument import Argument
+from paddle_tpu.core.registry import (LayerImpl, ParamSpec, ShapeInfo,
+                                      register_layer)
+
+
+@register_layer("nce")
+class NCELayer(LayerImpl):
+    """Noise-contrastive estimation cost (``NCELayer.cpp``): per sample,
+    score the true class plus ``num_neg_samples`` noise classes drawn from
+    ``neg_distribution`` (uniform by default) and apply the NCE logistic
+    loss. Inputs = (features, label[, weight]). size attr = num_classes."""
+
+    needs_rng = True
+
+    def infer(self, cfg, in_infos):
+        return ShapeInfo(size=1)
+
+    def params(self, cfg, in_infos):
+        num_classes = cfg.attrs["num_classes"]
+        specs = {"w0": ParamSpec(shape=(num_classes, in_infos[0].size))}
+        if cfg.bias:
+            specs["wbias"] = ParamSpec(shape=(num_classes,), init="zeros",
+                                       is_bias=True)
+        return specs
+
+    def apply(self, cfg, params, ins, ctx):
+        x, label = ins[0].value, ins[1].value.reshape(-1).astype(jnp.int32)
+        num_classes = cfg.attrs["num_classes"]
+        K = cfg.attrs.get("num_neg_samples", 10)
+        B = x.shape[0]
+        if ctx.train:
+            neg = jax.random.randint(
+                ctx.layer_rng(cfg.name), (B, K), 0, num_classes)
+        else:
+            # deterministic eval: stride through the classes
+            neg = (label[:, None] + 1
+                   + jnp.arange(K)[None, :] * ((num_classes - 1) // max(K, 1)
+                                              or 1)) % num_classes
+        ids = jnp.concatenate([label[:, None], neg], axis=1)  # [B, 1+K]
+        w = params["w0"][ids]                                  # [B, 1+K, D]
+        logits = jnp.einsum("bkd,bd->bk", w, x)
+        if "wbias" in params:
+            logits = logits + params["wbias"][ids]
+        # NCE with uniform noise: P_n = 1/num_classes, k samples
+        log_kpn = jnp.log(jnp.float32(K) / num_classes)
+        delta = logits - log_kpn
+        pos = jax.nn.log_sigmoid(delta[:, 0])
+        negs = jax.nn.log_sigmoid(-delta[:, 1:]).sum(axis=1)
+        cost = -(pos + negs)
+        if len(ins) > 2:
+            cost = cost * ins[2].value.reshape(-1)
+        return Argument(value=cost[:, None])
+
+
+@register_layer("hsigmoid")
+class HierarchicalSigmoidLayer(LayerImpl):
+    """Hierarchical sigmoid over a complete binary tree
+    (``HierarchicalSigmoidLayer.cpp``): num_classes-1 internal nodes, the
+    path to class c follows the bits of (c + num_classes) from the root;
+    cost = -sum log sigmoid(sign * (w_node . x + b_node))."""
+
+    def infer(self, cfg, in_infos):
+        return ShapeInfo(size=1)
+
+    def params(self, cfg, in_infos):
+        num_classes = cfg.attrs["num_classes"]
+        feat = sum(i.size for i in in_infos[:-1])
+        specs = {"w0": ParamSpec(shape=(num_classes - 1, feat))}
+        if cfg.bias:
+            specs["wbias"] = ParamSpec(shape=(num_classes - 1,), init="zeros",
+                                       is_bias=True)
+        return specs
+
+    def apply(self, cfg, params, ins, ctx):
+        num_classes = cfg.attrs["num_classes"]
+        x = jnp.concatenate([a.value for a in ins[:-1]], axis=-1)
+        label = ins[-1].value.reshape(-1).astype(jnp.int32)
+        depth = max((num_classes - 1).bit_length(), 1)
+        # complete binary tree addressing (reference MultiBinaryLabelCode):
+        # node code of class c = c + num_classes; bit walk from the top
+        code = label + num_classes
+        cost = jnp.zeros(label.shape, x.dtype)
+        w, b = params["w0"], params.get("wbias")
+        for d in range(depth, 0, -1):
+            node = code >> d
+            active = node >= 1
+            node_idx = jnp.clip(node - 1, 0, num_classes - 2)
+            bit = (code >> (d - 1)) & 1  # next step: 0 = left, 1 = right
+            score = jnp.einsum("bd,bd->b", w[node_idx], x)
+            if b is not None:
+                score = score + b[node_idx]
+            sign = 1.0 - 2.0 * bit.astype(x.dtype)  # left:+1, right:-1
+            step_cost = -jax.nn.log_sigmoid(sign * score)
+            cost = cost + jnp.where(active, step_cost, 0.0)
+        return Argument(value=cost[:, None])

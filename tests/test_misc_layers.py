@@ -1,0 +1,351 @@
+"""Long-tail layer tests (the analogue of per-layer cases in
+``test_LayerGrad.cpp``): math known-answer checks + gradient flow."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.config import dsl
+from paddle_tpu.core.argument import Argument
+from paddle_tpu.core.network import Network
+
+
+def _run(outputs, feed, seed=0, train=False, rng=None):
+    net = Network(dsl.current_graph(), outputs=[o.name for o in outputs])
+    params = net.init_params(jax.random.PRNGKey(seed))
+    outs = net.apply(params, feed, train=train, rng=rng)
+    return net, params, outs
+
+
+def test_clip_power_prelu():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 6).astype(np.float32)
+    dsl.reset()
+    d = dsl.data("x", size=6)
+    w = dsl.data("w", size=1)
+    c = dsl.clip_layer(d, min=-0.5, max=0.5)
+    p = dsl.power_layer(d, w)
+    pr = dsl.prelu_layer(d)
+    wv = np.full((4, 1), 2.0, np.float32)
+    _, params, outs = _run([c, p, pr], {
+        "x": Argument(value=jnp.asarray(x)),
+        "w": Argument(value=jnp.asarray(wv))})
+    np.testing.assert_allclose(np.asarray(outs[c.name].value),
+                               np.clip(x, -0.5, 0.5))
+    np.testing.assert_allclose(np.asarray(outs[p.name].value), x ** 2.0,
+                               rtol=1e-5)
+    want = np.maximum(x, 0) + 0.25 * np.minimum(x, 0)
+    np.testing.assert_allclose(np.asarray(outs[pr.name].value), want,
+                               rtol=1e-5)
+
+
+def test_maxout_flat():
+    x = np.arange(12, dtype=np.float32).reshape(2, 6)
+    dsl.reset()
+    d = dsl.data("x", size=6)
+    m = dsl.maxout_layer(d, groups=2)
+    _, _, outs = _run([m], {"x": Argument(value=jnp.asarray(x))})
+    # adjacent channels grouped: out i = max(x[2i], x[2i+1])
+    want = x.reshape(2, 3, 2).max(axis=2)
+    np.testing.assert_allclose(np.asarray(outs[m.name].value), want)
+
+
+def test_multiplex():
+    dsl.reset()
+    idx = dsl.data("idx", size=1)
+    a = dsl.data("a", size=3)
+    b = dsl.data("b", size=3)
+    m = dsl.multiplex_layer(idx, [a, b])
+    av = np.ones((2, 3), np.float32)
+    bv = 2 * np.ones((2, 3), np.float32)
+    _, _, outs = _run([m], {
+        "idx": Argument(value=jnp.asarray(np.array([[0], [1]], np.int32))),
+        "a": Argument(value=jnp.asarray(av)),
+        "b": Argument(value=jnp.asarray(bv))})
+    np.testing.assert_allclose(np.asarray(outs[m.name].value),
+                               [[1, 1, 1], [2, 2, 2]])
+
+
+def test_eos_id_and_conv_shift():
+    dsl.reset()
+    ids = dsl.data("ids", size=1, is_sequence=True)
+    e = dsl.eos_id_layer(ids, eos_id=2)
+    iv = np.array([[1, 2, 0], [2, 2, 1]], np.int32)
+    mask = np.ones((2, 3), np.float32)
+    _, _, outs = _run([e], {
+        "ids": Argument(value=jnp.asarray(iv), mask=jnp.asarray(mask))})
+    np.testing.assert_allclose(
+        np.asarray(outs[e.name].value)[..., 0],
+        [[0, 1, 0], [1, 1, 0]])
+
+    dsl.reset()
+    a = dsl.data("a", size=5)
+    b = dsl.data("b", size=3)
+    cs = dsl.conv_shift_layer(a, b)
+    av = np.zeros((1, 5), np.float32); av[0, 2] = 1.0
+    bv = np.array([[0.25, 0.5, 0.25]], np.float32)
+    _, _, outs = _run([cs], {"a": Argument(value=jnp.asarray(av)),
+                             "b": Argument(value=jnp.asarray(bv))})
+    got = np.asarray(outs[cs.name].value)[0]
+    # delta at 2 correlated with symmetric kernel spreads to 1..3
+    np.testing.assert_allclose(got, [0, 0.25, 0.5, 0.25, 0], atol=1e-6)
+
+
+def test_row_conv_lookahead():
+    dsl.reset()
+    x = dsl.data("x", size=2, is_sequence=True)
+    rc = dsl.row_conv_layer(x, context_length=2, name="rc")
+    xv = np.zeros((1, 4, 2), np.float32)
+    xv[0, 1] = 1.0
+    mask = np.ones((1, 4), np.float32)
+    net, params, outs = _run([rc], {
+        "x": Argument(value=jnp.asarray(xv), mask=jnp.asarray(mask))})
+    w = np.asarray(params["_rc.w0"])  # [2, D]
+    got = np.asarray(outs[rc.name].value)[0]
+    # out[t] = x[t]*w[0] + x[t+1]*w[1]: delta at t=1 -> out[0]=w[1], out[1]=w[0]
+    np.testing.assert_allclose(got[0], w[1], rtol=1e-5)
+    np.testing.assert_allclose(got[1], w[0], rtol=1e-5)
+    np.testing.assert_allclose(got[2:], 0, atol=1e-6)
+
+
+def test_tensor_layer_bilinear_form():
+    dsl.reset()
+    a = dsl.data("a", size=3)
+    b = dsl.data("b", size=2)
+    t = dsl.tensor_layer(a, b, size=4, bias_attr=False, name="t")
+    av = np.random.RandomState(0).randn(5, 3).astype(np.float32)
+    bv = np.random.RandomState(1).randn(5, 2).astype(np.float32)
+    net, params, outs = _run([t], {"a": Argument(value=jnp.asarray(av)),
+                                   "b": Argument(value=jnp.asarray(bv))})
+    w = np.asarray(params["_t.w0"]).reshape(3, 4, 2)
+    want = np.einsum("bi,ikj,bj->bk", av, w, bv)
+    np.testing.assert_allclose(np.asarray(outs[t.name].value), want,
+                               rtol=1e-4)
+
+
+def test_image_ops_pad_crop_rotate_bilinear():
+    C, H, W = 2, 4, 6
+    dsl.reset()
+    img = dsl.data("img", size=C * H * W, channels=C, height=H, width=W)
+    p = dsl.pad_layer(img, pad_h=(1, 1), pad_w=(0, 2))
+    r = dsl.rotate_layer(img)
+    bi = dsl.bilinear_interp_layer(img, out_size_x=3, out_size_y=2)
+    cr = dsl.crop_layer(img, axis=2, offset=[1, 2], shape=[C, 2, 3])
+    x = np.random.RandomState(0).randn(3, C * H * W).astype(np.float32)
+    _, _, outs = _run([p, r, bi, cr], {"img": Argument(value=jnp.asarray(x))})
+    assert outs[p.name].value.shape == (3, H + 2, W + 2, C)
+    assert outs[r.name].value.shape == (3, W, H, C)
+    assert outs[bi.name].value.shape == (3, 2, 3, C)
+    assert outs[cr.name].value.shape == (3, 2, 3, C)
+    # crop content check
+    nhwc = x.reshape(3, C, H, W).transpose(0, 2, 3, 1)
+    np.testing.assert_allclose(np.asarray(outs[cr.name].value),
+                               nhwc[:, 1:3, 2:5, :], rtol=1e-6)
+    # rotate is CLOCKWISE like the reference: out[a, b] = in[H-1-b, a]
+    rv = np.asarray(outs[r.name].value)
+    for a in range(W):
+        for b_ in range(H):
+            np.testing.assert_allclose(rv[:, a, b_], nhwc[:, H - 1 - b_, a],
+                                       rtol=1e-6)
+
+
+def test_blockexpand_shapes():
+    C, H, W = 1, 4, 4
+    dsl.reset()
+    img = dsl.data("img", size=C * H * W, channels=C, height=H, width=W)
+    be = dsl.block_expand_layer(img, block_x=2, block_y=2, stride_x=2,
+                                stride_y=2)
+    x = np.arange(16, dtype=np.float32).reshape(1, 16)
+    _, _, outs = _run([be], {"img": Argument(value=jnp.asarray(x))})
+    v = np.asarray(outs[be.name].value)
+    assert v.shape == (1, 4, 4)  # 2x2 block positions, each 1*2*2 features
+    # first block holds the top-left 2x2 patch values {0,1,4,5}
+    assert set(v[0, 0].tolist()) == {0.0, 1.0, 4.0, 5.0}
+
+
+def test_sub_nested_seq_selects():
+    dsl.reset()
+    x = dsl.data("x", size=2, is_sequence=True)
+    sel = dsl.data("sel", size=1)
+    s = dsl.sub_nested_seq_layer(x, sel)
+    B, T, D = 2, 6, 2
+    xv = np.arange(B * T * D, dtype=np.float32).reshape(B, T, D)
+    mask = np.ones((B, T), np.float32); mask[1, 4:] = 0
+    # sub-sequences: batch0 = [0:3], [3:6]; batch1 = [0:2], [2:4]
+    starts = np.zeros((B, T), np.float32)
+    starts[0, 0] = starts[0, 3] = 1
+    starts[1, 0] = starts[1, 2] = 1
+    arg = Argument(value=jnp.asarray(xv), mask=jnp.asarray(mask),
+                   sub_starts_mask=jnp.asarray(starts))
+    selv = np.array([[1], [0]], np.float32)
+    _, _, outs = _run([s], {"x": arg, "sel": Argument(value=jnp.asarray(selv))})
+    got = outs[s.name]
+    gv, gm = np.asarray(got.value), np.asarray(got.mask)
+    np.testing.assert_allclose(gv[0, :3], xv[0, 3:6])
+    assert gm[0].sum() == 3
+    np.testing.assert_allclose(gv[1, :2], xv[1, 0:2])
+    assert gm[1].sum() == 2
+
+
+def test_gru_lstm_step_match_full_layers():
+    """A recurrent_group built from gru_step must equal gated_recurrent."""
+    rng = np.random.RandomState(0)
+    B, T, H = 2, 5, 4
+    xv = rng.randn(B, T, 3 * H).astype(np.float32)
+    mask = np.ones((B, T), np.float32)
+    feed = Argument(value=jnp.asarray(xv), mask=jnp.asarray(mask))
+
+    dsl.reset()
+    xin = dsl.data("x", size=3 * H, is_sequence=True)
+    full = dsl.grumemory(xin, name="full")
+    netf = Network(dsl.current_graph(), outputs=["full"])
+    pf = netf.init_params(jax.random.PRNGKey(1))
+
+    dsl.reset()
+    xin = dsl.data("x", size=3 * H, is_sequence=True)
+
+    def step(xt):
+        m = dsl.memory(name="g", size=H)
+        return dsl.gru_step_layer(xt, m, name="g")
+
+    out = dsl.recurrent_group(step, [xin], name="grp")
+    netg = Network(dsl.current_graph(), outputs=[out.name])
+    pg = dict(netg.init_params(jax.random.PRNGKey(2)))
+    pg["_g.w0"] = pf["_full.w0"]
+    pg["_g.wbias"] = pf["_full.wbias"]
+
+    yf = netf.apply(pf, {"x": feed})["full"].value
+    yg = netg.apply(pg, {"x": feed})[out.name].value
+    np.testing.assert_allclose(np.asarray(yf), np.asarray(yg), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_lstm_step_with_get_output():
+    B, H = 3, 4
+    rng = np.random.RandomState(1)
+    dsl.reset()
+    g = dsl.data("g", size=4 * H)
+    c = dsl.data("c", size=H)
+    h = dsl.lstm_step_layer(g, c, name="h")
+    st = dsl.get_output_layer(h, arg_name="state", size=H)
+    gv = rng.randn(B, 4 * H).astype(np.float32)
+    cv = rng.randn(B, H).astype(np.float32)
+    _, params, outs = _run([h, st], {
+        "g": Argument(value=jnp.asarray(gv)),
+        "c": Argument(value=jnp.asarray(cv))})
+    b = np.asarray(params["_h.wbias"])
+    gates = gv + b[:4 * H]
+    gi, gig, gfg, gog = np.split(gates, 4, axis=-1)
+    sig = lambda z: 1 / (1 + np.exp(-z))
+    state = np.tanh(gi) * sig(gig + cv * b[4*H:5*H]) \
+        + cv * sig(gfg + cv * b[5*H:6*H])
+    outv = sig(gog + state * b[6*H:7*H]) * np.tanh(state)
+    np.testing.assert_allclose(np.asarray(outs[h.name].value), outv,
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(outs[st.name].value), state,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_nce_hsigmoid_descend():
+    rng = np.random.RandomState(0)
+    B, D, C = 8, 6, 10
+    xv = rng.randn(B, D).astype(np.float32)
+    lv = rng.randint(0, C, (B, 1))
+    dsl.reset()
+    x = dsl.data("x", size=D)
+    lab = dsl.data("lab", size=1)
+    n = dsl.nce_layer(x, lab, num_classes=C, num_neg_samples=5, name="nce")
+    hs = dsl.hsigmoid(x, lab, num_classes=C, name="hs")
+    net = Network(dsl.current_graph(), outputs=[n.name, hs.name])
+    params = net.init_params(jax.random.PRNGKey(0))
+    feed = {"x": Argument(value=jnp.asarray(xv)),
+            "lab": Argument(value=jnp.asarray(lv))}
+
+    def loss(p, which):
+        outs = net.apply(p, feed, train=True, rng=jax.random.PRNGKey(1))
+        return jnp.mean(outs[which].value)
+
+    for which in [n.name, hs.name]:
+        l0 = float(loss(params, which))
+        g = jax.grad(lambda p: loss(p, which))(params)
+        p2 = jax.tree_util.tree_map(lambda a, b: a - 0.1 * b, params, g)
+        l1 = float(loss(p2, which))
+        assert np.isfinite(l0) and l1 < l0, (which, l0, l1)
+
+
+def test_mdlstm_runs_and_grads():
+    B, H, W, S = 2, 3, 4, 2
+    rng = np.random.RandomState(0)
+    dsl.reset()
+    img = dsl.data("img", size=5 * S * H * W, channels=5 * S, height=H,
+                   width=W)
+    md = dsl.mdlstm_layer(img, name="md")
+    xv = rng.randn(B, 5 * S * H * W).astype(np.float32) * 0.1
+    net = Network(dsl.current_graph(), outputs=["md"])
+    params = net.init_params(jax.random.PRNGKey(0))
+    feed = {"img": Argument(value=jnp.asarray(xv))}
+
+    def loss(p):
+        return jnp.sum(net.apply(p, feed)["md"].value ** 2)
+
+    v = net.apply(params, feed)["md"].value
+    assert v.shape == (B, H, W, S)
+    g = jax.grad(loss)(params)
+    assert np.isfinite(np.asarray(g["_md.w0"])).all()
+
+
+def test_detection_stack():
+    from paddle_tpu.layers.detection import (decode_box, encode_box,
+                                             iou_matrix)
+    # encode/decode roundtrip
+    rng = np.random.RandomState(0)
+    priors = np.array([[0.1, 0.1, 0.3, 0.3], [0.5, 0.5, 0.9, 0.9]],
+                      np.float32)
+    var = np.full((2, 4), 0.1, np.float32)
+    gt = np.array([[0.12, 0.1, 0.33, 0.31], [0.4, 0.45, 0.8, 0.95]],
+                  np.float32)
+    enc = encode_box(jnp.asarray(gt), jnp.asarray(priors), jnp.asarray(var))
+    dec = decode_box(enc, jnp.asarray(priors), jnp.asarray(var))
+    np.testing.assert_allclose(np.asarray(dec), gt, rtol=1e-4, atol=1e-5)
+    # iou sanity
+    iou = np.asarray(iou_matrix(jnp.asarray(priors), jnp.asarray(priors)))
+    np.testing.assert_allclose(np.diag(iou), 1.0, rtol=1e-5)
+    assert iou[0, 1] == 0.0
+
+    # full stack through the DSL
+    C, Hf, Wf = 4, 2, 2
+    dsl.reset()
+    img = dsl.data("img", size=3 * 32 * 32, channels=3, height=32, width=32)
+    feat = dsl.data("feat", size=C * Hf * Wf, channels=C, height=Hf, width=Wf)
+    pb = dsl.priorbox_layer(feat, img, min_size=[10], aspect_ratio=[1.0])
+    N = Hf * Wf  # 1 prior per cell
+    classes = 3
+    conf = dsl.data("conf", size=N * classes)
+    loc = dsl.data("loc", size=N * 4)
+    gt = dsl.data("gt", size=5, is_sequence=True)
+    loss = dsl.multibox_loss_layer(pb, gt, conf, loc, num_classes=classes)
+    det = dsl.detection_output_layer(pb, conf, loc, num_classes=classes,
+                                     keep_top_k=5)
+    B = 2
+    gtv = np.zeros((B, 3, 5), np.float32)
+    gtv[:, 0] = [1, 0.1, 0.1, 0.4, 0.4]
+    gtm = np.zeros((B, 3), np.float32); gtm[:, 0] = 1
+    feed = {
+        "img": Argument(value=jnp.zeros((B, 3 * 32 * 32))),
+        "feat": Argument(value=jnp.zeros((B, C * Hf * Wf))),
+        "conf": Argument(value=jnp.asarray(
+            rng.randn(B, N * classes).astype(np.float32))),
+        "loc": Argument(value=jnp.asarray(
+            rng.randn(B, N * 4).astype(np.float32) * 0.1)),
+        "gt": Argument(value=jnp.asarray(gtv), mask=jnp.asarray(gtm)),
+    }
+    net = Network(dsl.current_graph(),
+                  outputs=[loss.name, det.name, pb.name])
+    params = net.init_params(jax.random.PRNGKey(0))
+    outs = net.apply(params, feed)
+    assert outs[pb.name].value.shape == (N, 8)
+    lv = np.asarray(outs[loss.name].value)
+    assert lv.shape == (B, 1) and np.isfinite(lv).all() and (lv > 0).all()
+    dv = np.asarray(outs[det.name].value)
+    assert dv.shape == (B, 5, 7)
