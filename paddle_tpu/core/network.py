@@ -150,6 +150,7 @@ class Network:
         state updates for moving statistics)."""
         ctx = Context(train=train, rng=rng)
         from paddle_tpu.layers.activations import apply_activation  # cycle-free
+        from paddle_tpu.utils.error_context import layer_scope
 
         for name in self.order:
             layer = self.model.layers[name]
@@ -163,13 +164,16 @@ class Network:
             lparams = {s: params[p] for s, p in self._layer_params[name].items()}
             ctx.in_infos = [self.shape_infos[i] for i in layer.input_names()]
             ctx.out_info = self.shape_infos[name]
-            out = impl.apply(layer, lparams, ins, ctx)
-            if layer.act and layer.act not in ("linear", ""):
-                out = out.with_value(
-                    apply_activation(layer.act, out.value, out.mask))
-            if layer.drop_rate > 0.0:
-                out = out.with_value(
-                    _dropout(out.value, layer.drop_rate, ctx, name))
+            # layer_scope = CustomStackTrace push/pop + HLO named_scope
+            # (NeuralNetwork.cpp:244-252)
+            with layer_scope(name):
+                out = impl.apply(layer, lparams, ins, ctx)
+                if layer.act and layer.act not in ("linear", ""):
+                    out = out.with_value(
+                        apply_activation(layer.act, out.value, out.mask))
+                if layer.drop_rate > 0.0:
+                    out = out.with_value(
+                        _dropout(out.value, layer.drop_rate, ctx, name))
             ctx.outputs[name] = out
         return ctx.outputs, ctx.state_updates
 

@@ -135,25 +135,42 @@ class SGD:
 
     # ---------------------------------------------------------------- loop
     def train(self, reader, *, feeder=None, num_passes: int = 1,
-              event_handler: Optional[Callable] = None):
+              event_handler: Optional[Callable] = None,
+              log_period: int = 0):
         """reader yields minibatches (lists of sample tuples); feeder
-        converts them to Arguments (or pass feed dicts directly)."""
+        converts them to Arguments (or pass feed dicts directly).
+        ``log_period``>0 logs a TrainerStats-style line and dumps+resets the
+        timer registry every N batches (``TrainerInternal.cpp:160-170``,
+        ``Trainer.cpp:443-451``)."""
+        from paddle_tpu.utils import global_stat, logger, timer
         event_handler = event_handler or (lambda e: None)
         acc = Accumulator()
         for pass_id in range(num_passes):
             event_handler(ev.BeginPass(pass_id))
             acc.reset()
+            window_cost, window_n = 0.0, 0
             for batch_id, data in enumerate(reader()):
                 event_handler(ev.BeginIteration(pass_id, batch_id))
-                feed = feeder(data) if feeder is not None else data
-                if self.mesh is not None:
-                    feed = mesh_lib.shard_batch(feed, self.mesh)
+                with timer("prepareBatchData"):
+                    feed = feeder(data) if feeder is not None else data
+                    if self.mesh is not None:
+                        feed = mesh_lib.shard_batch(feed, self.mesh)
                 self._rng, step_rng = jax.random.split(self._rng)
-                self.params, self.opt_state, metrics = self._train_step(
-                    self.params, self.opt_state, feed, step_rng,
-                    jnp.int32(pass_id))
-                cost = float(metrics["cost"])
+                with timer("trainBatch"):
+                    self.params, self.opt_state, metrics = self._train_step(
+                        self.params, self.opt_state, feed, step_rng,
+                        jnp.int32(pass_id))
+                    cost = float(metrics["cost"])
                 evals = self._accumulate(acc, metrics)
+                window_cost += cost
+                window_n += 1
+                if log_period and (batch_id + 1) % log_period == 0:
+                    logger.info(
+                        "Pass=%d Batch=%d Cost=%.5f Eval: %s", pass_id,
+                        batch_id + 1, window_cost / window_n,
+                        " ".join(f"{k}={v:.5g}" for k, v in evals.items()))
+                    logger.info("\n%s", global_stat.status(reset=True))
+                    window_cost, window_n = 0.0, 0
                 event_handler(ev.EndIteration(pass_id, batch_id, cost, evals))
             event_handler(ev.EndPass(pass_id, acc.result()))
 
@@ -176,9 +193,25 @@ class SGD:
                 acc.add(k, *(jax.device_get(x) for x in v))
         return acc.result()
 
+    def parameter_stats(self) -> Dict[str, Dict[str, float]]:
+        """Parameter health dump — per-parameter mean |v| and max |v|
+        (``showParameterStats``, ``TrainerInternal.cpp:186+``). One jitted
+        program for the whole table (per-parameter eager reductions would
+        trigger dozens of tiny compilations)."""
+        raw = jax.device_get(_param_stats_jit(self.params))
+        return {n: {"avg_abs": float(a), "max_abs": float(m),
+                    "size": int(self.params[n].size)}
+                for n, (a, m) in raw.items()}
+
     # ------------------------------------------------------------ forward
     def forward(self, feed, output_names: Optional[List[str]] = None):
         outputs = self.network.apply(self.params, feed, train=False)
         if output_names is None:
             return outputs
         return {n: outputs[n] for n in output_names}
+
+
+@jax.jit
+def _param_stats_jit(params):
+    return {n: (jnp.mean(jnp.abs(v)), jnp.max(jnp.abs(v)))
+            for n, v in params.items()}

@@ -1,0 +1,56 @@
+"""Layer-stack error context.
+
+Equivalent of ``CustomStackTrace<std::string>`` (``paddle/utils/
+CustomStackTrace.{h,cpp}``): the reference pushes/pops layer names around
+each layer's forward/backward so a CHECK failure prints the offending layer
+chain (``NeuralNetwork.cpp:244-252``). Here the graph executor pushes layer
+names while *tracing*; a Python exception raised inside a layer impl is
+re-raised wrapped with the active chain. Inside the compiled program the
+same names appear as ``jax.named_scope`` annotations in the XLA HLO, so
+device-side failures (nan-checker, OOM) also carry layer names.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from typing import List
+
+import jax
+
+_tls = threading.local()
+
+
+def current_layer_stack() -> List[str]:
+    return list(getattr(_tls, "stack", []))
+
+
+class LayerStackError(RuntimeError):
+    """Wraps an exception raised while executing a layer, carrying the
+    forward chain that led there."""
+
+    def __init__(self, chain: List[str], original: BaseException):
+        self.chain = chain
+        self.original = original
+        super().__init__(
+            f"error in layer {chain[-1]!r} (forward chain: "
+            f"{' -> '.join(chain)}): {type(original).__name__}: {original}")
+
+
+@contextmanager
+def layer_scope(name: str):
+    """Push a layer name for error reporting AND annotate the traced ops
+    with a named scope (so the profiler/HLO shows per-layer attribution)."""
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    stack.append(name)
+    try:
+        with jax.named_scope(name):
+            yield
+    except LayerStackError:
+        raise
+    except Exception as e:  # noqa: BLE001 - deliberately broad, re-raised
+        raise LayerStackError(list(stack), e) from e
+    finally:
+        stack.pop()

@@ -1,0 +1,39 @@
+"""glog-style logging (``paddle/utils/Logging.h``).
+
+One shared logger with the glog line format
+``I0729 12:00:00.123456 module.py:42] message``; unbuffered like the
+reference's trainer main (``TrainerMain.cpp:34``).
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+_FMT = "%(levelname).1s%(asctime)s %(filename)s:%(lineno)d] %(message)s"
+_DATEFMT = "%m%d %H:%M:%S"
+
+_configured = False
+
+
+def _configure():
+    global _configured
+    if _configured:
+        return
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(logging.Formatter(_FMT, datefmt=_DATEFMT))
+    root = logging.getLogger("paddle_tpu")
+    root.addHandler(handler)
+    root.setLevel(logging.INFO)
+    root.propagate = False
+    _configured = True
+
+
+def get_logger(name: str = "paddle_tpu") -> logging.Logger:
+    _configure()
+    if name == "paddle_tpu" or name.startswith("paddle_tpu."):
+        return logging.getLogger(name)
+    return logging.getLogger("paddle_tpu." + name)
+
+
+logger = get_logger()

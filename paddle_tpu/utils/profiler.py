@@ -1,0 +1,25 @@
+"""Device profiling bracket.
+
+The reference brackets regions with ``hl_profiler_start/end`` +
+``GpuProfiler`` (``paddle/utils/Stat.h:282-300``, ``WITH_PROFILER``); the
+TPU-native equivalent is a jax profiler trace: every op inside the bracket
+lands in a TensorBoard-loadable trace with the per-layer ``named_scope``
+annotations from the graph executor.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+import jax
+
+
+@contextmanager
+def profiler_trace(log_dir: str):
+    """``with profiler_trace("/tmp/trace"): step()`` — the
+    ``REGISTER_GPU_PROFILER`` bracket."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

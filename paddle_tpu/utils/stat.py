@@ -1,0 +1,133 @@
+"""Wall-time stat registry.
+
+Equivalent of the reference's ``REGISTER_TIMER*`` macros and ``globalStat``
+(``paddle/utils/Stat.h:114-277``): named timers accumulate count/total/max/min
+into a process-global registry; the trainer dumps and resets them every
+``log_period`` batches (``Trainer.cpp:443-451``). Differences by design:
+
+- timers are context managers / decorators, not RAII macros;
+- they measure *host-side* scopes (feed conversion, step dispatch, eval);
+  inside a jitted program XLA fuses layers, so the reference's per-layer
+  forward/backward timers (``NeuralNetwork.cpp:248``) map to the jax
+  profiler trace instead (see ``profiler.py``).
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+
+class Stat:
+    """One named accumulator: count, total seconds, max, min."""
+
+    __slots__ = ("name", "count", "total", "max", "min", "_lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self):
+        self.count = 0
+        self.total = 0.0
+        self.max = 0.0
+        self.min = float("inf")
+
+    def add(self, seconds: float):
+        with self._lock:
+            self.count += 1
+            self.total += seconds
+            if seconds > self.max:
+                self.max = seconds
+            if seconds < self.min:
+                self.min = seconds
+
+    @property
+    def avg(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+    def __repr__(self):
+        return (f"Stat({self.name}: count={self.count} "
+                f"total={self.total * 1e3:.3f}ms avg={self.avg * 1e3:.3f}ms "
+                f"max={self.max * 1e3:.3f}ms)")
+
+
+class StatRegistry:
+    """Registry of named Stats (the ``StatSet`` of ``Stat.h:137``)."""
+
+    def __init__(self, name: str = "global"):
+        self.name = name
+        self._stats: Dict[str, Stat] = {}
+        self._lock = threading.Lock()
+        self.enabled = True  # -DPADDLE_DISABLE_TIMER equivalent
+
+    def get(self, name: str) -> Stat:
+        with self._lock:
+            s = self._stats.get(name)
+            if s is None:
+                s = self._stats[name] = Stat(name)
+            return s
+
+    def reset(self):
+        with self._lock:
+            for s in self._stats.values():
+                with s._lock:
+                    s.reset()
+
+    def stats(self) -> Dict[str, Stat]:
+        with self._lock:
+            return dict(self._stats)
+
+    def status(self, reset: bool = False) -> str:
+        """Formatted dump, the ``printAllStatus`` of the reference. Reads
+        (and the optional reset) take each Stat's lock so a concurrent
+        ``add`` from a data-loader thread can't produce a torn window."""
+        lines = [f"======= StatSet: [{self.name}] status ======"]
+        with self._lock:
+            snapshot = dict(self._stats)
+        for name in sorted(snapshot):
+            s = snapshot[name]
+            with s._lock:
+                count, total, smax, avg = s.count, s.total, s.max, s.avg
+                if reset:
+                    s.reset()
+            if count == 0:
+                continue
+            lines.append(
+                f"  {name:<32} count={count:<8} "
+                f"total={total * 1e3:10.3f}ms avg={avg * 1e3:9.3f}ms "
+                f"max={smax * 1e3:9.3f}ms")
+        return "\n".join(lines)
+
+
+global_stat = StatRegistry()
+
+
+@contextmanager
+def timer(name: str, registry: Optional[StatRegistry] = None):
+    """``with timer("forwardBackward"): ...`` — REGISTER_TIMER_INFO."""
+    reg = registry or global_stat
+    if not reg.enabled:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        reg.get(name).add(time.perf_counter() - t0)
+
+
+def timer_guard(name: str, registry: Optional[StatRegistry] = None):
+    """Decorator form for whole functions."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            with timer(name, registry):
+                return fn(*args, **kwargs)
+        return wrapped
+    return deco

@@ -1,0 +1,118 @@
+"""Tests for the utils subsystem (timers, error context, logging) —
+covering the Stat.h / CustomStackTrace behaviors of ``paddle/utils``."""
+
+import time
+
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.utils import (LayerStackError, StatRegistry,
+                              current_layer_stack, global_stat, layer_scope,
+                              timer, timer_guard)
+
+
+def test_timer_accumulates():
+    reg = StatRegistry("test")
+    for _ in range(3):
+        with timer("scope_a", reg):
+            time.sleep(0.002)
+    s = reg.get("scope_a")
+    assert s.count == 3
+    assert s.total >= 0.006
+    assert s.max >= s.avg >= s.min > 0
+    status = reg.status(reset=True)
+    assert "scope_a" in status
+    assert reg.get("scope_a").count == 0  # reset worked
+
+
+def test_timer_guard_decorator():
+    reg = StatRegistry("test")
+
+    @timer_guard("fn", reg)
+    def f(x):
+        return x + 1
+
+    assert f(1) == 2
+    assert reg.get("fn").count == 1
+
+
+def test_timer_disabled():
+    reg = StatRegistry("test")
+    reg.enabled = False
+    with timer("x", reg):
+        pass
+    assert reg.get("x").count == 0
+
+
+def test_layer_scope_error_chain():
+    with pytest.raises(LayerStackError) as ei:
+        with layer_scope("fc1"):
+            with layer_scope("fc2"):
+                raise ValueError("boom")
+    assert ei.value.chain == ["fc1", "fc2"]
+    assert "fc1 -> fc2" in str(ei.value)
+    assert current_layer_stack() == []  # fully popped
+
+
+def test_layer_scope_clean_exit():
+    with layer_scope("a"):
+        assert current_layer_stack() == ["a"]
+    assert current_layer_stack() == []
+
+
+def test_network_error_carries_layer_chain():
+    """A bad feed shape inside a layer impl should name the failing layer."""
+    from paddle_tpu.config import dsl
+    from paddle_tpu.core.argument import Argument
+    from paddle_tpu.core.network import Network
+
+    dsl.reset()
+    d = dsl.data("x", size=4)
+    h = dsl.fc(input=d, size=8)
+    net = Network(dsl.current_graph(), outputs=[h.name])
+    import jax
+    params = net.init_params(jax.random.PRNGKey(0))
+    bad = {"x": Argument(value=jnp.ones((2, 5)))}  # wrong width
+    with pytest.raises(LayerStackError) as ei:
+        net.apply(params, bad)
+    assert ei.value.chain[-1] == h.name
+
+
+def test_trainer_log_period_and_param_stats(caplog):
+    import numpy as np
+    from paddle_tpu.config import dsl
+    from paddle_tpu.data import DataFeeder, dense_vector, integer_value
+    from paddle_tpu.optim import Momentum
+    from paddle_tpu.trainer import SGD
+
+    dsl.reset()
+    x = dsl.data("x", size=4)
+    y = dsl.data("y", size=2)
+    h = dsl.fc(input=x, size=2, act="softmax")
+    cost = dsl.classification_cost(input=h, label=y)
+    t = SGD(cost=cost, update_equation=Momentum(learning_rate=0.1))
+
+    rng = np.random.RandomState(0)
+    data = [(rng.randn(4).astype("float32"), int(rng.randint(2)))
+            for _ in range(8)]
+    feeder = DataFeeder({"x": dense_vector(4), "y": integer_value(2)})
+
+    def reader():
+        yield data[:4]
+        yield data[4:]
+
+    global_stat.reset()
+    # the paddle_tpu logger is non-propagating (it owns its glog-format
+    # stderr handler), so hook the capture handler onto it directly
+    import logging
+    plogger = logging.getLogger("paddle_tpu")
+    plogger.addHandler(caplog.handler)
+    try:
+        t.train(reader, feeder=feeder, num_passes=1, log_period=1)
+    finally:
+        plogger.removeHandler(caplog.handler)
+    text = caplog.text
+    assert "Cost=" in text and "classification_error=" in text
+    assert "trainBatch" in text  # the StatSet dump ran and was formatted
+    stats = t.parameter_stats()
+    assert any(v["size"] > 0 for v in stats.values())
