@@ -77,9 +77,9 @@ class LstmLayer(LayerImpl):
     def apply(self, cfg, params, ins, ctx):
         a = ins[0]
         size = ctx.out_info.size
-        act_in = _act(cfg.attrs.get("active_type", "tanh"))
-        act_gate = _act(cfg.attrs.get("active_gate_type", "sigmoid"))
-        act_state = _act(cfg.attrs.get("active_state_type", "tanh"))
+        act_in_name = cfg.attrs.get("active_type", "tanh")
+        act_gate_name = cfg.attrs.get("active_gate_type", "sigmoid")
+        act_state_name = cfg.attrs.get("active_state_type", "tanh")
         reverse = bool(cfg.attrs.get("reversed", False))
         w = params["w0"]
         if "wbias" in params:
@@ -95,6 +95,23 @@ class LstmLayer(LayerImpl):
         B = a.value.shape[0]
         xs = jnp.swapaxes(a.value, 0, 1)  # [T, B, 4*size]
         mask = jnp.swapaxes(a.mask, 0, 1)  # [T, B]
+
+        default_acts = (act_in_name in ("tanh", "")
+                        and act_gate_name == "sigmoid"
+                        and act_state_name in ("tanh", ""))
+        if default_acts:
+            # Fused path (ops/lstm.py): Pallas kernel on TPU, scan elsewhere.
+            from paddle_tpu.ops import lstm_sequence
+            h0 = jnp.zeros((B, size), a.value.dtype)
+            ys, hT, cT = lstm_sequence(xs, mask, w, gate_bias, check_i,
+                                       check_f, check_o, h0, h0,
+                                       reverse=reverse)
+            return Argument(value=jnp.swapaxes(ys, 0, 1), mask=a.mask,
+                            state=(hT, cT))
+
+        act_in = _act(act_in_name)
+        act_gate = _act(act_gate_name)
+        act_state = _act(act_state_name)
 
         def step(carry, x_t):
             h, c = carry
@@ -131,8 +148,8 @@ class GruLayer(LayerImpl):
     def apply(self, cfg, params, ins, ctx):
         a = ins[0]
         size = ctx.out_info.size
-        act_in = _act(cfg.attrs.get("active_type", "tanh"))
-        act_gate = _act(cfg.attrs.get("active_gate_type", "sigmoid"))
+        act_in_name = cfg.attrs.get("active_type", "tanh")
+        act_gate_name = cfg.attrs.get("active_gate_type", "sigmoid")
         reverse = bool(cfg.attrs.get("reversed", False))
         w_gate = params["w0"][:, : 2 * size]   # [size, 2*size] for z, r
         w_state = params["w0"][:, 2 * size:]   # [size, size] for candidate
@@ -142,6 +159,19 @@ class GruLayer(LayerImpl):
         B = a.value.shape[0]
         xs = jnp.swapaxes(a.value, 0, 1)
         mask = jnp.swapaxes(a.mask, 0, 1)
+
+        default_acts = (act_in_name in ("tanh", "")
+                        and act_gate_name == "sigmoid")
+        if default_acts:
+            from paddle_tpu.ops import gru_sequence
+            h0 = jnp.zeros((B, size), a.value.dtype)
+            ys, hT = gru_sequence(xs, mask, w_gate, w_state, bias, h0,
+                                  reverse=reverse)
+            return Argument(value=jnp.swapaxes(ys, 0, 1), mask=a.mask,
+                            state=hT)
+
+        act_in = _act(act_in_name)
+        act_gate = _act(act_gate_name)
 
         def step(carry, x_t):
             (h,) = carry

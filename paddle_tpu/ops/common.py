@@ -1,0 +1,54 @@
+"""Kernel-dispatch policy: pallas-compiled / pallas-interpret / reference.
+
+Mirrors the role of the reference's CPU stub layer
+(`paddle/cuda/include/stub/*_stub.h`): every kernel has a reference
+implementation that runs anywhere, and the fast path is selected by the
+platform actually present.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Optional
+
+import jax
+
+# None = auto; "pallas" = force compiled; "interpret" = force interpreter;
+# "ref" = force pure-JAX reference implementation.
+_FORCED: Optional[str] = os.environ.get("PADDLE_TPU_KERNELS") or None
+
+# VMEM budget used to decide whether a kernel's resident working set
+# (weights + a few time-step blocks) fits on-chip; conservative vs ~16MB.
+VMEM_BUDGET_BYTES = 12 * 1024 * 1024
+
+
+@contextlib.contextmanager
+def force_mode(mode: Optional[str]):
+    """Force kernel dispatch for a scope (tests use "interpret"/"ref")."""
+    global _FORCED
+    prev, _FORCED = _FORCED, mode
+    try:
+        yield
+    finally:
+        _FORCED = prev
+
+
+def mode() -> str:
+    if _FORCED is not None:
+        return _FORCED
+    return "pallas" if jax.default_backend() == "tpu" else "ref"
+
+
+def use_pallas(resident_bytes: int = 0) -> bool:
+    """Should this op take the Pallas path (compiled or interpreted)?"""
+    m = mode()
+    if m == "ref":
+        return False
+    if resident_bytes > VMEM_BUDGET_BYTES:
+        return False
+    return True
+
+
+def interpret() -> bool:
+    return mode() == "interpret"

@@ -1,0 +1,249 @@
+"""Fused LSTM sequence kernel (Pallas) with analytic backward.
+
+TPU-native equivalent of the reference's fused LSTM cell kernels
+(`paddle/cuda/include/hl_gpu_lstm.cuh:46-67`, driven per-timestep by
+`LstmLayer.cpp`): the whole recurrence runs as ONE Pallas kernel — the grid
+iterates time (TPU grids execute sequentially), the recurrent weight stays
+resident in VMEM across all T steps, and each step fuses the [B,H]x[H,4H]
+recurrent matmul (MXU) with the gate nonlinearities (VPU). The input
+projection x·W_in (the big MXU matmul) happens outside, batched over all
+timesteps, exactly as the reference splits `Layer::forward` projection from
+the fused cell.
+
+Cell math (reference gate order [input, input_gate, forget_gate,
+output_gate], peephole diagonals checkI/F/O):
+
+    i  = tanh(a_i)
+    ig = sigmoid(a_ig + c_prev * pI)
+    fg = sigmoid(a_fg + c_prev * pF)
+    c  = i*ig + c_prev*fg
+    og = sigmoid(a_og + c * pO)
+    h  = og * tanh(c)
+
+Padded timesteps (mask==0) hold the carried state; outputs are zeroed —
+this preserves the reference's ragged-sequence semantics
+(`Argument.sequenceStartPositions`) in a static-shape layout.
+
+Backward is an analytic reverse-time `lax.scan` over residuals saved by the
+forward kernel (activated gates + state chains), mirroring the cuDNN-style
+"save gates, no recompute" strategy.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from paddle_tpu.ops import common
+
+
+def lstm_sequence_ref(xs, mask, w, gate_bias, check_i, check_f, check_o,
+                      h0, c0):
+    """Pure lax.scan reference. xs [T,B,4H] (pre-projected inputs), mask
+    [T,B], w [H,4H]. Returns (ys [T,B,H], hT, cT)."""
+    H = h0.shape[-1]
+
+    def step(carry, inp):
+        h, c = carry
+        x_t, m_t = inp
+        gates = x_t + h @ w + gate_bias
+        a_i, a_ig, a_fg, a_og = jnp.split(gates, 4, axis=-1)
+        i = jnp.tanh(a_i)
+        ig = jax.nn.sigmoid(a_ig + c * check_i)
+        fg = jax.nn.sigmoid(a_fg + c * check_f)
+        c_new = i * ig + c * fg
+        og = jax.nn.sigmoid(a_og + c_new * check_o)
+        h_new = og * jnp.tanh(c_new)
+        m = m_t[:, None]
+        h_next = jnp.where(m > 0, h_new, h)
+        c_next = jnp.where(m > 0, c_new, c)
+        return (h_next, c_next), h_new * m
+
+    (hT, cT), ys = lax.scan(step, (h0, c0), (xs, mask))
+    return ys, hT, cT
+
+
+# ---------------------------------------------------------------- pallas fwd
+
+def _lstm_kernel(with_residuals, xs_ref, mask_ref, w_ref, pI_ref, pF_ref,
+                 pO_ref, h0_ref, c0_ref, *refs):
+    if with_residuals:
+        ys_ref, hs_ref, cs_ref, gates_ref, h_s, c_s = refs
+    else:
+        ys_ref, hT_ref, cT_ref, h_s, c_s = refs
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _():
+        h_s[:] = h0_ref[:]
+        c_s[:] = c0_ref[:]
+
+    h = h_s[:]
+    c = c_s[:]
+    H = c.shape[-1]
+    m = mask_ref[0]  # [B, 1] (mask is fed as [T, B, 1] for tiling rules)
+    gates = xs_ref[0] + jnp.dot(h, w_ref[:],
+                                preferred_element_type=jnp.float32
+                                ).astype(h.dtype)
+    a_i = gates[:, :H]
+    a_ig = gates[:, H:2 * H]
+    a_fg = gates[:, 2 * H:3 * H]
+    a_og = gates[:, 3 * H:]
+    i = jnp.tanh(a_i)
+    ig = jax.nn.sigmoid(a_ig + c * pI_ref[0])
+    fg = jax.nn.sigmoid(a_fg + c * pF_ref[0])
+    c_new = i * ig + c * fg
+    og = jax.nn.sigmoid(a_og + c_new * pO_ref[0])
+    h_new = og * jnp.tanh(c_new)
+
+    h_next = jnp.where(m > 0, h_new, h)
+    c_next = jnp.where(m > 0, c_new, c)
+    h_s[:] = h_next
+    c_s[:] = c_next
+    ys_ref[0] = h_new * m
+    if with_residuals:
+        hs_ref[0] = h_next
+        cs_ref[0] = c_next
+        gates_ref[0] = jnp.concatenate([i, ig, fg, og], axis=-1)
+    else:
+        # final-state outputs use a constant index map; the last grid step's
+        # write is what the caller sees
+        hT_ref[:] = h_next
+        cT_ref[:] = c_next
+
+
+def _lstm_pallas(xs, mask, w, pI, pF, pO, h0, c0, with_residuals):
+    T, B, H4 = xs.shape
+    H = H4 // 4
+    dt = xs.dtype
+    t_block = lambda *shape: pl.BlockSpec(
+        (1,) + shape, lambda t: (t,) + (0,) * len(shape),
+        memory_space=pltpu.VMEM)
+    full = lambda *shape: pl.BlockSpec(
+        shape, lambda t: (0,) * len(shape), memory_space=pltpu.VMEM)
+    if with_residuals:
+        out_shapes = (
+            jax.ShapeDtypeStruct((T, B, H), dt),       # ys
+            jax.ShapeDtypeStruct((T, B, H), dt),       # hs (guarded chain)
+            jax.ShapeDtypeStruct((T, B, H), dt),       # cs (guarded chain)
+            jax.ShapeDtypeStruct((T, B, 4 * H), dt),   # activated gates
+        )
+        out_specs = (t_block(B, H), t_block(B, H), t_block(B, H),
+                     t_block(B, 4 * H))
+    else:
+        out_shapes = (
+            jax.ShapeDtypeStruct((T, B, H), dt),       # ys
+            jax.ShapeDtypeStruct((B, H), dt),          # hT
+            jax.ShapeDtypeStruct((B, H), dt),          # cT
+        )
+        out_specs = (t_block(B, H), full(B, H), full(B, H))
+    return pl.pallas_call(
+        functools.partial(_lstm_kernel, with_residuals),
+        grid=(T,),
+        in_specs=[
+            t_block(B, 4 * H),            # xs
+            t_block(B, 1),                # mask as [T, B, 1]
+            full(H, 4 * H),               # w
+            full(1, H), full(1, H), full(1, H),   # peepholes
+            full(B, H), full(B, H),       # h0, c0
+        ],
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        scratch_shapes=[pltpu.VMEM((B, H), dt), pltpu.VMEM((B, H), dt)],
+        interpret=common.interpret(),
+    )(xs, mask[..., None], w, pI.reshape(1, H), pF.reshape(1, H),
+      pO.reshape(1, H), h0, c0)
+
+
+# ------------------------------------------------------------- custom vjp
+
+@jax.custom_vjp
+def _lstm_core(xs, mask, w, pI, pF, pO, h0, c0):
+    # primal-only path (inference): lean kernel without backward residuals
+    ys, hT, cT = _lstm_pallas(xs, mask, w, pI, pF, pO, h0, c0,
+                              with_residuals=False)
+    return ys, hT, cT
+
+
+def _fwd_rule(xs, mask, w, pI, pF, pO, h0, c0):
+    ys, hs, cs, gates = _lstm_pallas(xs, mask, w, pI, pF, pO, h0, c0,
+                                     with_residuals=True)
+    res = (mask, w, pI, pF, pO, h0, c0, hs, cs, gates)
+    return (ys, hs[-1], cs[-1]), res
+
+
+def _bwd_rule(res, grads):
+    dys, dhT, dcT = grads
+    mask, w, pI, pF, pO, h0, c0, hs, cs, gates = res
+    T, B, H = hs.shape
+    # previous-state chains (guarded): h_prev[t] = hs[t-1] (h0 at t=0)
+    h_prev = jnp.concatenate([h0[None], hs[:-1]], axis=0)
+    c_prev = jnp.concatenate([c0[None], cs[:-1]], axis=0)
+
+    def step(carry, inp):
+        dh, dc, dW, dpI, dpF, dpO = carry
+        dy_t, m_t, g_t, c_new, c_pv, h_pv = inp
+        m = m_t[:, None]
+        i = g_t[:, :H]
+        ig = g_t[:, H:2 * H]
+        fg = g_t[:, 2 * H:3 * H]
+        og = g_t[:, 3 * H:]
+        dh_new = m * (dh + dy_t)
+        dc_new = m * dc
+        tc = jnp.tanh(c_new)
+        da_og = (dh_new * tc) * og * (1 - og)
+        dc_tot = dc_new + dh_new * og * (1 - tc * tc) + da_og * pO
+        da_i = dc_tot * ig * (1 - i * i)
+        da_ig = (dc_tot * i) * ig * (1 - ig)
+        da_fg = (dc_tot * c_pv) * fg * (1 - fg)
+        dc_prev = (1 - m) * dc + dc_tot * fg + da_ig * pI + da_fg * pF
+        dgates = jnp.concatenate([da_i, da_ig, da_fg, da_og], axis=-1)
+        dh_prev = (1 - m) * dh + dgates @ w.T
+        dW = dW + h_pv.T @ dgates
+        dpI = dpI + jnp.sum(da_ig * c_pv, axis=0)
+        dpF = dpF + jnp.sum(da_fg * c_pv, axis=0)
+        dpO = dpO + jnp.sum(da_og * c_new, axis=0)
+        return (dh_prev, dc_prev, dW, dpI, dpF, dpO), dgates
+
+    zW = jnp.zeros_like(w)
+    zH = jnp.zeros_like(pI)
+    (dh0, dc0, dW, dpI, dpF, dpO), dxs = lax.scan(
+        step, (dhT, dcT, zW, zH, zH, zH),
+        (dys, mask, gates, cs, c_prev, h_prev), reverse=True)
+    return dxs, None, dW, dpI, dpF, dpO, dh0, dc0
+
+
+_lstm_core.defvjp(_fwd_rule, _bwd_rule)
+
+
+# ---------------------------------------------------------------- public
+
+def lstm_sequence(xs, mask, w, gate_bias, check_i, check_f, check_o, h0, c0,
+                  reverse=False):
+    """Fused LSTM over a padded [T,B,4H] gate-projection sequence.
+
+    Dispatches to the Pallas kernel when the resident working set (recurrent
+    weight + per-step blocks) fits VMEM, else to the lax.scan reference.
+    ``reverse=True`` runs the recurrence back-to-front (outputs stay in
+    input time order). Returns (ys [T,B,H], hT, cT). Differentiable either
+    way.
+    """
+    if reverse:
+        ys, hT, cT = lstm_sequence(jnp.flip(xs, 0), jnp.flip(mask, 0), w,
+                                   gate_bias, check_i, check_f, check_o,
+                                   h0, c0)
+        return jnp.flip(ys, 0), hT, cT
+    T, B, H4 = xs.shape
+    H = H4 // 4
+    itemsize = jnp.dtype(xs.dtype).itemsize
+    resident = itemsize * (H * H4 + 6 * B * H4 + 4 * B * H)
+    if not common.use_pallas(resident):
+        return lstm_sequence_ref(xs, mask, w, gate_bias, check_i, check_f,
+                                 check_o, h0, c0)
+    xs_b = xs + gate_bias  # fold bias into the pre-projected input once
+    return _lstm_core(xs_b, mask, w, check_i, check_f, check_o, h0, c0)

@@ -165,8 +165,11 @@ class SGD:
                 window_cost += cost
                 window_n += 1
                 if log_period and (batch_id + 1) % log_period == 0:
+                    # Cost is windowed (reset each log_period); AvgEval is
+                    # cumulative since pass start, like the reference's
+                    # "Eval:" vs "CurrentEval:" split (TrainerInternal.cpp).
                     logger.info(
-                        "Pass=%d Batch=%d Cost=%.5f Eval: %s", pass_id,
+                        "Pass=%d Batch=%d Cost=%.5f AvgEval: %s", pass_id,
                         batch_id + 1, window_cost / window_n,
                         " ".join(f"{k}={v:.5g}" for k, v in evals.items()))
                     logger.info("\n%s", global_stat.status(reset=True))

@@ -10,7 +10,8 @@ from __future__ import annotations
 import logging
 import sys
 
-_FMT = "%(levelname).1s%(asctime)s %(filename)s:%(lineno)d] %(message)s"
+_FMT = ("%(levelname).1s%(asctime)s.%(msecs)03d "
+        "%(filename)s:%(lineno)d] %(message)s")
 _DATEFMT = "%m%d %H:%M:%S"
 
 _configured = False

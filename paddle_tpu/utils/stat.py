@@ -92,7 +92,8 @@ class StatRegistry:
         for name in sorted(snapshot):
             s = snapshot[name]
             with s._lock:
-                count, total, smax, avg = s.count, s.total, s.max, s.avg
+                count, total, smax, smin, avg = (s.count, s.total, s.max,
+                                                 s.min, s.avg)
                 if reset:
                     s.reset()
             if count == 0:
@@ -100,7 +101,7 @@ class StatRegistry:
             lines.append(
                 f"  {name:<32} count={count:<8} "
                 f"total={total * 1e3:10.3f}ms avg={avg * 1e3:9.3f}ms "
-                f"max={smax * 1e3:9.3f}ms")
+                f"max={smax * 1e3:9.3f}ms min={smin * 1e3:9.3f}ms")
         return "\n".join(lines)
 
 
