@@ -233,6 +233,25 @@ def grumemory(input, *, name: str = None, reverse: bool = False,
     return _add(ldef)
 
 
+def multi_head_attention(query, key_value=None, *, size: int = None,
+                         num_heads: int = 1, causal: bool = False,
+                         name: str = None, bias_attr=True,
+                         param_attr=None) -> LayerOutput:
+    """Fused multi-head attention (flash kernel on TPU); self-attention
+    when key_value is omitted. Capability-add over the reference's
+    composite simple_attention."""
+    q = _in(query)[0]
+    inputs = [Input(q.name, param_attr=_param(param_attr))]
+    if key_value is not None:
+        inputs.append(Input(_in(key_value)[0].name))
+    ldef = LayerDef(name=name or _auto_name("mha"),
+                    type="multi_head_attention", inputs=inputs,
+                    size=size or q.size, act="linear",
+                    bias=_bias(bias_attr),
+                    attrs={"num_heads": num_heads, "causal": causal})
+    return _add(ldef)
+
+
 def recurrent(input, *, name: str = None, reverse: bool = False,
               act: str = "tanh", bias_attr=True, param_attr=None) -> LayerOutput:
     src = _in(input)[0]

@@ -104,7 +104,7 @@ def blockwise_attention(q, k, v, kv_mask=None, causal=False, scale=None,
 
 # ---------------------------------------------------------------- pallas
 
-def _flash_kernel(n_heads, tq_orig, tk_orig, scale, causal,
+def _flash_kernel(tq_orig, tk_orig, scale, causal,
                   q_ref, k_ref, v_ref, mask_ref,
                   o_ref, acc_s, m_s, l_s):
     kb = pl.program_id(2)
@@ -165,7 +165,7 @@ def _flash_forward(q, k, v, kv_mask, causal, scale, block_q, block_k):
     kf = k.reshape(B * N, Tkp, D)
     vf = v.reshape(B * N, Tkp, D)
     nq, nk = Tqp // block_q, Tkp // block_k
-    kernel = functools.partial(_flash_kernel, N, Tq, tk_orig, scale, causal)
+    kernel = functools.partial(_flash_kernel, Tq, tk_orig, scale, causal)
     out = pl.pallas_call(
         kernel,
         grid=(B * N, nq, nk),

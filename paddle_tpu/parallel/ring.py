@@ -1,0 +1,155 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+The 2017 reference has NO sequence parallelism (SURVEY §2 checklist — its
+long-sequence story is ragged batching only), so this module is pure
+capability-add, designed TPU-first: both schemes run inside ``shard_map``
+over a named mesh axis holding sequence shards, and XLA lowers the
+communication to ICI collectives.
+
+- ``ring_attention``: each device keeps its Q shard and rotates the KV
+  shard around the ring (``lax.ppermute``), accumulating flash-style
+  online-softmax state. Compute on the current block overlaps the
+  next block's transfer (XLA pipelines the ppermute). Memory per device:
+  O(T/P); total traffic: each KV shard crosses each ICI hop once per
+  step — the classic Ring Attention schedule.
+- ``ulysses_attention``: ``lax.all_to_all`` re-shards [seq → heads], so
+  each device holds N/P full-length heads, runs ordinary (flash)
+  attention locally, then all-to-alls back. Cheaper for moderate T with
+  enough heads; requires num_heads % P == 0.
+
+Both are differentiable (pure lax ops + the blockwise kernel from
+ops/attention.py) and mask/causal-aware with *global* positions, so the
+sharded result equals single-device attention bit-for-bit up to fp
+reassociation.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.ops.attention import blockwise_attention, flash_attention
+
+_NEG = -1e9
+
+
+def _local_attn_stats(q, k, v, kv_mask, causal, scale, q_off, k_off):
+    """One Q-shard vs one KV-block attention with un-normalized
+    accumulator: returns (acc, m, l) for online-softmax merging.
+    q [B,N,Tq,D], k/v [B,N,Tk,D], kv_mask [B,Tk] or None; q_off/k_off are
+    the global positions of element 0 (for causal masking)."""
+    s = jnp.einsum("bnqd,bnkd->bnqk", q, k) * scale
+    if kv_mask is not None:
+        s = jnp.where(kv_mask[:, None, None, :] > 0, s, _NEG)
+    if causal:
+        qi = q_off + jnp.arange(q.shape[2])[:, None]
+        kj = k_off + jnp.arange(k.shape[2])[None, :]
+        s = jnp.where(kj <= qi, s, _NEG)
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bnqk,bnkd->bnqd", p, v)
+    return acc, m, l
+
+
+def _merge_stats(acc1, m1, l1, acc2, m2, l2):
+    m = jnp.maximum(m1, m2)
+    a1 = jnp.exp(m1 - m)
+    a2 = jnp.exp(m2 - m)
+    return (acc1 * a1[..., None] + acc2 * a2[..., None],
+            m, l1 * a1 + l2 * a2)
+
+
+def ring_attention(q, k, v, axis_name, kv_mask=None, causal=False,
+                   scale=None):
+    """Ring attention over the mesh axis ``axis_name``. Must be called
+    inside ``shard_map``; q/k/v are the per-device sequence shards
+    [B, N, T/P, D], kv_mask the matching [B, T/P] shard."""
+    P = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    Tl = q.shape[2]
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    q_off = idx * Tl
+
+    B, N, _, D = q.shape
+    acc = jnp.zeros((B, N, Tl, D), jnp.float32)
+    m = jnp.full((B, N, Tl), _NEG, jnp.float32)
+    l = jnp.zeros((B, N, Tl), jnp.float32)
+    if kv_mask is None:
+        kv_mask = jnp.ones((B, Tl), q.dtype)
+
+    perm = [(i, (i + 1) % P) for i in range(P)]
+
+    def body(s, carry):
+        acc, m, l, k_cur, v_cur, mask_cur = carry
+        # KV currently resident here originated at device (idx - s) mod P
+        k_off = ((idx - s) % P) * Tl
+        a2, m2, l2 = _local_attn_stats(q, k_cur, v_cur, mask_cur, causal,
+                                       scale, q_off, k_off)
+        acc, m, l = _merge_stats(acc, m, l, a2, m2, l2)
+        if s < P - 1:  # last step's rotation would be dead ICI traffic
+            k_cur = lax.ppermute(k_cur, axis_name, perm)
+            v_cur = lax.ppermute(v_cur, axis_name, perm)
+            mask_cur = lax.ppermute(mask_cur, axis_name, perm)
+        return acc, m, l, k_cur, v_cur, mask_cur
+
+    # static unroll over ring steps: P is small and static, and unrolling
+    # lets XLA overlap each step's ppermute with the previous compute
+    carry = (acc, m, l, k, v, kv_mask)
+    for s in range(P):
+        carry = body(s, carry)
+    acc, m, l = carry[:3]
+    return (acc / l[..., None]).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name, kv_mask=None, causal=False,
+                      scale=None):
+    """Ulysses sequence parallelism over ``axis_name`` (inside shard_map):
+    all-to-all [B, N, T/P, D] → [B, N/P, T, D], local flash attention,
+    all-to-all back. num_heads must divide by the axis size."""
+    P = lax.psum(1, axis_name)
+    N = q.shape[1]
+    assert N % P == 0, f"heads {N} not divisible by seq-parallel degree {P}"
+    # concat_dim_to_split... all_to_all(split heads, concat sequence)
+    def fwd(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def bwd(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qh, kh, vh = fwd(q), fwd(k), fwd(v)
+    full_mask = (lax.all_gather(kv_mask, axis_name, axis=1, tiled=True)
+                 if kv_mask is not None else None)
+    out = flash_attention(qh, kh, vh, full_mask, causal=causal, scale=scale)
+    return bwd(out)
+
+
+def make_ring_attention(mesh, axis_name, kind="ring", causal=False):
+    """Build a jittable full-tensor attention fn sharded over ``mesh``'s
+    ``axis_name`` (sequence dim). Inputs/outputs are global [B, N, T, D]
+    (+ optional kv_mask [B, T]); sharding + collectives happen inside."""
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    inner = ring_attention if kind == "ring" else ulysses_attention
+    spec = P(None, None, axis_name, None)
+    mask_spec = P(None, axis_name)
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(spec, spec, spec, mask_spec),
+        out_specs=spec, check_vma=False)
+    def sharded(q, k, v, kv_mask):
+        return inner(q, k, v, axis_name, kv_mask=kv_mask, causal=causal)
+
+    def fn(q, k, v, kv_mask=None):
+        if kv_mask is None:
+            kv_mask = jnp.ones((q.shape[0], q.shape[2]), q.dtype)
+        return sharded(q, k, v, kv_mask)
+
+    return fn

@@ -160,3 +160,37 @@ def test_seq_pool_grads():
                 name="out", type=ltype, inputs=[Input("x")], attrs=attrs))
             return "out"
         _check_layer(g, _seq_feed())
+
+
+def test_multi_head_attention_grad():
+    def g():
+        dsl.data(name="x", size=8, is_sequence=True)
+        return dsl.multi_head_attention(
+            dsl.LayerOutput("x", 8), size=8, num_heads=2, causal=True).name
+
+    _check_layer(g, _seq_feed(d=8))
+
+
+def test_multi_head_attention_masks_padding():
+    """Padded positions must not attend nor be attended to."""
+    from paddle_tpu.ops.attention import mha_reference
+    dsl.reset()
+    dsl.data(name="x", size=8, is_sequence=True)
+    out = dsl.multi_head_attention(dsl.LayerOutput("x", 8), size=8,
+                                   num_heads=2)
+    net = Network(dsl.current_graph(), outputs=[out.name])
+    params = net.init_params(jax.random.PRNGKey(3))
+    feed = _seq_feed(d=8, seed=4)
+    res = net.apply(params, feed, train=False)[out.name]
+    mask = np.asarray(feed["x"].mask)
+    # output at padded positions is exactly zero
+    assert np.all(np.asarray(res.value)[mask == 0] == 0)
+    # changing a padded input position does not change valid outputs
+    v2 = np.asarray(feed["x"].value).copy()
+    b_pad, t_pad = np.argwhere(mask == 0)[0]
+    v2[b_pad, t_pad] += 100.0
+    feed2 = {"x": Argument(value=jnp.asarray(v2), mask=feed["x"].mask)}
+    res2 = net.apply(params, feed2, train=False)[out.name]
+    np.testing.assert_allclose(np.asarray(res.value)[mask == 1],
+                               np.asarray(res2.value)[mask == 1],
+                               rtol=1e-6, atol=1e-6)

@@ -1,0 +1,75 @@
+"""Sequence-parallel attention tests on the 8-device virtual CPU mesh.
+
+Verifies ring attention and Ulysses all-to-all attention equal the
+single-device reference (values and gradients) with ragged kv masks and
+causal masking — the sharded path must be a pure re-layout of the math.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from paddle_tpu.ops.attention import mha_reference
+from paddle_tpu.parallel.ring import make_ring_attention
+
+
+def _mesh(n):
+    return Mesh(np.asarray(jax.devices()[:n]), ("seq",))
+
+
+def _data(rng, B=2, N=4, T=32, D=8):
+    q = jnp.asarray(rng.normal(size=(B, N, T, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, N, T, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, N, T, D)), jnp.float32)
+    lens = rng.integers(T // 2, T + 1, size=B)
+    mask = (np.arange(T)[None, :] < lens[:, None]).astype(np.float32)
+    return q, k, v, jnp.asarray(mask)
+
+
+@pytest.mark.parametrize("kind", ["ring", "ulysses"])
+@pytest.mark.parametrize("causal", [False, True])
+def test_seq_parallel_attention_matches_reference(kind, causal):
+    rng = np.random.default_rng(0)
+    q, k, v, mask = _data(rng)
+    mesh = _mesh(4)
+    fn = make_ring_attention(mesh, "seq", kind=kind, causal=causal)
+    out = fn(q, k, v, mask)
+    ref = mha_reference(q, k, v, mask, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("kind", ["ring", "ulysses"])
+def test_seq_parallel_attention_grads(kind):
+    rng = np.random.default_rng(1)
+    q, k, v, mask = _data(rng, T=16)
+    mesh = _mesh(4)
+    fn = make_ring_attention(mesh, "seq", kind=kind, causal=True)
+
+    def loss(fn_, q_, k_, v_):
+        return jnp.sum(fn_(q_, k_, v_, mask) ** 2)
+
+    gq, gk, gv = jax.grad(lambda *a: loss(fn, *a), (0, 1, 2))(q, k, v)
+    rq, rk, rv = jax.grad(
+        lambda *a: loss(lambda q_, k_, v_, m: mha_reference(
+            q_, k_, v_, m, causal=True), *a), (0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(np.asarray(gq), np.asarray(rq),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gk), np.asarray(rk),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gv), np.asarray(rv),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_ring_attention_jits_and_shards():
+    """jit(fn) must compile with sharded inputs and produce sharded output."""
+    rng = np.random.default_rng(2)
+    q, k, v, mask = _data(rng, T=64)
+    mesh = _mesh(8)
+    fn = jax.jit(make_ring_attention(mesh, "seq", kind="ring", causal=True))
+    out = fn(q, k, v, mask)
+    ref = mha_reference(q, k, v, mask, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
