@@ -1,4 +1,5 @@
 from paddle_tpu.data import reader  # noqa: F401
+from paddle_tpu.data import recordio  # noqa: F401
 from paddle_tpu.data.feeder import DataFeeder  # noqa: F401
 from paddle_tpu.data.types import (  # noqa: F401
     dense_vector, dense_vector_sequence, integer_value,

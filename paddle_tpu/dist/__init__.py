@@ -1,0 +1,28 @@
+"""Distributed services: fault-tolerant task dispatch + checkpointing.
+
+TPU-native re-design of the reference's new-generation Go runtime
+(`go/master`, `go/pserver` — SURVEY §5.3): the accelerator-fabric parts
+(gradient aggregation, parameter sharding) are handled by XLA collectives
+in `paddle_tpu.parallel`, while the parts that are orthogonal to the
+fabric — elastic data dispatch, failure detection, checkpoint arbitration
+— live here as host-side services with the same observable semantics:
+
+- ``MasterService``: dataset partitioned into tasks; todo/pending/done/
+  failed queues; per-task timeout requeue; poison-pill discard after
+  ``failure_max``; state snapshot/recover through a ``Store``; exactly-one
+  -trainer save-model arbitration (`go/master/service.go:106,313,368,474`).
+- ``MasterServer``/``MasterClient``: length-prefixed JSON RPC over TCP
+  with client re-dial (replacing Go net/rpc + etcd discovery;
+  `go/connection/conn.go`).
+- ``FileStore``: atomic, checksummed snapshot store (replacing etcd;
+  `go/master/etcd_client.go`).
+"""
+
+from paddle_tpu.dist.master import (FileStore, InMemStore, MasterClient,
+                                    MasterServer, MasterService, Task,
+                                    master_reader, partition_chunks)
+
+__all__ = [
+    "MasterService", "MasterServer", "MasterClient", "Task",
+    "InMemStore", "FileStore", "partition_chunks", "master_reader",
+]

@@ -36,11 +36,22 @@ def save_params(path: str, params: Dict[str, Any],
     if opt_state is not None:
         arrays.update({f"opt::{k}": v
                        for k, v in _flatten(opt_state).items()})
-    np.savez(path, **arrays)
     real_path = path if path.endswith(".npz") else path + ".npz"
+    # atomic: a crash mid-save must never leave a torn file at the final
+    # name (the recovery scan would have to skip it, and a torn .npz with
+    # no .meta bypasses the MD5 gate)
+    tmp = real_path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, real_path)
     md5 = hashlib.md5(open(real_path, "rb").read()).hexdigest()
-    with open(real_path + ".meta", "w") as f:
+    with open(real_path + ".meta.tmp", "w") as f:
         json.dump({"md5": md5, **(meta or {})}, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(real_path + ".meta.tmp", real_path + ".meta")
 
 
 def load_params(path: str, check_integrity: bool = True):

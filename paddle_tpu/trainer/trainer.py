@@ -136,16 +136,35 @@ class SGD:
     # ---------------------------------------------------------------- loop
     def train(self, reader, *, feeder=None, num_passes: int = 1,
               event_handler: Optional[Callable] = None,
-              log_period: int = 0):
+              log_period: int = 0, checkpointer=None):
         """reader yields minibatches (lists of sample tuples); feeder
         converts them to Arguments (or pass feed dicts directly).
         ``log_period``>0 logs a TrainerStats-style line and dumps+resets the
         timer registry every N batches (``TrainerInternal.cpp:160-170``,
-        ``Trainer.cpp:443-451``)."""
+        ``Trainer.cpp:443-451``). ``checkpointer`` (dist.Checkpointer)
+        restores the newest intact checkpoint before training — resuming
+        at the pass after the saved one, the ``--start_pass`` semantics of
+        ``Trainer.cpp:229-250`` — and saves on its cadence at batch and
+        pass boundaries."""
         from paddle_tpu.utils import global_stat, logger, timer
+        start_pass = 0
+        if checkpointer is not None:
+            restored = checkpointer.restore()
+            if restored is not None:
+                r_params, r_opt, meta = restored
+                self.load_state(r_params, r_opt)
+                pid = int(meta.get("pass_id", -1))
+                if meta.get("end_of_pass", meta.get("batch_id", 0) == 0):
+                    start_pass = pid + 1
+                else:
+                    # mid-pass (batch-cadence) checkpoint: restart that
+                    # pass from its beginning so no batch goes untrained
+                    # (early batches re-train — at-least-once, like the
+                    # master's task requeue)
+                    start_pass = pid
         event_handler = event_handler or (lambda e: None)
         acc = Accumulator()
-        for pass_id in range(num_passes):
+        for pass_id in range(start_pass, num_passes):
             event_handler(ev.BeginPass(pass_id))
             acc.reset()
             window_cost, window_n = 0.0, 0
@@ -175,7 +194,38 @@ class SGD:
                     logger.info("\n%s", global_stat.status(reset=True))
                     window_cost, window_n = 0.0, 0
                 event_handler(ev.EndIteration(pass_id, batch_id, cost, evals))
+                if checkpointer is not None:
+                    checkpointer.maybe_save(self.params, self.opt_state,
+                                            pass_id=pass_id,
+                                            batch_id=batch_id + 1)
             event_handler(ev.EndPass(pass_id, acc.result()))
+            if checkpointer is not None:
+                checkpointer.maybe_save(self.params, self.opt_state,
+                                        pass_id=pass_id, end_of_pass=True)
+
+    def load_state(self, params: Dict[str, Any], opt_flat=None):
+        """Install restored parameters (+ optionally a flattened optimizer
+        state as produced by checkpoint.load_params): values are cast and
+        re-placed with each current array's sharding, so resuming under a
+        mesh keeps tables sharded."""
+
+        def place(new, old):
+            arr = jnp.asarray(new, dtype=old.dtype)
+            if self.mesh is not None and hasattr(old, "sharding"):
+                return jax.device_put(arr, old.sharding)
+            return arr
+
+        self.params = {k: place(v, self.params[k]) for k, v in params.items()}
+
+        if opt_flat:
+            def restore(tree, prefix=""):
+                if isinstance(tree, dict):
+                    return {k: restore(v, f"{prefix}{k}/")
+                            for k, v in tree.items()}
+                key = prefix.rstrip("/")
+                return place(opt_flat[key], tree) if key in opt_flat else tree
+
+            self.opt_state = restore(self.opt_state)
 
     def test(self, reader, *, feeder=None) -> ev.TestResult:
         acc = Accumulator()
