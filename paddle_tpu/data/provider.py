@@ -1,0 +1,147 @@
+"""PyDataProvider2: the ``@provider`` decorator.
+
+User-side data protocol of the reference
+(`python/paddle/trainer/PyDataProvider2.py:329` + the C++ host
+`gserver/dataproviders/PyDataProvider2.cpp`): a generator decorated with
+``@provider(input_types=...)`` yields samples per data file; the runtime
+adds pooled shuffling, batching into the feeder, optional per-file
+caching, and an init hook. Here the C++ host is the trainer's feeder
+path, so the decorated object exposes ``as_reader(file_list)`` — a
+standard reader the trainer/minibatch pipeline consumes — while keeping
+the reference's settings protocol (``settings.input_types``, init_hook
+kwargs, ``settings.logger``).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+from paddle_tpu.data import types as T
+from paddle_tpu.utils.log import get_logger
+
+
+class CacheType:
+    NO_CACHE = 0
+    CACHE_PASS_IN_MEM = 1
+
+
+class Settings:
+    """The ``settings`` object handed to the user generator."""
+
+    def __init__(self, input_types, **kwargs):
+        self.input_types = input_types
+        self.logger = get_logger("provider")
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+
+class DataProvider:
+    """Result of decorating a generator with ``@provider``."""
+
+    def __init__(self, generator: Callable, *, input_types=None,
+                 should_shuffle: Optional[bool] = None,
+                 pool_size: int = -1,
+                 cache: int = CacheType.NO_CACHE,
+                 init_hook: Optional[Callable] = None,
+                 calc_batch_size: Optional[Callable] = None,
+                 **kwargs):
+        self.generator = generator
+        self.input_types = input_types
+        self.should_shuffle = should_shuffle
+        self.pool_size = pool_size
+        self.cache = cache
+        self.init_hook = init_hook
+        self.calc_batch_size = calc_batch_size
+        self.extra_kwargs = kwargs
+        self.__name__ = getattr(generator, "__name__", "provider")
+        self._cache_store: Dict[str, List] = {}
+
+    # the reference instantiates per (file_list, kwargs) via the C++ host;
+    # here the instantiation IS a reader factory
+    def as_reader(self, file_list: Union[str, Sequence[str], None] = None,
+                  *, is_train: bool = True, seed: int = 0, **hook_kwargs):
+        if isinstance(file_list, str):
+            with open(file_list) as f:
+                file_list = [ln.strip() for ln in f if ln.strip()]
+        files = list(file_list) if file_list is not None else [None]
+        settings = Settings(self.input_types, **self.extra_kwargs)
+        settings.is_train = is_train
+        if self.init_hook is not None:
+            self.init_hook(settings, file_list=files, is_train=is_train,
+                           **hook_kwargs)
+        if settings.input_types is None:
+            raise ValueError("input_types must be set (decorator arg or "
+                             "init_hook assigning settings.input_types)")
+        shuffle = (self.should_shuffle if self.should_shuffle is not None
+                   else is_train)
+
+        def iter_samples():
+            for fname in files:
+                if (self.cache == CacheType.CACHE_PASS_IN_MEM
+                        and fname in self._cache_store):
+                    yield from self._cache_store[fname]
+                    continue
+                collected = [] if self.cache else None
+                for sample in (self.generator(settings, fname)
+                               if fname is not None
+                               else self.generator(settings)):
+                    sample = self._normalize(settings, sample)
+                    if collected is not None:
+                        collected.append(sample)
+                    yield sample
+                if collected is not None:
+                    self._cache_store[fname] = collected
+
+        def reader():
+            if not shuffle:
+                yield from iter_samples()
+                return
+            # pooled shuffle (pool_size semantics of the reference)
+            pool_cap = self.pool_size if self.pool_size > 0 else 4096
+            rng = random.Random(seed)
+            pool: List[Any] = []
+            for sample in iter_samples():
+                pool.append(sample)
+                if len(pool) >= pool_cap:
+                    rng.shuffle(pool)
+                    yield from pool
+                    pool = []
+            rng.shuffle(pool)
+            yield from pool
+
+        return reader
+
+    def feeding(self) -> Dict[str, T.InputType]:
+        """{name: InputType} for the DataFeeder, when input_types is a
+        dict (the recommended form)."""
+        if not isinstance(self.input_types, dict):
+            raise TypeError("feeding() needs dict-form input_types")
+        return dict(self.input_types)
+
+    @staticmethod
+    def _normalize(settings, sample):
+        # dict samples are ordered by input_types dict order
+        if isinstance(sample, dict):
+            return tuple(sample[k] for k in settings.input_types)
+        if not isinstance(sample, (tuple, list)):
+            return (sample,)
+        return tuple(sample)
+
+
+def provider(input_types=None, should_shuffle=None, pool_size=-1,
+             min_pool_size=-1, can_over_batch_size=True,
+             calc_batch_size=None, cache=CacheType.NO_CACHE,
+             init_hook=None, **kwargs):
+    """``@provider(input_types={...})`` — see module docstring.
+    min_pool_size/can_over_batch_size are accepted for source
+    compatibility (batching happens in the trainer's minibatch layer)."""
+
+    def deco(gen):
+        return DataProvider(gen, input_types=input_types,
+                            should_shuffle=should_shuffle,
+                            pool_size=pool_size, cache=cache,
+                            init_hook=init_hook,
+                            calc_batch_size=calc_batch_size, **kwargs)
+
+    return deco

@@ -1,0 +1,54 @@
+"""The v2 user API (`python/paddle/v2`): the familiar import surface.
+
+    import paddle_tpu.v2 as paddle
+    paddle.init(use_gpu=False)          # accepted for compatibility
+    img = paddle.layer.data(name="pixel",
+                            type=paddle.data_type.dense_vector(784))
+    out = paddle.layer.fc(input=img, size=10,
+                          act=paddle.activation.Softmax())
+    cost = paddle.layer.classification_cost(
+        input=out, label=paddle.layer.data(
+            name="label", type=paddle.data_type.integer_value(10)))
+    trainer = paddle.trainer.SGD(
+        cost=cost, parameters=None,
+        update_equation=paddle.optimizer.Momentum(learning_rate=0.1))
+    trainer.train(reader=paddle.batch(paddle.dataset.mnist.train(), 128),
+                  num_passes=5, event_handler=...)
+
+Flags passed to ``init`` mirror the reference's gflags bridge
+(`python/paddle/v2/__init__.py` → `utils/Flags.cpp`); on TPU most are
+no-ops (``use_gpu``/``trainer_count`` → mesh selection is explicit via
+``trainer.SGD(mesh=...)``) but are accepted so reference scripts run.
+"""
+
+from paddle_tpu.v2 import activation  # noqa: F401
+from paddle_tpu.v2 import attr  # noqa: F401
+from paddle_tpu.v2 import data_type  # noqa: F401
+from paddle_tpu.v2 import dataset  # noqa: F401
+from paddle_tpu.v2 import event  # noqa: F401
+from paddle_tpu.v2 import inference  # noqa: F401
+from paddle_tpu.v2 import layer  # noqa: F401
+from paddle_tpu.v2 import optimizer  # noqa: F401
+from paddle_tpu.v2 import parameters  # noqa: F401
+from paddle_tpu.v2 import pooling  # noqa: F401
+from paddle_tpu.v2 import reader  # noqa: F401
+from paddle_tpu.v2 import trainer  # noqa: F401
+from paddle_tpu.v2.inference import infer  # noqa: F401
+from paddle_tpu.v2.parameters import Parameters  # noqa: F401
+from paddle_tpu.data.reader import batch  # noqa: F401
+
+_initialized = False
+_init_flags = {}
+
+
+def init(**kwargs):
+    """Process-level init (`paddle.init(use_gpu=..., trainer_count=...)`).
+    Flags are recorded (see ``init_flags()``); device selection is JAX's,
+    so ``use_gpu`` and ``trainer_count`` do not restrict the TPU mesh."""
+    global _initialized
+    _init_flags.update(kwargs)
+    _initialized = True
+
+
+def init_flags():
+    return dict(_init_flags)

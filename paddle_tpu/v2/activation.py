@@ -1,0 +1,39 @@
+"""Activation objects (`python/paddle/trainer_config_helpers/
+activations.py` re-exported by v2): each carries the registry name the
+layer executor resolves."""
+
+
+class BaseActivation:
+    name = "linear"
+
+    def __repr__(self):
+        return f"{type(self).__name__}()"
+
+
+def _make(cls_name, act_name):
+    return type(cls_name, (BaseActivation,), {"name": act_name})
+
+
+Tanh = _make("Tanh", "tanh")
+Sigmoid = _make("Sigmoid", "sigmoid")
+Softmax = _make("Softmax", "softmax")
+SequenceSoftmax = _make("SequenceSoftmax", "sequence_softmax")
+Relu = _make("Relu", "relu")
+BRelu = _make("BRelu", "brelu")
+SoftRelu = _make("SoftRelu", "softrelu")
+STanh = _make("STanh", "stanh")
+Linear = _make("Linear", "linear")
+Identity = Linear
+Exp = _make("Exp", "exponential")
+Log = _make("Log", "log")
+Abs = _make("Abs", "abs")
+Square = _make("Square", "square")
+Sqrt = _make("Sqrt", "sqrt")
+Reciprocal = _make("Reciprocal", "reciprocal")
+
+
+def resolve(act):
+    """Activation object | string | None -> registry string."""
+    if act is None:
+        return None
+    return act if isinstance(act, str) else act.name

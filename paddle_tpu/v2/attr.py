@@ -1,0 +1,26 @@
+"""Parameter/layer attributes (`trainer_config_helpers/attrs.py`)."""
+
+from paddle_tpu.config.model_config import ParamAttr as _ParamAttr
+
+
+def Param(name=None, initial_std=None, initial_mean=0.0, is_static=False,
+          learning_rate=1.0, l1_rate=None, l2_rate=None,
+          sparse_update=False, **_ignored):
+    return _ParamAttr(name=name, initial_mean=initial_mean,
+                      initial_std=initial_std, is_static=is_static,
+                      learning_rate=learning_rate, l1_rate=l1_rate,
+                      l2_rate=l2_rate, sparse_grad=sparse_update)
+
+
+ParamAttr = Param
+
+
+class ExtraAttr:
+    """Extra layer attributes; drop_rate is the one with executor effect."""
+
+    def __init__(self, drop_rate=0.0, **kwargs):
+        self.drop_rate = drop_rate
+        self.kwargs = kwargs
+
+
+ExtraLayerAttribute = ExtraAttr

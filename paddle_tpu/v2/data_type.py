@@ -1,0 +1,6 @@
+"""v2 data types (`python/paddle/v2/data_type.py` — re-export of the
+PyDataProvider2 input types)."""
+
+from paddle_tpu.data.types import (  # noqa: F401
+    InputType, dense_vector, dense_vector_sequence, integer_value,
+    integer_value_sequence, sparse_binary_vector, sparse_float_vector)

@@ -1,0 +1,9 @@
+"""v2 datasets (`python/paddle/v2/dataset`): cached-real or
+deterministic-synthetic two-tier loaders (see common.py)."""
+
+from paddle_tpu.v2.dataset import cifar  # noqa: F401
+from paddle_tpu.v2.dataset import common  # noqa: F401
+from paddle_tpu.v2.dataset import imdb  # noqa: F401
+from paddle_tpu.v2.dataset import imikolov  # noqa: F401
+from paddle_tpu.v2.dataset import mnist  # noqa: F401
+from paddle_tpu.v2.dataset import uci_housing  # noqa: F401

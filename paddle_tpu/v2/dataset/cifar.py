@@ -1,0 +1,72 @@
+"""CIFAR-10/100 (`python/paddle/v2/dataset/cifar.py`): records
+``(image[3072] float in [0,1], label int)`` in CHW order."""
+
+from __future__ import annotations
+
+import pickle
+import tarfile
+
+import numpy as np
+
+from paddle_tpu.v2.dataset import common
+
+_TRAIN_N, _TEST_N = 4096, 1024
+
+
+def _real_reader(tar_path, member_match, classes):
+    def reader():
+        with tarfile.open(tar_path) as tar:
+            for member in tar.getmembers():
+                if member_match not in member.name:
+                    continue
+                batch = pickle.load(tar.extractfile(member),
+                                    encoding="latin1")
+                key = "labels" if "labels" in batch else "fine_labels"
+                for img, lab in zip(batch["data"], batch[key]):
+                    yield img.astype(np.float32) / 255.0, int(lab)
+
+    return reader
+
+
+def _synthetic_reader(n, classes, seed):
+    common.note_synthetic("cifar")
+    proto_rng = np.random.RandomState(7)
+    templates = proto_rng.rand(classes, 3072).astype(np.float32)
+
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            lab = int(rng.randint(classes))
+            img = (templates[lab] * 0.7
+                   + rng.rand(3072).astype(np.float32) * 0.3)
+            yield img.astype(np.float32), lab
+
+    return reader
+
+
+def train10():
+    path = common.cache_path("cifar", "cifar-10-python.tar.gz")
+    if path:
+        return _real_reader(path, "data_batch", 10)
+    return _synthetic_reader(_TRAIN_N, 10, seed=0)
+
+
+def test10():
+    path = common.cache_path("cifar", "cifar-10-python.tar.gz")
+    if path:
+        return _real_reader(path, "test_batch", 10)
+    return _synthetic_reader(_TEST_N, 10, seed=1)
+
+
+def train100():
+    path = common.cache_path("cifar", "cifar-100-python.tar.gz")
+    if path:
+        return _real_reader(path, "train", 100)
+    return _synthetic_reader(_TRAIN_N, 100, seed=2)
+
+
+def test100():
+    path = common.cache_path("cifar", "cifar-100-python.tar.gz")
+    if path:
+        return _real_reader(path, "test", 100)
+    return _synthetic_reader(_TEST_N, 100, seed=3)

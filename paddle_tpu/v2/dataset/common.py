@@ -1,0 +1,53 @@
+"""Dataset infrastructure (`python/paddle/v2/dataset/common.py`).
+
+The reference downloads public corpora into ``~/.cache/paddle/dataset``.
+This environment has no network egress, so each dataset here has two
+tiers with the same record schema:
+
+1. **cached real data** — if the standard files exist under
+   ``$PADDLE_TPU_DATA_DIR`` (default ``~/.cache/paddle_tpu/dataset``),
+   they are parsed exactly like the reference's loaders;
+2. **deterministic synthetic data** — otherwise, records are generated
+   from a seeded RNG with class-conditional structure (so models
+   genuinely learn from them) and a loud one-time log line. Shapes,
+   dtypes, ranges, and reader protocol match tier 1.
+
+``download()`` therefore never fetches: it returns the cache path if
+present, else None.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from paddle_tpu.utils.log import get_logger
+
+logger = get_logger("dataset")
+
+DATA_HOME = os.environ.get(
+    "PADDLE_TPU_DATA_DIR",
+    os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu", "dataset"))
+
+_warned = set()
+
+
+def cache_path(module: str, filename: str) -> Optional[str]:
+    """Path of a cached real-data file, or None (triggers synthetic)."""
+    path = os.path.join(DATA_HOME, module, filename)
+    return path if os.path.exists(path) else None
+
+
+def download(url: str, module: str, md5sum: str = None) -> Optional[str]:
+    """Reference-compatible signature; zero-egress: cache hit or None."""
+    return cache_path(module, url.rsplit("/", 1)[-1])
+
+
+def note_synthetic(module: str):
+    if module not in _warned:
+        _warned.add(module)
+        logger.warning(
+            "dataset %r: no cached files under %s — serving deterministic "
+            "SYNTHETIC data with the same schema (drop the real files "
+            "there to train on the true corpus)", module,
+            os.path.join(DATA_HOME, module))

@@ -1,0 +1,103 @@
+"""IMDB sentiment (`python/paddle/v2/dataset/imdb.py`): records
+``(token_ids list[int], label 0|1)``."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.v2.dataset import common
+
+_VOCAB = 5000
+_TRAIN_N, _TEST_N = 4096, 1024
+
+
+def word_dict():
+    """token -> id, '<unk>' included as the last id (so
+    ``integer_value(len(word_dict()))`` always covers every emitted id).
+    Synthetic tier: ids name themselves."""
+    path = common.cache_path("imdb", "aclImdb_v1.tar.gz")
+    if path:
+        # real tier: build frequency dict from the tarball like the
+        # reference's build_dict
+        import collections
+        import re
+        import tarfile
+        counts = collections.Counter()
+        pat = re.compile(r"aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$")
+        with tarfile.open(path) as tar:
+            for member in tar.getmembers():
+                if pat.match(member.name):
+                    text = tar.extractfile(member).read().decode(
+                        "latin1").lower()
+                    counts.update(text.split())
+        words = [w for w, _ in counts.most_common(_VOCAB - 1)]
+        d = {w: i for i, w in enumerate(words)}
+    else:
+        d = {f"w{i}": i for i in range(_VOCAB - 1)}
+    d["<unk>"] = len(d)
+    return d
+
+
+def _synthetic_reader(n, seed):
+    """Sentiment signal: positive docs draw tokens from a 'positive'
+    unigram distribution, negative from a shifted one — linearly
+    separable but noisy, like real bag-of-words sentiment."""
+    common.note_synthetic("imdb")
+    proto = np.random.RandomState(11)
+    logits = proto.randn(2, _VOCAB) * 1.5
+
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            lab = int(rng.randint(2))
+            p = np.exp(logits[lab] - logits[lab].max())
+            p /= p.sum()
+            length = int(rng.randint(20, 120))
+            toks = rng.choice(_VOCAB, size=length, p=p)
+            yield [int(t) for t in toks], lab
+
+    return reader
+
+
+def _real_reader(split, word_idx=None):
+    import re
+    import tarfile
+    path = common.cache_path("imdb", "aclImdb_v1.tar.gz")
+    wd = word_idx if word_idx is not None else word_dict()
+    unk = wd.get("<unk>", len(wd) - 1)
+
+    def reader():
+        pat = re.compile(rf"aclImdb/{split}/((pos)|(neg))/.*\.txt$")
+        with tarfile.open(path) as tar:
+            for member in tar.getmembers():
+                m = pat.match(member.name)
+                if not m:
+                    continue
+                lab = 1 if "/pos/" in member.name else 0
+                text = tar.extractfile(member).read().decode(
+                    "latin1").lower()
+                yield [wd.get(w, unk) for w in text.split()], lab
+
+    return reader
+
+
+def _remap(reader_fn, vocab):
+    """Clamp synthetic ids into a caller-provided smaller vocab."""
+    def reader():
+        for toks, lab in reader_fn():
+            yield [t % vocab for t in toks], lab
+    return reader
+
+
+def train(word_idx=None):
+    if common.cache_path("imdb", "aclImdb_v1.tar.gz"):
+        return _real_reader("train", word_idx)
+    r = _synthetic_reader(_TRAIN_N, seed=0)
+    return _remap(r, len(word_idx)) if word_idx is not None else r
+
+
+def test(word_idx=None):
+    if common.cache_path("imdb", "aclImdb_v1.tar.gz"):
+        return _real_reader("test", word_idx)
+    r = _synthetic_reader(_TEST_N, seed=1)
+    return _remap(r, len(word_idx)) if word_idx is not None else r

@@ -1,0 +1,104 @@
+"""PTB language-model n-grams (`python/paddle/v2/dataset/imikolov.py`):
+records are n-gram tuples of token ids."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.v2.dataset import common
+
+_VOCAB = 2048
+_TRAIN_SENTS, _TEST_SENTS = 2048, 512
+
+
+def build_dict(min_word_freq: int = 50):
+    path = common.cache_path("imikolov", "simple-examples.tgz")
+    if path:
+        import collections
+        import tarfile
+        counts = collections.Counter()
+        with tarfile.open(path) as tar:
+            f = tar.extractfile(
+                "./simple-examples/data/ptb.train.txt")
+            for line in f.read().decode().splitlines():
+                counts.update(line.split())
+        words = [w for w, c in counts.items() if c >= min_word_freq]
+        d = {w: i for i, w in enumerate(sorted(words))}
+        d["<unk>"] = len(d)
+        return d
+    return {f"w{i}": i for i in range(_VOCAB)}
+
+
+def _synthetic_sentences(n_sents, seed):
+    """First-order Markov chain over the vocab — n-gram models can
+    genuinely reduce perplexity on it."""
+    common.note_synthetic("imikolov")
+    proto = np.random.RandomState(23)
+    # sparse-ish transition structure: each token prefers 8 successors
+    succ = proto.randint(0, _VOCAB, size=(_VOCAB, 8))
+
+    def gen():
+        rng = np.random.RandomState(seed)
+        for _ in range(n_sents):
+            length = int(rng.randint(5, 25))
+            sent = [int(rng.randint(_VOCAB))]
+            for _ in range(length - 1):
+                if rng.rand() < 0.8:
+                    sent.append(int(succ[sent[-1], rng.randint(8)]))
+                else:
+                    sent.append(int(rng.randint(_VOCAB)))
+            yield sent
+
+    return gen
+
+
+def _real_sentences(filename, word_idx=None):
+    import tarfile
+    path = common.cache_path("imikolov", "simple-examples.tgz")
+    d = word_idx if word_idx is not None else build_dict()
+    unk = d.get("<unk>", len(d) - 1)
+
+    def gen():
+        with tarfile.open(path) as tar:
+            f = tar.extractfile(f"./simple-examples/data/{filename}")
+            for line in f.read().decode().splitlines():
+                yield [d.get(w, unk) for w in line.split()]
+
+    return gen
+
+
+def _ngram_reader(sent_gen, n):
+    def reader():
+        for sent in sent_gen():
+            if len(sent) < n:
+                continue
+            for i in range(n, len(sent) + 1):
+                yield tuple(sent[i - n:i])
+
+    return reader
+
+
+def _clamped(sent_gen, vocab):
+    """Clamp synthetic ids into a caller-provided smaller vocab."""
+    def gen():
+        for sent in sent_gen():
+            yield [t % vocab for t in sent]
+    return gen
+
+
+def train(word_idx=None, n: int = 5):
+    if common.cache_path("imikolov", "simple-examples.tgz"):
+        return _ngram_reader(_real_sentences("ptb.train.txt", word_idx), n)
+    sents = _synthetic_sentences(_TRAIN_SENTS, 0)
+    if word_idx is not None:
+        sents = _clamped(sents, len(word_idx))
+    return _ngram_reader(sents, n)
+
+
+def test(word_idx=None, n: int = 5):
+    if common.cache_path("imikolov", "simple-examples.tgz"):
+        return _ngram_reader(_real_sentences("ptb.valid.txt", word_idx), n)
+    sents = _synthetic_sentences(_TEST_SENTS, 1)
+    if word_idx is not None:
+        sents = _clamped(sents, len(word_idx))
+    return _ngram_reader(sents, n)

@@ -1,0 +1,58 @@
+"""MNIST (`python/paddle/v2/dataset/mnist.py`): records
+``(image[784] float in [-1,1], label int)``."""
+
+from __future__ import annotations
+
+import gzip
+import struct
+
+import numpy as np
+
+from paddle_tpu.v2.dataset import common
+
+_TRAIN_N, _TEST_N = 8192, 2048  # synthetic sizes (real: 60000/10000)
+
+
+def _real_reader(images_path, labels_path):
+    def reader():
+        with gzip.open(images_path, "rb") as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            images = np.frombuffer(f.read(), np.uint8).reshape(n, rows * cols)
+        with gzip.open(labels_path, "rb") as f:
+            struct.unpack(">II", f.read(8))
+            labels = np.frombuffer(f.read(), np.uint8)
+        for img, lab in zip(images, labels):
+            yield img.astype(np.float32) / 127.5 - 1.0, int(lab)
+
+    return reader
+
+
+def _synthetic_reader(n, seed):
+    common.note_synthetic("mnist")
+    proto_rng = np.random.RandomState(42)
+    templates = proto_rng.randn(10, 784).astype(np.float32)
+
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            lab = int(rng.randint(10))
+            img = templates[lab] * 0.6 + rng.randn(784).astype(np.float32)
+            yield np.clip(img, -1.0, 1.0).astype(np.float32), lab
+
+    return reader
+
+
+def train():
+    imgs = common.cache_path("mnist", "train-images-idx3-ubyte.gz")
+    labs = common.cache_path("mnist", "train-labels-idx1-ubyte.gz")
+    if imgs and labs:
+        return _real_reader(imgs, labs)
+    return _synthetic_reader(_TRAIN_N, seed=0)
+
+
+def test():
+    imgs = common.cache_path("mnist", "t10k-images-idx3-ubyte.gz")
+    labs = common.cache_path("mnist", "t10k-labels-idx1-ubyte.gz")
+    if imgs and labs:
+        return _real_reader(imgs, labs)
+    return _synthetic_reader(_TEST_N, seed=1)

@@ -1,0 +1,53 @@
+"""UCI housing regression (`python/paddle/v2/dataset/uci_housing.py`):
+records ``(features[13] float normalized, [price] float)``."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.v2.dataset import common
+
+feature_names = ["CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE", "DIS",
+                 "RAD", "TAX", "PTRATIO", "B", "LSTAT"]
+
+_N = 506
+_SPLIT = 406  # reference uses an 80/20-ish train/test split
+
+
+def _load_real(path):
+    data = np.fromfile(path, sep=" ").reshape(-1, 14)
+    feats = data[:, :-1]
+    feats = (feats - feats.mean(axis=0)) / (feats.std(axis=0) + 1e-8)
+    return feats.astype(np.float32), data[:, -1].astype(np.float32)
+
+
+def _load_synthetic():
+    common.note_synthetic("uci_housing")
+    rng = np.random.RandomState(13)
+    X = rng.randn(_N, 13).astype(np.float32)
+    w = rng.randn(13).astype(np.float32)
+    y = X @ w * 3.0 + 22.5 + rng.randn(_N).astype(np.float32)
+    return X, y.astype(np.float32)
+
+
+def _data():
+    path = common.cache_path("uci_housing", "housing.data")
+    return _load_real(path) if path else _load_synthetic()
+
+
+def train():
+    def reader():
+        X, y = _data()
+        for i in range(_SPLIT):
+            yield X[i], [float(y[i])]
+
+    return reader
+
+
+def test():
+    def reader():
+        X, y = _data()
+        for i in range(_SPLIT, len(X)):
+            yield X[i], [float(y[i])]
+
+    return reader

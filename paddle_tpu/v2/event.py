@@ -1,0 +1,4 @@
+"""v2 events (`python/paddle/v2/event.py`)."""
+
+from paddle_tpu.trainer.events import (  # noqa: F401
+    BeginIteration, BeginPass, EndIteration, EndPass, Event, TestResult)

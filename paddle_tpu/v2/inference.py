@@ -1,0 +1,54 @@
+"""v2 inference (`python/paddle/v2/inference.py`): ``paddle.infer``."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Union
+
+import jax
+import numpy as np
+
+from paddle_tpu.config import dsl as _dsl
+from paddle_tpu.core.network import Network
+from paddle_tpu.data.feeder import DataFeeder
+
+
+class Inference:
+    def __init__(self, output_layer, parameters=None, graph=None):
+        outputs = output_layer if isinstance(output_layer, (list, tuple)) \
+            else [output_layer]
+        self.output_names = [o.name if hasattr(o, "name") else o
+                             for o in outputs]
+        self.network = Network(graph or _dsl.current_graph(),
+                               outputs=self.output_names)
+        if parameters is None:
+            # explicit Inference(None) is allowed for tests/untrained runs,
+            # but loudly: forgetting the checkpoint here would otherwise
+            # yield well-shaped garbage predictions
+            from paddle_tpu.utils.log import get_logger
+            get_logger("v2.inference").warning(
+                "Inference created WITHOUT parameters — using random "
+                "init; pass parameters= to predict with trained weights")
+            self.params = self.network.init_params(jax.random.PRNGKey(0))
+        elif hasattr(parameters, "_params"):  # v2 Parameters
+            self.params = {k: jax.numpy.asarray(v)
+                           for k, v in parameters._params.items()}
+        else:  # trainer or plain dict
+            src = getattr(parameters, "params", parameters)
+            self.params = dict(src)
+
+    def infer(self, input, *, feeding: Dict = None, field: str = "value"):
+        feeder = DataFeeder(feeding) if isinstance(feeding, dict) else feeding
+        feed = feeder(input) if feeder is not None else input
+        out = self.network.apply(self.params, feed, train=False)
+        results = [np.asarray(getattr(out[name], field))
+                   for name in self.output_names]
+        return results[0] if len(results) == 1 else results
+
+
+def infer(output_layer, *, parameters, input=None, feeding=None,
+          field: str = "value"):
+    """v2 ``paddle.infer``; ``parameters`` is required, as in the
+    reference (use Inference(..., parameters=None) explicitly to probe an
+    untrained network)."""
+    return Inference(output_layer, parameters).infer(
+        input, feeding=feeding, field=field)

@@ -1,0 +1,114 @@
+"""v2 layer namespace (`python/paddle/v2/layer.py`).
+
+The reference auto-wraps every v1 config helper into graph-object style;
+here the DSL (`paddle_tpu.config.dsl`) already IS graph-object style, so
+this module adapts only the v2-isms:
+
+- ``data(name=, type=paddle.data_type.X, height=, width=)``
+- activation/pooling OBJECTS (``act=paddle.activation.Relu()``)
+- v2 layer names (``img_conv``/``img_pool``/``max_id``/``cross_entropy_cost``…)
+
+Everything else passes straight through — ``paddle.layer.<anything>``
+resolves to the DSL function of the same name.
+"""
+
+from __future__ import annotations
+
+import functools
+
+from paddle_tpu.config import dsl as _dsl
+from paddle_tpu.v2 import activation as _act
+from paddle_tpu.v2 import pooling as _pool
+
+
+def _fix_kwargs(kwargs):
+    if "act" in kwargs:
+        kwargs["act"] = _act.resolve(kwargs["act"])
+    for k in ("gate_act", "state_act"):
+        if k in kwargs:
+            kwargs[k] = _act.resolve(kwargs[k])
+    if "pooling_type" in kwargs:
+        kwargs["pooling_type"] = _pool.resolve(kwargs["pooling_type"])
+    la = kwargs.get("layer_attr")
+    if la is not None and not isinstance(la, dict):
+        # ExtraAttr object → the dict form dsl accepts
+        d = dict(getattr(la, "kwargs", {}))
+        if getattr(la, "drop_rate", 0.0):
+            d["drop_rate"] = la.drop_rate
+        kwargs["layer_attr"] = d
+    return kwargs
+
+
+def _wrap(fn):
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        return fn(*args, **_fix_kwargs(kwargs))
+    return wrapped
+
+
+def data(*, name: str, type, height: int = None, width: int = None):
+    """v2 data layer: dims come from the data_type object."""
+    channels = None
+    if height and width and type.dim % (height * width) == 0:
+        channels = type.dim // (height * width)
+    from paddle_tpu.data.types import SEQUENCE
+    return _dsl.data(name=name, size=type.dim, height=height, width=width,
+                     channels=channels,
+                     is_sequence=type.seq_type >= SEQUENCE)
+
+
+def pooling(input, *, pooling_type=None, **kwargs):
+    return _dsl.pooling(input=input,
+                        pooling_type=_pool.resolve(pooling_type) or "max",
+                        **_fix_kwargs(kwargs))
+
+
+# v2 name → dsl name for the renamed ones (cost layers pass through:
+# dsl already exports square_error_cost/mse_cost/cross_entropy_cost)
+_ALIASES = {
+    "img_conv": "conv",
+    "img_pool": "img_pool",
+    "max_id": "maxid",
+    "crf": "crf_layer",
+    "crf_decoding": "crf_decoding_layer",
+    "ctc": "ctc_layer",
+    "warp_ctc": "warp_ctc_layer",
+    "eos": "eos_id_layer",
+    "sampling_id": "sampling_id_layer",
+    "clip": "clip_layer",
+    "resize": "resize_layer",
+    "rotate": "rotate_layer",
+    "pad": "pad_layer",
+    "crop": "crop_layer",
+    "power": "power_layer",
+    "prelu": "prelu_layer",
+    "maxout": "maxout_layer",
+    "multiplex": "multiplex_layer",
+    "tensor": "tensor_layer",
+    "selective_fc": "selective_fc_layer",
+    "block_expand": "block_expand_layer",
+    "sub_nested_seq": "sub_nested_seq_layer",
+    "get_output": "get_output_layer",
+    "gru_step": "gru_step_layer",
+    "lstm_step": "lstm_step_layer",
+    "nce": "nce_layer",
+    "row_conv": "row_conv_layer",
+    "conv_shift": "conv_shift_layer",
+    "bilinear_interp": "bilinear_interp_layer",
+    "mdlstm": "mdlstm_layer",
+    "priorbox": "priorbox_layer",
+    "multibox_loss": "multibox_loss_layer",
+    "detection_output": "detection_output_layer",
+    "print": "print_layer",
+}
+
+
+def __getattr__(name):
+    target = _ALIASES.get(name, name)
+    fn = getattr(_dsl, target, None)
+    if fn is None or not callable(fn):
+        raise AttributeError(f"paddle.layer.{name} (dsl has no '{target}')")
+    return _wrap(fn)
+
+
+LayerOutput = _dsl.LayerOutput

@@ -1,0 +1,13 @@
+"""v2 optimizer namespace (`python/paddle/v2/optimizer.py`): thin
+constructors over the optim package; regularization/model-average kwargs
+pass through."""
+
+from paddle_tpu.optim.optimizers import (  # noqa: F401
+    AdaDelta, AdaGrad, Adam, Adamax, DecayedAdaGrad, Momentum, Optimizer,
+    RMSProp)
+
+# v2 capitalization variants
+Adagrad = AdaGrad
+Adadelta = AdaDelta
+RMSProp = RMSProp
+AdamOptimizer = Adam

@@ -1,0 +1,97 @@
+"""v2 Parameters (`python/paddle/v2/parameters.py`): numpy get/set over
+the trainer's parameter dict + tar serialization.
+
+The tar layout mirrors the reference's ``to_tar`` (one raw-bytes member
+per parameter plus a small json header each) so checkpoints are
+inspectable with plain tar tools.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import tarfile
+from typing import Dict, Iterator
+
+import numpy as np
+
+
+class Parameters:
+    def __init__(self, params: Dict[str, np.ndarray] = None):
+        self._params: Dict[str, np.ndarray] = {
+            k: np.asarray(v) for k, v in (params or {}).items()}
+
+    @classmethod
+    def from_trainer(cls, trainer) -> "Parameters":
+        import jax
+        return cls({k: np.asarray(jax.device_get(v))
+                    for k, v in trainer.params.items()})
+
+    def install_into(self, trainer):
+        trainer.load_state(dict(self._params))
+
+    # ------------------------------------------------------------- dict
+    def names(self):
+        return list(self._params)
+
+    def keys(self):
+        return self._params.keys()
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._params)
+
+    def __contains__(self, name) -> bool:
+        return name in self._params
+
+    def __len__(self):
+        return len(self._params)
+
+    def get(self, name) -> np.ndarray:
+        return self._params[name]
+
+    __getitem__ = get
+
+    def set(self, name, value):
+        value = np.asarray(value)
+        if name in self._params and value.shape != self._params[name].shape:
+            raise ValueError(
+                f"shape mismatch for {name}: {value.shape} vs "
+                f"{self._params[name].shape}")
+        self._params[name] = value
+
+    __setitem__ = set
+
+    def get_shape(self, name):
+        return self._params[name].shape
+
+    # -------------------------------------------------------------- tar
+    def to_tar(self, f):
+        with tarfile.open(fileobj=f, mode="w") as tar:
+            for name, arr in self._params.items():
+                hdr = json.dumps({"shape": list(arr.shape),
+                                  "dtype": str(arr.dtype)}).encode()
+                info = tarfile.TarInfo(name=f"{name}.meta")
+                info.size = len(hdr)
+                tar.addfile(info, io.BytesIO(hdr))
+                raw = np.ascontiguousarray(arr).tobytes()
+                info = tarfile.TarInfo(name=name)
+                info.size = len(raw)
+                tar.addfile(info, io.BytesIO(raw))
+
+    @classmethod
+    def from_tar(cls, f) -> "Parameters":
+        params = {}
+        metas = {}
+        with tarfile.open(fileobj=f, mode="r") as tar:
+            for member in tar.getmembers():
+                data = tar.extractfile(member).read()
+                if member.name.endswith(".meta"):
+                    metas[member.name[:-5]] = json.loads(data.decode())
+                else:
+                    params[member.name] = data
+        out = {}
+        for name, raw in params.items():
+            meta = metas[name]
+            out[name] = np.frombuffer(
+                raw, dtype=np.dtype(meta["dtype"])).reshape(meta["shape"])
+        return cls(out)

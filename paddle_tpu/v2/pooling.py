@@ -1,0 +1,24 @@
+"""Pooling objects (`trainer_config_helpers/poolings.py`)."""
+
+
+class BasePool:
+    name = "max"
+
+    def __repr__(self):
+        return f"{type(self).__name__}()"
+
+
+def _make(cls_name, pool_name):
+    return type(cls_name, (BasePool,), {"name": pool_name})
+
+
+Max = _make("Max", "max")
+Avg = _make("Avg", "average")
+Sum = _make("Sum", "sum")
+SquareRootN = _make("SquareRootN", "sqrt")
+
+
+def resolve(p):
+    if p is None:
+        return None
+    return p if isinstance(p, str) else p.name

@@ -1,0 +1,30 @@
+"""v2 reader decorators (`python/paddle/v2/reader/decorator.py`)."""
+
+from paddle_tpu.data.reader import (  # noqa: F401
+    batch, buffered, chain, compose, firstn, map_readers, shuffle)
+
+
+class creator:
+    """Reader creators (`python/paddle/v2/reader/creator.py`)."""
+
+    @staticmethod
+    def np_array(x):
+        def reader():
+            yield from x
+        return reader
+
+    @staticmethod
+    def recordio(paths, shuffle=False, seed=0):
+        """Reader over native record-chunk files (the RecordIO role)."""
+        from paddle_tpu.data.recordio import pool_reader
+        if isinstance(paths, str):
+            paths = [paths]
+        return pool_reader(paths, shuffle=shuffle, seed=seed)
+
+    @staticmethod
+    def text_file(path):
+        def reader():
+            with open(path) as f:
+                for line in f:
+                    yield line.rstrip("\n")
+        return reader

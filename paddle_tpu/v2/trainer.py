@@ -1,0 +1,45 @@
+"""v2 trainer (`python/paddle/v2/trainer.py`): SGD with the v2 signature.
+
+``feeding`` accepts either {name: data_type} (builds a DataFeeder) or a
+ready DataFeeder. Reader items are sample tuples in feeding order, as in
+the reference's DataFeeder protocol.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from paddle_tpu.data.feeder import DataFeeder
+from paddle_tpu.data.types import InputType
+from paddle_tpu.trainer.trainer import SGD as _SGD
+from paddle_tpu.trainer.trainer import Topology  # noqa: F401
+
+
+class SGD(_SGD):
+    def __init__(self, cost, parameters=None, update_equation=None,
+                 **kwargs):
+        if hasattr(parameters, "_params"):  # v2 Parameters object
+            import jax.numpy as jnp
+            parameters = {k: jnp.asarray(v)
+                          for k, v in parameters._params.items()}
+        super().__init__(cost, parameters=parameters,
+                         update_equation=update_equation, **kwargs)
+
+    def train(self, reader, *, num_passes: int = 1, event_handler=None,
+              feeding=None, **kwargs):
+        feeder = feeding
+        if isinstance(feeding, dict):
+            if not all(isinstance(v, InputType) for v in feeding.values()):
+                raise TypeError(
+                    "feeding must map data-layer names to paddle.data_type "
+                    "objects (the index-based v2 form is not supported; "
+                    "order the reader columns by the feeding dict instead)")
+            feeder = DataFeeder(feeding)
+        return super().train(reader, feeder=feeder, num_passes=num_passes,
+                             event_handler=event_handler, **kwargs)
+
+    def test(self, reader, *, feeding=None, **kwargs):
+        feeder = feeding
+        if isinstance(feeding, dict):
+            feeder = DataFeeder(feeding)
+        return super().test(reader, feeder=feeder, **kwargs)

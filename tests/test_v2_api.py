@@ -1,0 +1,197 @@
+"""v2 user-API surface tests (`python/paddle/v2/tests` role): layer
+construction via activation/pooling objects, datasets, trainer facade,
+Parameters tar roundtrip, inference, and the @provider decorator."""
+
+import io
+
+import numpy as np
+import pytest
+
+import paddle_tpu.v2 as paddle
+from paddle_tpu.config import dsl
+from paddle_tpu.data.provider import CacheType, provider
+
+
+def _mlp():
+    dsl.reset()
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(8))
+    hid = paddle.layer.fc(input=x, size=16, act=paddle.activation.Relu())
+    out = paddle.layer.fc(input=hid, size=4,
+                          act=paddle.activation.Softmax())
+    lab = paddle.layer.data(name="label",
+                            type=paddle.data_type.integer_value(4))
+    return out, paddle.layer.classification_cost(input=out, label=lab)
+
+
+def _toy_reader(n=128, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 8).astype(np.float32)
+    Y = np.argmax(X[:, :4], axis=1)
+
+    def reader():
+        for i in range(n):
+            yield X[i], int(Y[i])
+
+    return reader
+
+
+_FEED = None  # set in tests
+
+
+def test_v2_train_infer_parameters_roundtrip():
+    out, cost = _mlp()
+    feeding = {"x": paddle.data_type.dense_vector(8),
+               "label": paddle.data_type.integer_value(4)}
+    tr = paddle.trainer.SGD(
+        cost=cost, parameters=None,
+        update_equation=paddle.optimizer.Momentum(learning_rate=0.1,
+                                                  momentum=0.9))
+    errs = []
+    tr.train(paddle.batch(_toy_reader(), 32), num_passes=4, feeding=feeding,
+             event_handler=lambda e: errs.append(
+                 e.evaluator["classification_error"])
+             if isinstance(e, paddle.event.EndPass) else None)
+    assert errs[-1] < errs[0]
+
+    params = paddle.Parameters.from_trainer(tr)
+    buf = io.BytesIO()
+    params.to_tar(buf)
+    buf.seek(0)
+    p2 = paddle.Parameters.from_tar(buf)
+    assert sorted(p2.names()) == sorted(params.names())
+    for n in params.names():
+        np.testing.assert_array_equal(params.get(n), p2.get(n))
+
+    sample = next(_toy_reader(n=1, seed=9)())
+    pred = paddle.infer(output_layer=out, parameters=p2,
+                        input=[(sample[0],)],
+                        feeding={"x": paddle.data_type.dense_vector(8)})
+    assert pred.shape == (1, 4)
+    np.testing.assert_allclose(pred.sum(), 1.0, rtol=1e-5)
+
+
+def test_v2_layer_aliases_resolve():
+    dsl.reset()
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(6))
+    assert paddle.layer.max_id(input=paddle.layer.fc(input=x, size=3)).name
+    with pytest.raises(AttributeError):
+        paddle.layer.definitely_not_a_layer
+
+
+def test_datasets_have_stable_schema():
+    img, lab = next(paddle.dataset.mnist.train()())
+    assert img.shape == (784,) and img.dtype == np.float32
+    assert isinstance(lab, int) and 0 <= lab < 10
+    img, lab = next(paddle.dataset.cifar.train10()())
+    assert img.shape == (3072,) and 0 <= lab < 10
+    feats, price = next(paddle.dataset.uci_housing.train()())
+    assert feats.shape == (13,) and len(price) == 1
+    toks, sentiment = next(paddle.dataset.imdb.train()())
+    assert all(isinstance(t, int) for t in toks) and sentiment in (0, 1)
+    gram = next(paddle.dataset.imikolov.train(n=5)())
+    assert len(gram) == 5
+    # determinism: two reads give identical first records
+    a = next(paddle.dataset.mnist.train()())
+    b = next(paddle.dataset.mnist.train()())
+    np.testing.assert_array_equal(a[0], b[0])
+
+
+def test_provider_decorator():
+    @provider(input_types={"text": paddle.data_type.integer_value_sequence(
+        100), "label": paddle.data_type.integer_value(2)},
+        should_shuffle=False)
+    def process(settings, filename):
+        base = int(filename)
+        for i in range(3):
+            yield {"text": [base + i, base + i + 1], "label": i % 2}
+
+    reader = process.as_reader(["10", "20"])
+    samples = list(reader())
+    assert len(samples) == 6
+    assert samples[0] == ([10, 11], 0)
+    assert samples[3][0] == [20, 21]
+    feeding = process.feeding()
+    assert set(feeding) == {"text", "label"}
+
+
+def test_provider_shuffle_and_cache():
+    calls = {"n": 0}
+
+    @provider(input_types={"v": paddle.data_type.integer_value(1000)},
+              should_shuffle=True, pool_size=8,
+              cache=CacheType.CACHE_PASS_IN_MEM)
+    def gen(settings, filename):
+        calls["n"] += 1
+        for i in range(32):
+            yield (i,)
+
+    reader = gen.as_reader(["f"], seed=3)
+    first = list(reader())
+    second = list(reader())
+    assert sorted(first) == sorted((i,) for i in range(32))
+    assert calls["n"] == 1  # second pass served from cache
+    assert first != [(i,) for i in range(32)]  # pooled shuffle permuted
+
+
+def test_provider_init_hook_sets_types():
+    def hook(settings, file_list, is_train, **kw):
+        settings.input_types = {"x": paddle.data_type.dense_vector(2)}
+
+    @provider(init_hook=hook, should_shuffle=False)
+    def gen(settings, filename):
+        yield ([0.0, 1.0],)
+
+    assert list(gen.as_reader(["f"])()) == [([0.0, 1.0],)]
+
+
+def test_all_aliases_resolve_and_cost_layers_exist():
+    from paddle_tpu.config import dsl as _dsl
+    from paddle_tpu.v2.layer import _ALIASES
+    for v2name in _ALIASES:
+        assert callable(getattr(paddle.layer, v2name))
+    for cost in ("square_error_cost", "mse_cost", "cross_entropy_cost",
+                 "classification_cost"):
+        assert callable(getattr(paddle.layer, cost))
+    # pooling objects all resolve to registry names the dsl accepts
+    dsl.reset()
+    seq = paddle.layer.data(
+        name="s", type=paddle.data_type.dense_vector_sequence(4))
+    for p in (paddle.pooling.Max(), paddle.pooling.Avg(),
+              paddle.pooling.Sum(), paddle.pooling.SquareRootN()):
+        paddle.layer.pooling(input=seq, pooling_type=p)
+
+
+def test_sgd_accepts_v2_parameters_object():
+    out, cost = _mlp()
+    tr = paddle.trainer.SGD(
+        cost=cost, parameters=None,
+        update_equation=paddle.optimizer.Momentum(learning_rate=0.1))
+    params = paddle.Parameters.from_trainer(tr)
+    out2, cost2 = _mlp()
+    tr2 = paddle.trainer.SGD(
+        cost=cost2, parameters=params,
+        update_equation=paddle.optimizer.Momentum(learning_rate=0.1))
+    for name in params.names():
+        np.testing.assert_array_equal(np.asarray(tr2.params[name]),
+                                      params.get(name))
+
+
+def test_layer_attr_dict_and_extraattr_apply_dropout():
+    dsl.reset()
+    x = paddle.layer.data(name="x", type=paddle.data_type.dense_vector(4))
+    ld = paddle.layer.fc(input=x, size=8, layer_attr={"drop_rate": 0.5})
+    assert dsl.current_graph().layers[ld.name].drop_rate == 0.5
+    ld2 = paddle.layer.fc(input=x, size=8,
+                          layer_attr=paddle.attr.ExtraAttr(drop_rate=0.25))
+    assert dsl.current_graph().layers[ld2.name].drop_rate == 0.25
+
+
+def test_imdb_word_idx_respected_and_in_range():
+    wd = paddle.dataset.imdb.word_dict()
+    assert "<unk>" in wd
+    n = len(wd)
+    toks, _ = next(paddle.dataset.imdb.train(word_idx=wd)())
+    assert all(0 <= t < n for t in toks)
+    small = {f"w{i}": i for i in range(50)}
+    toks, _ = next(paddle.dataset.imdb.train(word_idx=small)())
+    assert all(0 <= t < 50 for t in toks)
