@@ -1,0 +1,39 @@
+"""C inference API (role of `paddle/capi`): see include/paddle_tpu_capi.h.
+
+``build_library()`` compiles the shim with the host toolchain +
+python3-config embed flags; returns the .so path (cached)."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sysconfig
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "src", "capi.cc")
+_SO = os.path.join(_DIR, "libpaddle_tpu_capi.so")
+
+
+def _python_flags():
+    inc = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR")
+    ver = sysconfig.get_config_var("LDVERSION")
+    return ([f"-I{inc}"], [f"-L{libdir}", f"-lpython{ver}"], libdir)
+
+
+def build_library(force: bool = False) -> str:
+    if (not force and os.path.exists(_SO)
+            and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
+        return _SO
+    incs, libs, libdir = _python_flags()
+    cmd = (["g++", "-O2", "-shared", "-fPIC", "-std=c++17"] + incs
+           + ["-o", _SO + ".tmp", _SRC] + libs
+           + [f"-Wl,-rpath,{libdir}"])
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+    except subprocess.CalledProcessError as e:
+        raise RuntimeError(
+            "capi shim build failed:\n" + e.stderr.decode(errors="replace")
+        ) from e
+    os.replace(_SO + ".tmp", _SO)
+    return _SO

@@ -1,0 +1,62 @@
+"""Python host for the C inference API (imported by src/capi.cc).
+
+Holds loaded merged models and their jitted inference functions; the C
+shim marshals float buffers in/out as bytes. Kept free of module-level
+jax work so embedding stays cheap until the first load.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, Optional
+
+_models: Dict[int, dict] = {}
+_next_id = 0
+
+
+def load(path: str) -> int:
+    """Load a merged model; returns a handle."""
+    global _next_id
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.network import Network
+    from paddle_tpu.trainer.merge_model import load_merged
+
+    graph, params, outputs = load_merged(path)
+    net = Network(graph, outputs=outputs)
+    data_layers = [name for name, ld in graph.layers.items()
+                   if ld.type == "data"]
+    mid = _next_id
+    _next_id += 1
+    _models[mid] = {
+        "net": net,
+        "params": {k: jnp.asarray(v) for k, v in params.items()},
+        "outputs": outputs,
+        "data_layers": data_layers,
+    }
+    return mid
+
+
+def infer_raw(mid: int, input_name: Optional[str], payload: bytes,
+              batch: int, dim: int):
+    """float32 little-endian (batch, dim) buffer -> (bytes, rows, cols)
+    of the first output."""
+    import numpy as np
+
+    from paddle_tpu.core.argument import Argument
+    import jax.numpy as jnp
+
+    m = _models[mid]
+    if input_name is None:
+        input_name = m["data_layers"][0]
+    x = np.frombuffer(payload, dtype="<f4").reshape(batch, dim)
+    feed = {input_name: Argument(value=jnp.asarray(x))}
+    out = m["net"].apply(m["params"], feed, train=False)
+    val = np.asarray(out[m["outputs"][0]].value, dtype="<f4")
+    if val.ndim == 1:
+        val = val[:, None]
+    return val.tobytes(), int(val.shape[0]), int(val.shape[1])
+
+
+def release(mid: int):
+    _models.pop(mid, None)

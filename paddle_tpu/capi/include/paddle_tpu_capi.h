@@ -1,0 +1,48 @@
+/* C inference API (role of paddle/capi/gradient_machine.h:36-86):
+ * embed the TPU inference engine in C/C++ deployments.
+ *
+ * Usage:
+ *   ptc_init(NULL);
+ *   void* m = ptc_load("model.ptmodel");          // merged model file
+ *   float out[10]; int rows, cols;
+ *   ptc_infer(m, NULL, input, 1, 784, out, 10, &rows, &cols);
+ *   ptc_release(m); ptc_shutdown();
+ *
+ * All functions return 0 on success (or a handle), negative on error;
+ * ptc_last_error() describes the most recent failure. Thread-safe for
+ * one interpreter: calls serialize on the GIL. The engine executes on
+ * whatever accelerator JAX selects (TPU when present).
+ */
+
+#ifndef PADDLE_TPU_CAPI_H
+#define PADDLE_TPU_CAPI_H
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Start the embedded runtime. python_home may be NULL. */
+int ptc_init(const char* python_home);
+
+/* Load a merged model (trainer --job=merge artifact). NULL on error. */
+void* ptc_load(const char* model_path);
+
+/* Run inference: batch x dim floats for input layer `input_name`
+ * (NULL = the model's first data layer). Writes up to out_cap floats,
+ * sets *out_rows/*out_cols. Returns 0, or -1 (error) / -2 (out_cap too
+ * small; *out_rows x *out_cols tells the needed size). */
+int ptc_infer(void* model, const char* input_name, const float* data,
+              int batch, int dim, float* out, int out_cap,
+              int* out_rows, int* out_cols);
+
+void ptc_release(void* model);
+
+const char* ptc_last_error(void);
+
+int ptc_shutdown(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* PADDLE_TPU_CAPI_H */

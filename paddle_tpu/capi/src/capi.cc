@@ -1,0 +1,151 @@
+// C inference API implementation: embeds CPython and drives
+// paddle_tpu.capi.host (which holds the jitted inference functions).
+// The reference's capi runs its C++ engine in-process
+// (paddle/capi/gradient_machine.h); here the engine is JAX, so the shim
+// hosts the interpreter — same deployment story (link one .so, call C
+// functions), TPU execution underneath.
+//
+// Marshalling deliberately avoids the numpy C ABI: buffers cross the
+// boundary as Python bytes (PyBytes_FromStringAndSize / memcpy out).
+
+#include <Python.h>
+
+#include <cstring>
+#include <mutex>
+#include <string>
+
+#include "../include/paddle_tpu_capi.h"
+
+namespace {
+
+std::mutex g_mu;
+std::string g_error;
+bool g_inited = false;
+PyObject* g_host = nullptr;  // paddle_tpu.capi.host module
+
+void set_error_from_python() {
+  PyObject *type, *value, *trace;
+  PyErr_Fetch(&type, &value, &trace);
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    g_error = s ? PyUnicode_AsUTF8(s) : "unknown python error";
+    Py_XDECREF(s);
+  } else {
+    g_error = "unknown error";
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(trace);
+}
+
+struct Gil {
+  PyGILState_STATE st;
+  Gil() : st(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(st); }
+};
+
+}  // namespace
+
+extern "C" {
+
+int ptc_init(const char* python_home) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (g_inited) return 0;
+  if (python_home != nullptr) {
+    static std::wstring home;
+    home.assign(python_home, python_home + strlen(python_home));
+    Py_SetPythonHome(const_cast<wchar_t*>(home.c_str()));
+  }
+  Py_InitializeEx(0);
+  g_host = PyImport_ImportModule("paddle_tpu.capi.host");
+  if (g_host == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  // release the GIL acquired by Py_Initialize so Gil{} works later
+  PyEval_SaveThread();
+  g_inited = true;
+  return 0;
+}
+
+void* ptc_load(const char* model_path) {
+  if (!g_inited) {
+    g_error = "ptc_init not called";
+    return nullptr;
+  }
+  Gil gil;
+  PyObject* r = PyObject_CallMethod(g_host, "load", "s", model_path);
+  if (r == nullptr) {
+    set_error_from_python();
+    return nullptr;
+  }
+  long long handle = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return reinterpret_cast<void*>(static_cast<intptr_t>(handle + 1));
+}
+
+int ptc_infer(void* model, const char* input_name, const float* data,
+              int batch, int dim, float* out, int out_cap,
+              int* out_rows, int* out_cols) {
+  if (!g_inited) {
+    g_error = "ptc_init not called";
+    return -1;
+  }
+  Gil gil;
+  long long handle =
+      static_cast<long long>(reinterpret_cast<intptr_t>(model)) - 1;
+  PyObject* bytes = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(data),
+      static_cast<Py_ssize_t>(batch) * dim * sizeof(float));
+  if (bytes == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject* r = PyObject_CallMethod(
+      g_host, "infer_raw", "LzOii", handle, input_name, bytes, batch, dim);
+  Py_DECREF(bytes);
+  if (r == nullptr) {
+    set_error_from_python();
+    return -1;
+  }
+  // (bytes, rows, cols)
+  PyObject* payload = PyTuple_GetItem(r, 0);
+  *out_rows = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 1)));
+  *out_cols = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 2)));
+  Py_ssize_t n = PyBytes_Size(payload);
+  if (n > static_cast<Py_ssize_t>(out_cap) * sizeof(float)) {
+    Py_DECREF(r);
+    g_error = "output buffer too small";
+    return -2;
+  }
+  memcpy(out, PyBytes_AsString(payload), n);
+  Py_DECREF(r);
+  return 0;
+}
+
+void ptc_release(void* model) {
+  if (!g_inited) return;
+  Gil gil;
+  long long handle =
+      static_cast<long long>(reinterpret_cast<intptr_t>(model)) - 1;
+  PyObject* r = PyObject_CallMethod(g_host, "release", "L", handle);
+  if (r == nullptr) {
+    set_error_from_python();
+  }
+  Py_XDECREF(r);
+}
+
+const char* ptc_last_error(void) { return g_error.c_str(); }
+
+int ptc_shutdown(void) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (!g_inited) return 0;
+  PyGILState_Ensure();
+  Py_XDECREF(g_host);
+  g_host = nullptr;
+  int rc = Py_FinalizeEx();
+  g_inited = false;
+  return rc;
+}
+
+}  // extern "C"

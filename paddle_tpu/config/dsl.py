@@ -46,6 +46,9 @@ def _auto_name(type_name: str) -> str:
 class LayerOutput:
     name: str
     size: int
+    # the graph this layer belongs to, so consumers (Inference, Topology)
+    # keep working after dsl.reset() starts a new one
+    graph: Any = dataclasses.field(default=None, repr=False, compare=False)
 
     def __repr__(self):
         return f"LayerOutput({self.name!r}, size={self.size})"
@@ -67,7 +70,7 @@ def _add(ldef: LayerDef) -> LayerOutput:
         infos.append(_shape_of(n))
     info = get_layer_impl(ldef.type).infer(ldef, infos)
     _SHAPES[ldef.name] = info
-    return LayerOutput(ldef.name, info.size)
+    return LayerOutput(ldef.name, info.size, graph=_GRAPH)
 
 
 _SHAPES: Dict[str, Any] = {}

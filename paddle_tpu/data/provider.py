@@ -76,11 +76,16 @@ class DataProvider:
         shuffle = (self.should_shuffle if self.should_shuffle is not None
                    else is_train)
 
+        # cache key includes the reader's settings: the same file yields
+        # different samples under e.g. is_train-dependent augmentation
+        ck = (is_train, repr(sorted(hook_kwargs.items())))
+
         def iter_samples():
             for fname in files:
+                key = (fname, ck)
                 if (self.cache == CacheType.CACHE_PASS_IN_MEM
-                        and fname in self._cache_store):
-                    yield from self._cache_store[fname]
+                        and key in self._cache_store):
+                    yield from self._cache_store[key]
                     continue
                 collected = [] if self.cache else None
                 for sample in (self.generator(settings, fname)
@@ -91,7 +96,7 @@ class DataProvider:
                         collected.append(sample)
                     yield sample
                 if collected is not None:
-                    self._cache_store[fname] = collected
+                    self._cache_store[key] = collected
 
         def reader():
             if not shuffle:

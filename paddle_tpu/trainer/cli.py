@@ -1,0 +1,303 @@
+"""The ``paddle_tpu_trainer`` command (`paddle/trainer/TrainerMain.cpp`).
+
+``python -m paddle_tpu.trainer.cli --config=model.py --job=train ...``
+
+Job modes mirror the reference trainer:
+- ``train``      — the training loop (+ checkpointing into --save_dir)
+- ``test``       — one evaluation pass over the test reader
+- ``time``       — steady-state ms/batch benchmark, skipping warmup
+                   (`Trainer::time`, `TrainerBenchmark.cpp:27`)
+- ``checkgrad``  — numeric-vs-analytic gradient check on one batch
+                   (`Trainer::checkGradient`, `Trainer.cpp:299+`)
+- ``merge``      — fuse config+params into one deploy file
+                   (`MergeModel.cpp`)
+
+The --config file is executed as Python (the reference's embedded-Python
+`parse_config` contract, `TrainerConfigHelper.cpp:33-57`): it builds the
+model with ``paddle_tpu.config.dsl`` or the v2 layer API and must define
+``cost`` (a LayerOutput); optionally ``optimizer``, ``train_reader``,
+``test_reader``, ``feeding`` (dict name->data_type), ``outputs``
+(inference layers). ``--config_args a=1,b=x`` are injected as variables
+before execution, exactly like the reference flag.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from typing import Optional
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu_trainer",
+        description="TPU trainer (paddle_trainer equivalent)")
+    p.add_argument("--config", required=True,
+                   help="Python model-config file (executed)")
+    p.add_argument("--job", default="train",
+                   choices=["train", "test", "time", "checkgrad", "merge"])
+    p.add_argument("--config_args", default="",
+                   help="comma-separated k=v injected into the config")
+    p.add_argument("--num_passes", type=int, default=1)
+    p.add_argument("--log_period", type=int, default=100)
+    p.add_argument("--save_dir", default=None,
+                   help="checkpoint directory (train) / source (test,merge)")
+    p.add_argument("--saving_period", type=int, default=1)
+    p.add_argument("--saving_period_by_batches", type=int, default=None)
+    p.add_argument("--init_model_path", default=None,
+                   help="checkpoint file or merged model to start from")
+    p.add_argument("--model_path", default=None,
+                   help="output path for --job=merge")
+    p.add_argument("--test_period", type=int, default=0,
+                   help="run the test reader every N passes during train")
+    p.add_argument("--trainer_count", type=int, default=1,
+                   help=">1 builds a data-parallel mesh over that many "
+                        "devices")
+    p.add_argument("--use_gpu", default=None,
+                   help="accepted for compatibility; device choice is "
+                        "JAX's")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--time_batches", type=int, default=20,
+                   help="--job=time: timed batches after warmup")
+    p.add_argument("--time_warmup", type=int, default=3)
+    return p.parse_args(argv)
+
+
+def load_config(path: str, config_args: str = ""):
+    """Execute the config file; returns its namespace."""
+    from paddle_tpu.config import dsl
+    dsl.reset()
+    ns = {"__file__": os.path.abspath(path), "__name__": "__paddle_config__"}
+    for kv in filter(None, config_args.split(",")):
+        k, _, v = kv.partition("=")
+        try:
+            ns[k] = int(v)
+        except ValueError:
+            try:
+                ns[k] = float(v)
+            except ValueError:
+                ns[k] = v
+    with open(path) as f:
+        code = compile(f.read(), path, "exec")
+    exec(code, ns)
+    if "cost" not in ns:
+        raise SystemExit(f"config {path} must define `cost`")
+    return ns
+
+
+def _build_trainer(ns, args):
+    from paddle_tpu.optim.optimizers import Momentum
+    from paddle_tpu.trainer.trainer import SGD
+    mesh = None
+    if args.trainer_count > 1:
+        from paddle_tpu.parallel import create_mesh
+        mesh = create_mesh(n_data=args.trainer_count)
+    optimizer = ns.get("optimizer") or Momentum(learning_rate=0.01,
+                                                momentum=0.9)
+    trainer = SGD(cost=ns["cost"], update_equation=optimizer, mesh=mesh,
+                  seed=args.seed)
+    if args.init_model_path:
+        _init_params(trainer, args.init_model_path)
+    return trainer
+
+
+def _init_params(trainer, path):
+    if path.endswith(".ptmodel"):
+        from paddle_tpu.trainer.merge_model import load_merged
+        _, params, _ = load_merged(path)
+        trainer.load_state(params)
+    else:
+        from paddle_tpu.trainer.checkpoint import load_params
+        params, opt_flat = load_params(path)
+        trainer.load_state(params, opt_flat)
+
+
+def _feeder(ns):
+    from paddle_tpu.data.feeder import DataFeeder
+    feeding = ns.get("feeding")
+    return DataFeeder(feeding) if isinstance(feeding, dict) else feeding
+
+
+def cmd_train(ns, args):
+    from paddle_tpu.trainer import events as ev
+    trainer = _build_trainer(ns, args)
+    reader = ns.get("train_reader")
+    if reader is None:
+        raise SystemExit("config must define `train_reader` for --job=train")
+    ck = None
+    if args.save_dir:
+        from paddle_tpu.dist.checkpoint import Checkpointer
+        ck = Checkpointer(args.save_dir, saving_period=args.saving_period,
+                          saving_period_by_batches=(
+                              args.saving_period_by_batches))
+
+    test_reader = ns.get("test_reader")
+    feeder = _feeder(ns)
+
+    def handler(e):
+        if isinstance(e, ev.EndPass):
+            print(f"Pass {e.pass_id}: " + " ".join(
+                f"{k}={v:.5g}" for k, v in e.evaluator.items()))
+            if (test_reader is not None and args.test_period
+                    and (e.pass_id + 1) % args.test_period == 0):
+                res = trainer.test(test_reader, feeder=feeder)
+                print(f"  Test: cost={res.cost:.5g} " + " ".join(
+                    f"{k}={v:.5g}" for k, v in res.evaluator.items()))
+
+    trainer.train(reader, feeder=feeder, num_passes=args.num_passes,
+                  event_handler=handler, log_period=args.log_period,
+                  checkpointer=ck)
+    return 0
+
+
+def cmd_test(ns, args):
+    trainer = _build_trainer(ns, args)
+    if not args.init_model_path and args.save_dir:
+        from paddle_tpu.dist.checkpoint import Checkpointer
+        restored = Checkpointer(args.save_dir).restore()
+        if restored:
+            trainer.load_state(restored[0], restored[1])
+    reader = ns.get("test_reader") or ns.get("train_reader")
+    res = trainer.test(reader, feeder=_feeder(ns))
+    print(f"Test: cost={res.cost:.5g} " + " ".join(
+        f"{k}={v:.5g}" for k, v in res.evaluator.items()))
+    return 0
+
+
+def cmd_time(ns, args):
+    """`paddle_trainer --job=time`: steady-state batch latency. Batches
+    whose shapes differ from the first (e.g. a smaller final partial
+    batch) are excluded — their jit recompile would otherwise put XLA
+    compile time inside the timed window."""
+    trainer = _build_trainer(ns, args)
+    reader = ns.get("train_reader")
+    if reader is None:
+        raise SystemExit("config must define `train_reader` for --job=time")
+    feeder = _feeder(ns)
+    want = args.time_warmup + args.time_batches
+    batches = []
+    while len(batches) < want:
+        before = len(batches)
+        for data in reader():
+            batches.append(data)
+            if len(batches) >= want:
+                break
+        if len(batches) == before:
+            break  # reader is empty/exhausted; time what we have
+    if not batches:
+        raise SystemExit("train_reader produced no batches")
+    import jax
+    import jax.numpy as jnp
+
+    def shape_sig(feed):
+        return tuple(sorted((k, v.value.shape) for k, v in feed.items()))
+
+    times = []
+    sig0 = None
+    for i, data in enumerate(batches):
+        feed = feeder(data) if feeder is not None else data
+        sig = shape_sig(feed)
+        sig0 = sig0 or sig
+        trainer._rng, step_rng = jax.random.split(trainer._rng)
+        t0 = time.perf_counter()
+        trainer.params, trainer.opt_state, metrics = trainer._train_step(
+            trainer.params, trainer.opt_state, feed, step_rng, jnp.int32(0))
+        jax.block_until_ready(metrics["cost"])
+        dt = time.perf_counter() - t0
+        if i >= args.time_warmup and sig == sig0:
+            times.append(dt)
+    if not times:
+        raise SystemExit("no steady-state batches to time (all warmup or "
+                         "shape-mismatched)")
+    ms = 1e3 * sum(times) / len(times)
+    print(f"TimeInfo: avg_batch_time={ms:.3f}ms over {len(times)} batches "
+          f"(skipped {args.time_warmup} warmup)")
+    return 0
+
+
+def cmd_checkgrad(ns, args, *, epsilon=1e-3, rtol=5e-2, samples=6):
+    """Numeric gradient check on one batch (`Trainer::checkGradient`).
+    rtol is loose relative to the reference's double-precision check:
+    the engine computes in float32, so the central difference itself
+    carries ~1e-2 relative noise."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    trainer = _build_trainer(ns, args)
+    reader = ns.get("train_reader")
+    feeder = _feeder(ns)
+    data = next(iter(reader()))
+    feed = feeder(data) if feeder is not None else data
+    network, cost_name = trainer.network, trainer.topology.cost_name
+
+    @jax.jit
+    def loss_fn(params):
+        out = network.apply(params, feed, train=False)
+        return jnp.sum(out[cost_name].value) / out[cost_name].value.shape[0]
+
+    analytic = jax.jit(jax.grad(loss_fn))(trainer.params)
+    rng = np.random.RandomState(args.seed)
+    worst = 0.0
+    failed = []
+    for name, g in analytic.items():
+        if trainer.network.param_specs[name].is_static:
+            continue
+        p0 = np.asarray(trainer.params[name], dtype=np.float64)
+        for idx in rng.choice(p0.size, size=min(samples, p0.size),
+                              replace=False):
+            delta = np.zeros(p0.size)
+            delta[idx] = epsilon
+            delta = delta.reshape(p0.shape)
+            pp = dict(trainer.params)
+            pp[name] = jnp.asarray(p0 + delta, jnp.float32)
+            pm = dict(trainer.params)
+            pm[name] = jnp.asarray(p0 - delta, jnp.float32)
+            num = (float(loss_fn(pp)) - float(loss_fn(pm))) / (2 * epsilon)
+            ana = float(np.asarray(g).reshape(-1)[idx])
+            denom = max(abs(num), abs(ana), 1e-4)
+            rel = abs(num - ana) / denom
+            worst = max(worst, rel)
+            if rel > rtol:
+                failed.append((name, int(idx), num, ana))
+    if failed:
+        for name, idx, num, ana in failed[:10]:
+            print(f"FAIL {name}[{idx}]: numeric={num:.6g} "
+                  f"analytic={ana:.6g}")
+        print(f"checkgrad FAILED ({len(failed)} mismatches, "
+              f"worst rel err {worst:.3g})")
+        return 1
+    print(f"checkgrad PASSED (worst rel err {worst:.3g})")
+    return 0
+
+
+def cmd_merge(ns, args):
+    from paddle_tpu.config import dsl
+    from paddle_tpu.trainer.merge_model import merge_model
+    trainer = _build_trainer(ns, args)
+    if not args.init_model_path and args.save_dir:
+        from paddle_tpu.dist.checkpoint import Checkpointer
+        restored = Checkpointer(args.save_dir).restore()
+        if restored:
+            trainer.load_state(restored[0], restored[1])
+    out_path = args.model_path or "model.ptmodel"
+    outputs = ns.get("outputs")
+    names = ([o.name if hasattr(o, "name") else o for o in outputs]
+             if outputs else [ns["cost"].name])
+    merge_model(out_path, trainer.topology.graph, trainer.params,
+                outputs=names)
+    print(f"merged model written to {out_path}")
+    return 0
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    ns = load_config(args.config, args.config_args)
+    return {"train": cmd_train, "test": cmd_test, "time": cmd_time,
+            "checkgrad": cmd_checkgrad, "merge": cmd_merge}[args.job](
+                ns, args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,54 @@
+"""Deploy-time model merging (`paddle/trainer/MergeModel.cpp`).
+
+Fuses the model graph (the ModelDef that plays ModelConfig's role) and
+trained parameters into ONE integrity-checked file for deployment — the
+artifact the C inference API loads (`paddle/capi`), and what
+`python/paddle/utils/merge_model.py` produced for v2 users.
+
+Format: ``b"PTM1" + md5(payload)[16 bytes] + pickle(payload)`` where
+payload = {"graph": ModelDef, "params": {name: np.ndarray},
+"outputs": [names]}.
+
+SECURITY: the MD5 gives *integrity* (torn-file detection), not
+*authenticity* — the payload is a pickle, so ``load_merged`` (and the C
+API's ``ptc_load``) must only be given model files from trusted sources,
+exactly like any pickle-based checkpoint format.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import pickle
+from typing import Dict, List, Optional
+
+import numpy as np
+
+_MAGIC = b"PTM1"
+
+
+def merge_model(path: str, graph, params: Dict[str, np.ndarray],
+                outputs: Optional[List[str]] = None):
+    import jax
+    payload = pickle.dumps({
+        "graph": graph,
+        "params": {k: np.asarray(jax.device_get(v))
+                   for k, v in params.items()},
+        "outputs": list(outputs or graph.output_layer_names or []),
+    }, protocol=pickle.HIGHEST_PROTOCOL)
+    with open(path, "wb") as f:
+        f.write(_MAGIC + hashlib.md5(payload).digest() + payload)
+
+
+def load_merged(path: str):
+    """-> (graph, params, output_names); raises on corruption.
+    Only load files from trusted sources (pickle payload — see module
+    docstring)."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    if raw[:4] != _MAGIC:
+        raise IOError(f"{path}: not a merged model (bad magic)")
+    digest, payload = raw[4:20], raw[20:]
+    if hashlib.md5(payload).digest() != digest:
+        raise IOError(f"{path}: merged model failed MD5 integrity check")
+    data = pickle.loads(payload)
+    return data["graph"], data["params"], data["outputs"]

@@ -42,7 +42,10 @@ class Topology:
 
     def __init__(self, cost, extra_outputs: Optional[List] = None,
                  graph: Optional[ModelDef] = None):
-        graph = graph or _dsl.current_graph()
+        if graph is None:
+            # prefer the graph the cost layer was built in (stays correct
+            # after dsl.reset() begins another model)
+            graph = getattr(cost, "graph", None) or _dsl.current_graph()
         names = [c.name if hasattr(c, "name") else c
                  for c in ([cost] + list(extra_outputs or []))]
         self.cost_name = names[0]

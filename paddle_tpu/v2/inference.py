@@ -18,6 +18,11 @@ class Inference:
             else [output_layer]
         self.output_names = [o.name if hasattr(o, "name") else o
                              for o in outputs]
+        if graph is None:
+            # prefer the graph the layer was built in — the global graph
+            # may already describe a different model after dsl.reset()
+            graph = next((o.graph for o in outputs
+                          if getattr(o, "graph", None) is not None), None)
         self.network = Network(graph or _dsl.current_graph(),
                                outputs=self.output_names)
         if parameters is None:

@@ -9,5 +9,5 @@ from paddle_tpu.optim.optimizers import (  # noqa: F401
 # v2 capitalization variants
 Adagrad = AdaGrad
 Adadelta = AdaDelta
-RMSProp = RMSProp
+RMSprop = RMSProp
 AdamOptimizer = Adam

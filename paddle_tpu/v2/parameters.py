@@ -92,6 +92,9 @@ class Parameters:
         out = {}
         for name, raw in params.items():
             meta = metas[name]
+            # copy: frombuffer views over the tar bytes are read-only,
+            # but Parameters are mutable (set()/in-place edits)
             out[name] = np.frombuffer(
-                raw, dtype=np.dtype(meta["dtype"])).reshape(meta["shape"])
+                raw, dtype=np.dtype(meta["dtype"])
+            ).reshape(meta["shape"]).copy()
         return cls(out)

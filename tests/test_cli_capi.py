@@ -1,0 +1,180 @@
+"""Trainer CLI + merged-model + C inference API tests.
+
+CLI mirrors `paddle/trainer/tests/test_Trainer.cpp` (run a real config a
+pass, assert cost) and `--job=checkgrad/time` modes; the capi test
+compiles and runs an actual C program against the shim, the analogue of
+`paddle/capi/tests`.
+"""
+
+import ctypes
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from paddle_tpu.trainer import cli
+
+CONFIG = textwrap.dedent("""
+    import numpy as np
+    from paddle_tpu.config import dsl
+    from paddle_tpu.data.types import dense_vector, integer_value
+    from paddle_tpu.optim import Momentum
+
+    x = dsl.data(name="x", size=8)
+    lab = dsl.data(name="label", size=4)
+    hid = dsl.fc(input=x, size=16, act="relu")
+    out = dsl.fc(input=hid, size=4, act="softmax")
+    cost = dsl.classification_cost(input=out, label=lab)
+    outputs = [out]
+    optimizer = Momentum(learning_rate=lr, momentum=0.9)
+    feeding = {"x": dense_vector(8), "label": integer_value(4)}
+
+    _rng = np.random.RandomState(0)
+    _X = _rng.randn(128, 8).astype(np.float32)
+    _Y = np.argmax(_X[:, :4], axis=1)
+
+    def train_reader():
+        for i in range(0, 128, 32):
+            yield [(_X[j], int(_Y[j])) for j in range(i, i + 32)]
+
+    test_reader = train_reader
+""")
+
+
+@pytest.fixture()
+def config_file(tmp_path):
+    path = tmp_path / "conf.py"
+    path.write_text(CONFIG)
+    return str(path)
+
+
+def test_cli_train_test_merge(config_file, tmp_path, capsys):
+    save = str(tmp_path / "ckpt")
+    rc = cli.main(["--config", config_file, "--config_args", "lr=0.1",
+                   "--job", "train", "--num_passes", "4",
+                   "--save_dir", save, "--log_period", "0"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Pass 3:" in out
+    rc = cli.main(["--config", config_file, "--config_args", "lr=0.1",
+                   "--job", "test", "--save_dir", save])
+    assert rc == 0
+    assert "Test: cost=" in capsys.readouterr().out
+    model = str(tmp_path / "m.ptmodel")
+    rc = cli.main(["--config", config_file, "--config_args", "lr=0.1",
+                   "--job", "merge", "--save_dir", save,
+                   "--model_path", model])
+    assert rc == 0 and os.path.exists(model)
+    # merged model loads and predicts
+    from paddle_tpu.capi import host
+    mid = host.load(model)
+    x = np.zeros((2, 8), dtype="<f4")
+    payload, rows, cols = host.infer_raw(mid, None, x.tobytes(), 2, 8)
+    assert (rows, cols) == (2, 4)
+    probs = np.frombuffer(payload, "<f4").reshape(2, 4)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, rtol=1e-5)
+    host.release(mid)
+
+
+def test_cli_checkgrad(config_file, capsys):
+    rc = cli.main(["--config", config_file, "--config_args", "lr=0.1",
+                   "--job", "checkgrad"])
+    assert rc == 0
+    assert "checkgrad PASSED" in capsys.readouterr().out
+
+
+def test_cli_time(config_file, capsys):
+    rc = cli.main(["--config", config_file, "--config_args", "lr=0.1",
+                   "--job", "time", "--time_batches", "3",
+                   "--time_warmup", "1"])
+    assert rc == 0
+    assert "avg_batch_time=" in capsys.readouterr().out
+
+
+def test_capi_from_c_program(config_file, tmp_path):
+    """Compile a real C program against the shim and run inference."""
+    from paddle_tpu import capi
+    save = str(tmp_path / "ckpt")
+    model = str(tmp_path / "m.ptmodel")
+    assert cli.main(["--config", config_file, "--config_args", "lr=0.1",
+                     "--job", "train", "--num_passes", "1",
+                     "--save_dir", save, "--log_period", "0"]) == 0
+    assert cli.main(["--config", config_file, "--config_args", "lr=0.1",
+                     "--job", "merge", "--save_dir", save,
+                     "--model_path", model]) == 0
+    so = capi.build_library()
+
+    c_src = tmp_path / "main.c"
+    c_src.write_text(textwrap.dedent("""
+        #include <stdio.h>
+        #include "paddle_tpu_capi.h"
+        int main(int argc, char** argv) {
+            if (ptc_init(NULL) != 0) {
+                fprintf(stderr, "init: %s\\n", ptc_last_error());
+                return 1;
+            }
+            void* m = ptc_load(argv[1]);
+            if (!m) {
+                fprintf(stderr, "load: %s\\n", ptc_last_error());
+                return 2;
+            }
+            float in[16]; int i;
+            for (i = 0; i < 16; i++) in[i] = 0.25f * (i % 5);
+            float out[8]; int rows, cols;
+            if (ptc_infer(m, "x", in, 2, 8, out, 8, &rows, &cols) != 0) {
+                fprintf(stderr, "infer: %s\\n", ptc_last_error());
+                return 3;
+            }
+            printf("rows=%d cols=%d\\n", rows, cols);
+            float s = 0; for (i = 0; i < cols; i++) s += out[i];
+            printf("row0_sum=%.4f\\n", s);
+            ptc_release(m);
+            return 0;
+        }
+    """))
+    exe = str(tmp_path / "capi_demo")
+    inc = os.path.join(os.path.dirname(capi.__file__), "include")
+    subprocess.run(["gcc", "-o", exe, str(c_src), f"-I{inc}", so,
+                    f"-Wl,-rpath,{os.path.dirname(so)}"],
+                   check=True, capture_output=True)
+    # embedders provide the package path via PYTHONPATH (the shim doesn't
+    # assume a venv)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pypath = ":".join([repo_root]
+                      + [p for p in sys.path if "site-packages" in p])
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=pypath)
+    res = subprocess.run([exe, model], capture_output=True, text=True,
+                         timeout=300, env=env)
+    assert res.returncode == 0, res.stderr
+    assert "rows=2 cols=4" in res.stdout
+    row0_sum = float(res.stdout.split("row0_sum=")[1].split()[0])
+    assert abs(row0_sum - 1.0) < 1e-3  # softmax row sums to 1
+
+
+def test_inference_uses_layer_graph_after_reset():
+    """Layers remember their graph: inference on model A keeps working
+    after dsl.reset() started building model B."""
+    import paddle_tpu.v2 as paddle
+    from paddle_tpu.config import dsl
+    dsl.reset()
+    a_in = paddle.layer.data(name="xa",
+                             type=paddle.data_type.dense_vector(4))
+    a_out = paddle.layer.fc(input=a_in, size=3,
+                            act=paddle.activation.Softmax())
+    tr = paddle.trainer.SGD(
+        cost=paddle.layer.classification_cost(
+            input=a_out, label=paddle.layer.data(
+                name="la", type=paddle.data_type.integer_value(3))),
+        update_equation=paddle.optimizer.Momentum(learning_rate=0.1))
+    params = paddle.Parameters.from_trainer(tr)
+    # now a different model occupies the global graph
+    dsl.reset()
+    paddle.layer.data(name="other", type=paddle.data_type.dense_vector(7))
+    pred = paddle.infer(
+        output_layer=a_out, parameters=params,
+        input=[([0.1, 0.2, 0.3, 0.4],)],
+        feeding={"xa": paddle.data_type.dense_vector(4)})
+    assert pred.shape == (1, 3)
