@@ -28,7 +28,9 @@ void set_error_from_python() {
   PyErr_Fetch(&type, &value, &trace);
   if (value != nullptr) {
     PyObject* s = PyObject_Str(value);
-    g_error = s ? PyUnicode_AsUTF8(s) : "unknown python error";
+    const char* msg = s ? PyUnicode_AsUTF8(s) : nullptr;
+    g_error = msg ? msg : "unknown python error";
+    PyErr_Clear();  // PyUnicode_AsUTF8 may itself have raised
     Py_XDECREF(s);
   } else {
     g_error = "unknown error";
@@ -108,11 +110,31 @@ int ptc_infer(void* model, const char* input_name, const float* data,
     set_error_from_python();
     return -1;
   }
-  // (bytes, rows, cols)
+  // (bytes, rows, cols) — validate before converting so a misbehaving
+  // host function sets g_error instead of crashing the embedder
+  if (!PyTuple_Check(r) || PyTuple_Size(r) != 3 ||
+      !PyBytes_Check(PyTuple_GetItem(r, 0))) {
+    Py_DECREF(r);
+    g_error = "infer_raw returned malformed result (want (bytes, rows, cols))";
+    return -1;
+  }
   PyObject* payload = PyTuple_GetItem(r, 0);
-  *out_rows = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 1)));
-  *out_cols = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(r, 2)));
+  long rows = PyLong_AsLong(PyTuple_GetItem(r, 1));
+  long cols = PyLong_AsLong(PyTuple_GetItem(r, 2));
+  if (PyErr_Occurred()) {
+    Py_DECREF(r);
+    set_error_from_python();
+    return -1;
+  }
   Py_ssize_t n = PyBytes_Size(payload);
+  if (rows < 0 || cols < 0 ||
+      static_cast<Py_ssize_t>(rows) * cols * sizeof(float) != n) {
+    Py_DECREF(r);
+    g_error = "infer_raw returned inconsistent rows/cols vs payload size";
+    return -1;
+  }
+  *out_rows = static_cast<int>(rows);
+  *out_cols = static_cast<int>(cols);
   if (n > static_cast<Py_ssize_t>(out_cap) * sizeof(float)) {
     Py_DECREF(r);
     g_error = "output buffer too small";

@@ -320,6 +320,14 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return buf
 
 
+# Explicit RPC surface — only these service methods are reachable over the
+# socket (anything else, including non-callable attributes, is rejected).
+RPC_METHODS = frozenset({
+    "set_dataset", "get_task", "task_finished", "task_failed",
+    "pass_finished", "request_save_model",
+})
+
+
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
         svc: MasterService = self.server.service  # type: ignore
@@ -329,9 +337,9 @@ class _Handler(socketserver.BaseRequestHandler):
                 method = req["method"]
                 kwargs = req.get("kwargs", {})
                 try:
+                    if method not in RPC_METHODS:
+                        raise ValueError(f"unknown RPC method: {method!r}")
                     fn = getattr(svc, method)
-                    if method.startswith("_"):
-                        raise AttributeError(method)
                     result = fn(**kwargs)
                     _send_msg(self.request, {"ok": True, "result": result})
                 except Exception as e:  # report, keep serving
@@ -438,12 +446,22 @@ def master_reader(client: MasterClient, load_chunk, *,
     `python/paddle/v2/master/client.py` role): pulls tasks, yields records
     from ``load_chunk(chunk)``, reports finish/failure. Each call of the
     returned reader streams one full pass; the pass counter advances
-    across calls (the StartGetRecords(pass) protocol)."""
+    across calls (the StartGetRecords(pass) protocol).
+
+    The returned reader declares ``pass_aware = True``: the trainer calls
+    it as ``reader(pass_id)`` so a checkpoint-resumed run requests the
+    right pass from the master instead of getting an instant 'end' for
+    already-finished ones. Caveat (shared with the reference): within a
+    pass the master does not re-serve tasks already finished, so a
+    mid-pass checkpoint restored against a persistent master resumes with
+    only that pass's *remaining* tasks — records between the checkpoint
+    and the crash are trained at-least-once only across passes, not
+    within the interrupted one."""
     state = {"pass_id": 0}
 
-    def reader():
-        my_pass = state["pass_id"]
-        state["pass_id"] += 1
+    def reader(pass_id: Optional[int] = None):
+        my_pass = state["pass_id"] if pass_id is None else pass_id
+        state["pass_id"] = my_pass + 1
         while True:
             status, task = client.get_task(my_pass)
             if status == "end":
@@ -463,4 +481,5 @@ def master_reader(client: MasterClient, load_chunk, *,
             else:
                 client.task_finished(task.id)
 
+    reader.pass_aware = True
     return reader

@@ -36,6 +36,16 @@ from paddle_tpu.trainer.evaluators import Accumulator, classification_error
 _CLASSIFICATION_COSTS = {"multi-class-cross-entropy"}
 
 
+def _call_reader(reader, pass_id: int):
+    """Invoke a per-pass reader. Readers that declare ``pass_aware = True``
+    (``dist.master.master_reader``) receive the trainer's pass_id so a
+    checkpoint-resumed run requests the correct pass from the master
+    instead of getting an instant 'end' for already-finished ones."""
+    if getattr(reader, "pass_aware", False):
+        return reader(pass_id)
+    return reader()
+
+
 class Topology:
     """cost LayerOutput -> executable Network (``python/paddle/v2/
     topology.py:44``)."""
@@ -163,7 +173,9 @@ class SGD:
                     # mid-pass (batch-cadence) checkpoint: restart that
                     # pass from its beginning so no batch goes untrained
                     # (early batches re-train — at-least-once, like the
-                    # master's task requeue)
+                    # master's task requeue). With a pass-aware master
+                    # reader only the pass's *unfinished* tasks replay —
+                    # see the caveat on dist.master.master_reader.
                     start_pass = pid
         event_handler = event_handler or (lambda e: None)
         acc = Accumulator()
@@ -171,7 +183,7 @@ class SGD:
             event_handler(ev.BeginPass(pass_id))
             acc.reset()
             window_cost, window_n = 0.0, 0
-            for batch_id, data in enumerate(reader()):
+            for batch_id, data in enumerate(_call_reader(reader, pass_id)):
                 event_handler(ev.BeginIteration(pass_id, batch_id))
                 with timer("prepareBatchData"):
                     feed = feeder(data) if feeder is not None else data
@@ -218,6 +230,13 @@ class SGD:
                 return jax.device_put(arr, old.sharding)
             return arr
 
+        missing = sorted(set(self.params) - set(params))
+        unknown = sorted(set(params) - set(self.params))
+        if missing or unknown:
+            raise ValueError(
+                "restored checkpoint does not match the model's parameters"
+                + (f"; missing: {missing}" if missing else "")
+                + (f"; unknown: {unknown}" if unknown else ""))
         self.params = {k: place(v, self.params[k]) for k, v in params.items()}
 
         if opt_flat:

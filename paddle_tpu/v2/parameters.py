@@ -1,9 +1,12 @@
 """v2 Parameters (`python/paddle/v2/parameters.py`): numpy get/set over
 the trainer's parameter dict + tar serialization.
 
-The tar layout mirrors the reference's ``to_tar`` (one raw-bytes member
-per parameter plus a small json header each) so checkpoints are
-inspectable with plain tar tools.
+The tar layout is inspectable-but-NOT-interchangeable with the
+reference's: one raw-bytes member per parameter plus a json
+``<name>.meta`` member each (the reference instead writes a
+binary-headed value member plus a ``<name>.protobuf`` config). A tar
+produced by the reference cannot be loaded here and vice versa;
+``from_tar`` raises a clear error when a member lacks its ``.meta``.
 """
 
 from __future__ import annotations
@@ -91,6 +94,11 @@ class Parameters:
                     params[member.name] = data
         out = {}
         for name, raw in params.items():
+            if name not in metas:
+                raise ValueError(
+                    f"tar member {name!r} has no companion '{name}.meta' — "
+                    "this tar was not written by Parameters.to_tar (the "
+                    "reference's to_tar layout is not interchangeable)")
             meta = metas[name]
             # copy: frombuffer views over the tar bytes are read-only,
             # but Parameters are mutable (set()/in-place edits)

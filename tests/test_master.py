@@ -265,3 +265,55 @@ def test_restore_skips_torn_npz_without_meta(tmp_path):
     restored = ck.restore()
     assert restored is not None
     assert restored[2]["pass_id"] == 0
+
+
+def test_reader_resume_at_start_pass():
+    """A checkpoint-resumed trainer (start_pass>0) must not see an
+    immediate 'end' from a fresh reader whose private counter is 0
+    (ADVICE r1): the trainer passes pass_id into pass-aware readers."""
+    from paddle_tpu.trainer.trainer import _call_reader
+
+    svc = MasterService(chunks_per_task=1)
+    server = MasterServer(svc).start()
+    chunks = [[i] for i in range(4)]
+    try:
+        # pass 0 trained before "the crash"
+        c0 = MasterClient(server.addr)
+        c0.set_dataset(chunks)
+        assert sorted(master_reader(c0, lambda c: c)()) == [0, 1, 2, 3]
+
+        # resumed process: brand-new client+reader, trainer resumes pass 1
+        c1 = MasterClient(server.addr, trainer_id="resumed")
+        r = master_reader(c1, lambda c: c)
+        got = sorted(_call_reader(r, 1))
+        assert got == [0, 1, 2, 3]  # not the empty 'end' of pass 0
+        assert svc.cur_pass == 1
+        # next trainer pass continues from the synced counter
+        got2 = sorted(r())
+        assert got2 == [0, 1, 2, 3]
+        assert svc.cur_pass == 2
+    finally:
+        server.stop()
+
+
+def test_call_reader_plain_readers_unaffected():
+    from paddle_tpu.trainer.trainer import _call_reader
+
+    def plain():
+        yield from [1, 2]
+
+    assert list(_call_reader(plain, 5)) == [1, 2]
+    assert list(_call_reader(lambda: iter([3]), 7)) == [3]
+
+
+def test_rpc_rejects_unknown_methods():
+    svc = MasterService()
+    server = MasterServer(svc).start()
+    try:
+        c = MasterClient(server.addr)
+        with pytest.raises(RuntimeError, match="unknown RPC method"):
+            c.call("_snapshot")
+        with pytest.raises(RuntimeError, match="unknown RPC method"):
+            c.call("cur_pass")  # non-callable attribute: also rejected
+    finally:
+        server.stop()
