@@ -1,0 +1,26 @@
+"""The 8-schema protobuf contract (reference `proto/` directory).
+
+These messages are the canonical model/job description: the config
+compiler (`paddle_tpu.compat.config_parser`) emits them, the lowering pass
+(`paddle_tpu.compat.lowering`) turns ``ModelConfig`` into the executable
+graph, and serialized configs interoperate with the reference's wire
+format (same fields and tags). Regenerate with ``gen.sh`` after editing
+``defs/*.proto``.
+"""
+
+from .DataConfig_pb2 import DataConfig, FileGroupConf  # noqa: F401
+from .DataFormat_pb2 import (DataHeader, DataSample, SlotDef,  # noqa: F401
+                             SubseqSlot, VectorSlot)
+from .ModelConfig_pb2 import (EvaluatorConfig, LayerConfig,  # noqa: F401
+                              LayerInputConfig, ModelConfig,
+                              ProjectionConfig, OperatorConfig,
+                              SubModelConfig)
+from .OptimizerConfig_pb2 import OptimizerConfig  # noqa: F401
+from .ParameterConfig_pb2 import (ParameterConfig,  # noqa: F401
+                                  ParameterUpdaterHookConfig)
+from .ParameterServerConfig_pb2 import (ParameterClientConfig,  # noqa: F401
+                                        ParameterServerConfig)
+from .ParameterService_pb2 import (SendParameterRequest,  # noqa: F401
+                                   SendParameterResponse)
+from .TrainerConfig_pb2 import (OptimizationConfig,  # noqa: F401
+                                TrainerConfig)

@@ -1,0 +1,15 @@
+#!/bin/sh
+# Regenerate the protobuf Python modules from defs/. Run from this dir.
+set -e
+cd "$(dirname "$0")"
+protoc -I defs --python_out=. defs/*.proto
+# gencode imports siblings absolutely ("import X_pb2"); rewrite to relative
+# imports so the package works without sys.path games.
+python - <<'EOF'
+import pathlib, re
+for p in pathlib.Path('.').glob('*_pb2.py'):
+    src = p.read_text()
+    src = re.sub(r'^import (\w+_pb2) as', r'from . import \1 as', src,
+                 flags=re.M)
+    p.write_text(src)
+EOF

@@ -1,0 +1,177 @@
+"""Wire-format parity of the vendored proto contract.
+
+Compiles the reference's schemas (`/root/reference/proto/*.proto`) with
+protoc into a FileDescriptorSet, loads them into a *private* descriptor
+pool (the default pool already holds our same-named files), and checks
+that messages serialized by our gencode parse identically under the
+reference schema and vice versa — the contract that makes
+reference-produced configs interoperable.
+"""
+
+import pathlib
+import shutil
+import subprocess
+
+import pytest
+from google.protobuf import descriptor_pb2, descriptor_pool, message_factory
+
+REF_PROTO = pathlib.Path("/root/reference/proto")
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("protoc") is None or not REF_PROTO.is_dir(),
+    reason="needs protoc + the reference schemas")
+
+
+@pytest.fixture(scope="module")
+def ref_msgs(tmp_path_factory):
+    out = tmp_path_factory.mktemp("refpb") / "ref.desc"
+    protos = sorted(REF_PROTO.glob("*.proto"))
+    subprocess.run(
+        ["protoc", f"-I{REF_PROTO}", "-o", str(out), "--include_imports"]
+        + [str(p) for p in protos], check=True)
+    fds = descriptor_pb2.FileDescriptorSet()
+    fds.ParseFromString(out.read_bytes())
+    pool = descriptor_pool.DescriptorPool()
+    for f in fds.file:
+        pool.Add(f)
+
+    def cls(name):
+        return message_factory.GetMessageClass(
+            pool.FindMessageTypeByName(f"paddle.{name}"))
+
+    return cls
+
+
+def _fill_model(m):
+    m.type = "nn"
+    lay = m.layers.add()
+    lay.name = "img"
+    lay.type = "data"
+    lay.size = 784
+    fc = m.layers.add()
+    fc.name = "fc1"
+    fc.type = "fc"
+    fc.size = 128
+    fc.active_type = "relu"
+    inp = fc.inputs.add()
+    inp.input_layer_name = "img"
+    inp.input_parameter_name = "w1"
+    p = m.parameters.add()
+    p.name = "w1"
+    p.size = 784 * 128
+    p.initial_std = 0.05
+    p.dims.extend([784, 128])
+    m.input_layer_names.append("img")
+    m.output_layer_names.append("fc1")
+
+
+def test_model_config_cross_parse(ref_msgs):
+    from paddle_tpu import proto
+    ours = proto.ModelConfig()
+    _fill_model(ours)
+    theirs = ref_msgs("ModelConfig")()
+    _fill_model(theirs)
+    assert ours.SerializeToString(deterministic=True) == \
+        theirs.SerializeToString(deterministic=True)
+    # cross-parse: reference-schema bytes into our gencode
+    back = proto.ModelConfig()
+    back.ParseFromString(theirs.SerializeToString())
+    assert back.layers[1].active_type == "relu"
+    assert list(back.parameters[0].dims) == [784, 128]
+
+
+def test_trainer_config_cross_parse(ref_msgs):
+    from paddle_tpu import proto
+
+    def fill(tc):
+        tc.opt_config.batch_size = 128
+        tc.opt_config.algorithm = "sgd"
+        tc.opt_config.learning_rate = 0.01
+        tc.opt_config.learning_method = "adam"
+        tc.opt_config.adam_beta1 = 0.95
+        tc.save_dir = "./out"
+
+    ours, theirs = proto.TrainerConfig(), ref_msgs("TrainerConfig")()
+    fill(ours)
+    fill(theirs)
+    assert ours.SerializeToString(deterministic=True) == \
+        theirs.SerializeToString(deterministic=True)
+
+
+def test_defaults_match_reference(ref_msgs):
+    """Spot-check defaults that the config compiler relies on."""
+    from paddle_tpu import proto
+    ours, theirs = proto.ParameterConfig(), ref_msgs("ParameterConfig")()
+    for f in ["learning_rate", "momentum", "initial_mean", "initial_std",
+              "decay_rate", "initial_strategy", "initial_smart",
+              "num_batches_regularization", "is_sparse", "is_static"]:
+        assert getattr(ours, f) == getattr(theirs, f), f
+    o2, t2 = proto.OptimizationConfig(), ref_msgs("OptimizationConfig")()
+    for f in ["algorithm", "learning_rate_schedule", "learning_method",
+              "average_window", "adam_beta1", "adam_beta2", "adam_epsilon",
+              "gradient_clipping_threshold", "l1weight", "l2weight"]:
+        assert getattr(o2, f) == getattr(t2, f), f
+    lo, lt = proto.LayerConfig(), ref_msgs("LayerConfig")()
+    for f in ["shared_biases", "device", "reversed", "num_neg_samples",
+              "coeff", "trans_type", "moving_average_fraction", "blank",
+              "seq_pool_stride", "axis"]:
+        assert getattr(lo, f) == getattr(lt, f), f
+
+
+def test_every_reference_field_exists(ref_msgs, tmp_path):
+    """Field-by-field schema audit: every field of every reference message
+    exists in ours with the same number, type, label, and default."""
+    import paddle_tpu
+    our_desc = tmp_path / "ours.desc"
+    defs = pathlib.Path(paddle_tpu.__file__).parent / "proto" / "defs"
+    subprocess.run(
+        ["protoc", f"-I{defs}", "-o", str(our_desc), "--include_imports"]
+        + [str(p) for p in sorted(defs.glob("*.proto"))], check=True)
+    fds = descriptor_pb2.FileDescriptorSet()
+    fds.ParseFromString(our_desc.read_bytes())
+    our_pool = descriptor_pool.DescriptorPool()
+    for f in fds.file:
+        our_pool.Add(f)
+
+    ref_set = tmp_path / "ref.desc"
+    subprocess.run(
+        ["protoc", f"-I{REF_PROTO}", "-o", str(ref_set), "--include_imports"]
+        + [str(p) for p in sorted(REF_PROTO.glob("*.proto"))], check=True)
+    ref_fds = descriptor_pb2.FileDescriptorSet()
+    ref_fds.ParseFromString(ref_set.read_bytes())
+
+    checked = 0
+    for f in ref_fds.file:
+        for msg in f.message_type:
+            ours = our_pool.FindMessageTypeByName(f"paddle.{msg.name}")
+            our_fields = {fl.number: fl for fl in ours.fields}
+            for fl in msg.field:
+                assert fl.number in our_fields, \
+                    f"{msg.name}.{fl.name} (#{fl.number}) missing"
+                o = our_fields[fl.number]
+                assert o.name == fl.name, (msg.name, fl.name, o.name)
+                assert o.type == fl.type, (msg.name, fl.name)
+                assert o.label == fl.label, (msg.name, fl.name)
+                if fl.HasField("default_value"):
+                    if o.enum_type is not None:
+                        got = o.enum_type.values_by_number[
+                            o.default_value].name
+                    else:
+                        got = str(o.default_value)
+                    assert got in (
+                        fl.default_value,
+                        str(fl.default_value),
+                        # bools/numbers stringify differently
+                        str(fl.default_value).capitalize(),
+                    ) or float_eq(o.default_value, fl.default_value), \
+                        (msg.name, fl.name, o.default_value,
+                         fl.default_value)
+                checked += 1
+    assert checked > 200  # the contract is nontrivial
+
+
+def float_eq(a, b):
+    try:
+        return abs(float(a) - float(b)) < 1e-12
+    except (TypeError, ValueError):
+        return False
