@@ -1,0 +1,51 @@
+"""v1 compatibility surface: the reference's Python config stack.
+
+Provides importable equivalents of ``paddle.trainer_config_helpers`` and
+``paddle.trainer`` (`python/paddle/trainer_config_helpers/*`,
+`python/paddle/trainer/config_parser.py:3704`) so reference v1 configs run
+unmodified. ``install_paddle_alias()`` registers ``sys.modules`` entries
+for the ``paddle.*`` names those configs import; ``parse_config`` executes
+a config file and returns the canonical protos + the executable graph.
+"""
+
+import sys
+import types
+
+
+def install_paddle_alias():
+    """Make ``import paddle.trainer_config_helpers`` etc. resolve to this
+    package (the reference embeds Python and imports its own `paddle`;
+    here the alias plays that role). Idempotent; returns the root module."""
+    if "paddle" in sys.modules and getattr(
+            sys.modules["paddle"], "__is_paddle_tpu_compat__", False):
+        return sys.modules["paddle"]
+
+    from paddle_tpu.compat import config_parser, data_sources, pydp2
+    from paddle_tpu.compat import trainer_config_helpers as tch
+
+    root = types.ModuleType("paddle")
+    root.__is_paddle_tpu_compat__ = True
+    trainer = types.ModuleType("paddle.trainer")
+    trainer.config_parser = config_parser
+    trainer.PyDataProvider2 = pydp2
+    root.trainer = trainer
+    root.trainer_config_helpers = tch
+    root.proto = __import__("paddle_tpu.proto", fromlist=["proto"])
+
+    sys.modules["paddle"] = root
+    sys.modules["paddle.trainer"] = trainer
+    sys.modules["paddle.trainer.config_parser"] = config_parser
+    sys.modules["paddle.trainer.PyDataProvider2"] = pydp2
+    sys.modules["paddle.trainer_config_helpers"] = tch
+    for sub in ["layers", "networks", "optimizers", "activations",
+                "attrs", "poolings", "evaluators", "data_sources",
+                "config_parser_utils"]:
+        mod = getattr(tch, sub, None)
+        if mod is not None:
+            sys.modules[f"paddle.trainer_config_helpers.{sub}"] = mod
+    sys.modules["paddle.proto"] = root.proto
+    return root
+
+
+from paddle_tpu.compat.config_parser import (parse_config,  # noqa: E402,F401
+                                             parse_config_and_serialize)

@@ -1,0 +1,275 @@
+"""The config compiler: v1 Python DSL -> canonical protos + executable graph.
+
+Role of the reference's ``python/paddle/trainer/config_parser.py:3704``
+(``parse_config`` / ``parse_config_and_serialize``), re-implemented for the
+TPU engine: helper calls (paddle_tpu.compat.trainer_config_helpers) build
+the graph through the native DSL (paddle_tpu.config.dsl) while this module
+holds the per-parse global state — settings, data sources, declared
+inputs/outputs, evaluators, name counters — and assembles the final
+``TrainerConfig`` proto (paddle_tpu.proto) with the ``ModelConfig``
+exported from the graph.
+
+The reference executes the config inside an embedded interpreter
+(``TrainerConfigHelper.cpp:33-57``); here ``parse_config`` execs it in a
+namespace where ``paddle.*`` resolves to the compat package, including
+Python-2 era builtins (``xrange``) so 2017-vintage configs run unmodified.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+from paddle_tpu.config import dsl
+from paddle_tpu.config.model_config import ModelDef
+
+
+@dataclasses.dataclass
+class DataSource:
+    """One define_py_data_sources2 stream."""
+
+    file_list: Optional[str]
+    module: Optional[str]
+    obj: Optional[str]
+    args: Any = None
+
+
+class ConfigContext:
+    """Per-parse global state (the reference's config_parser module
+    globals, reset at the top of every parse_config call)."""
+
+    def __init__(self, config_args: Optional[Dict[str, Any]] = None):
+        self.config_args = dict(config_args or {})
+        self.settings: Dict[str, Any] = {
+            "batch_size": None,
+            "learning_rate": None,
+            "learning_method": None,
+            "regularization": None,
+            "gradient_clipping_threshold": 0.0,
+            "model_average": None,
+            "learning_rate_decay_a": 0.0,
+            "learning_rate_decay_b": 0.0,
+            "learning_rate_schedule": "constant",
+            "learning_rate_args": "",
+            "algorithm": "sgd",
+            "async_lagged_grad_discard_ratio": 1.5,
+        }
+        self.train_source: Optional[DataSource] = None
+        self.test_source: Optional[DataSource] = None
+        self.input_layer_names: List[str] = []
+        self.output_layer_names: List[str] = []
+        self.evaluators: List[Dict[str, Any]] = []
+        self._counters: Dict[str, itertools.count] = {}
+        self.config_dir: Optional[str] = None
+
+    def auto_name(self, prefix: str) -> str:
+        c = self._counters.setdefault(prefix, itertools.count())
+        return f"__{prefix}_{next(c)}__"
+
+
+_CTX: Optional[ConfigContext] = None
+
+
+def ctx() -> ConfigContext:
+    if _CTX is None:
+        raise RuntimeError(
+            "no active config parse — call parse_config(), or "
+            "begin_parse() when building configs programmatically")
+    return _CTX
+
+
+def begin_parse(config_args: Optional[Dict[str, Any]] = None
+                ) -> ConfigContext:
+    """Reset all per-parse state and open a fresh context."""
+    global _CTX
+    dsl.reset()
+    _CTX = ConfigContext(config_args)
+    return _CTX
+
+
+def get_config_arg(name: str, type_: type = str, default: Any = None):
+    """Read a --config_args value with a type and default
+    (``config_parser.py get_config_arg``)."""
+    value = ctx().config_args.get(name, default)
+    if value is None:
+        return None
+    if type_ is bool and isinstance(value, str):
+        return value.lower() not in ("false", "0", "")
+    return type_(value)
+
+
+def inputs(*layers):
+    """Declare data-provider stream order (``@config_func inputs``)."""
+    names = [l.name if hasattr(l, "name") else str(l) for l in layers]
+    ctx().input_layer_names = names
+
+
+def outputs(*layers):
+    """Declare network outputs (costs when training)."""
+    names = [l.name if hasattr(l, "name") else str(l) for l in layers]
+    c = ctx()
+    c.output_layer_names = names
+    graph = dsl.current_graph()
+    graph.output_layer_names = names
+
+
+# cost layer types whose output drives the training objective (subset of
+# the reference's Layer config classes flagged as cost layers)
+COST_TYPES = {
+    "multi-class-cross-entropy", "mse", "square_error",
+    "cross-entropy", "multi_binary_label_cross_entropy", "rank-cost",
+    "lambda_cost", "huber", "soft_binary_class_cross_entropy",
+    "cross-entropy-with-selfnorm", "sum_cost", "smooth_l1", "ctc",
+    "warp_ctc", "crf", "nce", "hsigmoid", "multibox_loss",
+}
+
+
+@dataclasses.dataclass
+class ParsedConfig:
+    """What parse_config returns: the executable pieces + the protos."""
+
+    model: ModelDef
+    context: ConfigContext
+    namespace: Dict[str, Any]
+
+    # ------------------------------------------------------- executables
+    def cost_layers(self) -> List[str]:
+        return [n for n in self.context.output_layer_names
+                if self.model.layers[n].type in COST_TYPES]
+
+    def optimizer(self):
+        """Build the paddle_tpu Optimizer the settings() call described."""
+        from paddle_tpu.compat.trainer_config_helpers.optimizers import (
+            build_optimizer)
+        return build_optimizer(self.context.settings)
+
+    def batch_size(self) -> int:
+        return int(self.context.settings.get("batch_size") or 1)
+
+    def _reader_from(self, source: DataSource, *, is_train: bool):
+        if source is None or source.module is None:
+            return None, None
+        saved = list(sys.path)
+        if self.context.config_dir:
+            sys.path.insert(0, self.context.config_dir)
+        try:
+            mod = __import__(source.module)
+        finally:
+            sys.path[:] = saved
+        prov = getattr(mod, source.obj)
+        kwargs = {}
+        if source.args not in (None, "", {}):
+            kwargs = dict(source.args) if isinstance(source.args, dict) \
+                else {"args": source.args}
+        file_list = source.file_list
+        if file_list and self.context.config_dir and \
+                not os.path.isabs(file_list):
+            cand = os.path.join(self.context.config_dir, file_list)
+            if os.path.exists(cand):
+                file_list = cand
+        sample_reader = prov.as_reader(file_list, is_train=is_train,
+                                       **kwargs)
+        from paddle_tpu.data.reader import batch
+        return batch(sample_reader, self.batch_size()), prov
+
+    def train_reader(self):
+        reader, _ = self._reader_from(self.context.train_source,
+                                      is_train=True)
+        return reader
+
+    def test_reader(self):
+        reader, _ = self._reader_from(self.context.test_source,
+                                      is_train=False)
+        return reader
+
+    def feeding(self):
+        """{data-layer name: InputType} in provider order."""
+        src = self.context.train_source or self.context.test_source
+        if src is None or src.module is None:
+            return None
+        _, prov = self._reader_from(src, is_train=True)
+        kinds = prov.input_types
+        names = (self.context.input_layer_names
+                 or self.model.input_layer_names)
+        if isinstance(kinds, dict):
+            # order by data-layer declaration, not dict order
+            return {n: kinds[n] for n in names if n in kinds}
+        return dict(zip(names, kinds))
+
+    # ------------------------------------------------------------ protos
+    def model_proto(self):
+        from paddle_tpu.compat.proto_export import model_to_proto
+        return model_to_proto(self.model, self.context)
+
+    def trainer_proto(self):
+        from paddle_tpu.compat.proto_export import trainer_to_proto
+        return trainer_to_proto(self.model, self.context)
+
+
+def parse_config(config_file: str, config_arg_str: str = "") -> ParsedConfig:
+    """Execute a v1 config file and return the parsed configuration
+    (``config_parser.py:3704``). ``config_arg_str`` is the
+    ``--config_args`` comma-separated k=v list."""
+    from paddle_tpu.compat import install_paddle_alias
+    install_paddle_alias()
+
+    config_args: Dict[str, Any] = {}
+    for kv in filter(None, (config_arg_str or "").split(",")):
+        k, _, v = kv.partition("=")
+        config_args[k] = _coerce(v)
+
+    c = begin_parse(config_args)
+    c.config_dir = os.path.dirname(os.path.abspath(config_file))
+
+    ns: Dict[str, Any] = {
+        "__file__": os.path.abspath(config_file),
+        "__name__": "__paddle_config__",
+        # Python-2-era configs
+        "xrange": range,
+        "unicode": str,
+    }
+    saved_path = list(sys.path)
+    sys.path.insert(0, c.config_dir)
+    try:
+        with open(config_file) as f:
+            code = compile(f.read(), config_file, "exec")
+        exec(code, ns)
+    finally:
+        sys.path[:] = saved_path
+
+    graph = dsl.current_graph()
+    if not c.input_layer_names:
+        c.input_layer_names = list(graph.input_layer_names)
+    if not c.output_layer_names:
+        c.output_layer_names = list(graph.output_layer_names)
+    return ParsedConfig(model=graph, context=c, namespace=ns)
+
+
+def parse_config_and_serialize(config_file: str,
+                               config_arg_str: str = "") -> bytes:
+    """The embedded-interpreter entry the reference C++ calls
+    (``TrainerConfigHelper.cpp:54``): returns serialized TrainerConfig."""
+    return parse_config(config_file,
+                        config_arg_str).trainer_proto().SerializeToString()
+
+
+def _coerce(v: str):
+    for conv in (int, float):
+        try:
+            return conv(v)
+        except ValueError:
+            pass
+    if v.lower() in ("true", "false"):
+        return v.lower() == "true"
+    return v
+
+
+# re-exported names configs sometimes pull from paddle.trainer.config_parser
+__all__ = [
+    "parse_config", "parse_config_and_serialize", "get_config_arg",
+    "inputs", "outputs", "begin_parse", "ctx", "ConfigContext",
+    "ParsedConfig", "DataSource",
+]

@@ -1,0 +1,61 @@
+"""``paddle.trainer.PyDataProvider2`` surface for v1 configs/providers.
+
+The reference module (`python/paddle/trainer/PyDataProvider2.py:329`)
+defines the ``@provider`` decorator and the slot-type constructors used by
+user data scripts; here they resolve to the native provider pipeline
+(paddle_tpu/data/provider.py + native double-buffer prefetch).
+"""
+
+from paddle_tpu.data.provider import (CacheType, DataProvider,  # noqa: F401
+                                      provider)
+from paddle_tpu.data.types import (InputType, dense_vector,  # noqa: F401
+                                   dense_vector_sequence, integer_value,
+                                   integer_value_sequence,
+                                   sparse_binary_vector,
+                                   sparse_float_vector)
+from paddle_tpu.data import types as _T
+
+# sequence-ness constants (reference SequenceType)
+NO_SEQUENCE = _T.NO_SEQUENCE
+SEQUENCE = _T.SEQUENCE
+SUB_SEQUENCE = _T.SUB_SEQUENCE
+
+
+class SequenceType:
+    NO_SEQUENCE = _T.NO_SEQUENCE
+    SEQUENCE = _T.SEQUENCE
+    SUB_SEQUENCE = _T.SUB_SEQUENCE
+
+
+def sparse_binary_vector_sequence(dim):
+    import dataclasses
+    return dataclasses.replace(sparse_binary_vector(dim), seq_type=SEQUENCE)
+
+
+def sparse_float_vector_sequence(dim):
+    import dataclasses
+    return dataclasses.replace(sparse_float_vector(dim), seq_type=SEQUENCE)
+
+
+sparse_vector = sparse_float_vector
+sparse_vector_sequence = sparse_float_vector_sequence
+sparse_non_value_slot = sparse_binary_vector
+sparse_value_slot = sparse_float_vector
+index_slot = integer_value
+dense_slot = dense_vector
+
+
+def integer_sequence(dim):
+    return integer_value_sequence(dim)
+
+
+__all__ = [
+    "provider", "DataProvider", "CacheType", "InputType", "SequenceType",
+    "dense_vector", "dense_vector_sequence", "integer_value",
+    "integer_value_sequence", "sparse_binary_vector",
+    "sparse_binary_vector_sequence", "sparse_float_vector",
+    "sparse_float_vector_sequence", "sparse_vector",
+    "sparse_vector_sequence", "sparse_non_value_slot", "sparse_value_slot",
+    "index_slot", "dense_slot", "integer_sequence",
+    "NO_SEQUENCE", "SEQUENCE", "SUB_SEQUENCE",
+]

@@ -1,0 +1,50 @@
+"""``paddle.trainer_config_helpers.activations`` surface.
+
+Activation objects whose ``.name`` is the proto ``active_type`` string
+(`trainer_config_helpers/activations.py`; applied by the engine's
+activation table, paddle_tpu/layers/activations.py).
+"""
+
+__all__ = [
+    "TanhActivation", "SigmoidActivation", "SoftmaxActivation",
+    "IdentityActivation", "LinearActivation", "SequenceSoftmaxActivation",
+    "ExpActivation", "ReluActivation", "BReluActivation",
+    "SoftReluActivation", "STanhActivation", "AbsActivation",
+    "SquareActivation", "BaseActivation", "LogActivation",
+    "SqrtActivation", "ReciprocalActivation",
+]
+
+
+class BaseActivation:
+    name = ""
+    support_hppl = True
+
+    def __init__(self):
+        pass
+
+    def __repr__(self):
+        return self.name or "linear"
+
+
+def _make(cls_name, act_name):
+    cls = type(cls_name, (BaseActivation,), {"name": act_name})
+    return cls
+
+
+TanhActivation = _make("TanhActivation", "tanh")
+SigmoidActivation = _make("SigmoidActivation", "sigmoid")
+SoftmaxActivation = _make("SoftmaxActivation", "softmax")
+SequenceSoftmaxActivation = _make("SequenceSoftmaxActivation",
+                                  "sequence_softmax")
+IdentityActivation = _make("IdentityActivation", "")
+LinearActivation = IdentityActivation
+ReluActivation = _make("ReluActivation", "relu")
+BReluActivation = _make("BReluActivation", "brelu")
+SoftReluActivation = _make("SoftReluActivation", "softrelu")
+STanhActivation = _make("STanhActivation", "stanh")
+AbsActivation = _make("AbsActivation", "abs")
+SquareActivation = _make("SquareActivation", "square")
+ExpActivation = _make("ExpActivation", "exponential")
+LogActivation = _make("LogActivation", "log")
+SqrtActivation = _make("SqrtActivation", "sqrt")
+ReciprocalActivation = _make("ReciprocalActivation", "reciprocal")

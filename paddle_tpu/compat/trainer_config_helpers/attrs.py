@@ -1,0 +1,114 @@
+"""``paddle.trainer_config_helpers.attrs`` surface.
+
+ParameterAttribute / ExtraLayerAttribute with the reference's constructor
+signatures (`trainer_config_helpers/attrs.py`), carrying straight into the
+native ParamAttr / LayerDef attrs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from paddle_tpu.config.model_config import ParamAttr as _EngineParamAttr
+
+__all__ = ["HookAttr", "HookAttribute", "ParamAttr", "ExtraAttr",
+           "ParameterAttribute", "ExtraLayerAttribute"]
+
+
+class HookAttribute:
+    """Updater hook spec (currently 'pruning' with a sparsity ratio —
+    `parameter/ParameterUpdaterHook.cpp:39`)."""
+
+    def __init__(self, type, sparsity_ratio=None):
+        self.type = type
+        self.sparsity_ratio = sparsity_ratio
+        if sparsity_ratio is not None and not 0 <= sparsity_ratio <= 1:
+            raise ValueError("sparsity_ratio must be within [0, 1]")
+
+
+class ParameterAttribute:
+    """User-facing parameter attribute; ``.to_param_attr()`` converts to
+    the engine's ParamAttr."""
+
+    def __init__(self, name=None, is_static=False, initial_std=None,
+                 initial_mean=None, initial_max=None, initial_min=None,
+                 l1_rate=None, l2_rate=None, learning_rate=None,
+                 momentum=None, gradient_clipping_threshold=None,
+                 sparse_update=False, update_hooks=None,
+                 initializer=None):
+        if initial_max is not None or initial_min is not None:
+            if initial_max is None or initial_min is None:
+                raise ValueError("initial_max/min must be set together")
+            if initial_max <= initial_min:
+                raise ValueError("initial_max must exceed initial_min")
+        self.name = name
+        self.is_static = is_static
+        self.initial_std = initial_std
+        self.initial_mean = initial_mean
+        self.initial_max = initial_max
+        self.initial_min = initial_min
+        self.l1_rate = l1_rate
+        self.l2_rate = l2_rate
+        self.learning_rate = learning_rate
+        self.momentum = momentum
+        self.gradient_clipping_threshold = gradient_clipping_threshold
+        self.sparse_update = sparse_update
+        self.update_hooks = update_hooks
+        self.initializer = initializer
+
+    def set_default_parameter_name(self, name):
+        if self.name is None:
+            self.name = name
+
+    def to_param_attr(self) -> _EngineParamAttr:
+        init = "normal"
+        mean, std = self.initial_mean, self.initial_std
+        if self.initial_max is not None:
+            init = "uniform"
+            mean = (self.initial_max + self.initial_min) / 2.0
+            std = (self.initial_max - self.initial_min) / 2.0
+        return _EngineParamAttr(
+            name=self.name, init=init,
+            initial_mean=0.0 if mean is None else mean,
+            initial_std=std, is_static=self.is_static,
+            learning_rate=(1.0 if self.learning_rate is None
+                           else self.learning_rate),
+            l1_rate=self.l1_rate, l2_rate=self.l2_rate,
+            sparse_grad=bool(self.sparse_update))
+
+    @staticmethod
+    def to_bias(bias_attr):
+        """Reference semantics: False/None-ish -> no bias; True -> default
+        bias; ParameterAttribute -> that bias."""
+        if isinstance(bias_attr, ParameterAttribute):
+            return bias_attr.to_param_attr()
+        return bool(bias_attr) if isinstance(bias_attr, bool) else \
+            (bias_attr if bias_attr is None else bool(bias_attr))
+
+
+class ExtraLayerAttribute:
+    """Extra layer knobs: dropout, error clipping, device placement."""
+
+    def __init__(self, error_clipping_threshold=None, drop_rate=None,
+                 device=None):
+        self.error_clipping_threshold = error_clipping_threshold
+        self.drop_rate = drop_rate
+        self.device = device
+
+    @staticmethod
+    def to_kwargs(attr: Optional["ExtraLayerAttribute"]) -> dict:
+        if attr is None:
+            return {}
+        out = {}
+        if attr.drop_rate is not None:
+            out["drop_rate"] = attr.drop_rate
+        if attr.error_clipping_threshold is not None:
+            out["error_clipping_threshold"] = attr.error_clipping_threshold
+        if attr.device is not None:
+            out["device"] = attr.device
+        return out
+
+
+HookAttr = HookAttribute
+ExtraAttr = ExtraLayerAttribute
+ParamAttr = ParameterAttribute

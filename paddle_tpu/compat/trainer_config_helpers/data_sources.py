@@ -1,0 +1,37 @@
+"""``paddle.trainer_config_helpers.data_sources`` surface.
+
+``define_py_data_sources2`` (`trainer_config_helpers/data_sources.py`):
+records the train/test PyDataProvider2 hookups in the active parse
+context; the trainer builds readers from them (ParsedConfig.train_reader).
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.compat.config_parser import DataSource, ctx
+
+__all__ = ["define_py_data_sources2", "define_py_data_sources"]
+
+
+def define_py_data_sources2(train_list, test_list, module, obj, args=None):
+    """train_list/test_list: file-list file path (or None); module/obj:
+    the provider module and decorated object; args: init_hook kwargs.
+    module/obj/args may be two-element lists to differ per split."""
+
+    def pick(v, i):
+        return v[i] if isinstance(v, (list, tuple)) else v
+
+    c = ctx()
+    if train_list is not None:
+        c.train_source = DataSource(file_list=train_list,
+                                    module=pick(module, 0),
+                                    obj=pick(obj, 0), args=pick(args, 0))
+    if test_list is not None:
+        c.test_source = DataSource(file_list=test_list,
+                                   module=pick(module, 1),
+                                   obj=pick(obj, 1), args=pick(args, 1))
+
+
+def define_py_data_sources(train_list, test_list, module, obj, args=None,
+                           train_async=False, data_cls=None):
+    """Legacy PyDataProvider wrapper — same recording semantics."""
+    define_py_data_sources2(train_list, test_list, module, obj, args)

@@ -1,0 +1,152 @@
+"""``paddle.trainer_config_helpers.evaluators`` surface.
+
+The 16 evaluator wrappers (`trainer_config_helpers/evaluators.py`):
+each records an EvaluatorConfig-shaped dict in the parse context; the
+trainer wires them to the metric registry (paddle_tpu/trainer/metrics.py)
+during train/test.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.compat.config_parser import ctx
+
+__all__ = [
+    "evaluator_base", "classification_error_evaluator", "auc_evaluator",
+    "pnpair_evaluator", "precision_recall_evaluator", "ctc_error_evaluator",
+    "chunk_evaluator", "sum_evaluator", "column_sum_evaluator",
+    "value_printer_evaluator", "gradient_printer_evaluator",
+    "maxid_printer_evaluator", "maxframe_printer_evaluator",
+    "seqtext_printer_evaluator", "classification_error_printer_evaluator",
+    "detection_map_evaluator",
+]
+
+
+def evaluator_base(input, type, label=None, weight=None, name=None,
+                   chunk_scheme=None, num_chunk_types=None, classification_threshold=None,
+                   positive_label=None, dict_file=None, result_file=None,
+                   num_results=None, delimited=None, top_k=None,
+                   excluded_chunk_types=None, overlap_threshold=None,
+                   background_id=None, evaluate_difficult=None,
+                   ap_type=None):
+    """Record one evaluator attachment (the reference's Evaluator config
+    func)."""
+    inputs = input if isinstance(input, (list, tuple)) else [input]
+    names = [i.name if hasattr(i, "name") else str(i) for i in inputs]
+    if label is not None:
+        names.append(label.name if hasattr(label, "name") else str(label))
+    if weight is not None:
+        names.append(weight.name if hasattr(weight, "name") else str(weight))
+    c = ctx()
+    cfg = {"name": name or c.auto_name(f"{type}_evaluator"),
+           "type": type, "input_layers": names}
+    for k, v in [("chunk_scheme", chunk_scheme),
+                 ("num_chunk_types", num_chunk_types),
+                 ("classification_threshold", classification_threshold),
+                 ("positive_label", positive_label),
+                 ("dict_file", dict_file), ("result_file", result_file),
+                 ("num_results", num_results), ("delimited", delimited),
+                 ("top_k", top_k),
+                 ("excluded_chunk_types", excluded_chunk_types),
+                 ("overlap_threshold", overlap_threshold),
+                 ("background_id", background_id),
+                 ("evaluate_difficult", evaluate_difficult),
+                 ("ap_type", ap_type)]:
+        if v is not None:
+            cfg[k] = v
+    c.evaluators.append(cfg)
+    return cfg
+
+
+def classification_error_evaluator(input, label, name=None, weight=None,
+                                   top_k=None, threshold=None):
+    return evaluator_base(input, "classification_error", label=label,
+                          weight=weight, name=name, top_k=top_k,
+                          classification_threshold=threshold)
+
+
+def auc_evaluator(input, label, name=None, weight=None):
+    return evaluator_base(input, "last-column-auc", label=label,
+                          weight=weight, name=name)
+
+
+def pnpair_evaluator(input, label, query_id, weight=None, name=None):
+    ev = evaluator_base(input, "pnpair", label=label, weight=weight,
+                        name=name)
+    ev["input_layers"].append(
+        query_id.name if hasattr(query_id, "name") else str(query_id))
+    return ev
+
+
+def precision_recall_evaluator(input, label, positive_label=None,
+                               weight=None, name=None):
+    return evaluator_base(input, "precision_recall", label=label,
+                          positive_label=positive_label, weight=weight,
+                          name=name)
+
+
+def ctc_error_evaluator(input, label, name=None):
+    return evaluator_base(input, "ctc_edit_distance", label=label,
+                          name=name)
+
+
+def chunk_evaluator(input, label, chunk_scheme, num_chunk_types,
+                    name=None, excluded_chunk_types=None):
+    return evaluator_base(input, "chunk", label=label, name=name,
+                          chunk_scheme=chunk_scheme,
+                          num_chunk_types=num_chunk_types,
+                          excluded_chunk_types=excluded_chunk_types)
+
+
+def detection_map_evaluator(input, label, overlap_threshold=0.5,
+                            background_id=0, evaluate_difficult=False,
+                            ap_type="11point", name=None):
+    return evaluator_base(input, "detection_map", label=label, name=name,
+                          overlap_threshold=overlap_threshold,
+                          background_id=background_id,
+                          evaluate_difficult=evaluate_difficult,
+                          ap_type=ap_type)
+
+
+def sum_evaluator(input, name=None, weight=None):
+    return evaluator_base(input, "sum", weight=weight, name=name)
+
+
+def column_sum_evaluator(input, name=None, weight=None):
+    return evaluator_base(input, "last-column-sum", weight=weight,
+                          name=name)
+
+
+def value_printer_evaluator(input, name=None):
+    return evaluator_base(input, "value_printer", name=name)
+
+
+def gradient_printer_evaluator(input, name=None):
+    return evaluator_base(input, "gradient_printer", name=name)
+
+
+def maxid_printer_evaluator(input, num_results=None, name=None):
+    return evaluator_base(input, "max_id_printer", name=name,
+                          num_results=num_results)
+
+
+def maxframe_printer_evaluator(input, num_results=None, name=None):
+    return evaluator_base(input, "max_frame_printer", name=name,
+                          num_results=num_results)
+
+
+def seqtext_printer_evaluator(input, result_file, id_input=None,
+                              dict_file=None, delimited=None, name=None):
+    ev = evaluator_base(input, "seq_text_printer", name=name,
+                        dict_file=dict_file, result_file=result_file,
+                        delimited=delimited)
+    if id_input is not None:
+        ev["input_layers"].insert(
+            0, id_input.name if hasattr(id_input, "name") else str(id_input))
+    return ev
+
+
+def classification_error_printer_evaluator(input, label, threshold=0.5,
+                                           name=None):
+    return evaluator_base(input, "classification_error_printer",
+                          label=label, name=name,
+                          classification_threshold=threshold)

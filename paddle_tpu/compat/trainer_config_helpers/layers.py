@@ -1,0 +1,1187 @@
+"""``paddle.trainer_config_helpers.layers`` surface.
+
+The 100+ v1 layer helpers (`trainer_config_helpers/layers.py`, 6212 LoC)
+re-implemented over the native graph DSL: each helper validates its
+arguments, applies the reference's defaults/naming conventions
+(``__fc_layer_0__`` etc.), and appends a ``LayerDef`` whose ``type`` is
+the same ``LayerConfig.type`` string the reference registers — so the
+engine's registry (paddle_tpu/core/registry.py) executes it and the proto
+exporter (paddle_tpu/compat/proto_export.py) can emit the contract
+``ModelConfig``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from paddle_tpu.compat.config_parser import ctx
+from paddle_tpu.compat.trainer_config_helpers.activations import (
+    BaseActivation, IdentityActivation, LinearActivation, ReluActivation,
+    SigmoidActivation, TanhActivation)
+from paddle_tpu.compat.trainer_config_helpers.attrs import (
+    ExtraLayerAttribute, ParameterAttribute)
+from paddle_tpu.compat.trainer_config_helpers.poolings import (
+    AvgPooling, BasePoolingType, MaxPooling)
+from paddle_tpu.config import dsl
+from paddle_tpu.config.dsl import (GeneratedInput, LayerOutput,  # noqa: F401
+                                   StaticInput)
+from paddle_tpu.config.model_config import Input, LayerDef, ParamAttr
+
+__all__ = [
+    'full_matrix_projection', 'AggregateLevel', 'ExpandLevel',
+    'identity_projection', 'dotmul_projection', 'dotmul_operator',
+    'repeat_layer', 'seq_reshape_layer', 'table_projection', 'mixed_layer',
+    'data_layer', 'embedding_layer', 'fc_layer', 'grumemory',
+    'pooling_layer', 'lstmemory', 'last_seq', 'first_seq', 'cos_sim',
+    'hsigmoid', 'conv_projection', 'mse_cost', 'regression_cost',
+    'classification_cost', 'LayerOutput', 'img_conv_layer',
+    'img_pool_layer', 'batch_norm_layer', 'img_cmrnorm_layer',
+    'addto_layer', 'concat_layer', 'seq_concat_layer', 'lstm_step_layer',
+    'recurrent_group', 'memory', 'StaticInput', 'expand_layer',
+    'scaling_layer', 'scaling_projection', 'power_layer',
+    'interpolation_layer', 'bilinear_interp_layer', 'trans_layer',
+    'rotate_layer', 'sum_to_one_norm_layer', 'row_l2_norm_layer',
+    'get_output_layer', 'LayerType', 'context_projection', 'beam_search',
+    'maxid_layer', 'GeneratedInput', 'SubsequenceInput', 'gru_step_layer',
+    'gru_step_naive_layer', 'recurrent_layer', 'BaseGeneratedInput',
+    'conv_operator', 'conv_shift_layer', 'tensor_layer',
+    'selective_fc_layer', 'sampling_id_layer', 'slope_intercept_layer',
+    'trans_full_matrix_projection', 'linear_comb_layer',
+    'convex_comb_layer', 'ctc_layer', 'warp_ctc_layer', 'crf_layer',
+    'crf_decoding_layer', 'nce_layer', 'cross_entropy_with_selfnorm',
+    'cross_entropy', 'multi_binary_label_cross_entropy', 'sum_cost',
+    'rank_cost', 'lambda_cost', 'huber_cost', 'block_expand_layer',
+    'maxout_layer', 'out_prod_layer', 'printer_layer', 'print_layer',
+    'priorbox_layer', 'cross_channel_norm_layer', 'multibox_loss_layer',
+    'detection_output_layer', 'spp_layer', 'pad_layer', 'eos_layer',
+    'smooth_l1_cost', 'layer_support', 'multiplex_layer', 'row_conv_layer',
+    'dropout_layer', 'prelu_layer', 'gated_unit_layer', 'crop_layer',
+    'sub_nested_seq_layer', 'clip_layer', 'slice_projection',
+    'kmax_sequence_score_layer',
+]
+
+
+class LayerType:
+    """The proto ``LayerConfig.type`` vocabulary."""
+
+    DATA = 'data'
+    MIXED_LAYER = 'mixed'
+    LSTMEMORY = 'lstmemory'
+    GRUMEMORY = 'gated_recurrent'
+    SEQUENCE_LAST_INSTANCE = 'seqlastins'
+    SEQUENCE_FIRST_INSTANCE = 'seqlastins'
+    SEQUENCE_RESHAPE = 'seqreshape'
+    POOLING_MAX = 'max'
+    POOLING_AVG = 'average'
+    FC_LAYER = 'fc'
+    COST = 'cost'
+    COSINE_SIM_VEC = 'cos_vm'
+    COSINE_SIM = 'cos'
+    HSIGMOID = 'hsigmoid'
+    CONV_LAYER = 'conv'
+    CONVTRANS_LAYER = 'convt'
+    EXCONV_LAYER = 'exconv'
+    EXCONVTRANS_LAYER = 'exconvt'
+    CUDNNCONV_LAYER = 'cudnn_conv'
+    POOL_LAYER = 'pool'
+    BATCH_NORM_LAYER = 'batch_norm'
+    NORM_LAYER = 'norm'
+    ADDTO_LAYER = 'addto'
+    CONCAT_LAYER = 'concat'
+    SEQUENCE_CONCAT_LAYER = 'seqconcat'
+
+
+class AggregateLevel:
+    TO_NO_SEQUENCE = 'non-seq'
+    TO_SEQUENCE = 'seq'
+    # legacy aliases
+    EACH_TIMESTEP = 'non-seq'
+    EACH_SEQUENCE = 'seq'
+
+
+class ExpandLevel:
+    FROM_NO_SEQUENCE = 'non-seq'
+    FROM_SEQUENCE = 'seq'
+    FROM_TIMESTEP = 'non-seq'
+
+
+def layer_support(*attrs):
+    """Decorator marker in the reference; a no-op passthrough here."""
+
+    def deco(fn):
+        return fn
+
+    return deco
+
+
+# ------------------------------------------------------------------ helpers
+def _name(name: Optional[str], prefix: str) -> str:
+    return name if name is not None else ctx().auto_name(prefix)
+
+
+def _act(act, default: type = TanhActivation) -> str:
+    if act is None:
+        act = default()
+    if isinstance(act, BaseActivation):
+        return act.name
+    if isinstance(act, str):
+        return act
+    raise TypeError(f"bad activation {act!r}")
+
+
+def _pattr(attr) -> Optional[ParamAttr]:
+    if attr is None:
+        return None
+    if isinstance(attr, ParameterAttribute):
+        return attr.to_param_attr()
+    if isinstance(attr, ParamAttr):
+        return attr
+    if isinstance(attr, dict):
+        return ParamAttr(**attr)
+    raise TypeError(f"bad param attr {attr!r}")
+
+
+def _battr(bias_attr, default: bool = True):
+    """Reference bias semantics: None -> default; False/0 -> no bias;
+    True -> default bias; ParameterAttribute -> custom bias."""
+    if bias_attr is None:
+        return default
+    if isinstance(bias_attr, ParameterAttribute):
+        return bias_attr.to_param_attr()
+    return bool(bias_attr)
+
+
+def _one(x) -> LayerOutput:
+    if isinstance(x, (list, tuple)):
+        if len(x) != 1:
+            raise ValueError("this layer takes exactly one input")
+        x = x[0]
+    if not isinstance(x, LayerOutput):
+        raise TypeError(f"input must be a LayerOutput, got {type(x)}")
+    return x
+
+
+def _many(x) -> List[LayerOutput]:
+    xs = [x] if isinstance(x, LayerOutput) else list(x)
+    for i in xs:
+        if not isinstance(i, LayerOutput):
+            raise TypeError(f"input must be LayerOutput, got {type(i)}")
+    return xs
+
+
+def _layer(name, type_, inputs, *, size=None, act="", bias=False,
+           drop_rate=0.0, attrs=None, layer_attr=None) -> LayerOutput:
+    extra = ExtraLayerAttribute.to_kwargs(layer_attr)
+    drop = extra.pop("drop_rate", drop_rate)
+    at = dict(attrs or {})
+    if "error_clipping_threshold" in extra:
+        at["error_clipping_threshold"] = extra.pop(
+            "error_clipping_threshold")
+    at.update(extra)
+    ldef = LayerDef(name=name, type=type_, inputs=inputs, size=size,
+                    act=act or "linear", bias=bias, drop_rate=drop or 0.0,
+                    attrs=at)
+    return dsl._add(ldef)
+
+
+# ------------------------------------------------------------- projections
+@dataclasses.dataclass
+class Projection:
+    """A projection bound to one input (reference Projection configs;
+    consumed by mixed_layer)."""
+
+    input: LayerOutput
+    spec: Dict[str, Any]
+    size: int                      # output size (0 = same as mixed size)
+    param_attr: Optional[ParamAttr] = None
+    # operators take several inputs
+    extra_inputs: List[LayerOutput] = dataclasses.field(
+        default_factory=list)
+    is_operator: bool = False
+
+    # `proj + proj` shorthand builds an anonymous mixed layer
+    def __add__(self, other):
+        if isinstance(other, Projection):
+            return mixed_layer(input=[self, other])
+        raise TypeError("can only add projections")
+
+
+def full_matrix_projection(input, size=0, param_attr=None):
+    return Projection(_one(input), {"type": "full_matrix"}, size,
+                      _pattr(param_attr))
+
+
+def trans_full_matrix_projection(input, size=0, param_attr=None):
+    return Projection(_one(input), {"type": "trans_full_matrix"}, size,
+                      _pattr(param_attr))
+
+
+def table_projection(input, size=0, param_attr=None):
+    src = _one(input)
+    return Projection(src, {"type": "table", "vocab_size": src.size}, size,
+                      _pattr(param_attr))
+
+
+def identity_projection(input, offset=None, size=None):
+    src = _one(input)
+    if offset is None:
+        return Projection(src, {"type": "identity"}, src.size)
+    if size is None:
+        size = src.size - offset
+    return Projection(src, {"type": "identity_offset", "offset": offset},
+                      size)
+
+
+def slice_projection(input, slices):
+    src = _one(input)
+    total = 0
+    for s, e in slices:
+        if not 0 <= s < e <= src.size:
+            raise ValueError(f"bad slice [{s}, {e}) for size {src.size}")
+        total += e - s
+    return Projection(src, {"type": "slice", "slices": list(slices)}, total)
+
+
+def scaling_projection(input, param_attr=None):
+    src = _one(input)
+    return Projection(src, {"type": "scaling"}, src.size, _pattr(param_attr))
+
+
+def dotmul_projection(input, param_attr=None):
+    src = _one(input)
+    return Projection(src, {"type": "dot_mul"}, src.size, _pattr(param_attr))
+
+
+def dotmul_operator(a=None, b=None, scale=1, **kwargs):
+    a = kwargs.get("x", a)
+    b = kwargs.get("y", b)
+    a, b = _one(a), _one(b)
+    return Projection(a, {"type": "dot_mul_op", "scale": scale}, a.size,
+                      extra_inputs=[b], is_operator=True)
+
+
+def context_projection(input, context_len, context_start=None,
+                       padding_attr=False):
+    """Sliding window concat over the sequence axis
+    (`function/ContextProjection*`). trainable_padding when padding_attr
+    is a ParameterAttribute."""
+    src = _one(input)
+    start = -(context_len // 2) if context_start is None else context_start
+    trainable = isinstance(padding_attr, ParameterAttribute)
+    spec = {"type": "context", "context_start": start,
+            "context_length": context_len,
+            "trainable_padding": trainable}
+    return Projection(src, spec, src.size * context_len,
+                      _pattr(padding_attr) if trainable else None)
+
+
+def conv_operator(img, filter, filter_size, num_filters, num_channels=None,
+                  stride=1, padding=0, filter_size_y=None, stride_y=None,
+                  padding_y=None, trans=False):
+    img, flt = _one(img), _one(filter)
+    spec = {"type": "convt_op" if trans else "conv_op",
+            "filter_size": filter_size, "num_filters": num_filters,
+            "num_channels": num_channels, "stride": stride,
+            "padding": padding}
+    return Projection(img, spec, 0, extra_inputs=[flt], is_operator=True)
+
+
+def conv_projection(input, filter_size, num_filters, num_channels=None,
+                    stride=1, padding=0, filter_size_y=None, stride_y=None,
+                    padding_y=None, groups=1, param_attr=None, trans=False):
+    src = _one(input)
+    spec = {"type": "convt" if trans else "conv",
+            "filter_size": filter_size, "num_filters": num_filters,
+            "num_channels": num_channels, "stride": stride,
+            "padding": padding, "groups": groups}
+    return Projection(src, spec, 0, _pattr(param_attr))
+
+
+class MixedLayerType:
+    """The ``with mixed_layer(...) as m: m += projection`` protocol."""
+
+    def __init__(self, name, size, act, bias_attr, layer_attr):
+        self.name = name
+        self.size = size
+        self.act = act
+        self.bias_attr = bias_attr
+        self.layer_attr = layer_attr
+        self.projections: List[Projection] = []
+        self.finalized: Optional[LayerOutput] = None
+
+    def __iadd__(self, proj):
+        if self.finalized is not None:
+            raise ValueError("mixed_layer already finalized")
+        if not isinstance(proj, Projection):
+            raise TypeError("can only add projections/operators")
+        self.projections.append(proj)
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, *a):
+        if exc_type is None:
+            self._finalize()
+        return False
+
+    def _finalize(self) -> LayerOutput:
+        if self.finalized is not None:
+            return self.finalized
+        if not self.projections:
+            raise ValueError("mixed_layer has no projections")
+        size = self.size
+        if not size:
+            sizes = [p.size for p in self.projections if p.size]
+            size = sizes[0] if sizes else None
+        inputs, projs, operators = [], [], []
+        for p in self.projections:
+            if p.is_operator:
+                idxs = []
+                for ex in [p.input] + p.extra_inputs:
+                    idxs.append(len(inputs))
+                    inputs.append(Input(ex.name))
+                    projs.append({"type": "identity_op_arg"})
+                operators.append({**p.spec, "input_indices": idxs})
+            else:
+                inputs.append(Input(p.input.name,
+                                    param_attr=p.param_attr))
+                projs.append(dict(p.spec))
+        self.finalized = _layer(
+            self.name, "mixed", inputs, size=size, act=self.act,
+            bias=self.bias_attr,
+            attrs={"projections": projs, "operators": operators},
+            layer_attr=self.layer_attr)
+        return self.finalized
+
+    # LayerOutput duck-typing for immediate-mode use
+    @property
+    def _lo(self):
+        return self._finalize()
+
+    def __getattr__(self, item):
+        if item in ("name", "size") and "finalized" in self.__dict__:
+            lo = self._finalize()
+            return getattr(lo, item)
+        raise AttributeError(item)
+
+
+def mixed_layer(size=0, input=None, name=None, act=None, bias_attr=False,
+                layer_attr=None):
+    name = _name(name, "mixed")
+    m = MixedLayerType(name, size, _act(act, LinearActivation),
+                      _battr(bias_attr, False), layer_attr)
+    if input is not None:
+        for p in (input if isinstance(input, (list, tuple)) else [input]):
+            m += p
+        return m._finalize()
+    return m
+
+
+# ------------------------------------------------------------ basic layers
+def data_layer(name, size, height=None, width=None, layer_attr=None):
+    return dsl.data(name=name, size=size, height=height, width=width)
+
+
+def embedding_layer(input, size, name=None, param_attr=None,
+                    layer_attr=None):
+    src = _one(input)
+    pa = _pattr(param_attr)
+    return _layer(_name(name, "embedding"), "embedding",
+                  [Input(src.name, param_attr=pa)], size=size,
+                  attrs={"vocab_size": src.size}, layer_attr=layer_attr)
+
+
+def fc_layer(input, size, act=None, name=None, param_attr=None,
+             bias_attr=None, layer_attr=None):
+    ins = _many(input)
+    if isinstance(param_attr, (list, tuple)):
+        pas = [_pattr(a) for a in param_attr]
+    else:
+        pas = [_pattr(param_attr) for _ in ins]
+    return _layer(
+        _name(name, "fc_layer"), "fc",
+        [Input(i.name, param_attr=a) for i, a in zip(ins, pas)],
+        size=size, act=_act(act), bias=_battr(bias_attr),
+        layer_attr=layer_attr)
+
+
+def printer_layer(input, format=None, name=None):
+    ins = _many(input)
+    return _layer(_name(name, "print"), "print",
+                  [Input(i.name) for i in ins],
+                  attrs={"format": format})
+
+
+print_layer = printer_layer
+
+
+def trans_layer(input, name=None, layer_attr=None):
+    return _layer(_name(name, "trans_layer"), "trans",
+                  [Input(_one(input).name)], layer_attr=layer_attr)
+
+
+def rotate_layer(input, height, width, name=None, layer_attr=None):
+    return _layer(_name(name, "rotate"), "rotate",
+                  [Input(_one(input).name)],
+                  attrs={"height": height, "width": width},
+                  layer_attr=layer_attr)
+
+
+def repeat_layer(input, num_repeats, as_row_vector=True, act=None,
+                 name=None, layer_attr=None):
+    src = _one(input)
+    return _layer(_name(name, "repeat"), "featmap_expand",
+                  [Input(src.name)], size=src.size * num_repeats,
+                  act=_act(act, IdentityActivation),
+                  attrs={"num_filters": num_repeats,
+                         "user_arg": None if as_row_vector else "as_col_vec"},
+                  layer_attr=layer_attr)
+
+
+def seq_reshape_layer(input, reshape_size, act=None, name=None,
+                      layer_attr=None, bias_attr=None):
+    return _layer(_name(name, "seqreshape"), "seqreshape",
+                  [Input(_one(input).name)], size=reshape_size,
+                  act=_act(act, IdentityActivation),
+                  bias=_battr(bias_attr, False), layer_attr=layer_attr)
+
+
+def interpolation_layer(input, weight, name=None, layer_attr=None):
+    a, b = _many(input)
+    w = _one(weight)
+    return _layer(_name(name, "interpolation_layer"), "interpolation",
+                  [Input(w.name), Input(a.name), Input(b.name)],
+                  layer_attr=layer_attr)
+
+
+def bilinear_interp_layer(input, out_size_x=None, out_size_y=None,
+                          name=None, layer_attr=None):
+    return _layer(_name(name, "bilinear_interp"), "bilinear_interp",
+                  [Input(_one(input).name,
+                         extra={"out_size_x": out_size_x,
+                                "out_size_y": out_size_y})],
+                  layer_attr=layer_attr)
+
+
+def power_layer(input, weight, name=None, layer_attr=None):
+    return _layer(_name(name, "power"), "power",
+                  [Input(_one(weight).name), Input(_one(input).name)],
+                  layer_attr=layer_attr)
+
+
+def scaling_layer(input, weight, name=None, layer_attr=None):
+    return _layer(_name(name, "scaling"), "scaling",
+                  [Input(_one(weight).name), Input(_one(input).name)],
+                  layer_attr=layer_attr)
+
+
+def sum_to_one_norm_layer(input, name=None, layer_attr=None):
+    return _layer(_name(name, "sum_to_one_norm"), "sum_to_one_norm",
+                  [Input(_one(input).name)], layer_attr=layer_attr)
+
+
+def row_l2_norm_layer(input, name=None, layer_attr=None):
+    return _layer(_name(name, "row_l2_norm"), "row_l2_norm",
+                  [Input(_one(input).name)], layer_attr=layer_attr)
+
+
+def cos_sim(a, b, scale=1, size=1, name=None, layer_attr=None):
+    if size == 1:
+        return _layer(_name(name, "cos_sim"), "cos",
+                      [Input(_one(a).name), Input(_one(b).name)],
+                      attrs={"cos_scale": scale}, layer_attr=layer_attr)
+    return _layer(_name(name, "cos_sim"), "cos_vm",
+                  [Input(_one(a).name), Input(_one(b).name)], size=size,
+                  attrs={"cos_scale": scale}, layer_attr=layer_attr)
+
+
+def out_prod_layer(input1, input2, name=None, layer_attr=None):
+    return _layer(_name(name, "out_prod"), "out_prod",
+                  [Input(_one(input1).name), Input(_one(input2).name)],
+                  layer_attr=layer_attr)
+
+
+# ------------------------------------------------------------ aggregation
+def pooling_layer(input, pooling_type=None, name=None, bias_attr=None,
+                  agg_level=AggregateLevel.TO_NO_SEQUENCE, stride=-1,
+                  layer_attr=None):
+    src = _one(input)
+    pt = pooling_type if pooling_type is not None else MaxPooling()
+    attrs = {"trans_type": agg_level, "seq_pool_stride": stride}
+    if isinstance(pt, AvgPooling):
+        ltype = "average"
+        attrs["average_strategy"] = pt.strategy
+    elif isinstance(pt, (MaxPooling, BasePoolingType)):
+        ltype = "max"
+        if getattr(pt, "output_max_index", None):
+            attrs["output_max_index"] = True
+    else:
+        raise TypeError(f"bad pooling type {pt!r}")
+    return _layer(_name(name, "seq_pooling"), ltype, [Input(src.name)],
+                  bias=_battr(bias_attr, False), attrs=attrs,
+                  layer_attr=layer_attr)
+
+
+def last_seq(input, agg_level=AggregateLevel.TO_NO_SEQUENCE, name=None,
+             stride=-1, layer_attr=None):
+    return _layer(_name(name, "last_seq"), "seqlastins",
+                  [Input(_one(input).name)],
+                  attrs={"trans_type": agg_level,
+                         "seq_pool_stride": stride},
+                  layer_attr=layer_attr)
+
+
+def first_seq(input, agg_level=AggregateLevel.TO_NO_SEQUENCE, name=None,
+              stride=-1, layer_attr=None):
+    return _layer(_name(name, "first_seq"), "seqlastins",
+                  [Input(_one(input).name)],
+                  attrs={"trans_type": agg_level, "select_first": True,
+                         "seq_pool_stride": stride},
+                  layer_attr=layer_attr)
+
+
+def expand_layer(input, expand_as, name=None, bias_attr=False,
+                 expand_level=ExpandLevel.FROM_NO_SEQUENCE,
+                 layer_attr=None):
+    return _layer(_name(name, "expand"), "expand",
+                  [Input(_one(input).name), Input(_one(expand_as).name)],
+                  bias=_battr(bias_attr, False),
+                  attrs={"trans_type": expand_level}, layer_attr=layer_attr)
+
+
+def concat_layer(input, act=None, name=None, layer_attr=None,
+                 bias_attr=None):
+    ins = _many(input)
+    return _layer(_name(name, "concat"), "concat",
+                  [Input(i.name) for i in ins],
+                  act=_act(act, IdentityActivation), layer_attr=layer_attr)
+
+
+def seq_concat_layer(a, b, act=None, name=None, layer_attr=None,
+                     bias_attr=None):
+    return _layer(_name(name, "seqconcat"), "seqconcat",
+                  [Input(_one(a).name), Input(_one(b).name)],
+                  act=_act(act, IdentityActivation),
+                  bias=_battr(bias_attr, False), layer_attr=layer_attr)
+
+
+def addto_layer(input, act=None, name=None, bias_attr=None,
+                layer_attr=None):
+    ins = _many(input)
+    return _layer(_name(name, "addto"), "addto",
+                  [Input(i.name) for i in ins],
+                  act=_act(act, IdentityActivation),
+                  bias=_battr(bias_attr, False), layer_attr=layer_attr)
+
+
+def dropout_layer(input, dropout_rate, name=None):
+    """addto with dropout, exactly the reference composition."""
+    return _layer(_name(name, "dropout"), "addto",
+                  [Input(_one(input).name)], drop_rate=dropout_rate)
+
+
+# ------------------------------------------------------------- recurrence
+def lstmemory(input, name=None, size=None, reverse=False, act=None,
+              gate_act=None, state_act=None, bias_attr=None,
+              param_attr=None, layer_attr=None):
+    src = _one(input)
+    if size is not None and src.size != size * 4:
+        raise ValueError("lstmemory input must be 4x its size "
+                         "(project with fc_layer first)")
+    return _layer(
+        _name(name, "lstmemory"), "lstmemory",
+        [Input(src.name, param_attr=_pattr(param_attr))],
+        act="", bias=_battr(bias_attr),
+        attrs={"reversed": reverse,
+               "active_type": _act(act, TanhActivation),
+               "active_gate_type": _act(gate_act, SigmoidActivation),
+               "active_state_type": _act(state_act, TanhActivation)},
+        layer_attr=layer_attr)
+
+
+def grumemory(input, size=None, name=None, reverse=False, act=None,
+              gate_act=None, bias_attr=None, param_attr=None,
+              layer_attr=None):
+    src = _one(input)
+    if size is not None and src.size != size * 3:
+        raise ValueError("grumemory input must be 3x its size")
+    return _layer(
+        _name(name, "gru"), "gated_recurrent",
+        [Input(src.name, param_attr=_pattr(param_attr))],
+        act="", bias=_battr(bias_attr),
+        attrs={"reversed": reverse,
+               "active_type": _act(act, TanhActivation),
+               "active_gate_type": _act(gate_act, SigmoidActivation)},
+        layer_attr=layer_attr)
+
+
+def recurrent_layer(input, act=None, bias_attr=None, param_attr=None,
+                    name=None, reverse=False, layer_attr=None):
+    return _layer(
+        _name(name, "recurrent_layer"), "recurrent",
+        [Input(_one(input).name, param_attr=_pattr(param_attr))],
+        act="", bias=_battr(bias_attr),
+        attrs={"reversed": reverse,
+               "active_type": _act(act, TanhActivation)},
+        layer_attr=layer_attr)
+
+
+def memory(name, size, memory_name=None, is_seq=False, boot_layer=None,
+           boot_bias=None, boot_bias_active_type=None,
+           boot_with_const_id=None):
+    boot_const = 0.0
+    if boot_with_const_id is not None:
+        boot_const = float(boot_with_const_id)
+    return dsl.memory(name=name, size=size, boot_layer=boot_layer,
+                      boot_with_const_value=boot_const)
+
+
+def recurrent_group(step, input, reverse=False, name=None,
+                    targetInlink=None):
+    return dsl.recurrent_group(step, input, reverse=reverse, name=name)
+
+
+def SubsequenceInput(input):
+    """Marker for two-level sequence input of a recurrent_group; the
+    native group consumes the outer level per step."""
+    return input
+
+
+class BaseGeneratedInput:
+    pass
+
+
+def lstm_step_layer(input, state, size=None, act=None, name=None,
+                    gate_act=None, state_act=None, bias_attr=None,
+                    layer_attr=None):
+    inp, st = _one(input), _one(state)
+    size = size or st.size
+    return _layer(
+        _name(name, "lstm_step"), "lstm_step",
+        [Input(inp.name), Input(st.name)], size=size,
+        act="", bias=_battr(bias_attr),
+        attrs={"active_type": _act(act, TanhActivation),
+               "active_gate_type": _act(gate_act, SigmoidActivation),
+               "active_state_type": _act(state_act, TanhActivation)},
+        layer_attr=layer_attr)
+
+
+def gru_step_layer(input, output_mem, size=None, act=None, name=None,
+                   gate_act=None, bias_attr=None, param_attr=None,
+                   layer_attr=None):
+    inp, mem = _one(input), _one(output_mem)
+    size = size or inp.size // 3
+    return _layer(
+        _name(name, "gru_step"), "gru_step",
+        [Input(inp.name, param_attr=_pattr(param_attr)),
+         Input(mem.name)], size=size,
+        act="", bias=_battr(bias_attr),
+        attrs={"active_type": _act(act, TanhActivation),
+               "active_gate_type": _act(gate_act, SigmoidActivation)},
+        layer_attr=layer_attr)
+
+
+def gru_step_naive_layer(input, output_mem, size=None, name=None, act=None,
+                         gate_act=None, bias_attr=None, param_attr=None,
+                         layer_attr=None):
+    return gru_step_layer(input, output_mem, size=size, name=name, act=act,
+                          gate_act=gate_act, bias_attr=bias_attr,
+                          param_attr=param_attr, layer_attr=layer_attr)
+
+
+def get_output_layer(input, arg_name, name=None, layer_attr=None):
+    src = _one(input)
+    return _layer(_name(name, "get_output"), "get_output",
+                  [Input(src.name, extra={"input_layer_argument": arg_name})],
+                  attrs={"arg_name": arg_name}, layer_attr=layer_attr)
+
+
+def maxid_layer(input, name=None, layer_attr=None):
+    return _layer(_name(name, "maxid"), "maxid",
+                  [Input(_one(input).name)], layer_attr=layer_attr)
+
+
+def eos_layer(input, eos_id, name=None, layer_attr=None):
+    return _layer(_name(name, "eos"), "eos_id",
+                  [Input(_one(input).name)], attrs={"eos_id": eos_id},
+                  layer_attr=layer_attr)
+
+
+def kmax_sequence_score_layer(input, name=None, beam_size=1):
+    return _layer(_name(name, "kmax_seq_score"), "kmax_seq_score",
+                  [Input(_one(input).name)], attrs={"beam_size": beam_size})
+
+
+def beam_search(step, input, bos_id, eos_id, beam_size,
+                max_length=500, name=None, num_results_per_sample=None):
+    return dsl.beam_search(step, input, bos_id=bos_id, eos_id=eos_id,
+                           beam_size=beam_size, max_length=max_length,
+                           name=name)
+
+
+# ---------------------------------------------------------------- vision
+def img_conv_layer(input, filter_size, num_filters, name=None,
+                   num_channels=None, act=None, groups=1, stride=1,
+                   padding=0, dilation=1, bias_attr=None, param_attr=None,
+                   shared_biases=True, layer_attr=None, filter_size_y=None,
+                   stride_y=None, padding_y=None, dilation_y=None,
+                   trans=False, layer_type=None):
+    src = _one(input)
+
+    def _pair(v):
+        return v if not isinstance(v, (list, tuple)) else v[0]
+
+    ltype = layer_type or ("exconvt" if trans else "exconv")
+    extra = {"filter_size": _pair(filter_size), "stride": _pair(stride),
+             "padding": _pair(padding), "groups": groups}
+    if num_channels:
+        extra["channels"] = num_channels
+    return _layer(
+        _name(name, "conv"), ltype,
+        [Input(src.name, param_attr=_pattr(param_attr), extra=extra)],
+        act=_act(act, ReluActivation), bias=_battr(bias_attr),
+        attrs={"num_filters": num_filters, "shared_biases": shared_biases},
+        layer_attr=layer_attr)
+
+
+def img_pool_layer(input, pool_size, name=None, num_channels=None,
+                   pool_type=None, stride=1, padding=0, layer_attr=None,
+                   pool_size_y=None, stride_y=None, padding_y=None,
+                   ceil_mode=True, exclude_mode=None):
+    src = _one(input)
+    pt = pool_type if pool_type is not None else MaxPooling()
+    pt_name = "max-projection" if isinstance(pt, MaxPooling) else \
+        "avg-projection"
+    extra = {"filter_size": pool_size, "stride": stride, "padding": padding,
+             "pool_type": pt_name, "ceil_mode": ceil_mode}
+    if pool_size_y:
+        extra["size_y"] = pool_size_y
+    if stride_y:
+        extra["stride_y"] = stride_y
+    if num_channels:
+        extra["channels"] = num_channels
+    return _layer(_name(name, "pool"), "pool",
+                  [Input(src.name, extra=extra)], layer_attr=layer_attr)
+
+
+def spp_layer(input, name=None, num_channels=None, pool_type=None,
+              pyramid_height=None, layer_attr=None):
+    src = _one(input)
+    pt = "max-projection" if pool_type is None or isinstance(
+        pool_type, MaxPooling) else "avg-projection"
+    return _layer(_name(name, "spp"), "spp",
+                  [Input(src.name,
+                         extra={"pyramid_height": pyramid_height,
+                                "pool_type": pt,
+                                "channels": num_channels})],
+                  layer_attr=layer_attr)
+
+
+def img_cmrnorm_layer(input, size, scale=0.0128, power=0.75, name=None,
+                      num_channels=None, layer_attr=None):
+    return _layer(_name(name, "crmnorm"), "norm",
+                  [Input(_one(input).name,
+                         extra={"size": size, "scale": scale, "pow": power,
+                                "channels": num_channels})],
+                  layer_attr=layer_attr)
+
+
+def batch_norm_layer(input, act=None, name=None, img3D=False,
+                     num_channels=None, bias_attr=None, param_attr=None,
+                     layer_attr=None, batch_norm_type=None,
+                     moving_average_fraction=0.9, use_global_stats=None,
+                     mean_var_names=None):
+    src = _one(input)
+    return _layer(
+        _name(name, "batch_norm"), "batch_norm",
+        [Input(src.name, param_attr=_pattr(param_attr))],
+        act=_act(act, IdentityActivation), bias=_battr(bias_attr),
+        attrs={"use_global_stats": use_global_stats,
+               "moving_average_fraction": moving_average_fraction,
+               "channels": num_channels},
+        layer_attr=layer_attr)
+
+
+def maxout_layer(input, groups, num_channels=None, name=None,
+                 layer_attr=None):
+    return _layer(_name(name, "maxout"), "maxout",
+                  [Input(_one(input).name,
+                         extra={"groups": groups,
+                                "channels": num_channels})],
+                  layer_attr=layer_attr)
+
+
+def block_expand_layer(input, block_x=0, block_y=0, stride_x=0, stride_y=0,
+                       padding_x=0, padding_y=0, num_channels=None,
+                       name=None, layer_attr=None):
+    return _layer(_name(name, "blockexpand"), "blockexpand",
+                  [Input(_one(input).name,
+                         extra={"block_x": block_x, "block_y": block_y,
+                                "stride_x": stride_x, "stride_y": stride_y,
+                                "padding_x": padding_x,
+                                "padding_y": padding_y,
+                                "channels": num_channels})],
+                  layer_attr=layer_attr)
+
+
+def pad_layer(input, pad_c=None, pad_h=None, pad_w=None, name=None,
+              layer_attr=None):
+    return _layer(_name(name, "pad"), "pad",
+                  [Input(_one(input).name,
+                         extra={"pad_c": pad_c or [0, 0],
+                                "pad_h": pad_h or [0, 0],
+                                "pad_w": pad_w or [0, 0]})],
+                  layer_attr=layer_attr)
+
+
+def crop_layer(input, offset, axis=2, shape=None, name=None,
+               layer_attr=None):
+    ins = _many(input)
+    return _layer(_name(name, "crop"), "crop",
+                  [Input(i.name) for i in ins],
+                  attrs={"axis": axis, "offset": offset, "shape": shape},
+                  layer_attr=layer_attr)
+
+
+def bilinear_interp(input, **kw):
+    return bilinear_interp_layer(input, **kw)
+
+
+def rotate(input, **kw):
+    return rotate_layer(input, **kw)
+
+
+def cross_channel_norm_layer(input, name=None, param_attr=None):
+    return _layer(_name(name, "cross_channel_norm"), "cross_channel_norm",
+                  [Input(_one(input).name, param_attr=_pattr(param_attr))])
+
+
+def prelu_layer(input, name=None, partial_sum=1, param_attr=None,
+                layer_attr=None):
+    return _layer(_name(name, "prelu"), "prelu",
+                  [Input(_one(input).name, param_attr=_pattr(param_attr))],
+                  attrs={"partial_sum": partial_sum}, layer_attr=layer_attr)
+
+
+def gated_unit_layer(input, size, act=None, name=None, gate_attr=None,
+                     gate_param_attr=None, gate_bias_attr=True,
+                     inproj_attr=None, inproj_param_attr=None,
+                     inproj_bias_attr=True, layer_attr=None):
+    """input_proj ⊙ sigmoid(gate): the reference composes fc+fc+mixed."""
+    name = _name(name, "gated_unit_layer")
+    src = _one(input)
+    proj = fc_layer(src, size, act=act or LinearActivation(),
+                    name=f"{name}_input_proj",
+                    param_attr=inproj_param_attr,
+                    bias_attr=inproj_bias_attr, layer_attr=inproj_attr)
+    gate = fc_layer(src, size, act=SigmoidActivation(),
+                    name=f"{name}_gate", param_attr=gate_param_attr,
+                    bias_attr=gate_bias_attr, layer_attr=gate_attr)
+    return mixed_layer(size=size, name=name,
+                       input=dotmul_operator(proj, gate),
+                       layer_attr=layer_attr)
+
+
+# ------------------------------------------------------------- structured
+def hsigmoid(input, label, num_classes=None, name=None, bias_attr=None,
+             param_attr=None, layer_attr=None):
+    ins = _many(input)
+    lab = _one(label)
+    num_classes = num_classes or lab.size
+    if isinstance(param_attr, (list, tuple)):
+        pas = [_pattr(a) for a in param_attr]
+    else:
+        pas = [_pattr(param_attr) for _ in ins]
+    return _layer(
+        _name(name, "hsigmoid"), "hsigmoid",
+        [Input(i.name, param_attr=a) for i, a in zip(ins, pas)]
+        + [Input(lab.name)],
+        bias=_battr(bias_attr),
+        attrs={"num_classes": num_classes}, layer_attr=layer_attr)
+
+
+def tensor_layer(a, b, size, act=None, name=None, param_attr=None,
+                 bias_attr=None, layer_attr=None):
+    return _layer(
+        _name(name, "tensor"), "tensor",
+        [Input(_one(a).name, param_attr=_pattr(param_attr)),
+         Input(_one(b).name)],
+        size=size, act=_act(act, LinearActivation),
+        bias=_battr(bias_attr), layer_attr=layer_attr)
+
+
+def selective_fc_layer(input, size, select=None, act=None, name=None,
+                       pass_generation=False, has_selected_colums=True,
+                       mul_ratio=0.02, param_attr=None, bias_attr=None,
+                       layer_attr=None):
+    ins = _many(input)
+    if isinstance(param_attr, (list, tuple)):
+        pas = [_pattr(a) for a in param_attr]
+    else:
+        pas = [_pattr(param_attr) for _ in ins]
+    inputs = [Input(i.name, param_attr=a) for i, a in zip(ins, pas)]
+    if select is not None:
+        inputs.append(Input(_one(select).name))
+    return _layer(
+        _name(name, "selective_fc"), "selective_fc", inputs, size=size,
+        act=_act(act), bias=_battr(bias_attr),
+        attrs={"selective_fc_pass_generation": pass_generation,
+               "has_selected_colums": has_selected_colums,
+               "selective_fc_full_mul_ratio": mul_ratio},
+        layer_attr=layer_attr)
+
+
+def sampling_id_layer(input, name=None, layer_attr=None):
+    return _layer(_name(name, "sampling_id"), "sampling_id",
+                  [Input(_one(input).name)], layer_attr=layer_attr)
+
+
+def slope_intercept_layer(input, name=None, slope=1.0, intercept=0.0,
+                          layer_attr=None):
+    return _layer(_name(name, "slope_intercept"), "slope_intercept",
+                  [Input(_one(input).name)],
+                  attrs={"slope": slope, "intercept": intercept},
+                  layer_attr=layer_attr)
+
+
+def linear_comb_layer(weights, vectors, size=None, name=None,
+                      layer_attr=None):
+    w, v = _one(weights), _one(vectors)
+    if size is None:
+        size = v.size // w.size
+    return _layer(_name(name, "linear_comb"), "convex_comb",
+                  [Input(w.name), Input(v.name)], size=size,
+                  layer_attr=layer_attr)
+
+
+convex_comb_layer = linear_comb_layer
+
+
+def conv_shift_layer(a, b, name=None, layer_attr=None):
+    return _layer(_name(name, "conv_shift"), "conv_shift",
+                  [Input(_one(a).name), Input(_one(b).name)],
+                  layer_attr=layer_attr)
+
+
+def multiplex_layer(input, name=None, layer_attr=None):
+    ins = _many(input)
+    return _layer(_name(name, "multiplex"), "multiplex",
+                  [Input(i.name) for i in ins], layer_attr=layer_attr)
+
+
+def row_conv_layer(input, context_len, act=None, name=None,
+                   param_attr=None, layer_attr=None):
+    return _layer(
+        _name(name, "row_conv_layer"), "row_conv",
+        [Input(_one(input).name, param_attr=_pattr(param_attr))],
+        act=_act(act, LinearActivation),
+        attrs={"context_length": context_len}, layer_attr=layer_attr)
+
+
+def sub_nested_seq_layer(input, selected_indices, name=None):
+    return _layer(_name(name, "sub_nested_seq"), "sub_nested_seq",
+                  [Input(_one(input).name),
+                   Input(_one(selected_indices).name)])
+
+
+def clip_layer(input, min, max, name=None):
+    return _layer(_name(name, "clip"), "clip",
+                  [Input(_one(input).name)],
+                  attrs={"min": min, "max": max})
+
+
+# ---------------------------------------------------------------- detection
+def priorbox_layer(input, image, aspect_ratio, variance, min_size,
+                   max_size=[], name=None):
+    return dsl.priorbox_layer(_one(input), _one(image), min_size=min_size,
+                              max_size=max_size, aspect_ratio=aspect_ratio,
+                              variance=variance, name=_name(name,
+                                                            "priorbox"))
+
+
+def multibox_loss_layer(input_loc, input_conf, priorbox, label, num_classes,
+                        overlap_threshold=0.5, neg_pos_ratio=3.0,
+                        neg_overlap=0.5, background_id=0, name=None):
+    loc = _many(input_loc)
+    conf = _many(input_conf)
+    return dsl.multibox_loss_layer(
+        _one(priorbox), _one(label), conf[0], loc[0],
+        num_classes=num_classes, overlap_threshold=overlap_threshold,
+        neg_pos_ratio=neg_pos_ratio, neg_overlap=neg_overlap,
+        background_id=background_id,
+        name=_name(name, "multibox_loss"))
+
+
+def detection_output_layer(input_loc, input_conf, priorbox, num_classes,
+                           nms_threshold=0.45, nms_top_k=400,
+                           keep_top_k=200, confidence_threshold=0.01,
+                           background_id=0, name=None):
+    loc = _many(input_loc)
+    conf = _many(input_conf)
+    return dsl.detection_output_layer(
+        _one(priorbox), conf[0], loc[0], num_classes=num_classes,
+        nms_threshold=nms_threshold, nms_top_k=nms_top_k,
+        keep_top_k=keep_top_k, confidence_threshold=confidence_threshold,
+        background_id=background_id,
+        name=_name(name, "detection_output"))
+
+
+# -------------------------------------------------------------------- costs
+def _cost(name, prefix, type_, inputs, coeff=1.0, attrs=None,
+          layer_attr=None):
+    at = {"coeff": coeff}
+    at.update(attrs or {})
+    return _layer(_name(name, prefix), type_,
+                  [Input(i.name) for i in inputs], attrs=at,
+                  layer_attr=layer_attr)
+
+
+def classification_cost(input, label, weight=None, name=None,
+                        evaluator=None, top_k=None, layer_attr=None,
+                        coeff=1.0):
+    ins = [_one(input), _one(label)]
+    if weight is not None:
+        ins.append(_one(weight))
+    return _cost(name, "cost", "multi-class-cross-entropy", ins,
+                 coeff=coeff, layer_attr=layer_attr)
+
+
+def cross_entropy(input, label, name=None, coeff=1.0, weight=None,
+                  layer_attr=None):
+    ins = [_one(input), _one(label)]
+    if weight is not None:
+        ins.append(_one(weight))
+    return _cost(name, "cross_entropy", "multi-class-cross-entropy", ins,
+                 coeff=coeff, layer_attr=layer_attr)
+
+
+def cross_entropy_with_selfnorm(input, label, name=None, coeff=1.0,
+                                softmax_selfnorm_alpha=0.1,
+                                layer_attr=None):
+    return _cost(name, "cross_entropy_with_selfnorm",
+                 "multi_class_cross_entropy_with_selfnorm",
+                 [_one(input), _one(label)], coeff=coeff,
+                 attrs={"softmax_selfnorm_alpha": softmax_selfnorm_alpha},
+                 layer_attr=layer_attr)
+
+
+def mse_cost(input, label, weight=None, name=None, coeff=1.0,
+             layer_attr=None):
+    ins = [_one(input), _one(label)]
+    if weight is not None:
+        ins.append(_one(weight))
+    return _cost(name, "mse_cost", "square_error", ins, coeff=coeff,
+                 layer_attr=layer_attr)
+
+
+regression_cost = mse_cost
+
+
+def multi_binary_label_cross_entropy(input, label, name=None, coeff=1.0,
+                                     layer_attr=None):
+    return _cost(name, "multi_binary_label_cross_entropy",
+                 "multi_binary_label_cross_entropy",
+                 [_one(input), _one(label)], coeff=coeff,
+                 layer_attr=layer_attr)
+
+
+def sum_cost(input, name=None, layer_attr=None):
+    return _cost(name, "sum_cost", "sum_cost", [_one(input)],
+                 layer_attr=layer_attr)
+
+
+def rank_cost(left, right, label, weight=None, name=None, coeff=1.0,
+              layer_attr=None):
+    ins = [_one(left), _one(right), _one(label)]
+    if weight is not None:
+        ins.append(_one(weight))
+    return _cost(name, "rank_cost", "rank-cost", ins, coeff=coeff,
+                 layer_attr=layer_attr)
+
+
+def lambda_cost(input, score, name=None, NDCG_num=5, max_sort_size=-1,
+                layer_attr=None):
+    return _cost(name, "lambda_cost", "lambda_cost",
+                 [_one(input), _one(score)],
+                 attrs={"NDCG_num": NDCG_num,
+                        "max_sort_size": max_sort_size},
+                 layer_attr=layer_attr)
+
+
+def huber_cost(input, label, name=None, coeff=1.0, layer_attr=None):
+    return _cost(name, "huber_cost", "huber", [_one(input), _one(label)],
+                 coeff=coeff, layer_attr=layer_attr)
+
+
+def smooth_l1_cost(input, label, name=None, coeff=1.0, layer_attr=None):
+    return _cost(name, "smooth_l1", "smooth_l1",
+                 [_one(input), _one(label)], coeff=coeff,
+                 layer_attr=layer_attr)
+
+
+def ctc_layer(input, label, size=None, name=None, norm_by_times=False,
+              layer_attr=None):
+    inp, lab = _one(input), _one(label)
+    size = size or inp.size
+    return _layer(_name(name, "ctc_layer"), "ctc",
+                  [Input(inp.name), Input(lab.name)], size=size,
+                  attrs={"norm_by_times": norm_by_times},
+                  layer_attr=layer_attr)
+
+
+def warp_ctc_layer(input, label, size=None, name=None, blank=0,
+                   norm_by_times=False, layer_attr=None):
+    inp, lab = _one(input), _one(label)
+    size = size or inp.size + 1
+    return _layer(_name(name, "warp_ctc_layer"), "warp_ctc",
+                  [Input(inp.name), Input(lab.name)], size=size,
+                  attrs={"norm_by_times": norm_by_times, "blank": blank},
+                  layer_attr=layer_attr)
+
+
+def crf_layer(input, label, size=None, weight=None, param_attr=None,
+              name=None, coeff=1.0, layer_attr=None):
+    inp, lab = _one(input), _one(label)
+    size = size or inp.size
+    ins = [Input(inp.name, param_attr=_pattr(param_attr)),
+           Input(lab.name)]
+    if weight is not None:
+        ins.append(Input(_one(weight).name))
+    return _layer(_name(name, "crf_layer"), "crf", ins, size=size,
+                  attrs={"coeff": coeff}, layer_attr=layer_attr)
+
+
+def crf_decoding_layer(input, size=None, label=None, param_attr=None,
+                       name=None, layer_attr=None):
+    inp = _one(input)
+    size = size or inp.size
+    ins = [Input(inp.name, param_attr=_pattr(param_attr))]
+    if label is not None:
+        ins.append(Input(_one(label).name))
+    return _layer(_name(name, "crf_decoding_layer"), "crf_decoding", ins,
+                  size=size, layer_attr=layer_attr)
+
+
+def nce_layer(input, label, num_classes=None, act=None, param_attr=None,
+              weight=None, num_neg_samples=10, neg_distribution=None,
+              name=None, bias_attr=None, layer_attr=None):
+    ins = _many(input)
+    lab = _one(label)
+    num_classes = num_classes or lab.size
+    if isinstance(param_attr, (list, tuple)):
+        pas = [_pattr(a) for a in param_attr]
+    else:
+        pas = [_pattr(param_attr) for _ in ins]
+    inputs = [Input(i.name, param_attr=a) for i, a in zip(ins, pas)]
+    inputs.append(Input(lab.name))
+    if weight is not None:
+        inputs.append(Input(_one(weight).name))
+    return _layer(
+        _name(name, "nce_layer"), "nce", inputs,
+        bias=_battr(bias_attr),
+        attrs={"num_classes": num_classes,
+               "num_neg_samples": num_neg_samples,
+               "neg_sampling_dist": neg_distribution},
+        layer_attr=layer_attr)

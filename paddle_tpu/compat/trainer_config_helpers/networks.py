@@ -1,0 +1,452 @@
+"""``paddle.trainer_config_helpers.networks`` surface.
+
+The composite network presets (`trainer_config_helpers/networks.py`,
+1500 LoC): vgg/conv groups, simple/bidirectional LSTM & GRU, the
+``simple_attention`` block (north-star NMT dependency), sequence
+conv-pool, and the ``inputs``/``outputs`` declarations. Compositions
+follow the reference's layer algebra; every building block is a compat
+helper from layers.py so naming/parameters match.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.compat import config_parser as _cp
+from paddle_tpu.compat.trainer_config_helpers.activations import (
+    IdentityActivation, LinearActivation, ReluActivation,
+    SequenceSoftmaxActivation, SigmoidActivation, SoftmaxActivation,
+    TanhActivation)
+from paddle_tpu.compat.trainer_config_helpers.attrs import ExtraAttr
+from paddle_tpu.compat.trainer_config_helpers.layers import (
+    LayerOutput, batch_norm_layer, context_projection, dropout_layer,
+    expand_layer, fc_layer, full_matrix_projection, grumemory,
+    gru_step_layer, identity_projection, img_conv_layer, img_pool_layer,
+    lstm_step_layer, lstmemory, memory, mixed_layer, pooling_layer,
+    recurrent_group, scaling_layer, concat_layer)
+from paddle_tpu.compat.trainer_config_helpers.poolings import (MaxPooling,
+                                                               SumPooling)
+
+__all__ = [
+    'sequence_conv_pool', 'simple_lstm', 'simple_img_conv_pool',
+    'img_conv_bn_pool', 'lstmemory_group', 'lstmemory_unit', 'small_vgg',
+    'img_conv_group', 'vgg_16_network', 'gru_unit', 'gru_group',
+    'simple_gru', 'simple_attention', 'simple_gru2', 'bidirectional_gru',
+    'text_conv_pool', 'bidirectional_lstm', 'inputs', 'outputs',
+]
+
+
+def _name(name, prefix):
+    return name if name is not None else _cp.ctx().auto_name(prefix)
+
+
+# ------------------------------------------------------------------- text
+def sequence_conv_pool(input, context_len, hidden_size, name=None,
+                       context_start=None, pool_type=None,
+                       context_proj_layer_name=None,
+                       context_proj_param_attr=False, fc_layer_name=None,
+                       fc_param_attr=None, fc_bias_attr=None, fc_act=None,
+                       pool_bias_attr=None, fc_attr=None, context_attr=None,
+                       pool_attr=None):
+    """Context projection -> fc -> sequence pooling (text CNN)."""
+    name = _name(name, "sequence_conv_pool")
+    proj_name = context_proj_layer_name or f"{name}_conv_proj"
+    with mixed_layer(name=proj_name, size=input.size * context_len,
+                     act=LinearActivation(), layer_attr=context_attr) as m:
+        m += context_projection(input, context_len=context_len,
+                                context_start=context_start,
+                                padding_attr=context_proj_param_attr)
+    fl = fc_layer(input=m._finalize(), size=hidden_size,
+                  name=fc_layer_name or f"{name}_conv_fc", act=fc_act,
+                  layer_attr=fc_attr, param_attr=fc_param_attr,
+                  bias_attr=fc_bias_attr)
+    return pooling_layer(name=name, input=fl, pooling_type=pool_type,
+                         bias_attr=pool_bias_attr, layer_attr=pool_attr)
+
+
+text_conv_pool = sequence_conv_pool
+
+
+# ----------------------------------------------------------------- images
+def simple_img_conv_pool(input, filter_size, num_filters, pool_size,
+                         name=None, pool_type=None, act=None, groups=1,
+                         conv_stride=1, conv_padding=0, bias_attr=None,
+                         num_channel=None, param_attr=None,
+                         shared_bias=True, conv_layer_attr=None,
+                         pool_stride=1, pool_padding=0,
+                         pool_layer_attr=None):
+    name = _name(name, "conv_pool")
+    conv = img_conv_layer(
+        input=input, filter_size=filter_size, num_filters=num_filters,
+        name=f"{name}_conv", act=act, groups=groups, stride=conv_stride,
+        padding=conv_padding, bias_attr=bias_attr,
+        num_channels=num_channel, param_attr=param_attr,
+        shared_biases=shared_bias, layer_attr=conv_layer_attr)
+    return img_pool_layer(input=conv, pool_size=pool_size, name=name,
+                          pool_type=pool_type, stride=pool_stride,
+                          padding=pool_padding,
+                          layer_attr=pool_layer_attr)
+
+
+def img_conv_bn_pool(input, filter_size, num_filters, pool_size, name=None,
+                     pool_type=None, act=None, groups=1, conv_stride=1,
+                     conv_padding=0, conv_bias_attr=None, num_channel=None,
+                     conv_param_attr=None, shared_bias=True,
+                     conv_layer_attr=None, bn_param_attr=None,
+                     bn_bias_attr=None, bn_layer_attr=None, pool_stride=1,
+                     pool_padding=0, pool_layer_attr=None):
+    name = _name(name, "conv_bn_pool")
+    conv = img_conv_layer(
+        input=input, filter_size=filter_size, num_filters=num_filters,
+        name=f"{name}_conv", act=LinearActivation(), groups=groups,
+        stride=conv_stride, padding=conv_padding,
+        bias_attr=conv_bias_attr, num_channels=num_channel,
+        param_attr=conv_param_attr, shared_biases=shared_bias,
+        layer_attr=conv_layer_attr)
+    bn = batch_norm_layer(input=conv, act=act, name=f"{name}_bn",
+                          bias_attr=bn_bias_attr, param_attr=bn_param_attr,
+                          layer_attr=bn_layer_attr)
+    return img_pool_layer(input=bn, pool_size=pool_size, name=name,
+                          pool_type=pool_type, stride=pool_stride,
+                          padding=pool_padding,
+                          layer_attr=pool_layer_attr)
+
+
+def img_conv_group(input, conv_num_filter, pool_size, num_channels=None,
+                   conv_padding=1, conv_filter_size=3, conv_act=None,
+                   conv_with_batchnorm=False, conv_batchnorm_drop_rate=0,
+                   pool_stride=1, pool_type=None, param_attr=None):
+    """Chained conv(+bn+dropout) blocks ending in one pool — the vgg
+    building block."""
+    tmp = input
+    if not isinstance(tmp, LayerOutput):
+        raise TypeError("img_conv_group input must be a LayerOutput")
+    n = len(conv_num_filter)
+
+    def ext(v):
+        return list(v) if hasattr(v, "__len__") else [v] * n
+
+    conv_padding = ext(conv_padding)
+    conv_filter_size = ext(conv_filter_size)
+    conv_act = ext(conv_act)
+    conv_with_batchnorm = ext(conv_with_batchnorm)
+    conv_batchnorm_drop_rate = ext(conv_batchnorm_drop_rate)
+
+    for i in range(n):
+        extra = {}
+        if num_channels is not None:
+            extra["num_channels"] = num_channels
+            num_channels = None
+        extra["act"] = LinearActivation() if conv_with_batchnorm[i] \
+            else conv_act[i]
+        tmp = img_conv_layer(input=tmp, padding=conv_padding[i],
+                             filter_size=conv_filter_size[i],
+                             num_filters=conv_num_filter[i],
+                             param_attr=param_attr, **extra)
+        if conv_with_batchnorm[i]:
+            drop = conv_batchnorm_drop_rate[i]
+            if drop and abs(drop) >= 1e-5:
+                tmp = batch_norm_layer(input=tmp, act=conv_act[i],
+                                       layer_attr=ExtraAttr(drop_rate=drop))
+            else:
+                tmp = batch_norm_layer(input=tmp, act=conv_act[i])
+    return img_pool_layer(input=tmp, stride=pool_stride,
+                          pool_size=pool_size, pool_type=pool_type)
+
+
+def small_vgg(input_image, num_channels, num_classes):
+    def block(ipt, num_filter, times, dropouts, chans=None):
+        return img_conv_group(
+            input=ipt, num_channels=chans, pool_size=2, pool_stride=2,
+            conv_num_filter=[num_filter] * times, conv_filter_size=3,
+            conv_act=ReluActivation(), conv_with_batchnorm=True,
+            conv_batchnorm_drop_rate=dropouts, pool_type=MaxPooling())
+
+    tmp = block(input_image, 64, 2, [0.3, 0], num_channels)
+    tmp = block(tmp, 128, 2, [0.4, 0])
+    tmp = block(tmp, 256, 3, [0.4, 0.4, 0])
+    tmp = block(tmp, 512, 3, [0.4, 0.4, 0])
+    tmp = img_pool_layer(input=tmp, stride=2, pool_size=2,
+                         pool_type=MaxPooling())
+    tmp = dropout_layer(input=tmp, dropout_rate=0.5)
+    tmp = fc_layer(input=tmp, size=512, act=LinearActivation(),
+                   layer_attr=ExtraAttr(drop_rate=0.5))
+    tmp = batch_norm_layer(input=tmp, act=ReluActivation())
+    return fc_layer(input=tmp, size=num_classes, act=SoftmaxActivation())
+
+
+def vgg_16_network(input_image, num_channels, num_classes=1000):
+    def group(ipt, filters, chans=None):
+        return img_conv_group(
+            input=ipt, num_channels=chans, conv_padding=1,
+            conv_num_filter=filters, conv_filter_size=3,
+            conv_act=ReluActivation(), pool_size=2, pool_stride=2,
+            pool_type=MaxPooling())
+
+    tmp = group(input_image, [64, 64], num_channels)
+    tmp = group(tmp, [128, 128])
+    tmp = group(tmp, [256, 256, 256])
+    tmp = group(tmp, [512, 512, 512])
+    tmp = group(tmp, [512, 512, 512])
+    tmp = fc_layer(input=tmp, size=4096, act=ReluActivation(),
+                   layer_attr=ExtraAttr(drop_rate=0.5))
+    tmp = fc_layer(input=tmp, size=4096, act=ReluActivation(),
+                   layer_attr=ExtraAttr(drop_rate=0.5))
+    return fc_layer(input=tmp, size=num_classes, act=SoftmaxActivation())
+
+
+# -------------------------------------------------------------- recurrent
+def simple_lstm(input, size, name=None, reverse=False, mat_param_attr=None,
+                bias_param_attr=None, inner_param_attr=None, act=None,
+                gate_act=None, state_act=None, mixed_layer_attr=None,
+                lstm_cell_attr=None):
+    """mixed(full-matrix, 4*size) -> lstmemory."""
+    name = _name(name, "lstm")
+    m = mixed_layer(name=f"lstm_transform_{name}", size=size * 4,
+                    act=IdentityActivation(), bias_attr=False,
+                    layer_attr=mixed_layer_attr,
+                    input=full_matrix_projection(
+                        input, param_attr=mat_param_attr))
+    return lstmemory(name=name, input=m, reverse=reverse,
+                     bias_attr=bias_param_attr, param_attr=inner_param_attr,
+                     act=act, gate_act=gate_act, state_act=state_act,
+                     layer_attr=lstm_cell_attr)
+
+
+def lstmemory_unit(input, out_memory=None, name=None, size=None,
+                   param_attr=None, act=None, gate_act=None, state_act=None,
+                   input_proj_bias_attr=None, input_proj_layer_attr=None,
+                   lstm_bias_attr=None, lstm_layer_attr=None):
+    """Single-timestep LSTM block for recurrent_group (attention
+    decoders)."""
+    if size is None:
+        size = input.size // 4
+    name = _name(name, "lstm_unit")
+    if out_memory is None:
+        out_mem = memory(name=name, size=size)
+    else:
+        out_mem = out_memory
+    state_mem = memory(name=f"{name}_state", size=size)
+    with mixed_layer(name=f"{name}_input_recurrent", size=size * 4,
+                     bias_attr=input_proj_bias_attr,
+                     layer_attr=input_proj_layer_attr,
+                     act=IdentityActivation()) as m:
+        m += identity_projection(input=input)
+        m += full_matrix_projection(input=out_mem, param_attr=param_attr)
+    lstm_step = lstm_step_layer(
+        name=name, input=m._finalize(), state=state_mem, size=size,
+        bias_attr=lstm_bias_attr, act=act, gate_act=gate_act,
+        state_act=state_act, layer_attr=lstm_layer_attr)
+    from paddle_tpu.compat.trainer_config_helpers.layers import (
+        get_output_layer)
+    get_output_layer(name=f"{name}_state", input=lstm_step,
+                     arg_name="state")
+    return lstm_step
+
+
+def lstmemory_group(input, size=None, name=None, out_memory=None,
+                    reverse=False, param_attr=None, act=None, gate_act=None,
+                    state_act=None, input_proj_bias_attr=None,
+                    input_proj_layer_attr=None, lstm_bias_attr=None,
+                    lstm_layer_attr=None):
+    """LSTM via recurrent_group (flexible form of simple_lstm)."""
+    if size is None:
+        size = input.size // 4
+    name = _name(name, "lstm_group")
+
+    def step(x):
+        return lstmemory_unit(
+            input=x, name=f"{name}_recurrent", size=size,
+            param_attr=param_attr, act=act, gate_act=gate_act,
+            state_act=state_act, out_memory=out_memory,
+            input_proj_bias_attr=input_proj_bias_attr,
+            input_proj_layer_attr=input_proj_layer_attr,
+            lstm_bias_attr=lstm_bias_attr, lstm_layer_attr=lstm_layer_attr)
+
+    return recurrent_group(name=name, step=step, reverse=reverse,
+                           input=input)
+
+
+def gru_unit(input, memory_boot=None, size=None, name=None, gru_bias_attr=None,
+             gru_param_attr=None, act=None, gate_act=None,
+             gru_layer_attr=None, naive=False):
+    name = _name(name, "gru_unit")
+    if size is None:
+        size = input.size // 3
+    out_mem = memory(name=name, size=size, boot_layer=memory_boot)
+    return gru_step_layer(name=name, input=input, output_mem=out_mem,
+                          size=size, bias_attr=gru_bias_attr,
+                          param_attr=gru_param_attr, act=act,
+                          gate_act=gate_act, layer_attr=gru_layer_attr)
+
+
+def gru_group(input, memory_boot=None, size=None, name=None, reverse=False,
+              gru_bias_attr=None, gru_param_attr=None, act=None,
+              gate_act=None, gru_layer_attr=None, naive=False):
+    name = _name(name, "gru_group")
+
+    def step(x):
+        return gru_unit(input=x, memory_boot=memory_boot, name=f"{name}_recurrent",
+                        size=size, gru_bias_attr=gru_bias_attr,
+                        gru_param_attr=gru_param_attr, act=act,
+                        gate_act=gate_act, gru_layer_attr=gru_layer_attr,
+                        naive=naive)
+
+    return recurrent_group(name=name, step=step, reverse=reverse,
+                           input=input)
+
+
+def simple_gru(input, size, name=None, reverse=False, mixed_param_attr=None,
+               mixed_bias_param_attr=None, mixed_layer_attr=None,
+               gru_bias_attr=None, gru_param_attr=None, act=None,
+               gate_act=None, gru_layer_attr=None, naive=False):
+    name = _name(name, "gru")
+    m = mixed_layer(name=f"{name}_transform", size=size * 3,
+                    bias_attr=mixed_bias_param_attr,
+                    layer_attr=mixed_layer_attr,
+                    input=full_matrix_projection(
+                        input, param_attr=mixed_param_attr))
+    return gru_group(name=name, size=size, input=m, reverse=reverse,
+                     gru_bias_attr=gru_bias_attr,
+                     gru_param_attr=gru_param_attr, act=act,
+                     gate_act=gate_act, gru_layer_attr=gru_layer_attr,
+                     naive=naive)
+
+
+def simple_gru2(input, size, name=None, reverse=False, mixed_param_attr=None,
+                mixed_bias_attr=None, gru_param_attr=None,
+                gru_bias_attr=None, act=None, gate_act=None,
+                mixed_layer_attr=None, gru_cell_attr=None):
+    """Same math as simple_gru through the fused grumemory layer."""
+    name = _name(name, "gru")
+    m = mixed_layer(name=f"{name}_transform", size=size * 3,
+                    bias_attr=mixed_bias_attr,
+                    layer_attr=mixed_layer_attr,
+                    input=full_matrix_projection(
+                        input, param_attr=mixed_param_attr))
+    return grumemory(name=name, input=m, reverse=reverse,
+                     bias_attr=gru_bias_attr, param_attr=gru_param_attr,
+                     act=act, gate_act=gate_act, layer_attr=gru_cell_attr)
+
+
+def bidirectional_gru(input, size, name=None, return_seq=False,
+                      fwd_mixed_param_attr=None, fwd_mixed_bias_attr=None,
+                      fwd_gru_param_attr=None, fwd_gru_bias_attr=None,
+                      fwd_act=None, fwd_gate_act=None,
+                      fwd_mixed_layer_attr=None, fwd_gru_layer_attr=None,
+                      bwd_mixed_param_attr=None, bwd_mixed_bias_attr=None,
+                      bwd_gru_param_attr=None, bwd_gru_bias_attr=None,
+                      bwd_act=None, bwd_gate_act=None,
+                      bwd_mixed_layer_attr=None, bwd_gru_layer_attr=None,
+                      last_seq_attr=None, first_seq_attr=None,
+                      concat_attr=None, concat_act=None):
+    name = _name(name, "bidirectional_gru")
+    fw = simple_gru2(input=input, size=size, name=f"{name}_fw",
+                     mixed_param_attr=fwd_mixed_param_attr,
+                     mixed_bias_attr=fwd_mixed_bias_attr,
+                     gru_param_attr=fwd_gru_param_attr,
+                     gru_bias_attr=fwd_gru_bias_attr, act=fwd_act,
+                     gate_act=fwd_gate_act,
+                     mixed_layer_attr=fwd_mixed_layer_attr,
+                     gru_cell_attr=fwd_gru_layer_attr)
+    bw = simple_gru2(input=input, size=size, name=f"{name}_bw",
+                     reverse=True, mixed_param_attr=bwd_mixed_param_attr,
+                     mixed_bias_attr=bwd_mixed_bias_attr,
+                     gru_param_attr=bwd_gru_param_attr,
+                     gru_bias_attr=bwd_gru_bias_attr, act=bwd_act,
+                     gate_act=bwd_gate_act,
+                     mixed_layer_attr=bwd_mixed_layer_attr,
+                     gru_cell_attr=bwd_gru_layer_attr)
+    if return_seq:
+        return concat_layer(input=[fw, bw], layer_attr=concat_attr,
+                            act=concat_act, name=name)
+    from paddle_tpu.compat.trainer_config_helpers.layers import (first_seq,
+                                                                 last_seq)
+    fw_seq = last_seq(input=fw, layer_attr=last_seq_attr,
+                      name=f"{name}_fw_last")
+    bw_seq = first_seq(input=bw, layer_attr=first_seq_attr,
+                       name=f"{name}_bw_first")
+    return concat_layer(input=[fw_seq, bw_seq], layer_attr=concat_attr,
+                        act=concat_act, name=name)
+
+
+def bidirectional_lstm(input, size, name=None, return_seq=False,
+                       fwd_mat_param_attr=None, fwd_bias_param_attr=None,
+                       fwd_inner_param_attr=None, fwd_act=None,
+                       fwd_gate_act=None, fwd_state_act=None,
+                       fwd_mixed_layer_attr=None, fwd_lstm_cell_attr=None,
+                       bwd_mat_param_attr=None, bwd_bias_param_attr=None,
+                       bwd_inner_param_attr=None, bwd_act=None,
+                       bwd_gate_act=None, bwd_state_act=None,
+                       bwd_mixed_layer_attr=None, bwd_lstm_cell_attr=None,
+                       last_seq_attr=None, first_seq_attr=None,
+                       concat_attr=None, concat_act=None):
+    name = _name(name, "bidirectional_lstm")
+    fw = simple_lstm(input=input, size=size, name=f"{name}_fw",
+                     mat_param_attr=fwd_mat_param_attr,
+                     bias_param_attr=fwd_bias_param_attr,
+                     inner_param_attr=fwd_inner_param_attr, act=fwd_act,
+                     gate_act=fwd_gate_act, state_act=fwd_state_act,
+                     mixed_layer_attr=fwd_mixed_layer_attr,
+                     lstm_cell_attr=fwd_lstm_cell_attr)
+    bw = simple_lstm(input=input, size=size, name=f"{name}_bw",
+                     reverse=True, mat_param_attr=bwd_mat_param_attr,
+                     bias_param_attr=bwd_bias_param_attr,
+                     inner_param_attr=bwd_inner_param_attr, act=bwd_act,
+                     gate_act=bwd_gate_act, state_act=bwd_state_act,
+                     mixed_layer_attr=bwd_mixed_layer_attr,
+                     lstm_cell_attr=bwd_lstm_cell_attr)
+    if return_seq:
+        return concat_layer(input=[fw, bw], layer_attr=concat_attr,
+                            act=concat_act, name=name)
+    from paddle_tpu.compat.trainer_config_helpers.layers import (first_seq,
+                                                                 last_seq)
+    fw_seq = last_seq(input=fw, layer_attr=last_seq_attr,
+                      name=f"{name}_fw_last")
+    bw_seq = first_seq(input=bw, layer_attr=first_seq_attr,
+                       name=f"{name}_bw_first")
+    return concat_layer(input=[fw_seq, bw_seq], layer_attr=concat_attr,
+                        act=concat_act, name=name)
+
+
+# -------------------------------------------------------------- attention
+def simple_attention(encoded_sequence, encoded_proj, decoder_state,
+                     transform_param_attr=None, softmax_param_attr=None,
+                     weight_act=None, name=None):
+    """Additive (Bahdanau) attention: returns the context vector
+    (`networks.py simple_attention`; the NMT north-star block)."""
+    name = _name(name, "attention")
+    if encoded_proj.size != decoder_state.size:
+        raise ValueError("encoded_proj and decoder_state sizes must match")
+    proj_size = encoded_proj.size
+
+    m = mixed_layer(size=proj_size, name=f"{name}_transform",
+                    input=full_matrix_projection(
+                        decoder_state, param_attr=transform_param_attr))
+    expanded = expand_layer(input=m, expand_as=encoded_sequence,
+                            name=f"{name}_expand")
+    with mixed_layer(size=proj_size, act=weight_act,
+                     name=f"{name}_combine") as comb:
+        comb += identity_projection(expanded)
+        comb += identity_projection(encoded_proj)
+    attention_weight = fc_layer(input=comb._finalize(), size=1,
+                                act=SequenceSoftmaxActivation(),
+                                param_attr=softmax_param_attr,
+                                name=f"{name}_softmax", bias_attr=False)
+    scaled = scaling_layer(weight=attention_weight, input=encoded_sequence,
+                           name=f"{name}_scaling")
+    return pooling_layer(input=scaled, pooling_type=SumPooling(),
+                         name=f"{name}_pooling")
+
+
+# ------------------------------------------------------------ declarations
+def inputs(layers, *args):
+    if isinstance(layers, (LayerOutput, str)):
+        layers = [layers]
+    layers = list(layers) + list(args)
+    _cp.inputs(*layers)
+
+
+def outputs(layers, *args):
+    if isinstance(layers, (LayerOutput, str)):
+        layers = [layers]
+    layers = list(layers) + list(args)
+    _cp.outputs(*layers)

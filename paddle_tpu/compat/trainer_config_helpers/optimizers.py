@@ -1,0 +1,254 @@
+"""``paddle.trainer_config_helpers.optimizers`` surface.
+
+``settings(...)`` plus the optimizer/regularization/model-average objects
+(`trainer_config_helpers/optimizers.py`). ``settings`` records everything
+into the active ConfigContext; ``build_optimizer`` turns the recorded
+state into the engine's Optimizer (paddle_tpu/optim/optimizers.py) whose
+update formulas already match the v1 semantics.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.compat import config_parser as _cp
+from paddle_tpu.optim import optimizers as _opt
+
+__all__ = [
+    "Optimizer", "BaseSGDOptimizer", "MomentumOptimizer", "AdamaxOptimizer",
+    "AdamOptimizer", "AdaGradOptimizer", "RMSPropOptimizer",
+    "DecayedAdaGradOptimizer", "AdaDeltaOptimizer", "BaseRegularization",
+    "L2Regularization", "settings", "ModelAverage",
+    "GradientClippingThreshold",
+]
+
+
+class Optimizer:
+    """Base marker; subclasses carry their hyper-parameters and know how
+    to instantiate the engine optimizer."""
+
+    learning_method = "momentum"
+
+    def engine_kwargs(self):
+        return {}
+
+    def extra_settings(self):
+        """OptimizationConfig fields this method implies."""
+        return {"learning_method": self.learning_method}
+
+
+class BaseSGDOptimizer(Optimizer):
+    pass
+
+
+class MomentumOptimizer(BaseSGDOptimizer):
+    """SGD with momentum; ``sparse=True`` asks for sparse-momentum updates
+    on sparse-gradient parameters."""
+
+    learning_method = "momentum"
+
+    def __init__(self, momentum=None, sparse=False):
+        self.momentum = 1e-3 if momentum is None else momentum
+        self.sparse = sparse
+
+    def engine_kwargs(self):
+        return {"momentum": self.momentum}
+
+    def extra_settings(self):
+        return {"learning_method": "momentum", "momentum": self.momentum}
+
+    def engine_class(self):
+        return _opt.Momentum
+
+
+class AdamOptimizer(Optimizer):
+    learning_method = "adam"
+
+    def __init__(self, beta1=0.9, beta2=0.999, epsilon=1e-8):
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def engine_kwargs(self):
+        return {"beta1": self.beta1, "beta2": self.beta2,
+                "epsilon": self.epsilon}
+
+    def extra_settings(self):
+        return {"learning_method": "adam", "adam_beta1": self.beta1,
+                "adam_beta2": self.beta2, "adam_epsilon": self.epsilon}
+
+    def engine_class(self):
+        return _opt.Adam
+
+
+class AdamaxOptimizer(Optimizer):
+    learning_method = "adamax"
+
+    def __init__(self, beta1=0.9, beta2=0.999):
+        self.beta1, self.beta2 = beta1, beta2
+
+    def engine_kwargs(self):
+        return {"beta1": self.beta1, "beta2": self.beta2}
+
+    def extra_settings(self):
+        return {"learning_method": "adamax", "adam_beta1": self.beta1,
+                "adam_beta2": self.beta2}
+
+    def engine_class(self):
+        return _opt.Adamax
+
+
+class AdaGradOptimizer(Optimizer):
+    learning_method = "adagrad"
+
+    def __init__(self):
+        pass
+
+    def engine_class(self):
+        return _opt.AdaGrad
+
+
+class DecayedAdaGradOptimizer(Optimizer):
+    learning_method = "decayed_adagrad"
+
+    def __init__(self, rho=0.95, epsilon=1e-6):
+        self.rho, self.epsilon = rho, epsilon
+
+    def engine_kwargs(self):
+        return {"rou": self.rho, "epsilon": self.epsilon}
+
+    def extra_settings(self):
+        return {"learning_method": "decayed_adagrad",
+                "ada_rou": self.rho, "ada_epsilon": self.epsilon}
+
+    def engine_class(self):
+        return _opt.DecayedAdaGrad
+
+
+class AdaDeltaOptimizer(Optimizer):
+    learning_method = "adadelta"
+
+    def __init__(self, rho=0.95, epsilon=1e-6):
+        self.rho, self.epsilon = rho, epsilon
+
+    def engine_kwargs(self):
+        return {"rou": self.rho, "epsilon": self.epsilon}
+
+    def extra_settings(self):
+        return {"learning_method": "adadelta",
+                "ada_rou": self.rho, "ada_epsilon": self.epsilon}
+
+    def engine_class(self):
+        return _opt.AdaDelta
+
+
+class RMSPropOptimizer(Optimizer):
+    learning_method = "rmsprop"
+
+    def __init__(self, rho=0.95, epsilon=1e-6):
+        self.rho, self.epsilon = rho, epsilon
+
+    def engine_kwargs(self):
+        return {"rou": self.rho, "epsilon": self.epsilon}
+
+    def extra_settings(self):
+        return {"learning_method": "rmsprop",
+                "ada_rou": self.rho, "ada_epsilon": self.epsilon}
+
+    def engine_class(self):
+        return _opt.RMSProp
+
+
+class BaseRegularization:
+    pass
+
+
+class L2Regularization(BaseRegularization):
+    def __init__(self, rate):
+        self.rate = rate
+
+    def extra_settings(self):
+        return {"l2weight": self.rate}
+
+
+class L1Regularization(BaseRegularization):
+    def __init__(self, rate):
+        self.rate = rate
+
+    def extra_settings(self):
+        return {"l1weight": self.rate}
+
+
+class ModelAverage:
+    """AverageOptimizer window (`parameter/AverageOptimizer.h:23`)."""
+
+    def __init__(self, average_window, max_average_window=None,
+                 do_average_in_cpu=False):
+        self.average_window = average_window
+        self.max_average_window = max_average_window
+        self.do_average_in_cpu = do_average_in_cpu
+
+
+class GradientClippingThreshold:
+    def __init__(self, threshold):
+        self.threshold = threshold
+
+
+def settings(batch_size,
+             learning_rate=1e-3,
+             learning_rate_decay_a=0.,
+             learning_rate_decay_b=0.,
+             learning_rate_schedule='poly',
+             learning_rate_args='',
+             async_lagged_grad_discard_ratio=1.5,
+             learning_method=None,
+             regularization=None,
+             is_async=False,
+             model_average=None,
+             gradient_clipping_threshold=None):
+    """Record the job-wide optimization settings
+    (``trainer_config_helpers/optimizers.py settings``)."""
+    c = _cp.ctx()
+    if learning_method is None:
+        learning_method = MomentumOptimizer()
+    if not isinstance(learning_method, Optimizer):
+        raise TypeError("learning_method must be an Optimizer instance")
+    s = c.settings
+    s["batch_size"] = batch_size
+    s["learning_rate"] = learning_rate
+    s["learning_rate_decay_a"] = learning_rate_decay_a
+    s["learning_rate_decay_b"] = learning_rate_decay_b
+    s["learning_rate_schedule"] = learning_rate_schedule
+    s["learning_rate_args"] = learning_rate_args
+    s["algorithm"] = "async_sgd" if is_async else "sgd"
+    s["async_lagged_grad_discard_ratio"] = async_lagged_grad_discard_ratio
+    s["learning_method"] = learning_method
+    s["regularization"] = regularization
+    if isinstance(model_average, ModelAverage):
+        s["model_average"] = model_average
+    if gradient_clipping_threshold is not None:
+        if isinstance(gradient_clipping_threshold, GradientClippingThreshold):
+            gradient_clipping_threshold = gradient_clipping_threshold.threshold
+        s["gradient_clipping_threshold"] = gradient_clipping_threshold
+
+
+def build_optimizer(s) -> _opt.Optimizer:
+    """ConfigContext.settings -> engine Optimizer."""
+    method = s.get("learning_method") or MomentumOptimizer()
+    cls = method.engine_class() if hasattr(method, "engine_class") \
+        else _opt.Momentum
+    kwargs = dict(
+        learning_rate=s.get("learning_rate") or 1e-3,
+        learning_rate_schedule=s.get("learning_rate_schedule", "constant"),
+        learning_rate_decay_a=s.get("learning_rate_decay_a", 0.0),
+        learning_rate_decay_b=s.get("learning_rate_decay_b", 0.0),
+        learning_rate_args=s.get("learning_rate_args", ""),
+        gradient_clipping_threshold=s.get(
+            "gradient_clipping_threshold", 0.0) or 0.0,
+    )
+    reg = s.get("regularization")
+    if isinstance(reg, L2Regularization):
+        kwargs["l2_rate"] = reg.rate
+    elif isinstance(reg, L1Regularization):
+        kwargs["l1_rate"] = reg.rate
+    avg = s.get("model_average")
+    if isinstance(avg, ModelAverage):
+        kwargs["average_window"] = avg.average_window
+    kwargs.update(method.engine_kwargs())
+    return cls(**kwargs)

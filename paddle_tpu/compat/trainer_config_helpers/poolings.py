@@ -1,0 +1,57 @@
+"""``paddle.trainer_config_helpers.poolings`` surface
+(`trainer_config_helpers/poolings.py`): pooling-type objects whose
+``.name`` feeds PoolConfig.pool_type / sequence-pooling layer types.
+"""
+
+__all__ = ["BasePoolingType", "MaxPooling", "AvgPooling", "MaxWithMaskPooling",
+           "CudnnMaxPooling", "CudnnAvgPooling", "SumPooling",
+           "SquareRootNPooling"]
+
+
+class BasePoolingType:
+    def __init__(self, name):
+        self.name = name
+
+
+class MaxPooling(BasePoolingType):
+    """Max over window / sequence. ``output_max_index`` makes the sequence
+    pooling emit argmax indices instead of values."""
+
+    def __init__(self, output_max_index=None):
+        super().__init__("max")
+        self.output_max_index = output_max_index
+
+
+class MaxWithMaskPooling(BasePoolingType):
+    def __init__(self):
+        super().__init__("max-pool-with-mask")
+
+
+class CudnnMaxPooling(BasePoolingType):
+    def __init__(self):
+        super().__init__("cudnn-max-pool")
+
+
+class CudnnAvgPooling(BasePoolingType):
+    def __init__(self):
+        super().__init__("cudnn-avg-pool")
+
+
+class AvgPooling(BasePoolingType):
+    STRATEGY_AVG = "average"
+    STRATEGY_SUM = "sum"
+    STRATEGY_SQROOTN = "squarerootn"
+
+    def __init__(self, strategy=STRATEGY_AVG):
+        super().__init__("average")
+        self.strategy = strategy
+
+
+class SumPooling(AvgPooling):
+    def __init__(self):
+        super().__init__(AvgPooling.STRATEGY_SUM)
+
+
+class SquareRootNPooling(AvgPooling):
+    def __init__(self):
+        super().__init__(AvgPooling.STRATEGY_SQROOTN)
