@@ -1,32 +1,84 @@
-"""Benchmark harness. Prints ONE JSON line.
+"""Benchmark harness. Prints ONE JSON line — and cannot lose the result.
 
-Round-1 metric: the reference's headline RNN benchmark — IMDB-style LSTM
-text classification, batch 64, hidden 256, seqlen 100, dict 30k
-(``/root/reference/benchmark/paddle/rnn/rnn.py``; published number
-83 ms/batch on a K40m, ``benchmark/README.md:110-120``). We time the full
-jitted train step (forward+backward+update, the same thing
-``paddle_trainer --job=time`` measures) in steady state on one TPU chip.
+Two layers:
 
-vs_baseline = reference_ms / our_ms (>1 means faster than the reference).
+- **Orchestrator** (default): runs the measurement in a *subprocess* and
+  retries with long backoff when the TPU backend fails to initialize (the
+  tunnel drops intermittently; a fresh process is the only reliable way to
+  re-attempt backend setup, since jax caches a failed backend). On total
+  failure it still prints a JSON line carrying the error tail instead of a
+  bare traceback.
+- **Child** (``BENCH_CHILD=1``): the actual measurement.
+
+Metrics:
+
+- Primary: the reference's headline RNN benchmark — IMDB-style LSTM text
+  classification, batch 64, hidden 256, seqlen 100, dict 30k
+  (``/root/reference/benchmark/paddle/rnn/rnn.py``; published 83 ms/batch on
+  a K40m, ``benchmark/README.md:110-120``). Full jitted train step
+  (forward+backward+update), steady state, one chip — what
+  ``paddle_trainer --job=time`` measures. vs_baseline = reference_ms / ours.
+- Extras: ResNet-50 imgs/sec/chip + MFU (the BASELINE.json north-star
+  metric; FLOPs from XLA's own cost analysis of the compiled step, peak
+  from the device kind).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 
-import numpy as np
-
-REFERENCE_MS = 83.0  # Paddle on K40m, benchmark/README.md:110-120
+REFERENCE_MS = 83.0  # Paddle LSTM on K40m, benchmark/README.md:110-120
 BATCH, HIDDEN, SEQLEN, VOCAB = 64, 256, 100, 30000
-ITERS = int(os.environ.get("BENCH_ITERS", "30"))
+ITERS = int(os.environ.get("BENCH_ITERS", "100"))
+RESNET_BATCH = int(os.environ.get("BENCH_RESNET_BATCH", "64"))
+RESNET_ITERS = int(os.environ.get("BENCH_RESNET_ITERS", "30"))
+RETRIES = int(os.environ.get("BENCH_RETRIES", "4"))
+BACKOFFS = [60, 120, 240]  # seconds between attempts (tunnel recovery)
+
+# bf16 peak FLOP/s per chip by device kind (scaling-book numbers); used
+# only for the MFU denominator. Unknown kinds fall back to v5e.
+PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+DEFAULT_PEAK = 197e12
 
 
-def main():
+def _timed_chain(run_steps, fetch, n_long, n_short):
+    """Steady-state seconds/step over a remote (tunneled) device.
+
+    ``jax.block_until_ready`` does NOT wait through the axon tunnel — only a
+    real device→host fetch does — so: chain n steps device-side, fetch one
+    scalar, and take the difference quotient of a long and a short chain to
+    cancel the constant round-trip latency."""
+
+    def once(n):
+        t0 = time.perf_counter()
+        run_steps(n)
+        fetch()
+        return time.perf_counter() - t0
+
+    n_short = min(n_short, n_long - 1)  # keep the quotient well-defined
+    t_short = min(once(n_short) for _ in range(2)) if n_short else 0.0
+    t_long = min(once(n_long) for _ in range(2))
+    return max(t_long - t_short, 1e-9) / (n_long - n_short)
+
+
+def bench_lstm():
     import jax
+    import numpy as np
     from paddle_tpu.config import dsl
-    from paddle_tpu.data import DataFeeder, integer_value, integer_value_sequence
+    from paddle_tpu.data import (DataFeeder, integer_value,
+                                 integer_value_sequence)
     from paddle_tpu.models import lstm_text_classifier
     from paddle_tpu.optim import Adam
     from paddle_tpu.trainer import SGD
@@ -40,34 +92,154 @@ def main():
     rng = np.random.RandomState(0)
     feeder = DataFeeder({"words": integer_value_sequence(VOCAB),
                          "label": integer_value(2)}, pad_multiple=SEQLEN)
-    batch = [(list(rng.randint(0, VOCAB, size=SEQLEN)), int(rng.randint(0, 2)))
-             for _ in range(BATCH)]
+    batch = [(list(rng.randint(0, VOCAB, size=SEQLEN)),
+              int(rng.randint(0, 2))) for _ in range(BATCH)]
     feed = feeder(batch)
 
-    # warmup / compile
     rng_key = jax.random.PRNGKey(0)
-    for _ in range(3):
-        rng_key, step_key = jax.random.split(rng_key)
-        trainer.params, trainer.opt_state, metrics = trainer._train_step(
-            trainer.params, trainer.opt_state, feed, step_key, 0)
-    jax.block_until_ready(metrics["cost"])
+    state = {"m": None}
 
-    iters = ITERS
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        rng_key, step_key = jax.random.split(rng_key)
-        trainer.params, trainer.opt_state, metrics = trainer._train_step(
-            trainer.params, trainer.opt_state, feed, step_key, 0)
-    jax.block_until_ready(metrics["cost"])
-    ms = (time.perf_counter() - t0) / iters * 1000.0
+    def run_steps(n):
+        nonlocal rng_key
+        for _ in range(n):
+            rng_key, step_key = jax.random.split(rng_key)
+            trainer.params, trainer.opt_state, metrics = trainer._train_step(
+                trainer.params, trainer.opt_state, feed, step_key, 0)
+            state["m"] = metrics
 
+    def fetch():
+        return float(state["m"]["cost"])
+
+    run_steps(3)  # warmup / compile
+    fetch()
+    return _timed_chain(run_steps, fetch, ITERS, max(ITERS // 10, 1)) * 1e3
+
+
+def bench_resnet50():
+    """ResNet-50 train step: imgs/sec/chip and MFU (flops from XLA cost
+    analysis / wall time / device peak)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu.config import dsl
+    from paddle_tpu.core.argument import Argument
+    from paddle_tpu.models import resnet
+    from paddle_tpu.optim import Momentum
+    from paddle_tpu.trainer import SGD
+
+    dsl.reset()
+    cost, out, _ = resnet(depth=50, classes=1000, image_size=224)
+    trainer = SGD(cost=cost,
+                  update_equation=Momentum(learning_rate=0.1, momentum=0.9))
+
+    rng = np.random.RandomState(0)
+    feed = {
+        "image": Argument(value=jnp.asarray(
+            rng.rand(RESNET_BATCH, 224 * 224 * 3), jnp.float32)),
+        "label": Argument(value=jnp.asarray(
+            rng.randint(0, 1000, size=RESNET_BATCH), jnp.int32)),
+    }
+
+    key = jax.random.PRNGKey(0)
+    lowered = jax.jit(
+        lambda p, o, f, k: trainer._train_step(p, o, f, k, 0)).lower(
+            trainer.params, trainer.opt_state, feed, key)
+    compiled = lowered.compile()
+    cost_an = compiled.cost_analysis()
+    if isinstance(cost_an, list):  # older jax returns [dict]
+        cost_an = cost_an[0] if cost_an else {}
+    flops_per_step = float((cost_an or {}).get("flops", 0.0))
+
+    state = {"params": trainer.params, "opt": trainer.opt_state, "m": None}
+
+    def run_steps(n):
+        for _ in range(n):
+            state["params"], state["opt"], state["m"] = compiled(
+                state["params"], state["opt"], feed, key)
+
+    def fetch():
+        return float(state["m"]["cost"])
+
+    run_steps(2)  # warmup
+    fetch()
+    sec_per_step = _timed_chain(run_steps, fetch, RESNET_ITERS,
+                                max(RESNET_ITERS // 10, 1))
+
+    kind = jax.devices()[0].device_kind
+    peak = PEAK_FLOPS.get(kind, DEFAULT_PEAK)
+    mfu = (flops_per_step / sec_per_step / peak) if flops_per_step else None
+    return {
+        "resnet50_imgs_per_sec_per_chip": round(RESNET_BATCH / sec_per_step, 1),
+        "resnet50_step_ms": round(sec_per_step * 1000.0, 2),
+        "resnet50_batch": RESNET_BATCH,
+        "resnet50_mfu": round(mfu, 4) if mfu is not None else None,
+        "resnet50_flops_per_step": flops_per_step or None,
+        "device_kind": kind,
+    }
+
+
+def child_main():
+    import jax
+    result = {
+        "metric": "lstm_imdb_train_ms_per_batch_bs64_h256_seq100",
+        "value": None,
+        "unit": "ms/batch",
+        "vs_baseline": None,
+        "platform": jax.devices()[0].platform,
+        "device_kind": jax.devices()[0].device_kind,
+    }
+    ms = bench_lstm()
+    result["value"] = round(ms, 3)
+    result["vs_baseline"] = round(REFERENCE_MS / ms, 3)
+    # ResNet-50 is best-effort: a failure there must not lose the LSTM number
+    try:
+        result.update(bench_resnet50())
+    except Exception as e:  # noqa: BLE001
+        result["resnet50_error"] = repr(e)[:300]
+    print(json.dumps(result))
+    return 0
+
+
+def main():
+    if os.environ.get("BENCH_CHILD") == "1":
+        return child_main()
+
+    last_tail = ""
+    for attempt in range(RETRIES):
+        env = dict(os.environ, BENCH_CHILD="1")
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                capture_output=True, text=True, timeout=1800, env=env)
+        except subprocess.TimeoutExpired as e:
+            last_tail = f"timeout after 1800s: {str(e)[-400:]}"
+            continue
+        # the JSON line is the last stdout line that parses
+        for line in reversed((proc.stdout or "").strip().splitlines()):
+            try:
+                parsed = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(parsed, dict) and parsed.get("value") is not None:
+                print(line)
+                return 0
+        last_tail = ((proc.stderr or "") + (proc.stdout or ""))[-600:]
+        if attempt < RETRIES - 1:
+            wait = BACKOFFS[min(attempt, len(BACKOFFS) - 1)]
+            print(f"# attempt {attempt + 1} failed; retrying in {wait}s",
+                  file=sys.stderr)
+            time.sleep(wait)
+    # total failure: still emit a parseable JSON line, never a bare traceback
     print(json.dumps({
         "metric": "lstm_imdb_train_ms_per_batch_bs64_h256_seq100",
-        "value": round(ms, 3),
+        "value": None,
         "unit": "ms/batch",
-        "vs_baseline": round(REFERENCE_MS / ms, 3),
+        "vs_baseline": None,
+        "error": last_tail,
+        "attempts": RETRIES,
     }))
+    return 1
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

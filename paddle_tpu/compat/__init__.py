@@ -20,7 +20,7 @@ def install_paddle_alias():
             sys.modules["paddle"], "__is_paddle_tpu_compat__", False):
         return sys.modules["paddle"]
 
-    from paddle_tpu.compat import config_parser, data_sources, pydp2
+    from paddle_tpu.compat import config_parser, pydp2
     from paddle_tpu.compat import trainer_config_helpers as tch
 
     root = types.ModuleType("paddle")

@@ -1,0 +1,352 @@
+"""DSL graph -> wire-contract protos.
+
+The reference's ``config_parser.py`` mutates a ``TrainerConfig`` proto while
+the config executes; here the graph is built first (paddle_tpu/config/
+model_config.py) and this module lowers it into the contract schemas
+(paddle_tpu/proto, parity-tested against the reference's compiled schemas)
+afterwards — same output contract as ``parse_config_and_serialize``
+(``TrainerConfigHelper.cpp:33-57``), different pipeline shape.
+
+``model_to_proto`` emits ``ModelConfig`` (layers in topological order +
+``ParameterConfig`` per learnable parameter, shapes from the engine's shape
+inference); ``trainer_to_proto`` wraps it with ``OptimizationConfig`` and
+the ``DataConfig`` pair recorded by ``define_py_data_sources2``.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from paddle_tpu.config.model_config import ModelDef, ParamAttr
+from paddle_tpu.core.network import Network
+from paddle_tpu.proto import DataConfig_pb2, ModelConfig_pb2, TrainerConfig_pb2
+
+# LayerDef.act "linear" is the DSL spelling of the reference's empty
+# active_type (LinearActivation().name == "").
+def _active_type(act: str) -> str:
+    return "" if act in ("linear", "") else act
+
+
+def _img_geom(info):
+    """(channels, height, width) with 1x1 fallback for flat inputs."""
+    if info.channels is None:
+        return 1, 1, max(1, info.size)
+    return info.channels, info.height, info.width
+
+
+def _set_conv_conf(conf, extra, in_info, out_info, num_filters):
+    channels, in_h, in_w = _img_geom(in_info)
+    fs = int(extra.get("filter_size", 1))
+    groups = int(extra.get("groups", 1) or 1)
+    conf.filter_size = fs
+    conf.channels = int(extra.get("channels") or channels)
+    conf.stride = int(extra.get("stride", 1))
+    conf.padding = int(extra.get("padding", 0))
+    conf.groups = groups
+    conf.filter_channels = conf.channels // groups
+    conf.output_x = int(out_info.width or 1)
+    conf.img_size = int(in_w or 1)
+    conf.caffe_mode = True
+    conf.filter_size_y = int(extra.get("filter_size_y", fs))
+    conf.padding_y = int(extra.get("padding_y", conf.padding))
+    conf.stride_y = int(extra.get("stride_y", conf.stride))
+    conf.output_y = int(out_info.height or conf.output_x)
+    conf.img_size_y = int(in_h or conf.img_size)
+
+
+def _set_pool_conf(conf, extra, in_info, out_info):
+    channels, in_h, in_w = _img_geom(in_info)
+    conf.pool_type = str(extra.get("pool_type", "max-projection"))
+    conf.channels = int(extra.get("channels") or channels)
+    conf.size_x = int(extra.get("filter_size", 1))
+    conf.stride = int(extra.get("stride", 1))
+    conf.padding = int(extra.get("padding", 0))
+    conf.output_x = int(out_info.width or 1)
+    conf.img_size = int(in_w or 1)
+    if extra.get("size_y"):
+        conf.size_y = int(extra["size_y"])
+    if extra.get("stride_y"):
+        conf.stride_y = int(extra["stride_y"])
+    conf.output_y = int(out_info.height or conf.output_x)
+    conf.img_size_y = int(in_h or conf.img_size)
+
+
+def _set_norm_conf(conf, extra, in_info, out_info):
+    channels, in_h, in_w = _img_geom(in_info)
+    conf.norm_type = str(extra.get("norm_type", "cmrnorm-projection"))
+    conf.channels = int(extra.get("channels") or channels)
+    conf.size = int(extra.get("size", 5))
+    conf.scale = float(extra.get("scale", 1e-4))
+    conf.pow = float(extra.get("pow", 0.75))
+    conf.output_x = int(out_info.width or 1)
+    conf.img_size = int(in_w or 1)
+    conf.output_y = int(out_info.height or conf.output_x)
+    conf.img_size_y = int(in_h or conf.img_size)
+
+
+def _set_proj_conf(conf, spec, name, in_size, out_size):
+    ptype = spec.get("type", "full_matrix")
+    conf.type = {"full_matrix": "fc", "trans_full_matrix": "trans_fc",
+                 "table": "table", "identity": "identity",
+                 "identity_offset": "identity_offset",
+                 "dot_mul": "dot_mul", "scaling": "scaling",
+                 "context": "context", "conv": "conv", "convt": "convt",
+                 "slice": "slice"}.get(ptype, ptype)
+    conf.name = name
+    conf.input_size = int(in_size)
+    conf.output_size = int(out_size)
+    if ptype == "context":
+        conf.context_start = int(spec.get("context_start", 0))
+        conf.context_length = int(spec.get("context_length", 1))
+        conf.trainable_padding = bool(spec.get("trainable_padding", False))
+    if ptype == "identity_offset":
+        conf.offset = int(spec.get("offset", 0))
+    for s, e in spec.get("slices", []):
+        sl = conf.slices.add()
+        sl.start, sl.end = int(s), int(e)
+
+
+_LAYER_SCALAR_FIELDS = {
+    # LayerDef.attrs key -> LayerConfig field (same-typed scalars)
+    "num_filters": "num_filters",
+    "shared_biases": "shared_biases",
+    "num_classes": "num_classes",
+    "reversed": "reversed",
+    "active_gate_type": "active_gate_type",
+    "active_state_type": "active_state_type",
+    "num_neg_samples": "num_neg_samples",
+    "output_max_index": "output_max_index",
+    "norm_by_times": "norm_by_times",
+    "coeff": "coeff",
+    "average_strategy": "average_strategy",
+    "error_clipping_threshold": "error_clipping_threshold",
+    "NDCG_num": "NDCG_num",
+    "max_sort_size": "max_sort_size",
+    "slope": "slope",
+    "intercept": "intercept",
+    "cos_scale": "cos_scale",
+    "bos_id": "bos_id",
+    "eos_id": "eos_id",
+    "beam_size": "beam_size",
+    "select_first": "select_first",
+    "trans_type": "trans_type",
+    "use_global_stats": "use_global_stats",
+    "moving_average_fraction": "moving_average_fraction",
+    "blank": "blank",
+    "seq_pool_stride": "seq_pool_stride",
+    "axis": "axis",
+    "groups": "partial_sum",
+}
+
+
+def _export_layer(model: ModelDef, net: Network, name: str, proto_layer):
+    layer = model.layers[name]
+    out_info = net.shape_infos[name]
+    proto_layer.name = layer.name
+    proto_layer.type = layer.type
+    if layer.size or out_info.size:
+        proto_layer.size = int(layer.size or out_info.size)
+    # recurrent helpers keep the main activation in attrs (the engine
+    # applies it inside the scan); the proto's active_type is that one
+    proto_layer.active_type = _active_type(
+        layer.attrs.get("active_type", layer.act))
+    if layer.drop_rate:
+        proto_layer.drop_rate = float(layer.drop_rate)
+
+    lp = net._layer_params.get(name, {})
+    if "wbias" in lp:
+        proto_layer.bias_parameter_name = lp["wbias"]
+
+    for attr_key, field in _LAYER_SCALAR_FIELDS.items():
+        if attr_key in layer.attrs and layer.attrs[attr_key] is not None:
+            try:
+                setattr(proto_layer, field, layer.attrs[attr_key])
+            except TypeError:
+                pass  # attr used differently by this layer type
+    for key in ("offset", "shape"):
+        v = layer.attrs.get(key)
+        if isinstance(v, (list, tuple)):
+            getattr(proto_layer, key).extend(int(x) for x in v)
+
+    projections = layer.attrs.get("projections")
+    operators = layer.attrs.get("operators") or []
+    for i, inp in enumerate(layer.inputs):
+        pin = proto_layer.inputs.add()
+        pin.input_layer_name = inp.layer_name
+        if f"w{i}" in lp:
+            pin.input_parameter_name = lp[f"w{i}"]
+        extra = inp.extra or {}
+        in_info = net.shape_infos[inp.layer_name]
+        if layer.type in ("exconv", "exconvt", "cudnn_conv"):
+            _set_conv_conf(pin.conv_conf, extra, in_info, out_info,
+                           layer.attrs.get("num_filters"))
+        elif layer.type == "pool" and extra:
+            _set_pool_conf(pin.pool_conf, extra, in_info, out_info)
+        elif layer.type == "norm":
+            _set_norm_conf(pin.norm_conf, extra, in_info, out_info)
+        elif layer.type == "mixed" and projections is not None \
+                and i < len(projections):
+            spec = projections[i]
+            if spec.get("type") not in (None, "identity_op_arg"):
+                _set_proj_conf(pin.proj_conf, spec,
+                               f"___{layer.name}.w{i}", in_info.size,
+                               layer.size or out_info.size)
+    if layer.type == "batch_norm" and layer.inputs:
+        # the reference wires moving mean/var as static inputs 1 and 2 of
+        # the layer (BatchNormBaseLayer.cpp); the engine keeps them as
+        # static params w1/w2 — emit the same 3-input contract shape
+        src0 = layer.inputs[0].layer_name
+        ci, hh, ww = _img_geom(net.shape_infos[src0])
+        pin0 = proto_layer.inputs[0]
+        pin0.image_conf.channels = ci
+        pin0.image_conf.img_size = ww
+        pin0.image_conf.img_size_y = hh
+        for suffix in ("w1", "w2"):
+            pin = proto_layer.inputs.add()
+            pin.input_layer_name = src0
+            if suffix in lp:
+                pin.input_parameter_name = lp[suffix]
+        if net.shape_infos[src0].height is not None:
+            proto_layer.height = net.shape_infos[src0].height
+            proto_layer.width = net.shape_infos[src0].width
+
+    for op in operators:
+        pop = proto_layer.operator_confs.add()
+        pop.type = str(op.get("type", ""))
+        pop.input_indices.extend(int(i) for i in op.get("input_indices", []))
+        pop.input_sizes.extend(
+            int(net.shape_infos[layer.inputs[i].layer_name].size)
+            for i in op.get("input_indices", []))
+        pop.output_size = int(layer.size or out_info.size)
+        if "scale" in op:
+            pop.dotmul_scale = float(op["scale"])
+
+
+def _export_parameter(pname: str, spec, proto_param):
+    proto_param.name = pname
+    size = 1
+    for d in spec.shape:
+        size *= int(d)
+    proto_param.size = size
+    proto_param.dims.extend(int(d) for d in spec.shape)
+    proto_param.learning_rate = float(spec.learning_rate)
+    proto_param.initial_mean = float(spec.initial_mean)
+    if spec.initial_std is not None:
+        proto_param.initial_std = float(spec.initial_std)
+    else:
+        # the reference's "initial_smart": std = 1/sqrt(fan_in)
+        proto_param.initial_smart = True
+    proto_param.initial_strategy = 1 if spec.init == "uniform" else 0
+    if spec.is_static:
+        proto_param.is_static = True
+    if spec.sparse_grad:
+        proto_param.sparse_update = True
+    if spec.l2_rate is not None:
+        proto_param.decay_rate = float(spec.l2_rate)
+    if spec.l1_rate is not None:
+        proto_param.decay_rate_l1 = float(spec.l1_rate)
+
+
+def model_to_proto(model: ModelDef, context=None) -> "ModelConfig_pb2.ModelConfig":
+    mc = ModelConfig_pb2.ModelConfig()
+    mc.type = "nn"
+    # infer over ALL declared layers, emit in declaration order — the
+    # reference's config_parser emits layers as the config declares them
+    # (declaration order is a valid topological order: the DSL requires
+    # inputs to exist before use)
+    net = Network(model, outputs=list(model.layers))
+    for name in model.layers:
+        _export_layer(model, net, name, mc.layers.add())
+    for pname in sorted(net.param_specs):
+        _export_parameter(pname, net.param_specs[pname], mc.parameters.add())
+    input_names = (context.input_layer_names if context is not None
+                   and context.input_layer_names else model.input_layer_names)
+    mc.input_layer_names.extend(
+        n for n in input_names if n in net.shape_infos)
+    mc.output_layer_names.extend(model.output_layer_names)
+    if context is not None:
+        for ev in context.evaluators:
+            pe = mc.evaluators.add()
+            pe.name = ev.get("name", ev.get("type", "evaluator"))
+            pe.type = ev.get("type", "")
+            pe.input_layers.extend(ev.get("input_layers", []))
+            for field in ("chunk_scheme", "num_chunk_types",
+                          "classification_threshold", "positive_label",
+                          "dict_file", "result_file", "num_results",
+                          "delimited", "top_k", "overlap_threshold",
+                          "background_id", "evaluate_difficult", "ap_type"):
+                if ev.get(field) is not None:
+                    setattr(pe, field, ev[field])
+            if ev.get("excluded_chunk_types"):
+                pe.excluded_chunk_types.extend(ev["excluded_chunk_types"])
+    return mc
+
+
+def _data_config(source, *, for_test: bool) -> Optional["DataConfig_pb2.DataConfig"]:
+    if source is None:
+        return None
+    dc = DataConfig_pb2.DataConfig()
+    dc.type = "py2"
+    if source.file_list:
+        dc.files = source.file_list
+    if source.module:
+        dc.load_data_module = source.module
+    if source.obj:
+        dc.load_data_object = source.obj
+    if source.args not in (None, ""):
+        dc.load_data_args = (source.args if isinstance(source.args, str)
+                             else json.dumps(source.args))
+    if for_test:
+        dc.for_test = True
+    dc.async_load_data = True
+    return dc
+
+
+def opt_config_from_settings(s) -> "TrainerConfig_pb2.OptimizationConfig":
+    oc = TrainerConfig_pb2.OptimizationConfig()
+    oc.batch_size = int(s.get("batch_size") or 1)
+    oc.algorithm = s.get("algorithm") or "sgd"
+    oc.learning_rate = float(s.get("learning_rate") or 1e-3)
+    oc.learning_rate_decay_a = float(s.get("learning_rate_decay_a") or 0.0)
+    oc.learning_rate_decay_b = float(s.get("learning_rate_decay_b") or 0.0)
+    oc.learning_rate_schedule = s.get("learning_rate_schedule") or "constant"
+    oc.learning_rate_args = s.get("learning_rate_args") or ""
+    oc.async_lagged_grad_discard_ratio = float(
+        s.get("async_lagged_grad_discard_ratio") or 1.5)
+    if s.get("gradient_clipping_threshold"):
+        oc.gradient_clipping_threshold = float(
+            s["gradient_clipping_threshold"])
+    method = s.get("learning_method")
+    if method is not None and hasattr(method, "extra_settings"):
+        for k, v in method.extra_settings().items():
+            if k == "momentum":
+                continue  # OptimizationConfig has no momentum field
+            try:
+                setattr(oc, k, v)
+            except (AttributeError, TypeError):
+                pass
+    reg = s.get("regularization")
+    if reg is not None and hasattr(reg, "extra_settings"):
+        for k, v in reg.extra_settings().items():
+            setattr(oc, k, float(v))
+    avg = s.get("model_average")
+    if avg is not None:
+        oc.average_window = float(avg.average_window)
+        if avg.max_average_window is not None:
+            oc.max_average_window = int(avg.max_average_window)
+        oc.do_average_in_cpu = bool(avg.do_average_in_cpu)
+    return oc
+
+
+def trainer_to_proto(model: ModelDef, context) -> "TrainerConfig_pb2.TrainerConfig":
+    tc = TrainerConfig_pb2.TrainerConfig()
+    tc.model_config.CopyFrom(model_to_proto(model, context))
+    tc.opt_config.CopyFrom(opt_config_from_settings(context.settings))
+    train_dc = _data_config(context.train_source, for_test=False)
+    if train_dc is not None:
+        tc.data_config.CopyFrom(train_dc)
+    test_dc = _data_config(context.test_source, for_test=True)
+    if test_dc is not None:
+        tc.test_data_config.CopyFrom(test_dc)
+    return tc

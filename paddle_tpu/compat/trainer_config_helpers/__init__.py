@@ -1,0 +1,36 @@
+"""``paddle.trainer_config_helpers`` star-import surface.
+
+The reference package re-exports every helper family so the canonical
+config preamble ``from paddle.trainer_config_helpers import *`` brings in
+layers, networks, activations, poolings, attrs, optimizers, evaluators and
+data sources in one line (`python/paddle/trainer_config_helpers/
+__init__.py:15-24`). The reference additionally inherits the whole
+``config_parser`` namespace through ``layers.py``'s
+``from paddle.trainer.config_parser import *`` — which is how configs see
+``get_config_arg``/``inputs``/``outputs`` — so those are re-exported here
+explicitly.
+"""
+
+from paddle_tpu.compat import config_parser as _config_parser
+from paddle_tpu.compat.config_parser import (get_config_arg, inputs,  # noqa: F401
+                                             outputs, parse_config)
+from paddle_tpu.compat.trainer_config_helpers import (activations,  # noqa: F401
+                                                      attrs, data_sources,
+                                                      evaluators, layers,
+                                                      networks, optimizers,
+                                                      poolings)
+from paddle_tpu.compat.trainer_config_helpers import layer_math  # noqa: F401
+from paddle_tpu.compat.trainer_config_helpers.activations import *  # noqa: F401,F403
+from paddle_tpu.compat.trainer_config_helpers.attrs import *  # noqa: F401,F403
+from paddle_tpu.compat.trainer_config_helpers.data_sources import *  # noqa: F401,F403
+from paddle_tpu.compat.trainer_config_helpers.evaluators import *  # noqa: F401,F403
+from paddle_tpu.compat.trainer_config_helpers.layers import *  # noqa: F401,F403
+from paddle_tpu.compat.trainer_config_helpers.networks import *  # noqa: F401,F403
+from paddle_tpu.compat.trainer_config_helpers.optimizers import *  # noqa: F401,F403
+from paddle_tpu.compat.trainer_config_helpers.poolings import *  # noqa: F401,F403
+
+__all__ = (activations.__all__ + attrs.__all__ + data_sources.__all__
+           + evaluators.__all__ + layers.__all__ + networks.__all__
+           + optimizers.__all__ + poolings.__all__
+           + ["get_config_arg", "inputs", "outputs", "parse_config",
+              "layer_math"])

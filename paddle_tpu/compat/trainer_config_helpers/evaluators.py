@@ -1,9 +1,10 @@
 """``paddle.trainer_config_helpers.evaluators`` surface.
 
 The 16 evaluator wrappers (`trainer_config_helpers/evaluators.py`):
-each records an EvaluatorConfig-shaped dict in the parse context; the
-trainer wires them to the metric registry (paddle_tpu/trainer/metrics.py)
-during train/test.
+each records an EvaluatorConfig-shaped dict in the parse context
+(``ctx().evaluators``); the CLI hands that list to ``SGD(evaluators=...)``
+which builds registry evaluators from it (``trainer/metrics.py
+build_from_configs``) and feeds them every batch during train/test.
 """
 
 from __future__ import annotations
@@ -32,13 +33,19 @@ def evaluator_base(input, type, label=None, weight=None, name=None,
     func)."""
     inputs = input if isinstance(input, (list, tuple)) else [input]
     names = [i.name if hasattr(i, "name") else str(i) for i in inputs]
+    n_outputs = len(names)
     if label is not None:
         names.append(label.name if hasattr(label, "name") else str(label))
     if weight is not None:
         names.append(weight.name if hasattr(weight, "name") else str(weight))
     c = ctx()
     cfg = {"name": name or c.auto_name(f"{type}_evaluator"),
-           "type": type, "input_layers": names}
+           "type": type, "input_layers": names,
+           # role map so the trainer binds eval_batch kwargs correctly
+           # (flat input_layers is the proto contract; roles are wiring-only)
+           "_roles": {"n_outputs": n_outputs,
+                      "has_label": label is not None,
+                      "has_weight": weight is not None}}
     for k, v in [("chunk_scheme", chunk_scheme),
                  ("num_chunk_types", num_chunk_types),
                  ("classification_threshold", classification_threshold),
@@ -74,6 +81,7 @@ def pnpair_evaluator(input, label, query_id, weight=None, name=None):
                         name=name)
     ev["input_layers"].append(
         query_id.name if hasattr(query_id, "name") else str(query_id))
+    ev["_roles"]["has_query"] = True
     return ev
 
 

@@ -1,0 +1,95 @@
+"""``paddle.trainer_config_helpers.layer_math`` surface.
+
+Unary math helpers (``layer_math.exp(x)`` etc.) and arithmetic operator
+overloads on ``LayerOutput`` — the reference installs ``__add__``/
+``__sub__``/``__mul__`` lowering to slope_intercept / identity-projection
+mixes / scaling layers (`trainer_config_helpers/layer_math.py`). Importing
+this module (the package ``__init__`` does) installs the overloads.
+"""
+
+from __future__ import annotations
+
+import numbers
+
+from paddle_tpu.compat.trainer_config_helpers import activations as act
+from paddle_tpu.compat.trainer_config_helpers.layers import (
+    LayerOutput, _name, identity_projection, mixed_layer, repeat_layer,
+    scaling_layer, slope_intercept_layer)
+
+__all__ = []
+
+
+def _register_unary(op_name, activation):
+    def op(input, name=None):
+        return mixed_layer(input=[identity_projection(input=input)],
+                           name=_name(name, op_name), act=activation)
+
+    op.__name__ = op_name
+    globals()[op_name] = op
+    __all__.append(op_name)
+
+
+_register_unary("exp", act.ExpActivation())
+_register_unary("log", act.LogActivation())
+_register_unary("abs", act.AbsActivation())
+_register_unary("sigmoid", act.SigmoidActivation())
+_register_unary("tanh", act.TanhActivation())
+_register_unary("square", act.SquareActivation())
+_register_unary("relu", act.ReluActivation())
+_register_unary("sqrt", act.SqrtActivation())
+_register_unary("reciprocal", act.ReciprocalActivation())
+
+
+def _add(layeroutput, other):
+    if isinstance(other, numbers.Number):
+        return slope_intercept_layer(input=layeroutput, intercept=other)
+    if not isinstance(other, LayerOutput):
+        raise TypeError("LayerOutput can only be added with another "
+                        "LayerOutput or a number")
+    if layeroutput.size == other.size:
+        return mixed_layer(input=[identity_projection(input=layeroutput),
+                                  identity_projection(input=other)])
+    if other.size != 1 and layeroutput.size != 1:
+        raise ValueError(
+            f"'+' needs equal sizes or one size-1 operand; got "
+            f"{layeroutput.size} and {other.size}")
+    if layeroutput.size == 1:
+        layeroutput, other = other, layeroutput
+    other = repeat_layer(other, layeroutput.size)
+    return mixed_layer(input=[identity_projection(input=layeroutput),
+                              identity_projection(input=other)])
+
+
+def _sub(layeroutput, other):
+    if isinstance(other, numbers.Number):
+        return slope_intercept_layer(input=layeroutput, intercept=-other)
+    if not isinstance(other, LayerOutput):
+        raise TypeError("LayerOutput can only be subtracted with another "
+                        "LayerOutput or a number")
+    return _add(layeroutput, slope_intercept_layer(input=other, slope=-1.0))
+
+
+def _rsub(layeroutput, other):
+    return _add(slope_intercept_layer(input=layeroutput, slope=-1.0), other)
+
+
+def _mul(layeroutput, other):
+    if isinstance(other, numbers.Number):
+        return slope_intercept_layer(input=layeroutput, slope=other)
+    if not isinstance(other, LayerOutput):
+        raise TypeError("LayerOutput can only be multiplied with another "
+                        "LayerOutput or a number")
+    if layeroutput.size == 1:
+        return scaling_layer(input=other, weight=layeroutput)
+    if other.size == 1:
+        return scaling_layer(input=layeroutput, weight=other)
+    raise ValueError("'*' needs one scalar operand (a number or a "
+                     "size-1 LayerOutput)")
+
+
+LayerOutput.__add__ = _add
+LayerOutput.__radd__ = _add
+LayerOutput.__sub__ = _sub
+LayerOutput.__rsub__ = _rsub
+LayerOutput.__mul__ = _mul
+LayerOutput.__rmul__ = _mul

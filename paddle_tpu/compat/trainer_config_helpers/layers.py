@@ -157,13 +157,16 @@ def _one(x) -> LayerOutput:
         if len(x) != 1:
             raise ValueError("this layer takes exactly one input")
         x = x[0]
+    if isinstance(x, MixedLayerType):
+        x = x._finalize()
     if not isinstance(x, LayerOutput):
         raise TypeError(f"input must be a LayerOutput, got {type(x)}")
     return x
 
 
 def _many(x) -> List[LayerOutput]:
-    xs = [x] if isinstance(x, LayerOutput) else list(x)
+    xs = [x] if isinstance(x, (LayerOutput, MixedLayerType)) else list(x)
+    xs = [i._finalize() if isinstance(i, MixedLayerType) else i for i in xs]
     for i in xs:
         if not isinstance(i, LayerOutput):
             raise TypeError(f"input must be LayerOutput, got {type(i)}")
@@ -276,6 +279,27 @@ def context_projection(input, context_len, context_start=None,
                       _pattr(padding_attr) if trainable else None)
 
 
+def _conv_proj_out_size(in_size, channels, filter_size, stride, padding,
+                        num_filters, trans=False, filter_size_y=None,
+                        stride_y=None, padding_y=None):
+    """Output size of a conv projection/operator over a square image whose
+    side is derived from the flat input size (the reference's
+    config_parser geometry inference; y params default to their x twins)."""
+    import math
+    c = channels or 1
+    side = math.isqrt(max(1, in_size // c))
+    fsy = filter_size if filter_size_y is None else filter_size_y
+    sty = stride if stride_y is None else stride_y
+    pady = padding if padding_y is None else padding_y
+
+    def _out(sz, f, s, p):
+        return (sz - 1) * s + f - 2 * p if trans \
+            else (sz + 2 * p - f) // s + 1
+
+    return num_filters * _out(side, fsy, sty, pady) * _out(
+        side, filter_size, stride, padding)
+
+
 def conv_operator(img, filter, filter_size, num_filters, num_channels=None,
                   stride=1, padding=0, filter_size_y=None, stride_y=None,
                   padding_y=None, trans=False):
@@ -284,7 +308,10 @@ def conv_operator(img, filter, filter_size, num_filters, num_channels=None,
             "filter_size": filter_size, "num_filters": num_filters,
             "num_channels": num_channels, "stride": stride,
             "padding": padding}
-    return Projection(img, spec, 0, extra_inputs=[flt], is_operator=True)
+    size = _conv_proj_out_size(img.size, num_channels, filter_size, stride,
+                               padding, num_filters, trans,
+                               filter_size_y, stride_y, padding_y)
+    return Projection(img, spec, size, extra_inputs=[flt], is_operator=True)
 
 
 def conv_projection(input, filter_size, num_filters, num_channels=None,
@@ -295,7 +322,10 @@ def conv_projection(input, filter_size, num_filters, num_channels=None,
             "filter_size": filter_size, "num_filters": num_filters,
             "num_channels": num_channels, "stride": stride,
             "padding": padding, "groups": groups}
-    return Projection(src, spec, 0, _pattr(param_attr))
+    size = _conv_proj_out_size(src.size, num_channels, filter_size, stride,
+                               padding, num_filters, trans,
+                               filter_size_y, stride_y, padding_y)
+    return Projection(src, spec, size, _pattr(param_attr))
 
 
 class MixedLayerType:
@@ -423,7 +453,7 @@ def trans_layer(input, name=None, layer_attr=None):
 
 
 def rotate_layer(input, height, width, name=None, layer_attr=None):
-    return _layer(_name(name, "rotate"), "rotate",
+    return _layer(_name(name, "rotate_layer"), "rotate",
                   [Input(_one(input).name)],
                   attrs={"height": height, "width": width},
                   layer_attr=layer_attr)
@@ -432,7 +462,7 @@ def rotate_layer(input, height, width, name=None, layer_attr=None):
 def repeat_layer(input, num_repeats, as_row_vector=True, act=None,
                  name=None, layer_attr=None):
     src = _one(input)
-    return _layer(_name(name, "repeat"), "featmap_expand",
+    return _layer(_name(name, "repeat_layer"), "featmap_expand",
                   [Input(src.name)], size=src.size * num_repeats,
                   act=_act(act, IdentityActivation),
                   attrs={"num_filters": num_repeats,
@@ -458,32 +488,32 @@ def interpolation_layer(input, weight, name=None, layer_attr=None):
 
 def bilinear_interp_layer(input, out_size_x=None, out_size_y=None,
                           name=None, layer_attr=None):
-    return _layer(_name(name, "bilinear_interp"), "bilinear_interp",
-                  [Input(_one(input).name,
-                         extra={"out_size_x": out_size_x,
-                                "out_size_y": out_size_y})],
+    return _layer(_name(name, "bilinear_interp_layer"), "bilinear_interp",
+                  [Input(_one(input).name)],
+                  attrs={"out_size_x": out_size_x,
+                         "out_size_y": out_size_y},
                   layer_attr=layer_attr)
 
 
 def power_layer(input, weight, name=None, layer_attr=None):
-    return _layer(_name(name, "power"), "power",
+    return _layer(_name(name, "power_layer"), "power",
                   [Input(_one(weight).name), Input(_one(input).name)],
                   layer_attr=layer_attr)
 
 
 def scaling_layer(input, weight, name=None, layer_attr=None):
-    return _layer(_name(name, "scaling"), "scaling",
+    return _layer(_name(name, "scaling_layer"), "scaling",
                   [Input(_one(weight).name), Input(_one(input).name)],
                   layer_attr=layer_attr)
 
 
 def sum_to_one_norm_layer(input, name=None, layer_attr=None):
-    return _layer(_name(name, "sum_to_one_norm"), "sum_to_one_norm",
+    return _layer(_name(name, "sum_to_one_norm_layer"), "sum_to_one_norm",
                   [Input(_one(input).name)], layer_attr=layer_attr)
 
 
 def row_l2_norm_layer(input, name=None, layer_attr=None):
-    return _layer(_name(name, "row_l2_norm"), "row_l2_norm",
+    return _layer(_name(name, "row_l2_norm_layer"), "row_l2_norm",
                   [Input(_one(input).name)], layer_attr=layer_attr)
 
 
@@ -498,7 +528,7 @@ def cos_sim(a, b, scale=1, size=1, name=None, layer_attr=None):
 
 
 def out_prod_layer(input1, input2, name=None, layer_attr=None):
-    return _layer(_name(name, "out_prod"), "out_prod",
+    return _layer(_name(name, "out_prod_layer"), "out_prod",
                   [Input(_one(input1).name), Input(_one(input2).name)],
                   layer_attr=layer_attr)
 
@@ -545,7 +575,7 @@ def first_seq(input, agg_level=AggregateLevel.TO_NO_SEQUENCE, name=None,
 def expand_layer(input, expand_as, name=None, bias_attr=False,
                  expand_level=ExpandLevel.FROM_NO_SEQUENCE,
                  layer_attr=None):
-    return _layer(_name(name, "expand"), "expand",
+    return _layer(_name(name, "expand_layer"), "expand",
                   [Input(_one(input).name), Input(_one(expand_as).name)],
                   bias=_battr(bias_attr, False),
                   attrs={"trans_type": expand_level}, layer_attr=layer_attr)
@@ -553,7 +583,12 @@ def expand_layer(input, expand_as, name=None, bias_attr=False,
 
 def concat_layer(input, act=None, name=None, layer_attr=None,
                  bias_attr=None):
-    ins = _many(input)
+    # the reference's ConcatenateLayer2 accepts projections; each becomes
+    # an anonymous mixed layer whose outputs are concatenated
+    items = input if isinstance(input, (list, tuple)) else [input]
+    items = [mixed_layer(input=[p]) if isinstance(p, Projection) else p
+             for p in items]
+    ins = _many(items)
     return _layer(_name(name, "concat"), "concat",
                   [Input(i.name) for i in ins],
                   act=_act(act, IdentityActivation), layer_attr=layer_attr)
@@ -640,6 +675,11 @@ def memory(name, size, memory_name=None, is_seq=False, boot_layer=None,
 
 def recurrent_group(step, input, reverse=False, name=None,
                     targetInlink=None):
+    if isinstance(input, MixedLayerType):
+        input = input._finalize()
+    elif isinstance(input, (list, tuple)):
+        input = [i._finalize() if isinstance(i, MixedLayerType) else i
+                 for i in input]
     return dsl.recurrent_group(step, input, reverse=reverse, name=name)
 
 
@@ -693,24 +733,24 @@ def gru_step_naive_layer(input, output_mem, size=None, name=None, act=None,
 
 def get_output_layer(input, arg_name, name=None, layer_attr=None):
     src = _one(input)
-    return _layer(_name(name, "get_output"), "get_output",
+    return _layer(_name(name, "get_output_layer"), "get_output",
                   [Input(src.name, extra={"input_layer_argument": arg_name})],
                   attrs={"arg_name": arg_name}, layer_attr=layer_attr)
 
 
 def maxid_layer(input, name=None, layer_attr=None):
-    return _layer(_name(name, "maxid"), "maxid",
+    return _layer(_name(name, "maxid_layer"), "maxid",
                   [Input(_one(input).name)], layer_attr=layer_attr)
 
 
 def eos_layer(input, eos_id, name=None, layer_attr=None):
-    return _layer(_name(name, "eos"), "eos_id",
+    return _layer(_name(name, "eos_layer"), "eos_id",
                   [Input(_one(input).name)], attrs={"eos_id": eos_id},
                   layer_attr=layer_attr)
 
 
 def kmax_sequence_score_layer(input, name=None, beam_size=1):
-    return _layer(_name(name, "kmax_seq_score"), "kmax_seq_score",
+    return _layer(_name(name, "kmax_sequence_score_layer"), "kmax_seq_score",
                   [Input(_one(input).name)], attrs={"beam_size": beam_size})
 
 
@@ -772,10 +812,9 @@ def spp_layer(input, name=None, num_channels=None, pool_type=None,
     pt = "max-projection" if pool_type is None or isinstance(
         pool_type, MaxPooling) else "avg-projection"
     return _layer(_name(name, "spp"), "spp",
-                  [Input(src.name,
-                         extra={"pyramid_height": pyramid_height,
-                                "pool_type": pt,
-                                "channels": num_channels})],
+                  [Input(src.name)],
+                  attrs={"pyramid_height": pyramid_height,
+                         "pool_type": pt, "channels": num_channels},
                   layer_attr=layer_attr)
 
 
@@ -806,23 +845,21 @@ def batch_norm_layer(input, act=None, name=None, img3D=False,
 
 def maxout_layer(input, groups, num_channels=None, name=None,
                  layer_attr=None):
-    return _layer(_name(name, "maxout"), "maxout",
-                  [Input(_one(input).name,
-                         extra={"groups": groups,
-                                "channels": num_channels})],
+    return _layer(_name(name, "maxout_layer"), "maxout",
+                  [Input(_one(input).name)],
+                  attrs={"groups": groups, "channels": num_channels},
                   layer_attr=layer_attr)
 
 
 def block_expand_layer(input, block_x=0, block_y=0, stride_x=0, stride_y=0,
                        padding_x=0, padding_y=0, num_channels=None,
                        name=None, layer_attr=None):
-    return _layer(_name(name, "blockexpand"), "blockexpand",
-                  [Input(_one(input).name,
-                         extra={"block_x": block_x, "block_y": block_y,
-                                "stride_x": stride_x, "stride_y": stride_y,
-                                "padding_x": padding_x,
-                                "padding_y": padding_y,
-                                "channels": num_channels})],
+    return _layer(_name(name, "block_expand_layer"), "blockexpand",
+                  [Input(_one(input).name)],
+                  attrs={"block_x": block_x, "block_y": block_y,
+                         "stride_x": stride_x, "stride_y": stride_y,
+                         "padding_x": padding_x, "padding_y": padding_y,
+                         "channels": num_channels},
                   layer_attr=layer_attr)
 
 
@@ -839,7 +876,7 @@ def pad_layer(input, pad_c=None, pad_h=None, pad_w=None, name=None,
 def crop_layer(input, offset, axis=2, shape=None, name=None,
                layer_attr=None):
     ins = _many(input)
-    return _layer(_name(name, "crop"), "crop",
+    return _layer(_name(name, "crop_layer"), "crop",
                   [Input(i.name) for i in ins],
                   attrs={"axis": axis, "offset": offset, "shape": shape},
                   layer_attr=layer_attr)
@@ -860,7 +897,7 @@ def cross_channel_norm_layer(input, name=None, param_attr=None):
 
 def prelu_layer(input, name=None, partial_sum=1, param_attr=None,
                 layer_attr=None):
-    return _layer(_name(name, "prelu"), "prelu",
+    return _layer(_name(name, "prelu_layer"), "prelu",
                   [Input(_one(input).name, param_attr=_pattr(param_attr))],
                   attrs={"partial_sum": partial_sum}, layer_attr=layer_attr)
 
@@ -905,7 +942,7 @@ def hsigmoid(input, label, num_classes=None, name=None, bias_attr=None,
 def tensor_layer(a, b, size, act=None, name=None, param_attr=None,
                  bias_attr=None, layer_attr=None):
     return _layer(
-        _name(name, "tensor"), "tensor",
+        _name(name, "tensor_layer"), "tensor",
         [Input(_one(a).name, param_attr=_pattr(param_attr)),
          Input(_one(b).name)],
         size=size, act=_act(act, LinearActivation),
@@ -925,7 +962,7 @@ def selective_fc_layer(input, size, select=None, act=None, name=None,
     if select is not None:
         inputs.append(Input(_one(select).name))
     return _layer(
-        _name(name, "selective_fc"), "selective_fc", inputs, size=size,
+        _name(name, "selective_fc_layer"), "selective_fc", inputs, size=size,
         act=_act(act), bias=_battr(bias_attr),
         attrs={"selective_fc_pass_generation": pass_generation,
                "has_selected_colums": has_selected_colums,
@@ -934,13 +971,13 @@ def selective_fc_layer(input, size, select=None, act=None, name=None,
 
 
 def sampling_id_layer(input, name=None, layer_attr=None):
-    return _layer(_name(name, "sampling_id"), "sampling_id",
+    return _layer(_name(name, "sampling_id_layer"), "sampling_id",
                   [Input(_one(input).name)], layer_attr=layer_attr)
 
 
 def slope_intercept_layer(input, name=None, slope=1.0, intercept=0.0,
                           layer_attr=None):
-    return _layer(_name(name, "slope_intercept"), "slope_intercept",
+    return _layer(_name(name, "slope_intercept_layer"), "slope_intercept",
                   [Input(_one(input).name)],
                   attrs={"slope": slope, "intercept": intercept},
                   layer_attr=layer_attr)
@@ -951,7 +988,7 @@ def linear_comb_layer(weights, vectors, size=None, name=None,
     w, v = _one(weights), _one(vectors)
     if size is None:
         size = v.size // w.size
-    return _layer(_name(name, "linear_comb"), "convex_comb",
+    return _layer(_name(name, "linear_comb_layer"), "convex_comb",
                   [Input(w.name), Input(v.name)], size=size,
                   layer_attr=layer_attr)
 
@@ -960,14 +997,14 @@ convex_comb_layer = linear_comb_layer
 
 
 def conv_shift_layer(a, b, name=None, layer_attr=None):
-    return _layer(_name(name, "conv_shift"), "conv_shift",
+    return _layer(_name(name, "conv_shift_layer"), "conv_shift",
                   [Input(_one(a).name), Input(_one(b).name)],
                   layer_attr=layer_attr)
 
 
 def multiplex_layer(input, name=None, layer_attr=None):
     ins = _many(input)
-    return _layer(_name(name, "multiplex"), "multiplex",
+    return _layer(_name(name, "multiplex_layer"), "multiplex",
                   [Input(i.name) for i in ins], layer_attr=layer_attr)
 
 
@@ -981,7 +1018,7 @@ def row_conv_layer(input, context_len, act=None, name=None,
 
 
 def sub_nested_seq_layer(input, selected_indices, name=None):
-    return _layer(_name(name, "sub_nested_seq"), "sub_nested_seq",
+    return _layer(_name(name, "sub_nested_seq_layer"), "sub_nested_seq",
                   [Input(_one(input).name),
                    Input(_one(selected_indices).name)])
 

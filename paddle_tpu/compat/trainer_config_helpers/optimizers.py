@@ -16,7 +16,7 @@ __all__ = [
     "Optimizer", "BaseSGDOptimizer", "MomentumOptimizer", "AdamaxOptimizer",
     "AdamOptimizer", "AdaGradOptimizer", "RMSPropOptimizer",
     "DecayedAdaGradOptimizer", "AdaDeltaOptimizer", "BaseRegularization",
-    "L2Regularization", "settings", "ModelAverage",
+    "L2Regularization", "L1Regularization", "settings", "ModelAverage",
     "GradientClippingThreshold",
 ]
 

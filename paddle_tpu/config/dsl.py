@@ -401,12 +401,31 @@ def memory(*, name: str, size: int, boot_layer: Optional[LayerOutput] = None,
     if _GROUP_CTX is None:
         raise RuntimeError(
             "memory() must be called inside a recurrent_group step function")
+    if name is None:
+        # anonymous memory: the link target is bound later via
+        # .set_input(layer) (the reference DSL's memory.set_input)
+        name = f"__anon_mem_{len(_GROUP_CTX['memories'])}__"
     bname = f"{_GROUP_CTX['name']}@mem_{name}"
     out = _add(LayerDef(name=bname, type="data", size=size, bias=False))
-    _GROUP_CTX["memories"].append({
-        "boundary": bname, "link": name, "boot_layer": boot_layer,
-        "init": boot_with_const_value})
+    _GROUP_CTX["memories"].append(
+        {"boundary": bname, "link": name, "boot_layer": boot_layer,
+         "init": boot_with_const_value})
     return out
+
+
+def _memory_set_input(self, layer):
+    """The reference DSL's ``memory.set_input``: bind an anonymous memory
+    to its producing layer after the fact."""
+    if _GROUP_CTX is not None:
+        for entry in _GROUP_CTX["memories"]:
+            if entry["boundary"] == self.name:
+                entry["link"] = layer.name
+                return
+    raise RuntimeError("set_input() is only valid on a memory created "
+                       "inside the active recurrent_group")
+
+
+LayerOutput.set_input = _memory_set_input
 
 
 def recurrent_group(step, input, *, reverse: bool = False,
@@ -481,6 +500,28 @@ def recurrent_group(step, input, *, reverse: bool = False,
         extras.append(_add(odef))
     return (main, *extras)
 
+
+
+def evaluator(type: str, input, *, label=None, weight=None, name: str = None,
+              **kwargs):
+    """Attach a metric evaluator to the graph (the native spelling of the
+    reference's evaluator config funcs, `trainer_config_helpers/
+    evaluators.py`); the trainer wires it to the metric registry
+    (paddle_tpu/trainer/metrics.py) each pass."""
+    ins = [input] if isinstance(input, LayerOutput) else list(input)
+    names = [i.name for i in ins]
+    n_outputs = len(names)
+    for extra in (label, weight):
+        if extra is not None:
+            names.append(extra.name)
+    cfg = {"type": type, "name": name or f"__{type}_evaluator__",
+           "input_layers": names,
+           "_roles": {"n_outputs": n_outputs,
+                      "has_label": label is not None,
+                      "has_weight": weight is not None}}
+    cfg.update({k: v for k, v in kwargs.items() if v is not None})
+    current_graph().evaluators.append(cfg)
+    return cfg
 
 def slope_intercept(input, *, slope: float = 1.0, intercept: float = 0.0,
                     name: str = None) -> LayerOutput:
@@ -812,7 +853,7 @@ def priorbox_layer(input, image, *, min_size, max_size=(), aspect_ratio=(1.0,),
 
 def multibox_loss_layer(priorbox, label, conf, loc, *, num_classes: int,
                         overlap_threshold: float = 0.5,
-                        neg_pos_ratio: float = 3.0,
+                        neg_pos_ratio: float = 3.0, neg_overlap: float = 0.5,
                         background_id: int = 0, name=None):
     ldef = LayerDef(name=name or _auto_name("multibox_loss"),
                     type="multibox_loss",
@@ -823,6 +864,7 @@ def multibox_loss_layer(priorbox, label, conf, loc, *, num_classes: int,
                     attrs={"num_classes": num_classes,
                            "overlap_threshold": overlap_threshold,
                            "neg_pos_ratio": neg_pos_ratio,
+                           "neg_overlap": neg_overlap,
                            "background_id": background_id})
     return _add(ldef)
 

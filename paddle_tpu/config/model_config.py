@@ -66,6 +66,9 @@ class ModelDef:
     layers: Dict[str, LayerDef] = dataclasses.field(default_factory=dict)
     input_layer_names: List[str] = dataclasses.field(default_factory=list)
     output_layer_names: List[str] = dataclasses.field(default_factory=list)
+    # EvaluatorConfig-shaped dicts ({"type", "name", "input_layers", ...});
+    # consumed by the trainer's metric wiring (SGD._host_evals)
+    evaluators: List[Dict[str, Any]] = dataclasses.field(default_factory=list)
 
     def add(self, layer: LayerDef) -> LayerDef:
         if layer.name in self.layers:

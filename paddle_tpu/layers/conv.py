@@ -39,6 +39,24 @@ def _conv_geom(in_sz: int, filt: int, pad: int, stride: int) -> int:
     return (in_sz + 2 * pad - filt) // stride + 1
 
 
+def derive_geom(in_info: ShapeInfo, channels=None):
+    """(channels, height, width) of an input, deriving a square image from
+    the flat size when the producing layer carried no geometry — the
+    reference's config_parser does the same sqrt(size/channels) inference
+    when a conv consumes a plain data layer."""
+    c = channels or in_info.channels
+    if in_info.height is not None:
+        return c or in_info.channels, in_info.height, in_info.width
+    c = c or 1
+    import math
+    side = math.isqrt(in_info.size // c)
+    if side * side * c != in_info.size:
+        raise ValueError(
+            f"cannot infer square image geometry from size {in_info.size} "
+            f"with {c} channels; set height/width on the data layer")
+    return c, side, side
+
+
 def _conv_spec(inp_extra: dict, in_info: ShapeInfo):
     fs = inp_extra["filter_size"]
     fsy = inp_extra.get("filter_size_y", fs)
@@ -57,8 +75,9 @@ class ConvLayer(LayerImpl):
         nf = cfg.attrs["num_filters"]
         fs, fsy, st, sty, pad, pady, groups, c = _conv_spec(
             cfg.inputs[0].extra, in_infos[0])
-        h = _conv_geom(in_infos[0].height, fsy, pady, sty)
-        w = _conv_geom(in_infos[0].width, fs, pad, st)
+        _, in_h, in_w = derive_geom(in_infos[0], c)
+        h = _conv_geom(in_h, fsy, pady, sty)
+        w = _conv_geom(in_w, fs, pad, st)
         return ShapeInfo(size=nf * h * w, channels=nf, height=h, width=w)
 
     def params(self, cfg, in_infos):
@@ -67,6 +86,7 @@ class ConvLayer(LayerImpl):
         for i, info in enumerate(in_infos):
             fs, fsy, st, sty, pad, pady, groups, c = _conv_spec(
                 cfg.inputs[i].extra, info)
+            c = derive_geom(info, c)[0]
             specs[f"w{i}"] = ParamSpec(shape=(fsy, fs, c // groups, nf))
         if cfg.bias:
             specs["wbias"] = ParamSpec(shape=(nf,), init="zeros", is_bias=True)
@@ -78,7 +98,8 @@ class ConvLayer(LayerImpl):
             info = ctx.in_infos[i]
             fs, fsy, st, sty, pad, pady, groups, c = _conv_spec(
                 cfg.inputs[i].extra, info)
-            x = to_nhwc(a.value, c, info.height, info.width)
+            c, in_h, in_w = derive_geom(info, c)
+            x = to_nhwc(a.value, c, in_h, in_w)
             y = lax.conv_general_dilated(
                 x, params[f"w{i}"],
                 window_strides=(sty, st),
@@ -101,8 +122,9 @@ class ConvTransLayer(LayerImpl):
         nf = cfg.attrs["num_filters"]
         fs, fsy, st, sty, pad, pady, groups, c = _conv_spec(
             cfg.inputs[0].extra, in_infos[0])
-        h = (in_infos[0].height - 1) * sty + fsy - 2 * pady
-        w = (in_infos[0].width - 1) * st + fs - 2 * pad
+        _, in_h, in_w = derive_geom(in_infos[0], c)
+        h = (in_h - 1) * sty + fsy - 2 * pady
+        w = (in_w - 1) * st + fs - 2 * pad
         return ShapeInfo(size=nf * h * w, channels=nf, height=h, width=w)
 
     def params(self, cfg, in_infos):
@@ -111,6 +133,7 @@ class ConvTransLayer(LayerImpl):
         for i, info in enumerate(in_infos):
             fs, fsy, st, sty, pad, pady, groups, c = _conv_spec(
                 cfg.inputs[i].extra, info)
+            c = derive_geom(info, c)[0]
             # gradient-of-conv layout: treat as conv from nf -> c
             specs[f"w{i}"] = ParamSpec(shape=(fsy, fs, nf // groups, c))
         if cfg.bias:

@@ -136,3 +136,30 @@ class LambdaCost(_CostBase):
         better = (r_diff > 0).astype(score.dtype) * pair_valid
         cost = jnp.sum(better * jax.nn.softplus(-s_diff), axis=(1, 2))
         return Argument(value=cost.reshape(-1, 1))
+
+
+@register_layer("multi_class_cross_entropy_with_selfnorm")
+class CrossEntropyWithSelfNormCost(_CostBase):
+    """``CostLayer.cpp`` MultiClassCrossEntropyWithSelfNorm: cross-entropy
+    plus alpha * log(Z)^2 pushing the partition sum Z toward 1 (so inference
+    can skip normalization)."""
+
+    def apply(self, cfg, params, ins, ctx):
+        prob, label = ins[0], ins[1]
+        p = jnp.clip(prob.value, _EPS, None)
+        z = jnp.sum(p, axis=-1)
+        pn = p / z[..., None]
+        lab = label.value.astype(jnp.int32)
+        ll = jnp.take_along_axis(pn, lab[..., None], axis=-1)[..., 0]
+        alpha = cfg.attrs.get("softmax_selfnorm_alpha", 0.1)
+        cost = -jnp.log(ll) + alpha * jnp.square(jnp.log(z))
+        return Argument(value=_reduce_tokens(cost, prob.mask))
+
+
+@register_layer("sum_cost")
+class SumCost(_CostBase):
+    """``CostLayer.cpp`` SumCostLayer: cost = sum of the input row."""
+
+    def apply(self, cfg, params, ins, ctx):
+        cost = jnp.sum(ins[0].value, axis=-1)
+        return Argument(value=_reduce_tokens(cost, ins[0].mask))

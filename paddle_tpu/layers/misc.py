@@ -466,3 +466,107 @@ class GetOutputLayer(LayerImpl):
     def apply(self, cfg, params, ins, ctx):
         arg = cfg.attrs.get("arg_name", "state")
         return Argument(value=ins[0].state[arg], mask=ins[0].mask)
+
+
+@register_layer("featmap_expand")
+class FeatureMapExpandLayer(LayerImpl):
+    """``FeatureMapExpandLayer.cpp``: repeat the input N times along the
+    feature axis — whole-vector tiling by default (as_row_vector), or
+    per-element repetition when user_arg is "as_col_vec". Used by
+    ``repeat_layer`` and layer_math broadcasting."""
+
+    def infer(self, cfg, in_infos):
+        n = cfg.attrs.get("num_filters", 1)
+        info = in_infos[0]
+        return ShapeInfo(size=info.size * n, is_sequence=info.is_sequence)
+
+    def apply(self, cfg, params, ins, ctx):
+        n = cfg.attrs.get("num_filters", 1)
+        x = ins[0].value
+        if cfg.attrs.get("user_arg") == "as_col_vec":
+            out = jnp.repeat(x, n, axis=-1)
+        else:
+            out = jnp.tile(x, (1,) * (x.ndim - 1) + (n,))
+        return ins[0].with_value(out)
+
+
+@register_layer("row_l2_norm")
+class RowL2NormLayer(LayerImpl):
+    """``RowL2NormLayer.cpp``: x / ||x||_2 per row."""
+
+    def infer(self, cfg, in_infos):
+        return in_infos[0]
+
+    def apply(self, cfg, params, ins, ctx):
+        x = ins[0].value
+        norm = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True)) + 1e-12
+        return ins[0].with_value(x / norm)
+
+
+@register_layer("cos_vm")
+class CosSimVecMatLayer(LayerImpl):
+    """``CosSimVecMatLayer.cpp``: cosine similarity of input 0's vector
+    [B, D] against each of the `size` rows of input 1 [B, size*D]."""
+
+    def infer(self, cfg, in_infos):
+        return ShapeInfo(size=cfg.size)
+
+    def apply(self, cfg, params, ins, ctx):
+        vec, mat = ins[0].value, ins[1].value
+        n = cfg.size
+        d = vec.shape[-1]
+        rows = mat.reshape(mat.shape[0], n, d)
+        scale = cfg.attrs.get("cos_scale", 1.0)
+        dot = jnp.einsum("bd,bnd->bn", vec, rows)
+        denom = (jnp.linalg.norm(vec, axis=-1, keepdims=True)
+                 * jnp.linalg.norm(rows, axis=-1) + 1e-12)
+        return Argument(value=scale * dot / denom)
+
+
+@register_layer("kmax_seq_score")
+class KmaxSeqScoreLayer(LayerImpl):
+    """``KmaxSeqScoreLayer.cpp``: top-beam_size timestep indices of a
+    per-timestep score sequence, by descending score."""
+
+    def infer(self, cfg, in_infos):
+        return ShapeInfo(size=cfg.attrs.get("beam_size", 1))
+
+    def apply(self, cfg, params, ins, ctx):
+        k = cfg.attrs.get("beam_size", 1)
+        scores = ins[0].value
+        if scores.ndim == 3:
+            scores = scores[..., 0]
+        if ins[0].mask is not None:
+            scores = jnp.where(ins[0].mask > 0, scores, -jnp.inf)
+        _, idx = jax.lax.top_k(scores, k)
+        return Argument(value=idx.astype(jnp.int32))
+
+
+@register_layer("sum_to_one_norm")
+class SumToOneNormLayer(LayerImpl):
+    """``SumToOneNormLayer.cpp``: x / sum(x) per row."""
+
+    def infer(self, cfg, in_infos):
+        return in_infos[0]
+
+    def apply(self, cfg, params, ins, ctx):
+        x = ins[0].value
+        s = jnp.sum(x, axis=-1, keepdims=True) + 1e-12
+        return ins[0].with_value(x / s)
+
+
+@register_layer("convex_comb")
+class LinearCombLayer(LayerImpl):
+    """``LinearChainCombLayer`` ("convex_comb", the reference's
+    linear_comb_layer): weights [B, m] linearly combine the m rows of
+    input 1 [B, m*size] into [B, size]."""
+
+    def infer(self, cfg, in_infos):
+        return ShapeInfo(size=cfg.size)
+
+    def apply(self, cfg, params, ins, ctx):
+        w, v = ins[0].value, ins[1].value
+        d = cfg.size
+        m = v.shape[-1] // d
+        rows = v.reshape(v.shape[0], m, d)
+        return Argument(value=jnp.einsum("bm,bmd->bd", w[:, :m], rows))

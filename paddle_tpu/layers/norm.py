@@ -4,7 +4,7 @@
 BatchNorm*Layer.cpp``): scale+shift per channel, batch statistics in
 training, moving statistics at test. The reference keeps moving mean/var as
 two *static* parameters (inputs 1 and 2 of the layer); here they are static
-entries in the parameter dict (``w1moving``, ``w2moving``) and the training
+entries in the parameter dict (``w1``, ``w2``) and the training
 apply records their EMA update in ``ctx.state_updates`` — the train step
 applies those updates functionally (no mutation inside jit).
 
@@ -34,8 +34,8 @@ class BatchNormLayer(LayerImpl):
             "w0": ParamSpec(shape=(c,), init="const", initial_mean=1.0,
                             initial_std=0.0),
             "wbias": ParamSpec(shape=(c,), init="zeros", is_bias=True),
-            "w1moving": ParamSpec(shape=(c,), init="zeros", is_static=True),
-            "w2moving": ParamSpec(shape=(c,), init="const", initial_mean=1.0,
+            "w1": ParamSpec(shape=(c,), init="zeros", is_static=True),
+            "w2": ParamSpec(shape=(c,), init="const", initial_mean=1.0,
                                   is_static=True),
         }
 
@@ -51,17 +51,17 @@ class BatchNormLayer(LayerImpl):
         if use_global is None:
             use_global = not ctx.train
         if use_global:
-            mean, var = params["w1moving"], params["w2moving"]
+            mean, var = params["w1"], params["w2"]
         else:
             mean = jnp.mean(x, axis=axes)
             var = jnp.mean(jnp.square(x - mean), axis=axes)
         y = (x - mean) * lax.rsqrt(var + eps) * params["w0"] + params["wbias"]
         if ctx.train and not use_global:
             lname = cfg.name
-            ctx.state_updates[f"_{lname}.w1moving"] = (
-                momentum * params["w1moving"] + (1.0 - momentum) * mean)
-            ctx.state_updates[f"_{lname}.w2moving"] = (
-                momentum * params["w2moving"] + (1.0 - momentum) * var)
+            ctx.state_updates[f"_{lname}.w1"] = (
+                momentum * params["w1"] + (1.0 - momentum) * mean)
+            ctx.state_updates[f"_{lname}.w2"] = (
+                momentum * params["w2"] + (1.0 - momentum) * var)
         return Argument(value=y, mask=ins[0].mask)
 
 

@@ -76,15 +76,23 @@ class SppLayer(LayerImpl):
     """Spatial pyramid pooling (``SpatialPyramidPoolLayer.cpp``): concat of
     pyramid_height levels of adaptive max/avg pooling, flattened."""
 
+    def _geom(self, cfg, info):
+        c = cfg.attrs.get("channels") or info.channels
+        if info.height is not None:
+            return c, info.height, info.width
+        from paddle_tpu.layers.conv import derive_geom
+        return derive_geom(info, c)
+
     def infer(self, cfg, in_infos):
-        c = in_infos[0].channels
+        c, _, _ = self._geom(cfg, in_infos[0])
         levels = cfg.attrs.get("pyramid_height", 3)
         bins = sum(4 ** l for l in range(levels))
         return ShapeInfo(size=c * bins)
 
     def apply(self, cfg, params, ins, ctx):
         info = ctx.in_infos[0]
-        x = to_nhwc(ins[0].value, info.channels, info.height, info.width)
+        c, h, w = self._geom(cfg, info)
+        x = to_nhwc(ins[0].value, c, h, w)
         levels = cfg.attrs.get("pyramid_height", 3)
         ptype = cfg.attrs.get("pool_type", "max-projection")
         outs = []

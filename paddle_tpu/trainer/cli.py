@@ -66,7 +66,16 @@ def parse_args(argv=None):
 
 
 def load_config(path: str, config_args: str = ""):
-    """Execute the config file; returns its namespace."""
+    """Execute the config file; returns its namespace. Configs that import
+    the v1 surface (``from paddle.trainer_config_helpers import *``) go
+    through the compat config compiler (the reference's embedded
+    ``parse_config`` contract, ``TrainerConfigHelper.cpp:33-57``) so
+    reference configs run unmodified; native configs are executed directly
+    and must define ``cost``."""
+    with open(path) as f:
+        src = f.read()
+    if "trainer_config_helpers" in src or "paddle.trainer." in src:
+        return _load_v1_config(path, config_args)
     from paddle_tpu.config import dsl
     dsl.reset()
     ns = {"__file__": os.path.abspath(path), "__name__": "__paddle_config__"}
@@ -87,6 +96,44 @@ def load_config(path: str, config_args: str = ""):
     return ns
 
 
+def _load_v1_config(path: str, config_args: str = ""):
+    """v1 config -> the same namespace contract the native path produces
+    (cost/optimizer/train_reader/test_reader/feeding/outputs)."""
+    from paddle_tpu.compat import parse_config
+    from paddle_tpu.trainer.trainer import Topology
+    parsed = parse_config(path, config_args)
+
+    costs = parsed.cost_layers()
+    out_names = list(parsed.context.output_layer_names)
+    if costs:
+        # all declared cost layers train jointly (their sum); non-cost
+        # outputs ride along as passive extras
+        extra = [n for n in out_names if n not in costs]
+        cost = Topology(costs, extra_outputs=extra, graph=parsed.model)
+    elif out_names:
+        # inference-only config (e.g. is_predict=1): topology rooted at the
+        # declared outputs; --job=train will fail later, by design
+        cost = Topology(out_names[0], extra_outputs=out_names[1:],
+                        graph=parsed.model)
+    else:
+        raise SystemExit(f"config {path} declares no outputs()")
+
+    ns = {
+        "__file__": os.path.abspath(path),
+        "parsed_config": parsed,
+        "cost": cost,
+        "optimizer": parsed.optimizer(),
+        "feeding": parsed.feeding(),
+        "outputs": out_names,
+        "evaluators": list(parsed.context.evaluators),
+    }
+    ns["train_reader"] = (parsed.train_reader()
+                          if parsed.context.train_source else None)
+    ns["test_reader"] = (parsed.test_reader()
+                         if parsed.context.test_source else None)
+    return ns
+
+
 def _build_trainer(ns, args):
     from paddle_tpu.optim.optimizers import Momentum
     from paddle_tpu.trainer.trainer import SGD
@@ -97,7 +144,7 @@ def _build_trainer(ns, args):
     optimizer = ns.get("optimizer") or Momentum(learning_rate=0.01,
                                                 momentum=0.9)
     trainer = SGD(cost=ns["cost"], update_equation=optimizer, mesh=mesh,
-                  seed=args.seed)
+                  seed=args.seed, evaluators=ns.get("evaluators"))
     if args.init_model_path:
         _init_params(trainer, args.init_model_path)
     return trainer
@@ -204,7 +251,9 @@ def cmd_time(ns, args):
         t0 = time.perf_counter()
         trainer.params, trainer.opt_state, metrics = trainer._train_step(
             trainer.params, trainer.opt_state, feed, step_rng, jnp.int32(0))
-        jax.block_until_ready(metrics["cost"])
+        # a real host fetch, not block_until_ready: remote (tunneled)
+        # devices report ready before execution finishes
+        float(metrics["cost"])
         dt = time.perf_counter() - t0
         if i >= args.time_warmup and sig == sig0:
             times.append(dt)

@@ -41,6 +41,9 @@ class EvaluatorBase:
     (already fetched from device) and ``value()``."""
 
     type_name = "?"
+    # printer evaluators set this: value() has print side effects, so the
+    # trainer reads them once per pass (EndPass), not every log period
+    prints_on_value = False
 
     def __init__(self, name: Optional[str] = None):
         self.name = name or self.type_name
@@ -465,6 +468,7 @@ class ColumnSumEvaluator(EvaluatorBase):
 
 @register_evaluator("value_printer")
 class ValuePrinter(EvaluatorBase):
+    prints_on_value = True
     """``ValuePrinter`` — debug printer; keeps last batch, prints on
     finish (the reference prints every eval)."""
 
@@ -481,6 +485,7 @@ class ValuePrinter(EvaluatorBase):
 
 @register_evaluator("maxid_printer")
 class MaxIdPrinter(EvaluatorBase):
+    prints_on_value = True
     def start(self):
         self.last = None
 
@@ -494,6 +499,7 @@ class MaxIdPrinter(EvaluatorBase):
 
 @register_evaluator("seq_text_printer")
 class SeqTextPrinter(EvaluatorBase):
+    prints_on_value = True
     """``utils/SeqTextPrinter`` analogue: map id sequences through a dict
     file and print."""
 
@@ -522,3 +528,139 @@ class SeqTextPrinter(EvaluatorBase):
     def value(self):
         print("\n".join(self.lines))
         return 0.0
+
+
+@register_evaluator("detection_map")
+class DetectionMAPEvaluator(EvaluatorBase):
+    """``DetectionMAPEvaluator.cpp``: mean average precision over detection
+    outputs. output rows (per image): [keep_top_k, 7] =
+    (label, score, xmin, ymin, xmax, ymax, valid) — the detection_output
+    layer's format; label rows: [M, 6] = (label, xmin, ymin, xmax, ymax,
+    difficult), with label < 0 marking padding rows.
+    ap_type: "11point" (default) or "integral"."""
+
+    def __init__(self, name=None, overlap_threshold: float = 0.5,
+                 background_id: int = 0, evaluate_difficult: bool = False,
+                 ap_type: str = "11point"):
+        self.overlap_threshold = overlap_threshold
+        self.background_id = background_id
+        self.evaluate_difficult = evaluate_difficult
+        self.ap_type = ap_type
+        super().__init__(name)
+
+    def start(self):
+        # per class: list of (score, is_tp) + ground-truth count
+        self.dets: Dict[int, List] = {}
+        self.n_gt: Dict[int, int] = {}
+
+    @staticmethod
+    def _iou(a, b):
+        ix = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+        iy = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+        inter = ix * iy
+        ua = ((a[2] - a[0]) * (a[3] - a[1])
+              + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+        return inter / ua if ua > 0 else 0.0
+
+    def eval_batch(self, output, label=None, weight=None, mask=None):
+        out = np.asarray(output)
+        gt = np.asarray(label)
+        if out.ndim == 2:
+            out, gt = out[None], gt[None]
+        if out.shape[-1] != 7:
+            out = out.reshape(out.shape[0], -1, 7)
+        if gt.shape[-1] != 6:
+            gt = gt.reshape(gt.shape[0], -1, 6)
+        for b in range(out.shape[0]):
+            gts = [g for g in gt[b] if g[0] >= 0]
+            for g in gts:
+                c = int(g[0])
+                if self.evaluate_difficult or not g[5]:
+                    self.n_gt[c] = self.n_gt.get(c, 0) + 1
+            matched = [False] * len(gts)
+            dets = [d for d in out[b] if d[6] > 0 and d[0] != self.background_id]
+            dets.sort(key=lambda d: -d[1])
+            for d in dets:
+                c = int(d[0])
+                best, best_i = 0.0, -1
+                for i, g in enumerate(gts):
+                    if int(g[0]) != c:
+                        continue
+                    o = self._iou(d[2:6], g[1:5])
+                    if o > best:
+                        best, best_i = o, i
+                tp = False
+                if best >= self.overlap_threshold and best_i >= 0:
+                    g = gts[best_i]
+                    if not self.evaluate_difficult and g[5]:
+                        continue  # difficult match: ignore the detection
+                    if not matched[best_i]:
+                        matched[best_i] = True
+                        tp = True
+                self.dets.setdefault(c, []).append((float(d[1]), tp))
+
+    def _ap(self, recs, precs):
+        if self.ap_type == "integral":
+            ap, prev_r = 0.0, 0.0
+            for r, p in zip(recs, precs):
+                ap += p * (r - prev_r)
+                prev_r = r
+            return ap
+        ap = 0.0
+        for t in np.arange(0.0, 1.01, 0.1):
+            ps = [p for r, p in zip(recs, precs) if r >= t]
+            ap += (max(ps) if ps else 0.0) / 11.0
+        return ap
+
+    def value(self):
+        aps = []
+        for c, n_gt in self.n_gt.items():
+            dets = sorted(self.dets.get(c, []), key=lambda d: -d[0])
+            tp_cum = fp_cum = 0
+            recs, precs = [], []
+            for score, tp in dets:
+                tp_cum += tp
+                fp_cum += not tp
+                recs.append(tp_cum / max(n_gt, 1))
+                precs.append(tp_cum / max(tp_cum + fp_cum, 1))
+            aps.append(self._ap(recs, precs) if dets else 0.0)
+        return float(np.mean(aps)) if aps else 0.0
+
+
+# ---------------------------------------------------------- config wiring
+# reference EvaluatorConfig.type -> registry name
+_TYPE_ALIASES = {
+    "last-column-auc": "auc",
+    "last-column-sum": "column_sum",
+    "max_id_printer": "maxid_printer",
+}
+
+
+def build_from_configs(configs: Sequence[dict]):
+    """EvaluatorConfig-shaped dicts (compat ctx().evaluators / ModelDef
+    .evaluators) -> [(evaluator, input_layer_names, roles)]. ``roles``
+    (the ``_roles`` key the DSLs record) says how many leading inputs are
+    outputs and whether label/weight/query follow, so the trainer binds
+    ``eval_batch`` kwargs correctly. Unknown types are skipped with a
+    warning — a config must not fail to train because a printer evaluator
+    is missing."""
+    import inspect
+    from paddle_tpu.utils import logger
+    built = []
+    for cfg in configs or []:
+        tname = _TYPE_ALIASES.get(cfg.get("type"), cfg.get("type"))
+        cls = _EVALUATORS.get(tname)
+        if cls is None:
+            logger.warning("evaluator type %r not supported; skipping",
+                           cfg.get("type"))
+            continue
+        accepted = set(inspect.signature(cls.__init__).parameters)
+        kwargs = {k: v for k, v in cfg.items()
+                  if k in accepted and k not in ("input_layers", "type")}
+        roles = cfg.get("_roles") or {"n_outputs": 1,
+                                      "has_label":
+                                      len(cfg.get("input_layers", [])) > 1,
+                                      "has_weight": False}
+        built.append((cls(**kwargs), list(cfg.get("input_layers", [])),
+                      roles))
+    return built

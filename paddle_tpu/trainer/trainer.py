@@ -47,17 +47,21 @@ def _call_reader(reader, pass_id: int):
 
 
 class Topology:
-    """cost LayerOutput -> executable Network (``python/paddle/v2/
-    topology.py:44``)."""
+    """cost LayerOutput(s) -> executable Network (``python/paddle/v2/
+    topology.py:44``). ``cost`` may be a list: multi-task configs train on
+    the SUM of their cost layers, as the reference's ``Argument::sum``
+    over all output args does."""
 
     def __init__(self, cost, extra_outputs: Optional[List] = None,
                  graph: Optional[ModelDef] = None):
+        costs = list(cost) if isinstance(cost, (list, tuple)) else [cost]
         if graph is None:
             # prefer the graph the cost layer was built in (stays correct
             # after dsl.reset() begins another model)
-            graph = getattr(cost, "graph", None) or _dsl.current_graph()
+            graph = getattr(costs[0], "graph", None) or _dsl.current_graph()
         names = [c.name if hasattr(c, "name") else c
-                 for c in ([cost] + list(extra_outputs or []))]
+                 for c in (costs + list(extra_outputs or []))]
+        self.cost_names = names[:len(costs)]
         self.cost_name = names[0]
         graph.output_layer_names = names
         self.network = Network(graph, outputs=names)
@@ -72,12 +76,32 @@ class SGD:
                  update_equation: Optimizer = None, *,
                  extra_layers: Optional[List] = None,
                  mesh=None, shard_rules: Optional[Dict[str, Any]] = None,
-                 seed: int = 0, is_local: bool = True):
+                 seed: int = 0, is_local: bool = True,
+                 evaluators: Optional[List[dict]] = None):
         if update_equation is None:
             raise ValueError("update_equation (an Optimizer) is required")
         self.topology = (cost if isinstance(cost, Topology)
                          else Topology(cost, extra_outputs=extra_layers))
         self.network = self.topology.network
+        # config-declared evaluators (compat ctx().evaluators and/or the
+        # DSL's graph.evaluators) wired to the metric registry — the
+        # reference's gm->eval(evaluators) path (TrainerInternal.cpp:160)
+        from paddle_tpu.trainer import metrics as _metrics_mod
+        graph = self.topology.graph
+        ev_cfgs = (list(evaluators or [])
+                   + list(getattr(graph, "evaluators", None) or []))
+        self._host_evals = _metrics_mod.build_from_configs(ev_cfgs)
+        needed = {n for _, ins, _ in self._host_evals for n in ins
+                  if n in graph.layers}
+        missing = needed - set(self.network.shape_infos)
+        if missing:
+            # evaluator inputs off the loss path (e.g. a maxid decode
+            # branch): extend the executed sub-graph to cover them
+            self.network = Network(
+                graph, outputs=list(graph.output_layer_names)
+                + sorted(missing))
+            self.topology.network = self.network
+        self._eval_layers = sorted(needed)
         self.optimizer = update_equation
         self.mesh = mesh
         key = jax.random.PRNGKey(seed)
@@ -102,16 +126,31 @@ class SGD:
         self._eval_step = self._build_eval_step()
 
     # ------------------------------------------------------------ builders
+    def _total_cost(self, outputs):
+        """Sum of all cost layers' batch-mean — multi-task configs train
+        on the sum (the reference's Argument::sum over outArgs)."""
+        total = 0.0
+        for n in getattr(self.topology, "cost_names",
+                         [self.topology.cost_name]):
+            v = outputs[n].value
+            total = total + jnp.sum(v) / v.shape[0]
+        return total
+
     def _metrics(self, outputs, feed):
         cost_name = self.topology.cost_name
         cdef = self.topology.graph.layers[cost_name]
-        cost_val = outputs[cost_name].value
-        bsz = cost_val.shape[0]
-        metrics = {"cost": jnp.sum(cost_val) / bsz}
+        metrics = {"cost": self._total_cost(outputs)}
         if cdef.type in _CLASSIFICATION_COSTS:
             out_l, lab_l = cdef.input_names()[0], cdef.input_names()[1]
             errs, cnt = classification_error(outputs[out_l], outputs[lab_l])
             metrics["classification_error"] = (errs, cnt)
+        if self._eval_layers:
+            # layer outputs the config-declared evaluators consume; fetched
+            # to host once per batch (dict values are skipped by
+            # _accumulate's tuple protocol)
+            metrics["eval_outputs"] = {
+                n: (outputs[n].value, outputs[n].mask)
+                for n in self._eval_layers}
         return metrics
 
     def _build_train_step(self):
@@ -121,9 +160,7 @@ class SGD:
         def loss_fn(params, feed, rng):
             outputs, updates = network.apply_with_state(
                 params, feed, train=True, rng=rng)
-            cost_val = outputs[cost_name].value
-            loss = jnp.sum(cost_val) / cost_val.shape[0]
-            return loss, (outputs, updates)
+            return self._total_cost(outputs), (outputs, updates)
 
         def step(params, opt_state, feed, rng, num_passes):
             (_, (outputs, updates)), grads = jax.value_and_grad(
@@ -182,6 +219,7 @@ class SGD:
         for pass_id in range(start_pass, num_passes):
             event_handler(ev.BeginPass(pass_id))
             acc.reset()
+            self._start_host_evaluators()
             window_cost, window_n = 0.0, 0
             for batch_id, data in enumerate(_call_reader(reader, pass_id)):
                 event_handler(ev.BeginIteration(pass_id, batch_id))
@@ -196,6 +234,7 @@ class SGD:
                         jnp.int32(pass_id))
                     cost = float(metrics["cost"])
                 evals = self._accumulate(acc, metrics)
+                self._feed_host_evaluators(metrics)
                 window_cost += cost
                 window_n += 1
                 if log_period and (batch_id + 1) % log_period == 0:
@@ -205,7 +244,9 @@ class SGD:
                     logger.info(
                         "Pass=%d Batch=%d Cost=%.5f AvgEval: %s", pass_id,
                         batch_id + 1, window_cost / window_n,
-                        " ".join(f"{k}={v:.5g}" for k, v in evals.items()))
+                        " ".join(f"{k}={v:.5g}" for k, v in
+                                 {**evals, **self.host_eval_values(
+                                     include_printers=False)}.items()))
                     logger.info("\n%s", global_stat.status(reset=True))
                     window_cost, window_n = 0.0, 0
                 event_handler(ev.EndIteration(pass_id, batch_id, cost, evals))
@@ -213,7 +254,8 @@ class SGD:
                     checkpointer.maybe_save(self.params, self.opt_state,
                                             pass_id=pass_id,
                                             batch_id=batch_id + 1)
-            event_handler(ev.EndPass(pass_id, acc.result()))
+            event_handler(ev.EndPass(
+                pass_id, {**acc.result(), **self.host_eval_values()}))
             if checkpointer is not None:
                 checkpointer.maybe_save(self.params, self.opt_state,
                                         pass_id=pass_id, end_of_pass=True)
@@ -251,6 +293,7 @@ class SGD:
 
     def test(self, reader, *, feeder=None) -> ev.TestResult:
         acc = Accumulator()
+        self._start_host_evaluators()
         total_cost, batches = 0.0, 0
         for data in reader():
             feed = feeder(data) if feeder is not None else data
@@ -260,13 +303,49 @@ class SGD:
             total_cost += float(metrics["cost"])
             batches += 1
             self._accumulate(acc, metrics)
-        return ev.TestResult(0, total_cost / max(batches, 1), acc.result())
+            self._feed_host_evaluators(metrics)
+        return ev.TestResult(0, total_cost / max(batches, 1),
+                             {**acc.result(), **self.host_eval_values()})
 
     def _accumulate(self, acc: Accumulator, metrics) -> Dict[str, float]:
         for k, v in metrics.items():
             if isinstance(v, tuple):
                 acc.add(k, *(jax.device_get(x) for x in v))
         return acc.result()
+
+    # -------------------------------------------- config-driven evaluators
+    def _start_host_evaluators(self):
+        for e, _, _ in self._host_evals:
+            e.start()
+
+    def _feed_host_evaluators(self, metrics):
+        """Per-batch accumulation into the config-declared evaluators.
+        Inputs bind by the roles the DSL recorded — [outputs..., label?,
+        weight?, query_id?] — so e.g. pnpair's query_id lands on its
+        keyword, not on ``weight``."""
+        outs = metrics.get("eval_outputs")
+        if not outs or not self._host_evals:
+            return
+        host = jax.device_get(outs)
+        for e, ins, roles in self._host_evals:
+            if not ins or ins[0] not in host:
+                continue
+            vals = [host[n][0] if n in host else None for n in ins]
+            n_out = roles.get("n_outputs", 1)
+            rest = vals[n_out:]
+            kwargs = {"mask": host[ins[0]][1]}
+            if roles.get("has_label") and rest:
+                kwargs["label"] = rest.pop(0)
+            if roles.get("has_weight") and rest:
+                kwargs["weight"] = rest.pop(0)
+            if roles.get("has_query") and rest:
+                kwargs["query_id"] = rest.pop(0)
+            e.eval_batch(vals[0], **kwargs)
+
+    def host_eval_values(self, include_printers: bool = True
+                         ) -> Dict[str, float]:
+        return {e.name: e.value() for e, _, _ in self._host_evals
+                if include_printers or not e.prints_on_value}
 
     def parameter_stats(self) -> Dict[str, Dict[str, float]]:
         """Parameter health dump — per-parameter mean |v| and max |v|

@@ -1,0 +1,271 @@
+"""v1 config-compat pipeline tests.
+
+The north-star contract: reference v1 configs (`python/paddle/
+trainer_config_helpers/tests/configs/*.py`, `v1_api_demo/*/*.py`) parse
+through ``paddle_tpu.compat.parse_config`` unmodified, export the wire
+protos (``TrainerConfigHelper.cpp:33-57`` contract), and train through the
+CLI. Structural parity is checked against the reference's golden protostr
+files (``tests/configs/protostr/*.protostr``), the same goldens its
+``ProtobufEqualMain.cpp`` harness compares.
+"""
+
+import os
+import pathlib
+import textwrap
+
+import pytest
+
+from paddle_tpu.compat import parse_config
+
+REF = pathlib.Path("/root/reference")
+CFG_DIR = REF / "python/paddle/trainer_config_helpers/tests/configs"
+GOLDEN_DIR = CFG_DIR / "protostr"
+
+needs_ref = pytest.mark.skipif(not REF.exists(), reason="needs reference")
+
+# Every config in the reference's own test list (`tests/configs/
+# file_list.sh` — 42 configs + test_split_datasource) parses. test_crop.py
+# is excluded there too: it is broken at the source (duplicate layer name
+# 'data', and `outputs(pad)` references the helper function).
+PARSING_CONFIGS = [
+    "img_layers.py", "img_trans_layers.py", "last_first_seq.py",
+    "layer_activations.py", "math_ops.py", "projections.py",
+    "shared_fc.py", "shared_gru.py", "shared_lstm.py",
+    "simple_rnn_layers.py", "test_bi_grumemory.py",
+    "test_bilinear_interp.py", "test_clip_layer.py",
+    "test_config_parser_for_non_file_config.py", "test_cost_layers.py",
+    "test_cost_layers_with_weight.py",
+    "test_detection_output_layer.py", "test_expand_layer.py", "test_fc.py",
+    "test_gated_unit_layer.py", "test_grumemory_layer.py",
+    "test_hsigmoid.py", "test_kmax_seq_socre_layer.py",
+    "test_lstmemory_layer.py", "test_maxout.py",
+    "test_multibox_loss_layer.py", "test_multiplex_layer.py",
+    "test_ntm_layers.py", "test_pad.py", "test_prelu_layer.py",
+    "test_print_layer.py", "test_recursive_topology.py",
+    "test_repeat_layer.py", "test_rnn_group.py", "test_row_conv.py",
+    "test_row_l2_norm_layer.py", "test_seq_concat_reshape.py",
+    "test_seq_select_layers.py", "test_sequence_pooling.py",
+    "test_smooth_l1.py", "test_split_datasource.py", "test_spp_layer.py",
+    "unused_layers.py", "util_layers.py",
+]
+
+# configs whose golden protostr our export matches structurally (layer
+# names/types/sizes/wiring + parameter names/dims)
+GOLDEN_PARITY_CONFIGS = [
+    "test_fc.py", "img_layers.py", "last_first_seq.py",
+    "layer_activations.py", "shared_fc.py", "test_expand_layer.py",
+    "test_sequence_pooling.py", "test_grumemory_layer.py",
+    "test_lstmemory_layer.py", "test_hsigmoid.py",
+]
+
+
+def test_install_paddle_alias_importable():
+    """ADVICE r2 (high): the advertised entry point must actually import."""
+    from paddle_tpu.compat import install_paddle_alias
+    root = install_paddle_alias()
+    import importlib
+    import sys
+    assert sys.modules["paddle"] is root
+    tch = importlib.import_module("paddle.trainer_config_helpers")
+    for name in ("data_layer", "fc_layer", "settings", "get_config_arg",
+                 "inputs", "outputs", "define_py_data_sources2",
+                 "small_vgg", "L1Regularization", "MomentumOptimizer"):
+        assert hasattr(tch, name), name
+    pdp2 = importlib.import_module("paddle.trainer.PyDataProvider2")
+    assert hasattr(pdp2, "provider")
+
+
+@needs_ref
+@pytest.mark.parametrize("name", PARSING_CONFIGS)
+def test_reference_golden_config_parses(name):
+    parsed = parse_config(str(CFG_DIR / name))
+    mp = parsed.model_proto()
+    assert len(mp.layers) == len(parsed.model.layers)
+    # serialized bytes parse back under the schema
+    blob = mp.SerializeToString()
+    from paddle_tpu.proto import ModelConfig_pb2
+    rt = ModelConfig_pb2.ModelConfig.FromString(blob)
+    assert [l.name for l in rt.layers] == [l.name for l in mp.layers]
+
+
+def _golden_model(name):
+    from google.protobuf import text_format
+    from paddle_tpu.proto import ModelConfig_pb2, TrainerConfig_pb2
+    txt = (GOLDEN_DIR / (name[:-3] + ".protostr")).read_text()
+    mc = ModelConfig_pb2.ModelConfig()
+    try:
+        text_format.Parse(txt, mc)
+        return mc
+    except text_format.ParseError:
+        tc = TrainerConfig_pb2.TrainerConfig()
+        text_format.Parse(txt, tc)
+        return tc.model_config
+
+
+@needs_ref
+@pytest.mark.parametrize("name", GOLDEN_PARITY_CONFIGS)
+def test_golden_protostr_structural_parity(name):
+    """Layer names, types, sizes, input wiring, and parameter names/dims
+    must match the reference's golden protos exactly."""
+    parsed = parse_config(str(CFG_DIR / name))
+    ours = parsed.model_proto()
+    ref = _golden_model(name)
+    assert [l.name for l in ours.layers] == [l.name for l in ref.layers]
+    for ol, rl in zip(ours.layers, ref.layers):
+        assert ol.type == rl.type, ol.name
+        assert ol.size == rl.size, ol.name
+        assert ol.active_type == rl.active_type, ol.name
+        assert [i.input_layer_name for i in ol.inputs] == \
+            [i.input_layer_name for i in rl.inputs], ol.name
+        assert [i.input_parameter_name for i in ol.inputs] == \
+            [i.input_parameter_name for i in rl.inputs], ol.name
+        assert ol.bias_parameter_name == rl.bias_parameter_name, ol.name
+    # parameter names and total sizes must match; dim *layouts* may differ
+    # (e.g. our lstm packs w0 as (H, 4H) where the reference uses (H, H, 4))
+    ours_params = {p.name: p.size for p in ours.parameters}
+    ref_params = {p.name: p.size for p in ref.parameters}
+    assert ours_params == ref_params
+    assert list(ours.input_layer_names) == list(ref.input_layer_names)
+    assert list(ours.output_layer_names) == list(ref.output_layer_names)
+
+
+@needs_ref
+def test_vgg16_mnist_reference_config():
+    """`v1_api_demo/mnist/vgg_16_mnist.py` — the north-star demo config —
+    parses unmodified, in both train and predict modes."""
+    cfg = str(REF / "v1_api_demo/mnist/vgg_16_mnist.py")
+    parsed = parse_config(cfg)
+    assert parsed.context.train_source.module == "mnist_provider"
+    assert parsed.context.settings["batch_size"] == 128
+    costs = parsed.cost_layers()
+    assert len(costs) == 1
+    tp = parsed.trainer_proto()
+    assert tp.opt_config.learning_method == "momentum"
+    assert tp.data_config.load_data_module == "mnist_provider"
+    assert len(tp.model_config.layers) > 20  # the full VGG stack
+    opt = parsed.optimizer()
+    assert type(opt).__name__ == "Momentum"
+
+    pred = parse_config(cfg, "is_predict=1")
+    assert not pred.cost_layers()
+
+
+@needs_ref
+def test_parse_config_and_serialize_reference_schema_roundtrip(tmp_path):
+    """Serialized TrainerConfig bytes parse under the *reference's* compiled
+    schema — the C++ consumer contract."""
+    import shutil
+    import subprocess
+    if shutil.which("protoc") is None:
+        pytest.skip("needs protoc")
+    from google.protobuf import (descriptor_pb2, descriptor_pool,
+                                 message_factory)
+    from paddle_tpu.compat import parse_config_and_serialize
+    blob = parse_config_and_serialize(str(CFG_DIR / "test_fc.py"))
+
+    out = tmp_path / "ref.desc"
+    subprocess.run(
+        ["protoc", f"-I{REF / 'proto'}", "-o", str(out),
+         "--include_imports", "TrainerConfig.proto"],
+        check=True, cwd=REF / "proto")
+    fds = descriptor_pb2.FileDescriptorSet.FromString(out.read_bytes())
+    pool = descriptor_pool.DescriptorPool()
+    for f in fds.file:
+        pool.Add(f)
+    ref_cls = message_factory.GetMessageClass(
+        pool.FindMessageTypeByName("paddle.TrainerConfig"))
+    tc = ref_cls.FromString(blob)
+    assert tc.opt_config.batch_size == 1000
+    assert len(tc.model_config.layers) == 5
+
+
+# --------------------------------------------------------- end-to-end train
+V1_TRAIN_CONFIG = """\
+from paddle.trainer_config_helpers import *
+
+define_py_data_sources2(
+    train_list='train.list', test_list='test.list',
+    module='toy_provider', obj='process')
+
+settings(
+    batch_size=8,
+    learning_rate=0.1,
+    learning_method=MomentumOptimizer(0.9),
+    regularization=L2Regularization(1e-4))
+
+img = data_layer(name='pixel', size=16)
+hidden = fc_layer(input=img, size=32, act=TanhActivation())
+predict = fc_layer(input=hidden, size=4, act=SoftmaxActivation())
+lbl = data_layer(name='label', size=4)
+inputs(img, lbl)
+outputs(classification_cost(input=predict, label=lbl))
+"""
+
+TOY_PROVIDER = """\
+from paddle.trainer.PyDataProvider2 import *
+import random
+
+
+@provider(input_types={'pixel': dense_vector(16),
+                       'label': integer_value(4)})
+def process(settings, filename):
+    rng = random.Random(42)
+    for _ in range(64):
+        label = rng.randrange(4)
+        base = [0.0] * 16
+        for i in range(4):
+            base[label * 4 + i] = 1.0 + rng.random() * 0.1
+        yield base, label
+"""
+
+
+@pytest.fixture
+def v1_job_dir(tmp_path):
+    (tmp_path / "trainer_config.py").write_text(V1_TRAIN_CONFIG)
+    (tmp_path / "toy_provider.py").write_text(TOY_PROVIDER)
+    (tmp_path / "data.txt").write_text("synthetic\n")
+    (tmp_path / "train.list").write_text(str(tmp_path / "data.txt") + "\n")
+    (tmp_path / "test.list").write_text(str(tmp_path / "data.txt") + "\n")
+    return tmp_path
+
+
+def test_cli_trains_v1_config(v1_job_dir, capsys):
+    """`--config=<v1 config>` trains end-to-end through the compat
+    compiler: the reference CLI contract (`TrainerMain.cpp:32-64`)."""
+    from paddle_tpu.trainer import cli
+    rc = cli.main(["--config", str(v1_job_dir / "trainer_config.py"),
+                   "--job", "train", "--num_passes", "2",
+                   "--log_period", "4"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Pass 0" in out and "Pass 1" in out
+
+
+def test_cli_tests_v1_config(v1_job_dir, capsys):
+    from paddle_tpu.trainer import cli
+    rc = cli.main(["--config", str(v1_job_dir / "trainer_config.py"),
+                   "--job", "test"])
+    assert rc == 0
+    assert "Test: cost=" in capsys.readouterr().out
+
+
+def test_v1_config_loss_decreases(v1_job_dir):
+    """The compat pipeline doesn't just run — it learns: loss after two
+    passes is below the first-batch loss."""
+    from paddle_tpu.trainer import cli as cli_mod
+    ns = cli_mod.load_config(str(v1_job_dir / "trainer_config.py"))
+    from paddle_tpu.trainer.trainer import SGD
+    trainer = SGD(cost=ns["cost"], update_equation=ns["optimizer"], seed=0)
+    losses = []
+
+    from paddle_tpu.trainer import events as ev
+
+    def handler(e):
+        if isinstance(e, ev.EndIteration):
+            losses.append(float(e.cost))
+
+    from paddle_tpu.data.feeder import DataFeeder
+    feeder = DataFeeder(ns["feeding"])
+    trainer.train(ns["train_reader"], feeder=feeder, num_passes=3,
+                  event_handler=handler, log_period=1000)
+    assert losses[-1] < losses[0] * 0.7

@@ -1,0 +1,164 @@
+"""Config-declared evaluators reach the train loop.
+
+The reference wires ``EvaluatorConfig`` entries into ``gm->eval`` every
+batch and reports them in the per-period log and EndPass
+(``TrainerInternal.cpp:160-170``). Here: compat configs record into
+``ctx().evaluators``, the DSL records into ``ModelDef.evaluators``, and
+``SGD`` feeds both through ``trainer/metrics.py build_from_configs``.
+"""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.config import dsl
+from paddle_tpu.core.argument import Argument
+from paddle_tpu.optim import Momentum
+from paddle_tpu.trainer import events as ev
+from paddle_tpu.trainer.trainer import SGD
+
+
+def _toy_batch(rng, n=16):
+    import jax.numpy as jnp
+    x = rng.rand(n, 8).astype(np.float32)
+    y = (x[:, 0] > 0.5).astype(np.int32)
+    return {"x": Argument(value=jnp.asarray(x)),
+            "label": Argument(value=jnp.asarray(y))}
+
+
+def _toy_reader(seed=0, batches=4):
+    rng = np.random.RandomState(seed)
+
+    def reader():
+        for _ in range(batches):
+            yield _toy_batch(rng)
+
+    return reader
+
+
+def test_dsl_evaluator_reaches_endpass():
+    dsl.reset()
+    x = dsl.data(name="x", size=8)
+    lbl = dsl.data(name="label", size=2)
+    out = dsl.fc(input=x, size=2, act="softmax", name="probs")
+    cost = dsl.classification_cost(input=out, label=lbl)
+    dsl.evaluator("auc", out, label=lbl, name="probs_auc")
+    dsl.evaluator("precision_recall", out, label=lbl, name="pr")
+
+    trainer = SGD(cost=cost, update_equation=Momentum(learning_rate=0.1,
+                                                      momentum=0.9))
+    got = {}
+
+    def handler(e):
+        if isinstance(e, ev.EndPass):
+            got.update(e.evaluator)
+
+    trainer.train(_toy_reader(), num_passes=1, event_handler=handler)
+    assert "probs_auc" in got and 0.0 <= got["probs_auc"] <= 1.0
+    assert "pr" in got
+
+
+def test_evaluator_branch_off_loss_path():
+    """An evaluator whose input (maxid decode) is NOT reachable from the
+    cost still gets computed — the network extends its outputs."""
+    dsl.reset()
+    x = dsl.data(name="x", size=8)
+    lbl = dsl.data(name="label", size=2)
+    out = dsl.fc(input=x, size=2, act="softmax", name="probs")
+    ids = dsl.maxid(input=out, name="decoded")
+    cost = dsl.classification_cost(input=out, label=lbl)
+    dsl.evaluator("sum", ids, name="decoded_sum")
+    trainer = SGD(cost=cost, update_equation=Momentum(learning_rate=0.1,
+                                                      momentum=0.9))
+    assert "decoded" in trainer.network.shape_infos
+    res = trainer.test(_toy_reader())
+    assert "decoded_sum" in res.evaluator
+
+
+def test_test_loop_reports_evaluators():
+    dsl.reset()
+    x = dsl.data(name="x", size=8)
+    lbl = dsl.data(name="label", size=2)
+    out = dsl.fc(input=x, size=2, act="softmax", name="probs")
+    cost = dsl.classification_cost(input=out, label=lbl)
+    dsl.evaluator("auc", out, label=lbl, name="auc")
+    trainer = SGD(cost=cost, update_equation=Momentum(learning_rate=0.1,
+                                                      momentum=0.9))
+    res = trainer.test(_toy_reader())
+    assert "auc" in res.evaluator
+
+
+V1_EVAL_CONFIG = """\
+from paddle.trainer_config_helpers import *
+
+define_py_data_sources2(
+    train_list='train.list', test_list=None,
+    module='eval_provider', obj='process')
+
+settings(batch_size=8, learning_rate=0.1,
+         learning_method=MomentumOptimizer(0.9))
+
+x = data_layer(name='x', size=8)
+lbl = data_layer(name='label', size=2)
+probs = fc_layer(input=x, size=2, act=SoftmaxActivation(), name='probs')
+inputs(x, lbl)
+outputs(classification_cost(input=probs, label=lbl))
+auc_evaluator(input=probs, label=lbl, name='train_auc')
+"""
+
+EVAL_PROVIDER = """\
+from paddle.trainer.PyDataProvider2 import *
+import random
+
+
+@provider(input_types={'x': dense_vector(8), 'label': integer_value(2)})
+def process(settings, filename):
+    rng = random.Random(7)
+    for _ in range(32):
+        v = [rng.random() for _ in range(8)]
+        yield v, int(v[0] > 0.5)
+"""
+
+
+def test_v1_config_evaluator_prints_during_training(tmp_path, capsys):
+    (tmp_path / "trainer_config.py").write_text(V1_EVAL_CONFIG)
+    (tmp_path / "eval_provider.py").write_text(EVAL_PROVIDER)
+    (tmp_path / "data.txt").write_text("synthetic\n")
+    (tmp_path / "train.list").write_text(str(tmp_path / "data.txt") + "\n")
+    from paddle_tpu.trainer import cli
+    rc = cli.main(["--config", str(tmp_path / "trainer_config.py"),
+                   "--job", "train", "--num_passes", "1"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "train_auc" in out  # EndPass line carries the evaluator
+
+
+def test_chunk_evaluator_f1_via_dsl():
+    """chunk evaluator (NER F1) fed from a sequence decode branch."""
+    import jax.numpy as jnp
+    dsl.reset()
+    x = dsl.data(name="tokens", size=6, is_sequence=True)
+    lbl = dsl.data(name="tags", size=3, is_sequence=True)
+    probs = dsl.fc(input=x, size=3, act="softmax", name="tag_probs")
+    ids = dsl.maxid(input=probs, name="decoded_tags")
+    cost = dsl.classification_cost(input=probs, label=lbl)
+    dsl.evaluator("chunk", ids, label=lbl, name="chunk_f1",
+                  chunk_scheme="IOB", num_chunk_types=1)
+    trainer = SGD(cost=cost, update_equation=Momentum(learning_rate=0.1,
+                                                      momentum=0.9))
+
+    rng = np.random.RandomState(0)
+
+    def reader():
+        for _ in range(2):
+            B, T = 4, 8
+            x = rng.rand(B, T, 6).astype(np.float32)
+            y = rng.randint(0, 3, size=(B, T)).astype(np.int32)
+            mask = np.ones((B, T), np.float32)
+            yield {"tokens": Argument(value=jnp.asarray(x),
+                                      mask=jnp.asarray(mask)),
+                   "tags": Argument(value=jnp.asarray(y),
+                                    mask=jnp.asarray(mask))}
+
+    res = trainer.test(reader)
+    assert "chunk_f1" in res.evaluator
+    assert 0.0 <= res.evaluator["chunk_f1"] <= 1.0

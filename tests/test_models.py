@@ -53,7 +53,7 @@ def test_resnet18_tiny_trains():
     assert np.isfinite(costs).all()
     assert costs[-1] < costs[0]
     # moving statistics actually moved (functional state updates applied)
-    assert not np.allclose(np.asarray(tr.params["_stem_bn.w1moving"]), 0.0)
+    assert not np.allclose(np.asarray(tr.params["_stem_bn.w1"]), 0.0)
 
 
 def test_resnet50_graph_shape():
