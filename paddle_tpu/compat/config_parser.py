@@ -101,6 +101,13 @@ def get_config_arg(name: str, type_: type = str, default: Any = None):
     return type_(value)
 
 
+def default_device(device_id=-1):
+    """Reference ``@config_func default_device``: per-layer GPU placement.
+    Device placement is meaningless under SPMD (the mesh owns placement),
+    so this records nothing — accepted so configs run unmodified."""
+    ctx().config_args.setdefault("_default_device", device_id)
+
+
 def inputs(*layers):
     """Declare data-provider stream order (``@config_func inputs``)."""
     names = [l.name if hasattr(l, "name") else str(l) for l in layers]
@@ -270,6 +277,7 @@ def _coerce(v: str):
 # re-exported names configs sometimes pull from paddle.trainer.config_parser
 __all__ = [
     "parse_config", "parse_config_and_serialize", "get_config_arg",
+    "default_device",
     "inputs", "outputs", "begin_parse", "ctx", "ConfigContext",
     "ParsedConfig", "DataSource",
 ]

@@ -136,7 +136,7 @@ _LAYER_SCALAR_FIELDS = {
     "blank": "blank",
     "seq_pool_stride": "seq_pool_stride",
     "axis": "axis",
-    "groups": "partial_sum",
+    "partial_sum": "partial_sum",
 }
 
 

@@ -12,7 +12,8 @@ explicitly.
 """
 
 from paddle_tpu.compat import config_parser as _config_parser
-from paddle_tpu.compat.config_parser import (get_config_arg, inputs,  # noqa: F401
+from paddle_tpu.compat.config_parser import (default_device,  # noqa: F401
+                                             get_config_arg, inputs,
                                              outputs, parse_config)
 from paddle_tpu.compat.trainer_config_helpers import (activations,  # noqa: F401
                                                       attrs, data_sources,
@@ -33,4 +34,4 @@ __all__ = (activations.__all__ + attrs.__all__ + data_sources.__all__
            + evaluators.__all__ + layers.__all__ + networks.__all__
            + optimizers.__all__ + poolings.__all__
            + ["get_config_arg", "inputs", "outputs", "parse_config",
-              "layer_math"])
+              "layer_math", "default_device"])

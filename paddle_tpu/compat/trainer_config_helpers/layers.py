@@ -279,25 +279,27 @@ def context_projection(input, context_len, context_start=None,
                       _pattr(padding_attr) if trainable else None)
 
 
-def _conv_proj_out_size(in_size, channels, filter_size, stride, padding,
+def _conv_proj_out_size(src, channels, filter_size, stride, padding,
                         num_filters, trans=False, filter_size_y=None,
                         stride_y=None, padding_y=None):
-    """Output size of a conv projection/operator over a square image whose
-    side is derived from the flat input size (the reference's
-    config_parser geometry inference; y params default to their x twins)."""
-    import math
-    c = channels or 1
-    side = math.isqrt(max(1, in_size // c))
+    """Output size of a conv projection/operator. Geometry comes from the
+    engine's single source of truth (layers/conv.py): channels default to
+    the producing layer's (the reference infers img.num_filters,
+    `trainer_config_helpers/layers.py:4201`), flat inputs derive a square
+    side. y params default to their x twins."""
+    from paddle_tpu.config.dsl import _shape_of
+    from paddle_tpu.layers.conv import _conv_geom, derive_geom
+    info = _shape_of(src.name)
+    c, in_h, in_w = derive_geom(info, channels)
     fsy = filter_size if filter_size_y is None else filter_size_y
     sty = stride if stride_y is None else stride_y
     pady = padding if padding_y is None else padding_y
 
     def _out(sz, f, s, p):
-        return (sz - 1) * s + f - 2 * p if trans \
-            else (sz + 2 * p - f) // s + 1
+        return (sz - 1) * s + f - 2 * p if trans else _conv_geom(sz, f, p, s)
 
-    return num_filters * _out(side, fsy, sty, pady) * _out(
-        side, filter_size, stride, padding)
+    return num_filters * _out(in_h, fsy, sty, pady) * _out(
+        in_w, filter_size, stride, padding)
 
 
 def conv_operator(img, filter, filter_size, num_filters, num_channels=None,
@@ -308,7 +310,7 @@ def conv_operator(img, filter, filter_size, num_filters, num_channels=None,
             "filter_size": filter_size, "num_filters": num_filters,
             "num_channels": num_channels, "stride": stride,
             "padding": padding}
-    size = _conv_proj_out_size(img.size, num_channels, filter_size, stride,
+    size = _conv_proj_out_size(img, num_channels, filter_size, stride,
                                padding, num_filters, trans,
                                filter_size_y, stride_y, padding_y)
     return Projection(img, spec, size, extra_inputs=[flt], is_operator=True)
@@ -322,7 +324,7 @@ def conv_projection(input, filter_size, num_filters, num_channels=None,
             "filter_size": filter_size, "num_filters": num_filters,
             "num_channels": num_channels, "stride": stride,
             "padding": padding, "groups": groups}
-    size = _conv_proj_out_size(src.size, num_channels, filter_size, stride,
+    size = _conv_proj_out_size(src, num_channels, filter_size, stride,
                                padding, num_filters, trans,
                                filter_size_y, stride_y, padding_y)
     return Projection(src, spec, size, _pattr(param_attr))

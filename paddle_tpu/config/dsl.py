@@ -514,7 +514,9 @@ def evaluator(type: str, input, *, label=None, weight=None, name: str = None,
     for extra in (label, weight):
         if extra is not None:
             names.append(extra.name)
-    cfg = {"type": type, "name": name or f"__{type}_evaluator__",
+    cfg = {"type": type,
+           "name": name or _auto_name(f"{type}_evaluator").replace(
+               "_layer_", "_"),
            "input_layers": names,
            "_roles": {"n_outputs": n_outputs,
                       "has_label": label is not None,
@@ -660,6 +662,15 @@ def _simple(type_name, input, name=None, *, attrs=None, size=None,
 
 def clip_layer(input, *, min: float, max: float, name=None):
     return _simple("clip", input, name, attrs={"min": min, "max": max})
+
+
+def scaling_layer(input, weight, *, name=None):
+    """Row-wise scale: out[i] = weight[i] * input[i] (weight is [B, 1] or
+    per-timestep [B, T, 1]); the attention-weighting primitive."""
+    ldef = LayerDef(name=name or _auto_name("scaling"), type="scaling",
+                    inputs=[Input(_in(weight)[0].name),
+                            Input(_in(input)[0].name)], bias=False)
+    return _add(ldef)
 
 
 def power_layer(input, weight, *, name=None):

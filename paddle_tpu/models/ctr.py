@@ -1,0 +1,31 @@
+"""Sparse-embedding text CTR model.
+
+Mirrors the reference's quick_start demo family
+(`v1_api_demo/quick_start/trainer_config.emb.py` /
+`trainer_config.lstm.py`): word-id sequence -> embedding -> sequence
+pooling -> fc -> binary classification. The embedding table is flagged
+``sparse_grad`` — the reference's sparse remote-update story
+(`SparseRowMatrix.h:204`, `RemoteParameterUpdater.h:265`) — which here
+selects the lazy touched-rows-only optimizer path and, under a mesh,
+automatic row-sharding over the model axis (parallel/mesh.effective_rules).
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.config import dsl
+from paddle_tpu.config.model_config import ParamAttr
+
+
+def ctr_model(*, vocab_size: int = 10000, embed_dim: int = 64,
+              hidden: int = 128, classes: int = 2):
+    """Returns (cost, softmax_output, data_names)."""
+    words = dsl.data(name="words", size=vocab_size, is_sequence=True)
+    label = dsl.data(name="label", size=classes)
+    emb = dsl.embedding(input=words, size=embed_dim, vocab_size=vocab_size,
+                        name="embed",
+                        param_attr=ParamAttr(sparse_grad=True))
+    pooled = dsl.pooling(input=emb, pooling_type="average", name="avg_pool")
+    h = dsl.fc(input=pooled, size=hidden, act="relu", name="hidden")
+    out = dsl.fc(input=h, size=classes, act="softmax", name="output")
+    cost = dsl.classification_cost(input=out, label=label, name="cost")
+    return cost, out, ["words", "label"]

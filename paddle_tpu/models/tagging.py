@@ -1,0 +1,42 @@
+"""BiLSTM-CRF sequence tagger.
+
+Mirrors the reference's sequence-tagging demo
+(`v1_api_demo/sequence_tagging/rnn_crf.py`): embeddings -> forward +
+backward recurrence -> linear CRF emission scores -> linear-chain CRF cost
+(`paddle/gserver/layers/LinearChainCRF.cpp`) with a Viterbi decode branch
+sharing the transition matrix. The recurrences run as ``lax.scan`` groups
+(fused LSTM steps); CRF forward-backward is the chain kernel
+(paddle_tpu/layers/chain.py).
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.config import dsl
+from paddle_tpu.config.model_config import ParamAttr
+
+
+def bilstm_crf_tagger(*, vocab_size: int = 5000, embed_dim: int = 64,
+                      hidden: int = 64, num_labels: int = 9):
+    """Returns (cost, decoded, data_names). ``decoded`` is the Viterbi
+    path; the CRF transition matrix is shared between cost and decode by
+    parameter name, as the reference shares it between ``crf_layer`` and
+    ``crf_decoding_layer``."""
+    word = dsl.data(name="word", size=vocab_size, is_sequence=True)
+    label = dsl.data(name="label", size=num_labels, is_sequence=True)
+    emb = dsl.embedding(input=word, size=embed_dim, name="word_emb")
+
+    f_in = dsl.fc(input=emb, size=hidden * 4, act="linear", name="fwd_in")
+    fwd = dsl.lstmemory(input=f_in, name="lstm_fwd")
+    b_in = dsl.fc(input=emb, size=hidden * 4, act="linear", name="bwd_in")
+    bwd = dsl.lstmemory(input=b_in, reverse=True, name="lstm_bwd")
+    feat = dsl.concat([fwd, bwd], name="bilstm")
+
+    emission = dsl.fc(input=feat, size=num_labels, act="linear",
+                      name="emission", bias_attr=False)
+    transitions = ParamAttr(name="crf_transitions")
+    cost = dsl.crf_layer(input=emission, label=label, size=num_labels,
+                         param_attr=transitions, name="crf_cost")
+    decoded = dsl.crf_decoding_layer(input=emission, size=num_labels,
+                                     param_attr=transitions,
+                                     name="crf_decode")
+    return cost, decoded, ["word", "label"]

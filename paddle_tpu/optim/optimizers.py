@@ -48,13 +48,24 @@ class Optimizer:
         raise NotImplementedError
 
     # -- public ---------------------------------------------------------
+    def _is_sparse(self, spec) -> bool:
+        return (spec is not None and getattr(spec, "sparse_grad", False)
+                and hasattr(self, "_apply_sparse"))
+
     def init(self, params: Dict[str, jnp.ndarray],
              meta: Optional[Dict[str, ParamSpec]] = None) -> Dict[str, Any]:
-        slots = {
-            name: {s: jnp.zeros_like(p) for s in self.slot_names()}
-            for name, p in params.items()
-            if not (meta and meta.get(name) and meta[name].is_static)
-        }
+        slots = {}
+        for name, p in params.items():
+            spec = meta.get(name) if meta else None
+            if spec is not None and spec.is_static:
+                continue
+            d = {s: jnp.zeros_like(p) for s in self.slot_names()}
+            if self._is_sparse(spec):
+                # per-row last-processed step for lazy (touched-rows-only)
+                # updates — the SparseRowMatrix/catchUpWith bookkeeping
+                # (SparseRowMatrix.h:204, OptimizerWithRegularizer.h)
+                d["t_rows"] = jnp.zeros((p.shape[0],), jnp.int32)
+            slots[name] = d
         state = {"slots": slots, "t": jnp.zeros((), jnp.int32),
                  "num_samples": jnp.zeros((), jnp.float32)}
         if self.average_window > 0:
@@ -94,6 +105,14 @@ class Optimizer:
                 # (FirstOrderOptimizer.h, clipping in SgdOptimizer variants)
                 th = self.gradient_clipping_threshold
                 g = jnp.clip(g, -th, th)
+            if self._is_sparse(spec):
+                # touched-rows-only update with momentum/decay catch-up;
+                # l1/l2 handled inside (deferred per-row)
+                p_new, slots_new = self._apply_sparse(
+                    p, g, state["slots"][name], lr_t * lr_mult, l1, l2, t)
+                new_params[name] = p_new
+                new_slots[name] = slots_new
+                continue
             p_new, slots_new = self._apply_one(
                 p, g, state["slots"][name], lr_t * lr_mult, l2, t)
             if l1 > 0:
@@ -112,6 +131,39 @@ class Optimizer:
                 n: state["avg"][n] + (new_params[n] - state["avg"][n]) / w
                 for n in new_slots}
         return new_params, new_state
+
+    def catch_up(self, params, state,
+                 meta: Optional[Dict[str, ParamSpec]] = None,
+                 num_passes: int = 0):
+        """Apply deferred sparse-row updates to ALL rows (the reference's
+        ``catchUpWith``, ``OptimizerWithRegularizer.h``): run at pass end
+        and before checkpoints so lazily-updated tables are current. Uses
+        the current learning rate for the missed steps, as the reference
+        does; ``num_passes`` keeps pass-based schedules on the right rate."""
+        if not any("t_rows" in s for s in state["slots"].values()):
+            return params, state
+        from paddle_tpu.optim.schedules import learning_rate_at
+        lr_t = learning_rate_at(
+            self.learning_rate_schedule, self.learning_rate,
+            self.learning_rate_decay_a, self.learning_rate_decay_b,
+            state["num_samples"], args=self.learning_rate_args,
+            num_passes=num_passes)
+        new_params = dict(params)
+        new_slots = dict(state["slots"])
+        for name, slots in state["slots"].items():
+            if "t_rows" not in slots:
+                continue
+            spec = meta.get(name) if meta else None
+            lr_mult = spec.learning_rate if spec else 1.0
+            l2 = (spec.l2_rate if spec and spec.l2_rate is not None
+                  else self.l2_rate)
+            l1 = (spec.l1_rate if spec and spec.l1_rate is not None
+                  else self.l1_rate)
+            p2, s2 = self._sparse_catch_up_one(
+                params[name], slots, lr_t * lr_mult, l1, l2, state["t"])
+            new_params[name] = p2
+            new_slots[name] = s2
+        return new_params, {**state, "slots": new_slots}
 
     def averaged_params(self, state, params):
         """``AverageOptimizer::apply`` (AverageOptimizer.h:23): swap in the
@@ -144,6 +196,57 @@ class Momentum(Optimizer):
             return p + self.momentum * mom - lr * (g + decay * p), \
                 {"mom": mom}
         return p + mom, {"mom": mom}
+
+    # ---------------------------------------------------- sparse (lazy) path
+    # Touched-rows-only updates for sparse_grad tables, with closed-form
+    # catch-up. For a row with zero grad the dense recurrence is
+    # mom *= mu; p += mom — over k missed steps p += mom*(mu+...+mu^k) and
+    # mom *= mu^k, applied lazily when the row is next touched (or at
+    # catch_up). Exactly equal to the dense updater when l1=l2=0 (the
+    # test_CompareSparse property); with regularization the decay is
+    # deferred per-row as (1-lr*l2)^k / k-scaled l1 shrink, the reference's
+    # OptimizerWithRegularizerSparse approximation.
+
+    def _geo_sum(self, k):
+        """mu + mu^2 + ... + mu^k, elementwise over int k."""
+        mu = self.momentum
+        kf = k.astype(jnp.float32)
+        if mu == 1.0:
+            return kf
+        if mu == 0.0:
+            return jnp.zeros_like(kf)
+        return mu * (1.0 - jnp.power(mu, kf)) / (1.0 - mu)
+
+    def _catch_up_rows(self, p, mom, lr, l1, l2, k):
+        kf = k.astype(p.dtype).reshape(k.shape + (1,) * (p.ndim - 1))
+        if l2 > 0:
+            p = p * jnp.power(1.0 - lr * l2, kf)
+        if l1 > 0:
+            shrink = lr * l1 * kf
+            p = jnp.sign(p) * jnp.maximum(jnp.abs(p) - shrink, 0.0)
+        geo = self._geo_sum(k).reshape(kf.shape)
+        p = p + mom * geo
+        mom = mom * jnp.power(self.momentum, kf) if self.momentum > 0 \
+            else jnp.where(kf > 0, 0.0, mom)
+        return p, mom
+
+    def _apply_sparse(self, p, g, slots, lr, l1, l2, t):
+        t_rows = slots["t_rows"]
+        touched = jnp.any(g != 0, axis=tuple(range(1, g.ndim)))
+        k = (t - 1) - t_rows  # steps missed before this one
+        cp, cmom = self._catch_up_rows(p, slots["mom"], lr, l1, l2, k)
+        mom_new = self.momentum * cmom - lr * (g + l2 * cp)
+        p_new = cp + mom_new
+        tb = touched.reshape(touched.shape + (1,) * (p.ndim - 1))
+        return (jnp.where(tb, p_new, p),
+                {"mom": jnp.where(tb, mom_new, slots["mom"]),
+                 "t_rows": jnp.where(touched, t, t_rows)})
+
+    def _sparse_catch_up_one(self, p, slots, lr, l1, l2, t):
+        k = t - slots["t_rows"]
+        p2, mom2 = self._catch_up_rows(p, slots["mom"], lr, l1, l2, k)
+        return p2, {"mom": mom2,
+                    "t_rows": jnp.full_like(slots["t_rows"], t)}
 
 
 @dataclasses.dataclass

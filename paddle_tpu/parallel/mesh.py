@@ -91,9 +91,31 @@ def shard_params(params: Dict[str, jax.Array], mesh: Mesh,
 def param_shardings(param_names, mesh: Mesh,
                     rules: Optional[Dict[str, P]] = None):
     """NamedSharding per parameter name (for jit out_shardings so big
-    sharded tables are *created* in place, never materialized whole)."""
+    sharded tables are *created* in place, never materialized whole).
+
+    ``param_names`` may be a {name: ParamSpec} dict: parameters flagged
+    ``sparse_grad`` (embedding tables) default to row-sharding over the
+    model axis when no explicit rule names them — the ``SparseRowMatrix``
+    row-slice placement, without configs having to spell it out."""
+    rules = effective_rules(param_names, mesh, rules)
     return {name: NamedSharding(mesh, rule_for(name, rules))
             for name in param_names}
+
+
+def effective_rules(param_specs, mesh: Mesh,
+                    rules: Optional[Dict[str, P]] = None) -> Dict[str, P]:
+    """User rules + the sparse default: tables flagged ``sparse_grad`` with
+    no explicit rule row-shard over the model axis. Use the result for both
+    param placement and shard_opt_state so slots follow their table."""
+    out = dict(rules or {})
+    if not isinstance(param_specs, dict):
+        return out
+    if mesh.shape.get(MODEL_AXIS, 1) <= 1:
+        return out
+    for name, spec in param_specs.items():
+        if getattr(spec, "sparse_grad", False) and rule_for(name, out) == P():
+            out[name] = P(MODEL_AXIS)
+    return out
 
 
 def shard_opt_state(opt_state, mesh: Mesh,
@@ -101,13 +123,18 @@ def shard_opt_state(opt_state, mesh: Mesh,
     """Shard any optimizer-state pytree: entries of per-parameter dicts
     ("slots", "avg", or any future key whose value is {param_name: ...})
     follow their owning parameter's rule; everything else replicates."""
+    def leaf_sharding(x, rule):
+        # slots may have fewer dims than their parameter (e.g. the sparse
+        # path's per-row timestamps [V] vs the table [V, D]): trim the spec
+        return NamedSharding(mesh, P(*rule[:x.ndim]))
+
     out = {}
     for key, val in opt_state.items():
         if isinstance(val, dict):
             out[key] = {
                 name: jax.tree_util.tree_map(
                     lambda x, n=name: jax.device_put(
-                        x, NamedSharding(mesh, rule_for(n, rules))), sub)
+                        x, leaf_sharding(x, rule_for(n, rules))), sub)
                 for name, sub in val.items()}
         else:
             out[key] = jax.device_put(val, NamedSharding(mesh, P()))

@@ -72,9 +72,11 @@ def load_config(path: str, config_args: str = ""):
     ``parse_config`` contract, ``TrainerConfigHelper.cpp:33-57``) so
     reference configs run unmodified; native configs are executed directly
     and must define ``cost``."""
+    import re
     with open(path) as f:
         src = f.read()
-    if "trainer_config_helpers" in src or "paddle.trainer." in src:
+    # route on actual import statements, not mere mentions in comments
+    if re.search(r"^\s*(from|import)\s+paddle\.trainer", src, re.M):
         return _load_v1_config(path, config_args)
     from paddle_tpu.config import dsl
     dsl.reset()

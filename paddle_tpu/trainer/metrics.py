@@ -334,6 +334,10 @@ class ChunkEvaluator(EvaluatorBase):
         """output: predicted tag ids [B, T] (or list of lists); label same."""
         pred = np.asarray(output)
         lab = np.asarray(label)
+        if pred.ndim == 3 and pred.shape[-1] == 1:  # [B, T, 1] decode output
+            pred = pred[..., 0]
+        if lab.ndim == 3 and lab.shape[-1] == 1:
+            lab = lab[..., 0]
         if pred.ndim == 1:
             pred, lab = pred[None], lab[None]
             mask = None if mask is None else np.asarray(mask)[None]

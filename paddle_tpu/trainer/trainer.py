@@ -106,6 +106,10 @@ class SGD:
         self.mesh = mesh
         key = jax.random.PRNGKey(seed)
         self.meta = self.network.param_meta()
+        if mesh is not None:
+            # user rules + the sparse-table row-sharding default
+            shard_rules = mesh_lib.effective_rules(
+                self.network.param_specs, mesh, shard_rules)
         if parameters is not None:
             self.params = (mesh_lib.shard_params(parameters, mesh, shard_rules)
                            if mesh is not None else parameters)
@@ -254,6 +258,11 @@ class SGD:
                     checkpointer.maybe_save(self.params, self.opt_state,
                                             pass_id=pass_id,
                                             batch_id=batch_id + 1)
+            # apply deferred sparse-row updates so the pass ends with
+            # current tables (reference catchUpWith before eval/save)
+            self.params, self.opt_state = self.optimizer.catch_up(
+                self.params, self.opt_state, self.meta,
+                num_passes=pass_id)
             event_handler(ev.EndPass(
                 pass_id, {**acc.result(), **self.host_eval_values()}))
             if checkpointer is not None:

@@ -151,6 +151,17 @@ def test_vgg16_mnist_reference_config():
 
 
 @needs_ref
+def test_rnn_crf_reference_config_parses():
+    """The sequence-tagging north-star config
+    (`v1_api_demo/sequence_tagging/rnn_crf.py`) parses unmodified."""
+    parsed = parse_config(str(REF / "v1_api_demo/sequence_tagging/rnn_crf.py"))
+    assert parsed.cost_layers() == ["__crf_layer_0__"]
+    mp = parsed.model_proto()
+    types = {l.type for l in mp.layers}
+    assert {"crf", "recurrent", "mixed", "embedding"} <= types
+
+
+@needs_ref
 def test_parse_config_and_serialize_reference_schema_roundtrip(tmp_path):
     """Serialized TrainerConfig bytes parse under the *reference's* compiled
     schema — the C++ consumer contract."""
