@@ -375,6 +375,18 @@ class StaticInput:
 
 
 @dataclasses.dataclass
+class SubsequenceInput:
+    """Two-level (nested) sequence input to a recurrent_group: the outer
+    group steps over SUB-sequences; each step sees one whole sub-sequence
+    as a sequence Argument (the reference's SubsequenceInput +
+    ``RecurrentGradientMachine`` nested frames, ``:294-346``). Nested
+    batches flow as [B, S, T_sub, D] with mask [B, S, T_sub] — the padded
+    static-shape spelling of ``subSequenceStartPositions``."""
+
+    input: LayerOutput
+
+
+@dataclasses.dataclass
 class GeneratedInput:
     """Generation-mode input: at each step the previous step's generated
     word id is embedded and fed (reference GeneratedInput in
@@ -438,8 +450,8 @@ def recurrent_group(step, input, *, reverse: bool = False,
     tuple (first = main out_link)."""
     global _GRAPH, _GROUP_CTX
     from paddle_tpu.config.model_config import ModelDef as _ModelDef
-    inputs = [input] if isinstance(input, (LayerOutput, StaticInput)) \
-        else list(input)
+    inputs = [input] if isinstance(
+        input, (LayerOutput, StaticInput, SubsequenceInput)) else list(input)
     gname = name or _auto_name("recurrent_group")
     outer = _GRAPH
     sub = _ModelDef()
@@ -454,17 +466,26 @@ def recurrent_group(step, input, *, reverse: bool = False,
             if isinstance(x, StaticInput):
                 src = x.input
                 bname = f"{gname}@static{i}"
+                kind = "static"
                 ldef = LayerDef(name=bname, type="data", size=src.size,
                                 bias=False)
+            elif isinstance(x, SubsequenceInput):
+                # outer step sees one whole sub-sequence: the boundary
+                # data layer is itself a sequence inside the step net
+                src = x.input
+                bname = f"{gname}@subseq{i}"
+                kind = "subseq"
+                ldef = LayerDef(name=bname, type="data", size=src.size,
+                                bias=False,
+                                attrs={"is_sequence": True})
             else:
                 src = x
                 bname = f"{gname}@seq{i}"
+                kind = "seq"
                 ldef = LayerDef(name=bname, type="data", size=src.size,
                                 bias=False)
             proxies.append(_add(ldef))
-            ins_meta.append({"boundary": bname,
-                             "kind": "static" if isinstance(x, StaticInput)
-                             else "seq"})
+            ins_meta.append({"boundary": bname, "kind": kind})
             outer_in_names.append(src.name)
         traced = step(*proxies)
         memories = _GROUP_CTX["memories"]

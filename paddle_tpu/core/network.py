@@ -36,6 +36,9 @@ class Context:
     # functional side-channel for moving statistics (batch_norm): param name
     # -> new value; applied by the train step after the gradient update.
     state_updates: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # cross-batch recurrent state (--prev_batch_state truncated BPTT,
+    # Trainer.cpp:396-418): layer name -> initial state for this batch
+    carried: Dict[str, Any] = dataclasses.field(default_factory=dict)
 
     def layer_rng(self, layer_name: str) -> jax.Array:
         if self.rng is None:
@@ -136,19 +139,24 @@ class Network:
     # ----------------------------------------------------------------- apply
     def apply(self, params: Dict[str, jnp.ndarray],
               feed: Dict[str, Argument], *, train: bool = False,
-              rng: Optional[jax.Array] = None) -> Dict[str, Argument]:
-        outs, _ = self.apply_with_state(params, feed, train=train, rng=rng)
+              rng: Optional[jax.Array] = None,
+              carried: Optional[Dict[str, Any]] = None
+              ) -> Dict[str, Argument]:
+        outs, _ = self.apply_with_state(params, feed, train=train, rng=rng,
+                                        carried=carried)
         return outs
 
     def apply_with_state(
             self, params: Dict[str, jnp.ndarray],
             feed: Dict[str, Argument], *, train: bool = False,
             rng: Optional[jax.Array] = None,
+            carried: Optional[Dict[str, Any]] = None,
     ) -> Tuple[Dict[str, Argument], Dict[str, jnp.ndarray]]:
         """Pure forward over the whole graph. ``feed`` maps data-layer names
         to Arguments. Returns (every layer's output keyed by layer name,
-        state updates for moving statistics)."""
-        ctx = Context(train=train, rng=rng)
+        state updates for moving statistics). ``carried`` maps recurrent
+        layer names to cross-batch initial state (--prev_batch_state)."""
+        ctx = Context(train=train, rng=rng, carried=carried or {})
         from paddle_tpu.layers.activations import apply_activation  # cycle-free
         from paddle_tpu.utils.error_context import layer_scope
 

@@ -146,12 +146,17 @@ class ConvTransLayer(LayerImpl):
             info = ctx.in_infos[i]
             fs, fsy, st, sty, pad, pady, groups, c = _conv_spec(
                 cfg.inputs[i].extra, info)
-            x = to_nhwc(a.value, c, info.height, info.width)
+            c, in_h, in_w = derive_geom(info, c)
+            x = to_nhwc(a.value, c, in_h, in_w)
+            # kernel is stored gradient-of-conv style (nf -> c);
+            # transpose_kernel flips spatial dims and swaps I/O so the
+            # transposed conv is exactly the forward conv's gradient
             y = lax.conv_transpose(
                 x, params[f"w{i}"],
                 strides=(sty, st),
                 padding=((pady, pady), (pad, pad)),
                 dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                transpose_kernel=True,
             )
             out = y if out is None else out + y
         if "wbias" in params:

@@ -65,6 +65,8 @@ class RecurrentLayerGroup(LayerImpl):
         reverse = bool(cfg.attrs.get("reverse", False))
 
         xs: Dict[str, jnp.ndarray] = {}
+        sub_xs: Dict[str, jnp.ndarray] = {}   # nested: [S, B, T_sub, D]
+        sub_masks: Dict[str, jnp.ndarray] = {}  # [S, B, T_sub]
         static_feed: Dict[str, Argument] = {}
         boot: Dict[str, jnp.ndarray] = {}
         mask = None
@@ -73,16 +75,31 @@ class RecurrentLayerGroup(LayerImpl):
                 xs[m["boundary"]] = jnp.swapaxes(a.value, 0, 1)
                 if mask is None and a.mask is not None:
                     mask = a.mask
+            elif m["kind"] == "subseq":
+                # nested input [B, S, T_sub, D] with mask [B, S, T_sub]:
+                # the outer scan walks S; each step feeds one sub-sequence
+                if a.value.ndim < 3 or a.mask is None or a.mask.ndim != 3:
+                    raise ValueError(
+                        f"nested group {cfg.name!r} needs a [B, S, T, D] "
+                        "value with a [B, S, T] mask (2-level padded "
+                        "layout)")
+                sub_xs[m["boundary"]] = jnp.swapaxes(a.value, 0, 1)
+                sub_masks[m["boundary"]] = jnp.swapaxes(a.mask, 0, 1)
+                if mask is None:
+                    # an outer step is live if its sub-sequence has tokens
+                    mask = (jnp.sum(a.mask, axis=-1) > 0).astype(
+                        jnp.float32)
             elif m["kind"] == "static":
                 static_feed[m["boundary"]] = a
             elif m["kind"] == "boot":
                 boot[m["boundary"]] = a.value
-        if not xs:
+        if not xs and not sub_xs:
             raise ValueError(
                 f"recurrent group {cfg.name!r} has no sequence input; "
                 "use beam_search/generation for input-free unrolling")
-        T = next(iter(xs.values())).shape[0]
-        B = next(iter(xs.values())).shape[1]
+        lead = next(iter(xs.values())) if xs else next(iter(sub_xs.values()))
+        T = lead.shape[0]
+        B = lead.shape[1]
         if mask is None:
             mask = jnp.ones((B, T), jnp.float32)
         mask_tb = jnp.swapaxes(mask, 0, 1)
@@ -98,7 +115,8 @@ class RecurrentLayerGroup(LayerImpl):
                                          jnp.float32)
 
         out_names = cfg.attrs["outputs"]
-        scan_in: Dict[str, Any] = {"x": xs, "m": mask_tb}
+        scan_in: Dict[str, Any] = {"x": xs, "m": mask_tb,
+                                   "xsub": sub_xs, "msub": sub_masks}
         if ctx.rng is not None:
             scan_in["rng"] = jax.random.split(
                 ctx.layer_rng(cfg.name + "/group"), T)
@@ -108,6 +126,8 @@ class RecurrentLayerGroup(LayerImpl):
             feed = dict(static_feed)
             for k, v in inp["x"].items():
                 feed[k] = Argument(value=v)
+            for k, v in inp["xsub"].items():
+                feed[k] = Argument(value=v, mask=inp["msub"][k])
             for mem in memories:
                 feed[mem["boundary"]] = Argument(value=carry[mem["boundary"]])
             outs = net.apply(sub_params, feed, train=train,

@@ -256,13 +256,20 @@ class CropLayer(LayerImpl):
     (0=batch 1=C 2=H 3=W)."""
 
     def infer(self, cfg, in_infos):
+        info = in_infos[0]
+        axis = cfg.attrs.get("axis", 2)
         if len(in_infos) > 1:
             ref = in_infos[1]
             c, h, w = ref.channels, ref.height, ref.width
         else:
-            c, h, w = cfg.attrs["shape"]
-        info = in_infos[0]
-        axis = cfg.attrs.get("axis", 2)
+            # shape is the full (c, h, w) target, or the extents for NCHW
+            # axes [axis..3] only (both spellings appear in configs)
+            shape = list(cfg.attrs["shape"])
+            dims = [info.channels, info.height, info.width]
+            start = 1 if len(shape) == 3 else max(axis, 1)
+            for ax, s in zip(range(start, 4), shape):
+                dims[ax - 1] = s
+            c, h, w = dims
         c = c if axis <= 1 else info.channels
         h = h if axis <= 2 else info.height
         w = w if axis <= 3 else info.width

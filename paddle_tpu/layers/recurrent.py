@@ -99,12 +99,14 @@ class LstmLayer(LayerImpl):
         default_acts = (act_in_name in ("tanh", "")
                         and act_gate_name == "sigmoid"
                         and act_state_name in ("tanh", ""))
+        carried = None if reverse else ctx.carried.get(cfg.name)
         if default_acts:
             # Fused path (ops/lstm.py): Pallas kernel on TPU, scan elsewhere.
             from paddle_tpu.ops import lstm_sequence
-            h0 = jnp.zeros((B, size), a.value.dtype)
+            z = jnp.zeros((B, size), a.value.dtype)
+            h0, c0 = carried if carried is not None else (z, z)
             ys, hT, cT = lstm_sequence(xs, mask, w, gate_bias, check_i,
-                                       check_f, check_o, h0, h0,
+                                       check_f, check_o, h0, c0,
                                        reverse=reverse)
             return Argument(value=jnp.swapaxes(ys, 0, 1), mask=a.mask,
                             state=(hT, cT))
@@ -125,8 +127,9 @@ class LstmLayer(LayerImpl):
             out = g_og * act_state(state)
             return (out, state), out
 
-        h0 = jnp.zeros((B, size), a.value.dtype)
-        (hT, cT), ys = _scan_time(step, (h0, h0), xs, mask, reverse)
+        z = jnp.zeros((B, size), a.value.dtype)
+        h0, c0 = carried if carried is not None else (z, z)
+        (hT, cT), ys = _scan_time(step, (h0, c0), xs, mask, reverse)
         return Argument(value=jnp.swapaxes(ys, 0, 1), mask=a.mask,
                         state=(hT, cT))
 
@@ -162,9 +165,11 @@ class GruLayer(LayerImpl):
 
         default_acts = (act_in_name in ("tanh", "")
                         and act_gate_name == "sigmoid")
+        carried = None if reverse else ctx.carried.get(cfg.name)
         if default_acts:
             from paddle_tpu.ops import gru_sequence
-            h0 = jnp.zeros((B, size), a.value.dtype)
+            h0 = carried if carried is not None \
+                else jnp.zeros((B, size), a.value.dtype)
             ys, hT = gru_sequence(xs, mask, w_gate, w_state, bias, h0,
                                   reverse=reverse)
             return Argument(value=jnp.swapaxes(ys, 0, 1), mask=a.mask,
@@ -183,7 +188,8 @@ class GruLayer(LayerImpl):
             out = h - z * h + z * c
             return (out,), out
 
-        h0 = jnp.zeros((B, size), a.value.dtype)
+        h0 = carried if carried is not None \
+            else jnp.zeros((B, size), a.value.dtype)
         (hT,), ys = _scan_time(step, (h0,), xs, mask, reverse)
         return Argument(value=jnp.swapaxes(ys, 0, 1), mask=a.mask, state=hT)
 
@@ -221,7 +227,9 @@ class SimpleRecurrentLayer(LayerImpl):
             out = act(x_t + h @ w + b)
             return (out,), out
 
-        h0 = jnp.zeros((B, D), a.value.dtype)
+        carried = None if reverse else ctx.carried.get(cfg.name)
+        h0 = carried if carried is not None \
+            else jnp.zeros((B, D), a.value.dtype)
         (hT,), ys = _scan_time(step, (h0,), xs, mask, reverse)
         return Argument(value=jnp.swapaxes(ys, 0, 1), mask=a.mask, state=hT)
 

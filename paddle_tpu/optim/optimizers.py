@@ -237,6 +237,10 @@ class Momentum(Optimizer):
         cp, cmom = self._catch_up_rows(p, slots["mom"], lr, l1, l2, k)
         mom_new = self.momentum * cmom - lr * (g + l2 * cp)
         p_new = cp + mom_new
+        if l1 > 0:
+            # the live step's shrink (catch-up covered only missed steps)
+            p_new = jnp.sign(p_new) * jnp.maximum(
+                jnp.abs(p_new) - lr * l1, 0.0)
         tb = touched.reshape(touched.shape + (1,) * (p.ndim - 1))
         return (jnp.where(tb, p_new, p),
                 {"mom": jnp.where(tb, mom_new, slots["mom"]),

@@ -59,6 +59,9 @@ def parse_args(argv=None):
                    help="accepted for compatibility; device choice is "
                         "JAX's")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--prev_batch_state", action="store_true",
+                   help="carry RNN state across batches (truncated BPTT, "
+                        "the reference's --prev_batch_state)")
     p.add_argument("--time_batches", type=int, default=20,
                    help="--job=time: timed batches after warmup")
     p.add_argument("--time_warmup", type=int, default=3)
@@ -146,7 +149,8 @@ def _build_trainer(ns, args):
     optimizer = ns.get("optimizer") or Momentum(learning_rate=0.01,
                                                 momentum=0.9)
     trainer = SGD(cost=ns["cost"], update_equation=optimizer, mesh=mesh,
-                  seed=args.seed, evaluators=ns.get("evaluators"))
+                  seed=args.seed, evaluators=ns.get("evaluators"),
+                  prev_batch_state=getattr(args, "prev_batch_state", False))
     if args.init_model_path:
         _init_params(trainer, args.init_model_path)
     return trainer

@@ -77,7 +77,8 @@ class SGD:
                  extra_layers: Optional[List] = None,
                  mesh=None, shard_rules: Optional[Dict[str, Any]] = None,
                  seed: int = 0, is_local: bool = True,
-                 evaluators: Optional[List[dict]] = None):
+                 evaluators: Optional[List[dict]] = None,
+                 prev_batch_state: bool = False):
         if update_equation is None:
             raise ValueError("update_equation (an Optimizer) is required")
         self.topology = (cost if isinstance(cost, Topology)
@@ -125,6 +126,18 @@ class SGD:
             # slots/avg follow their owning parameter; scalars replicate
             self.opt_state = mesh_lib.shard_opt_state(
                 self.opt_state, mesh, shard_rules)
+        # --prev_batch_state truncated BPTT (Trainer.cpp:396-418,
+        # Flags.cpp:73): forward recurrent layers start each batch from the
+        # previous batch's final state instead of zeros. Gradients are cut
+        # at the batch boundary (stop_gradient), the reference's truncated
+        # semantics. Reversed layers can't carry (they'd need the future).
+        self.prev_batch_state = prev_batch_state
+        self._carry_layers = [
+            name for name, ld in self.topology.graph.layers.items()
+            if ld.type in ("lstmemory", "gated_recurrent", "recurrent")
+            and not ld.attrs.get("reversed")
+            and name in self.network.order] if prev_batch_state else []
+        self._carried = None  # {layer: state}, threaded across batches
         self._rng = jax.random.PRNGKey(seed + 1)
         self._train_step = self._build_train_step()
         self._eval_step = self._build_eval_step()
@@ -160,21 +173,29 @@ class SGD:
     def _build_train_step(self):
         network, optimizer, meta = self.network, self.optimizer, self.meta
         cost_name = self.topology.cost_name
+        carry_layers = self._carry_layers
 
-        def loss_fn(params, feed, rng):
+        def loss_fn(params, feed, rng, carried):
             outputs, updates = network.apply_with_state(
-                params, feed, train=True, rng=rng)
+                params, feed, train=True, rng=rng, carried=carried)
             return self._total_cost(outputs), (outputs, updates)
 
-        def step(params, opt_state, feed, rng, num_passes):
+        def step(params, opt_state, feed, rng, num_passes, carried=None):
+            if carried is not None:
+                # truncated BPTT: no gradient across the batch boundary
+                carried = jax.lax.stop_gradient(carried)
             (_, (outputs, updates)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params, feed, rng)
+                loss_fn, has_aux=True)(params, feed, rng, carried)
             bsz = outputs[cost_name].value.shape[0]
             new_params, new_opt = optimizer.update(
                 grads, opt_state, params, meta, batch_size=bsz,
                 num_passes=num_passes)
             new_params.update(updates)  # moving statistics (batch_norm)
-            return new_params, new_opt, self._metrics(outputs, feed)
+            metrics = self._metrics(outputs, feed)
+            if carry_layers:
+                metrics["carried"] = jax.lax.stop_gradient(
+                    {n: outputs[n].state for n in carry_layers})
+            return new_params, new_opt, metrics
 
         return jax.jit(step, donate_argnums=(0, 1))
 
@@ -224,6 +245,7 @@ class SGD:
             event_handler(ev.BeginPass(pass_id))
             acc.reset()
             self._start_host_evaluators()
+            self._carried = None  # reference resets RNN state per pass
             window_cost, window_n = 0.0, 0
             for batch_id, data in enumerate(_call_reader(reader, pass_id)):
                 event_handler(ev.BeginIteration(pass_id, batch_id))
@@ -232,11 +254,22 @@ class SGD:
                     if self.mesh is not None:
                         feed = mesh_lib.shard_batch(feed, self.mesh)
                 self._rng, step_rng = jax.random.split(self._rng)
+                if self._carried is not None:
+                    # a batch-size change (e.g. smaller final batch) makes
+                    # the carried state unusable: reset, like the
+                    # reference's resetState on shape change
+                    b_feed = next(iter(feed.values())).value.shape[0]
+                    b_carry = jax.tree_util.tree_leaves(
+                        self._carried)[0].shape[0]
+                    if b_carry != b_feed:
+                        self._carried = None
                 with timer("trainBatch"):
                     self.params, self.opt_state, metrics = self._train_step(
                         self.params, self.opt_state, feed, step_rng,
-                        jnp.int32(pass_id))
+                        jnp.int32(pass_id), self._carried)
                     cost = float(metrics["cost"])
+                if self._carry_layers:
+                    self._carried = metrics.pop("carried")
                 evals = self._accumulate(acc, metrics)
                 self._feed_host_evaluators(metrics)
                 window_cost += cost

@@ -1,0 +1,134 @@
+"""Nested (2-level) recurrent groups.
+
+The reference's ``RecurrentGradientMachine`` runs recurrent groups over
+nested sequences and asserts nested == flat on equivalent configs
+(``paddle/trainer/tests/test_RecurrentGradientMachine.cpp``,
+``sequence_nest_rnn.conf`` vs ``sequence_rnn.conf``). Same property here:
+an outer group stepping over sub-sequences, whose inner group boots from
+the carried outer memory, must equal one flat scan over the concatenated
+sequence.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.config import dsl
+from paddle_tpu.core.argument import Argument
+from paddle_tpu.core.network import Network
+
+B, S, TS, D = 2, 3, 4, 5
+
+
+def _inner_step_factory():
+    def inner_step(x):
+        m = dsl.memory(name="h", size=D)
+        return dsl.fc(input=[x, m], size=D, act="tanh", name="h",
+                      bias_attr=False)
+
+    return inner_step
+
+
+def _build_flat():
+    dsl.reset()
+    x = dsl.data(name="x", size=D, is_sequence=True)
+    out = dsl.recurrent_group(_inner_step_factory(), x, name="flat_rnn")
+    return dsl.current_graph(), out
+
+
+def _build_nested():
+    dsl.reset()
+    x = dsl.data(name="x", size=D, is_sequence=True)
+
+    def outer_step(sub):
+        outer_m = dsl.memory(name="outer_h", size=D)
+
+        def inner_step(xt):
+            m = dsl.memory(name="h", size=D, boot_layer=outer_m)
+            return dsl.fc(input=[xt, m], size=D, act="tanh", name="h",
+                          bias_attr=False)
+
+        inner = dsl.recurrent_group(inner_step, sub, name="inner_rnn")
+        return dsl.last_seq(inner, name="outer_h")
+
+    out = dsl.recurrent_group(outer_step, dsl.SubsequenceInput(x),
+                              name="outer_rnn")
+    return dsl.current_graph(), out
+
+
+def test_nested_equals_flat():
+    rng = np.random.RandomState(0)
+    v = rng.randn(B, S, TS, D).astype(np.float32)
+
+    flat_graph, flat_out = _build_flat()
+    flat_net = Network(flat_graph, outputs=[flat_out.name])
+    params = flat_net.init_params(jax.random.PRNGKey(1))
+    assert "_h.w0" in params  # shared step weight, hoisted
+
+    flat_feed = {"x": Argument(
+        value=jnp.asarray(v.reshape(B, S * TS, D)),
+        mask=jnp.ones((B, S * TS), jnp.float32))}
+    flat = flat_net.apply(params, flat_feed)[flat_out.name]
+    # per-sub-sequence last hidden states of the flat run
+    flat_last = np.asarray(flat.value).reshape(B, S, TS, D)[:, :, -1, :]
+
+    nested_graph, nested_out = _build_nested()
+    nested_net = Network(nested_graph, outputs=[nested_out.name])
+    # same parameter table (names line up through the double hoist)
+    assert set(nested_net.param_specs) == set(flat_net.param_specs)
+    nested_feed = {"x": Argument(
+        value=jnp.asarray(v), mask=jnp.ones((B, S, TS), jnp.float32))}
+    nested = nested_net.apply(params, nested_feed)[nested_out.name]
+
+    np.testing.assert_allclose(np.asarray(nested.value), flat_last,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_nested_group_shapes_and_mask():
+    nested_graph, nested_out = _build_nested()
+    net = Network(nested_graph, outputs=[nested_out.name])
+    params = net.init_params(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(1)
+    v = rng.randn(B, S, TS, D).astype(np.float32)
+    mask = np.ones((B, S, TS), np.float32)
+    mask[0, 2] = 0.0  # batch 0 has only 2 sub-sequences
+    out = net.apply(params, {"x": Argument(value=jnp.asarray(v),
+                                           mask=jnp.asarray(mask))})
+    a = out[nested_out.name]
+    assert np.asarray(a.value).shape == (B, S, D)
+    # outer mask marks the live sub-sequences
+    np.testing.assert_allclose(np.asarray(a.mask),
+                               [[1, 1, 0], [1, 1, 1]])
+    # padded outer step contributes zeros
+    assert np.allclose(np.asarray(a.value)[0, 2], 0.0)
+
+
+def test_nested_group_grads():
+    nested_graph, nested_out = _build_nested()
+    net = Network(nested_graph, outputs=[nested_out.name])
+    params = net.init_params(jax.random.PRNGKey(2))
+    rng = np.random.RandomState(3)
+    v = rng.randn(B, S, TS, D).astype(np.float32)
+    feed = {"x": Argument(value=jnp.asarray(v),
+                          mask=jnp.ones((B, S, TS), jnp.float32))}
+
+    def loss(p):
+        return jnp.sum(net.apply(p, feed)[nested_out.name].value ** 2)
+
+    g = jax.grad(loss)(params)
+    name = "_h.w0"
+    ana = np.asarray(g[name])
+    p0 = np.asarray(params[name], np.float64)
+    eps = 1e-3
+    for idx in rng.choice(p0.size, size=4, replace=False):
+        d = np.zeros(p0.size)
+        d[idx] = eps
+        d = d.reshape(p0.shape)
+        pp = dict(params)
+        pp[name] = jnp.asarray(p0 + d, jnp.float32)
+        pm = dict(params)
+        pm[name] = jnp.asarray(p0 - d, jnp.float32)
+        num = (float(loss(pp)) - float(loss(pm))) / (2 * eps)
+        assert abs(num - ana.reshape(-1)[idx]) < 5e-2 * max(
+            1.0, abs(num)), (idx, num, ana.reshape(-1)[idx])

@@ -1,0 +1,149 @@
+"""--prev_batch_state: truncated-BPTT state carry across batches.
+
+The reference carries RNN state over batch boundaries when
+``--prev_batch_state`` is set (``Trainer.cpp:396-418``, ``Flags.cpp:73``)
+so contiguous text trains as one long stream. Continuity property: feeding
+a long sequence as two carried half-batches must produce the same forward
+outputs as feeding it whole.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.config import dsl
+from paddle_tpu.core.argument import Argument
+from paddle_tpu.core.network import Network
+from paddle_tpu.optim import Adam
+from paddle_tpu.trainer import events as ev
+from paddle_tpu.trainer.trainer import SGD
+
+
+@pytest.mark.parametrize("ltype,din", [("lstmemory", 12),
+                                       ("gated_recurrent", 9),
+                                       ("recurrent", 3)])
+def test_carried_state_equals_unsplit_forward(ltype, din):
+    from paddle_tpu.config.model_config import Input, LayerDef
+    dsl.reset()
+    dsl.data(name="x", size=din, is_sequence=True)
+    dsl.current_graph().add(LayerDef(
+        name="rnn", type=ltype, inputs=[Input("x")], bias=True))
+    net = Network(dsl.current_graph(), outputs=["rnn"])
+    params = net.init_params(jax.random.PRNGKey(0))
+
+    rng = np.random.RandomState(0)
+    B, T = 2, 8
+    v = rng.randn(B, T, din).astype(np.float32)
+    full_mask = np.ones((B, T), np.float32)
+    whole = net.apply(params, {"x": Argument(
+        value=jnp.asarray(v), mask=jnp.asarray(full_mask))})["rnn"]
+
+    half_mask = np.ones((B, T // 2), np.float32)
+    first = net.apply(params, {"x": Argument(
+        value=jnp.asarray(v[:, :T // 2]), mask=jnp.asarray(half_mask))})["rnn"]
+    second = net.apply(
+        params,
+        {"x": Argument(value=jnp.asarray(v[:, T // 2:]),
+                       mask=jnp.asarray(half_mask))},
+        carried={"rnn": first.state})["rnn"]
+
+    got = np.concatenate([np.asarray(first.value), np.asarray(second.value)],
+                         axis=1)
+    np.testing.assert_allclose(got, np.asarray(whole.value),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_reversed_layer_ignores_carry():
+    from paddle_tpu.config.model_config import Input, LayerDef
+    dsl.reset()
+    dsl.data(name="x", size=9, is_sequence=True)
+    dsl.current_graph().add(LayerDef(
+        name="rnn", type="gated_recurrent", inputs=[Input("x")], bias=True,
+        attrs={"reversed": True}))
+    net = Network(dsl.current_graph(), outputs=["rnn"])
+    params = net.init_params(jax.random.PRNGKey(0))
+    v = np.random.RandomState(0).randn(2, 4, 9).astype(np.float32)
+    feed = {"x": Argument(value=jnp.asarray(v),
+                          mask=jnp.ones((2, 4), jnp.float32))}
+    base = net.apply(params, feed)["rnn"]
+    poisoned = net.apply(params, feed,
+                         carried={"rnn": jnp.full((2, 3), 99.0)})["rnn"]
+    np.testing.assert_allclose(np.asarray(base.value),
+                               np.asarray(poisoned.value))
+
+
+def _stream_reader(rng, batches=6, B=4, T=6, din=12, classes=2):
+    def reader():
+        for _ in range(batches):
+            v = rng.randn(B, T, din).astype(np.float32)
+            y = rng.randint(0, classes, size=B).astype(np.int32)
+            m = np.ones((B, T), np.float32)
+            yield {"x": Argument(value=jnp.asarray(v), mask=jnp.asarray(m)),
+                   "label": Argument(value=jnp.asarray(y))}
+    return reader
+
+
+def test_trainer_threads_state_and_trains():
+    """IMDB-style LSTM classifier with carried state trains; the carried
+    dict is threaded across batches and reset at pass boundaries."""
+    dsl.reset()
+    x = dsl.data(name="x", size=12, is_sequence=True)
+    lbl = dsl.data(name="label", size=2)
+    h = dsl.lstmemory(input=x, name="lstm")
+    pooled = dsl.last_seq(h)
+    out = dsl.fc(input=pooled, size=2, act="softmax")
+    cost = dsl.classification_cost(input=out, label=lbl)
+    tr = SGD(cost=cost, update_equation=Adam(learning_rate=3e-3),
+             prev_batch_state=True)
+    assert tr._carry_layers == ["lstm"]
+    rng = np.random.RandomState(0)
+    costs = []
+    tr.train(_stream_reader(rng), num_passes=3,
+             event_handler=lambda e: costs.append(e.cost)
+             if isinstance(e, ev.EndIteration) else None)
+    assert tr._carried is not None and "lstm" in tr._carried
+    hT, cT = tr._carried["lstm"]
+    assert np.asarray(hT).shape == (4, 3)
+    assert np.isfinite(costs[-1])
+
+
+def test_batch_size_change_resets_carry():
+    """A smaller final batch must not crash the carried step — the carry
+    resets on batch-size change (reference resetState semantics)."""
+    dsl.reset()
+    x = dsl.data(name="x", size=12, is_sequence=True)
+    lbl = dsl.data(name="label", size=2)
+    h = dsl.lstmemory(input=x, name="lstm")
+    out = dsl.fc(input=dsl.last_seq(h), size=2, act="softmax")
+    cost = dsl.classification_cost(input=out, label=lbl)
+    tr = SGD(cost=cost, update_equation=Adam(learning_rate=3e-3),
+             prev_batch_state=True)
+    rng = np.random.RandomState(0)
+
+    def reader():
+        for B in (4, 4, 3):  # ragged final batch
+            v = rng.randn(B, 6, 12).astype(np.float32)
+            y = rng.randint(0, 2, size=B).astype(np.int32)
+            m = np.ones((B, 6), np.float32)
+            yield {"x": Argument(value=jnp.asarray(v), mask=jnp.asarray(m)),
+                   "label": Argument(value=jnp.asarray(y))}
+
+    tr.train(reader, num_passes=1)  # must not raise
+
+
+def test_prev_batch_state_off_keeps_zero_boot():
+    """Without the flag, every batch starts from zero state (no carry key
+    in metrics, no retrace)."""
+    dsl.reset()
+    x = dsl.data(name="x", size=12, is_sequence=True)
+    lbl = dsl.data(name="label", size=2)
+    h = dsl.lstmemory(input=x, name="lstm")
+    out = dsl.fc(input=dsl.last_seq(h), size=2, act="softmax")
+    cost = dsl.classification_cost(input=out, label=lbl)
+    tr = SGD(cost=cost, update_equation=Adam(learning_rate=3e-3))
+    assert tr._carry_layers == []
+    rng = np.random.RandomState(0)
+    tr.train(_stream_reader(rng, batches=2), num_passes=1)
+    assert tr._carried is None

@@ -63,6 +63,25 @@ def test_sparse_dense_equivalence_zero_momentum():
                                rtol=1e-5, atol=1e-6)
 
 
+def test_sparse_dense_equivalence_l1_every_row_touched():
+    """With every row touched every step, the sparse path must match the
+    dense path including the per-step L1 shrink."""
+    def run(sparse):
+        rng = np.random.RandomState(0)
+        opt = Momentum(learning_rate=0.1, momentum=0.0, l1_rate=0.05)
+        params = {"emb": jnp.asarray(rng.randn(V, D), jnp.float32)}
+        state = opt.init(params, _meta(sparse))
+        for _ in range(6):
+            g = jnp.asarray(rng.randn(V, D).astype(np.float32))
+            params, state = opt.update({"emb": g}, state, params,
+                                       _meta(sparse), batch_size=8)
+        return params
+
+    np.testing.assert_allclose(np.asarray(run(False)["emb"]),
+                               np.asarray(run(True)["emb"]),
+                               rtol=1e-5, atol=1e-6)
+
+
 def test_sparse_state_tracks_rows():
     _, state = _run(sparse=True)
     slots = state["slots"]["emb"]
