@@ -686,9 +686,10 @@ def recurrent_group(step, input, reverse=False, name=None,
 
 
 def SubsequenceInput(input):
-    """Marker for two-level sequence input of a recurrent_group; the
-    native group consumes the outer level per step."""
-    return input
+    """Two-level sequence input of a recurrent_group: the outer group
+    steps over sub-sequences (nested frames,
+    ``RecurrentGradientMachine.cpp:294-346``)."""
+    return dsl.SubsequenceInput(_one(input))
 
 
 class BaseGeneratedInput:

@@ -104,10 +104,15 @@ class RecurrentLayerGroup(LayerImpl):
             mask = jnp.ones((B, T), jnp.float32)
         mask_tb = jnp.swapaxes(mask, 0, 1)
 
+        # cross-batch carry (--prev_batch_state): resume every memory from
+        # the previous batch's final carry instead of boot/zeros
+        carried = None if reverse else ctx.carried.get(cfg.name)
         carry0: Dict[str, jnp.ndarray] = {}
         for mem in memories:
             bname = mem["boundary"]
-            if bname in boot:
+            if carried is not None and bname in carried:
+                carry0[bname] = carried[bname]
+            elif bname in boot:
                 carry0[bname] = boot[bname]
             else:
                 size = net.shape_infos[bname].size

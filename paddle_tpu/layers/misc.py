@@ -262,10 +262,13 @@ class CropLayer(LayerImpl):
             ref = in_infos[1]
             c, h, w = ref.channels, ref.height, ref.width
         else:
-            # shape is the full (c, h, w) target, or the extents for NCHW
-            # axes [axis..3] only (both spellings appear in configs)
+            # shape spellings: 4 values = full NCHW (batch extent ignored,
+            # SPMD owns the batch), 3 = (c, h, w), fewer = extents for
+            # NCHW axes [axis..3]
             shape = list(cfg.attrs["shape"])
             dims = [info.channels, info.height, info.width]
+            if len(shape) == 4:
+                shape = shape[1:]
             start = 1 if len(shape) == 3 else max(axis, 1)
             for ax, s in zip(range(start, 4), shape):
                 dims[ax - 1] = s

@@ -62,6 +62,9 @@ def parse_args(argv=None):
     p.add_argument("--prev_batch_state", action="store_true",
                    help="carry RNN state across batches (truncated BPTT, "
                         "the reference's --prev_batch_state)")
+    p.add_argument("--fp_anomaly", action="store_true",
+                   help="raise at the first op producing NaN/Inf (the "
+                        "reference's feenableexcept, TrainerMain.cpp:49)")
     p.add_argument("--time_batches", type=int, default=20,
                    help="--job=time: timed batches after warmup")
     p.add_argument("--time_warmup", type=int, default=3)
@@ -348,6 +351,9 @@ def cmd_merge(ns, args):
 
 def main(argv=None):
     args = parse_args(argv)
+    if getattr(args, "fp_anomaly", False):
+        from paddle_tpu.utils.fp import enable_fp_anomaly
+        enable_fp_anomaly()
     ns = load_config(args.config, args.config_args)
     return {"train": cmd_train, "test": cmd_test, "time": cmd_time,
             "checkgrad": cmd_checkgrad, "merge": cmd_merge}[args.job](

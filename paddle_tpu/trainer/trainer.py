@@ -134,8 +134,9 @@ class SGD:
         self.prev_batch_state = prev_batch_state
         self._carry_layers = [
             name for name, ld in self.topology.graph.layers.items()
-            if ld.type in ("lstmemory", "gated_recurrent", "recurrent")
-            and not ld.attrs.get("reversed")
+            if ld.type in ("lstmemory", "gated_recurrent", "recurrent",
+                           "recurrent_layer_group")
+            and not (ld.attrs.get("reversed") or ld.attrs.get("reverse"))
             and name in self.network.order] if prev_batch_state else []
         self._carried = None  # {layer: state}, threaded across batches
         self._rng = jax.random.PRNGKey(seed + 1)
@@ -193,8 +194,18 @@ class SGD:
             new_params.update(updates)  # moving statistics (batch_norm)
             metrics = self._metrics(outputs, feed)
             if carry_layers:
+                graph = self.topology.graph
+
+                def final_state(n):
+                    s = outputs[n].state
+                    # a recurrent group's .state also holds extra outputs;
+                    # only its final scan carry crosses the batch boundary
+                    if graph.layers[n].type == "recurrent_layer_group":
+                        return s["final"]
+                    return s
+
                 metrics["carried"] = jax.lax.stop_gradient(
-                    {n: outputs[n].state for n in carry_layers})
+                    {n: final_state(n) for n in carry_layers})
             return new_params, new_opt, metrics
 
         return jax.jit(step, donate_argnums=(0, 1))

@@ -1,0 +1,33 @@
+"""Model topology diagram (`python/paddle/utils/make_model_diagram.py`):
+emit a graphviz dot description of a ModelDef (render with ``dot`` if
+installed; the dot text itself is the artifact)."""
+
+from __future__ import annotations
+
+from paddle_tpu.config.model_config import ModelDef
+
+
+def make_diagram(model: ModelDef, out_path: str = None) -> str:
+    lines = ["digraph model {", "  rankdir=BT;",
+             '  node [shape=box, fontsize=10];']
+    for name, ld in model.layers.items():
+        shape = "ellipse" if ld.type == "data" else "box"
+        size = f"\\n[{ld.size}]" if ld.size else ""
+        lines.append(
+            f'  "{name}" [label="{name}\\n{ld.type}{size}", shape={shape}];')
+    for name, ld in model.layers.items():
+        for inp in ld.inputs:
+            lines.append(f'  "{inp.layer_name}" -> "{name}";')
+    for out in model.output_layer_names:
+        lines.append(f'  "{out}" [style=bold, color=red];')
+    lines.append("}")
+    dot = "\n".join(lines)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(dot)
+    return dot
+
+
+def make_diagram_from_config(config_path: str, out_path: str = None) -> str:
+    from paddle_tpu.compat import parse_config
+    return make_diagram(parse_config(config_path).model, out_path)

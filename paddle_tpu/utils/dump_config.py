@@ -1,0 +1,35 @@
+"""``python -m paddle_tpu.utils.dump_config config.py [config_args]
+[--binary]`` — print the TrainerConfig proto a config compiles to
+(`python/paddle/utils/dump_config.py`)."""
+
+from __future__ import annotations
+
+import sys
+
+
+def dump_config(config_path: str, config_args: str = "",
+                binary: bool = False):
+    from paddle_tpu.compat import parse_config
+    parsed = parse_config(config_path, config_args)
+    proto = parsed.trainer_proto()
+    if binary:
+        sys.stdout.buffer.write(proto.SerializeToString())
+    else:
+        print(proto)
+
+
+def main(argv=None):
+    args = list(argv if argv is not None else sys.argv[1:])
+    binary = "--binary" in args
+    if binary:
+        args.remove("--binary")
+    if not args:
+        print("usage: dump_config <config.py> [config_args] [--binary]",
+              file=sys.stderr)
+        return 1
+    dump_config(args[0], args[1] if len(args) > 1 else "", binary)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,34 @@
+"""FP-anomaly mode — the TPU spelling of the reference's hardware FP
+exceptions (``feenableexcept(FE_INVALID | FE_DIVBYZERO | FE_OVERFLOW)``,
+``TrainerMain.cpp:49``; tested by ``math/tests/test_FPException.cpp``).
+
+On TPU there is no trap to enable; jax's debug_nans/debug_infs re-run the
+offending jitted computation op-by-op when a NaN/Inf appears in an output
+and raise with the responsible primitive — same failure-at-the-source
+contract, compiler-style."""
+
+from __future__ import annotations
+
+import jax
+
+_enabled = False
+
+
+def enable_fp_anomaly(nans: bool = True, infs: bool = True):
+    """Raise at the op that first produces NaN (and optionally Inf).
+    Noticeable slowdown on failure paths only; fine to leave on in CI."""
+    global _enabled
+    jax.config.update("jax_debug_nans", bool(nans))
+    jax.config.update("jax_debug_infs", bool(infs))
+    _enabled = True
+
+
+def disable_fp_anomaly():
+    global _enabled
+    jax.config.update("jax_debug_nans", False)
+    jax.config.update("jax_debug_infs", False)
+    _enabled = False
+
+
+def fp_anomaly_enabled() -> bool:
+    return _enabled

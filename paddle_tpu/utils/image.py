@@ -1,0 +1,87 @@
+"""Image preprocessing (`python/paddle/v2/image.py` + ``utils``):
+resize/crop/flip/transform pipeline, numpy-only (no PIL dependency — the
+bilinear resize is a small gather, fine on host for input pipelines)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _as_hwc(im: np.ndarray) -> np.ndarray:
+    if im.ndim == 2:
+        return im[..., None]
+    return im
+
+
+def resize_short(im: np.ndarray, size: int) -> np.ndarray:
+    """Scale so the SHORT side equals ``size`` (aspect preserved),
+    bilinear."""
+    im = _as_hwc(im)
+    h, w = im.shape[:2]
+    if h < w:
+        nh, nw = size, max(1, round(w * size / h))
+    else:
+        nh, nw = max(1, round(h * size / w)), size
+    return resize(im, nh, nw)
+
+
+def resize(im: np.ndarray, nh: int, nw: int) -> np.ndarray:
+    """Bilinear resize to (nh, nw)."""
+    im = _as_hwc(im).astype(np.float32)
+    h, w = im.shape[:2]
+    ys = np.linspace(0, h - 1, nh)
+    xs = np.linspace(0, w - 1, nw)
+    y0 = np.floor(ys).astype(int)
+    x0 = np.floor(xs).astype(int)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    fy = (ys - y0)[:, None, None]
+    fx = (xs - x0)[None, :, None]
+    a = im[y0][:, x0]
+    b = im[y0][:, x1]
+    c = im[y1][:, x0]
+    d = im[y1][:, x1]
+    return (a * (1 - fy) * (1 - fx) + b * (1 - fy) * fx
+            + c * fy * (1 - fx) + d * fy * fx)
+
+
+def center_crop(im: np.ndarray, size: int) -> np.ndarray:
+    im = _as_hwc(im)
+    h, w = im.shape[:2]
+    y0 = max((h - size) // 2, 0)
+    x0 = max((w - size) // 2, 0)
+    return im[y0:y0 + size, x0:x0 + size]
+
+
+def random_crop(im: np.ndarray, size: int, rng=None) -> np.ndarray:
+    rng = rng or np.random
+    im = _as_hwc(im)
+    h, w = im.shape[:2]
+    y0 = rng.randint(0, max(h - size, 0) + 1)
+    x0 = rng.randint(0, max(w - size, 0) + 1)
+    return im[y0:y0 + size, x0:x0 + size]
+
+
+def left_right_flip(im: np.ndarray) -> np.ndarray:
+    return _as_hwc(im)[:, ::-1]
+
+
+def to_chw(im: np.ndarray) -> np.ndarray:
+    return np.transpose(_as_hwc(im), (2, 0, 1))
+
+
+def simple_transform(im: np.ndarray, resize_size: int, crop_size: int,
+                     is_train: bool, mean=None, rng=None) -> np.ndarray:
+    """The reference's train/test transform: resize-short, (random|center)
+    crop, random flip in training, optional mean subtraction, CHW."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, rng)
+        if (rng or np.random).rand() > 0.5:
+            im = left_right_flip(im)
+    else:
+        im = center_crop(im, crop_size)
+    im = to_chw(im).astype(np.float32)
+    if mean is not None:
+        im = im - np.asarray(mean, np.float32).reshape(-1, 1, 1)
+    return im

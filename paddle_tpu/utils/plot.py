@@ -1,0 +1,49 @@
+"""Training-curve plotting (`python/paddle/v2/plot/plot.py`): ``Ploter``
+accumulates (step, value) series and renders via matplotlib when present
+(notebooks); headless environments still accumulate and can ``save()``
+or read ``.series`` directly."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+
+class Ploter:
+    def __init__(self, *titles: str):
+        self.titles = list(titles)
+        self.series: Dict[str, List[Tuple[float, float]]] = {
+            t: [] for t in titles}
+
+    def append(self, title: str, step: float, value: float):
+        if title not in self.series:
+            raise KeyError(f"unknown series {title!r}; have {self.titles}")
+        self.series[title].append((float(step), float(value)))
+
+    def _plt(self):
+        try:
+            import matplotlib
+            matplotlib.use("Agg")
+            import matplotlib.pyplot as plt
+            return plt
+        except Exception:  # noqa: BLE001 — matplotlib genuinely optional
+            return None
+
+    def plot(self, path: str = None):
+        plt = self._plt()
+        if plt is None:
+            return  # headless/minimal env: data stays in .series
+        plt.figure()
+        for t in self.titles:
+            if self.series[t]:
+                xs, ys = zip(*self.series[t])
+                plt.plot(xs, ys, label=t)
+        plt.legend()
+        if path:
+            plt.savefig(path)
+        plt.close()
+
+    save = plot
+
+    def reset(self):
+        for t in self.titles:
+            self.series[t].clear()

@@ -3,7 +3,14 @@ deterministic-synthetic two-tier loaders (see common.py)."""
 
 from paddle_tpu.v2.dataset import cifar  # noqa: F401
 from paddle_tpu.v2.dataset import common  # noqa: F401
+from paddle_tpu.v2.dataset import conll05  # noqa: F401
+from paddle_tpu.v2.dataset import flowers  # noqa: F401
 from paddle_tpu.v2.dataset import imdb  # noqa: F401
 from paddle_tpu.v2.dataset import imikolov  # noqa: F401
 from paddle_tpu.v2.dataset import mnist  # noqa: F401
+from paddle_tpu.v2.dataset import movielens  # noqa: F401
+from paddle_tpu.v2.dataset import mq2007  # noqa: F401
+from paddle_tpu.v2.dataset import sentiment  # noqa: F401
 from paddle_tpu.v2.dataset import uci_housing  # noqa: F401
+from paddle_tpu.v2.dataset import voc2012  # noqa: F401
+from paddle_tpu.v2.dataset import wmt14  # noqa: F401

@@ -1,0 +1,88 @@
+"""CoNLL-2005 semantic role labeling (`python/paddle/v2/dataset/conll05.py`).
+
+Records mirror the reference's ``reader_creator`` 9-tuple:
+``(word_ids, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, verb_ids, mark, label_ids)``
+— the five context windows around the predicate, the predicate id repeated
+per token, a 0/1 predicate mark, and IOB label ids. Synthetic tier builds
+sentences whose labels depend on distance to the predicate, so an SRL
+tagger genuinely learns.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.v2.dataset import common
+
+_WORD_V, _VERB_V = 2000, 100
+_LABELS = ["O", "B-A0", "I-A0", "B-A1", "I-A1", "B-V", "I-V",
+           "B-AM", "I-AM"]
+
+
+def word_dict():
+    d = {f"w{i}": i for i in range(_WORD_V)}
+    return d
+
+
+def verb_dict():
+    return {f"v{i}": i for i in range(_VERB_V)}
+
+
+def label_dict():
+    return {l: i for i, l in enumerate(_LABELS)}
+
+
+def get_dict():
+    """(word_dict, verb_dict, label_dict) — the reference's get_dict."""
+    return word_dict(), verb_dict(), label_dict()
+
+
+def get_embedding():
+    """Deterministic stand-in for the reference's pretrained emb32 table."""
+    rng = np.random.RandomState(5)
+    return rng.randn(_WORD_V, 32).astype(np.float32)
+
+
+def _reader(n, seed):
+    common.note_synthetic("conll05")
+    ld = label_dict()
+
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            T = int(rng.randint(5, 20))
+            words = rng.randint(0, _WORD_V, size=T)
+            vpos = int(rng.randint(0, T))
+            verb = int(rng.randint(0, _VERB_V))
+
+            def ctx(off):
+                j = min(max(vpos + off, 0), T - 1)
+                return [int(words[j])] * T
+
+            mark = [1 if t == vpos else 0 for t in range(T)]
+            labels = []
+            for t in range(T):
+                if t == vpos:
+                    labels.append(ld["B-V"])
+                elif t == vpos - 1:
+                    labels.append(ld["B-A0"])
+                elif t == vpos + 1:
+                    labels.append(ld["B-A1"])
+                elif t == vpos + 2:
+                    labels.append(ld["I-A1"])
+                else:
+                    labels.append(ld["O"])
+            yield ([int(w) for w in words], ctx(-2), ctx(-1), ctx(0),
+                   ctx(1), ctx(2), [verb] * T, mark, labels)
+
+    return reader
+
+
+def test():
+    return _reader(1024, seed=3)
+
+
+def train():
+    """The reference ships only the public test split; synthetic tier
+    offers a train split with the same generator."""
+    return _reader(4096, seed=2)

@@ -1,0 +1,55 @@
+"""Oxford 102 Flowers (`python/paddle/v2/dataset/flowers.py`).
+
+Records mirror the reference's mapped output: ``(image, label)`` with
+image a flattened float32 CHW array in [0, 1] (3x32x32 here — the
+reference's mapper crops/resizes to a fixed square too) and label in
+[0, 102). Synthetic tier renders class-conditional color blobs so a conv
+net genuinely learns."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.v2.dataset import common
+
+N_CLASSES = 102
+_SIDE = 32
+
+
+def _render(rng, label):
+    """Class-conditional 'flower': a colored disc on textured background;
+    hue/radius derive from the label."""
+    img = rng.rand(3, _SIDE, _SIDE).astype(np.float32) * 0.2
+    cy, cx = rng.randint(8, _SIDE - 8, size=2)
+    rad = 4 + (label % 7)
+    hue = np.array([(label * 37 % 255) / 255.0,
+                    (label * 101 % 255) / 255.0,
+                    (label * 197 % 255) / 255.0], np.float32)
+    yy, xx = np.mgrid[0:_SIDE, 0:_SIDE]
+    disc = ((yy - cy) ** 2 + (xx - cx) ** 2) <= rad ** 2
+    img[:, disc] = hue[:, None] * (0.7 + 0.3 * rng.rand())
+    return img.reshape(-1)
+
+
+def _reader(n, seed):
+    common.note_synthetic("flowers")
+
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            label = int(rng.randint(0, N_CLASSES))
+            yield _render(rng, label), label
+
+    return reader
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=True):
+    return _reader(2048, seed=0)
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=True):
+    return _reader(512, seed=1)
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=True):
+    return _reader(512, seed=2)

@@ -1,0 +1,137 @@
+"""MovieLens-1M ratings (`python/paddle/v2/dataset/movielens.py`).
+
+Records mirror the reference's ``__reader_creator__``:
+``[user_id, gender, age, job, movie_id, category_ids, title_ids, [rating]]``
+(user/movie features then the score). Real tier parses the ml-1m archive's
+``ratings.dat``/``users.dat``/``movies.dat``; synthetic tier fabricates a
+consistent catalog with taste structure (ratings correlate with a latent
+user x category affinity, so factorization models genuinely learn).
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from paddle_tpu.v2.dataset import common
+
+_N_USERS, _N_MOVIES, _N_CATEGORIES, _TITLE_VOCAB = 600, 400, 18, 1000
+_AGES = [1, 18, 25, 35, 45, 50, 56]
+_N_JOBS = 21
+
+
+def max_user_id():
+    return _N_USERS
+
+
+def max_movie_id():
+    return _N_MOVIES
+
+
+def max_job_id():
+    return _N_JOBS - 1
+
+
+def age_table():
+    return list(_AGES)
+
+
+def categories():
+    return [f"cat{i}" for i in range(_N_CATEGORIES)]
+
+
+def _catalog():
+    """Deterministic synthetic catalog: per-movie categories/titles and
+    per-user demographics."""
+    rng = np.random.RandomState(77)
+    movies = []
+    for m in range(_N_MOVIES):
+        cats = sorted(rng.choice(_N_CATEGORIES,
+                                 size=rng.randint(1, 4), replace=False))
+        title = list(rng.randint(0, _TITLE_VOCAB, size=rng.randint(1, 5)))
+        movies.append(([int(c) for c in cats], [int(t) for t in title]))
+    users = []
+    for u in range(_N_USERS):
+        users.append((int(rng.randint(0, 2)),
+                      int(rng.randint(0, len(_AGES))),
+                      int(rng.randint(0, _N_JOBS))))
+    affinity = rng.randn(_N_USERS, _N_CATEGORIES)
+    return movies, users, affinity
+
+
+def _reader(n, seed):
+    common.note_synthetic("movielens")
+    movies, users, affinity = _catalog()
+
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            u = int(rng.randint(0, _N_USERS))
+            m = int(rng.randint(0, _N_MOVIES))
+            cats, title = movies[m]
+            gender, age, job = users[u]
+            score = float(np.clip(
+                3.0 + affinity[u, cats].mean() + rng.randn() * 0.3,
+                1.0, 5.0))
+            yield [u, gender, age, job, m, cats, title, [score]]
+
+    return reader
+
+
+def train():
+    path = common.cache_path("movielens", "ml-1m.zip")
+    if path:
+        return _real_reader(path, is_test=False)
+    return _reader(8192, seed=0)
+
+
+def test():
+    path = common.cache_path("movielens", "ml-1m.zip")
+    if path:
+        return _real_reader(path, is_test=True)
+    return _reader(1024, seed=1)
+
+
+def _real_reader(path, *, is_test):
+    """Parse the genuine ml-1m archive (reference format: ``::``-separated
+    .dat files inside the zip). Every 10th rating goes to test, like the
+    reference's modulo split."""
+    import zipfile
+
+    def reader():
+        with zipfile.ZipFile(path) as z:
+            users = {}
+            for line in z.read("ml-1m/users.dat").decode(
+                    "latin1").splitlines():
+                uid, gender, age, job, _ = line.split("::")
+                users[int(uid)] = (int(gender == "M"),
+                                   _AGES.index(int(age)), int(job))
+            import zlib
+            movies = {}
+
+            def stable(s, mod):
+                # process-stable id (hash() varies with PYTHONHASHSEED)
+                return zlib.crc32(s.encode()) % mod
+
+            for line in z.read("ml-1m/movies.dat").decode(
+                    "latin1").splitlines():
+                mid, title, genres = line.split("::")
+                words = re.sub(r"\(\d{4}\)$", "", title.strip()).split()
+                movies[int(mid)] = (
+                    [stable(g, _N_CATEGORIES) for g in genres.split("|")],
+                    [stable(w, _TITLE_VOCAB) for w in words])
+            for i, line in enumerate(z.read("ml-1m/ratings.dat").decode(
+                    "latin1").splitlines()):
+                uid, mid, score, _ = line.split("::")
+                if (i % 10 == 9) != is_test:
+                    continue
+                uid, mid = int(uid), int(mid)
+                if uid not in users or mid not in movies:
+                    continue
+                gender, age, job = users[uid]
+                cats, title = movies[mid]
+                yield [uid, gender, age, job, mid, cats, title,
+                       [float(score)]]
+
+    return reader

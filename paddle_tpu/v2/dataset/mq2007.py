@@ -1,0 +1,100 @@
+"""LETOR MQ2007 learning-to-rank (`python/paddle/v2/dataset/mq2007.py`).
+
+Three record formats, mirroring the reference's ``format`` argument:
+
+- ``pointwise``: ``(relevance_score, feature_vector[46])``
+- ``pairwise``: ``(label, better_features, worse_features)``
+- ``listwise``: ``(score_list, feature_matrix)`` per query
+
+Real tier parses the genuine LETOR text format
+(``rel qid:<id> 1:<v> 2:<v> ... #docid``); synthetic tier draws features
+whose first components drive relevance, so rank models genuinely learn.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.v2.dataset import common
+
+FEATURE_DIM = 46
+
+
+def _parse_letor(path):
+    """LETOR text -> {qid: (scores, features)} (the reference's
+    QueryList)."""
+    queries = {}
+    with open(path) as f:
+        for line in f:
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            rel = float(parts[0])
+            qid = parts[1].split(":")[1]
+            feats = np.zeros(FEATURE_DIM, np.float32)
+            for kv in parts[2:]:
+                k, _, v = kv.partition(":")
+                idx = int(k) - 1
+                if 0 <= idx < FEATURE_DIM:
+                    feats[idx] = float(v)
+            queries.setdefault(qid, []).append((rel, feats))
+    return {q: (np.asarray([r for r, _ in rows], np.float32),
+                np.stack([f for _, f in rows]))
+            for q, rows in queries.items()}
+
+
+def _synthetic_queries(n_queries, seed):
+    common.note_synthetic("mq2007")
+    rng = np.random.RandomState(seed)
+    out = {}
+    for q in range(n_queries):
+        n_docs = int(rng.randint(5, 20))
+        feats = rng.rand(n_docs, FEATURE_DIM).astype(np.float32)
+        score = (feats[:, 0] * 2 + feats[:, 1]
+                 + rng.rand(n_docs) * 0.2)
+        rel = np.digitize(score, [1.0, 2.0]).astype(np.float32)  # 0/1/2
+        out[f"q{q}"] = (rel, feats)
+    return out
+
+
+def _queries(split, seed):
+    path = common.cache_path("mq2007", f"{split}.txt")
+    if path:
+        return _parse_letor(path)
+    return _synthetic_queries(200 if split == "train" else 50, seed)
+
+
+def _emit(queries, format):
+    if format == "pointwise":
+        for rel, feats in queries.values():
+            for r, f in zip(rel, feats):
+                yield float(r), f
+    elif format == "pairwise":
+        for rel, feats in queries.values():
+            order = np.argsort(-rel)
+            for i in range(len(order)):
+                for j in range(i + 1, len(order)):
+                    a, b = order[i], order[j]
+                    if rel[a] == rel[b]:
+                        continue
+                    yield np.array([1.0], np.float32), feats[a], feats[b]
+    elif format == "listwise":
+        for rel, feats in queries.values():
+            yield rel, feats
+    else:
+        raise ValueError(f"unknown mq2007 format {format!r}")
+
+
+def train(format="pairwise"):
+    def reader():
+        yield from _emit(_queries("train", seed=0), format)
+
+    return reader
+
+
+def test(format="pairwise"):
+    def reader():
+        yield from _emit(_queries("test", seed=1), format)
+
+    return reader

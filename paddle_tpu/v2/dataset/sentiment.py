@@ -1,0 +1,45 @@
+"""NLTK movie-review sentiment (`python/paddle/v2/dataset/sentiment.py`).
+
+Records mirror the reference: ``(word_ids, label)`` with label 0/1
+(positive sorts first in the reference's corpus walk). Same
+class-conditional unigram generator idea as imdb, different vocabulary."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.v2.dataset import common
+
+_VOCAB = 3000
+
+
+def get_word_dict():
+    """word -> id, ordered by synthetic 'frequency' like the reference
+    sorts by corpus frequency."""
+    return {f"w{i}": i for i in range(_VOCAB)}
+
+
+def _reader(n, seed):
+    common.note_synthetic("sentiment")
+    proto = np.random.RandomState(23)
+    logits = proto.randn(2, _VOCAB)
+
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            lab = int(rng.randint(2))
+            p = np.exp(logits[lab] - logits[lab].max())
+            p /= p.sum()
+            length = int(rng.randint(10, 60))
+            toks = rng.choice(_VOCAB, size=length, p=p)
+            yield [int(t) for t in toks], lab
+
+    return reader
+
+
+def train():
+    return _reader(2048, seed=0)
+
+
+def test():
+    return _reader(512, seed=1)

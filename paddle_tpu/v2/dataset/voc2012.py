@@ -1,0 +1,54 @@
+"""Pascal VOC2012 segmentation (`python/paddle/v2/dataset/voc2012.py`).
+
+Records mirror the reference: ``(image, label_mask)`` — image float32
+CHW in [0,1], mask int32 HW with class ids in [0, 21) (20 object classes
++ background). Synthetic tier paints rectangles whose class matches their
+color, so a segmentation head genuinely learns."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.v2.dataset import common
+
+N_CLASSES = 21
+_SIDE = 32
+
+
+def _sample(rng):
+    img = rng.rand(3, _SIDE, _SIDE).astype(np.float32) * 0.15
+    mask = np.zeros((_SIDE, _SIDE), np.int32)
+    for _ in range(rng.randint(1, 4)):
+        cls = int(rng.randint(1, N_CLASSES))
+        y0, x0 = rng.randint(0, _SIDE - 8, size=2)
+        h, w = rng.randint(6, 12, size=2)
+        hue = np.array([(cls * 53 % 255) / 255.0,
+                        (cls * 131 % 255) / 255.0,
+                        (cls * 211 % 255) / 255.0], np.float32)
+        img[:, y0:y0 + h, x0:x0 + w] = hue[:, None, None]
+        mask[y0:y0 + h, x0:x0 + w] = cls
+    return img, mask
+
+
+def _reader(n, seed):
+    common.note_synthetic("voc2012")
+
+    def reader():
+        rng = np.random.RandomState(seed)
+        for _ in range(n):
+            img, mask = _sample(rng)
+            yield img, mask
+
+    return reader
+
+
+def train():
+    return _reader(1024, seed=0)
+
+
+def test():
+    return _reader(256, seed=1)
+
+
+def val():
+    return _reader(256, seed=2)

@@ -1,0 +1,60 @@
+"""WMT-14 French->English translation (`python/paddle/v2/dataset/wmt14.py`).
+
+Records mirror the reference: ``(src_ids, trg_ids, trg_ids_next)`` where
+trg_ids starts with <s> and trg_ids_next ends with <e> (ids 0/1/2 =
+<s>/<e>/<unk>, as in the reference). Synthetic tier generates parallel
+pairs under a deterministic token mapping with local reordering, so an
+attention model genuinely learns an alignment.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.v2.dataset import common
+
+START_ID, END_ID, UNK_ID = 0, 1, 2
+
+
+def _reader(dict_size, n, seed):
+    common.note_synthetic("wmt14")
+
+    def reader():
+        rng = np.random.RandomState(seed)
+        shift = 7
+        for _ in range(n):
+            T = int(rng.randint(4, 16))
+            src = rng.randint(3, dict_size, size=T)
+            trg = [(int(s) - 3 + shift) % (dict_size - 3) + 3 for s in src]
+            # local reordering: swap adjacent pairs (French-ish)
+            for i in range(0, len(trg) - 1, 2):
+                if rng.rand() < 0.3:
+                    trg[i], trg[i + 1] = trg[i + 1], trg[i]
+            src_ids = [int(s) for s in src]
+            yield (src_ids, [START_ID] + trg, trg + [END_ID])
+
+    return reader
+
+
+def train(dict_size):
+    return _reader(dict_size, 4096, seed=0)
+
+
+def test(dict_size):
+    return _reader(dict_size, 512, seed=1)
+
+
+def gen(dict_size):
+    return _reader(dict_size, 128, seed=2)
+
+
+def get_dict(dict_size, reverse=False):
+    """(src_dict, trg_dict); reverse=True maps id -> token."""
+    src = {"<s>": 0, "<e>": 1, "<unk>": 2}
+    src.update({f"f{i}": i for i in range(3, dict_size)})
+    trg = {"<s>": 0, "<e>": 1, "<unk>": 2}
+    trg.update({f"e{i}": i for i in range(3, dict_size)})
+    if reverse:
+        src = {v: k for k, v in src.items()}
+        trg = {v: k for k, v in trg.items()}
+    return src, trg

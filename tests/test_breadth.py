@@ -1,0 +1,175 @@
+"""Breadth coverage: the remaining v2 datasets, utils (dump_config, image
+preprocessing, plotting, model diagram), and FP-anomaly mode."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.v2 import dataset
+
+
+def _take(reader, n=5):
+    out = []
+    for i, rec in enumerate(reader()):
+        out.append(rec)
+        if i + 1 >= n:
+            break
+    return out
+
+
+def test_movielens_schema():
+    recs = _take(dataset.movielens.train())
+    for r in recs:
+        uid, gender, age, job, mid, cats, title, score = r
+        assert 0 <= uid < dataset.movielens.max_user_id()
+        assert gender in (0, 1)
+        assert 0 <= mid < dataset.movielens.max_movie_id()
+        assert all(isinstance(c, int) for c in cats)
+        assert 1.0 <= score[0] <= 5.0
+    assert len(dataset.movielens.categories()) == 18
+
+
+def test_conll05_schema():
+    wd, vd, ld = dataset.conll05.get_dict()
+    emb = dataset.conll05.get_embedding()
+    assert emb.shape == (len(wd), 32)
+    for rec in _take(dataset.conll05.test()):
+        words, n2, n1, c0, p1, p2, verb, mark, labels = rec
+        T = len(words)
+        assert all(len(x) == T for x in (n2, n1, c0, p1, p2, verb, mark,
+                                         labels))
+        assert sum(mark) == 1  # exactly one predicate
+        assert all(0 <= l < len(ld) for l in labels)
+
+
+def test_wmt14_schema():
+    for src, trg, nxt in _take(dataset.wmt14.train(1000)):
+        assert trg[0] == dataset.wmt14.START_ID
+        assert nxt[-1] == dataset.wmt14.END_ID
+        assert trg[1:] == nxt[:-1]
+        assert all(3 <= t < 1000 for t in src)
+    s, t = dataset.wmt14.get_dict(100, reverse=True)
+    assert s[0] == "<s>" and t[1] == "<e>"
+
+
+def test_flowers_voc_schemas():
+    for img, lab in _take(dataset.flowers.train()):
+        assert img.shape == (3 * 32 * 32,) and img.dtype == np.float32
+        assert 0 <= lab < dataset.flowers.N_CLASSES
+    for img, mask in _take(dataset.voc2012.train()):
+        assert img.shape == (3, 32, 32)
+        assert mask.shape == (32, 32)
+        assert mask.max() < dataset.voc2012.N_CLASSES
+
+
+def test_sentiment_schema():
+    wd = dataset.sentiment.get_word_dict()
+    for words, lab in _take(dataset.sentiment.train()):
+        assert lab in (0, 1)
+        assert all(0 <= w < len(wd) for w in words)
+
+
+def test_mq2007_formats():
+    for rel, feats in _take(dataset.mq2007.train("pointwise")):
+        assert feats.shape == (dataset.mq2007.FEATURE_DIM,)
+    for lab, a, b in _take(dataset.mq2007.train("pairwise")):
+        assert a.shape == b.shape == (dataset.mq2007.FEATURE_DIM,)
+    for rels, mat in _take(dataset.mq2007.train("listwise")):
+        assert mat.shape == (len(rels), dataset.mq2007.FEATURE_DIM)
+
+
+def test_mq2007_real_letor_parse(tmp_path):
+    """The genuine LETOR text format parses (real-tier path)."""
+    txt = ("2 qid:10 1:0.5 2:0.1 46:0.9 #doc1\n"
+           "0 qid:10 1:0.1 2:0.2 #doc2\n"
+           "1 qid:11 1:0.9 #doc3\n")
+    p = tmp_path / "train.txt"
+    p.write_text(txt)
+    q = dataset.mq2007._parse_letor(str(p))
+    assert set(q) == {"10", "11"}
+    rel, feats = q["10"]
+    assert list(rel) == [2.0, 0.0]
+    assert feats[0, 0] == np.float32(0.5) and feats[0, 45] == np.float32(0.9)
+
+
+def test_movielens_real_archive_parse(tmp_path, monkeypatch):
+    """The genuine ml-1m zip layout parses (real-tier path)."""
+    import zipfile
+    d = tmp_path / "movielens"
+    d.mkdir()
+    with zipfile.ZipFile(d / "ml-1m.zip", "w") as z:
+        z.writestr("ml-1m/users.dat",
+                   "1::M::25::4::55455\n2::F::35::7::55117\n")
+        z.writestr("ml-1m/movies.dat",
+                   "10::Toy Story (1995)::Animation|Comedy\n")
+        z.writestr("ml-1m/ratings.dat",
+                   "1::10::5::978300760\n2::10::3::978302109\n")
+    monkeypatch.setattr(dataset.common, "DATA_HOME", str(tmp_path))
+    recs = list(dataset.movielens.train()())
+    assert len(recs) == 2  # neither lands in the 1-in-10 test split
+    uid, gender, age, job, mid, cats, title, score = recs[0]
+    assert (uid, gender, mid, score) == (1, 1, 10, [5.0])
+    assert len(cats) == 2 and len(title) == 2  # two genres, "Toy Story"
+
+
+# ------------------------------------------------------------------- utils
+def test_image_transforms():
+    from paddle_tpu.utils import image
+    rng = np.random.RandomState(0)
+    im = rng.rand(48, 64, 3).astype(np.float32)
+    assert image.resize_short(im, 32).shape[0] == 32  # short side
+    assert image.center_crop(im, 32).shape[:2] == (32, 32)
+    assert image.random_crop(im, 32, rng).shape[:2] == (32, 32)
+    out = image.simple_transform(im, 40, 32, is_train=True, rng=rng,
+                                 mean=[0.5, 0.5, 0.5])
+    assert out.shape == (3, 32, 32)
+    flipped = image.left_right_flip(im)
+    np.testing.assert_allclose(flipped[:, ::-1], im)
+
+
+def test_ploter_accumulates():
+    from paddle_tpu.utils.plot import Ploter
+    p = Ploter("train", "test")
+    p.append("train", 0, 1.0)
+    p.append("train", 1, 0.5)
+    assert p.series["train"] == [(0.0, 1.0), (1.0, 0.5)]
+    p.plot()  # headless: must not raise
+    p.reset()
+    assert p.series["train"] == []
+
+
+def test_model_diagram(tmp_path):
+    from paddle_tpu.config import dsl
+    from paddle_tpu.utils.diagram import make_diagram
+    dsl.reset()
+    x = dsl.data(name="x", size=4)
+    y = dsl.fc(input=x, size=2, name="out")
+    dot = make_diagram(dsl.current_graph(), str(tmp_path / "m.dot"))
+    assert '"x" -> "out";' in dot
+    assert (tmp_path / "m.dot").read_text() == dot
+
+
+def test_dump_config(tmp_path, capsys):
+    cfg = tmp_path / "c.py"
+    cfg.write_text(
+        "from paddle.trainer_config_helpers import *\n"
+        "settings(batch_size=32, learning_rate=0.1)\n"
+        "x = data_layer(name='x', size=4)\n"
+        "outputs(fc_layer(input=x, size=2))\n")
+    from paddle_tpu.utils.dump_config import main
+    assert main([str(cfg)]) == 0
+    out = capsys.readouterr().out
+    assert "batch_size: 32" in out and 'type: "fc"' in out
+
+
+def test_fp_anomaly_mode():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.utils import fp
+    fp.enable_fp_anomaly()
+    try:
+        with pytest.raises(FloatingPointError):
+            jax.jit(lambda x: jnp.log(x))(jnp.float32(-1.0)).block_until_ready()
+    finally:
+        fp.disable_fp_anomaly()
+    # and normal computation is unaffected afterwards
+    assert float(jax.jit(lambda x: x + 1)(jnp.float32(1.0))) == 2.0

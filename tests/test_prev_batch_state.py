@@ -109,6 +109,71 @@ def test_trainer_threads_state_and_trains():
     assert np.isfinite(costs[-1])
 
 
+def test_recurrent_group_carry_continuity():
+    """recurrent_group memories carry too: two carried half-batches equal
+    the whole forward, like the flat-layer case."""
+    dsl.reset()
+    x = dsl.data(name="x", size=5, is_sequence=True)
+
+    def step(xt):
+        m = dsl.memory(name="h", size=5)
+        return dsl.fc(input=[xt, m], size=5, act="tanh", name="h",
+                      bias_attr=False)
+
+    out = dsl.recurrent_group(step, x, name="grp")
+    net = Network(dsl.current_graph(), outputs=[out.name])
+    params = net.init_params(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    B, T = 2, 8
+    v = rng.randn(B, T, 5).astype(np.float32)
+    m_full = jnp.ones((B, T), jnp.float32)
+    whole = net.apply(params, {"x": Argument(value=jnp.asarray(v),
+                                             mask=m_full)})[out.name]
+    m_half = jnp.ones((B, T // 2), jnp.float32)
+    first = net.apply(params, {"x": Argument(
+        value=jnp.asarray(v[:, :T // 2]), mask=m_half)})[out.name]
+    second = net.apply(
+        params, {"x": Argument(value=jnp.asarray(v[:, T // 2:]),
+                               mask=m_half)},
+        carried={"grp": first.state["final"]})[out.name]
+    got = np.concatenate([np.asarray(first.value),
+                          np.asarray(second.value)], axis=1)
+    np.testing.assert_allclose(got, np.asarray(whole.value),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_trainer_carries_group_state():
+    """SGD(prev_batch_state=True) threads recurrent_group finals."""
+    dsl.reset()
+    x = dsl.data(name="x", size=5, is_sequence=True)
+    lbl = dsl.data(name="label", size=2)
+
+    def step(xt):
+        m = dsl.memory(name="h", size=5)
+        return dsl.fc(input=[xt, m], size=5, act="tanh", name="h",
+                      bias_attr=False)
+
+    grp = dsl.recurrent_group(step, x, name="grp")
+    out = dsl.fc(input=dsl.last_seq(grp), size=2, act="softmax")
+    cost = dsl.classification_cost(input=out, label=lbl)
+    tr = SGD(cost=cost, update_equation=Adam(learning_rate=3e-3),
+             prev_batch_state=True)
+    assert tr._carry_layers == ["grp"]
+    rng = np.random.RandomState(0)
+
+    def reader():
+        for _ in range(3):
+            v = rng.randn(4, 6, 5).astype(np.float32)
+            y = rng.randint(0, 2, size=4).astype(np.int32)
+            m = np.ones((4, 6), np.float32)
+            yield {"x": Argument(value=jnp.asarray(v), mask=jnp.asarray(m)),
+                   "label": Argument(value=jnp.asarray(y))}
+
+    tr.train(reader, num_passes=1)
+    assert tr._carried is not None
+    assert set(tr._carried["grp"]) == {"grp@mem_h"}
+
+
 def test_batch_size_change_resets_carry():
     """A smaller final batch must not crash the carried step — the carry
     resets on batch-size change (reference resetState semantics)."""
