@@ -67,8 +67,18 @@ class ParameterAttribute:
             init = "uniform"
             mean = (self.initial_max + self.initial_min) / 2.0
             std = (self.initial_max - self.initial_min) / 2.0
+        ratio = None
+        hooks = self.update_hooks
+        if hooks is not None:
+            hooks = hooks if isinstance(hooks, (list, tuple)) else [hooks]
+            for h in hooks:
+                if getattr(h, "type", None) == "pruning":
+                    # the proto default when the config leaves it unset
+                    # (ParameterConfig.proto sparsity_ratio [default=0.6])
+                    ratio = (h.sparsity_ratio
+                             if h.sparsity_ratio is not None else 0.6)
         return _EngineParamAttr(
-            name=self.name, init=init,
+            name=self.name, init=init, sparsity_ratio=ratio,
             initial_mean=0.0 if mean is None else mean,
             initial_std=std, is_static=self.is_static,
             learning_rate=(1.0 if self.learning_rate is None

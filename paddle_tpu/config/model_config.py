@@ -30,6 +30,9 @@ class ParamAttr:
     l1_rate: Optional[float] = None
     l2_rate: Optional[float] = None
     sparse_grad: bool = False
+    # StaticPruningHook (ParameterUpdaterHook.cpp:39): fraction of weights
+    # masked to zero (smallest |w| at init) and kept zero by the optimizer
+    sparsity_ratio: Optional[float] = None
 
 
 @dataclasses.dataclass

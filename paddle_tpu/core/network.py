@@ -70,6 +70,9 @@ def _apply_attr(spec: ParamSpec, attr: Optional[ParamAttr]) -> ParamSpec:
         sparse_grad=attr.sparse_grad or spec.sparse_grad,
         l1_rate=attr.l1_rate,
         l2_rate=attr.l2_rate,
+        sparsity_ratio=(attr.sparsity_ratio
+                        if attr.sparsity_ratio is not None
+                        else spec.sparsity_ratio),
     )
 
 

@@ -56,6 +56,7 @@ class ParamSpec:
     sparse_grad: bool = False  # embedding-style row-sparse gradients
     l1_rate: Optional[float] = None  # per-param regularizer overrides
     l2_rate: Optional[float] = None
+    sparsity_ratio: Optional[float] = None  # StaticPruningHook mask
     # when set, the parameter keeps this exact global name instead of the
     # `_{layer}.{suffix}` convention — used by recurrent groups to hoist
     # sub-network parameters (shared across timesteps like the reference's

@@ -163,3 +163,15 @@ class SumCost(_CostBase):
     def apply(self, cfg, params, ins, ctx):
         cost = jnp.sum(ins[0].value, axis=-1)
         return Argument(value=_reduce_tokens(cost, ins[0].mask))
+
+
+@register_layer("kl_gaussian")
+class KLGaussianCost(_CostBase):
+    """KL(q(z|x) || N(0, I)) for a diagonal gaussian given (mu, logvar):
+    -0.5 * sum(1 + logvar - mu^2 - exp(logvar)). The VAE regularizer."""
+
+    def apply(self, cfg, params, ins, ctx):
+        mu, logvar = ins[0].value, ins[1].value
+        kl = -0.5 * jnp.sum(1.0 + logvar - mu * mu - jnp.exp(logvar),
+                            axis=-1)
+        return Argument(value=_reduce_tokens(kl, ins[0].mask))

@@ -106,3 +106,24 @@ class HierarchicalSigmoidLayer(LayerImpl):
             step_cost = -jax.nn.log_sigmoid(sign * score)
             cost = cost + jnp.where(active, step_cost, 0.0)
         return Argument(value=cost[:, None])
+
+
+@register_layer("sample_gaussian")
+class SampleGaussianLayer(LayerImpl):
+    """Reparameterized gaussian sample: inputs (mu, logvar) ->
+    mu + eps * exp(logvar/2) in training, mu at eval. The VAE
+    reparameterization trick (the reference's vae demo implements it in
+    the config; here it is a first-class layer so autodiff flows through
+    mu/logvar)."""
+
+    needs_rng = True
+
+    def infer(self, cfg, in_infos):
+        return in_infos[0]
+
+    def apply(self, cfg, params, ins, ctx):
+        mu, logvar = ins[0].value, ins[1].value
+        if not ctx.train:
+            return ins[0].with_value(mu)
+        eps = jax.random.normal(ctx.layer_rng(cfg.name), mu.shape, mu.dtype)
+        return ins[0].with_value(mu + eps * jnp.exp(0.5 * logvar))

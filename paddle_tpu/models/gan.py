@@ -1,0 +1,130 @@
+"""GAN training (`v1_api_demo/gan/gan_conf.py` + ``gan_trainer.py``).
+
+The reference trains three config-sharing networks alternately (generator,
+discriminator-on-real, generator+discriminator with frozen copies). The
+TPU-native spelling: two graphs sharing parameters BY NAME —
+
+- D-graph: x -> discriminator -> binary cost (trained on real=1 / fake=0)
+- G-graph: noise -> generator -> the SAME discriminator layers with
+  ``is_static`` params -> cost toward label 1
+
+``GANTrainer`` alternates jitted steps and copies the discriminator's
+fresh weights into the G-graph's static slots each round — the same
+parameter flow as the reference's copy-between-gradient-machines loop.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from paddle_tpu.config import dsl
+from paddle_tpu.config.model_config import ParamAttr
+
+
+def _generator(noise, *, hidden, data_dim):
+    h = dsl.fc(input=noise, size=hidden, act="relu", name="g_h")
+    return dsl.fc(input=h, size=data_dim, act="linear", name="g_out")
+
+
+def _discriminator(x, *, hidden, static=False):
+    def attr():
+        return ParamAttr(is_static=True) if static else None
+
+    h = dsl.fc(input=x, size=hidden, act="relu", name="d_h",
+               param_attr=attr(), bias_attr=attr() or True)
+    return dsl.fc(input=h, size=2, act="softmax", name="d_out",
+                  param_attr=attr(), bias_attr=attr() or True)
+
+
+def build_gan(*, noise_dim: int = 16, data_dim: int = 2, hidden: int = 64):
+    """Returns (d_cost, g_cost) LayerOutputs living in two graphs."""
+    dsl.reset()
+    xin = dsl.data(name="x", size=data_dim)
+    lab = dsl.data(name="label", size=2)
+    d_cost = dsl.classification_cost(
+        input=_discriminator(xin, hidden=hidden), label=lab, name="d_cost")
+    d_graph = dsl.current_graph()
+
+    dsl.reset()
+    noise = dsl.data(name="noise", size=noise_dim)
+    lab_g = dsl.data(name="label", size=2)
+    fake = _generator(noise, hidden=hidden, data_dim=data_dim)
+    g_cost = dsl.classification_cost(
+        input=_discriminator(fake, hidden=hidden, static=True),
+        label=lab_g, name="g_cost")
+    g_graph = dsl.current_graph()
+    return d_cost, g_cost, d_graph, g_graph
+
+
+class GANTrainer:
+    """Alternating GAN training driver (``gan_trainer.py``)."""
+
+    def __init__(self, *, noise_dim: int = 16, data_dim: int = 2,
+                 hidden: int = 64, lr: float = 1e-3, seed: int = 0):
+        import jax
+        from paddle_tpu.optim import Adam
+        from paddle_tpu.trainer.trainer import SGD
+        self.noise_dim = noise_dim
+        d_cost, g_cost, _, _ = build_gan(
+            noise_dim=noise_dim, data_dim=data_dim, hidden=hidden)
+        self.d = SGD(cost=d_cost, update_equation=Adam(learning_rate=lr),
+                     seed=seed)
+        self.g = SGD(cost=g_cost, update_equation=Adam(learning_rate=lr),
+                     seed=seed + 1)
+        # start from one consistent discriminator
+        self._push_d_into_g()
+        self._rng = jax.random.PRNGKey(seed + 2)
+        net = self.g.network
+        self._gen_fwd = jax.jit(
+            lambda p, f: net.apply(p, f, train=False)["g_out"].value)
+
+    def _push_d_into_g(self):
+        for name, v in self.d.params.items():
+            if name.startswith("_d_") and name in self.g.params:
+                # copy: the D trainer's step donates its param buffers, so
+                # sharing the array object would hand G a deleted buffer
+                self.g.params[name] = v.copy()
+
+    def _pull_g(self):
+        return {n: v for n, v in self.g.params.items()
+                if n.startswith("_g_")}
+
+    def generate(self, n: int):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.core.argument import Argument
+        self._rng, k = jax.random.split(self._rng)
+        noise = jax.random.normal(k, (n, self.noise_dim), jnp.float32)
+        feed = {"noise": Argument(value=noise),
+                "label": Argument(value=jnp.ones((n,), jnp.int32))}
+        return self._gen_fwd(self.g.params, feed), feed
+
+    def train_round(self, real_batch) -> Dict[str, float]:
+        """One alternation: D on real(1)+fake(0), then G toward 1."""
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.core.argument import Argument
+        n = real_batch.shape[0]
+        fake, g_feed = self.generate(n)
+
+        def d_step(x, label):
+            feed = {"x": Argument(value=x),
+                    "label": Argument(value=label)}
+            self._rng, k = jax.random.split(self._rng)
+            self.d.params, self.d.opt_state, m = self.d._train_step(
+                self.d.params, self.d.opt_state, feed, k, 0, None)
+            return float(m["cost"])
+
+        d_real = d_step(jnp.asarray(real_batch, jnp.float32),
+                        jnp.ones((n,), jnp.int32))
+        d_fake = d_step(jax.lax.stop_gradient(fake),
+                        jnp.zeros((n,), jnp.int32))
+        self._push_d_into_g()
+
+        self._rng, k = jax.random.split(self._rng)
+        self.g.params, self.g.opt_state, m = self.g._train_step(
+            self.g.params, self.g.opt_state, g_feed, k, 0, None)
+        return {"d_real": d_real, "d_fake": d_fake,
+                "g": float(m["cost"])}

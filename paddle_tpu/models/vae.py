@@ -1,0 +1,43 @@
+"""Variational autoencoder (`v1_api_demo/vae/vae_conf.py`).
+
+Encoder fc stack -> (mu, logvar) -> reparameterized sample (the
+``sample_gaussian`` layer) -> decoder fc stack -> sigmoid reconstruction.
+Training objective = reconstruction cross-entropy + KL(q || N(0,I)),
+expressed as TWO cost layers trained on their sum (the multi-cost path)."""
+
+from __future__ import annotations
+
+from paddle_tpu.config import dsl
+from paddle_tpu.config.model_config import Input, LayerDef
+
+
+def _raw_layer(name, type_, inputs, **attrs):
+    ld = LayerDef(name=name, type=type_,
+                  inputs=[Input(i.name) for i in inputs], bias=False,
+                  attrs=attrs)
+    return dsl._add(ld)
+
+
+def vae(*, data_dim: int = 784, hidden: int = 256, latent: int = 32):
+    """Returns (costs, reconstruction, data_names). Train with
+    ``SGD(cost=Topology(costs))`` — the trainer sums both costs."""
+    x = dsl.data(name="x", size=data_dim)
+    h = dsl.fc(input=x, size=hidden, act="relu", name="enc_h")
+    mu = dsl.fc(input=h, size=latent, act="linear", name="enc_mu")
+    logvar = dsl.fc(input=h, size=latent, act="linear", name="enc_logvar")
+    z = _raw_layer("z", "sample_gaussian", [mu, logvar])
+    dh = dsl.fc(input=z, size=hidden, act="relu", name="dec_h")
+    recon = dsl.fc(input=dh, size=data_dim, act="sigmoid", name="recon")
+    recon_cost = _raw_layer("recon_cost", "multi_binary_label_cross_entropy",
+                            [recon, x])
+    kl_cost = _raw_layer("kl_cost", "kl_gaussian", [mu, logvar])
+    return [recon_cost, kl_cost], recon, ["x"]
+
+
+def vae_decoder(*, data_dim: int = 784, hidden: int = 256,
+                latent: int = 32):
+    """Generation-mode graph: z -> reconstruction, sharing the decoder
+    parameters (_dec_h.*, _recon.*) with the trained model."""
+    z = dsl.data(name="z", size=latent)
+    dh = dsl.fc(input=z, size=hidden, act="relu", name="dec_h")
+    return dsl.fc(input=dh, size=data_dim, act="sigmoid", name="recon")

@@ -60,6 +60,11 @@ class Optimizer:
             if spec is not None and spec.is_static:
                 continue
             d = {s: jnp.zeros_like(p) for s in self.slot_names()}
+            if spec is not None and spec.sparsity_ratio:
+                # StaticPruningHook (ParameterUpdaterHook.cpp:39): mask the
+                # smallest-|w| fraction at init; update() keeps them zero
+                thresh = jnp.quantile(jnp.abs(p), spec.sparsity_ratio)
+                d["prune_mask"] = (jnp.abs(p) >= thresh).astype(p.dtype)
             if self._is_sparse(spec):
                 # per-row last-processed step for lazy (touched-rows-only)
                 # updates — the SparseRowMatrix/catchUpWith bookkeeping
@@ -105,20 +110,22 @@ class Optimizer:
                 # (FirstOrderOptimizer.h, clipping in SgdOptimizer variants)
                 th = self.gradient_clipping_threshold
                 g = jnp.clip(g, -th, th)
+            mask = state["slots"][name].get("prune_mask")
             if self._is_sparse(spec):
                 # touched-rows-only update with momentum/decay catch-up;
                 # l1/l2 handled inside (deferred per-row)
                 p_new, slots_new = self._apply_sparse(
                     p, g, state["slots"][name], lr_t * lr_mult, l1, l2, t)
-                new_params[name] = p_new
-                new_slots[name] = slots_new
-                continue
-            p_new, slots_new = self._apply_one(
-                p, g, state["slots"][name], lr_t * lr_mult, l2, t)
-            if l1 > 0:
-                shrink = l1 * lr_t * lr_mult
-                p_new = jnp.sign(p_new) * jnp.maximum(
-                    jnp.abs(p_new) - shrink, 0.0)
+            else:
+                p_new, slots_new = self._apply_one(
+                    p, g, state["slots"][name], lr_t * lr_mult, l2, t)
+                if l1 > 0:
+                    shrink = l1 * lr_t * lr_mult
+                    p_new = jnp.sign(p_new) * jnp.maximum(
+                        jnp.abs(p_new) - shrink, 0.0)
+            if mask is not None:
+                p_new = p_new * mask          # pruned weights stay zero
+                slots_new["prune_mask"] = mask
             new_params[name] = p_new
             new_slots[name] = slots_new
 
@@ -131,6 +138,17 @@ class Optimizer:
                 n: state["avg"][n] + (new_params[n] - state["avg"][n]) / w
                 for n in new_slots}
         return new_params, new_state
+
+    def prune_params(self, params, state):
+        """Zero the masked weights immediately — the reference's
+        StaticPruningHook::init dotMul's the mask into the value before
+        any step runs, so forwards/checkpoints before the first update
+        already see pruned weights."""
+        out = dict(params)
+        for name, slots in state["slots"].items():
+            if "prune_mask" in slots and name in out:
+                out[name] = out[name] * slots["prune_mask"]
+        return out
 
     def catch_up(self, params, state,
                  meta: Optional[Dict[str, ParamSpec]] = None,
@@ -161,6 +179,9 @@ class Optimizer:
                   else self.l1_rate)
             p2, s2 = self._sparse_catch_up_one(
                 params[name], slots, lr_t * lr_mult, l1, l2, state["t"])
+            if "prune_mask" in slots:
+                p2 = p2 * slots["prune_mask"]
+                s2["prune_mask"] = slots["prune_mask"]
             new_params[name] = p2
             new_slots[name] = s2
         return new_params, {**state, "slots": new_slots}

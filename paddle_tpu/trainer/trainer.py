@@ -78,7 +78,8 @@ class SGD:
                  mesh=None, shard_rules: Optional[Dict[str, Any]] = None,
                  seed: int = 0, is_local: bool = True,
                  evaluators: Optional[List[dict]] = None,
-                 prev_batch_state: bool = False):
+                 prev_batch_state: bool = False,
+                 compute_dtype: Optional[Any] = None):
         if update_equation is None:
             raise ValueError("update_equation (an Optimizer) is required")
         self.topology = (cost if isinstance(cost, Topology)
@@ -122,6 +123,9 @@ class SGD:
                 if mesh is not None else None)
             self.params = self.network.init_params(key, shardings=shardings)
         self.opt_state = self.optimizer.init(self.params, self.meta)
+        # StaticPruningHook: masked weights are zero from step 0
+        self.params = self.optimizer.prune_params(self.params,
+                                                  self.opt_state)
         if mesh is not None:
             # slots/avg follow their owning parameter; scalars replicate
             self.opt_state = mesh_lib.shard_opt_state(
@@ -139,18 +143,47 @@ class SGD:
             and not (ld.attrs.get("reversed") or ld.attrs.get("reverse"))
             and name in self.network.order] if prev_batch_state else []
         self._carried = None  # {layer: state}, threaded across batches
+        # mixed precision: master params/optimizer state stay float32,
+        # forward+backward run in compute_dtype (bfloat16 feeds the MXU at
+        # 2x the f32 rate; grads cast back to f32 before the update)
+        self.compute_dtype = (jnp.dtype(compute_dtype)
+                              if compute_dtype is not None else None)
         self._rng = jax.random.PRNGKey(seed + 1)
         self._train_step = self._build_train_step()
         self._eval_step = self._build_eval_step()
 
+    def _cast_compute(self, tree):
+        if self.compute_dtype is None:
+            return tree
+        dt = self.compute_dtype
+
+        def cast(x):
+            if hasattr(x, "dtype") and x.dtype == jnp.float32:
+                return x.astype(dt)
+            return x
+
+        return jax.tree_util.tree_map(cast, tree)
+
+    def _cast_f32(self, tree):
+        if self.compute_dtype is None:
+            return tree
+
+        def cast(x):
+            if hasattr(x, "dtype") and x.dtype == self.compute_dtype:
+                return x.astype(jnp.float32)
+            return x
+
+        return jax.tree_util.tree_map(cast, tree)
+
     # ------------------------------------------------------------ builders
     def _total_cost(self, outputs):
         """Sum of all cost layers' batch-mean — multi-task configs train
-        on the sum (the reference's Argument::sum over outArgs)."""
+        on the sum (the reference's Argument::sum over outArgs). Reduces
+        in f32 even under bf16 compute (batch sums need the mantissa)."""
         total = 0.0
         for n in getattr(self.topology, "cost_names",
                          [self.topology.cost_name]):
-            v = outputs[n].value
+            v = outputs[n].value.astype(jnp.float32)
             total = total + jnp.sum(v) / v.shape[0]
         return total
 
@@ -178,7 +211,8 @@ class SGD:
 
         def loss_fn(params, feed, rng, carried):
             outputs, updates = network.apply_with_state(
-                params, feed, train=True, rng=rng, carried=carried)
+                self._cast_compute(params), self._cast_compute(feed),
+                train=True, rng=rng, carried=carried)
             return self._total_cost(outputs), (outputs, updates)
 
         def step(params, opt_state, feed, rng, num_passes, carried=None):
@@ -187,6 +221,9 @@ class SGD:
                 carried = jax.lax.stop_gradient(carried)
             (_, (outputs, updates)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params, feed, rng, carried)
+            # grads are already f32 (cotangents take the f32 params' dtype);
+            # only the moving-stat updates computed in bf16 need casting
+            updates = self._cast_f32(updates)
             bsz = outputs[cost_name].value.shape[0]
             new_params, new_opt = optimizer.update(
                 grads, opt_state, params, meta, batch_size=bsz,
@@ -214,7 +251,8 @@ class SGD:
         network = self.network
 
         def step(params, feed):
-            outputs = network.apply(params, feed, train=False)
+            outputs = network.apply(self._cast_compute(params),
+                                    self._cast_compute(feed), train=False)
             return self._metrics(outputs, feed)
 
         return jax.jit(step)

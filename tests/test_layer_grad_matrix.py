@@ -463,6 +463,18 @@ def _case_ctc():
              "y": _seq_ids(t=3, classes=4)})
 
 
+def _case_sample_gaussian():
+    return ([("mu", 4, {}), ("lv", 4, {})],
+            L("out", "sample_gaussian", ["mu", "lv"]),
+            {"mu": _dense(d=4), "lv": _dense(d=4, seed=1)})
+
+
+def _case_kl_gaussian():
+    return ([("mu", 4, {}), ("lv", 4, {})],
+            L("out", "kl_gaussian", ["mu", "lv"]),
+            {"mu": _dense(d=4), "lv": _dense(d=4, seed=1)})
+
+
 def _case_nce():
     return ([("x", 6, {}), ("y", 8, {})],
             L("out", "nce", ["x", "y"], bias=True, num_classes=8,
@@ -559,7 +571,8 @@ GRAD_CASES = {
     "huber_classification": _case_huber, "rank-cost": _case_rank_cost,
     "lambda_cost": _case_lambda_cost, "sum_cost": _case_sum_cost,
     "crf": _case_crf, "ctc": _case_ctc, "nce": _case_nce,
-    "hsigmoid": _case_hsigmoid,
+    "hsigmoid": _case_hsigmoid, "sample_gaussian": _case_sample_gaussian,
+    "kl_gaussian": _case_kl_gaussian,
 }
 
 FWD_CASES = {

@@ -174,3 +174,38 @@ def test_model_averaging_apply():
     # averaged value lags the raw trained value (running mean of iterates)
     assert float(avg["w"][0]) > float(params["w"][0])
     assert float(avg["w"][0]) < 0.0  # moved in the gradient direction
+
+
+def test_static_pruning_hook_keeps_weights_zero():
+    """StaticPruningHook (ParameterUpdaterHook.cpp:39): the smallest-|w|
+    fraction is masked at init and stays exactly zero through updates."""
+    import numpy as np
+    import jax.numpy as jnp
+    from paddle_tpu.core.registry import ParamSpec
+    from paddle_tpu.optim.optimizers import Momentum
+
+    rng = np.random.RandomState(0)
+    p0 = rng.randn(16, 8).astype(np.float32)
+    meta = {"w": ParamSpec(shape=(16, 8), sparsity_ratio=0.5)}
+    opt = Momentum(learning_rate=0.1, momentum=0.9)
+    params = {"w": jnp.asarray(p0)}
+    state = opt.init(params, meta)
+    mask = np.asarray(state["slots"]["w"]["prune_mask"])
+    assert abs(mask.mean() - 0.5) < 0.1  # ~half pruned
+    for _ in range(5):
+        g = jnp.asarray(rng.randn(16, 8).astype(np.float32))
+        params, state = opt.update({"w": g}, state, params, meta,
+                                   batch_size=4)
+    w = np.asarray(params["w"])
+    assert np.all(w[mask == 0] == 0.0)      # pruned stay zero
+    assert np.any(w[mask == 1] != p0[mask == 1])  # others trained
+
+
+def test_pruning_hook_via_v1_config_attr():
+    """ParameterAttribute(update_hooks=HookAttribute('pruning', r)) flows
+    through the compat surface into the engine ParamSpec."""
+    from paddle_tpu.compat.trainer_config_helpers.attrs import (
+        HookAttribute, ParameterAttribute)
+    attr = ParameterAttribute(
+        update_hooks=HookAttribute("pruning", sparsity_ratio=0.7))
+    assert attr.to_param_attr().sparsity_ratio == 0.7
