@@ -166,6 +166,10 @@ class ParsedConfig:
             mod = __import__(source.module)
         finally:
             sys.path[:] = saved
+        # Python-2-era provider scripts (xrange at generator time)
+        for legacy, repl in (("xrange", range), ("unicode", str)):
+            if not hasattr(mod, legacy):
+                setattr(mod, legacy, repl)
         prov = getattr(mod, source.obj)
         kwargs = {}
         if source.args not in (None, "", {}):
@@ -180,7 +184,10 @@ class ParsedConfig:
         sample_reader = prov.as_reader(file_list, is_train=is_train,
                                        **kwargs)
         from paddle_tpu.data.reader import batch
-        return batch(sample_reader, self.batch_size()), prov
+        batched = batch(sample_reader, self.batch_size())
+        # init_hook-resolved types ride along for feeding construction
+        batched.input_types = getattr(sample_reader, "input_types", None)
+        return batched, prov
 
     def train_reader(self):
         reader, _ = self._reader_from(self.context.train_source,
@@ -197,8 +204,12 @@ class ParsedConfig:
         src = self.context.train_source or self.context.test_source
         if src is None or src.module is None:
             return None
-        _, prov = self._reader_from(src, is_train=True)
-        kinds = prov.input_types
+        reader, prov = self._reader_from(src, is_train=True)
+        # init_hook providers resolve their types at reader construction
+        kinds = (prov.input_types if prov.input_types is not None
+                 else getattr(reader, "input_types", None))
+        if kinds is None:
+            return None
         names = (self.context.input_layer_names
                  or self.model.input_layer_names)
         if isinstance(kinds, dict):

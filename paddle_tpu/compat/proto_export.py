@@ -246,6 +246,10 @@ def _export_parameter(pname: str, spec, proto_param):
         proto_param.decay_rate = float(spec.l2_rate)
     if spec.l1_rate is not None:
         proto_param.decay_rate_l1 = float(spec.l1_rate)
+    if getattr(spec, "sparsity_ratio", None):
+        hook = proto_param.update_hooks.add()
+        hook.type = "pruning"
+        hook.sparsity_ratio = float(spec.sparsity_ratio)
 
 
 def model_to_proto(model: ModelDef, context=None) -> "ModelConfig_pb2.ModelConfig":

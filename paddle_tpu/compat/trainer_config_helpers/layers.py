@@ -309,7 +309,8 @@ def conv_operator(img, filter, filter_size, num_filters, num_channels=None,
     spec = {"type": "convt_op" if trans else "conv_op",
             "filter_size": filter_size, "num_filters": num_filters,
             "num_channels": num_channels, "stride": stride,
-            "padding": padding}
+            "padding": padding, "filter_size_y": filter_size_y,
+            "stride_y": stride_y, "padding_y": padding_y}
     size = _conv_proj_out_size(img, num_channels, filter_size, stride,
                                padding, num_filters, trans,
                                filter_size_y, stride_y, padding_y)
@@ -323,7 +324,9 @@ def conv_projection(input, filter_size, num_filters, num_channels=None,
     spec = {"type": "convt" if trans else "conv",
             "filter_size": filter_size, "num_filters": num_filters,
             "num_channels": num_channels, "stride": stride,
-            "padding": padding, "groups": groups}
+            "padding": padding, "groups": groups,
+            "filter_size_y": filter_size_y, "stride_y": stride_y,
+            "padding_y": padding_y}
     size = _conv_proj_out_size(src, num_channels, filter_size, stride,
                                padding, num_filters, trans,
                                filter_size_y, stride_y, padding_y)

@@ -35,6 +35,15 @@ class Settings:
         for k, v in kwargs.items():
             setattr(self, k, v)
 
+    # legacy alias: 2017-era providers assign ``settings.slots``
+    @property
+    def slots(self):
+        return self.input_types
+
+    @slots.setter
+    def slots(self, value):
+        self.input_types = value
+
 
 class DataProvider:
     """Result of decorating a generator with ``@provider``."""
@@ -115,6 +124,9 @@ class DataProvider:
             rng.shuffle(pool)
             yield from pool
 
+        # init_hook-based providers only know their types after settings
+        # ran; expose them for feeding construction (ParsedConfig.feeding)
+        reader.input_types = settings.input_types
         return reader
 
     def feeding(self) -> Dict[str, T.InputType]:

@@ -115,14 +115,46 @@ def _project(proj: dict, x: jnp.ndarray, w) -> jnp.ndarray:
     raise KeyError(f"unknown projection type {kind!r}")
 
 
+def _conv_proj_geom(proj: dict, info):
+    """(c_in, in_h, in_w, out_h, out_w) for a conv projection over one
+    input (square side derived from flat size when needed; *_y params
+    default to their x twins)."""
+    from paddle_tpu.layers.conv import _conv_geom, derive_geom
+    c, in_h, in_w = derive_geom(info, proj.get("num_channels"))
+    fs = proj["filter_size"]
+    fsy = proj.get("filter_size_y") or fs
+    st = proj.get("stride", 1)
+    sty = proj.get("stride_y") or st
+    pad = proj.get("padding", 0)
+    pady = proj.get("padding_y")
+    pady = pad if pady is None else pady
+    if proj["type"] in ("convt", "convt_op"):
+        oh = (in_h - 1) * sty + fsy - 2 * pady
+        ow = (in_w - 1) * st + fs - 2 * pad
+    else:
+        oh = _conv_geom(in_h, fsy, pady, sty)
+        ow = _conv_geom(in_w, fs, pad, st)
+    return c, in_h, in_w, oh, ow
+
+
 @register_layer("mixed")
 class MixedLayer(LayerImpl):
     """Sum of per-input projections (``MixedLayer.cpp``). Each input's
     ``extra`` dict holds {"type": projection_type, ...}. Supported:
-    full_matrix, trans_full_matrix, identity, dot_mul, table, scaling —
-    the projection set in ``paddle/gserver/layers/*Projection.cpp``."""
+    full_matrix, trans_full_matrix, identity, dot_mul, table, scaling,
+    conv/convt — the projection set in
+    ``paddle/gserver/layers/*Projection.cpp`` + ``ConvProjection``."""
 
     def infer(self, cfg, in_infos):
+        projs = cfg.attrs.get("projections") or []
+        # a conv projection gives the mixed output image geometry
+        # (inception-style blocks pool/concat the result)
+        for proj, info in zip(projs, in_infos):
+            if proj and proj.get("type") in ("conv", "convt"):
+                nf = proj["num_filters"]
+                _, _, _, oh, ow = _conv_proj_geom(proj, info)
+                return ShapeInfo(size=nf * oh * ow, channels=nf,
+                                 height=oh, width=ow)
         return ShapeInfo(size=cfg.size,
                          is_sequence=any(i.is_sequence for i in in_infos))
 
@@ -133,7 +165,12 @@ class MixedLayer(LayerImpl):
         for i, info in enumerate(in_infos):
             specs.update(self._param_for(i, projs[i] or {}, info, cfg))
         if cfg.bias:
-            specs["wbias"] = ParamSpec(shape=(cfg.size,), init="zeros",
+            size = cfg.size
+            for proj, info in zip(projs, in_infos):
+                if proj and proj.get("type") in ("conv", "convt"):
+                    size = proj["num_filters"]  # shared conv bias per map
+                    break
+            specs["wbias"] = ParamSpec(shape=(size,), init="zeros",
                                        is_bias=True)
         return specs
 
@@ -151,15 +188,61 @@ class MixedLayer(LayerImpl):
                                        sparse_grad=True)}
         if kind == "scaling":
             return {f"w{i}": ParamSpec(shape=(1,))}
+        if kind in ("conv", "convt"):
+            c, *_ = _conv_proj_geom(proj, info)
+            groups = proj.get("groups", 1) or 1
+            fs = proj["filter_size"]
+            nf = proj["num_filters"]
+            if kind == "conv":
+                return {f"w{i}": ParamSpec(shape=(fs, fs, c // groups, nf))}
+            return {f"w{i}": ParamSpec(shape=(fs, fs, nf // groups, c))}
         return {}  # identity
 
     def apply(self, cfg, params, ins, ctx):
+        from jax import lax
+
+        from paddle_tpu.layers.conv import to_nhwc
         projs = cfg.attrs.get("projections") or [
             {"type": "full_matrix"} for _ in ins]
+        kinds = {p.get("type", "full_matrix") for p in projs if p}
+        if kinds & {"conv", "convt"} and kinds - {
+                "conv", "convt", "identity_op_arg"}:
+            # conv outputs are 4-D NHWC; flat projections are [B, size] —
+            # the sum is undefined (the reference never mixes them either)
+            raise NotImplementedError(
+                "a mixed layer cannot combine conv projections with flat "
+                "projections")
         out = None
         for i, (a, proj) in enumerate(zip(ins, projs)):
-            x = a.value if proj.get("type") == "table" else _flat(a)
-            y = _project(proj, x, params.get(f"w{i}"))
+            kind = proj.get("type", "full_matrix")
+            if kind in ("conv", "convt"):
+                info = ctx.in_infos[i]
+                c, in_h, in_w, oh, ow = _conv_proj_geom(proj, info)
+                st = proj.get("stride", 1)
+                pad = proj.get("padding", 0)
+                x = to_nhwc(a.value, c, in_h, in_w)
+                if kind == "conv":
+                    y = lax.conv_general_dilated(
+                        x, params[f"w{i}"], window_strides=(st, st),
+                        padding=((pad, pad), (pad, pad)),
+                        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                        feature_group_count=proj.get("groups", 1) or 1)
+                else:
+                    if (proj.get("groups", 1) or 1) != 1:
+                        raise NotImplementedError(
+                            "grouped transposed conv projection")
+                    fs = proj["filter_size"]
+                    # gradient-of-conv shape needs lax padding fs-1-p
+                    # (see ConvTransLayer.apply)
+                    y = lax.conv_transpose(
+                        x, params[f"w{i}"], strides=(st, st),
+                        padding=((fs - 1 - pad, fs - 1 - pad),
+                                 (fs - 1 - pad, fs - 1 - pad)),
+                        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                        transpose_kernel=True)
+            else:
+                x = a.value if kind == "table" else _flat(a)
+                y = _project(proj, x, params.get(f"w{i}"))
             out = y if out is None else out + y
         if "wbias" in params:
             out = out + params["wbias"]
@@ -193,11 +276,28 @@ class AddtoLayer(LayerImpl):
 @register_layer("concat")
 class ConcatLayer(LayerImpl):
     def infer(self, cfg, in_infos):
-        return ShapeInfo(size=sum(i.size for i in in_infos),
+        info = ShapeInfo(size=sum(i.size for i in in_infos),
                          is_sequence=any(i.is_sequence for i in in_infos))
+        # image inputs with matching spatial extents concat channel-wise
+        # (inception blocks); geometry survives so pooling can follow
+        if all(i.height is not None and i.channels is not None
+               for i in in_infos) and len(
+                {(i.height, i.width) for i in in_infos}) == 1:
+            info.channels = sum(i.channels for i in in_infos)
+            info.height = in_infos[0].height
+            info.width = in_infos[0].width
+        return info
 
     def apply(self, cfg, params, ins, ctx):
-        return Argument(value=jnp.concatenate([a.value for a in ins], axis=-1),
+        vals = []
+        for a, info in zip(ins, ctx.in_infos):
+            v = a.value
+            if ctx.out_info.channels is not None and v.ndim == 2:
+                # flat channel-major rows -> NHWC before channel concat
+                from paddle_tpu.layers.conv import to_nhwc
+                v = to_nhwc(v, info.channels, info.height, info.width)
+            vals.append(v)
+        return Argument(value=jnp.concatenate(vals, axis=-1),
                         mask=_first_mask(ins))
 
 

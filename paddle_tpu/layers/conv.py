@@ -146,15 +146,23 @@ class ConvTransLayer(LayerImpl):
             info = ctx.in_infos[i]
             fs, fsy, st, sty, pad, pady, groups, c = _conv_spec(
                 cfg.inputs[i].extra, info)
+            if groups != 1:
+                raise NotImplementedError(
+                    "grouped transposed conv is not supported "
+                    "(lax.conv_transpose has no feature_group_count)")
             c, in_h, in_w = derive_geom(info, c)
             x = to_nhwc(a.value, c, in_h, in_w)
             # kernel is stored gradient-of-conv style (nf -> c);
             # transpose_kernel flips spatial dims and swaps I/O so the
-            # transposed conv is exactly the forward conv's gradient
+            # transposed conv is exactly the forward conv's gradient.
+            # lax's explicit padding q yields (in-1)*s - fs + 2 + 2q, so
+            # the gradient-of-conv shape (in-1)*s + fs - 2p needs
+            # q = fs - 1 - p per side.
             y = lax.conv_transpose(
                 x, params[f"w{i}"],
                 strides=(sty, st),
-                padding=((pady, pady), (pad, pad)),
+                padding=((fsy - 1 - pady, fsy - 1 - pady),
+                         (fs - 1 - pad, fs - 1 - pad)),
                 dimension_numbers=("NHWC", "HWIO", "NHWC"),
                 transpose_kernel=True,
             )

@@ -15,9 +15,7 @@ parameter flow as the reference's copy-between-gradient-machines loop.
 
 from __future__ import annotations
 
-from typing import Dict, List
-
-import numpy as np
+from typing import Dict
 
 from paddle_tpu.config import dsl
 from paddle_tpu.config.model_config import ParamAttr
@@ -86,10 +84,6 @@ class GANTrainer:
                 # copy: the D trainer's step donates its param buffers, so
                 # sharing the array object would hand G a deleted buffer
                 self.g.params[name] = v.copy()
-
-    def _pull_g(self):
-        return {n: v for n, v in self.g.params.items()
-                if n.startswith("_g_")}
 
     def generate(self, n: int):
         import jax

@@ -1,0 +1,89 @@
+"""The reference's benchmark configs (`benchmark/paddle/image/*.py`) run
+unmodified: AlexNet, GoogLeNet (inception = conv projections inside mixed
+layers + channel-wise concat), SmallNet. The small one trains a full pass
+through the CLI with the reference's own random-data provider; the big two
+build and take a real train step."""
+
+import os
+import pathlib
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.compat import parse_config
+from paddle_tpu.core.argument import Argument
+
+REF = pathlib.Path("/root/reference")
+IMG_DIR = REF / "benchmark/paddle/image"
+
+needs_ref = pytest.mark.skipif(not REF.exists(), reason="needs reference")
+
+
+@needs_ref
+@pytest.mark.parametrize("name,layers", [("alexnet", 16),
+                                         ("googlenet", 85),
+                                         ("smallnet_mnist_cifar", 11)])
+def test_benchmark_config_parses(name, layers):
+    parsed = parse_config(str(IMG_DIR / f"{name}.py"), "batch_size=8")
+    assert len(parsed.model_proto().layers) == layers
+    assert parsed.cost_layers()
+
+
+def _one_step(config, config_args, feed):
+    from paddle_tpu.trainer.trainer import SGD, Topology
+    parsed = parse_config(config, config_args)
+    costs = parsed.cost_layers()
+    topo = Topology(costs, extra_outputs=[
+        n for n in parsed.context.output_layer_names if n not in costs],
+        graph=parsed.model)
+    tr = SGD(cost=topo, update_equation=parsed.optimizer())
+    tr.params, tr.opt_state, m = tr._train_step(
+        tr.params, tr.opt_state, feed, jax.random.PRNGKey(0), 0, None)
+    return float(m["cost"])
+
+
+@needs_ref
+def test_alexnet_one_train_step():
+    rng = np.random.RandomState(0)
+    feed = {"data": Argument(value=jnp.asarray(
+        rng.rand(2, 3 * 227 * 227).astype(np.float32))),
+        "label": Argument(value=jnp.asarray(
+            rng.randint(0, 1000, size=2).astype(np.int32)))}
+    cost = _one_step(str(IMG_DIR / "alexnet.py"), "batch_size=2", feed)
+    assert np.isfinite(cost) and cost > 0
+
+
+@needs_ref
+def test_googlenet_one_train_step():
+    rng = np.random.RandomState(0)
+    feed = {"input": Argument(value=jnp.asarray(
+        rng.rand(2, 3 * 224 * 224).astype(np.float32))),
+        "label": Argument(value=jnp.asarray(
+            rng.randint(0, 1000, size=2).astype(np.int32)))}
+    cost = _one_step(str(IMG_DIR / "googlenet.py"), "batch_size=2", feed)
+    assert np.isfinite(cost) and cost > 0
+
+
+@needs_ref
+def test_smallnet_full_pass_with_reference_provider(tmp_path, capsys):
+    """The whole reference benchmark job — config + its random-data
+    provider, both unmodified from /root/reference — trains a pass through
+    the CLI. train.list is the only local file (it lists data shards; the
+    provider fabricates samples)."""
+    (tmp_path / "data.txt").write_text("x\n")
+    (tmp_path / "train.list").write_text(str(tmp_path / "data.txt") + "\n")
+    cwd = os.getcwd()
+    os.chdir(tmp_path)  # the config names train.list relative to the job
+    try:
+        from paddle_tpu.trainer import cli
+        rc = cli.main([
+            "--config", str(IMG_DIR / "smallnet_mnist_cifar.py"),
+            "--config_args", "batch_size=256",
+            "--job", "train", "--num_passes", "1", "--log_period", "2"])
+    finally:
+        os.chdir(cwd)
+    assert rc == 0
+    assert "Pass 0" in capsys.readouterr().out
