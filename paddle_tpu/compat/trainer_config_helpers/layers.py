@@ -798,8 +798,10 @@ def img_pool_layer(input, pool_size, name=None, num_channels=None,
                    ceil_mode=True, exclude_mode=None):
     src = _one(input)
     pt = pool_type if pool_type is not None else MaxPooling()
-    pt_name = "max-projection" if isinstance(pt, MaxPooling) else \
-        "avg-projection"
+    # name-based: CudnnMaxPooling etc. are plain BasePoolingType, not
+    # MaxPooling subclasses
+    pt_name = ("max-projection" if "max" in getattr(pt, "name", "max")
+               else "avg-projection")
     extra = {"filter_size": pool_size, "stride": stride, "padding": padding,
              "pool_type": pt_name, "ceil_mode": ceil_mode}
     if pool_size_y:

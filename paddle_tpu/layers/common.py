@@ -112,6 +112,9 @@ def _project(proj: dict, x: jnp.ndarray, w) -> jnp.ndarray:
         return jnp.take(w, x.astype(jnp.int32), axis=0)
     if kind == "scaling":
         return x * w[0]
+    if kind == "slice":
+        return jnp.concatenate([x[..., s:e] for s, e in proj["slices"]],
+                               axis=-1)
     raise KeyError(f"unknown projection type {kind!r}")
 
 
@@ -192,10 +195,11 @@ class MixedLayer(LayerImpl):
             c, *_ = _conv_proj_geom(proj, info)
             groups = proj.get("groups", 1) or 1
             fs = proj["filter_size"]
+            fsy = proj.get("filter_size_y") or fs
             nf = proj["num_filters"]
             if kind == "conv":
-                return {f"w{i}": ParamSpec(shape=(fs, fs, c // groups, nf))}
-            return {f"w{i}": ParamSpec(shape=(fs, fs, nf // groups, c))}
+                return {f"w{i}": ParamSpec(shape=(fsy, fs, c // groups, nf))}
+            return {f"w{i}": ParamSpec(shape=(fsy, fs, nf // groups, c))}
         return {}  # identity
 
     def apply(self, cfg, params, ins, ctx):
@@ -205,38 +209,47 @@ class MixedLayer(LayerImpl):
         projs = cfg.attrs.get("projections") or [
             {"type": "full_matrix"} for _ in ins]
         kinds = {p.get("type", "full_matrix") for p in projs if p}
-        if kinds & {"conv", "convt"} and kinds - {
-                "conv", "convt", "identity_op_arg"}:
+        if kinds & {"conv", "convt"} and kinds - {"conv", "convt"}:
             # conv outputs are 4-D NHWC; flat projections are [B, size] —
             # the sum is undefined (the reference never mixes them either)
             raise NotImplementedError(
                 "a mixed layer cannot combine conv projections with flat "
                 "projections")
+        if cfg.attrs.get("operators"):
+            # conv/dotmul OPERATORS (MixedLayer.cpp's Operator path) are
+            # config/proto-representable but not executed by this engine
+            raise NotImplementedError(
+                "mixed-layer operators (conv_operator/dotmul_operator) "
+                "are not executable; use conv_projection / a conv layer")
         out = None
         for i, (a, proj) in enumerate(zip(ins, projs)):
             kind = proj.get("type", "full_matrix")
             if kind in ("conv", "convt"):
                 info = ctx.in_infos[i]
                 c, in_h, in_w, oh, ow = _conv_proj_geom(proj, info)
+                fs = proj["filter_size"]
+                fsy = proj.get("filter_size_y") or fs
                 st = proj.get("stride", 1)
+                sty = proj.get("stride_y") or st
                 pad = proj.get("padding", 0)
+                pady = proj.get("padding_y")
+                pady = pad if pady is None else pady
                 x = to_nhwc(a.value, c, in_h, in_w)
                 if kind == "conv":
                     y = lax.conv_general_dilated(
-                        x, params[f"w{i}"], window_strides=(st, st),
-                        padding=((pad, pad), (pad, pad)),
+                        x, params[f"w{i}"], window_strides=(sty, st),
+                        padding=((pady, pady), (pad, pad)),
                         dimension_numbers=("NHWC", "HWIO", "NHWC"),
                         feature_group_count=proj.get("groups", 1) or 1)
                 else:
                     if (proj.get("groups", 1) or 1) != 1:
                         raise NotImplementedError(
                             "grouped transposed conv projection")
-                    fs = proj["filter_size"]
                     # gradient-of-conv shape needs lax padding fs-1-p
                     # (see ConvTransLayer.apply)
                     y = lax.conv_transpose(
-                        x, params[f"w{i}"], strides=(st, st),
-                        padding=((fs - 1 - pad, fs - 1 - pad),
+                        x, params[f"w{i}"], strides=(sty, st),
+                        padding=((fsy - 1 - pady, fsy - 1 - pady),
                                  (fs - 1 - pad, fs - 1 - pad)),
                         dimension_numbers=("NHWC", "HWIO", "NHWC"),
                         transpose_kernel=True)

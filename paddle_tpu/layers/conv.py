@@ -58,13 +58,16 @@ def derive_geom(in_info: ShapeInfo, channels=None):
 
 
 def _conv_spec(inp_extra: dict, in_info: ShapeInfo):
+    # *_y keys may be present with value None (helpers pass them through);
+    # treat explicit None like absent
     fs = inp_extra["filter_size"]
-    fsy = inp_extra.get("filter_size_y", fs)
+    fsy = inp_extra.get("filter_size_y") or fs
     st = inp_extra.get("stride", 1)
-    sty = inp_extra.get("stride_y", st)
+    sty = inp_extra.get("stride_y") or st
     pad = inp_extra.get("padding", 0)
-    pady = inp_extra.get("padding_y", pad)
-    groups = inp_extra.get("groups", 1)
+    pady = inp_extra.get("padding_y")
+    pady = pad if pady is None else pady
+    groups = inp_extra.get("groups", 1) or 1
     c = inp_extra.get("channels") or in_info.channels
     return fs, fsy, st, sty, pad, pady, groups, c
 

@@ -157,7 +157,18 @@ class RecurrentLayerGroup(LayerImpl):
         carry, ys = lax.scan(body, carry0, scan_in, reverse=reverse)
         main = out_names[0]
         extras = {o: jnp.swapaxes(ys[o], 0, 1) for o in out_names[1:]}
-        return Argument(value=jnp.swapaxes(ys[main], 0, 1), mask=mask,
+        y_main = jnp.swapaxes(ys[main], 0, 1)
+        if sub_xs and net.shape_infos[main].is_sequence:
+            # the outer step returned a whole sequence per sub-sequence
+            # (the reference's nested out_link): concatenate sub-sequences
+            # back into one flat sequence, like the reference does when a
+            # nested group's output feeds flat-level consumers
+            Bq, Sq, Tq = y_main.shape[0], y_main.shape[1], y_main.shape[2]
+            flat = y_main.reshape(Bq, Sq * Tq, *y_main.shape[3:])
+            sm = jnp.swapaxes(next(iter(sub_masks.values())), 0, 1)
+            return Argument(value=flat, mask=sm.reshape(Bq, Sq * Tq),
+                            state={"group_outputs": extras, "final": carry})
+        return Argument(value=y_main, mask=mask,
                         state={"group_outputs": extras, "final": carry})
 
 

@@ -1,0 +1,120 @@
+"""Config-pair equivalence on the REFERENCE's own compare configs.
+
+`paddle/gserver/tests/test_NetworkCompare.cpp` runs pairs of configs that
+must produce identical outputs (projection spellings vs layer spellings);
+`test_RecurrentGradientMachine.cpp` asserts nested-sequence configs equal
+their flat twins. Same assertions here, on the same unmodified config
+files, with parameters copied between the nets by position (the
+reference's parameter-order copy)."""
+
+import pathlib
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.compat import parse_config
+from paddle_tpu.core.argument import Argument
+from paddle_tpu.core.network import Network
+
+TESTS = pathlib.Path("/root/reference/paddle/gserver/tests")
+needs_ref = pytest.mark.skipif(not TESTS.exists(), reason="needs reference")
+
+
+def _build(conf):
+    parsed = parse_config(str(TESTS / conf))
+    outs = list(parsed.context.output_layer_names)
+    net = Network(parsed.model, outputs=outs)
+    return net, outs
+
+
+def _map_params(src_net, src_params, dst_net, seed=0):
+    """Copy parameters by position: sorted name order pairs shapes, the
+    reference's copy-by-parameter-index."""
+    src_items = sorted(src_params.items())
+    dst_names = sorted(dst_net.param_specs)
+    assert len(src_items) == len(dst_names), (
+        [n for n, _ in src_items], dst_names)
+    out = {}
+    for (sname, v), dname in zip(src_items, dst_names):
+        assert tuple(dst_net.param_specs[dname].shape) == tuple(v.shape), (
+            sname, dname, v.shape, dst_net.param_specs[dname].shape)
+        out[dname] = v
+    return out
+
+
+PAIRS = [
+    ("concat_dotmul_a.conf", "concat_dotmul_b.conf", (4, 1000)),
+    ("concat_fullmatrix_a.conf", "concat_fullmatrix_b.conf", (4, 100)),
+    ("concat_slice_a.conf", "concat_slice_b.conf", (4, 8 * 16 * 16)),
+    ("img_conv_a.conf", "img_conv_b.conf", (2, 8 * 16 * 16)),
+    ("img_pool_a.conf", "img_pool_b.conf", (2, 8 * 16 * 16)),
+]
+
+
+@needs_ref
+@pytest.mark.parametrize("conf_a,conf_b,shape", PAIRS)
+def test_network_pair_outputs_equal(conf_a, conf_b, shape):
+    net_a, outs_a = _build(conf_a)
+    params_a = net_a.init_params(jax.random.PRNGKey(0))
+    net_b, outs_b = _build(conf_b)
+    params_b = _map_params(net_a, params_a, net_b)
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(*shape).astype(np.float32))
+    res_a = net_a.apply(params_a, {"input": Argument(value=x)})
+    res_b = net_b.apply(params_b, {"input": Argument(value=x)})
+    for oa, ob in zip(outs_a, outs_b):
+        va = np.asarray(res_a[oa].value).reshape(shape[0], -1)
+        vb = np.asarray(res_b[ob].value).reshape(shape[0], -1)
+        np.testing.assert_allclose(va, vb, rtol=1e-5, atol=1e-5,
+                                   err_msg=f"{conf_a} {oa} vs {ob}")
+
+
+@needs_ref
+def test_concat_table_pair_outputs_equal():
+    net_a, outs_a = _build("concat_table_a.conf")
+    params_a = net_a.init_params(jax.random.PRNGKey(0))
+    net_b, outs_b = _build("concat_table_b.conf")
+    params_b = _map_params(net_a, params_a, net_b)
+    ids = jnp.asarray(np.random.RandomState(0).randint(
+        0, 10000, size=(6,)).astype(np.int32))
+    res_a = net_a.apply(params_a, {"input": Argument(value=ids)})
+    res_b = net_b.apply(params_b, {"input": Argument(value=ids)})
+    np.testing.assert_allclose(np.asarray(res_a[outs_a[0]].value),
+                               np.asarray(res_b[outs_b[0]].value),
+                               rtol=1e-6)
+
+
+@needs_ref
+def test_reference_nested_rnn_equals_flat():
+    """`sequence_nest_rnn.conf` == `sequence_rnn.conf` on equivalent data —
+    the test_RecurrentGradientMachine property, on the reference's own
+    config files."""
+    flat_net, flat_outs = _build("sequence_rnn.conf")
+    params = flat_net.init_params(jax.random.PRNGKey(7))
+    nest_net, nest_outs = _build("sequence_nest_rnn.conf")
+    nest_params = _map_params(flat_net, params, nest_net)
+
+    rng = np.random.RandomState(0)
+    B, S, TS = 2, 2, 3
+    ids = rng.randint(0, 10, size=(B, S, TS)).astype(np.int32)
+    labels = rng.randint(0, 3, size=B).astype(np.int32)
+
+    flat_feed = {
+        "word": Argument(value=jnp.asarray(ids.reshape(B, S * TS)),
+                         mask=jnp.ones((B, S * TS), jnp.float32)),
+        "label": Argument(value=jnp.asarray(labels))}
+    nest_feed = {
+        "word": Argument(value=jnp.asarray(ids),
+                         mask=jnp.ones((B, S, TS), jnp.float32)),
+        "label": Argument(value=jnp.asarray(labels))}
+
+    res_flat = flat_net.apply(params, flat_feed)
+    res_nest = nest_net.apply(nest_params, nest_feed)
+    for of, on in zip(flat_outs, nest_outs):
+        np.testing.assert_allclose(np.asarray(res_flat[of].value),
+                                   np.asarray(res_nest[on].value),
+                                   rtol=1e-5, atol=1e-5)
