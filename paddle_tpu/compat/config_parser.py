@@ -62,6 +62,9 @@ class ConfigContext:
         self.input_layer_names: List[str] = []
         self.output_layer_names: List[str] = []
         self.evaluators: List[Dict[str, Any]] = []
+        # default_initial_std() etc. — global parameter defaults applied
+        # where a layer gives no explicit ParamAttr
+        self.param_defaults: Dict[str, Any] = {}
         self._counters: Dict[str, itertools.count] = {}
         self.config_dir: Optional[str] = None
 
@@ -69,8 +72,28 @@ class ConfigContext:
         c = self._counters.setdefault(prefix, itertools.count())
         return f"__{prefix}_{next(c)}__"
 
+    def default_param_attr(self, **overrides):
+        """ParamAttr built from default_initial_std()/.. defaults plus
+        per-site overrides (parameter name, per-param rates). Returns None
+        when nothing applies — the single source both the helper and raw
+        surfaces use."""
+        from paddle_tpu.config.model_config import ParamAttr
+        d = dict(self.param_defaults)
+        d.update({k: v for k, v in overrides.items() if v is not None})
+        if not d:
+            return None
+        init = "uniform" if d.get("initial_strategy") == 1 else "normal"
+        return ParamAttr(
+            name=d.get("name"), init=init,
+            initial_std=d.get("initial_std"),
+            initial_mean=d.get("initial_mean", 0.0),
+            learning_rate=d.get("learning_rate", 1.0),
+            l1_rate=d.get("l1_rate"), l2_rate=d.get("l2_rate"))
+
 
 _CTX: Optional[ConfigContext] = None
+# open raw-style recurrent groups (RecurrentLayerGroupBegin/End nesting)
+_RAW_GROUPS: List[Dict[str, Any]] = []
 
 
 def ctx() -> ConfigContext:
@@ -86,6 +109,10 @@ def begin_parse(config_args: Optional[Dict[str, Any]] = None
     """Reset all per-parse state and open a fresh context."""
     global _CTX
     dsl.reset()
+    # a previous parse that failed between RecurrentLayerGroupBegin/End
+    # must not leak its sub-graph into this one
+    dsl._GROUP_CTX = None
+    _RAW_GROUPS.clear()
     _CTX = ConfigContext(config_args)
     return _CTX
 
@@ -106,6 +133,269 @@ def default_device(device_id=-1):
     Device placement is meaningless under SPMD (the mesh owns placement),
     so this records nothing — accepted so configs run unmodified."""
     ctx().config_args.setdefault("_default_device", device_id)
+
+
+# ------------------------- old-style @config_func surface (pre-helpers) --
+def _default_setter(field):
+    def setter(value):
+        ctx().param_defaults[field] = value
+
+    setter.__name__ = f"default_{field}"
+    return setter
+
+
+default_initial_std = _default_setter("initial_std")
+default_initial_mean = _default_setter("initial_mean")
+default_decay_rate = _default_setter("l2_rate")
+default_initial_strategy = _default_setter("initial_strategy")
+
+
+def default_momentum(value):
+    """Per-parameter momentum defaults have no per-param slot here (the
+    optimizer's momentum is global); accepted with a loud note so training
+    semantics are not silently different."""
+    from paddle_tpu.utils.log import get_logger
+    get_logger("compat").warning(
+        "default_momentum(%s): per-parameter momentum is not supported; "
+        "the optimizer's global momentum applies", value)
+    ctx().param_defaults["momentum"] = value
+
+
+def model_type(name):
+    """'nn' | 'recurrent_nn' — recorded; the executor infers recurrence
+    from the graph itself."""
+    ctx().settings["model_type"] = name
+
+
+def SimpleData(**kw):
+    return {"type": "simple", **kw}
+
+
+def ProtoData(**kw):
+    return {"type": kw.pop("type", "proto"), **kw}
+
+
+def PyData(**kw):
+    return {"type": "py", **kw}
+
+
+def _data_from_spec(spec):
+    if isinstance(spec, dict):
+        return DataSource(file_list=spec.get("files"),
+                          module=spec.get("load_data_module"),
+                          obj=spec.get("load_data_object"),
+                          args=spec.get("load_data_args"))
+    return spec
+
+
+def TrainData(spec, async_load_data=None):
+    """Old spelling of the train data declaration (`config_parser.py
+    @config_func TrainData`). Proto/simple shards aren't readable here —
+    the source records for proto export; training needs a py provider."""
+    ctx().train_source = _data_from_spec(spec)
+
+
+def TestData(spec, async_load_data=None):
+    ctx().test_source = _data_from_spec(spec)
+
+
+def Settings(**kwargs):
+    """Old spelling: maps straight onto the settings dict."""
+    s = ctx().settings
+    for k, v in kwargs.items():
+        s[k] = v
+
+
+# ---- the raw primitive surface (Layer/Input/Projection/Memory/Group) ----
+# Old .conf files call config_parser's @config_layer handlers directly.
+# Specs are plain dicts; Layer() lowers them onto the native graph.
+def _lname(x):
+    return x.name if hasattr(x, "name") else str(x)
+
+
+def Input(input_layer_name, parameter_name=None, **kw):
+    return {"input": _lname(input_layer_name),
+            "parameter_name": parameter_name, **kw}
+
+
+_PARAM_KW = {"initial_std", "initial_mean", "learning_rate",
+             "decay_rate", "decay_rate_l1", "initial_strategy",
+             "sparse_update"}
+
+
+def _raw_proj(ptype, input_layer_name, parameter_name=None, **kw):
+    spec = {"input": _lname(input_layer_name),
+            "parameter_name": parameter_name,
+            "proj": {"type": ptype}}
+    for k, v in kw.items():
+        (spec if k in _PARAM_KW else spec["proj"])[k] = v
+    return spec
+
+
+def FullMatrixProjection(input_layer_name, parameter_name=None, **kw):
+    return _raw_proj("full_matrix", input_layer_name, parameter_name, **kw)
+
+
+def TransposedFullMatrixProjection(input_layer_name, parameter_name=None,
+                                   **kw):
+    return _raw_proj("trans_full_matrix", input_layer_name, parameter_name,
+                     **kw)
+
+
+def IdentityProjection(input_layer_name, **kw):
+    return _raw_proj("identity", input_layer_name, **kw)
+
+
+def TableProjection(input_layer_name, parameter_name=None, **kw):
+    return _raw_proj("table", input_layer_name, parameter_name, **kw)
+
+
+def DotMulProjection(input_layer_name, parameter_name=None, **kw):
+    return _raw_proj("dot_mul", input_layer_name, parameter_name, **kw)
+
+
+def Layer(name=None, type=None, size=None, active_type="", bias=True,
+          inputs=(), device=None, **kw):
+    """The reference's ``@config_layer`` dispatch: build one layer from a
+    raw spec. Covers the primitive spelling old .conf files use; helper
+    calls remain the main path."""
+    from paddle_tpu.config.model_config import Input as EInput
+    from paddle_tpu.config.model_config import LayerDef, ParamAttr
+    if type == "data":
+        return dsl.data(name=name, size=size, height=kw.get("height"),
+                        width=kw.get("width"), channels=kw.get("channels"))
+    if isinstance(inputs, (str, dict)) or hasattr(inputs, "name"):
+        inputs = [inputs]
+    specs = []
+    for item in inputs:
+        if isinstance(item, dict):
+            specs.append(item)
+        else:
+            specs.append({"input": _lname(item), "parameter_name": None})
+
+    def pattr(spec):
+        return ctx().default_param_attr(
+            name=spec.get("parameter_name"),
+            initial_std=spec.get("initial_std"),
+            learning_rate=spec.get("learning_rate"),
+            l1_rate=spec.get("decay_rate_l1"),
+            l2_rate=spec.get("decay_rate"))
+
+    bias_attr = bias
+    if isinstance(bias, dict):  # Bias(parameter_name=...)
+        bias_attr = ParamAttr(name=bias.get("parameter_name"))
+
+    attrs = dict(kw)
+    eins = []
+    if type == "mixed":
+        projs = []
+        for spec in specs:
+            proj = dict(spec.get("proj") or {"type": "full_matrix"})
+            if proj["type"] == "table":
+                src = dsl.current_graph().layers.get(spec["input"])
+                proj["vocab_size"] = src.size if src is not None else size
+            projs.append(proj)
+            eins.append(EInput(spec["input"], param_attr=pattr(spec)))
+        attrs["projections"] = projs
+    else:
+        eins = [EInput(s["input"], param_attr=pattr(s)) for s in specs]
+    ldef = LayerDef(name=name, type=type, inputs=eins, size=size,
+                    act=active_type or "linear", bias=bias_attr,
+                    attrs=attrs)
+    return dsl._add(ldef)
+
+
+def Bias(parameter_name=None, **kw):
+    return {"parameter_name": parameter_name, **kw}
+
+
+def Memory(name=None, size=None, boot_layer=None, **kw):
+    bl = None
+    if boot_layer is not None:
+        bl = boot_layer if hasattr(boot_layer, "name") else \
+            dsl.LayerOutput(str(boot_layer), size)
+    return dsl.memory(name=name, size=size, boot_layer=bl)
+
+
+def RecurrentLayerGroupBegin(name, in_links, out_links, seq_reversed=False,
+                             **kw):
+    """Imperative spelling of recurrent_group (RecurrentLayerGroupBegin /
+    End in config_parser): switch graph building into a step sub-network
+    whose boundary data layers take the in_links' outer names."""
+    from paddle_tpu.config.model_config import LayerDef, ModelDef
+    outer = dsl._GRAPH
+    sub = ModelDef()
+    prev_ctx = dsl._GROUP_CTX
+    dsl._GRAPH = sub
+    dsl._GROUP_CTX = {"name": name, "memories": []}
+    ins_meta, outer_in_names = [], []
+    for link in in_links:
+        lname = _lname(link)
+        outer_src = outer.layers[lname]
+        dsl._add(LayerDef(name=lname, type="data", size=outer_src.size,
+                          bias=False))
+        ins_meta.append({"boundary": lname, "kind": "seq"})
+        outer_in_names.append(lname)
+    _RAW_GROUPS.append({
+        "name": name, "outer": outer, "sub": sub, "prev_ctx": prev_ctx,
+        "ins_meta": ins_meta, "outer_in_names": outer_in_names,
+        "out_links": [_lname(o) for o in out_links],
+        "reverse": bool(seq_reversed)})
+
+
+def RecurrentLayerGroupEnd(name):
+    from paddle_tpu.config.model_config import Input as EInput
+    from paddle_tpu.config.model_config import LayerDef
+    if not _RAW_GROUPS:
+        raise ValueError(f"RecurrentLayerGroupEnd({name!r}) without Begin")
+    g = _RAW_GROUPS.pop()
+    if g["name"] != name:
+        raise ValueError(f"group end mismatch: {name!r} vs {g['name']!r}")
+    memories = dsl._GROUP_CTX["memories"]
+    dsl._GRAPH = g["outer"]
+    dsl._GROUP_CTX = g["prev_ctx"]
+    ins_meta, outer_in_names = g["ins_meta"], g["outer_in_names"]
+    for mem in memories:
+        bl = mem.pop("boot_layer")
+        if bl is not None:
+            ins_meta.append({"boundary": mem["boundary"], "kind": "boot"})
+            outer_in_names.append(bl.name)
+    ldef = LayerDef(
+        name=name, type="recurrent_layer_group",
+        inputs=[EInput(n) for n in outer_in_names], bias=False,
+        attrs={"sub_model": g["sub"], "ins": ins_meta,
+               "memories": memories, "outputs": g["out_links"],
+               "reverse": g["reverse"]})
+    main = dsl._add(ldef)
+    # the outer graph refers to out_links by their sub-net names
+    for out in g["out_links"]:
+        if out not in dsl.current_graph().layers:
+            dsl._add(LayerDef(name=out, type="agent",
+                              inputs=[EInput(main.name)], bias=False))
+    return main
+
+
+def Evaluator(name=None, type=None, inputs=(), **kw):
+    if isinstance(inputs, str) or hasattr(inputs, "name"):
+        inputs = [inputs]
+    names = [_lname(i) for i in inputs]
+    cfg = {"name": name or ctx().auto_name(f"{type}_evaluator"),
+           "type": type, "input_layers": names,
+           "_roles": {"n_outputs": 1, "has_label": len(names) > 1,
+                      "has_weight": False}}
+    cfg.update({k: v for k, v in kw.items() if v is not None})
+    ctx().evaluators.append(cfg)
+    return cfg
+
+
+def Inputs(*names):
+    ctx().input_layer_names = [str(n) for n in names]
+
+
+def Outputs(*names):
+    c = ctx()
+    c.output_layer_names = [str(n) for n in names]
+    dsl.current_graph().output_layer_names = list(c.output_layer_names)
 
 
 def inputs(*layers):
@@ -249,6 +539,11 @@ def parse_config(config_file: str, config_arg_str: str = "") -> ParsedConfig:
         "xrange": range,
         "unicode": str,
     }
+    # the reference execs configs inside config_parser's own module
+    # namespace, so its @config_func surface is available WITHOUT imports
+    # (old .conf files rely on this)
+    for fname in __all__:
+        ns.setdefault(fname, globals()[fname])
     saved_path = list(sys.path)
     sys.path.insert(0, c.config_dir)
     try:
@@ -288,7 +583,14 @@ def _coerce(v: str):
 # re-exported names configs sometimes pull from paddle.trainer.config_parser
 __all__ = [
     "parse_config", "parse_config_and_serialize", "get_config_arg",
-    "default_device",
+    "default_device", "default_initial_std", "default_initial_mean",
+    "default_decay_rate", "default_momentum", "default_initial_strategy",
+    "model_type", "TrainData", "TestData", "SimpleData", "ProtoData",
+    "PyData", "Settings", "Inputs", "Outputs", "Layer", "Input", "Bias",
+    "Memory", "Evaluator", "FullMatrixProjection",
+    "TransposedFullMatrixProjection", "IdentityProjection",
+    "TableProjection", "DotMulProjection", "RecurrentLayerGroupBegin",
+    "RecurrentLayerGroupEnd",
     "inputs", "outputs", "begin_parse", "ctx", "ConfigContext",
     "ParsedConfig", "DataSource",
 ]

@@ -12,9 +12,18 @@ explicitly.
 """
 
 from paddle_tpu.compat import config_parser as _config_parser
-from paddle_tpu.compat.config_parser import (default_device,  # noqa: F401
+from paddle_tpu.compat.config_parser import (Inputs, Outputs,  # noqa: F401
+                                             ProtoData, PyData, Settings,
+                                             SimpleData, TestData,
+                                             TrainData, default_decay_rate,
+                                             default_device,
+                                             default_initial_mean,
+                                             default_initial_std,
+                                             default_initial_strategy,
+                                             default_momentum,
                                              get_config_arg, inputs,
-                                             outputs, parse_config)
+                                             model_type, outputs,
+                                             parse_config)
 from paddle_tpu.compat.trainer_config_helpers import (activations,  # noqa: F401
                                                       attrs, data_sources,
                                                       evaluators, layers,
@@ -34,4 +43,8 @@ __all__ = (activations.__all__ + attrs.__all__ + data_sources.__all__
            + evaluators.__all__ + layers.__all__ + networks.__all__
            + optimizers.__all__ + poolings.__all__
            + ["get_config_arg", "inputs", "outputs", "parse_config",
-              "layer_math", "default_device"])
+              "layer_math", "default_device", "default_initial_std",
+              "default_initial_mean", "default_decay_rate",
+              "default_momentum", "default_initial_strategy", "model_type",
+              "TrainData", "TestData", "SimpleData", "ProtoData", "PyData",
+              "Settings", "Inputs", "Outputs"])

@@ -24,8 +24,13 @@ from paddle_tpu.compat.trainer_config_helpers.attrs import (
 from paddle_tpu.compat.trainer_config_helpers.poolings import (
     AvgPooling, BasePoolingType, MaxPooling)
 from paddle_tpu.config import dsl
-from paddle_tpu.config.dsl import (GeneratedInput, LayerOutput,  # noqa: F401
-                                   StaticInput)
+from paddle_tpu.config.dsl import GeneratedInput, LayerOutput  # noqa: F401
+
+
+def StaticInput(input, is_seq=False, size=None):
+    """Reference StaticInput accepts (input, is_seq, size); size is
+    informational (the layer carries it)."""
+    return dsl.StaticInput(input)
 from paddle_tpu.config.model_config import Input, LayerDef, ParamAttr
 
 __all__ = [
@@ -132,7 +137,10 @@ def _act(act, default: type = TanhActivation) -> str:
 
 def _pattr(attr) -> Optional[ParamAttr]:
     if attr is None:
-        return None
+        # default_initial_std() etc. set parse-wide defaults that apply
+        # wherever a layer gives no explicit attribute (single source:
+        # ConfigContext.default_param_attr)
+        return ctx().default_param_attr()
     if isinstance(attr, ParameterAttribute):
         return attr.to_param_attr()
     if isinstance(attr, ParamAttr):
@@ -762,9 +770,15 @@ def kmax_sequence_score_layer(input, name=None, beam_size=1):
 
 def beam_search(step, input, bos_id, eos_id, beam_size,
                 max_length=500, name=None, num_results_per_sample=None):
-    return dsl.beam_search(step, input, bos_id=bos_id, eos_id=eos_id,
-                           beam_size=beam_size, max_length=max_length,
-                           name=name)
+    out = dsl.beam_search(step, input, bos_id=bos_id, eos_id=eos_id,
+                          beam_size=beam_size, max_length=max_length,
+                          name=name)
+    # the reference names the prediction output "__beam_search_predict__"
+    # regardless of the group name; configs reference it in Outputs()
+    graph = dsl.current_graph()
+    if "__beam_search_predict__" not in graph.layers:
+        _layer("__beam_search_predict__", "agent", [Input(out.name)])
+    return out
 
 
 # ---------------------------------------------------------------- vision

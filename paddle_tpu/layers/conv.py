@@ -40,21 +40,24 @@ def _conv_geom(in_sz: int, filt: int, pad: int, stride: int) -> int:
 
 
 def derive_geom(in_info: ShapeInfo, channels=None):
-    """(channels, height, width) of an input, deriving a square image from
-    the flat size when the producing layer carried no geometry — the
-    reference's config_parser does the same sqrt(size/channels) inference
-    when a conv consumes a plain data layer."""
+    """(channels, height, width) of an input, deriving image geometry from
+    the flat size when the producing layer carried none — the reference's
+    config_parser inference (`config_parser.py:1159-1166`):
+    width = isqrt(pixels), height = pixels // width, exact-factor
+    asserted."""
     c = channels or in_info.channels
     if in_info.height is not None:
         return c or in_info.channels, in_info.height, in_info.width
     c = c or 1
     import math
-    side = math.isqrt(in_info.size // c)
-    if side * side * c != in_info.size:
+    pixels = in_info.size // c
+    w = math.isqrt(pixels)
+    h = pixels // max(w, 1)
+    if h * w * c != in_info.size:
         raise ValueError(
-            f"cannot infer square image geometry from size {in_info.size} "
-            f"with {c} channels; set height/width on the data layer")
-    return c, side, side
+            f"cannot infer image geometry from size {in_info.size} with "
+            f"{c} channels; set height/width on the data layer")
+    return c, h, w
 
 
 def _conv_spec(inp_extra: dict, in_info: ShapeInfo):

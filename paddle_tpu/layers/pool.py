@@ -9,6 +9,7 @@ ceil) for pooling.
 
 from __future__ import annotations
 
+import dataclasses
 import math
 
 import jax.numpy as jnp
@@ -39,6 +40,14 @@ def _spec(extra, info):
 class PoolLayer(LayerImpl):
     def infer(self, cfg, in_infos):
         fs, fsy, st, sty, pad, pady, c = _spec(cfg.inputs[0].extra, in_infos[0])
+        if in_infos[0].height is None:
+            # flat input (e.g. pooling an fc output): derive square geometry
+            # like the reference's config_parser does
+            from paddle_tpu.layers.conv import derive_geom
+            c, in_h, in_w = derive_geom(in_infos[0], c)
+            in_infos = [dataclasses.replace(in_infos[0], channels=c,
+                                            height=in_h, width=in_w)]
+            cfg.inputs[0].extra.setdefault("channels", c)
         h = _pool_geom(in_infos[0].height, fsy, pady, sty)
         w = _pool_geom(in_infos[0].width, fs, pad, st)
         return ShapeInfo(size=c * h * w, channels=c, height=h, width=w)
@@ -46,6 +55,12 @@ class PoolLayer(LayerImpl):
     def apply(self, cfg, params, ins, ctx):
         info = ctx.in_infos[0]
         fs, fsy, st, sty, pad, pady, c = _spec(cfg.inputs[0].extra, info)
+        if info.height is None:
+            # flat producer: same derivation infer() used
+            from paddle_tpu.layers.conv import derive_geom
+            c, in_h, in_w = derive_geom(info, c)
+            info = dataclasses.replace(info, channels=c, height=in_h,
+                                       width=in_w)
         ptype = cfg.inputs[0].extra.get("pool_type", "max-projection")
         x = to_nhwc(ins[0].value, c, info.height, info.width)
         oh, ow = ctx.out_info.height, ctx.out_info.width

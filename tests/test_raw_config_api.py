@@ -1,0 +1,161 @@
+"""The raw (pre-helpers) config_parser surface: ``Layer()``, projections,
+``Memory``/``RecurrentLayerGroupBegin/End``, ``TrainData``, ``Settings``,
+``Inputs``/``Outputs``, ``default_initial_std`` — what the reference's own
+trainer test configs (`paddle/trainer/tests/*.conf`) are written in.
+Every one of those configs must parse unmodified."""
+
+import pathlib
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.compat import parse_config
+from paddle_tpu.core.argument import Argument
+from paddle_tpu.core.network import Network
+
+TESTS = pathlib.Path("/root/reference/paddle/trainer/tests")
+needs_ref = pytest.mark.skipif(not TESTS.exists(), reason="needs reference")
+
+ALL_CONFS = [
+    "chunking.conf", "sample_trainer_config.conf",
+    "sample_trainer_config_compare_sparse.conf",
+    "sample_trainer_config_hsigmoid.conf",
+    "sample_trainer_config_opt_a.conf", "sample_trainer_config_opt_b.conf",
+    "sample_trainer_config_parallel.conf",
+    "sample_trainer_config_qb_rnn.conf", "sample_trainer_config_rnn.conf",
+    "sample_trainer_nest_rnn_gen.conf", "sample_trainer_rnn_gen.conf",
+    "test_config.conf",
+]
+
+
+@needs_ref
+@pytest.mark.parametrize("conf", ALL_CONFS)
+def test_trainer_test_config_parses(conf):
+    parsed = parse_config(str(TESTS / conf))
+    assert parsed.model.layers
+    assert parsed.model_proto().layers
+
+
+@needs_ref
+def test_chunking_crf_forward_runs():
+    """chunking.conf (raw Layer/Input/Evaluator spelling) builds a CRF net
+    that runs forward+decoding and exposes the sum evaluator."""
+    parsed = parse_config(str(TESTS / "chunking.conf"))
+    assert parsed.cost_layers() == ["crf"]
+    assert parsed.context.evaluators[0]["type"] == "sum"
+    outs = ["crf", "crf_decoding"]
+    net = Network(parsed.model, outputs=outs)
+    assert "crfw" in net.param_specs  # shared transition by explicit name
+    assert "feature_weights" in net.param_specs
+    params = net.init_params(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    B, T = 2, 5
+    feed = {
+        "features": Argument(
+            value=jnp.asarray(rng.rand(B, T, 4339).astype(np.float32)),
+            mask=jnp.ones((B, T), jnp.float32)),
+        "chunk": Argument(
+            value=jnp.asarray(rng.randint(0, 23, size=(B, T)), jnp.int32),
+            mask=jnp.ones((B, T), jnp.float32)),
+    }
+    res = net.apply(params, feed)
+    assert np.asarray(res["crf"].value).shape == (B, 1)
+    assert np.isfinite(np.asarray(res["crf"].value)).all()
+
+
+@needs_ref
+def test_raw_recurrent_group_runs():
+    """sample_trainer_config_rnn.conf's hand-rolled recurrent groups
+    (RecurrentLayerGroupBegin/Memory/Layer/End) execute under lax.scan."""
+    parsed = parse_config(str(TESTS / "sample_trainer_config_rnn.conf"))
+    graph = parsed.model
+    groups = [n for n, ld in graph.layers.items()
+              if ld.type == "recurrent_layer_group"]
+    assert groups, "expected raw recurrent groups"
+    # run the first group's consumer chain: find a seqlastins over it
+    g = groups[0]
+    net = Network(graph, outputs=[g])
+    params = net.init_params(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    B, T = 2, 4
+    mask = jnp.ones((B, T), jnp.float32)
+
+    def is_table_fed(name):
+        for ld in graph.layers.values():
+            projs = ld.attrs.get("projections") or []
+            for idx, inp in enumerate(ld.inputs):
+                if inp.layer_name == name and idx < len(projs) and \
+                        (projs[idx] or {}).get("type") == "table":
+                    return True
+        return False
+
+    feed = {}
+    for n in net.order:
+        if graph.layers[n].type != "data":
+            continue
+        size = net.shape_infos[n].size
+        if is_table_fed(n):
+            feed[n] = Argument(value=jnp.asarray(
+                rng.randint(0, size, size=(B, T)).astype(np.int32)),
+                mask=mask)
+        else:
+            feed[n] = Argument(value=jnp.asarray(
+                rng.rand(B, T, size).astype(np.float32)), mask=mask)
+    out = net.apply(params, feed)[g]
+    assert np.asarray(out.value).shape[:2] == (B, T)
+
+
+@needs_ref
+def test_rnn_gen_config_generates_with_beam():
+    """sample_trainer_rnn_gen.conf — the generation-golden config from
+    test_recurrent_machine_generation.cpp — parses and its beam group
+    generates deterministic sequences."""
+    from paddle_tpu.core.generation import SequenceGenerator
+    parsed = parse_config(str(TESTS / "sample_trainer_rnn_gen.conf"),
+                          "beam_search=1")
+    graph = parsed.model
+    assert "__beam_search_predict__" in graph.layers
+    gen_name = [n for n, ld in graph.layers.items()
+                if ld.type == "beam_search_group"][0]
+    net = Network(graph, outputs=["dummy_data_input"])
+    rng = np.random.RandomState(5)
+    params = {}
+    from paddle_tpu.core.registry import get_layer_impl
+    impl = get_layer_impl("beam_search_group")
+    for suffix, spec in impl.params(graph.layers[gen_name], []).items():
+        params[spec.absolute_name] = jnp.asarray(
+            rng.randn(*spec.shape).astype(np.float32))
+    params.setdefault("wordvec", jnp.asarray(
+        rng.randn(5, 5).astype(np.float32)))
+    outer = {"dummy_data_input": Argument(
+        value=jnp.asarray(rng.rand(3, 2).astype(np.float32)))}
+    sg = SequenceGenerator(graph, gen_name)
+    tokens, scores, lengths = sg.generate(params, outer)
+    t1, _, _ = sg.generate(params, outer)
+    assert np.array_equal(np.asarray(tokens), np.asarray(t1))
+    assert np.asarray(tokens).shape[0] == 3
+
+
+@needs_ref
+def test_test_config_pool_over_flat_executes():
+    """test_config.conf pools an fc output (no declared geometry): the
+    rectangular-factorization inference (config_parser.py:1159-1166) must
+    hold at execution too, not just shape inference."""
+    parsed = parse_config(str(TESTS / "test_config.conf"))
+    graph = parsed.model
+    pools = [n for n, ld in graph.layers.items() if ld.type == "pool"]
+    assert pools
+    net = Network(graph, outputs=pools)
+    params = net.init_params(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    feed = {}
+    for n in net.order:
+        if graph.layers[n].type == "data":
+            feed[n] = Argument(value=jnp.asarray(
+                rng.rand(3, net.shape_infos[n].size).astype(np.float32)))
+    res = net.apply(params, feed, rng=jax.random.PRNGKey(1))
+    for p in pools:
+        assert np.isfinite(np.asarray(res[p].value)).all()
