@@ -239,6 +239,7 @@ def main():
         env = dict(os.environ, BENCH_CHILD="1")
         # cheap probe first: when the tunnel is wedged even backend init
         # hangs, so don't spend a full bench timeout discovering that
+        probe_ok, probe_msg = False, ""
         try:
             probe = subprocess.run(
                 [sys.executable, "-c",
@@ -246,10 +247,14 @@ def main():
                 capture_output=True, text=True, timeout=150,
                 cwd=os.path.dirname(os.path.abspath(__file__)))
             probe_ok = probe.returncode == 0
+            if not probe_ok:
+                probe_msg = ("backend probe failed rc="
+                             f"{probe.returncode}: "
+                             + (probe.stderr or "")[-300:])
         except subprocess.TimeoutExpired:
-            probe_ok = False
+            probe_msg = "backend probe hung (tunnel wedged?)"
         if not probe_ok:
-            last_tail = "backend probe hung (tunnel wedged?)"
+            last_tail = probe_msg
         else:
             try:
                 proc = subprocess.run(

@@ -83,12 +83,18 @@ class ConfigContext:
         if not d:
             return None
         init = "uniform" if d.get("initial_strategy") == 1 else "normal"
-        return ParamAttr(
+        attr = ParamAttr(
             name=d.get("name"), init=init,
             initial_std=d.get("initial_std"),
             initial_mean=d.get("initial_mean", 0.0),
             learning_rate=d.get("learning_rate", 1.0),
+            sparse_grad=bool(d.get("sparse_update", False)),
             l1_rate=d.get("l1_rate"), l2_rate=d.get("l2_rate"))
+        # purely-default attrs must not clobber const-initialized specs
+        # (e.g. batch-norm gamma = const 1.0)
+        attr.from_defaults = not any(
+            k in overrides and overrides[k] is not None for k in overrides)
+        return attr
 
 
 _CTX: Optional[ConfigContext] = None
@@ -110,8 +116,8 @@ def begin_parse(config_args: Optional[Dict[str, Any]] = None
     global _CTX
     dsl.reset()
     # a previous parse that failed between RecurrentLayerGroupBegin/End
-    # must not leak its sub-graph into this one
-    dsl._GROUP_CTX = None
+    # must not leak raw-group bookkeeping into this one (dsl.reset clears
+    # the dsl-side group context)
     _RAW_GROUPS.clear()
     _CTX = ConfigContext(config_args)
     return _CTX
@@ -158,7 +164,6 @@ def default_momentum(value):
     get_logger("compat").warning(
         "default_momentum(%s): per-parameter momentum is not supported; "
         "the optimizer's global momentum applies", value)
-    ctx().param_defaults["momentum"] = value
 
 
 def model_type(name):
@@ -277,13 +282,20 @@ def Layer(name=None, type=None, size=None, active_type="", bias=True,
         return ctx().default_param_attr(
             name=spec.get("parameter_name"),
             initial_std=spec.get("initial_std"),
+            initial_mean=spec.get("initial_mean"),
+            initial_strategy=spec.get("initial_strategy"),
+            sparse_update=spec.get("sparse_update"),
             learning_rate=spec.get("learning_rate"),
             l1_rate=spec.get("decay_rate_l1"),
             l2_rate=spec.get("decay_rate"))
 
     bias_attr = bias
-    if isinstance(bias, dict):  # Bias(parameter_name=...)
-        bias_attr = ParamAttr(name=bias.get("parameter_name"))
+    if isinstance(bias, dict):  # Bias(parameter_name=..., initial_std=...)
+        bias_attr = ctx().default_param_attr(
+            name=bias.get("parameter_name"),
+            initial_std=bias.get("initial_std"),
+            initial_mean=bias.get("initial_mean"),
+            learning_rate=bias.get("learning_rate")) or True
 
     attrs = dict(kw)
     eins = []
@@ -388,14 +400,9 @@ def Evaluator(name=None, type=None, inputs=(), **kw):
     return cfg
 
 
-def Inputs(*names):
-    ctx().input_layer_names = [str(n) for n in names]
-
-
-def Outputs(*names):
-    c = ctx()
-    c.output_layer_names = [str(n) for n in names]
-    dsl.current_graph().output_layer_names = list(c.output_layer_names)
+# capitalized old spellings accept plain strings, which inputs()/outputs()
+# already handle
+Inputs = None  # assigned below, after inputs() is defined
 
 
 def inputs(*layers):
@@ -411,6 +418,10 @@ def outputs(*layers):
     c.output_layer_names = names
     graph = dsl.current_graph()
     graph.output_layer_names = names
+
+
+Inputs = inputs
+Outputs = outputs
 
 
 # cost layer types whose output drives the training objective (subset of

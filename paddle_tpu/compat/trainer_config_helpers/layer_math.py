@@ -13,8 +13,8 @@ import numbers
 
 from paddle_tpu.compat.trainer_config_helpers import activations as act
 from paddle_tpu.compat.trainer_config_helpers.layers import (
-    LayerOutput, _name, identity_projection, mixed_layer, repeat_layer,
-    scaling_layer, slope_intercept_layer)
+    LayerOutput, MixedLayerType, _name, identity_projection, mixed_layer,
+    repeat_layer, scaling_layer, slope_intercept_layer)
 
 __all__ = []
 
@@ -41,6 +41,8 @@ _register_unary("reciprocal", act.ReciprocalActivation())
 
 
 def _add(layeroutput, other):
+    if isinstance(other, MixedLayerType):
+        other = other._finalize()
     if isinstance(other, numbers.Number):
         return slope_intercept_layer(input=layeroutput, intercept=other)
     if not isinstance(other, LayerOutput):
@@ -61,6 +63,8 @@ def _add(layeroutput, other):
 
 
 def _sub(layeroutput, other):
+    if isinstance(other, MixedLayerType):
+        other = other._finalize()
     if isinstance(other, numbers.Number):
         return slope_intercept_layer(input=layeroutput, intercept=-other)
     if not isinstance(other, LayerOutput):
@@ -74,6 +78,8 @@ def _rsub(layeroutput, other):
 
 
 def _mul(layeroutput, other):
+    if isinstance(other, MixedLayerType):
+        other = other._finalize()
     if isinstance(other, numbers.Number):
         return slope_intercept_layer(input=layeroutput, slope=other)
     if not isinstance(other, LayerOutput):

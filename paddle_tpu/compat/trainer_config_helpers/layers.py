@@ -28,9 +28,11 @@ from paddle_tpu.config.dsl import GeneratedInput, LayerOutput  # noqa: F401
 
 
 def StaticInput(input, is_seq=False, size=None):
-    """Reference StaticInput accepts (input, is_seq, size); size is
-    informational (the layer carries it)."""
-    return dsl.StaticInput(input)
+    """Reference StaticInput accepts (input, is_seq, size). The native
+    group always passes the WHOLE Argument — including its sequence
+    structure/mask — to every step, so is_seq is honored implicitly;
+    size is informational (the layer carries it)."""
+    return dsl.StaticInput(_one(input))
 from paddle_tpu.config.model_config import Input, LayerDef, ParamAttr
 
 __all__ = [

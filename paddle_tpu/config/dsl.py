@@ -27,10 +27,13 @@ _COUNTERS: Dict[str, itertools.count] = {}
 def reset():
     """Start a fresh graph (the reference resets config_parser globals per
     parse_config call)."""
-    global _GRAPH, _COUNTERS
+    global _GRAPH, _COUNTERS, _GROUP_CTX
     _GRAPH = ModelDef()
     _COUNTERS = {}
     _SHAPES.clear()
+    # a build that raised inside a recurrent_group step must not leave the
+    # group context armed for the next build
+    _GROUP_CTX = None
 
 
 def current_graph() -> ModelDef:

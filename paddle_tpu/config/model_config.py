@@ -33,6 +33,10 @@ class ParamAttr:
     # StaticPruningHook (ParameterUpdaterHook.cpp:39): fraction of weights
     # masked to zero (smallest |w| at init) and kept zero by the optimizer
     sparsity_ratio: Optional[float] = None
+    # True when this attr was synthesized from parse-wide defaults
+    # (default_initial_std()...) rather than written at the layer: such
+    # attrs must not clobber const-initialized specs (batch-norm gamma)
+    from_defaults: bool = False
 
 
 @dataclasses.dataclass

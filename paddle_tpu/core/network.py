@@ -58,6 +58,10 @@ def _resolve_param_name(layer: LayerDef, suffix: str, spec: ParamSpec,
 def _apply_attr(spec: ParamSpec, attr: Optional[ParamAttr]) -> ParamSpec:
     if attr is None:
         return spec
+    if getattr(attr, "from_defaults", False) and spec.init in ("const",
+                                                               "zeros"):
+        # parse-wide defaults don't override deliberate constant inits
+        return spec
     return dataclasses.replace(
         spec,
         init=attr.init if attr.init != "normal" or attr.initial_std is not None

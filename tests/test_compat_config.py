@@ -162,6 +162,24 @@ def test_rnn_crf_reference_config_parses():
 
 
 @needs_ref
+@pytest.mark.parametrize("path,min_layers", [
+    ("v1_api_demo/gan/gan_conf.py", 5),
+    ("v1_api_demo/gan/gan_conf_image.py", 8),
+    ("v1_api_demo/vae/vae_conf.py", 20),
+    ("v1_api_demo/traffic_prediction/trainer_config.py", 90),
+    ("v1_api_demo/model_zoo/resnet/resnet.py", 120),
+    ("v1_api_demo/sequence_tagging/linear_crf.py", 7),
+])
+def test_v1_demo_config_parses(path, min_layers):
+    """The remaining v1_api_demo configs — GAN (incl. conv-transpose image
+    GAN), VAE (layer_math arithmetic), traffic prediction, the model-zoo
+    ResNet, linear-CRF tagging — parse unmodified."""
+    parsed = parse_config(str(REF / path))
+    assert len(parsed.model.layers) >= min_layers
+    assert parsed.model_proto().layers
+
+
+@needs_ref
 def test_parse_config_and_serialize_reference_schema_roundtrip(tmp_path):
     """Serialized TrainerConfig bytes parse under the *reference's* compiled
     schema — the C++ consumer contract."""

@@ -89,6 +89,35 @@ def test_concat_table_pair_outputs_equal():
 
 
 @needs_ref
+def test_reference_nested_rnn_multi_input_equals_flat():
+    """The multi-input variant: two SubsequenceInputs (ids + embeddings),
+    with an embedding layer inside the inner step."""
+    flat_net, flat_outs = _build("sequence_rnn_multi_input.conf")
+    params = flat_net.init_params(jax.random.PRNGKey(9))
+    nest_net, nest_outs = _build("sequence_nest_rnn_multi_input.conf")
+    nest_params = _map_params(flat_net, params, nest_net)
+
+    rng = np.random.RandomState(1)
+    B, S, TS = 2, 2, 3
+    ids = rng.randint(0, 10, size=(B, S, TS)).astype(np.int32)
+    labels = rng.randint(0, 3, size=B).astype(np.int32)
+    flat_feed = {
+        "word": Argument(value=jnp.asarray(ids.reshape(B, S * TS)),
+                         mask=jnp.ones((B, S * TS), jnp.float32)),
+        "label": Argument(value=jnp.asarray(labels))}
+    nest_feed = {
+        "word": Argument(value=jnp.asarray(ids),
+                         mask=jnp.ones((B, S, TS), jnp.float32)),
+        "label": Argument(value=jnp.asarray(labels))}
+    res_flat = flat_net.apply(params, flat_feed)
+    res_nest = nest_net.apply(nest_params, nest_feed)
+    for of, on in zip(flat_outs, nest_outs):
+        np.testing.assert_allclose(np.asarray(res_flat[of].value),
+                                   np.asarray(res_nest[on].value),
+                                   rtol=1e-5, atol=1e-5)
+
+
+@needs_ref
 def test_reference_nested_rnn_equals_flat():
     """`sequence_nest_rnn.conf` == `sequence_rnn.conf` on equivalent data —
     the test_RecurrentGradientMachine property, on the reference's own
