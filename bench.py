@@ -115,9 +115,11 @@ def bench_lstm():
     return _timed_chain(run_steps, fetch, ITERS, max(ITERS // 10, 1)) * 1e3
 
 
-def bench_resnet50():
+def bench_resnet50(compute_dtype=None):
     """ResNet-50 train step: imgs/sec/chip and MFU (flops from XLA cost
-    analysis / wall time / device peak)."""
+    analysis / wall time / device peak). ``compute_dtype="bfloat16"`` runs
+    mixed precision: f32 master params, bf16 forward/backward feeding the
+    MXU at twice the f32 rate."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -130,7 +132,8 @@ def bench_resnet50():
     dsl.reset()
     cost, out, _ = resnet(depth=50, classes=1000, image_size=224)
     trainer = SGD(cost=cost,
-                  update_equation=Momentum(learning_rate=0.1, momentum=0.9))
+                  update_equation=Momentum(learning_rate=0.1, momentum=0.9),
+                  compute_dtype=compute_dtype)
 
     rng = np.random.RandomState(0)
     feed = {
@@ -168,12 +171,13 @@ def bench_resnet50():
     kind = jax.devices()[0].device_kind
     peak = PEAK_FLOPS.get(kind, DEFAULT_PEAK)
     mfu = (flops_per_step / sec_per_step / peak) if flops_per_step else None
+    tag = "resnet50_bf16" if compute_dtype else "resnet50"
     return {
-        "resnet50_imgs_per_sec_per_chip": round(RESNET_BATCH / sec_per_step, 1),
-        "resnet50_step_ms": round(sec_per_step * 1000.0, 2),
-        "resnet50_batch": RESNET_BATCH,
-        "resnet50_mfu": round(mfu, 4) if mfu is not None else None,
-        "resnet50_flops_per_step": flops_per_step or None,
+        f"{tag}_imgs_per_sec_per_chip": round(RESNET_BATCH / sec_per_step, 1),
+        f"{tag}_step_ms": round(sec_per_step * 1000.0, 2),
+        f"{tag}_batch": RESNET_BATCH,
+        f"{tag}_mfu": round(mfu, 4) if mfu is not None else None,
+        f"{tag}_flops_per_step": flops_per_step or None,
         "device_kind": kind,
     }
 
@@ -209,13 +213,14 @@ def child_main():
     # parseable line, and the extras watchdog exits 0)
     print(json.dumps(result), flush=True)
     wd.cancel()
-    wd = _watchdog(420, 0)
-    try:
-        result.update(bench_resnet50())
-    except Exception as e:  # noqa: BLE001
-        result["resnet50_error"] = repr(e)[:300]
-    wd.cancel()
-    print(json.dumps(result), flush=True)
+    for dtype, tag in ((None, "resnet50"), ("bfloat16", "resnet50_bf16")):
+        wd = _watchdog(420, 0)
+        try:
+            result.update(bench_resnet50(compute_dtype=dtype))
+        except Exception as e:  # noqa: BLE001
+            result[f"{tag}_error"] = repr(e)[:300]
+        wd.cancel()
+        print(json.dumps(result), flush=True)
     return 0
 
 

@@ -91,9 +91,10 @@ class ConfigContext:
             sparse_grad=bool(d.get("sparse_update", False)),
             l1_rate=d.get("l1_rate"), l2_rate=d.get("l2_rate"))
         # purely-default attrs must not clobber const-initialized specs
-        # (e.g. batch-norm gamma = const 1.0)
+        # (e.g. batch-norm gamma = const 1.0); a bare parameter_name does
+        # not make the init values explicit, so it doesn't count
         attr.from_defaults = not any(
-            k in overrides and overrides[k] is not None for k in overrides)
+            v is not None for k, v in overrides.items() if k != "name")
         return attr
 
 

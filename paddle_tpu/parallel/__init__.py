@@ -1,3 +1,3 @@
 from paddle_tpu.parallel.mesh import (  # noqa: F401
-    create_mesh, param_shardings, replicate, shard_batch, shard_opt_state,
-    shard_params)
+    create_mesh, create_multislice_mesh, param_shardings, replicate,
+    shard_batch, shard_opt_state, shard_params)

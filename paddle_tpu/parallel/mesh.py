@@ -33,6 +33,7 @@ from paddle_tpu.core.argument import Argument
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+DCN_AXIS = "dcn"  # cross-slice (data-center network) leading axis
 
 
 def create_mesh(n_data: Optional[int] = None, n_model: int = 1,
@@ -46,10 +47,74 @@ def create_mesh(n_data: Optional[int] = None, n_model: int = 1,
     return Mesh(devs, (DATA_AXIS, MODEL_AXIS))
 
 
-def shard_batch(feed: Dict[str, Argument], mesh: Mesh) -> Dict[str, Argument]:
-    """Place a feed dict with the batch dim split over the data axis."""
+def create_multislice_mesh(n_slices: Optional[int] = None,
+                           n_data: Optional[int] = None, n_model: int = 1,
+                           devices=None) -> Mesh:
+    """Build a hierarchical (dcn, data, model) mesh for multi-slice jobs —
+    the TPU-native successor of the reference's multi-*node* story
+    (`ParameterServer2` sharded sync SGD over TCP/RDMA,
+    `ParameterServer2.cpp:362`; SURVEY §5.8).
 
-    n_data = mesh.shape[DATA_AXIS]
+    The batch is data-parallel over BOTH the leading ``dcn`` axis (slices,
+    connected by data-center network) and the ``data`` axis (chips within a
+    slice, connected by ICI); the gradient all-reduce XLA emits over such a
+    mesh is hierarchical — reduce-scatter/all-gather rides ICI within each
+    slice and only the per-slice partial crosses DCN. The ``model`` axis
+    (tensor/embedding sharding, all-to-all traffic) is laid out innermost so
+    its collectives never leave a slice.
+
+    On real multi-slice hardware, devices are grouped by their
+    ``slice_index`` attribute; elsewhere (virtual CPU meshes, single slice)
+    a contiguous reshape stands in, which preserves the axis semantics the
+    driver's dryrun validates.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    by_slice: Dict[int, list] = {}
+    for d in devices:
+        by_slice.setdefault(getattr(d, "slice_index", 0), []).append(d)
+    if n_slices is None:
+        n_slices = len(by_slice) if len(by_slice) > 1 else 1
+    if len(by_slice) > 1 and n_slices != len(by_slice):
+        # never silently mix physical slices inside a dcn group — the
+        # data/model axes would then carry "ICI" collectives across DCN
+        raise ValueError(
+            f"devices span {len(by_slice)} physical slices but "
+            f"n_slices={n_slices}; pass n_slices={len(by_slice)} (or a "
+            "device subset) so the dcn axis follows slice boundaries")
+    if len(by_slice) == n_slices and n_slices > 1:
+        per_slice = min(len(v) for v in by_slice.values())
+        grouped = [v[:per_slice] for _, v in sorted(by_slice.items())]
+    else:  # virtual: contiguous split into n_slices groups
+        per_slice = len(devices) // n_slices
+        grouped = [devices[i * per_slice:(i + 1) * per_slice]
+                   for i in range(n_slices)]
+    if n_data is None:
+        n_data = per_slice // n_model
+    devs = np.asarray([g[: n_data * n_model] for g in grouped]).reshape(
+        n_slices, n_data, n_model)
+    return Mesh(devs, (DCN_AXIS, DATA_AXIS, MODEL_AXIS))
+
+
+def batch_axes(mesh: Mesh):
+    """Mesh axes the batch dimension is split over (dcn is part of DP)."""
+    if DCN_AXIS in mesh.axis_names:
+        return (DCN_AXIS, DATA_AXIS)
+    return (DATA_AXIS,)
+
+
+def data_parallel_degree(mesh: Mesh) -> int:
+    d = 1
+    for ax in batch_axes(mesh):
+        d *= mesh.shape[ax]
+    return d
+
+
+def shard_batch(feed: Dict[str, Argument], mesh: Mesh) -> Dict[str, Argument]:
+    """Place a feed dict with the batch dim split over the data axis (and
+    the dcn axis on a multi-slice mesh)."""
+
+    n_data = data_parallel_degree(mesh)
+    axes = batch_axes(mesh)
 
     def place(x):
         if x.shape[0] % n_data != 0:
@@ -58,7 +123,7 @@ def shard_batch(feed: Dict[str, Argument], mesh: Mesh) -> Dict[str, Argument]:
                 f"degree {n_data}; pad or resize the batch (the reference "
                 "splits remainders unevenly across TrainerThreads — on a "
                 "SPMD mesh the split must be exact)")
-        spec = P(DATA_AXIS, *([None] * (x.ndim - 1)))
+        spec = P(axes, *([None] * (x.ndim - 1)))
         return jax.device_put(x, NamedSharding(mesh, spec))
 
     return jax.tree_util.tree_map(place, feed)

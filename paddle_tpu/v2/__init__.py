@@ -43,8 +43,13 @@ _init_flags = {}
 
 def init(**kwargs):
     """Process-level init (`paddle.init(use_gpu=..., trainer_count=...)`).
-    Flags are recorded (see ``init_flags()``); device selection is JAX's,
-    so ``use_gpu`` and ``trainer_count`` do not restrict the TPU mesh."""
+
+    Mirrors the reference's gflags bridge (`python/paddle/v2/__init__.py`
+    → `utils/Flags.cpp:18-80`): recorded flags become trainer defaults —
+    ``trainer_count>1`` selects an N-way data-parallel mesh over the
+    visible devices (the `MultiGradientMachine` fan-out), ``seed`` seeds
+    parameter init, ``log_period`` paces train logging. ``use_gpu`` is
+    accepted and ignored: device selection is JAX's (TPU when present)."""
     global _initialized
     _init_flags.update(kwargs)
     _initialized = True

@@ -22,11 +22,40 @@ class SGD(_SGD):
             import jax.numpy as jnp
             parameters = {k: jnp.asarray(v)
                           for k, v in parameters._params.items()}
+        # paddle.init(...) flags become trainer defaults, the way the
+        # reference's gflags reach Trainer::init (`utils/Flags.cpp:18-80`):
+        # trainer_count>1 selects a data-parallel mesh (the
+        # MultiGradientMachine thread fan-out, `MultiGradientMachine.h:44`),
+        # seed seeds parameter init, log_period paces train logging.
+        from paddle_tpu import v2 as _v2
+        flags = _v2.init_flags()
+        if "seed" in flags:
+            kwargs.setdefault("seed", int(flags["seed"]))
+        if kwargs.get("mesh") is None and int(
+                flags.get("trainer_count", 1) or 1) > 1:
+            import jax as _jax
+
+            from paddle_tpu.parallel import create_mesh
+            want = int(flags["trainer_count"])
+            have = len(_jax.devices())
+            n = min(want, have)
+            if n < want:
+                from paddle_tpu.utils.log import logger
+                logger.warning(
+                    "trainer_count=%d but only %d devices visible; "
+                    "using %d-way data parallelism", want, have, n)
+            if n > 1:
+                kwargs["mesh"] = create_mesh(
+                    n_data=n, devices=_jax.devices()[:n])
         super().__init__(cost, parameters=parameters,
                          update_equation=update_equation, **kwargs)
 
     def train(self, reader, *, num_passes: int = 1, event_handler=None,
               feeding=None, **kwargs):
+        from paddle_tpu import v2 as _v2
+        flags = _v2.init_flags()
+        if "log_period" in flags:
+            kwargs.setdefault("log_period", int(flags["log_period"]))
         feeder = feeding
         if isinstance(feeding, dict):
             if not all(isinstance(v, InputType) for v in feeding.values()):

@@ -1,0 +1,124 @@
+"""Multi-slice (DCN) mesh: hierarchical data parallelism over
+(dcn, data, model) — the TPU-native multi-node story standing in for the
+reference's C++ pserver sharded sync SGD (`ParameterServer2.cpp:362`) at
+cross-slice scale. Runs on the 8-device virtual CPU platform (conftest)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.config import dsl
+from paddle_tpu.core.argument import Argument
+from paddle_tpu.data import DataFeeder, integer_value, integer_value_sequence
+from paddle_tpu.models import lstm_text_classifier
+from paddle_tpu.optim import Adam
+from paddle_tpu.parallel import create_mesh, create_multislice_mesh
+from paddle_tpu.parallel import mesh as mesh_lib
+from paddle_tpu.trainer import SGD
+
+
+def _make_batch(rng, n, vocab=64):
+    return [(list(rng.randint(0, vocab, size=8)), int(rng.randint(0, 2)))
+            for _ in range(n)]
+
+
+def _feeder(vocab=64):
+    return DataFeeder({"words": integer_value_sequence(vocab),
+                       "label": integer_value(2)}, pad_multiple=8)
+
+
+def test_multislice_mesh_shape_and_axes():
+    mesh = create_multislice_mesh(n_slices=2, n_data=2, n_model=2)
+    assert mesh.axis_names == ("dcn", "data", "model")
+    assert mesh.shape == {"dcn": 2, "data": 2, "model": 2}
+    assert mesh_lib.data_parallel_degree(mesh) == 4
+    # single-axis meshes are untouched by the dcn logic
+    flat = create_mesh(n_data=4, n_model=2)
+    assert mesh_lib.data_parallel_degree(flat) == 4
+
+
+def test_batch_shards_over_dcn_and_data():
+    mesh = create_multislice_mesh(n_slices=2, n_data=2, n_model=2)
+    feed = {"x": Argument(value=jnp.ones((8, 4), jnp.float32))}
+    placed = mesh_lib.shard_batch(feed, mesh)
+    spec = placed["x"].value.sharding.spec
+    assert tuple(spec[0]) == ("dcn", "data")
+    assert all(s is None for s in spec[1:])
+    with pytest.raises(ValueError, match="not divisible"):
+        mesh_lib.shard_batch(
+            {"x": Argument(value=jnp.ones((6, 4), jnp.float32))}, mesh)
+
+
+def test_train_step_on_multislice_mesh_matches_single_device():
+    """One train step over the hierarchical mesh produces the same cost and
+    parameters as the unsharded run (sync SGD ≡ hierarchical all-reduce)."""
+    rng = np.random.RandomState(0)
+    data = _make_batch(rng, 8)
+    feeder = _feeder()
+
+    def run(mesh):
+        dsl.reset()
+        cost, _, _ = lstm_text_classifier(
+            vocab_size=64, embed_dim=8, hidden=8, num_layers=1, classes=2)
+        tr = SGD(cost=cost, update_equation=Adam(learning_rate=1e-2),
+                 mesh=mesh,
+                 shard_rules={"_embed.w0": P("model", None)}
+                 if mesh is not None else None)
+        tr.train(lambda: iter([data]), feeder=feeder, num_passes=1)
+        return {k: np.asarray(v) for k, v in tr.params.items()}
+
+    p_ms = run(create_multislice_mesh(n_slices=2, n_data=2, n_model=2))
+    p_1 = run(None)
+    for k in p_1:
+        np.testing.assert_allclose(p_ms[k], p_1[k], rtol=2e-4, atol=2e-5,
+                                   err_msg=k)
+
+
+def test_multislice_hlo_has_hierarchical_collectives():
+    """The compiled step all-reduces gradients across all 4 DP shards and
+    keeps the table model-sharded (XLA gathers it via masked dynamic-slice
+    + all-reduce on this mesh — sharding is never undone on the host)."""
+    mesh = create_multislice_mesh(n_slices=2, n_data=2, n_model=2)
+    dsl.reset()
+    cost, _, _ = lstm_text_classifier(
+        vocab_size=64, embed_dim=8, hidden=8, num_layers=1, classes=2)
+    tr = SGD(cost=cost, update_equation=Adam(learning_rate=1e-2), mesh=mesh,
+             shard_rules={"_embed.w0": P("model", None)})
+    rng = np.random.RandomState(0)
+    feed = mesh_lib.shard_batch(_feeder()(_make_batch(rng, 8)), mesh)
+    hlo = tr._train_step.lower(
+        tr.params, tr.opt_state, feed, jax.random.PRNGKey(0), 0,
+        None).compile().as_text()
+    assert "all-reduce" in hlo
+    # the model-sharded gather: either an explicit gather collective or the
+    # masked dynamic-slice + all-reduce strategy; the table itself must
+    # still be laid out sharded on the model axis
+    assert ("all-gather" in hlo or "all-to-all" in hlo
+            or "dynamic-slice" in hlo)
+    assert tr.params["_embed.w0"].sharding.spec == P("model", None)
+
+
+def test_real_slice_grouping_is_respected():
+    """Devices carrying distinct slice_index attrs group by slice, so the
+    dcn axis really is the cross-slice axis on multi-slice hardware."""
+
+    class FakeDev:
+        def __init__(self, i, s):
+            self.id, self.slice_index = i, s
+
+        def __repr__(self):
+            return f"d{self.id}s{self.slice_index}"
+
+    # interleaved enumeration, as a runtime may present it
+    devs = [FakeDev(i, s) for s in (0, 1) for i in range(4)]
+    mesh = create_multislice_mesh(n_slices=2, n_data=2, n_model=2,
+                                  devices=devs[::-1])  # scrambled order
+    got = np.vectorize(lambda d: d.slice_index)(np.asarray(mesh.devices))
+    # every entry of dcn-row k must live in the same slice
+    assert (got[0] == got[0, 0, 0]).all() and (got[1] == got[1, 0, 0]).all()
+    assert got[0, 0, 0] != got[1, 0, 0]
+    # mismatched n_slices must refuse to mix physical slices
+    with pytest.raises(ValueError, match="physical slices"):
+        create_multislice_mesh(n_slices=4, n_data=1, n_model=2, devices=devs)

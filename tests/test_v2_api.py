@@ -195,3 +195,27 @@ def test_imdb_word_idx_respected_and_in_range():
     small = {f"w{i}": i for i in range(50)}
     toks, _ = next(paddle.dataset.imdb.train(word_idx=small)())
     assert all(0 <= t < 50 for t in toks)
+
+
+def test_init_flags_reach_the_trainer():
+    """paddle.init flags become trainer defaults: trainer_count>1 builds a
+    data-parallel mesh (MultiGradientMachine fan-out), seed seeds init."""
+    try:
+        paddle.init(use_gpu=False, trainer_count=4, seed=7, log_period=5)
+        out, cost = _mlp()
+        tr = paddle.trainer.SGD(
+            cost=cost,
+            update_equation=paddle.optimizer.Momentum(learning_rate=0.1))
+        assert tr.mesh is not None
+        assert tr.mesh.shape["data"] == 4
+        # explicit args still beat the flag defaults
+        out2, cost2 = _mlp()
+        tr2 = paddle.trainer.SGD(
+            cost=cost2, seed=0,
+            update_equation=paddle.optimizer.Momentum(learning_rate=0.1))
+        assert any(
+            not np.array_equal(np.asarray(tr.params[n]),
+                               np.asarray(tr2.params[n]))
+            for n in tr.params)
+    finally:
+        paddle._init_flags.clear()

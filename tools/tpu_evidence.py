@@ -1,0 +1,231 @@
+"""Live-TPU kernel evidence: compiled Pallas vs reference, on-device checkgrad.
+
+Runs only when a real TPU backend is present. Produces `TPU_EVIDENCE.json`
+at the repo root with, per kernel (fused LSTM / fused GRU / flash
+attention):
+
+- forward + backward numerical parity between the *compiled* Pallas kernel
+  (``force_mode("pallas")``) and the pure-JAX reference implementation
+  (``force_mode("ref")``) — the reference's CPU-stub-vs-GPU-kernel
+  equivalence tests (`paddle/math/tests/test_matrixCompare.cpp`) at TPU
+  granularity;
+- steady-state per-call timing for both paths (compiled Pallas must not be
+  slower than the XLA reference to be worth shipping);
+- a numeric-vs-analytic directional-derivative check of the hand-written
+  VJPs executed **on the TPU** (`Trainer::checkGradient`,
+  `paddle/trainer/Trainer.cpp:299`, on device numerics).
+
+Usage: ``python tools/tpu_evidence.py`` (writes TPU_EVIDENCE.json, prints it).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+
+from paddle_tpu.ops import common  # noqa: E402
+from paddle_tpu.ops.attention import flash_attention  # noqa: E402
+from paddle_tpu.ops.gru import gru_sequence  # noqa: E402
+from paddle_tpu.ops.lstm import lstm_sequence  # noqa: E402
+
+
+def _timeit(fn, *args):
+    """Per-call seconds with the tunnel round-trip cancelled.
+
+    bench.py's chain trick: dispatch N dependent steps (the first input is
+    perturbed by the previous step's output so every dispatch is a fresh
+    computation the runtime cannot serve from cache), fetch ONE scalar to
+    close the window, and take the difference quotient of a long and a
+    short chain — the constant round-trip latency cancels."""
+    x0, rest = args[0], args[1:]
+
+    @jax.jit
+    def step(x):
+        out = fn(x, *rest)
+        out0 = out[0] if isinstance(out, tuple) else out
+        return x + jnp.sum(out0) * 1e-30
+
+    def chain(n):
+        x = x0
+        t0 = time.perf_counter()
+        for _ in range(n):
+            x = step(x)
+        float(jnp.sum(x) * 0 + x.reshape(-1)[0])  # one scalar fetch
+        return time.perf_counter() - t0
+
+    chain(2)  # compile + warm
+    long_n, short_n = 60, 6
+    t_long = min(chain(long_n) for _ in range(2))
+    t_short = min(chain(short_n) for _ in range(2))
+    return max(t_long - t_short, 1e-9) / (long_n - short_n)
+
+
+def _compare(name, make_fn, args, grad_argnums, report):
+    """Forward+grad parity (pallas vs ref) and timing for one kernel."""
+    entry = {}
+
+    def run(mode):
+        # jax's trace cache is keyed on the function object, so without a
+        # cache clear the second mode would silently reuse the first mode's
+        # lowering and the comparison would compare the kernel to itself
+        jax.clear_caches()
+        with common.force_mode(mode):
+            fwd = jax.jit(make_fn)
+            loss = jax.jit(lambda *a: jnp.sum(
+                (fwd(*a)[0] if isinstance(fwd(*a), tuple) else fwd(*a)) ** 2))
+            grads = jax.jit(jax.grad(loss, argnums=grad_argnums))
+            lowered = fwd.lower(*args).as_text()
+            out = fwd(*args)
+            out0 = out[0] if isinstance(out, tuple) else out
+            g = grads(*args)
+            # materialize before leaving the force_mode scope
+            out0, g = jax.device_get((out0, g))
+            t = _timeit(fwd, *args)
+            return out0, g, t, "tpu_custom_call" in lowered
+
+    out_p, g_p, t_p, cc_p = run("pallas")
+    out_r, g_r, t_r, cc_r = run("ref")
+    # the two modes must actually be different compiled programs
+    assert cc_p and not cc_r, (name, cc_p, cc_r)
+    fwd_err = float(np.max(np.abs(out_p - out_r)) /
+                    (np.max(np.abs(out_r)) + 1e-8))
+    grad_err = max(
+        float(np.max(np.abs(a - b)) / (np.max(np.abs(b)) + 1e-8))
+        for a, b in zip(g_p, g_r))
+    entry["fwd_rel_err_vs_ref"] = round(fwd_err, 8)
+    entry["grad_rel_err_vs_ref"] = round(grad_err, 8)
+    # below ~20us/call the difference quotient is tunnel jitter, not kernel
+    # time: report null rather than a fake number
+    valid = t_p > 2e-5 and t_r > 2e-5
+    entry["pallas_ms"] = round(t_p * 1e3, 3) if valid else None
+    entry["ref_xla_ms"] = round(t_r * 1e3, 3) if valid else None
+    entry["pallas_speedup_vs_ref"] = round(t_r / t_p, 3) if valid else None
+    entry["parity_ok"] = bool(fwd_err < 2e-2 and grad_err < 5e-2)
+    report[name] = entry
+    print(f"{name}: fwd_err={fwd_err:.2e} grad_err={grad_err:.2e} "
+          f"pallas={t_p * 1e3:.2f}ms ref={t_r * 1e3:.2f}ms", flush=True)
+
+
+def _checkgrad(name, make_loss, args, report, eps=1e-3):
+    """Directional numeric-vs-analytic derivative on the TPU, highest
+    matmul precision (the --job=checkgrad contract on device numerics)."""
+    with jax.default_matmul_precision("highest"):
+        loss = jax.jit(make_loss)
+        grads = jax.jit(jax.grad(make_loss, argnums=tuple(range(len(args)))))
+        g = grads(*args)
+        rng = np.random.RandomState(7)
+        dirs = [jnp.asarray(rng.randn(*np.shape(a)).astype(np.float32))
+                for a in args]
+        analytic = float(sum(jnp.vdot(gi, di) for gi, di in zip(g, dirs)))
+        plus = loss(*[a + eps * d for a, d in zip(args, dirs)])
+        minus = loss(*[a - eps * d for a, d in zip(args, dirs)])
+        numeric = float((plus - minus) / (2 * eps))
+    rel = abs(analytic - numeric) / (abs(numeric) + 1e-8)
+    ok = rel < 5e-2
+    report.setdefault("checkgrad", {})[name] = {
+        "analytic": analytic, "numeric": numeric,
+        "rel_err": round(rel, 8), "ok": bool(ok)}
+    print(f"checkgrad[{name}]: analytic={analytic:.6f} numeric={numeric:.6f} "
+          f"rel={rel:.2e}", flush=True)
+
+
+def main():
+    backend = jax.default_backend()
+    dev = jax.devices()[0]
+    report = {
+        "backend": backend,
+        "device_kind": dev.device_kind,
+        "note": "compiled Pallas kernels vs pure-JAX reference, on real TPU",
+    }
+    if backend != "tpu":
+        report["error"] = f"no TPU backend (got {backend}); evidence not run"
+        print(json.dumps(report))
+        return 1
+
+    rng = np.random.RandomState(0)
+
+    def arr(*shape, scale=0.2):
+        return jnp.asarray(rng.randn(*shape).astype(np.float32) * scale)
+
+    # ---- fused LSTM (bench shape: T=100, B=64, H=256)
+    # The gate bias is pre-folded into xs for BOTH paths: the Pallas entry
+    # folds it before the kernel while the scan reference adds it after the
+    # recurrent matmul, and that single add-reorder (1 ulp at t=0) amplifies
+    # chaotically through 100 recurrent steps (measured: 0.23 max abs by
+    # t=98, bitwise 0.0 when folded identically). Parity must compare the
+    # same rounding schedule, not the recurrence's Lyapunov exponent.
+    T, B, H = 100, 64, 256
+    mask = jnp.ones((T, B), jnp.float32)
+    xs = arr(T, B, 4 * H) + arr(4 * H)  # input with bias pre-folded
+    w, zbias = arr(H, 4 * H), jnp.zeros((4 * H,), jnp.float32)
+    zc = jnp.zeros((H,), jnp.float32)
+    h0 = c0 = jnp.zeros((B, H), jnp.float32)
+    _compare(
+        "lstm_sequence",
+        lambda xs_, w_: lstm_sequence(xs_, mask, w_, zbias, zc, zc, zc,
+                                      h0, c0),
+        (xs, w), (0, 1), report)
+
+    # ---- fused GRU
+    xg, wg, ws = arr(T, B, 3 * H), arr(H, 2 * H), arr(H, H)
+    bg = arr(3 * H)
+    _compare(
+        "gru_sequence",
+        lambda xs_, wg_, ws_: gru_sequence(xs_, mask, wg_, ws_, bg, h0),
+        (xg, wg, ws), (0, 1, 2), report)
+
+    # ---- flash attention (B=4, heads=8, T=1024, D=64, causal)
+    q, k, v = arr(4, 8, 1024, 64), arr(4, 8, 1024, 64), arr(4, 8, 1024, 64)
+    _compare(
+        "flash_attention",
+        partial(flash_attention, causal=True),
+        (q, k, v), (0, 1, 2), report)
+
+    # ---- on-device checkgrad of the custom VJPs (small TPU-tiled shapes)
+    t, b, h = 8, 8, 128
+    cx, cm = arr(t, b, 4 * h), jnp.ones((t, b), jnp.float32)
+    cw, cb = arr(h, 4 * h), arr(4 * h)
+    czc = jnp.zeros((h,), jnp.float32)
+    ch = cc = jnp.zeros((b, h), jnp.float32)
+    with common.force_mode("pallas"):
+        _checkgrad(
+            "lstm_pallas",
+            lambda xs_, w_: jnp.sum(lstm_sequence(
+                xs_, cm, w_, cb, czc, czc, czc, ch, cc)[0] ** 2),
+            (cx, cw), report)
+        gx, gwg, gws, gb = arr(t, b, 3 * h), arr(h, 2 * h), arr(h, h), \
+            arr(3 * h)
+        _checkgrad(
+            "gru_pallas",
+            lambda xs_, wg_, ws_: jnp.sum(gru_sequence(
+                xs_, cm, wg_, ws_, gb, ch)[0] ** 2),
+            (gx, gwg, gws), report)
+        fq, fk, fv = arr(2, 2, 256, 64), arr(2, 2, 256, 64), \
+            arr(2, 2, 256, 64)
+        _checkgrad(
+            "flash_attention_pallas",
+            lambda q_, k_, v_: jnp.sum(
+                flash_attention(q_, k_, v_, causal=True) ** 2),
+            (fq, fk, fv), report)
+
+    report["all_parity_ok"] = all(
+        report[k]["parity_ok"]
+        for k in ("lstm_sequence", "gru_sequence", "flash_attention"))
+    report["all_checkgrad_ok"] = all(
+        v["ok"] for v in report["checkgrad"].values())
+    with open("TPU_EVIDENCE.json", "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
