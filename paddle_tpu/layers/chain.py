@@ -63,19 +63,11 @@ def crf_log_likelihood(x, labels, mask, w):
     end = b[last]
     gold = emit + tr + start + end
 
-    # ---- denominator: forward algorithm (alpha frozen on padded steps)
-    alpha0 = a[None, :] + x[:, 0]  # [B, C]
-
-    def body(alpha, inp):
-        x_t, m_t = inp  # [B, C], [B]
-        nxt = _logsumexp(alpha[:, :, None] + trans[None], axis=1) + x_t
-        alpha = jnp.where(m_t[:, None] > 0, nxt, alpha)
-        return alpha, None
-
-    xs = jnp.swapaxes(x, 0, 1)[1:]
-    ms = jnp.swapaxes(mask, 0, 1)[1:]
-    alpha, _ = lax.scan(body, alpha0, (xs, ms))
-    log_z = _logsumexp(alpha + b[None, :], axis=1)
+    # ---- denominator: forward algorithm (alpha frozen on padded steps).
+    # Dispatches to the Pallas exp-space-matmul kernel on TPU
+    # (ops/crf.py), lax.scan elsewhere.
+    from paddle_tpu.ops.crf import crf_log_z
+    log_z = crf_log_z(x, mask.astype(x.dtype), trans, a, b)
     return gold - log_z
 
 

@@ -20,10 +20,12 @@ from paddle_tpu.ops.lstm import lstm_sequence, lstm_sequence_ref
 from paddle_tpu.ops.gru import gru_sequence, gru_sequence_ref
 from paddle_tpu.ops.attention import (blockwise_attention, flash_attention,
                                       mha_reference)
+from paddle_tpu.ops.crf import crf_log_z, crf_log_z_ref
 
 __all__ = [
     "use_pallas", "force_mode",
     "lstm_sequence", "lstm_sequence_ref",
     "gru_sequence", "gru_sequence_ref",
     "blockwise_attention", "flash_attention", "mha_reference",
+    "crf_log_z", "crf_log_z_ref",
 ]

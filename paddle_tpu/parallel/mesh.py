@@ -90,6 +90,14 @@ def create_multislice_mesh(n_slices: Optional[int] = None,
                    for i in range(n_slices)]
     if n_data is None:
         n_data = per_slice // n_model
+    used = n_slices * n_data * n_model
+    if used < len(devices):
+        from paddle_tpu.utils.log import logger
+        logger.warning(
+            "create_multislice_mesh uses %d of %d devices "
+            "(n_slices=%d x n_data=%d x n_model=%d); %d devices idle",
+            used, len(devices), n_slices, n_data, n_model,
+            len(devices) - used)
     devs = np.asarray([g[: n_data * n_model] for g in grouped]).reshape(
         n_slices, n_data, n_model)
     return Mesh(devs, (DCN_AXIS, DATA_AXIS, MODEL_AXIS))

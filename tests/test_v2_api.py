@@ -219,3 +219,26 @@ def test_init_flags_reach_the_trainer():
             for n in tr.params)
     finally:
         paddle._init_flags.clear()
+
+
+def test_init_flag_mesh_trims_ragged_final_batch():
+    """With trainer_count-driven DP, a final batch not divisible by the
+    degree is trimmed (drop-remainder), not a crash — paddle.batch
+    defaults to drop_last=False so ragged tails are the norm."""
+    try:
+        paddle.init(trainer_count=4)
+        out, cost = _mlp()
+        tr = paddle.trainer.SGD(
+            cost=cost,
+            update_equation=paddle.optimizer.Momentum(learning_rate=0.1))
+        rng = np.random.RandomState(0)
+        X = rng.randn(22, 8).astype(np.float32)
+        Y = rng.randint(0, 4, size=22)
+        def reader():  # 16 + ragged 6 -> trimmed to 4
+            yield [(X[i], int(Y[i])) for i in range(16)]
+            yield [(X[i], int(Y[i])) for i in range(16, 22)]
+        from paddle_tpu.data import dense_vector, integer_value
+        tr.train(reader, num_passes=1,
+                 feeding={"x": dense_vector(8), "label": integer_value(4)})
+    finally:
+        paddle._init_flags.clear()

@@ -189,6 +189,18 @@ def main():
         partial(flash_attention, causal=True),
         (q, k, v), (0, 1, 2), report)
 
+    # ---- CRF partition function (exp-space MXU matmul DP; 9 classes
+    # padded to the 128-lane width inside the dispatcher)
+    from paddle_tpu.ops.crf import crf_log_z
+    xc = arr(64, 32, 9, scale=1.0)
+    maskc = jnp.ones((64, 32), jnp.float32)
+    transc, ac, bc = arr(9, 9, scale=1.0), arr(9, scale=1.0), \
+        arr(9, scale=1.0)
+    _compare(
+        "crf_log_z",
+        lambda x_, t_: crf_log_z(x_, maskc, t_, ac, bc),
+        (xc, transc), (0, 1), report)
+
     # ---- on-device checkgrad of the custom VJPs (small TPU-tiled shapes)
     t, b, h = 8, 8, 128
     cx, cm = arr(t, b, 4 * h), jnp.ones((t, b), jnp.float32)
@@ -215,10 +227,19 @@ def main():
             lambda q_, k_, v_: jnp.sum(
                 flash_attention(q_, k_, v_, causal=True) ** 2),
             (fq, fk, fv), report)
+        kx = arr(8, 6, 9, scale=1.0)
+        kmask = jnp.ones((8, 6), jnp.float32)
+        ktr, ka, kb = arr(9, 9, scale=1.0), arr(9, scale=1.0), \
+            arr(9, scale=1.0)
+        _checkgrad(
+            "crf_pallas",
+            lambda x_, t_: jnp.sum(crf_log_z(x_, kmask, t_, ka, kb) ** 2),
+            (kx, ktr), report)
 
     report["all_parity_ok"] = all(
         report[k]["parity_ok"]
-        for k in ("lstm_sequence", "gru_sequence", "flash_attention"))
+        for k in ("lstm_sequence", "gru_sequence", "flash_attention",
+                  "crf_log_z"))
     report["all_checkgrad_ok"] = all(
         v["ok"] for v in report["checkgrad"].values())
     with open("TPU_EVIDENCE.json", "w") as f:
