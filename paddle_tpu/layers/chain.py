@@ -4,9 +4,10 @@ TPU-native equivalents of ``paddle/gserver/layers/LinearChainCRF.cpp`` /
 ``CRFLayer.cpp`` / ``CRFDecodingLayer.cpp`` and ``LinearChainCTC.cpp`` /
 ``CTCLayer.cpp`` (+ ``WarpCTCLayer.cpp``). The reference hand-writes
 forward-backward recursions and their gradients per sequence on the host;
-here each DP is a ``lax.scan`` over the (padded) time axis in log space,
-vectorized over the batch, and the gradient comes from ``jax.grad``
-differentiating through the scan — no hand-written backward.
+here each DP runs whole-batch on device — the likelihood recursions
+dispatch to fused Pallas kernels with analytic beta-recursion VJPs on TPU
+(``ops/crf.py``, ``ops/ctc.py``) and to ``lax.scan`` + autodiff elsewhere;
+Viterbi decoding stays a scan (argmax has no gradient to fuse).
 
 Parameter layout matches the reference CRF exactly
 (``LinearChainCRF.cpp:28-45``): one (C+2, C) matrix whose row 0 is the
@@ -26,16 +27,6 @@ from jax import lax
 from paddle_tpu.core.argument import Argument
 from paddle_tpu.core.registry import (LayerImpl, ParamSpec, ShapeInfo,
                                       register_layer)
-
-NEG = -1e30
-
-
-def _logsumexp(x, axis=-1):
-    m = jnp.max(x, axis=axis, keepdims=True)
-    m = jnp.where(jnp.isfinite(m), m, 0.0)
-    return jnp.squeeze(m, axis) + jnp.log(
-        jnp.sum(jnp.exp(x - m), axis=axis))
-
 
 # --------------------------------------------------------------------- CRF
 def crf_log_likelihood(x, labels, mask, w):
@@ -180,36 +171,17 @@ def ctc_loss(log_probs, labels, in_mask, label_mask, blank):
         [jnp.full((B, 2), -1, jnp.int32), ext[:, :-2]], axis=1)
     can_skip = (ext != blank) & (ext != ext_m2)
 
-    def emit(t_lp):  # [B, C] -> [B, S]
-        return jnp.take_along_axis(t_lp, ext, axis=1)
-
-    lp0 = emit(log_probs[:, 0])
-    alpha0 = jnp.where((s_idx <= 1) & valid_s, lp0, NEG)
-
-    def body(alpha, inp):
-        lp_t, m_t = inp  # [B, C], [B]
-        a_m1 = jnp.concatenate(
-            [jnp.full((B, 1), NEG), alpha[:, :-1]], axis=1)
-        a_m2 = jnp.concatenate(
-            [jnp.full((B, 2), NEG), alpha[:, :-2]], axis=1)
-        a_m2 = jnp.where(can_skip, a_m2, NEG)
-        merged = jnp.stack([alpha, a_m1, a_m2], axis=0)
-        nxt = _logsumexp(merged, axis=0) + emit(lp_t)
-        nxt = jnp.where(valid_s, nxt, NEG)
-        return jnp.where(m_t[:, None] > 0, nxt, alpha), None
-
-    xs = jnp.swapaxes(log_probs, 0, 1)[1:]
-    ms = jnp.swapaxes(in_mask, 0, 1)[1:]
-    alpha, _ = lax.scan(body, alpha0, (xs, ms))
-    # P = alpha[ext_len-1] + alpha[ext_len-2]
-    last = jnp.take_along_axis(
-        alpha, jnp.maximum(ext_lens - 1, 0)[:, None], axis=1)[:, 0]
-    last2 = jnp.take_along_axis(
-        alpha, jnp.maximum(ext_lens - 2, 0)[:, None], axis=1)[:, 0]
-    # empty transcript (ext_lens == 1): only the blank-path entry counts —
-    # without the guard alpha[0] would be double-counted (+log 2)
-    last2 = jnp.where(ext_lens >= 2, last2, NEG)
-    ll = _logsumexp(jnp.stack([last, last2], axis=-1), axis=-1)
+    # gather emissions once for every (t, ext-state); the gather's
+    # transpose (scatter-add back into [B,T,C]) stays in XLA autodiff.
+    # The DP itself dispatches to the Pallas kernel on TPU (ops/ctc.py),
+    # lax.scan elsewhere. Empty transcripts (ext_lens == 1) count only
+    # the blank-path entry (the ext_lens >= 2 guard lives in _final_ll).
+    from paddle_tpu.ops.ctc import ctc_ll
+    emit = jnp.take_along_axis(
+        log_probs, jnp.broadcast_to(ext[:, None, :], (B, T, S)), axis=2)
+    ll = ctc_ll(emit, in_mask.astype(log_probs.dtype),
+                valid_s.astype(log_probs.dtype),
+                can_skip.astype(log_probs.dtype), ext_lens)
     return -ll
 
 

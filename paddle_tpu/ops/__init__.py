@@ -21,6 +21,7 @@ from paddle_tpu.ops.gru import gru_sequence, gru_sequence_ref
 from paddle_tpu.ops.attention import (blockwise_attention, flash_attention,
                                       mha_reference)
 from paddle_tpu.ops.crf import crf_log_z, crf_log_z_ref
+from paddle_tpu.ops.ctc import ctc_ll, ctc_ll_ref
 
 __all__ = [
     "use_pallas", "force_mode",
@@ -28,4 +29,5 @@ __all__ = [
     "gru_sequence", "gru_sequence_ref",
     "blockwise_attention", "flash_attention", "mha_reference",
     "crf_log_z", "crf_log_z_ref",
+    "ctc_ll", "ctc_ll_ref",
 ]

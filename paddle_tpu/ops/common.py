@@ -52,3 +52,26 @@ def use_pallas(resident_bytes: int = 0) -> bool:
 
 def interpret() -> bool:
     return mode() == "interpret"
+
+
+# shared kernel-layout vocabulary -------------------------------------------
+
+NEG = -1e30     # finite -inf stand-in (log-space padding)
+LANE = 128      # TPU vector lane width; minor axes pad to a multiple
+
+
+def time_block(*shape):
+    """BlockSpec for a [T, ...]-shaped operand consumed one step per grid
+    index (the sequential-time pattern every fused recurrence uses)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    return pl.BlockSpec((1,) + shape, lambda t: (t,) + (0,) * len(shape),
+                        memory_space=pltpu.VMEM)
+
+
+def resident_block(*shape):
+    """BlockSpec for an operand resident in VMEM across all grid steps."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    return pl.BlockSpec(shape, lambda t: (0,) * len(shape),
+                        memory_space=pltpu.VMEM)

@@ -37,8 +37,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 from paddle_tpu.ops import common
 
-NEG = -1e30
-LANE = 128
+NEG = common.NEG
+LANE = common.LANE
 
 
 def _pad_classes(x, trans, a, b):
@@ -110,11 +110,7 @@ def _crf_alphas_pallas(x, mask, trans, a):
     dt = x.dtype
     tm = jnp.max(trans)
     trans_shift = jnp.exp(trans - tm)
-    t_block = lambda *shape: pl.BlockSpec(
-        (1,) + shape, lambda t: (t,) + (0,) * len(shape),
-        memory_space=pltpu.VMEM)
-    full = lambda *shape: pl.BlockSpec(
-        shape, lambda t: (0,) * len(shape), memory_space=pltpu.VMEM)
+    t_block, full = common.time_block, common.resident_block
     xs = jnp.swapaxes(x, 0, 1)  # [T,B,C]; step t consumes xs[t] (t>=1)
     ms = jnp.swapaxes(mask, 0, 1)[:, :, None]
     # grid step 0 writes alpha_0 (mask forced 0 so the update freezes),

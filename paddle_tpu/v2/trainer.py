@@ -57,31 +57,7 @@ class SGD(_SGD):
         flags = _v2.init_flags()
         if "log_period" in flags:
             kwargs.setdefault("log_period", int(flags["log_period"]))
-        if getattr(self, "_mesh_from_flags", False):
-            # the user asked for trainer_count-way DP via paddle.init, not
-            # an explicit mesh; ragged final batches (paddle.batch defaults
-            # to drop_last=False) must not crash — trim them to the DP
-            # degree like a drop-remainder, with a one-time warning
-            from paddle_tpu.parallel import mesh as _mesh_lib
-            n = _mesh_lib.data_parallel_degree(self.mesh)
-            inner, warned = reader, [False]
-
-            def trimming_reader():
-                for batch in inner():
-                    extra = len(batch) % n
-                    if extra:
-                        if not warned[0]:
-                            warned[0] = True
-                            from paddle_tpu.utils.log import logger
-                            logger.warning(
-                                "dropping %d sample(s) from a batch of %d "
-                                "not divisible by trainer_count=%d",
-                                extra, len(batch), n)
-                        batch = batch[:len(batch) - extra]
-                    if batch:
-                        yield batch
-
-            reader = trimming_reader
+        reader = self._trim_to_dp_degree(reader)
         feeder = feeding
         if isinstance(feeding, dict):
             if not all(isinstance(v, InputType) for v in feeding.values()):
@@ -97,4 +73,33 @@ class SGD(_SGD):
         feeder = feeding
         if isinstance(feeding, dict):
             feeder = DataFeeder(feeding)
+        reader = self._trim_to_dp_degree(reader)
         return super().test(reader, feeder=feeder, **kwargs)
+
+    def _trim_to_dp_degree(self, reader):
+        """When the mesh came from paddle.init(trainer_count=N) rather than
+        an explicit mesh argument, ragged final batches (paddle.batch
+        defaults to drop_last=False) must not crash — trim them to the DP
+        degree like a drop-remainder, with a one-time warning."""
+        if not getattr(self, "_mesh_from_flags", False):
+            return reader
+        from paddle_tpu.parallel import mesh as _mesh_lib
+        n = _mesh_lib.data_parallel_degree(self.mesh)
+        warned = [False]
+
+        def trimming_reader():
+            for batch in reader():
+                extra = len(batch) % n
+                if extra:
+                    if not warned[0]:
+                        warned[0] = True
+                        from paddle_tpu.utils.log import logger
+                        logger.warning(
+                            "dropping %d sample(s) from a batch of %d "
+                            "not divisible by trainer_count=%d",
+                            extra, len(batch), n)
+                    batch = batch[:len(batch) - extra]
+                if batch:
+                    yield batch
+
+        return trimming_reader

@@ -242,3 +242,25 @@ def test_init_flag_mesh_trims_ragged_final_batch():
                  feeding={"x": dense_vector(8), "label": integer_value(4)})
     finally:
         paddle._init_flags.clear()
+
+
+def test_init_flag_mesh_trims_ragged_batch_in_test_too():
+    try:
+        paddle.init(trainer_count=4)
+        out, cost = _mlp()
+        tr = paddle.trainer.SGD(
+            cost=cost,
+            update_equation=paddle.optimizer.Momentum(learning_rate=0.1))
+        rng = np.random.RandomState(0)
+        X = rng.randn(10, 8).astype(np.float32)
+        Y = rng.randint(0, 4, size=10)
+        def reader():  # 8 + ragged 2 -> trimmed away
+            yield [(X[i], int(Y[i])) for i in range(8)]
+            yield [(X[i], int(Y[i])) for i in range(8, 10)]
+        from paddle_tpu.data import dense_vector, integer_value
+        res = tr.test(reader,
+                      feeding={"x": dense_vector(8),
+                               "label": integer_value(4)})
+        assert res is not None
+    finally:
+        paddle._init_flags.clear()

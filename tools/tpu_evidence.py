@@ -201,6 +201,17 @@ def main():
         lambda x_, t_: crf_log_z(x_, maskc, t_, ac, bc),
         (xc, transc), (0, 1), report)
 
+    # ---- CTC (extended axis 2L+1=17 padded to 128 in the dispatcher)
+    from paddle_tpu.layers.chain import ctc_loss
+    lp = jax.nn.log_softmax(arr(32, 40, 12, scale=1.0), axis=-1)
+    lab = jnp.asarray(rng.randint(0, 11, size=(32, 8)).astype(np.int32))
+    in_m = jnp.ones((32, 40), jnp.float32)
+    lab_m = jnp.ones((32, 8), jnp.float32)
+    _compare(
+        "ctc_loss",
+        lambda lp_: ctc_loss(lp_, lab, in_m, lab_m, blank=11),
+        (lp,), (0,), report)
+
     # ---- on-device checkgrad of the custom VJPs (small TPU-tiled shapes)
     t, b, h = 8, 8, 128
     cx, cm = arr(t, b, 4 * h), jnp.ones((t, b), jnp.float32)
@@ -239,7 +250,7 @@ def main():
     report["all_parity_ok"] = all(
         report[k]["parity_ok"]
         for k in ("lstm_sequence", "gru_sequence", "flash_attention",
-                  "crf_log_z"))
+                  "crf_log_z", "ctc_loss"))
     report["all_checkgrad_ok"] = all(
         v["ok"] for v in report["checkgrad"].values())
     with open("TPU_EVIDENCE.json", "w") as f:
