@@ -98,6 +98,64 @@ def firstn(reader, n):
     return data_reader
 
 
+def mix(reader_ratio_pairs, main=0):
+    """Proportionally mix sub-readers — the ``MultiDataProvider`` contract
+    (``paddle/gserver/dataproviders/MultiDataProvider.cpp:80-110``): each
+    round yields ``ratio_i`` samples from sub-reader i, non-main readers
+    restart when exhausted, and the pass ends when the ``main`` reader
+    does. Feed the result to ``batch()``; a batch size divisible by
+    ``sum(ratios)`` reproduces the reference's exact per-batch
+    composition.
+
+    ``reader_ratio_pairs``: list of (reader, int ratio). ``main``: index of
+    the main sub-reader (``is_main_data``).
+    """
+    readers = [r for r, _ in reader_ratio_pairs]
+    ratios = [int(k) for _, k in reader_ratio_pairs]
+    if not readers:
+        raise ValueError("mix() needs at least one (reader, ratio) pair")
+    if not 0 <= main < len(readers):
+        raise ValueError(
+            f"main={main} out of range for {len(readers)} sub-readers")
+    if any(k <= 0 for k in ratios):
+        raise ValueError(f"ratios must be positive ints, got {ratios}")
+
+    def mixed_reader():
+        its = [iter(r()) for r in readers]
+        done = False
+        while not done:
+            round_items = []
+            for i, k in enumerate(ratios):
+                for _ in range(k):
+                    item, stop = _next_or_none(its[i])
+                    if stop:
+                        if i == main:
+                            done = True  # main exhausted: end of pass
+                            break
+                        its[i] = iter(readers[i]())  # restart sub-reader
+                        item, stop = _next_or_none(its[i])
+                        if stop:
+                            raise ValueError(
+                                "non-main sub-reader produced no samples")
+                    round_items.append(item)
+                if done:
+                    break
+            # flush what this round already drew (a main reader whose
+            # length is not a multiple of its ratio must not lose its tail)
+            yield from round_items
+
+    return mixed_reader
+
+
+def _next_or_none(it):
+    """next() without raising StopIteration inside a generator frame
+    (PEP 479 would turn it into RuntimeError)."""
+    try:
+        return next(it), False
+    except StopIteration:
+        return None, True
+
+
 def batch(reader, batch_size, drop_last=False):
     """Group samples into lists of batch_size
     (``python/paddle/v2/minibatch.py``)."""

@@ -42,6 +42,10 @@ def parse_args(argv=None):
                    help="comma-separated k=v injected into the config")
     p.add_argument("--num_passes", type=int, default=1)
     p.add_argument("--log_period", type=int, default=100)
+    p.add_argument("--dot_period", type=int, default=0,
+                   help="print a progress dot every N batches")
+    p.add_argument("--show_parameter_stats_period", type=int, default=0,
+                   help="log the parameter health dump every N batches")
     p.add_argument("--save_dir", default=None,
                    help="checkpoint directory (train) / source (test,merge)")
     p.add_argument("--saving_period", type=int, default=1)
@@ -204,6 +208,9 @@ def cmd_train(ns, args):
 
     trainer.train(reader, feeder=feeder, num_passes=args.num_passes,
                   event_handler=handler, log_period=args.log_period,
+                  dot_period=args.dot_period,
+                  show_parameter_stats_period=(
+                      args.show_parameter_stats_period),
                   checkpointer=ck)
     return 0
 

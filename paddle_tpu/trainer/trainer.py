@@ -260,16 +260,21 @@ class SGD:
     # ---------------------------------------------------------------- loop
     def train(self, reader, *, feeder=None, num_passes: int = 1,
               event_handler: Optional[Callable] = None,
-              log_period: int = 0, checkpointer=None):
+              log_period: int = 0, checkpointer=None,
+              dot_period: int = 0, show_parameter_stats_period: int = 0):
         """reader yields minibatches (lists of sample tuples); feeder
         converts them to Arguments (or pass feed dicts directly).
         ``log_period``>0 logs a TrainerStats-style line and dumps+resets the
         timer registry every N batches (``TrainerInternal.cpp:160-170``,
-        ``Trainer.cpp:443-451``). ``checkpointer`` (dist.Checkpointer)
-        restores the newest intact checkpoint before training — resuming
-        at the pass after the saved one, the ``--start_pass`` semantics of
-        ``Trainer.cpp:229-250`` — and saves on its cadence at batch and
-        pass boundaries."""
+        ``Trainer.cpp:443-451``); ``dot_period``>0 prints a progress dot
+        every N batches (``--dot_period``, ``Flags.cpp``);
+        ``show_parameter_stats_period``>0 logs the parameter health dump
+        every N batches (``showParameterStats``,
+        ``TrainerInternal.cpp:81-88``). ``checkpointer``
+        (dist.Checkpointer) restores the newest intact checkpoint before
+        training — resuming at the pass after the saved one, the
+        ``--start_pass`` semantics of ``Trainer.cpp:229-250`` — and saves
+        on its cadence at batch and pass boundaries."""
         from paddle_tpu.utils import global_stat, logger, timer
         start_pass = 0
         if checkpointer is not None:
@@ -296,6 +301,7 @@ class SGD:
             self._start_host_evaluators()
             self._carried = None  # reference resets RNN state per pass
             window_cost, window_n = 0.0, 0
+            dots_pending = False
             for batch_id, data in enumerate(_call_reader(reader, pass_id)):
                 event_handler(ev.BeginIteration(pass_id, batch_id))
                 with timer("prepareBatchData"):
@@ -323,7 +329,22 @@ class SGD:
                 self._feed_host_evaluators(metrics)
                 window_cost += cost
                 window_n += 1
-                if log_period and (batch_id + 1) % log_period == 0:
+                if dot_period and (batch_id + 1) % dot_period == 0:
+                    print(".", end="", flush=True)
+                    dots_pending = True
+                stats_due = show_parameter_stats_period and \
+                    (batch_id + 1) % show_parameter_stats_period == 0
+                log_due = log_period and (batch_id + 1) % log_period == 0
+                if dots_pending and (stats_due or log_due):
+                    print(flush=True)  # newline before the periodic lines
+                    dots_pending = False
+                if stats_due:
+                    for pname, st in self.parameter_stats().items():
+                        logger.info(
+                            "Param %s: %s", pname,
+                            " ".join(f"{k}={v:.5g}"
+                                     for k, v in st.items()))
+                if log_due:
                     # Cost is windowed (reset each log_period); AvgEval is
                     # cumulative since pass start, like the reference's
                     # "Eval:" vs "CurrentEval:" split (TrainerInternal.cpp).
@@ -340,6 +361,8 @@ class SGD:
                     checkpointer.maybe_save(self.params, self.opt_state,
                                             pass_id=pass_id,
                                             batch_id=batch_id + 1)
+            if dots_pending:
+                print(flush=True)  # close the dot line at pass end
             # apply deferred sparse-row updates so the pass ends with
             # current tables (reference catchUpWith before eval/save)
             self.params, self.opt_state = self.optimizer.catch_up(

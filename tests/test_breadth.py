@@ -173,3 +173,38 @@ def test_fp_anomaly_mode():
         fp.disable_fp_anomaly()
     # and normal computation is unaffected afterwards
     assert float(jax.jit(lambda x: x + 1)(jnp.float32(1.0))) == 2.0
+
+
+def test_mix_readers_multidataprovider_contract():
+    """reader.mix: per-round ratio composition, non-main restart, main
+    ends the pass (MultiDataProvider.cpp:80-110)."""
+    from paddle_tpu.data.reader import batch, mix
+
+    def ra():  # main: 6 samples
+        return iter(["a%d" % i for i in range(6)])
+
+    def rb():  # short: restarts
+        return iter(["b%d" % i for i in range(2)])
+
+    mixed = mix([(lambda: ra(), 2), (lambda: rb(), 1)], main=0)
+    got = list(mixed())
+    # rounds of 2 a's + 1 b until a is exhausted; b wraps around
+    assert got == ["a0", "a1", "b0", "a2", "a3", "b1", "a4", "a5", "b0"]
+    # batch size divisible by sum(ratios) gives exact composition
+    bs = list(batch(mixed, 3)())
+    assert all(sum(s.startswith("a") for s in b) == 2 for b in bs)
+    import pytest
+    with pytest.raises(ValueError):
+        mix([(ra, 0)])
+    with pytest.raises(ValueError):
+        mix([])
+    with pytest.raises(ValueError):
+        mix([(ra, 1)], main=1)
+    # a main whose length is not a multiple of its ratio keeps its tail
+    def r5():
+        return iter(["a%d" % i for i in range(5)])
+    tail = list(mix([(lambda: r5(), 2), (lambda: rb(), 1)], main=0)())
+    assert "a4" in tail and tail[-1] == "a4"
+    # an empty non-main sub-reader is a loud error, not a hang/crash
+    with pytest.raises(ValueError, match="no samples"):
+        list(mix([(lambda: r5(), 1), (lambda: iter([]), 1)], main=0)())

@@ -141,32 +141,60 @@ def test_every_reference_field_exists(ref_msgs, tmp_path):
     ref_fds.ParseFromString(ref_set.read_bytes())
 
     checked = 0
+
+    def audit_message(msg, ours, scope):
+        """Recursive audit so nested message/enum types stay covered if
+        the schemas ever grow them (today the reference nests none)."""
+        nonlocal checked
+        our_fields = {fl.number: fl for fl in ours.fields}
+        for fl in msg.field:
+            assert fl.number in our_fields, \
+                f"{scope}.{fl.name} (#{fl.number}) missing"
+            o = our_fields[fl.number]
+            assert o.name == fl.name, (scope, fl.name, o.name)
+            assert o.type == fl.type, (scope, fl.name)
+            assert o.label == fl.label, (scope, fl.name)
+            if fl.HasField("default_value"):
+                if o.enum_type is not None:
+                    got = o.enum_type.values_by_number[
+                        o.default_value].name
+                else:
+                    got = str(o.default_value)
+                assert got in (
+                    fl.default_value,
+                    str(fl.default_value),
+                    # bools/numbers stringify differently
+                    str(fl.default_value).capitalize(),
+                ) or float_eq(o.default_value, fl.default_value), \
+                    (scope, fl.name, o.default_value, fl.default_value)
+            checked += 1
+        our_nested = {n.name: n for n in ours.nested_types}
+        for nested in msg.nested_type:
+            assert nested.name in our_nested, \
+                f"nested message {scope}.{nested.name} missing"
+            audit_message(nested, our_nested[nested.name],
+                          f"{scope}.{nested.name}")
+        our_enums = {e.name: e for e in ours.enum_types}
+        for enum in msg.enum_type:
+            assert enum.name in our_enums, \
+                f"nested enum {scope}.{enum.name} missing"
+            ours_vals = {v.number: v.name
+                         for v in our_enums[enum.name].values}
+            for v in enum.value:
+                assert ours_vals.get(v.number) == v.name, \
+                    (scope, enum.name, v.name, v.number)
+
     for f in ref_fds.file:
+        # top-level enums audit too (EnumDescriptorProto at file scope)
+        for enum in f.enum_type:
+            ours_enum = our_pool.FindEnumTypeByName(f"paddle.{enum.name}")
+            ours_vals = {v.number: v.name for v in ours_enum.values}
+            for v in enum.value:
+                assert ours_vals.get(v.number) == v.name, \
+                    (enum.name, v.name, v.number)
         for msg in f.message_type:
             ours = our_pool.FindMessageTypeByName(f"paddle.{msg.name}")
-            our_fields = {fl.number: fl for fl in ours.fields}
-            for fl in msg.field:
-                assert fl.number in our_fields, \
-                    f"{msg.name}.{fl.name} (#{fl.number}) missing"
-                o = our_fields[fl.number]
-                assert o.name == fl.name, (msg.name, fl.name, o.name)
-                assert o.type == fl.type, (msg.name, fl.name)
-                assert o.label == fl.label, (msg.name, fl.name)
-                if fl.HasField("default_value"):
-                    if o.enum_type is not None:
-                        got = o.enum_type.values_by_number[
-                            o.default_value].name
-                    else:
-                        got = str(o.default_value)
-                    assert got in (
-                        fl.default_value,
-                        str(fl.default_value),
-                        # bools/numbers stringify differently
-                        str(fl.default_value).capitalize(),
-                    ) or float_eq(o.default_value, fl.default_value), \
-                        (msg.name, fl.name, o.default_value,
-                         fl.default_value)
-                checked += 1
+            audit_message(msg, ours, msg.name)
     assert checked > 200  # the contract is nontrivial
 
 
