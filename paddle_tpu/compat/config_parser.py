@@ -413,12 +413,42 @@ def inputs(*layers):
 
 
 def outputs(*layers):
-    """Declare network outputs (costs when training)."""
+    """Declare network outputs (costs when training). When ``inputs()``
+    was not called, the input order is inferred by the reference's
+    DFS-LRV traversal from the outputs (`networks.py:1412-1498`): data
+    layers appear in post-order of first reachability, not declaration
+    order, and unreachable data layers are excluded."""
     names = [l.name if hasattr(l, "name") else str(l) for l in layers]
     c = ctx()
     c.output_layer_names = names
     graph = dsl.current_graph()
     graph.output_layer_names = names
+    if not c.input_layer_names:
+        seen: set = set()
+        order: List[str] = []
+
+        # the reference DFS walks LayerOutput.parents, which for a few
+        # helpers is a strict subset of the proto inputs (e.g.
+        # sub_nested_seq_layer records only `input`, not
+        # selected_indices — `layers.py:6138`); mirror that
+        dfs_parent_count = {"sub_nested_seq": 1}
+
+        def dfs(n):
+            if n in seen:
+                return
+            seen.add(n)
+            ld = graph.layers.get(n)
+            if ld is None:
+                return
+            limit = dfs_parent_count.get(ld.type, len(ld.inputs))
+            for i in ld.inputs[:limit]:
+                dfs(i.layer_name)
+            if ld.type == "data":
+                order.append(n)
+
+        for n in names:
+            dfs(n)
+        c.input_layer_names = order
 
 
 Inputs = inputs
@@ -478,7 +508,8 @@ class ParsedConfig:
             kwargs = dict(source.args) if isinstance(source.args, dict) \
                 else {"args": source.args}
         file_list = source.file_list
-        if file_list and self.context.config_dir and \
+        if file_list and isinstance(file_list, str) and \
+                self.context.config_dir and \
                 not os.path.isabs(file_list):
             cand = os.path.join(self.context.config_dir, file_list)
             if os.path.exists(cand):

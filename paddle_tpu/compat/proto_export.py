@@ -140,12 +140,19 @@ _LAYER_SCALAR_FIELDS = {
 }
 
 
-def _export_layer(model: ModelDef, net: Network, name: str, proto_layer):
+# layer types whose reference LayerConfig carries no size (config_parser
+# leaves it unset: side-effect/scoring/cost layers with no feature width)
+_SIZELESS_TYPES = {"print", "kmax_seq_score",
+                   "multi_class_cross_entropy_with_selfnorm"}
+
+
+def _export_layer(model: ModelDef, net: Network, name: str, proto_layer,
+                  rename=None):
     layer = model.layers[name]
     out_info = net.shape_infos[name]
     proto_layer.name = layer.name
-    proto_layer.type = layer.type
-    if layer.size or out_info.size:
+    proto_layer.type = "mixed" if layer.type == "embedding" else layer.type
+    if layer.type not in _SIZELESS_TYPES and (layer.size or out_info.size):
         proto_layer.size = int(layer.size or out_info.size)
     # recurrent helpers keep the main activation in attrs (the engine
     # applies it inside the scan); the proto's active_type is that one
@@ -173,7 +180,8 @@ def _export_layer(model: ModelDef, net: Network, name: str, proto_layer):
     operators = layer.attrs.get("operators") or []
     for i, inp in enumerate(layer.inputs):
         pin = proto_layer.inputs.add()
-        pin.input_layer_name = inp.layer_name
+        pin.input_layer_name = (rename or {}).get(inp.layer_name,
+                                                  inp.layer_name)
         if f"w{i}" in lp:
             pin.input_parameter_name = lp[f"w{i}"]
         extra = inp.extra or {}
@@ -185,13 +193,22 @@ def _export_layer(model: ModelDef, net: Network, name: str, proto_layer):
             _set_pool_conf(pin.pool_conf, extra, in_info, out_info)
         elif layer.type == "norm":
             _set_norm_conf(pin.norm_conf, extra, in_info, out_info)
-        elif layer.type == "mixed" and projections is not None \
+        elif layer.type in ("mixed", "concat2") and projections is not None \
                 and i < len(projections):
             spec = projections[i]
             if spec.get("type") not in (None, "identity_op_arg"):
+                out_size = (spec.get("size") if layer.type == "concat2"
+                            else None) or layer.size or out_info.size
                 _set_proj_conf(pin.proj_conf, spec,
                                f"___{layer.name}.w{i}", in_info.size,
-                               layer.size or out_info.size)
+                               out_size)
+        elif layer.type == "embedding":
+            # the reference represents embedding_layer as a mixed layer
+            # with one table projection (`layers.py` embedding_layer);
+            # the engine keeps a native type — translate at the wire
+            _set_proj_conf(pin.proj_conf, {"type": "table"},
+                           f"___{layer.name}.w{i}", in_info.size,
+                           layer.size or out_info.size)
     if layer.type == "batch_norm" and layer.inputs:
         # the reference wires moving mean/var as static inputs 1 and 2 of
         # the layer (BatchNormBaseLayer.cpp); the engine keeps them as
@@ -252,23 +269,180 @@ def _export_parameter(pname: str, spec, proto_param):
         hook.sparsity_ratio = float(spec.sparsity_ratio)
 
 
+def _expand_group(model, net, gname, layer, mc, rename, root_names,
+                  sub_entries, params_out):
+    """Emit a recurrent group the way the reference config_parser does
+    (`config_parser.py` RecurrentLayerGroupBegin/End): a shell layer, one
+    scatter_agent per in-link (named ``{outer}@{group}``), one ``agent``
+    per memory (named ``{link}+delay1@{group}``), the step layers scoped
+    ``{sub}@{group}`` with their parameters scoped ``_{sub}@{group}.sfx``
+    (projection names stay unscoped — the reference quirk), a gather_agent
+    in the root named after the out-link sub layer, and a SubModelConfig
+    entry recording links and memories."""
+    sub: ModelDef = layer.attrs["sub_model"]
+    ins_meta = layer.attrs["ins"]
+    memories = layer.attrs["memories"]
+    outs = layer.attrs["outputs"]
+    subnet = Network(sub, outputs=list(sub.layers))
+    entry = {"name": gname, "layer_names": [], "in_links": [],
+             "out_links": [], "memories": [],
+             "reversed": bool(layer.attrs.get("reverse"))}
+
+    shell = mc.layers.add()
+    shell.name = gname
+    shell.type = "recurrent_layer_group"
+    shell.active_type = ""
+    root_names.append(gname)
+
+    boundary_map = {}   # sub boundary data layer -> emitted agent name
+    boot_of = {}        # memory boundary -> outer boot layer name
+    for meta, inp in zip(ins_meta, layer.inputs):
+        outer = rename.get(inp.layer_name, inp.layer_name)
+        if meta["kind"] == "boot":
+            boot_of[meta["boundary"]] = outer
+            continue
+        sc = f"{outer}@{gname}"
+        pl = mc.layers.add()
+        pl.name = sc
+        pl.type = "scatter_agent"
+        pl.size = int(sub.layers[meta["boundary"]].size)
+        pl.active_type = ""
+        boundary_map[meta["boundary"]] = sc
+        entry["in_links"].append(
+            (outer, sc, meta["kind"] == "subseq"))
+        entry["layer_names"].append(sc)
+    for mem in memories:
+        base = mem.get("agent_name") or f"{mem['link']}+delay1"
+        agent = f"{base}@{gname}"
+        pl = mc.layers.add()
+        pl.name = agent
+        pl.type = "agent"
+        pl.size = int(sub.layers[mem["boundary"]].size)
+        pl.active_type = ""
+        boundary_map[mem["boundary"]] = agent
+        m = {"layer_name": f"{mem['link']}@{gname}", "link_name": agent}
+        if mem["boundary"] in boot_of:
+            m["boot_layer_name"] = boot_of[mem["boundary"]]
+        entry["memories"].append(m)
+        entry["layer_names"].append(agent)
+
+    sub_names = set(sub.layers)
+
+    def scope_param(pname):
+        for s in sub_names:
+            pre = f"_{s}."
+            if pname.startswith(pre):
+                return f"_{s}@{gname}." + pname[len(pre):]
+        return pname
+
+    step_rename = {n: boundary_map.get(n, f"{n}@{gname}")
+                   for n in sub.layers}
+    for subname, sl in sub.layers.items():
+        if subname in boundary_map or subname in boot_of:
+            continue  # boundary data layers became agents
+        pl = mc.layers.add()
+        _export_layer(sub, subnet, subname, pl, rename=step_rename)
+        pl.name = f"{subname}@{gname}"
+        for pin in pl.inputs:
+            if pin.input_parameter_name:
+                pin.input_parameter_name = scope_param(
+                    pin.input_parameter_name)
+        if pl.bias_parameter_name:
+            pl.bias_parameter_name = scope_param(pl.bias_parameter_name)
+        entry["layer_names"].append(pl.name)
+    for pname, spec in subnet.param_specs.items():
+        params_out[scope_param(pname)] = spec
+
+    main = outs[0]
+    pl = mc.layers.add()
+    pl.name = main
+    pl.type = "gather_agent"
+    pl.size = int(subnet.shape_infos[main].size)
+    pl.active_type = ""
+    root_names.append(main)
+    rename[gname] = main
+    entry["out_links"].append((f"{main}@{gname}", main))
+    sub_entries.append(entry)
+    return entry, set(subnet.param_specs)
+
+
 def model_to_proto(model: ModelDef, context=None) -> "ModelConfig_pb2.ModelConfig":
     mc = ModelConfig_pb2.ModelConfig()
-    mc.type = "nn"
+    has_groups = any(l.type == "recurrent_layer_group"
+                     for l in model.layers.values())
+    mc.type = "recurrent_nn" if has_groups else "nn"
     # infer over ALL declared layers, emit in declaration order — the
     # reference's config_parser emits layers as the config declares them
     # (declaration order is a valid topological order: the DSL requires
     # inputs to exist before use)
     net = Network(model, outputs=list(model.layers))
-    for name in model.layers:
-        _export_layer(model, net, name, mc.layers.add())
-    for pname in sorted(net.param_specs):
-        _export_parameter(pname, net.param_specs[pname], mc.parameters.add())
+    rename = {}            # group/group_output name -> gather-agent name
+    root_names = []        # root sub_model layer list
+    sub_entries = []       # SubModelConfig data per group
+    entry_of = {}          # gname -> entry (secondary out_links)
+    all_params = {}        # name -> spec (root + scoped group params)
+    hoisted = set()        # group param names already emitted scoped
+    for name, layer in model.layers.items():
+        if layer.type == "recurrent_layer_group":
+            entry, sub_param_names = _expand_group(
+                model, net, name, layer, mc, rename, root_names,
+                sub_entries, all_params)
+            entry_of[name] = entry
+            hoisted.update(sub_param_names)
+        elif layer.type == "group_output":
+            gname = layer.inputs[0].layer_name
+            sub_out = layer.attrs["sub_name"]
+            pl = mc.layers.add()
+            pl.name = sub_out
+            pl.type = "gather_agent"
+            pl.size = int(layer.size or net.shape_infos[name].size)
+            pl.active_type = ""
+            rename[name] = sub_out
+            entry_of[gname]["out_links"].append(
+                (f"{sub_out}@{gname}", sub_out))
+            root_names.append(sub_out)
+        else:
+            _export_layer(model, net, name, mc.layers.add(), rename=rename)
+            root_names.append(name)
+    for pname, spec in net.param_specs.items():
+        if pname not in hoisted:
+            all_params.setdefault(pname, spec)
+    for pname in sorted(all_params):
+        _export_parameter(pname, all_params[pname], mc.parameters.add())
     input_names = (context.input_layer_names if context is not None
                    and context.input_layer_names else model.input_layer_names)
     mc.input_layer_names.extend(
         n for n in input_names if n in net.shape_infos)
-    mc.output_layer_names.extend(model.output_layer_names)
+    mc.output_layer_names.extend(
+        rename.get(n, n) for n in model.output_layer_names)
+    root_entry = mc.sub_models.add()
+    root_entry.name = "root"
+    root_entry.layer_names.extend(root_names)
+    root_entry.input_layer_names.extend(mc.input_layer_names)
+    root_entry.output_layer_names.extend(mc.output_layer_names)
+    for e in sub_entries:
+        sm = mc.sub_models.add()
+        sm.name = e["name"]
+        sm.layer_names.extend(e["layer_names"])
+        sm.is_recurrent_layer_group = True
+        sm.reversed = e["reversed"]
+        for m in e["memories"]:
+            pm = sm.memories.add()
+            pm.layer_name = m["layer_name"]
+            pm.link_name = m["link_name"]
+            if m.get("boot_layer_name"):
+                pm.boot_layer_name = m["boot_layer_name"]
+        for outer, link, _subseq in e["in_links"]:
+            pl = sm.in_links.add()
+            pl.layer_name = outer
+            pl.link_name = link
+            # the reference leaves LinkConfig.has_subseq at its default
+            # even for nested-sequence in-links (observed in the golden
+            # protostr of sequence_nest configs); mirror that
+        for lay, link in e["out_links"]:
+            pl = sm.out_links.add()
+            pl.layer_name = lay
+            pl.link_name = link
     if context is not None:
         for ev in context.evaluators:
             pe = mc.evaluators.add()

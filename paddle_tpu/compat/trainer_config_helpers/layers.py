@@ -381,18 +381,27 @@ class MixedLayerType:
             sizes = [p.size for p in self.projections if p.size]
             size = sizes[0] if sizes else None
         inputs, projs, operators = [], [], []
+        deferred = []  # (operator dict, extra inputs) appended at the end
         for p in self.projections:
             if p.is_operator:
-                idxs = []
-                for ex in [p.input] + p.extra_inputs:
-                    idxs.append(len(inputs))
-                    inputs.append(Input(ex.name))
-                    projs.append({"type": "identity_op_arg"})
-                operators.append({**p.spec, "input_indices": idxs})
+                # reference MixedLayer (config_parser.py:2895-2905): the
+                # operator's first arg sits inline at the operator's add
+                # position; the remaining args append AFTER all inputs
+                idxs = [len(inputs)]
+                inputs.append(Input(p.input.name))
+                projs.append({"type": "identity_op_arg"})
+                op = {**p.spec, "input_indices": idxs}
+                operators.append(op)
+                deferred.append((op, p.extra_inputs))
             else:
                 inputs.append(Input(p.input.name,
                                     param_attr=p.param_attr))
                 projs.append(dict(p.spec))
+        for op, extras in deferred:
+            for ex in extras:
+                op["input_indices"].append(len(inputs))
+                inputs.append(Input(ex.name))
+                projs.append({"type": "identity_op_arg"})
         self.finalized = _layer(
             self.name, "mixed", inputs, size=size, act=self.act,
             bias=self.bias_attr,
@@ -598,11 +607,22 @@ def expand_layer(input, expand_as, name=None, bias_attr=False,
 
 def concat_layer(input, act=None, name=None, layer_attr=None,
                  bias_attr=None):
-    # the reference's ConcatenateLayer2 accepts projections; each becomes
-    # an anonymous mixed layer whose outputs are concatenated
     items = input if isinstance(input, (list, tuple)) else [input]
-    items = [mixed_layer(input=[p]) if isinstance(p, Projection) else p
-             for p in items]
+    if any(isinstance(p, Projection) for p in items):
+        # the reference's ConcatenateLayer2: projection inputs, outputs
+        # concatenated per-projection (config_parser `concat2`)
+        inputs, projs, total = [], [], 0
+        for p in items:
+            if not isinstance(p, Projection):
+                p = identity_projection(_one(p))
+            psize = int(p.size or p.input.size)
+            inputs.append(Input(p.input.name, param_attr=p.param_attr))
+            projs.append(dict(p.spec, size=psize))
+            total += psize
+        return _layer(_name(name, "concat"), "concat2", inputs,
+                      size=total, act=_act(act, IdentityActivation),
+                      bias=_battr(bias_attr, False),
+                      attrs={"projections": projs}, layer_attr=layer_attr)
     ins = _many(items)
     return _layer(_name(name, "concat"), "concat",
                   [Input(i.name) for i in ins],
@@ -684,8 +704,15 @@ def memory(name, size, memory_name=None, is_seq=False, boot_layer=None,
     boot_const = 0.0
     if boot_with_const_id is not None:
         boot_const = float(boot_with_const_id)
+    # reference @wrap_name_default("memory", "memory_name") consumes the
+    # global memory counter on EVERY call; the auto name is only used as
+    # the agent name when the memory is anonymous (layers.py:3230-3241)
+    auto = ctx().auto_name("memory")
+    if memory_name is None:
+        memory_name = auto
+    agent = None if name is not None else memory_name
     return dsl.memory(name=name, size=size, boot_layer=boot_layer,
-                      boot_with_const_value=boot_const)
+                      boot_with_const_value=boot_const, agent_name=agent)
 
 
 def recurrent_group(step, input, reverse=False, name=None,
@@ -889,11 +916,12 @@ def block_expand_layer(input, block_x=0, block_y=0, stride_x=0, stride_y=0,
 
 def pad_layer(input, pad_c=None, pad_h=None, pad_w=None, name=None,
               layer_attr=None):
-    return _layer(_name(name, "pad"), "pad",
-                  [Input(_one(input).name,
-                         extra={"pad_c": pad_c or [0, 0],
-                                "pad_h": pad_h or [0, 0],
-                                "pad_w": pad_w or [0, 0]})],
+    # the pad amounts live in LayerDef.attrs (where layers/misc.PadLayer
+    # reads them), not in Input.extra
+    return _layer(_name(name, "pad"), "pad", [Input(_one(input).name)],
+                  attrs={"pad_c": list(pad_c or [0, 0]),
+                         "pad_h": list(pad_h or [0, 0]),
+                         "pad_w": list(pad_w or [0, 0])},
                   layer_attr=layer_attr)
 
 
@@ -940,7 +968,7 @@ def gated_unit_layer(input, size, act=None, name=None, gate_attr=None,
     gate = fc_layer(src, size, act=SigmoidActivation(),
                     name=f"{name}_gate", param_attr=gate_param_attr,
                     bias_attr=gate_bias_attr, layer_attr=gate_attr)
-    return mixed_layer(size=size, name=name,
+    return mixed_layer(size=size, name=f"{name}_gated_act",
                        input=dotmul_operator(proj, gate),
                        layer_attr=layer_attr)
 
@@ -1177,7 +1205,8 @@ def huber_cost(input, label, name=None, coeff=1.0, layer_attr=None):
 
 
 def smooth_l1_cost(input, label, name=None, coeff=1.0, layer_attr=None):
-    return _cost(name, "smooth_l1", "smooth_l1",
+    # reference @wrap_name_default() uses the function name as prefix
+    return _cost(name, "smooth_l1_cost", "smooth_l1",
                  [_one(input), _one(label)], coeff=coeff,
                  layer_attr=layer_attr)
 
@@ -1185,6 +1214,12 @@ def smooth_l1_cost(input, label, name=None, coeff=1.0, layer_attr=None):
 def ctc_layer(input, label, size=None, name=None, norm_by_times=False,
               layer_attr=None):
     inp, lab = _one(input), _one(label)
+    # reference contract (`layers.py:4987-4992`): size = num classes + 1
+    # (the blank); defaults from the label vocabulary, NOT the input
+    if lab.size:
+        if size is not None:
+            assert size == lab.size + 1, (size, lab.size)
+        size = lab.size + 1
     size = size or inp.size
     return _layer(_name(name, "ctc_layer"), "ctc",
                   [Input(inp.name), Input(lab.name)], size=size,
@@ -1195,6 +1230,11 @@ def ctc_layer(input, label, size=None, name=None, norm_by_times=False,
 def warp_ctc_layer(input, label, size=None, name=None, blank=0,
                    norm_by_times=False, layer_attr=None):
     inp, lab = _one(input), _one(label)
+    # like ctc_layer: size = num classes + 1, from the label vocabulary
+    if lab.size:
+        if size is not None:
+            assert size == lab.size + 1, (size, lab.size)
+        size = lab.size + 1
     size = size or inp.size + 1
     return _layer(_name(name, "warp_ctc_layer"), "warp_ctc",
                   [Input(inp.name), Input(lab.name)], size=size,
@@ -1241,7 +1281,7 @@ def nce_layer(input, label, num_classes=None, act=None, param_attr=None,
         inputs.append(Input(_one(weight).name))
     return _layer(
         _name(name, "nce_layer"), "nce", inputs,
-        bias=_battr(bias_attr),
+        act=_act(act, SigmoidActivation), bias=_battr(bias_attr),
         attrs={"num_classes": num_classes,
                "num_neg_samples": num_neg_samples,
                "neg_sampling_dist": neg_distribution},

@@ -254,15 +254,17 @@ def lstmemory_group(input, size=None, name=None, out_memory=None,
 
     def step(x):
         return lstmemory_unit(
-            input=x, name=f"{name}_recurrent", size=size,
+            input=x, name=name, size=size,
             param_attr=param_attr, act=act, gate_act=gate_act,
             state_act=state_act, out_memory=out_memory,
             input_proj_bias_attr=input_proj_bias_attr,
             input_proj_layer_attr=input_proj_layer_attr,
             lstm_bias_attr=lstm_bias_attr, lstm_layer_attr=lstm_layer_attr)
 
-    return recurrent_group(name=name, step=step, reverse=reverse,
-                           input=input)
+    # reference naming: the group is `{name}_recurrent_group`, the step
+    # lstm layer is `{name}` (networks.py:833)
+    return recurrent_group(name=f"{name}_recurrent_group", step=step,
+                           reverse=reverse, input=input)
 
 
 def gru_unit(input, memory_boot=None, size=None, name=None, gru_bias_attr=None,
@@ -284,21 +286,21 @@ def gru_group(input, memory_boot=None, size=None, name=None, reverse=False,
     name = _name(name, "gru_group")
 
     def step(x):
-        return gru_unit(input=x, memory_boot=memory_boot, name=f"{name}_recurrent",
+        return gru_unit(input=x, memory_boot=memory_boot, name=name,
                         size=size, gru_bias_attr=gru_bias_attr,
                         gru_param_attr=gru_param_attr, act=act,
                         gate_act=gate_act, gru_layer_attr=gru_layer_attr,
                         naive=naive)
 
-    return recurrent_group(name=name, step=step, reverse=reverse,
-                           input=input)
+    return recurrent_group(name=f"{name}_recurrent_group", step=step,
+                           reverse=reverse, input=input)
 
 
 def simple_gru(input, size, name=None, reverse=False, mixed_param_attr=None,
                mixed_bias_param_attr=None, mixed_layer_attr=None,
                gru_bias_attr=None, gru_param_attr=None, act=None,
                gate_act=None, gru_layer_attr=None, naive=False):
-    name = _name(name, "gru")
+    name = _name(name, "simple_gru")
     m = mixed_layer(name=f"{name}_transform", size=size * 3,
                     bias_attr=mixed_bias_param_attr,
                     layer_attr=mixed_layer_attr,

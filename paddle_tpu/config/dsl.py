@@ -407,7 +407,8 @@ _GROUP_CTX: Optional[Dict[str, Any]] = None
 
 
 def memory(*, name: str, size: int, boot_layer: Optional[LayerOutput] = None,
-           boot_with_const_value: float = 0.0) -> LayerOutput:
+           boot_with_const_value: float = 0.0,
+           agent_name: Optional[str] = None) -> LayerOutput:
     """Declare a recurrent memory inside a recurrent_group step function:
     the previous timestep's output of the layer called ``name`` (zero /
     constant / boot-layer initialized). Mirrors the DSL ``memory()`` that
@@ -424,7 +425,7 @@ def memory(*, name: str, size: int, boot_layer: Optional[LayerOutput] = None,
     out = _add(LayerDef(name=bname, type="data", size=size, bias=False))
     _GROUP_CTX["memories"].append(
         {"boundary": bname, "link": name, "boot_layer": boot_layer,
-         "init": boot_with_const_value})
+         "init": boot_with_const_value, "agent_name": agent_name})
     return out
 
 
@@ -455,7 +456,10 @@ def recurrent_group(step, input, *, reverse: bool = False,
     from paddle_tpu.config.model_config import ModelDef as _ModelDef
     inputs = [input] if isinstance(
         input, (LayerOutput, StaticInput, SubsequenceInput)) else list(input)
-    gname = name or _auto_name("recurrent_group")
+    # reference auto-name convention: __recurrent_group_0__ (config_parser
+    # RecurrentLayerGroupBegin), not the generic __X_layer_0__ pattern
+    c = _COUNTERS.setdefault("recurrent_group", itertools.count())
+    gname = name or f"__recurrent_group_{next(c)}__"
     outer = _GRAPH
     sub = _ModelDef()
     ins_meta: List[Dict[str, Any]] = []
@@ -894,8 +898,8 @@ def multibox_loss_layer(priorbox, label, conf, loc, *, num_classes: int,
                     type="multibox_loss",
                     inputs=[Input(_in(priorbox)[0].name),
                             Input(_in(label)[0].name),
-                            Input(_in(conf)[0].name),
-                            Input(_in(loc)[0].name)], bias=False,
+                            Input(_in(loc)[0].name),
+                            Input(_in(conf)[0].name)], bias=False,
                     attrs={"num_classes": num_classes,
                            "overlap_threshold": overlap_threshold,
                            "neg_pos_ratio": neg_pos_ratio,
@@ -912,8 +916,8 @@ def detection_output_layer(priorbox, conf, loc, *, num_classes: int,
     ldef = LayerDef(name=name or _auto_name("detection_output"),
                     type="detection_output",
                     inputs=[Input(_in(priorbox)[0].name),
-                            Input(_in(conf)[0].name),
-                            Input(_in(loc)[0].name)], bias=False,
+                            Input(_in(loc)[0].name),
+                            Input(_in(conf)[0].name)], bias=False,
                     attrs={"num_classes": num_classes,
                            "nms_threshold": nms_threshold,
                            "nms_top_k": nms_top_k, "keep_top_k": keep_top_k,

@@ -124,7 +124,7 @@ def mix(reader_ratio_pairs, main=0):
         its = [iter(r()) for r in readers]
         done = False
         while not done:
-            round_items = []
+            round_items = []  # (reader_index, item)
             for i, k in enumerate(ratios):
                 for _ in range(k):
                     item, stop = _next_or_none(its[i])
@@ -137,12 +137,17 @@ def mix(reader_ratio_pairs, main=0):
                         if stop:
                             raise ValueError(
                                 "non-main sub-reader produced no samples")
-                    round_items.append(item)
+                    round_items.append((i, item))
                 if done:
                     break
-            # flush what this round already drew (a main reader whose
-            # length is not a multiple of its ratio must not lose its tail)
-            yield from round_items
+            if done:
+                # the incomplete final round contributes only the main
+                # reader's tail (its length need not be a multiple of its
+                # ratio); other readers' partial draws are dropped so the
+                # pass never over-represents them past the main's end
+                round_items = [(i, it) for i, it in round_items
+                               if i == main]
+            yield from (it for _, it in round_items)
 
     return mixed_reader
 

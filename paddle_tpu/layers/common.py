@@ -12,6 +12,7 @@ come from jax.grad.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Dict, List
 
 import jax.numpy as jnp
@@ -118,6 +119,76 @@ def _project(proj: dict, x: jnp.ndarray, w) -> jnp.ndarray:
     raise KeyError(f"unknown projection type {kind!r}")
 
 
+def _context_project(proj: dict, a: Argument, w) -> jnp.ndarray:
+    """Sliding-window concat over time (``ContextProjection``): output
+    feature t is [x[t+start], ..., x[t+start+len-1]] concatenated, with
+    out-of-sequence positions taken from the padding rows ``w`` (begin
+    rows then end rows; static zeros unless trainable_padding)."""
+    x, mask = a.value, a.mask
+    if x.ndim != 3:
+        raise ValueError("context projection needs a sequence input")
+    B, T, D = x.shape
+    start = int(proj.get("context_start", 0))
+    length = int(proj.get("context_length", 1))
+    begin_pad = max(0, -start)
+    lengths = (jnp.sum(mask, axis=1).astype(jnp.int32) if mask is not None
+               else jnp.full((B,), T, jnp.int32))
+    t_idx = jnp.arange(T)
+    pieces = []
+    for o in range(start, start + length):
+        idx = t_idx + o  # [T]
+        src = x[:, jnp.clip(idx, 0, T - 1)]  # [B,T,D]
+        before = idx < 0                      # [T]
+        after = idx[None, :] > (lengths[:, None] - 1)  # [B,T]
+        if w is not None:
+            total_pad = w.shape[0]
+            brow = w[jnp.clip(idx + begin_pad, 0, total_pad - 1)]  # [T,D]
+            arow_idx = jnp.clip(begin_pad + idx[None, :]
+                                - lengths[:, None], 0, total_pad - 1)
+            arow = w[arow_idx]                # [B,T,D]
+        else:
+            brow = jnp.zeros((T, D), x.dtype)
+            arow = jnp.zeros((B, T, D), x.dtype)
+        piece = jnp.where(before[None, :, None],
+                          jnp.broadcast_to(brow[None], (B, T, D)), src)
+        piece = jnp.where(after[:, :, None], arow, piece)
+        pieces.append(piece)
+    return jnp.concatenate(pieces, axis=-1)
+
+
+def _conv_project(proj: dict, a: Argument, w, info):
+    """One conv/convt projection -> NHWC [B, oh, ow, nf] output."""
+    from jax import lax
+
+    from paddle_tpu.layers.conv import to_nhwc
+    kind = proj["type"]
+    c, in_h, in_w, oh, ow = _conv_proj_geom(proj, info)
+    fs = proj["filter_size"]
+    fsy = proj.get("filter_size_y") or fs
+    st = proj.get("stride", 1)
+    sty = proj.get("stride_y") or st
+    pad = proj.get("padding", 0)
+    pady = proj.get("padding_y")
+    pady = pad if pady is None else pady
+    x = to_nhwc(a.value, c, in_h, in_w)
+    if kind == "conv":
+        return lax.conv_general_dilated(
+            x, w, window_strides=(sty, st),
+            padding=((pady, pady), (pad, pad)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=proj.get("groups", 1) or 1)
+    if (proj.get("groups", 1) or 1) != 1:
+        raise NotImplementedError("grouped transposed conv projection")
+    # gradient-of-conv shape needs lax padding fs-1-p
+    # (see ConvTransLayer.apply)
+    return lax.conv_transpose(
+        x, w, strides=(sty, st),
+        padding=((fsy - 1 - pady, fsy - 1 - pady),
+                 (fs - 1 - pad, fs - 1 - pad)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        transpose_kernel=True)
+
+
 def _conv_proj_geom(proj: dict, info):
     """(c_in, in_h, in_w, out_h, out_w) for a conv projection over one
     input (square side derived from flat size when needed; *_y params
@@ -191,6 +262,19 @@ class MixedLayer(LayerImpl):
                                        sparse_grad=True)}
         if kind == "scaling":
             return {f"w{i}": ParamSpec(shape=(1,))}
+        if kind == "context":
+            start = int(proj.get("context_start", 0))
+            length = int(proj.get("context_length", 1))
+            total_pad = max(0, -start) + max(0, start + length - 1)
+            if total_pad == 0:
+                return {}
+            # the reference always allocates the padding rows
+            # (config_parser.py:677-684); they stay static zeros unless
+            # trainable_padding
+            return {f"w{i}": ParamSpec(
+                shape=(total_pad, info.size), init="const",
+                initial_mean=0.0, initial_std=0.0,
+                is_static=not proj.get("trainable_padding", False))}
         if kind in ("conv", "convt"):
             c, *_ = _conv_proj_geom(proj, info)
             groups = proj.get("groups", 1) or 1
@@ -203,9 +287,6 @@ class MixedLayer(LayerImpl):
         return {}  # identity
 
     def apply(self, cfg, params, ins, ctx):
-        from jax import lax
-
-        from paddle_tpu.layers.conv import to_nhwc
         projs = cfg.attrs.get("projections") or [
             {"type": "full_matrix"} for _ in ins]
         kinds = {p.get("type", "full_matrix") for p in projs if p}
@@ -225,34 +306,10 @@ class MixedLayer(LayerImpl):
         for i, (a, proj) in enumerate(zip(ins, projs)):
             kind = proj.get("type", "full_matrix")
             if kind in ("conv", "convt"):
-                info = ctx.in_infos[i]
-                c, in_h, in_w, oh, ow = _conv_proj_geom(proj, info)
-                fs = proj["filter_size"]
-                fsy = proj.get("filter_size_y") or fs
-                st = proj.get("stride", 1)
-                sty = proj.get("stride_y") or st
-                pad = proj.get("padding", 0)
-                pady = proj.get("padding_y")
-                pady = pad if pady is None else pady
-                x = to_nhwc(a.value, c, in_h, in_w)
-                if kind == "conv":
-                    y = lax.conv_general_dilated(
-                        x, params[f"w{i}"], window_strides=(sty, st),
-                        padding=((pady, pady), (pad, pad)),
-                        dimension_numbers=("NHWC", "HWIO", "NHWC"),
-                        feature_group_count=proj.get("groups", 1) or 1)
-                else:
-                    if (proj.get("groups", 1) or 1) != 1:
-                        raise NotImplementedError(
-                            "grouped transposed conv projection")
-                    # gradient-of-conv shape needs lax padding fs-1-p
-                    # (see ConvTransLayer.apply)
-                    y = lax.conv_transpose(
-                        x, params[f"w{i}"], strides=(sty, st),
-                        padding=((fsy - 1 - pady, fsy - 1 - pady),
-                                 (fs - 1 - pad, fs - 1 - pad)),
-                        dimension_numbers=("NHWC", "HWIO", "NHWC"),
-                        transpose_kernel=True)
+                y = _conv_project(proj, a, params[f"w{i}"],
+                                  ctx.in_infos[i])
+            elif kind == "context":
+                y = _context_project(proj, a, params.get(f"w{i}"))
             else:
                 x = a.value if kind == "table" else _flat(a)
                 y = _project(proj, x, params.get(f"w{i}"))
@@ -389,3 +446,80 @@ class TransLayer(LayerImpl):
 
     def apply(self, cfg, params, ins, ctx):
         return Argument(value=ins[0].value.T)
+
+
+@register_layer("concat2")
+class Concat2Layer(MixedLayer):
+    """``ConcatenateLayer2.cpp``: per-input projections whose OUTPUTS are
+    concatenated (the reference's concat-of-projections form); shares the
+    projection vocabulary with MixedLayer but combines by concat, and each
+    projection keeps its own output width."""
+
+    def infer(self, cfg, in_infos):
+        projs = cfg.attrs.get("projections") or []
+        conv_kinds = [(p or {}).get("type") in ("conv", "convt")
+                      for p in projs]
+        if any(conv_kinds):
+            if not all(conv_kinds):
+                raise NotImplementedError(
+                    "concat2 cannot mix conv projections with flat "
+                    "projections (4-D maps vs [B, size] vectors)")
+            # inception-style concat of conv maps: channels add, spatial
+            # dims must agree
+            nf_total, oh, ow = 0, None, None
+            for p, info in zip(projs, in_infos):
+                _, _, _, poh, pow_ = _conv_proj_geom(p, info)
+                nf_total += int(p["num_filters"])
+                if oh is None:
+                    oh, ow = poh, pow_
+                elif (oh, ow) != (poh, pow_):
+                    raise ValueError(
+                        "concat2 conv projections disagree on output "
+                        f"geometry: {(oh, ow)} vs {(poh, pow_)}")
+            return ShapeInfo(size=nf_total * oh * ow, channels=nf_total,
+                             height=oh, width=ow)
+        total = sum(int((p or {}).get("size") or info.size)
+                    for p, info in zip(projs, in_infos))
+        return ShapeInfo(size=total,
+                         is_sequence=any(i.is_sequence for i in in_infos))
+
+    def params(self, cfg, in_infos):
+        projs = cfg.attrs.get("projections") or [
+            {"type": "identity"} for _ in in_infos]
+        specs: Dict[str, ParamSpec] = {}
+        for i, info in enumerate(in_infos):
+            psize = int((projs[i] or {}).get("size") or info.size)
+            sub_cfg = dataclasses.replace(cfg, size=psize)
+            specs.update(self._param_for(i, projs[i] or {}, info, sub_cfg))
+        if cfg.bias:
+            if any((p or {}).get("type") in ("conv", "convt")
+                   for p in projs):
+                # reference concat2 with conv projections: shared biases,
+                # one per output channel (config_parser.py:3039-3047)
+                bias_size = sum(int(p["num_filters"]) for p in projs)
+            else:
+                bias_size = self.infer(cfg, in_infos).size
+            specs["wbias"] = ParamSpec(shape=(bias_size,), init="zeros",
+                                       is_bias=True)
+        return specs
+
+    def apply(self, cfg, params, ins, ctx):
+        projs = cfg.attrs.get("projections") or [
+            {"type": "identity"} for _ in ins]
+        outs = []
+        for i, (a, proj) in enumerate(zip(ins, projs)):
+            kind = (proj or {}).get("type", "identity")
+            if kind in ("conv", "convt"):
+                # NHWC maps concat on the channel axis (inception blocks)
+                outs.append(_conv_project(proj, a, params[f"w{i}"],
+                                          ctx.in_infos[i]))
+            elif kind == "context":
+                outs.append(_context_project(proj, a,
+                                             params.get(f"w{i}")))
+            else:
+                x = a.value if kind == "table" else _flat(a)
+                outs.append(_project(proj or {}, x, params.get(f"w{i}")))
+        out = jnp.concatenate(outs, axis=-1)
+        if "wbias" in params:
+            out = out + params["wbias"]
+        return Argument(value=out, mask=_first_mask(ins))

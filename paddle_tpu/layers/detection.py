@@ -126,16 +126,16 @@ class PriorBoxLayer(LayerImpl):
 @register_layer("multibox_loss")
 class MultiBoxLossLayer(LayerImpl):
     """Inputs = (priorbox [N,8], gt label sequence [B, G, 5]
-    (class, xmin, ymin, xmax, ymax) with mask, conf pred [B, N*C],
-    loc pred [B, N*4]). attrs: num_classes (incl background 0),
-    overlap_threshold, neg_pos_ratio, background_id.
-    Output: per-sample cost [B, 1]."""
+    (class, xmin, ymin, xmax, ymax) with mask, loc pred [B, N*4],
+    conf pred [B, N*C]) — the reference's input order. attrs:
+    num_classes (incl background 0), overlap_threshold, neg_pos_ratio,
+    background_id. Output: per-sample cost [B, 1]."""
 
     def infer(self, cfg, in_infos):
         return ShapeInfo(size=1)
 
     def apply(self, cfg, params, ins, ctx):
-        prior_a, gt_a, conf_a, loc_a = ins
+        prior_a, gt_a, loc_a, conf_a = ins  # reference input order
         C = cfg.attrs["num_classes"]
         thresh = cfg.attrs.get("overlap_threshold", 0.5)
         neg_ratio = cfg.attrs.get("neg_pos_ratio", 3.0)
@@ -210,15 +210,15 @@ def nms_fixed(boxes, scores, iou_thresh, max_out):
 
 @register_layer("detection_output")
 class DetectionOutputLayer(LayerImpl):
-    """Inputs = (priorbox, conf pred, loc pred). Decode + per-class NMS +
-    keep_top_k. Output [B, keep_top_k, 7]:
+    """Inputs = (priorbox, loc pred, conf pred) — the reference's input
+    order. Decode + per-class NMS + keep_top_k. Output [B, keep_top_k, 7]:
     (label, score, xmin, ymin, xmax, ymax, valid)."""
 
     def infer(self, cfg, in_infos):
         return ShapeInfo(size=cfg.attrs.get("keep_top_k", 200) * 7)
 
     def apply(self, cfg, params, ins, ctx):
-        prior_a, conf_a, loc_a = ins
+        prior_a, loc_a, conf_a = ins  # reference input order
         C = cfg.attrs["num_classes"]
         bg = cfg.attrs.get("background_id", 0)
         conf_th = cfg.attrs.get("confidence_threshold", 0.01)

@@ -283,7 +283,11 @@ class LstmStepLayer(LayerImpl):
     def params(self, cfg, in_infos):
         size = in_infos[0].size // 4
         if cfg.bias:
-            return {"wbias": ParamSpec(shape=(7 * size,), init="zeros",
+            # the reference lstm_step bias is ONLY the three peephole
+            # check vectors (create_bias_parameter(bias, size * 3),
+            # config_parser.py:3111; LstmStepLayer.cpp:84) — gate biases
+            # belong to the input projection layer
+            return {"wbias": ParamSpec(shape=(3 * size,), init="zeros",
                                        is_bias=True)}
         return {}
 
@@ -295,10 +299,9 @@ class LstmStepLayer(LayerImpl):
         act_state = _act(cfg.attrs.get("active_state_type", "tanh"))
         if "wbias" in params:
             b = params["wbias"]
-            gates = gates + b[: 4 * size]
-            check_i = b[4 * size: 5 * size]
-            check_f = b[5 * size: 6 * size]
-            check_o = b[6 * size: 7 * size]
+            check_i = b[:size]
+            check_f = b[size: 2 * size]
+            check_o = b[2 * size: 3 * size]
         else:
             z = jnp.zeros((size,), gates.dtype)
             check_i = check_f = check_o = z

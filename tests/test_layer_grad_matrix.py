@@ -136,6 +136,14 @@ def _case_concat():
             {"a": _dense(), "b": _dense(d=4, seed=1)})
 
 
+def _case_concat2():
+    ld = L("out", "concat2", ["a", "b"], size=8, act="tanh",
+           projections=[{"type": "full_matrix", "size": 4},
+                        {"type": "identity", "size": 4}])
+    return ([("a", 6, {}), ("b", 4, {})], ld,
+            {"a": _dense(), "b": _dense(d=4, seed=1)})
+
+
 def _case_mixed():
     ld = L("out", "mixed", ["a", "b"], size=4, act="tanh",
            projections=[{"type": "full_matrix"}, {"type": "dot_mul"}])
@@ -541,7 +549,8 @@ GRAD_CASES = {
     "fc": _case_fc, "embedding": _case_embedding, "exconv": _case_conv,
     "exconvt": _case_convt, "pool": _case_pool, "norm": _case_norm,
     "batch_norm": _case_batch_norm, "addto": _case_addto,
-    "concat": _case_concat, "mixed": _case_mixed,
+    "concat": _case_concat,
+    "concat2": _case_concat2, "mixed": _case_mixed,
     "lstmemory": _case_lstmemory, "gated_recurrent": _case_gru,
     "recurrent": _case_recurrent, "mdlstmemory": _case_mdlstm,
     "gru_step": _case_gru_step, "lstm_step": _case_lstm_step,

@@ -234,13 +234,16 @@ def test_lstm_step_with_get_output():
     _, params, outs = _run([h, st], {
         "g": Argument(value=jnp.asarray(gv)),
         "c": Argument(value=jnp.asarray(cv))})
+    # lstm_step bias is the 3 peephole check vectors only (the gate bias
+    # belongs to the input projection), matching the reference's
+    # create_bias_parameter(bias, size * 3)
     b = np.asarray(params["_h.wbias"])
-    gates = gv + b[:4 * H]
-    gi, gig, gfg, gog = np.split(gates, 4, axis=-1)
+    assert b.shape == (3 * H,)
+    gi, gig, gfg, gog = np.split(gv, 4, axis=-1)
     sig = lambda z: 1 / (1 + np.exp(-z))
-    state = np.tanh(gi) * sig(gig + cv * b[4*H:5*H]) \
-        + cv * sig(gfg + cv * b[5*H:6*H])
-    outv = sig(gog + state * b[6*H:7*H]) * np.tanh(state)
+    state = np.tanh(gi) * sig(gig + cv * b[:H]) \
+        + cv * sig(gfg + cv * b[H:2*H])
+    outv = sig(gog + state * b[2*H:3*H]) * np.tanh(state)
     np.testing.assert_allclose(np.asarray(outs[h.name].value), outv,
                                rtol=1e-4, atol=1e-5)
     np.testing.assert_allclose(np.asarray(outs[st.name].value), state,
