@@ -166,3 +166,25 @@ def test_imikolov_real_ptb_parses(data_home):
     assert grams, "n-gram reader produced nothing"
     assert all(len(g) == 3 for g in grams)
     assert all(0 <= t < len(wd) + 2 for g in grams for t in g)
+
+
+def test_mq2007_real_letor_file_parses(data_home):
+    mq = _mod("mq2007")
+    d = data_home / "mq2007"
+    d.mkdir()
+    rng = np.random.RandomState(0)
+    lines = []
+    for qid in (101, 102):
+        for doc in range(4):
+            feats = " ".join(f"{j + 1}:{rng.rand():.4f}" for j in range(46))
+            lines.append(f"{doc % 3} qid:{qid} {feats} #docid={qid}-{doc}")
+    for split in ("train", "test"):
+        (d / f"{split}.txt").write_text("\n".join(lines) + "\n")
+    pairs = list(mq.train(format="pairwise")())
+    assert pairs, "pairwise reader empty"
+    score, a, b = pairs[0]  # (label, better-doc feats, worse-doc feats)
+    assert float(score[0]) == 1.0
+    assert len(np.asarray(a).reshape(-1)) == 46
+    assert len(np.asarray(b).reshape(-1)) == 46
+    lw = list(mq.train(format="listwise")())
+    assert lw and len(lw[0]) == 2  # (labels, feature list) per query
