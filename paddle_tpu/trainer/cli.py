@@ -46,6 +46,8 @@ def parse_args(argv=None):
                    help="print a progress dot every N batches")
     p.add_argument("--show_parameter_stats_period", type=int, default=0,
                    help="log the parameter health dump every N batches")
+    p.add_argument("--show_layer_stat", action="store_true",
+                   help="log per-layer output stats at each log_period")
     p.add_argument("--save_dir", default=None,
                    help="checkpoint directory (train) / source (test,merge)")
     p.add_argument("--saving_period", type=int, default=1)
@@ -211,6 +213,7 @@ def cmd_train(ns, args):
                   dot_period=args.dot_period,
                   show_parameter_stats_period=(
                       args.show_parameter_stats_period),
+                  show_layer_stat=args.show_layer_stat,
                   checkpointer=ck)
     return 0
 

@@ -261,7 +261,8 @@ class SGD:
     def train(self, reader, *, feeder=None, num_passes: int = 1,
               event_handler: Optional[Callable] = None,
               log_period: int = 0, checkpointer=None,
-              dot_period: int = 0, show_parameter_stats_period: int = 0):
+              dot_period: int = 0, show_parameter_stats_period: int = 0,
+              show_layer_stat: bool = False):
         """reader yields minibatches (lists of sample tuples); feeder
         converts them to Arguments (or pass feed dicts directly).
         ``log_period``>0 logs a TrainerStats-style line and dumps+resets the
@@ -270,7 +271,9 @@ class SGD:
         every N batches (``--dot_period``, ``Flags.cpp``);
         ``show_parameter_stats_period``>0 logs the parameter health dump
         every N batches (``showParameterStats``,
-        ``TrainerInternal.cpp:81-88``). ``checkpointer``
+        ``TrainerInternal.cpp:81-88``); ``show_layer_stat`` logs every
+        layer output's mean/abs-max at each log_period
+        (``--show_layer_stat``, ``Flags.cpp:71``). ``checkpointer``
         (dist.Checkpointer) restores the newest intact checkpoint before
         training — resuming at the pass after the saved one, the
         ``--start_pass`` semantics of ``Trainer.cpp:229-250`` — and saves
@@ -356,6 +359,11 @@ class SGD:
                                      include_printers=False)}.items()))
                     logger.info("\n%s", global_stat.status(reset=True))
                     window_cost, window_n = 0.0, 0
+                    if show_layer_stat:
+                        for lname, st in self.layer_stats(feed).items():
+                            logger.info(
+                                "Layer %s: avg_abs=%.5g max_abs=%.5g",
+                                lname, st["avg_abs"], st["max_abs"])
                 event_handler(ev.EndIteration(pass_id, batch_id, cost, evals))
                 if checkpointer is not None:
                     checkpointer.maybe_save(self.params, self.opt_state,
@@ -469,6 +477,33 @@ class SGD:
         raw = jax.device_get(_param_stats_jit(self.params))
         return {n: {"avg_abs": float(a), "max_abs": float(m),
                     "size": int(self.params[n].size)}
+                for n, (a, m) in raw.items()}
+
+    def layer_stats(self, feed) -> Dict[str, Dict[str, float]]:
+        """Per-layer output stats on one batch (``--show_layer_stat``,
+        ``Flags.cpp:71``): a jitted full-graph forward that returns every
+        layer's mean |out| and max |out| (compiled once, cached)."""
+        if not hasattr(self, "_layer_stat_fn"):
+            # the EXECUTED subgraph only (self.network): off-path layers
+            # have no parameters in self.params and possibly no feeds.
+            # Same compute dtype as training so the stats reflect the
+            # numerics the step actually sees (bf16 range problems are
+            # exactly what this flag exists to surface).
+            net = self.network
+
+            @jax.jit
+            def stat_fn(params, feed):
+                outs = net.apply(self._cast_compute(params),
+                                 self._cast_compute(feed), train=False)
+                return {n: (jnp.mean(jnp.abs(a.value)),
+                            jnp.max(jnp.abs(a.value)))
+                        for n, a in outs.items()
+                        if hasattr(a.value, "dtype")
+                        and jnp.issubdtype(a.value.dtype, jnp.inexact)}
+
+            self._layer_stat_fn = stat_fn
+        raw = jax.device_get(self._layer_stat_fn(self.params, feed))
+        return {n: {"avg_abs": float(a), "max_abs": float(m)}
                 for n, (a, m) in raw.items()}
 
     # ------------------------------------------------------------ forward
