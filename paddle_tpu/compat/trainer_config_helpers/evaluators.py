@@ -39,7 +39,14 @@ def evaluator_base(input, type, label=None, weight=None, name=None,
     if weight is not None:
         names.append(weight.name if hasattr(weight, "name") else str(weight))
     c = ctx()
-    cfg = {"name": name or c.auto_name(f"{type}_evaluator"),
+    name = name or c.auto_name(f"{type}_evaluator")
+    taken = {e["name"] for e in c.evaluators}
+    if name in taken:  # multi-cost configs: never silently shadow
+        k = 1
+        while f"{name}_{k}" in taken:
+            k += 1
+        name = f"{name}_{k}"
+    cfg = {"name": name,
            "type": type, "input_layers": names,
            # role map so the trainer binds eval_batch kwargs correctly
            # (flat input_layers is the proto contract; roles are wiring-only)

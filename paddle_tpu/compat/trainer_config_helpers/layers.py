@@ -1130,11 +1130,30 @@ def _cost(name, prefix, type_, inputs, coeff=1.0, attrs=None,
 def classification_cost(input, label, weight=None, name=None,
                         evaluator=None, top_k=None, layer_attr=None,
                         coeff=1.0):
-    ins = [_one(input), _one(label)]
-    if weight is not None:
-        ins.append(_one(weight))
-    return _cost(name, "cost", "multi-class-cross-entropy", ins,
-                 coeff=coeff, layer_attr=layer_attr)
+    inp, lab = _one(input), _one(label)
+    w = _one(weight) if weight is not None else None
+    ins = [inp, lab] + ([w] if w is not None else [])
+    out = _cost(name, "cost", "multi-class-cross-entropy", ins,
+                coeff=coeff, layer_attr=layer_attr)
+    # the reference attaches a classification_error evaluator by default
+    # (`layers.py:4086,4122-4134`); it lands in ctx().evaluators and the
+    # exported ModelConfig.evaluators
+    from paddle_tpu.compat.trainer_config_helpers.evaluators import (
+        classification_error_evaluator)
+    evs = evaluator if evaluator is not None \
+        else classification_error_evaluator
+    if not isinstance(evs, (list, tuple)):
+        evs = [evs]
+    for e in evs:
+        if e is None:
+            continue
+        # exactly the reference's __add_evaluator__ call shape
+        # (name/input/label/weight only); this intentionally reports
+        # alongside the trainer's built-in cost-derived metric, as the
+        # reference's per-batch evaluator does
+        e(name=getattr(e, "__name__", "evaluator"), input=inp, label=lab,
+          weight=w)
+    return out
 
 
 def cross_entropy(input, label, name=None, coeff=1.0, weight=None,

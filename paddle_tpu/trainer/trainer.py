@@ -495,9 +495,19 @@ class SGD:
             def stat_fn(params, feed):
                 outs = net.apply(self._cast_compute(params),
                                  self._cast_compute(feed), train=False)
-                return {n: (jnp.mean(jnp.abs(a.value)),
-                            jnp.max(jnp.abs(a.value)))
-                        for n, a in outs.items()
+
+                def stats(a):
+                    v = jnp.abs(a.value)
+                    if a.mask is not None and v.ndim >= 2 \
+                            and a.mask.shape == v.shape[:a.mask.ndim]:
+                        m = a.mask.reshape(
+                            a.mask.shape + (1,) * (v.ndim - a.mask.ndim))
+                        n = jnp.maximum(jnp.sum(m), 1.0) * (
+                            v.size / max(1, m.size))
+                        return (jnp.sum(v * m) / n, jnp.max(v * m))
+                    return jnp.mean(v), jnp.max(v)
+
+                return {n: stats(a) for n, a in outs.items()
                         if hasattr(a.value, "dtype")
                         and jnp.issubdtype(a.value.dtype, jnp.inexact)}
 

@@ -128,6 +128,9 @@ def test_golden_protostr_structural_parity(name):
     assert ours_params == ref_params
     assert list(ours.input_layer_names) == list(ref.input_layer_names)
     assert list(ours.output_layer_names) == list(ref.output_layer_names)
+    assert [(e.type, e.name, list(e.input_layers)) for e in
+            ours.evaluators] == \
+        [(e.type, e.name, list(e.input_layers)) for e in ref.evaluators]
 
 
 @needs_ref
