@@ -1137,22 +1137,27 @@ def classification_cost(input, label, weight=None, name=None,
                 coeff=coeff, layer_attr=layer_attr)
     # the reference attaches a classification_error evaluator by default
     # (`layers.py:4086,4122-4134`); it lands in ctx().evaluators and the
-    # exported ModelConfig.evaluators
+    # exported ModelConfig.evaluators. Opt out with evaluator=[] (None
+    # means "the default", matching the reference's signature semantics).
     from paddle_tpu.compat.trainer_config_helpers.evaluators import (
         classification_error_evaluator)
-    evs = evaluator if evaluator is not None \
-        else classification_error_evaluator
-    if not isinstance(evs, (list, tuple)):
-        evs = [evs]
-    for e in evs:
-        if e is None:
-            continue
-        # exactly the reference's __add_evaluator__ call shape
-        # (name/input/label/weight only); this intentionally reports
-        # alongside the trainer's built-in cost-derived metric, as the
-        # reference's per-batch evaluator does
-        e(name=getattr(e, "__name__", "evaluator"), input=inp, label=lab,
-          weight=w)
+    if evaluator is None:
+        # default evaluator understands top_k; forward it
+        classification_error_evaluator(
+            name="classification_error_evaluator", input=inp, label=lab,
+            weight=w, top_k=top_k)
+    else:
+        evs = evaluator if isinstance(evaluator, (list, tuple)) \
+            else [evaluator]
+        for e in evs:
+            if e is None:
+                continue
+            # exactly the reference's __add_evaluator__ call shape
+            # (name/input/label/weight only); reports alongside the
+            # trainer's built-in cost-derived metric, as the reference's
+            # per-batch evaluator does
+            e(name=getattr(e, "__name__", "evaluator"), input=inp,
+              label=lab, weight=w)
     return out
 
 
