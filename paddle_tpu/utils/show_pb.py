@@ -1,0 +1,50 @@
+"""Print serialized contract protos (`python/paddle/utils/show_pb.py`).
+
+The reference tool dumps proto-buffer data files; here the common case is
+inspecting a serialized ``ModelConfig``/``TrainerConfig`` blob (e.g. the
+bytes `parse_config_and_serialize` emits, or the config half of a merged
+deploy model)::
+
+    python -m paddle_tpu.utils.show_pb model.bin
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def show(path: str, out=None) -> str:
+    """Parse ``path`` as TrainerConfig, falling back to ModelConfig, and
+    return (and optionally print) the text format."""
+    from paddle_tpu.proto import ModelConfig_pb2, TrainerConfig_pb2
+    blob = open(path, "rb").read()
+    last_err = None
+    for cls in (TrainerConfig_pb2.TrainerConfig,
+                ModelConfig_pb2.ModelConfig):
+        try:
+            msg = cls.FromString(blob)
+        except Exception as e:  # noqa: BLE001 - try the next schema
+            last_err = e
+            continue
+        # prefer the parse that actually consumed recognizable fields
+        if msg.ByteSize() or not blob:
+            txt = f"# {cls.__name__}\n{msg}"
+            if out is not None:
+                print(txt, file=out)
+            return txt
+    raise ValueError(f"{path}: not a TrainerConfig/ModelConfig blob "
+                     f"({last_err})")
+
+
+def main(argv=None):
+    args = argv if argv is not None else sys.argv[1:]
+    if len(args) != 1:
+        print("usage: python -m paddle_tpu.utils.show_pb <proto-file>",
+              file=sys.stderr)
+        return 2
+    show(args[0], out=sys.stdout)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

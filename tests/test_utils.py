@@ -116,3 +116,31 @@ def test_trainer_log_period_and_param_stats(caplog):
     assert "trainBatch" in text  # the StatSet dump ran and was formatted
     stats = t.parameter_stats()
     assert any(v["size"] > 0 for v in stats.values())
+
+
+def test_show_pb_prints_serialized_model_config(tmp_path):
+    """utils/show_pb (the reference's show_pb.py): dump a serialized
+    contract proto as text."""
+    import os
+    os.environ.setdefault("XLA_FLAGS", "")
+    from paddle_tpu.compat import parse_config
+    from paddle_tpu.utils import show_pb
+    import pathlib
+    cfg = tmp_path / "conf.py"
+    cfg.write_text(
+        "from paddle.trainer_config_helpers import *\n"
+        "settings(batch_size=8, learning_rate=0.1)\n"
+        "x = data_layer(name='x', size=4)\n"
+        "y = data_layer(name='y', size=2)\n"
+        "out = fc_layer(input=x, size=2, act=SoftmaxActivation())\n"
+        "outputs(classification_cost(input=out, label=y))\n")
+    parsed = parse_config(str(cfg))
+    blob = tmp_path / "model.bin"
+    blob.write_bytes(parsed.model_proto().SerializeToString())
+    txt = show_pb.show(str(blob))
+    assert "ModelConfig" in txt and "__fc_layer_0__" in txt
+    import pytest
+    with pytest.raises(ValueError):
+        bad = tmp_path / "bad.bin"
+        bad.write_bytes(b"\xff\xfe\xfd not a proto")
+        show_pb.show(str(bad))
