@@ -296,14 +296,34 @@ class MixedLayer(LayerImpl):
             raise NotImplementedError(
                 "a mixed layer cannot combine conv projections with flat "
                 "projections")
-        if cfg.attrs.get("operators"):
-            # conv/dotmul OPERATORS (MixedLayer.cpp's Operator path) are
-            # config/proto-representable but not executed by this engine
-            raise NotImplementedError(
-                "mixed-layer operators (conv_operator/dotmul_operator) "
-                "are not executable; use conv_projection / a conv layer")
+        op_terms = []
+        op_arg_idx = set()
+        for op in cfg.attrs.get("operators") or []:
+            idxs = list(op.get("input_indices", []))
+            op_arg_idx.update(idxs)
+            if op.get("type") in ("dot_mul", "dot_mul_op"):
+                # DotMulOperator.cpp: elementwise a*b (*scale) added into
+                # the mixed sum; both args are dynamic layer outputs of
+                # equal width (the reference CHECKs this)
+                a_in, b_in = ins[idxs[0]], ins[idxs[1]]
+                av, bv = _flat(a_in), _flat(b_in)
+                if av.shape[-1] != bv.shape[-1]:
+                    raise ValueError(
+                        f"dotmul_operator argument widths differ: "
+                        f"{av.shape[-1]} vs {bv.shape[-1]}")
+                op_terms.append(av * bv * float(op.get("scale", 1.0)))
+            else:
+                # ConvOperator (dynamic per-sample filters) stays
+                # config/proto-representable but unexecuted
+                raise NotImplementedError(
+                    f"mixed-layer operator {op.get('type')!r} is not "
+                    "executable; use conv_projection / a conv layer")
         out = None
+        for t in op_terms:
+            out = t if out is None else out + t
         for i, (a, proj) in enumerate(zip(ins, projs)):
+            if i in op_arg_idx:
+                continue  # operator argument slots carry no projection
             kind = proj.get("type", "full_matrix")
             if kind in ("conv", "convt"):
                 y = _conv_project(proj, a, params[f"w{i}"],

@@ -352,3 +352,53 @@ def test_detection_stack():
     assert lv.shape == (B, 1) and np.isfinite(lv).all() and (lv > 0).all()
     dv = np.asarray(outs[det.name].value)
     assert dv.shape == (B, 5, 7)
+
+
+def test_mixed_dotmul_operator_executes():
+    """dotmul_operator inside a mixed layer (DotMulOperator.cpp): the
+    elementwise product of two dynamic inputs joins the projection sum."""
+    B, D = 3, 5
+    rng = np.random.RandomState(0)
+    av, bv, cv = (rng.randn(B, D).astype(np.float32) for _ in range(3))
+    dsl.reset()
+    a = dsl.data("a", size=D)
+    b = dsl.data("b", size=D)
+    c = dsl.data("c", size=D)
+    out = dsl.mixed([a, b, c], size=D, projections=[
+        {"type": "identity_op_arg"}, {"type": "identity_op_arg"},
+        {"type": "identity"}])
+    g = dsl.current_graph()
+    g.layers[out.name].attrs["operators"] = [
+        {"type": "dot_mul", "input_indices": [0, 1], "scale": 2.0}]
+    _, params, outs = _run([out], {
+        "a": Argument(value=jnp.asarray(av)),
+        "b": Argument(value=jnp.asarray(bv)),
+        "c": Argument(value=jnp.asarray(cv))})
+    want = 2.0 * av * bv + cv
+    np.testing.assert_allclose(np.asarray(outs[out.name].value), want,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_gated_unit_executes_through_public_path():
+    """gated_unit_layer builds mixed(input=dotmul_operator(...)) via the
+    real helper (operator type 'dot_mul_op') — the operator must execute,
+    not raise, and equal proj * sigmoid(gate)."""
+    from paddle_tpu.compat import install_paddle_alias
+    from paddle_tpu.compat.config_parser import begin_parse
+    install_paddle_alias()
+    begin_parse()
+    import importlib
+    tch = importlib.import_module("paddle.trainer_config_helpers")
+    x = tch.data_layer(name="x", size=6)
+    g = tch.gated_unit_layer(input=x, size=6)
+    net = Network(dsl.current_graph(), outputs=[g.name])
+    params = net.init_params(jax.random.PRNGKey(0))
+    xv = np.random.RandomState(0).randn(3, 6).astype(np.float32)
+    outs = net.apply(params, {"x": Argument(value=jnp.asarray(xv))})
+    got = np.asarray(outs[g.name].value)
+    assert got.shape == (3, 6)
+    # reproduce by hand from the sub-layer outputs
+    proj = np.asarray(
+        outs["__gated_unit_layer_0___input_proj"].value)
+    gate = np.asarray(outs["__gated_unit_layer_0___gate"].value)
+    np.testing.assert_allclose(got, proj * gate, rtol=1e-5, atol=1e-6)
