@@ -323,6 +323,20 @@ def _expand_group(model, net, gname, layer, mc, rename, root_names,
         m = {"layer_name": f"{mem['link']}@{gname}", "link_name": agent}
         if mem["boundary"] in boot_of:
             m["boot_layer_name"] = boot_of[mem["boundary"]]
+        init = mem.get("init", 0.0)
+        if init:
+            # the wire format only carries integral boot constants
+            # (MemoryConfig.boot_with_const_id); non-integral values are
+            # a native-DSL extension that cannot round-trip
+            if float(init) == int(init):
+                m["boot_with_const_id"] = int(init)
+            else:
+                from paddle_tpu.utils import logger
+                logger.warning(
+                    "memory %s: non-integral boot_with_const_value %r "
+                    "cannot be represented in the wire format; an "
+                    "imported copy of this model boots at 0.0",
+                    mem["link"], init)
         entry["memories"].append(m)
         entry["layer_names"].append(agent)
 
@@ -432,6 +446,8 @@ def model_to_proto(model: ModelDef, context=None) -> "ModelConfig_pb2.ModelConfi
             pm.link_name = m["link_name"]
             if m.get("boot_layer_name"):
                 pm.boot_layer_name = m["boot_layer_name"]
+            if m.get("boot_with_const_id") is not None:
+                pm.boot_with_const_id = m["boot_with_const_id"]
         for outer, link, _subseq in e["in_links"]:
             pl = sm.in_links.add()
             pl.layer_name = outer

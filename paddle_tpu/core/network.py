@@ -170,9 +170,14 @@ class Network:
         for name in self.order:
             layer = self.model.layers[name]
             impl = get_layer_impl(layer.type)
-            if layer.type == "data":
+            if layer.type == "data" or (
+                    getattr(impl, "feed_slot", False) and not layer.inputs):
+                # data layers and input-less agents (scatter_agent / memory
+                # agents of an expanded recurrent sub-model) are fed by name
                 if name not in feed:
-                    raise KeyError(f"missing feed for data layer {name!r}")
+                    what = ("data layer" if layer.type == "data"
+                            else f"{layer.type} feed slot")
+                    raise KeyError(f"missing feed for {what} {name!r}")
                 ctx.outputs[name] = feed[name]
                 continue
             ins = [ctx.outputs[i] for i in layer.input_names()]

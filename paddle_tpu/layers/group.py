@@ -71,6 +71,19 @@ class RecurrentLayerGroup(LayerImpl):
         boot: Dict[str, jnp.ndarray] = {}
         mask = None
         for a, m in zip(ins, ins_meta):
+            kind = m["kind"]
+            if kind == "auto":
+                # wire-imported groups (compat/proto_import.py) cannot
+                # recover the link kind from the proto; resolve it from
+                # the Argument the way the reference engine inspects
+                # hasSubseq at runtime
+                if a.mask is not None and a.mask.ndim == 3:
+                    kind = "subseq"
+                elif a.mask is None:
+                    kind = "static"
+                else:
+                    kind = "seq"
+                m = dict(m, kind=kind)
             if m["kind"] == "seq":
                 xs[m["boundary"]] = jnp.swapaxes(a.value, 0, 1)
                 if mask is None and a.mask is not None:

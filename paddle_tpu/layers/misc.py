@@ -23,13 +23,126 @@ from paddle_tpu.layers.conv import to_nhwc
 class AgentLayer(LayerImpl):
     """``AgentLayer.cpp``: forwards another layer's output unchanged (the
     reference wires it by name across sub-model boundaries; here groups
-    pass boundaries explicitly, so agent is identity)."""
+    pass boundaries explicitly, so agent is identity). In the expanded
+    wire format (recurrent sub-models) memory agents have *no* config
+    inputs and are fed at runtime — the executor treats an input-less
+    agent as a feed slot (``feed_slot``)."""
+
+    feed_slot = True
 
     def infer(self, cfg, in_infos):
+        if not in_infos:
+            return ShapeInfo(size=cfg.size or 0,
+                             is_sequence=cfg.attrs.get("is_sequence", False))
         return in_infos[0]
 
     def apply(self, cfg, params, ins, ctx):
         return ins[0]
+
+
+@register_layer("scatter_agent")
+class ScatterAgentLayer(LayerImpl):
+    """``AgentLayer.cpp:209`` (``REGISTER_LAYER(scatter_agent, ...)``):
+    inside an expanded recurrent sub-model, the in-link boundary that
+    receives one timestep's frame of the outer sequence. The reference
+    wires it at runtime via ``setRealLayer`` (``AgentLayer.h:133``); here
+    the group executor feeds it by name each scan step, so it is a feed
+    slot when input-less and an identity connector when wired
+    explicitly."""
+
+    feed_slot = True
+
+    def infer(self, cfg, in_infos):
+        if not in_infos:
+            return ShapeInfo(size=cfg.size or 0,
+                             is_sequence=cfg.attrs.get("is_sequence", False))
+        return in_infos[0]
+
+    def apply(self, cfg, params, ins, ctx):
+        return ins[0]
+
+
+@register_layer("gather_agent")
+class GatherAgentLayer(LayerImpl):
+    """``AgentLayer.cpp:209`` (``REGISTER_LAYER(gather_agent, ...)``):
+    collects the per-frame outputs of a recurrent sub-model back into one
+    sequence (``GatherAgentLayer::forward`` copies each real layer's rows
+    via ``copyByRowIndex``). In the scan-based engine the stacking happens
+    inside the group body, so a gather over one wired input is identity;
+    several wired inputs concatenate along time in order — the flat-frame
+    equivalent of gathering multiple real layers."""
+
+    def infer(self, cfg, in_infos):
+        if not in_infos:
+            return ShapeInfo(size=cfg.size or 0, is_sequence=True)
+        return dataclasses.replace(in_infos[0], is_sequence=True)
+
+    def apply(self, cfg, params, ins, ctx):
+        if len(ins) == 1:
+            return ins[0]
+        vals = [a.value for a in ins]
+        masks = [a.mask if a.mask is not None
+                 else jnp.ones(a.value.shape[:2], jnp.float32) for a in ins]
+        return Argument(value=jnp.concatenate(vals, axis=1),
+                        mask=jnp.concatenate(masks, axis=1))
+
+
+@register_layer("out_prod")
+class OuterProdLayer(LayerImpl):
+    """``OuterProdLayer.cpp:48``: per-sample outer product of two vectors,
+    out[b] = flatten(x0[b] ⊗ x1[b]) — (B, d0) × (B, d1) → (B, d0*d1).
+    Used by neural-turing-machine-style addressing. One batched einsum on
+    the MXU instead of the reference's per-row GEMM loop."""
+
+    def infer(self, cfg, in_infos):
+        if in_infos[0].is_sequence != in_infos[1].is_sequence:
+            raise ValueError(
+                "out_prod needs two inputs of the same kind (both "
+                "sequence or both non-sequence); the reference pairs "
+                "rows 1:1 (OuterProdLayer.cpp CHECK_EQ on heights)")
+        return ShapeInfo(size=in_infos[0].size * in_infos[1].size,
+                         is_sequence=in_infos[0].is_sequence)
+
+    def apply(self, cfg, params, ins, ctx):
+        x0, x1 = ins[0].value, ins[1].value
+        out = jnp.einsum("...i,...j->...ij", x0, x1)
+        out = out.reshape(out.shape[:-2] + (x0.shape[-1] * x1.shape[-1],))
+        from paddle_tpu.layers.common import _first_mask
+        return Argument(value=out, mask=_first_mask(ins))
+
+
+@register_layer("data_norm")
+class DataNormLayer(LayerImpl):
+    """``DataNormLayer.cpp:21``: normalize dense input features with
+    *precomputed* statistics held in one static 5×size parameter
+    (rows: min, 1/(max-min), mean, 1/std, 1/10^j — layout from
+    ``DataNormLayer::init``). Strategies: z-score (x-mean)*stdRecip,
+    min-max (x-min)*rangeRecip, decimal-scaling x*decimalRecip. The
+    parameter is static (never trained); gradients still flow to the
+    input through the affine map, matching ``DataNormLayer::backward``."""
+
+    def infer(self, cfg, in_infos):
+        return dataclasses.replace(in_infos[0])
+
+    def params(self, cfg, in_infos):
+        return {"w0": ParamSpec(shape=(5, in_infos[0].size), init="zeros",
+                                is_static=True)}
+
+    def apply(self, cfg, params, ins, ctx):
+        w = params["w0"]
+        mode = cfg.attrs.get("data_norm_strategy", "z-score")
+        x = ins[0].value
+        if mode == "z-score":
+            out = (x - w[2]) * w[3]
+        elif mode == "min-max":
+            out = (x - w[0]) * w[1]
+        elif mode == "decimal-scaling":
+            out = x * w[4]
+        else:
+            raise ValueError(
+                f"unknown data normalization strategy {mode!r} "
+                "(z-score | min-max | decimal-scaling)")
+        return ins[0].with_value(out)
 
 
 @register_layer("clip")

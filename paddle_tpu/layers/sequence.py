@@ -12,7 +12,8 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from paddle_tpu.core.argument import Argument
-from paddle_tpu.core.registry import LayerImpl, ShapeInfo, register_layer
+from paddle_tpu.core.registry import (LayerImpl, ParamSpec, ShapeInfo,
+                                      register_layer)
 
 _NEG_INF = -1e30
 
@@ -137,3 +138,45 @@ class SeqConcatLayer(LayerImpl):
                                  .repeat(D, -1), axis=1)
         v = jnp.where((pos < la[:, None])[..., None], va, vb) * mask[..., None]
         return Argument(value=v, mask=mask)
+
+
+@register_layer("subseq")
+class SubSequenceLayer(LayerImpl):
+    """``SubSequenceLayer.cpp:45``: take a sub-span of each sequence given
+    per-sequence (offset, size) id inputs — out[b] = x[b, off[b]:off[b]+
+    n[b]]. The reference copies ragged row ranges and rewrites
+    ``sequenceStartPositions``; here it is one gather with a recomputed
+    mask (the span shifts to position 0, matching the reference's packed
+    output). Inputs: sequence [B,T,D], offsets ids [B], sizes ids [B];
+    optional bias like the reference's ``biases_``."""
+
+    def infer(self, cfg, in_infos):
+        return ShapeInfo(size=in_infos[0].size, is_sequence=True)
+
+    def params(self, cfg, in_infos):
+        if cfg.bias:
+            return {"wbias": ParamSpec(shape=(in_infos[0].size,),
+                                       init="zeros", is_bias=True)}
+        return {}
+
+    def apply(self, cfg, params, ins, ctx):
+        a, off_a, size_a = ins
+        x = a.value
+        B, T = x.shape[0], x.shape[1]
+        off = off_a.value.reshape(B).astype(jnp.int32)
+        n = size_a.value.reshape(B).astype(jnp.int32)
+        pos = jnp.arange(T)[None, :]
+        idx = jnp.clip(pos + off[:, None], 0, T - 1)
+        out = jnp.take_along_axis(
+            x, idx[..., None].repeat(x.shape[-1], -1), axis=1)
+        mask = (pos < n[:, None]).astype(jnp.float32)
+        if a.mask is not None:
+            # a span reaching past the source sequence's true length must
+            # not mark padding as valid (the reference CHECKs spans are
+            # in range; with padded batches we clamp and mask instead)
+            src_valid = jnp.take_along_axis(a.mask, idx, axis=1)
+            mask = mask * src_valid
+        out = out * mask[..., None]
+        if "wbias" in params:
+            out = out + params["wbias"] * mask[..., None]
+        return Argument(value=out, mask=mask)

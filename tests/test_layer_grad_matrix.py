@@ -545,6 +545,47 @@ def _case_print():
     return ([("x", 4, {})], L("out", "print", ["x"]), {"x": _dense(d=4)})
 
 
+def _case_scatter_agent():
+    # wired identity (inside an expanded sub-model it is an input-less
+    # feed slot; tests/test_proto_import.py covers that execution mode)
+    return ([("x", 6, {})], L("out", "scatter_agent", ["x"]),
+            {"x": _dense()})
+
+
+def _case_gather_agent():
+    # two wired sequence inputs concatenate along time
+    return ([("x", 6, {"is_sequence": True}),
+             ("y", 6, {"is_sequence": True})],
+            L("out", "gather_agent", ["x", "y"]),
+            {"x": _seq(), "y": _seq(seed=3)})
+
+
+def _case_out_prod():
+    return ([("x", 3, {}), ("y", 4, {})],
+            L("out", "out_prod", ["x", "y"]),
+            {"x": _dense(d=3), "y": _dense(d=4, seed=5)})
+
+
+def _case_data_norm():
+    from paddle_tpu.config.model_config import ParamAttr
+    # random (non-zero) stats via the input param_attr so every strategy
+    # scales by something; the 5xD parameter itself is static
+    attr = ParamAttr(init="normal", initial_mean=0.1, initial_std=0.5)
+    return ([("x", 6, {})],
+            L("out", "data_norm", [Input("x", param_attr=attr)],
+              data_norm_strategy="z-score"),
+            {"x": _dense()})
+
+
+def _case_subseq():
+    b, t = 3, 6
+    off = Argument(value=jnp.asarray([0, 1, 2], jnp.int32))
+    n = Argument(value=jnp.asarray([3, 2, 4], jnp.int32))
+    return ([("x", 5, {"is_sequence": True}), ("off", 1, {}), ("n", 1, {})],
+            L("out", "subseq", ["x", "off", "n"]),
+            {"x": _seq(b=b, t=t, d=5, full=True), "off": off, "n": n})
+
+
 GRAD_CASES = {
     "fc": _case_fc, "embedding": _case_embedding, "exconv": _case_conv,
     "exconvt": _case_convt, "pool": _case_pool, "norm": _case_norm,
@@ -571,6 +612,10 @@ GRAD_CASES = {
     "selective_fc": _case_selective_fc, "prelu": _case_prelu,
     "multi_head_attention": _case_multi_head_attention,
     "agent": _case_agent,
+    "scatter_agent": _case_scatter_agent,
+    "gather_agent": _case_gather_agent,
+    "out_prod": _case_out_prod, "data_norm": _case_data_norm,
+    "subseq": _case_subseq,
     # costs
     "multi-class-cross-entropy": _case_xent,
     "multi_class_cross_entropy_with_selfnorm": _case_xent_selfnorm,
