@@ -325,18 +325,21 @@ def _expand_group(model, net, gname, layer, mc, rename, root_names,
             m["boot_layer_name"] = boot_of[mem["boundary"]]
         init = mem.get("init", 0.0)
         if init:
-            # the wire format only carries integral boot constants
-            # (MemoryConfig.boot_with_const_id); non-integral values are
-            # a native-DSL extension that cannot round-trip
-            if float(init) == int(init):
+            # MemoryConfig.boot_with_const_id is a uint32 token id in
+            # the reference (generation bootstrapping,
+            # RecurrentGradientMachine.cpp:255); it can carry our dense
+            # boot constant only when that constant is a non-negative
+            # integer — anything else is a native-DSL extension that
+            # cannot round-trip through the wire format
+            if float(init) == int(init) and init >= 0:
                 m["boot_with_const_id"] = int(init)
             else:
                 from paddle_tpu.utils import logger
                 logger.warning(
-                    "memory %s: non-integral boot_with_const_value %r "
-                    "cannot be represented in the wire format; an "
-                    "imported copy of this model boots at 0.0",
-                    mem["link"], init)
+                    "memory %s: boot_with_const_value %r is not a "
+                    "non-negative integer and cannot be represented in "
+                    "the wire format; an imported copy of this model "
+                    "boots at 0.0", mem["link"], init)
         entry["memories"].append(m)
         entry["layer_names"].append(agent)
 

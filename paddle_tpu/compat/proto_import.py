@@ -77,6 +77,12 @@ def _layer_def(lc, params) -> LayerDef:
             projs.append(_proj_spec(ic.proj_conf))
     if lc.type == "mixed" and projs:
         attrs["projections"] = projs
+    if lc.operator_confs:
+        attrs["operators"] = [
+            {"type": oc.type,
+             "input_indices": list(oc.input_indices),
+             "scale": oc.dotmul_scale}
+            for oc in lc.operator_confs]
     if lc.type == "data":
         if lc.height:
             attrs["height"], attrs["width"] = lc.height, lc.width
@@ -171,7 +177,16 @@ def model_from_proto(mc) -> ModelDef:
     model.input_layer_names = list(mc.input_layer_names)
     model.output_layer_names = list(mc.output_layer_names)
     for ev in mc.evaluators:
-        model.evaluators.append({
-            "name": ev.name, "type": ev.type,
-            "input_layers": list(ev.input_layers)})
+        cfg = {"name": ev.name, "type": ev.type,
+               "input_layers": list(ev.input_layers)}
+        for f in ("chunk_scheme", "num_chunk_types",
+                  "classification_threshold", "positive_label",
+                  "dict_file", "result_file", "num_results", "delimited",
+                  "top_k", "overlap_threshold", "background_id",
+                  "evaluate_difficult", "ap_type"):
+            if ev.HasField(f):
+                cfg[f] = getattr(ev, f)
+        if ev.excluded_chunk_types:
+            cfg["excluded_chunk_types"] = list(ev.excluded_chunk_types)
+        model.evaluators.append(cfg)
     return model

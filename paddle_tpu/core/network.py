@@ -158,11 +158,16 @@ class Network:
             feed: Dict[str, Argument], *, train: bool = False,
             rng: Optional[jax.Array] = None,
             carried: Optional[Dict[str, Any]] = None,
+            probes: Optional[Dict[str, jnp.ndarray]] = None,
     ) -> Tuple[Dict[str, Argument], Dict[str, jnp.ndarray]]:
         """Pure forward over the whole graph. ``feed`` maps data-layer names
         to Arguments. Returns (every layer's output keyed by layer name,
         state updates for moving statistics). ``carried`` maps recurrent
-        layer names to cross-batch initial state (--prev_batch_state)."""
+        layer names to cross-batch initial state (--prev_batch_state).
+        ``probes`` maps layer names to zero-valued perturbations added to
+        that layer's output — differentiating the cost w.r.t. a probe
+        yields d(cost)/d(layer output), the quantity the reference's
+        ``gradient_printer`` evaluator prints (``Argument.grad``)."""
         ctx = Context(train=train, rng=rng, carried=carried or {})
         from paddle_tpu.layers.activations import apply_activation  # cycle-free
         from paddle_tpu.utils.error_context import layer_scope
@@ -194,6 +199,8 @@ class Network:
                 if layer.drop_rate > 0.0:
                     out = out.with_value(
                         _dropout(out.value, layer.drop_rate, ctx, name))
+            if probes and name in probes:
+                out = out.with_value(out.value + probes[name])
             ctx.outputs[name] = out
         return ctx.outputs, ctx.state_updates
 

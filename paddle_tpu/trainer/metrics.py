@@ -29,10 +29,13 @@ def register_evaluator(name: str):
     return deco
 
 
-def create_evaluator(name: str, **kwargs) -> "EvaluatorBase":
-    if name not in _EVALUATORS:
-        raise KeyError(f"unknown evaluator {name!r}; have {sorted(_EVALUATORS)}")
-    return _EVALUATORS[name](**kwargs)
+def create_evaluator(type_name: str, **kwargs) -> "EvaluatorBase":
+    """By-type construction (``Evaluator::create``); kwargs may include
+    ``name=`` for the instance's reported name."""
+    if type_name not in _EVALUATORS:
+        raise KeyError(
+            f"unknown evaluator {type_name!r}; have {sorted(_EVALUATORS)}")
+    return _EVALUATORS[type_name](**kwargs)
 
 
 class EvaluatorBase:
@@ -92,6 +95,83 @@ class ClassificationErrorEvaluator(EvaluatorBase):
 
     def value(self):
         return self.wrong / max(self.count, 1.0)
+
+
+@register_evaluator("seq_classification_error")
+class SeqClassificationErrorEvaluator(ClassificationErrorEvaluator):
+    """``SequenceClassificationErrorEvaluator`` (``Evaluator.cpp:172``):
+    sequence-level error — if ANY frame of a sequence is wrong, the whole
+    sequence counts as one error; the denominator is the number of
+    sequences."""
+
+    def eval_batch(self, output, label=None, weight=None, mask=None):
+        output = np.asarray(output)
+        label = np.asarray(label)
+        if self.top_k == 1:
+            hit = np.argmax(output, axis=-1) == label
+        else:
+            topk = np.argsort(-output, axis=-1)[..., :self.top_k]
+            hit = (topk == label[..., None]).any(axis=-1)
+        wrong = (~hit).astype(np.float64)
+        if mask is not None:
+            wrong = wrong * np.asarray(mask)
+        # [B, T] frame errors -> per-sequence any()
+        seq_wrong = (wrong.reshape(wrong.shape[0], -1).sum(axis=-1) > 0)
+        self.wrong += float(seq_wrong.sum())
+        self.count += float(wrong.shape[0])
+
+
+@register_evaluator("rankauc")
+class RankAucEvaluator(EvaluatorBase):
+    """``RankAucEvaluator`` (``Evaluator.cpp:503``): per-sequence ranking
+    AUC over (score, click, pageview) triples; value is the mean
+    per-sequence AUC. The tie-handling trapezoid walk mirrors
+    ``calcRankAuc`` exactly."""
+
+    def start(self):
+        self.total = 0.0
+        self.n_seq = 0.0
+
+    @staticmethod
+    def _calc(score, click, pv):
+        order = np.argsort(-score, kind="stable")
+        auc = click_sum = old_click_sum = 0.0
+        no_click = no_click_sum = 0.0
+        last = float(score[order[0]]) + 1.0
+        for i in order:
+            s = float(score[i])
+            if last != s:
+                auc += (click_sum + old_click_sum) * no_click / 2.0
+                old_click_sum = click_sum
+                no_click = 0.0
+                last = s
+            no_click += float(pv[i]) - float(click[i])
+            no_click_sum += no_click
+            click_sum += float(click[i])
+        auc += (click_sum + old_click_sum) * no_click / 2.0
+        denom = click_sum * no_click_sum
+        return 0.0 if denom == 0.0 else auc / denom
+
+    def eval_batch(self, output, label=None, weight=None, mask=None):
+        # inputs: output scores, click (label), optional pv (weight)
+        score = np.asarray(output)
+        if score.ndim == 3:
+            score = score[..., 0]
+        click = np.asarray(label).reshape(score.shape)
+        pv = (np.ones_like(score) if weight is None
+              else np.asarray(weight).reshape(score.shape))
+        if score.ndim == 1:
+            score, click, pv = score[None], click[None], pv[None]
+        for b in range(score.shape[0]):
+            n = int(np.asarray(mask)[b].sum()) if mask is not None \
+                else score.shape[1]
+            if n <= 0:
+                continue
+            self.total += self._calc(score[b, :n], click[b, :n], pv[b, :n])
+            self.n_seq += 1.0
+
+    def value(self):
+        return self.total / max(self.n_seq, 1.0)
 
 
 @register_evaluator("auc")
@@ -470,34 +550,184 @@ class ColumnSumEvaluator(EvaluatorBase):
         return self.total / max(self.count, 1.0)
 
 
+def _matrix_str(m) -> str:
+    """Row-per-line space-separated rendering (``Matrix::print``)."""
+    m = np.asarray(m, np.float64)
+    m = m.reshape(m.shape[0], -1) if m.ndim > 1 else m.reshape(1, -1)
+    return "\n".join(" ".join(f"{v:g}" for v in row) for row in m) + "\n"
+
+
 @register_evaluator("value_printer")
 class ValuePrinter(EvaluatorBase):
+    """``ValuePrinter`` (``Evaluator.cpp:1008``): prints each watched
+    layer's output. Format follows ``Argument::printValueString``:
+    ``layer=<name> value:\\n<matrix>`` (+ sequence pos when masked)."""
+
     prints_on_value = True
-    """``ValuePrinter`` — debug printer; keeps last batch, prints on
-    finish (the reference prints every eval)."""
 
     def start(self):
         self.last = None
+        self.last_mask = None
 
     def eval_batch(self, output, label=None, weight=None, mask=None):
         self.last = np.asarray(output)
+        self.last_mask = None if mask is None else np.asarray(mask)
 
     def value(self):
-        print(f"[{self.name}] value:\n{self.last}")
+        v, m = self.last, self.last_mask
+        pos_str = ""
+        if m is not None and v is not None and v.ndim >= 2:
+            # pack padded [B, T, ...] to the reference's flat
+            # [total_frames, D] layout so the printed matrix and the
+            # sequence pos vector describe the same rows
+            lens = m.sum(axis=-1).astype(int)
+            rows = [v[b, :lens[b]].reshape(lens[b], -1)
+                    for b in range(v.shape[0])]
+            v = (np.concatenate(rows, axis=0) if rows
+                 else v.reshape(0, v.shape[-1]))
+            pos = np.concatenate([[0], np.cumsum(lens)])
+            pos_str = ("layer=" + self.name + " sequence pos:\n"
+                       + " ".join(str(int(p)) for p in pos) + "\n")
+        print("layer=" + self.name + " value:\n"
+              + _matrix_str(v) + pos_str, end="")
         return 0.0
 
 
-@register_evaluator("maxid_printer")
-class MaxIdPrinter(EvaluatorBase):
+@register_evaluator("gradient_printer")
+class GradientPrinter(EvaluatorBase):
+    """``GradientPrinter`` (``Evaluator.cpp:1046``): prints
+    d(cost)/d(layer output) — ``Argument.grad`` in the reference. The
+    trainer computes it via a zero probe added at the watched layer
+    (Network.apply_with_state(probes=...)) and passes it as ``grad``."""
+
     prints_on_value = True
+    wants_grad = True
+
     def start(self):
         self.last = None
 
-    def eval_batch(self, output, label=None, weight=None, mask=None):
-        self.last = np.argmax(np.asarray(output), axis=-1)
+    def eval_batch(self, output, label=None, weight=None, mask=None,
+                   grad=None):
+        if grad is not None:
+            self.last = np.asarray(grad)
 
     def value(self):
-        print(f"[{self.name}] maxid:\n{self.last}")
+        if self.last is None:
+            print(f"layer={self.name} grad: (not computed)")
+        else:
+            print("layer=" + self.name + " grad matrix:\n"
+                  + _matrix_str(self.last), end="")
+        return 0.0
+
+
+@register_evaluator("max_id_printer")
+class MaxIdPrinter(EvaluatorBase):
+    """``MaxIdPrinter`` (``Evaluator.cpp:1088``, registered as
+    ``max_id_printer``): per row, the top ``num_results`` ids with their
+    values, ``id : value, `` repeated. The repo's pre-r4 name
+    ``maxid_printer`` stays as an alias."""
+
+    prints_on_value = True
+
+    def __init__(self, name=None, num_results: int = 1):
+        self.num_results = max(int(num_results or 1), 1)
+        super().__init__(name)
+
+    def start(self):
+        self.ids = None
+        self.values = None
+
+    def eval_batch(self, output, label=None, weight=None, mask=None):
+        out = np.asarray(output)
+        out = out.reshape(-1, out.shape[-1])
+        k = min(self.num_results, out.shape[-1])
+        idx = np.argsort(-out, axis=-1)[:, :k]
+        self.ids = idx
+        self.values = np.take_along_axis(out, idx, axis=-1)
+
+    def value(self):
+        if self.ids is None:
+            return 0.0
+        lines = []
+        for row_i, row_v in zip(self.ids, self.values):
+            lines.append("".join(f"{int(i)} : {float(v):g}, "
+                                 for i, v in zip(row_i, row_v)))
+        print("layer=" + self.name + " row max id vector:\n"
+              + "\n".join(lines) + "\n", end="")
+        return 0.0
+
+
+@register_evaluator("max_frame_printer")
+class MaxFramePrinter(EvaluatorBase):
+    """``MaxFramePrinter`` (``Evaluator.cpp:1142``): for a width-1
+    sequence output, prints each sequence's top ``num_results`` frames as
+    ``time_index : value, `` plus ``total N frames``."""
+
+    prints_on_value = True
+
+    def __init__(self, name=None, num_results: int = 1):
+        self.num_results = max(int(num_results or 1), 1)
+        super().__init__(name)
+
+    def start(self):
+        self.lines: List[str] = []
+
+    def eval_batch(self, output, label=None, weight=None, mask=None):
+        out = np.asarray(output)
+        if out.ndim == 3:
+            out = out[..., 0]
+        if out.ndim == 1:
+            out = out[None]
+        for b in range(out.shape[0]):
+            n = int(np.asarray(mask)[b].sum()) if mask is not None \
+                else out.shape[1]
+            if n <= 0:
+                continue
+            seq = out[b, :n]
+            k = min(self.num_results, n)
+            idx = np.argsort(-seq, kind="stable")[:k]
+            self.lines.append(
+                "".join(f"{int(i)} : {float(seq[i]):g}, " for i in idx)
+                + f"total {n} frames")
+
+    def value(self):
+        print("layer=" + self.name + " sequence max frames:\n"
+              + "\n".join(self.lines) + "\n", end="")
+        return 0.0
+
+
+@register_evaluator("classification_error_printer")
+class ClassificationErrorPrinter(EvaluatorBase):
+    """``ClassificationErrorPrinter`` (``Evaluator.cpp:1346``): prints the
+    per-sample 0/1 error matrix (``calcError``) and, for sequences, the
+    start-position vector."""
+
+    prints_on_value = True
+
+    def start(self):
+        self.err = None
+        self.last_mask = None
+
+    def eval_batch(self, output, label=None, weight=None, mask=None):
+        out = np.asarray(output)
+        lab = np.asarray(label)
+        err = (np.argmax(out, axis=-1) != lab).astype(np.float64)
+        if mask is not None:
+            err = err * np.asarray(mask)
+        self.err = err
+        self.last_mask = None if mask is None else np.asarray(mask)
+
+    def value(self):
+        if self.err is None:
+            return 0.0
+        out = ("Printer=" + self.name + " Classification Error:\n"
+               + _matrix_str(self.err.reshape(-1, 1)))
+        if self.last_mask is not None:
+            lens = self.last_mask.sum(axis=-1).astype(int)
+            pos = np.concatenate([[0], np.cumsum(lens)])
+            out += ("Printer=" + self.name + " sequence pos vector:\n"
+                    + " ".join(str(int(p)) for p in pos) + "\n")
+        print(out, end="")
         return 0.0
 
 
@@ -631,12 +861,15 @@ class DetectionMAPEvaluator(EvaluatorBase):
         return float(np.mean(aps)) if aps else 0.0
 
 
+# the canonical registration is max_id_printer (the reference's string,
+# Evaluator.cpp:1088); keep the repo's pre-r4 spelling working
+_EVALUATORS["maxid_printer"] = MaxIdPrinter
+
 # ---------------------------------------------------------- config wiring
 # reference EvaluatorConfig.type -> registry name
 _TYPE_ALIASES = {
     "last-column-auc": "auc",
     "last-column-sum": "column_sum",
-    "max_id_printer": "maxid_printer",
 }
 
 

@@ -23,6 +23,7 @@ from typing import Any, Callable, Dict, List, Optional, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from paddle_tpu.config import dsl as _dsl
 from paddle_tpu.config.model_config import ModelDef
@@ -329,7 +330,7 @@ class SGD:
                 if self._carry_layers:
                     self._carried = metrics.pop("carried")
                 evals = self._accumulate(acc, metrics)
-                self._feed_host_evaluators(metrics)
+                self._feed_host_evaluators(metrics, feed=feed, rng=step_rng)
                 window_cost += cost
                 window_n += 1
                 if dot_period and (batch_id + 1) % dot_period == 0:
@@ -440,15 +441,28 @@ class SGD:
         for e, _, _ in self._host_evals:
             e.start()
 
-    def _feed_host_evaluators(self, metrics):
+    def _feed_host_evaluators(self, metrics, feed=None, rng=None):
         """Per-batch accumulation into the config-declared evaluators.
         Inputs bind by the roles the DSL recorded — [outputs..., label?,
         weight?, query_id?] — so e.g. pnpair's query_id lands on its
-        keyword, not on ``weight``."""
+        keyword, not on ``weight``. gradient_printer evaluators
+        additionally receive d(cost)/d(layer output), computed via zero
+        probes at the watched layers (the reference prints
+        ``Argument.grad``, Evaluator.cpp:1046)."""
         outs = metrics.get("eval_outputs")
         if not outs or not self._host_evals:
             return
         host = jax.device_get(outs)
+        grad_watch = sorted({
+            n for e, ins, _ in self._host_evals
+            if getattr(e, "wants_grad", False) for n in ins if n in host})
+        if grad_watch and feed is not None:
+            # only the LAST batch's gradient is ever printed (value() at
+            # EndPass), so don't pay a second forward+backward per batch:
+            # stash the context and compute lazily at print time
+            self._pending_grad = (feed, rng, {
+                n: np.zeros_like(np.asarray(host[n][0]))
+                for n in grad_watch})
         for e, ins, roles in self._host_evals:
             if not ins or ins[0] not in host:
                 continue
@@ -456,6 +470,8 @@ class SGD:
             n_out = roles.get("n_outputs", 1)
             rest = vals[n_out:]
             kwargs = {"mask": host[ins[0]][1]}
+            if getattr(e, "wants_grad", False):
+                kwargs["grad"] = None  # supplied at print time
             if roles.get("has_label") and rest:
                 kwargs["label"] = rest.pop(0)
             if roles.get("has_weight") and rest:
@@ -464,8 +480,36 @@ class SGD:
                 kwargs["query_id"] = rest.pop(0)
             e.eval_batch(vals[0], **kwargs)
 
+    def _layer_grad_fn(self):
+        """Jitted d(cost)/d(layer output) via output probes (lazy; only
+        built when a gradient_printer evaluator is wired)."""
+        if getattr(self, "_grad_probe_fn", None) is None:
+            network = self.network
+
+            def fn(params, feed, rng, probes):
+                def f(pr):
+                    outs, _ = network.apply_with_state(
+                        self._cast_compute(params),
+                        self._cast_compute(feed),
+                        train=True, rng=rng, probes=pr)
+                    return self._total_cost(outs)
+
+                return jax.grad(f)(probes)
+
+            self._grad_probe_fn = jax.jit(fn)
+        return self._grad_probe_fn
+
     def host_eval_values(self, include_printers: bool = True
                          ) -> Dict[str, float]:
+        if include_printers and getattr(self, "_pending_grad", None):
+            feed, rng, zeros = self._pending_grad
+            self._pending_grad = None
+            probes = {n: jnp.asarray(z) for n, z in zeros.items()}
+            grads = jax.device_get(
+                self._layer_grad_fn()(self.params, feed, rng, probes))
+            for e, ins, _ in self._host_evals:
+                if getattr(e, "wants_grad", False) and ins:
+                    e.last = grads.get(ins[0], e.last)
         return {e.name: e.value() for e, _, _ in self._host_evals
                 if include_printers or not e.prints_on_value}
 

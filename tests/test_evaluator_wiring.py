@@ -162,3 +162,47 @@ def test_chunk_evaluator_f1_via_dsl():
     res = trainer.test(reader)
     assert "chunk_f1" in res.evaluator
     assert 0.0 <= res.evaluator["chunk_f1"] <= 1.0
+
+
+def test_gradient_printer_prints_real_grads(capsys):
+    """gradient_printer receives d(cost)/d(layer output) via the probe
+    mechanism (Network.apply_with_state(probes=...)) — the reference
+    prints Argument.grad (Evaluator.cpp:1046)."""
+    import jax.numpy as jnp
+    dsl.reset()
+    x = dsl.data(name="x", size=8)
+    lbl = dsl.data(name="label", size=2)
+    hidden = dsl.fc(input=x, size=4, act="tanh", name="hid")
+    out = dsl.fc(input=hidden, size=2, act="softmax", name="probs")
+    cost = dsl.classification_cost(input=out, label=lbl)
+    dsl.evaluator("gradient_printer", hidden, name="hid")
+    trainer = SGD(cost=cost, update_equation=Momentum(learning_rate=0.1,
+                                                      momentum=0.9))
+    trainer.train(_toy_reader(batches=2), num_passes=1,
+                  event_handler=lambda e: None)
+    got = capsys.readouterr().out
+    assert "layer=hid grad matrix:" in got
+    # at least one non-zero gradient entry printed
+    import re
+    nums = [float(v) for v in re.findall(
+        r"-?\d+\.?\d*(?:e-?\d+)?",
+        got.split("grad matrix:\n", 1)[1].split("layer=")[0])]
+    assert any(abs(v) > 0 for v in nums)
+
+
+def test_max_id_printer_via_config_type_string():
+    """A config naming the reference string max_id_printer resolves (the
+    repo used to register only maxid_printer)."""
+    from paddle_tpu.trainer.metrics import build_from_configs
+    built = build_from_configs([
+        {"type": "max_id_printer", "name": "p", "input_layers": ["x"]},
+        {"type": "maxid_printer", "name": "q", "input_layers": ["x"]},
+        {"type": "rankauc", "name": "r", "input_layers": ["o", "c"]},
+        {"type": "seq_classification_error", "name": "s",
+         "input_layers": ["o", "l"]},
+        {"type": "max_frame_printer", "name": "m", "input_layers": ["o"]},
+        {"type": "classification_error_printer", "name": "cep",
+         "input_layers": ["o", "l"]},
+        {"type": "gradient_printer", "name": "g", "input_layers": ["o"]},
+    ])
+    assert len(built) == 7
