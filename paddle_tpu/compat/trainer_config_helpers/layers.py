@@ -1241,8 +1241,10 @@ def ctc_layer(input, label, size=None, name=None, norm_by_times=False,
     # reference contract (`layers.py:4987-4992`): size = num classes + 1
     # (the blank); defaults from the label vocabulary, NOT the input
     if lab.size:
-        if size is not None:
-            assert size == lab.size + 1, (size, lab.size)
+        if size is not None and size != lab.size + 1:
+            raise ValueError(
+                f"ctc_layer: size ({size}) must equal label size + 1 "
+                f"({lab.size + 1}, the blank symbol)")
         size = lab.size + 1
     size = size or inp.size
     return _layer(_name(name, "ctc_layer"), "ctc",
@@ -1256,8 +1258,10 @@ def warp_ctc_layer(input, label, size=None, name=None, blank=0,
     inp, lab = _one(input), _one(label)
     # like ctc_layer: size = num classes + 1, from the label vocabulary
     if lab.size:
-        if size is not None:
-            assert size == lab.size + 1, (size, lab.size)
+        if size is not None and size != lab.size + 1:
+            raise ValueError(
+                f"warp_ctc_layer: size ({size}) must equal label size + 1 "
+                f"({lab.size + 1}, the blank symbol)")
         size = lab.size + 1
     size = size or inp.size + 1
     return _layer(_name(name, "warp_ctc_layer"), "warp_ctc",

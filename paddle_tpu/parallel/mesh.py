@@ -90,6 +90,11 @@ def create_multislice_mesh(n_slices: Optional[int] = None,
                    for i in range(n_slices)]
     if n_data is None:
         n_data = per_slice // n_model
+    if n_data * n_model > per_slice:
+        raise ValueError(
+            f"create_multislice_mesh: n_data ({n_data}) x n_model "
+            f"({n_model}) = {n_data * n_model} exceeds the {per_slice} "
+            f"devices available per slice")
     used = n_slices * n_data * n_model
     if used < len(devices):
         from paddle_tpu.utils.log import logger

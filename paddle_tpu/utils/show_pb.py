@@ -26,8 +26,12 @@ def show(path: str, out=None) -> str:
         except Exception as e:  # noqa: BLE001 - try the next schema
             last_err = e
             continue
-        # prefer the parse that actually consumed recognizable fields
-        if msg.ByteSize() or not blob:
+        # prefer the parse that actually consumed recognizable fields —
+        # known-field presence, not ByteSize(), because python protobuf
+        # retains unknown fields and counts them in ByteSize(), which
+        # would accept a ModelConfig blob "parsed" into TrainerConfig
+        # purely as unknown fields
+        if msg.ListFields() or not blob:
             txt = f"# {cls.__name__}\n{msg}"
             if out is not None:
                 print(txt, file=out)
