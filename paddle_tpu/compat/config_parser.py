@@ -29,12 +29,15 @@ from paddle_tpu.config.model_config import ModelDef
 
 @dataclasses.dataclass
 class DataSource:
-    """One define_py_data_sources2 stream."""
+    """One data stream: a define_py_data_sources2 python provider
+    (kind="py2"), or a binary proto-shard list (kind="proto",
+    ProtoData())."""
 
     file_list: Optional[str]
     module: Optional[str]
     obj: Optional[str]
     args: Any = None
+    kind: str = "py2"
 
 
 class ConfigContext:
@@ -190,7 +193,8 @@ def _data_from_spec(spec):
         return DataSource(file_list=spec.get("files"),
                           module=spec.get("load_data_module"),
                           obj=spec.get("load_data_object"),
-                          args=spec.get("load_data_args"))
+                          args=spec.get("load_data_args"),
+                          kind=spec.get("type", "py2"))
     return spec
 
 
@@ -489,7 +493,27 @@ class ParsedConfig:
         return int(self.context.settings.get("batch_size") or 1)
 
     def _reader_from(self, source: DataSource, *, is_train: bool):
-        if source is None or source.module is None:
+        if source is None:
+            return None, None
+        if source.kind == "proto":
+            # binary proto shards (ProtoDataProvider.h:48) need no
+            # python provider module — the header drives the types
+            from paddle_tpu.data.protodata import ProtoDataReader
+            from paddle_tpu.data.reader import batch
+            file_list = source.file_list
+            if file_list and isinstance(file_list, str) and \
+                    self.context.config_dir:
+                # reference jobs run from the source root with paths like
+                # "trainer/tests/mnist.list": anchor via the config dir
+                from paddle_tpu.data.protodata import anchor_path
+                file_list = anchor_path(file_list,
+                                        self.context.config_dir)
+            rdr = ProtoDataReader(file_list)
+            batched = batch(rdr, self.batch_size())
+            batched.input_types = rdr.input_types
+            rdr.as_reader = lambda *a, **k: rdr  # provider-shape shim
+            return batched, rdr
+        if source.module is None:
             return None, None
         saved = list(sys.path)
         if self.context.config_dir:
@@ -535,7 +559,7 @@ class ParsedConfig:
     def feeding(self):
         """{data-layer name: InputType} in provider order."""
         src = self.context.train_source or self.context.test_source
-        if src is None or src.module is None:
+        if src is None or (src.module is None and src.kind != "proto"):
             return None
         reader, prov = self._reader_from(src, is_train=True)
         # init_hook providers resolve their types at reader construction

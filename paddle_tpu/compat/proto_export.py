@@ -35,8 +35,26 @@ def _img_geom(info):
     return info.channels, info.height, info.width
 
 
-def _set_conv_conf(conf, extra, in_info, out_info, num_filters):
-    channels, in_h, in_w = _img_geom(in_info)
+def _derive(in_info, extra):
+    """(channels, h, w) with the reference's sqrt inference for flat
+    inputs (config_parser.py:1160-1161). Falls back to the 1x1 flat view
+    when the geometry is genuinely unknowable (e.g. a concat output with
+    no channel metadata) — the engine re-derives at execution time from
+    the real channels."""
+    from paddle_tpu.layers.conv import derive_geom
+    try:
+        return derive_geom(in_info, extra.get("channels"))
+    except ValueError:
+        return _img_geom(in_info)
+
+
+def _set_conv_conf(conf, extra, in_info, out_info, num_filters,
+                   trans=False):
+    """Mirror ``parse_conv`` (config_parser.py:1247-1277): for trans=True
+    the conf describes the *forward* conv whose backward this layer is —
+    output_x/y hold the input geometry, img_size the output, and
+    filter_channels = num_filters/groups."""
+    channels, in_h, in_w = _derive(in_info, extra)
     fs = int(extra.get("filter_size", 1))
     groups = int(extra.get("groups", 1) or 1)
     conf.filter_size = fs
@@ -44,19 +62,30 @@ def _set_conv_conf(conf, extra, in_info, out_info, num_filters):
     conf.stride = int(extra.get("stride", 1))
     conf.padding = int(extra.get("padding", 0))
     conf.groups = groups
-    conf.filter_channels = conf.channels // groups
-    conf.output_x = int(out_info.width or 1)
-    conf.img_size = int(in_w or 1)
     conf.caffe_mode = True
-    conf.filter_size_y = int(extra.get("filter_size_y", fs))
-    conf.padding_y = int(extra.get("padding_y", conf.padding))
-    conf.stride_y = int(extra.get("stride_y", conf.stride))
-    conf.output_y = int(out_info.height or conf.output_x)
-    conf.img_size_y = int(in_h or conf.img_size)
+    conf.filter_size_y = int(extra.get("filter_size_y") or fs)
+    conf.padding_y = int(extra.get("padding_y")
+                         if extra.get("padding_y") is not None
+                         else conf.padding)
+    conf.stride_y = int(extra.get("stride_y")
+                        if extra.get("stride_y") is not None
+                        else conf.stride)
+    if not trans:
+        conf.filter_channels = conf.channels // groups
+        conf.img_size = int(in_w or 1)
+        conf.img_size_y = int(in_h or conf.img_size)
+        conf.output_x = int(out_info.width or 1)
+        conf.output_y = int(out_info.height or conf.output_x)
+    else:
+        conf.filter_channels = int(num_filters or conf.channels) // groups
+        conf.output_x = int(in_w or 1)
+        conf.output_y = int(in_h or conf.output_x)
+        conf.img_size = int(out_info.width or 1)
+        conf.img_size_y = int(out_info.height or conf.img_size)
 
 
 def _set_pool_conf(conf, extra, in_info, out_info):
-    channels, in_h, in_w = _img_geom(in_info)
+    channels, in_h, in_w = _derive(in_info, extra)
     conf.pool_type = str(extra.get("pool_type", "max-projection"))
     conf.channels = int(extra.get("channels") or channels)
     conf.size_x = int(extra.get("filter_size", 1))
@@ -64,28 +93,54 @@ def _set_pool_conf(conf, extra, in_info, out_info):
     conf.padding = int(extra.get("padding", 0))
     conf.output_x = int(out_info.width or 1)
     conf.img_size = int(in_w or 1)
-    if extra.get("size_y"):
-        conf.size_y = int(extra["size_y"])
-    if extra.get("stride_y"):
-        conf.stride_y = int(extra["stride_y"])
+    # the reference always resolves the y variants (parse_pool defaults
+    # them from the x values)
+    conf.size_y = int(extra.get("size_y") or conf.size_x)
+    conf.stride_y = int(extra.get("stride_y") or conf.stride)
+    conf.padding_y = int(extra["padding_y"]
+                         if extra.get("padding_y") is not None
+                         else conf.padding)
     conf.output_y = int(out_info.height or conf.output_x)
     conf.img_size_y = int(in_h or conf.img_size)
 
 
 def _set_norm_conf(conf, extra, in_info, out_info):
-    channels, in_h, in_w = _img_geom(in_info)
+    channels, in_h, in_w = _derive(in_info, extra)
     conf.norm_type = str(extra.get("norm_type", "cmrnorm-projection"))
     conf.channels = int(extra.get("channels") or channels)
     conf.size = int(extra.get("size", 5))
-    conf.scale = float(extra.get("scale", 1e-4))
+    # parse_norm (config_parser.py:1239-1242) folds the window size into
+    # the stored scale
+    scale = float(extra.get("scale", 1e-4))
+    conf.scale = scale / (conf.size if conf.norm_type == "cmrnorm-projection"
+                          else conf.size ** 2)
     conf.pow = float(extra.get("pow", 0.75))
+    conf.blocked = bool(extra.get("blocked", False))
     conf.output_x = int(out_info.width or 1)
     conf.img_size = int(in_w or 1)
     conf.output_y = int(out_info.height or conf.output_x)
     conf.img_size_y = int(in_h or conf.img_size)
 
 
-def _set_proj_conf(conf, spec, name, in_size, out_size):
+def _conv_out_geom(ih, iw, extra, trans):
+    """(oh, ow) for a conv/convt spec, per-axis with the *_y variants
+    defaulting to their x twins — parse_conv's formulas."""
+    fs = int(extra["filter_size"])
+    fsy = int(extra.get("filter_size_y") or fs)
+    st = int(extra.get("stride") or 1)
+    sty = int(extra.get("stride_y") or st)
+    pd = int(extra.get("padding") or 0)
+    pdy = int(extra["padding_y"]
+              if extra.get("padding_y") is not None else pd)
+
+    def _out(sz, f, s, p):
+        return (sz - 1) * s + f - 2 * p if trans \
+            else (sz - f + 2 * p) // s + 1
+
+    return _out(ih, fsy, sty, pdy), _out(iw, fs, st, pd)
+
+
+def _set_proj_conf(conf, spec, name, in_size, out_size, in_info=None):
     ptype = spec.get("type", "full_matrix")
     conf.type = {"full_matrix": "fc", "trans_full_matrix": "trans_fc",
                  "table": "table", "identity": "identity",
@@ -102,6 +157,23 @@ def _set_proj_conf(conf, spec, name, in_size, out_size):
         conf.trainable_padding = bool(spec.get("trainable_padding", False))
     if ptype == "identity_offset":
         conf.offset = int(spec.get("offset", 0))
+    if ptype in ("conv", "convt") and spec.get("filter_size"):
+        from paddle_tpu.core.registry import ShapeInfo as _SI
+        from paddle_tpu.layers.conv import derive_geom
+        trans = ptype == "convt"
+        extra = {k: spec.get(k) for k in (
+            "filter_size", "stride", "padding", "filter_size_y",
+            "stride_y", "padding_y", "groups")}
+        extra["channels"] = spec.get("num_channels") or spec.get("channels")
+        c, ih, iw = derive_geom(in_info or _SI(size=in_size),
+                                extra.get("channels"))
+        oh, ow = _conv_out_geom(ih, iw, extra, trans)
+        nf = int(spec.get("num_filters") or 0)
+        _set_conv_conf(conf.conv_conf, extra,
+                       _SI(size=in_size, channels=c, height=ih, width=iw),
+                       _SI(size=nf * oh * ow, channels=nf, height=oh,
+                           width=ow), nf, trans=trans)
+        conf.num_filters = nf
     for s, e in spec.get("slices", []):
         sl = conf.slices.add()
         sl.start, sl.end = int(s), int(e)
@@ -167,6 +239,13 @@ def _export_layer(model: ModelDef, net: Network, name: str, proto_layer,
 
     for attr_key, field in _LAYER_SCALAR_FIELDS.items():
         if attr_key in layer.attrs and layer.attrs[attr_key] is not None:
+            if attr_key == "partial_sum" and layer.type == "prelu":
+                # ParameterReluLayer uses partial_sum only to size its
+                # parameter; the reference never writes the proto field
+                continue
+            if attr_key == "num_classes" and layer.type in (
+                    "multibox_loss", "detection_output"):
+                continue  # lives inside the per-input *_conf
             try:
                 setattr(proto_layer, field, layer.attrs[attr_key])
             except TypeError:
@@ -175,6 +254,43 @@ def _export_layer(model: ModelDef, net: Network, name: str, proto_layer,
         v = layer.attrs.get(key)
         if isinstance(v, (list, tuple)):
             getattr(proto_layer, key).extend(int(x) for x in v)
+    if layer.attrs.get("user_arg"):
+        proto_layer.user_arg = str(layer.attrs["user_arg"])
+    if layer.type == "multi_class_cross_entropy_with_selfnorm":
+        proto_layer.softmax_selfnorm_alpha = float(
+            layer.attrs.get("softmax_selfnorm_alpha", 0.1))
+    if layer.type == "lambda_cost":
+        # LambdaCost (config_parser.py:2287) always writes NDCG_num and
+        # max_sort_size and never coeff
+        proto_layer.ClearField("coeff")
+        proto_layer.max_sort_size = int(layer.attrs.get("max_sort_size",
+                                                        -1))
+    if layer.type == "selective_fc":
+        proto_layer.selective_fc_pass_generation = bool(
+            layer.attrs.get("pass_generation", False))
+        proto_layer.has_selected_colums = bool(
+            layer.attrs.get("has_selected_colums", True))
+        proto_layer.selective_fc_full_mul_ratio = float(
+            layer.attrs.get("full_mul_ratio", 0.02))
+    # image geometry on the layer itself: data layers carry the
+    # user-declared height/width; cnn layers the output geometry
+    # (set_cnn_layer / set_layer_height_width in the reference)
+    if layer.type == "data":
+        hh = layer.attrs.get("height")
+        if hh:
+            proto_layer.height = int(hh)
+            proto_layer.width = int(layer.attrs.get("width") or 0)
+    elif layer.type == "spp":
+        # set_cnn_layer for spp: height 1, width = total pyramid bins
+        ph = int(layer.attrs.get("pyramid_height", 3))
+        proto_layer.height = 1
+        proto_layer.width = (4 ** ph - 1) // 3
+    elif layer.type in ("exconv", "exconvt", "cudnn_conv", "pool", "norm",
+                        "maxout", "blockexpand", "pad", "crop",
+                        "bilinear_interp"):
+        if out_info.height is not None:
+            proto_layer.height = int(out_info.height)
+            proto_layer.width = int(out_info.width)
 
     projections = layer.attrs.get("projections")
     operators = layer.attrs.get("operators") or []
@@ -185,30 +301,107 @@ def _export_layer(model: ModelDef, net: Network, name: str, proto_layer,
         if f"w{i}" in lp:
             pin.input_parameter_name = lp[f"w{i}"]
         extra = inp.extra or {}
+        if extra.get("input_layer_argument"):
+            # get_output: which named output of the producer to read
+            pin.input_layer_argument = str(extra["input_layer_argument"])
         in_info = net.shape_infos[inp.layer_name]
         if layer.type in ("exconv", "exconvt", "cudnn_conv"):
             _set_conv_conf(pin.conv_conf, extra, in_info, out_info,
-                           layer.attrs.get("num_filters"))
+                           layer.attrs.get("num_filters"),
+                           trans=layer.type == "exconvt")
         elif layer.type == "pool" and extra:
             _set_pool_conf(pin.pool_conf, extra, in_info, out_info)
         elif layer.type == "norm":
             _set_norm_conf(pin.norm_conf, extra, in_info, out_info)
+        elif layer.type == "clip":
+            pin.clip_conf.min = float(layer.attrs.get("min", -1.0))
+            pin.clip_conf.max = float(layer.attrs.get("max", 1.0))
+        elif layer.type == "row_conv":
+            pin.row_conv_conf.context_length = int(
+                layer.attrs.get("context_length", 1))
+        elif layer.type == "blockexpand" and i == 0:
+            be = pin.block_expand_conf
+            be.channels = int(layer.attrs.get("channels") or 1)
+            be.stride_x = int(layer.attrs.get("stride_x", 0))
+            be.stride_y = int(layer.attrs.get("stride_y", 0))
+            be.padding_x = int(layer.attrs.get("padding_x", 0))
+            be.padding_y = int(layer.attrs.get("padding_y", 0))
+            be.block_x = int(layer.attrs.get("block_x", 0))
+            be.block_y = int(layer.attrs.get("block_y", 0))
+            # geometry resolves at runtime in the reference
+            # (parse_block_expand leaves it zero)
+            be.output_x = be.output_y = 0
+            be.img_size_x = be.img_size_y = 0
+        elif layer.type == "maxout" and i == 0:
+            c, hh, ww = _derive(in_info, layer.attrs)
+            ic = pin.maxout_conf.image_conf
+            ic.channels = int(layer.attrs.get("channels") or c)
+            ic.img_size, ic.img_size_y = int(ww), int(hh)
+            pin.maxout_conf.groups = int(layer.attrs.get("groups", 1))
+        elif layer.type == "pad" and i == 0:
+            c, hh, ww = _derive(in_info, layer.attrs)
+            ic = pin.pad_conf.image_conf
+            ic.channels, ic.img_size, ic.img_size_y = int(c), int(ww), \
+                int(hh)
+            for key in ("pad_c", "pad_h", "pad_w"):
+                getattr(pin.pad_conf, key).extend(
+                    int(x) for x in layer.attrs.get(key, [0, 0]))
+        elif layer.type == "bilinear_interp" and i == 0:
+            c, hh, ww = _derive(in_info, layer.attrs)
+            ic = pin.bilinear_interp_conf.image_conf
+            ic.channels, ic.img_size, ic.img_size_y = int(c), int(ww), \
+                int(hh)
+            pin.bilinear_interp_conf.out_size_x = int(
+                layer.attrs.get("out_size_x") or 0)
+            pin.bilinear_interp_conf.out_size_y = int(
+                layer.attrs.get("out_size_y") or 0)
+        elif layer.type == "spp" and i == 0:
+            c, hh, ww = _derive(in_info, layer.attrs)
+            ic = pin.spp_conf.image_conf
+            ic.channels, ic.img_size, ic.img_size_y = int(c), int(ww), \
+                int(hh)
+            pin.spp_conf.pool_type = str(
+                layer.attrs.get("pool_type", "max-projection"))
+            pin.spp_conf.pyramid_height = int(
+                layer.attrs.get("pyramid_height", 3))
+        elif layer.type == "multibox_loss" and i == 0:
+            mb = pin.multibox_loss_conf
+            mb.num_classes = int(layer.attrs.get("num_classes", 0))
+            mb.overlap_threshold = float(
+                layer.attrs.get("overlap_threshold", 0.5))
+            mb.neg_pos_ratio = float(layer.attrs.get("neg_pos_ratio", 3.0))
+            mb.neg_overlap = float(layer.attrs.get("neg_overlap", 0.5))
+            mb.background_id = int(layer.attrs.get("background_id", 0))
+            mb.input_num = 1
+        elif layer.type == "detection_output" and i == 0:
+            dc = pin.detection_output_conf
+            dc.num_classes = int(layer.attrs.get("num_classes", 0))
+            dc.nms_threshold = float(layer.attrs.get("nms_threshold",
+                                                     0.45))
+            dc.nms_top_k = int(layer.attrs.get("nms_top_k", 400))
+            dc.background_id = int(layer.attrs.get("background_id", 0))
+            dc.input_num = 1
+            dc.keep_top_k = int(layer.attrs.get("keep_top_k", 200))
+            dc.confidence_threshold = float(
+                layer.attrs.get("confidence_threshold", 0.01))
         elif layer.type in ("mixed", "concat2") and projections is not None \
                 and i < len(projections):
             spec = projections[i]
             if spec.get("type") not in (None, "identity_op_arg"):
                 out_size = (spec.get("size") if layer.type == "concat2"
                             else None) or layer.size or out_info.size
+                # proj_conf.name is the projection's own scoped name,
+                # NOT the (possibly shared) parameter name
                 _set_proj_conf(pin.proj_conf, spec,
-                               f"___{layer.name}.w{i}", in_info.size,
-                               out_size)
+                               f"_{layer.name}.w{i}",
+                               in_info.size, out_size, in_info=in_info)
         elif layer.type == "embedding":
             # the reference represents embedding_layer as a mixed layer
             # with one table projection (`layers.py` embedding_layer);
             # the engine keeps a native type — translate at the wire
             _set_proj_conf(pin.proj_conf, {"type": "table"},
-                           f"___{layer.name}.w{i}", in_info.size,
-                           layer.size or out_info.size)
+                           f"_{layer.name}.w{i}",
+                           in_info.size, layer.size or out_info.size)
     if layer.type == "batch_norm" and layer.inputs:
         # the reference wires moving mean/var as static inputs 1 and 2 of
         # the layer (BatchNormBaseLayer.cpp); the engine keeps them as
@@ -230,7 +423,10 @@ def _export_layer(model: ModelDef, net: Network, name: str, proto_layer,
 
     for op in operators:
         pop = proto_layer.operator_confs.add()
-        pop.type = str(op.get("type", ""))
+        # the engine distinguishes dot_mul projection vs operator with a
+        # _op suffix; the wire type string is the reference's "dot_mul"
+        pop.type = {"dot_mul_op": "dot_mul"}.get(
+            str(op.get("type", "")), str(op.get("type", "")))
         pop.input_indices.extend(int(i) for i in op.get("input_indices", []))
         pop.input_sizes.extend(
             int(net.shape_infos[layer.inputs[i].layer_name].size)
@@ -238,31 +434,84 @@ def _export_layer(model: ModelDef, net: Network, name: str, proto_layer,
         pop.output_size = int(layer.size or out_info.size)
         if "scale" in op:
             pop.dotmul_scale = float(op["scale"])
+        if op.get("type") in ("conv_op", "convt_op"):
+            pop.type = "convt" if op["type"] == "convt_op" else "conv"
+            idx0 = int(op["input_indices"][0])
+            img_info = net.shape_infos[layer.inputs[idx0].layer_name]
+            extra = {k: op.get(k) for k in (
+                "filter_size", "stride", "padding", "filter_size_y",
+                "stride_y", "padding_y")}
+            extra["channels"] = op.get("num_channels")
+            trans = op["type"] == "convt_op"
+            from paddle_tpu.layers.conv import derive_geom
+            c, ih, iw = derive_geom(img_info, extra.get("channels"))
+            oh, ow = _conv_out_geom(ih, iw, extra, trans)
+            from paddle_tpu.core.registry import ShapeInfo as _SI
+            nf = int(op.get("num_filters") or 0)
+            _set_conv_conf(pop.conv_conf, extra,
+                           _SI(size=img_info.size, channels=c, height=ih,
+                               width=iw),
+                           _SI(size=nf * oh * ow, channels=nf, height=oh,
+                               width=ow), nf, trans=trans)
+            pop.num_filters = nf
+            pop.output_size = nf * oh * ow
 
 
 def _export_parameter(pname: str, spec, proto_param):
+    import math
     proto_param.name = pname
     size = 1
     for d in spec.shape:
         size *= int(d)
     proto_param.size = size
-    proto_param.dims.extend(int(d) for d in spec.shape)
-    proto_param.learning_rate = float(spec.learning_rate)
-    proto_param.initial_mean = float(spec.initial_mean)
-    if spec.initial_std is not None:
-        proto_param.initial_std = float(spec.initial_std)
+    wire_dims = getattr(spec, "wire_dims", None)
+    if wire_dims is not None:
+        # reference layout override: conv shared biases record [size, 1];
+        # an explicit empty tuple means "no dims recorded" (prelu slopes,
+        # create_input_parameter without dims)
+        proto_param.dims.extend(int(d) for d in wire_dims)
+    elif len(spec.shape) == 1:
+        # the reference stores vectors (biases) as 1 x size matrices
+        # (create_bias_parameter -> dims [1, size])
+        proto_param.dims.extend([1, size])
     else:
-        # the reference's "initial_smart": std = 1/sqrt(fan_in)
+        proto_param.dims.extend(int(d) for d in spec.shape)
+    if float(spec.learning_rate) != 1.0:
+        # the reference leaves ParameterConfig.learning_rate at its proto
+        # default unless the user set one (goldens carry no field)
+        proto_param.learning_rate = float(spec.learning_rate)
+    proto_param.initial_mean = float(spec.initial_mean)
+    if spec.init in ("zeros", "const"):
+        # biases / constant inits: std 0, smart off (golden bias params:
+        # initial_std: 0.0, initial_smart: false)
+        proto_param.initial_std = 0.0
+        proto_param.initial_smart = False
+    elif spec.initial_std is not None:
+        proto_param.initial_std = float(spec.initial_std)
+        proto_param.initial_smart = False
+    else:
+        # "initial_smart": the reference RESOLVES the std into the proto
+        # (config_parser.py:3391: std = 1/sqrt(dims[0]) of the RECORDED
+        # dims — 1 for vectors stored as [1, size]), truncated to 12
+        # significant digits because the goldens were written by
+        # Python 2's str(float)
+        fan = proto_param.dims[0] if proto_param.dims else size
+        std = 1.0 / math.sqrt(max(int(fan), 1))
+        proto_param.initial_std = float(f"{std:.12g}")
         proto_param.initial_smart = True
     proto_param.initial_strategy = 1 if spec.init == "uniform" else 0
     if spec.is_static:
         proto_param.is_static = True
-    if spec.sparse_grad:
+    if getattr(spec, "user_sparse", False):
         proto_param.sparse_update = True
     if spec.l2_rate is not None:
         proto_param.decay_rate = float(spec.l2_rate)
     if spec.l1_rate is not None:
         proto_param.decay_rate_l1 = float(spec.l1_rate)
+    if getattr(spec, "wire_sparse", None) is not None:
+        proto_param.is_sparse = bool(spec.wire_sparse)
+    if getattr(spec, "wire_shared", None) is not None:
+        proto_param.is_shared = bool(spec.wire_shared)
     if getattr(spec, "sparsity_ratio", None):
         hook = proto_param.update_hooks.add()
         hook.type = "pruning"

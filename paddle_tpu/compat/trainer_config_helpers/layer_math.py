@@ -66,7 +66,10 @@ def _sub(layeroutput, other):
     if isinstance(other, MixedLayerType):
         other = other._finalize()
     if isinstance(other, numbers.Number):
-        return slope_intercept_layer(input=layeroutput, intercept=-other)
+        # bug-for-bug with the reference (layer_math.py:78): y - c lowers
+        # to intercept=+c, i.e. y + c. The goldens encode this, so the
+        # wire format must too.
+        return slope_intercept_layer(input=layeroutput, intercept=other)
     if not isinstance(other, LayerOutput):
         raise TypeError("LayerOutput can only be subtracted with another "
                         "LayerOutput or a number")

@@ -274,19 +274,43 @@ def dotmul_operator(a=None, b=None, scale=1, **kwargs):
                       extra_inputs=[b], is_operator=True)
 
 
+_PADDING_NOT_SET = object()
+
+
 def context_projection(input, context_len, context_start=None,
-                       padding_attr=False):
+                       padding_attr=_PADDING_NOT_SET):
     """Sliding window concat over the sequence axis
-    (`function/ContextProjection*`). trainable_padding when padding_attr
-    is a ParameterAttribute."""
+    (`function/ContextProjection*`). Decorator quirk reproduced from the
+    reference (`@wrap_bias_attr_default(['padding_attr'])`,
+    default_decorators.py:146-151): padding_attr omitted / None / True
+    becomes a default zero-init ParameterAttribute, so the padding rows
+    are TRAINABLE by default; only an explicit ``padding_attr=False``
+    keeps them static zeros."""
     src = _one(input)
     start = -(context_len // 2) if context_start is None else context_start
+    if padding_attr is _PADDING_NOT_SET or padding_attr is None \
+            or padding_attr is True:
+        padding_attr = ParameterAttribute(initial_std=0.0, initial_mean=0.0)
     trainable = isinstance(padding_attr, ParameterAttribute)
     spec = {"type": "context", "context_start": start,
             "context_length": context_len,
             "trainable_padding": trainable}
     return Projection(src, spec, src.size * context_len,
                       _pattr(padding_attr) if trainable else None)
+
+
+def _resolved_channels(src, num_channels):
+    """Channel count for conv init defaults — the reference resolves
+    num_channels from the input layer before computing init_w
+    (layers.py:2418-2445); flat inputs derive a square side."""
+    if num_channels:
+        return num_channels
+    from paddle_tpu.config.dsl import _shape_of
+    from paddle_tpu.layers.conv import derive_geom
+    try:
+        return derive_geom(_shape_of(src.name), None)[0]
+    except (KeyError, ValueError):
+        return 1
 
 
 def _conv_proj_out_size(src, channels, filter_size, stride, padding,
@@ -340,6 +364,14 @@ def conv_projection(input, filter_size, num_filters, num_channels=None,
     size = _conv_proj_out_size(src, num_channels, filter_size, stride,
                                padding, num_filters, trans,
                                filter_size_y, stride_y, padding_y)
+    if param_attr is None:
+        # reference default (layers.py:4310): He-style std from the
+        # filter fan-in (channels resolved from the input when omitted),
+        # truncated like Python 2's str(float)
+        init_w = (2.0 / (filter_size ** 2
+                         * _resolved_channels(src, num_channels))) ** 0.5
+        param_attr = ParameterAttribute(initial_mean=0.0,
+                                        initial_std=float(f"{init_w:.12g}"))
     return Projection(src, spec, size, _pattr(param_attr))
 
 
@@ -463,9 +495,12 @@ def fc_layer(input, size, act=None, name=None, param_attr=None,
 
 def printer_layer(input, format=None, name=None):
     ins = _many(input)
+    if format is None:
+        # config_parser.py:1690: default format lists each input
+        format = "\n".join(f"layer={i.name} %s" for i in ins)
     return _layer(_name(name, "print"), "print",
                   [Input(i.name) for i in ins],
-                  attrs={"format": format})
+                  attrs={"format": format, "user_arg": format})
 
 
 print_layer = printer_layer
@@ -827,6 +862,14 @@ def img_conv_layer(input, filter_size, num_filters, name=None,
              "padding": _pair(padding), "groups": groups}
     if num_channels:
         extra["channels"] = num_channels
+    if param_attr is None:
+        # reference default (layers.py:2445): He-style std from the
+        # filter fan-in (channels resolved from the input when omitted),
+        # truncated like Python 2's str(float)
+        init_w = (2.0 / (_pair(filter_size) ** 2
+                         * _resolved_channels(src, num_channels))) ** 0.5
+        param_attr = ParameterAttribute(initial_mean=0.0,
+                                        initial_std=float(f"{init_w:.12g}"))
     return _layer(
         _name(name, "conv"), ltype,
         [Input(src.name, param_attr=_pattr(param_attr), extra=extra)],

@@ -72,6 +72,7 @@ def _apply_attr(spec: ParamSpec, attr: Optional[ParamAttr]) -> ParamSpec:
         is_static=attr.is_static or spec.is_static,
         learning_rate=attr.learning_rate,
         sparse_grad=attr.sparse_grad or spec.sparse_grad,
+        user_sparse=attr.sparse_grad or spec.user_sparse,
         l1_rate=attr.l1_rate,
         l2_rate=attr.l2_rate,
         sparsity_ratio=(attr.sparsity_ratio

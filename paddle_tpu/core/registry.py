@@ -62,6 +62,20 @@ class ParamSpec:
     # sub-network parameters (shared across timesteps like the reference's
     # frame-shared weights, RecurrentGradientMachine.cpp:294-346)
     absolute_name: Optional[str] = None
+    # wire-format ParameterConfig.is_sparse: emitted explicitly (even
+    # when False) for layer types whose reference handler writes it
+    # (selective_fc's create_input_parameter with a sparse format)
+    wire_sparse: Optional[bool] = None
+    # wire-format ParameterConfig.is_shared (batch-norm moving stats are
+    # marked shared in the reference)
+    wire_shared: Optional[bool] = None
+    # wire-format dims override where the reference's recorded layout
+    # differs from the physical shape (conv shared biases: [size, 1])
+    wire_dims: Optional[Tuple[int, ...]] = None
+    # True only when the USER requested sparse_update (ParamAttr); the
+    # engine's sparse_grad default (embedding touched-rows updates) is an
+    # internal optimization the reference wire format doesn't record
+    user_sparse: bool = False
 
 
 class LayerImpl:

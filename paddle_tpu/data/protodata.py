@@ -1,0 +1,225 @@
+"""ProtoDataProvider: the binary proto-shard data path.
+
+The reference's ``ProtoDataProvider`` (``paddle/gserver/dataproviders/
+ProtoDataProvider.h:48``) reads shard files framed as varint-length-
+prefixed protobuf messages — one ``DataHeader`` then a stream of
+``DataSample``s (``proto/DataFormat.proto``; framing in
+``ProtoReader.h:96``: CodedInputStream varint32 + message bytes, gzip
+when the filename ends in ``.gz``). Samples are timesteps;
+``is_beginning`` marks sequence starts (``ProtoDataProvider.cpp:227``).
+
+This module reads (and writes) that exact format and exposes the
+standard reader protocol, so reference jobs declaring ``ProtoData()``
+feed the trainer directly — e.g. the sample shards checked into
+``paddle/trainer/tests/`` (mnist_bin_part, data_bin_part).
+"""
+
+from __future__ import annotations
+
+import gzip
+from typing import IO, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from paddle_tpu.proto import DataHeader, DataSample, SlotDef
+
+
+# ----------------------------------------------------------------- framing
+def _read_varint(f: IO[bytes]) -> Optional[int]:
+    result, shift = 0, 0
+    while True:
+        b = f.read(1)
+        if not b:
+            return None if shift == 0 else _bad_eof()
+        byte = b[0]
+        result |= (byte & 0x7F) << shift
+        if not byte & 0x80:
+            return result
+        shift += 7
+        if shift > 63:
+            raise IOError("malformed varint in proto data shard")
+
+
+def _bad_eof():
+    raise IOError("truncated proto data shard (EOF inside varint)")
+
+
+def _write_varint(f: IO[bytes], value: int):
+    while True:
+        bits = value & 0x7F
+        value >>= 7
+        if value:
+            f.write(bytes([bits | 0x80]))
+        else:
+            f.write(bytes([bits]))
+            return
+
+
+def _open(path: str, mode: str) -> IO[bytes]:
+    if str(path).endswith(".gz"):
+        return gzip.open(path, mode)
+    return open(path, mode)
+
+
+def anchor_path(p: str, base: str, depth: int = 5) -> str:
+    """Resolve a source-root-relative path ("trainer/tests/mnist_bin_part",
+    the reference's .list convention) by walking up from ``base``."""
+    import os
+    if os.path.isabs(p) or os.path.exists(p):
+        return p
+    for _ in range(depth):
+        cand = os.path.join(base, p)
+        if os.path.exists(cand):
+            return cand
+        base = os.path.dirname(base) or base
+    return p
+
+
+def read_messages(path: str):
+    """Yield (DataHeader, iterator-of-DataSample) for one shard file."""
+    f = _open(path, "rb")
+    n = _read_varint(f)
+    if n is None:
+        f.close()
+        raise IOError(f"{path}: empty proto data shard")
+    header = DataHeader()
+    header.ParseFromString(f.read(n))
+
+    def samples() -> Iterator[DataSample]:
+        try:
+            while True:
+                n = _read_varint(f)
+                if n is None:
+                    return
+                s = DataSample()
+                s.ParseFromString(f.read(n))
+                yield s
+        finally:
+            f.close()
+
+    return header, samples()
+
+
+def write_shard(path: str, header: DataHeader,
+                samples: Sequence[DataSample]):
+    """Write one shard in the reference framing (gzip iff path endswith
+    .gz) — the role of ``paddle/trainer/tests/gen_proto_data.py``."""
+    with _open(path, "wb") as f:
+        blob = header.SerializeToString()
+        _write_varint(f, len(blob))
+        f.write(blob)
+        for s in samples:
+            blob = s.SerializeToString()
+            _write_varint(f, len(blob))
+            f.write(blob)
+
+
+# ----------------------------------------------------------------- decode
+def _decode_slot(sample: DataSample, i: int, slot: SlotDef,
+                 num_vec_slots: int):
+    """One slot of one timestep -> the python value our DataFeeder
+    accepts for the matching input type (``fillSlots``,
+    ``ProtoDataProvider.cpp:239-330``)."""
+    t = slot.type
+    if t == SlotDef.VECTOR_DENSE:
+        return np.asarray(sample.vector_slots[i].values, np.float32)
+    if t == SlotDef.VECTOR_SPARSE_NON_VALUE:
+        return list(sample.vector_slots[i].ids)
+    if t == SlotDef.VECTOR_SPARSE_VALUE:
+        vs = sample.vector_slots[i]
+        return list(zip(vs.ids, vs.values))
+    if t == SlotDef.INDEX:
+        return int(sample.id_slots[i - num_vec_slots])
+    if t == SlotDef.VAR_MDIM_DENSE:
+        return np.asarray(sample.vector_slots[i].values, np.float32)
+    if t == SlotDef.STRING:
+        return list(sample.vector_slots[i].strs)
+    raise NotImplementedError(f"proto data slot type {t}")
+
+
+def slot_input_types(header: DataHeader, sequence: bool):
+    """SlotDefs -> the reader's input types (`data/types.py` vocabulary),
+    per-timestep types wrapped into their *_sequence forms when the
+    shard carries multi-timestep sequences."""
+    from paddle_tpu.data import types as T
+    out = []
+    for sd in header.slot_defs:
+        if sd.type == SlotDef.VECTOR_DENSE:
+            t = (T.dense_vector_sequence(sd.dim) if sequence
+                 else T.dense_vector(sd.dim))
+        elif sd.type == SlotDef.VECTOR_SPARSE_NON_VALUE:
+            t = (T.sparse_binary_vector_sequence(sd.dim) if sequence
+                 else T.sparse_binary_vector(sd.dim))
+        elif sd.type == SlotDef.VECTOR_SPARSE_VALUE:
+            t = (T.sparse_float_vector_sequence(sd.dim) if sequence
+                 else T.sparse_float_vector(sd.dim))
+        elif sd.type == SlotDef.INDEX:
+            t = (T.integer_value_sequence(sd.dim) if sequence
+                 else T.integer_value(sd.dim))
+        else:
+            t = None  # VAR_MDIM/STRING: caller feeds raw
+        out.append(t)
+    return out
+
+
+class ProtoDataReader:
+    """Reader over proto shards: yields one tuple per *sequence* (each
+    slot a list of per-timestep values) when the shards carry sequences,
+    else one tuple per sample — the shapes DataFeeder expects.
+
+    ``file_list``: a .list file of shard paths (one per line, the
+    reference's ``files`` convention, e.g. mnist.list) or a list of shard
+    paths."""
+
+    def __init__(self, file_list):
+        if isinstance(file_list, str):
+            import os
+            with open(file_list) as f:
+                raw = [ln.strip() for ln in f if ln.strip()]
+            base = os.path.dirname(os.path.abspath(file_list))
+            self.files: List[str] = [anchor_path(p, base) for p in raw]
+        else:
+            self.files = list(file_list)
+        if not self.files:
+            raise ValueError("proto data: empty file list")
+        self.header, _ = read_messages(self.files[0])
+        # probe sequence-ness: any sample beyond the first with
+        # is_beginning False means timesteps group into sequences
+        self.is_sequence = self._probe_sequence()
+        self.input_types = slot_input_types(self.header, self.is_sequence)
+
+    def _probe_sequence(self, limit: int = 64) -> bool:
+        _, samples = read_messages(self.files[0])
+        for k, s in enumerate(samples):
+            if k > 0 and not s.is_beginning:
+                return True
+            if k >= limit:
+                break
+        return False
+
+    def __call__(self):
+        nvec = sum(1 for sd in self.header.slot_defs
+                   if sd.type != SlotDef.INDEX)
+        nslots = len(self.header.slot_defs)
+        for path in self.files:
+            header, samples = read_messages(path)
+            if len(header.slot_defs) != nslots:
+                raise IOError(f"{path}: slot_defs mismatch across shards")
+            seq: Optional[list] = None
+            for s in samples:
+                step = tuple(
+                    _decode_slot(s, i, header.slot_defs[i], nvec)
+                    for i in range(nslots))
+                if not self.is_sequence:
+                    yield step
+                    continue
+                if s.is_beginning and seq is not None:
+                    yield tuple(seq)
+                    seq = None
+                if seq is None:
+                    seq = [[] for _ in range(nslots)]
+                for i, v in enumerate(step):
+                    seq[i].append(v)
+            if seq is not None:
+                yield tuple(seq)
+                seq = None

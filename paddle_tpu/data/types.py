@@ -43,5 +43,13 @@ def sparse_binary_vector(dim):
     return InputType(dim, NO_SEQUENCE, SPARSE_BINARY)
 
 
+def sparse_binary_vector_sequence(dim):
+    return InputType(dim, SEQUENCE, SPARSE_BINARY)
+
+
 def sparse_float_vector(dim):
     return InputType(dim, NO_SEQUENCE, SPARSE_FLOAT)
+
+
+def sparse_float_vector_sequence(dim):
+    return InputType(dim, SEQUENCE, SPARSE_FLOAT)

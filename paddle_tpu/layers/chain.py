@@ -105,7 +105,8 @@ class CRFLayer(LayerImpl):
 
     def params(self, cfg, in_infos):
         C = in_infos[0].size
-        return {"w0": ParamSpec(shape=(C + 2, C), init="zeros")}
+        # reference init: plain create_input_parameter -> smart normal
+        return {"w0": ParamSpec(shape=(C + 2, C))}
 
     def apply(self, cfg, params, ins, ctx):
         x, label = ins[0], ins[1]
@@ -131,7 +132,8 @@ class CRFDecodingLayer(LayerImpl):
 
     def params(self, cfg, in_infos):
         C = in_infos[0].size
-        return {"w0": ParamSpec(shape=(C + 2, C), init="zeros")}
+        # reference init: plain create_input_parameter -> smart normal
+        return {"w0": ParamSpec(shape=(C + 2, C))}
 
     def apply(self, cfg, params, ins, ctx):
         x = ins[0]

@@ -255,8 +255,9 @@ class MixedLayer(LayerImpl):
         if kind == "trans_full_matrix":
             return {f"w{i}": ParamSpec(shape=(cfg.size, info.size))}
         if kind == "dot_mul":
-            return {f"w{i}": ParamSpec(shape=(cfg.size,), initial_mean=1.0,
-                                       initial_std=0.0, init="const")}
+            # reference init: create_input_parameter with dims [1, size]
+            # -> smart normal, std = 1/sqrt(1) = 1.0 (not const-ones)
+            return {f"w{i}": ParamSpec(shape=(cfg.size,))}
         if kind == "table":
             return {f"w{i}": ParamSpec(shape=(proj["vocab_size"], cfg.size),
                                        sparse_grad=True)}
@@ -282,7 +283,9 @@ class MixedLayer(LayerImpl):
             fsy = proj.get("filter_size_y") or fs
             nf = proj["num_filters"]
             if kind == "conv":
-                return {f"w{i}": ParamSpec(shape=(fsy, fs, c // groups, nf))}
+                # the reference records conv projection params dimless
+                return {f"w{i}": ParamSpec(shape=(fsy, fs, c // groups, nf),
+                                           wire_dims=())}
             return {f"w{i}": ParamSpec(shape=(fsy, fs, nf // groups, c))}
         return {}  # identity
 

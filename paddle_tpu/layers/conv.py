@@ -95,7 +95,8 @@ class ConvLayer(LayerImpl):
             c = derive_geom(info, c)[0]
             specs[f"w{i}"] = ParamSpec(shape=(fsy, fs, c // groups, nf))
         if cfg.bias:
-            specs["wbias"] = ParamSpec(shape=(nf,), init="zeros", is_bias=True)
+            specs["wbias"] = ParamSpec(shape=(nf,), init="zeros", is_bias=True,
+                                       wire_dims=(nf, 1))
         return specs
 
     def apply(self, cfg, params, ins, ctx):
@@ -143,7 +144,8 @@ class ConvTransLayer(LayerImpl):
             # gradient-of-conv layout: treat as conv from nf -> c
             specs[f"w{i}"] = ParamSpec(shape=(fsy, fs, nf // groups, c))
         if cfg.bias:
-            specs["wbias"] = ParamSpec(shape=(nf,), init="zeros", is_bias=True)
+            specs["wbias"] = ParamSpec(shape=(nf,), init="zeros", is_bias=True,
+                                       wire_dims=(nf, 1))
         return specs
 
     def apply(self, cfg, params, ins, ctx):

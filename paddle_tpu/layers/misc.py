@@ -184,7 +184,10 @@ class PReluLayer(LayerImpl):
     def params(self, cfg, in_infos):
         partial = cfg.attrs.get("partial_sum", 1)
         n = in_infos[0].size // partial
-        return {"w0": ParamSpec(shape=(n,), init="const", initial_mean=0.25)}
+        # the reference initializes the slopes smart-normal like any
+        # input parameter (create_input_parameter with NO dims recorded,
+        # so smart std = 1/sqrt(size)) — NOT the torch-style 0.25 constant
+        return {"w0": ParamSpec(shape=(n,), wire_dims=())}
 
     def apply(self, cfg, params, ins, ctx):
         x = ins[0].value
@@ -498,7 +501,8 @@ class SelectiveFcLayer(LayerImpl):
         return ShapeInfo(size=cfg.size)
 
     def params(self, cfg, in_infos):
-        specs = {"w0": ParamSpec(shape=(in_infos[0].size, cfg.size))}
+        specs = {"w0": ParamSpec(shape=(in_infos[0].size, cfg.size),
+                                 wire_sparse=False)}
         if cfg.bias:
             specs["wbias"] = ParamSpec(shape=(cfg.size,), init="zeros",
                                        is_bias=True)

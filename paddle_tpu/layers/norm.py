@@ -34,9 +34,12 @@ class BatchNormLayer(LayerImpl):
             "w0": ParamSpec(shape=(c,), init="const", initial_mean=1.0,
                             initial_std=0.0),
             "wbias": ParamSpec(shape=(c,), init="zeros", is_bias=True),
-            "w1": ParamSpec(shape=(c,), init="zeros", is_static=True),
-            "w2": ParamSpec(shape=(c,), init="const", initial_mean=1.0,
-                                  is_static=True),
+            "w1": ParamSpec(shape=(c,), init="zeros", is_static=True,
+                            wire_shared=True),
+            # moving variance starts at 0 like the reference (the
+            # epsilon in the denominator keeps sqrt well-defined)
+            "w2": ParamSpec(shape=(c,), init="zeros", is_static=True,
+                            wire_shared=True),
         }
 
     def apply(self, cfg, params, ins, ctx):
@@ -78,7 +81,10 @@ class CrossMapNormLayer(LayerImpl):
         info = ctx.in_infos[0]
         extra = cfg.inputs[0].extra
         size = extra.get("size", 5)
-        alpha = extra.get("scale", 1e-4) * size
+        # the reference folds /size into the stored scale at config time
+        # (parse_norm, config_parser.py:1239-1240) and the kernel applies
+        # it verbatim — the effective coefficient is user_scale / size
+        alpha = extra.get("scale", 1e-4)
         beta = extra.get("pow", 0.75)
         x = to_nhwc(ins[0].value, info.channels, info.height, info.width)
         sq = jnp.square(x)

@@ -103,6 +103,58 @@ def _golden_model(name):
         return tc.model_config
 
 
+# ---- full-field parity normalizations -------------------------------------
+# The short, documented list of wire-format divergences between our
+# exporter and the reference goldens. Everything NOT cleared here is
+# compared verbatim by test_golden_protostr_full_field_parity.
+def normalize_layer_pair(ours, gold):
+    pass
+
+
+def normalize_param_pair(ours, gold):
+    """Whitelisted dims-layout divergences (total size is ALWAYS
+    compared, and mismatched 2-dim layouts still fail):
+
+    1. fused-gate packing: the reference stores lstm/tensor weights as
+       3-dim blocks ((H, H, 4) / (D, D, K)); the engine packs them
+       2-dim ((H, 4H) / (D, D*K)) so the recurrent matmul is one MXU
+       op. Compared by total size only.
+    2. dimless goldens: create_input_parameter without dims (prelu
+       slopes) leaves ParameterConfig.dims empty; the engine always
+       records the physical shape.
+    """
+    if list(ours.dims) != list(gold.dims) and len(gold.dims) in (0, 3):
+        ours.ClearField("dims")
+        gold.ClearField("dims")
+
+
+@needs_ref
+@pytest.mark.parametrize("name", GOLDEN_PARITY_CONFIGS)
+def test_golden_protostr_full_field_parity(name):
+    """Complete LayerConfig/ParameterConfig text-format equality against
+    the reference goldens, modulo the explicit normalize_* whitelist —
+    the ``ProtobufEqualMain.cpp`` bar (the structural test above checks
+    the load-bearing subset and predates this)."""
+    from google.protobuf import text_format
+    parsed = parse_config(str(CFG_DIR / name))
+    ours = parsed.model_proto()
+    ref = _golden_model(name)
+    assert [l.name for l in ours.layers] == [l.name for l in ref.layers]
+    for ol, rl in zip(ours.layers, ref.layers):
+        normalize_layer_pair(ol, rl)
+        assert text_format.MessageToString(ol) == \
+            text_format.MessageToString(rl), ol.name
+    ours_p = {p.name: p for p in ours.parameters}
+    ref_p = {p.name: p for p in ref.parameters}
+    assert set(ours_p) == set(ref_p)
+    for pname in sorted(ours_p):
+        a, b = ours_p[pname], ref_p[pname]
+        assert a.size == b.size, pname
+        normalize_param_pair(a, b)
+        assert text_format.MessageToString(a) == \
+            text_format.MessageToString(b), pname
+
+
 @needs_ref
 @pytest.mark.parametrize("name", GOLDEN_PARITY_CONFIGS)
 def test_golden_protostr_structural_parity(name):

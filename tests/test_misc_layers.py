@@ -35,7 +35,10 @@ def test_clip_power_prelu():
                                np.clip(x, -0.5, 0.5))
     np.testing.assert_allclose(np.asarray(outs[p.name].value), x ** 2.0,
                                rtol=1e-5)
-    want = np.maximum(x, 0) + 0.25 * np.minimum(x, 0)
+    # slopes init smart-normal like the reference (create_input_parameter
+    # with no explicit init) — read the actual values
+    alpha = np.asarray(params[f"_{pr.name}.w0"])
+    want = np.maximum(x, 0) + alpha * np.minimum(x, 0)
     np.testing.assert_allclose(np.asarray(outs[pr.name].value), want,
                                rtol=1e-5)
 

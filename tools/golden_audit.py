@@ -1,0 +1,105 @@
+"""Full-field golden parity audit loop.
+
+For every reference golden protostr, compare our exported LayerConfig /
+ParameterConfig messages field-for-field (text format) against the
+golden, after applying the documented normalizations, and print the
+FIRST divergence per config. Drive this until the only output is
+'all match', then lock the result in tests/test_compat_config.py::
+test_golden_protostr_full_field_parity.
+
+Usage: python tools/golden_audit.py [config.py ...]
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+
+sys.path.insert(0, ".")
+
+from google.protobuf import text_format  # noqa: E402
+
+from paddle_tpu.compat import parse_config  # noqa: E402
+from paddle_tpu.proto import ModelConfig_pb2, TrainerConfig_pb2  # noqa: E402
+
+REF = pathlib.Path("/root/reference")
+CFG_DIR = REF / "python/paddle/trainer_config_helpers/tests/configs"
+GOLDEN_DIR = CFG_DIR / "protostr"
+
+
+def golden_model(name):
+    txt = (GOLDEN_DIR / (name[:-3] + ".protostr")).read_text()
+    mc = ModelConfig_pb2.ModelConfig()
+    try:
+        text_format.Parse(txt, mc)
+        return mc
+    except text_format.ParseError:
+        tc = TrainerConfig_pb2.TrainerConfig()
+        text_format.Parse(txt, tc)
+        return tc.model_config
+
+
+def normalize_pair(ol, rl):
+    """Documented divergences — see test_compat_config.py whitelist."""
+    from tests.test_compat_config import normalize_layer_pair
+    normalize_layer_pair(ol, rl)
+
+
+def audit(name, verbose=False):
+    parsed = parse_config(str(CFG_DIR / name))
+    mine = parsed.model_proto()
+    ref = golden_model(name)
+    if [l.name for l in mine.layers] != [l.name for l in ref.layers]:
+        return f"layer name lists differ"
+    for ol, rl in zip(mine.layers, ref.layers):
+        normalize_pair(ol, rl)
+        a = text_format.MessageToString(ol)
+        b = text_format.MessageToString(rl)
+        if a != b:
+            av, bv = a.splitlines(), b.splitlines()
+            diff = [f"  ours: {x}\n  gold: {y}"
+                    for x, y in zip(av, bv) if x != y]
+            extra = ""
+            if len(av) != len(bv):
+                sa, sb = set(av), set(bv)
+                extra = (f"\n  only-ours: {sorted(sa - sb)[:6]}"
+                         f"\n  only-gold: {sorted(sb - sa)[:6]}")
+            return (f"layer {ol.name!r} ({ol.type}):\n"
+                    + "\n".join(diff[:4]) + extra)
+    ours_p = {p.name: p for p in mine.parameters}
+    ref_p = {p.name: p for p in ref.parameters}
+    if set(ours_p) != set(ref_p):
+        return f"param name sets differ: {set(ours_p) ^ set(ref_p)}"
+    for pname in ours_p:
+        from tests.test_compat_config import normalize_param_pair
+        a, b = ours_p[pname], ref_p[pname]
+        if a.size != b.size:
+            return f"param {pname!r} size: {a.size} vs {b.size}"
+        normalize_param_pair(a, b)
+        ta = text_format.MessageToString(a)
+        tb = text_format.MessageToString(b)
+        if ta != tb:
+            return (f"param {pname!r}:\n  ours: {ta!r}\n  gold: {tb!r}")
+    return None
+
+
+def main():
+    names = sys.argv[1:]
+    if not names:
+        from tests.test_compat_config import GOLDEN_PARITY_CONFIGS
+        names = GOLDEN_PARITY_CONFIGS
+    bad = 0
+    for name in names:
+        try:
+            msg = audit(name)
+        except Exception as e:  # noqa: BLE001
+            msg = f"EXCEPTION {e!r}"
+        if msg:
+            bad += 1
+            print(f"== {name}: {msg}\n")
+    print(f"{len(names) - bad}/{len(names)} match")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
