@@ -495,6 +495,11 @@ class ParsedConfig:
     def _reader_from(self, source: DataSource, *, is_train: bool):
         if source is None:
             return None, None
+        key = (source.kind, source.file_list, source.module, source.obj,
+               is_train)
+        cached = getattr(self, "_reader_cache", {}).get(key)
+        if cached is not None:
+            return cached
         if source.kind == "proto":
             # binary proto shards (ProtoDataProvider.h:48) need no
             # python provider module — the header drives the types
@@ -512,6 +517,8 @@ class ParsedConfig:
             batched = batch(rdr, self.batch_size())
             batched.input_types = rdr.input_types
             rdr.as_reader = lambda *a, **k: rdr  # provider-shape shim
+            self.__dict__.setdefault("_reader_cache", {})[key] = \
+                (batched, rdr)
             return batched, rdr
         if source.module is None:
             return None, None

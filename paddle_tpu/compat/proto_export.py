@@ -124,7 +124,9 @@ def _set_norm_conf(conf, extra, in_info, out_info):
 
 def _conv_out_geom(ih, iw, extra, trans):
     """(oh, ow) for a conv/convt spec, per-axis with the *_y variants
-    defaulting to their x twins — parse_conv's formulas."""
+    defaulting to their x twins — delegating the per-axis formula to the
+    engine's single source of truth (layers/conv.py)."""
+    from paddle_tpu.layers.conv import _conv_geom
     fs = int(extra["filter_size"])
     fsy = int(extra.get("filter_size_y") or fs)
     st = int(extra.get("stride") or 1)
@@ -134,10 +136,30 @@ def _conv_out_geom(ih, iw, extra, trans):
               if extra.get("padding_y") is not None else pd)
 
     def _out(sz, f, s, p):
-        return (sz - 1) * s + f - 2 * p if trans \
-            else (sz - f + 2 * p) // s + 1
+        return (sz - 1) * s + f - 2 * p if trans else _conv_geom(sz, f, p, s)
 
     return _out(ih, fsy, sty, pdy), _out(iw, fs, st, pd)
+
+
+def _export_conv_spec(conf, spec, in_info, in_size, trans):
+    """Shared conv/convt export for projections AND operators: derive
+    input geometry, compute output geometry, fill conv_conf. Returns
+    (num_filters, output_size)."""
+    from paddle_tpu.core.registry import ShapeInfo as _SI
+    from paddle_tpu.layers.conv import derive_geom
+    extra = {k: spec.get(k) for k in (
+        "filter_size", "stride", "padding", "filter_size_y",
+        "stride_y", "padding_y", "groups")}
+    extra["channels"] = spec.get("num_channels") or spec.get("channels")
+    c, ih, iw = derive_geom(in_info or _SI(size=in_size),
+                            extra.get("channels"))
+    oh, ow = _conv_out_geom(ih, iw, extra, trans)
+    nf = int(spec.get("num_filters") or 0)
+    _set_conv_conf(conf, extra,
+                   _SI(size=in_size, channels=c, height=ih, width=iw),
+                   _SI(size=nf * oh * ow, channels=nf, height=oh,
+                       width=ow), nf, trans=trans)
+    return nf, nf * oh * ow
 
 
 def _set_proj_conf(conf, spec, name, in_size, out_size, in_info=None):
@@ -158,21 +180,8 @@ def _set_proj_conf(conf, spec, name, in_size, out_size, in_info=None):
     if ptype == "identity_offset":
         conf.offset = int(spec.get("offset", 0))
     if ptype in ("conv", "convt") and spec.get("filter_size"):
-        from paddle_tpu.core.registry import ShapeInfo as _SI
-        from paddle_tpu.layers.conv import derive_geom
-        trans = ptype == "convt"
-        extra = {k: spec.get(k) for k in (
-            "filter_size", "stride", "padding", "filter_size_y",
-            "stride_y", "padding_y", "groups")}
-        extra["channels"] = spec.get("num_channels") or spec.get("channels")
-        c, ih, iw = derive_geom(in_info or _SI(size=in_size),
-                                extra.get("channels"))
-        oh, ow = _conv_out_geom(ih, iw, extra, trans)
-        nf = int(spec.get("num_filters") or 0)
-        _set_conv_conf(conf.conv_conf, extra,
-                       _SI(size=in_size, channels=c, height=ih, width=iw),
-                       _SI(size=nf * oh * ow, channels=nf, height=oh,
-                           width=ow), nf, trans=trans)
+        nf, _ = _export_conv_spec(conf.conv_conf, spec, in_info, in_size,
+                                  ptype == "convt")
         conf.num_filters = nf
     for s, e in spec.get("slices", []):
         sl = conf.slices.add()
@@ -438,23 +447,11 @@ def _export_layer(model: ModelDef, net: Network, name: str, proto_layer,
             pop.type = "convt" if op["type"] == "convt_op" else "conv"
             idx0 = int(op["input_indices"][0])
             img_info = net.shape_infos[layer.inputs[idx0].layer_name]
-            extra = {k: op.get(k) for k in (
-                "filter_size", "stride", "padding", "filter_size_y",
-                "stride_y", "padding_y")}
-            extra["channels"] = op.get("num_channels")
-            trans = op["type"] == "convt_op"
-            from paddle_tpu.layers.conv import derive_geom
-            c, ih, iw = derive_geom(img_info, extra.get("channels"))
-            oh, ow = _conv_out_geom(ih, iw, extra, trans)
-            from paddle_tpu.core.registry import ShapeInfo as _SI
-            nf = int(op.get("num_filters") or 0)
-            _set_conv_conf(pop.conv_conf, extra,
-                           _SI(size=img_info.size, channels=c, height=ih,
-                               width=iw),
-                           _SI(size=nf * oh * ow, channels=nf, height=oh,
-                               width=ow), nf, trans=trans)
+            nf, out_size = _export_conv_spec(
+                pop.conv_conf, op, img_info, img_info.size,
+                op["type"] == "convt_op")
             pop.num_filters = nf
-            pop.output_size = nf * oh * ow
+            pop.output_size = out_size
 
 
 def _export_parameter(pname: str, spec, proto_param):

@@ -75,6 +75,14 @@ def anchor_path(p: str, base: str, depth: int = 5) -> str:
     return p
 
 
+def _read_exact(f: IO[bytes], n: int, path: str) -> bytes:
+    blob = f.read(n)
+    if len(blob) != n:
+        raise IOError(f"{path}: truncated proto data shard "
+                      f"(wanted {n} bytes, got {len(blob)})")
+    return blob
+
+
 def read_messages(path: str):
     """Yield (DataHeader, iterator-of-DataSample) for one shard file."""
     f = _open(path, "rb")
@@ -83,7 +91,8 @@ def read_messages(path: str):
         f.close()
         raise IOError(f"{path}: empty proto data shard")
     header = DataHeader()
-    header.ParseFromString(f.read(n))
+    header.ParseFromString(_read_exact(f, n, path))
+    _check_header(header, path)
 
     def samples() -> Iterator[DataSample]:
         try:
@@ -92,12 +101,26 @@ def read_messages(path: str):
                 if n is None:
                     return
                 s = DataSample()
-                s.ParseFromString(f.read(n))
+                s.ParseFromString(_read_exact(f, n, path))
                 yield s
         finally:
             f.close()
 
     return header, samples()
+
+
+def _check_header(header: DataHeader, path: str):
+    """checkDataHeader parity (ProtoDataProvider.cpp:107-110): INDEX
+    slots must follow every vector slot — decoding indexes id_slots by
+    (i - num_vec_slots), which an interleaved header would corrupt."""
+    seen_index = False
+    for sd in header.slot_defs:
+        if sd.type == SlotDef.INDEX:
+            seen_index = True
+        elif seen_index:
+            raise IOError(
+                f"{path}: malformed DataHeader — vector slot after an "
+                "INDEX slot (the wire format requires INDEX slots last)")
 
 
 def write_shard(path: str, header: DataHeader,
@@ -182,20 +205,24 @@ class ProtoDataReader:
             self.files = list(file_list)
         if not self.files:
             raise ValueError("proto data: empty file list")
-        self.header, _ = read_messages(self.files[0])
-        # probe sequence-ness: any sample beyond the first with
-        # is_beginning False means timesteps group into sequences
-        self.is_sequence = self._probe_sequence()
-        self.input_types = slot_input_types(self.header, self.is_sequence)
-
-    def _probe_sequence(self, limit: int = 64) -> bool:
-        _, samples = read_messages(self.files[0])
-        for k, s in enumerate(samples):
-            if k > 0 and not s.is_beginning:
-                return True
-            if k >= limit:
+        # one pass per file: header from the first, sequence-ness from
+        # the first 64 samples of EVERY file (a leading shard of
+        # singleton sequences must not misclassify the dataset)
+        self.header = None
+        self.is_sequence = False
+        for path in self.files:
+            header, samples = read_messages(path)
+            if self.header is None:
+                self.header = header
+            for k, s in enumerate(samples):
+                if k > 0 and not s.is_beginning:
+                    self.is_sequence = True
+                    break
+                if k >= 64:
+                    break
+            if self.is_sequence:
                 break
-        return False
+        self.input_types = slot_input_types(self.header, self.is_sequence)
 
     def __call__(self):
         nvec = sum(1 for sd in self.header.slot_defs
