@@ -126,16 +126,27 @@ def launch_local(script: str, nproc: int, *,
             server = MasterServer(service).start()
             master_addr = f"{server.addr[0]}:{server.addr[1]}"
         procs = []
-        for pid in range(nproc):
-            wenv = _worker_env(dict(env or os.environ), nproc=nproc,
-                               pid=pid, coordinator=coordinator,
-                               master=master_addr, distributed=distributed)
-            procs.append(subprocess.Popen(
-                [sys.executable, script, *script_args], env=wenv))
+        try:
+            for pid in range(nproc):
+                wenv = _worker_env(dict(env or os.environ), nproc=nproc,
+                                   pid=pid, coordinator=coordinator,
+                                   master=master_addr,
+                                   distributed=distributed)
+                procs.append(subprocess.Popen(
+                    [sys.executable, script, *script_args], env=wenv))
+        except OSError:
+            for p in procs:  # don't orphan the already-spawned workers
+                p.kill()
+            raise
+        # one shared deadline: a wedged fleet costs ONE timeout, not
+        # nproc of them
+        import time
+        deadline = time.monotonic() + timeout
         rcs = []
         for p in procs:
             try:
-                rcs.append(p.wait(timeout=timeout))
+                rcs.append(p.wait(
+                    timeout=max(0.0, deadline - time.monotonic())))
             except subprocess.TimeoutExpired:
                 p.kill()
                 rcs.append(-9)
@@ -181,8 +192,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                          "instead of launching locally")
     ap.add_argument("--master", default="",
                     help="external master endpoint host:port")
-    ap.add_argument("--distributed", action="store_true",
-                    help="workers call jax.distributed.initialize")
+    ap.add_argument("--distributed", default=None,
+                    action=__import__("argparse").BooleanOptionalAction,
+                    help="workers call jax.distributed.initialize "
+                         "(default: on for --hosts, off locally)")
     ap.add_argument("script")
     ap.add_argument("script_args", nargs="*")
     args = ap.parse_args(argv)
@@ -191,7 +204,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         for host, cmd in build_host_commands(
                 args.hosts.split(","), args.script,
                 script_args=args.script_args, master_addr=args.master,
-                distributed=True):
+                distributed=(args.distributed
+                             if args.distributed is not None else True)):
             print(f"# {host}\n{cmd}")
         return 0
     rcs = launch_local(args.script, args.nproc,
@@ -199,7 +213,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                        env={**os.environ,
                             **({"PADDLE_TPU_MASTER": args.master}
                                if args.master else {})},
-                       distributed=args.distributed)
+                       distributed=bool(args.distributed))
     return 0 if all(rc == 0 for rc in rcs) else 1
 
 

@@ -160,6 +160,164 @@ def _lstm_pallas(xs, mask, w, pI, pF, pO, h0, c0, with_residuals):
       pO.reshape(1, H), h0, c0)
 
 
+# ------------------------------------------------- pallas fwd, tiled-H
+# For big hidden sizes (BASELINE.md h=1280: w alone is 26 MB fp32) the
+# weight cannot stay VMEM-resident. This variant tiles the HIDDEN
+# dimension: grid (T, J) with J = H/Hb column blocks iterated innermost;
+# block (t, j) streams w[:, 4 gate columns of block j] from HBM, computes
+# that block's gates/cell update, and keeps only the full h/c state
+# (2*B*H) resident in scratch. The cell math is elementwise in the H
+# columns, so blocks are independent within a timestep; the sequential
+# TPU grid guarantees every j of step t completes before step t+1 reads
+# the full h.
+
+def _lstm_kernel_tiled(with_residuals, hb, xs_ref, mask_ref, w_ref, pI_ref,
+                       pF_ref, pO_ref, h0_ref, c0_ref, *refs):
+    if with_residuals:
+        ys_ref, hs_ref, cs_ref, gates_ref, h_s, hn_s, c_s = refs
+    else:
+        ys_ref, hT_ref, cT_ref, h_s, hn_s, c_s = refs
+    t = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(jnp.logical_and(t == 0, j == 0))
+    def _():
+        h_s[:] = h0_ref[:]
+        c_s[:] = c0_ref[:]
+
+    cols = pl.dslice(j * hb, hb)
+    # every j block of this timestep must see the SAME h_{t-1}: h_s holds
+    # the previous step all timestep long; new values buffer in hn_s and
+    # commit after the last block
+    h = h_s[:]                      # full [B, H] = h_{t-1}
+    c = c_s[:, cols]                # [B, hb]
+    m = mask_ref[0]                 # [B, 1]
+    B = h.shape[0]
+    H = h.shape[1]
+    # w block [H, 4, hb] -> [H, 4*hb] (minor-axes merge, layout no-op)
+    wb = w_ref[:].reshape(H, 4 * hb)
+    gates = (xs_ref[0].reshape(B, 4 * hb)
+             + jnp.dot(h, wb, preferred_element_type=jnp.float32
+                       ).astype(h.dtype)).reshape(B, 4, hb)
+    a_i, a_ig, a_fg, a_og = (gates[:, 0], gates[:, 1], gates[:, 2],
+                             gates[:, 3])
+    i = jnp.tanh(a_i)
+    ig = jax.nn.sigmoid(a_ig + c * pI_ref[0])
+    fg = jax.nn.sigmoid(a_fg + c * pF_ref[0])
+    c_new = i * ig + c * fg
+    og = jax.nn.sigmoid(a_og + c_new * pO_ref[0])
+    h_new = og * jnp.tanh(c_new)
+
+    h_prev = h_s[:, cols]
+    h_next = jnp.where(m > 0, h_new, h_prev)
+    c_next = jnp.where(m > 0, c_new, c)
+    hn_s[:, cols] = h_next
+    c_s[:, cols] = c_next
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _():
+        h_s[:] = hn_s[:]
+
+    ys_ref[0] = h_new * m
+    if with_residuals:
+        hs_ref[0] = h_next
+        cs_ref[0] = c_next
+        gates_ref[0] = jnp.stack([i, ig, fg, og], axis=1)
+    else:
+        hT_ref[:] = h_next
+        cT_ref[:] = c_next
+
+
+def _pick_hblock(H: int, B: int, itemsize: int) -> int:
+    """Largest lane-aligned divisor of H whose per-block working set
+    (streamed weight block + step blocks + full state) fits the VMEM
+    budget; 0 if none."""
+    for hb in (1024, 512, 256, 128):
+        if H % hb:
+            continue
+        resident = itemsize * (
+            H * 4 * hb        # weight block
+            + 6 * B * 4 * hb  # xs/gates/ys blocks (double-buffered)
+            + 3 * B * H       # h (prev + commit buffer) / c scratch
+            + 4 * B * hb)     # residual blocks
+        if resident <= common.VMEM_BUDGET_BYTES:
+            return hb
+    return 0
+
+
+def _lstm_pallas_tiled(xs, mask, w, pI, pF, pO, h0, c0, with_residuals,
+                       hb):
+    T, B, H4 = xs.shape
+    H = H4 // 4
+    J = H // hb
+    dt = xs.dtype
+    xs4 = xs.reshape(T, B, 4, H)
+    w4 = w.reshape(H, 4, H)
+    if with_residuals:
+        out_shapes = (
+            jax.ShapeDtypeStruct((T, B, H), dt),
+            jax.ShapeDtypeStruct((T, B, H), dt),
+            jax.ShapeDtypeStruct((T, B, H), dt),
+            jax.ShapeDtypeStruct((T, B, 4, H), dt),
+        )
+        out_specs = (
+            pl.BlockSpec((1, B, hb), lambda t, j: (t, 0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, B, hb), lambda t, j: (t, 0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, B, hb), lambda t, j: (t, 0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, B, 4, hb), lambda t, j: (t, 0, 0, j),
+                         memory_space=pltpu.VMEM),
+        )
+    else:
+        out_shapes = (
+            jax.ShapeDtypeStruct((T, B, H), dt),
+            jax.ShapeDtypeStruct((B, H), dt),
+            jax.ShapeDtypeStruct((B, H), dt),
+        )
+        out_specs = (
+            pl.BlockSpec((1, B, hb), lambda t, j: (t, 0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((B, hb), lambda t, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((B, hb), lambda t, j: (0, j),
+                         memory_space=pltpu.VMEM),
+        )
+    res = pl.pallas_call(
+        functools.partial(_lstm_kernel_tiled, with_residuals, hb),
+        grid=(T, J),
+        in_specs=[
+            pl.BlockSpec((1, B, 4, hb), lambda t, j: (t, 0, 0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, B, 1), lambda t, j: (t, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((H, 4, hb), lambda t, j: (0, 0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, hb), lambda t, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, hb), lambda t, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, hb), lambda t, j: (0, j),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((B, H), lambda t, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((B, H), lambda t, j: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        scratch_shapes=[pltpu.VMEM((B, H), dt), pltpu.VMEM((B, H), dt),
+                        pltpu.VMEM((B, H), dt)],
+        interpret=common.interpret(),
+    )(xs4, mask[..., None], w4, pI.reshape(1, H), pF.reshape(1, H),
+      pO.reshape(1, H), h0, c0)
+    if with_residuals:
+        ys, hs, cs, gates4 = res
+        return ys, hs, cs, gates4.reshape(T, B, 4 * H)
+    return res
+
+
 # ------------------------------------------------------------- custom vjp
 
 @jax.custom_vjp
@@ -173,6 +331,26 @@ def _lstm_core(xs, mask, w, pI, pF, pO, h0, c0):
 def _fwd_rule(xs, mask, w, pI, pF, pO, h0, c0):
     ys, hs, cs, gates = _lstm_pallas(xs, mask, w, pI, pF, pO, h0, c0,
                                      with_residuals=True)
+    res = (mask, w, pI, pF, pO, h0, c0, hs, cs, gates)
+    return (ys, hs[-1], cs[-1]), res
+
+
+def _hb_of(xs):
+    T, B, H4 = xs.shape
+    return _pick_hblock(H4 // 4, B, jnp.dtype(xs.dtype).itemsize)
+
+
+@jax.custom_vjp
+def _lstm_core_tiled(xs, mask, w, pI, pF, pO, h0, c0):
+    ys, hT, cT = _lstm_pallas_tiled(xs, mask, w, pI, pF, pO, h0, c0,
+                                    with_residuals=False, hb=_hb_of(xs))
+    return ys, hT, cT
+
+
+def _fwd_rule_tiled(xs, mask, w, pI, pF, pO, h0, c0):
+    ys, hs, cs, gates = _lstm_pallas_tiled(
+        xs, mask, w, pI, pF, pO, h0, c0, with_residuals=True,
+        hb=_hb_of(xs))
     res = (mask, w, pI, pF, pO, h0, c0, hs, cs, gates)
     return (ys, hs[-1], cs[-1]), res
 
@@ -219,19 +397,36 @@ def _bwd_rule(res, grads):
 
 
 _lstm_core.defvjp(_fwd_rule, _bwd_rule)
+_lstm_core_tiled.defvjp(_fwd_rule_tiled, _bwd_rule)
 
 
 # ---------------------------------------------------------------- public
+
+def lstm_dispatch(B: int, H: int, itemsize: int = 4) -> str:
+    """Which implementation these shapes take: "resident" (weight stays
+    in VMEM all T steps), "tiled" (big hidden sizes stream gate-column
+    blocks — BASELINE.md h=1280), or "ref" (lax.scan). Exposed so tests
+    can pin the benchmark shapes to their intended path."""
+    if common.mode() == "ref":
+        return "ref"
+    resident = itemsize * (H * 4 * H + 6 * B * 4 * H + 4 * B * H)
+    if resident <= common.VMEM_BUDGET_BYTES:
+        return "resident"
+    if H % 128 == 0 and _pick_hblock(H, B, itemsize):
+        return "tiled"
+    return "ref"
+
 
 def lstm_sequence(xs, mask, w, gate_bias, check_i, check_f, check_o, h0, c0,
                   reverse=False):
     """Fused LSTM over a padded [T,B,4H] gate-projection sequence.
 
-    Dispatches to the Pallas kernel when the resident working set (recurrent
-    weight + per-step blocks) fits VMEM, else to the lax.scan reference.
-    ``reverse=True`` runs the recurrence back-to-front (outputs stay in
-    input time order). Returns (ys [T,B,H], hT, cT). Differentiable either
-    way.
+    Dispatch (``lstm_dispatch``): the resident Pallas kernel when the
+    recurrent weight fits VMEM for all T steps, the tiled Pallas kernel
+    (weight streamed in gate-column blocks) for big hidden sizes, else
+    the lax.scan reference. ``reverse=True`` runs the recurrence
+    back-to-front (outputs stay in input time order). Returns
+    (ys [T,B,H], hT, cT). Differentiable on every path.
     """
     if reverse:
         ys, hT, cT = lstm_sequence(jnp.flip(xs, 0), jnp.flip(mask, 0), w,
@@ -240,10 +435,10 @@ def lstm_sequence(xs, mask, w, gate_bias, check_i, check_f, check_o, h0, c0,
         return jnp.flip(ys, 0), hT, cT
     T, B, H4 = xs.shape
     H = H4 // 4
-    itemsize = jnp.dtype(xs.dtype).itemsize
-    resident = itemsize * (H * H4 + 6 * B * H4 + 4 * B * H)
-    if not common.use_pallas(resident):
+    path = lstm_dispatch(B, H, jnp.dtype(xs.dtype).itemsize)
+    if path == "ref":
         return lstm_sequence_ref(xs, mask, w, gate_bias, check_i, check_f,
                                  check_o, h0, c0)
     xs_b = xs + gate_bias  # fold bias into the pre-projected input once
-    return _lstm_core(xs_b, mask, w, check_i, check_f, check_o, h0, c0)
+    core = _lstm_core if path == "resident" else _lstm_core_tiled
+    return core(xs_b, mask, w, check_i, check_f, check_o, h0, c0)

@@ -174,6 +174,26 @@ def main():
                                       h0, c0),
         (xs, w), (0, 1), report)
 
+    # ---- fused LSTM at the big-hidden BASELINE row (h=1280, bs=64:
+    # benchmark/README.md:108-127) — takes the TILED kernel (the weight
+    # no longer fits VMEM; lstm_dispatch must not fall back to scan)
+    from paddle_tpu.ops.lstm import lstm_dispatch
+    H2 = 1280
+    with common.force_mode("pallas"):
+        assert lstm_dispatch(B, H2) == "tiled", \
+            lstm_dispatch(B, H2)
+    mask2 = jnp.ones((T, B), jnp.float32)
+    xs2 = arr(T, B, 4 * H2, scale=0.1) + arr(4 * H2, scale=0.1)
+    w2 = arr(H2, 4 * H2, scale=0.05)
+    zb2 = jnp.zeros((4 * H2,), jnp.float32)
+    zc2 = jnp.zeros((H2,), jnp.float32)
+    h02 = c02 = jnp.zeros((B, H2), jnp.float32)
+    _compare(
+        "lstm_sequence_h1280_tiled",
+        lambda xs_, w_: lstm_sequence(xs_, mask2, w_, zb2, zc2, zc2, zc2,
+                                      h02, c02),
+        (xs2, w2), (0, 1), report)
+
     # ---- fused GRU
     xg, wg, ws = arr(T, B, 3 * H), arr(H, 2 * H), arr(H, H)
     bg = arr(3 * H)
@@ -249,7 +269,8 @@ def main():
 
     report["all_parity_ok"] = all(
         report[k]["parity_ok"]
-        for k in ("lstm_sequence", "gru_sequence", "flash_attention",
+        for k in ("lstm_sequence", "lstm_sequence_h1280_tiled",
+                  "gru_sequence", "flash_attention",
                   "crf_log_z", "ctc_loss"))
     report["all_checkgrad_ok"] = all(
         v["ok"] for v in report["checkgrad"].values())
