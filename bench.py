@@ -37,7 +37,9 @@ ITERS = int(os.environ.get("BENCH_ITERS", "100"))
 RESNET_BATCH = int(os.environ.get("BENCH_RESNET_BATCH", "64"))
 RESNET_ITERS = int(os.environ.get("BENCH_RESNET_ITERS", "30"))
 RETRIES = int(os.environ.get("BENCH_RETRIES", "4"))
-BACKOFFS = [60, 120, 240]  # seconds between attempts (tunnel recovery)
+# short backoffs: the cheap probe already filters a wedged tunnel, so a
+# failed attempt costs little and a recovering tunnel is caught quickly
+BACKOFFS = [30, 60, 120]
 
 # bf16 peak FLOP/s per chip by device kind (scaling-book numbers); used
 # only for the MFU denominator. Unknown kinds fall back to v5e.

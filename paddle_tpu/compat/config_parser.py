@@ -55,7 +55,7 @@ class ConfigContext:
             "model_average": None,
             "learning_rate_decay_a": 0.0,
             "learning_rate_decay_b": 0.0,
-            "learning_rate_schedule": "constant",
+            "learning_rate_schedule": None,  # default "poly" (reference)
             "learning_rate_args": "",
             "algorithm": "sgd",
             "async_lagged_grad_discard_ratio": 1.5,

@@ -750,10 +750,13 @@ def opt_config_from_settings(s) -> "TrainerConfig_pb2.OptimizationConfig":
     oc = TrainerConfig_pb2.OptimizationConfig()
     oc.batch_size = int(s.get("batch_size") or 1)
     oc.algorithm = s.get("algorithm") or "sgd"
-    oc.learning_rate = float(s.get("learning_rate") or 1e-3)
+    # unset-settings defaults follow the reference DEFAULT_SETTING
+    # (config_parser.py:3513-3526), same as build_optimizer
+    oc.learning_rate = float(s.get("learning_rate")
+                             if s.get("learning_rate") is not None else 1.0)
     oc.learning_rate_decay_a = float(s.get("learning_rate_decay_a") or 0.0)
     oc.learning_rate_decay_b = float(s.get("learning_rate_decay_b") or 0.0)
-    oc.learning_rate_schedule = s.get("learning_rate_schedule") or "constant"
+    oc.learning_rate_schedule = s.get("learning_rate_schedule") or "poly"
     oc.learning_rate_args = s.get("learning_rate_args") or ""
     oc.async_lagged_grad_discard_ratio = float(
         s.get("async_lagged_grad_discard_ratio") or 1.5)

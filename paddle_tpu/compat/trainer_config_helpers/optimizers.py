@@ -233,9 +233,23 @@ def build_optimizer(s) -> _opt.Optimizer:
     method = s.get("learning_method") or MomentumOptimizer()
     cls = method.engine_class() if hasattr(method, "engine_class") \
         else _opt.Momentum
+    # Reference gradient semantics: parameter gradients are SUMMED over
+    # the batch and the optimizer applies settings.learning_rate,
+    # clipping, and decay rates to that sum (sgdUpdate,
+    # ParameterUpdateFunctions.cpp:25-36 — no batch normalization
+    # anywhere; hence the idiomatic learning_rate=0.1/128 with
+    # batch_size=128 in v1_api_demo/mnist/vgg_16_mnist.py). The engine
+    # differentiates the batch-MEAN cost, so compat-built optimizers set
+    # sum_gradients: grads are re-scaled by the actual batch size inside
+    # the update, and learning rate, clipping thresholds, L1/L2 rates,
+    # and schedule parameters all keep their reference values. Defaults
+    # follow DEFAULT_SETTING (config_parser.py:3513-3526): lr 1.0,
+    # schedule "poly" (with decay a=b=0 it is constant).
     kwargs = dict(
-        learning_rate=s.get("learning_rate") or 1e-3,
-        learning_rate_schedule=s.get("learning_rate_schedule", "constant"),
+        learning_rate=(s.get("learning_rate")
+                       if s.get("learning_rate") is not None else 1.0),
+        sum_gradients=True,
+        learning_rate_schedule=s.get("learning_rate_schedule") or "poly",
         learning_rate_decay_a=s.get("learning_rate_decay_a", 0.0),
         learning_rate_decay_b=s.get("learning_rate_decay_b", 0.0),
         learning_rate_args=s.get("learning_rate_args", ""),

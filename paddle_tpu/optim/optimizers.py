@@ -39,6 +39,14 @@ class Optimizer:
     gradient_clipping_threshold: float = 0.0
     # model averaging (``AverageOptimizer``): do_average window in [0, +)
     average_window: float = 0.0
+    # Reference v1 gradient semantics (compat configs): parameter grads
+    # are the batch SUM (sgdUpdateCpu applies learning_rate to the
+    # accumulated gradient; ParameterUpdateFunctions.cpp:25-36, no batch
+    # normalization). The engine differentiates the batch-MEAN cost, so
+    # with this flag the update multiplies grads by the ACTUAL batch
+    # size before clipping/decay — keeping learning_rate, clipping
+    # thresholds, L1/L2 rates, and schedules at their reference values.
+    sum_gradients: bool = False
 
     # -- per-subclass ---------------------------------------------------
     def slot_names(self):
@@ -96,6 +104,9 @@ class Optimizer:
 
         new_params = dict(params)
         new_slots = {}
+        if self.sum_gradients:
+            bsz = jnp.asarray(batch_size, jnp.float32)
+            grads = {n: g * bsz for n, g in grads.items()}
         for name, g in grads.items():
             if name not in state["slots"]:
                 new_params[name] = params[name]

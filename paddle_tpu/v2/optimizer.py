@@ -1,6 +1,13 @@
 """v2 optimizer namespace (`python/paddle/v2/optimizer.py`): thin
 constructors over the optim package; regularization/model-average kwargs
-pass through."""
+pass through.
+
+Gradient-scale note: the engine differentiates the batch-MEAN cost, so
+``learning_rate`` here is a per-mean-gradient rate (the modern
+convention). Reference v1 jobs apply the rate to batch-SUMMED gradients
+(hence ``0.1/128``-style settings); pass ``sum_gradients=True`` to
+reproduce that exactly — the compat config path sets it automatically.
+"""
 
 from paddle_tpu.optim.optimizers import (  # noqa: F401
     AdaDelta, AdaGrad, Adam, Adamax, DecayedAdaGrad, Momentum, Optimizer,

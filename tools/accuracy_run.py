@@ -1,0 +1,196 @@
+"""North-star accuracy evidence (ACCURACY_r04.json).
+
+Trains reference configs UNMODIFIED through the CLI on the only real
+MNIST corpus present in this offline environment: the reference's own
+checked-in proto shard (``paddle/trainer/tests/mnist_bin_part``, 1227
+genuine MNIST digits — the download scripts in ``v1_api_demo/mnist/data``
+need network egress this machine does not have).
+
+Jobs (both on a 1100/127 train/held-out split of the real shard, with
+per-pass held-out evaluation; the user-side data provider module
+(``mnist_provider`` — user code in the demo) is substituted with one
+that reads the proto shard; the CONFIGS — network, optimizer, batch
+size, regularization — run unmodified):
+1. ``v1_api_demo/mnist/light_mnist.py`` (conv groups + Adam).
+2. ``v1_api_demo/mnist/vgg_16_mnist.py`` (small_vgg + Momentum,
+   the north-star demo config).
+
+Honest caveat recorded in the artifact: 1227 samples is ~2% of MNIST;
+reference-grade (99%+) test accuracy requires the full 60k corpus,
+which cannot be downloaded here. The evidence shows the training
+pipeline drives real data to high accuracy, not full-corpus parity.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import os
+import re
+import sys
+import time
+import types
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+REF_TESTS = "/root/reference/paddle/trainer/tests"
+VGG_CONFIG = "/root/reference/v1_api_demo/mnist/vgg_16_mnist.py"
+
+
+def split_shard(workdir: str):
+    """mnist_bin_part -> 1100-sample train shard + 127-sample test shard
+    with the demo's data/{train,test}.list layout."""
+    import numpy as np
+
+    from paddle_tpu.data.protodata import read_messages, write_shard
+    header, samples = read_messages(os.path.join(REF_TESTS,
+                                                 "mnist_bin_part"))
+    samples = list(samples)
+    # the shard is label-sorted — a tail split would hold out a class
+    # the training set barely contains; shuffle deterministically
+    order = np.random.RandomState(0).permutation(len(samples))
+    samples = [samples[i] for i in order]
+    os.makedirs(os.path.join(workdir, "data"), exist_ok=True)
+    train_p = os.path.join(workdir, "data", "train.shard")
+    test_p = os.path.join(workdir, "data", "test.shard")
+    write_shard(train_p, header, samples[:1100])
+    write_shard(test_p, header, samples[1100:])
+    with open(os.path.join(workdir, "data", "train.list"), "w") as f:
+        f.write(train_p + "\n")
+    with open(os.path.join(workdir, "data", "test.list"), "w") as f:
+        f.write(test_p + "\n")
+    return len(samples)
+
+
+def install_provider_shim():
+    """A ``mnist_provider`` module reading proto shards with the demo
+    provider's exact interface (pixel scaled to [-1, 1] like
+    ``mnist_util.read_from_mnist``)."""
+    from paddle_tpu.compat import install_paddle_alias
+    install_paddle_alias()
+    from paddle.trainer.PyDataProvider2 import (dense_vector,  # noqa
+                                                integer_value, provider)
+
+    mod = types.ModuleType("mnist_provider")
+
+    @provider(input_types={"pixel": dense_vector(28 * 28),
+                           "label": integer_value(10)})
+    def process(settings, filename):
+        from paddle_tpu.data.protodata import ProtoDataReader
+        for pixel, label in ProtoDataReader([filename])():
+            yield {"pixel": pixel * 2.0 - 1.0, "label": int(label)}
+
+    mod.process = process
+    sys.modules["mnist_provider"] = mod
+    return mod
+
+
+def run_cli(argv):
+    from paddle_tpu.trainer import cli
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli.main(argv)
+    out = buf.getvalue()
+    sys.stdout.write(out)
+    return rc, out
+
+
+def last_metric(out: str, line_prefix: str, key: str):
+    vals = [float(m.group(1)) for m in re.finditer(
+        rf"{line_prefix}.*{key}=([0-9.eE+-]+)", out)]
+    return vals[-1] if vals else None
+
+
+def job_light(workdir: str, passes: int):
+    """light_mnist.py: the demo's lighter conv config (Adam), same
+    split + held-out eval."""
+    install_provider_shim()
+    t0 = time.time()
+    cwd = os.getcwd()
+    os.chdir(workdir)
+    try:
+        rc, out = run_cli([
+            "--config", "/root/reference/v1_api_demo/mnist/light_mnist.py",
+            "--job", "train", "--num_passes", str(passes),
+            "--test_period", "1", "--log_period", "0"])
+    finally:
+        os.chdir(cwd)
+    train_err = last_metric(out, r"Pass \d+:", "classification_error")
+    test_err = last_metric(out, r"Test:", "classification_error")
+    return {
+        "config": "v1_api_demo/mnist/light_mnist.py (unmodified; "
+                  "user-side mnist_provider reads the proto shard)",
+        "corpus": "mnist_bin_part split 1100 train / 127 held-out",
+        "rc": rc, "passes": passes,
+        "final_train_error": train_err,
+        "heldout_test_error": test_err,
+        "heldout_test_accuracy": None if test_err is None
+        else round(1 - test_err, 4),
+        "wall_s": round(time.time() - t0, 1),
+    }
+
+
+def job_vgg(workdir: str, passes: int):
+    install_provider_shim()
+    t0 = time.time()
+    cwd = os.getcwd()
+    os.chdir(workdir)
+    try:
+        rc, out = run_cli([
+            "--config", VGG_CONFIG,
+            "--job", "train", "--num_passes", str(passes),
+            "--test_period", "1", "--log_period", "0"])
+    finally:
+        os.chdir(cwd)
+    train_err = last_metric(out, r"Pass \d+:", "classification_error")
+    test_err = last_metric(out, r"Test:", "classification_error")
+    return {
+        "config": "v1_api_demo/mnist/vgg_16_mnist.py (unmodified; "
+                  "user-side mnist_provider reads the proto shard)",
+        "corpus": "mnist_bin_part split 1100 train / 127 held-out",
+        "rc": rc, "passes": passes,
+        "final_train_error": train_err,
+        "heldout_test_error": test_err,
+        "heldout_test_accuracy": None if test_err is None
+        else round(1 - test_err, 4),
+        "wall_s": round(time.time() - t0, 1),
+    }
+
+
+def main():
+    import jax
+
+    # sitecustomize pre-imports jax with the axon backend, so the
+    # JAX_PLATFORMS env var alone does not stick; honor it explicitly
+    # (otherwise a wedged TPU tunnel hangs even CPU-intended runs)
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    platform = jax.devices()[0].platform
+    workdir = os.path.abspath(os.environ.get("ACC_WORKDIR",
+                                             "/tmp/paddle_tpu_accuracy"))
+    os.makedirs(workdir, exist_ok=True)
+    n = split_shard(workdir)
+    report = {
+        "platform": platform,
+        "corpus_note": (
+            f"only real MNIST on this offline host is the reference's "
+            f"checked-in shard ({n} samples, ~2% of MNIST); the demo "
+            "data download scripts need network egress. Reference-grade "
+            "full-corpus accuracy is not reachable from it; this "
+            "artifact shows the unmodified configs training real data "
+            "end-to-end."),
+        "light_mnist": job_light(
+            workdir, int(os.environ.get("ACC_LIGHT_PASSES", "30"))),
+    }
+    json.dump(report, open("ACCURACY_r04.json", "w"), indent=1)
+    report["vgg_16_mnist"] = job_vgg(
+        workdir, int(os.environ.get("ACC_VGG_PASSES", "30")))
+    json.dump(report, open("ACCURACY_r04.json", "w"), indent=1)
+    print(json.dumps(report, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
