@@ -184,5 +184,11 @@ def gru_sequence(xs, mask, w_gate, w_state, bias, h0, reverse=False):
     itemsize = jnp.dtype(xs.dtype).itemsize
     resident = itemsize * (3 * H * H + 6 * B * H3)
     if not common.use_pallas(resident):
+        # Big hidden sizes fall back to the scan reference. Unlike the
+        # LSTM (ops/lstm.py:_lstm_pallas_tiled), a gate-column-tiled GRU
+        # needs two phases per timestep (the candidate matmul consumes
+        # the FULL reset gate), doubling weight streaming — measured
+        # benefit over XLA's scan is not established, and no BASELINE
+        # benchmark shape exceeds the resident budget for GRU.
         return gru_sequence_ref(xs, mask, w_gate, w_state, bias, h0)
     return _gru_core(xs + bias, mask, w_gate, w_state, h0)
