@@ -137,7 +137,16 @@ def shard_batch(feed: Dict[str, Argument], mesh: Mesh) -> Dict[str, Argument]:
                 "splits remainders unevenly across TrainerThreads — on a "
                 "SPMD mesh the split must be exact)")
         spec = P(axes, *([None] * (x.ndim - 1)))
-        return jax.device_put(x, NamedSharding(mesh, spec))
+        sharding = NamedSharding(mesh, spec)
+        if jax.process_count() > 1:
+            # multi-host SPMD (dist.launch jobs): device_put cannot target
+            # non-addressable devices; each process contributes the shards
+            # it owns, sliced from the host-replicated batch by global
+            # index
+            host = np.asarray(x)
+            return jax.make_array_from_callback(
+                host.shape, sharding, lambda idx: host[idx])
+        return jax.device_put(x, sharding)
 
     return jax.tree_util.tree_map(place, feed)
 

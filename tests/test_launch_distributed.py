@@ -1,0 +1,92 @@
+"""REAL multi-process SPMD through the launcher: two OS processes, each
+with two local CPU devices, form one 4-device global mesh via
+``jax.distributed`` (Gloo collectives) and train a data-parallel job —
+the gradient all-reduce genuinely crosses process boundaries, the
+closest a single host gets to the reference's multi-node pserver path
+(SURVEY §5.8). Complements tests/test_multislice.py's single-process
+virtual-mesh checks."""
+
+import json
+import pathlib
+import textwrap
+
+import pytest
+
+from paddle_tpu.dist.launch import launch_local
+
+WORKER = textwrap.dedent("""
+    import json, os, sys
+    sys.path.insert(0, {repo!r})
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.dist.launch import init_from_env
+    ctx = init_from_env()   # brings up jax.distributed (Gloo on CPU)
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 4 and jax.local_device_count() == 2
+
+    import numpy as np
+    import jax.numpy as jnp
+    import zlib
+    from paddle_tpu.config import dsl
+    from paddle_tpu.core.argument import Argument
+    from paddle_tpu.optim import Momentum
+    from paddle_tpu.parallel import mesh as mesh_lib
+    from paddle_tpu.trainer import SGD, events
+
+    dsl.reset()
+    x = dsl.data(name="x", size=8)
+    lbl = dsl.data(name="label", size=4)
+    out = dsl.fc(input=dsl.fc(input=x, size=16, act="relu"), size=4,
+                 act="softmax")
+    cost = dsl.classification_cost(input=out, label=lbl)
+    mesh = mesh_lib.create_mesh()   # 4 global devices on the data axis
+    trainer = SGD(cost=cost,
+                  update_equation=Momentum(learning_rate=0.1, momentum=0.9),
+                  mesh=mesh)
+
+    rng = np.random.RandomState(0)  # same data on every process (SPMD)
+    X = rng.randn(64, 8).astype(np.float32)
+    W = rng.randn(8, 4)
+    Y = np.argmax(X @ W, axis=1).astype(np.int32)
+
+    def reader():
+        for i in range(0, 64, 16):
+            yield {{"x": Argument(value=jnp.asarray(X[i:i+16])),
+                   "label": Argument(value=jnp.asarray(Y[i:i+16]))}}
+
+    costs = []
+    trainer.train(reader, num_passes=6,
+                  event_handler=lambda e: costs.append(float(e.cost))
+                  if isinstance(e, events.EndIteration) else None)
+    assert costs[-1] < costs[0], costs
+
+    # replicated params must be bit-identical on every process — the
+    # proof the gradient all-reduce crossed the process boundary
+    blob = b"".join(np.asarray(jax.device_get(v)).tobytes()
+                    for _, v in sorted(trainer.params.items()))
+    json.dump({{"pid": ctx.process_id, "cost_first": costs[0],
+               "cost_last": costs[-1],
+               "param_crc": zlib.crc32(blob)}},
+              open(os.environ["RESULT_TEMPLATE"].format(ctx.process_id),
+                   "w"))
+""")
+
+
+@pytest.mark.timeout(600)
+def test_two_process_data_parallel_training(tmp_path):
+    repo = str(pathlib.Path(__file__).resolve().parent.parent)
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER.format(repo=repo))
+    import os
+    env = dict(os.environ, RESULT_TEMPLATE=str(tmp_path / "r{}.json"))
+    env.pop("XLA_FLAGS", None)
+    rcs = launch_local(str(script), 2, distributed=True, env=env,
+                       timeout=540)
+    assert rcs == [0, 0]
+    r0 = json.loads((tmp_path / "r0.json").read_text())
+    r1 = json.loads((tmp_path / "r1.json").read_text())
+    assert r0["cost_last"] < r0["cost_first"]
+    # both processes ended with identical parameters: XLA's gradient
+    # all-reduce ran over the cross-process Gloo fabric
+    assert r0["param_crc"] == r1["param_crc"]
