@@ -166,6 +166,40 @@ def _build_trainer(ns, args):
 
 
 def _init_params(trainer, path):
+    import os
+    if os.path.isdir(path):
+        # a reference pass/model directory: one Parameter::save binary
+        # file per parameter (the --init_model_path contract,
+        # Trainer.cpp:229-250) — reference-trained models load directly
+        import jax.numpy as jnp
+
+        from paddle_tpu.compat.param_format import load_v1_model_dir
+        raw = load_v1_model_dir(path)
+        params = dict(trainer.params)
+        missing, loaded = [], 0
+        for name, spec in trainer.meta.items():
+            if name not in raw:
+                missing.append(name)
+                continue
+            flat = raw[name]
+            want = 1
+            for d in spec.shape:
+                want *= int(d)
+            if flat.size != want:
+                raise ValueError(
+                    f"--init_model_path: parameter {name!r} has "
+                    f"{flat.size} values, the model needs {want} "
+                    f"(shape {spec.shape}; fused-gate layouts may need "
+                    "repacking)")
+            params[name] = jnp.asarray(flat.reshape(spec.shape))
+            loaded += 1
+        if missing:
+            from paddle_tpu.utils import logger
+            logger.warning("--init_model_path: %d parameters missing in "
+                           "%s (kept initialized): %s", len(missing),
+                           path, missing[:5])
+        trainer.load_state(params)
+        return
     if path.endswith(".ptmodel"):
         from paddle_tpu.trainer.merge_model import load_merged
         _, params, _ = load_merged(path)

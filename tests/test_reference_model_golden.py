@@ -1,0 +1,202 @@
+"""Reference-trained model + golden generation parity.
+
+The reference's ``test_recurrent_machine_generation.cpp`` loads a
+TRAINED model from the checked-in binary parameter files
+(``rnn_gen_test_model_dir/t1``, ``Parameter::save`` format), runs
+``sample_trainer_rnn_gen.conf`` / ``sample_trainer_nest_rnn_gen.conf``
+in generating mode, and diffs the dumped text against golden files
+(``r1.test.nobeam/.beam/.nest``). This test replicates it end-to-end:
+the reference's OWN binary artifacts load here (compat/param_format.py),
+the unmodified configs generate, and the formatted output equals the
+reference's golden files byte-for-byte."""
+
+import pathlib
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.compat import parse_config
+from paddle_tpu.compat.param_format import (load_v1_model_dir,
+                                            load_v1_param, save_v1_param)
+from paddle_tpu.core.argument import Argument
+from paddle_tpu.core.generation import SequenceGenerator
+from paddle_tpu.core.registry import get_layer_impl
+
+TESTS = pathlib.Path("/root/reference/paddle/trainer/tests")
+MODEL = TESTS / "rnn_gen_test_model_dir"
+needs_ref = pytest.mark.skipif(not TESTS.exists(), reason="needs reference")
+
+
+def test_param_format_roundtrip(tmp_path):
+    rng = np.random.RandomState(0)
+    arr = rng.randn(7, 3).astype(np.float32)
+    save_v1_param(str(tmp_path / "w"), arr)
+    back = load_v1_param(str(tmp_path / "w"))
+    np.testing.assert_array_equal(back, arr.reshape(-1))
+    raw = (tmp_path / "w").read_bytes()
+    assert len(raw) == 16 + 21 * 4  # reference Header + payload
+
+
+@needs_ref
+def test_reference_binary_params_load():
+    """The checked-in reference-trained files parse: 16-byte header
+    (version 0, float32) + values (Parameter.cpp:247-251)."""
+    params = load_v1_model_dir(str(MODEL / "t1"))
+    assert set(params) == {"transtable", "wordvec"}
+    np.testing.assert_array_equal(params["wordvec"].reshape(5, 5),
+                                  np.eye(5, dtype=np.float32))
+    tt = params["transtable"].reshape(5, 5)
+    assert tt[0, 1] == 0.0 and tt[0, 0] == pytest.approx(-0.2)
+
+
+def _load_gen(config_args: str, conf: str):
+    parsed = parse_config(str(TESTS / conf), config_args)
+    graph = parsed.model
+    gen_name = [n for n, ld in graph.layers.items()
+                if ld.type == "beam_search_group"][0]
+    specs = get_layer_impl("beam_search_group").params(
+        graph.layers[gen_name], [])
+    raw = load_v1_model_dir(str(MODEL / "t1"))
+    params = {}
+    for spec in specs.values():
+        params[spec.absolute_name] = jnp.asarray(
+            raw[spec.absolute_name].reshape(spec.shape))
+    return graph, gen_name, params
+
+
+def _format_flat(tokens, scores, lengths, num_results):
+    """The seqtext result_file format (``SequenceTextPrinter``,
+    ``Evaluator.cpp:1375+``): one `id\\t toks` line per sample for a
+    single result; `id NL rank\\tscore\\t toks ... NL` blocks for
+    beams."""
+    t, s, L = (np.asarray(tokens), np.asarray(scores),
+               np.asarray(lengths))
+    lines = []
+    for b in range(t.shape[0]):
+        if num_results == 1:
+            toks = t[b, 0, : L[b, 0]]
+            lines.append(f"{b}\t " + " ".join(str(int(x)) for x in toks))
+        else:
+            lines.append(f"{b}")
+            for k in range(num_results):
+                toks = t[b, k, : L[b, k]]
+                lines.append(f"{k}\t{s[b, k]:g}\t "
+                             + " ".join(str(int(x)) for x in toks))
+            lines.append("")
+    out = "\n".join(lines) + "\n"
+    if num_results == 1:
+        out += "\n"   # the reference dump ends single-result files with
+        #               a blank line (SequenceTextPrinter final endl)
+    return out
+
+
+@needs_ref
+def test_golden_generation_nobeam():
+    """Greedy generation with the reference-trained params equals
+    r1.test.nobeam byte-for-byte."""
+    graph, gen_name, params = _load_gen("beam_search=0",
+                                        "sample_trainer_rnn_gen.conf")
+    rng = np.random.RandomState(0)
+    outer = {"dummy_data_input": Argument(
+        value=jnp.asarray(rng.rand(15, 2).astype(np.float32)))}
+    sg = SequenceGenerator(graph, gen_name)
+    tokens, scores, lengths = sg.generate(params, outer)
+    got = _format_flat(tokens, scores, lengths, num_results=1)
+    want = (MODEL / "r1.test.nobeam").read_text()
+    assert got == want
+
+
+@needs_ref
+def test_golden_generation_beam():
+    """Beam-2 generation (2 results/sample) equals r1.test.beam —
+    including the reference's path scores (0 and -0.2, the summed log
+    of the exp-activated step outputs)."""
+    graph, gen_name, params = _load_gen("beam_search=1",
+                                        "sample_trainer_rnn_gen.conf")
+    rng = np.random.RandomState(0)
+    outer = {"dummy_data_input": Argument(
+        value=jnp.asarray(rng.rand(15, 2).astype(np.float32)))}
+    sg = SequenceGenerator(graph, gen_name)
+    tokens, scores, lengths = sg.generate(params, outer)
+    got = _format_flat(tokens, scores, lengths, num_results=2)
+    want = (MODEL / "r1.test.beam").read_text()
+    assert got == want
+
+
+@needs_ref
+def test_golden_generation_nested():
+    """sample_trainer_nest_rnn_gen.conf: an outer group concatenates the
+    inner generation's per-subsequence results (the inner memory is
+    read-only, so outer step i = inner generation on sub-batch i — the
+    C++ comment in test_recurrent_machine_generation.cpp:135-138 states
+    exactly this reduction). Output equals r1.test.nest: one outer
+    sequence of 15 sub-results, sample id printed on the first only."""
+    parsed = parse_config(str(TESTS / "sample_trainer_nest_rnn_gen.conf"),
+                          "beam_search=0")
+    graph = parsed.model
+    # the inner beam group lives inside the outer group's sub-model
+    outer_name = [n for n, ld in graph.layers.items()
+                  if ld.type == "recurrent_layer_group"][0]
+    sub = graph.layers[outer_name].attrs["sub_model"]
+    gen_name = [n for n, ld in sub.layers.items()
+                if ld.type == "beam_search_group"][0]
+    specs = get_layer_impl("beam_search_group").params(
+        sub.layers[gen_name], [])
+    raw = load_v1_model_dir(str(MODEL / "t1"))
+    params = {spec.absolute_name: jnp.asarray(
+        raw[spec.absolute_name].reshape(spec.shape))
+        for spec in specs.values()}
+
+    rng = np.random.RandomState(0)
+    # one outer sequence of 15 single-step subsequences (prepareInArgs
+    # hasSubseq=True): each subsequence drives one inner generation
+    outer_feed = {}
+    for inp, meta in zip(sub.layers[gen_name].inputs,
+                         sub.layers[gen_name].attrs["ins"]):
+        outer_feed[inp.layer_name] = Argument(value=jnp.asarray(
+            rng.rand(15, 2).astype(np.float32)))
+    sg = SequenceGenerator(sub, gen_name)
+    tokens, scores, lengths = sg.generate(params, {
+        name: a for name, a in outer_feed.items()})
+    t, L = np.asarray(tokens), np.asarray(lengths)
+    lines = []
+    for b in range(15):
+        toks = " ".join(str(int(x)) for x in t[b, 0, : L[b, 0]])
+        lines.append((f"{0}\t " if b == 0 else "\t ") + toks)
+    got = "\n".join(lines) + "\n\n"
+    want = (MODEL / "r1.test.nest").read_text()
+    assert got == want
+
+
+def test_cli_init_model_path_accepts_v1_dir(tmp_path):
+    """`--init_model_path <dir>` loads a reference-format model directory
+    (one Parameter::save file per parameter) into the trainer — the
+    reference's resume/deploy contract (Trainer.cpp:229-250)."""
+    import numpy as np
+
+    from paddle_tpu.compat.param_format import save_v1_model_dir
+    from paddle_tpu.config import dsl
+    from paddle_tpu.optim import Momentum
+    from paddle_tpu.trainer.cli import _init_params
+    from paddle_tpu.trainer.trainer import SGD
+
+    dsl.reset()
+    x = dsl.data(name="x", size=4)
+    lbl = dsl.data(name="label", size=3)
+    out = dsl.fc(input=x, size=3, act="softmax", name="probs")
+    cost = dsl.classification_cost(input=out, label=lbl)
+    trainer = SGD(cost=cost,
+                  update_equation=Momentum(learning_rate=0.1, momentum=0.9))
+
+    rng = np.random.RandomState(3)
+    golden = {name: rng.randn(*spec.shape).astype(np.float32)
+              for name, spec in trainer.meta.items()}
+    save_v1_model_dir(str(tmp_path / "pass-00001"), golden)
+
+    _init_params(trainer, str(tmp_path / "pass-00001"))
+    for name, want in golden.items():
+        np.testing.assert_array_equal(
+            np.asarray(trainer.params[name]), want)
