@@ -177,7 +177,8 @@ def model_type(name):
 
 
 def SimpleData(**kw):
-    return {"type": "simple", **kw}
+    spec = {"type": "simple", **kw}
+    return spec
 
 
 def ProtoData(**kw):
@@ -190,11 +191,14 @@ def PyData(**kw):
 
 def _data_from_spec(spec):
     if isinstance(spec, dict):
+        kind = spec.get("type", "py2")
+        # SimpleData carries its knobs (feat_dim, context_len, ...) in
+        # the spec itself rather than load_data_args
+        args = spec if kind == "simple" else spec.get("load_data_args")
         return DataSource(file_list=spec.get("files"),
                           module=spec.get("load_data_module"),
                           obj=spec.get("load_data_object"),
-                          args=spec.get("load_data_args"),
-                          kind=spec.get("type", "py2"))
+                          args=args, kind=kind)
     return spec
 
 
@@ -500,6 +504,25 @@ class ParsedConfig:
         cached = getattr(self, "_reader_cache", {}).get(key)
         if cached is not None:
             return cached
+        if source.kind == "simple":
+            # plain-text `label f1..fn` files (SimpleDataProvider,
+            # DataProvider.cpp:395) — the reference's e2e test configs
+            from paddle_tpu.data.protodata import anchor_path
+            from paddle_tpu.data.reader import batch
+            from paddle_tpu.data.simpledata import SimpleDataReader
+            file_list = source.file_list
+            if file_list and isinstance(file_list, str) and \
+                    self.context.config_dir:
+                file_list = anchor_path(file_list, self.context.config_dir)
+            args = source.args if isinstance(source.args, dict) else {}
+            rdr = SimpleDataReader(
+                file_list, feat_dim=int(args.get("feat_dim") or 1),
+                context_len=int(args.get("context_len") or 0))
+            batched = batch(rdr, self.batch_size())
+            batched.input_types = rdr.input_types
+            self.__dict__.setdefault("_reader_cache", {})[key] = \
+                (batched, rdr)
+            return batched, rdr
         if source.kind == "proto":
             # binary proto shards (ProtoDataProvider.h:48) need no
             # python provider module — the header drives the types
@@ -566,7 +589,8 @@ class ParsedConfig:
     def feeding(self):
         """{data-layer name: InputType} in provider order."""
         src = self.context.train_source or self.context.test_source
-        if src is None or (src.module is None and src.kind != "proto"):
+        if src is None or (src.module is None
+                           and src.kind not in ("proto", "simple")):
             return None
         reader, prov = self._reader_from(src, is_train=True)
         # init_hook providers resolve their types at reader construction

@@ -1,0 +1,60 @@
+"""SimpleDataProvider: the reference's plain-text data path.
+
+``SimpleDataProvider::loadDataFile`` (``paddle/gserver/dataproviders/
+DataProvider.cpp:395-410``): a file list names text files whose lines
+are ``label f1 f2 ... f{feat_dim}``. Declared in configs as
+``TrainData(SimpleData(files=..., feat_dim=N, context_len=0, ...))`` —
+the format of the reference's own e2e trainer tests
+(``sample_trainer_config.conf`` over ``sample_data.txt``)."""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+
+class SimpleDataReader:
+    """Yields (features float32[feat_dim], int label) per line."""
+
+    def __init__(self, file_list, feat_dim: int, context_len: int = 0):
+        if context_len:
+            raise NotImplementedError(
+                "SimpleData context_len > 0 is not supported (the "
+                "reference e2e configs use 0)")
+        from paddle_tpu.data.protodata import anchor_path
+        import os
+        if isinstance(file_list, str):
+            base = os.path.dirname(os.path.abspath(file_list))
+            with open(file_list) as f:
+                self.files: List[str] = [
+                    anchor_path(ln.strip(), base) for ln in f
+                    if ln.strip()]
+        else:
+            self.files = list(file_list)
+        self.feat_dim = int(feat_dim)
+        # one eager pass for label arity (the reader re-reads lazily)
+        max_label = 0
+        for path in self.files:
+            with open(path) as f:
+                for line in f:
+                    parts = line.split()
+                    if parts:
+                        max_label = max(max_label, int(parts[0]))
+        from paddle_tpu.data import types as T
+        self.input_types = [T.dense_vector(self.feat_dim),
+                            T.integer_value(max_label + 1)]
+
+    def __call__(self):
+        for path in self.files:
+            with open(path) as f:
+                for line in f:
+                    parts = line.split()
+                    if not parts:
+                        continue
+                    if len(parts) != self.feat_dim + 1:
+                        raise ValueError(
+                            f"{path}: line has {len(parts) - 1} features,"
+                            f" feat_dim is {self.feat_dim}")
+                    yield (np.asarray(parts[1:], np.float32),
+                           int(parts[0]))

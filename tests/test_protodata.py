@@ -128,3 +128,33 @@ def test_opt_a_config_trains_one_pass_from_proto_shard(capsys):
     assert rc == 0
     out = capsys.readouterr().out
     assert "Pass 0" in out or "pass 0" in out.lower()
+
+
+@needs_ref
+def test_simple_data_config_trains_one_pass(capsys):
+    """sample_trainer_config.conf (TrainData(SimpleData(...)) over the
+    checked-in sample_data.txt) — the reference's own e2e trainer-test
+    job (test_Trainer.cpp) — trains a pass through the CLI."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from paddle_tpu.trainer import cli
+    rc = cli.main(["--config",
+                   str(REF_TESTS / "sample_trainer_config.conf"),
+                   "--job", "train", "--num_passes", "2",
+                   "--log_period", "0"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Pass 1" in out
+
+
+def test_simple_data_reader_parses(tmp_path):
+    from paddle_tpu.data.simpledata import SimpleDataReader
+    data = tmp_path / "d.txt"
+    data.write_text("0 1 2 -1\n2 3 -1 2\n")
+    lst = tmp_path / "f.list"
+    lst.write_text(str(data) + "\n")
+    r = SimpleDataReader(str(lst), feat_dim=3)
+    rows = list(r())
+    assert len(rows) == 2 and rows[1][1] == 2
+    np.testing.assert_allclose(rows[0][0], [1, 2, -1])
+    assert [t.dim for t in r.input_types] == [3, 3]
