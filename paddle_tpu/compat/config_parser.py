@@ -415,9 +415,12 @@ Inputs = None  # assigned below, after inputs() is defined
 
 
 def inputs(*layers):
-    """Declare data-provider stream order (``@config_func inputs``)."""
+    """Declare data-provider stream order (``@config_func inputs``).
+    APPENDS like the reference (``config_parser.py:212-222`` — old
+    configs call Inputs() once per slot in a loop)."""
     names = [l.name if hasattr(l, "name") else str(l) for l in layers]
-    ctx().input_layer_names = names
+    ctx().input_layer_names.extend(
+        n for n in names if n not in ctx().input_layer_names)
 
 
 def outputs(*layers):
@@ -523,7 +526,7 @@ class ParsedConfig:
             self.__dict__.setdefault("_reader_cache", {})[key] = \
                 (batched, rdr)
             return batched, rdr
-        if source.kind == "proto":
+        if source.kind in ("proto", "proto_sequence"):
             # binary proto shards (ProtoDataProvider.h:48) need no
             # python provider module — the header drives the types
             from paddle_tpu.data.protodata import ProtoDataReader
@@ -536,7 +539,9 @@ class ParsedConfig:
                 from paddle_tpu.data.protodata import anchor_path
                 file_list = anchor_path(file_list,
                                         self.context.config_dir)
-            rdr = ProtoDataReader(file_list)
+            rdr = ProtoDataReader(
+                file_list,
+                as_sequences=source.kind == "proto_sequence")
             batched = batch(rdr, self.batch_size())
             batched.input_types = rdr.input_types
             rdr.as_reader = lambda *a, **k: rdr  # provider-shape shim
@@ -590,7 +595,8 @@ class ParsedConfig:
         """{data-layer name: InputType} in provider order."""
         src = self.context.train_source or self.context.test_source
         if src is None or (src.module is None
-                           and src.kind not in ("proto", "simple")):
+                           and src.kind not in ("proto", "proto_sequence",
+                                                "simple")):
             return None
         reader, prov = self._reader_from(src, is_train=True)
         # init_hook providers resolve their types at reader construction

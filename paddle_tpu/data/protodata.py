@@ -192,9 +192,16 @@ class ProtoDataReader:
 
     ``file_list``: a .list file of shard paths (one per line, the
     reference's ``files`` convention, e.g. mnist.list) or a list of shard
-    paths."""
+    paths.
 
-    def __init__(self, file_list):
+    ``as_sequences``: ProtoSequenceDataProvider semantics
+    (``ProtoDataProvider.h`` subclass, configs with
+    ``ProtoData(type="proto_sequence")``): sparse-non-value slots are
+    TOKEN SEQUENCES (one id per position), so they type as
+    integer_value_sequence instead of sparse_binary_vector."""
+
+    def __init__(self, file_list, as_sequences: bool = False):
+        self.as_sequences = bool(as_sequences)
         if isinstance(file_list, str):
             import os
             with open(file_list) as f:
@@ -223,6 +230,12 @@ class ProtoDataReader:
             if self.is_sequence:
                 break
         self.input_types = slot_input_types(self.header, self.is_sequence)
+        if self.as_sequences:
+            from paddle_tpu.data import types as T
+            self.input_types = [
+                T.integer_value_sequence(sd.dim)
+                if sd.type == SlotDef.VECTOR_SPARSE_NON_VALUE else t
+                for sd, t in zip(self.header.slot_defs, self.input_types)]
 
     def __call__(self):
         nvec = sum(1 for sd in self.header.slot_defs

@@ -87,8 +87,12 @@ def load_config(path: str, config_args: str = ""):
     import re
     with open(path) as f:
         src = f.read()
-    # route on actual import statements, not mere mentions in comments
-    if re.search(r"^\s*(from|import)\s+paddle\.trainer", src, re.M):
+    # route on actual import statements, not mere mentions in comments;
+    # .conf files are ALWAYS v1 configs — the oldest ones use the bare
+    # @config_func spelling (default_initial_std, TrainData, Layer...)
+    # with no import at all (paddle_trainer injected the names)
+    if path.endswith(".conf") or re.search(
+            r"^\s*(from|import)\s+paddle\.trainer", src, re.M):
         return _load_v1_config(path, config_args)
     from paddle_tpu.config import dsl
     dsl.reset()

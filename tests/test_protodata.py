@@ -158,3 +158,28 @@ def test_simple_data_reader_parses(tmp_path):
     assert len(rows) == 2 and rows[1][1] == 2
     np.testing.assert_allclose(rows[0][0], [1, 2, -1])
     assert [t.dim for t in r.input_types] == [3, 3]
+
+
+@needs_ref
+def test_proto_sequence_sparse_config_trains(tmp_path, capsys, monkeypatch):
+    """sample_trainer_config_compare_sparse.conf — the reference's
+    sparse qb job over the checked-in compare_sparse_data shard
+    (ProtoData(type="proto_sequence"): sparse-non-value slots are token
+    sequences). Trains through the CLI with the runtime-synthesized
+    list file, exactly like test_CompareSparse.cpp runs it from the
+    source root."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    lst = tmp_path / "trainer" / "tests"
+    lst.mkdir(parents=True)
+    (lst / "train_sparse.list").write_text(
+        str(REF_TESTS / "compare_sparse_data") + "\n")
+    monkeypatch.chdir(tmp_path)
+    from paddle_tpu.trainer import cli
+    rc = cli.main(["--config",
+                   str(REF_TESTS /
+                       "sample_trainer_config_compare_sparse.conf"),
+                   "--job", "train", "--num_passes", "1",
+                   "--log_period", "0"])
+    assert rc == 0
+    assert "Pass 0" in capsys.readouterr().out
