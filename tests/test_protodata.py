@@ -161,24 +161,30 @@ def test_simple_data_reader_parses(tmp_path):
 
 
 @needs_ref
-def test_proto_sequence_sparse_config_trains(tmp_path, capsys, monkeypatch):
-    """sample_trainer_config_compare_sparse.conf — the reference's
-    sparse qb job over the checked-in compare_sparse_data shard
-    (ProtoData(type="proto_sequence"): sparse-non-value slots are token
-    sequences). Trains through the CLI with the runtime-synthesized
-    list file, exactly like test_CompareSparse.cpp runs it from the
-    source root."""
+@pytest.mark.parametrize("conf", [
+    "sample_trainer_config_compare_sparse.conf",  # sparse qb MLP
+    "sample_trainer_config_qb_rnn.conf",          # sparse qb RNN groups
+    "sample_trainer_config_rnn.conf",             # raw recurrent groups
+    "sample_trainer_config_opt_b.conf",           # mnist MLP, opt pair b
+])
+def test_reference_proto_configs_train(conf, tmp_path, capsys,
+                                       monkeypatch):
+    """The reference's own proto-data training jobs run end-to-end on
+    the checked-in real shards, unmodified, through the CLI — with the
+    runtime-synthesized list files test_CompareSparse.cpp /
+    test_CompareTwoNets.cpp use (they run from the source root). The
+    sparse configs declare ProtoData(type="proto_sequence") over
+    compare_sparse_data; opt_b trains on mnist_bin_part."""
     import jax
     jax.config.update("jax_platforms", "cpu")
     lst = tmp_path / "trainer" / "tests"
     lst.mkdir(parents=True)
-    (lst / "train_sparse.list").write_text(
-        str(REF_TESTS / "compare_sparse_data") + "\n")
+    for name in ("train_sparse.list", "train.list"):
+        (lst / name).write_text(
+            str(REF_TESTS / "compare_sparse_data") + "\n")
     monkeypatch.chdir(tmp_path)
     from paddle_tpu.trainer import cli
-    rc = cli.main(["--config",
-                   str(REF_TESTS /
-                       "sample_trainer_config_compare_sparse.conf"),
+    rc = cli.main(["--config", str(REF_TESTS / conf),
                    "--job", "train", "--num_passes", "1",
                    "--log_period", "0"])
     assert rc == 0
