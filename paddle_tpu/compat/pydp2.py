@@ -9,10 +9,15 @@ user data scripts; here they resolve to the native provider pipeline
 from paddle_tpu.data.provider import (CacheType, DataProvider,  # noqa: F401
                                       provider)
 from paddle_tpu.data.types import (InputType, dense_vector,  # noqa: F401
-                                   dense_vector_sequence, integer_value,
+                                   dense_vector_sequence,
+                                   dense_vector_sub_sequence,
+                                   integer_value,
                                    integer_value_sequence,
+                                   integer_value_sub_sequence,
                                    sparse_binary_vector,
-                                   sparse_float_vector)
+                                   sparse_binary_vector_sub_sequence,
+                                   sparse_float_vector,
+                                   sparse_float_vector_sub_sequence)
 from paddle_tpu.data import types as _T
 
 # sequence-ness constants (reference SequenceType)
@@ -57,5 +62,7 @@ __all__ = [
     "sparse_float_vector_sequence", "sparse_vector",
     "sparse_vector_sequence", "sparse_non_value_slot", "sparse_value_slot",
     "index_slot", "dense_slot", "integer_sequence",
+    "integer_value_sub_sequence", "dense_vector_sub_sequence",
+    "sparse_binary_vector_sub_sequence", "sparse_float_vector_sub_sequence",
     "NO_SEQUENCE", "SEQUENCE", "SUB_SEQUENCE",
 ]

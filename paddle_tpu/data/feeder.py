@@ -63,6 +63,44 @@ class DataFeeder:
                             dense[i, j] = v
                 return Argument(value=jnp.asarray(dense))
             raise KeyError(itype.type)
+        if itype.seq_type == T.SUB_SEQUENCE:
+            # nested: sample = list of sub-sequences -> [B, S, T(, D)]
+            # with a [B, S, T] mask (the 2-level padded layout the
+            # nested recurrent groups consume, layers/group.py)
+            B = len(col)
+            S = max(len(s) for s in col)
+            Tm = _ceil_to(max((len(ss) for s in col for ss in s),
+                              default=1), self.pad_multiple)
+            mask = np.zeros((B, S, Tm), dtype=np.float32)
+            if itype.type == T.INDEX:
+                value = np.zeros((B, S, Tm), dtype=np.int32)
+                for i, s in enumerate(col):
+                    for j, ss in enumerate(s):
+                        value[i, j, : len(ss)] = np.asarray(ss,
+                                                            dtype=np.int32)
+                        mask[i, j, : len(ss)] = 1.0
+            elif itype.type == T.DENSE:
+                value = np.zeros((B, S, Tm, itype.dim), dtype=np.float32)
+                for i, s in enumerate(col):
+                    for j, ss in enumerate(s):
+                        arr = np.asarray(ss, dtype=np.float32).reshape(
+                            len(ss), itype.dim)
+                        value[i, j, : len(ss)] = arr
+                        mask[i, j, : len(ss)] = 1.0
+            else:
+                value = np.zeros((B, S, Tm, itype.dim), dtype=np.float32)
+                for i, s in enumerate(col):
+                    for j, ss in enumerate(s):
+                        for t, idxs in enumerate(ss):
+                            if itype.type == T.SPARSE_BINARY:
+                                value[i, j, t, np.asarray(
+                                    idxs, dtype=np.int64)] = 1.0
+                            else:
+                                for k, v in idxs:
+                                    value[i, j, t, k] = v
+                            mask[i, j, t] = 1.0
+            return Argument(value=jnp.asarray(value),
+                            mask=jnp.asarray(mask))
         # sequences: pad to multiple for shape bucketing
         max_len = _ceil_to(max(len(s) for s in col), self.pad_multiple)
         bsz = len(col)

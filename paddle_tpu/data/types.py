@@ -53,3 +53,20 @@ def sparse_float_vector(dim):
 
 def sparse_float_vector_sequence(dim):
     return InputType(dim, SEQUENCE, SPARSE_FLOAT)
+
+
+# -- 2-level (nested) sequences: one sample = a list of sub-sequences --
+def integer_value_sub_sequence(value_range):
+    return InputType(value_range, SUB_SEQUENCE, INDEX)
+
+
+def dense_vector_sub_sequence(dim):
+    return InputType(dim, SUB_SEQUENCE, DENSE)
+
+
+def sparse_binary_vector_sub_sequence(dim):
+    return InputType(dim, SUB_SEQUENCE, SPARSE_BINARY)
+
+
+def sparse_float_vector_sub_sequence(dim):
+    return InputType(dim, SUB_SEQUENCE, SPARSE_FLOAT)

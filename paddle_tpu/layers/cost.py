@@ -38,6 +38,17 @@ class CrossEntropyCost(_CostBase):
         prob, label = ins[0], ins[1]
         p = jnp.clip(prob.value, _EPS, 1.0)
         lab = label.value.astype(jnp.int32)
+        if (prob.mask is not None and label.mask is not None
+                and lab.shape[1] != p.shape[1]):
+            # both are sequences padded to different lengths (e.g. a
+            # sub-sequence-aggregated output vs a feeder-padded label
+            # stream): positions align semantically, masks carry truth —
+            # trim/pad the label to the output's padded length
+            T = p.shape[1]
+            if lab.shape[1] > T:
+                lab = lab[:, :T]
+            else:
+                lab = jnp.pad(lab, ((0, 0), (0, T - lab.shape[1])))
         ll = jnp.take_along_axis(p, lab[..., None], axis=-1)[..., 0]
         cost = -jnp.log(ll)
         return Argument(value=_reduce_tokens(cost, prob.mask))

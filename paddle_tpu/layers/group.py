@@ -179,8 +179,12 @@ class RecurrentLayerGroup(LayerImpl):
             Bq, Sq, Tq = y_main.shape[0], y_main.shape[1], y_main.shape[2]
             flat = y_main.reshape(Bq, Sq * Tq, *y_main.shape[3:])
             sm = jnp.swapaxes(next(iter(sub_masks.values())), 0, 1)
+            # keep the un-flattened 2-level view alongside: TO_SEQUENCE
+            # aggregations (seqlastins/pooling with agg_level=seq) need
+            # the sub-sequence boundaries the flat layout erases
             return Argument(value=flat, mask=sm.reshape(Bq, Sq * Tq),
-                            state={"group_outputs": extras, "final": carry})
+                            state={"group_outputs": extras, "final": carry,
+                                   "nested": (y_main, sm)})
         return Argument(value=y_main, mask=mask,
                         state={"group_outputs": extras, "final": carry})
 

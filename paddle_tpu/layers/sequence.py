@@ -22,15 +22,41 @@ def _pooled_info(cfg, in_infos):
     return ShapeInfo(size=in_infos[0].size, is_sequence=False)
 
 
+def _nested_view(a):
+    """(value [B,S,T,D], mask [B,S,T]) for a 2-level input: either the
+    group's stashed un-flattened view or a directly nested Argument."""
+    if a.state is not None and isinstance(a.state, dict) \
+            and "nested" in a.state:
+        return a.state["nested"]
+    if a.mask is not None and a.mask.ndim == 3:
+        return a.value, a.mask
+    return None
+
+
+def _to_sequence(cfg) -> bool:
+    return cfg.attrs.get("trans_type") == "seq"
+
+
 @register_layer("max")
 class MaxLayer(LayerImpl):
-    """Max over time of each sequence (``MaxLayer.cpp``)."""
+    """Max over time of each sequence (``MaxLayer.cpp``); with
+    agg_level=TO_SEQUENCE ("seq") on a nested input, max per
+    SUB-sequence -> a flat sequence of sub-maxima."""
 
     def infer(self, cfg, in_infos):
+        if _to_sequence(cfg):
+            return ShapeInfo(size=in_infos[0].size, is_sequence=True)
         return _pooled_info(cfg, in_infos)
 
     def apply(self, cfg, params, ins, ctx):
         a = ins[0]
+        if _to_sequence(cfg):
+            v4, m3 = _nested_view(a)
+            v = jnp.where(m3[..., None] > 0, v4, _NEG_INF)
+            out = jnp.max(v, axis=2)           # [B, S, D]
+            sub_live = (jnp.sum(m3, axis=-1) > 0).astype(jnp.float32)
+            return Argument(value=out * sub_live[..., None],
+                            mask=sub_live)
         v = jnp.where(a.mask[..., None] > 0, a.value, _NEG_INF)
         return Argument(value=jnp.max(v, axis=1))
 
@@ -41,11 +67,26 @@ class AverageLayer(LayerImpl):
     ModelConfig)."""
 
     def infer(self, cfg, in_infos):
+        if _to_sequence(cfg):
+            return ShapeInfo(size=in_infos[0].size, is_sequence=True)
         return _pooled_info(cfg, in_infos)
 
     def apply(self, cfg, params, ins, ctx):
         a = ins[0]
         strategy = cfg.attrs.get("average_strategy", "average")
+        if _to_sequence(cfg):
+            v4, m3 = _nested_view(a)
+            s = jnp.sum(v4 * m3[..., None], axis=2)      # [B, S, D]
+            n = jnp.maximum(jnp.sum(m3, axis=2)[..., None], 1.0)
+            sub_live = (jnp.sum(m3, axis=-1) > 0).astype(jnp.float32)
+            if strategy == "sum":
+                out = s
+            elif strategy == "squarerootn":
+                out = s / jnp.sqrt(n)
+            else:
+                out = s / n
+            return Argument(value=out * sub_live[..., None],
+                            mask=sub_live)
         s = jnp.sum(a.value * a.mask[..., None], axis=1)
         n = jnp.maximum(jnp.sum(a.mask, axis=1, keepdims=True), 1.0)
         if strategy == "sum":
@@ -58,14 +99,30 @@ class AverageLayer(LayerImpl):
 @register_layer("seqlastins")
 class SeqLastInsLayer(LayerImpl):
     """Last (or first, with select_first) token of each sequence
-    (``SequenceLastInstanceLayer.cpp``)."""
+    (``SequenceLastInstanceLayer.cpp``); agg_level=TO_SEQUENCE on a
+    nested input picks per-SUB-sequence last/first tokens."""
 
     def infer(self, cfg, in_infos):
+        if _to_sequence(cfg):
+            return ShapeInfo(size=in_infos[0].size, is_sequence=True)
         return _pooled_info(cfg, in_infos)
 
     def apply(self, cfg, params, ins, ctx):
         a = ins[0]
-        if cfg.attrs.get("select_first", False):
+        first = cfg.attrs.get("select_first", False)
+        if _to_sequence(cfg):
+            v4, m3 = _nested_view(a)
+            if first:
+                idx = jnp.zeros(m3.shape[:2], jnp.int32)
+            else:
+                idx = jnp.maximum(
+                    jnp.sum(m3, axis=-1).astype(jnp.int32) - 1, 0)
+            v = jnp.take_along_axis(
+                v4, idx[:, :, None, None].astype(jnp.int32),
+                axis=2)[:, :, 0]
+            sub_live = (jnp.sum(m3, axis=-1) > 0).astype(jnp.float32)
+            return Argument(value=v * sub_live[..., None], mask=sub_live)
+        if first:
             idx = jnp.zeros((a.batch_size,), jnp.int32)
         else:
             idx = jnp.maximum(a.seq_lengths() - 1, 0)
@@ -84,6 +141,16 @@ class ExpandLayer(LayerImpl):
 
     def apply(self, cfg, params, ins, ctx):
         src, ref = ins
+        if ref.mask is not None and ref.mask.ndim == 3:
+            # nested reference [B, S, T]: a per-sub-sequence vector
+            # ([B, S, size]) broadcasts over timesteps; a per-sequence
+            # vector ([B, size]) over sub-sequences AND timesteps
+            # (ExpandLayer with a subseq target, both expand levels)
+            B, S, T = ref.mask.shape
+            v = (src.value[:, :, None, :] if src.value.ndim == 3
+                 else src.value[:, None, None, :])
+            v = jnp.broadcast_to(v, (B, S, T, src.value.shape[-1]))
+            return Argument(value=v * ref.mask[..., None], mask=ref.mask)
         T = ref.value.shape[1]
         v = jnp.broadcast_to(
             src.value[:, None, :],

@@ -26,6 +26,13 @@ def classification_error(output: Argument, label: Argument) -> jnp.ndarray:
     ``paddle_tpu.trainer.metrics`` — same semantics when weight is None."""
     pred = jnp.argmax(output.value, axis=-1)
     lab = label.value.astype(pred.dtype)
+    if (output.mask is not None and label.mask is not None
+            and lab.ndim == pred.ndim and lab.shape[1] != pred.shape[1]):
+        # differently-padded aligned sequences (sub-seq-aggregated output
+        # vs feeder-padded labels): trim/pad labels to the output length
+        T = pred.shape[1]
+        lab = (lab[:, :T] if lab.shape[1] > T
+               else jnp.pad(lab, ((0, 0), (0, T - lab.shape[1]))))
     wrong = (pred != lab).astype(jnp.float32)
     if output.mask is not None:
         wrong = wrong * output.mask

@@ -65,6 +65,19 @@ class EvaluatorBase:
         return self.value()
 
 
+def _align_label(label, out_T):
+    """Trim/pad a feeder-padded label sequence to the output's padded
+    length (positions align semantically; masks carry truth)."""
+    label = np.asarray(label)
+    if label.ndim >= 2 and label.shape[1] != out_T:
+        if label.shape[1] > out_T:
+            return label[:, :out_T]
+        pad = [(0, 0), (0, out_T - label.shape[1])] + \
+            [(0, 0)] * (label.ndim - 2)
+        return np.pad(label, pad)
+    return label
+
+
 @register_evaluator("classification_error")
 class ClassificationErrorEvaluator(EvaluatorBase):
     """``ClassificationErrorEvaluator`` — fraction argmax(output) != label;
@@ -81,6 +94,8 @@ class ClassificationErrorEvaluator(EvaluatorBase):
     def eval_batch(self, output, label=None, weight=None, mask=None):
         output = np.asarray(output)
         label = np.asarray(label)
+        if output.ndim >= 3:
+            label = _align_label(label, output.shape[1])
         if self.top_k == 1:
             hit = np.argmax(output, axis=-1) == label
         else:

@@ -1,0 +1,39 @@
+"""The reference's sequence layer-group configs train on the checked-in
+REAL segmented-text corpus (`gserver/tests/Sequence/tour_train_wdseg*`,
+dict of 158 phrases) — the test_RecurrentGradientMachine layer-group
+scenarios: an LSTM built from recurrent_group + lstm step primitives
+(flat), and the same nested one level down (outer group over
+sub-sequences) with the full TO_SEQUENCE aggregation chain
+(last_seq -> expand -> avg-pool at sub-sequence level)."""
+
+import pathlib
+
+import pytest
+
+GTESTS = pathlib.Path("/root/reference/paddle/gserver/tests")
+needs_ref = pytest.mark.skipif(not GTESTS.exists(), reason="needs reference")
+
+
+@needs_ref
+@pytest.mark.parametrize("conf,passes,max_err", [
+    ("sequence_layer_group.conf", 3, 0.9),
+    ("sequence_nest_layer_group.conf", 3, 0.9),
+])
+def test_layer_group_config_trains_on_real_corpus(conf, passes, max_err,
+                                                  monkeypatch, capsys):
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    # the configs read their dict/provider data relative to the source
+    # root, exactly how the reference tests run them
+    monkeypatch.chdir("/root/reference/paddle")
+    from paddle_tpu.trainer import cli
+    rc = cli.main(["--config", str(GTESTS / conf), "--job", "train",
+                   "--num_passes", str(passes), "--log_period", "0"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    import re
+    errs = [float(m.group(1)) for m in re.finditer(
+        r"classification_error=([0-9.]+)", out)]
+    assert errs, out
+    assert errs[-1] <= errs[0] <= max_err + 0.2
+    assert errs[-1] < max_err
