@@ -757,7 +757,10 @@ def recurrent_group(step, input, reverse=False, name=None,
     elif isinstance(input, (list, tuple)):
         input = [i._finalize() if isinstance(i, MixedLayerType) else i
                  for i in input]
-    return dsl.recurrent_group(step, input, reverse=reverse, name=name)
+    if targetInlink is not None:
+        targetInlink = _one(targetInlink)
+    return dsl.recurrent_group(step, input, reverse=reverse, name=name,
+                               target_inlink=targetInlink)
 
 
 def SubsequenceInput(input):

@@ -445,7 +445,7 @@ LayerOutput.set_input = _memory_set_input
 
 
 def recurrent_group(step, input, *, reverse: bool = False,
-                    name: str = None):
+                    name: str = None, target_inlink=None):
     """Unroll a user step network over the timesteps of the sequence
     inputs (the TPU-native ``RecurrentGradientMachine`` training path —
     see paddle_tpu/layers/group.py). ``input`` items: sequence
@@ -511,12 +511,22 @@ def recurrent_group(step, input, *, reverse: bool = False,
         if bl is not None:
             ins_meta.append({"boundary": mem["boundary"], "kind": "boot"})
             outer_in_names.append(bl.name)
+    # targetInlink (config_parser target_inlinkname): which in-link's
+    # sub-sequence boundaries define the group's OUTPUT structure
+    target_idx = 0
+    if target_inlink is not None:
+        for i, x in enumerate(inputs):
+            src_in = getattr(x, "input", x)
+            if getattr(src_in, "name", None) == target_inlink.name:
+                target_idx = i
+                break
     ldef = LayerDef(
         name=gname, type="recurrent_layer_group",
         inputs=[Input(n) for n in outer_in_names], bias=False,
         attrs={"sub_model": sub, "ins": ins_meta, "memories": memories,
                "outputs": [h.name for h in out_handles],
-               "reverse": reverse})
+               "reverse": reverse,
+               "target_boundary": ins_meta[target_idx]["boundary"]})
     main = _add(ldef)
     if len(out_handles) == 1:
         return main

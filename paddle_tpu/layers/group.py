@@ -98,8 +98,11 @@ class RecurrentLayerGroup(LayerImpl):
                         "layout)")
                 sub_xs[m["boundary"]] = jnp.swapaxes(a.value, 0, 1)
                 sub_masks[m["boundary"]] = jnp.swapaxes(a.mask, 0, 1)
-                if mask is None:
-                    # an outer step is live if its sub-sequence has tokens
+                is_target = m["boundary"] == cfg.attrs.get(
+                    "target_boundary", ins_meta[0]["boundary"])
+                if mask is None or is_target:
+                    # an outer step is live if its sub-sequence has
+                    # tokens; the target in-link wins the outer mask
                     mask = (jnp.sum(a.mask, axis=-1) > 0).astype(
                         jnp.float32)
             elif m["kind"] == "static":
@@ -178,13 +181,24 @@ class RecurrentLayerGroup(LayerImpl):
             # nested group's output feeds flat-level consumers
             Bq, Sq, Tq = y_main.shape[0], y_main.shape[1], y_main.shape[2]
             flat = y_main.reshape(Bq, Sq * Tq, *y_main.shape[3:])
-            sm = jnp.swapaxes(next(iter(sub_masks.values())), 0, 1)
+            target = cfg.attrs.get("target_boundary")
+            sm_src = sub_masks.get(target,
+                                   next(iter(sub_masks.values())))
+            sm = jnp.swapaxes(sm_src, 0, 1)
             # keep the un-flattened 2-level view alongside: TO_SEQUENCE
             # aggregations (seqlastins/pooling with agg_level=seq) need
-            # the sub-sequence boundaries the flat layout erases
+            # the sub-sequence boundaries the flat layout erases; extra
+            # out-links flatten the same way (group_output re-attaches
+            # the nested view)
+            extras = {
+                o: (v.reshape(Bq, Sq * Tq, *v.shape[3:])
+                    if v.ndim >= 3 and v.shape[1] == Sq
+                    and v.shape[2] == Tq else v)
+                for o, v in extras.items()}
             return Argument(value=flat, mask=sm.reshape(Bq, Sq * Tq),
                             state={"group_outputs": extras, "final": carry,
-                                   "nested": (y_main, sm)})
+                                   "nested": (y_main, sm),
+                                   "nested_tq": Tq})
         return Argument(value=y_main, mask=mask,
                         state={"group_outputs": extras, "final": carry})
 
@@ -199,8 +213,16 @@ class GroupOutput(LayerImpl):
 
     def apply(self, cfg, params, ins, ctx):
         a = ins[0]
-        return Argument(value=a.state["group_outputs"][cfg.attrs["sub_name"]],
-                        mask=a.mask)
+        v = a.state["group_outputs"][cfg.attrs["sub_name"]]
+        state = None
+        if isinstance(a.state, dict) and "nested_tq" in a.state \
+                and a.mask is not None and v.ndim == 3:
+            tq = a.state["nested_tq"]
+            B, ST = v.shape[0], v.shape[1]
+            state = {"nested": (v.reshape(B, ST // tq, tq, v.shape[-1]),
+                                a.mask.reshape(B, ST // tq, tq)),
+                     "nested_tq": tq}
+        return Argument(value=v, mask=a.mask, state=state)
 
 
 @register_layer("beam_search_group")

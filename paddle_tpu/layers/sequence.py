@@ -122,10 +122,18 @@ class SeqLastInsLayer(LayerImpl):
                 axis=2)[:, :, 0]
             sub_live = (jnp.sum(m3, axis=-1) > 0).astype(jnp.float32)
             return Argument(value=v * sub_live[..., None], mask=sub_live)
+        # find the true first/last positions from the mask itself: a
+        # flattened 2-level layout pads INSIDE the sequence (between
+        # sub-sequences), so sum(mask)-1 is not the last valid index
+        m = a.mask
+        if m is None:
+            m = jnp.ones(a.value.shape[:2], jnp.float32)
         if first:
-            idx = jnp.zeros((a.batch_size,), jnp.int32)
+            idx = jnp.argmax(m > 0, axis=1).astype(jnp.int32)
         else:
-            idx = jnp.maximum(a.seq_lengths() - 1, 0)
+            T = m.shape[1]
+            idx = (T - 1 - jnp.argmax(jnp.flip(m, axis=1) > 0,
+                                      axis=1)).astype(jnp.int32)
         v = jnp.take_along_axis(
             a.value, idx[:, None, None].astype(jnp.int32), axis=1)[:, 0]
         return Argument(value=v)
@@ -152,6 +160,20 @@ class ExpandLayer(LayerImpl):
             v = jnp.broadcast_to(v, (B, S, T, src.value.shape[-1]))
             return Argument(value=v * ref.mask[..., None], mask=ref.mask)
         T = ref.value.shape[1]
+        if src.value.ndim == 3:
+            # a sequence of per-SUB-sequence vectors ([B, S, D])
+            # expanding over a flattened nested target: position t of
+            # the flat layout belongs to sub t // T_sub (the group's
+            # static 2-level padding)
+            nested = _nested_view(ref) if ref.mask.ndim == 2 else None
+            if nested is None:
+                raise ValueError(
+                    "expand of a per-sub-sequence input needs a nested "
+                    "target (a group output carrying its 2-level view)")
+            t_sub = nested[1].shape[-1]
+            sub_of = (jnp.arange(T) // t_sub).astype(jnp.int32)
+            v = jnp.take(src.value, sub_of, axis=1)
+            return Argument(value=v * ref.mask[..., None], mask=ref.mask)
         v = jnp.broadcast_to(
             src.value[:, None, :],
             (src.value.shape[0], T, src.value.shape[-1]))

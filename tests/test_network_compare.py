@@ -50,6 +50,10 @@ PAIRS = [
     ("concat_fullmatrix_a.conf", "concat_fullmatrix_b.conf", (4, 100)),
     ("concat_slice_a.conf", "concat_slice_b.conf", (4, 8 * 16 * 16)),
     ("img_conv_a.conf", "img_conv_b.conf", (2, 8 * 16 * 16)),
+    # ConvUnify (test_ConvUnify.cpp): padded vs mixed-projection conv,
+    # and the cudnn vs exconv grouped-conv pair
+    ("img_conv_a.conf", "img_conv_c.conf", (2, 8 * 16 * 16)),
+    ("img_conv_cudnn.py", "img_conv_exconv.py", (2, 8 * 16 * 16)),
     ("img_pool_a.conf", "img_pool_b.conf", (2, 8 * 16 * 16)),
 ]
 
@@ -147,3 +151,66 @@ def test_reference_nested_rnn_equals_flat():
         np.testing.assert_allclose(np.asarray(res_flat[of].value),
                                    np.asarray(res_nest[on].value),
                                    rtol=1e-5, atol=1e-5)
+
+
+@needs_ref
+def test_reference_unequalength_nested_equals_flat():
+    """test_RecurrentGradientMachine.cpp:149-156: the DOUBLE-nested
+    config (outer group over sub-sequence pairs, inner per-sub groups
+    whose memories boot from outer memories, targetInlink=emb2) equals
+    the flat two-stream RNN on the reference's own data2 fixture —
+    exactly, because the inner chains continue across sub boundaries
+    through the outer memory boots."""
+    flat_net, flat_outs = _build("sequence_rnn_multi_unequalength_inputs.py")
+    params = flat_net.init_params(jax.random.PRNGKey(9))
+    nest_net, nest_outs = _build(
+        "sequence_nest_rnn_multi_unequalength_inputs.py")
+    nest_params = _map_params(flat_net, params, nest_net)
+
+    # rnn_data_provider.py data2 (the reference test's fixture)
+    data2 = [
+        [[[1, 2], [4, 5, 2]], [[5, 4, 1], [3, 1]], 0],
+        [[[0, 2], [2, 5], [0, 1, 2]], [[1, 5], [4], [2, 3, 6, 1]], 1],
+    ]
+    B = 2
+
+    def pad_flat(col):
+        T = max(len(s) for s in col)
+        v = np.zeros((B, T), np.int32)
+        m = np.zeros((B, T), np.float32)
+        for i, s in enumerate(col):
+            v[i, : len(s)] = s
+            m[i, : len(s)] = 1
+        return v, m
+
+    def pad_nest(col):
+        S = max(len(d) for d in col)
+        T = max(len(ss) for d in col for ss in d)
+        v = np.zeros((B, S, T), np.int32)
+        m = np.zeros((B, S, T), np.float32)
+        for i, d in enumerate(col):
+            for j, ss in enumerate(d):
+                v[i, j, : len(ss)] = ss
+                m[i, j, : len(ss)] = 1
+        return v, m
+
+    w1 = [sum(d[0], []) for d in data2]
+    w2 = [sum(d[1], []) for d in data2]
+    v1, m1 = pad_flat(w1)
+    v2, m2 = pad_flat(w2)
+    lab = np.asarray([d[2] for d in data2], np.int32)
+    n1, nm1 = pad_nest([d[0] for d in data2])
+    n2, nm2 = pad_nest([d[1] for d in data2])
+
+    res_f = flat_net.apply(params, {
+        "word1": Argument(value=jnp.asarray(v1), mask=jnp.asarray(m1)),
+        "word2": Argument(value=jnp.asarray(v2), mask=jnp.asarray(m2)),
+        "label": Argument(value=jnp.asarray(lab))})
+    res_n = nest_net.apply(nest_params, {
+        "word1": Argument(value=jnp.asarray(n1), mask=jnp.asarray(nm1)),
+        "word2": Argument(value=jnp.asarray(n2), mask=jnp.asarray(nm2)),
+        "label": Argument(value=jnp.asarray(lab))})
+    for of, on in zip(flat_outs, nest_outs):
+        np.testing.assert_allclose(np.asarray(res_f[of].value),
+                                   np.asarray(res_n[on].value),
+                                   rtol=1e-6, atol=1e-6)
