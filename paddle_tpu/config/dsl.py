@@ -488,7 +488,20 @@ def recurrent_group(step, input, *, reverse: bool = False,
             else:
                 src = x
                 bname = f"{gname}@seq{i}"
-                kind = "seq"
+                # a plain input whose source the graph KNOWS is a
+                # sequence steps per timestep; otherwise the level is
+                # only knowable from the fed data (the reference infers
+                # it from the provider's slot types), so defer to the
+                # executor's runtime resolution ("auto": 3-D mask ->
+                # sub-sequence, maskless flat -> static broadcast)
+                try:
+                    is_seq = _shape_of(src.name).is_sequence
+                except KeyError:
+                    is_seq = False
+                kind = "seq" if is_seq else "auto"
+                # NOTE: the boundary stays a plain (non-sequence) data
+                # layer even for kind="seq" — the step sees ONE frame
+                # per timestep, not a sequence
                 ldef = LayerDef(name=bname, type="data", size=src.size,
                                 bias=False)
             proxies.append(_add(ldef))

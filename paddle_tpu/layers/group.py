@@ -80,7 +80,10 @@ class RecurrentLayerGroup(LayerImpl):
                 if a.mask is not None and a.mask.ndim == 3:
                     kind = "subseq"
                 elif a.mask is None:
-                    kind = "static"
+                    # maskless [B, T, D] still walks as a full-length
+                    # sequence; flat maskless values broadcast (the
+                    # reference's non-sequence in-link semantics)
+                    kind = "seq" if a.value.ndim >= 3 else "static"
                 else:
                     kind = "seq"
                 m = dict(m, kind=kind)
@@ -174,7 +177,15 @@ class RecurrentLayerGroup(LayerImpl):
         main = out_names[0]
         extras = {o: jnp.swapaxes(ys[o], 0, 1) for o in out_names[1:]}
         y_main = jnp.swapaxes(ys[main], 0, 1)
-        if sub_xs and net.shape_infos[main].is_sequence:
+        sub_t = (next(iter(sub_masks.values())).shape[2]
+                 if sub_masks else None)
+        if sub_xs and (net.shape_infos[main].is_sequence
+                       or (y_main.ndim >= 4
+                           and y_main.shape[2] == sub_t)):
+            # flatten when the per-step output carries a TIME axis —
+            # either statically known (is_sequence) or, for runtime-
+            # resolved ("auto") sub-sequence in-links, recognized by the
+            # output's third axis matching the sub-sequence length
             # the outer step returned a whole sequence per sub-sequence
             # (the reference's nested out_link): concatenate sub-sequences
             # back into one flat sequence, like the reference does when a

@@ -153,6 +153,31 @@ def test_reference_nested_rnn_equals_flat():
                                    rtol=1e-5, atol=1e-5)
 
 
+def _pad_flat(col):
+    """Ragged int rows -> padded [B, T] + mask."""
+    B, T = len(col), max(len(s) for s in col)
+    v = np.zeros((B, T), np.int32)
+    m = np.zeros((B, T), np.float32)
+    for i, s in enumerate(col):
+        v[i, : len(s)] = s
+        m[i, : len(s)] = 1
+    return v, m
+
+
+def _pad_nest(col):
+    """Ragged 2-level int rows -> padded [B, S, T] + mask."""
+    B = len(col)
+    S = max(len(d) for d in col)
+    T = max(len(ss) for d in col for ss in d)
+    v = np.zeros((B, S, T), np.int32)
+    m = np.zeros((B, S, T), np.float32)
+    for i, d in enumerate(col):
+        for j, ss in enumerate(d):
+            v[i, j, : len(ss)] = ss
+            m[i, j, : len(ss)] = 1
+    return v, m
+
+
 @needs_ref
 def test_reference_unequalength_nested_equals_flat():
     """test_RecurrentGradientMachine.cpp:149-156: the DOUBLE-nested
@@ -172,35 +197,13 @@ def test_reference_unequalength_nested_equals_flat():
         [[[1, 2], [4, 5, 2]], [[5, 4, 1], [3, 1]], 0],
         [[[0, 2], [2, 5], [0, 1, 2]], [[1, 5], [4], [2, 3, 6, 1]], 1],
     ]
-    B = 2
-
-    def pad_flat(col):
-        T = max(len(s) for s in col)
-        v = np.zeros((B, T), np.int32)
-        m = np.zeros((B, T), np.float32)
-        for i, s in enumerate(col):
-            v[i, : len(s)] = s
-            m[i, : len(s)] = 1
-        return v, m
-
-    def pad_nest(col):
-        S = max(len(d) for d in col)
-        T = max(len(ss) for d in col for ss in d)
-        v = np.zeros((B, S, T), np.int32)
-        m = np.zeros((B, S, T), np.float32)
-        for i, d in enumerate(col):
-            for j, ss in enumerate(d):
-                v[i, j, : len(ss)] = ss
-                m[i, j, : len(ss)] = 1
-        return v, m
-
     w1 = [sum(d[0], []) for d in data2]
     w2 = [sum(d[1], []) for d in data2]
-    v1, m1 = pad_flat(w1)
-    v2, m2 = pad_flat(w2)
+    v1, m1 = _pad_flat(w1)
+    v2, m2 = _pad_flat(w2)
     lab = np.asarray([d[2] for d in data2], np.int32)
-    n1, nm1 = pad_nest([d[0] for d in data2])
-    n2, nm2 = pad_nest([d[1] for d in data2])
+    n1, nm1 = _pad_nest([d[0] for d in data2])
+    n2, nm2 = _pad_nest([d[1] for d in data2])
 
     res_f = flat_net.apply(params, {
         "word1": Argument(value=jnp.asarray(v1), mask=jnp.asarray(m1)),
@@ -213,4 +216,34 @@ def test_reference_unequalength_nested_equals_flat():
     for of, on in zip(flat_outs, nest_outs):
         np.testing.assert_allclose(np.asarray(res_f[of].value),
                                    np.asarray(res_n[on].value),
+                                   rtol=1e-6, atol=1e-6)
+
+
+@needs_ref
+def test_reference_mixed_inputs_equals_matched():
+    """test_RecurrentGradientMachine.cpp:158-163: the mixed-level group
+    (nested ids + per-sub tokens + static label + static encoding, an
+    inner group with a StaticInput and simple_attention in the outer
+    step) equals the matched-level spelling exactly on the reference's
+    data3 fixture."""
+    mixed_net, mixed_outs = _build("sequence_rnn_mixed_inputs.py")
+    params = mixed_net.init_params(jax.random.PRNGKey(9))
+    matched_net, matched_outs = _build("sequence_rnn_matched_inputs.py")
+    matched_params = _map_params(mixed_net, params, matched_net)
+
+    data3 = [
+        [[[1, 2], [4, 5, 2]], [1, 2], 0],
+        [[[0, 2], [2, 5], [0, 1, 2]], [2, 3, 0], 1],
+    ]
+    v1, m1 = _pad_nest([d[0] for d in data3])
+    v2, m2 = _pad_flat([d[1] for d in data3])
+    lab = np.asarray([d[2] for d in data3], np.int32)
+    feed = {"word1": Argument(value=jnp.asarray(v1), mask=jnp.asarray(m1)),
+            "word2": Argument(value=jnp.asarray(v2), mask=jnp.asarray(m2)),
+            "label": Argument(value=jnp.asarray(lab))}
+    res_mixed = mixed_net.apply(params, feed)
+    res_matched = matched_net.apply(matched_params, feed)
+    for om, on in zip(mixed_outs, matched_outs):
+        np.testing.assert_allclose(np.asarray(res_mixed[om].value),
+                                   np.asarray(res_matched[on].value),
                                    rtol=1e-6, atol=1e-6)
