@@ -28,6 +28,9 @@ def install_paddle_alias():
     trainer = types.ModuleType("paddle.trainer")
     trainer.config_parser = config_parser
     trainer.PyDataProvider2 = pydp2
+    pydp_wrapper = __import__("paddle_tpu.compat.pydp_wrapper",
+                              fromlist=["pydp_wrapper"])
+    trainer.PyDataProviderWrapper = pydp_wrapper
     root.trainer = trainer
     root.trainer_config_helpers = tch
     root.proto = __import__("paddle_tpu.proto", fromlist=["proto"])
@@ -36,6 +39,7 @@ def install_paddle_alias():
     sys.modules["paddle.trainer"] = trainer
     sys.modules["paddle.trainer.config_parser"] = config_parser
     sys.modules["paddle.trainer.PyDataProvider2"] = pydp2
+    sys.modules["paddle.trainer.PyDataProviderWrapper"] = pydp_wrapper
     sys.modules["paddle.trainer_config_helpers"] = tch
     for sub in ["layers", "networks", "optimizers", "activations",
                 "attrs", "poolings", "evaluators", "data_sources",

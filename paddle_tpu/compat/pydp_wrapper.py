@@ -1,0 +1,165 @@
+"""``paddle.trainer.PyDataProviderWrapper`` — the LEGACY (pre-PyDP2)
+provider surface.
+
+The reference module (``python/paddle/trainer/PyDataProviderWrapper.py``)
+has user code declare ``@provider(slots=[DenseSlot(9), IndexSlot(2)])``
+over a ``process(obj, filename)`` generator yielding one sample per
+yield: a list with one entry per slot (with ``use_seq=True``, each entry
+is a list of timesteps). The reference serialized batches over a binary
+protocol to the C++ ``PyDataProviderWrapper``; here the decorator plugs
+straight into the native reader pipeline (``as_reader``), so old configs
+declaring ``PyData(load_data_module=..., load_data_object=...)`` with
+wrapper-era providers feed the trainer unmodified
+(``paddle/trainer/tests/testPyDataWrapper.py`` is the contract)."""
+
+from __future__ import annotations
+
+import random
+from typing import Any, List, Optional
+
+from paddle_tpu.data import types as T
+
+__all__ = [
+    "DenseSlot", "SparseNonValueSlot", "SparseValueSlot", "IndexSlot",
+    "StringSlot", "SlotType", "PoolSize", "provider", "init_hook_wrapper",
+    "default_init_hook", "GeneralPyDataProvider",
+]
+
+
+class SlotType:
+    dim: int = 0
+
+    def input_type(self, use_seq: bool):
+        raise NotImplementedError
+
+
+class DenseSlot(SlotType):
+    def __init__(self, dim):
+        self.dim = int(dim)
+
+    def input_type(self, use_seq):
+        return (T.dense_vector_sequence(self.dim) if use_seq
+                else T.dense_vector(self.dim))
+
+
+class SparseNonValueSlot(SlotType):
+    def __init__(self, dim):
+        self.dim = int(dim)
+
+    def input_type(self, use_seq):
+        return (T.sparse_binary_vector_sequence(self.dim) if use_seq
+                else T.sparse_binary_vector(self.dim))
+
+
+class SparseValueSlot(SlotType):
+    def __init__(self, dim):
+        self.dim = int(dim)
+
+    def input_type(self, use_seq):
+        return (T.sparse_float_vector_sequence(self.dim) if use_seq
+                else T.sparse_float_vector(self.dim))
+
+
+class IndexSlot(SlotType):
+    def __init__(self, dim):
+        self.dim = int(dim)
+
+    def input_type(self, use_seq):
+        return (T.integer_value_sequence(self.dim) if use_seq
+                else T.integer_value(self.dim))
+
+
+class StringSlot(SlotType):
+    """Raw strings ride through untyped (debug/printer consumption)."""
+
+    def __init__(self, dim=1):
+        self.dim = int(dim)
+
+    def input_type(self, use_seq):
+        return None
+
+
+class PoolSize:
+    def __init__(self, size):
+        self.size = int(size)
+
+
+def default_init_hook(cls, *args, **kwargs):
+    del cls, args, kwargs
+
+
+def init_hook_wrapper(func):
+    """Reference helper: lets an init hook receive load_data_args as
+    typed kwargs."""
+
+    def hook(obj, *args, **kwargs):
+        func(obj, *args, **kwargs)
+
+    return hook
+
+
+class GeneralPyDataProvider:
+    """The decorated provider object: carries slots/logger like the
+    reference instance, and exposes the native ``as_reader`` protocol."""
+
+    def __init__(self, generator, slots, use_seq, should_shuffle,
+                 init_hook, args=None, kwargs=None):
+        from paddle_tpu.utils import logger
+        self.generator = generator
+        self.slots: Optional[List[SlotType]] = slots
+        self.use_seq = bool(use_seq)
+        self.should_shuffle = bool(should_shuffle)
+        self.logger = logger
+        init_hook(self, *(args or ()), **(kwargs or {}))
+        self.input_types = (
+            [s.input_type(self.use_seq) for s in self.slots]
+            if self.slots else None)
+
+    def _files(self, file_list):
+        if file_list is None:
+            return []
+        if isinstance(file_list, str):
+            with open(file_list) as f:
+                return [ln.strip() for ln in f if ln.strip()]
+        return list(file_list)
+
+    def as_reader(self, file_list, is_train=True, **kwargs):
+        del kwargs
+        files = self._files(file_list)
+        provider = self
+
+        def reader():
+            samples = []
+            for path in files:
+                for sample in provider.generator(provider, path):
+                    # generators may yield lazy map objects (py2-era
+                    # style); materialize per slot (scalars/strings ride
+                    # through)
+                    samples.append(tuple(
+                        list(col) if hasattr(col, "__iter__")
+                        and not isinstance(col, (str, bytes)) else col
+                        for col in sample))
+            if provider.should_shuffle and is_train:
+                random.shuffle(samples)
+            yield from samples
+
+        reader.input_types = self.input_types
+        return reader
+
+    __call__ = as_reader
+
+
+def provider(slots=None, use_seq=False, should_shuffle=True, pool_size=1,
+             can_over_batch_size=True, calc_batch_size=None, debug=False,
+             init_hook=default_init_hook, profile_filename=None):
+    """The legacy ``@provider`` decorator
+    (``PyDataProviderWrapper.py:568``). pool/batch knobs are accepted
+    for compatibility; batching is the trainer's job here."""
+    del pool_size, can_over_batch_size, calc_batch_size, debug, \
+        profile_filename
+
+    def deco(func):
+        return GeneralPyDataProvider(func, slots, use_seq, should_shuffle,
+                                     init_hook)
+
+    return deco
