@@ -683,6 +683,12 @@ def model_to_proto(model: ModelDef, context=None) -> "ModelConfig_pb2.ModelConfi
     root_entry.layer_names.extend(root_names)
     root_entry.input_layer_names.extend(mc.input_layer_names)
     root_entry.output_layer_names.extend(mc.output_layer_names)
+    # the reference writes the flag explicitly even on the root
+    root_entry.is_recurrent_layer_group = False
+    if context is not None:
+        root_entry.evaluator_names.extend(
+            ev.get("name", ev.get("type", ""))
+            for ev in context.evaluators)
     for e in sub_entries:
         sm = mc.sub_models.add()
         sm.name = e["name"]

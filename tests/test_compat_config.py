@@ -153,6 +153,14 @@ def test_golden_protostr_full_field_parity(name):
         normalize_param_pair(a, b)
         assert text_format.MessageToString(a) == \
             text_format.MessageToString(b), pname
+    # ... and the REST of the proto verbatim: sub_models (incl. the
+    # recurrent expansions' in/out links and memories), declared
+    # input/output orders, and evaluator configs
+    for msg in (ours, ref):
+        del msg.layers[:]
+        del msg.parameters[:]
+    assert text_format.MessageToString(ours) == \
+        text_format.MessageToString(ref)
 
 
 @needs_ref
