@@ -391,3 +391,32 @@ def test_sub_models_match_golden(name):
              for l in r.in_links], o.name
         assert [(l.layer_name, l.link_name) for l in o.out_links] == \
             [(l.layer_name, l.link_name) for l in r.out_links], o.name
+
+
+@needs_ref
+def test_reference_config_parser_test_invocations():
+    """The reference's own parser unit test
+    (`paddle/trainer/tests/config_parser_test.py`) — its three
+    parse_config_and_serialize invocations succeed here, including the
+    extension_module_name arg and the gserver pyDataProvider config."""
+    import os
+    from paddle_tpu.compat import install_paddle_alias
+    install_paddle_alias()
+    from paddle.trainer.config_parser import parse_config_and_serialize
+    cwd = os.getcwd()
+    os.chdir("/root/reference/paddle")
+    try:
+        for conf, arg in [
+            ("trainer/tests/test_config.conf", ""),
+            ("trainer/tests/sample_trainer_config.conf",
+             "extension_module_name="
+             "paddle.trainer.config_parser_extension"),
+            ("gserver/tests/pyDataProvider/trainer.conf", ""),
+        ]:
+            blob = parse_config_and_serialize(conf, arg)
+            assert isinstance(blob, bytes) and len(blob) > 500, conf
+            from paddle_tpu.proto import TrainerConfig_pb2
+            tc = TrainerConfig_pb2.TrainerConfig.FromString(blob)
+            assert tc.model_config.layers
+    finally:
+        os.chdir(cwd)
