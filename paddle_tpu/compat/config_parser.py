@@ -557,8 +557,10 @@ class ParsedConfig:
             mod = __import__(source.module)
         finally:
             sys.path[:] = saved
-        # Python-2-era provider scripts (xrange at generator time)
-        for legacy, repl in (("xrange", range), ("unicode", str)):
+        # Python-2-era provider scripts (xrange/reduce at generator time)
+        import functools
+        for legacy, repl in (("xrange", range), ("unicode", str),
+                             ("reduce", functools.reduce)):
             if not hasattr(mod, legacy):
                 setattr(mod, legacy, repl)
         prov = getattr(mod, source.obj)

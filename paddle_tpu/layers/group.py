@@ -116,7 +116,27 @@ class RecurrentLayerGroup(LayerImpl):
             raise ValueError(
                 f"recurrent group {cfg.name!r} has no sequence input; "
                 "use beam_search/generation for input-free unrolling")
-        lead = next(iter(xs.values())) if xs else next(iter(sub_xs.values()))
+        if sub_xs and xs:
+            # mixed levels: the outer steps over SUB-SEQUENCES, so every
+            # flat sequence input must align to the sub count; the
+            # feeder may have padded it longer (pad_multiple bucketing)
+            S = next(iter(sub_xs.values())).shape[0]
+
+            def _fit(v):
+                if v.shape[0] > S:
+                    return v[:S]
+                if v.shape[0] < S:
+                    pad = [(0, S - v.shape[0])] + [(0, 0)] * (v.ndim - 1)
+                    return jnp.pad(v, pad)
+                return v
+
+            xs = {k: _fit(v) for k, v in xs.items()}
+            if mask is not None and mask.shape[1] != S:
+                mask = (mask[:, :S] if mask.shape[1] > S
+                        else jnp.pad(mask,
+                                     ((0, 0), (0, S - mask.shape[1]))))
+        lead = next(iter(sub_xs.values())) if sub_xs \
+            else next(iter(xs.values()))
         T = lead.shape[0]
         B = lead.shape[1]
         if mask is None:

@@ -155,9 +155,16 @@ class ExpandLayer(LayerImpl):
             # vector ([B, size]) over sub-sequences AND timesteps
             # (ExpandLayer with a subseq target, both expand levels)
             B, S, T = ref.mask.shape
-            v = (src.value[:, :, None, :] if src.value.ndim == 3
-                 else src.value[:, None, None, :])
-            v = jnp.broadcast_to(v, (B, S, T, src.value.shape[-1]))
+            sv = src.value
+            if sv.ndim == 3 and sv.shape[1] != S:
+                # feeder bucketing can pad the per-sub source longer
+                # than the nested S; masks carry truth, align by trim/pad
+                sv = (sv[:, :S] if sv.shape[1] > S
+                      else jnp.pad(sv, ((0, 0), (0, S - sv.shape[1]),
+                                        (0, 0))))
+            v = (sv[:, :, None, :] if sv.ndim == 3
+                 else sv[:, None, None, :])
+            v = jnp.broadcast_to(v, (B, S, T, sv.shape[-1]))
             return Argument(value=v * ref.mask[..., None], mask=ref.mask)
         T = ref.value.shape[1]
         if src.value.ndim == 3:

@@ -18,6 +18,12 @@ needs_ref = pytest.mark.skipif(not GTESTS.exists(), reason="needs reference")
 @pytest.mark.parametrize("conf,passes,max_err", [
     ("sequence_layer_group.conf", 3, 0.9),
     ("sequence_nest_layer_group.conf", 3, 0.9),
+    ("sequence_rnn.conf", 2, 1.01),
+    ("sequence_nest_rnn.conf", 2, 1.01),
+    ("sequence_rnn_multi_unequalength_inputs.py", 2, 1.01),
+    ("sequence_nest_rnn_multi_unequalength_inputs.py", 2, 1.01),
+    ("sequence_rnn_mixed_inputs.py", 2, 1.01),
+    ("sequence_rnn_matched_inputs.py", 2, 1.01),
 ])
 def test_layer_group_config_trains_on_real_corpus(conf, passes, max_err,
                                                   monkeypatch, capsys):
