@@ -188,6 +188,67 @@ def bench_resnet50(compute_dtype=None, batch=None):
     }
 
 
+# The reference's published image benchmarks (`benchmark/README.md:36-61`,
+# mirrored in BASELINE.md): unmodified configs from
+# `/root/reference/benchmark/paddle/image/`, timed as full train steps.
+IMAGE_BENCHES = {
+    "alexnet": dict(feed="data", size=227, batch=128, ref_ms=334.0,
+                    classes=1000),
+    "googlenet": dict(feed="input", size=224, batch=128, ref_ms=1149.0,
+                      classes=1000),
+    "smallnet_mnist_cifar": dict(feed="data", size=32, batch=64,
+                                 ref_ms=10.46, classes=10),
+}
+
+
+def bench_image_config(name, compute_dtype="bfloat16", iters=None):
+    """Time one of the reference's own benchmark configs (unmodified) and
+    compare against its published K40m ms/batch."""
+    spec = IMAGE_BENCHES[name]
+    iters = iters or max(RESNET_ITERS, 3)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu.compat import parse_config
+    from paddle_tpu.config import dsl
+    from paddle_tpu.core.argument import Argument
+
+    dsl.reset()
+    parsed = parse_config(
+        f"/root/reference/benchmark/paddle/image/{name}.py",
+        f"batch_size={spec['batch']}")
+    trainer = parsed.build_trainer(compute_dtype=compute_dtype)
+
+    rng = np.random.RandomState(0)
+    feed = {
+        spec["feed"]: Argument(value=jnp.asarray(
+            rng.rand(spec["batch"], 3 * spec["size"] * spec["size"]),
+            jnp.float32)),
+        "label": Argument(value=jnp.asarray(
+            rng.randint(0, spec["classes"], size=spec["batch"]), jnp.int32)),
+    }
+    key = jax.random.PRNGKey(0)
+    state = {"params": trainer.params, "opt": trainer.opt_state, "m": None}
+
+    def run_steps(n):
+        for _ in range(n):
+            state["params"], state["opt"], state["m"] = trainer._train_step(
+                state["params"], state["opt"], feed, key, 0)
+
+    def fetch():
+        return float(state["m"]["cost"])
+
+    run_steps(2)  # warmup / compile
+    fetch()
+    ms = _timed_chain(run_steps, fetch, iters, max(iters // 10, 1)) * 1e3
+    tag = name.split("_")[0]
+    return {
+        f"{tag}_ms_per_batch": round(ms, 3),
+        f"{tag}_batch": spec["batch"],
+        f"{tag}_vs_k40m_baseline": round(spec["ref_ms"] / ms, 3),
+    }
+
+
 def _watchdog(seconds, exit_code):
     """Force-exit the child after a deadline. A wedged tunnel hangs inside
     C calls where SIGALRM handlers never run, but a watchdog thread's
@@ -238,11 +299,14 @@ def child_main():
 
     extra("lstm_bf16", lambda: {"lstm_bf16_ms_per_batch": round(
         bench_lstm(compute_dtype="bfloat16"), 3)})
-    extra("resnet50", bench_resnet50)
     extra("resnet50_bf16",
           lambda: bench_resnet50(compute_dtype="bfloat16",
                                  batch=int(os.environ.get(
                                      "BENCH_RESNET_BF16_BATCH", "256"))))
+    extra("resnet50", bench_resnet50)
+    extra("alexnet", lambda: bench_image_config("alexnet"))
+    extra("googlenet", lambda: bench_image_config("googlenet"))
+    extra("smallnet", lambda: bench_image_config("smallnet_mnist_cifar"))
     return 0
 
 
@@ -286,13 +350,13 @@ def main():
             try:
                 proc = subprocess.run(
                     [sys.executable, os.path.abspath(__file__)],
-                    capture_output=True, text=True, timeout=1800, env=env)
+                    capture_output=True, text=True, timeout=4200, env=env)
                 stdout, stderr = proc.stdout, proc.stderr
             except subprocess.TimeoutExpired as e:
                 # a killed child may still have printed the primary metric
                 stdout = e.stdout.decode() if isinstance(e.stdout, bytes) \
                     else (e.stdout or "")
-                stderr = "timeout after 1800s"
+                stderr = "timeout after 4200s"
             line = best_line(stdout)
             if line is not None:
                 print(line)

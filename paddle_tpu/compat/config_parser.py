@@ -496,6 +496,28 @@ class ParsedConfig:
             build_optimizer)
         return build_optimizer(self.context.settings)
 
+    def topology(self):
+        """The trainable Topology this config describes: all declared cost
+        layers train jointly, non-cost outputs ride along as passive
+        extras; an outputs()-only config roots at its declared outputs
+        (inference-only)."""
+        from paddle_tpu.trainer.trainer import Topology
+        costs = self.cost_layers()
+        out_names = list(self.context.output_layer_names)
+        if costs:
+            extra = [n for n in out_names if n not in costs]
+            return Topology(costs, extra_outputs=extra, graph=self.model)
+        if out_names:
+            return Topology(out_names[0], extra_outputs=out_names[1:],
+                            graph=self.model)
+        raise ValueError("config declares no outputs()")
+
+    def build_trainer(self, **sgd_kwargs):
+        """Topology + settings-derived optimizer -> a ready SGD trainer."""
+        from paddle_tpu.trainer.trainer import SGD
+        return SGD(cost=self.topology(),
+                   update_equation=self.optimizer(), **sgd_kwargs)
+
     def batch_size(self) -> int:
         return int(self.context.settings.get("batch_size") or 1)
 

@@ -25,6 +25,27 @@ import numpy as np
 from flax import struct
 
 
+def check_dead(count_live, what: str) -> None:
+    """Runtime guard for padded-length alignment shims.
+
+    Length mismatches between padded inputs are benign only when every
+    trimmed / zero-filled position is masked dead (feeder ``pad_multiple``
+    bucketing). ``count_live`` is a traced scalar counting live positions
+    that would be silently dropped or fabricated; when it is non-zero the
+    mismatch is real data (the reference would CHECK-fail on misaligned
+    ``sequenceStartPositions``), so fail loudly at run time via a debug
+    callback — a trace-time ``raise`` cannot see traced mask values."""
+
+    def _raise(n):
+        if int(n) > 0:
+            raise ValueError(
+                f"{what}: {int(n)} live (unmasked) positions would be "
+                "silently dropped/zero-filled by padded-length alignment; "
+                "the inputs are genuinely misaligned, not just padded")
+
+    jax.debug.callback(_raise, count_live)
+
+
 @struct.dataclass
 class Argument:
     """A batch flowing between layers.

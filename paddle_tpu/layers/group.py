@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from paddle_tpu.core.argument import Argument
+from paddle_tpu.core.argument import Argument, check_dead
 from paddle_tpu.core.network import Network
 from paddle_tpu.core.registry import (LayerImpl, ShapeInfo, register_layer)
 
@@ -65,6 +65,7 @@ class RecurrentLayerGroup(LayerImpl):
         reverse = bool(cfg.attrs.get("reverse", False))
 
         xs: Dict[str, jnp.ndarray] = {}
+        flat_masks: Dict[str, jnp.ndarray] = {}  # [B, T] per flat in-link
         sub_xs: Dict[str, jnp.ndarray] = {}   # nested: [S, B, T_sub, D]
         sub_masks: Dict[str, jnp.ndarray] = {}  # [S, B, T_sub]
         static_feed: Dict[str, Argument] = {}
@@ -89,6 +90,8 @@ class RecurrentLayerGroup(LayerImpl):
                 m = dict(m, kind=kind)
             if m["kind"] == "seq":
                 xs[m["boundary"]] = jnp.swapaxes(a.value, 0, 1)
+                if a.mask is not None:
+                    flat_masks[m["boundary"]] = a.mask
                 if mask is None and a.mask is not None:
                     mask = a.mask
             elif m["kind"] == "subseq":
@@ -121,16 +124,33 @@ class RecurrentLayerGroup(LayerImpl):
             # flat sequence input must align to the sub count; the
             # feeder may have padded it longer (pad_multiple bucketing)
             S = next(iter(sub_xs.values())).shape[0]
+            # outer-step liveness (set by the target sub in-link above)
+            # tells whether padded flat steps would feed live outer steps
+            outer_live = mask if (mask is not None
+                                  and mask.shape[1] == S) else None
 
-            def _fit(v):
+            def _fit(k, v):
                 if v.shape[0] > S:
+                    fm = flat_masks.get(k)
+                    if fm is not None:
+                        check_dead(
+                            jnp.sum(fm[:, S:]),
+                            f"recurrent group {cfg.name!r}: flat in-link "
+                            f"{k!r} (len {v.shape[0]}) vs {S} "
+                            "sub-sequences")
                     return v[:S]
                 if v.shape[0] < S:
+                    if outer_live is not None:
+                        check_dead(
+                            jnp.sum(outer_live[:, v.shape[0]:]),
+                            f"recurrent group {cfg.name!r}: flat in-link "
+                            f"{k!r} (len {v.shape[0]}) shorter than the "
+                            f"{S} live sub-sequences")
                     pad = [(0, S - v.shape[0])] + [(0, 0)] * (v.ndim - 1)
                     return jnp.pad(v, pad)
                 return v
 
-            xs = {k: _fit(v) for k, v in xs.items()}
+            xs = {k: _fit(k, v) for k, v in xs.items()}
             if mask is not None and mask.shape[1] != S:
                 mask = (mask[:, :S] if mask.shape[1] > S
                         else jnp.pad(mask,

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from paddle_tpu.core.argument import Argument
+from paddle_tpu.core.argument import Argument, check_dead
 from paddle_tpu.core.registry import (LayerImpl, ParamSpec, ShapeInfo,
                                       register_layer)
 
@@ -159,9 +159,21 @@ class ExpandLayer(LayerImpl):
             if sv.ndim == 3 and sv.shape[1] != S:
                 # feeder bucketing can pad the per-sub source longer
                 # than the nested S; masks carry truth, align by trim/pad
-                sv = (sv[:, :S] if sv.shape[1] > S
-                      else jnp.pad(sv, ((0, 0), (0, S - sv.shape[1]),
-                                        (0, 0))))
+                if sv.shape[1] > S:
+                    if src.mask is not None:
+                        check_dead(
+                            jnp.sum(src.mask[:, S:]),
+                            "expand: per-sub source longer than the "
+                            f"target's {S} sub-sequences")
+                    sv = sv[:, :S]
+                else:
+                    sub_live = (jnp.sum(ref.mask, axis=-1) > 0)
+                    check_dead(
+                        jnp.sum(sub_live[:, sv.shape[1]:]),
+                        f"expand: per-sub source (len {sv.shape[1]}) "
+                        "shorter than the target's live sub-sequences")
+                    sv = jnp.pad(sv, ((0, 0), (0, S - sv.shape[1]),
+                                      (0, 0)))
             v = (sv[:, :, None, :] if sv.ndim == 3
                  else sv[:, None, None, :])
             v = jnp.broadcast_to(v, (B, S, T, sv.shape[-1]))

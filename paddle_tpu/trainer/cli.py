@@ -118,22 +118,13 @@ def _load_v1_config(path: str, config_args: str = ""):
     """v1 config -> the same namespace contract the native path produces
     (cost/optimizer/train_reader/test_reader/feeding/outputs)."""
     from paddle_tpu.compat import parse_config
-    from paddle_tpu.trainer.trainer import Topology
     parsed = parse_config(path, config_args)
 
-    costs = parsed.cost_layers()
     out_names = list(parsed.context.output_layer_names)
-    if costs:
-        # all declared cost layers train jointly (their sum); non-cost
-        # outputs ride along as passive extras
-        extra = [n for n in out_names if n not in costs]
-        cost = Topology(costs, extra_outputs=extra, graph=parsed.model)
-    elif out_names:
-        # inference-only config (e.g. is_predict=1): topology rooted at the
-        # declared outputs; --job=train will fail later, by design
-        cost = Topology(out_names[0], extra_outputs=out_names[1:],
-                        graph=parsed.model)
-    else:
+    try:
+        # --job=train on an inference-only topology fails later, by design
+        cost = parsed.topology()
+    except ValueError:
         raise SystemExit(f"config {path} declares no outputs()")
 
     ns = {

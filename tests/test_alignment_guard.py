@@ -1,0 +1,65 @@
+"""Padded-length alignment shims must distinguish benign feeder padding
+(trimmed/zero-filled positions are masked dead) from genuinely misaligned
+data, which the reference would CHECK-fail on (misaligned
+``sequenceStartPositions``). The guard (`core/argument.py:check_dead`)
+raises at run time through a debug callback, since masks are traced."""
+
+import types
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.argument import Argument, check_dead
+
+
+def test_check_dead_passes_when_tail_is_masked_dead():
+    @jax.jit
+    def f(mask):
+        check_dead(jnp.sum(mask[:, 2:]), "trim")
+        return mask[:, :2]
+
+    out = f(jnp.asarray([[1.0, 1.0, 0.0, 0.0]]))
+    assert out.shape == (1, 2)
+
+
+def test_check_dead_raises_on_live_positions():
+    @jax.jit
+    def f(mask):
+        check_dead(jnp.sum(mask[:, 2:]), "trim")
+        return mask[:, :2]
+
+    with pytest.raises(Exception, match="live|callback"):
+        jax.block_until_ready(f(jnp.ones((1, 4))))
+
+
+def _expand_nested(src_subs, live_subs, total_subs):
+    """Drive ExpandLayer's nested-target branch directly."""
+    from paddle_tpu.core.registry import get_layer_impl
+
+    impl = get_layer_impl("expand")
+    cfg = types.SimpleNamespace(name="ex", attrs={})
+    B, T, D = 1, 2, 3
+    src = Argument(
+        value=jnp.ones((B, src_subs, D)),
+        mask=jnp.ones((B, src_subs)))
+    ref_mask = jnp.zeros((B, total_subs, T)).at[:, :live_subs, :].set(1.0)
+    ref = Argument(value=jnp.zeros((B, total_subs, T, D)), mask=ref_mask)
+
+    @jax.jit
+    def run():
+        return impl.apply(cfg, {}, [src, ref], None)
+
+    return jax.block_until_ready(run().value)
+
+
+def test_expand_pads_dead_subs_silently():
+    # source covers every LIVE sub; extra dead subs are benign padding
+    v = _expand_nested(src_subs=2, live_subs=2, total_subs=4)
+    assert v.shape == (1, 4, 2, 3)
+
+
+def test_expand_raises_when_live_subs_would_get_zeros():
+    with pytest.raises(Exception, match="live|callback"):
+        _expand_nested(src_subs=2, live_subs=3, total_subs=4)

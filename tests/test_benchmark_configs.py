@@ -36,13 +36,7 @@ def test_benchmark_config_parses(name, layers):
 
 
 def _one_step(config, config_args, feed):
-    from paddle_tpu.trainer.trainer import SGD, Topology
-    parsed = parse_config(config, config_args)
-    costs = parsed.cost_layers()
-    topo = Topology(costs, extra_outputs=[
-        n for n in parsed.context.output_layer_names if n not in costs],
-        graph=parsed.model)
-    tr = SGD(cost=topo, update_equation=parsed.optimizer())
+    tr = parse_config(config, config_args).build_trainer()
     tr.params, tr.opt_state, m = tr._train_step(
         tr.params, tr.opt_state, feed, jax.random.PRNGKey(0), 0, None)
     return float(m["cost"])

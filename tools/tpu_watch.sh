@@ -20,7 +20,7 @@ while true; do
     # bench first (the headline artifact), evidence second; a capture
     # that fails mid-wedge must NOT end the watch — re-enter the probe
     # loop so a later working window still produces the artifacts
-    if BENCH_RETRIES=1 timeout 2400 python bench.py >"BENCH_LIVE_${TAG}.json.tmp" 2>>"$LOG" \
+    if BENCH_RETRIES=1 timeout 4500 python bench.py >"BENCH_LIVE_${TAG}.json.tmp" 2>>"$LOG" \
         && grep -q '"value":' "BENCH_LIVE_${TAG}.json.tmp"; then
       mv "BENCH_LIVE_${TAG}.json.tmp" "BENCH_LIVE_${TAG}.json"
       echo "[$(date -u +%H:%M:%S)] bench captured" >>"$LOG"
