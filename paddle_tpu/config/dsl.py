@@ -365,6 +365,10 @@ def _layer_attr(layer_attr: Optional[dict]):
     if layer_attr:
         if "drop_rate" in layer_attr:
             out["drop_rate"] = layer_attr["drop_rate"]
+        if "device" in layer_attr:
+            # per-layer placement (--parallel_nn); consumed by
+            # parallel.mesh.device_attr_rules as a model-axis shard hint
+            out["attrs"] = {"device": layer_attr["device"]}
     return out
 
 

@@ -205,6 +205,39 @@ def effective_rules(param_specs, mesh: Mesh,
     return out
 
 
+def device_attr_rules(graph, param_specs, mesh: Mesh,
+                      rules: Optional[Dict[str, P]] = None) -> Dict[str, P]:
+    """The reference's per-layer ``device`` placement, TPU-native.
+
+    Under ``--parallel_nn`` the reference pins whole layers to devices and
+    runs them on per-device worker threads (``ParallelNeuralNetwork.h:
+    23-62``, per-layer ``device`` attr in the config). Pinning layers to
+    chips is an anti-pattern under SPMD — the XLA-native equivalent of
+    "this layer lives on other devices" is sharding its parameters over
+    the model axis and letting XLA insert the collectives the reference's
+    task queues hand-scheduled. So: every layer whose config carries a
+    nonnegative ``device`` gets its parameters sharded over MODEL_AXIS on
+    their last (output-feature) dim. Explicit user rules win; parameters
+    whose last dim doesn't divide the axis stay replicated (placement is
+    a hint, not a contract)."""
+    out = dict(rules or {})
+    n_model = mesh.shape.get(MODEL_AXIS, 1)
+    if graph is None or n_model <= 1 or not isinstance(param_specs, dict):
+        return out
+    pinned = {name for name, ldef in graph.layers.items()
+              if int(getattr(ldef, "attrs", {}).get("device", -1)) >= 0}
+    if not pinned:
+        return out
+    for pname, spec in param_specs.items():
+        if rule_for(pname, out) != P():
+            continue  # an explicit rule already covers this parameter
+        owner = pname[1:].rsplit(".", 1)[0] if pname.startswith("_") else None
+        shape = getattr(spec, "shape", None)
+        if owner in pinned and shape and shape[-1] % n_model == 0:
+            out[pname] = P(*([None] * (len(shape) - 1) + [MODEL_AXIS]))
+    return out
+
+
 def shard_opt_state(opt_state, mesh: Mesh,
                     rules: Optional[Dict[str, P]] = None):
     """Shard any optimizer-state pytree: entries of per-parameter dicts

@@ -110,9 +110,14 @@ class SGD:
         key = jax.random.PRNGKey(seed)
         self.meta = self.network.param_meta()
         if mesh is not None:
-            # user rules + the sparse-table row-sharding default
+            # user rules + the sparse-table row-sharding default + the
+            # config's per-layer device placement (--parallel_nn) mapped
+            # to model-axis sharding
             shard_rules = mesh_lib.effective_rules(
                 self.network.param_specs, mesh, shard_rules)
+            shard_rules = mesh_lib.device_attr_rules(
+                self.topology.graph, self.network.param_specs, mesh,
+                shard_rules)
         if parameters is not None:
             self.params = (mesh_lib.shard_params(parameters, mesh, shard_rules)
                            if mesh is not None else parameters)

@@ -86,6 +86,40 @@ def test_model_sharded_embedding_trains():
     assert "model" in str(sh.spec), sh
 
 
+def test_device_attr_shards_layer_over_model_axis():
+    """The reference's per-layer `device` placement (`--parallel_nn`,
+    `ParallelNeuralNetwork.h:23-62`) maps to model-axis sharding of that
+    layer's parameters; training matches the unsharded run exactly."""
+    def model():
+        dsl.reset()
+        x = dsl.data(name="x", size=16)
+        lab = dsl.data(name="label", size=4)
+        h = dsl.fc(input=x, size=32, act="relu", name="h",
+                   layer_attr={"device": 1})
+        out = dsl.fc(input=h, size=4, act="softmax", name="out")
+        return dsl.classification_cost(input=out, label=lab)
+
+    data = _data(64)
+    feeder = DataFeeder({"x": dense_vector(16), "label": integer_value(4)})
+
+    def run(mesh):
+        tr = SGD(cost=model(), update_equation=Momentum(
+            learning_rate=0.1, momentum=0.9), mesh=mesh, seed=7)
+        if mesh is not None:
+            # the pinned layer's weight is sharded; the unpinned one isn't
+            assert tr.params["_h.w0"].sharding.spec == P(None, "model")
+            assert tr.params["_out.w0"].sharding.spec == P()
+        tr.train(lambda: iter([data]), feeder=feeder, num_passes=3)
+        return {k: np.asarray(jax.device_get(v))
+                for k, v in tr.params.items()}
+
+    p1 = run(None)
+    p8 = run(create_mesh(n_data=2, n_model=4))
+    for k in p1:
+        np.testing.assert_allclose(p1[k], p8[k], rtol=2e-4, atol=2e-5,
+                                   err_msg=k)
+
+
 def test_dryrun_multichip_entry():
     import __graft_entry__ as ge
     ge.dryrun_multichip(8)

@@ -40,6 +40,39 @@ def test_trainer_test_config_parses(conf):
 
 
 @needs_ref
+def test_parallel_config_device_attrs_shard_over_model_axis():
+    """The reference's --parallel_nn config (`sample_trainer_config_parallel
+    .conf`, per-layer ExtraAttr(device=N)) runs with its placement hints
+    mapped to model-axis sharding: GPU-pinned fc layers shard, the
+    device=-1 (CPU) layer stays replicated, and a sharded train step
+    executes."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.core.argument import Argument
+    from paddle_tpu.parallel import create_mesh
+    from paddle_tpu.parallel.mesh import shard_batch
+
+    parsed = parse_config(str(TESTS / "sample_trainer_config_parallel.conf"))
+    tr = parsed.build_trainer(mesh=create_mesh(n_data=4, n_model=2))
+    specs = {k: v.sharding.spec for k, v in tr.params.items()}
+    assert specs["___fc_layer_1__.w0"] == P(None, "model")
+    assert specs["___fc_layer_0__.w0"] == P()  # device=-1: replicated
+
+    rng = np.random.RandomState(0)
+    feed = shard_batch({
+        "input": Argument(value=jnp.asarray(rng.rand(8, 3), jnp.float32)),
+        "label": Argument(value=jnp.asarray(
+            rng.randint(0, 10, size=8), jnp.int32)),
+    }, tr.mesh)
+    tr.params, tr.opt_state, m = tr._train_step(
+        tr.params, tr.opt_state, feed, jax.random.PRNGKey(0), 0, None)
+    assert np.isfinite(float(m["cost"]))
+
+
+@needs_ref
 def test_chunking_crf_forward_runs():
     """chunking.conf (raw Layer/Input/Evaluator spelling) builds a CRF net
     that runs forward+decoding and exposes the sum evaluator."""
