@@ -114,6 +114,19 @@ def ctx() -> ConfigContext:
     return _CTX
 
 
+def ensure_ctx() -> ConfigContext:
+    """An active context, opening an implicit one if none exists — WITHOUT
+    resetting the dsl graph. The v1 reference keeps its config_parser
+    globals alive permanently, so helper layers compose with the v2
+    graph-object API outside any parse (e.g. ``paddle.v2.op`` arithmetic
+    over v2-built layers); an explicit parse_config/begin_parse still
+    resets everything."""
+    global _CTX
+    if _CTX is None:
+        _CTX = ConfigContext()
+    return _CTX
+
+
 def begin_parse(config_args: Optional[Dict[str, Any]] = None
                 ) -> ConfigContext:
     """Reset all per-parse state and open a fresh context."""

@@ -15,7 +15,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Dict, List, Optional, Sequence, Union
 
-from paddle_tpu.compat.config_parser import ctx
+from paddle_tpu.compat.config_parser import ctx, ensure_ctx
 from paddle_tpu.compat.trainer_config_helpers.activations import (
     BaseActivation, IdentityActivation, LinearActivation, ReluActivation,
     SigmoidActivation, TanhActivation)
@@ -124,7 +124,7 @@ def layer_support(*attrs):
 
 # ------------------------------------------------------------------ helpers
 def _name(name: Optional[str], prefix: str) -> str:
-    return name if name is not None else ctx().auto_name(prefix)
+    return name if name is not None else ensure_ctx().auto_name(prefix)
 
 
 def _act(act, default: type = TanhActivation) -> str:
@@ -142,7 +142,7 @@ def _pattr(attr) -> Optional[ParamAttr]:
         # default_initial_std() etc. set parse-wide defaults that apply
         # wherever a layer gives no explicit attribute (single source:
         # ConfigContext.default_param_attr)
-        return ctx().default_param_attr()
+        return ensure_ctx().default_param_attr()
     if isinstance(attr, ParameterAttribute):
         return attr.to_param_attr()
     if isinstance(attr, ParamAttr):

@@ -46,6 +46,21 @@ def _wrap(fn):
     return wrapped
 
 
+def parse_network(*outputs):
+    """The reference's ``layer.parse_network`` (`v2/layer.py:263`): emit
+    the ``ModelConfig`` proto of the (sub-)network producing ``outputs``.
+    The DSL holds one current graph, so this serializes it whole with the
+    requested layers appended to output_layer_names."""
+    from paddle_tpu.compat.proto_export import model_to_proto
+    from paddle_tpu.config import dsl as _d
+    graph = _d.current_graph()
+    names = [o.name if hasattr(o, "name") else str(o) for o in outputs]
+    for n in names:
+        if n not in graph.output_layer_names:
+            graph.output_layer_names.append(n)
+    return model_to_proto(graph)
+
+
 def data(*, name: str, type, height: int = None, width: int = None):
     """v2 data layer: dims come from the data_type object."""
     channels = None

@@ -1,0 +1,29 @@
+"""v2 op namespace (``python/paddle/v2/op.py``).
+
+The reference registers unary math ops (exp/log/abs/sigmoid/tanh/square/
+relu/sqrt/reciprocal/softmax) lowering to identity-projection mixed
+layers, and installs ``+ - *`` operator overloads on layer outputs
+(slope_intercept for layer+number, identity-projection mix for
+layer+layer, scaling for layer*layer). All of that machinery lives in the
+v1 ``layer_math`` helpers — the v2 module is the same surface re-exposed;
+importing it (the package ``__init__`` does) installs the operators.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu.compat.trainer_config_helpers import layer_math as _math
+
+__all__ = list(_math.__all__) + ["softmax"]
+
+for _name in _math.__all__:
+    globals()[_name] = getattr(_math, _name)
+
+
+def softmax(input, name=None):
+    """v2-only addition over the v1 set (``v2/op.py:44``)."""
+    from paddle_tpu.compat.trainer_config_helpers import activations as _act
+    from paddle_tpu.compat.trainer_config_helpers.layers import (
+        _name as _nm, identity_projection, mixed_layer)
+    return mixed_layer(input=[identity_projection(input=input)],
+                       name=_nm(name, "softmax"),
+                       act=_act.SoftmaxActivation())
