@@ -28,6 +28,7 @@ from paddle_tpu.v2 import dataset  # noqa: F401
 from paddle_tpu.v2 import event  # noqa: F401
 from paddle_tpu.v2 import inference  # noqa: F401
 from paddle_tpu.v2 import layer  # noqa: F401
+from paddle_tpu.v2 import master  # noqa: F401
 from paddle_tpu.v2 import op  # noqa: F401
 from paddle_tpu.v2 import optimizer  # noqa: F401
 from paddle_tpu.v2 import parameters  # noqa: F401

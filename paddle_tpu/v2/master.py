@@ -1,0 +1,64 @@
+"""v2 master-client surface (``python/paddle/v2/master/client.py``).
+
+The reference's v2 reader discovers the Go master through etcd and pulls
+records via a cgo client (``libpaddle_master.so``: ``paddle_set_dataset``
+/ ``paddle_next_record`` / ``paddle_request_save_model``). Here the master
+is ``paddle_tpu.dist.master.MasterService`` (same task-queue protocol:
+GetTask / TaskFinished / TaskFailed / timeout-requeue / save arbitration)
+and etcd discovery is absorbed by the single-controller address — so
+``client`` takes the master's ``(host, port)`` instead of etcd endpoints
+and keeps the reference method surface, return-code conventions included.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from paddle_tpu.data.recordio import read_chunk
+from paddle_tpu.dist.master import MasterClient, master_reader
+
+# next_record error codes (the cgo client's convention: 0 = ok, < 0 =
+# error; end-of-pass is distinguishable so callers can roll the pass)
+OK = 0
+PASS_END = -2
+
+
+class client:
+    """A client to the master server (reference ``client`` class)."""
+
+    def __init__(self, endpoints, timeout_sec: float = 5, buf_size: int = 0,
+                 load_chunk=read_chunk):
+        if isinstance(endpoints, str):
+            host, _, port = endpoints.rpartition(":")
+            endpoints = (host or "127.0.0.1", int(port))
+        self._mc = MasterClient(endpoints)
+        self._pass_reader = master_reader(self._mc, load_chunk)
+        self._gen = None
+
+    def set_dataset(self, paths) -> None:
+        self._mc.set_dataset(list(paths))
+
+    def paddle_start_get_records(self, pass_id: int) -> None:
+        self._gen = self._pass_reader(pass_id)
+
+    def next_record(self):
+        """(record, 0) while the pass has records, (None, PASS_END) after."""
+        if self._gen is None:
+            self.paddle_start_get_records(0)
+        try:
+            return next(self._gen), OK
+        except StopIteration:
+            self._gen = None
+            return None, PASS_END
+
+    def request_save_model(self, trainer_id, block_ms: float) -> int:
+        """1 = approved, 0 = another trainer is saving, -1 = error."""
+        try:
+            ok = self._mc.request_save_model(str(trainer_id),
+                                             block_ms / 1000.0)
+            return 1 if ok else 0
+        except Exception:  # noqa: BLE001 — reference returns -1, not raise
+            return -1
+
+    def release(self) -> None:
+        self._mc.close()

@@ -28,3 +28,26 @@ class creator:
                 for line in f:
                     yield line.rstrip("\n")
         return reader
+
+    @staticmethod
+    def cloud_reader(paths, master_endpoint, timeout_sec=5, buf_size=64):
+        """Fault-tolerant reader over master-dispatched chunks (reference
+        ``creator.cloud_reader``, with the master's address in etcd's
+        discovery role). Each call of the returned reader streams one
+        pass; task timeout/failure handling lives in the master."""
+        from paddle_tpu.v2 import master
+
+        c = master.client(master_endpoint, timeout_sec, buf_size)
+        c.set_dataset(list(paths))
+        state = {"pass": 0}
+
+        def reader():
+            c.paddle_start_get_records(state["pass"])
+            state["pass"] += 1
+            while True:
+                r, e = c.next_record()
+                if e != master.OK:
+                    return
+                yield r
+
+        return reader

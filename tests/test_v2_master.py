@@ -1,0 +1,58 @@
+"""v2 master-client surface over the in-proc master server — the
+reference's `python/paddle/v2/master/client.py` + `creator.cloud_reader`
+path (etcd discovery absorbed by the master address, SURVEY §5.8)."""
+
+import pytest
+
+from paddle_tpu.data.recordio import write_chunk
+from paddle_tpu.dist.master import MasterServer, MasterService
+
+
+@pytest.fixture()
+def served_chunks(tmp_path):
+    paths = []
+    for i in range(3):
+        p = str(tmp_path / f"chunk-{i:03d}")
+        write_chunk(p, [f"rec-{i}-{j}" for j in range(4)])
+        paths.append(p)
+    svc = MasterService(timeout_s=30.0, chunks_per_task=1)
+    server = MasterServer(svc).start()
+    yield server, paths
+    server.stop()
+
+
+def test_v2_client_streams_all_records_then_pass_end(served_chunks):
+    from paddle_tpu.v2 import master
+    server, paths = served_chunks
+    c = master.client("%s:%d" % server.addr)
+    c.set_dataset(paths)
+    c.paddle_start_get_records(0)
+    got = []
+    while True:
+        r, e = c.next_record()
+        if e != master.OK:
+            assert e == master.PASS_END
+            break
+        got.append(r)
+    assert sorted(got) == sorted(f"rec-{i}-{j}"
+                                 for i in range(3) for j in range(4))
+    c.release()
+
+
+def test_v2_client_save_arbitration(served_chunks):
+    from paddle_tpu.v2 import master
+    server, paths = served_chunks
+    c1 = master.client("%s:%d" % server.addr)
+    c2 = master.client("%s:%d" % server.addr)
+    assert c1.request_save_model("t0", 60000) == 1
+    assert c2.request_save_model("t1", 60000) == 0  # other trainer saving
+    c1.release(), c2.release()
+
+
+def test_cloud_reader_round(served_chunks):
+    import paddle_tpu.v2 as paddle
+    server, paths = served_chunks
+    reader = paddle.reader.creator.cloud_reader(
+        paths, "%s:%d" % server.addr)
+    assert len(list(reader())) == 12
+    assert len(list(reader())) == 12  # second call = next pass
