@@ -367,15 +367,31 @@ def main():
             print(f"# attempt {attempt + 1} failed; retrying in {wait}s",
                   file=sys.stderr)
             time.sleep(wait)
-    # total failure: still emit a parseable JSON line, never a bare traceback
-    print(json.dumps({
+    # total failure: still emit a parseable JSON line, never a bare
+    # traceback. If a mid-round live capture exists (tools/tpu_watch.sh
+    # writes BENCH_LIVE_*.json the moment the tunnel answers), attach it —
+    # clearly labeled as NOT measured by this run — so a wedged tunnel at
+    # round end doesn't erase the round's real numbers.
+    fail = {
         "metric": "lstm_imdb_train_ms_per_batch_bs64_h256_seq100",
         "value": None,
         "unit": "ms/batch",
         "vs_baseline": None,
         "error": last_tail,
         "attempts": RETRIES,
-    }))
+    }
+    here = os.path.dirname(os.path.abspath(__file__))
+    live = sorted(
+        f for f in os.listdir(here)
+        if f.startswith("BENCH_LIVE_") and f.endswith(".json"))
+    if live:
+        try:
+            with open(os.path.join(here, live[-1])) as f:
+                fail["live_capture_not_this_run"] = {
+                    "file": live[-1], "data": json.loads(f.read())}
+        except (OSError, json.JSONDecodeError):
+            pass
+    print(json.dumps(fail))
     return 1
 
 

@@ -14,7 +14,7 @@ TAG="${1:-r04}"
 LOG=tpu_watch.log
 echo "[$(date -u +%H:%M:%S)] watcher start" >>"$LOG"
 while true; do
-  if timeout 90 python -c "import jax; x=__import__('jax.numpy',fromlist=['x']).ones((256,256)); print(float((x@x).sum()))" >>"$LOG" 2>&1; then
+  if timeout -k 10 90 python -c "import jax; x=__import__('jax.numpy',fromlist=['x']).ones((256,256)); print(float((x@x).sum()))" >>"$LOG" 2>&1; then
     echo "[$(date -u +%H:%M:%S)] TUNNEL LIVE — capturing" >>"$LOG"
     ok=1
     # bench first (the headline artifact), evidence second; a capture
