@@ -381,14 +381,16 @@ def main():
         "attempts": RETRIES,
     }
     here = os.path.dirname(os.path.abspath(__file__))
-    live = sorted(
-        f for f in os.listdir(here)
-        if f.startswith("BENCH_LIVE_") and f.endswith(".json"))
+    live = [f for f in os.listdir(here)
+            if f.startswith("BENCH_LIVE_") and f.endswith(".json")]
     if live:
+        # newest by mtime, not name — r9 would sort after r10
+        newest = max(live,
+                     key=lambda f: os.path.getmtime(os.path.join(here, f)))
         try:
-            with open(os.path.join(here, live[-1])) as f:
+            with open(os.path.join(here, newest)) as f:
                 fail["live_capture_not_this_run"] = {
-                    "file": live[-1], "data": json.loads(f.read())}
+                    "file": newest, "data": json.loads(f.read())}
         except (OSError, json.JSONDecodeError):
             pass
     print(json.dumps(fail))

@@ -120,11 +120,21 @@ def ensure_ctx() -> ConfigContext:
     globals alive permanently, so helper layers compose with the v2
     graph-object API outside any parse (e.g. ``paddle.v2.op`` arithmetic
     over v2-built layers); an explicit parse_config/begin_parse still
-    resets everything."""
+    resets everything, and ``dsl.reset()`` clears the implicit context
+    (hook below) so auto-name counters never leak across rebuilds."""
     global _CTX
     if _CTX is None:
         _CTX = ConfigContext()
     return _CTX
+
+
+@dsl.on_reset
+def _clear_ctx_on_graph_reset():
+    # keyed to the graph: a fresh graph must mean fresh auto-name counters
+    # and defaults, or layer/param names would depend on process history
+    # (begin_parse resets the graph first, then installs its own context)
+    global _CTX
+    _CTX = None
 
 
 def begin_parse(config_args: Optional[Dict[str, Any]] = None

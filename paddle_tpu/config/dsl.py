@@ -24,6 +24,17 @@ _GRAPH = ModelDef()
 _COUNTERS: Dict[str, itertools.count] = {}
 
 
+# modules holding per-build state keyed to this graph (e.g. the compat
+# layer helpers' implicit ConfigContext) register a hook so reset() clears
+# them too — names/counters must not leak across rebuilds
+_RESET_HOOKS = []
+
+
+def on_reset(fn):
+    _RESET_HOOKS.append(fn)
+    return fn
+
+
 def reset():
     """Start a fresh graph (the reference resets config_parser globals per
     parse_config call)."""
@@ -34,6 +45,8 @@ def reset():
     # a build that raised inside a recurrent_group step must not leave the
     # group context armed for the next build
     _GROUP_CTX = None
+    for fn in _RESET_HOOKS:
+        fn()
 
 
 def current_graph() -> ModelDef:

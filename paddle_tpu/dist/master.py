@@ -376,10 +376,12 @@ class MasterClient:
     """Client with re-dial on connection loss (`go/connection/conn.go`)."""
 
     def __init__(self, addr, *, retries: int = 10, retry_delay: float = 0.2,
-                 trainer_id: Optional[str] = None):
+                 trainer_id: Optional[str] = None,
+                 connect_timeout: float = 30.0):
         self.addr = tuple(addr)
         self.retries = retries
         self.retry_delay = retry_delay
+        self.connect_timeout = connect_timeout
         # identifies this client's task lease so a retried get_task after a
         # dropped response re-serves the same task instead of leaking it
         self.trainer_id = trainer_id or f"trainer-{os.getpid()}-{id(self):x}"
@@ -387,7 +389,7 @@ class MasterClient:
         self._lock = threading.Lock()
 
     def _connect(self):
-        s = socket.create_connection(self.addr, timeout=30.0)
+        s = socket.create_connection(self.addr, timeout=self.connect_timeout)
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._sock = s
 

@@ -132,20 +132,33 @@ class RecurrentLayerGroup(LayerImpl):
             def _fit(k, v):
                 if v.shape[0] > S:
                     fm = flat_masks.get(k)
-                    if fm is not None:
-                        check_dead(
-                            jnp.sum(fm[:, S:]),
-                            f"recurrent group {cfg.name!r}: flat in-link "
-                            f"{k!r} (len {v.shape[0]}) vs {S} "
-                            "sub-sequences")
+                    if fm is None:
+                        # maskless = every position live by definition, so
+                        # any trim drops real data: fail closed, statically
+                        raise ValueError(
+                            f"recurrent group {cfg.name!r}: maskless flat "
+                            f"in-link {k!r} (len {v.shape[0]}) cannot "
+                            f"align to {S} sub-sequences")
+                    check_dead(
+                        jnp.sum(fm[:, S:]),
+                        f"recurrent group {cfg.name!r}: flat in-link "
+                        f"{k!r} (len {v.shape[0]}) vs {S} "
+                        "sub-sequences")
                     return v[:S]
                 if v.shape[0] < S:
-                    if outer_live is not None:
-                        check_dead(
-                            jnp.sum(outer_live[:, v.shape[0]:]),
+                    if outer_live is None:
+                        # no outer mask → the group later defaults it to
+                        # all-ones, so padded steps WOULD be live
+                        raise ValueError(
                             f"recurrent group {cfg.name!r}: flat in-link "
                             f"{k!r} (len {v.shape[0]}) shorter than the "
-                            f"{S} live sub-sequences")
+                            f"{S} sub-sequences with no outer mask to "
+                            "prove the tail dead")
+                    check_dead(
+                        jnp.sum(outer_live[:, v.shape[0]:]),
+                        f"recurrent group {cfg.name!r}: flat in-link "
+                        f"{k!r} (len {v.shape[0]}) shorter than the "
+                        f"{S} live sub-sequences")
                     pad = [(0, S - v.shape[0])] + [(0, 0)] * (v.ndim - 1)
                     return jnp.pad(v, pad)
                 return v

@@ -160,11 +160,16 @@ class ExpandLayer(LayerImpl):
                 # feeder bucketing can pad the per-sub source longer
                 # than the nested S; masks carry truth, align by trim/pad
                 if sv.shape[1] > S:
-                    if src.mask is not None:
-                        check_dead(
-                            jnp.sum(src.mask[:, S:]),
-                            "expand: per-sub source longer than the "
+                    if src.mask is None:
+                        # maskless = all live: a trim would drop real data
+                        raise ValueError(
+                            f"expand: maskless per-sub source (len "
+                            f"{sv.shape[1]}) cannot align to the "
                             f"target's {S} sub-sequences")
+                    check_dead(
+                        jnp.sum(src.mask[:, S:]),
+                        "expand: per-sub source longer than the "
+                        f"target's {S} sub-sequences")
                     sv = sv[:, :S]
                 else:
                     sub_live = (jnp.sum(ref.mask, axis=-1) > 0)

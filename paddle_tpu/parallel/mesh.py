@@ -229,8 +229,9 @@ def device_attr_rules(graph, param_specs, mesh: Mesh,
     if not pinned:
         return out
     for pname, spec in param_specs.items():
-        if rule_for(pname, out) != P():
-            continue  # an explicit rule already covers this parameter
+        if any(pat in pname for pat in out):
+            continue  # an explicit rule names this parameter — it wins,
+            # including an explicit P() asking for replication
         owner = pname[1:].rsplit(".", 1)[0] if pname.startswith("_") else None
         shape = getattr(spec, "shape", None)
         if owner in pinned and shape and shape[-1] % n_model == 0:

@@ -121,11 +121,10 @@ def _load_v1_config(path: str, config_args: str = ""):
     parsed = parse_config(path, config_args)
 
     out_names = list(parsed.context.output_layer_names)
-    try:
-        # --job=train on an inference-only topology fails later, by design
-        cost = parsed.topology()
-    except ValueError:
+    if not parsed.cost_layers() and not out_names:
         raise SystemExit(f"config {path} declares no outputs()")
+    # --job=train on an inference-only topology fails later, by design
+    cost = parsed.topology()
 
     ns = {
         "__file__": os.path.abspath(path),

@@ -56,9 +56,18 @@ def parse_network(*outputs):
     graph = _d.current_graph()
     names = [o.name if hasattr(o, "name") else str(o) for o in outputs]
     for n in names:
-        if n not in graph.output_layer_names:
-            graph.output_layer_names.append(n)
-    return model_to_proto(graph)
+        if n not in graph.layers:
+            raise ValueError(f"parse_network: {n!r} is not a layer of the "
+                             "current graph (stale LayerOutput?)")
+    # serialization is read-only: splice the requested outputs in for the
+    # emit, then restore (repeated parse_network calls must not accumulate)
+    saved = list(graph.output_layer_names)
+    try:
+        graph.output_layer_names.extend(
+            n for n in names if n not in graph.output_layer_names)
+        return model_to_proto(graph)
+    finally:
+        graph.output_layer_names[:] = saved
 
 
 def data(*, name: str, type, height: int = None, width: int = None):

@@ -31,24 +31,40 @@ class client:
         if isinstance(endpoints, str):
             host, _, port = endpoints.rpartition(":")
             endpoints = (host or "127.0.0.1", int(port))
-        self._mc = MasterClient(endpoints)
+        self._mc = MasterClient(endpoints, connect_timeout=timeout_sec)
         self._pass_reader = master_reader(self._mc, load_chunk)
+        self._buf_size = buf_size
         self._gen = None
+        self._pass_ended = False
 
     def set_dataset(self, paths) -> None:
         self._mc.set_dataset(list(paths))
 
     def paddle_start_get_records(self, pass_id: int) -> None:
-        self._gen = self._pass_reader(pass_id)
+        raw = self._pass_reader(pass_id)
+        if self._buf_size > 0:
+            # buf_size>0 = background prefetch, the cgo client's read-ahead
+            # buffer (note: `lambda: raw`, a distinct name — closing over a
+            # rebound variable would hand the worker its own generator)
+            from paddle_tpu.data.reader import buffered
+            self._gen = buffered(lambda: raw, self._buf_size)()
+        else:
+            self._gen = raw
+        self._pass_ended = False
 
     def next_record(self):
-        """(record, 0) while the pass has records, (None, PASS_END) after."""
+        """(record, 0) while the pass has records, (None, PASS_END) after —
+        and on every later call until the caller starts the next pass
+        (restarting pass 0 implicitly would duplicate its records)."""
+        if self._pass_ended:
+            return None, PASS_END
         if self._gen is None:
             self.paddle_start_get_records(0)
         try:
             return next(self._gen), OK
         except StopIteration:
             self._gen = None
+            self._pass_ended = True
             return None, PASS_END
 
     def request_save_model(self, trainer_id, block_ms: float) -> int:

@@ -44,6 +44,10 @@ def test_layer_group_config_trains_on_real_corpus(conf, passes, max_err,
         r"classification_error=([0-9.]+)", out)]
     assert errs, out
     assert all(0.0 <= e <= 1.0 for e in errs)
+    # even smoke entries must not get WORSE while training (catches e.g.
+    # an alignment-shim regression feeding garbage); 0.05 absorbs 2-pass
+    # noise on the tiny corpus without making the bound vacuous
+    assert errs[-1] <= errs[0] + 0.05
     if max_err is not None:
-        assert errs[-1] <= errs[0] <= max_err + 0.2
+        assert errs[0] <= max_err + 0.2
         assert errs[-1] < max_err

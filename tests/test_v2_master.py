@@ -36,6 +36,13 @@ def test_v2_client_streams_all_records_then_pass_end(served_chunks):
         got.append(r)
     assert sorted(got) == sorted(f"rec-{i}-{j}"
                                  for i in range(3) for j in range(4))
+    # PASS_END latches: further calls must NOT silently restart pass 0
+    assert c.next_record() == (None, master.PASS_END)
+    assert c.next_record() == (None, master.PASS_END)
+    # explicitly starting the next pass streams again
+    c.paddle_start_get_records(1)
+    r, e = c.next_record()
+    assert e == master.OK and r.startswith("rec-")
     c.release()
 
 
