@@ -47,21 +47,33 @@ def chain(*readers):
     return reader
 
 
+class ComposeNotAligned(ValueError):
+    """Raised when composed readers yield different numbers of samples
+    (reference ``reader.ComposeNotAligned``)."""
+
+
 def compose(*readers, check_alignment=True):
     """Yield tuples drawing one sample from each reader, flattening
-    tuple-samples like the reference compose."""
+    tuple-samples. ``check_alignment=True`` raises ``ComposeNotAligned``
+    when one reader runs dry before the others; ``False`` silently
+    discards trailing outputs (reference ``decorator.py:compose``)."""
 
     def make_tuple(x):
         return x if isinstance(x, tuple) else (x,)
 
     def reader():
         rs = [r() for r in readers]
-        if check_alignment:
+        if not check_alignment:
             for outputs in zip(*rs):
                 yield sum((make_tuple(o) for o in outputs), ())
         else:
             for outputs in itertools.zip_longest(*rs):
-                yield sum((make_tuple(o) for o in outputs if o is not None), ())
+                if any(o is None for o in outputs):
+                    if all(o is None for o in outputs):
+                        return
+                    raise ComposeNotAligned(
+                        "outputs of composed readers are not aligned")
+                yield sum((make_tuple(o) for o in outputs), ())
     return reader
 
 

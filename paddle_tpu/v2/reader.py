@@ -1,7 +1,8 @@
 """v2 reader decorators (`python/paddle/v2/reader/decorator.py`)."""
 
 from paddle_tpu.data.reader import (  # noqa: F401
-    batch, buffered, chain, compose, firstn, map_readers, mix, shuffle)
+    ComposeNotAligned, batch, buffered, chain, compose, firstn, map_readers,
+    mix, shuffle)
 
 
 class creator:

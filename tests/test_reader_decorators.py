@@ -1,0 +1,106 @@
+"""Port of the reference's reader decorator tests
+(`python/paddle/v2/reader/tests/decorator_test.py`): map_readers,
+buffered (incl. the it-actually-buffers timing check), compose (aligned,
+not-aligned raising, not-aligned discarding), chain, shuffle, firstn, mix.
+"""
+
+import time
+
+import pytest
+
+import paddle_tpu.v2 as paddle
+
+reader = paddle.reader
+
+
+def reader_creator_10(dur=0.0):
+    def r():
+        for i in range(10):
+            if dur:
+                time.sleep(dur)
+            yield i
+    return r
+
+
+def test_map():
+    d = {"h": 0, "i": 1}
+
+    def read():
+        yield "h"
+        yield "i"
+
+    r = reader.map_readers(lambda x: d[x], read)
+    assert list(r()) == [0, 1]
+
+
+def test_buffered_preserves_order():
+    for size in range(1, 20):
+        assert list(reader.buffered(reader_creator_10(), size)()) \
+            == list(range(10))
+
+
+def test_buffered_actually_buffers():
+    b = reader.buffered(reader_creator_10(0.03), 10)
+    last = time.time()
+    for i in b():
+        elapsed = time.time() - last
+        if i == 0:
+            time.sleep(0.3)  # let the worker fill the buffer
+        else:
+            assert elapsed < 0.05, "reads should hit the buffer"
+        last = time.time()
+
+
+def test_compose_aligned():
+    r = reader.compose(reader_creator_10(), reader_creator_10())
+    assert list(r()) == [(i, i) for i in range(10)]
+
+
+def test_compose_not_aligned_raises():
+    r = reader.compose(
+        reader.chain(reader_creator_10(), reader_creator_10()),
+        reader_creator_10())
+    total = 0
+    with pytest.raises(reader.ComposeNotAligned):
+        for _ in r():
+            total += 1
+    assert total == 10  # the aligned prefix is yielded before the raise
+
+
+def test_compose_not_aligned_no_check_discards_tail():
+    r = reader.compose(
+        reader.chain(reader_creator_10(), reader_creator_10()),
+        reader_creator_10(), check_alignment=False)
+    assert len(list(r())) == 10  # not 20: trailing outputs discarded
+
+
+def test_chain():
+    c = reader.chain(reader_creator_10(), reader_creator_10())
+    assert list(c()) == [i % 10 for i in range(20)]
+
+
+def test_shuffle():
+    for size, check_eq in [(0, True), (1, True), (10, False), (100, False)]:
+        got = list(reader.shuffle(reader_creator_10(), size)())
+        assert len(got) == 10
+        if check_eq:
+            assert got == list(range(10))
+        assert sorted(got) == list(range(10))
+
+
+def test_firstn():
+    assert list(reader.firstn(reader_creator_10(), 3)()) == [0, 1, 2]
+    assert len(list(reader.firstn(reader_creator_10(), 100)())) == 10
+
+
+def test_mix_ratios():
+    a = reader_creator_10()
+
+    def b():
+        for i in range(20):
+            yield 100 + i
+
+    got = list(reader.mix([(a, 1), (b, 2)], main=0)())
+    # main reader (a) exhausts after 10; b contributes ~2 per a-sample
+    assert [x for x in got if x < 100] == list(range(10))
+    assert sum(1 for x in got if x >= 100) >= 10
