@@ -83,28 +83,56 @@ def _read_exact(f: IO[bytes], n: int, path: str) -> bytes:
     return blob
 
 
+def _message_blobs(path: str) -> Iterator[bytes]:
+    """Raw varint-framed messages from a shard. Plain files go through the
+    native reader (the C++ IO role of ``ProtoDataProvider.cpp``, buffered
+    stdio instead of a byte-at-a-time Python loop); gzip shards and hosts
+    without a toolchain use the Python framing."""
+    from paddle_tpu import native
+    if not str(path).endswith(".gz") and native.available():
+        import ctypes
+        lib = native.load_library()
+        r = lib.ptr_vmsg_open(str(path).encode())
+        if r:
+            try:
+                n = ctypes.c_int64()
+                while True:
+                    p = lib.ptr_vmsg_next(r, ctypes.byref(n))
+                    if n.value == -1:
+                        return
+                    if n.value < 0 or (n.value > 0 and not p):
+                        raise IOError(
+                            f"{path}: malformed/truncated proto data shard")
+                    yield ctypes.string_at(p, n.value) if n.value else b""
+            finally:
+                lib.ptr_vmsg_close(r)
+            return
+    f = _open(path, "rb")
+    try:
+        while True:
+            n = _read_varint(f)
+            if n is None:
+                return
+            yield _read_exact(f, n, path)
+    finally:
+        f.close()
+
+
 def read_messages(path: str):
     """Yield (DataHeader, iterator-of-DataSample) for one shard file."""
-    f = _open(path, "rb")
-    n = _read_varint(f)
-    if n is None:
-        f.close()
+    blobs = _message_blobs(path)
+    first = next(blobs, None)
+    if first is None:
         raise IOError(f"{path}: empty proto data shard")
     header = DataHeader()
-    header.ParseFromString(_read_exact(f, n, path))
+    header.ParseFromString(first)
     _check_header(header, path)
 
     def samples() -> Iterator[DataSample]:
-        try:
-            while True:
-                n = _read_varint(f)
-                if n is None:
-                    return
-                s = DataSample()
-                s.ParseFromString(_read_exact(f, n, path))
-                yield s
-        finally:
-            f.close()
+        for blob in blobs:
+            s = DataSample()
+            s.ParseFromString(blob)
+            yield s
 
     return header, samples()
 

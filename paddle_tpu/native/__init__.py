@@ -75,6 +75,13 @@ def load_library():
             ctypes.c_int64, ctypes.POINTER(ctypes.c_int64)]
         lib.ptr_pool_destroy.restype = None
         lib.ptr_pool_destroy.argtypes = [ctypes.c_void_p]
+        lib.ptr_vmsg_open.restype = ctypes.c_void_p
+        lib.ptr_vmsg_open.argtypes = [ctypes.c_char_p]
+        lib.ptr_vmsg_next.restype = ctypes.POINTER(ctypes.c_uint8)
+        lib.ptr_vmsg_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64)]
+        lib.ptr_vmsg_close.restype = None
+        lib.ptr_vmsg_close.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 

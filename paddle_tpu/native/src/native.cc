@@ -220,6 +220,59 @@ void ptr_reader_close(void* r_) {
   delete r;
 }
 
+// ------------------------------------------- varint-framed proto shards
+// The reference's ProtoDataProvider reads DataHeader/DataSample shards
+// natively (paddle/gserver/dataproviders/ProtoDataProvider.cpp); this is
+// the framing layer of that role: varint length prefix + message bytes,
+// buffered stdio instead of Python's byte-at-a-time loop. Message
+// PARSING stays in Python (protobuf gencode) — only IO is native.
+
+void* ptr_vmsg_open(const char* path) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  return new Reader{f, {}, ""};
+}
+
+// Next message into the internal buffer. *len_out: >=0 message length,
+// -1 clean EOF (at a message boundary), -2 malformed/truncated shard.
+const uint8_t* ptr_vmsg_next(void* r_, int64_t* len_out) {
+  Reader* r = static_cast<Reader*>(r_);
+  uint64_t value = 0;
+  int shift = 0;
+  int c = fgetc(r->f);
+  if (c == EOF) {
+    *len_out = -1;
+    return nullptr;
+  }
+  while (true) {
+    value |= static_cast<uint64_t>(c & 0x7F) << shift;
+    if (!(c & 0x80)) break;
+    shift += 7;
+    if (shift > 63) {
+      *len_out = -2;  // malformed varint
+      return nullptr;
+    }
+    c = fgetc(r->f);
+    if (c == EOF) {
+      *len_out = -2;  // EOF inside varint
+      return nullptr;
+    }
+  }
+  r->buf.resize(value);
+  if (value > 0 && !read_exact(r->f, r->buf.data(), value)) {
+    *len_out = -2;  // truncated message body
+    return nullptr;
+  }
+  *len_out = static_cast<int64_t>(value);
+  return r->buf.data();
+}
+
+void ptr_vmsg_close(void* r_) {
+  Reader* r = static_cast<Reader*>(r_);
+  fclose(r->f);
+  delete r;
+}
+
 // ------------------------------------------------------------------ pool
 void* ptr_pool_create(const char** paths, int n_paths, int queue_cap,
                       int shuffle, uint64_t seed) {

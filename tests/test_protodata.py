@@ -60,6 +60,33 @@ def test_roundtrip_dense_index(tmp_path):
     assert rows[0][0].shape == (4,)
 
 
+def test_native_and_python_framing_agree(tmp_path, monkeypatch):
+    """The native (C++) varint framing and the Python fallback read the
+    same shard identically — the ProtoDataProvider.cpp IO role."""
+    from paddle_tpu import native
+    from paddle_tpu.data import protodata
+    if not native.available():
+        pytest.skip("needs the native library")
+    h = DataHeader()
+    sd = h.slot_defs.add()
+    sd.type = SlotDef.VECTOR_DENSE
+    sd.dim = 3
+    samples = []
+    for i in range(5):
+        s = DataSample()
+        v = s.vector_slots.add()
+        v.values.extend([float(i), 0.5, -1.0])
+        samples.append(s)
+    path = str(tmp_path / "shard")
+    write_shard(path, h, samples)
+
+    native_blobs = list(protodata._message_blobs(path))
+    monkeypatch.setattr(native, "available", lambda: False)
+    py_blobs = list(protodata._message_blobs(path))
+    assert native_blobs == py_blobs
+    assert len(native_blobs) == 6  # header + 5 samples
+
+
 def test_roundtrip_gzip_and_sparse_sequences(tmp_path):
     """gzip framing + sparse-non-value slots + is_beginning grouping."""
     h = _header((SlotDef.VECTOR_SPARSE_NON_VALUE, 10), (SlotDef.INDEX, 4))
