@@ -48,7 +48,40 @@ def install_paddle_alias():
         if mod is not None:
             sys.modules[f"paddle.trainer_config_helpers.{sub}"] = mod
     sys.modules["paddle.proto"] = root.proto
+
+    # py_paddle: the SWIG training-API surface (api_train.py-style
+    # raw-API programs import this directly). Registered LAZILY — the
+    # shim pulls in jax, and config-parse-only callers of this alias
+    # must not pay (or require) a jax import.
+    for alias, target in [
+            ("py_paddle", "paddle_tpu.compat.py_paddle"),
+            ("py_paddle.swig_paddle", "paddle_tpu.compat.swig_api"),
+            ("py_paddle.dataprovider_converter",
+             "paddle_tpu.compat.py_paddle")]:
+        sys.modules[alias] = _LazyAlias(alias, target)
     return root
+
+
+class _LazyAlias(types.ModuleType):
+    """sys.modules placeholder that swaps in the real module on first
+    attribute access (so `import py_paddle.swig_paddle as api` works
+    without importing jax until the api surface is actually used)."""
+
+    def __init__(self, name, target):
+        super().__init__(name)
+        self.__dict__["_target"] = target
+
+    def __getattr__(self, item):
+        import importlib
+        mod = importlib.import_module(self._target)
+        sys.modules[self.__name__] = mod
+        # `import a.b` binds attribute b on a: keep that working for the
+        # real modules once loaded
+        if self.__name__ == "py_paddle":
+            mod.dataprovider_converter = mod
+            from paddle_tpu.compat import swig_api as _swig
+            mod.swig_paddle = _swig
+        return getattr(mod, item)
 
 
 from paddle_tpu.compat.config_parser import (parse_config,  # noqa: E402,F401

@@ -17,6 +17,7 @@ Python-2 era builtins (``xrange``) so 2017-vintage configs run unmodified.
 
 from __future__ import annotations
 
+import functools
 import dataclasses
 import itertools
 import os
@@ -540,6 +541,17 @@ class ParsedConfig:
         from paddle_tpu.trainer.trainer import SGD
         return SGD(cost=self.topology(),
                    update_equation=self.optimizer(), **sgd_kwargs)
+
+    # reference parse_config returns ONE TrainerConfig proto whose fields
+    # raw-API programs read (and may mutate) before use — cache so
+    # repeated access sees the same message and mutations stick
+    @functools.cached_property
+    def model_config(self):
+        return self.model_proto()
+
+    @functools.cached_property
+    def opt_config(self):
+        return self.trainer_proto().opt_config
 
     def batch_size(self) -> int:
         return int(self.context.settings.get("batch_size") or 1)

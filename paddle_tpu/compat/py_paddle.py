@@ -1,0 +1,46 @@
+"""``py_paddle`` package surface: ``swig_paddle`` + DataProviderConverter.
+
+The reference's ``py_paddle.dataprovider_converter.DataProviderConverter``
+turns PyDataProvider2-shaped python rows into slot-ordered ``Arguments``
+(numpy → Matrix/IVector, one slot per declared input type). Sequence
+types need the offset-vector API the padded engine replaces — feed those
+through ``paddle_tpu.data.DataFeeder`` instead (clear error below).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from paddle_tpu.compat import swig_api as swig_paddle  # noqa: F401
+from paddle_tpu.data.types import DENSE, INDEX, NO_SEQUENCE, InputType
+
+
+class DataProviderConverter:
+    def __init__(self, input_types):
+        self.input_types = list(input_types)
+        for t in self.input_types:
+            if not isinstance(t, InputType):
+                raise TypeError(f"expected an InputType, got {t!r}")
+
+    def __call__(self, batch, argument=None):
+        args = argument or swig_paddle.Arguments.createArguments(
+            len(self.input_types))
+        args.resize(len(self.input_types))
+        for i, t in enumerate(self.input_types):
+            col = [row[i] for row in batch]
+            if t.seq_type != NO_SEQUENCE:
+                raise NotImplementedError(
+                    "sequence slots in DataProviderConverter: use "
+                    "paddle_tpu.data.DataFeeder (padded+masked layout) "
+                    "instead of the offset-vector Arguments API")
+            if t.type == INDEX:
+                args.setSlotIds(i, swig_paddle.IVector.createVectorFromNumpy(
+                    np.asarray(col, np.int32)))
+            elif t.type == DENSE:
+                args.setSlotValue(
+                    i, swig_paddle.Matrix.createDenseFromNumpy(
+                        np.asarray(col, np.float32)))
+            else:
+                raise NotImplementedError(
+                    f"slot type {t.type!r} in DataProviderConverter")
+        return args

@@ -1,0 +1,523 @@
+"""``py_paddle.swig_paddle`` surface (the L7a SWIG training API).
+
+The reference exposes its C++ stack to Python through SWIG
+(``paddle/api/PaddleAPI.h:103-700``, ``Paddle.i``): Matrix/IVector/
+Arguments value types with numpy bridges, ``GradientMachine`` driven by
+``forward``/``forwardBackward``, the ``ParameterUpdater`` batch protocol
+(startPass/startBatch/update/finishBatch/apply/restore/catchUpWith/
+finishPass, ``PaddleAPI.h:576-644``), ``Trainer.create`` +
+``trainOneDataBatch``, and per-batch evaluators. Raw-API programs
+(``v1_api_demo/mnist/api_train.py``, ``v1_api_demo/gan/gan_trainer.py``)
+are written directly against this surface.
+
+Here the engine is native Python, so this module is a thin object layer
+with the same names and calling conventions over the Network/optimizer
+machinery — no binding generator, numpy in, numpy out. Slot order
+follows the proto's ``input_layer_names``/``output_layer_names``, which
+is how the reference's DataProviderConverter lines up arguments.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+# ---------------------------------------------------------------- enums
+# (utils/GlobalConstants.h / PaddleAPI.h enum values)
+PASS_TRAIN = 0
+PASS_TEST = 1
+PASS_GC = 2
+
+CREATE_MODE_NORMAL = 0
+CREATE_MODE_SGD_SPARSE_CPU_TRAINING = 3
+CREATE_MODE_TESTING = 4
+
+PARAMETER_VALUE = 0
+PARAMETER_GRADIENT = 1
+PARAMETER_MOMENTUM = 2
+
+
+def initPaddle(*args):
+    """``swig_paddle.initPaddle(...)``: gflags-style process init. Flags
+    are forwarded to ``paddle.init`` semantics (mesh/logging); unknown
+    flags are accepted and ignored like gflags does for modules that
+    aren't linked in."""
+    kwargs = {}
+    for a in args:
+        a = str(a).lstrip("-")
+        k, _, v = a.partition("=")
+        kwargs[k] = v
+    from paddle_tpu.v2 import init as _init
+    known = {}
+    for k in ("use_gpu", "trainer_count", "seed", "log_period", "dot_period",
+              "save_dir"):
+        if k in kwargs:
+            known[k] = kwargs[k]
+    try:
+        _init(**known)
+    except TypeError:
+        _init()
+
+
+# ---------------------------------------------------------- value types
+class Matrix:
+    """Dense 2-D float matrix (``PaddleAPI.h:103`` role)."""
+
+    def __init__(self, arr):
+        self._a = np.atleast_2d(np.asarray(arr, np.float32))
+
+    @staticmethod
+    def createDenseFromNumpy(arr, copy=True):
+        return Matrix(np.array(arr, np.float32, copy=copy))
+
+    @staticmethod
+    def createDense(data, height, width):
+        return Matrix(np.asarray(data, np.float32).reshape(height, width))
+
+    @staticmethod
+    def createZero(height, width):
+        return Matrix(np.zeros((height, width), np.float32))
+
+    def copyToNumpyMat(self):
+        return np.array(self._a)
+
+    def copyFromNumpyMat(self, arr):
+        self._a = np.atleast_2d(np.asarray(arr, np.float32))
+
+    def getHeight(self):
+        return self._a.shape[0]
+
+    def getWidth(self):
+        return self._a.shape[1]
+
+    def getData(self):
+        return self._a.reshape(-1).tolist()
+
+
+class IVector:
+    """Int vector (ids / labels)."""
+
+    def __init__(self, arr):
+        self._a = np.asarray(arr, np.int32).reshape(-1)
+
+    @staticmethod
+    def createVectorFromNumpy(arr, copy=True):
+        return IVector(np.array(arr, np.int32, copy=copy))
+
+    @staticmethod
+    def create(data):
+        return IVector(np.asarray(data, np.int32))
+
+    def copyToNumpyArray(self):
+        return np.array(self._a)
+
+    def getSize(self):
+        return int(self._a.shape[0])
+
+    def getData(self):
+        return self._a.tolist()
+
+
+class Vector:
+    """Float vector (parameter buffers use this shape)."""
+
+    def __init__(self, arr):
+        self._a = np.asarray(arr, np.float32).reshape(-1)
+
+    @staticmethod
+    def createVectorFromNumpy(arr, copy=True):
+        return Vector(np.array(arr, np.float32, copy=copy))
+
+    def copyToNumpyArray(self):
+        return np.array(self._a)
+
+    def getSize(self):
+        return int(self._a.shape[0])
+
+
+class Arguments:
+    """Slot-indexed network inputs/outputs (``api/Arguments.cpp`` role).
+    Slot i of inputs lines up with ``input_layer_names[i]``; outputs with
+    ``output_layer_names[i]`` — the DataProviderConverter contract."""
+
+    def __init__(self, n: int):
+        self._slots: List[Dict[str, Any]] = [dict() for _ in range(n)]
+
+    @staticmethod
+    def createArguments(n: int) -> "Arguments":
+        return Arguments(n)
+
+    def resize(self, n: int):
+        self._slots = [dict() for _ in range(n)]
+
+    def size(self) -> int:
+        return len(self._slots)
+
+    def getSlotNum(self) -> int:
+        return len(self._slots)
+
+    def _slot(self, i) -> Dict[str, Any]:
+        while i >= len(self._slots):
+            self._slots.append(dict())
+        return self._slots[i]
+
+    def setSlotValue(self, i, m: Matrix):
+        self._slot(i)["value"] = m
+
+    def setSlotIds(self, i, ids: IVector):
+        self._slot(i)["ids"] = ids
+
+    def getSlotValue(self, i) -> Matrix:
+        return self._slots[i]["value"]
+
+    def getSlotIds(self, i) -> IVector:
+        return self._slots[i]["ids"]
+
+
+# ------------------------------------------------------------ parameters
+class _ParameterBuffer:
+    """A typed view of one parameter's buffer, flat like the reference's
+    ``Vector`` handles (shape restored on write-back)."""
+
+    def __init__(self, machine: "GradientMachine", name: str, kind: int):
+        self._m, self._name, self._kind = machine, name, kind
+
+    def _array(self):
+        if self._kind == PARAMETER_VALUE:
+            return np.asarray(jax.device_get(self._m._params[self._name]))
+        if self._kind == PARAMETER_GRADIENT:
+            g = self._m._grads.get(self._name)
+            return (np.asarray(jax.device_get(g)) if g is not None
+                    else np.zeros(self._shape(), np.float32))
+        slots = self._m._opt_state["slots"].get(self._name, {}) \
+            if self._m._opt_state else {}
+        mom = slots.get("mom")
+        return (np.asarray(jax.device_get(mom)) if mom is not None
+                else np.zeros(self._shape(), np.float32))
+
+    def _shape(self):
+        return np.asarray(
+            jax.device_get(self._m._params[self._name])).shape
+
+    def getSize(self) -> int:
+        return int(np.prod(self._shape()))
+
+    def copyToNumpyArray(self):
+        return self._array().reshape(-1).copy()
+
+    def copyFromNumpyArray(self, arr):
+        if self._kind != PARAMETER_VALUE:
+            raise ValueError("only PARAMETER_VALUE buffers are writable "
+                             "through the api surface")
+        shape = self._shape()
+        self._m._params[self._name] = jnp.asarray(
+            np.asarray(arr, np.float32).reshape(shape))
+
+
+class Parameter:
+    def __init__(self, machine: "GradientMachine", name: str):
+        self._m, self._name = machine, name
+
+    def getName(self) -> str:
+        return self._name
+
+    def getSize(self) -> int:
+        return int(np.prod(np.asarray(
+            jax.device_get(self._m._params[self._name])).shape))
+
+    def getBuf(self, kind=PARAMETER_VALUE) -> _ParameterBuffer:
+        return _ParameterBuffer(self._m, self._name, kind)
+
+
+# ------------------------------------------------------------- evaluator
+class Evaluator:
+    """Per-batch metric accumulator (``Evaluator`` via
+    ``GradientMachine::makeEvaluator``). Accumulates between start() and
+    finish(); prints the reference's ``name=value`` form."""
+
+    def __init__(self, machine: "GradientMachine"):
+        self._m = machine
+        self._err = 0.0
+        self._cnt = 0.0
+
+    def start(self):
+        self._err, self._cnt = 0.0, 0.0
+
+    def finish(self):
+        pass
+
+    def accumulate(self, err: float, cnt: float):
+        self._err += err
+        self._cnt += cnt
+
+    def getError(self) -> float:
+        return self._err / max(self._cnt, 1.0)
+
+    def __str__(self):
+        if self._cnt == 0:
+            return " classification_error_evaluator=nan "
+        return f" classification_error_evaluator={self.getError():.6g} "
+
+
+# ------------------------------------------------------- gradient machine
+class GradientMachine:
+    """``GradientMachine::create`` over a ``ModelConfig`` proto
+    (``PaddleAPI.h:700`` region; createFromConfigProto at
+    ``api/GradientMachine.cpp``). Imports the proto through
+    ``compat.proto_import`` — the same path that executes wire-format
+    configs — and drives the jitted Network."""
+
+    def __init__(self, graph, seed: int = 0):
+        from paddle_tpu.core.network import Network
+        self._graph = graph
+        outs = list(graph.output_layer_names) or list(graph.layers)
+        self._network = Network(graph, outputs=outs)
+        self._params = self._network.init_params(jax.random.PRNGKey(seed))
+        self._meta = self._network.param_meta()
+        self._grads: Dict[str, jnp.ndarray] = {}
+        self._opt_state: Optional[Dict[str, Any]] = None
+        self._last_outputs: Optional[Dict[str, Any]] = None
+        self._last_feed: Optional[Dict[str, Any]] = None
+        self._rng = jax.random.PRNGKey(seed + 17)
+        self._fwd = jax.jit(
+            lambda p, f, r: self._network.apply(p, f, train=True, rng=r))
+        self._fwd_test = jax.jit(
+            lambda p, f: self._network.apply(p, f, train=False))
+
+        def loss_fn(p, f, r):
+            # apply_with_state: batch-norm moving statistics update during
+            # training exactly as in the SGD trainer's step
+            outputs, updates = self._network.apply_with_state(
+                p, f, train=True, rng=r)
+            total = 0.0
+            for n in self._cost_layers():
+                v = outputs[n].value.astype(jnp.float32)
+                total = total + jnp.sum(v) / v.shape[0]
+            return total, (outputs, updates)
+
+        self._grad_fn = jax.jit(jax.value_and_grad(loss_fn, has_aux=True))
+
+    # -- construction ---------------------------------------------------
+    @staticmethod
+    def createFromConfigProto(model_config, mode=CREATE_MODE_NORMAL,
+                              enable_types=None):
+        from paddle_tpu.compat.proto_import import model_from_proto
+        if hasattr(model_config, "layers") and not hasattr(
+                model_config, "SerializeToString"):
+            graph = model_config  # already a ModelDef
+        else:
+            graph = model_from_proto(model_config)
+        return GradientMachine(graph)
+
+    def _cost_layers(self) -> List[str]:
+        from paddle_tpu.compat.config_parser import COST_TYPES
+        names = [n for n in self._graph.output_layer_names
+                 if self._graph.layers[n].type in COST_TYPES]
+        if not names:
+            names = [n for n, l in self._graph.layers.items()
+                     if l.type in COST_TYPES]
+        return names
+
+    # -- feed/slot mapping ----------------------------------------------
+    def _input_names(self) -> List[str]:
+        names = list(self._graph.input_layer_names)
+        if not names:
+            names = [n for n, l in self._graph.layers.items()
+                     if l.type == "data"]
+        return names
+
+    def _feed_from(self, args: Arguments) -> Dict[str, Any]:
+        from paddle_tpu.core.argument import Argument
+        names = self._input_names()
+        feed = {}
+        for i, name in enumerate(names[: args.size()]):
+            slot = args._slots[i]
+            if "ids" in slot:
+                feed[name] = Argument(value=jnp.asarray(
+                    slot["ids"]._a, jnp.int32))
+            elif "value" in slot:
+                feed[name] = Argument(value=jnp.asarray(
+                    slot["value"]._a, jnp.float32))
+        return feed
+
+    def _fill_out(self, outputs, outArgs: Arguments):
+        names = [n for n in self._graph.output_layer_names] or \
+            list(outputs)
+        outArgs.resize(len(names))
+        for i, n in enumerate(names):
+            v = np.asarray(jax.device_get(outputs[n].value))
+            if v.ndim == 1:
+                v = v[:, None]
+            outArgs.setSlotValue(i, Matrix(v))
+
+    # -- the SWIG protocol ----------------------------------------------
+    def start(self):
+        pass
+
+    def finish(self):
+        pass
+
+    def getParameters(self) -> List[Parameter]:
+        return [Parameter(self, n) for n in self._params]
+
+    def getParameter(self, name: str) -> Parameter:
+        if name not in self._params:
+            raise KeyError(name)
+        return Parameter(self, name)
+
+    def randParameters(self):
+        self._params = self._network.init_params(
+            jax.random.PRNGKey(int(np.random.randint(0, 2**31 - 1))))
+
+    def forward(self, inArgs: Arguments, outArgs: Arguments, passType):
+        feed = self._feed_from(inArgs)
+        if passType == PASS_TRAIN:
+            self._rng, r = jax.random.split(self._rng)
+            outputs = self._fwd(self._params, feed, r)
+        else:
+            outputs = self._fwd_test(self._params, feed)
+        self._last_outputs, self._last_feed = outputs, feed
+        self._fill_out(outputs, outArgs)
+
+    def forwardBackward(self, inArgs: Arguments, outArgs: Arguments,
+                        passType):
+        feed = self._feed_from(inArgs)
+        self._rng, r = jax.random.split(self._rng)
+        (cost, (outputs, updates)), grads = self._grad_fn(
+            self._params, feed, r)
+        self._grads = grads
+        self._state_updates = dict(updates)
+        self._last_outputs, self._last_feed = outputs, feed
+        self._fill_out(outputs, outArgs)
+
+    def makeEvaluator(self) -> Evaluator:
+        return Evaluator(self)
+
+    def eval(self, evaluator: Evaluator):
+        """Accumulate classification error of the last forward into the
+        evaluator (``Evaluator.cpp:35`` ClassificationErrorEvaluator)."""
+        from paddle_tpu.trainer.evaluators import classification_error
+        if self._last_outputs is None:
+            return
+        for n in self._cost_layers():
+            cdef = self._graph.layers[n]
+            if cdef.type != "multi-class-cross-entropy":
+                continue
+            out_l, lab_l = cdef.input_names()[0], cdef.input_names()[1]
+            outs = self._last_outputs
+            lab = outs.get(lab_l) or self._last_feed.get(lab_l)
+            if lab is None:
+                continue
+            err, cnt = classification_error(outs[out_l], lab)
+            evaluator.accumulate(float(err), float(cnt))
+
+
+# ------------------------------------------------------ parameter updater
+class ParameterUpdater:
+    """The local updater protocol (``PaddleAPI.h:576-644``,
+    ``TrainerInternal.cpp:66-131`` batch lifecycle) over a paddle_tpu
+    optimizer: startPass → N×(startBatch → [update per param] →
+    finishBatch) → [apply/restore for model-average test] → finishPass."""
+
+    def __init__(self, optimizer):
+        self._opt = optimizer
+        self._m: Optional[GradientMachine] = None
+        self._bsz = 1
+        self._pass = 0
+        self._backup: Optional[Dict[str, jnp.ndarray]] = None
+
+    @staticmethod
+    def createLocalUpdater(optimizer):
+        return ParameterUpdater(optimizer)
+
+    def init(self, machine: GradientMachine):
+        self._m = machine
+        machine._opt_state = self._opt.init(machine._params, machine._meta)
+
+    def startPass(self):
+        pass
+
+    def startBatch(self, batch_size: int) -> int:
+        self._bsz = batch_size
+        return PASS_TRAIN
+
+    def update(self, parameter: Parameter):
+        # per-parameter pipelined update in the reference; here the whole
+        # dict steps once in finishBatch (same observable result)
+        pass
+
+    def finishBatch(self, cost: float = 0.0):
+        m = self._m
+        if m._grads:
+            m._params, m._opt_state = self._opt.update(
+                m._grads, m._opt_state, m._params, m._meta,
+                batch_size=self._bsz, num_passes=self._pass)
+            m._grads = {}
+        if getattr(m, "_state_updates", None):
+            m._params.update(m._state_updates)  # batch-norm moving stats
+            m._state_updates = {}
+
+    def apply(self):
+        """Swap in the model-averaged parameters (AverageOptimizer's
+        test-time apply); no-op without an average window."""
+        m = self._m
+        if m._opt_state and "avg" in m._opt_state and self._backup is None:
+            self._backup = dict(m._params)
+            m._params = self._opt.averaged_params(m._opt_state, m._params)
+
+    def restore(self):
+        if self._backup is not None:
+            self._m._params = self._backup
+            self._backup = None
+
+    def catchUpWith(self):
+        # dense parameters are always current here; the sparse lazy-row
+        # catch-up lives inside the optimizer's sparse path
+        pass
+
+    def finishPass(self):
+        self._pass += 1
+
+
+# ---------------------------------------------------------------- trainer
+class Trainer:
+    """``api.Trainer.create(config, machine)`` + the train-by-batch calls
+    the GAN demo drives (``Trainer.cpp:402`` trainOneDataBatch)."""
+
+    def __init__(self, machine: GradientMachine, updater: ParameterUpdater):
+        self._machine = machine
+        self._updater = updater
+        self._outArgs = Arguments.createArguments(0)
+
+    @staticmethod
+    def create(config, machine: GradientMachine) -> "Trainer":
+        opt = config.optimizer() if hasattr(config, "optimizer") else config
+        updater = ParameterUpdater(opt)
+        updater.init(machine)
+        return Trainer(machine, updater)
+
+    def startTrain(self):
+        self._machine.start()
+
+    def finishTrain(self):
+        self._machine.finish()
+
+    def startTrainPass(self):
+        self._updater.startPass()
+
+    def finishTrainPass(self):
+        self._updater.finishPass()
+
+    def trainOneDataBatch(self, batch_size: int, args: Arguments) -> float:
+        pt = self._updater.startBatch(batch_size)
+        self._machine.forwardBackward(args, self._outArgs, pt)
+        for p in self._machine.getParameters():
+            self._updater.update(p)
+        cost = self._outArgs.getSlotValue(0).copyToNumpyMat()
+        cost = float(cost.sum() / batch_size)
+        self._updater.finishBatch(cost)
+        return cost

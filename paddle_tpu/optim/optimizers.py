@@ -56,6 +56,21 @@ class Optimizer:
         raise NotImplementedError
 
     # -- public ---------------------------------------------------------
+    def create_local_updater(self):
+        """The v2-on-SWIG idiom (``optimizer.py:45-56`` →
+        ``api.ParameterUpdater::createLocalUpdater``): an updater driving
+        this optimizer through the startBatch/update/finishBatch
+        protocol."""
+        from paddle_tpu.compat.swig_api import ParameterUpdater
+        return ParameterUpdater(self)
+
+    def enable_types(self):
+        """Parameter buffer types this optimizer maintains
+        (``ParameterOptimizer::getParameterTypes``: always VALUE and
+        GRADIENT, plus one slot type per optimizer state buffer); the api
+        surface passes this to createFromConfigProto."""
+        return [0, 1] + [i + 2 for i, _ in enumerate(self.slot_names())]
+
     def _is_sparse(self, spec) -> bool:
         return (spec is not None and getattr(spec, "sparse_grad", False)
                 and hasattr(self, "_apply_sparse"))

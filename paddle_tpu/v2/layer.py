@@ -76,9 +76,13 @@ def data(*, name: str, type, height: int = None, width: int = None):
     if height and width and type.dim % (height * width) == 0:
         channels = type.dim // (height * width)
     from paddle_tpu.data.types import SEQUENCE
-    return _dsl.data(name=name, size=type.dim, height=height, width=width,
-                     channels=channels,
-                     is_sequence=type.seq_type >= SEQUENCE)
+    out = _dsl.data(name=name, size=type.dim, height=height, width=width,
+                    channels=channels,
+                    is_sequence=type.seq_type >= SEQUENCE)
+    # the reference's v2 data layer carries its data_type for
+    # DataProviderConverter(input_types=[images.type, ...])
+    object.__setattr__(out, "type", type)  # LayerOutput is frozen
+    return out
 
 
 def pooling(input, *, pooling_type=None, **kwargs):

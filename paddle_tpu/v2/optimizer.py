@@ -9,9 +9,51 @@ convention). Reference v1 jobs apply the rate to batch-SUMMED gradients
 reproduce that exactly — the compat config path sets it automatically.
 """
 
-from paddle_tpu.optim.optimizers import (  # noqa: F401
-    AdaDelta, AdaGrad, Adam, Adamax, DecayedAdaGrad, Momentum, Optimizer,
-    RMSProp)
+from paddle_tpu.optim import optimizers as _opt
+from paddle_tpu.optim.optimizers import Optimizer  # noqa: F401
+
+
+def _translate(kwargs):
+    """v2 constructor kwargs (`python/paddle/v2/optimizer.py`): accept
+    regularization / model_average / gradient_clipping objects and the
+    remote-updater batch_size, mapping them onto the optimizer fields."""
+    out = dict(kwargs)
+    out.pop("batch_size", None)  # remote sparse-updater knob; no pserver
+    reg = out.pop("regularization", None)
+    if reg is not None:
+        extra = reg.extra_settings() if hasattr(reg, "extra_settings") \
+            else {}
+        if "l2weight" in extra:
+            out["l2_rate"] = extra["l2weight"]
+        if "l1weight" in extra:
+            out["l1_rate"] = extra["l1weight"]
+    ma = out.pop("model_average", None)
+    if ma is not None:
+        out["average_window"] = getattr(ma, "average_window", 0.0)
+    clip = out.pop("gradient_clipping_threshold", None)
+    if clip is not None:
+        out["gradient_clipping_threshold"] = getattr(
+            clip, "threshold", clip)
+    return out
+
+
+def _v2(cls):
+    """A real subclass (not a factory): isinstance/subclassing keep
+    working as they do against the reference's optimizer classes."""
+    sub = type(cls.__name__, (cls,), {
+        "__init__": lambda self, **kw: cls.__init__(self, **_translate(kw)),
+        "__doc__": cls.__doc__,
+    })
+    return sub
+
+
+Adam = _v2(_opt.Adam)
+Momentum = _v2(_opt.Momentum)
+AdaGrad = _v2(_opt.AdaGrad)
+AdaDelta = _v2(_opt.AdaDelta)
+Adamax = _v2(_opt.Adamax)
+DecayedAdaGrad = _v2(_opt.DecayedAdaGrad)
+RMSProp = _v2(_opt.RMSProp)
 
 # v2 capitalization variants
 Adagrad = AdaGrad

@@ -1,0 +1,218 @@
+"""The py_paddle.swig_paddle surface (L7a): the reference's raw-API
+programs' exact call sequences run against the shim.
+
+- `v1_api_demo/mnist/api_train.py`: init → optimizer.create_local_updater
+  → v2 layers → parse_network → GradientMachine.createFromConfigProto →
+  updater protocol → forwardBackward → evaluator → apply/restore →
+  parameter numpy round-trips. (Its MNIST idx files need network; the
+  flow runs on a learnable synthetic problem, every API call identical.)
+- `v1_api_demo/gan/gan_trainer.py`: the GAN demo against the reference's
+  OWN `gan_conf.py` (unmodified, data=uniform — the demo's offline
+  source): three gradient machines from parse_config protos, shared-
+  parameter copying, Trainer.create + trainOneDataBatch alternation.
+"""
+
+import pathlib
+
+import numpy as np
+import pytest
+
+GAN_DIR = pathlib.Path("/root/reference/v1_api_demo/gan")
+needs_ref = pytest.mark.skipif(not GAN_DIR.exists(), reason="needs reference")
+
+
+@pytest.fixture()
+def api():
+    import paddle_tpu.compat as compat
+    compat.install_paddle_alias()
+    from paddle_tpu.config import dsl
+    dsl.reset()
+    import py_paddle.swig_paddle as api
+    return api
+
+
+def test_api_train_flow(api):
+    """api_train.py's full call sequence, converging on synthetic data."""
+    from py_paddle import DataProviderConverter
+    import paddle_tpu.v2 as paddle_v2
+    from paddle_tpu.compat.trainer_config_helpers.optimizers import (
+        L2Regularization, ModelAverage)
+
+    api.initPaddle("-use_gpu=false", "-trainer_count=4")
+    optimizer = paddle_v2.optimizer.Adam(
+        learning_rate=1e-3,
+        batch_size=64,
+        model_average=ModelAverage(average_window=0.5),
+        regularization=L2Regularization(rate=0.5e-4))
+    updater = optimizer.create_local_updater()
+    assert isinstance(updater, api.ParameterUpdater)
+
+    images = paddle_v2.layer.data(
+        name="pixel", type=paddle_v2.data_type.dense_vector(64))
+    label = paddle_v2.layer.data(
+        name="label", type=paddle_v2.data_type.integer_value(10))
+    hidden1 = paddle_v2.layer.fc(input=images, size=64)
+    inference = paddle_v2.layer.fc(
+        input=hidden1, size=10, act=paddle_v2.activation.Softmax())
+    cost = paddle_v2.layer.classification_cost(input=inference, label=label)
+
+    model_config = paddle_v2.layer.parse_network(cost)
+    m = api.GradientMachine.createFromConfigProto(
+        model_config, api.CREATE_MODE_NORMAL, optimizer.enable_types())
+    assert isinstance(m, api.GradientMachine)
+
+    # init_parameter(): numpy-writes every parameter buffer
+    for each_param in m.getParameters():
+        assert isinstance(each_param, api.Parameter)
+        buf = each_param.getBuf(api.PARAMETER_VALUE)
+        arr = np.random.RandomState(0).uniform(
+            -0.08, 0.08, buf.getSize()).astype("float32")
+        buf.copyFromNumpyArray(arr)
+        np.testing.assert_allclose(buf.copyToNumpyArray(), arr, rtol=1e-6)
+
+    updater.init(m)
+    converter = DataProviderConverter(input_types=[images.type, label.type])
+    m.start()
+    batch_evaluator = m.makeEvaluator()
+    outArgs = api.Arguments.createArguments(0)
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(256, 64).astype(np.float32)
+    Y = np.argmax(X @ rng.randn(64, 10), axis=1)
+    errs = []
+    for pass_id in range(6):
+        updater.startPass()
+        batch_evaluator.start()
+        for b in range(0, 256, 64):
+            data_batch = [(X[j], int(Y[j])) for j in range(b, b + 64)]
+            pass_type = updater.startBatch(len(data_batch))
+            m.forwardBackward(converter(data_batch), outArgs, pass_type)
+            for each_param in m.getParameters():
+                updater.update(each_param)
+            cost_v = outArgs.getSlotValue(0).copyToNumpyMat()
+            cost_v = cost_v.sum() / len(data_batch)
+            m.eval(batch_evaluator)
+            updater.finishBatch(cost_v)
+        batch_evaluator.finish()
+        errs.append(batch_evaluator.getError())
+        # test stage with averaged parameters
+        updater.apply()
+        test_evaluator = m.makeEvaluator()
+        test_evaluator.start()
+        m.forward(converter([(X[j], int(Y[j])) for j in range(64)]),
+                  outArgs, api.PASS_TEST)
+        m.eval(test_evaluator)
+        test_evaluator.finish()
+        assert "classification_error_evaluator=" in str(test_evaluator)
+        updater.restore()
+        updater.catchUpWith()
+        updater.finishPass()
+    m.finish()
+    assert errs[-1] < errs[0]  # it learns
+
+
+@needs_ref
+def test_gan_demo_flow(api):
+    """gan_trainer.py against the reference's own gan_conf.py (uniform
+    mode): three machines, shared-parameter sync, trainer alternation."""
+    from paddle.trainer.config_parser import parse_config
+
+    def conf(mode):
+        return parse_config(str(GAN_DIR / "gan_conf.py"),
+                            f"mode={mode},data=uniform")
+
+    gen_conf = conf("generator_training")
+    dis_conf = conf("discriminator_training")
+    generator_conf = conf("generator")
+    batch_size = int(gen_conf.opt_config.batch_size)
+    assert batch_size == 128
+
+    def layer_size(model_conf, name):
+        lc = [l for l in model_conf.layers if l.name == name]
+        assert lc, name
+        return lc[0].size
+
+    noise_dim = layer_size(gen_conf.model_config, "noise")
+
+    dis_machine = api.GradientMachine.createFromConfigProto(
+        dis_conf.model_config)
+    gen_machine = api.GradientMachine.createFromConfigProto(
+        gen_conf.model_config)
+    generator_machine = api.GradientMachine.createFromConfigProto(
+        generator_conf.model_config)
+
+    def copy_shared_parameters(src, dst):
+        src_params = {p.getName(): p for p in src.getParameters()}
+        for dst_p in dst.getParameters():
+            src_p = src_params.get(dst_p.getName())
+            if src_p is None:
+                continue
+            dst_p.getBuf(api.PARAMETER_VALUE).copyFromNumpyArray(
+                src_p.getBuf(api.PARAMETER_VALUE).copyToNumpyArray())
+
+    copy_shared_parameters(gen_machine, dis_machine)
+    copy_shared_parameters(gen_machine, generator_machine)
+
+    dis_trainer = api.Trainer.create(dis_conf, dis_machine)
+    gen_trainer = api.Trainer.create(gen_conf, gen_machine)
+    dis_trainer.startTrain()
+    gen_trainer.startTrain()
+
+    rng = np.random.RandomState(7)
+    data_np = rng.rand(4096, 2).astype("float32")
+
+    def get_noise():
+        return rng.normal(size=(batch_size, noise_dim)).astype("float32")
+
+    def get_fake_samples(noise):
+        gi = api.Arguments.createArguments(1)
+        gi.setSlotValue(0, api.Matrix.createDenseFromNumpy(noise))
+        go = api.Arguments.createArguments(0)
+        generator_machine.forward(gi, go, api.PASS_TEST)
+        return go.getSlotValue(0).copyToNumpyMat()
+
+    def dis_batch(samples, lab):
+        inputs = api.Arguments.createArguments(2)
+        inputs.setSlotValue(0, api.Matrix.createDenseFromNumpy(samples))
+        inputs.setSlotIds(1, api.IVector.createVectorFromNumpy(
+            np.full(batch_size, lab, dtype="int32")))
+        return inputs
+
+    def gen_batch(noise):
+        inputs = api.Arguments.createArguments(2)
+        inputs.setSlotValue(0, api.Matrix.createDenseFromNumpy(noise))
+        inputs.setSlotIds(1, api.IVector.createVectorFromNumpy(
+            np.ones(batch_size, dtype="int32")))
+        return inputs
+
+    def training_loss(machine, inputs):
+        outputs = api.Arguments.createArguments(0)
+        machine.forward(inputs, outputs, api.PASS_TEST)
+        return float(np.mean(outputs.getSlotValue(0).copyToNumpyMat()))
+
+    dis_trainer.startTrainPass()
+    gen_trainer.startTrainPass()
+    losses = []
+    for i in range(8):
+        noise = get_noise()
+        real = data_np[rng.choice(len(data_np), batch_size, replace=False)]
+        pos = dis_batch(real, 1)
+        neg = dis_batch(get_fake_samples(noise), 0)
+        d_loss = (training_loss(dis_machine, pos)
+                  + training_loss(dis_machine, neg)) / 2.0
+        g_loss = training_loss(gen_machine, gen_batch(noise))
+        assert np.isfinite(d_loss) and np.isfinite(g_loss)
+        losses.append((d_loss, g_loss))
+        if d_loss > g_loss:
+            dis_trainer.trainOneDataBatch(batch_size, neg)
+            dis_trainer.trainOneDataBatch(batch_size, pos)
+            copy_shared_parameters(dis_machine, gen_machine)
+        else:
+            gen_trainer.trainOneDataBatch(batch_size, gen_batch(noise))
+            copy_shared_parameters(gen_machine, dis_machine)
+            copy_shared_parameters(gen_machine, generator_machine)
+    dis_trainer.finishTrainPass()
+    gen_trainer.finishTrainPass()
+    dis_trainer.finishTrain()
+    gen_trainer.finishTrain()
+    assert all(np.isfinite(d) and np.isfinite(g) for d, g in losses)
