@@ -63,12 +63,38 @@ def initPaddle(*args):
         _init()
 
 
+class RangeError(IndexError):
+    """Out-of-range element access (the SWIG-thrown ``RangeError``)."""
+
+
+class UnsupportError(RuntimeError):
+    """Operation unsupported for this value kind (reference name)."""
+
+
+# sparse enums (Matrix.h)
+SPARSE_NON_VALUE = 0
+SPARSE_VALUE = 1
+SPARSE_CSR = 0
+SPARSE_CSC = 1
+
+
+def isUsingGpu():
+    return False  # device residency is XLA's, not a per-object flag
+
+
+def isGpuVersion():
+    return False
+
+
 # ---------------------------------------------------------- value types
 class Matrix:
-    """Dense 2-D float matrix (``PaddleAPI.h:103`` role)."""
+    """Dense or sparse 2-D float matrix (``PaddleAPI.h:103`` role).
+    Sparse support covers the test surface (CSR row/col/value views);
+    the engine consumes dense numpy either way."""
 
     def __init__(self, arr):
         self._a = np.atleast_2d(np.asarray(arr, np.float32))
+        self._sparse = None  # (value_type, format, rows, cols, vals)
 
     @staticmethod
     def createDenseFromNumpy(arr, copy=True):
@@ -82,11 +108,73 @@ class Matrix:
     def createZero(height, width):
         return Matrix(np.zeros((height, width), np.float32))
 
+    @staticmethod
+    def createSparse(height, width, nnz, non_value=True, trans=False,
+                     use_gpu=False):
+        m = Matrix(np.zeros((height, width), np.float32))
+        m._sparse = {
+            "value_type": SPARSE_NON_VALUE if non_value else SPARSE_VALUE,
+            "format": SPARSE_CSR, "rows": [0] * (height + 1), "cols": [],
+            "vals": []}
+        return m
+
+    def isSparse(self):
+        return self._sparse is not None
+
+    def getSparseValueType(self):
+        if not self.isSparse():
+            raise UnsupportError("dense matrix")
+        return self._sparse["value_type"]
+
+    def getSparseFormat(self):
+        if not self.isSparse():
+            raise UnsupportError("dense matrix")
+        return self._sparse["format"]
+
+    def sparseCopyFrom(self, rows, cols, values=()):
+        s = self._sparse
+        if s is None:
+            raise UnsupportError("dense matrix")
+        s["rows"], s["cols"] = list(rows), list(cols)
+        s["vals"] = list(values)
+        self._a = np.zeros_like(self._a)
+        for r in range(len(s["rows"]) - 1):
+            for k in range(s["rows"][r], s["rows"][r + 1]):
+                c = s["cols"][k]
+                self._a[r, c] = s["vals"][k] if s["vals"] else 1.0
+
+    def getSparseRowCols(self, row):
+        s = self._sparse
+        return s["cols"][s["rows"][row]:s["rows"][row + 1]]
+
+    def getSparseRowColsVal(self, row):
+        s = self._sparse
+        lo, hi = s["rows"][row], s["rows"][row + 1]
+        return list(zip(s["cols"][lo:hi], s["vals"][lo:hi]))
+
+    def get(self, x, y):
+        # reference api/Matrix.cpp:116: x is the COLUMN, y the ROW
+        # (element x + y * width)
+        if x >= self.getWidth() or y >= self.getHeight():
+            raise RangeError(f"({x}, {y}) out of {self._a.shape}")
+        return float(self._a[y, x])
+
+    def set(self, x, y, value):
+        if x >= self.getWidth() or y >= self.getHeight():
+            raise RangeError(f"({x}, {y}) out of {self._a.shape}")
+        self._a[y, x] = value
+
     def copyToNumpyMat(self):
         return np.array(self._a)
 
+    def toNumpyMatInplace(self):
+        return self._a  # the backing array: mutations are visible
+
     def copyFromNumpyMat(self, arr):
         self._a = np.atleast_2d(np.asarray(arr, np.float32))
+
+    def isGpu(self):
+        return False
 
     def getHeight(self):
         return self._a.shape[0]
@@ -109,14 +197,41 @@ class IVector:
         return IVector(np.array(arr, np.int32, copy=copy))
 
     @staticmethod
-    def create(data):
-        return IVector(np.asarray(data, np.int32))
+    def createCpuVectorFromNumpy(arr, copy=True):
+        return IVector(np.array(arr, np.int32, copy=copy))
+
+    @staticmethod
+    def create(data, use_gpu=False):
+        return IVector(np.asarray(list(data), np.int32))
+
+    @staticmethod
+    def createZero(size, use_gpu=False):
+        return IVector(np.zeros(size, np.int32))
 
     def copyToNumpyArray(self):
         return np.array(self._a)
 
+    def toNumpyArrayInplace(self):
+        return self._a
+
+    def isGpu(self):
+        return False
+
     def getSize(self):
         return int(self._a.shape[0])
+
+    def __len__(self):
+        return self.getSize()
+
+    def __getitem__(self, i):
+        if i >= self.getSize():
+            raise RangeError(str(i))
+        return int(self._a[i])
+
+    def __setitem__(self, i, v):
+        if i >= self.getSize():
+            raise RangeError(str(i))
+        self._a[i] = v
 
     def getData(self):
         return self._a.tolist()
@@ -132,11 +247,28 @@ class Vector:
     def createVectorFromNumpy(arr, copy=True):
         return Vector(np.array(arr, np.float32, copy=copy))
 
+    @staticmethod
+    def create(data, use_gpu=False):
+        return Vector(np.asarray(list(data), np.float32))
+
+    @staticmethod
+    def createZero(size, use_gpu=False):
+        return Vector(np.zeros(size, np.float32))
+
     def copyToNumpyArray(self):
         return np.array(self._a)
 
+    def toNumpyArrayInplace(self):
+        return self._a
+
+    def isGpu(self):
+        return False
+
     def getSize(self):
         return int(self._a.shape[0])
+
+    def __len__(self):
+        return self.getSize()
 
 
 class Arguments:
@@ -176,6 +308,25 @@ class Arguments:
 
     def getSlotIds(self, i) -> IVector:
         return self._slots[i]["ids"]
+
+    def setSlotFrameHeight(self, i, h):
+        self._slot(i)["frame_height"] = h
+
+    def setSlotFrameWidth(self, i, w):
+        self._slot(i)["frame_width"] = w
+
+    def getSlotFrameHeight(self, i=0):
+        return self._slots[i].get("frame_height", 0)
+
+    def getSlotFrameWidth(self, i=0):
+        return self._slots[i].get("frame_width", 0)
+
+    def sum(self) -> float:
+        total = 0.0
+        for slot in self._slots:
+            if "value" in slot:
+                total += float(slot["value"]._a.sum())
+        return total
 
 
 # ------------------------------------------------------------ parameters

@@ -31,6 +31,68 @@ def api():
     return api
 
 
+def test_matrix_surface(api):
+    """`paddle/api/test/testMatrix.py`: zero/get/set + RangeError, sparse
+    CSR views, numpy round-trips."""
+    m = api.Matrix.createZero(32, 24)
+    assert (m.getHeight(), m.getWidth()) == (32, 24)
+    for x in range(24):
+        for y in range(32):
+            assert m.get(x, y) == 0.0
+    with pytest.raises(api.RangeError):
+        m.get(51, 47)
+    m.set(3, 3, 3.0)
+    assert m.get(3, 3) == 3.0
+
+    s = api.Matrix.createSparse(3, 3, 6, True, False, False)
+    assert s.isSparse()
+    assert s.getSparseValueType() == api.SPARSE_NON_VALUE
+    assert s.getSparseFormat() == api.SPARSE_CSR
+    s.sparseCopyFrom([0, 2, 3, 3], [0, 1, 2], [])
+    assert s.getSparseRowCols(0) == [0, 1]
+    assert s.getSparseRowCols(1) == [2]
+    assert s.getSparseRowCols(2) == []
+
+    sv = api.Matrix.createSparse(3, 3, 6, False, False, False)
+    sv.sparseCopyFrom([0, 2, 3, 3], [0, 1, 2], [7.3, 4.2, 3.2])
+    got = sv.getSparseRowColsVal(0)
+    assert [c for c, _ in got] == [0, 1]
+    assert abs(got[0][1] - 7.3) < 1e-5
+
+    d = api.Matrix.createDenseFromNumpy(
+        np.random.RandomState(0).rand(4, 5).astype("float32"))
+    ip = d.toNumpyMatInplace()
+    ip[0, 0] = 42.0
+    assert d.get(0, 0) == 42.0  # in-place view
+
+
+def test_vector_and_arguments_surface(api):
+    """`testVector.py` / `testArguments.py`: create/zero/numpy-inplace,
+    Arguments sum + frame dims."""
+    iv = api.IVector.createZero(10)
+    assert iv.getSize() == 10 and not iv.isGpu()
+    iv = api.IVector.create(range(10))
+    assert iv.getData() == list(range(10))
+    iv[3] = 77
+    assert iv[3] == 77
+    with pytest.raises(api.RangeError):
+        iv[10]
+
+    m = api.Matrix.createDense([4, 2, 4, 3, 9, 5], 2, 3)
+    args = api.Arguments.createArguments(1)
+    args.setSlotValue(0, m)
+    assert abs(args.sum() - 27.0) < 1e-6
+    assert args.getSlotValue(0).toNumpyMatInplace().shape == (2, 3)
+    args.setSlotIds(0, api.IVector.create([1, 2, 3, 4, 5, 6]))
+    assert args.getSlotIds(0).toNumpyArrayInplace().shape == (6,)
+
+    h, w = 4, 6
+    args.setSlotFrameHeight(0, h)
+    args.setSlotFrameWidth(0, w)
+    assert args.getSlotFrameHeight() == h
+    assert args.getSlotFrameWidth() == w
+
+
 def test_api_train_flow(api):
     """api_train.py's full call sequence, converging on synthetic data."""
     from py_paddle import DataProviderConverter
