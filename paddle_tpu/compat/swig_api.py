@@ -369,12 +369,54 @@ class _ParameterBuffer:
             np.asarray(arr, np.float32).reshape(shape))
 
 
+class ParameterConfigView:
+    """``Parameter::getConfig()`` — a handle whose ``toProto()`` yields
+    the ``ParameterConfig`` message (name/size/dims)."""
+
+    def __init__(self, name: str, shape):
+        self._name, self._shape = name, tuple(shape)
+
+    def toProto(self):
+        from paddle_tpu.proto import ParameterConfig_pb2
+        pc = ParameterConfig_pb2.ParameterConfig()
+        pc.name = self._name
+        pc.size = int(np.prod(self._shape))
+        dims = self._shape if len(self._shape) > 1 else (1, self._shape[0])
+        pc.dims.extend(int(d) for d in dims)
+        return pc
+
+    @property
+    def name(self):
+        return self._name
+
+    @property
+    def shape(self):
+        return self._shape
+
+
+class _BoundVector(Vector):
+    """A Vector view bound to a machine parameter buffer: writes commit
+    back (the SWIG buffers alias C++ memory; here the commit is
+    explicit)."""
+
+    def __init__(self, arr, writeback=None):
+        super().__init__(arr)
+        self._writeback = writeback
+
+    def commit(self):
+        if self._writeback is not None:
+            self._writeback(self._a)
+
+
 class Parameter:
-    def __init__(self, machine: "GradientMachine", name: str):
-        self._m, self._name = machine, name
+    def __init__(self, machine: "GradientMachine", name: str, pid: int = 0):
+        self._m, self._name, self._pid = machine, name, pid
 
     def getName(self) -> str:
         return self._name
+
+    def getID(self) -> int:
+        return self._pid
 
     def getSize(self) -> int:
         return int(np.prod(np.asarray(
@@ -382,6 +424,53 @@ class Parameter:
 
     def getBuf(self, kind=PARAMETER_VALUE) -> _ParameterBuffer:
         return _ParameterBuffer(self._m, self._name, kind)
+
+    def getConfig(self) -> ParameterConfigView:
+        shape = np.asarray(jax.device_get(self._m._params[self._name])).shape
+        return ParameterConfigView(self._name, shape)
+
+    def getBufs(self):
+        """(value, gradient, slot...) Vector views; the value view
+        commits back into the machine (``Parameter::getBufs`` feeding
+        ``ParameterOptimizer::update``)."""
+        m, name = self._m, self._name
+        value = np.asarray(jax.device_get(m._params[name]))
+        shape = value.shape
+
+        def write_value(arr):
+            m._params[name] = jnp.asarray(
+                np.asarray(arr, np.float32).reshape(shape))
+
+        bufs = [_BoundVector(value.reshape(-1).copy(), write_value)]
+        g = m._grads.get(name)
+        bufs.append(Vector(np.asarray(jax.device_get(g)).reshape(-1)
+                           if g is not None
+                           else np.zeros(value.size, np.float32)))
+        slots = (m._opt_state or {}).get("slots", {}).get(name, {})
+        for s in sorted(slots):
+            bufs.append(Vector(
+                np.asarray(jax.device_get(slots[s])).reshape(-1)))
+        return bufs
+
+    def save(self, path: str) -> bool:
+        """Write the reference's binary parameter format
+        (``Parameter::save``)."""
+        from paddle_tpu.compat.param_format import save_v1_param
+        try:
+            save_v1_param(path, np.asarray(
+                jax.device_get(self._m._params[self._name])))
+            return True
+        except OSError:
+            return False
+
+    def load(self, path: str) -> bool:
+        from paddle_tpu.compat.param_format import load_v1_param
+        try:
+            arr = load_v1_param(path)
+        except (OSError, ValueError):
+            return False
+        self.getBuf(PARAMETER_VALUE).copyFromNumpyArray(arr.reshape(-1))
+        return True
 
 
 # ------------------------------------------------------------- evaluator
@@ -464,6 +553,9 @@ class GradientMachine:
             graph = model_from_proto(model_config)
         return GradientMachine(graph)
 
+    # testGradientMachine.py spelling
+    createByModelConfig = createFromConfigProto
+
     def _cost_layers(self) -> List[str]:
         from paddle_tpu.compat.config_parser import COST_TYPES
         names = [n for n in self._graph.output_layer_names
@@ -513,12 +605,16 @@ class GradientMachine:
         pass
 
     def getParameters(self) -> List[Parameter]:
-        return [Parameter(self, n) for n in self._params]
+        return [Parameter(self, n, i)
+                for i, n in enumerate(self._params)]
 
     def getParameter(self, name: str) -> Parameter:
         if name not in self._params:
             raise KeyError(name)
-        return Parameter(self, name)
+        return Parameter(self, name, list(self._params).index(name))
+
+    def getParameterSize(self) -> int:
+        return len(self._params)
 
     def randParameters(self):
         self._params = self._network.init_params(
@@ -544,6 +640,22 @@ class GradientMachine:
         self._state_updates = dict(updates)
         self._last_outputs, self._last_feed = outputs, feed
         self._fill_out(outputs, outArgs)
+
+    def backward(self, callback=None):
+        """Backward over the LAST forward's batch, then the per-parameter
+        update callback — the pipelined-update-during-backward protocol
+        (``TrainerInternal.cpp:70-74``; here gradients arrive all at once
+        from ``jax.grad``, so the callback runs per parameter after)."""
+        if self._last_feed is None:
+            raise RuntimeError("backward() needs a prior forward()")
+        self._rng, r = jax.random.split(self._rng)
+        (_, (outputs, updates)), grads = self._grad_fn(
+            self._params, self._last_feed, r)
+        self._grads = grads
+        self._state_updates = dict(updates)
+        if callback is not None:
+            for p in self.getParameters():
+                callback(p)
 
     def makeEvaluator(self) -> Evaluator:
         return Evaluator(self)
@@ -628,6 +740,132 @@ class ParameterUpdater:
     def catchUpWith(self):
         # dense parameters are always current here; the sparse lazy-row
         # catch-up lives inside the optimizer's sparse path
+        pass
+
+    def finishPass(self):
+        self._pass += 1
+
+
+# ----------------------------------------------- config + raw optimizers
+class OptimizationConfig:
+    """``swig_paddle.OptimizationConfig``: a handle ParameterOptimizer
+    consumes. Wraps an engine-optimizer factory (from a parsed config's
+    settings, or mapped from a raw ``OptimizationConfig`` proto)."""
+
+    def __init__(self, factory):
+        self._factory = factory
+
+    @staticmethod
+    def createFromProto(proto):
+        from paddle_tpu.compat.trainer_config_helpers.optimizers import (
+            build_optimizer)
+        settings = {
+            "learning_rate": proto.learning_rate,
+            "learning_method": None,
+            "batch_size": getattr(proto, "batch_size", 1),
+            "learning_rate_schedule": proto.learning_rate_schedule or None,
+            "learning_rate_decay_a": proto.learning_rate_decay_a,
+            "learning_rate_decay_b": proto.learning_rate_decay_b,
+            "learning_rate_args": proto.learning_rate_args,
+        }
+        method = proto.learning_method or "momentum"
+        # map the proto's method string through the helper classes so the
+        # per-method hyper-params (momentum/ada_epsilon/...) ride along
+        from paddle_tpu.compat.trainer_config_helpers import optimizers as o
+        cls = {
+            "momentum": lambda: o.MomentumOptimizer(proto.momentum),
+            "adagrad": lambda: o.AdaGradOptimizer(),
+            "adadelta": lambda: o.AdaDeltaOptimizer(),
+            "rmsprop": lambda: o.RMSPropOptimizer(),
+            "decayed_adagrad": lambda: o.DecayedAdaGradOptimizer(),
+            "adam": lambda: o.AdamOptimizer(),
+            "adamax": lambda: o.AdamaxOptimizer(),
+        }.get(method)
+        if cls is not None:
+            settings["learning_method"] = cls()
+        return OptimizationConfig(lambda: build_optimizer(settings))
+
+    def make_optimizer(self):
+        return self._factory()
+
+
+class TrainerConfig:
+    """``swig_paddle.TrainerConfig``: parse a config file and hand out
+    its model/optimization pieces (``TrainerConfigHelper`` role)."""
+
+    def __init__(self, parsed):
+        self._parsed = parsed
+
+    @staticmethod
+    def createFromTrainerConfigFile(path, config_args: str = ""):
+        from paddle_tpu.compat.config_parser import parse_config
+        return TrainerConfig(parse_config(path, config_args))
+
+    @staticmethod
+    def createFromProtoString(blob: bytes):
+        raise NotImplementedError(
+            "create from a config FILE (createFromTrainerConfigFile) — "
+            "a serialized TrainerConfig has no python source to re-run")
+
+    def getModelConfig(self):
+        return self._parsed.model_config
+
+    def getOptimizationConfig(self) -> OptimizationConfig:
+        parsed = self._parsed
+        return OptimizationConfig(parsed.optimizer)
+
+
+class ParameterOptimizer:
+    """Per-parameter optimizer handles (``paddle/optimizer``'s C-ABI role
+    consumed through SWIG, ``testTrain.py`` / ``testGradientMachine.py``
+    protocol): create per parameter, startPass/startBatch, then
+    ``update([value, grad, ...], param_config)`` applies one step to the
+    value buffer (committed back to its machine), finishBatch/finishPass."""
+
+    def __init__(self, optimizer):
+        self._opt = optimizer
+        self._state: Dict[str, Any] = {}
+        self._bsz = 1
+        self._pass = 0
+
+    @staticmethod
+    def create(opt_config: OptimizationConfig) -> "ParameterOptimizer":
+        return ParameterOptimizer(opt_config.make_optimizer())
+
+    def getParameterTypes(self):
+        return self._opt.enable_types()
+
+    def init(self, num_rows: int, param_config=None):
+        pass  # state allocates lazily per parameter on first update
+
+    def startPass(self):
+        pass
+
+    def startBatch(self, batch_size: int):
+        self._bsz = batch_size
+
+    def update(self, vecs, param_config):
+        name = getattr(param_config, "name", None) or param_config.toProto().name
+        shape = getattr(param_config, "shape", None)
+        value, grad = vecs[0], vecs[1]
+        arr = value._a.reshape(shape) if shape else value._a
+        g = grad._a.reshape(arr.shape)
+        params = {name: jnp.asarray(arr)}
+        grads = {name: jnp.asarray(g)}
+        if name not in self._state:
+            self._state[name] = self._opt.init(params, None)
+        new_params, self._state[name] = self._opt.update(
+            grads, self._state[name], params, None,
+            batch_size=self._bsz, num_passes=self._pass)
+        value._a[:] = np.asarray(
+            jax.device_get(new_params[name])).reshape(-1)
+        if hasattr(value, "commit"):
+            value.commit()
+
+    def needSpecialTraversal(self, param_config) -> bool:
+        return False
+
+    def finishBatch(self):
         pass
 
     def finishPass(self):
