@@ -910,3 +910,18 @@ class Trainer:
         cost = float(cost.sum() / batch_size)
         self._updater.finishBatch(cost)
         return cost
+
+    def startTestPeriod(self):
+        self._updater.apply()  # model-averaged params for testing
+
+    def testOneDataBatch(self, batch_size: int, args: Arguments):
+        self._machine.forward(args, self._outArgs, PASS_TEST)
+
+    def finishTestPeriod(self):
+        self._updater.restore()
+
+    def getForwardOutput(self):
+        """The last batch's outputs as [{'value': ndarray}, ...]
+        (``Trainer::getForwardOutput`` through the SWIG typemap)."""
+        return [{"value": self._outArgs.getSlotValue(i).copyToNumpyMat()}
+                for i in range(self._outArgs.getSlotNum())]

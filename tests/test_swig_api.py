@@ -260,6 +260,54 @@ def test_raw_parameter_optimizer_flow(api):
 
 
 @needs_ref
+def test_trainer_flow(api):
+    """`paddle/api/test/testTrainer.py`: Trainer.create over the parsed
+    reference config, train/test periods, getForwardOutput."""
+    from paddle.trainer.config_parser import parse_config
+    trainer_config = parse_config(
+        "/root/reference/paddle/api/test/testTrainConfig.py", "")
+    model = api.GradientMachine.createFromConfigProto(
+        trainer_config.model_config)
+    trainer = api.Trainer.create(trainer_config, model)
+    trainer.startTrain()
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(512, 784).astype("float32")
+    Y = (X[:, :10].argmax(axis=1)).astype("int32")  # learnable labels
+
+    def batches():
+        for b in range(0, 512, 128):
+            args = api.Arguments.createArguments(2)
+            args.setSlotValue(0, api.Matrix.createDenseFromNumpy(X[b:b+128]))
+            args.setSlotIds(1, api.IVector.createVectorFromNumpy(Y[b:b+128]))
+            yield 128, args
+
+    pass_costs = []
+    for _ in range(3):
+        trainer.startTrainPass()
+        num = cost = 0
+        for bs, data in batches():
+            trainer.trainOneDataBatch(bs, data)
+            outs = trainer.getForwardOutput()
+            cost += float(np.sum(outs[0]["value"]))
+            num += bs
+        trainer.finishTrainPass()
+        pass_costs.append(cost / num)
+
+        trainer.startTestPeriod()
+        num = cost = 0
+        for bs, data in batches():
+            trainer.testOneDataBatch(bs, data)
+            outs = trainer.getForwardOutput()
+            cost += float(np.sum(outs[0]["value"]))
+            num += bs
+        trainer.finishTestPeriod()
+        assert np.isfinite(cost / num)
+    trainer.finishTrain()
+    assert pass_costs[-1] < pass_costs[0]  # it learns
+
+
+@needs_ref
 def test_gan_demo_flow(api):
     """gan_trainer.py against the reference's own gan_conf.py (uniform
     mode): three machines, shared-parameter sync, trainer alternation."""
