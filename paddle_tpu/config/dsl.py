@@ -182,13 +182,16 @@ def img_pool(input, *, pool_size: Optional[int] = None, stride: int = 1,
 def batch_norm(input, *, act: str = "linear", name: str = None,
                use_global_stats: bool = None,
                moving_average_fraction: float = 0.9,
-               epsilon: float = 1e-5, bias_attr=True) -> LayerOutput:
+               epsilon: float = 1e-5, bias_attr=True,
+               layer_attr: dict = None) -> LayerOutput:
     src = _in(input)[0]
+    attrs = {"use_global_stats": use_global_stats,
+             "moving_average_fraction": moving_average_fraction,
+             "epsilon": epsilon}
+    attrs.update(_layer_attr(layer_attr).get("attrs", {}))
     ldef = LayerDef(name=name or _auto_name("batch_norm"), type="batch_norm",
                     inputs=[Input(src.name)], act=act, bias=_bias(bias_attr),
-                    attrs={"use_global_stats": use_global_stats,
-                           "moving_average_fraction": moving_average_fraction,
-                           "epsilon": epsilon})
+                    attrs=attrs)
     return _add(ldef)
 
 
@@ -378,10 +381,16 @@ def _layer_attr(layer_attr: Optional[dict]):
     if layer_attr:
         if "drop_rate" in layer_attr:
             out["drop_rate"] = layer_attr["drop_rate"]
+        attrs = {}
         if "device" in layer_attr:
             # per-layer placement (--parallel_nn); consumed by
             # parallel.mesh.device_attr_rules as a model-axis shard hint
-            out["attrs"] = {"device": layer_attr["device"]}
+            attrs["device"] = layer_attr["device"]
+        if "recompute" in layer_attr:
+            # per-layer rematerialization (jax.checkpoint in the executor)
+            attrs["recompute"] = bool(layer_attr["recompute"])
+        if attrs:
+            out["attrs"] = attrs
     return out
 
 

@@ -193,13 +193,31 @@ class Network:
             # layer_scope = CustomStackTrace push/pop + HLO named_scope
             # (NeuralNetwork.cpp:244-252)
             with layer_scope(name):
-                out = impl.apply(layer, lparams, ins, ctx)
-                if layer.act and layer.act not in ("linear", ""):
-                    out = out.with_value(
-                        apply_activation(layer.act, out.value, out.mask))
-                if layer.drop_rate > 0.0:
-                    out = out.with_value(
-                        _dropout(out.value, layer.drop_rate, ctx, name))
+                def compute(lp, ins_t, layer=layer, impl=impl, name=name):
+                    # state updates thread through as explicit outputs so
+                    # this stays pure enough for jax.checkpoint below
+                    saved = ctx.state_updates
+                    ctx.state_updates = {}
+                    try:
+                        out = impl.apply(layer, lp, ins_t, ctx)
+                        if layer.act and layer.act not in ("linear", ""):
+                            out = out.with_value(apply_activation(
+                                layer.act, out.value, out.mask))
+                        if layer.drop_rate > 0.0:
+                            out = out.with_value(_dropout(
+                                out.value, layer.drop_rate, ctx, name))
+                        return out, ctx.state_updates
+                    finally:
+                        ctx.state_updates = saved
+
+                if layer.attrs.get("recompute") and train:
+                    # per-layer rematerialization: trade recompute FLOPs
+                    # for activation HBM (jax.checkpoint; the TPU-native
+                    # render of memory-pressure knobs)
+                    out, new_state = jax.checkpoint(compute)(lparams, ins)
+                else:
+                    out, new_state = compute(lparams, ins)
+                ctx.state_updates.update(new_state)
             if probes and name in probes:
                 out = out.with_value(out.value + probes[name])
             ctx.outputs[name] = out
