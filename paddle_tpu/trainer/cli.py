@@ -74,6 +74,10 @@ def parse_args(argv=None):
     p.add_argument("--time_batches", type=int, default=20,
                    help="--job=time: timed batches after warmup")
     p.add_argument("--time_warmup", type=int, default=3)
+    p.add_argument("--compute_dtype", default=None,
+                   choices=["bfloat16", "float32"],
+                   help="mixed precision (TPU-native addition): f32 "
+                        "master params, forward/backward in this dtype")
     return p.parse_args(argv)
 
 
@@ -151,9 +155,11 @@ def _build_trainer(ns, args):
         mesh = create_mesh(n_data=args.trainer_count)
     optimizer = ns.get("optimizer") or Momentum(learning_rate=0.01,
                                                 momentum=0.9)
+    dtype = getattr(args, "compute_dtype", None)
     trainer = SGD(cost=ns["cost"], update_equation=optimizer, mesh=mesh,
                   seed=args.seed, evaluators=ns.get("evaluators"),
-                  prev_batch_state=getattr(args, "prev_batch_state", False))
+                  prev_batch_state=getattr(args, "prev_batch_state", False),
+                  compute_dtype=None if dtype in (None, "float32") else dtype)
     if args.init_model_path:
         _init_params(trainer, args.init_model_path)
     return trainer

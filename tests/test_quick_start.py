@@ -57,6 +57,26 @@ def test_quick_start_lr_trains(qs_job, capsys):
 
 
 @needs_ref
+def test_quick_start_lr_trains_bf16(qs_job, capsys):
+    """--compute_dtype=bfloat16: the same unmodified reference config
+    trains mixed-precision through the CLI and still learns."""
+    cwd = os.getcwd()
+    os.chdir(qs_job)
+    try:
+        from paddle_tpu.trainer import cli
+        rc = cli.main(["--config", str(QS / "trainer_config.lr.py"),
+                       "--job", "train", "--num_passes", "30",
+                       "--compute_dtype", "bfloat16"])
+    finally:
+        os.chdir(cwd)
+    assert rc == 0
+    out = capsys.readouterr().out
+    last = [ln for ln in out.splitlines() if ln.startswith("Pass 29")][0]
+    err = float(last.split("classification_error=")[1].split()[0])
+    assert err < 0.25, out
+
+
+@needs_ref
 def test_quick_start_emb_cnn_config_parses(qs_job):
     """The embedding+CNN variant parses with its dictionary."""
     cwd = os.getcwd()
