@@ -33,8 +33,10 @@ def _fix_kwargs(kwargs):
     if la is not None and not isinstance(la, dict):
         # ExtraAttr object → the dict form dsl accepts
         d = dict(getattr(la, "kwargs", {}))
-        if getattr(la, "drop_rate", 0.0):
+        if getattr(la, "drop_rate", None):
             d["drop_rate"] = la.drop_rate
+        if getattr(la, "device", None) is not None:
+            d["device"] = la.device
         kwargs["layer_attr"] = d
     return kwargs
 
