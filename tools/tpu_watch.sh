@@ -1,5 +1,5 @@
 #!/bin/bash
-# TPU tunnel watcher: probe cheaply on a loop; the moment the tunnel is
+# TPU tunnel watcher: probe gently on a loop; the moment the tunnel is
 # live, capture the round's benchmark + kernel-evidence artifacts.
 #
 # The axon tunnel alternates between working windows and multi-hour
@@ -7,14 +7,21 @@
 # This script makes capture automatic: run it in the background, check
 # tpu_watch.log / the artifact files.
 #
+# PROBE DISCIPLINE (round-4 lesson): killing a probe mid-operation can
+# WORSEN the wedge — the tunnel was live at round start and wedged right
+# after a 90s-timeout matmul probe was SIGTERM-killed mid-compile (first
+# compile over the tunnel can exceed 90s). So the probe is devices-only
+# (no compile), the deadline is generous (240s), and failed probes back
+# off 20 minutes so kills are rare.
+#
 # Usage: bash tools/tpu_watch.sh [round_tag]   (default r04)
 set -u
 cd "$(dirname "$0")/.."
 TAG="${1:-r04}"
 LOG=tpu_watch.log
-echo "[$(date -u +%H:%M:%S)] watcher start" >>"$LOG"
+echo "[$(date -u +%H:%M:%S)] watcher start (gentle probe)" >>"$LOG"
 while true; do
-  if timeout -k 10 90 python -c "import jax; x=__import__('jax.numpy',fromlist=['x']).ones((256,256)); print(float((x@x).sum()))" >>"$LOG" 2>&1; then
+  if timeout -k 15 240 python -c "import jax; print(jax.devices()[0].platform)" >>"$LOG" 2>&1; then
     echo "[$(date -u +%H:%M:%S)] TUNNEL LIVE — capturing" >>"$LOG"
     ok=1
     # bench first (the headline artifact), evidence second; a capture
@@ -38,6 +45,6 @@ while true; do
     fi
     echo "[$(date -u +%H:%M:%S)] capture incomplete; re-entering probe loop" >>"$LOG"
   fi
-  echo "[$(date -u +%H:%M:%S)] tunnel wedged/incomplete; retry in 600s" >>"$LOG"
-  sleep 600
+  echo "[$(date -u +%H:%M:%S)] tunnel wedged/incomplete; retry in 1200s" >>"$LOG"
+  sleep 1200
 done
