@@ -1,0 +1,103 @@
+"""Convert PyTorch parameters to paddle model files.
+
+The reference's ``python/paddle/utils/torch2paddle.py`` converts
+lua-torch ``.t7`` files into v1 binary parameter files (one
+``_<layer>.w0`` / ``_<layer>.wbias`` per layer); the modern counterpart
+converts a PyTorch ``state_dict`` (``torch.save``'d) the same way,
+writing the reference's ``Parameter::save`` binary format so the result
+loads through ``--init_model_path`` / ``compat.param_format``.
+
+Layout note: torch ``nn.Linear`` stores ``weight[out, in]``; the engine's
+fc weights are ``[in, out]`` (``_<layer>.w0``), so 2-D weights are
+transposed on the way through. 4-D conv weights ``[out, in, kh, kw]``
+become the engine's ``[kh, kw, in, out]`` (HWIO).
+
+Usage:
+    python -m paddle_tpu.utils.torch2paddle \
+        -i model.pt -l layers.txt -o path/to/paddle_model
+
+``layers.txt`` lists one target layer name per line, consumed in order
+against the state_dict's (weight, bias) pairs — the reference's
+contract.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Dict, List
+
+import numpy as np
+
+
+def _to_engine_layout(arr: np.ndarray) -> np.ndarray:
+    a = np.asarray(arr, np.float32)
+    if a.ndim == 2:
+        return a.T                      # [out, in] -> [in, out]
+    if a.ndim == 4:
+        return a.transpose(2, 3, 1, 0)  # OIHW -> HWIO
+    return a
+
+
+def convert_state_dict(state_dict, layers: List[str]
+                       ) -> Dict[str, np.ndarray]:
+    """(ordered) torch state_dict + layer names -> {param file name:
+    value}. Tensors pair up as (weight, bias) per layer, like the
+    reference's ``params[i*2] / params[i*2+1]``; a layer without a bias
+    (its next tensor is another weight, ndim > 1) gets only ``w0``."""
+    tensors = [(k, v) for k, v in state_dict.items()]
+    out: Dict[str, np.ndarray] = {}
+    i = 0
+    for layer in layers:
+        if i >= len(tensors):
+            raise ValueError(f"state_dict ran out of tensors at {layer!r}")
+        key, w = tensors[i]
+        i += 1
+        out[f"_{layer}.w0"] = _to_engine_layout(_np(w))
+        if i < len(tensors) and _np(tensors[i][1]).ndim == 1:
+            out[f"_{layer}.wbias"] = _np(tensors[i][1])
+            i += 1
+    if i != len(tensors):
+        raise ValueError(
+            f"{len(tensors) - i} tensors left over after {len(layers)} "
+            "layers — the layer list does not match the state_dict")
+    return out
+
+
+def _np(t) -> np.ndarray:
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().numpy()
+    return np.asarray(t, np.float32)
+
+
+def save_net_parameters(layers: List[str], state_dict, output_path: str):
+    from paddle_tpu.compat.param_format import save_v1_param
+    os.makedirs(output_path, exist_ok=True)
+    for name, value in convert_state_dict(state_dict, layers).items():
+        save_v1_param(os.path.join(output_path, name), value)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="Convert PyTorch parameters to paddle model files.")
+    p.add_argument("-i", "--input", required=True,
+                   help="torch.save'd state_dict (or module) file")
+    p.add_argument("-l", "--layers", required=True,
+                   help="text file with one target layer name per line")
+    p.add_argument("-o", "--output", required=True,
+                   help="output model directory")
+    args = p.parse_args(argv)
+
+    import torch
+    obj = torch.load(args.input, map_location="cpu", weights_only=False)
+    state_dict = obj.state_dict() if hasattr(obj, "state_dict") else obj
+    with open(args.layers) as f:
+        layers = [line.strip() for line in f if line.strip()]
+    save_net_parameters(layers, state_dict, args.output)
+    print(f"wrote {len(layers)} layers to {args.output}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

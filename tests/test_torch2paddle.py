@@ -1,0 +1,78 @@
+"""torch2paddle (`python/paddle/utils/torch2paddle.py` role, PyTorch
+edition): a torch model's parameters convert to reference-format binary
+files, load through the engine, and reproduce the torch forward."""
+
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from paddle_tpu.config import dsl  # noqa: E402
+from paddle_tpu.core.argument import Argument  # noqa: E402
+from paddle_tpu.core.network import Network  # noqa: E402
+
+
+def _torch_model():
+    torch.manual_seed(0)
+    return torch.nn.Sequential(
+        torch.nn.Linear(8, 16), torch.nn.Tanh(),
+        torch.nn.Linear(16, 4))
+
+
+def test_converted_params_reproduce_torch_forward(tmp_path):
+    from paddle_tpu.compat.param_format import load_v1_model_dir
+    from paddle_tpu.utils.torch2paddle import save_net_parameters
+
+    tm = _torch_model()
+    save_net_parameters(["fc1", "fc2"], tm.state_dict(), str(tmp_path))
+
+    dsl.reset()
+    x = dsl.data(name="x", size=8)
+    h = dsl.fc(input=x, size=16, act="tanh", name="fc1")
+    out = dsl.fc(input=h, size=4, act="linear", name="fc2")
+    net = Network(dsl.current_graph(), outputs=[out.name])
+    params = net.init_params(jax.random.PRNGKey(0))
+
+    loaded = load_v1_model_dir(str(tmp_path))
+    for name in params:
+        assert name in loaded, name
+        params[name] = jnp.asarray(
+            loaded[name].reshape(np.asarray(params[name]).shape))
+
+    xs = np.random.RandomState(0).randn(6, 8).astype(np.float32)
+    ours = np.asarray(jax.device_get(net.apply(
+        params, {"x": Argument(value=jnp.asarray(xs))})[out.name].value))
+    with torch.no_grad():
+        theirs = tm(torch.from_numpy(xs)).numpy()
+    np.testing.assert_allclose(ours, theirs, rtol=1e-5, atol=1e-5)
+
+
+def test_cli_roundtrip(tmp_path):
+    tm = _torch_model()
+    pt = tmp_path / "model.pt"
+    torch.save(tm.state_dict(), pt)
+    layers = tmp_path / "layers.txt"
+    layers.write_text("fc1\nfc2\n")
+    outdir = tmp_path / "out"
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.utils.torch2paddle",
+         "-i", str(pt), "-l", str(layers), "-o", str(outdir)],
+        capture_output=True, text=True, timeout=240,
+        env={"JAX_PLATFORMS": "cpu", "PATH": __import__("os").environ["PATH"],
+             "PYTHONPATH": "/root/repo"})
+    assert proc.returncode == 0, proc.stderr
+    names = sorted(p.name for p in outdir.iterdir())
+    assert names == ["_fc1.w0", "_fc1.wbias", "_fc2.w0", "_fc2.wbias"]
+
+
+def test_layer_list_mismatch_is_loud(tmp_path):
+    from paddle_tpu.utils.torch2paddle import convert_state_dict
+    tm = _torch_model()
+    with pytest.raises(ValueError, match="left over|ran out"):
+        convert_state_dict(tm.state_dict(), ["only_one"])
