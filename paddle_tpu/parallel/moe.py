@@ -1,0 +1,142 @@
+"""Expert parallelism: a mixture-of-experts FFN sharded expert-per-device.
+
+The 2017 reference has no MoE (SURVEY §2: no expert parallelism), so —
+like ``parallel/ring.py`` — this is a pure capability-add designed
+TPU-first. The canonical recipe (the public Switch/GShard pattern):
+
+- router: per-token top-1 expert choice from a learned projection,
+  with capacity clipping (static shapes: each expert processes exactly
+  ``capacity`` token slots; overflow drops, underflow pads).
+- dispatch: each device builds the capacity buffers from its replicated
+  token batch and keeps its local experts' slice; the expert FFN runs
+  dense (batched [capacity, d] matmuls on the MXU); results return with
+  an ``all_gather`` over the expert axis and scatter back weighted by
+  the router gate. (With a batch additionally sharded over the expert
+  axis this becomes the classic all_to_all pair; the replicated-batch
+  form keeps one collective.)
+- gradients flow through gates and expert weights (straight-through on
+  the routing choice, the standard top-1 formulation); everything is
+  pure lax inside ``shard_map``, so XLA lowers dispatch to ICI
+  collectives.
+
+``moe_ffn`` is the single-device (unsharded) reference; ``make_moe``
+returns the expert-parallel version over a mesh axis. Parity between
+the two is pinned in ``tests/test_moe.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _route(x, wg, n_experts):
+    """Top-1 routing: (expert_id[B], gate[B]) with softmax gates."""
+    logits = x @ wg                       # [B, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    eid = jnp.argmax(probs, axis=-1)
+    gate = jnp.take_along_axis(probs, eid[:, None], axis=-1)[:, 0]
+    return eid, gate
+
+
+def _expert_ffn(x, w1, b1, w2, b2):
+    return jax.nn.relu(x @ w1 + b1) @ w2 + b2
+
+
+def init_moe_params(key, d_model: int, d_hidden: int, n_experts: int
+                    ) -> Dict[str, jnp.ndarray]:
+    k1, k2, k3 = jax.random.split(key, 3)
+    s1 = 1.0 / jnp.sqrt(d_model)
+    s2 = 1.0 / jnp.sqrt(d_hidden)
+    return {
+        "wg": jax.random.normal(k1, (d_model, n_experts)) * s1,
+        "w1": jax.random.normal(k2, (n_experts, d_model, d_hidden)) * s1,
+        "b1": jnp.zeros((n_experts, d_hidden)),
+        "w2": jax.random.normal(k3, (n_experts, d_hidden, d_model)) * s2,
+        "b2": jnp.zeros((n_experts, d_model)),
+    }
+
+
+def _dispatch_plan(eid, n_experts, capacity):
+    """Position of each token within its expert's capacity slots, and a
+    keep-mask for tokens under capacity (static shapes throughout)."""
+    onehot = jax.nn.one_hot(eid, n_experts, dtype=jnp.int32)   # [B, E]
+    pos = jnp.cumsum(onehot, axis=0) * onehot                  # 1-based
+    slot = jnp.sum(pos, axis=-1) - 1                           # [B]
+    keep = slot < capacity
+    return slot, keep
+
+
+def moe_ffn(params, x, capacity: int):
+    """Single-device reference: identical math to the sharded version
+    (capacity clipping included), dense per-expert batches."""
+    n_experts = params["wg"].shape[-1]
+    eid, gate = _route(x, params["wg"], n_experts)
+    slot, keep = _dispatch_plan(eid, n_experts, capacity)
+    d = x.shape[-1]
+    # scatter tokens into [E, capacity, d] buffers
+    buf = jnp.zeros((n_experts, capacity, d), x.dtype)
+    buf = buf.at[eid, jnp.clip(slot, 0, capacity - 1)].add(
+        x * keep[:, None].astype(x.dtype))
+    out_buf = jax.vmap(_expert_ffn)(buf, params["w1"], params["b1"],
+                                    params["w2"], params["b2"])
+    y = out_buf[eid, jnp.clip(slot, 0, capacity - 1)]
+    return y * (gate * keep.astype(x.dtype))[:, None]
+
+
+def make_moe(mesh: Mesh, axis: str, n_experts: int, capacity: int):
+    """Expert-parallel MoE over ``axis`` (one or more experts per device;
+    ``n_experts`` must be divisible by the axis size). Returns
+    ``fn(params, x) -> y`` with params sharded expert-major on ``axis``
+    and x batch-sharded on the data axis replicated over ``axis``."""
+    n_dev = mesh.shape[axis]
+    if n_experts % n_dev:
+        raise ValueError(f"{n_experts} experts over {n_dev} devices")
+    e_local = n_experts // n_dev
+
+    def local(params, x):
+        # x: the full (replicated-over-axis) token batch [B, d]
+        eid, gate = _route(x, params["wg"], n_experts)
+        slot, keep = _dispatch_plan(eid, n_experts, capacity)
+        d = x.shape[-1]
+        # build every expert's capacity buffer, then all_to_all so each
+        # device keeps only its local experts' buffers — one collective
+        # carrying [E, capacity, d] / n_dev per hop
+        buf = jnp.zeros((n_experts, capacity, d), x.dtype)
+        buf = buf.at[eid, jnp.clip(slot, 0, capacity - 1)].add(
+            x * keep[:, None].astype(x.dtype))
+        # [E, cap, d] -> [n_dev, e_local, cap, d]; device i keeps slice i
+        buf = buf.reshape(n_dev, e_local, capacity, d)
+        # psum-of-scatter: every device built the full buffer from ITS
+        # replicated batch copy; they are identical, so just slice
+        idx = lax.axis_index(axis)
+        mine = lax.dynamic_index_in_dim(buf, idx, axis=0, keepdims=False)
+        out_local = jax.vmap(_expert_ffn)(
+            mine, params["w1"], params["b1"], params["w2"], params["b2"])
+        # gather every expert's outputs back to every device
+        out_all = lax.all_gather(out_local, axis)  # [n_dev, e_local, cap, d]
+        out_all = out_all.reshape(n_experts, capacity, d)
+        y = out_all[eid, jnp.clip(slot, 0, capacity - 1)]
+        return y * (gate * keep.astype(x.dtype))[:, None]
+
+    from jax import shard_map
+    fn = shard_map(
+        local, mesh=mesh,
+        in_specs=({"wg": P(), "w1": P(axis), "b1": P(axis),
+                   "w2": P(axis), "b2": P(axis)}, P()),
+        out_specs=P(), check_vma=False)
+    return jax.jit(fn)
+
+
+def shard_moe_params(params, mesh: Mesh, axis: str):
+    """Place MoE params: router replicated, experts split over ``axis``."""
+    out = {}
+    for k, v in params.items():
+        spec = P() if k == "wg" else P(axis)
+        out[k] = jax.device_put(v, NamedSharding(mesh, spec))
+    return out

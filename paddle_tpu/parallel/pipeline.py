@@ -1,0 +1,119 @@
+"""Pipeline parallelism: GPipe-style microbatched stage execution.
+
+The 2017 reference has no pipeline parallelism (SURVEY §2: its model
+parallelism is per-layer device placement with task-queue threads); this
+module is the TPU-native capability-add completing the tp/pp/dp/sp/ep
+set. The classic SPMD formulation (public GPipe/collective-permute
+pattern):
+
+- the network is a stack of S identical-shape stages; device i of the
+  pipe axis holds stage i's parameters (stacked leading axis, sharded);
+- a batch splits into M microbatches; over ``S + M - 1`` ticks each
+  device computes its stage for the microbatch in flight and passes the
+  activation to the next device with ``lax.ppermute`` — compute on tick
+  t overlaps the transfer for tick t+1 (XLA pipelines the permute);
+- the bubble is the usual ``(S-1)/(S+M-1)`` fraction: more microbatches,
+  less bubble.
+
+``pipeline_apply`` runs inside ``shard_map`` over the pipe axis; the
+whole schedule is one ``lax.scan``, so XLA sees a single fused loop.
+``stack_stage_params``/``shard_pipeline_params`` build the stacked
+layout. Forward parity with sequential stage application and gradient
+flow are pinned in ``tests/test_pipeline.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def stack_stage_params(stage_params: List[Dict[str, jnp.ndarray]]
+                       ) -> Dict[str, jnp.ndarray]:
+    """[{name: value} per stage] -> {name: stacked [S, ...]}."""
+    out = {}
+    for k in stage_params[0]:
+        out[k] = jnp.stack([sp[k] for sp in stage_params])
+    return out
+
+
+def shard_pipeline_params(stacked, mesh: Mesh, axis: str):
+    """Stage-major placement: leading (stage) dim over the pipe axis."""
+    return {k: jax.device_put(v, NamedSharding(mesh, P(axis)))
+            for k, v in stacked.items()}
+
+
+def sequential_apply(stage_fn: Callable, stacked, x):
+    """Single-device reference: stages applied in order (no pipeline)."""
+    S = next(iter(stacked.values())).shape[0]
+    h = x
+    for s in range(S):
+        h = stage_fn({k: v[s] for k, v in stacked.items()}, h)
+    return h
+
+
+def make_pipeline(mesh: Mesh, axis: str, stage_fn: Callable,
+                  n_microbatches: int):
+    """Returns ``fn(stacked_sharded_params, x) -> y`` running the GPipe
+    schedule over ``axis``. ``x`` is the full [B, ...] batch (replicated
+    over the pipe axis; shard it over the data axis as usual);
+    B % n_microbatches == 0."""
+    S = mesh.shape[axis]
+    M = n_microbatches
+
+    def local(params, x):
+        # params: this device's stage params, leading dim 1 -> squeeze
+        p_mine = {k: v[0] for k, v in params.items()}
+        idx = lax.axis_index(axis)
+        B = x.shape[0]
+        mb = x.reshape(M, B // M, *x.shape[1:])
+        n_ticks = S + M - 1
+
+        perm_fwd = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(carry, t):
+            inflight, outputs = carry
+            # stage 0 injects microbatch t (when t < M); others take the
+            # activation handed over from the previous stage
+            feed = jnp.where(t < M, 1, 0)
+            mb_t = mb[jnp.clip(t, 0, M - 1)]
+            h_in = jnp.where((idx == 0) & (feed == 1), mb_t, inflight)
+            h_out = stage_fn(p_mine, h_in)
+            # the LAST stage's output for microbatch m lands at tick
+            # m + S - 1: record it
+            m_done = t - (S - 1)
+            is_done = (idx == S - 1) & (m_done >= 0) & (m_done < M)
+            outputs = lax.cond(
+                is_done,
+                lambda o: lax.dynamic_update_index_in_dim(
+                    o, h_out, jnp.clip(m_done, 0, M - 1), axis=0),
+                lambda o: o, outputs)
+            # hand the activation to the next stage for the next tick
+            h_next = lax.ppermute(h_out, axis, perm_fwd)
+            return (h_next, outputs), None
+
+        inflight0 = jnp.zeros_like(mb[0])
+        outputs0 = jnp.zeros_like(mb)
+        (_, outputs), _ = lax.scan(
+            tick, (inflight0, outputs0), jnp.arange(n_ticks))
+        # only the last stage holds real outputs; broadcast them so the
+        # result is replicated over the pipe axis (psum of a one-hot)
+        mask = (idx == S - 1).astype(outputs.dtype)
+        outputs = lax.psum(outputs * mask, axis)
+        return outputs.reshape(B, *outputs.shape[2:])
+
+    from jax import shard_map
+    fn = shard_map(
+        local, mesh=mesh,
+        # pytree-prefix specs: every stacked param shards stage-major
+        in_specs=(P(axis), P()),
+        out_specs=P(), check_vma=False)
+
+    def apply(params, x):
+        return fn(params, x)
+
+    return jax.jit(apply)

@@ -1,0 +1,68 @@
+"""Expert parallelism: the sharded MoE equals the single-device
+reference exactly, trains (gradients flow through gates + experts), and
+the sharded program contains the expert-axis collective."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.parallel import create_mesh
+from paddle_tpu.parallel.moe import (init_moe_params, make_moe, moe_ffn,
+                                     shard_moe_params)
+
+D, H, E, CAP, B = 16, 32, 4, 16, 32
+
+
+@pytest.fixture()
+def setup():
+    params = init_moe_params(jax.random.PRNGKey(0), D, H, E)
+    x = jax.random.normal(jax.random.PRNGKey(1), (B, D))
+    return params, x
+
+
+def test_sharded_matches_reference(setup):
+    params, x = setup
+    ref = moe_ffn(params, x, CAP)
+    mesh = create_mesh(n_data=2, n_model=4)
+    fn = make_moe(mesh, "model", E, CAP)
+    got = fn(shard_moe_params(params, mesh, "model"), x)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_gradients_flow_and_train(setup):
+    params, x = setup
+    y_target = jax.random.normal(jax.random.PRNGKey(2), (B, D))
+
+    def loss(p):
+        return jnp.mean((moe_ffn(p, x, CAP) - y_target) ** 2)
+
+    grads = jax.grad(loss)(params)
+    assert float(jnp.abs(grads["wg"]).sum()) > 0      # router learns
+    assert float(jnp.abs(grads["w1"]).sum()) > 0      # experts learn
+    l0 = float(loss(params))
+    p2 = jax.tree_util.tree_map(lambda p, g: p - 0.1 * g, params, grads)
+    assert float(loss(p2)) < l0
+
+
+def test_capacity_clipping_is_static_and_effective():
+    params = init_moe_params(jax.random.PRNGKey(0), D, H, E)
+    # force every token to one expert: only `capacity` survive
+    params = dict(params)
+    params["wg"] = params["wg"] * 0.0 + jnp.eye(D, E) * 100.0
+    x = jnp.ones((B, D))
+    y = moe_ffn(params, x, capacity=4)
+    live = jnp.sum(jnp.any(y != 0.0, axis=-1))
+    assert int(live) == 4  # overflow dropped, shapes static
+
+
+def test_sharded_program_has_collective(setup):
+    params, x = setup
+    mesh = create_mesh(n_data=2, n_model=4)
+    fn = make_moe(mesh, "model", E, CAP)
+    sp = shard_moe_params(params, mesh, "model")
+    hlo = jax.jit(fn).lower(sp, x).compile().as_text()
+    assert "all-gather" in hlo or "all-to-all" in hlo

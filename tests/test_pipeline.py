@@ -1,0 +1,88 @@
+"""Pipeline parallelism: the GPipe schedule over the pipe axis equals
+sequential stage application, gradients flow to every stage's params,
+and the program carries the collective-permute."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.parallel.pipeline import (make_pipeline, sequential_apply,
+                                          shard_pipeline_params,
+                                          stack_stage_params)
+
+D, B, S, M = 8, 16, 4, 4
+
+
+def stage_fn(p, h):
+    return jnp.tanh(h @ p["w"] + p["b"])
+
+
+def _pipe_mesh():
+    devs = np.asarray(jax.devices()[:S]).reshape(S)
+    return Mesh(devs, ("pipe",))
+
+
+@pytest.fixture()
+def setup():
+    keys = jax.random.split(jax.random.PRNGKey(0), S)
+    stages = [{"w": jax.random.normal(k, (D, D)) * 0.5,
+               "b": jnp.zeros(D)} for k in keys]
+    stacked = stack_stage_params(stages)
+    x = jax.random.normal(jax.random.PRNGKey(1), (B, D))
+    return stacked, x
+
+
+def test_pipeline_matches_sequential(setup):
+    stacked, x = setup
+    ref = sequential_apply(stage_fn, stacked, x)
+    mesh = _pipe_mesh()
+    fn = make_pipeline(mesh, "pipe", stage_fn, n_microbatches=M)
+    got = fn(shard_pipeline_params(stacked, mesh, "pipe"), x)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_pipeline_single_microbatch_also_correct(setup):
+    stacked, x = setup
+    ref = sequential_apply(stage_fn, stacked, x)
+    mesh = _pipe_mesh()
+    fn = make_pipeline(mesh, "pipe", stage_fn, n_microbatches=1)
+    got = fn(shard_pipeline_params(stacked, mesh, "pipe"), x)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_pipeline_grads_reach_every_stage(setup):
+    stacked, x = setup
+    mesh = _pipe_mesh()
+    fn = make_pipeline(mesh, "pipe", stage_fn, n_microbatches=M)
+    sharded = shard_pipeline_params(stacked, mesh, "pipe")
+    y_t = jax.random.normal(jax.random.PRNGKey(2), (B, D))
+
+    def loss(p):
+        return jnp.mean((fn(p, x) - y_t) ** 2)
+
+    grads = jax.grad(loss)(sharded)
+    gw = np.asarray(grads["w"])
+    for s in range(S):
+        assert np.abs(gw[s]).sum() > 0, f"stage {s} got no gradient"
+
+    # and the sharded grads match the sequential formulation's grads
+    def ref_loss(p):
+        return jnp.mean((sequential_apply(stage_fn, p, x) - y_t) ** 2)
+
+    ref_grads = jax.grad(ref_loss)(stacked)
+    np.testing.assert_allclose(gw, np.asarray(ref_grads["w"]),
+                               rtol=2e-4, atol=1e-6)
+
+
+def test_pipeline_program_has_collective_permute(setup):
+    stacked, x = setup
+    mesh = _pipe_mesh()
+    fn = make_pipeline(mesh, "pipe", stage_fn, n_microbatches=M)
+    hlo = jax.jit(fn).lower(
+        shard_pipeline_params(stacked, mesh, "pipe"), x).compile().as_text()
+    assert "collective-permute" in hlo
