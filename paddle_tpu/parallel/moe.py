@@ -26,7 +26,6 @@ the two is pinned in ``tests/test_moe.py``.
 
 from __future__ import annotations
 
-import functools
 from typing import Dict
 
 import jax
@@ -93,7 +92,10 @@ def make_moe(mesh: Mesh, axis: str, n_experts: int, capacity: int):
     """Expert-parallel MoE over ``axis`` (one or more experts per device;
     ``n_experts`` must be divisible by the axis size). Returns
     ``fn(params, x) -> y`` with params sharded expert-major on ``axis``
-    and x batch-sharded on the data axis replicated over ``axis``."""
+    and ``x`` fully REPLICATED (in_specs pins it): every device routes
+    the whole batch and keeps only its experts' buffers. Shard the batch
+    upstream over the data axis and call this per data-shard if DP is
+    also in play."""
     n_dev = mesh.shape[axis]
     if n_experts % n_dev:
         raise ValueError(f"{n_experts} experts over {n_dev} devices")
@@ -104,9 +106,9 @@ def make_moe(mesh: Mesh, axis: str, n_experts: int, capacity: int):
         eid, gate = _route(x, params["wg"], n_experts)
         slot, keep = _dispatch_plan(eid, n_experts, capacity)
         d = x.shape[-1]
-        # build every expert's capacity buffer, then all_to_all so each
-        # device keeps only its local experts' buffers — one collective
-        # carrying [E, capacity, d] / n_dev per hop
+        # build every expert's capacity buffer locally (the batch is
+        # replicated, so all copies agree); keep this device's slice —
+        # the only collective is the all_gather of expert outputs below
         buf = jnp.zeros((n_experts, capacity, d), x.dtype)
         buf = buf.at[eid, jnp.clip(slot, 0, capacity - 1)].add(
             x * keep[:, None].astype(x.dtype))

@@ -113,7 +113,4 @@ def make_pipeline(mesh: Mesh, axis: str, stage_fn: Callable,
         in_specs=(P(axis), P()),
         out_specs=P(), check_vma=False)
 
-    def apply(params, x):
-        return fn(params, x)
-
-    return jax.jit(apply)
+    return jax.jit(fn)

@@ -31,7 +31,10 @@ def _fix_kwargs(kwargs):
         kwargs["pooling_type"] = _pool.resolve(kwargs["pooling_type"])
     la = kwargs.get("layer_attr")
     if la is not None and not isinstance(la, dict):
-        # ExtraAttr object → the dict form dsl accepts
+        # ExtraAttr object → the dict form dsl accepts. Two classes reach
+        # here: v2/attr.ExtraAttr (extras live in .kwargs) and the compat
+        # trainer_config_helpers ExtraAttr (named fields, no .kwargs) —
+        # handle both so device/drop_rate survive either spelling.
         d = dict(getattr(la, "kwargs", {}))
         if getattr(la, "drop_rate", None):
             d["drop_rate"] = la.drop_rate

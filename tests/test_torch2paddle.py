@@ -60,12 +60,15 @@ def test_cli_roundtrip(tmp_path):
     layers = tmp_path / "layers.txt"
     layers.write_text("fc1\nfc2\n")
     outdir = tmp_path / "out"
+    import os
+    import pathlib
+    repo_root = str(pathlib.Path(__file__).resolve().parents[1])
     proc = subprocess.run(
         [sys.executable, "-m", "paddle_tpu.utils.torch2paddle",
          "-i", str(pt), "-l", str(layers), "-o", str(outdir)],
         capture_output=True, text=True, timeout=240,
-        env={"JAX_PLATFORMS": "cpu", "PATH": __import__("os").environ["PATH"],
-             "PYTHONPATH": "/root/repo"})
+        env={"JAX_PLATFORMS": "cpu", "PATH": os.environ["PATH"],
+             "PYTHONPATH": repo_root})
     assert proc.returncode == 0, proc.stderr
     names = sorted(p.name for p in outdir.iterdir())
     assert names == ["_fc1.w0", "_fc1.wbias", "_fc2.w0", "_fc2.wbias"]

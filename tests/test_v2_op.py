@@ -82,6 +82,24 @@ def test_creator_text_file(paddle, tmp_path):
         assert line == f"{2*idx} {2*idx+1}"
 
 
+def test_layer_attr_device_survives_both_extraattr_classes(paddle):
+    """ExtraAttr(device=N) reaches LayerDef.attrs from BOTH spellings:
+    paddle.v2.attr.ExtraAttr (kwargs-based) and the compat
+    trainer_config_helpers ExtraAttr (named fields)."""
+    from paddle_tpu.compat.trainer_config_helpers.attrs import (
+        ExtraAttr as CompatExtra)
+    from paddle_tpu.config import dsl
+    from paddle_tpu.v2.attr import ExtraAttr as V2Extra
+
+    for attr in (V2Extra(device=1), CompatExtra(device=1)):
+        dsl.reset()
+        x = paddle.layer.data(name="x",
+                              type=paddle.data_type.dense_vector(8))
+        h = paddle.layer.fc(input=x, size=16, layer_attr=attr)
+        assert dsl.current_graph().layers[h.name].attrs.get("device") == 1, \
+            type(attr).__module__
+
+
 @needs_ref
 def test_parse_is_idempotent():
     """`test_reset_hook.py`: two parses of the same config serialize
