@@ -122,6 +122,21 @@ def fc(input, size: int, *, act: str = "tanh", name: str = None,
     return _add(ldef)
 
 
+def moe(input, *, expert_hidden: int, num_experts: int,
+        capacity: int = None, name: str = None) -> LayerOutput:
+    """Top-1 mixture-of-experts FFN (TPU-native capability-add; output
+    size = input size). Expert weights are ordinary parameters —
+    shard them over the model axis via shard_rules for expert
+    parallelism (`parallel/moe.py` documents the shard_map form)."""
+    src = _in(input)[0]
+    ldef = LayerDef(name=name or _auto_name("moe"), type="moe",
+                    inputs=[Input(src.name)], bias=False,
+                    attrs={"num_experts": num_experts,
+                           "expert_hidden": expert_hidden,
+                           "capacity": capacity})
+    return _add(ldef)
+
+
 def embedding(input, size: int, *, vocab_size: int = None, name: str = None,
               param_attr=None) -> LayerOutput:
     src = _in(input)[0]

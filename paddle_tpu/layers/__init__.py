@@ -18,3 +18,4 @@ from paddle_tpu.layers import misc  # noqa: F401
 from paddle_tpu.layers import sampling  # noqa: F401
 from paddle_tpu.layers import detection  # noqa: F401
 from paddle_tpu.layers import attention  # noqa: F401
+from paddle_tpu.layers import moe  # noqa: F401

@@ -1,0 +1,55 @@
+"""MoE as a first-class layer type (TPU-native capability-add).
+
+``dsl.moe(input, expert_hidden=..., num_experts=..., capacity=...)``
+registers a ``moe`` layer whose parameters live in the ordinary
+parameter table (so SGD/optimizers/checkpoints/shard_rules all apply):
+a top-1-routed expert FFN (``parallel/moe.py:moe_ffn`` math inline,
+batched [E, capacity, d] MXU matmuls, static shapes). Expert weights
+shard over the model axis with ``shard_rules={"_<name>.w1": P('model'),
+...}`` or automatically through ``parallel.moe.make_moe`` for the
+shard_map formulation.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.argument import Argument
+from paddle_tpu.core.registry import (LayerImpl, ParamSpec, ShapeInfo,
+                                      register_layer)
+
+
+@register_layer("moe")
+class MoELayer(LayerImpl):
+    """Top-1 mixture-of-experts FFN over the feature dim; output size =
+    input size. Capacity-clipped static dispatch (overflow tokens pass
+    through with a zero expert contribution, as in the library form)."""
+
+    def infer(self, cfg, in_infos):
+        return ShapeInfo(size=in_infos[0].size,
+                         is_sequence=in_infos[0].is_sequence)
+
+    def params(self, cfg, in_infos) -> Dict[str, ParamSpec]:
+        d = in_infos[0].size
+        e = int(cfg.attrs["num_experts"])
+        h = int(cfg.attrs["expert_hidden"])
+        return {
+            "wg": ParamSpec(shape=(d, e)),
+            "w1": ParamSpec(shape=(e, d, h)),
+            "b1": ParamSpec(shape=(e, h), init="zeros", is_bias=True),
+            "w2": ParamSpec(shape=(e, h, d)),
+            "b2": ParamSpec(shape=(e, d), init="zeros", is_bias=True),
+        }
+
+    def apply(self, cfg, params, ins, ctx):
+        from paddle_tpu.parallel.moe import moe_ffn
+        a = ins[0]
+        v = a.value
+        shape = v.shape
+        flat = v.reshape(-1, shape[-1])
+        cap = int(cfg.attrs.get("capacity") or flat.shape[0])
+        y = moe_ffn(params, flat, cap)
+        return Argument(value=y.reshape(shape), mask=a.mask)

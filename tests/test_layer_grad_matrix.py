@@ -640,6 +640,8 @@ FWD_CASES = {
 # points at the dedicated test file exercising it
 COVERED_ELSEWHERE = {
     "data": "fed directly by every test",
+    "moe": "tests/test_moe.py (routing boundaries break numeric diff; "
+           "gradient flow + sharded parity tested there)",
     "recurrent_layer_group": "tests/test_recurrent_group.py",
     "beam_search_group": "tests/test_generation.py, tests/test_seq_models.py",
     "group_output": "tests/test_recurrent_group.py",

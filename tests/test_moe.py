@@ -59,6 +59,44 @@ def test_capacity_clipping_is_static_and_effective():
     assert int(live) == 4  # overflow dropped, shapes static
 
 
+def test_moe_layer_trains_and_shards():
+    """`dsl.moe`: the registered layer type trains through SGD and its
+    expert weights shard over the model axis via shard_rules."""
+    from paddle_tpu.config import dsl
+    from paddle_tpu.data import DataFeeder, dense_vector, integer_value
+    from paddle_tpu.optim import Momentum
+    from paddle_tpu.trainer import SGD
+
+    def model():
+        dsl.reset()
+        x = dsl.data(name="x", size=D)
+        lab = dsl.data(name="label", size=4)
+        m = dsl.moe(input=x, expert_hidden=H, num_experts=E,
+                    capacity=CAP, name="mx")
+        out = dsl.fc(input=m, size=4, act="softmax", name="out")
+        return dsl.classification_cost(input=out, label=lab)
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, D).astype(np.float32)
+    Y = rng.randint(0, 4, 64)
+    feeder = DataFeeder({"x": dense_vector(D), "label": integer_value(4)})
+
+    mesh = create_mesh(n_data=2, n_model=4)
+    tr = SGD(cost=model(), update_equation=Momentum(learning_rate=0.1),
+             mesh=mesh,
+             shard_rules={"_mx.w1": P("model"), "_mx.b1": P("model"),
+                          "_mx.w2": P("model"), "_mx.b2": P("model")})
+    assert tr.params["_mx.w1"].sharding.spec == P("model")
+    errs = []
+    tr.train(lambda: iter([[(X[i], int(Y[i])) for i in range(64)]]),
+             feeder=feeder, num_passes=3,
+             event_handler=lambda e: errs.append(e) if hasattr(
+                 e, "evaluator") and e.evaluator else None)
+    assert np.isfinite(float(np.asarray(
+        tr.params["_mx.w1"]).sum()))  # trained, still sharded
+    assert tr.params["_mx.w1"].sharding.spec == P("model")
+
+
 def test_sharded_program_has_collective(setup):
     params, x = setup
     mesh = create_mesh(n_data=2, n_model=4)
