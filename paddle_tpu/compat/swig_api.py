@@ -351,8 +351,7 @@ class _ParameterBuffer:
                 else np.zeros(self._shape(), np.float32))
 
     def _shape(self):
-        return np.asarray(
-            jax.device_get(self._m._params[self._name])).shape
+        return tuple(self._m._params[self._name].shape)  # no transfer
 
     def getSize(self) -> int:
         return int(np.prod(self._shape()))
@@ -419,15 +418,14 @@ class Parameter:
         return self._pid
 
     def getSize(self) -> int:
-        return int(np.prod(np.asarray(
-            jax.device_get(self._m._params[self._name])).shape))
+        return int(np.prod(self._m._params[self._name].shape))
 
     def getBuf(self, kind=PARAMETER_VALUE) -> _ParameterBuffer:
         return _ParameterBuffer(self._m, self._name, kind)
 
     def getConfig(self) -> ParameterConfigView:
-        shape = np.asarray(jax.device_get(self._m._params[self._name])).shape
-        return ParameterConfigView(self._name, shape)
+        return ParameterConfigView(
+            self._name, tuple(self._m._params[self._name].shape))
 
     def getBufs(self):
         """(value, gradient, slot...) Vector views; the value view
@@ -624,6 +622,7 @@ class GradientMachine:
         feed = self._feed_from(inArgs)
         if passType == PASS_TRAIN:
             self._rng, r = jax.random.split(self._rng)
+            self._last_rng = r  # backward() must see the SAME dropout
             outputs = self._fwd(self._params, feed, r)
         else:
             outputs = self._fwd_test(self._params, feed)
@@ -645,10 +644,14 @@ class GradientMachine:
         """Backward over the LAST forward's batch, then the per-parameter
         update callback — the pipelined-update-during-backward protocol
         (``TrainerInternal.cpp:70-74``; here gradients arrive all at once
-        from ``jax.grad``, so the callback runs per parameter after)."""
+        from ``jax.grad``, so the callback runs per parameter after).
+        Reuses the last PASS_TRAIN forward's rng so gradients belong to
+        the same dropout realization the caller observed."""
         if self._last_feed is None:
             raise RuntimeError("backward() needs a prior forward()")
-        self._rng, r = jax.random.split(self._rng)
+        r = getattr(self, "_last_rng", None)
+        if r is None:
+            self._rng, r = jax.random.split(self._rng)
         (_, (outputs, updates)), grads = self._grad_fn(
             self._params, self._last_feed, r)
         self._grads = grads
@@ -687,6 +690,8 @@ class ParameterUpdater:
     finishBatch) → [apply/restore for model-average test] → finishPass."""
 
     def __init__(self, optimizer):
+        if hasattr(optimizer, "make_optimizer"):
+            optimizer = optimizer.make_optimizer()  # OptimizationConfig
         self._opt = optimizer
         self._m: Optional[GradientMachine] = None
         self._bsz = 1
@@ -884,7 +889,17 @@ class Trainer:
 
     @staticmethod
     def create(config, machine: GradientMachine) -> "Trainer":
-        opt = config.optimizer() if hasattr(config, "optimizer") else config
+        # accepted spellings: a ParsedConfig (parse_config return), this
+        # module's TrainerConfig/OptimizationConfig handles, or a bare
+        # engine Optimizer
+        if isinstance(config, TrainerConfig):
+            opt = config.getOptimizationConfig().make_optimizer()
+        elif isinstance(config, OptimizationConfig):
+            opt = config.make_optimizer()
+        elif hasattr(config, "optimizer"):
+            opt = config.optimizer()
+        else:
+            opt = config
         updater = ParameterUpdater(opt)
         updater.init(machine)
         return Trainer(machine, updater)

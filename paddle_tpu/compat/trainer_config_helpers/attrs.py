@@ -77,7 +77,7 @@ class ParameterAttribute:
                     # (ParameterConfig.proto sparsity_ratio [default=0.6])
                     ratio = (h.sparsity_ratio
                              if h.sparsity_ratio is not None else 0.6)
-        return _EngineParamAttr(
+        attr = _EngineParamAttr(
             name=self.name, init=init, sparsity_ratio=ratio,
             initial_mean=0.0 if mean is None else mean,
             initial_std=std, is_static=self.is_static,
@@ -85,6 +85,13 @@ class ParameterAttribute:
                            else self.learning_rate),
             l1_rate=self.l1_rate, l2_rate=self.l2_rate,
             sparse_grad=bool(self.sparse_update))
+        # an attr that sets only non-init knobs (lr, decay, static, name)
+        # must not clobber a layer's deliberate const init (e.g. BN gamma
+        # = 1.0): record whether the INIT values themselves are explicit
+        attr.init_explicit = (self.initial_mean is not None
+                              or self.initial_std is not None
+                              or self.initial_max is not None)
+        return attr
 
     @staticmethod
     def to_bias(bias_attr):

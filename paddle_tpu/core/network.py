@@ -62,11 +62,20 @@ def _apply_attr(spec: ParamSpec, attr: Optional[ParamAttr]) -> ParamSpec:
                                                                "zeros"):
         # parse-wide defaults don't override deliberate constant inits
         return spec
+    # an attr carrying NO explicit init values (just lr/static/name/...)
+    # keeps the layer's deliberate init — e.g. batch-norm gamma's const
+    # 1.0 must survive ParamAttr(learning_rate=...) (init_explicit is set
+    # by to_param_attr; raw ParamAttr objects count std as the marker)
+    init_explicit = getattr(attr, "init_explicit",
+                            attr.initial_std is not None
+                            or attr.init != "normal")
+    keep_init = (not init_explicit) and spec.init in ("const", "zeros")
     return dataclasses.replace(
         spec,
-        init=attr.init if attr.init != "normal" or attr.initial_std is not None
-        else spec.init,
-        initial_mean=attr.initial_mean,
+        init=spec.init if keep_init else (
+            attr.init if attr.init != "normal"
+            or attr.initial_std is not None else spec.init),
+        initial_mean=spec.initial_mean if keep_init else attr.initial_mean,
         initial_std=attr.initial_std if attr.initial_std is not None
         else spec.initial_std,
         is_static=attr.is_static or spec.is_static,
@@ -213,8 +222,32 @@ class Network:
                 if layer.attrs.get("recompute") and train:
                     # per-layer rematerialization: trade recompute FLOPs
                     # for activation HBM (jax.checkpoint; the TPU-native
-                    # render of memory-pressure knobs)
-                    out, new_state = jax.checkpoint(compute)(lparams, ins)
+                    # render of memory-pressure knobs). Static Python
+                    # metadata in Argument.state (e.g. a nested group's
+                    # shape ints) must NOT pass through checkpoint as
+                    # pytree leaves — it would come back as tracers and
+                    # break downstream shape arithmetic — so array leaves
+                    # go through and statics rejoin outside (the cell is
+                    # filled at trace time).
+                    cell = {}
+
+                    def arrays_only(lp, ins_t):
+                        res = compute(lp, ins_t)
+                        leaves, td = jax.tree_util.tree_flatten(res)
+                        is_arr = [isinstance(v, jax.Array) for v in leaves]
+                        cell["td"] = td
+                        cell["static"] = [None if a else v
+                                          for v, a in zip(leaves, is_arr)]
+                        cell["is_arr"] = is_arr
+                        return [v for v, a in zip(leaves, is_arr) if a]
+
+                    arrs = jax.checkpoint(arrays_only)(lparams, ins)
+                    it = iter(arrs)
+                    leaves = [next(it) if a else s
+                              for a, s in zip(cell["is_arr"],
+                                              cell["static"])]
+                    out, new_state = jax.tree_util.tree_unflatten(
+                        cell["td"], leaves)
                 else:
                     out, new_state = compute(lparams, ins)
                 ctx.state_updates.update(new_state)

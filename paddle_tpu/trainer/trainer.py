@@ -162,13 +162,25 @@ class SGD:
         if self.compute_dtype is None:
             return tree
         dt = self.compute_dtype
+        from paddle_tpu.core.argument import Argument
 
         def cast(x):
             if hasattr(x, "dtype") and x.dtype == jnp.float32:
                 return x.astype(dt)
             return x
 
-        return jax.tree_util.tree_map(cast, tree)
+        def go(x):
+            if isinstance(x, Argument):
+                # masks are COUNT/index data: summed for token counts and
+                # per-row lengths, where bf16 saturates at 256 — they must
+                # stay f32. Only values (and carried state) compute in dt.
+                return x.replace(
+                    value=jax.tree_util.tree_map(cast, x.value),
+                    state=jax.tree_util.tree_map(cast, x.state))
+            return cast(x)
+
+        return jax.tree_util.tree_map(
+            go, tree, is_leaf=lambda x: isinstance(x, Argument))
 
     def _cast_f32(self, tree):
         if self.compute_dtype is None:
@@ -214,19 +226,41 @@ class SGD:
         network, optimizer, meta = self.network, self.optimizer, self.meta
         cost_name = self.topology.cost_name
         carry_layers = self._carry_layers
+        # gradient_printer evaluators need d(cost)/d(layer output) FOR THE
+        # BATCH BEING STEPPED (the reference prints Argument.grad during
+        # that batch's backward). Probes ride the SAME backward pass, so
+        # the printed grads belong to the pre-update parameters — a lazy
+        # recompute after the update would be one step stale (and
+        # pre-update params can't be kept around: they're donated).
+        grad_watch = sorted({
+            n for e, ins, _ in self._host_evals
+            if getattr(e, "wants_grad", False) for n in ins
+            if n in self.network.shape_infos})
 
-        def loss_fn(params, feed, rng, carried):
+        def loss_fn(params, feed, rng, carried, probes=None):
             outputs, updates = network.apply_with_state(
                 self._cast_compute(params), self._cast_compute(feed),
-                train=True, rng=rng, carried=carried)
+                train=True, rng=rng, carried=carried, probes=probes)
             return self._total_cost(outputs), (outputs, updates)
 
         def step(params, opt_state, feed, rng, num_passes, carried=None):
             if carried is not None:
                 # truncated BPTT: no gradient across the batch boundary
                 carried = jax.lax.stop_gradient(carried)
-            (_, (outputs, updates)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params, feed, rng, carried)
+            probe_grads = None
+            if grad_watch:
+                shapes = jax.eval_shape(
+                    lambda p: loss_fn(p, feed, rng, carried)[1][0], params)
+                probes = {n: jnp.zeros(shapes[n].value.shape,
+                                       shapes[n].value.dtype)
+                          for n in grad_watch}
+                (_, (outputs, updates)), (grads, probe_grads) = \
+                    jax.value_and_grad(loss_fn, argnums=(0, 4),
+                                       has_aux=True)(
+                        params, feed, rng, carried, probes)
+            else:
+                (_, (outputs, updates)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params, feed, rng, carried)
             # grads are already f32 (cotangents take the f32 params' dtype);
             # only the moving-stat updates computed in bf16 need casting
             updates = self._cast_f32(updates)
@@ -249,6 +283,10 @@ class SGD:
 
                 metrics["carried"] = jax.lax.stop_gradient(
                     {n: final_state(n) for n in carry_layers})
+            if probe_grads is not None:
+                metrics["probe_grads"] = {
+                    n: g.astype(jnp.float32)
+                    for n, g in probe_grads.items()}
             return new_params, new_opt, metrics
 
         return jax.jit(step, donate_argnums=(0, 1))
@@ -458,16 +496,14 @@ class SGD:
         if not outs or not self._host_evals:
             return
         host = jax.device_get(outs)
-        grad_watch = sorted({
-            n for e, ins, _ in self._host_evals
-            if getattr(e, "wants_grad", False) for n in ins if n in host})
-        if grad_watch and feed is not None:
-            # only the LAST batch's gradient is ever printed (value() at
-            # EndPass), so don't pay a second forward+backward per batch:
-            # stash the context and compute lazily at print time
-            self._pending_grad = (feed, rng, {
-                n: np.zeros_like(np.asarray(host[n][0]))
-                for n in grad_watch})
+        probe_grads = metrics.get("probe_grads")
+        if probe_grads is not None:
+            # d(cost)/d(layer output) computed in the SAME backward as the
+            # batch's step (pre-update params, reference semantics)
+            pg = jax.device_get(probe_grads)
+            for e, ins, _ in self._host_evals:
+                if getattr(e, "wants_grad", False) and ins and ins[0] in pg:
+                    e.last = pg[ins[0]]
         for e, ins, roles in self._host_evals:
             if not ins or ins[0] not in host:
                 continue
@@ -485,36 +521,8 @@ class SGD:
                 kwargs["query_id"] = rest.pop(0)
             e.eval_batch(vals[0], **kwargs)
 
-    def _layer_grad_fn(self):
-        """Jitted d(cost)/d(layer output) via output probes (lazy; only
-        built when a gradient_printer evaluator is wired)."""
-        if getattr(self, "_grad_probe_fn", None) is None:
-            network = self.network
-
-            def fn(params, feed, rng, probes):
-                def f(pr):
-                    outs, _ = network.apply_with_state(
-                        self._cast_compute(params),
-                        self._cast_compute(feed),
-                        train=True, rng=rng, probes=pr)
-                    return self._total_cost(outs)
-
-                return jax.grad(f)(probes)
-
-            self._grad_probe_fn = jax.jit(fn)
-        return self._grad_probe_fn
-
     def host_eval_values(self, include_printers: bool = True
                          ) -> Dict[str, float]:
-        if include_printers and getattr(self, "_pending_grad", None):
-            feed, rng, zeros = self._pending_grad
-            self._pending_grad = None
-            probes = {n: jnp.asarray(z) for n, z in zeros.items()}
-            grads = jax.device_get(
-                self._layer_grad_fn()(self.params, feed, rng, probes))
-            for e, ins, _ in self._host_evals:
-                if getattr(e, "wants_grad", False) and ins:
-                    e.last = grads.get(ins[0], e.last)
         return {e.name: e.value() for e, _, _ in self._host_evals
                 if include_printers or not e.prints_on_value}
 

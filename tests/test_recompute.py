@@ -57,6 +57,49 @@ def test_recompute_matches_plain():
     assert not np.allclose(p1["_hb.w1"], 0.0)
 
 
+def test_recompute_on_nested_group_keeps_static_state():
+    """A recomputed layer whose Argument.state carries static Python
+    metadata (a nested group's shape ints) must not leak that metadata
+    through jax.checkpoint as tracers — downstream shape arithmetic
+    stays static."""
+    from paddle_tpu.core.network import Network
+
+    B, S, T, D_ = 2, 3, 4, 5
+    dsl.reset()
+    x = dsl.data(name="x", size=D_, is_sequence=True)
+
+    def outer_step(sub):
+        def inner_step(xt):
+            m = dsl.memory(name="h", size=D_)
+            return dsl.fc(input=[xt, m], size=D_, act="tanh", name="h",
+                          bias_attr=False)
+
+        inner = dsl.recurrent_group(inner_step, sub, name="inner_rnn")
+        return dsl.last_seq(inner, name="olast")
+
+    out = dsl.recurrent_group(outer_step, dsl.SubsequenceInput(x),
+                              name="outer_rnn")
+    pooled = dsl.pooling(input=out, pooling_type="avg", name="pooled")
+    graph = dsl.current_graph()
+    graph.layers[out.name].attrs["recompute"] = True
+
+    net = Network(graph, outputs=[pooled.name])
+    params = net.init_params(jax.random.PRNGKey(0))
+    feed = {"x": Argument(
+        value=jnp.asarray(np.random.RandomState(0).randn(
+            B, S, T, D_).astype(np.float32)),
+        mask=jnp.ones((B, S, T), jnp.float32))}
+
+    def loss(p):
+        return jnp.sum(net.apply(p, feed, train=True,
+                                 rng=jax.random.PRNGKey(1))[
+                                     pooled.name].value ** 2)
+
+    val, grads = jax.jit(jax.value_and_grad(loss))(params)
+    assert np.isfinite(float(val))
+    assert float(jnp.abs(grads["_h.w0"]).sum()) > 0
+
+
 def test_recompute_emits_remat_region():
     tr = SGD(cost=_model(True),
              update_equation=Momentum(learning_rate=0.1), seed=3)

@@ -110,3 +110,64 @@ def test_lstm_sequence_classification():
                   event_handler=lambda e: costs.append(e.cost)
                   if hasattr(e, "cost") else None)
     assert costs[-1] < 0.8 * costs[0], (costs[0], costs[-1])
+
+
+def test_bf16_compute_keeps_masks_f32():
+    """Mixed precision must NOT cast sequence masks: they are count data
+    (token sums, per-row lengths) and bf16 saturates at 256 — a batch
+    with >256 live tokens would report garbage error denominators."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.config import dsl
+    from paddle_tpu.core.argument import Argument
+    from paddle_tpu.optim import Momentum
+    from paddle_tpu.trainer import SGD
+
+    dsl.reset()
+    x = dsl.data(name="x", size=4, is_sequence=True)
+    lab = dsl.data(name="label", size=2)
+    pooled = dsl.pooling(input=dsl.fc(input=x, size=8), pooling_type="avg")
+    out = dsl.fc(input=pooled, size=2, act="softmax")
+    cost = dsl.classification_cost(input=out, label=lab)
+    tr = SGD(cost=cost, update_equation=Momentum(learning_rate=0.1),
+             compute_dtype="bfloat16")
+
+    # 2 x 300 = 600 live tokens: far past bf16's 256 integer ceiling
+    feed = {
+        "x": Argument(value=jnp.ones((2, 300, 4), jnp.float32),
+                      mask=jnp.ones((2, 300), jnp.float32)),
+        "label": Argument(value=jnp.zeros((2,), jnp.int32)),
+    }
+    cast = tr._cast_compute(feed)
+    assert cast["x"].value.dtype == jnp.bfloat16
+    assert cast["x"].mask.dtype == jnp.float32  # counts stay exact
+    assert float(jnp.sum(cast["x"].mask)) == 600.0
+
+
+def test_param_attr_without_init_keeps_const_init():
+    """An explicit ParamAttr carrying only non-init knobs (learning_rate)
+    must not clobber a layer's deliberate const init — batch-norm gamma
+    stays 1.0 (the reference's BN gamma default)."""
+    import numpy as np
+
+    import jax
+
+    from paddle_tpu.compat import parse_config_and_serialize  # noqa: F401
+    from paddle_tpu.compat.config_parser import begin_parse
+    from paddle_tpu.compat.trainer_config_helpers import (batch_norm_layer,
+                                                          data_layer)
+    from paddle_tpu.compat.trainer_config_helpers.attrs import (
+        ParameterAttribute)
+    from paddle_tpu.config import dsl
+    from paddle_tpu.core.network import Network
+
+    begin_parse()
+    din = data_layer(name="input", size=8)
+    bn = batch_norm_layer(input=din, name="bn",
+                          param_attr=ParameterAttribute(learning_rate=0.1))
+    net = Network(dsl.current_graph(), outputs=[bn.name])
+    params = net.init_params(jax.random.PRNGKey(0))
+    gamma = np.asarray(params["_bn.w0"])
+    np.testing.assert_allclose(gamma, 1.0)  # const init survives
+    # and the lr override itself took effect
+    assert net.param_specs["_bn.w0"].learning_rate == 0.1
