@@ -29,11 +29,31 @@ class DataProviderConverter:
         for i, t in enumerate(self.input_types):
             col = [row[i] for row in batch]
             if t.seq_type != NO_SEQUENCE:
-                raise NotImplementedError(
-                    "sequence slots in DataProviderConverter: use "
-                    "paddle_tpu.data.DataFeeder (padded+masked layout) "
-                    "instead of the offset-vector Arguments API")
-            if t.type == INDEX:
+                # flat concatenation + offset vector, the reference's
+                # Argument layout (dataprovider_converter.py:308); the
+                # machine re-shapes to padded+masked at feed time
+                starts = np.zeros(len(col) + 1, np.int32)
+                for j, seq in enumerate(col):
+                    starts[j + 1] = starts[j] + len(seq)
+                if t.type == INDEX:
+                    flat = np.concatenate(
+                        [np.asarray(s, np.int32) for s in col]) \
+                        if col else np.zeros(0, np.int32)
+                    args.setSlotIds(
+                        i, swig_paddle.IVector.createVectorFromNumpy(flat))
+                elif t.type == DENSE:
+                    flat = np.concatenate(
+                        [np.asarray(s, np.float32).reshape(len(s), -1)
+                         for s in col]) if col \
+                        else np.zeros((0, t.dim), np.float32)
+                    args.setSlotValue(
+                        i, swig_paddle.Matrix.createDenseFromNumpy(flat))
+                else:
+                    raise NotImplementedError(
+                        f"sequence slot type {t.type!r}")
+                args.setSlotSequenceStartPositions(
+                    i, swig_paddle.IVector.createVectorFromNumpy(starts))
+            elif t.type == INDEX:
                 args.setSlotIds(i, swig_paddle.IVector.createVectorFromNumpy(
                     np.asarray(col, np.int32)))
             elif t.type == DENSE:

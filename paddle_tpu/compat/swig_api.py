@@ -309,6 +309,15 @@ class Arguments:
     def getSlotIds(self, i) -> IVector:
         return self._slots[i]["ids"]
 
+    def setSlotSequenceStartPositions(self, i, starts: "IVector"):
+        """Offset vector marking sequence boundaries within the flat slot
+        (``Argument::sequenceStartPositions``); the engine converts to
+        its padded+masked layout at feed time."""
+        self._slot(i)["seq_starts"] = starts
+
+    def getSlotSequenceStartPositions(self, i) -> "IVector":
+        return self._slots[i]["seq_starts"]
+
     def setSlotFrameHeight(self, i, h):
         self._slot(i)["frame_height"] = h
 
@@ -572,12 +581,23 @@ class GradientMachine:
         return names
 
     def _feed_from(self, args: Arguments) -> Dict[str, Any]:
-        from paddle_tpu.core.argument import Argument
+        from paddle_tpu.core.argument import Argument, from_ragged
         names = self._input_names()
         feed = {}
         for i, name in enumerate(names[: args.size()]):
             slot = args._slots[i]
-            if "ids" in slot:
+            starts = slot.get("seq_starts")
+            if starts is not None:
+                # flat (totalTokens, ...) + offsets -> padded + masked
+                # (the engine's native layout; Argument.h:84 semantics)
+                offs = list(starts._a)
+                flat = (slot["ids"]._a if "ids" in slot
+                        else slot["value"]._a)
+                dtype = np.int32 if "ids" in slot else np.float32
+                rows = [flat[offs[j]:offs[j + 1]]
+                        for j in range(len(offs) - 1)]
+                feed[name] = from_ragged(rows, dtype=dtype)
+            elif "ids" in slot:
                 feed[name] = Argument(value=jnp.asarray(
                     slot["ids"]._a, jnp.int32))
             elif "value" in slot:

@@ -259,6 +259,58 @@ def test_raw_parameter_optimizer_flow(api):
             rtol=1e-6)
 
 
+def test_sequence_slots_through_raw_api(api):
+    """DataProviderConverter sequence slots: flat tokens + offset vector
+    (the reference's Argument layout) feed a sequence model through the
+    raw API and it learns."""
+    from py_paddle import DataProviderConverter
+    import paddle_tpu.v2 as paddle_v2
+
+    words = paddle_v2.layer.data(
+        name="w", type=paddle_v2.data_type.integer_value_sequence(16))
+    label = paddle_v2.layer.data(
+        name="label", type=paddle_v2.data_type.integer_value(2))
+    emb = paddle_v2.layer.embedding(input=words, size=8)
+    pooled = paddle_v2.layer.pooling(
+        input=emb, pooling_type=paddle_v2.pooling.Max())
+    out = paddle_v2.layer.fc(input=pooled, size=2,
+                             act=paddle_v2.activation.Softmax())
+    cost = paddle_v2.layer.classification_cost(input=out, label=label)
+
+    m = api.GradientMachine.createFromConfigProto(
+        paddle_v2.layer.parse_network(cost))
+    optimizer = paddle_v2.optimizer.Adam(learning_rate=5e-2)
+    updater = optimizer.create_local_updater()
+    updater.init(m)
+    converter = DataProviderConverter(input_types=[words.type, label.type])
+
+    rng = np.random.RandomState(0)
+    # separable: label = whether token 0 appears
+    def make_batch(n=32):
+        rows = []
+        for _ in range(n):
+            lab = int(rng.randint(2))
+            pool = [0, 1, 2] if lab else [3, 4, 5]
+            seq = list(rng.choice(pool, size=rng.randint(2, 6)))
+            rows.append((seq, lab))
+        return rows
+
+    outArgs = api.Arguments.createArguments(0)
+    ev = m.makeEvaluator()
+    errs = []
+    for _ in range(15):
+        batch = make_batch()
+        pt = updater.startBatch(len(batch))
+        ev.start()
+        m.forwardBackward(converter(batch), outArgs, pt)
+        for p in m.getParameters():
+            updater.update(p)
+        m.eval(ev)
+        updater.finishBatch(0.0)
+        errs.append(ev.getError())
+    assert errs[-1] < errs[0], errs
+
+
 @needs_ref
 def test_trainer_flow(api):
     """`paddle/api/test/testTrainer.py`: Trainer.create over the parsed
