@@ -264,5 +264,7 @@ def build_optimizer(s) -> _opt.Optimizer:
     avg = s.get("model_average")
     if isinstance(avg, ModelAverage):
         kwargs["average_window"] = avg.average_window
+        if avg.max_average_window is not None:
+            kwargs["max_average_window"] = avg.max_average_window
     kwargs.update(method.engine_kwargs())
     return cls(**kwargs)

@@ -83,7 +83,12 @@ class RecurrentLayerGroup(LayerImpl):
                 elif a.mask is None:
                     # maskless [B, T, D] still walks as a full-length
                     # sequence; flat maskless values broadcast (the
-                    # reference's non-sequence in-link semantics)
+                    # reference's non-sequence in-link semantics). KNOWN
+                    # AMBIGUITY: a maskless [B, T] could also be an
+                    # equal-length id sequence — the reference has offsets
+                    # to disambiguate, the padded layout doesn't. Feed id
+                    # sequences WITH masks (every feeder does) to step
+                    # them.
                     kind = "seq" if a.value.ndim >= 3 else "static"
                 else:
                     kind = "seq"
@@ -230,8 +235,13 @@ class RecurrentLayerGroup(LayerImpl):
         main = out_names[0]
         extras = {o: jnp.swapaxes(ys[o], 0, 1) for o in out_names[1:]}
         y_main = jnp.swapaxes(ys[main], 0, 1)
-        sub_t = (next(iter(sub_masks.values())).shape[2]
-                 if sub_masks else None)
+        # the output follows the TARGET sub-link's sub-length, not the
+        # first one's (they differ when multiple subseq in-links carry
+        # different sub-paddings)
+        target = cfg.attrs.get("target_boundary")
+        sm_ref = (sub_masks.get(target, next(iter(sub_masks.values())))
+                  if sub_masks else None)
+        sub_t = sm_ref.shape[2] if sm_ref is not None else None
         if sub_xs and (net.shape_infos[main].is_sequence
                        or (y_main.ndim >= 4
                            and y_main.shape[2] == sub_t)):
@@ -245,10 +255,7 @@ class RecurrentLayerGroup(LayerImpl):
             # nested group's output feeds flat-level consumers
             Bq, Sq, Tq = y_main.shape[0], y_main.shape[1], y_main.shape[2]
             flat = y_main.reshape(Bq, Sq * Tq, *y_main.shape[3:])
-            target = cfg.attrs.get("target_boundary")
-            sm_src = sub_masks.get(target,
-                                   next(iter(sub_masks.values())))
-            sm = jnp.swapaxes(sm_src, 0, 1)
+            sm = jnp.swapaxes(sm_ref, 0, 1)
             # keep the un-flattened 2-level view alongside: TO_SEQUENCE
             # aggregations (seqlastins/pooling with agg_level=seq) need
             # the sub-sequence boundaries the flat layout erases; extra
@@ -279,14 +286,26 @@ class GroupOutput(LayerImpl):
         a = ins[0]
         v = a.state["group_outputs"][cfg.attrs["sub_name"]]
         state = None
-        if isinstance(a.state, dict) and "nested_tq" in a.state \
-                and a.mask is not None and v.ndim == 3:
-            tq = a.state["nested_tq"]
+        mask = a.mask
+        tq = (a.state or {}).get("nested_tq") \
+            if isinstance(a.state, dict) else None
+        if tq and mask is not None and v.ndim == 3 \
+                and v.shape[1] == mask.shape[1] and v.shape[1] % tq == 0:
+            # the extra was flattened [B, S*Tq, D] like the main output:
+            # re-attach the 2-level view for TO_SEQUENCE consumers
             B, ST = v.shape[0], v.shape[1]
             state = {"nested": (v.reshape(B, ST // tq, tq, v.shape[-1]),
-                                a.mask.reshape(B, ST // tq, tq)),
+                                mask.reshape(B, ST // tq, tq)),
                      "nested_tq": tq}
-        return Argument(value=v, mask=a.mask, state=state)
+        elif tq and mask is not None and v.ndim >= 2 \
+                and v.shape[1] * tq == mask.shape[1]:
+            # a PER-SUB-SEQUENCE extra ([B, S, ...], e.g. last_seq inside
+            # the step): the flat [B, S*Tq] mask doesn't apply — its
+            # outer-level mask is "sub-sequence has tokens"
+            sm = a.state["nested"][1] if "nested" in a.state else \
+                mask.reshape(v.shape[0], v.shape[1], tq)
+            mask = (jnp.sum(sm, axis=-1) > 0).astype(jnp.float32)
+        return Argument(value=v, mask=mask, state=state)
 
 
 @register_layer("beam_search_group")

@@ -37,8 +37,10 @@ class Optimizer:
     l1_rate: float = 0.0
     l2_rate: float = 0.0
     gradient_clipping_threshold: float = 0.0
-    # model averaging (``AverageOptimizer``): do_average window in [0, +)
+    # model averaging (``AverageOptimizer``): fraction of updates kept in
+    # the average (TrainerConfig.proto:74); >= 1 acts as an absolute window
     average_window: float = 0.0
+    max_average_window: float = float("inf")
     # Reference v1 gradient semantics (compat configs): parameter grads
     # are the batch SUM (sgdUpdateCpu applies learning_rate to the
     # accumulated gradient; ParameterUpdateFunctions.cpp:25-36, no batch
@@ -72,8 +74,13 @@ class Optimizer:
         return [0, 1] + [i + 2 for i, _ in enumerate(self.slot_names())]
 
     def _is_sparse(self, spec) -> bool:
+        # the lazy touched-rows path implements the PLAIN momentum
+        # recurrence; nesterov's lookahead has no closed-form row
+        # catch-up, so those parameters take the dense path (correct,
+        # just not lazy) to keep the documented dense==sparse property
         return (spec is not None and getattr(spec, "sparse_grad", False)
-                and hasattr(self, "_apply_sparse"))
+                and hasattr(self, "_apply_sparse")
+                and not getattr(self, "nesterov", False))
 
     def init(self, params: Dict[str, jnp.ndarray],
              meta: Optional[Dict[str, ParamSpec]] = None) -> Dict[str, Any]:
@@ -118,7 +125,11 @@ class Optimizer:
             num_passes=num_passes)
 
         new_params = dict(params)
-        new_slots = {}
+        # parameters whose gradient is absent this call keep their slots
+        # untouched (an API caller updating a subset must not erase
+        # momentum history / prune masks / t_rows for the rest)
+        new_slots = {n: s for n, s in state["slots"].items()
+                     if n not in grads}
         if self.sum_gradients:
             bsz = jnp.asarray(batch_size, jnp.float32)
             grads = {n: g * bsz for n, g in grads.items()}
@@ -157,9 +168,17 @@ class Optimizer:
 
         new_state = {"slots": new_slots, "t": t, "num_samples": num_samples}
         if "avg" in state:
-            # AverageOptimizer.h:23 — running average of parameter values
-            w = jnp.minimum(jnp.float32(t), jnp.float32(
-                max(self.average_window, 1.0)))
+            # AverageOptimizer: the window is a FRACTION of all updates so
+            # far — about average_window * numUpdates parameters are
+            # averaged (TrainerConfig.proto:70-74), capped by
+            # max_average_window (AverageOptimizer.h:83-88). Running
+            # average with the growing effective window W_t =
+            # clip(average_window * t, 1, max_average_window); values >= 1
+            # behave as an absolute window.
+            tf32 = t.astype(jnp.float32)
+            w = jnp.clip(jnp.float32(self.average_window) * tf32,
+                         1.0, jnp.float32(self.max_average_window))
+            w = jnp.minimum(tf32, w)
             new_state["avg"] = {
                 n: state["avg"][n] + (new_params[n] - state["avg"][n]) / w
                 for n in new_slots}

@@ -158,10 +158,17 @@ def replicate(tree, mesh: Mesh):
 
 
 def rule_for(name: str, rules: Optional[Dict[str, P]]) -> P:
-    """First rule whose key is a substring of ``name``; replicated default."""
+    """First rule whose key matches ``name``; replicated default.
+
+    A key starting with ``=`` matches the full name EXACTLY (used by the
+    auto-added per-parameter rules so a rule for ``_emb.w0`` can never
+    capture ``_user_emb.w0``); any other key matches as a substring."""
     if rules:
         for pat, s in rules.items():
-            if pat in name:
+            if pat.startswith("="):
+                if pat[1:] == name:
+                    return s
+            elif pat in name:
                 return s
     return P()
 
@@ -201,7 +208,7 @@ def effective_rules(param_specs, mesh: Mesh,
         return out
     for name, spec in param_specs.items():
         if getattr(spec, "sparse_grad", False) and rule_for(name, out) == P():
-            out[name] = P(MODEL_AXIS)
+            out["=" + name] = P(MODEL_AXIS)  # exact: no substring capture
     return out
 
 
@@ -229,13 +236,15 @@ def device_attr_rules(graph, param_specs, mesh: Mesh,
     if not pinned:
         return out
     for pname, spec in param_specs.items():
-        if any(pat in pname for pat in out):
-            continue  # an explicit rule names this parameter — it wins,
+        if any((pat[1:] == pname if pat.startswith("=") else pat in pname)
+               for pat in out):
+            continue  # a rule already names this parameter — it wins,
             # including an explicit P() asking for replication
         owner = pname[1:].rsplit(".", 1)[0] if pname.startswith("_") else None
         shape = getattr(spec, "shape", None)
         if owner in pinned and shape and shape[-1] % n_model == 0:
-            out[pname] = P(*([None] * (len(shape) - 1) + [MODEL_AXIS]))
+            out["=" + pname] = P(
+                *([None] * (len(shape) - 1) + [MODEL_AXIS]))
     return out
 
 

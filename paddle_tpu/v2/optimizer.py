@@ -30,6 +30,8 @@ def _translate(kwargs):
     ma = out.pop("model_average", None)
     if ma is not None:
         out["average_window"] = getattr(ma, "average_window", 0.0)
+        if getattr(ma, "max_average_window", None) is not None:
+            out["max_average_window"] = ma.max_average_window
     clip = out.pop("gradient_clipping_threshold", None)
     if clip is not None:
         out["gradient_clipping_threshold"] = getattr(

@@ -176,6 +176,40 @@ def test_model_averaging_apply():
     assert float(avg["w"][0]) < 0.0  # moved in the gradient direction
 
 
+def test_model_averaging_fractional_window_is_not_a_noop():
+    """The reference's average_window is a FRACTION of updates so far
+    (TrainerConfig.proto:70-74; ModelAverage(average_window=0.5) is the
+    normal v1 usage) — the averaged params must lag the raw iterates,
+    not equal them."""
+    opt = Momentum(learning_rate=0.5, average_window=0.5)
+    params = {"w": jnp.asarray(np.array([0.0], np.float32))}
+    state = opt.init(params)
+    for _ in range(8):
+        params, state = opt.update(
+            {"w": jnp.asarray(np.array([1.0], np.float32))}, state, params)
+    avg = opt.averaged_params(state, params)
+    assert float(avg["w"][0]) > float(params["w"][0]) + 1e-4  # lags
+    assert float(avg["w"][0]) < 0.0
+
+
+def test_update_with_partial_grads_keeps_other_slots():
+    """An update carrying gradients for a SUBSET of parameters must not
+    erase the others' optimizer state (momentum history stays intact and
+    later full updates keep working)."""
+    opt = Momentum(learning_rate=0.1, momentum=0.9)
+    params = {"a": jnp.zeros(2), "b": jnp.zeros(2)}
+    state = opt.init(params)
+    g = jnp.ones(2)
+    params, state = opt.update({"a": g, "b": g}, state, params)
+    mom_b = np.asarray(state["slots"]["b"]["mom"]).copy()
+    params, state = opt.update({"a": g}, state, params)  # subset
+    assert "b" in state["slots"], "b's slots erased by a partial update"
+    np.testing.assert_allclose(np.asarray(state["slots"]["b"]["mom"]),
+                               mom_b)
+    params2, state = opt.update({"a": g, "b": g}, state, params)
+    assert float(params2["b"][0]) != float(params["b"][0])  # still trains
+
+
 def test_static_pruning_hook_keeps_weights_zero():
     """StaticPruningHook (ParameterUpdaterHook.cpp:39): the smallest-|w|
     fraction is masked at init and stays exactly zero through updates."""
