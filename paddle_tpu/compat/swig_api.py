@@ -155,12 +155,12 @@ class Matrix:
     def get(self, x, y):
         # reference api/Matrix.cpp:116: x is the COLUMN, y the ROW
         # (element x + y * width)
-        if x >= self.getWidth() or y >= self.getHeight():
+        if not (0 <= x < self.getWidth() and 0 <= y < self.getHeight()):
             raise RangeError(f"({x}, {y}) out of {self._a.shape}")
         return float(self._a[y, x])
 
     def set(self, x, y, value):
-        if x >= self.getWidth() or y >= self.getHeight():
+        if not (0 <= x < self.getWidth() and 0 <= y < self.getHeight()):
             raise RangeError(f"({x}, {y}) out of {self._a.shape}")
         self._a[y, x] = value
 
@@ -224,12 +224,12 @@ class IVector:
         return self.getSize()
 
     def __getitem__(self, i):
-        if i >= self.getSize():
+        if not 0 <= i < self.getSize():
             raise RangeError(str(i))
         return int(self._a[i])
 
     def __setitem__(self, i, v):
-        if i >= self.getSize():
+        if not 0 <= i < self.getSize():
             raise RangeError(str(i))
         self._a[i] = v
 
@@ -658,6 +658,9 @@ class GradientMachine:
         self._grads = grads
         self._state_updates = dict(updates)
         self._last_outputs, self._last_feed = outputs, feed
+        # the scalar the loss_fn actually optimized (batch-mean over every
+        # cost layer) — callers read this instead of sniffing output slots
+        self._last_cost = float(jax.device_get(cost))
         self._fill_out(outputs, outArgs)
 
     def backward(self, callback=None):
@@ -941,8 +944,11 @@ class Trainer:
         self._machine.forwardBackward(args, self._outArgs, pt)
         for p in self._machine.getParameters():
             self._updater.update(p)
-        cost = self._outArgs.getSlotValue(0).copyToNumpyMat()
-        cost = float(cost.sum() / batch_size)
+        # the machine records the scalar its loss_fn optimized (batch-mean
+        # over all cost layers) — a config may declare a non-cost output
+        # in slot 0, so never sniff output slots for the cost
+        # (Trainer.cpp:402 likewise reads the machine's cost)
+        cost = self._machine._last_cost
         self._updater.finishBatch(cost)
         return cost
 

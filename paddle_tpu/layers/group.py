@@ -266,9 +266,12 @@ class RecurrentLayerGroup(LayerImpl):
                     if v.ndim >= 3 and v.shape[1] == Sq
                     and v.shape[2] == Tq else v)
                 for o, v in extras.items()}
+            # the nested view rides in state as an Argument so mask-aware
+            # machinery (e.g. the trainer's bf16 cast) exempts its mask
+            # structurally, by type — not by knowing this layer's keys
             return Argument(value=flat, mask=sm.reshape(Bq, Sq * Tq),
                             state={"group_outputs": extras, "final": carry,
-                                   "nested": (y_main, sm),
+                                   "nested": Argument(value=y_main, mask=sm),
                                    "nested_tq": Tq})
         return Argument(value=y_main, mask=mask,
                         state={"group_outputs": extras, "final": carry})
@@ -294,15 +297,16 @@ class GroupOutput(LayerImpl):
             # the extra was flattened [B, S*Tq, D] like the main output:
             # re-attach the 2-level view for TO_SEQUENCE consumers
             B, ST = v.shape[0], v.shape[1]
-            state = {"nested": (v.reshape(B, ST // tq, tq, v.shape[-1]),
-                                mask.reshape(B, ST // tq, tq)),
+            state = {"nested": Argument(
+                        value=v.reshape(B, ST // tq, tq, v.shape[-1]),
+                        mask=mask.reshape(B, ST // tq, tq)),
                      "nested_tq": tq}
         elif tq and mask is not None and v.ndim >= 2 \
                 and v.shape[1] * tq == mask.shape[1]:
             # a PER-SUB-SEQUENCE extra ([B, S, ...], e.g. last_seq inside
             # the step): the flat [B, S*Tq] mask doesn't apply — its
             # outer-level mask is "sub-sequence has tokens"
-            sm = a.state["nested"][1] if "nested" in a.state else \
+            sm = a.state["nested"].mask if "nested" in a.state else \
                 mask.reshape(v.shape[0], v.shape[1], tq)
             mask = (jnp.sum(sm, axis=-1) > 0).astype(jnp.float32)
         return Argument(value=v, mask=mask, state=state)

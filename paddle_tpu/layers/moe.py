@@ -51,5 +51,9 @@ class MoELayer(LayerImpl):
         shape = v.shape
         flat = v.reshape(-1, shape[-1])
         cap = int(cfg.attrs.get("capacity") or flat.shape[0])
-        y = moe_ffn(params, flat, cap)
+        # Dead (padded) positions must not claim capacity slots — a
+        # padded batch would otherwise crowd out live tokens and the
+        # output would change with padding amount (ragged invariant).
+        live = a.mask.reshape(-1) if a.mask is not None else None
+        y = moe_ffn(params, flat, cap, live=live)
         return Argument(value=y.reshape(shape), mask=a.mask)

@@ -27,7 +27,8 @@ def _nested_view(a):
     group's stashed un-flattened view or a directly nested Argument."""
     if a.state is not None and isinstance(a.state, dict) \
             and "nested" in a.state:
-        return a.state["nested"]
+        nested = a.state["nested"]
+        return nested.value, nested.mask
     if a.mask is not None and a.mask.ndim == 3:
         return a.value, a.mask
     return None

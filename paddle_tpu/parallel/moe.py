@@ -61,22 +61,29 @@ def init_moe_params(key, d_model: int, d_hidden: int, n_experts: int
     }
 
 
-def _dispatch_plan(eid, n_experts, capacity):
+def _dispatch_plan(eid, n_experts, capacity, live=None):
     """Position of each token within its expert's capacity slots, and a
-    keep-mask for tokens under capacity (static shapes throughout)."""
+    keep-mask for tokens under capacity (static shapes throughout).
+
+    ``live`` ([B] bool/0-1, optional) marks real tokens: dead (padded)
+    positions claim no capacity slot and are excluded from ``keep``, so
+    a padded batch routes identically to its unpadded equivalent."""
     onehot = jax.nn.one_hot(eid, n_experts, dtype=jnp.int32)   # [B, E]
+    if live is not None:
+        onehot = onehot * live.astype(jnp.int32)[:, None]
     pos = jnp.cumsum(onehot, axis=0) * onehot                  # 1-based
     slot = jnp.sum(pos, axis=-1) - 1                           # [B]
-    keep = slot < capacity
+    keep = (slot < capacity) & (slot >= 0)
     return slot, keep
 
 
-def moe_ffn(params, x, capacity: int):
+def moe_ffn(params, x, capacity: int, live=None):
     """Single-device reference: identical math to the sharded version
-    (capacity clipping included), dense per-expert batches."""
+    (capacity clipping included), dense per-expert batches. ``live``
+    excludes masked/padded tokens from dispatch (they produce zeros)."""
     n_experts = params["wg"].shape[-1]
     eid, gate = _route(x, params["wg"], n_experts)
-    slot, keep = _dispatch_plan(eid, n_experts, capacity)
+    slot, keep = _dispatch_plan(eid, n_experts, capacity, live)
     d = x.shape[-1]
     # scatter tokens into [E, capacity, d] buffers
     buf = jnp.zeros((n_experts, capacity, d), x.dtype)
@@ -95,16 +102,18 @@ def make_moe(mesh: Mesh, axis: str, n_experts: int, capacity: int):
     and ``x`` fully REPLICATED (in_specs pins it): every device routes
     the whole batch and keeps only its experts' buffers. Shard the batch
     upstream over the data axis and call this per data-shard if DP is
-    also in play."""
+    also in play. ``fn(params, x, live)`` takes a [B] 0-1 live mask
+    (pass ones for fully-dense batches): dead/padded tokens claim no
+    capacity slot, matching ``moe_ffn``'s ragged semantics exactly."""
     n_dev = mesh.shape[axis]
     if n_experts % n_dev:
         raise ValueError(f"{n_experts} experts over {n_dev} devices")
     e_local = n_experts // n_dev
 
-    def local(params, x):
+    def local(params, x, live):
         # x: the full (replicated-over-axis) token batch [B, d]
         eid, gate = _route(x, params["wg"], n_experts)
-        slot, keep = _dispatch_plan(eid, n_experts, capacity)
+        slot, keep = _dispatch_plan(eid, n_experts, capacity, live)
         d = x.shape[-1]
         # build every expert's capacity buffer locally (the batch is
         # replicated, so all copies agree); keep this device's slice —
@@ -130,9 +139,16 @@ def make_moe(mesh: Mesh, axis: str, n_experts: int, capacity: int):
     fn = shard_map(
         local, mesh=mesh,
         in_specs=({"wg": P(), "w1": P(axis), "b1": P(axis),
-                   "w2": P(axis), "b2": P(axis)}, P()),
+                   "w2": P(axis), "b2": P(axis)}, P(), P()),
         out_specs=P(), check_vma=False)
-    return jax.jit(fn)
+    jitted = jax.jit(fn)
+
+    def call(params, x, live=None):
+        if live is None:
+            live = jnp.ones((x.shape[0],), x.dtype)
+        return jitted(params, x, live)
+
+    return call
 
 
 def shard_moe_params(params, mesh: Mesh, axis: str):

@@ -174,9 +174,15 @@ class SGD:
                 # masks are COUNT/index data: summed for token counts and
                 # per-row lengths, where bf16 saturates at 256 — they must
                 # stay f32. Only values (and carried state) compute in dt.
+                # The recursion treats nested Arguments inside state as
+                # leaves too, so a mask carried anywhere in state (e.g. a
+                # group's state["nested"] Argument, layers/group.py) is
+                # exempted structurally — by type, not by key name.
                 return x.replace(
                     value=jax.tree_util.tree_map(cast, x.value),
-                    state=jax.tree_util.tree_map(cast, x.state))
+                    state=jax.tree_util.tree_map(
+                        go, x.state,
+                        is_leaf=lambda s: isinstance(s, Argument)))
             return cast(x)
 
         return jax.tree_util.tree_map(

@@ -31,6 +31,13 @@ def test_sharded_matches_reference(setup):
     got = fn(shard_moe_params(params, mesh, "model"), x)
     np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
                                rtol=2e-5, atol=2e-6)
+    # live-mask parity: the sharded dispatch honors the same ragged
+    # semantics as the reference (dead tokens claim no capacity)
+    live = (jnp.arange(B) % 3 != 0).astype(x.dtype)
+    ref_l = moe_ffn(params, x, CAP, live=live)
+    got_l = fn(shard_moe_params(params, mesh, "model"), x, live)
+    np.testing.assert_allclose(np.asarray(ref_l), np.asarray(got_l),
+                               rtol=2e-5, atol=2e-6)
 
 
 def test_gradients_flow_and_train(setup):
@@ -57,6 +64,56 @@ def test_capacity_clipping_is_static_and_effective():
     y = moe_ffn(params, x, capacity=4)
     live = jnp.sum(jnp.any(y != 0.0, axis=-1))
     assert int(live) == 4  # overflow dropped, shapes static
+
+
+def test_masked_tokens_claim_no_capacity():
+    """Ragged invariant (advisor r04 medium): dead/padded positions must
+    not claim capacity slots — the live tokens' outputs are identical
+    whatever amount of padding follows them."""
+    params = init_moe_params(jax.random.PRNGKey(0), D, H, E)
+    x = jax.random.normal(jax.random.PRNGKey(2), (8, D))
+    cap = 3  # tight: padding would crowd out live tokens without `live`
+    y_ref = moe_ffn(params, x, cap, live=jnp.ones(8))
+    # same live tokens + 24 padded rows interleaved ahead in flat order
+    pad = jax.random.normal(jax.random.PRNGKey(3), (24, D))
+    xp = jnp.concatenate([pad, x], axis=0)
+    live = jnp.concatenate([jnp.zeros(24), jnp.ones(8)])
+    y_pad = moe_ffn(params, xp, cap, live=live)
+    np.testing.assert_allclose(np.asarray(y_pad[24:]), np.asarray(y_ref),
+                               rtol=1e-6, atol=1e-6)
+    assert not np.any(np.asarray(y_pad[:24]))  # dead rows produce zeros
+
+
+def test_moe_layer_respects_sequence_mask():
+    """The registered `moe` layer threads Argument.mask into dispatch:
+    growing the pad length leaves live positions' outputs unchanged."""
+    from paddle_tpu.core.argument import Argument
+    from paddle_tpu.core.registry import get_layer_impl
+    from paddle_tpu.config import dsl
+
+    dsl.reset()
+    x = dsl.data(name="x", size=D)
+    m = dsl.moe(input=x, expert_hidden=H, num_experts=E, capacity=6,
+                name="mx")
+    cfg = dsl.current_graph().layers["mx"]
+    impl = get_layer_impl("moe")
+    infos = [type("I", (), {"size": D, "is_sequence": True})()]
+    key = jax.random.PRNGKey(0)
+    params = {k: jax.random.normal(key, s.shape) * 0.1
+              for k, s in impl.params(cfg, infos).items()}
+    v = jax.random.normal(jax.random.PRNGKey(1), (2, 4, D))
+    mask = jnp.asarray([[1, 1, 1, 0], [1, 1, 0, 0]], jnp.float32)
+    a_short = Argument(value=v, mask=mask)
+    out_short = impl.apply(cfg, params, [a_short], None)
+    # re-pad to T=9 with garbage values in the dead tail
+    v_long = jnp.concatenate(
+        [v, jax.random.normal(jax.random.PRNGKey(2), (2, 5, D))], axis=1)
+    mask_long = jnp.concatenate([mask, jnp.zeros((2, 5))], axis=1)
+    out_long = impl.apply(cfg, params, [Argument(value=v_long,
+                                                 mask=mask_long)], None)
+    np.testing.assert_allclose(np.asarray(out_long.value[:, :4]),
+                               np.asarray(out_short.value),
+                               rtol=1e-5, atol=1e-5)
 
 
 def test_moe_layer_trains_and_shards():
