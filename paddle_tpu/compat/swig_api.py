@@ -704,6 +704,214 @@ class GradientMachine:
             err, cnt = classification_error(outs[out_l], lab)
             evaluator.accumulate(float(err), float(cnt))
 
+    def loadParameters(self, path: str):
+        """``GradientMachine::loadParameters`` (``PaddleAPI.h:790``):
+        accepts an engine ``.npz`` checkpoint or a reference v1 model
+        directory (one Parameter::save file per parameter)."""
+        import os
+        if os.path.isdir(path):
+            from paddle_tpu.compat.param_format import load_v1_model_dir
+            raw = load_v1_model_dir(path)
+            loaded = {}
+            for name, spec in self._meta.items():
+                if name not in raw:
+                    continue
+                want = 1
+                for d in spec.shape:
+                    want *= int(d)
+                if raw[name].size != want:
+                    raise ValueError(
+                        f"loadParameters: {name!r} has {raw[name].size} "
+                        f"values, the model needs {want} (shape "
+                        f"{spec.shape})")
+                loaded[name] = jnp.asarray(raw[name].reshape(spec.shape))
+        else:
+            from paddle_tpu.trainer.checkpoint import load_params
+            params, _ = load_params(path)
+            loaded = {}
+            for name, v in params.items():
+                if name not in self._params:
+                    continue
+                # params outside the Network's table (e.g. a generation
+                # embedding installed post-hoc) aren't in _meta; their
+                # current array's .shape is the contract (no host copy)
+                want = tuple(int(d) for d in self._meta[name].shape) \
+                    if name in self._meta else tuple(
+                        self._params[name].shape)
+                if tuple(v.shape) != want:
+                    raise ValueError(
+                        f"loadParameters: {name!r} has shape {v.shape}, "
+                        f"the model needs {want}")
+                loaded[name] = jnp.asarray(v)
+        # every shape validated above — only now mutate, so a mismatch
+        # never leaves the machine half-loaded
+        self._params.update(loaded)
+        missing = sorted(set(self._params) - set(loaded))
+        if missing:
+            from paddle_tpu.utils import logger
+            logger.warning("loadParameters: %d parameters missing in %s "
+                           "(kept initialized): %s", len(missing), path,
+                           missing[:5])
+
+    def asSequenceGenerator(self, dict=(), begin_id=None, end_id=None,
+                            max_length=100, beam_size=-1
+                            ) -> "SequenceGenerator":
+        """``GradientMachine::asSequenceGenerator`` (``PaddleAPI.h:809``):
+        the raw-API generation surface over the engine's jitted beam
+        search. ``begin_id``/``end_id`` default to the config's
+        generator bos/eos (``None`` here where the C++ default of ``0``
+        cannot be told apart from an explicit 0)."""
+        return SequenceGenerator(self, dict=dict, begin_id=begin_id,
+                                 end_id=end_id, max_length=max_length,
+                                 beam_size=beam_size)
+
+
+# ----------------------------------------------------- sequence generator
+class ISequenceResults:
+    """N-best results from one generation call (``PaddleAPI.h:1003-1022``).
+    Concrete results are ``_PathSequenceResults``; this base mirrors the
+    reference's abstract interface."""
+
+    def getSize(self) -> int:
+        raise NotImplementedError
+
+    def getSentence(self, id, split=False) -> str:
+        raise NotImplementedError
+
+    def getSequence(self, id) -> List[int]:
+        raise NotImplementedError
+
+    def getScore(self, id) -> float:
+        raise NotImplementedError
+
+
+class _PathSequenceResults(ISequenceResults):
+    """``PathSequenceResults`` (``api/SequenceGenerator.cpp:158-200``):
+    paths sorted best-first, scores are cumulative log-probabilities."""
+
+    def __init__(self, paths, dict_words):
+        self._paths = paths  # [(ids: List[int], logprob: float)]
+        self._dict = list(dict_words)
+
+    def getSize(self) -> int:
+        return len(self._paths)
+
+    def _check(self, id):
+        if not 0 <= id < len(self._paths):
+            raise RangeError(str(id))
+
+    def getSentence(self, id, split=False) -> str:
+        self._check(id)
+        ids = self._paths[id][0]
+        if ids and (not self._dict or max(ids) >= len(self._dict)):
+            raise UnsupportError(
+                f"getSentence needs a word dict covering id "
+                f"{max(ids)} (have {len(self._dict)} words) — call "
+                "setDict() / pass dict= to asSequenceGenerator")
+        words = [self._dict[i] for i in ids]
+        return (" " if split else "").join(words)
+
+    def getSequence(self, id) -> List[int]:
+        self._check(id)
+        return list(self._paths[id][0])
+
+    def getScore(self, id) -> float:
+        self._check(id)
+        return float(self._paths[id][1])
+
+
+class SequenceGenerator:
+    """``SequenceGenerator`` (``PaddleAPI.h:1024-1046``, impl
+    ``api/SequenceGenerator.cpp``): obtained via
+    ``GradientMachine.asSequenceGenerator``. Where the reference re-runs
+    the machine per candidate path with host-side state save/restore
+    (``findNBest``, ``SequenceGenerator.cpp:42-113``), this drives the
+    engine's single jitted ``lax.scan`` beam search
+    (``core/generation.py``) — same N-best contract, sorted by score."""
+
+    def __init__(self, machine: GradientMachine, dict=(), begin_id=None,
+                 end_id=None, max_length=100, beam_size=-1):
+        self._machine = machine
+        self._dict = list(dict)
+        self._bos = begin_id
+        self._eos = end_id
+        self._max_length = int(max_length)
+        self._beam_size = int(beam_size)
+        self._built = None  # (engine generator, encoder Network)
+
+    # -- setters (PaddleAPI.h:1040-1044) --------------------------------
+    def setDict(self, dict):
+        self._dict = list(dict)
+
+    def setBos(self, bos):
+        self._bos = int(bos)
+        self._built = None  # bos/eos are trace-time constants
+
+    def setEos(self, eos):
+        self._eos = int(eos)
+        self._built = None
+
+    def setMaxLength(self, maxlength):
+        self._max_length = int(maxlength)
+
+    def setBeamSize(self, beamSize):
+        self._beam_size = int(beamSize)
+
+    # -------------------------------------------------------------------
+    def _build(self):
+        if self._built is not None:
+            return self._built
+        from paddle_tpu.core.generation import \
+            SequenceGenerator as EngineGenerator
+        from paddle_tpu.core.network import Network
+        graph = self._machine._graph
+        gen_name = next((n for n, l in graph.layers.items()
+                         if l.type == "beam_search_group"), None)
+        if gen_name is None:
+            raise UnsupportError(
+                "asSequenceGenerator needs a generating config (a "
+                "beam_search group); this model has none")
+        engine = EngineGenerator(graph, gen_name)
+        if self._bos is not None or self._eos is not None:
+            gen = dict(engine.gen)
+            if self._bos is not None:
+                gen["bos_id"] = self._bos
+            if self._eos is not None:
+                gen["eos_id"] = self._eos
+            engine.gen = gen
+        encoder = Network(graph, outputs=engine.static_input_layers())
+        self._built = (engine, encoder)
+        return self._built
+
+    def generateSequence(self, inArgs: Arguments) -> ISequenceResults:
+        """N-best generation for the input sequence(s), sorted by score
+        (``SequenceGenerator::generateSequence``). Results are
+        batch-major: with B input sequences and beam K, path ``b*K + k``
+        is sequence b's k-th best."""
+        engine, encoder = self._build()
+        m = self._machine
+        emb_name = engine.gen["embedding_name"]
+        if emb_name not in m._params:
+            raise KeyError(
+                f"generation embedding {emb_name!r} is not in the "
+                "machine's parameters — loadParameters() a trained "
+                "model first")
+        feed = m._feed_from(inArgs)
+        outer = encoder.apply(m._params, feed, train=False)
+        tokens, scores, lengths = engine.generate(
+            m._params, outer,
+            beam_size=self._beam_size if self._beam_size > 0 else None,
+            max_length=self._max_length)
+        tokens = np.asarray(tokens)
+        scores = np.asarray(scores)
+        lengths = np.asarray(lengths)
+        paths = []
+        for b in range(tokens.shape[0]):
+            for k in range(tokens.shape[1]):
+                ids = tokens[b, k, : int(lengths[b, k])].tolist()
+                paths.append((ids, float(scores[b, k])))
+        return _PathSequenceResults(paths, self._dict)
+
 
 # ------------------------------------------------------ parameter updater
 class ParameterUpdater:

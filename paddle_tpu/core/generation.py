@@ -48,6 +48,14 @@ class SequenceGenerator:
         self._jitted: Dict[Any, Callable] = {}
 
     # ------------------------------------------------------------------
+    def static_input_layers(self):
+        """Outer layer names feeding the group's static/boot inputs —
+        the encoder outputs ``generate`` needs in ``outer_outputs``."""
+        return [inp.layer_name
+                for inp, meta in zip(self.cfg.inputs, self.cfg.attrs["ins"])
+                if meta["kind"] in ("static", "boot")]
+
+    # ------------------------------------------------------------------
     def generate(self, params, outer_outputs: Dict[str, Argument], *,
                  beam_size: Optional[int] = None,
                  max_length: Optional[int] = None,
