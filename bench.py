@@ -274,6 +274,13 @@ def child_main():
         "platform": jax.devices()[0].platform,
         "device_kind": jax.devices()[0].device_kind,
     }
+    try:
+        # which backend each baseline shape takes — pins perf claims to
+        # dispatch (tests/test_ops_pallas.py::test_dispatch_table...)
+        from paddle_tpu.ops.lstm import kernel_dispatch_table
+        result["kernel_dispatch"] = kernel_dispatch_table()
+    except Exception as e:  # noqa: BLE001
+        result["kernel_dispatch"] = {"error": repr(e)[:120]}
     wd = _watchdog(1200, 7)  # nothing printed yet: die loudly, retry
     ms = bench_lstm()
     result["value"] = round(ms, 3)

@@ -525,6 +525,22 @@ class GradientMachine:
         self._network = Network(graph, outputs=outs)
         self._params = self._network.init_params(jax.random.PRNGKey(seed))
         self._meta = self._network.param_meta()
+        # a generating config references the target-word embedding only
+        # by PARAMETER NAME (GeneratedInput.embedding_name) — no layer
+        # owns it, so the Network table misses it. Register it here so
+        # init/loadParameters/save all cover the load-then-generate flow.
+        from paddle_tpu.core.registry import ParamSpec
+        for ldef in graph.layers.values():
+            if ldef.type != "beam_search_group":
+                continue
+            gen = ldef.attrs.get("gen") or {}
+            emb = gen.get("embedding_name")
+            if emb and emb not in self._params:
+                shape = (int(gen["size"]), int(gen["embedding_size"]))
+                self._meta[emb] = ParamSpec(shape=shape)
+                self._params[emb] = jax.random.normal(
+                    jax.random.fold_in(jax.random.PRNGKey(seed), 99),
+                    shape) / jnp.sqrt(shape[0])
         self._grads: Dict[str, jnp.ndarray] = {}
         self._opt_state: Optional[Dict[str, Any]] = None
         self._last_outputs: Optional[Dict[str, Any]] = None

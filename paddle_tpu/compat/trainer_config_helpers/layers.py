@@ -232,8 +232,16 @@ def trans_full_matrix_projection(input, size=0, param_attr=None):
 
 def table_projection(input, size=0, param_attr=None):
     src = _one(input)
-    return Projection(src, {"type": "table", "vocab_size": src.size}, size,
-                      _pattr(param_attr))
+    spec = {"type": "table", "vocab_size": src.size}
+    g = getattr(src, "graph", None)
+    producer = g.layers.get(src.name) if g is not None else None
+    if producer is not None and producer.type != "data":
+        # the reference's own golden projections.py feeds a table
+        # projection a dense float layer (TableProjection.cpp would
+        # CHECK-fail at run time); flag the executable interpretation
+        # (argmax-id) EXPLICITLY so ids-fed tables stay strict
+        spec["dense_argmax_ids"] = True
+    return Projection(src, spec, size, _pattr(param_attr))
 
 
 def identity_projection(input, offset=None, size=None):

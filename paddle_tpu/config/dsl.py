@@ -272,20 +272,33 @@ def grumemory(input, *, name: str = None, reverse: bool = False,
 
 def multi_head_attention(query, key_value=None, *, size: int = None,
                          num_heads: int = 1, causal: bool = False,
+                         seq_parallel: str = None, seq_axis: str = "seq",
                          name: str = None, bias_attr=True,
                          param_attr=None) -> LayerOutput:
     """Fused multi-head attention (flash kernel on TPU); self-attention
     when key_value is omitted. Capability-add over the reference's
-    composite simple_attention."""
+    composite simple_attention.
+
+    ``seq_parallel="ring"|"ulysses"`` turns on sequence parallelism for
+    long contexts: when the trainer runs with a mesh carrying
+    ``seq_axis`` (``create_mesh(n_seq=...)``), the attention shards the
+    time dimension over it (ring = KV rotation over ICI, ulysses =
+    heads<->sequence all-to-all; ulysses needs num_heads divisible by
+    the axis size). Without such a mesh the layer runs dense."""
     q = _in(query)[0]
     inputs = [Input(q.name, param_attr=_param(param_attr))]
     if key_value is not None:
         inputs.append(Input(_in(key_value)[0].name))
+    if seq_parallel not in (None, "ring", "ulysses"):
+        raise ValueError(f"seq_parallel must be ring/ulysses, "
+                         f"got {seq_parallel!r}")
     ldef = LayerDef(name=name or _auto_name("mha"),
                     type="multi_head_attention", inputs=inputs,
                     size=size or q.size, act="linear",
                     bias=_bias(bias_attr),
-                    attrs={"num_heads": num_heads, "causal": causal})
+                    attrs={"num_heads": num_heads, "causal": causal,
+                           "seq_parallel": seq_parallel,
+                           "seq_axis": seq_axis})
     return _add(ldef)
 
 

@@ -30,6 +30,9 @@ class Context:
 
     train: bool = False
     rng: Optional[jax.Array] = None
+    # device mesh for layers with sharded compute paths (e.g. the
+    # seq_parallel attention); None = single-device semantics
+    mesh: Any = None
     in_infos: List[ShapeInfo] = dataclasses.field(default_factory=list)
     out_info: Optional[ShapeInfo] = None
     outputs: Dict[str, Argument] = dataclasses.field(default_factory=dict)
@@ -157,10 +160,11 @@ class Network:
     def apply(self, params: Dict[str, jnp.ndarray],
               feed: Dict[str, Argument], *, train: bool = False,
               rng: Optional[jax.Array] = None,
-              carried: Optional[Dict[str, Any]] = None
+              carried: Optional[Dict[str, Any]] = None,
+              mesh: Any = None,
               ) -> Dict[str, Argument]:
         outs, _ = self.apply_with_state(params, feed, train=train, rng=rng,
-                                        carried=carried)
+                                        carried=carried, mesh=mesh)
         return outs
 
     def apply_with_state(
@@ -168,6 +172,7 @@ class Network:
             feed: Dict[str, Argument], *, train: bool = False,
             rng: Optional[jax.Array] = None,
             carried: Optional[Dict[str, Any]] = None,
+            mesh: Any = None,
             probes: Optional[Dict[str, jnp.ndarray]] = None,
     ) -> Tuple[Dict[str, Argument], Dict[str, jnp.ndarray]]:
         """Pure forward over the whole graph. ``feed`` maps data-layer names
@@ -178,7 +183,8 @@ class Network:
         that layer's output — differentiating the cost w.r.t. a probe
         yields d(cost)/d(layer output), the quantity the reference's
         ``gradient_printer`` evaluator prints (``Argument.grad``)."""
-        ctx = Context(train=train, rng=rng, carried=carried or {})
+        ctx = Context(train=train, rng=rng, carried=carried or {},
+                      mesh=mesh)
         from paddle_tpu.layers.activations import apply_activation  # cycle-free
         from paddle_tpu.utils.error_context import layer_scope
 

@@ -110,6 +110,19 @@ class DataFeeder:
             for i, s in enumerate(col):
                 value[i, : len(s)] = np.asarray(s, dtype=np.int32)
                 mask[i, : len(s)] = 1.0
+        elif itype.type in (T.SPARSE_BINARY, T.SPARSE_FLOAT):
+            # per-timestep index lists (sparse_binary_vector_sequence,
+            # e.g. the sequence-tagging demo's feature slot) densify to
+            # the padded [B, T, dim] layout like every sequence input
+            value = np.zeros((bsz, max_len, itype.dim), dtype=np.float32)
+            for i, s in enumerate(col):
+                for t, idxs in enumerate(s):
+                    if itype.type == T.SPARSE_BINARY:
+                        value[i, t, np.asarray(idxs, dtype=np.int64)] = 1.0
+                    else:
+                        for k, v in idxs:
+                            value[i, t, k] = v
+                    mask[i, t] = 1.0
         else:
             value = np.zeros((bsz, max_len, itype.dim), dtype=np.float32)
             for i, s in enumerate(col):

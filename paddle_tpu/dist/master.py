@@ -354,10 +354,14 @@ class MasterServer:
     def __init__(self, service: MasterService, host: str = "127.0.0.1",
                  port: int = 0):
         self.service = service
+        # reuse must be set BEFORE bind — a restarted master (recovery)
+        # re-binds its old port while client sockets sit in TIME_WAIT
         self._srv = socketserver.ThreadingTCPServer(
-            (host, port), _Handler, bind_and_activate=True)
+            (host, port), _Handler, bind_and_activate=False)
         self._srv.daemon_threads = True
         self._srv.allow_reuse_address = True
+        self._srv.server_bind()
+        self._srv.server_activate()
         self._srv.service = service  # type: ignore
         self.addr = self._srv.server_address
         self._thread = threading.Thread(target=self._srv.serve_forever,

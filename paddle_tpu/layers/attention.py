@@ -62,7 +62,24 @@ class MultiHeadAttentionLayer(LayerImpl):
         k = split(kv_arg.value @ params["wk"])
         v = split(kv_arg.value @ params["wv"])
         kv_mask = kv_arg.mask
-        out = flash_attention(q, k, v, kv_mask, causal=causal)
+        sp = cfg.attrs.get("seq_parallel")
+        axis = cfg.attrs.get("seq_axis", "seq")
+        if sp and ctx.mesh is not None and axis in ctx.mesh.shape \
+                and ctx.mesh.shape[axis] > 1:
+            # sequence parallelism: the [B, N, T, D] tensors shard over
+            # the mesh's sequence axis; ring rotates KV over ICI
+            # (ppermute), ulysses all-to-alls heads<->sequence
+            # (parallel/ring.py). Config-reachable via
+            # multi_head_attention(seq_parallel="ring"|"ulysses") + a
+            # trainer mesh carrying a "seq" axis (create_mesh(n_seq=...)).
+            from paddle_tpu.parallel.ring import make_ring_attention
+            fn = make_ring_attention(ctx.mesh, axis, kind=sp,
+                                     causal=causal)
+            out = fn(q, k, v, kv_mask)
+        else:
+            # no mesh / no seq axis: same math on one device (the knob
+            # degrades gracefully so configs run everywhere)
+            out = flash_attention(q, k, v, kv_mask, causal=causal)
         B, N, T, _ = out.shape
         out = out.transpose(0, 2, 1, 3).reshape(B, T, size) @ params["wo"]
         if "wbias" in params:

@@ -143,7 +143,13 @@ class CRFDecodingLayer(LayerImpl):
         if len(ins) > 1:
             gold = ins[1].value.astype(path.dtype)
             wrong = jnp.any((path != gold) & (mask > 0), axis=1)
-            return Argument(value=wrong.astype(jnp.float32)[:, None])
+            # the reference layer carries BOTH: output_.ids = the decoded
+            # path (what ChunkEvaluator reads) and value = the error
+            # indicator (what sum_evaluator reads). The ids view rides in
+            # state for evaluators that want ids.
+            return Argument(value=wrong.astype(jnp.float32)[:, None],
+                            state={"ids": path.astype(jnp.int32),
+                                   "ids_mask": mask})
         return Argument(value=path.astype(jnp.int32)[:, :, None], mask=mask)
 
 

@@ -110,7 +110,17 @@ def _project(proj: dict, x: jnp.ndarray, w) -> jnp.ndarray:
     if kind == "dot_mul":
         return x * w
     if kind == "table":
-        return jnp.take(w, x.astype(jnp.int32), axis=0)
+        ids = x
+        if proj.get("dense_argmax_ids") \
+                and jnp.issubdtype(ids.dtype, jnp.floating) \
+                and ids.ndim >= 2 and ids.shape[-1] == w.shape[0]:
+            # EXPLICITLY flagged by the config layer: a dense float layer
+            # feeds this table (the reference golden projections.py ships
+            # exactly this; TableProjection.cpp would CHECK-fail at run
+            # time). Executable interpretation = argmax-id. Ids-fed
+            # tables never take this branch — they stay strict.
+            ids = jnp.argmax(ids, axis=-1)
+        return jnp.take(w, ids.astype(jnp.int32), axis=0)
     if kind == "scaling":
         return x * w[0]
     if kind == "slice":
@@ -125,6 +135,15 @@ def _context_project(proj: dict, a: Argument, w) -> jnp.ndarray:
     out-of-sequence positions taken from the padding rows ``w`` (begin
     rows then end rows; static zeros unless trainable_padding)."""
     x, mask = a.value, a.mask
+    if x.ndim == 2:
+        # a non-sequence batch is B length-1 sequences in the reference's
+        # Argument model (every batch carries sequenceStartPositions):
+        # context windows see padding on both sides of the single token
+        B2, D2 = x.shape
+        y = _context_project(proj,
+                             Argument(value=x[:, None],
+                                      mask=jnp.ones((B2, 1), x.dtype)), w)
+        return y[:, 0]
     if x.ndim != 3:
         raise ValueError("context projection needs a sequence input")
     B, T, D = x.shape
@@ -177,16 +196,14 @@ def _conv_project(proj: dict, a: Argument, w, info):
             padding=((pady, pady), (pad, pad)),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
             feature_group_count=proj.get("groups", 1) or 1)
-    if (proj.get("groups", 1) or 1) != 1:
-        raise NotImplementedError("grouped transposed conv projection")
     # gradient-of-conv shape needs lax padding fs-1-p
     # (see ConvTransLayer.apply)
-    return lax.conv_transpose(
+    from paddle_tpu.layers.conv import conv_transpose_grouped
+    return conv_transpose_grouped(
         x, w, strides=(sty, st),
         padding=((fsy - 1 - pady, fsy - 1 - pady),
                  (fs - 1 - pad, fs - 1 - pad)),
-        dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        transpose_kernel=True)
+        groups=proj.get("groups", 1) or 1)
 
 
 def _conv_proj_geom(proj: dict, info):
@@ -211,6 +228,58 @@ def _conv_proj_geom(proj: dict, info):
     return c, in_h, in_w, oh, ow
 
 
+def _conv_operator(op: dict, img: Argument, flt: Argument, info):
+    """Dynamic per-sample-filter conv inside a mixed layer
+    (``REGISTER_OPERATOR(conv, ConvOperator)``,
+    ``paddle/gserver/layers/ConvOperator.cpp:30`` + the trans variant,
+    ``ConvTransOperator.cpp``): input[0] is the image, input[1] a layer
+    OUTPUT carrying each sample's filter bank, flat in the reference's
+    weightOffset order [nf, c, fsy, fs] (``ConvOperator.cpp:49``).
+
+    TPU-form: a vmap'd ``lax.conv`` over the batch — B independent
+    convs, each sample with its own rhs; XLA batches them onto the MXU
+    (the reference loops cudnn calls per sample, ``:70-86``)."""
+    import jax
+    from jax import lax
+
+    from paddle_tpu.layers.conv import to_nhwc
+    c, in_h, in_w, _, _ = _conv_proj_geom(op, info)
+    nf = op["num_filters"]
+    fs = op["filter_size"]
+    fsy = op.get("filter_size_y") or fs
+    st = op.get("stride", 1)
+    sty = op.get("stride_y") or st
+    pad = op.get("padding", 0)
+    pady = op.get("padding_y")
+    pady = pad if pady is None else pady
+    x = to_nhwc(img.value, c, in_h, in_w)            # [B, H, W, C]
+    k = flt.value.reshape(-1, nf, c, fsy, fs)
+    if flt.value.shape[0] != x.shape[0]:
+        raise ValueError(
+            f"conv_operator: filter batch {flt.value.shape[0]} != image "
+            f"batch {x.shape[0]} (ConvOperator.cpp:61 CHECK_EQ)")
+    if op["type"] == "conv_op":
+        k = jnp.transpose(k, (0, 3, 4, 2, 1))        # [B, fsy, fs, c, nf]
+
+        def one(xi, ki):
+            return lax.conv_general_dilated(
+                xi[None], ki, window_strides=(sty, st),
+                padding=((pady, pady), (pad, pad)),
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))[0]
+    else:                                            # convt_op
+        k = jnp.transpose(k, (0, 3, 4, 1, 2))        # [B, fsy, fs, nf, c]
+
+        def one(xi, ki):
+            return lax.conv_transpose(
+                xi[None], ki, strides=(sty, st),
+                padding=((fsy - 1 - pady, fsy - 1 - pady),
+                         (fs - 1 - pad, fs - 1 - pad)),
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                transpose_kernel=True)[0]
+
+    return jax.vmap(one)(x, k)                       # [B, oh, ow, nf]
+
+
 @register_layer("mixed")
 class MixedLayer(LayerImpl):
     """Sum of per-input projections (``MixedLayer.cpp``). Each input's
@@ -221,12 +290,19 @@ class MixedLayer(LayerImpl):
 
     def infer(self, cfg, in_infos):
         projs = cfg.attrs.get("projections") or []
-        # a conv projection gives the mixed output image geometry
-        # (inception-style blocks pool/concat the result)
+        # a conv projection/operator gives the mixed output image
+        # geometry (inception-style blocks pool/concat the result)
         for proj, info in zip(projs, in_infos):
             if proj and proj.get("type") in ("conv", "convt"):
                 nf = proj["num_filters"]
                 _, _, _, oh, ow = _conv_proj_geom(proj, info)
+                return ShapeInfo(size=nf * oh * ow, channels=nf,
+                                 height=oh, width=ow)
+        for op in cfg.attrs.get("operators") or []:
+            if op.get("type") in ("conv_op", "convt_op"):
+                nf = op["num_filters"]
+                idx = op["input_indices"][0]
+                _, _, _, oh, ow = _conv_proj_geom(op, in_infos[idx])
                 return ShapeInfo(size=nf * oh * ow, channels=nf,
                                  height=oh, width=ow)
         return ShapeInfo(size=cfg.size,
@@ -244,6 +320,11 @@ class MixedLayer(LayerImpl):
                 if proj and proj.get("type") in ("conv", "convt"):
                     size = proj["num_filters"]  # shared conv bias per map
                     break
+            else:
+                for op in cfg.attrs.get("operators") or []:
+                    if op.get("type") in ("conv_op", "convt_op"):
+                        size = op["num_filters"]
+                        break
             specs["wbias"] = ParamSpec(shape=(size,), init="zeros",
                                        is_bias=True)
         return specs
@@ -286,22 +367,33 @@ class MixedLayer(LayerImpl):
                 # the reference records conv projection params dimless
                 return {f"w{i}": ParamSpec(shape=(fsy, fs, c // groups, nf),
                                            wire_dims=())}
-            return {f"w{i}": ParamSpec(shape=(fsy, fs, nf // groups, c))}
+            return {f"w{i}": ParamSpec(shape=(fsy, fs, nf // groups, c),
+                                       wire_dims=())}
         return {}  # identity
 
     def apply(self, cfg, params, ins, ctx):
         projs = cfg.attrs.get("projections") or [
             {"type": "full_matrix"} for _ in ins]
-        kinds = {p.get("type", "full_matrix") for p in projs if p}
-        if kinds & {"conv", "convt"} and kinds - {"conv", "convt"}:
+        ops = cfg.attrs.get("operators") or []
+        conv_kinds = {"conv", "convt"}
+        # operator-argument slots carry no projection of their own
+        kinds = {p.get("type", "full_matrix") for p in projs
+                 if p and p.get("type") != "identity_op_arg"}
+        has_conv_op = any(o.get("type") in ("conv_op", "convt_op")
+                          for o in ops)
+        has_flat_op = any(o.get("type") in ("dot_mul", "dot_mul_op")
+                          for o in ops)
+        image_side = bool(kinds & conv_kinds) or has_conv_op
+        flat_side = bool(kinds - conv_kinds) or has_flat_op
+        if image_side and flat_side:
             # conv outputs are 4-D NHWC; flat projections are [B, size] —
             # the sum is undefined (the reference never mixes them either)
             raise NotImplementedError(
-                "a mixed layer cannot combine conv projections with flat "
-                "projections")
+                "a mixed layer cannot combine conv projections/operators "
+                "with flat projections")
         op_terms = []
         op_arg_idx = set()
-        for op in cfg.attrs.get("operators") or []:
+        for op in ops:
             idxs = list(op.get("input_indices", []))
             op_arg_idx.update(idxs)
             if op.get("type") in ("dot_mul", "dot_mul_op"):
@@ -315,12 +407,14 @@ class MixedLayer(LayerImpl):
                         f"dotmul_operator argument widths differ: "
                         f"{av.shape[-1]} vs {bv.shape[-1]}")
                 op_terms.append(av * bv * float(op.get("scale", 1.0)))
+            elif op.get("type") in ("conv_op", "convt_op"):
+                op_terms.append(_conv_operator(
+                    op, ins[idxs[0]], ins[idxs[1]],
+                    ctx.in_infos[idxs[0]]))
             else:
-                # ConvOperator (dynamic per-sample filters) stays
-                # config/proto-representable but unexecuted
                 raise NotImplementedError(
                     f"mixed-layer operator {op.get('type')!r} is not "
-                    "executable; use conv_projection / a conv layer")
+                    "executable")
         out = None
         for t in op_terms:
             out = t if out is None else out + t

@@ -34,6 +34,35 @@ def to_nhwc(x: jnp.ndarray, channels: int, height: int, width: int):
     return x
 
 
+def conv_transpose_grouped(x, w, *, strides, padding, groups: int = 1):
+    """Grouped transposed conv. ``w`` is gradient-of-conv HWIO
+    ``(fsy, fs, nf // groups, c)`` — the kernel of the forward conv
+    nf→c whose gradient this computes. Group j maps input-channel block
+    j (c/g wide) to output block j (nf/g wide); XLA fuses the g
+    conv_transposes + concat (g is a small static constant, exactly the
+    reference's grouped im2col loop, ``ExpandConvTransLayer.cpp``)."""
+    if groups == 1:
+        return lax.conv_transpose(
+            x, w, strides=strides, padding=padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            transpose_kernel=True)
+    c = x.shape[-1]
+    if c % groups or w.shape[3] != c:
+        raise ValueError(
+            f"grouped conv-trans: {c} input channels with kernel "
+            f"{w.shape} over {groups} groups")
+    cg = c // groups
+    ys = []
+    for j in range(groups):
+        ys.append(lax.conv_transpose(
+            x[..., j * cg:(j + 1) * cg],
+            w[:, :, :, j * cg:(j + 1) * cg],
+            strides=strides, padding=padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            transpose_kernel=True))
+    return jnp.concatenate(ys, axis=-1)
+
+
 def _conv_geom(in_sz: int, filt: int, pad: int, stride: int) -> int:
     # reference formula, caffe-style (config_parser.cg_image_size)
     return (in_sz + 2 * pad - filt) // stride + 1
@@ -93,7 +122,10 @@ class ConvLayer(LayerImpl):
             fs, fsy, st, sty, pad, pady, groups, c = _conv_spec(
                 cfg.inputs[i].extra, info)
             c = derive_geom(info, c)[0]
-            specs[f"w{i}"] = ParamSpec(shape=(fsy, fs, c // groups, nf))
+            # the reference records conv weights dimless in the proto
+            # (create_input_parameter without dims; goldens carry none)
+            specs[f"w{i}"] = ParamSpec(shape=(fsy, fs, c // groups, nf),
+                                       wire_dims=())
         if cfg.bias:
             specs["wbias"] = ParamSpec(shape=(nf,), init="zeros", is_bias=True,
                                        wire_dims=(nf, 1))
@@ -142,7 +174,8 @@ class ConvTransLayer(LayerImpl):
                 cfg.inputs[i].extra, info)
             c = derive_geom(info, c)[0]
             # gradient-of-conv layout: treat as conv from nf -> c
-            specs[f"w{i}"] = ParamSpec(shape=(fsy, fs, nf // groups, c))
+            specs[f"w{i}"] = ParamSpec(shape=(fsy, fs, nf // groups, c),
+                                       wire_dims=())
         if cfg.bias:
             specs["wbias"] = ParamSpec(shape=(nf,), init="zeros", is_bias=True,
                                        wire_dims=(nf, 1))
@@ -154,10 +187,6 @@ class ConvTransLayer(LayerImpl):
             info = ctx.in_infos[i]
             fs, fsy, st, sty, pad, pady, groups, c = _conv_spec(
                 cfg.inputs[i].extra, info)
-            if groups != 1:
-                raise NotImplementedError(
-                    "grouped transposed conv is not supported "
-                    "(lax.conv_transpose has no feature_group_count)")
             c, in_h, in_w = derive_geom(info, c)
             x = to_nhwc(a.value, c, in_h, in_w)
             # kernel is stored gradient-of-conv style (nf -> c);
@@ -166,13 +195,12 @@ class ConvTransLayer(LayerImpl):
             # lax's explicit padding q yields (in-1)*s - fs + 2 + 2q, so
             # the gradient-of-conv shape (in-1)*s + fs - 2p needs
             # q = fs - 1 - p per side.
-            y = lax.conv_transpose(
+            y = conv_transpose_grouped(
                 x, params[f"w{i}"],
                 strides=(sty, st),
                 padding=((fsy - 1 - pady, fsy - 1 - pady),
                          (fs - 1 - pad, fs - 1 - pad)),
-                dimension_numbers=("NHWC", "HWIO", "NHWC"),
-                transpose_kernel=True,
+                groups=groups,
             )
             out = y if out is None else out + y
         if "wbias" in params:

@@ -472,7 +472,10 @@ class TensorLayer(LayerImpl):
 
     def params(self, cfg, in_infos):
         dx, dy = in_infos[0].size, in_infos[1].size
-        specs = {"w0": ParamSpec(shape=(dx, cfg.size * dy))}
+        # wire layout is the reference's 3-dim (Dx, Dy, K) block form
+        # (config_parser TensorLayer dims); engine packs [Dx, K*Dy]
+        specs = {"w0": ParamSpec(shape=(dx, cfg.size * dy),
+                                 wire_dims=(dx, dy, cfg.size))}
         if cfg.bias:
             specs["wbias"] = ParamSpec(shape=(cfg.size,), init="zeros",
                                        is_bias=True)

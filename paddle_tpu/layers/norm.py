@@ -31,8 +31,10 @@ class BatchNormLayer(LayerImpl):
     def params(self, cfg, in_infos):
         c = in_infos[0].channels or in_infos[0].size
         return {
+            # scale: the reference creates it via create_input_parameter
+            # without dims (goldens record none)
             "w0": ParamSpec(shape=(c,), init="const", initial_mean=1.0,
-                            initial_std=0.0),
+                            initial_std=0.0, wire_dims=()),
             "wbias": ParamSpec(shape=(c,), init="zeros", is_bias=True),
             "w1": ParamSpec(shape=(c,), init="zeros", is_static=True,
                             wire_shared=True),

@@ -68,7 +68,11 @@ class LstmLayer(LayerImpl):
 
     def params(self, cfg, in_infos):
         size = in_infos[0].size // 4
-        specs = {"w0": ParamSpec(shape=(size, 4 * size))}
+        # engine layout: one [H, 4H] block so the recurrent matmul is a
+        # single MXU op; the WIRE records the reference's 3-dim fused-
+        # gate layout (H, H, 4) verbatim (config_parser LstmLayer dims)
+        specs = {"w0": ParamSpec(shape=(size, 4 * size),
+                                 wire_dims=(size, size, 4))}
         if cfg.bias:
             specs["wbias"] = ParamSpec(shape=(7 * size,), init="zeros",
                                        is_bias=True)

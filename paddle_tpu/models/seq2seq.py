@@ -31,12 +31,23 @@ def _attention(name, enc_seq, enc_proj, state, hidden):
 def seq2seq_attention(*, src_vocab: int = 5000, trg_vocab: int = 5000,
                       embed_dim: int = 64, hidden: int = 64,
                       beam_size: int = 4, max_length: int = 20,
-                      generating: bool = False):
+                      generating: bool = False,
+                      seq_parallel: str = None, num_heads: int = 4):
     """Build the training graph (generating=False: returns (cost,
     probs_seq, data_names)) or the generation graph (generating=True:
-    returns (gen_layer, data_names) — drive with SequenceGenerator)."""
+    returns (gen_layer, data_names) — drive with SequenceGenerator).
+
+    ``seq_parallel="ring"|"ulysses"`` adds an encoder self-attention
+    block whose time dim shards over the trainer mesh's ``seq`` axis
+    (``create_mesh(n_seq=...)``) — the long-context path for long
+    source sequences. Off by default (goldens unchanged); without a
+    seq-axis mesh the block runs dense."""
     src = dsl.data(name="source_words", size=src_vocab, is_sequence=True)
     semb = dsl.embedding(input=src, size=embed_dim, name="src_emb")
+    if seq_parallel:
+        semb = dsl.multi_head_attention(
+            semb, num_heads=num_heads, seq_parallel=seq_parallel,
+            name="enc_self_att")
     f_in = dsl.fc(input=semb, size=hidden * 3, act="linear", name="enc_f_in")
     fwd = dsl.grumemory(input=f_in, name="enc_fwd")
     b_in = dsl.fc(input=semb, size=hidden * 3, act="linear", name="enc_b_in")

@@ -417,6 +417,18 @@ def lstm_dispatch(B: int, H: int, itemsize: int = 4) -> str:
     return "ref"
 
 
+BENCH_SHAPES = [(64, 256), (64, 512), (64, 1280), (128, 256), (128, 1280),
+                (256, 256), (256, 1280), (512, 512)]
+
+
+def kernel_dispatch_table():
+    """{"lstm_bs{B}_h{H}": path} for every BASELINE.md rnn-table shape
+    (benchmark/README.md:108-161). bench.py embeds this in its output so
+    perf claims and dispatch can never drift apart silently."""
+    return {f"lstm_bs{b}_h{h}": lstm_dispatch(b, h)
+            for b, h in BENCH_SHAPES}
+
+
 def lstm_sequence(xs, mask, w, gate_bias, check_i, check_f, check_o, h0, c0,
                   reverse=False):
     """Fused LSTM over a padded [T,B,4H] gate-projection sequence.

@@ -33,16 +33,25 @@ from paddle_tpu.core.argument import Argument
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
 DCN_AXIS = "dcn"  # cross-slice (data-center network) leading axis
 
 
 def create_mesh(n_data: Optional[int] = None, n_model: int = 1,
-                devices=None) -> Mesh:
-    """Build a (data, model) mesh. Defaults to all visible devices on the
-    data axis (pure DP, the reference's trainer_count semantics)."""
+                n_seq: int = 1, devices=None) -> Mesh:
+    """Build a (data, model) mesh — or (data, seq, model) when
+    ``n_seq > 1`` for sequence/context parallelism (ring/ulysses
+    attention shards the time axis over ``seq``; the axis sits between
+    data and model so its ppermute/all-to-all rides ICI next to the
+    model axis). Defaults to all visible devices on the data axis (pure
+    DP, the reference's trainer_count semantics)."""
     devices = devices if devices is not None else jax.devices()
     if n_data is None:
-        n_data = len(devices) // n_model
+        n_data = len(devices) // (n_model * n_seq)
+    if n_seq > 1:
+        devs = np.asarray(devices[: n_data * n_seq * n_model]).reshape(
+            n_data, n_seq, n_model)
+        return Mesh(devs, (DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
     devs = np.asarray(devices[: n_data * n_model]).reshape(n_data, n_model)
     return Mesh(devs, (DATA_AXIS, MODEL_AXIS))
 
@@ -235,6 +244,18 @@ def device_attr_rules(graph, param_specs, mesh: Mesh,
               if int(getattr(ldef, "attrs", {}).get("device", -1)) >= 0}
     if not pinned:
         return out
+    # the SAME config field also spells GPipe stages (pipeline.py:
+    # make_pipeline_from_device_attrs). A pipeline config pins EVERY
+    # non-data layer with contiguous stage ids from 0 — stand down so
+    # the trainer doesn't silently model-shard stage ids; the
+    # --parallel_nn shard-hint form pins only SOME layers.
+    non_data = [n for n, l in graph.layers.items() if l.type != "data"]
+    if non_data and set(non_data) <= pinned:
+        stage_ids = sorted({int(graph.layers[n].attrs.get("device"))
+                            for n in non_data})
+        if len(stage_ids) > 1 and \
+                stage_ids == list(range(len(stage_ids))):
+            return out
     for pname, spec in param_specs.items():
         if any((pat[1:] == pname if pat.startswith("=") else pat in pname)
                for pat in out):

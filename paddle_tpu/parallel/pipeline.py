@@ -114,3 +114,134 @@ def make_pipeline(mesh: Mesh, axis: str, stage_fn: Callable,
         out_specs=P(), check_vma=False)
 
     return jax.jit(fn)
+
+
+# ------------------------------------------------ config-driven stages
+def stages_from_device_attrs(graph):
+    """Partition a graph's layers into pipeline stages by their per-layer
+    ``device`` attr — the reference's layer-placement spelling
+    (``ParallelNeuralNetwork.h:23-62`` pins layers to devices via the
+    config's ``device`` field) reinterpreted as GPipe stage ids.
+
+    Rules: data layers are stageless (fed to stage 0); every other layer
+    needs ``device >= 0``; stage ids must be contiguous from 0 and
+    non-decreasing along the topological order (a pipeline is a chain).
+    Returns the list of per-stage layer-name lists."""
+    order = [n for n in graph.topo_order()
+             if graph.layers[n].type != "data"]
+    stages: list = []
+    last = -1
+    for name in order:
+        ldef = graph.layers[name]
+        dev = int(getattr(ldef, "attrs", {}).get("device", -1))
+        if dev < 0:
+            raise ValueError(
+                f"pipeline-from-device-attrs: layer {name!r} has no "
+                "device attr; every non-data layer needs a stage id")
+        if dev < last:
+            raise ValueError(
+                f"layer {name!r} (device {dev}) appears after stage "
+                f"{last}: stages must be contiguous along the topo order")
+        if dev > last:
+            if dev != last + 1:
+                raise ValueError(
+                    f"stage ids must be contiguous: jumped {last}->{dev}")
+            stages.append([])
+            last = dev
+        stages[dev].append(name)
+    return stages
+
+
+def make_pipeline_from_device_attrs(graph, params, mesh: Mesh, axis: str,
+                                    n_microbatches: int, full_net=None):
+    """Config-reachable GPipe: build the pipelined forward of a graph
+    whose per-layer ``device`` attrs assign stages (the reference's
+    placement spelling; see ``stages_from_device_attrs``).
+
+    Requirements (checked): ``mesh.shape[axis] ==`` number of stages;
+    stages are structurally identical (same layer-type/size sequence and
+    the same parameter shapes — the repeated-block idiom), each stage is
+    a chain consuming the previous stage's single output. Returns
+    ``(fn, stacked_sharded_params)`` with ``fn(stacked, x) -> y``, plus
+    the single-device ``sequential_apply`` parity path via the same
+    ``stage_fn`` closure (``fn.stage_fn``, ``fn.stacked``). Pass the
+    already-built ``full_net`` (a ``Network(graph)``) to skip rebuilding
+    shape inference just for the param-name mapping.
+
+    The same per-layer ``device`` field also serves the trainer's
+    model-axis shard hint (``parallel/mesh.py:device_attr_rules``); the
+    rule there detects the pipeline spelling (EVERY non-data layer
+    staged contiguously from 0) and stands down, so a config written for
+    this entry point is not silently model-sharded by the trainer."""
+    from paddle_tpu.config.model_config import ModelDef
+    from paddle_tpu.core.network import Network
+    from paddle_tpu.core.argument import Argument
+
+    stages = stages_from_device_attrs(graph)
+    S = len(stages)
+    if mesh.shape[axis] != S:
+        raise ValueError(f"{S} stages need mesh axis {axis!r} of size "
+                         f"{S}, got {mesh.shape[axis]}")
+    sigs = [[(graph.layers[n].type, graph.layers[n].size)
+             for n in st] for st in stages]
+    if any(sig != sigs[0] for sig in sigs[1:]):
+        raise ValueError(
+            "pipeline stages must be structurally identical (repeated-"
+            f"block idiom); got signatures {sigs}")
+
+    # stage-0 template sub-graph: one data layer feeding the chain
+    first = graph.layers[stages[0][0]]
+    in_name = first.input_names()[0]
+    import dataclasses as _dc
+    sub = ModelDef()
+    in_size = graph.layers[in_name].size if in_name in graph.layers else None
+    from paddle_tpu.config.model_config import LayerDef, Input
+    sub.add(LayerDef(name="__pipe_in__", type="data", size=in_size))
+    prev = "__pipe_in__"
+    for n in stages[0]:
+        ldef = graph.layers[n]
+        if len(ldef.input_names()) != 1:
+            raise ValueError(f"stage layer {n!r} must be a chain "
+                             "(single input)")
+        # rewire to the chain predecessor, KEEPING the Input's extra /
+        # param_attr (conv filter specs etc. live there)
+        sub.add(_dc.replace(
+            ldef, inputs=[_dc.replace(ldef.inputs[0], layer_name=prev)]))
+        prev = n
+    net = Network(sub, outputs=[stages[0][-1]])
+
+    # positional param mapping: stage s's params in stage-0 name space
+    full = full_net if full_net is not None else Network(graph)
+    per_stage = []
+    for st in stages:
+        sp = {}
+        for tmpl_layer, layer in zip(stages[0], st):
+            for suffix, pname in full._layer_params[layer].items():
+                tmpl_pname = full._layer_params[tmpl_layer][suffix]
+                sp[tmpl_pname] = params[pname]
+        per_stage.append(sp)
+    shapes = [{k: v.shape for k, v in sp.items()} for sp in per_stage]
+    if any(s != shapes[0] for s in shapes[1:]):
+        raise ValueError(f"stage parameter shapes differ: {shapes}")
+    stacked = stack_stage_params(per_stage)
+
+    def stage_fn(sp, x):
+        out = net.apply(sp, {"__pipe_in__": Argument(value=x)},
+                        train=False)
+        return out[stages[0][-1]].value
+
+    fn = make_pipeline(mesh, axis, stage_fn, n_microbatches)
+    fn = _attach(fn, stage_fn, shard_pipeline_params(stacked, mesh, axis))
+    return fn, fn.stacked
+
+
+def _attach(fn, stage_fn, stacked):
+    class _Pipe:
+        def __init__(self):
+            self.stage_fn = stage_fn
+            self.stacked = stacked
+
+        def __call__(self, params, x):
+            return fn(params, x)
+
+    return _Pipe()

@@ -348,6 +348,9 @@ class ChunkEvaluator(EvaluatorBase):
     """
 
     SCHEMES = {"plain": 1, "IOB": 2, "IOE": 2, "IOBES": 4}
+    # reads the layer's decoded-ids view when it carries one (the
+    # reference evaluator consumes output_.ids, ChunkEvaluator.cpp)
+    wants_ids = True
 
     def __init__(self, name=None, chunk_scheme: str = "IOB",
                  num_chunk_types: int = 1, excluded_chunk_types=()):

@@ -225,6 +225,11 @@ class SGD:
             # _accumulate's tuple protocol)
             metrics["eval_outputs"] = {
                 n: (outputs[n].value, outputs[n].mask)
+                if not (isinstance(outputs[n].state, dict)
+                        and "ids" in outputs[n].state)
+                else (outputs[n].value, outputs[n].mask,
+                      outputs[n].state["ids"],
+                      outputs[n].state.get("ids_mask"))
                 for n in self._eval_layers}
         return metrics
 
@@ -246,7 +251,8 @@ class SGD:
         def loss_fn(params, feed, rng, carried, probes=None):
             outputs, updates = network.apply_with_state(
                 self._cast_compute(params), self._cast_compute(feed),
-                train=True, rng=rng, carried=carried, probes=probes)
+                train=True, rng=rng, carried=carried, probes=probes,
+                mesh=self.mesh)
             return self._total_cost(outputs), (outputs, updates)
 
         def step(params, opt_state, feed, rng, num_passes, carried=None):
@@ -302,7 +308,8 @@ class SGD:
 
         def step(params, feed):
             outputs = network.apply(self._cast_compute(params),
-                                    self._cast_compute(feed), train=False)
+                                    self._cast_compute(feed), train=False,
+                                    mesh=self.mesh)
             return self._metrics(outputs, feed)
 
         return jax.jit(step)
@@ -517,6 +524,13 @@ class SGD:
             n_out = roles.get("n_outputs", 1)
             rest = vals[n_out:]
             kwargs = {"mask": host[ins[0]][1]}
+            if getattr(e, "wants_ids", False) and len(host[ins[0]]) > 2:
+                # the layer exposes a decoded-ids view alongside its
+                # value (crf_decoding with label: value = error
+                # indicator, ids = the path — ChunkEvaluator reads ids,
+                # Evaluator.cpp / CRFDecodingLayer.cpp semantics)
+                vals[0] = host[ins[0]][2]
+                kwargs["mask"] = host[ins[0]][3]
             if getattr(e, "wants_grad", False):
                 kwargs["grad"] = None  # supplied at print time
             if roles.get("has_label") and rest:
@@ -581,7 +595,8 @@ class SGD:
 
     # ------------------------------------------------------------ forward
     def forward(self, feed, output_names: Optional[List[str]] = None):
-        outputs = self.network.apply(self.params, feed, train=False)
+        outputs = self.network.apply(self.params, feed, train=False,
+                                     mesh=self.mesh)
         if output_names is None:
             return outputs
         return {n: outputs[n] for n in output_names}

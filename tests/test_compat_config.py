@@ -112,20 +112,12 @@ def normalize_layer_pair(ours, gold):
 
 
 def normalize_param_pair(ours, gold):
-    """Whitelisted dims-layout divergences (total size is ALWAYS
-    compared, and mismatched 2-dim layouts still fail):
-
-    1. fused-gate packing: the reference stores lstm/tensor weights as
-       3-dim blocks ((H, H, 4) / (D, D, K)); the engine packs them
-       2-dim ((H, 4H) / (D, D*K)) so the recurrent matmul is one MXU
-       op. Compared by total size only.
-    2. dimless goldens: create_input_parameter without dims (prelu
-       slopes) leaves ParameterConfig.dims empty; the engine always
-       records the physical shape.
-    """
-    if list(ours.dims) != list(gold.dims) and len(gold.dims) in (0, 3):
-        ours.ClearField("dims")
-        gold.ClearField("dims")
+    """Zero entries (VERDICT r04 item #6): parameters are compared
+    VERBATIM — the wire carries the reference's exact dims (3-dim
+    fused-gate blocks for lstm/tensor, dimless conv/batch-norm-scale
+    params via ``ParamSpec.wire_dims``); the engine reshapes at its own
+    boundary."""
+    pass
 
 
 @needs_ref

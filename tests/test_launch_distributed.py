@@ -73,6 +73,205 @@ WORKER = textwrap.dedent("""
 """)
 
 
+# --------------------------------------------------------------- elasticity
+# The reference's design promise: "trainers are stateless & restartable"
+# (doc/design/cluster_train/README.md); the Go master's own tests kill
+# in-process servers mid-job (go/master/client_internal_test.go). Both
+# scenarios here use REAL OS processes and SIGKILL.
+
+ELASTIC_WORKER = textwrap.dedent("""
+    import os, sys, time
+    sys.path.insert(0, {repo!r})
+    from paddle_tpu.dist.launch import init_from_env
+
+    ctx = init_from_env()
+    client = ctx.master_client(retries=60, retry_delay=0.25)
+    task_s = float(os.environ.get("TASK_SECONDS", "0.2"))
+    out = open(os.environ["WORKER_LOG"].format(ctx.process_id), "a", 1)
+    while True:
+        status, task = client.get_task(pass_id=0)
+        if status == "end":
+            break
+        if status == "wait":
+            time.sleep(0.15)
+            continue
+        for c in task.chunks:
+            out.write(f"start {{c}}\\n")
+        time.sleep(task_s)           # "training" on the chunk
+        client.call("task_finished", task_id=task.id)
+        for c in task.chunks:
+            out.write(f"done {{c}}\\n")
+    out.close()
+""")
+
+
+def _spawn_workers(tmp_path, repo, n, master_addr, victim_task_s=None,
+                   task_s=None):
+    import os
+    import subprocess
+    import sys as _sys
+    script = tmp_path / "elastic_worker.py"
+    script.write_text(ELASTIC_WORKER.format(repo=repo))
+    procs = []
+    for pid in range(n):
+        env = dict(os.environ,
+                   PADDLE_TPU_NUM_PROCESSES=str(n),
+                   PADDLE_TPU_PROCESS_ID=str(pid),
+                   PADDLE_TPU_COORDINATOR="",
+                   PADDLE_TPU_MASTER=master_addr,
+                   WORKER_LOG=str(tmp_path / "w{}.log"))
+        if task_s is not None:
+            env["TASK_SECONDS"] = str(task_s)
+        if victim_task_s is not None and pid == 0:
+            env["TASK_SECONDS"] = str(victim_task_s)
+        procs.append(subprocess.Popen([_sys.executable, str(script)],
+                                      env=env))
+    return procs
+
+
+def _worker_log(tmp_path, pid):
+    p = tmp_path / f"w{pid}.log"
+    return p.read_text().splitlines() if p.exists() else []
+
+
+@pytest.mark.timeout(120)
+def test_sigkill_trainer_midpass_job_completes(tmp_path):
+    """SIGKILL a trainer while it HOLDS a task lease: the master
+    requeues the lease on timeout, a survivor completes it, and the
+    pass resolves with every chunk finished exactly once."""
+    import time
+
+    from paddle_tpu.dist.master import MasterServer, MasterService
+
+    repo = str(pathlib.Path(__file__).resolve().parent.parent)
+    chunks = [f"chunk-{i}" for i in range(12)]
+    service = MasterService(timeout_s=2.0, chunks_per_task=1)
+    service.set_dataset(chunks)
+    server = MasterServer(service).start()
+    addr = f"{server.addr[0]}:{server.addr[1]}"
+    try:
+        # worker 0 is SLOW (5 s per task) so the kill is guaranteed to
+        # land mid-task, with a lease outstanding
+        procs = _spawn_workers(tmp_path, repo, 3, addr, victim_task_s=5.0)
+        victim = procs[0]
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:  # victim started its task?
+            started = [l for l in _worker_log(tmp_path, 0)
+                       if l.startswith("start ")]
+            if started:
+                break
+            time.sleep(0.1)
+        assert started, "victim never leased a task"
+        victim.kill()
+        victim.wait()
+        rcs = [p.wait(timeout=60) for p in procs[1:]]
+        assert rcs == [0, 0]
+        # master: the pass fully resolved, every chunk done EXACTLY once
+        assert service.pass_finished()
+        done_chunks = sorted(c for t in service.done for c in t.chunks)
+        assert done_chunks == sorted(chunks)
+        assert not service.todo and not service.pending
+        # the killed trainer's in-flight chunk was requeued and finished
+        # by a survivor (at-least-once repair, service.go:341-355)
+        victim_started = {l.split(" ", 1)[1] for l in
+                          _worker_log(tmp_path, 0) if l.startswith("start ")}
+        victim_done = {l.split(" ", 1)[1] for l in
+                       _worker_log(tmp_path, 0) if l.startswith("done ")}
+        orphaned = victim_started - victim_done
+        assert orphaned, "kill landed between tasks; expected mid-task"
+        survivor_done = {l.split(" ", 1)[1]
+                         for pid in (1, 2)
+                         for l in _worker_log(tmp_path, pid)
+                         if l.startswith("done ")}
+        assert orphaned <= survivor_done
+    finally:
+        server.stop()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
+MASTER_PROC = textwrap.dedent("""
+    import sys, time
+    sys.path.insert(0, {repo!r})
+    from paddle_tpu.dist.master import (FileStore, MasterServer,
+                                        MasterService)
+    port = int(sys.argv[1])
+    service = MasterService(FileStore(sys.argv[2]), timeout_s=2.0,
+                            chunks_per_task=1)
+    service.set_dataset([f"chunk-{{i}}" for i in range(10)])  # no-op if recovered
+    server = MasterServer(service, port=port).start()
+    print("ready", flush=True)
+    while True:
+        time.sleep(0.5)
+""")
+
+
+@pytest.mark.timeout(180)
+def test_master_kill_restart_recovers_from_snapshot(tmp_path):
+    """SIGKILL the master mid-job; restart it on the same port with the
+    same snapshot store: it recovers (pending leases requeued), workers'
+    clients re-dial, and the job completes every chunk exactly once."""
+    import json as _json
+    import subprocess
+    import sys as _sys
+    import time
+
+    from paddle_tpu.dist.launch import _free_port
+    from paddle_tpu.dist.master import FileStore
+
+    repo = str(pathlib.Path(__file__).resolve().parent.parent)
+    port = _free_port()
+    store_path = str(tmp_path / "master.snapshot")
+    mscript = tmp_path / "master_proc.py"
+    mscript.write_text(MASTER_PROC.format(repo=repo))
+
+    def start_master():
+        p = subprocess.Popen([_sys.executable, str(mscript), str(port),
+                              store_path], stdout=subprocess.PIPE,
+                             text=True)
+        assert p.stdout.readline().strip() == "ready"
+        return p
+
+    master = start_master()
+    procs = []
+    try:
+        procs = _spawn_workers(tmp_path, repo, 2,
+                               f"127.0.0.1:{port}", task_s=0.6)
+        # let the job get mid-flight (some done, some pending), then
+        # SIGKILL the master
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            done_lines = sum(
+                1 for pid in (0, 1) for l in _worker_log(tmp_path, pid)
+                if l.startswith("done "))
+            if done_lines >= 2:
+                break
+            time.sleep(0.1)
+        assert done_lines >= 2, "job never got going"
+        master.kill()
+        master.wait()
+        snap_at_kill = FileStore(store_path).load()
+        assert snap_at_kill is not None
+        state = _json.loads(snap_at_kill.decode())
+        assert state["done"], "expected completed tasks in the snapshot"
+        time.sleep(0.5)
+        master = start_master()  # same port, same store -> recovery
+        rcs = [p.wait(timeout=90) for p in procs]
+        assert rcs == [0, 0]
+        # final snapshot: all 10 chunks done exactly once, nothing lost
+        final = _json.loads(FileStore(store_path).load().decode())
+        done_chunks = sorted(c for t in final["done"] for c in t["chunks"])
+        assert done_chunks == [f"chunk-{i}" for i in range(10)]
+        assert final["todo"] == [] and final["pending"] == []
+    finally:
+        if master.poll() is None:
+            master.kill()
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
 @pytest.mark.timeout(600)
 def test_two_process_data_parallel_training(tmp_path):
     repo = str(pathlib.Path(__file__).resolve().parent.parent)

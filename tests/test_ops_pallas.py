@@ -328,12 +328,31 @@ def test_lstm_dispatch_pins_bench_shapes():
     from paddle_tpu.ops import common
     from paddle_tpu.ops.lstm import lstm_dispatch
     with common.force_mode("pallas"):
+        # EVERY BASELINE.md rnn-table shape (benchmark/README.md:108-161)
         assert lstm_dispatch(64, 256) == "resident"
+        assert lstm_dispatch(64, 512) == "resident"
         assert lstm_dispatch(64, 1280) == "tiled"
+        assert lstm_dispatch(128, 256) == "resident"
         assert lstm_dispatch(128, 1280) == "tiled"
+        assert lstm_dispatch(256, 256) == "resident"
         assert lstm_dispatch(256, 1280) == "tiled"
+        assert lstm_dispatch(512, 512) == "tiled"  # 4-GPU table row
     with common.force_mode("ref"):
         assert lstm_dispatch(64, 256) == "ref"
+
+
+def test_dispatch_table_matches_pins():
+    """bench.py embeds ``kernel_dispatch_table()`` in its output so perf
+    claims and dispatch can't drift apart (VERDICT r04 item #8); the
+    table must agree with the pins above."""
+    from paddle_tpu.ops import common
+    from paddle_tpu.ops.lstm import kernel_dispatch_table
+    with common.force_mode("pallas"):
+        table = kernel_dispatch_table()
+    assert table["lstm_bs64_h256"] == "resident"
+    assert table["lstm_bs64_h512"] == "resident"
+    assert table["lstm_bs512_h512"] == "tiled"
+    assert all(v in ("resident", "tiled") for v in table.values()), table
 
 
 def test_lstm_tiled_matches_ref_fwd_bwd():

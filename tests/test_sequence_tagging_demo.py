@@ -1,0 +1,41 @@
+"""The sequence-tagging demo (v1_api_demo/sequence_tagging/rnn_crf.py,
+the BiLSTM-CRF north star) trains through the CLI on the REAL CoNLL-2000
+slice checked into the reference (paddle/trainer/tests/train.txt), with
+the demo's own provider exec'd verbatim (py2 shims documented in
+tools/accuracy_run.py). The full 30-pass artifact lives in
+ACCURACY_r05.json (held-out chunk F1 0.93); this is the fast regression
+guard for the same path.
+"""
+
+import os
+import pathlib
+import sys
+
+import pytest
+
+REF = pathlib.Path("/root/reference/v1_api_demo/sequence_tagging")
+needs_ref = pytest.mark.skipif(not REF.exists(), reason="needs reference")
+
+
+@needs_ref
+def test_rnn_crf_trains_on_conll_slice(tmp_path):
+    sys.path.insert(0, str(pathlib.Path(__file__).parent.parent / "tools"))
+    try:
+        import accuracy_run as ar
+    finally:
+        sys.path.pop(0)
+    old_mod = sys.modules.pop("dataprovider", None)
+    try:
+        r = ar.job_sequence_tagging(str(tmp_path), passes=2)
+    finally:
+        sys.modules.pop("dataprovider", None)
+        if old_mod is not None:
+            sys.modules["dataprovider"] = old_mod
+    assert r["rc"] == 0
+    # 2 passes is a smoke bound — the chunk evaluator must report a real
+    # (finite, non-None) F1 from the decoded PATH, and the held-out eval
+    # must have run
+    assert r["final_train_chunk_f1"] is not None
+    assert 0.0 <= r["final_train_chunk_f1"] <= 1.0
+    assert r["heldout_chunk_f1"] is not None
+    assert 0.0 <= r["heldout_chunk_f1"] <= 1.0
