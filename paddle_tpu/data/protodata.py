@@ -180,7 +180,11 @@ def _decode_slot(sample: DataSample, i: int, slot: SlotDef,
         vs = sample.vector_slots[i]
         return list(zip(vs.ids, vs.values))
     if t == SlotDef.INDEX:
-        return int(sample.id_slots[i - num_vec_slots])
+        v = int(sample.id_slots[i - num_vec_slots])
+        # 0xffffffff is the reference's OOV/ignore sentinel
+        # (gen_proto_data.py OOV_POLICY_IGNORE): keep it as -1, the
+        # two's-complement form the reference engine stores
+        return -1 if v == 0xFFFFFFFF else v
     if t == SlotDef.VAR_MDIM_DENSE:
         return np.asarray(sample.vector_slots[i].values, np.float32)
     if t == SlotDef.STRING:

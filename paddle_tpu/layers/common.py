@@ -94,11 +94,23 @@ class EmbeddingLayer(LayerImpl):
 
     def apply(self, cfg, params, ins, ctx):
         ids = ins[0].value.astype(jnp.int32)
-        out = jnp.take(params["w0"], ids, axis=0)
+        out = _table_lookup(params["w0"], ids)
         return Argument(value=out, mask=ins[0].mask)
 
 
 # --------------------------------------------------------------------- mixed
+def _table_lookup(w: jnp.ndarray, ids: jnp.ndarray) -> jnp.ndarray:
+    """Row lookup with the reference's ignore semantics: id -1 (the
+    ProtoData OOV sentinel, ``ProtoDataProvider.cpp:198`` keeps -1U and
+    the engine skips those rows) contributes a ZERO row — never the
+    wrapped last row — and neither reads nor trains any embedding.
+    Out-of-range ids clamp to the last row (the reference CHECK-fails;
+    clamping keeps jit shapes static without NaN fills)."""
+    safe = jnp.clip(ids, 0, w.shape[0] - 1)
+    out = jnp.take(w, safe, axis=0)
+    return out * (ids >= 0)[..., None].astype(out.dtype)
+
+
 def _project(proj: dict, x: jnp.ndarray, w) -> jnp.ndarray:
     kind = proj.get("type", "full_matrix")
     if kind == "full_matrix":
@@ -120,7 +132,7 @@ def _project(proj: dict, x: jnp.ndarray, w) -> jnp.ndarray:
             # time). Executable interpretation = argmax-id. Ids-fed
             # tables never take this branch — they stay strict.
             ids = jnp.argmax(ids, axis=-1)
-        return jnp.take(w, ids.astype(jnp.int32), axis=0)
+        return _table_lookup(w, ids.astype(jnp.int32))
     if kind == "scaling":
         return x * w[0]
     if kind == "slice":

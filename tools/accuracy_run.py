@@ -128,16 +128,15 @@ def install_tagging_provider(workdir: str):
     """
     with open(os.path.join(workdir, "dataprovider.py"), "w") as f:
         f.write(f'''\
-import builtins
 import gzip as _gzip
 
-builtins.xrange = range  # the reference provider is python 2
 _src = open({TAG_PROVIDER!r}).read()
 # mechanical py2->py3 token translation (no logic change)
 _src = _src.replace(".iteritems()", ".items()")
 _src = _src.replace(".iterkeys()", ".keys()")
 _src = _src.replace(".itervalues()", ".values()")
-_ns = {{"__name__": "ref_tagging_provider"}}
+# py2 shim in the exec'd module's OWN globals (no builtins mutation)
+_ns = {{"__name__": "ref_tagging_provider", "xrange": range}}
 exec(compile(_src, {TAG_PROVIDER!r}, "exec"), _ns)
 
 
