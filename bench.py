@@ -252,6 +252,93 @@ def bench_image_config(name, compute_dtype="bfloat16", iters=None):
     }
 
 
+def bench_input_pipeline(decode_ms=None, batches=None, batch_size=24):
+    """Off-tunnel input-pipeline A/B: steps/s and host-blocked fraction
+    for the SAME provider-fed LSTM config with the async prefetch
+    pipeline off vs on, under a synthetic per-batch host decode cost
+    (default 5 ms — the acceptance shape of ISSUE r06). CPU-runnable
+    (``python bench.py --input-pipeline``) so BENCH_r06 has a real
+    number even when the tunnel is wedged; on TPU it rides along as a
+    child extra. ``data_wait_frac`` = fraction of step wall time the
+    trainer thread is blocked on data (data-wait + host h2d/decode) —
+    the quantity prefetch exists to drive to zero."""
+    import numpy as np
+    from paddle_tpu.config import dsl
+    from paddle_tpu.data import (DataFeeder, integer_value,
+                                 integer_value_sequence)
+    from paddle_tpu.data.provider import provider
+    from paddle_tpu.data.reader import batch as batch_reader
+    from paddle_tpu.models import lstm_text_classifier
+    from paddle_tpu.optim import Adam
+    from paddle_tpu.trainer import SGD
+
+    decode_ms = float(os.environ.get("BENCH_IP_DECODE_MS", "5.0")
+                      if decode_ms is None else decode_ms)
+    batches = int(os.environ.get("BENCH_IP_BATCHES", "30")
+                  if batches is None else batches)
+    vocab, seqlen = 1000, 32
+    dsl.reset()
+    cost, out, _ = lstm_text_classifier(
+        vocab_size=vocab, embed_dim=32, hidden=48, num_layers=1, classes=2)
+    trainer = SGD(cost=cost, update_equation=Adam(learning_rate=1e-3))
+
+    types = {"words": integer_value_sequence(vocab), "label": integer_value(2)}
+
+    @provider(input_types=types, should_shuffle=False)
+    def corpus(settings):
+        rng = np.random.RandomState(0)
+        for _ in range(batches * batch_size):
+            yield (list(rng.randint(0, vocab, size=seqlen)),
+                   int(rng.randint(0, 2)))
+
+    base_feeder = DataFeeder(types, pad_multiple=seqlen)
+
+    def slow_feeder(b):
+        time.sleep(decode_ms / 1e3)  # synthetic decode cost
+        return base_feeder(b)
+
+    import itertools
+    reader = batch_reader(corpus.as_reader(), batch_size, drop_last=True)
+    # compile outside the measured passes (same shapes throughout:
+    # fixed batch, pad_multiple = seqlen)
+    trainer.train(lambda: itertools.islice(reader(), 2),
+                  feeder=base_feeder, num_passes=1)
+
+    def measure(async_on):
+        trainer.train(reader, feeder=slow_feeder, num_passes=1,
+                      async_load_data=async_on)
+        s = trainer.step_breakdown()
+        return (s["steps_per_sec"],
+                s["data_wait_frac"] + s["h2d_frac"], s["steps"])
+
+    sync_sps, sync_wait, n1 = measure(False)
+    async_sps, async_wait, n2 = measure(True)
+    return {
+        "input_pipeline_steps_per_sec": round(async_sps, 3),
+        "input_pipeline_steps_per_sec_sync": round(sync_sps, 3),
+        "input_pipeline_speedup": round(async_sps / sync_sps, 3)
+        if sync_sps else None,
+        "data_wait_frac": round(async_wait, 4),
+        "data_wait_frac_sync": round(sync_wait, 4),
+        "input_pipeline_decode_ms": decode_ms,
+        "input_pipeline_batches": min(n1, n2),
+        "input_pipeline_batch_size": batch_size,
+        "input_pipeline_recompiles": trainer.recompile_guard.count,
+    }
+
+
+def input_pipeline_main():
+    """``python bench.py --input-pipeline``: the off-tunnel metric alone,
+    forced onto CPU (no tunnel involvement), one JSON line."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    result = {"metric": "input_pipeline_async_prefetch_ab",
+              "platform": jax.devices()[0].platform}
+    result.update(bench_input_pipeline())
+    print(json.dumps(result), flush=True)
+    return 0
+
+
 def _watchdog(seconds, exit_code):
     """Force-exit the child after a deadline. A wedged tunnel hangs inside
     C calls where SIGALRM handlers never run, but a watchdog thread's
@@ -317,10 +404,16 @@ def child_main():
     extra("alexnet", lambda: bench_image_config("alexnet"))
     extra("googlenet", lambda: bench_image_config("googlenet"))
     extra("smallnet", lambda: bench_image_config("smallnet_mnist_cifar"))
+    # the step-time-breakdown A/B rides along on-chip too, so a capture
+    # window reports the same {steps/s, data_wait_frac} split off-tunnel
+    # rounds record on CPU
+    extra("input_pipeline", bench_input_pipeline)
     return 0
 
 
 def main():
+    if "--input-pipeline" in sys.argv[1:]:
+        return input_pipeline_main()
     if os.environ.get("BENCH_CHILD") == "1":
         return child_main()
 

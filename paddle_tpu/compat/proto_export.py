@@ -670,8 +670,20 @@ def model_to_proto(model: ModelDef, context=None) -> "ModelConfig_pb2.ModelConfi
     for pname, spec in net.param_specs.items():
         if pname not in hoisted:
             all_params.setdefault(pname, spec)
+    # momentum is per-parameter on the wire (ParameterConfig.momentum,
+    # the reference's default_momentum path — OptimizationConfig has no
+    # such field): an explicitly-set coefficient is written to every
+    # parameter so serialize -> createFromProtoString round-trips it
+    method = (context.settings.get("learning_method")
+              if context is not None and getattr(context, "settings", None)
+              else None)
+    wire_momentum = (float(method.momentum)
+                     if getattr(method, "explicit_momentum", False) else 0.0)
     for pname in sorted(all_params):
-        _export_parameter(pname, all_params[pname], mc.parameters.add())
+        pc = mc.parameters.add()
+        _export_parameter(pname, all_params[pname], pc)
+        if wire_momentum:
+            pc.momentum = wire_momentum
     input_names = (context.input_layer_names if context is not None
                    and context.input_layer_names else model.input_layer_names)
     mc.input_layer_names.extend(

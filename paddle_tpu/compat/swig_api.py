@@ -720,10 +720,16 @@ class GradientMachine:
             err, cnt = classification_error(outs[out_l], lab)
             evaluator.accumulate(float(err), float(cnt))
 
-    def loadParameters(self, path: str):
+    def loadParameters(self, path: str, strict: bool = True):
         """``GradientMachine::loadParameters`` (``PaddleAPI.h:790``):
         accepts an engine ``.npz`` checkpoint or a reference v1 model
-        directory (one Parameter::save file per parameter)."""
+        directory (one Parameter::save file per parameter).
+
+        ``strict`` (default on, the reference's behavior — its
+        ``Parameter::load`` CHECK-fails on a missing file) raises when
+        any model parameter is absent from the checkpoint; pass
+        ``strict=False`` for intentional partial loads (the old
+        warn-and-keep-random-init behavior, ADVICE r05 #4)."""
         import os
         if os.path.isdir(path):
             from paddle_tpu.compat.param_format import load_v1_model_dir
@@ -759,10 +765,18 @@ class GradientMachine:
                         f"loadParameters: {name!r} has shape {v.shape}, "
                         f"the model needs {want}")
                 loaded[name] = jnp.asarray(v)
+        missing = sorted(set(self._params) - set(loaded))
+        if missing and strict:
+            # raise BEFORE mutating: a partially-loaded machine silently
+            # training/generating from garbage is the failure mode
+            raise ValueError(
+                f"loadParameters: {len(missing)} model parameters absent "
+                f"from {path}: {missing[:8]}"
+                + ("..." if len(missing) > 8 else "")
+                + " (pass strict=False for an intentional partial load)")
         # every shape validated above — only now mutate, so a mismatch
         # never leaves the machine half-loaded
         self._params.update(loaded)
-        missing = sorted(set(self._params) - set(loaded))
         if missing:
             from paddle_tpu.utils import logger
             logger.warning("loadParameters: %d parameters missing in %s "
@@ -1008,7 +1022,23 @@ class OptimizationConfig:
         self._factory = factory
 
     @staticmethod
-    def createFromProto(proto):
+    def createFromProtoString(blob: bytes) -> "OptimizationConfig":
+        """``OptimizationConfig::createFromProtoString``
+        (``PaddleAPI.h:533``): deserialize the wire-format proto and
+        route through ``createFromProto``."""
+        from paddle_tpu.proto import OptimizationConfig as _OptProto
+        proto = _OptProto()
+        proto.ParseFromString(bytes(blob))
+        return OptimizationConfig.createFromProto(proto)
+
+    @staticmethod
+    def createFromProto(proto, parameters=None):
+        """Map a wire-format ``OptimizationConfig`` onto an
+        engine-optimizer factory. ``parameters`` (the sibling
+        ``model_config.parameters``, when the caller has the full
+        ``TrainerConfig``) recovers the momentum coefficient — it rides
+        the wire per-parameter (``ParameterConfig.momentum``, the
+        reference's default_momentum path), not on OptimizationConfig."""
         from paddle_tpu.compat.trainer_config_helpers.optimizers import (
             build_optimizer)
         settings = {
@@ -1025,7 +1055,9 @@ class OptimizationConfig:
         # per-method hyper-params (momentum/ada_epsilon/...) ride along
         from paddle_tpu.compat.trainer_config_helpers import optimizers as o
         cls = {
-            "momentum": lambda: o.MomentumOptimizer(proto.momentum),
+            "momentum": lambda: o.MomentumOptimizer(
+                max((p.momentum for p in parameters), default=0.0)
+                if parameters is not None else 0.0),
             "adagrad": lambda: o.AdaGradOptimizer(),
             "adadelta": lambda: o.AdaDeltaOptimizer(),
             "rmsprop": lambda: o.RMSPropOptimizer(),
@@ -1041,6 +1073,23 @@ class OptimizationConfig:
         return self._factory()
 
 
+class _ProtoParsedConfig:
+    """Wire-format stand-in for ``config_parser.ParsedConfig``: the two
+    members ``TrainerConfig`` hands out (``model_config`` proto +
+    ``optimizer`` factory), reconstituted from a deserialized
+    ``TrainerConfig`` message instead of re-run python source."""
+
+    def __init__(self, proto):
+        self.trainer_config = proto
+        self.model_config = proto.model_config
+
+    def optimizer(self):
+        return OptimizationConfig.createFromProto(
+            self.trainer_config.opt_config,
+            parameters=self.trainer_config.model_config.parameters,
+        ).make_optimizer()
+
+
 class TrainerConfig:
     """``swig_paddle.TrainerConfig``: parse a config file and hand out
     its model/optimization pieces (``TrainerConfigHelper`` role)."""
@@ -1054,10 +1103,17 @@ class TrainerConfig:
         return TrainerConfig(parse_config(path, config_args))
 
     @staticmethod
-    def createFromProtoString(blob: bytes):
-        raise NotImplementedError(
-            "create from a config FILE (createFromTrainerConfigFile) — "
-            "a serialized TrainerConfig has no python source to re-run")
+    def createFromProtoString(blob: bytes) -> "TrainerConfig":
+        """``TrainerConfig::createFromProtoString`` (``PaddleAPI.h:631``):
+        a serialized ``TrainerConfig`` needs no python source to re-run —
+        the wire-format importer (``compat/proto_import.py``) rebuilds a
+        runnable graph from its expanded ``model_config``, and the
+        ``opt_config`` maps through ``OptimizationConfig.createFromProto``
+        (the same path ``GradientMachine.createFromConfigProto`` uses)."""
+        from paddle_tpu.proto import TrainerConfig as _TCProto
+        proto = _TCProto()
+        proto.ParseFromString(bytes(blob))
+        return TrainerConfig(_ProtoParsedConfig(proto))
 
     def getModelConfig(self):
         return self._parsed.model_config

@@ -47,6 +47,11 @@ class MomentumOptimizer(BaseSGDOptimizer):
 
     def __init__(self, momentum=None, sparse=False):
         self.momentum = 1e-3 if momentum is None else momentum
+        # an explicitly-passed coefficient rides the wire per-parameter
+        # (ParameterConfig.momentum, the reference's default_momentum
+        # path); the implicit 1e-3 default stays off the wire so golden
+        # parity is untouched (proto_export.model_to_proto)
+        self.explicit_momentum = momentum is not None
         self.sparse = sparse
 
     def engine_kwargs(self):

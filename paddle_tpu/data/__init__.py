@@ -1,6 +1,8 @@
 from paddle_tpu.data import reader  # noqa: F401
 from paddle_tpu.data import recordio  # noqa: F401
-from paddle_tpu.data.feeder import DataFeeder  # noqa: F401
+from paddle_tpu.data.feeder import DataFeeder, ROW_MASK_KEY  # noqa: F401
+from paddle_tpu.data.prefetch import (  # noqa: F401
+    LengthBuckets, PrefetchPipeline, RecompileGuard, prefetch_reader)
 from paddle_tpu.data.types import (  # noqa: F401
     dense_vector, dense_vector_sequence, integer_value,
     integer_value_sequence, sparse_binary_vector, sparse_float_vector)

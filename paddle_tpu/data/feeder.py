@@ -5,7 +5,11 @@ dataprovider_converter.py``) + the SWIG ``Arguments`` assembly: given input
 type declarations, converts a minibatch (list of tuples) into a feed dict of
 padded Arguments. Sequence inputs are padded to ``pad_multiple`` to bound
 XLA recompilation (bucketed static shapes) — the TPU answer to ragged
-offset batches.
+offset batches. ``length_buckets`` tightens that bound to a fixed menu of
+padded lengths, and ``batch_buckets`` pads short (e.g. final partial)
+batches up to a bucketed row count with all-masked rows plus a
+``ROW_MASK_KEY`` feed entry the trainer uses to ignore them exactly
+(zero loss, zero grad — see ``trainer/trainer.py:_total_cost``).
 """
 
 from __future__ import annotations
@@ -18,21 +22,80 @@ import jax.numpy as jnp
 from paddle_tpu.core.argument import Argument
 from paddle_tpu.data import types as T
 
+# feed-dict entry carrying the [B] f32 row-validity mask emitted when
+# batch_buckets pads the batch dim. Not a data layer: Network.apply only
+# reads data-layer names, so the entry flows untouched to the trainer.
+# Like every mask it is f32 COUNT data (never cast to bf16); the trainer
+# reads it from the *uncast* feed.
+ROW_MASK_KEY = "__row_mask__"
+
 
 def _ceil_to(n: int, m: int) -> int:
     return ((max(n, 1) + m - 1) // m) * m
 
 
+def _zero_sample(itype: T.InputType):
+    """An all-padding sample for one input slot: empty for sequences
+    (rows pad to an all-zero mask), zeros otherwise."""
+    if itype.seq_type != T.NO_SEQUENCE:
+        return []
+    if itype.type == T.INDEX:
+        return 0
+    if itype.type in (T.SPARSE_BINARY, T.SPARSE_FLOAT):
+        return []
+    return np.zeros(itype.dim, dtype=np.float32)
+
+
 class DataFeeder:
     def __init__(self, feeding: Dict[str, T.InputType],
-                 pad_multiple: int = 32):
+                 pad_multiple: int = 32,
+                 length_buckets: Optional[Sequence[int]] = None,
+                 batch_buckets: Optional[Sequence[int]] = None):
         """feeding: data-layer name -> InputType, in feed order if the
-        reader yields tuples."""
+        reader yields tuples. ``length_buckets``: fixed menu of padded
+        sequence lengths (``data/prefetch.py:LengthBuckets``) overriding
+        the pad_multiple ceiling. ``batch_buckets``: menu of batch sizes;
+        short batches pad up with dead rows + a ROW_MASK_KEY entry."""
         self.feeding = feeding
         self.names = list(feeding)
         self.pad_multiple = pad_multiple
+        self.length_buckets = None
+        if length_buckets is not None:
+            from paddle_tpu.data.prefetch import LengthBuckets
+            self.length_buckets = (
+                length_buckets if isinstance(length_buckets, LengthBuckets)
+                else LengthBuckets(length_buckets))
+        self.batch_buckets = (sorted(int(b) for b in batch_buckets)
+                              if batch_buckets else None)
+
+    def _pad_len(self, raw_max: int) -> int:
+        if self.length_buckets is not None:
+            return self.length_buckets.pad_len(raw_max)
+        return _ceil_to(raw_max, self.pad_multiple)
 
     def convert(self, batch: List[Tuple]) -> Dict[str, Argument]:
+        n_real = len(batch)
+        row_mask = None
+        if self.batch_buckets:
+            import bisect
+            # batch sizes are a CLOSED menu (unlike lengths, there is no
+            # overflow rule): a batch beyond the largest bucket is a
+            # reader/config mismatch, not something to pad around
+            i = bisect.bisect_left(self.batch_buckets, n_real)
+            if i == len(self.batch_buckets):
+                raise ValueError(
+                    f"batch of {n_real} exceeds the largest batch bucket "
+                    f"{self.batch_buckets[-1]}; include the reader's "
+                    "batch size in batch_buckets")
+            target = self.batch_buckets[i]
+            pad_row = tuple(_zero_sample(self.feeding[n])
+                            for n in self.names)
+            batch = list(batch) + [pad_row] * (target - n_real)
+            # emitted whenever bucketing is on (even unpadded batches) so
+            # the feed's pytree structure is step-invariant — a structure
+            # flip would itself force a jit recompile
+            row_mask = np.zeros(target, dtype=np.float32)
+            row_mask[:n_real] = 1.0
         cols = list(zip(*batch))
         if len(cols) != len(self.names):
             raise ValueError(
@@ -41,6 +104,8 @@ class DataFeeder:
         feed = {}
         for name, col in zip(self.names, cols):
             feed[name] = self._convert_one(self.feeding[name], col)
+        if row_mask is not None:
+            feed[ROW_MASK_KEY] = Argument(value=jnp.asarray(row_mask))
         return feed
 
     __call__ = convert
@@ -69,8 +134,8 @@ class DataFeeder:
             # nested recurrent groups consume, layers/group.py)
             B = len(col)
             S = max(len(s) for s in col)
-            Tm = _ceil_to(max((len(ss) for s in col for ss in s),
-                              default=1), self.pad_multiple)
+            Tm = self._pad_len(max((len(ss) for s in col for ss in s),
+                                   default=1))
             mask = np.zeros((B, S, Tm), dtype=np.float32)
             if itype.type == T.INDEX:
                 value = np.zeros((B, S, Tm), dtype=np.int32)
@@ -101,8 +166,8 @@ class DataFeeder:
                             mask[i, j, t] = 1.0
             return Argument(value=jnp.asarray(value),
                             mask=jnp.asarray(mask))
-        # sequences: pad to multiple for shape bucketing
-        max_len = _ceil_to(max(len(s) for s in col), self.pad_multiple)
+        # sequences: pad to multiple / bucket edge for shape bucketing
+        max_len = self._pad_len(max(len(s) for s in col))
         bsz = len(col)
         mask = np.zeros((bsz, max_len), dtype=np.float32)
         if itype.type == T.INDEX:

@@ -1,0 +1,299 @@
+"""Async input pipeline: host data work overlapped with device compute.
+
+The reference dedicates a native double-buffer thread to exactly this —
+``PyDataProvider2``'s async pool and ``DataProvider.h:249,343``
+(``--use_async_load_data``): while the GPU steps batch N, a host thread
+decodes and stages batch N+1. Under JAX the equivalent overlap is a
+bounded background-thread pipeline that finishes each batch with a
+**sharded ``jax.device_put``** so the H2D copy (and any cross-device
+scatter) is already in flight when the trainer asks for the batch; XLA's
+async dispatch does the rest (the jitted step for batch N executes while
+the host prepares N+1).
+
+Three pieces:
+
+- :class:`PrefetchPipeline` — wraps any batched reader (PyDP2
+  ``@provider`` readers, ProtoData, RecordIO, v2 readers: anything the
+  trainer can consume) with decode → pad/bucket (the feeder) → shard →
+  ``device_put`` in a worker thread, keeping ``depth`` batches in flight
+  (double-buffer default). Bounded queue = backpressure; worker
+  exceptions re-raise in the consumer; ``close()`` (or the context
+  manager / generator ``close``) shuts the worker down cleanly.
+- :class:`LengthBuckets` — the recompile-guard's shape policy: pad
+  ragged lengths up to a small fixed set of bucket edges so a ragged
+  corpus compiles at most ``len(edges)+1`` step variants instead of one
+  per length (the feeder's ``pad_multiple`` ceiling is the degenerate
+  single-bucket case). Padding stays exactly ignored because masks are
+  f32 count data the layers already honor (``core/argument.py``).
+- :class:`RecompileGuard` — a compilation-cache monitor over the jitted
+  step: warns (once) when the cache exceeds ``warn_after`` entries, so
+  shape thrash is loud instead of silently eating XLA compile time.
+
+The native C++ pool (``native/src/native.cc``, ``ptr_pool_*``) is the
+record-level backend of the same bounded-queue interface: it prefetches
+raw records off disk; this module prefetches *prepared device batches*.
+Stack them freely — reader decorators compose.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from queue import Empty, Full, Queue
+from typing import Callable, Optional, Sequence
+
+from paddle_tpu.utils.log import get_logger
+from paddle_tpu.utils.stat import StatRegistry, global_stat, timer
+
+logger = get_logger("prefetch")
+
+_END = object()
+
+
+class _Failure:
+    """Worker-thread exception, carried through the queue to the consumer."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+# ---------------------------------------------------------------- buckets
+class LengthBuckets:
+    """Pad-to-bucket policy for ragged sequence lengths.
+
+    ``edges`` is a small ascending set of padded lengths (e.g.
+    ``[32, 64, 128, 256]``). A raw max-length pads to the smallest edge
+    that holds it; lengths beyond the last edge pad to the next multiple
+    of it (so the variant count stays bounded by
+    ``len(edges) + ceil(true_max / edges[-1])``, not by the corpus's
+    length distribution). This is the TPU answer to the reference's
+    ragged ``sequenceStartPositions`` offsets: XLA wants static shapes,
+    so shapes come from a fixed menu."""
+
+    def __init__(self, edges: Sequence[int]):
+        edges = sorted(int(e) for e in edges)
+        if not edges or edges[0] < 1:
+            raise ValueError(f"bucket edges must be positive ints: {edges}")
+        if len(set(edges)) != len(edges):
+            raise ValueError(f"duplicate bucket edges: {edges}")
+        self.edges = edges
+
+    def pad_len(self, n: int) -> int:
+        """Smallest bucket holding a raw length ``n``."""
+        n = max(int(n), 1)
+        i = bisect.bisect_left(self.edges, n)
+        if i < len(self.edges):
+            return self.edges[i]
+        last = self.edges[-1]
+        return ((n + last - 1) // last) * last
+
+    def __repr__(self):
+        return f"LengthBuckets({self.edges})"
+
+
+# ----------------------------------------------------------- the pipeline
+class PrefetchPipeline:
+    """Bounded background-thread input pipeline over one pass of data.
+
+    ``reader``: zero-arg callable returning an iterable of raw batches
+    (the trainer's usual minibatch reader). ``feeder``: optional
+    batch -> feed-dict converter (``DataFeeder`` or any callable) run in
+    the worker — this is where decode/pad/bucket cost lives. ``mesh``:
+    when given, batches land sharded over the data axis
+    (``parallel/mesh.py:shard_batch``); otherwise a plain
+    ``jax.device_put`` starts the H2D copy early. ``depth``: batches in
+    flight (2 = the reference's double buffer).
+
+    Iterate it (or call :meth:`get`) to consume; iteration ends at the
+    reader's end. A worker exception re-raises at the consumer's next
+    pull, after already-prepared batches drain (ordering is preserved —
+    a single worker thread feeds a FIFO queue). ``close()`` is
+    idempotent and safe mid-stream; the context manager and generator
+    ``close`` call it.
+
+    Timing: decode and H2D seconds accumulate into the stat registry
+    (``prefetch/decode``, ``prefetch/h2d``); consumer-side blocked time
+    accumulates into ``prefetch/wait`` and :attr:`data_wait` — the
+    numerator of the bench's ``data_wait_frac``.
+    """
+
+    def __init__(self, reader: Callable, feeder: Optional[Callable] = None,
+                 mesh=None, depth: int = 2,
+                 registry: Optional[StatRegistry] = None,
+                 place: bool = True):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self._reader = reader
+        self._feeder = feeder
+        self._mesh = mesh
+        self._place = place
+        self._registry = registry or global_stat
+        self._q: Queue = Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._closed = False
+        self.depth = depth
+        self.data_wait = 0.0  # consumer seconds blocked on the queue
+        self.batches = 0
+        self._thread = threading.Thread(
+            target=self._work, name="prefetch-worker", daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------- worker
+    def _prepare(self, raw):
+        if self._feeder is not None:
+            with timer("prefetch/decode", self._registry):
+                raw = self._feeder(raw)
+        if self._place:
+            with timer("prefetch/h2d", self._registry):
+                raw = self._device_put(raw)
+        return raw
+
+    def _device_put(self, feed):
+        import jax
+        if self._mesh is not None:
+            from paddle_tpu.parallel import mesh as mesh_lib
+            return mesh_lib.shard_batch(feed, self._mesh)
+        return jax.device_put(feed)
+
+    def _work(self):
+        try:
+            for raw in self._reader():
+                if self._stop.is_set():
+                    return
+                item = self._prepare(raw)
+                if not self._put(item):
+                    return
+            self._put(_END)
+        except BaseException as e:  # noqa: BLE001 — crosses the thread
+            self._put(_Failure(e))
+
+    def _put(self, item) -> bool:
+        """Blocking put that honors close(); False when shut down."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except Full:
+                continue
+        return False
+
+    # ----------------------------------------------------------- consumer
+    def get(self):
+        """Next prepared batch; raises StopIteration at end of pass and
+        re-raises a worker exception (chained) at its queue position."""
+        if self._closed:
+            raise StopIteration
+        t0 = time.perf_counter()
+        item = self._q.get()
+        dt = time.perf_counter() - t0
+        self.data_wait += dt
+        self._registry.get("prefetch/wait").add(dt)
+        if item is _END:
+            self._closed = True
+            raise StopIteration
+        if isinstance(item, _Failure):
+            self._closed = True
+            raise item.exc
+        self.batches += 1
+        return item
+
+    def __iter__(self):
+        try:
+            while True:
+                try:
+                    yield self.get()
+                except StopIteration:
+                    return
+        finally:
+            self.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def close(self):
+        """Stop the worker and release its blocked put; idempotent."""
+        self._closed = True
+        self._stop.set()
+        # drain so a worker blocked on a full queue sees the stop flag
+        while True:
+            try:
+                self._q.get_nowait()
+            except Empty:
+                break
+        self._thread.join(timeout=5.0)
+
+
+def prefetch_reader(reader: Callable, feeder: Optional[Callable] = None,
+                    mesh=None, depth: int = 2,
+                    place: bool = True) -> Callable:
+    """Decorator form: wrap a batched reader so each call streams through
+    a fresh :class:`PrefetchPipeline`. The result yields *prepared feeds*
+    (already through the feeder and on device), so it marks itself
+    ``is_prefetched`` — the trainer skips its own feeder/shard step."""
+
+    pass_aware = getattr(reader, "pass_aware", False)
+
+    def prefetched(*args):
+        src = (lambda: reader(*args)) if args else reader
+        pipe = PrefetchPipeline(src, feeder=feeder, mesh=mesh, depth=depth,
+                                place=place)
+        return iter(pipe)
+
+    prefetched.is_prefetched = True
+    prefetched.pass_aware = pass_aware
+    prefetched.input_types = getattr(reader, "input_types", None)
+    return prefetched
+
+
+# ---------------------------------------------------------------- guard
+def jit_cache_size(fn) -> Optional[int]:
+    """Number of compiled variants a jitted callable holds, or None when
+    the probe isn't available on this jax version."""
+    probe = getattr(fn, "_cache_size", None)
+    if probe is None:
+        return None
+    try:
+        return int(probe())
+    except Exception:  # noqa: BLE001 — a probe must never break training
+        return None
+
+
+class RecompileGuard:
+    """Compilation-cache monitor for a jitted step function.
+
+    The XLA failure mode this guards is *silent*: a ragged corpus with
+    unbucketed shapes retraces/recompiles the step every batch, and
+    training limps along at compile speed with no error anywhere. The
+    guard polls the jit cache (``check()`` per step is cheap) and logs
+    one loud warning when the variant count passes ``warn_after`` —
+    pointing at the bucketing knobs that bound it."""
+
+    def __init__(self, fn, warn_after: int = 8, name: str = "train_step"):
+        self.fn = fn
+        self.warn_after = int(warn_after)
+        self.name = name
+        self.warned = False
+
+    @property
+    def count(self) -> Optional[int]:
+        return jit_cache_size(self.fn)
+
+    def check(self) -> Optional[int]:
+        n = self.count
+        if (n is not None and not self.warned and self.warn_after > 0
+                and n > self.warn_after):
+            self.warned = True
+            logger.warning(
+                "%s recompiled %d times — the input shapes are thrashing "
+                "XLA's compile cache. Bucket your batch shapes (DataFeeder "
+                "length_buckets/batch_buckets, or a coarser pad_multiple) "
+                "so a ragged corpus compiles a bounded set of variants.",
+                self.name, n)
+        return n

@@ -320,9 +320,21 @@ class MixedLayer(LayerImpl):
         return ShapeInfo(size=cfg.size,
                          is_sequence=any(i.is_sequence for i in in_infos))
 
+    @staticmethod
+    def _default_projs(cfg, n):
+        """Default-fill for a missing/empty ``projections`` attr:
+        full_matrix everywhere EXCEPT operator-argument slots, which
+        carry no projection of their own — marking them full_matrix
+        would fabricate unused parameters and poison the conv/flat
+        mixing check for valid operator-only configs (ADVICE r05 #1)."""
+        op_args = {i for op in (cfg.attrs.get("operators") or [])
+                   for i in op.get("input_indices", [])}
+        return [{"type": "identity_op_arg"} if i in op_args
+                else {"type": "full_matrix"} for i in range(n)]
+
     def params(self, cfg, in_infos):
-        projs = cfg.attrs.get("projections") or [
-            {"type": "full_matrix"} for _ in in_infos]
+        projs = cfg.attrs.get("projections") or self._default_projs(
+            cfg, len(in_infos))
         specs: Dict[str, ParamSpec] = {}
         for i, info in enumerate(in_infos):
             specs.update(self._param_for(i, projs[i] or {}, info, cfg))
@@ -384,8 +396,8 @@ class MixedLayer(LayerImpl):
         return {}  # identity
 
     def apply(self, cfg, params, ins, ctx):
-        projs = cfg.attrs.get("projections") or [
-            {"type": "full_matrix"} for _ in ins]
+        projs = cfg.attrs.get("projections") or self._default_projs(
+            cfg, len(ins))
         ops = cfg.attrs.get("operators") or []
         conv_kinds = {"conv", "convt"}
         # operator-argument slots carry no projection of their own

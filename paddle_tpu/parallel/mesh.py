@@ -117,6 +117,23 @@ def create_multislice_mesh(n_slices: Optional[int] = None,
     return Mesh(devs, (DCN_AXIS, DATA_AXIS, MODEL_AXIS))
 
 
+def shard_map_compat(f, mesh: Mesh, in_specs, out_specs,
+                     check_vma: bool = False):
+    """``jax.shard_map`` across jax versions: newer jax exports it
+    top-level with ``check_vma``; older jax has
+    ``jax.experimental.shard_map`` with the same knob named
+    ``check_rep``. One spelling for every shard_map consumer
+    (pipeline/moe/ring)."""
+    try:
+        from jax import shard_map as _sm
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_vma=check_vma)
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _sm
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=check_vma)
+
+
 def batch_axes(mesh: Mesh):
     """Mesh axes the batch dimension is split over (dcn is part of DP)."""
     if DCN_AXIS in mesh.axis_names:
@@ -131,12 +148,21 @@ def data_parallel_degree(mesh: Mesh) -> int:
     return d
 
 
+def batch_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """The NamedSharding a rank-``ndim`` batch array takes: dim 0 split
+    over the data (+dcn) axes, the rest replicated. The single source of
+    truth for batch placement — ``shard_batch`` and the async input
+    pipeline's device_put stage (``data/prefetch.py``) both use it, so a
+    prefetched batch lands exactly where the step expects it."""
+    axes = batch_axes(mesh)
+    return NamedSharding(mesh, P(axes, *([None] * (ndim - 1))))
+
+
 def shard_batch(feed: Dict[str, Argument], mesh: Mesh) -> Dict[str, Argument]:
     """Place a feed dict with the batch dim split over the data axis (and
     the dcn axis on a multi-slice mesh)."""
 
     n_data = data_parallel_degree(mesh)
-    axes = batch_axes(mesh)
 
     def place(x):
         if x.shape[0] % n_data != 0:
@@ -144,9 +170,9 @@ def shard_batch(feed: Dict[str, Argument], mesh: Mesh) -> Dict[str, Argument]:
                 f"batch size {x.shape[0]} not divisible by data-parallel "
                 f"degree {n_data}; pad or resize the batch (the reference "
                 "splits remainders unevenly across TrainerThreads — on a "
-                "SPMD mesh the split must be exact)")
-        spec = P(axes, *([None] * (x.ndim - 1)))
-        sharding = NamedSharding(mesh, spec)
+                "SPMD mesh the split must be exact; DataFeeder "
+                "batch_buckets pads with masked rows)")
+        sharding = batch_sharding(mesh, x.ndim)
         if jax.process_count() > 1:
             # multi-host SPMD (dist.launch jobs): device_put cannot target
             # non-addressable devices; each process contributes the shards
@@ -255,6 +281,16 @@ def device_attr_rules(graph, param_specs, mesh: Mesh,
                             for n in non_data})
         if len(stage_ids) > 1 and \
                 stage_ids == list(range(len(stage_ids))):
+            # a user who meant --parallel_nn shard hints (not GPipe
+            # stages) must be able to see why they were ignored
+            from paddle_tpu.utils.log import logger as _logger
+            _logger.warning(
+                "device_attr_rules: every non-data layer carries a "
+                "contiguous device id 0..%d — treating the config as a "
+                "pipeline-stage spelling and standing down the model-axis "
+                "shard hints. If you meant --parallel_nn-style placement "
+                "hints, leave at least one non-data layer unpinned or "
+                "pass explicit shard_rules.", len(stage_ids) - 1)
             return out
     for pname, spec in param_specs.items():
         if any((pat[1:] == pname if pat.startswith("=") else pat in pname)

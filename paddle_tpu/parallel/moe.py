@@ -135,8 +135,8 @@ def make_moe(mesh: Mesh, axis: str, n_experts: int, capacity: int):
         y = out_all[eid, jnp.clip(slot, 0, capacity - 1)]
         return y * (gate * keep.astype(x.dtype))[:, None]
 
-    from jax import shard_map
-    fn = shard_map(
+    from paddle_tpu.parallel.mesh import shard_map_compat
+    fn = shard_map_compat(
         local, mesh=mesh,
         in_specs=({"wg": P(), "w1": P(axis), "b1": P(axis),
                    "w2": P(axis), "b2": P(axis)}, P(), P()),

@@ -106,8 +106,8 @@ def make_pipeline(mesh: Mesh, axis: str, stage_fn: Callable,
         outputs = lax.psum(outputs * mask, axis)
         return outputs.reshape(B, *outputs.shape[2:])
 
-    from jax import shard_map
-    fn = shard_map(
+    from paddle_tpu.parallel.mesh import shard_map_compat
+    fn = shard_map_compat(
         local, mesh=mesh,
         # pytree-prefix specs: every stacked param shards stage-major
         in_specs=(P(axis), P()),
@@ -188,6 +188,23 @@ def make_pipeline_from_device_attrs(graph, params, mesh: Mesh, axis: str,
         raise ValueError(
             "pipeline stages must be structurally identical (repeated-"
             f"block idiom); got signatures {sigs}")
+    # chain topology holds for EVERY stage, not just the stage-0 template
+    # (an identically-signed later stage with different fan-in — e.g. a
+    # 2-input addto — would otherwise silently execute with stage-0's
+    # wiring, ADVICE r05 #2)
+    for s, st in enumerate(stages):
+        for j, n in enumerate(st):
+            names = graph.layers[n].input_names()
+            if len(names) != 1:
+                raise ValueError(
+                    f"stage {s} layer {n!r} must be a chain (single "
+                    f"input); it has inputs {names}")
+            want = (st[j - 1] if j > 0
+                    else stages[s - 1][-1] if s > 0 else None)
+            if want is not None and names[0] != want:
+                raise ValueError(
+                    f"stage {s} layer {n!r} consumes {names[0]!r}, but a "
+                    f"pipeline chain requires its predecessor {want!r}")
 
     # stage-0 template sub-graph: one data layer feeding the chain
     first = graph.layers[stages[0][0]]
@@ -199,10 +216,7 @@ def make_pipeline_from_device_attrs(graph, params, mesh: Mesh, axis: str,
     sub.add(LayerDef(name="__pipe_in__", type="data", size=in_size))
     prev = "__pipe_in__"
     for n in stages[0]:
-        ldef = graph.layers[n]
-        if len(ldef.input_names()) != 1:
-            raise ValueError(f"stage layer {n!r} must be a chain "
-                             "(single input)")
+        ldef = graph.layers[n]  # fan-in validated for all stages above
         # rewire to the chain predecessor, KEEPING the Input's extra /
         # param_attr (conv filter specs etc. live there)
         sub.add(_dc.replace(

@@ -134,14 +134,14 @@ def make_ring_attention(mesh, axis_name, kind="ring", causal=False):
     ``axis_name`` (sequence dim). Inputs/outputs are global [B, N, T, D]
     (+ optional kv_mask [B, T]); sharding + collectives happen inside."""
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from paddle_tpu.parallel.mesh import shard_map_compat
 
     inner = ring_attention if kind == "ring" else ulysses_attention
     spec = P(None, None, axis_name, None)
     mask_spec = P(None, axis_name)
 
     @functools.partial(
-        shard_map, mesh=mesh,
+        shard_map_compat, mesh=mesh,
         in_specs=(spec, spec, spec, mask_spec),
         out_specs=spec, check_vma=False)
     def sharded(q, k, v, kv_mask):

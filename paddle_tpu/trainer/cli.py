@@ -78,6 +78,17 @@ def parse_args(argv=None):
                    choices=["bfloat16", "float32"],
                    help="mixed precision (TPU-native addition): f32 "
                         "master params, forward/backward in this dtype")
+    p.add_argument("--use_async_load_data", action="store_true",
+                   help="decode/pad/shard/device_put batches in a "
+                        "background thread, overlapped with the device "
+                        "step (the reference's --use_async_load_data "
+                        "double buffer, DataProvider.h:249)")
+    p.add_argument("--prefetch_depth", type=int, default=2,
+                   help="batches in flight under --use_async_load_data "
+                        "(2 = double buffer)")
+    p.add_argument("--show_step_breakdown", action="store_true",
+                   help="log the per-step host-time split {data_wait, "
+                        "h2d, compute, callback} at each log_period")
     return p.parse_args(argv)
 
 
@@ -248,6 +259,11 @@ def cmd_train(ns, args):
                   show_parameter_stats_period=(
                       args.show_parameter_stats_period),
                   show_layer_stat=args.show_layer_stat,
+                  async_load_data=getattr(args, "use_async_load_data",
+                                          False),
+                  prefetch_depth=getattr(args, "prefetch_depth", 2),
+                  show_step_breakdown=getattr(args, "show_step_breakdown",
+                                              False),
                   checkpointer=ck)
     return 0
 

@@ -15,10 +15,13 @@ import jax.numpy as jnp
 from paddle_tpu.core.argument import Argument
 
 
-def classification_error(output: Argument, label: Argument) -> jnp.ndarray:
+def classification_error(output: Argument, label: Argument,
+                         row_mask: jnp.ndarray = None) -> jnp.ndarray:
     """Fraction of rows whose argmax != label
     (``ClassificationErrorEvaluator``, Evaluator.cpp). Returns (errors,
-    count) so the trainer can aggregate across batches.
+    count) so the trainer can aggregate across batches. ``row_mask``
+    ([B] f32, batch-bucket padding) removes dead rows from both the
+    error sum and the count.
 
     This is the *device-side* stat producer used inside the jitted train/
     eval step; the host-side evaluator framework (including the richer
@@ -35,8 +38,13 @@ def classification_error(output: Argument, label: Argument) -> jnp.ndarray:
                else jnp.pad(lab, ((0, 0), (0, T - lab.shape[1]))))
     wrong = (pred != lab).astype(jnp.float32)
     if output.mask is not None:
+        # dead rows already carry an all-zero token mask; row_mask would
+        # be redundant here
         wrong = wrong * output.mask
         count = jnp.sum(output.mask)
+    elif row_mask is not None:
+        wrong = wrong * row_mask
+        count = jnp.sum(row_mask)
     else:
         count = jnp.float32(wrong.shape[0])
     return jnp.sum(wrong), count

@@ -36,6 +36,8 @@ from paddle_tpu.trainer.evaluators import Accumulator, classification_error
 
 _CLASSIFICATION_COSTS = {"multi-class-cross-entropy"}
 
+_END_OF_PASS = object()  # reader-exhausted sentinel for the timed next()
+
 
 def _call_reader(reader, pass_id: int):
     """Invoke a per-pass reader. Readers that declare ``pass_aware = True``
@@ -80,7 +82,8 @@ class SGD:
                  seed: int = 0, is_local: bool = True,
                  evaluators: Optional[List[dict]] = None,
                  prev_batch_state: bool = False,
-                 compute_dtype: Optional[Any] = None):
+                 compute_dtype: Optional[Any] = None,
+                 recompile_warn: int = 8):
         if update_equation is None:
             raise ValueError("update_equation (an Optimizer) is required")
         self.topology = (cost if isinstance(cost, Topology)
@@ -157,12 +160,29 @@ class SGD:
         self._rng = jax.random.PRNGKey(seed + 1)
         self._train_step = self._build_train_step()
         self._eval_step = self._build_eval_step()
+        # recompile-guard: a ragged corpus with unbucketed shapes silently
+        # retraces the step per batch; the guard makes that loud
+        # (data/prefetch.py:RecompileGuard; warn_after=recompile_warn)
+        from paddle_tpu.data.prefetch import RecompileGuard
+        from paddle_tpu.utils.profiler import StepBreakdown
+        self.recompile_guard = RecompileGuard(self._train_step,
+                                              warn_after=recompile_warn)
+        self.breakdown = StepBreakdown()
 
     def _cast_compute(self, tree):
         if self.compute_dtype is None:
             return tree
         dt = self.compute_dtype
         from paddle_tpu.core.argument import Argument
+        from paddle_tpu.data.feeder import ROW_MASK_KEY
+        if isinstance(tree, dict) and ROW_MASK_KEY in tree:
+            # the row-validity mask is f32 COUNT data like every mask
+            # (bf16 saturates at 256 rows) — exempt it by key, the same
+            # invariant the structural mask exemption below enforces
+            rest = {k: v for k, v in tree.items() if k != ROW_MASK_KEY}
+            out = self._cast_compute(rest)
+            out[ROW_MASK_KEY] = tree[ROW_MASK_KEY]
+            return out
 
         def cast(x):
             if hasattr(x, "dtype") and x.dtype == jnp.float32:
@@ -200,24 +220,44 @@ class SGD:
         return jax.tree_util.tree_map(cast, tree)
 
     # ------------------------------------------------------------ builders
-    def _total_cost(self, outputs):
+    @staticmethod
+    def _row_mask(feed):
+        """[B] f32 row-validity mask the bucketing feeder emits when it
+        pads the batch dim (``data/feeder.py:ROW_MASK_KEY``); None for
+        unpadded feeds. Read from the UNCAST feed — like every mask it
+        is count data and must stay f32."""
+        from paddle_tpu.data.feeder import ROW_MASK_KEY
+        arg = feed.get(ROW_MASK_KEY) if feed is not None else None
+        return arg.value if arg is not None else None
+
+    def _total_cost(self, outputs, row_mask=None):
         """Sum of all cost layers' batch-mean — multi-task configs train
         on the sum (the reference's Argument::sum over outArgs). Reduces
-        in f32 even under bf16 compute (batch sums need the mantissa)."""
+        in f32 even under bf16 compute (batch sums need the mantissa).
+        ``row_mask`` makes batch-bucket padding exact: dead rows are
+        zeroed out of the sum AND out of the denominator, so the loss
+        (and its gradient) equals the unpadded batch's."""
         total = 0.0
         for n in getattr(self.topology, "cost_names",
                          [self.topology.cost_name]):
             v = outputs[n].value.astype(jnp.float32)
-            total = total + jnp.sum(v) / v.shape[0]
+            if row_mask is not None:
+                rm = row_mask.reshape((-1,) + (1,) * (v.ndim - 1))
+                total = total + jnp.sum(v * rm) / jnp.maximum(
+                    jnp.sum(row_mask), 1.0)
+            else:
+                total = total + jnp.sum(v) / v.shape[0]
         return total
 
     def _metrics(self, outputs, feed):
         cost_name = self.topology.cost_name
         cdef = self.topology.graph.layers[cost_name]
-        metrics = {"cost": self._total_cost(outputs)}
+        row_mask = self._row_mask(feed)
+        metrics = {"cost": self._total_cost(outputs, row_mask)}
         if cdef.type in _CLASSIFICATION_COSTS:
             out_l, lab_l = cdef.input_names()[0], cdef.input_names()[1]
-            errs, cnt = classification_error(outputs[out_l], outputs[lab_l])
+            errs, cnt = classification_error(outputs[out_l], outputs[lab_l],
+                                             row_mask=row_mask)
             metrics["classification_error"] = (errs, cnt)
         if self._eval_layers:
             # layer outputs the config-declared evaluators consume; fetched
@@ -253,7 +293,8 @@ class SGD:
                 self._cast_compute(params), self._cast_compute(feed),
                 train=True, rng=rng, carried=carried, probes=probes,
                 mesh=self.mesh)
-            return self._total_cost(outputs), (outputs, updates)
+            return (self._total_cost(outputs, self._row_mask(feed)),
+                    (outputs, updates))
 
         def step(params, opt_state, feed, rng, num_passes, carried=None):
             if carried is not None:
@@ -276,7 +317,11 @@ class SGD:
             # grads are already f32 (cotangents take the f32 params' dtype);
             # only the moving-stat updates computed in bf16 need casting
             updates = self._cast_f32(updates)
-            bsz = outputs[cost_name].value.shape[0]
+            row_mask = self._row_mask(feed)
+            # LIVE rows drive the lr schedule's sample count, not the
+            # padded shape (sum_gradients scaling likewise)
+            bsz = (jnp.sum(row_mask) if row_mask is not None
+                   else outputs[cost_name].value.shape[0])
             new_params, new_opt = optimizer.update(
                 grads, opt_state, params, meta, batch_size=bsz,
                 num_passes=num_passes)
@@ -319,7 +364,9 @@ class SGD:
               event_handler: Optional[Callable] = None,
               log_period: int = 0, checkpointer=None,
               dot_period: int = 0, show_parameter_stats_period: int = 0,
-              show_layer_stat: bool = False):
+              show_layer_stat: bool = False,
+              async_load_data: bool = False, prefetch_depth: int = 2,
+              show_step_breakdown: bool = False):
         """reader yields minibatches (lists of sample tuples); feeder
         converts them to Arguments (or pass feed dicts directly).
         ``log_period``>0 logs a TrainerStats-style line and dumps+resets the
@@ -334,7 +381,18 @@ class SGD:
         (dist.Checkpointer) restores the newest intact checkpoint before
         training — resuming at the pass after the saved one, the
         ``--start_pass`` semantics of ``Trainer.cpp:229-250`` — and saves
-        on its cadence at batch and pass boundaries."""
+        on its cadence at batch and pass boundaries.
+
+        ``async_load_data`` (the reference's ``--use_async_load_data``,
+        ``DataProvider.h:249``) runs decode → pad/bucket → shard →
+        device_put in a background thread with ``prefetch_depth`` batches
+        in flight (``data/prefetch.py``), overlapping host data work with
+        device compute. A reader already wrapped by ``prefetch_reader``
+        (``is_prefetched``) yields ready feeds and is consumed as such.
+        ``show_step_breakdown`` logs the per-step host-time split
+        {data_wait, h2d, compute, callback} at each log_period and pass
+        end (``utils/profiler.py:StepBreakdown``; always accumulated —
+        the flag only controls logging)."""
         from paddle_tpu.utils import global_stat, logger, timer
         start_pass = 0
         if checkpointer is not None:
@@ -355,6 +413,16 @@ class SGD:
                     start_pass = pid
         event_handler = event_handler or (lambda e: None)
         acc = Accumulator()
+        bd = self.breakdown
+        bd.reset()
+        # a prefetch_reader-wrapped reader already yields prepared,
+        # device-placed feeds; async_load_data wraps a plain reader here
+        pre_prepared = bool(getattr(reader, "is_prefetched", False))
+        if pre_prepared and feeder is not None:
+            raise ValueError(
+                "feeder would be silently ignored: this reader is already "
+                "prefetched — pass the feeder to prefetch_reader(...) "
+                "instead")
         for pass_id in range(start_pass, num_passes):
             event_handler(ev.BeginPass(pass_id))
             acc.reset()
@@ -362,70 +430,116 @@ class SGD:
             self._carried = None  # reference resets RNN state per pass
             window_cost, window_n = 0.0, 0
             dots_pending = False
-            for batch_id, data in enumerate(_call_reader(reader, pass_id)):
-                event_handler(ev.BeginIteration(pass_id, batch_id))
-                with timer("prepareBatchData"):
-                    feed = feeder(data) if feeder is not None else data
-                    if self.mesh is not None:
-                        feed = mesh_lib.shard_batch(feed, self.mesh)
-                self._rng, step_rng = jax.random.split(self._rng)
-                if self._carried is not None:
-                    # a batch-size change (e.g. smaller final batch) makes
-                    # the carried state unusable: reset, like the
-                    # reference's resetState on shape change
-                    b_feed = next(iter(feed.values())).value.shape[0]
-                    b_carry = jax.tree_util.tree_leaves(
-                        self._carried)[0].shape[0]
-                    if b_carry != b_feed:
-                        self._carried = None
-                with timer("trainBatch"):
-                    self.params, self.opt_state, metrics = self._train_step(
-                        self.params, self.opt_state, feed, step_rng,
-                        jnp.int32(pass_id), self._carried)
-                    cost = float(metrics["cost"])
-                if self._carry_layers:
-                    self._carried = metrics.pop("carried")
-                evals = self._accumulate(acc, metrics)
-                self._feed_host_evaluators(metrics, feed=feed, rng=step_rng)
-                window_cost += cost
-                window_n += 1
-                if dot_period and (batch_id + 1) % dot_period == 0:
-                    print(".", end="", flush=True)
-                    dots_pending = True
-                stats_due = show_parameter_stats_period and \
-                    (batch_id + 1) % show_parameter_stats_period == 0
-                log_due = log_period and (batch_id + 1) % log_period == 0
-                if dots_pending and (stats_due or log_due):
-                    print(flush=True)  # newline before the periodic lines
-                    dots_pending = False
-                if stats_due:
-                    for pname, st in self.parameter_stats().items():
-                        logger.info(
-                            "Param %s: %s", pname,
-                            " ".join(f"{k}={v:.5g}"
-                                     for k, v in st.items()))
-                if log_due:
-                    # Cost is windowed (reset each log_period); AvgEval is
-                    # cumulative since pass start, like the reference's
-                    # "Eval:" vs "CurrentEval:" split (TrainerInternal.cpp).
-                    logger.info(
-                        "Pass=%d Batch=%d Cost=%.5f AvgEval: %s", pass_id,
-                        batch_id + 1, window_cost / window_n,
-                        " ".join(f"{k}={v:.5g}" for k, v in
-                                 {**evals, **self.host_eval_values(
-                                     include_printers=False)}.items()))
-                    logger.info("\n%s", global_stat.status(reset=True))
-                    window_cost, window_n = 0.0, 0
-                    if show_layer_stat:
-                        for lname, st in self.layer_stats(feed).items():
+            pipe = None
+            if async_load_data and not pre_prepared:
+                from paddle_tpu.data.prefetch import PrefetchPipeline
+                pipe = PrefetchPipeline(
+                    lambda: _call_reader(reader, pass_id), feeder=feeder,
+                    mesh=self.mesh, depth=prefetch_depth)
+                stream = iter(pipe)
+            else:
+                stream = iter(_call_reader(reader, pass_id))
+            batch_id = -1
+            try:
+                while True:
+                    t_step = time.perf_counter()
+                    # blocked-on-data time: the sync reader's own cost, or
+                    # the prefetch queue wait (near zero once it keeps up)
+                    with bd.measure("data_wait"):
+                        data = next(stream, _END_OF_PASS)
+                    if data is _END_OF_PASS:
+                        break
+                    batch_id += 1
+                    event_handler(ev.BeginIteration(pass_id, batch_id))
+                    if pipe is not None or pre_prepared:
+                        feed = data  # decoded + sharded by the worker thread
+                    else:
+                        with bd.measure("h2d"), timer("prepareBatchData"):
+                            feed = feeder(data) if feeder is not None else data
+                            if self.mesh is not None:
+                                feed = mesh_lib.shard_batch(feed, self.mesh)
+                    self._rng, step_rng = jax.random.split(self._rng)
+                    if self._carried is not None:
+                        # a batch-size change (e.g. smaller final batch) makes
+                        # the carried state unusable: reset, like the
+                        # reference's resetState on shape change
+                        b_feed = next(iter(feed.values())).value.shape[0]
+                        b_carry = jax.tree_util.tree_leaves(
+                            self._carried)[0].shape[0]
+                        if b_carry != b_feed:
+                            self._carried = None
+                    with bd.measure("compute"), timer("trainBatch"):
+                        self.params, self.opt_state, metrics = self._train_step(
+                            self.params, self.opt_state, feed, step_rng,
+                            jnp.int32(pass_id), self._carried)
+                        # a real host fetch: on remote devices
+                        # block_until_ready returns before execution finishes
+                        cost = float(metrics["cost"])
+                    self.recompile_guard.check()
+                    t_cb = time.perf_counter()
+                    if self._carry_layers:
+                        self._carried = metrics.pop("carried")
+                    evals = self._accumulate(acc, metrics)
+                    self._feed_host_evaluators(metrics, feed=feed, rng=step_rng)
+                    window_cost += cost
+                    window_n += 1
+                    if dot_period and (batch_id + 1) % dot_period == 0:
+                        print(".", end="", flush=True)
+                        dots_pending = True
+                    stats_due = show_parameter_stats_period and \
+                        (batch_id + 1) % show_parameter_stats_period == 0
+                    log_due = log_period and (batch_id + 1) % log_period == 0
+                    if dots_pending and (stats_due or log_due):
+                        print(flush=True)  # newline before the periodic lines
+                        dots_pending = False
+                    if stats_due:
+                        for pname, st in self.parameter_stats().items():
                             logger.info(
-                                "Layer %s: avg_abs=%.5g max_abs=%.5g",
-                                lname, st["avg_abs"], st["max_abs"])
-                event_handler(ev.EndIteration(pass_id, batch_id, cost, evals))
-                if checkpointer is not None:
-                    checkpointer.maybe_save(self.params, self.opt_state,
-                                            pass_id=pass_id,
-                                            batch_id=batch_id + 1)
+                                "Param %s: %s", pname,
+                                " ".join(f"{k}={v:.5g}"
+                                         for k, v in st.items()))
+                    if log_due:
+                        # Cost is windowed (reset each log_period); AvgEval is
+                        # cumulative since pass start, like the reference's
+                        # "Eval:" vs "CurrentEval:" split (TrainerInternal.cpp).
+                        logger.info(
+                            "Pass=%d Batch=%d Cost=%.5f AvgEval: %s", pass_id,
+                            batch_id + 1, window_cost / window_n,
+                            " ".join(f"{k}={v:.5g}" for k, v in
+                                     {**evals, **self.host_eval_values(
+                                         include_printers=False)}.items()))
+                        if show_step_breakdown:
+                            logger.info("%s", bd.status())
+                        logger.info("\n%s", global_stat.status(reset=True))
+                        window_cost, window_n = 0.0, 0
+                        if show_layer_stat:
+                            for lname, st in self.layer_stats(feed).items():
+                                logger.info(
+                                    "Layer %s: avg_abs=%.5g max_abs=%.5g",
+                                    lname, st["avg_abs"], st["max_abs"])
+                    event_handler(ev.EndIteration(pass_id, batch_id, cost, evals))
+                    if checkpointer is not None:
+                        checkpointer.maybe_save(self.params, self.opt_state,
+                                                pass_id=pass_id,
+                                                batch_id=batch_id + 1)
+                    bd.add("callback", time.perf_counter() - t_cb)
+                    # true wall denominator: work outside the four
+                    # brackets (BeginIteration handlers, rng split) shows
+                    # as a shortfall from 1.0 instead of inflating steps/s
+                    bd.step_done(time.perf_counter() - t_step)
+            finally:
+                # the worker must not outlive this pass — a raising
+                # event handler / step / checkpointer (or Ctrl-C)
+                # would otherwise leak a thread holding `depth`
+                # device batches until GC (and a traceback pinning the
+                # frame defeats GC entirely)
+                if pipe is not None:
+                    pipe.close()
+                close = getattr(stream, "close", None)
+                if close is not None:
+                    close()  # a prefetch_reader stream: its generator's
+                    # finally closes the pipeline it owns; harmless on
+                    # plain generators
             if dots_pending:
                 print(flush=True)  # close the dot line at pass end
             # apply deferred sparse-row updates so the pass ends with
@@ -433,11 +547,19 @@ class SGD:
             self.params, self.opt_state = self.optimizer.catch_up(
                 self.params, self.opt_state, self.meta,
                 num_passes=pass_id)
+            if show_step_breakdown:
+                logger.info("%s", bd.status())
             event_handler(ev.EndPass(
                 pass_id, {**acc.result(), **self.host_eval_values()}))
             if checkpointer is not None:
                 checkpointer.maybe_save(self.params, self.opt_state,
                                         pass_id=pass_id, end_of_pass=True)
+
+    def step_breakdown(self) -> Dict[str, float]:
+        """Summary of the last train() call's per-step host-time split
+        (plus the prefetch worker's queue-wait total): the bench's
+        ``input_pipeline_steps_per_sec`` / ``data_wait_frac`` source."""
+        return self.breakdown.summary()
 
     def load_state(self, params: Dict[str, Any], opt_flat=None):
         """Install restored parameters (+ optionally a flattened optimizer
@@ -482,7 +604,7 @@ class SGD:
             total_cost += float(metrics["cost"])
             batches += 1
             self._accumulate(acc, metrics)
-            self._feed_host_evaluators(metrics)
+            self._feed_host_evaluators(metrics, feed=feed)
         return ev.TestResult(0, total_cost / max(batches, 1),
                              {**acc.result(), **self.host_eval_values()})
 
@@ -509,11 +631,22 @@ class SGD:
         if not outs or not self._host_evals:
             return
         host = jax.device_get(outs)
+        row_mask = self._row_mask(feed) if feed is not None else None
+        if row_mask is not None:
+            # batch-bucket padding appends dead rows at the END of the
+            # batch (feeder.py): slice every fetched array to the live
+            # prefix so host evaluators never see padding — exact for
+            # sequence AND non-sequence metrics alike
+            n_live = int(np.asarray(jax.device_get(row_mask)).sum())
+            host = {k: tuple(v[:n_live] if v is not None else None
+                             for v in tup) for k, tup in host.items()}
         probe_grads = metrics.get("probe_grads")
         if probe_grads is not None:
             # d(cost)/d(layer output) computed in the SAME backward as the
             # batch's step (pre-update params, reference semantics)
             pg = jax.device_get(probe_grads)
+            if row_mask is not None:
+                pg = {k: v[:n_live] for k, v in pg.items()}
             for e, ins, _ in self._host_evals:
                 if getattr(e, "wants_grad", False) and ins and ins[0] in pg:
                     e.last = pg[ins[0]]

@@ -1,17 +1,26 @@
-"""Device profiling bracket.
+"""Device profiling bracket + host-side step-time breakdown.
 
 The reference brackets regions with ``hl_profiler_start/end`` +
 ``GpuProfiler`` (``paddle/utils/Stat.h:282-300``, ``WITH_PROFILER``); the
 TPU-native equivalent is a jax profiler trace: every op inside the bracket
 lands in a TensorBoard-loadable trace with the per-layer ``named_scope``
 annotations from the graph executor.
+
+:class:`StepBreakdown` is the coarse host-side complement: per-step wall
+time split into {data-wait, h2d, compute, callback} so the first-order
+utilization question — is the chip waiting on the host? — is answerable
+without a trace. The trainer feeds it (``--show_step_breakdown``), the
+bench emits its summary as the off-tunnel input-pipeline metric.
 """
 
 from __future__ import annotations
 
+import time
 from contextlib import contextmanager
 
 import jax
+
+from paddle_tpu.utils.stat import StatRegistry, global_stat
 
 
 @contextmanager
@@ -23,3 +32,79 @@ def profiler_trace(log_dir: str):
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+class StepBreakdown:
+    """Per-step host-side wall-time split.
+
+    Parts:
+
+    - ``data_wait`` — blocked pulling the next batch (the reader's own
+      cost when synchronous; queue-wait when the async pipeline runs —
+      near zero once prefetch keeps up).
+    - ``h2d``      — feed conversion + device placement done on the
+      trainer thread (``prepareBatchData``); with prefetch on this moves
+      into the worker (``prefetch/decode`` / ``prefetch/h2d`` stats) and
+      the trainer-side number collapses.
+    - ``compute``  — step dispatch through the device fetch
+      (``block_until_ready``-equivalent: a host read of the cost).
+    - ``callback`` — host evaluators, event handlers, periodic logging.
+
+    Every ``add`` also lands in the stat registry (``step/<part>``) so
+    the existing ``log_period`` dump shows the same numbers. ``summary``
+    yields the bench metrics: ``steps_per_sec`` and ``data_wait_frac``.
+    """
+
+    PARTS = ("data_wait", "h2d", "compute", "callback")
+
+    def __init__(self, registry: StatRegistry = None):
+        self.registry = registry or global_stat
+        self.reset()
+
+    def reset(self):
+        self.steps = 0
+        self.wall = 0.0  # true per-step wall time, when the caller times it
+        self.totals = {p: 0.0 for p in self.PARTS}
+
+    def add(self, part: str, seconds: float):
+        self.totals[part] += seconds
+        self.registry.get(f"step/{part}").add(seconds)
+
+    @contextmanager
+    def measure(self, part: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(part, time.perf_counter() - t0)
+
+    def step_done(self, wall_seconds: float = None):
+        """Count a finished step; pass the step's true wall time so
+        throughput and fractions use it as the denominator — work outside
+        the four measured brackets then shows up as a shortfall from 1.0
+        instead of silently inflating steps/s."""
+        self.steps += 1
+        if wall_seconds is not None:
+            self.wall += wall_seconds
+
+    @property
+    def total(self) -> float:
+        return self.wall if self.wall > 0 else sum(self.totals.values())
+
+    def summary(self) -> dict:
+        total = self.total
+        out = {"steps": self.steps,
+               "steps_per_sec": (self.steps / total) if total > 0 else 0.0}
+        for p in self.PARTS:
+            out[f"{p}_frac"] = (self.totals[p] / total) if total > 0 else 0.0
+            out[f"{p}_ms_per_step"] = (
+                1e3 * self.totals[p] / self.steps if self.steps else 0.0)
+        return out
+
+    def status(self) -> str:
+        s = self.summary()
+        parts = " ".join(
+            f"{p}={s[f'{p}_ms_per_step']:.2f}ms({s[f'{p}_frac'] * 100:.1f}%)"
+            for p in self.PARTS)
+        return (f"StepBreakdown: steps={self.steps} "
+                f"steps/s={s['steps_per_sec']:.3f} {parts}")

@@ -80,6 +80,34 @@ def test_per_sample_filters_match_individual_convs():
     np.testing.assert_allclose(np.asarray(out2[1]), np.asarray(out[1]))
 
 
+def test_operator_only_mixed_without_projections_attr_executes():
+    """ADVICE r05 #1: a valid operator-only mixed config whose
+    ``projections`` attr is absent (the wire format omits it when no
+    input carries a proj_conf) must execute — the default fill marks
+    operator-argument slots ``identity_op_arg``, not ``full_matrix``,
+    so the conv/flat mixing check no longer fires spuriously, and no
+    phantom projection parameters are created for operator slots."""
+    dsl.reset()
+    dsl.data(name="img", size=1 * 4 * 4, channels=1, height=4, width=4)
+    dsl.data(name="flt", size=3 * 1 * 3 * 3)
+    g = dsl.current_graph()
+    op = {"type": "conv_op", "filter_size": 3, "num_filters": 3,
+          "num_channels": 1, "stride": 1, "padding": 0,
+          "input_indices": [0, 1]}
+    g.add(LayerDef(name="out", type="mixed",
+                   inputs=[Input("img"), Input("flt")],
+                   bias=False, attrs={"operators": [op]}))  # no projections
+    net = Network(g, outputs=["out"])
+    params = net.init_params(jax.random.PRNGKey(0))
+    assert params == {}  # operator slots fabricate no parameters
+    out = net.apply({}, _feed(), train=False)["out"].value
+    assert out.shape == (2, 2, 2, 3)
+    # parity with the explicit identity_op_arg spelling
+    want = _mixed_conv_net().apply({}, _feed(), train=False)["out"].value
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=1e-6, atol=1e-7)
+
+
 def test_trans_operator_upsamples():
     net = _mixed_conv_net(trans=True)
     out = net.apply({}, _feed(), train=False)["out"].value

@@ -120,6 +120,43 @@ def test_device_attr_shards_layer_over_model_axis():
                                    err_msg=k)
 
 
+def test_device_attr_pipeline_stand_down_warns(caplog):
+    """ADVICE r05 #3: when EVERY non-data layer is pinned with
+    contiguous device ids (the GPipe-stage spelling), the trainer's
+    model-shard hints stand down — and now say so out loud, so a
+    --parallel_nn user can see why their hints were ignored."""
+    import logging
+
+    from paddle_tpu.core.network import Network
+    from paddle_tpu.parallel.mesh import device_attr_rules
+
+    dsl.reset()
+    x = dsl.data(name="x", size=16)
+    h = dsl.fc(input=x, size=16, name="s0", layer_attr={"device": 0})
+    dsl.fc(input=h, size=16, name="s1", layer_attr={"device": 1})
+    g = dsl.current_graph()
+    net = Network(g, outputs=["s1"])
+    mesh = create_mesh(n_data=2, n_model=4)
+    plogger = logging.getLogger("paddle_tpu")
+    plogger.addHandler(caplog.handler)
+    try:
+        rules = device_attr_rules(g, net.param_specs, mesh, None)
+    finally:
+        plogger.removeHandler(caplog.handler)
+    assert rules == {}  # stood down
+    assert "standing down" in caplog.text
+    # the hint form (only SOME layers pinned) still shards — no warning
+    caplog.clear()
+    dsl.reset()
+    x = dsl.data(name="x", size=16)
+    h = dsl.fc(input=x, size=16, name="s0", layer_attr={"device": 1})
+    dsl.fc(input=h, size=16, name="s1")
+    g2 = dsl.current_graph()
+    net2 = Network(g2, outputs=["s1"])
+    rules2 = device_attr_rules(g2, net2.param_specs, mesh, None)
+    assert any("_s0" in k for k in rules2)
+
+
 def test_dryrun_multichip_entry():
     import __graft_entry__ as ge
     ge.dryrun_multichip(8)

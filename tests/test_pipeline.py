@@ -154,3 +154,55 @@ def test_pipeline_from_device_attrs_rejects_bad_configs():
     dsl.fc(input=h, size=8, name="b", layer_attr={"device": 2})
     with _pytest.raises(ValueError, match="contiguous"):
         stages_from_device_attrs(dsl.current_graph())
+
+
+def _two_stage_graph(stage1_wiring="chain"):
+    """Two structurally identical 2-fc stages; stage 1 optionally breaks
+    the chain contract in a way the (type, size) signature can't see."""
+    from paddle_tpu.config import dsl
+
+    dsl.reset()
+    x = dsl.data(name="x", size=8)
+    a0 = dsl.fc(input=x, size=8, name="a0", layer_attr={"device": 0})
+    b0 = dsl.fc(input=a0, size=8, name="b0", layer_attr={"device": 0})
+    if stage1_wiring == "chain":
+        a1 = dsl.fc(input=b0, size=8, name="a1", layer_attr={"device": 1})
+        dsl.fc(input=a1, size=8, name="b1", layer_attr={"device": 1})
+    elif stage1_wiring == "fan_in":
+        a1 = dsl.fc(input=b0, size=8, name="a1", layer_attr={"device": 1})
+        # 2-input fc: same (type, size) signature, different topology
+        dsl.fc(input=[a1, a0], size=8, name="b1",
+               layer_attr={"device": 1})
+    else:  # skip: consumes a non-predecessor
+        a1 = dsl.fc(input=a0, size=8, name="a1", layer_attr={"device": 1})
+        dsl.fc(input=a1, size=8, name="b1", layer_attr={"device": 1})
+    return dsl.current_graph()
+
+
+def test_pipeline_validates_fan_in_for_every_stage():
+    """ADVICE r05 #2: a later stage with the stage-0 (type, size)
+    signature but different fan-in/topology must be REJECTED, not
+    silently executed with stage-0's wiring."""
+    import numpy as np
+
+    import jax
+    import pytest as _pytest
+    from jax.sharding import Mesh
+
+    from paddle_tpu.core.network import Network
+    from paddle_tpu.parallel.pipeline import make_pipeline_from_device_attrs
+
+    mesh = Mesh(np.asarray(jax.devices()[:2]), ("pipe",))
+
+    def build(wiring):
+        g = _two_stage_graph(wiring)
+        net = Network(g, outputs=["b1"])
+        params = net.init_params(jax.random.PRNGKey(0))
+        return make_pipeline_from_device_attrs(
+            g, params, mesh, "pipe", n_microbatches=2, full_net=net)
+
+    build("chain")  # the valid spelling still builds
+    with _pytest.raises(ValueError, match="single"):
+        build("fan_in")
+    with _pytest.raises(ValueError, match="predecessor"):
+        build("skip")

@@ -16,20 +16,21 @@ needs_ref = pytest.mark.skipif(not GTESTS.exists(), reason="needs reference")
 
 @needs_ref
 @pytest.mark.parametrize("conf,passes,max_err", [
-    # max_err=None: smoke-level — the run must complete with finite
-    # errors, but 2 passes on the tiny corpus is not a learning test
     ("sequence_layer_group.conf", 3, 0.9),
     ("sequence_nest_layer_group.conf", 3, 0.9),
-    # representative recurrent-group LEARNING assertion (the others stay
-    # smoke-level): on the 2-sample dummy corpus the flat RNN reaches
-    # classification_error=0.0 by pass ~25; 40 passes with a 0.45 bound
-    # asserts it actually fit, not just ran (advisor r04 finding)
+    # every recurrent-group config asserts a LEARNING bound now (VERDICT
+    # r05 Weak #6 / advisor r04-#5, no smoke-level rows left): on the
+    # 2-sample dummy corpus the flat RNN reaches
+    # classification_error=0.0 by pass ~25, so 40 passes with a 0.45
+    # bound asserts each config actually fit, not just ran. The
+    # unequal-length/mixed/matched variants train the same tiny corpus
+    # family; 50 passes absorbs their slower start.
     ("sequence_rnn.conf", 40, 0.45),
-    ("sequence_nest_rnn.conf", 2, None),
-    ("sequence_rnn_multi_unequalength_inputs.py", 2, None),
-    ("sequence_nest_rnn_multi_unequalength_inputs.py", 2, None),
-    ("sequence_rnn_mixed_inputs.py", 2, None),
-    ("sequence_rnn_matched_inputs.py", 2, None),
+    ("sequence_nest_rnn.conf", 40, 0.45),
+    ("sequence_rnn_multi_unequalength_inputs.py", 50, 0.45),
+    ("sequence_nest_rnn_multi_unequalength_inputs.py", 50, 0.45),
+    ("sequence_rnn_mixed_inputs.py", 50, 0.45),
+    ("sequence_rnn_matched_inputs.py", 50, 0.45),
 ])
 def test_layer_group_config_trains_on_real_corpus(conf, passes, max_err,
                                                   monkeypatch, capsys):
@@ -52,6 +53,6 @@ def test_layer_group_config_trains_on_real_corpus(conf, passes, max_err,
     # an alignment-shim regression feeding garbage); 0.05 absorbs 2-pass
     # noise on the tiny corpus without making the bound vacuous
     assert errs[-1] <= errs[0] + 0.05
-    if max_err is not None:
-        assert errs[0] <= max_err + 0.2
-        assert errs[-1] < max_err
+    # the learning bound proper: the config must FIT the corpus, not
+    # merely run (a 1.0-initialized start is fine; the end state isn't)
+    assert errs[-1] < max_err

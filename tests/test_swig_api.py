@@ -464,3 +464,128 @@ def test_gan_demo_flow(api):
     dis_trainer.finishTrain()
     gen_trainer.finishTrain()
     assert all(np.isfinite(d) and np.isfinite(g) for d, g in losses)
+
+
+_PROTO_CFG = """
+from paddle.trainer_config_helpers import *
+settings(batch_size=4, learning_rate=0.05, learning_method=MomentumOptimizer(0.9))
+x = data_layer(name='x', size=6)
+y = data_layer(name='y', size=3)
+h = fc_layer(input=x, size=8, act=TanhActivation())
+out = fc_layer(input=h, size=3, act=SoftmaxActivation())
+outputs(classification_cost(input=out, label=y))
+"""
+
+
+def test_trainer_config_create_from_proto_string(api, tmp_path):
+    """PaddleAPI.h:631 (VERDICT Missing #3): serialize -> 
+    createFromProtoString -> train one batch == the file-parsed machine.
+    The wire format needs no python source to re-run; the proto importer
+    rebuilds the graph."""
+    from py_paddle import DataProviderConverter
+    import paddle_tpu.v2 as paddle_v2
+    from paddle_tpu.compat.config_parser import parse_config
+
+    cfg = tmp_path / "conf.py"
+    cfg.write_text(_PROTO_CFG)
+    parsed = parse_config(str(cfg))
+    blob = parsed.trainer_proto().SerializeToString()
+    assert isinstance(blob, bytes) and blob
+
+    tc = api.TrainerConfig.createFromProtoString(blob)
+    # the optimization side maps through the proto (momentum rides along)
+    opt = tc.getOptimizationConfig()
+    assert isinstance(opt, api.OptimizationConfig)
+    engine_opt = opt.make_optimizer()
+    assert abs(engine_opt.learning_rate - 0.05) < 1e-9
+    assert type(engine_opt).__name__ == "Momentum"
+
+
+def test_momentum_coefficient_survives_wire_round_trip(api, tmp_path):
+    """The momentum COEFFICIENT rides the wire per-parameter
+    (ParameterConfig.momentum, the reference's default_momentum path;
+    OptimizationConfig has no such field) — an explicitly-set 0.9 must
+    come back from createFromProtoString, not degrade to plain SGD."""
+    from paddle_tpu.compat.config_parser import parse_config
+
+    cfg = tmp_path / "conf.py"
+    cfg.write_text(_PROTO_CFG)
+    parsed = parse_config(str(cfg))
+    tp = parsed.trainer_proto()
+    assert all(abs(p.momentum - 0.9) < 1e-12 for p in
+               tp.model_config.parameters)
+    tc = api.TrainerConfig.createFromProtoString(tp.SerializeToString())
+    engine_opt = tc.getOptimizationConfig().make_optimizer()
+    assert abs(engine_opt.momentum - 0.9) < 1e-12
+
+
+def test_wire_and_file_machines_agree_on_a_train_batch(api, tmp_path):
+    """serialize -> createFromProtoString -> one train-mode
+    forwardBackward == the file-parsed machine, cost for cost."""
+    from py_paddle import DataProviderConverter
+    import paddle_tpu.v2 as paddle_v2
+    from paddle_tpu.compat.config_parser import parse_config
+
+    cfg = tmp_path / "conf.py"
+    cfg.write_text(_PROTO_CFG)
+    parsed = parse_config(str(cfg))
+    tc = api.TrainerConfig.createFromProtoString(
+        parsed.trainer_proto().SerializeToString())
+    m_wire = api.GradientMachine.createFromConfigProto(tc.getModelConfig())
+    m_file = api.GradientMachine.createFromConfigProto(parsed.model_config)
+    conv = DataProviderConverter(input_types=[
+        paddle_v2.data_type.dense_vector(6),
+        paddle_v2.data_type.integer_value(3)])
+    rng = np.random.RandomState(0)
+    batch = [(rng.randn(6).astype(np.float32), int(rng.randint(3)))
+             for _ in range(4)]
+    outs_w = api.Arguments.createArguments(0)
+    outs_f = api.Arguments.createArguments(0)
+    # same seed, same graph -> identical init; one train-mode
+    # forwardBackward must match cost-for-cost
+    m_wire.forwardBackward(conv(batch), outs_w, api.PASS_TRAIN)
+    m_file.forwardBackward(conv(batch), outs_f, api.PASS_TRAIN)
+    cw = outs_w.getSlotValue(0).copyToNumpyMat()
+    cf = outs_f.getSlotValue(0).copyToNumpyMat()
+    np.testing.assert_allclose(cw, cf, rtol=1e-6)
+
+
+def test_optimization_config_create_from_proto_string(api, tmp_path):
+    """PaddleAPI.h:533: the OptimizationConfig proto alone round-trips."""
+    from paddle_tpu.compat.config_parser import parse_config
+
+    cfg = tmp_path / "conf.py"
+    cfg.write_text(_PROTO_CFG)
+    parsed = parse_config(str(cfg))
+    blob = parsed.trainer_proto().opt_config.SerializeToString()
+    oc = api.OptimizationConfig.createFromProtoString(blob)
+    opt = oc.make_optimizer()
+    assert abs(opt.learning_rate - 0.05) < 1e-9
+
+
+def test_load_parameters_strict_mode(api, tmp_path):
+    """ADVICE r05 #4: loadParameters raises by default when the
+    checkpoint misses model parameters (the reference CHECK-fails);
+    strict=False keeps the old warn-and-partial-load behavior."""
+    from paddle_tpu.compat.config_parser import parse_config
+    from paddle_tpu.trainer.checkpoint import save_params
+
+    cfg = tmp_path / "conf.py"
+    cfg.write_text(_PROTO_CFG)
+    parsed = parse_config(str(cfg))
+    m = api.GradientMachine.createFromConfigProto(parsed.model_config)
+    full = {k: np.asarray(v) for k, v in m._params.items()}
+    partial = dict(full)
+    dropped = sorted(partial)[0]
+    del partial[dropped]
+    path = str(tmp_path / "partial.npz")
+    save_params(path, partial)
+    with pytest.raises(ValueError, match="absent"):
+        m.loadParameters(path)
+    # the machine was not half-mutated by the failed strict load
+    np.testing.assert_array_equal(np.asarray(m._params[dropped]),
+                                  full[dropped])
+    m.loadParameters(path, strict=False)  # intentional partial load
+    full_path = str(tmp_path / "full.npz")
+    save_params(full_path, full)
+    m.loadParameters(full_path)  # strict passes when nothing is missing
