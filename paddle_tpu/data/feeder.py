@@ -50,15 +50,30 @@ class DataFeeder:
     def __init__(self, feeding: Dict[str, T.InputType],
                  pad_multiple: int = 32,
                  length_buckets: Optional[Sequence[int]] = None,
-                 batch_buckets: Optional[Sequence[int]] = None):
+                 batch_buckets: Optional[Sequence[int]] = None,
+                 validate_ids: Optional[bool] = None):
         """feeding: data-layer name -> InputType, in feed order if the
         reader yields tuples. ``length_buckets``: fixed menu of padded
         sequence lengths (``data/prefetch.py:LengthBuckets``) overriding
         the pad_multiple ceiling. ``batch_buckets``: menu of batch sizes;
-        short batches pad up with dead rows + a ROW_MASK_KEY entry."""
+        short batches pad up with dead rows + a ROW_MASK_KEY entry.
+
+        ``validate_ids`` (debug mode; default from the
+        ``PADDLE_TPU_VALIDATE_IDS`` env var) checks every INDEX input
+        against its declared range on the host and raises with the
+        offending id and input/layer name. The device-side table lookup
+        cannot raise (jit shapes are static): it maps out-of-range ids to
+        zero rows (``layers/common.py:_table_lookup``), so this check is
+        the loud counterpart of the reference's CHECK-fail
+        (``TableProjection.cpp``)."""
+        import os
         self.feeding = feeding
         self.names = list(feeding)
         self.pad_multiple = pad_multiple
+        if validate_ids is None:
+            validate_ids = os.environ.get(
+                "PADDLE_TPU_VALIDATE_IDS", "").lower() in ("1", "true", "yes")
+        self.validate_ids = bool(validate_ids)
         self.length_buckets = None
         if length_buckets is not None:
             from paddle_tpu.data.prefetch import LengthBuckets
@@ -103,18 +118,40 @@ class DataFeeder:
                 f"{len(self.names)} ({self.names})")
         feed = {}
         for name, col in zip(self.names, cols):
-            feed[name] = self._convert_one(self.feeding[name], col)
+            feed[name] = self._convert_one(self.feeding[name], col, name)
         if row_mask is not None:
             feed[ROW_MASK_KEY] = Argument(value=jnp.asarray(row_mask))
         return feed
 
     __call__ = convert
 
-    def _convert_one(self, itype: T.InputType, col: Sequence) -> Argument:
+    def _check_ids(self, name, itype: T.InputType, value: np.ndarray,
+                   mask: Optional[np.ndarray] = None):
+        """Debug-mode host-side range check for INDEX inputs: raises with
+        the offending id and the input (data-layer) name. -1 stays legal
+        (the OOV ignore sentinel); padding positions (mask 0) are
+        exempt."""
+        if not self.validate_ids:
+            return
+        bad = (value >= itype.dim) | (value < -1)
+        if mask is not None:
+            bad &= mask > 0
+        if bad.any():
+            pos = tuple(int(i) for i in np.argwhere(bad)[0])
+            raise ValueError(
+                f"input {name!r}: id {int(value[pos])} at position {pos} "
+                f"is outside the declared range [-1, {itype.dim}). The "
+                "reference CHECK-fails here (TableProjection.cpp); the "
+                "jitted table lookup maps such ids to zero rows instead "
+                "of raising — fix the data or the declared dimension.")
+
+    def _convert_one(self, itype: T.InputType, col: Sequence,
+                     name: str = "?") -> Argument:
         if itype.seq_type == T.NO_SEQUENCE:
             if itype.type == T.INDEX:
-                return Argument(value=jnp.asarray(
-                    np.asarray(col, dtype=np.int32)))
+                arr = np.asarray(col, dtype=np.int32)
+                self._check_ids(name, itype, arr)
+                return Argument(value=jnp.asarray(arr))
             if itype.type == T.DENSE:
                 return Argument(value=jnp.asarray(
                     np.asarray(col, dtype=np.float32)))
@@ -144,6 +181,7 @@ class DataFeeder:
                         value[i, j, : len(ss)] = np.asarray(ss,
                                                             dtype=np.int32)
                         mask[i, j, : len(ss)] = 1.0
+                self._check_ids(name, itype, value, mask)
             elif itype.type == T.DENSE:
                 value = np.zeros((B, S, Tm, itype.dim), dtype=np.float32)
                 for i, s in enumerate(col):
@@ -175,6 +213,7 @@ class DataFeeder:
             for i, s in enumerate(col):
                 value[i, : len(s)] = np.asarray(s, dtype=np.int32)
                 mask[i, : len(s)] = 1.0
+            self._check_ids(name, itype, value, mask)
         elif itype.type in (T.SPARSE_BINARY, T.SPARSE_FLOAT):
             # per-timestep index lists (sparse_binary_vector_sequence,
             # e.g. the sequence-tagging demo's feature slot) densify to

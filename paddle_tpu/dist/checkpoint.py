@@ -51,7 +51,11 @@ class Checkpointer:
 
     def save(self, params: Dict[str, Any], opt_state: Any, *,
              pass_id: int, batch_id: int = 0, end_of_pass: bool = False):
-        """Unconditional save + pointer update + GC."""
+        """Unconditional save + pointer update + GC. ``opt_state`` may be
+        a zero-arg callable producing the state — the trainer passes its
+        ZeRO-1 slot-gather lazily so the (device-op) gather only runs for
+        saves that are actually due (resolved by ``save_params``, the
+        single owner of that protocol)."""
         path = self._ckpt_path(pass_id, batch_id)
         save_params(path, params, opt_state,
                     meta={"pass_id": pass_id, "batch_id": batch_id,

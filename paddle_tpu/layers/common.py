@@ -104,11 +104,19 @@ def _table_lookup(w: jnp.ndarray, ids: jnp.ndarray) -> jnp.ndarray:
     ProtoData OOV sentinel, ``ProtoDataProvider.cpp:198`` keeps -1U and
     the engine skips those rows) contributes a ZERO row — never the
     wrapped last row — and neither reads nor trains any embedding.
-    Out-of-range ids clamp to the last row (the reference CHECK-fails;
-    clamping keeps jit shapes static without NaN fills)."""
+
+    Out-of-range ids (>= vocab) ALSO contribute a zero row and train
+    nothing. The reference CHECK-fails on them; a jitted program cannot
+    raise, and the previous behavior — silently clamping to the last
+    row — quietly READ AND TRAINED row vocab-1 for every bad id. Zero
+    keeps jit shapes static without corrupting any embedding, and the
+    host-side debug validation (``DataFeeder(validate_ids=True)`` or
+    ``PADDLE_TPU_VALIDATE_IDS=1``) raises with the offending id and
+    input name before the batch ever reaches the device."""
+    valid = (ids >= 0) & (ids < w.shape[0])
     safe = jnp.clip(ids, 0, w.shape[0] - 1)
     out = jnp.take(w, safe, axis=0)
-    return out * (ids >= 0)[..., None].astype(out.dtype)
+    return out * valid[..., None].astype(out.dtype)
 
 
 def _project(proj: dict, x: jnp.ndarray, w) -> jnp.ndarray:

@@ -108,6 +108,42 @@ class Optimizer:
                             if n in slots}
         return state
 
+    def _update_param(self, g, p, slots, spec, lr_t, t):
+        """One parameter's update: clipping, l1/l2 resolution, the dense or
+        sparse apply, and the prune mask. Shape-agnostic and elementwise
+        (except the sparse lazy path), so the ZeRO-1 updater
+        (``optim/zero1.py``) runs the same code on each device's 1/N flat
+        shard — one source of truth for update semantics. Clipping happens
+        HERE, on whatever gradient the caller accumulated: under microbatch
+        gradient accumulation that is the accumulation-averaged gradient,
+        never a per-microbatch one (the reference clips the full batch's
+        accumulated gradient, ``FirstOrderOptimizer.h``)."""
+        lr_mult = spec.learning_rate if spec else 1.0
+        l2 = spec.l2_rate if spec and spec.l2_rate is not None else self.l2_rate
+        l1 = spec.l1_rate if spec and spec.l1_rate is not None else self.l1_rate
+        if self.gradient_clipping_threshold > 0:
+            # reference clips per-parameter by value threshold
+            # (FirstOrderOptimizer.h, clipping in SgdOptimizer variants)
+            th = self.gradient_clipping_threshold
+            g = jnp.clip(g, -th, th)
+        mask = slots.get("prune_mask")
+        if self._is_sparse(spec):
+            # touched-rows-only update with momentum/decay catch-up;
+            # l1/l2 handled inside (deferred per-row)
+            p_new, slots_new = self._apply_sparse(
+                p, g, slots, lr_t * lr_mult, l1, l2, t)
+        else:
+            p_new, slots_new = self._apply_one(
+                p, g, slots, lr_t * lr_mult, l2, t)
+            if l1 > 0:
+                shrink = l1 * lr_t * lr_mult
+                p_new = jnp.sign(p_new) * jnp.maximum(
+                    jnp.abs(p_new) - shrink, 0.0)
+        if mask is not None:
+            p_new = p_new * mask          # pruned weights stay zero
+            slots_new["prune_mask"] = mask
+        return p_new, slots_new
+
     def update(self, grads, state, params,
                meta: Optional[Dict[str, ParamSpec]] = None,
                batch_size=1, num_passes=0):
@@ -138,51 +174,33 @@ class Optimizer:
                 new_params[name] = params[name]
                 continue
             spec = meta.get(name) if meta else None
-            lr_mult = spec.learning_rate if spec else 1.0
-            l2 = spec.l2_rate if spec and spec.l2_rate is not None else self.l2_rate
-            l1 = spec.l1_rate if spec and spec.l1_rate is not None else self.l1_rate
-            p = params[name]
-            if self.gradient_clipping_threshold > 0:
-                # reference clips per-parameter by value threshold
-                # (FirstOrderOptimizer.h, clipping in SgdOptimizer variants)
-                th = self.gradient_clipping_threshold
-                g = jnp.clip(g, -th, th)
-            mask = state["slots"][name].get("prune_mask")
-            if self._is_sparse(spec):
-                # touched-rows-only update with momentum/decay catch-up;
-                # l1/l2 handled inside (deferred per-row)
-                p_new, slots_new = self._apply_sparse(
-                    p, g, state["slots"][name], lr_t * lr_mult, l1, l2, t)
-            else:
-                p_new, slots_new = self._apply_one(
-                    p, g, state["slots"][name], lr_t * lr_mult, l2, t)
-                if l1 > 0:
-                    shrink = l1 * lr_t * lr_mult
-                    p_new = jnp.sign(p_new) * jnp.maximum(
-                        jnp.abs(p_new) - shrink, 0.0)
-            if mask is not None:
-                p_new = p_new * mask          # pruned weights stay zero
-                slots_new["prune_mask"] = mask
+            p_new, slots_new = self._update_param(
+                g, params[name], state["slots"][name], spec, lr_t, t)
             new_params[name] = p_new
             new_slots[name] = slots_new
 
         new_state = {"slots": new_slots, "t": t, "num_samples": num_samples}
         if "avg" in state:
-            # AverageOptimizer: the window is a FRACTION of all updates so
-            # far — about average_window * numUpdates parameters are
-            # averaged (TrainerConfig.proto:70-74), capped by
-            # max_average_window (AverageOptimizer.h:83-88). Running
-            # average with the growing effective window W_t =
-            # clip(average_window * t, 1, max_average_window); values >= 1
-            # behave as an absolute window.
-            tf32 = t.astype(jnp.float32)
-            w = jnp.clip(jnp.float32(self.average_window) * tf32,
-                         1.0, jnp.float32(self.max_average_window))
-            w = jnp.minimum(tf32, w)
-            new_state["avg"] = {
-                n: state["avg"][n] + (new_params[n] - state["avg"][n]) / w
-                for n in new_slots}
+            new_state["avg"] = self._update_avg(state["avg"], t, new_params,
+                                                new_slots)
         return new_params, new_state
+
+    def _update_avg(self, avg, t, new_params, new_slots):
+        """AverageOptimizer: the window is a FRACTION of all updates so
+        far — about average_window * numUpdates parameters are averaged
+        (TrainerConfig.proto:70-74), capped by max_average_window
+        (AverageOptimizer.h:83-88). Running average with the growing
+        effective window W_t = clip(average_window * t, 1,
+        max_average_window); values >= 1 behave as an absolute window.
+        Shared by the replicated update and the ZeRO-1 updater (which
+        keeps ``avg`` replicated) — one source of truth for the window
+        semantics."""
+        tf32 = t.astype(jnp.float32)
+        w = jnp.clip(jnp.float32(self.average_window) * tf32,
+                     1.0, jnp.float32(self.max_average_window))
+        w = jnp.minimum(tf32, w)
+        return {n: avg[n] + (new_params[n] - avg[n]) / w
+                for n in new_slots}
 
     def prune_params(self, params, state):
         """Zero the masked weights immediately — the reference's

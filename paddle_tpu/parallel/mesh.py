@@ -309,11 +309,45 @@ def shard_opt_state(opt_state, mesh: Mesh,
                     rules: Optional[Dict[str, P]] = None):
     """Shard any optimizer-state pytree: entries of per-parameter dicts
     ("slots", "avg", or any future key whose value is {param_name: ...})
-    follow their owning parameter's rule; everything else replicates."""
-    def leaf_sharding(x, rule):
+    follow their owning parameter's rule; everything else replicates.
+
+    Rule keys use ``rule_for``'s matching contract: a key starting with
+    ``=`` matches the parameter name EXACTLY (the auto-added per-parameter
+    rules use this so a rule for ``_emb.w0`` can never capture
+    ``_user_emb.w0``); any other key matches as a substring of the name.
+
+    A dimension a rule would shard that is NOT divisible by the mesh axis
+    size keeps that leaf replicated — loudly: the warning names the
+    parameter, the dim, and the axis. (Previously the mismatch surfaced
+    as a bare ``jax.device_put`` ValueError with no parameter name; now
+    placement succeeds, at full per-device bytes, and says which rule to
+    fix.)"""
+    from paddle_tpu.utils.log import logger
+
+    def axis_size(entry) -> int:
+        names = entry if isinstance(entry, tuple) else (entry,)
+        n = 1
+        for a in names:
+            n *= mesh.shape[a]
+        return n
+
+    def leaf_sharding(x, rule, name):
         # slots may have fewer dims than their parameter (e.g. the sparse
         # path's per-row timestamps [V] vs the table [V, D]): trim the spec
-        return NamedSharding(mesh, P(*rule[:x.ndim]))
+        spec = P(*rule[:x.ndim])
+        for i, entry in enumerate(spec):
+            if entry is None:
+                continue
+            sz = axis_size(entry)
+            if sz > 1 and x.shape[i] % sz != 0:
+                logger.warning(
+                    "shard_opt_state: slot of %r has dim %d of size %d, "
+                    "not divisible by mesh axis %r (size %d) — keeping "
+                    "this leaf replicated (every device pays its full "
+                    "bytes); pad the parameter or drop the rule",
+                    name, i, x.shape[i], entry, sz)
+                return NamedSharding(mesh, P())
+        return NamedSharding(mesh, spec)
 
     out = {}
     for key, val in opt_state.items():
@@ -321,7 +355,7 @@ def shard_opt_state(opt_state, mesh: Mesh,
             out[key] = {
                 name: jax.tree_util.tree_map(
                     lambda x, n=name: jax.device_put(
-                        x, leaf_sharding(x, rule_for(n, rules))), sub)
+                        x, leaf_sharding(x, rule_for(n, rules), n)), sub)
                 for name, sub in val.items()}
         else:
             out[key] = jax.device_put(val, NamedSharding(mesh, P()))

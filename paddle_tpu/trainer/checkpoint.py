@@ -30,6 +30,14 @@ def _flatten(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
 
 def save_params(path: str, params: Dict[str, Any],
                 opt_state: Optional[Any] = None, meta: Optional[dict] = None):
+    """``opt_state`` may be a zero-arg callable producing the state tree
+    (lazy export). The trainer's ZeRO-1 mode passes
+    ``SGD._opt_state_for_save`` here so sharded optimizer slots are
+    gathered back to their parameters' full shapes at save time — the
+    on-disk format (keys and shapes) never depends on the update path,
+    and ``SGD.load_state`` reshards on restore."""
+    if callable(opt_state):
+        opt_state = opt_state()
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     arrays = {f"param::{k}": np.asarray(jax.device_get(v))
               for k, v in params.items()}

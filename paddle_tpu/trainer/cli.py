@@ -88,7 +88,20 @@ def parse_args(argv=None):
                         "(2 = double buffer)")
     p.add_argument("--show_step_breakdown", action="store_true",
                    help="log the per-step host-time split {data_wait, "
-                        "h2d, compute, callback} at each log_period")
+                        "h2d, compute, callback} and per-device "
+                        "param/optimizer-slot bytes at each log_period")
+    p.add_argument("--use_zero1", action="store_true",
+                   help="ZeRO-1 sharded optimizer update: partition "
+                        "optimizer state over the data axis (each device "
+                        "holds 1/N of every slot), update shard-wise, "
+                        "all-gather params — the pserver's sharded "
+                        "update (ParameterServer2.cpp:362), TPU-native")
+    p.add_argument("--grad_accum_steps", type=int, default=1,
+                   help="split each batch into k microbatches scanned "
+                        "inside the jitted step, applying the optimizer "
+                        "(and gradient clipping) once on the accumulated "
+                        "gradient — a k× effective batch at 1/k the "
+                        "activation memory")
     return p.parse_args(argv)
 
 
@@ -264,6 +277,8 @@ def cmd_train(ns, args):
                   prefetch_depth=getattr(args, "prefetch_depth", 2),
                   show_step_breakdown=getattr(args, "show_step_breakdown",
                                               False),
+                  zero1=True if getattr(args, "use_zero1", False) else None,
+                  grad_accum_steps=getattr(args, "grad_accum_steps", 1),
                   checkpointer=ck)
     return 0
 

@@ -110,6 +110,12 @@ class SGD:
         self._eval_layers = sorted(needed)
         self.optimizer = update_equation
         self.mesh = mesh
+        # ZeRO-1 sharded optimizer state (optim/zero1.py): disabled until
+        # train(zero1=True) / enable_zero1(); the updater replaces the
+        # optimizer in the jitted step, everything else is unchanged
+        self._zero1 = None
+        self.grad_accum_steps = 1
+        self._recompile_warn = recompile_warn
         key = jax.random.PRNGKey(seed)
         self.meta = self.network.param_meta()
         if mesh is not None:
@@ -121,6 +127,7 @@ class SGD:
             shard_rules = mesh_lib.device_attr_rules(
                 self.topology.graph, self.network.param_specs, mesh,
                 shard_rules)
+        self._shard_rules = shard_rules if mesh is not None else None
         if parameters is not None:
             self.params = (mesh_lib.shard_params(parameters, mesh, shard_rules)
                            if mesh is not None else parameters)
@@ -230,23 +237,32 @@ class SGD:
         arg = feed.get(ROW_MASK_KEY) if feed is not None else None
         return arg.value if arg is not None else None
 
-    def _total_cost(self, outputs, row_mask=None):
+    def _total_cost(self, outputs, row_mask=None, accum_k=1,
+                    total_live=None):
         """Sum of all cost layers' batch-mean — multi-task configs train
         on the sum (the reference's Argument::sum over outArgs). Reduces
         in f32 even under bf16 compute (batch sums need the mantissa).
         ``row_mask`` makes batch-bucket padding exact: dead rows are
         zeroed out of the sum AND out of the denominator, so the loss
-        (and its gradient) equals the unpadded batch's."""
+        (and its gradient) equals the unpadded batch's.
+
+        Under microbatch gradient accumulation the denominator must be the
+        FULL batch's, not this microbatch's, so that summing the k partial
+        losses (and their gradients) reproduces the single k×-batch step
+        exactly: ``accum_k`` scales the unmasked per-layer denominator and
+        ``total_live`` replaces the masked one with the whole batch's live
+        row count."""
         total = 0.0
         for n in getattr(self.topology, "cost_names",
                          [self.topology.cost_name]):
             v = outputs[n].value.astype(jnp.float32)
             if row_mask is not None:
+                denom = (total_live if total_live is not None
+                         else jnp.sum(row_mask))
                 rm = row_mask.reshape((-1,) + (1,) * (v.ndim - 1))
-                total = total + jnp.sum(v * rm) / jnp.maximum(
-                    jnp.sum(row_mask), 1.0)
+                total = total + jnp.sum(v * rm) / jnp.maximum(denom, 1.0)
             else:
-                total = total + jnp.sum(v) / v.shape[0]
+                total = total + jnp.sum(v) / (v.shape[0] * accum_k)
         return total
 
     def _metrics(self, outputs, feed):
@@ -273,8 +289,69 @@ class SGD:
                 for n in self._eval_layers}
         return metrics
 
+    def _accum_k_for(self, batch_size: int) -> int:
+        """Effective accumulation factor for one batch shape. The FIRST
+        batch shape must be divisible by ``grad_accum_steps`` — a k the
+        run's dominant batch size can't honor is a config error, raised
+        before any training happens (a silent gcd there would quietly run
+        at full activation memory, the OOM the flag exists to avoid).
+        Once a conforming shape has been seen, a LATER shape k doesn't
+        divide (the dataset-tail partial batch) must not abort a nearly-
+        finished pass: accumulation is a memory knob, not a math knob, so
+        that batch scans gcd(k, B) fewer (larger) microbatches, with a
+        warning."""
+        import math
+        if batch_size % self.grad_accum_steps == 0:
+            self._accum_shape_seen = True
+            return self.grad_accum_steps
+        if not getattr(self, "_accum_shape_seen", False):
+            raise ValueError(
+                f"grad_accum_steps={self.grad_accum_steps} does not divide "
+                f"the batch size ({batch_size} rows): pick a k that "
+                "divides the reader's batch size (or bucket batches with "
+                "DataFeeder batch_buckets)")
+        k = math.gcd(self.grad_accum_steps, batch_size)
+        from paddle_tpu.utils import logger
+        logger.warning(
+            "grad_accum_steps=%d does not divide this batch's %d rows (a "
+            "final partial batch) — using %d microbatches for this shape; "
+            "bucket batch sizes (DataFeeder batch_buckets) or drop the "
+            "remainder batch to keep k uniform",
+            self.grad_accum_steps, batch_size, k)
+        return k
+
+    def _split_microbatches(self, feed, k: int):
+        """Reshape every feed leaf (B, ...) -> (k, B/k, ...) for the
+        ``lax.scan`` over microbatches; on a mesh the microbatch dim keeps
+        the batch sharding (dim 1 over the data axes) so each scan slice
+        is exactly a smaller sharded batch."""
+        n_data = (mesh_lib.data_parallel_degree(self.mesh)
+                  if self.mesh is not None else 1)
+
+        def split(x):
+            if not hasattr(x, "shape") or x.ndim == 0 or x.shape[0] % k:
+                raise ValueError(
+                    f"grad_accum_steps={k} must divide the batch dim of "
+                    f"every feed entry; got shape "
+                    f"{getattr(x, 'shape', None)}")
+            y = x.reshape((k, x.shape[0] // k) + x.shape[1:])
+            if self.mesh is not None and (x.shape[0] // k) % n_data == 0:
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as P
+                y = jax.lax.with_sharding_constraint(
+                    y, NamedSharding(self.mesh,
+                                     P(None, mesh_lib.batch_axes(self.mesh))))
+            return y
+
+        return jax.tree_util.tree_map(split, feed)
+
     def _build_train_step(self):
         network, optimizer, meta = self.network, self.optimizer, self.meta
+        # the ZeRO-1 updater is a drop-in for the optimizer's update
+        # protocol (optim/zero1.py); everything upstream of the update —
+        # forward, backward, metrics — is shared
+        updater = self._zero1 or self.optimizer
+        accum_k = self.grad_accum_steps
         cost_name = self.topology.cost_name
         carry_layers = self._carry_layers
         # gradient_printer evaluators need d(cost)/d(layer output) FOR THE
@@ -322,7 +399,7 @@ class SGD:
             # padded shape (sum_gradients scaling likewise)
             bsz = (jnp.sum(row_mask) if row_mask is not None
                    else outputs[cost_name].value.shape[0])
-            new_params, new_opt = optimizer.update(
+            new_params, new_opt = updater.update(
                 grads, opt_state, params, meta, batch_size=bsz,
                 num_passes=num_passes)
             new_params.update(updates)  # moving statistics (batch_norm)
@@ -346,7 +423,77 @@ class SGD:
                     for n, g in probe_grads.items()}
             return new_params, new_opt, metrics
 
-        return jax.jit(step, donate_argnums=(0, 1))
+        def accum_step(params, opt_state, feed, rng, num_passes,
+                       carried=None):
+            """Microbatch gradient accumulation: ``lax.scan`` over k
+            equal slices of the batch, one forward+backward per slice (so
+            only one microbatch's activations are ever live), gradients
+            SUMMED with full-batch denominators baked into each partial
+            loss — the sum is exactly the single k×-batch step's mean
+            gradient. Clipping/decay/schedules then run ONCE, inside the
+            optimizer, on that accumulated gradient."""
+            del carried  # rejected in _configure_step (truncated-BPTT
+            # state cannot cross microbatches of disjoint rows)
+            row_mask_full = self._row_mask(feed)
+            total_live = (jnp.sum(row_mask_full)
+                          if row_mask_full is not None else None)
+            full_bsz = next(iter(feed.values())).value.shape[0]
+            # trace-time constant: a partial tail batch k doesn't divide
+            # scans fewer microbatches instead of aborting the pass
+            k_eff = self._accum_k_for(full_bsz)
+            micro_feed = self._split_microbatches(feed, k_eff)
+            rngs = jax.random.split(rng, k_eff)
+
+            def loss_micro(params, mfeed, mrng):
+                outputs, updates = network.apply_with_state(
+                    self._cast_compute(params), self._cast_compute(mfeed),
+                    train=True, rng=mrng, mesh=self.mesh)
+                return (self._total_cost(outputs, self._row_mask(mfeed),
+                                         accum_k=k_eff,
+                                         total_live=total_live),
+                        (outputs, updates))
+
+            def micro(g_acc, xs):
+                mfeed, mrng = xs
+                (loss, (outputs, updates)), grads = jax.value_and_grad(
+                    loss_micro, has_aux=True)(params, mfeed, mrng)
+                g_acc = jax.tree_util.tree_map(jnp.add, g_acc, grads)
+                return g_acc, (loss, self._cast_f32(updates),
+                               self._metrics(outputs, mfeed))
+
+            g_zero = jax.tree_util.tree_map(jnp.zeros_like, params)
+            grads, (losses, updates_k, metrics_k) = jax.lax.scan(
+                micro, g_zero, (micro_feed, rngs))
+            # moving statistics (batch_norm): mean over microbatches —
+            # for equal-size unmasked microbatches this IS the k×-batch
+            # update (the EMA is affine in the batch mean)
+            updates = jax.tree_util.tree_map(
+                lambda x: jnp.mean(x, axis=0), updates_k)
+            # partial losses already carry full-batch denominators: the
+            # sum is the k×-batch cost
+            metrics = {"cost": jnp.sum(losses)}
+            for key, val in metrics_k.items():
+                if key == "cost":
+                    continue
+                if isinstance(val, tuple):
+                    # (sum, count) accumulator pairs: sum the k partials
+                    metrics[key] = tuple(jnp.sum(x, axis=0) for x in val)
+                elif key == "eval_outputs":
+                    # per-row fetches: merge (k, b, ...) back to (B, ...)
+                    # — bucket-padded dead rows sat at the end of the
+                    # batch and end up at the end again, so the host-side
+                    # live-prefix slice stays exact
+                    metrics[key] = jax.tree_util.tree_map(
+                        lambda x: x.reshape((-1,) + x.shape[2:]), val)
+            bsz = total_live if total_live is not None else full_bsz
+            new_params, new_opt = updater.update(
+                grads, opt_state, params, meta, batch_size=bsz,
+                num_passes=num_passes)
+            new_params.update(updates)
+            return new_params, new_opt, metrics
+
+        return jax.jit(accum_step if accum_k > 1 else step,
+                       donate_argnums=(0, 1))
 
     def _build_eval_step(self):
         network = self.network
@@ -360,13 +507,111 @@ class SGD:
         return jax.jit(step)
 
     # ---------------------------------------------------------------- loop
+    def enable_zero1(self):
+        """Switch to the ZeRO-1 sharded optimizer update
+        (``optim/zero1.py``): optimizer slots reshard to each device's 1/N
+        partition of the data axis, the jitted step updates shard-wise and
+        all-gathers the parameters. Bit-exact vs the replicated path; a
+        no-op (with a warning) when there is no data-parallel axis to
+        partition over. Parameters and the ``swig_api`` surface are
+        untouched — only optimizer state changes layout."""
+        if self._zero1 is not None:
+            return
+        from paddle_tpu.utils import logger
+        if self.mesh is None or mesh_lib.data_parallel_degree(self.mesh) <= 1:
+            logger.warning(
+                "zero1 requested but the mesh has no data-parallel axis "
+                "to partition optimizer state over (mesh=%s) — keeping "
+                "the replicated update", self.mesh)
+            return
+        from paddle_tpu.optim.zero1 import Zero1Updater
+        self._zero1 = Zero1Updater(self.optimizer, self.mesh, self.params,
+                                   self.meta, rules=self._shard_rules)
+        self.opt_state = self._zero1.convert_state(self.opt_state)
+        self._rebuild_train_step()
+
+    def disable_zero1(self):
+        """Back to the replicated update: gather the sharded slots to
+        their full shapes, restore the rule-driven placement
+        (``shard_opt_state``), drop the updater, rebuild the step. The
+        inverse of :meth:`enable_zero1`, so A/B comparisons on one SGD
+        instance measure what they claim to."""
+        if self._zero1 is None:
+            return
+        self.opt_state = self._zero1.gather_opt_state(self.opt_state)
+        self._zero1 = None
+        if self.mesh is not None:
+            self.opt_state = mesh_lib.shard_opt_state(
+                self.opt_state, self.mesh, self._shard_rules)
+        self._rebuild_train_step()
+
+    def _rebuild_train_step(self):
+        from paddle_tpu.data.prefetch import RecompileGuard
+        self._train_step = self._build_train_step()
+        self.recompile_guard = RecompileGuard(self._train_step,
+                                              warn_after=self._recompile_warn)
+
+    def _configure_step(self, zero1: Optional[bool],
+                        grad_accum_steps: Optional[int]):
+        if grad_accum_steps is None:   # like zero1=None: keep current —
+            # a later train() without the kwarg must not silently drop
+            # accumulation (and 8x the activation memory)
+            grad_accum_steps = self.grad_accum_steps
+        if grad_accum_steps < 1:
+            raise ValueError(f"grad_accum_steps must be >= 1, got "
+                             f"{grad_accum_steps}")
+        if grad_accum_steps > 1:
+            if self._carry_layers:
+                raise ValueError(
+                    "grad_accum_steps > 1 is incompatible with "
+                    "prev_batch_state: truncated-BPTT state cannot carry "
+                    "across microbatches of disjoint rows")
+            if any(getattr(e, "wants_grad", False)
+                   for e, _, _ in self._host_evals):
+                raise ValueError(
+                    "grad_accum_steps > 1 is incompatible with "
+                    "gradient_printer evaluators (per-batch output "
+                    "gradients are not accumulated across microbatches)")
+            bn = [n for n, ld in self.topology.graph.layers.items()
+                  if ld.type in ("batch_norm", "cudnn_batch_norm",
+                                 "batch_normalization")]
+            if bn:
+                from paddle_tpu.utils import logger
+                logger.warning(
+                    "grad_accum_steps > 1 with batch-stat layers %s: each "
+                    "microbatch normalizes by ITS OWN batch statistics "
+                    "(1/k of the rows), so the step is NOT exactly the "
+                    "k×-batch step — the usual accumulation caveat, loud "
+                    "here because the exactness claim holds only for "
+                    "batch-stat-free models (moving averages are still "
+                    "averaged across microbatches)", bn)
+        if zero1 is True:
+            self.enable_zero1()
+        elif zero1 is False:
+            self.disable_zero1()   # None = keep the current mode
+        if grad_accum_steps != self.grad_accum_steps:
+            self.grad_accum_steps = grad_accum_steps
+            self._rebuild_train_step()
+
+    def _opt_state_for_save(self):
+        """Checkpoint view of the optimizer state: with ZeRO-1 active the
+        sharded slots are gathered back to their parameters' full shapes,
+        so the file format (keys AND array shapes) is identical to a
+        replicated run's — resume crosses sharded<->replicated modes in
+        both directions."""
+        if self._zero1 is not None:
+            return self._zero1.gather_opt_state(self.opt_state)
+        return self.opt_state
+
     def train(self, reader, *, feeder=None, num_passes: int = 1,
               event_handler: Optional[Callable] = None,
               log_period: int = 0, checkpointer=None,
               dot_period: int = 0, show_parameter_stats_period: int = 0,
               show_layer_stat: bool = False,
               async_load_data: bool = False, prefetch_depth: int = 2,
-              show_step_breakdown: bool = False):
+              show_step_breakdown: bool = False,
+              zero1: Optional[bool] = None,
+              grad_accum_steps: Optional[int] = None):
         """reader yields minibatches (lists of sample tuples); feeder
         converts them to Arguments (or pass feed dicts directly).
         ``log_period``>0 logs a TrainerStats-style line and dumps+resets the
@@ -392,8 +637,24 @@ class SGD:
         ``show_step_breakdown`` logs the per-step host-time split
         {data_wait, h2d, compute, callback} at each log_period and pass
         end (``utils/profiler.py:StepBreakdown``; always accumulated —
-        the flag only controls logging)."""
+        the flag only controls logging) plus the per-device
+        parameter/optimizer-slot byte accounting
+        (``utils/profiler.py:memory_stats``).
+
+        ``zero1`` (the ``--use_zero1`` flag) partitions optimizer state
+        over the mesh's data axis — each device holds 1/N of every slot,
+        updates its shard, and all-gathers the parameters (ZeRO-1; the
+        reference pserver's sharded update, ``ParameterServer2.cpp:362``).
+        Tri-state: ``True`` enables, ``False`` disables (resharding the
+        slots back), ``None`` (default) keeps the current mode.
+        ``grad_accum_steps`` (``--grad_accum_steps``) splits each batch
+        into k microbatches scanned inside the jitted step, applying the
+        optimizer (and clipping/decay) once on the accumulated gradient —
+        effective batch size decouples from per-device activation
+        memory. Like ``zero1``, sticky: ``None`` (default) keeps the
+        previously configured value."""
         from paddle_tpu.utils import global_stat, logger, timer
+        self._configure_step(zero1, grad_accum_steps)
         start_pass = 0
         if checkpointer is not None:
             restored = checkpointer.restore()
@@ -509,7 +770,11 @@ class SGD:
                                      {**evals, **self.host_eval_values(
                                          include_printers=False)}.items()))
                         if show_step_breakdown:
+                            from paddle_tpu.utils.profiler import \
+                                memory_status
                             logger.info("%s", bd.status())
+                            logger.info("%s", memory_status(
+                                self.params, self.opt_state))
                         logger.info("\n%s", global_stat.status(reset=True))
                         window_cost, window_n = 0.0, 0
                         if show_layer_stat:
@@ -519,7 +784,10 @@ class SGD:
                                     lname, st["avg_abs"], st["max_abs"])
                     event_handler(ev.EndIteration(pass_id, batch_id, cost, evals))
                     if checkpointer is not None:
-                        checkpointer.maybe_save(self.params, self.opt_state,
+                        # the callable defers the (device-op) ZeRO-1 slot
+                        # gather to saves that are actually due
+                        checkpointer.maybe_save(self.params,
+                                                self._opt_state_for_save,
                                                 pass_id=pass_id,
                                                 batch_id=batch_id + 1)
                     bd.add("callback", time.perf_counter() - t_cb)
@@ -543,16 +811,22 @@ class SGD:
             if dots_pending:
                 print(flush=True)  # close the dot line at pass end
             # apply deferred sparse-row updates so the pass ends with
-            # current tables (reference catchUpWith before eval/save)
-            self.params, self.opt_state = self.optimizer.catch_up(
+            # current tables (reference catchUpWith before eval/save);
+            # routed through the active updater so a zero1 state always
+            # goes through the delegate that understands its layout
+            self.params, self.opt_state = (
+                self._zero1 or self.optimizer).catch_up(
                 self.params, self.opt_state, self.meta,
                 num_passes=pass_id)
             if show_step_breakdown:
+                from paddle_tpu.utils.profiler import memory_status
                 logger.info("%s", bd.status())
+                logger.info("%s", memory_status(self.params, self.opt_state))
             event_handler(ev.EndPass(
                 pass_id, {**acc.result(), **self.host_eval_values()}))
             if checkpointer is not None:
-                checkpointer.maybe_save(self.params, self.opt_state,
+                checkpointer.maybe_save(self.params,
+                                        self._opt_state_for_save,
                                         pass_id=pass_id, end_of_pass=True)
 
     def step_breakdown(self) -> Dict[str, float]:
@@ -588,7 +862,15 @@ class SGD:
                     return {k: restore(v, f"{prefix}{k}/")
                             for k, v in tree.items()}
                 key = prefix.rstrip("/")
-                return place(opt_flat[key], tree) if key in opt_flat else tree
+                if key not in opt_flat:
+                    return tree
+                new = opt_flat[key]
+                if self._zero1 is not None:
+                    # checkpoints always store full-shape slots
+                    # (_opt_state_for_save gathers): reshard a planned
+                    # slot into this run's (N, chunk) partition
+                    new = self._zero1.pack_for_load(key, new, tree)
+                return place(new, tree)
 
             self.opt_state = restore(self.opt_state)
 

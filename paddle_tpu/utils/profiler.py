@@ -34,6 +34,78 @@ def profiler_trace(log_dir: str):
         jax.profiler.stop_trace()
 
 
+def _leaf_device_bytes(leaf) -> int:
+    """Bytes ONE device holds for an array: the shard size under its
+    NamedSharding (a replicated array costs full size per device; a
+    ZeRO-1 slot or model-sharded table costs 1/N)."""
+    shape = getattr(leaf, "shape", None)
+    if shape is None:
+        return 0
+    sharding = getattr(leaf, "sharding", None)
+    if sharding is not None and hasattr(sharding, "shard_shape"):
+        try:
+            shape = sharding.shard_shape(tuple(shape))
+        except (TypeError, ValueError):
+            pass
+    dtype = getattr(leaf, "dtype", None)
+    if dtype is None:
+        return 0
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * dtype.itemsize
+
+
+def tree_device_bytes(tree) -> int:
+    """Per-device bytes of a pytree of (possibly sharded) arrays."""
+    return sum(_leaf_device_bytes(x)
+               for x in jax.tree_util.tree_leaves(tree))
+
+
+def device_peak_bytes():
+    """Device-reported peak allocation (TPU/GPU ``memory_stats``;
+    None on backends that don't expose it, e.g. CPU)."""
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:  # noqa: BLE001 — absent on some backends
+        return None
+    if not stats:
+        return None
+    return stats.get("peak_bytes_in_use")
+
+
+def memory_stats(params, opt_state=None) -> dict:
+    """Per-device memory accounting for the training state: parameter
+    bytes, optimizer-slot bytes (the quantity ZeRO-1 divides by the
+    data-parallel degree), model-averaging bytes, and the device's peak
+    allocation when the backend reports one. The bench's ``--zero1`` A/B
+    and ``--show_step_breakdown`` both read this."""
+    out = {"param_bytes_per_device": tree_device_bytes(params)}
+    if opt_state is not None and isinstance(opt_state, dict):
+        out["slot_bytes_per_device"] = tree_device_bytes(
+            opt_state.get("slots", {}))
+        if "avg" in opt_state:
+            out["avg_bytes_per_device"] = tree_device_bytes(opt_state["avg"])
+    peak = device_peak_bytes()
+    if peak is not None:
+        out["device_peak_bytes"] = int(peak)
+    return out
+
+
+def _fmt_bytes(v: int) -> str:
+    return f"{v / 1e6:.2f}MB" if v >= 1e5 else f"{v / 1e3:.2f}KB"
+
+
+def memory_status(params, opt_state=None) -> str:
+    s = memory_stats(params, opt_state)
+    parts = " ".join(f"{k.replace('_bytes_per_device', '')}="
+                     f"{_fmt_bytes(v)}" for k, v in s.items()
+                     if k.endswith("_bytes_per_device"))
+    if "device_peak_bytes" in s:
+        parts += f" peak={_fmt_bytes(s['device_peak_bytes'])}"
+    return f"DeviceMemory(per-device): {parts}"
+
+
 class StepBreakdown:
     """Per-step host-side wall-time split.
 

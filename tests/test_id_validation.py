@@ -1,0 +1,95 @@
+"""Debug-mode host-side id range validation (DataFeeder.validate_ids).
+
+The reference CHECK-fails on an out-of-range table id
+(``TableProjection.cpp``); a jitted lookup cannot raise, and
+``layers/common.py:_table_lookup`` maps bad ids to zero rows instead of
+silently training the last embedding row. This feeder check is the loud
+counterpart: it names the input and the offending id before the batch
+reaches the device.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.data import DataFeeder
+from paddle_tpu.data.types import integer_value, integer_value_sequence
+
+
+def _feeder(**kw):
+    return DataFeeder({"words": integer_value_sequence(10),
+                       "label": integer_value(4)}, pad_multiple=4, **kw)
+
+
+def test_valid_ids_pass():
+    f = _feeder(validate_ids=True)
+    feed = f([([1, 2, 9], 3), ([0, 5], 0)])
+    assert feed["words"].value.shape[0] == 2
+
+
+def test_out_of_range_sequence_id_raises_with_name_and_id():
+    f = _feeder(validate_ids=True)
+    with pytest.raises(ValueError) as e:
+        f([([1, 17, 2], 3)])
+    assert "'words'" in str(e.value) and "17" in str(e.value)
+
+
+def test_out_of_range_label_raises():
+    f = _feeder(validate_ids=True)
+    with pytest.raises(ValueError) as e:
+        f([([1, 2], 4)])  # label range is [0, 4)
+    assert "'label'" in str(e.value) and "4" in str(e.value)
+
+
+def test_minus_one_oov_sentinel_is_legal():
+    # -1 is the ProtoData ignore sentinel: zero row, trains nothing
+    f = _feeder(validate_ids=True)
+    feed = f([([1, -1, 2], 0)])
+    assert feed["words"].value.shape == (1, 4)
+
+
+def test_below_minus_one_raises():
+    f = _feeder(validate_ids=True)
+    with pytest.raises(ValueError):
+        f([([1, -2], 0)])
+
+
+def test_padding_positions_exempt():
+    # pad_multiple pads with zeros under mask 0 — never flagged even
+    # though a strict check of the raw array would pass anyway; the mask
+    # gate matters for id 0 being out of range (dim could be 0-sized
+    # never, but bucketed dead rows reuse zero samples)
+    f = DataFeeder({"words": integer_value_sequence(10),
+                    "label": integer_value(4)}, pad_multiple=4,
+                   batch_buckets=[4], validate_ids=True)
+    feed = f([([1, 2, 3], 0)])  # pads up to 4 rows with zero samples
+    assert feed["words"].value.shape[0] == 4
+
+
+def test_default_off_ids_clamp_to_zero_rows():
+    # without debug mode the feed converts silently; the lookup maps the
+    # bad id to a ZERO row (not the last row, which would train it)
+    f = _feeder()
+    feed = f([([1, 17, 2], 3)])
+    from paddle_tpu.layers.common import _table_lookup
+    w = jnp.asarray(np.random.RandomState(0).randn(10, 4).astype(np.float32))
+    out = np.asarray(_table_lookup(w, feed["words"].value))
+    assert np.all(out[0, 1] == 0.0)          # bad id -> zero row
+    assert not np.all(out[0, 0] == 0.0)      # good id -> real row
+
+
+def test_env_var_enables_validation(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_VALIDATE_IDS", "1")
+    f = _feeder()
+    assert f.validate_ids
+    with pytest.raises(ValueError):
+        f([([11], 0)])
+
+
+def test_nested_sequence_ids_checked():
+    from paddle_tpu.data.types import integer_value_sub_sequence
+    f = DataFeeder({"w": integer_value_sub_sequence(5)}, pad_multiple=2,
+                   validate_ids=True)
+    with pytest.raises(ValueError) as e:
+        f([([[1, 2], [7]],)])
+    assert "7" in str(e.value)

@@ -157,6 +157,34 @@ def test_device_attr_pipeline_stand_down_warns(caplog):
     assert any("_s0" in k for k in rules2)
 
 
+def test_shard_opt_state_warns_on_nondivisible_dim(caplog):
+    """ISSUE r07 satellite: a slot rule that would shard a dimension not
+    divisible by the axis size keeps the leaf replicated — and says so,
+    naming the parameter and the axis, instead of silently falling
+    back."""
+    import logging
+
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.parallel.mesh import shard_opt_state
+
+    mesh = create_mesh(n_data=8)
+    state = {"slots": {"w": {"mom": jnp.zeros((13, 4))},
+                       "ok": {"mom": jnp.zeros((16, 4))}},
+             "t": jnp.zeros((), jnp.int32)}
+    plogger = logging.getLogger("paddle_tpu")
+    plogger.addHandler(caplog.handler)
+    try:
+        out = shard_opt_state(state, mesh,
+                              rules={"w": P("data"), "ok": P("data")})
+    finally:
+        plogger.removeHandler(caplog.handler)
+    assert "not divisible" in caplog.text and "'w'" in caplog.text
+    # the offending leaf is replicated; the divisible one is sharded
+    assert out["slots"]["w"]["mom"].sharding.is_fully_replicated
+    assert not out["slots"]["ok"]["mom"].sharding.is_fully_replicated
+
+
 def test_dryrun_multichip_entry():
     import __graft_entry__ as ge
     ge.dryrun_multichip(8)
