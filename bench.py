@@ -414,6 +414,130 @@ def bench_zero1(batches=None, batch_size=64):
     return out
 
 
+def bench_pipeline(batches=None, batch_size=64, hidden=256, n_stages=4,
+                   layers_per_stage=4, microbatches=None):
+    """Pipeline-parallel A/B: the SAME deep-MLP config (per-layer device
+    attrs, `n_stages` stages x `layers_per_stage` fc layers) trained
+    unpipelined over a pure-DP mesh vs pipelined over a (data, pipe)
+    mesh with the GPipe schedule (`--parallel_nn`), interleaved best-of-R
+    per the host-drift rules (CLAUDE.md). Reports steps/s both modes, the
+    bubble-fraction estimate from `utils/profiler.pipeline_bubble_stats`,
+    and the per-device body-parameter bytes (the stage-stacked layout
+    holds 1/S per device). CPU-runnable off-tunnel
+    (``python bench.py --pipeline`` -> BENCH_r08.json); on real ICI the
+    ppermute hand-off overlaps compute — on the 1-core virtual mesh the
+    schedule's win cannot show, so the honest headline here is
+    correctness + bubble accounting, with steps/s recorded for drift
+    context."""
+    import jax
+    import numpy as np
+    from paddle_tpu.config import dsl
+    from paddle_tpu.core.argument import Argument
+    from paddle_tpu.optim import Adam
+    from paddle_tpu.parallel import create_mesh
+    from paddle_tpu.trainer import SGD
+    from paddle_tpu.utils.profiler import memory_stats
+
+    batches = int(os.environ.get("BENCH_PIPE_BATCHES", "12")
+                  if batches is None else batches)
+    n_dev = len(jax.devices())
+    S = min(n_stages, n_dev)
+    n_data = max(n_dev // S, 1)
+    M = microbatches or int(os.environ.get("BENCH_PIPE_MICROBATCHES", "8"))
+
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    X = rng.randn(batch_size, hidden).astype(np.float32)
+    Y = rng.randint(0, 10, size=batch_size).astype(np.int32)
+    feed = {"x": Argument(value=jnp.asarray(X)),
+            "label": Argument(value=jnp.asarray(Y))}
+
+    def reader():
+        for _ in range(batches):
+            yield feed
+
+    def build(pipelined):
+        dsl.reset()
+        x = dsl.data(name="x", size=hidden)
+        lbl = dsl.data(name="label", size=10)
+        h = x
+        for s in range(S):
+            for j in range(layers_per_stage):
+                h = dsl.fc(input=h, size=hidden, act="tanh",
+                           name=f"blk{s}_{j}", layer_attr={"device": s})
+        out = dsl.fc(input=h, size=10, act="softmax", name="out")
+        cost = dsl.classification_cost(input=out, label=lbl)
+        mesh = (create_mesh(n_data=n_data, n_pipe=S) if pipelined
+                else create_mesh(n_data=n_dev, n_model=1))
+        tr = SGD(cost=cost, update_equation=Adam(learning_rate=1e-3),
+                 mesh=mesh, seed=0)
+        # compile outside the measured passes
+        tr.train(lambda: iter([feed, feed]), num_passes=1,
+                 pipeline={"microbatches": M} if pipelined else None)
+        return tr
+
+    trainers = {False: build(False), True: build(True)}
+    best = {False: 0.0, True: 0.0}
+    for _ in range(int(os.environ.get("BENCH_PIPE_ROUNDS", "3"))):
+        for pipelined, tr in trainers.items():
+            tr.train(reader, num_passes=1)
+            best[pipelined] = max(best[pipelined],
+                                  tr.step_breakdown()["steps_per_sec"])
+    pipe_tr = trainers[True]
+    s = pipe_tr.step_breakdown()
+    body_keys = pipe_tr._pipe.stacked_keys() if pipe_tr._pipe else []
+    pipe_body = memory_stats({k: pipe_tr.params[k] for k in body_keys})
+    flat = trainers[False]
+    flat_body = memory_stats({k: v for k, v in flat.params.items()
+                              if k.startswith("_blk")})
+    return {
+        "pipeline_devices": n_dev,
+        "pipeline_stages": s.get("pipeline_stages", S),
+        "pipeline_microbatches": s.get("pipeline_microbatches", M),
+        "pipeline_bubble_frac": round(s.get("pipeline_bubble_frac", 0.0),
+                                      4),
+        "pipeline_bubble_frac_per_stage": [
+            round(v, 4) for v in s.get("pipeline_bubble_frac_per_stage",
+                                       [])],
+        "pipeline_steps_per_sec": round(best[True], 3),
+        "unpipelined_steps_per_sec": round(best[False], 3),
+        "pipeline_vs_unpipelined_steps": (
+            round(best[True] / best[False], 3) if best[False] else None),
+        "pipeline_body_param_bytes_per_device":
+            pipe_body["param_bytes_per_device"],
+        "unpipelined_body_param_bytes_per_device":
+            flat_body["param_bytes_per_device"],
+        "pipeline_body_param_bytes_reduction": round(
+            flat_body["param_bytes_per_device"]
+            / max(pipe_body["param_bytes_per_device"], 1), 2),
+        "pipeline_batches": batches,
+        "pipeline_batch_size": batch_size,
+        "pipeline_hidden": hidden,
+        "pipeline_layers_per_stage": layers_per_stage,
+    }
+
+
+def pipeline_main():
+    """``python bench.py --pipeline``: the off-tunnel pipeline A/B alone,
+    forced onto an 8-virtual-device CPU mesh; one JSON line, mirrored to
+    BENCH_r08.json."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    result = {"metric": "pipeline_parallel_train_ab",
+              "platform": jax.devices()[0].platform}
+    result.update(bench_pipeline())
+    line = json.dumps(result)
+    print(line, flush=True)
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, "BENCH_r08.json"), "w") as f:
+        f.write(line + "\n")
+    return 0
+
+
 def zero1_main():
     """``python bench.py --zero1``: the off-tunnel ZeRO-1 A/B alone,
     forced onto an 8-virtual-device CPU mesh (no tunnel involvement);
@@ -519,6 +643,10 @@ def child_main():
     # ZeRO-1 sharded-optimizer A/B over the real device mesh (the
     # off-tunnel number lives in BENCH_r07.json via --zero1)
     extra("zero1", bench_zero1)
+    # pipeline-parallel A/B over the real mesh — on ICI the ppermute
+    # hand-off overlaps compute, so this is where the schedule's win can
+    # actually show (off-tunnel number: BENCH_r08.json via --pipeline)
+    extra("pipeline", bench_pipeline)
     return 0
 
 
@@ -527,6 +655,8 @@ def main():
         return input_pipeline_main()
     if "--zero1" in sys.argv[1:]:
         return zero1_main()
+    if "--pipeline" in sys.argv[1:]:
+        return pipeline_main()
     if os.environ.get("BENCH_CHILD") == "1":
         return child_main()
 

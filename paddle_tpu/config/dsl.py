@@ -38,13 +38,14 @@ def on_reset(fn):
 def reset():
     """Start a fresh graph (the reference resets config_parser globals per
     parse_config call)."""
-    global _GRAPH, _COUNTERS, _GROUP_CTX
+    global _GRAPH, _COUNTERS, _GROUP_CTX, _DEVICE_SCOPE
     _GRAPH = ModelDef()
     _COUNTERS = {}
     _SHAPES.clear()
     # a build that raised inside a recurrent_group step must not leave the
-    # group context armed for the next build
+    # group context armed for the next build (nor a pipeline_stage scope)
     _GROUP_CTX = None
+    _DEVICE_SCOPE = None
     for fn in _RESET_HOOKS:
         fn()
 
@@ -77,6 +78,10 @@ def _in(x) -> List[LayerOutput]:
 
 
 def _add(ldef: LayerDef) -> LayerOutput:
+    if (_DEVICE_SCOPE is not None and ldef.type != "data"
+            and ldef.attrs.get("device") is None):
+        # pipeline_stage(s) scope: the --parallel_nn placement spelling
+        ldef.attrs["device"] = _DEVICE_SCOPE
     _GRAPH.add(ldef)
     from paddle_tpu.core.registry import get_layer_impl
     # resolve output size via the impl's shape inference
@@ -413,6 +418,8 @@ def _layer_attr(layer_attr: Optional[dict]):
         if "device" in layer_attr:
             # per-layer placement (--parallel_nn); consumed by
             # parallel.mesh.device_attr_rules as a model-axis shard hint
+            # or, all-layers-contiguous, as GPipe stage ids
+            # (parallel/pipeline.py)
             attrs["device"] = layer_attr["device"]
         if "recompute" in layer_attr:
             # per-layer rematerialization (jax.checkpoint in the executor)
@@ -420,6 +427,28 @@ def _layer_attr(layer_attr: Optional[dict]):
         if attrs:
             out["attrs"] = attrs
     return out
+
+
+_DEVICE_SCOPE: Optional[int] = None
+
+
+@contextlib.contextmanager
+def pipeline_stage(stage: int):
+    """``with dsl.pipeline_stage(s): ...`` — every non-data layer built
+    inside carries ``device=s``, the reference's ``--parallel_nn``
+    placement spelling (``ParallelNeuralNetwork.h:23-62``) without
+    repeating ``layer_attr={"device": s}`` per layer. An explicit
+    per-layer ``device`` wins; scopes nest (innermost wins). Contiguous
+    stage ids 0..S-1 across the body make the config trainable through
+    ``SGD.train(pipeline=True)`` / ``--parallel_nn``
+    (``docs/pipeline_parallel.md``)."""
+    global _DEVICE_SCOPE
+    prev = _DEVICE_SCOPE
+    _DEVICE_SCOPE = int(stage)
+    try:
+        yield
+    finally:
+        _DEVICE_SCOPE = prev
 
 
 # ------------------------------------------------- recurrent groups (§3.5)

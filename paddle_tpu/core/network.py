@@ -154,7 +154,15 @@ class Network:
         out_shardings = (
             {name: shardings[name] for name in self.param_specs}
             if shardings else None)
-        return jax.jit(_init, out_shardings=out_shardings)(key)
+        # partitionable threefry ONLY for init: with the default
+        # (non-partitionable) impl, jitted random values DEPEND on the
+        # out_sharding, so a model-sharded table initializes to different
+        # numbers than the same table replicated — breaking every
+        # sharded-vs-unsharded parity claim at step 0 (observed on the
+        # (dcn, data, model) mesh, tests/test_multislice.py). Scoped here
+        # so existing dropout/sampling streams are untouched.
+        with jax.threefry_partitionable(True):
+            return jax.jit(_init, out_shardings=out_shardings)(key)
 
     # ----------------------------------------------------------------- apply
     def apply(self, params: Dict[str, jnp.ndarray],

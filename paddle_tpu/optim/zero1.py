@@ -29,7 +29,12 @@ plan (they fall back to the replicated per-parameter update inside the same
   ``t_rows`` bookkeeping is row-structured, not flat-elementwise);
 - parameters with a non-replicated sharding rule (e.g. embedding tables
   row-sharded over the model axis — their slots already follow the table,
-  ``parallel/mesh.py:shard_opt_state``).
+  ``parallel/mesh.py:shard_opt_state``). Since r08 this is also how the
+  pipeline composes: stage-stacked body parameters carry ``P(pipe, ...)``
+  rules (``parallel/pipeline.py:PipelineTrainPlan.shard_rules``), so
+  their slots stay 1/S-per-device on the pipe axis while the replicated
+  head still partitions over the data axis here
+  (``docs/pipeline_parallel.md`` interaction matrix).
 
 Model-averaging state (``avg``) stays replicated: it is consumed whole by
 ``averaged_params`` at eval/save time and is rare enough not to warrant a

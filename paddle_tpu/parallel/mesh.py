@@ -34,20 +34,32 @@ from paddle_tpu.core.argument import Argument
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
+PIPE_AXIS = "pipe"  # GPipe stage axis (parallel/pipeline.py)
 DCN_AXIS = "dcn"  # cross-slice (data-center network) leading axis
 
 
 def create_mesh(n_data: Optional[int] = None, n_model: int = 1,
-                n_seq: int = 1, devices=None) -> Mesh:
+                n_seq: int = 1, devices=None, n_pipe: int = 1) -> Mesh:
     """Build a (data, model) mesh — or (data, seq, model) when
     ``n_seq > 1`` for sequence/context parallelism (ring/ulysses
     attention shards the time axis over ``seq``; the axis sits between
     data and model so its ppermute/all-to-all rides ICI next to the
-    model axis). Defaults to all visible devices on the data axis (pure
-    DP, the reference's trainer_count semantics)."""
+    model axis), or (data, pipe) when ``n_pipe > 1`` for pipeline
+    parallelism (one GPipe stage per pipe slot, innermost so the
+    stage-handoff ppermute rides ICI; ``--parallel_nn``,
+    ``trainer/trainer.py:enable_pipeline``). Defaults to all visible
+    devices on the data axis (pure DP, the reference's trainer_count
+    semantics)."""
     devices = devices if devices is not None else jax.devices()
+    if n_pipe > 1 and (n_model > 1 or n_seq > 1):
+        raise ValueError(
+            "n_pipe composes with n_data only (a pipeline stage owns its "
+            "whole layer; shard within a stage via shard_rules instead)")
     if n_data is None:
-        n_data = len(devices) // (n_model * n_seq)
+        n_data = len(devices) // (n_model * n_seq * n_pipe)
+    if n_pipe > 1:
+        devs = np.asarray(devices[: n_data * n_pipe]).reshape(n_data, n_pipe)
+        return Mesh(devs, (DATA_AXIS, PIPE_AXIS))
     if n_seq > 1:
         devs = np.asarray(devices[: n_data * n_seq * n_model]).reshape(
             n_data, n_seq, n_model)
@@ -135,7 +147,11 @@ def shard_map_compat(f, mesh: Mesh, in_specs, out_specs,
 
 
 def batch_axes(mesh: Mesh):
-    """Mesh axes the batch dimension is split over (dcn is part of DP)."""
+    """Mesh axes the batch dimension is split over (dcn is part of DP).
+    A mesh WITHOUT a data axis (e.g. a pure ("pipe",) stage mesh) has no
+    batch axes: the batch replicates and DP degree is 1."""
+    if DATA_AXIS not in mesh.axis_names:
+        return ()
     if DCN_AXIS in mesh.axis_names:
         return (DCN_AXIS, DATA_AXIS)
     return (DATA_AXIS,)
@@ -155,6 +171,8 @@ def batch_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
     pipeline's device_put stage (``data/prefetch.py``) both use it, so a
     prefetched batch lands exactly where the step expects it."""
     axes = batch_axes(mesh)
+    if not axes:
+        return NamedSharding(mesh, P())
     return NamedSharding(mesh, P(axes, *([None] * (ndim - 1))))
 
 

@@ -259,3 +259,492 @@ def _attach(fn, stage_fn, stacked):
             return fn(params, x)
 
     return _Pipe()
+
+
+# ---------------------------------------------------------- training plan
+def split_pipeline_graph(graph):
+    """Partition a graph into ``(stages, head)`` for *training* through
+    the pipeline: the staged body (layers carrying a nonnegative
+    ``device`` attr, the reference's ``--parallel_nn`` placement spelling,
+    ``ParallelNeuralNetwork.h:23-62``) plus the trailing unstaged head
+    (cost layers, evaluator decodes) computed replicated on the body
+    output. Unlike :func:`stages_from_device_attrs` (forward-only: every
+    non-data layer must be staged), a training config keeps its cost
+    layers unstaged — the loss is not part of the repeated block.
+
+    Rules: staged layers form a chain with contiguous stage ids along the
+    topological order, consuming only data layers (stage-0 entry) or other
+    staged layers; head layers may consume data layers, other head layers,
+    and the LAST staged layer only (a head reaching into an intermediate
+    stage would need a second activation route the schedule doesn't
+    carry). Raises ``ValueError`` with a pinpointed message otherwise —
+    the trainer catches it and stands down to the unpipelined step."""
+    order = [n for n in graph.topo_order() if graph.layers[n].type != "data"]
+
+    def dev(n):
+        return int(getattr(graph.layers[n], "attrs", {}).get("device", -1))
+
+    staged = [n for n in order if dev(n) >= 0]
+    if not staged:
+        raise ValueError("pipeline: no layer carries a device attr")
+    head = [n for n in order if dev(n) < 0]
+    staged_set = set(staged)
+    last_staged = staged[-1]
+    for n in head:
+        for src in graph.layers[n].input_names():
+            if src in staged_set and src != last_staged:
+                raise ValueError(
+                    f"pipeline head layer {n!r} consumes intermediate "
+                    f"stage output {src!r}; the head may read only the "
+                    f"last staged layer ({last_staged!r})")
+    for n in staged:
+        for src in graph.layers[n].input_names():
+            if src not in staged_set and graph.layers[src].type != "data":
+                raise ValueError(
+                    f"staged layer {n!r} consumes unstaged layer {src!r}: "
+                    "every body input must be a data layer or another "
+                    "staged layer")
+    stages: list = []
+    last = -1
+    for name in staged:
+        d = dev(name)
+        if d < last:
+            raise ValueError(
+                f"layer {name!r} (device {d}) appears after stage {last}: "
+                "stages must be non-decreasing along the topo order")
+        if d > last:
+            if d != last + 1:
+                raise ValueError(
+                    f"stage ids must be contiguous: jumped {last}->{d}")
+            stages.append([])
+            last = d
+        stages[d].append(name)
+    if len(stages) < 2:
+        raise ValueError("pipeline needs >= 2 stages")
+    # chain topology per stage (single input, exact predecessor)
+    for s, st in enumerate(stages):
+        for j, n in enumerate(st):
+            names = graph.layers[n].input_names()
+            if len(names) != 1:
+                raise ValueError(
+                    f"stage {s} layer {n!r} must be a chain (single "
+                    f"input); it has inputs {names}")
+            want = (st[j - 1] if j > 0
+                    else stages[s - 1][-1] if s > 0 else None)
+            if want is not None and names[0] != want:
+                raise ValueError(
+                    f"stage {s} layer {n!r} consumes {names[0]!r}, but a "
+                    f"pipeline chain requires its predecessor {want!r}")
+    return stages, head
+
+
+def _stage_subnet(graph, layer_names, in_name, in_size):
+    """Sub-Network for one stage: a ``__pipe_in__`` data stand-in feeding
+    the stage's chain (Input extras/param_attrs preserved — conv filter
+    specs live there)."""
+    import dataclasses as _dc
+
+    from paddle_tpu.config.model_config import LayerDef, ModelDef
+    from paddle_tpu.core.network import Network
+
+    sub = ModelDef()
+    sub.add(LayerDef(name="__pipe_in__", type="data", size=in_size))
+    prev = "__pipe_in__"
+    for n in layer_names:
+        ldef = graph.layers[n]
+        sub.add(_dc.replace(
+            ldef, inputs=[_dc.replace(ldef.inputs[0], layer_name=prev)]))
+        prev = n
+    return Network(sub, outputs=[layer_names[-1]])
+
+
+def _schedule(mesh: Mesh, axis: str, stage_call, S: int, M: int,
+              params_spec, batch_axes=()):
+    """The GPipe fill-drain schedule as one shard_map'd ``lax.scan`` over
+    ``S + M - 1`` ticks (its ``jax.grad`` is the reverse drain — the
+    backward pipeline). ``stage_call(params, idx, h, rng) -> h`` runs this
+    device's stage; ``params_spec`` is the shard_map in_spec prefix for
+    the params pytree (``P(axis)`` stage-stacked, ``P()`` replicated for
+    heterogeneous stages). ``batch_axes`` (the mesh's data axes) shard the
+    batch dim of x/y so the pipeline composes with data parallelism: each
+    data slot runs the same schedule on its rows."""
+    x_spec = P(batch_axes) if batch_axes else P()
+
+    def local(sp, x, rng):
+        idx = lax.axis_index(axis)
+        B = x.shape[0]
+        if B % M:
+            raise ValueError(
+                f"pipeline microbatches ({M}) must divide the per-device "
+                f"batch ({B} rows)")
+        mb = x.reshape(M, B // M, *x.shape[1:])
+        n_ticks = S + M - 1
+        perm_fwd = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(carry, t):
+            inflight, outputs = carry
+            feed = jnp.where(t < M, 1, 0)
+            mb_t = mb[jnp.clip(t, 0, M - 1)]
+            h_in = jnp.where((idx == 0) & (feed == 1), mb_t, inflight)
+            # per-tick rng: without the fold every microbatch would
+            # sample the SAME dropout mask (the grad-accum path splits
+            # per microbatch for the same reason, trainer.py accum_step)
+            r_t = (jax.random.fold_in(rng, t) if rng is not None else None)
+            h_out = stage_call(sp, idx, h_in, r_t)
+            m_done = t - (S - 1)
+            is_done = (idx == S - 1) & (m_done >= 0) & (m_done < M)
+            outputs = lax.cond(
+                is_done,
+                lambda o: lax.dynamic_update_index_in_dim(
+                    o, h_out, jnp.clip(m_done, 0, M - 1), axis=0),
+                lambda o: o, outputs)
+            h_next = lax.ppermute(h_out, axis, perm_fwd)
+            return (h_next, outputs), None
+
+        inflight0 = jnp.zeros_like(mb[0])
+        outputs0 = jnp.zeros_like(mb)
+        (_, outputs), _ = lax.scan(
+            tick, (inflight0, outputs0), jnp.arange(n_ticks))
+        mask = (idx == S - 1).astype(outputs.dtype)
+        outputs = lax.psum(outputs * mask, axis)
+        return outputs.reshape(B, *outputs.shape[2:])
+
+    from paddle_tpu.parallel.mesh import shard_map_compat
+    return shard_map_compat(
+        local, mesh=mesh, in_specs=(params_spec, x_spec, P()),
+        out_specs=x_spec, check_vma=False)
+
+
+class PipelineTrainPlan:
+    """Everything the trainer needs to run a device-attr config's body
+    through the GPipe schedule inside the jitted train step.
+
+    Identical stages (the repeated-block idiom) take the SPMD fast path:
+    the body's parameters restructure to stage-stacked ``[S, ...]`` arrays
+    sharded ``P(pipe)`` — each mesh slot permanently holds ONE stage's
+    parameters and optimizer slots (1/S of the body state per device), the
+    reference's per-device layer ownership made SPMD. Structurally uneven
+    splits (different layer counts per stage, uniform boundary width) fall
+    back to ``lax.switch`` over per-stage sub-networks with replicated
+    parameters — the schedule still pipelines, only the memory win is
+    forfeited (documented in docs/pipeline_parallel.md).
+
+    Construction VALIDATES and raises ``ValueError`` on any config the
+    schedule cannot honor; the trainer turns that into a warn-and-stand-
+    down, never a broken step."""
+
+    def __init__(self, graph, full_net, params, meta, mesh: Mesh,
+                 axis: str, n_microbatches=None):
+        self.graph, self.mesh, self.axis = graph, mesh, axis
+        self.stages, self.head = split_pipeline_graph(graph)
+        self.S = len(self.stages)
+        if axis not in mesh.axis_names:
+            raise ValueError(f"mesh {dict(mesh.shape)} has no {axis!r} axis")
+        if mesh.shape[axis] != self.S:
+            raise ValueError(
+                f"{self.S} stages need mesh axis {axis!r} of size "
+                f"{self.S}, got {mesh.shape[axis]}")
+        # default M = S: bubble (S-1)/(2S-1) just under one half — a sane
+        # floor; raise M (more, smaller microbatches) to shrink it
+        self.M = int(n_microbatches) if n_microbatches else self.S
+        if self.M < 1:
+            raise ValueError("n_microbatches must be >= 1")
+        first = graph.layers[self.stages[0][0]]
+        self.body_in = first.input_names()[0]
+        self.body_out = self.stages[-1][-1]
+        if graph.layers[self.body_in].type != "data":
+            raise ValueError(
+                f"stage 0 must consume a data layer; {self.body_in!r} "
+                f"is {graph.layers[self.body_in].type!r}")
+        # the handoff buffer has ONE shape: every stage boundary (and the
+        # body input) must share the feature width
+        widths = [graph.layers[st[-1]].size for st in self.stages]
+        in_w = graph.layers[self.body_in].size
+        if any(w != widths[0] for w in widths) or in_w != widths[0]:
+            raise ValueError(
+                f"pipeline stage boundary widths must be uniform and "
+                f"equal the body input size; got input {in_w}, stage "
+                f"outputs {widths}")
+        for st in self.stages:
+            for n in st:
+                t = graph.layers[n].type
+                if t in ("batch_norm", "cudnn_batch_norm",
+                         "batch_normalization"):
+                    raise ValueError(
+                        f"staged layer {n!r} is a batch-stat layer: "
+                        "moving-statistic updates cannot thread through "
+                        "the pipeline scan")
+        # body parameter ownership: per-stage nets + name bookkeeping
+        body_pnames = []
+        self._stage_pnames = []
+        for st in self.stages:
+            sp = []
+            for layer in st:
+                sp.extend(sorted(full_net._layer_params[layer].values()))
+            self._stage_pnames.append(sp)
+            body_pnames.extend(sp)
+        if len(set(body_pnames)) != len(body_pnames):
+            raise ValueError(
+                "pipeline stages share parameters (explicit param names "
+                "across stages); stage-stacked layout cannot hold them")
+        self.body_pnames = body_pnames
+        head_pnames = {p for layer in self.head
+                       for p in full_net._layer_params[layer].values()}
+        if head_pnames & set(body_pnames):
+            raise ValueError(
+                "a parameter is shared between the pipeline body and the "
+                "head; split the sharing or unpin the layer")
+        sigs = [[(graph.layers[n].type, graph.layers[n].size)
+                 for n in st] for st in self.stages]
+        self.identical = all(sig == sigs[0] for sig in sigs[1:])
+        if self.identical:
+            tmpl = self.stages[0]
+            self._tmpl_net = _stage_subnet(graph, tmpl, self.body_in, in_w)
+            # stacked key = the stage-0 (template) parameter name; maps
+            # positionally onto every stage's parameters
+            self.stacked_map = {}
+            for j, tmpl_layer in enumerate(tmpl):
+                for suffix, tmpl_pname in (
+                        full_net._layer_params[tmpl_layer].items()):
+                    self.stacked_map[tmpl_pname] = [
+                        full_net._layer_params[st[j]][suffix]
+                        for st in self.stages]
+            shapes = [[tuple(params[n].shape) for n in names]
+                      for names in self.stacked_map.values()]
+            for names, shp in zip(self.stacked_map.values(), shapes):
+                if any(s != shp[0] for s in shp[1:]):
+                    raise ValueError(
+                        f"stage parameter shapes differ for {names}: {shp}")
+            # per-stage specs must agree on everything the update reads
+            for tmpl_pname, names in self.stacked_map.items():
+                s0 = meta[names[0]]
+                for n in names[1:]:
+                    s = meta[n]
+                    if (s.learning_rate, s.is_static, s.l1_rate, s.l2_rate,
+                        s.sparsity_ratio) != (
+                            s0.learning_rate, s0.is_static, s0.l1_rate,
+                            s0.l2_rate, s0.sparsity_ratio):
+                        raise ValueError(
+                            f"stage parameters {names[0]!r} and {n!r} "
+                            "have different update attrs (lr/static/"
+                            "l1/l2/sparsity); the stacked update needs "
+                            "them uniform")
+            self._stage_nets = None
+        else:
+            self._tmpl_net = None
+            self.stacked_map = {}
+            prev_out = self.body_in
+            self._stage_nets = []
+            for st in self.stages:
+                self._stage_nets.append(_stage_subnet(
+                    graph, st, prev_out, in_w))
+                prev_out = st[-1]
+        self._fwd_cache = {}
+
+    # ---------------------------------------------------------- forward
+    def stacked_keys(self):
+        return sorted(self.stacked_map)
+
+    def body_param_names(self):
+        """Names the body view of the step's param dict must contain:
+        stacked keys on the fast path, the original flat names otherwise."""
+        return (self.stacked_keys() if self.identical
+                else sorted(self.body_pnames))
+
+    def stacked_spec(self, ndim: int) -> P:
+        return P(self.axis, *([None] * (ndim - 1)))
+
+    def fwd(self, M: int, train: bool = True):
+        """The shard_map'd schedule for M microbatches (cached — M is a
+        static property of the program; a tail batch that needs a smaller
+        M compiles its own instance, same as any other shape change).
+        Stage rngs fold in the stage index (here) and the tick index
+        (inside the schedule) so dropout streams differ per stage AND per
+        microbatch (the sampled masks necessarily differ from the
+        unpipelined step's — the usual microbatching caveat; parity
+        claims hold for deterministic bodies)."""
+        key = (M, bool(train))
+        if key in self._fwd_cache:
+            return self._fwd_cache[key]
+        from paddle_tpu.core.argument import Argument
+        if self.identical:
+            net, out_name = self._tmpl_net, self.stages[0][-1]
+
+            def stage_call(sp, idx, h, rng):
+                mine = {k: v[0] for k, v in sp.items()}
+                r = (jax.random.fold_in(rng, idx)
+                     if rng is not None else None)
+                out = net.apply(mine, {"__pipe_in__": Argument(value=h)},
+                                train=train, rng=r)
+                return out[out_name].value
+
+            params_spec = P(self.axis)
+        else:
+            nets = self._stage_nets
+            outs = [st[-1] for st in self.stages]
+
+            def stage_call(sp, idx, h, rng):
+                r = (jax.random.fold_in(rng, idx)
+                     if rng is not None else None)
+
+                def branch(s):
+                    def run(sp, h):
+                        out = nets[s].apply(
+                            sp, {"__pipe_in__": Argument(value=h)},
+                            train=train, rng=r)
+                        return out[outs[s]].value
+                    return run
+
+                return lax.switch(idx, [branch(s) for s in range(self.S)],
+                                  sp, h)
+
+            params_spec = P()
+        from paddle_tpu.parallel import mesh as mesh_lib
+        fn = _schedule(self.mesh, self.axis, stage_call, self.S, M,
+                       params_spec,
+                       batch_axes=mesh_lib.batch_axes(self.mesh))
+        self._fwd_cache[key] = fn
+        return fn
+
+    # ---------------------------------------------- state restructuring
+    def _stacked_sharding(self, ndim: int):
+        return NamedSharding(self.mesh, self.stacked_spec(ndim))
+
+    def stack_params(self, params):
+        """Flat per-stage params -> stage-stacked params sharded one
+        stage per pipe slot. Non-body params pass through."""
+        if not self.identical:
+            return dict(params)
+        body = set(self.body_pnames)
+        out = {k: v for k, v in params.items() if k not in body}
+        for skey, names in self.stacked_map.items():
+            stacked = jnp.stack([params[n] for n in names])
+            out[skey] = jax.device_put(
+                stacked, self._stacked_sharding(stacked.ndim))
+        return out
+
+    def unstack_params(self, params):
+        """The checkpoint view: stage-stacked arrays back to the flat
+        per-stage names — the on-disk format never depends on whether the
+        run was pipelined."""
+        if not self.identical:
+            return dict(params)
+        out = {k: v for k, v in params.items() if k not in self.stacked_map}
+        for skey, names in self.stacked_map.items():
+            stacked = params[skey]
+            for s, n in enumerate(names):
+                out[n] = stacked[s]
+        return out
+
+    def stack_opt_state(self, state):
+        """Per-stage slot dicts -> one stacked slot dict per stacked key
+        (leaf-wise stack, sharded like the parameter). Scalars pass
+        through; ``avg`` is rejected upstream (enable_pipeline)."""
+        if not self.identical:
+            return state
+        body = set(self.body_pnames)
+        slots = {n: s for n, s in state["slots"].items() if n not in body}
+        for skey, names in self.stacked_map.items():
+            if names[0] not in state["slots"]:
+                continue  # static params have no slots
+            per = [state["slots"][n] for n in names]
+            slots[skey] = {
+                slot: jax.device_put(
+                    jnp.stack([p[slot] for p in per]),
+                    self._stacked_sharding(per[0][slot].ndim + 1))
+                for slot in per[0]}
+        return {**state, "slots": slots}
+
+    def unstack_opt_state(self, state):
+        if not self.identical:
+            return state
+        slots = {n: s for n, s in state["slots"].items()
+                 if n not in self.stacked_map}
+        for skey, names in self.stacked_map.items():
+            if skey not in state["slots"]:
+                continue
+            stacked = state["slots"][skey]
+            for s, n in enumerate(names):
+                slots[n] = {slot: leaf[s] for slot, leaf in stacked.items()}
+        return {**state, "slots": slots}
+
+    def stacked_meta(self, meta):
+        """meta with per-stage specs replaced by one stacked spec (leading
+        S dim; update attrs validated uniform in __init__)."""
+        if not self.identical:
+            return dict(meta)
+        import dataclasses as _dc
+        body = set(self.body_pnames)
+        out = {k: v for k, v in meta.items() if k not in body}
+        for skey, names in self.stacked_map.items():
+            spec = meta[names[0]]
+            out[skey] = _dc.replace(
+                spec, shape=(self.S,) + tuple(spec.shape))
+        return out
+
+    def restack_checkpoint(self, params, opt_flat):
+        """A restored flat-format checkpoint -> this run's stacked layout
+        (host-side numpy; ``SGD.load_state`` places the result)."""
+        import numpy as np
+        if not self.identical:
+            return params, opt_flat
+        body = set(self.body_pnames)
+        p_out = {k: v for k, v in params.items() if k not in body}
+        for skey, names in self.stacked_map.items():
+            missing = [n for n in names if n not in params]
+            if missing:
+                raise ValueError(
+                    f"checkpoint lacks pipeline body parameters {missing}")
+            p_out[skey] = np.stack([np.asarray(params[n]) for n in names])
+        o_out = {}
+        grouped: dict = {}
+        for key, val in (opt_flat or {}).items():
+            parts = key.split("/")
+            if len(parts) == 3 and parts[0] == "slots" and parts[1] in body:
+                grouped.setdefault(parts[2], {})[parts[1]] = val
+            else:
+                o_out[key] = val
+        for slot, by_name in grouped.items():
+            for skey, names in self.stacked_map.items():
+                if names[0] in by_name:
+                    o_out[f"slots/{skey}/{slot}"] = np.stack(
+                        [np.asarray(by_name[n]) for n in names])
+        return p_out, o_out
+
+    def build_head_net(self, outputs):
+        """Network computing the unstaged head (cost layers, evaluator
+        decodes) on the pipeline's output: a data stand-in named exactly
+        like the last staged layer (so cost-layer wiring and the metric
+        code's ``outputs[...]`` lookups need no rewiring) plus the data
+        layers the head consumes, feeding the head layer defs unchanged."""
+        import dataclasses as _dc
+
+        from paddle_tpu.config.model_config import LayerDef, ModelDef
+        from paddle_tpu.core.network import Network
+
+        g = self.graph
+        sub = ModelDef()
+        bo = g.layers[self.body_out]
+        sub.add(LayerDef(name=self.body_out, type="data", size=bo.size))
+        for n in self.head:
+            for src in g.layers[n].input_names():
+                if (src != self.body_out and src not in sub.layers
+                        and g.layers[src].type == "data"):
+                    sub.add(_dc.replace(g.layers[src]))
+        for n in self.head:
+            sub.add(_dc.replace(g.layers[n]))
+        return Network(sub, outputs=[n for n in outputs
+                                     if n in sub.layers])
+
+    def shard_rules(self):
+        """Exact-match rules pinning every stacked key (params AND slots)
+        to the stage-major ``P(pipe, ...)`` layout — merged into the
+        trainer's rule set so ``shard_opt_state`` keeps slots with their
+        stage and the ZeRO-1 planner EXCLUDES the stacked keys from its
+        data-axis partitioning (their state is already 1/S per device;
+        ZeRO-1 composes by sharding the remaining replicated params —
+        the head — over the data axis)."""
+        if not self.identical:
+            return {}
+        return {"=" + skey: self.stacked_spec(2)  # trimmed per-leaf ndim
+                for skey in self.stacked_map}

@@ -30,12 +30,16 @@ def _flatten(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
 
 def save_params(path: str, params: Dict[str, Any],
                 opt_state: Optional[Any] = None, meta: Optional[dict] = None):
-    """``opt_state`` may be a zero-arg callable producing the state tree
-    (lazy export). The trainer's ZeRO-1 mode passes
+    """``params`` and ``opt_state`` may be zero-arg callables producing
+    their trees (lazy export). The trainer's ZeRO-1 mode passes
     ``SGD._opt_state_for_save`` here so sharded optimizer slots are
-    gathered back to their parameters' full shapes at save time — the
-    on-disk format (keys and shapes) never depends on the update path,
-    and ``SGD.load_state`` reshards on restore."""
+    gathered back to their parameters' full shapes at save time, and the
+    pipeline mode passes ``SGD._params_for_save`` so stage-stacked body
+    parameters unstack to their flat per-stage names — the on-disk format
+    (keys and shapes) never depends on the update path;
+    ``SGD.load_state`` reshards/restacks on restore."""
+    if callable(params):
+        params = params()
     if callable(opt_state):
         opt_state = opt_state()
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)

@@ -102,6 +102,23 @@ def parse_args(argv=None):
                         "(and gradient clipping) once on the accumulated "
                         "gradient — a k× effective batch at 1/k the "
                         "activation memory")
+    p.add_argument("--checkgrad_eps", type=float, default=1e-3,
+                   help="--job=checkgrad finite-difference step (the "
+                        "reference's --checkgrad_eps; default loosened "
+                        "from 1e-5 because the engine computes in f32)")
+    p.add_argument("--parallel_nn", action="store_true",
+                   help="train the config's per-layer device placement "
+                        "as a pipeline: layers pinned device=0..S-1 "
+                        "become GPipe stages over an S-slot pipe mesh "
+                        "axis, parameters sharded one stage per slot "
+                        "(the reference's --parallel_nn, Flags.cpp:23 / "
+                        "ParallelNeuralNetwork.h:23-62). Warns and "
+                        "trains unpipelined when the config has no "
+                        "device attrs or devices are short")
+    p.add_argument("--pipeline_microbatches", type=int, default=0,
+                   help="microbatches per batch under --parallel_nn "
+                        "(bubble fraction = (S-1)/(S+M-1)); 0 = auto "
+                        "(the stage count, or --grad_accum_steps)")
     return p.parse_args(argv)
 
 
@@ -172,20 +189,54 @@ def _load_v1_config(path: str, config_args: str = ""):
 
 def _build_trainer(ns, args):
     from paddle_tpu.optim.optimizers import Momentum
-    from paddle_tpu.trainer.trainer import SGD
+    from paddle_tpu.trainer.trainer import SGD, Topology
+    topo = (ns["cost"] if isinstance(ns["cost"], Topology)
+            else Topology(ns["cost"]))
     mesh = None
-    if args.trainer_count > 1:
+    n_pipe = 1
+    if getattr(args, "parallel_nn", False):
+        # the reference flag: per-layer device placement becomes GPipe
+        # stages (ParallelNeuralNetwork.h:23-62); the mesh needs a pipe
+        # axis exactly as wide as the config's stage count
+        import jax
+
+        from paddle_tpu.parallel.pipeline import split_pipeline_graph
+        from paddle_tpu.utils import logger
+        try:
+            stages, _ = split_pipeline_graph(topo.graph)
+            n_pipe = len(stages)
+        except ValueError as e:
+            logger.warning("--parallel_nn: %s — training unpipelined", e)
+        n_data = max(args.trainer_count, 1)
+        if n_pipe > 1 and len(jax.devices()) < n_pipe * n_data:
+            logger.warning(
+                "--parallel_nn: %d stages x trainer_count %d needs %d "
+                "devices, have %d — training unpipelined",
+                n_pipe, n_data, n_pipe * n_data, len(jax.devices()))
+            n_pipe = 1
+    if n_pipe > 1:
+        from paddle_tpu.parallel import create_mesh
+        mesh = create_mesh(n_data=max(args.trainer_count, 1),
+                           n_pipe=n_pipe)
+    elif args.trainer_count > 1:
         from paddle_tpu.parallel import create_mesh
         mesh = create_mesh(n_data=args.trainer_count)
     optimizer = ns.get("optimizer") or Momentum(learning_rate=0.01,
                                                 momentum=0.9)
     dtype = getattr(args, "compute_dtype", None)
-    trainer = SGD(cost=ns["cost"], update_equation=optimizer, mesh=mesh,
+    trainer = SGD(cost=topo, update_equation=optimizer, mesh=mesh,
                   seed=args.seed, evaluators=ns.get("evaluators"),
                   prev_batch_state=getattr(args, "prev_batch_state", False),
                   compute_dtype=None if dtype in (None, "float32") else dtype)
     if args.init_model_path:
+        # BEFORE enable_pipeline: init files carry flat per-stage names
+        # and _init_params maps them through the (flat) meta
         _init_params(trainer, args.init_model_path)
+    if n_pipe > 1:
+        # enabled HERE so every --job (train/time/...) sees the
+        # pipelined step; SGD.train(pipeline=None) keeps the mode sticky
+        trainer.enable_pipeline(
+            microbatches=getattr(args, "pipeline_microbatches", 0) or None)
     return trainer
 
 
@@ -350,7 +401,7 @@ def cmd_time(ns, args):
     return 0
 
 
-def cmd_checkgrad(ns, args, *, epsilon=1e-3, rtol=5e-2, samples=6):
+def cmd_checkgrad(ns, args, *, epsilon=None, rtol=5e-2, samples=6):
     """Numeric gradient check on one batch (`Trainer::checkGradient`).
     rtol is loose relative to the reference's double-precision check:
     the engine computes in float32, so the central difference itself
@@ -358,34 +409,39 @@ def cmd_checkgrad(ns, args, *, epsilon=1e-3, rtol=5e-2, samples=6):
     import jax
     import jax.numpy as jnp
     import numpy as np
+    if epsilon is None:
+        epsilon = getattr(args, "checkgrad_eps", 1e-3)
     trainer = _build_trainer(ns, args)
     reader = ns.get("train_reader")
     feeder = _feeder(ns)
     data = next(iter(reader()))
     feed = feeder(data) if feeder is not None else data
     network, cost_name = trainer.network, trainer.topology.cost_name
+    # the flat per-stage view: under --parallel_nn the live params are
+    # stage-stacked, but the check runs the plain graph
+    tparams = trainer._flat_params_view()
 
     @jax.jit
     def loss_fn(params):
         out = network.apply(params, feed, train=False)
         return jnp.sum(out[cost_name].value) / out[cost_name].value.shape[0]
 
-    analytic = jax.jit(jax.grad(loss_fn))(trainer.params)
+    analytic = jax.jit(jax.grad(loss_fn))(tparams)
     rng = np.random.RandomState(args.seed)
     worst = 0.0
     failed = []
     for name, g in analytic.items():
         if trainer.network.param_specs[name].is_static:
             continue
-        p0 = np.asarray(trainer.params[name], dtype=np.float64)
+        p0 = np.asarray(tparams[name], dtype=np.float64)
         for idx in rng.choice(p0.size, size=min(samples, p0.size),
                               replace=False):
             delta = np.zeros(p0.size)
             delta[idx] = epsilon
             delta = delta.reshape(p0.shape)
-            pp = dict(trainer.params)
+            pp = dict(tparams)
             pp[name] = jnp.asarray(p0 + delta, jnp.float32)
-            pm = dict(trainer.params)
+            pm = dict(tparams)
             pm[name] = jnp.asarray(p0 - delta, jnp.float32)
             num = (float(loss_fn(pp)) - float(loss_fn(pm))) / (2 * epsilon)
             ana = float(np.asarray(g).reshape(-1)[idx])
@@ -418,7 +474,8 @@ def cmd_merge(ns, args):
     outputs = ns.get("outputs")
     names = ([o.name if hasattr(o, "name") else o for o in outputs]
              if outputs else [ns["cost"].name])
-    merge_model(out_path, trainer.topology.graph, trainer.params,
+    merge_model(out_path, trainer.topology.graph,
+                trainer._params_for_save(),
                 outputs=names)
     print(f"merged model written to {out_path}")
     return 0

@@ -24,6 +24,7 @@ from typing import Any, Callable, Dict, List, Optional, Union
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec as P_spec
 
 from paddle_tpu.config import dsl as _dsl
 from paddle_tpu.config.model_config import ModelDef
@@ -114,6 +115,15 @@ class SGD:
         # train(zero1=True) / enable_zero1(); the updater replaces the
         # optimizer in the jitted step, everything else is unchanged
         self._zero1 = None
+        # pipeline parallelism (parallel/pipeline.py:PipelineTrainPlan):
+        # disabled until train(pipeline=...) / enable_pipeline(); while
+        # active, body parameters live stage-stacked [S, ...] sharded
+        # one stage per pipe slot and the jitted step runs the GPipe
+        # schedule (--parallel_nn, ParallelNeuralNetwork.h:23-62)
+        self._pipe = None
+        self._pipe_head_net = None
+        self._pipe_microbatches = None
+        self._flat_meta = None  # pre-stacking meta, restored on disable
         self.grad_accum_steps = 1
         self._recompile_warn = recompile_warn
         key = jax.random.PRNGKey(seed)
@@ -345,7 +355,76 @@ class SGD:
 
         return jax.tree_util.tree_map(split, feed)
 
+    def _build_pipe_step(self):
+        """The pipelined train step: body forward through the GPipe
+        schedule (``PipelineTrainPlan.fwd`` — a shard_map'd scan whose
+        ``jax.grad`` is the reverse-order backward pipeline), cost head
+        replicated on the gathered body output, ONE optimizer update on
+        the whole-batch gradient. Loss math is identical to the
+        unpipelined step's (same denominators, same clip/decay point), so
+        the step is gradient-exact on deterministic bodies — pinned by
+        tests/test_pipeline_train.py."""
+        import math
+
+        from paddle_tpu.core.argument import Argument
+        plan = self._pipe
+        head_net = self._pipe_head_net
+        updater = self._zero1 or self.optimizer
+        meta = self.meta
+        cost_name = self.topology.cost_name
+        body_names = list(plan.body_param_names())
+        M_cfg = self._pipe_microbatches
+        n_data = mesh_lib.data_parallel_degree(self.mesh)
+
+        def step(params, opt_state, feed, rng, num_passes, carried=None):
+            del carried  # rejected at enable time (no prev_batch_state)
+            B = next(iter(feed.values())).value.shape[0]
+            b_loc = B // n_data
+            m_eff = math.gcd(M_cfg, b_loc)  # trace-time constant
+            if m_eff != M_cfg:
+                from paddle_tpu.utils import logger
+                logger.warning(
+                    "pipeline: %d microbatches do not divide the "
+                    "per-device batch (%d rows) — using %d for this "
+                    "shape (bubble fraction rises to %.3f)",
+                    M_cfg, b_loc, m_eff,
+                    (plan.S - 1) / (plan.S + m_eff - 1))
+            fwd = plan.fwd(m_eff, train=True)
+
+            def loss_fn(params, feed, rng):
+                cast_params = self._cast_compute(params)
+                cast_feed = self._cast_compute(feed)
+                x = cast_feed[plan.body_in].value
+                body = {k: cast_params[k] for k in body_names}
+                y = fwd(body, x, rng)
+                head_feed = dict(cast_feed)
+                head_feed[plan.body_out] = Argument(value=y)
+                outputs, updates = head_net.apply_with_state(
+                    cast_params, head_feed, train=True, rng=rng,
+                    mesh=self.mesh)
+                return (self._total_cost(outputs, self._row_mask(feed)),
+                        (outputs, updates))
+
+            (_, (outputs, updates)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, feed, rng)
+            updates = self._cast_f32(updates)
+            row_mask = self._row_mask(feed)
+            bsz = (jnp.sum(row_mask) if row_mask is not None
+                   else outputs[cost_name].value.shape[0])
+            new_params, new_opt = updater.update(
+                grads, opt_state, params, meta, batch_size=bsz,
+                num_passes=num_passes)
+            new_params.update(updates)
+            metrics = self._metrics(outputs, feed)
+            return new_params, new_opt, metrics
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
     def _build_train_step(self):
+        if self._pipe is not None:
+            # the schedule's microbatching subsumes grad_accum_steps
+            # (absorbed in enable_pipeline); accum/carry paths don't apply
+            return self._build_pipe_step()
         network, optimizer, meta = self.network, self.optimizer, self.meta
         # the ZeRO-1 updater is a drop-in for the optimizer's update
         # protocol (optim/zero1.py); everything upstream of the update —
@@ -499,9 +578,13 @@ class SGD:
         network = self.network
 
         def step(params, feed):
-            outputs = network.apply(self._cast_compute(params),
-                                    self._cast_compute(feed), train=False,
-                                    mesh=self.mesh)
+            # under the pipeline the params arrive stage-stacked; the
+            # eval forward runs the plain (unpipelined) graph on the flat
+            # view — jnp slicing, free at trace time
+            outputs = network.apply(
+                self._cast_compute(self._flat_params_view(params)),
+                self._cast_compute(feed), train=False,
+                mesh=self.mesh)
             return self._metrics(outputs, feed)
 
         return jax.jit(step)
@@ -551,8 +634,189 @@ class SGD:
         self.recompile_guard = RecompileGuard(self._train_step,
                                               warn_after=self._recompile_warn)
 
+    # ------------------------------------------------------------ pipeline
+    def enable_pipeline(self, microbatches: Optional[int] = None) -> bool:
+        """Switch to the pipelined train step (``--parallel_nn``): stages
+        derive from the config's per-layer ``device`` attrs
+        (``parallel/pipeline.py:split_pipeline_graph``), body parameters
+        and optimizer slots restructure to stage-stacked arrays sharded
+        one stage per ``pipe`` mesh slot, and the jitted step runs the
+        GPipe microbatch schedule with the cost head replicated on the
+        body output. Gradient-exact vs the unpipelined step (full-batch
+        denominators, clipping/decay once on the whole-batch gradient).
+
+        Returns True when pipelining is active. Any config/mesh shape the
+        schedule cannot honor WARNS and stands down (returns False,
+        training continues unpipelined) — the reference's --parallel_nn
+        likewise degrades to single-device execution when the config pins
+        nothing."""
+        from paddle_tpu.parallel.pipeline import PipelineTrainPlan
+        from paddle_tpu.utils import logger
+        if self._pipe is not None:
+            if microbatches and microbatches != self._pipe_microbatches:
+                self._pipe_microbatches = int(microbatches)
+                self.breakdown.set_pipeline(self._pipe.S,
+                                            self._pipe_microbatches)
+                self._rebuild_train_step()
+            return True
+
+        def stand_down(msg, *args):
+            logger.warning(
+                "pipeline requested but " + msg +
+                " — keeping the unpipelined step", *args)
+            return False
+
+        if self.mesh is None \
+                or mesh_lib.PIPE_AXIS not in self.mesh.axis_names:
+            return stand_down(
+                "the mesh has no %r axis (mesh=%s); build one with "
+                "create_mesh(n_pipe=<n_stages>)", mesh_lib.PIPE_AXIS,
+                dict(self.mesh.shape) if self.mesh is not None else None)
+        if self._carry_layers:
+            return stand_down(
+                "prev_batch_state carries recurrent state across batches; "
+                "the pipeline scan cannot thread it")
+        if "avg" in self.opt_state:
+            return stand_down(
+                "model averaging ('avg' optimizer state) is consumed "
+                "whole at eval/save time and is not stage-stacked")
+        if any(getattr(e, "wants_grad", False)
+               for e, _, _ in self._host_evals):
+            return stand_down(
+                "gradient_printer evaluators probe layer-output gradients "
+                "inside the body; probes do not thread through the "
+                "pipeline scan")
+        try:
+            plan = PipelineTrainPlan(
+                self.topology.graph, self.network, self.params, self.meta,
+                self.mesh, mesh_lib.PIPE_AXIS,
+                n_microbatches=microbatches)
+        except ValueError as e:
+            return stand_down("the config cannot pipeline: %s", e)
+        head_set = set(plan.head)
+        missing_cost = [c for c in self.topology.cost_names
+                        if c not in head_set]
+        if missing_cost:
+            return stand_down(
+                "cost layers %s carry device attrs (staged); the loss is "
+                "not part of the repeated block — leave cost layers "
+                "unpinned", missing_cost)
+        off_head = [n for n in self._eval_layers
+                    if n not in head_set and n != plan.body_out]
+        if off_head:
+            return stand_down(
+                "evaluator inputs %s live inside the pipeline body; only "
+                "the body output and head layers are fetched", off_head)
+        ruled = [n for n in plan.body_pnames
+                 if mesh_lib.rule_for(n, self._shard_rules) != P_spec()]
+        if ruled:
+            return stand_down(
+                "body parameters %s carry shard rules; a stage owns its "
+                "parameters whole (shard the head instead)", ruled[:3])
+        sparse = [n for n in plan.body_pnames
+                  if self.optimizer._is_sparse(self.meta.get(n))]
+        if sparse:
+            return stand_down(
+                "body parameters %s take the sparse lazy update (per-row "
+                "t_rows bookkeeping is not stage-stackable)", sparse[:3])
+
+        # ZeRO-1 must wrap the STACKED layout: unwind it first, re-arm
+        # after (its plan excludes the stacked keys via the pipe rules and
+        # keeps partitioning the replicated head over the data axis)
+        rezero = self._zero1 is not None
+        if rezero:
+            self.disable_zero1()
+        needed = list(dict.fromkeys(
+            list(self.topology.cost_names) + list(self._eval_layers)))
+        self._pipe_head_net = plan.build_head_net(needed)
+        self.params = plan.stack_params(self.params)
+        self.opt_state = plan.stack_opt_state(self.opt_state)
+        self._flat_meta = self.meta
+        self.meta = plan.stacked_meta(self.meta)
+        self._shard_rules = {**(self._shard_rules or {}),
+                             **plan.shard_rules()}
+        self._pipe = plan
+        if microbatches:
+            self._pipe_microbatches = int(microbatches)
+        elif self.grad_accum_steps > 1:
+            # the pipeline's microbatching IS the gradient accumulation
+            # (full-batch denominators, one clip/decay): absorb the knob
+            logger.info(
+                "pipeline: grad_accum_steps=%d absorbed as the microbatch "
+                "count (the schedule accumulates per-microbatch gradients "
+                "with full-batch denominators)", self.grad_accum_steps)
+            self._pipe_microbatches = self.grad_accum_steps
+        else:
+            self._pipe_microbatches = plan.M  # plan default: M = S
+        self.breakdown.set_pipeline(plan.S, self._pipe_microbatches)
+        logger.info(
+            "pipeline enabled: %d stages over the %r axis, %d "
+            "microbatches, %s layout (bubble fraction %.3f)",
+            plan.S, mesh_lib.PIPE_AXIS, self._pipe_microbatches,
+            "stage-stacked" if plan.identical else
+            "heterogeneous (replicated params)",
+            (plan.S - 1) / (plan.S + self._pipe_microbatches - 1))
+        if rezero:
+            self.enable_zero1()
+        self._rebuild_train_step()
+        return True
+
+    def disable_pipeline(self):
+        """Back to the unpipelined step: unstack body parameters and
+        slots to their flat per-stage names, restore rule-driven
+        placement and the flat meta. The inverse of
+        :meth:`enable_pipeline`, so resume and A/B runs cross pipeline
+        on/off freely."""
+        if self._pipe is None:
+            return
+        rezero = self._zero1 is not None
+        if rezero:
+            self.disable_zero1()
+        plan = self._pipe
+        for key in plan.shard_rules():
+            self._shard_rules.pop(key, None)
+        self.params = plan.unstack_params(self.params)
+        self.opt_state = plan.unstack_opt_state(self.opt_state)
+        self.meta = self._flat_meta or self.meta
+        self._flat_meta = None
+        if self.mesh is not None:
+            self.params = mesh_lib.shard_params(self.params, self.mesh,
+                                                self._shard_rules)
+            self.opt_state = mesh_lib.shard_opt_state(
+                self.opt_state, self.mesh, self._shard_rules)
+        self._pipe = None
+        self._pipe_head_net = None
+        self.breakdown.set_pipeline(0, 0)
+        if rezero:
+            self.enable_zero1()
+        self._rebuild_train_step()
+
+    def _flat_params_view(self, params=None):
+        """Flat per-stage view of (possibly stage-stacked) params — jnp
+        slicing, so it works both eagerly and under a trace. Identity
+        when the pipeline is off."""
+        params = self.params if params is None else params
+        if self._pipe is not None:
+            return self._pipe.unstack_params(params)
+        return params
+
     def _configure_step(self, zero1: Optional[bool],
-                        grad_accum_steps: Optional[int]):
+                        grad_accum_steps: Optional[int],
+                        pipeline=None):
+        # pipeline first: zero1 must build its plan over the final
+        # (possibly stage-stacked) parameter layout
+        if pipeline is not None:
+            if pipeline is False or pipeline == 0:
+                # 0 (a CLI-derived int flag) means OFF, same as False —
+                # not "enable with the default microbatch count"
+                self.disable_pipeline()
+            else:
+                mb = None
+                if isinstance(pipeline, dict):
+                    mb = pipeline.get("microbatches")
+                elif pipeline is not True and isinstance(pipeline, int):
+                    mb = pipeline
+                self.enable_pipeline(microbatches=mb)
         if grad_accum_steps is None:   # like zero1=None: keep current —
             # a later train() without the kwarg must not silently drop
             # accumulation (and 8x the activation memory)
@@ -596,12 +860,25 @@ class SGD:
     def _opt_state_for_save(self):
         """Checkpoint view of the optimizer state: with ZeRO-1 active the
         sharded slots are gathered back to their parameters' full shapes,
-        so the file format (keys AND array shapes) is identical to a
-        replicated run's — resume crosses sharded<->replicated modes in
-        both directions."""
+        and with the pipeline active the stage-stacked slot dicts unstack
+        to their flat per-stage names — the file format (keys AND array
+        shapes) never depends on the update path, so resume crosses
+        sharded<->replicated and pipelined<->unpipelined in any
+        combination."""
+        state = self.opt_state
         if self._zero1 is not None:
-            return self._zero1.gather_opt_state(self.opt_state)
-        return self.opt_state
+            state = self._zero1.gather_opt_state(state)
+        if self._pipe is not None:
+            state = self._pipe.unstack_opt_state(state)
+        return state
+
+    def _params_for_save(self):
+        """Checkpoint view of the parameters: stage-stacked body params
+        unstack to the flat per-stage names (``_blk3.w0`` etc.), identical
+        to an unpipelined run's file."""
+        if self._pipe is not None:
+            return self._pipe.unstack_params(self.params)
+        return self.params
 
     def train(self, reader, *, feeder=None, num_passes: int = 1,
               event_handler: Optional[Callable] = None,
@@ -611,7 +888,8 @@ class SGD:
               async_load_data: bool = False, prefetch_depth: int = 2,
               show_step_breakdown: bool = False,
               zero1: Optional[bool] = None,
-              grad_accum_steps: Optional[int] = None):
+              grad_accum_steps: Optional[int] = None,
+              pipeline=None):
         """reader yields minibatches (lists of sample tuples); feeder
         converts them to Arguments (or pass feed dicts directly).
         ``log_period``>0 logs a TrainerStats-style line and dumps+resets the
@@ -652,9 +930,19 @@ class SGD:
         optimizer (and clipping/decay) once on the accumulated gradient —
         effective batch size decouples from per-device activation
         memory. Like ``zero1``, sticky: ``None`` (default) keeps the
-        previously configured value."""
+        previously configured value.
+
+        ``pipeline`` (the reference-spelled ``--parallel_nn`` flag,
+        ``Flags.cpp:23`` / ``ParallelNeuralNetwork.h:23-62``) runs the
+        config's device-attr-staged body through the GPipe microbatch
+        schedule on the mesh's ``pipe`` axis (``enable_pipeline``).
+        ``True`` enables with the default microbatch count (S, or the
+        configured grad_accum_steps), an int or ``{"microbatches": k}``
+        sets it, ``False`` disables (unstacking the body back to flat
+        parameters), ``None`` keeps the current mode. Configs or meshes
+        the schedule cannot honor warn and stand down cleanly."""
         from paddle_tpu.utils import global_stat, logger, timer
-        self._configure_step(zero1, grad_accum_steps)
+        self._configure_step(zero1, grad_accum_steps, pipeline)
         start_pass = 0
         if checkpointer is not None:
             restored = checkpointer.restore()
@@ -784,9 +1072,9 @@ class SGD:
                                     lname, st["avg_abs"], st["max_abs"])
                     event_handler(ev.EndIteration(pass_id, batch_id, cost, evals))
                     if checkpointer is not None:
-                        # the callable defers the (device-op) ZeRO-1 slot
-                        # gather to saves that are actually due
-                        checkpointer.maybe_save(self.params,
+                        # the callables defer the (device-op) ZeRO-1 slot
+                        # gather / pipeline unstack to saves actually due
+                        checkpointer.maybe_save(self._params_for_save,
                                                 self._opt_state_for_save,
                                                 pass_id=pass_id,
                                                 batch_id=batch_id + 1)
@@ -825,7 +1113,7 @@ class SGD:
             event_handler(ev.EndPass(
                 pass_id, {**acc.result(), **self.host_eval_values()}))
             if checkpointer is not None:
-                checkpointer.maybe_save(self.params,
+                checkpointer.maybe_save(self._params_for_save,
                                         self._opt_state_for_save,
                                         pass_id=pass_id, end_of_pass=True)
 
@@ -839,7 +1127,13 @@ class SGD:
         """Install restored parameters (+ optionally a flattened optimizer
         state as produced by checkpoint.load_params): values are cast and
         re-placed with each current array's sharding, so resuming under a
-        mesh keeps tables sharded."""
+        mesh keeps tables sharded. Checkpoints always arrive in the flat
+        per-stage format (``_params_for_save``); a pipelined run restacks
+        them into its stage-stacked layout here — resume crosses pipeline
+        on/off in both directions."""
+        if self._pipe is not None:
+            params, opt_flat = self._pipe.restack_checkpoint(params,
+                                                             opt_flat)
 
         def place(new, old):
             arr = jnp.asarray(new, dtype=old.dtype)
@@ -1004,14 +1298,15 @@ class SGD:
                         and jnp.issubdtype(a.value.dtype, jnp.inexact)}
 
             self._layer_stat_fn = stat_fn
-        raw = jax.device_get(self._layer_stat_fn(self.params, feed))
+        raw = jax.device_get(self._layer_stat_fn(self._flat_params_view(),
+                                                 feed))
         return {n: {"avg_abs": float(a), "max_abs": float(m)}
                 for n, (a, m) in raw.items()}
 
     # ------------------------------------------------------------ forward
     def forward(self, feed, output_names: Optional[List[str]] = None):
-        outputs = self.network.apply(self.params, feed, train=False,
-                                     mesh=self.mesh)
+        outputs = self.network.apply(self._flat_params_view(), feed,
+                                     train=False, mesh=self.mesh)
         if output_names is None:
             return outputs
         return {n: outputs[n] for n in output_names}

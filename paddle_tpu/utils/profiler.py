@@ -106,6 +106,33 @@ def memory_status(params, opt_state=None) -> str:
     return f"DeviceMemory(per-device): {parts}"
 
 
+def pipeline_bubble_stats(n_stages: int, n_microbatches: int) -> dict:
+    """GPipe schedule occupancy accounting (``parallel/pipeline.py``).
+
+    The fill-drain schedule runs ``S + M - 1`` ticks; stage ``s`` computes
+    a real microbatch on M of them and idles ``s`` ticks while the pipe
+    fills plus ``S - 1 - s`` while it drains — so every stage idles
+    exactly ``S - 1`` microbatch slots of the ``S + M - 1`` total, and the
+    per-stage bubble fraction (idle slots / total slots) is the classic
+    ``(S-1)/(S+M-1)``, uniform across stages. The backward pipeline
+    (``jax.grad`` of the scan) replays the drain in reverse, doubling both
+    numerator and denominator — the fraction is unchanged, which is why
+    one number serves the whole step."""
+    S, M = int(n_stages), int(n_microbatches)
+    ticks = S + M - 1
+    # per-stage idle is s (fill) + S-1-s (drain) = S-1 for EVERY stage:
+    # the per-stage list is uniform by construction, kept as a list so
+    # bench consumers get one entry per stage
+    per_stage = [(S - 1) / ticks] * S
+    return {
+        "pipeline_stages": S,
+        "pipeline_microbatches": M,
+        "pipeline_ticks": ticks,
+        "pipeline_bubble_frac": (S - 1) / ticks,
+        "pipeline_bubble_frac_per_stage": per_stage,
+    }
+
+
 class StepBreakdown:
     """Per-step host-side wall-time split.
 
@@ -137,6 +164,16 @@ class StepBreakdown:
         self.steps = 0
         self.wall = 0.0  # true per-step wall time, when the caller times it
         self.totals = {p: 0.0 for p in self.PARTS}
+        # set by SGD.enable_pipeline; reset() survives it (a pass reset
+        # must not silently drop the schedule identity from summaries)
+        if not hasattr(self, "pipeline"):
+            self.pipeline = None
+
+    def set_pipeline(self, n_stages: int, n_microbatches: int):
+        """Record the active GPipe schedule so ``summary()`` carries the
+        bubble-fraction estimate next to steps/s (None disables)."""
+        self.pipeline = ((int(n_stages), int(n_microbatches))
+                         if n_stages else None)
 
     def add(self, part: str, seconds: float):
         self.totals[part] += seconds
@@ -171,6 +208,8 @@ class StepBreakdown:
             out[f"{p}_frac"] = (self.totals[p] / total) if total > 0 else 0.0
             out[f"{p}_ms_per_step"] = (
                 1e3 * self.totals[p] / self.steps if self.steps else 0.0)
+        if self.pipeline is not None:
+            out.update(pipeline_bubble_stats(*self.pipeline))
         return out
 
     def status(self) -> str:
@@ -178,5 +217,10 @@ class StepBreakdown:
         parts = " ".join(
             f"{p}={s[f'{p}_ms_per_step']:.2f}ms({s[f'{p}_frac'] * 100:.1f}%)"
             for p in self.PARTS)
+        pipe = ""
+        if self.pipeline is not None:
+            pipe = (f" pipeline=S{s['pipeline_stages']}/M"
+                    f"{s['pipeline_microbatches']}"
+                    f" bubble={s['pipeline_bubble_frac'] * 100:.1f}%")
         return (f"StepBreakdown: steps={self.steps} "
-                f"steps/s={s['steps_per_sec']:.3f} {parts}")
+                f"steps/s={s['steps_per_sec']:.3f} {parts}{pipe}")

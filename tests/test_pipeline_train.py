@@ -1,0 +1,285 @@
+"""Pipeline parallelism through the TRAINING loop (`--parallel_nn`):
+the GPipe schedule runs forward+backward+optimizer-update inside
+`SGD._train_step` with loss-curve parity vs the unpipelined step,
+composes with ZeRO-1, and checkpoints cross pipeline on/off both ways.
+
+Closure: the parity matrix below MUST cover ≥2 stage counts plus an
+uneven (heterogeneous) split — enforced by `test_parity_matrix_closure`
+so a future stage-count addition cannot silently drop a layout."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.config import dsl
+from paddle_tpu.core.argument import Argument
+from paddle_tpu.optim import Adam, Momentum
+from paddle_tpu.parallel import create_mesh
+from paddle_tpu.trainer import SGD, events
+
+WIDTH, CLASSES, B = 12, 3, 16
+
+# (stage_count, layers_per_stage list) — uneven rows take the
+# heterogeneous (lax.switch, replicated-params) path
+PARITY_MATRIX = [
+    ("s2", [1, 1]),
+    ("s4", [1, 1, 1, 1]),
+    ("s2_uneven", [2, 1]),
+]
+
+
+def _build(mesh, split, opt=None, seed=0):
+    dsl.reset()
+    x = dsl.data(name="x", size=WIDTH)
+    lbl = dsl.data(name="label", size=CLASSES)
+    h = x
+    for s, n_layers in enumerate(split):
+        for j in range(n_layers):
+            h = dsl.fc(input=h, size=WIDTH, act="tanh", name=f"blk{s}_{j}",
+                       layer_attr={"device": s})
+    out = dsl.fc(input=h, size=CLASSES, act="softmax", name="out")
+    cost = dsl.classification_cost(input=out, label=lbl)
+    return SGD(cost=cost,
+               update_equation=opt or Adam(learning_rate=3e-3),
+               mesh=mesh, seed=seed)
+
+
+def _reader():
+    rng = np.random.RandomState(7)
+    X = rng.randn(2 * B, WIDTH).astype(np.float32)
+    W = rng.randn(WIDTH, CLASSES)
+    Y = np.argmax(X @ W, axis=1).astype(np.int32)
+
+    def reader():
+        for i in range(0, 2 * B, B):
+            yield {"x": Argument(value=jnp.asarray(X[i:i + B])),
+                   "label": Argument(value=jnp.asarray(Y[i:i + B]))}
+
+    return reader
+
+
+def _train(trainer, reader, passes=2, **kw):
+    costs = []
+    trainer.train(reader, num_passes=passes,
+                  event_handler=lambda e: costs.append(e.cost)
+                  if isinstance(e, events.EndIteration) else None, **kw)
+    return costs
+
+
+def test_parity_matrix_closure():
+    splits = [s for _, s in PARITY_MATRIX]
+    assert len({len(s) for s in splits}) >= 2, "need >= 2 stage counts"
+    assert any(len(set(s)) > 1 for s in splits), "need an uneven split"
+
+
+@pytest.mark.parametrize("tag,split", PARITY_MATRIX,
+                         ids=[t for t, _ in PARITY_MATRIX])
+def test_pipelined_training_matches_unpipelined(tag, split):
+    """Loss-curve parity over two passes: the pipelined step (DP x PP
+    mesh) reproduces the unpipelined run's costs to float tolerance —
+    full-batch denominators, one optimizer application."""
+    reader = _reader()
+    S = len(split)
+    tr_pipe = _build(create_mesh(n_data=2, n_pipe=S), split)
+    cs_pipe = _train(tr_pipe, reader, pipeline=True)
+    assert tr_pipe._pipe is not None, "pipeline stood down unexpectedly"
+    assert tr_pipe._pipe.identical == (len(set(split)) == 1)
+    tr_ref = _build(None, split)
+    cs_ref = _train(tr_ref, reader)
+    np.testing.assert_allclose(cs_pipe, cs_ref, rtol=2e-5, atol=2e-6)
+    # trained parameters agree too (checkpoint view is flat both ways)
+    flat = tr_pipe._params_for_save()
+    for k, v in tr_ref.params.items():
+        np.testing.assert_allclose(np.asarray(flat[k]), np.asarray(v),
+                                   rtol=2e-4, atol=2e-5, err_msg=k)
+
+
+def test_stacked_params_shard_one_stage_per_slot():
+    """The fast path stores body params stage-stacked with the leading
+    dim over the pipe axis: each mesh slot holds ONE stage's parameters
+    (and optimizer slots) — 1/S of the body state per device."""
+    tr = _build(create_mesh(n_data=2, n_pipe=4), [1, 1, 1, 1])
+    tr.train(_reader(), num_passes=1, pipeline=True)
+    stacked = tr.params["_blk0_0.w0"]
+    assert stacked.shape == (4, WIDTH, WIDTH)
+    assert "pipe" in str(stacked.sharding.spec), stacked.sharding
+    mom = tr.opt_state["slots"]["_blk0_0.w0"]["mom"]
+    assert mom.shape == (4, WIDTH, WIDTH)
+    assert "pipe" in str(mom.sharding.spec), mom.sharding
+    # per-stage names are absorbed into the stack
+    assert "_blk1_0.w0" not in tr.params
+    # and the step breakdown carries the bubble accounting
+    s = tr.step_breakdown()
+    assert s["pipeline_stages"] == 4
+    assert s["pipeline_bubble_frac"] == pytest.approx(3 / 7)
+    assert len(s["pipeline_bubble_frac_per_stage"]) == 4
+
+
+def test_pipeline_composes_with_zero1():
+    """pipeline=True + zero1=True: stacked body slots stay stage-sharded
+    (excluded from the ZeRO-1 plan via the pipe rules), the head's slots
+    partition over the data axis, and the result still matches the plain
+    replicated run."""
+    reader = _reader()
+    tr = _build(create_mesh(n_data=4, n_pipe=2), [1, 1])
+    cs = _train(tr, reader, pipeline=True, zero1=True)
+    assert tr._pipe is not None and tr._zero1 is not None
+    # stacked keys excluded from the ZeRO-1 plan; head params planned
+    assert not any(k in tr._zero1.plan for k in tr._pipe.stacked_map)
+    assert "_out.w0" in tr._zero1.plan
+    tr_ref = _build(None, [1, 1])
+    cs_ref = _train(tr_ref, reader)
+    np.testing.assert_allclose(cs, cs_ref, rtol=2e-5, atol=2e-6)
+
+
+def test_checkpoint_crosses_pipeline_on_off_both_ways(tmp_path):
+    """A pipelined run's checkpoint resumes unpipelined and vice versa:
+    the on-disk format is always the flat per-stage one, restacked on
+    load when the pipeline is active."""
+    from paddle_tpu.dist.checkpoint import Checkpointer
+    reader = _reader()
+    tr1 = _build(create_mesh(n_data=2, n_pipe=2), [1, 1])
+    _train(tr1, reader, pipeline=True)
+    Checkpointer(str(tmp_path)).save(
+        tr1._params_for_save, tr1._opt_state_for_save,
+        pass_id=0, end_of_pass=True)
+    flat1 = {k: np.asarray(v) for k, v in tr1._params_for_save().items()}
+
+    # pipelined -> unpipelined
+    tr2 = _build(None, [1, 1])
+    params, opt_flat, _ = Checkpointer(str(tmp_path)).restore()
+    tr2.load_state(params, opt_flat)
+    for k, v in tr2.params.items():
+        np.testing.assert_allclose(np.asarray(v), flat1[k], err_msg=k)
+
+    # unpipelined (flat format) -> pipelined: restack on load
+    tr3 = _build(create_mesh(n_data=2, n_pipe=2), [1, 1])
+    assert tr3.enable_pipeline()
+    params, opt_flat, _ = Checkpointer(str(tmp_path)).restore()
+    tr3.load_state(params, opt_flat)
+    flat3 = tr3._params_for_save()
+    for k in flat1:
+        np.testing.assert_allclose(np.asarray(flat3[k]), flat1[k],
+                                   err_msg=k)
+    # both resumed runs continue with identical losses
+    c2 = _train(tr2, reader, passes=1)
+    c3 = _train(tr3, reader, passes=1)
+    np.testing.assert_allclose(c2, c3, rtol=2e-5, atol=2e-6)
+
+
+def test_pipeline_stands_down_cleanly():
+    """No device attrs / no pipe axis: enable_pipeline warns and returns
+    False; training proceeds unpipelined (the --parallel_nn contract)."""
+    dsl.reset()
+    x = dsl.data(name="x", size=WIDTH)
+    lbl = dsl.data(name="label", size=CLASSES)
+    out = dsl.fc(input=x, size=CLASSES, act="softmax", name="out")
+    cost = dsl.classification_cost(input=out, label=lbl)
+    tr = SGD(cost=cost, update_equation=Momentum(learning_rate=0.1),
+             mesh=create_mesh(n_data=2, n_pipe=2))
+    assert tr.enable_pipeline() is False  # no device attrs
+    assert tr._pipe is None
+
+    # device attrs but a mesh with no pipe axis
+    tr2 = _build(create_mesh(n_data=2), [1, 1])
+    assert tr2.enable_pipeline() is False
+    cs = _train(tr2, _reader(), passes=1, pipeline=True)  # still trains
+    assert np.isfinite(cs).all()
+
+    # stage count != pipe-axis width
+    tr3 = _build(create_mesh(n_data=2, n_pipe=4), [1, 1])
+    assert tr3.enable_pipeline() is False
+
+
+def test_parallel_nn_cli_trains_with_parity(tmp_path, capsys):
+    """A reference-style config with per-layer device attrs trains
+    through `trainer/cli.py --parallel_nn` and its final pass matches the
+    unflagged run (acceptance criterion of ISSUE r08)."""
+    cfg = tmp_path / "pipe_cfg.py"
+    cfg.write_text("""
+import numpy as np
+import jax.numpy as jnp
+from paddle_tpu.config import dsl
+from paddle_tpu.core.argument import Argument
+from paddle_tpu.optim import Momentum
+
+x = dsl.data(name="x", size=16)
+lbl = dsl.data(name="label", size=4)
+h = x
+for s in range(2):
+    h = dsl.fc(input=h, size=16, act="tanh", name=f"blk{s}",
+               layer_attr={"device": s})
+out = dsl.fc(input=h, size=4, act="softmax", name="out")
+cost = dsl.classification_cost(input=out, label=lbl)
+optimizer = Momentum(learning_rate=0.1, momentum=0.9)
+_rng = np.random.RandomState(0)
+_X = _rng.randn(32, 16).astype(np.float32)
+_W = _rng.randn(16, 4)
+_Y = np.argmax(_X @ _W, axis=1).astype(np.int32)
+def train_reader():
+    for i in (0, 16):
+        yield {"x": Argument(value=jnp.asarray(_X[i:i+16])),
+               "label": Argument(value=jnp.asarray(_Y[i:i+16]))}
+""")
+    from paddle_tpu.trainer import cli
+
+    def final_err(argv):
+        rc = cli.main(argv)
+        assert rc == 0
+        out = capsys.readouterr().out
+        last = [ln for ln in out.splitlines() if ln.startswith("Pass 2")][0]
+        return float(last.split("classification_error=")[1].split()[0])
+
+    base = ["--config", str(cfg), "--job", "train", "--num_passes", "3"]
+    err_pipe = final_err(base + ["--parallel_nn",
+                                 "--pipeline_microbatches", "4"])
+    err_ref = final_err(base)
+    assert err_pipe == pytest.approx(err_ref, abs=1e-6)
+
+
+def test_dsl_pipeline_stage_scope():
+    """`with dsl.pipeline_stage(s):` stamps device attrs without
+    per-layer spelling; explicit attrs win; data layers are exempt; the
+    result derives the same stages as the explicit form."""
+    from paddle_tpu.parallel.pipeline import split_pipeline_graph
+    dsl.reset()
+    x = dsl.data(name="x", size=8)
+    lbl = dsl.data(name="label", size=2)
+    with dsl.pipeline_stage(0):
+        h = dsl.fc(input=x, size=8, act="tanh", name="a0")
+        h = dsl.fc(input=h, size=8, act="tanh", name="a1")
+    with dsl.pipeline_stage(1):
+        h = dsl.fc(input=h, size=8, act="tanh", name="b0",
+                   layer_attr={"device": 1})  # explicit agrees
+    out = dsl.fc(input=h, size=2, act="softmax", name="out")
+    dsl.classification_cost(input=out, label=lbl, name="cost")
+    g = dsl.current_graph()
+    assert g.layers["a0"].attrs["device"] == 0
+    assert g.layers["b0"].attrs["device"] == 1
+    assert "device" not in g.layers["x"].attrs
+    assert g.layers["out"].attrs.get("device") is None
+    stages, head = split_pipeline_graph(g)
+    assert stages == [["a0", "a1"], ["b0"]]
+    assert head == ["out", "cost"]
+    dsl.reset()  # scope must not leak
+    assert dsl._DEVICE_SCOPE is None
+
+
+def test_pipeline_microbatch_gcd_fallback():
+    """A batch the configured M doesn't divide scans fewer microbatches
+    for that shape instead of crashing (same contract as
+    grad_accum_steps' tail-batch handling)."""
+    tr = _build(create_mesh(n_data=1, n_pipe=2), [1, 1])
+    rng = np.random.RandomState(3)
+
+    def reader():
+        for b in (12, 10):  # second batch: 10 % 4 != 0 -> gcd(4,10)=2
+            yield {"x": Argument(value=jnp.asarray(
+                rng.randn(b, WIDTH).astype(np.float32))),
+                "label": Argument(value=jnp.asarray(
+                    rng.randint(0, CLASSES, b).astype(np.int32)))}
+
+    cs = _train(tr, reader, passes=1, pipeline={"microbatches": 4})
+    assert len(cs) == 2 and np.isfinite(cs).all()

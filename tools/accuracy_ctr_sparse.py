@@ -1,0 +1,190 @@
+"""Sparse-updater CTR accuracy evidence (ACCURACY_r08.json).
+
+VERDICT r05 Missing #4: the r05 CTR entry trained `trainer_config.lr.py`
+DENSELY on a 209-sentence CoNLL proxy; BASELINE config #5 is "quick_start
+CTR ... with sparse updater". This run replaces that proxy entry:
+
+- **model**: `models/ctr.py:ctr_model` — the quick_start CTR family
+  (word-id sequence -> embedding -> average pooling -> fc -> binary
+  classification) with the embedding flagged ``sparse_grad=True`` (the
+  reference's ``sparse_update`` ParamAttr, `SparseRowMatrix.h:204`,
+  `RemoteParameterUpdater.h:265`), selecting the lazy touched-rows-only
+  optimizer path end to end;
+- **corpus**: REAL Amazon product reviews — the quick_start demo's
+  actual dataset family (its fetch script downloads Amazon review
+  polarity; this host has the McAuley 2014 dump checked in at
+  /root/datasets/amazon_reviews). Musical Instruments 5-core split,
+  binary sentiment (overall>=4 positive, <=2 negative, 3s dropped — the
+  demo's polarity convention), with a held-out test split;
+- **metric**: held-out classification error per pass.
+
+The multichip dryrun (`__graft_entry__.py`) runs the SAME config
+row-sharded over the model axis ("sparse CTR step OK, table row-sharded
+N-way" in MULTICHIP_r08.json) — together: accuracy on real data through
+the sparse path + sharded execution of the identical model.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+CORPUS = ("/root/datasets/amazon_reviews/untarred/data_dir/5core/"
+          "reviews_Amazon_Instant_Video_5.json")
+OUT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "ACCURACY_r08.json")
+
+VOCAB = 5000
+MAX_LEN = 64
+N_TRAIN, N_TEST = 6000, 1500
+BATCH = 100
+PASSES = int(os.environ.get("CTR_PASSES", "25"))
+
+
+def load_corpus():
+    """(texts, labels) — balanced-ish binary sentiment from the 5-core
+    reviews; deterministic order."""
+    import numpy as np
+    texts, labels = [], []
+    with open(CORPUS) as f:
+        for line in f:
+            r = json.loads(line)
+            overall = r.get("overall", 3.0)
+            if overall == 3.0:
+                continue  # the demo's polarity convention drops neutral
+            texts.append(r.get("reviewText", "") or "")
+            labels.append(1 if overall >= 4.0 else 0)
+            if len(texts) >= 4 * (N_TRAIN + N_TEST):
+                break
+    order = np.random.RandomState(0).permutation(len(texts))
+    # 5-core reviews skew positive ~85/15: subsample positives so the
+    # error metric cannot be gamed by the majority class
+    neg = [i for i in order if labels[i] == 0]
+    pos = [i for i in order if labels[i] == 1][:2 * len(neg)]
+    keep = list(np.random.RandomState(1).permutation(neg + pos))
+    keep = keep[:N_TRAIN + N_TEST]
+    return [texts[i] for i in keep], [labels[i] for i in keep]
+
+
+def tokenize(text):
+    return re.findall(r"[a-z']+", text.lower())[:MAX_LEN]
+
+
+def build_dict(texts):
+    from collections import Counter
+    c = Counter(w for t in texts for w in tokenize(t))
+    # id 0..VOCAB-1; OOV words drop (DataFeeder validates ids)
+    return {w: i for i, (w, _) in enumerate(c.most_common(VOCAB))}
+
+
+def main():
+    t0 = time.time()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from paddle_tpu.config import dsl
+    from paddle_tpu.data import (DataFeeder, integer_value,
+                                 integer_value_sequence)
+    from paddle_tpu.models import ctr_model
+    from paddle_tpu.optim import Momentum
+    from paddle_tpu.trainer import SGD
+
+    texts, labels = load_corpus()
+    # the balanced subset may be smaller than the nominal split (5-core
+    # reviews skew heavily positive): hold out 1/5, cap at N_TEST
+    n_test = min(N_TEST, len(texts) // 5)
+    n_train = len(texts) - n_test
+    vocab = build_dict(texts[:n_train])
+    n_neg = labels[:n_train].count(0)
+
+    def encode(t):
+        ids = [vocab[w] for w in tokenize(t) if w in vocab]
+        return ids or [0]
+
+    train = [(encode(t), l) for t, l in zip(texts[:n_train],
+                                            labels[:n_train])]
+    test = [(encode(t), l) for t, l in zip(texts[n_train:],
+                                           labels[n_train:])]
+
+    dsl.reset()
+    cost, out, _ = ctr_model(vocab_size=VOCAB, embed_dim=32, hidden=64,
+                             classes=2)
+    # Momentum: the optimizer family whose sparse_update has the lazy
+    # touched-rows path (the reference's SparseMomentumParameterOptimizer,
+    # FirstOrderOptimizer.h:64-122) — the point of this run
+    trainer = SGD(cost=cost,
+                  update_equation=Momentum(learning_rate=0.05,
+                                           momentum=0.9),
+                  seed=0)
+    spec = trainer.meta["_embed.w0"]
+    assert spec.sparse_grad, "embedding lost its sparse_update flag"
+    assert "t_rows" in trainer.opt_state["slots"]["_embed.w0"], \
+        "sparse table did not take the lazy touched-rows path"
+
+    feeder = DataFeeder({"words": integer_value_sequence(VOCAB),
+                         "label": integer_value(2)}, pad_multiple=MAX_LEN)
+
+    def reader(data):
+        def r():
+            for i in range(0, len(data) - BATCH + 1, BATCH):
+                yield data[i:i + BATCH]
+        return r
+
+    history = []
+    for p in range(PASSES):
+        trainer.train(reader(train), feeder=feeder, num_passes=1)
+        res = trainer.test(reader(test), feeder=feeder)
+        err = res.evaluator.get("classification_error")
+        history.append(round(float(err), 5))
+        print(f"pass {p}: heldout_error={err:.4f}", flush=True)
+
+    entry = {
+        "config": "models/ctr.py:ctr_model (the quick_start CTR family: "
+                  "embedding(sparse_update=True) -> avg pooling -> fc -> "
+                  "binary classification; lazy touched-rows optimizer "
+                  "path asserted on _embed.w0)",
+        "corpus": "REAL Amazon product reviews (McAuley 2014, Instant "
+                  "Video 5-core) — the quick_start demo's actual "
+                  "dataset family; binary sentiment (>=4 pos, <=2 neg, "
+                  "3s dropped), positives subsampled 2:1",
+        "sparse_update": True,
+        "rc": 0,
+        "passes": PASSES,
+        "vocab": VOCAB,
+        "train_samples": len(train),
+        "heldout_samples": len(test),
+        "train_neg_fraction": round(n_neg / max(n_train, 1), 3),
+        "heldout_error_by_pass": history,
+        "final_heldout_error": history[-1],
+        "best_heldout_error": min(history),
+        "majority_class_error": round(
+            min(n_neg, n_train - n_neg) / max(n_train, 1), 3),
+        "dryrun_row_sharded": "MULTICHIP_r08.json: 'sparse CTR step OK, "
+                              "table row-sharded 4-way' runs the same "
+                              "ctr_model over the (data, model) mesh",
+        "wall_s": round(time.time() - t0, 1),
+    }
+    doc = {
+        "platform": "cpu",
+        "note": "r08 replaces the r05 dense CoNLL-proxy CTR entry "
+                "(VERDICT Missing #4): the sparse updater now trains the "
+                "quick_start CTR shape on its real corpus family with a "
+                "held-out metric. Other r05 entries (MNIST, rnn_crf, "
+                "seq2seq) are unchanged and live in ACCURACY_r05.json.",
+        "quick_start_ctr_sparse": entry,
+    }
+    with open(OUT, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps(entry)[:400], flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
