@@ -517,6 +517,147 @@ def bench_pipeline(batches=None, batch_size=64, hidden=256, n_stages=4,
     }
 
 
+def bench_serving(n_requests=None, rounds=None):
+    """Serving A/B: the SAME LSTM-classifier deploy model behind the
+    dynamic micro-batching engine (max_batch=8, small coalesce window)
+    vs batch-size-1 serving (max_batch=1 — every request its own device
+    launch), under an identical synthetic OPEN-LOOP load (arrivals on a
+    fixed clock, independent of completions — the regime where queueing
+    either explodes or doesn't). Interleaved best-of-R per CLAUDE.md's
+    host-drift rule. Reports completed-requests/s and the p50/p99 total
+    latency from the serving metrics plane, plus batch occupancy and the
+    guard-asserted compile count. The offered rate is calibrated to ~2x
+    the measured single-request service rate, so the unbatched mode MUST
+    queue: batching's win is throughput at *bounded* p99, not a faster
+    single request. CPU-runnable (``python bench.py --serving`` ->
+    BENCH_r09.json); rides along as a TPU child extra."""
+    import numpy as np
+    from paddle_tpu.config import dsl
+    from paddle_tpu.data import integer_value, integer_value_sequence
+    from paddle_tpu.models import lstm_text_classifier
+    from paddle_tpu.serving import ServingEngine, ServingPredictor
+    from paddle_tpu.trainer.trainer import Topology
+
+    n_requests = int(os.environ.get("BENCH_SERVE_REQUESTS", "64")
+                     if n_requests is None else n_requests)
+    rounds = int(os.environ.get("BENCH_SERVE_ROUNDS", "3")
+                 if rounds is None else rounds)
+    vocab, seqlen = 1000, 32
+    dsl.reset()
+    cost, out, _ = lstm_text_classifier(
+        vocab_size=vocab, embed_dim=32, hidden=48, num_layers=1, classes=2)
+    topo = Topology(cost)
+    import jax
+    net = topo.network
+    params = net.init_params(jax.random.PRNGKey(0))
+    feeding = {"words": integer_value_sequence(vocab),
+               "label": integer_value(2)}
+    rng = np.random.RandomState(0)
+
+    def mk_sample():
+        return (list(rng.randint(0, vocab, size=seqlen)),
+                int(rng.randint(0, 2)))
+
+    samples = [mk_sample() for _ in range(n_requests)]
+
+    def build(max_batch):
+        pred = ServingPredictor(
+            topo.graph, params, [out.name], feeding,
+            batch_buckets=[b for b in (1, 2, 4, 8) if b <= max_batch],
+            length_buckets=[seqlen])
+        eng = ServingEngine(pred, max_batch=max_batch,
+                            batch_timeout_ms=2.0,
+                            queue_depth=n_requests + 8)
+        eng.start(warmup=True)
+        return eng
+
+    engines = {"batched": build(8), "unbatched": build(1)}
+
+    # calibrate the open-loop rate off the UNBATCHED engine's sequential
+    # service time (max_batch=1 dispatches immediately, so this is the
+    # true per-request cost with no coalescing window in it); offer ~2x
+    # that rate to both modes — the regime where batch-size-1 serving
+    # must queue and dynamic batching must absorb
+    t0 = time.perf_counter()
+    for _ in range(10):
+        engines["unbatched"].infer(samples[0])
+    single_ms = (time.perf_counter() - t0) / 10 * 1e3
+    interval = single_ms / 1e3 / 2.0
+    # fresh metrics for BOTH modes so the published p50/p99/occupancy
+    # reflect only the measured open-loop rounds (the 10 zero-queue
+    # calibration requests would otherwise skew the unbatched reservoir)
+    from paddle_tpu.serving import ServingMetrics
+    for eng in engines.values():
+        eng.metrics = ServingMetrics()
+
+    def run(eng):
+        from paddle_tpu.serving import ServingError
+        reqs = []
+        t_start = time.perf_counter()
+        for i, s in enumerate(samples):
+            target = t_start + i * interval
+            now = time.perf_counter()
+            if target > now:
+                time.sleep(target - now)
+            try:
+                reqs.append(eng.submit(s))
+            except ServingError:
+                # shed / dead worker: not-ok, but the A/B must still
+                # finish and report (a dead engine reads as ~zero
+                # throughput + its fatal in hot_path_recompiles)
+                pass
+        answered = [r.event.wait(120.0) for r in reqs]
+        done = time.perf_counter()
+        # only requests that were actually ANSWERED cleanly count — a
+        # hung/dead engine must read as zero throughput, not success
+        ok = sum(1 for got, r in zip(answered, reqs)
+                 if got and r.error is None)
+        return ok / (done - t_start)
+
+    best = {}
+    for _ in range(rounds):
+        for mode, eng in engines.items():
+            tput = run(eng)
+            best[mode] = max(best.get(mode, 0.0), tput)
+    res = {"serving_requests": n_requests,
+           "serving_open_loop_interval_ms": round(interval * 1e3, 3),
+           "serving_batched_rps": round(best["batched"], 2),
+           "serving_unbatched_rps": round(best["unbatched"], 2),
+           "serving_batched_vs_unbatched_rps": round(
+               best["batched"] / max(best["unbatched"], 1e-9), 3)}
+    for mode, eng in engines.items():
+        snap = eng.metrics.snapshot()
+        lat = snap["latency_ms"]["total"]
+        res[f"serving_{mode}_p50_ms"] = lat["p50_ms"]
+        res[f"serving_{mode}_p99_ms"] = lat["p99_ms"]
+        res[f"serving_{mode}_queue_wait_p99_ms"] = (
+            snap["latency_ms"]["queue_wait"]["p99_ms"])
+        res[f"serving_{mode}_occupancy"] = snap["batch_occupancy"]["mean"]
+        res[f"serving_{mode}_batches"] = snap["batches_total"]
+        # the hardened guard raises (killing the worker) on any hot-path
+        # compile — a clean worker proves zero; a dead one is recorded
+        res[f"serving_{mode}_hot_path_recompiles"] = (
+            0 if eng.fatal is None else repr(eng.fatal)[:120])
+        eng.shutdown()
+    return res
+
+
+def serving_main():
+    """``python bench.py --serving``: the off-tunnel serving A/B alone,
+    forced onto CPU; one JSON line, mirrored to BENCH_r09.json."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    result = {"metric": "serving_dynamic_batching_ab",
+              "platform": jax.devices()[0].platform}
+    result.update(bench_serving())
+    line = json.dumps(result)
+    print(line, flush=True)
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, "BENCH_r09.json"), "w") as f:
+        f.write(line + "\n")
+    return 0
+
+
 def pipeline_main():
     """``python bench.py --pipeline``: the off-tunnel pipeline A/B alone,
     forced onto an 8-virtual-device CPU mesh; one JSON line, mirrored to
@@ -647,6 +788,9 @@ def child_main():
     # hand-off overlaps compute, so this is where the schedule's win can
     # actually show (off-tunnel number: BENCH_r08.json via --pipeline)
     extra("pipeline", bench_pipeline)
+    # serving A/B over the real chip: dynamic batching vs batch-size-1
+    # (off-tunnel number: BENCH_r09.json via --serving)
+    extra("serving", bench_serving)
     return 0
 
 
@@ -657,6 +801,8 @@ def main():
         return zero1_main()
     if "--pipeline" in sys.argv[1:]:
         return pipeline_main()
+    if "--serving" in sys.argv[1:]:
+        return serving_main()
     if os.environ.get("BENCH_CHILD") == "1":
         return child_main()
 

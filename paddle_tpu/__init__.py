@@ -27,6 +27,7 @@ from paddle_tpu import optim  # noqa: F401
 from paddle_tpu import parallel  # noqa: F401
 from paddle_tpu import trainer  # noqa: F401
 from paddle_tpu import models  # noqa: F401
+from paddle_tpu import serving  # noqa: F401
 
 __version__ = "0.1.0"
 

@@ -867,6 +867,7 @@ class SequenceGenerator:
         self._eos = end_id
         self._max_length = int(max_length)
         self._beam_size = int(beam_size)
+        self._hooks = {}  # registerBeamSearchControlCallbacks
         self._built = None  # (engine generator, encoder Network)
 
     # -- setters (PaddleAPI.h:1040-1044) --------------------------------
@@ -886,6 +887,28 @@ class SequenceGenerator:
 
     def setBeamSize(self, beamSize):
         self._beam_size = int(beamSize)
+
+    # -- beam-control callbacks (RecurrentGradientMachine.h:92-145) -----
+    def registerBeamSearchControlCallbacks(self, candidate_adjust=None,
+                                           drop_callback=None,
+                                           norm_or_drop=None,
+                                           stop_beam_search=None):
+        """``RecurrentGradientMachine::registerBeamSearchControlCallbacks``
+        surfaced on the generator handle. Registered hooks MERGE with
+        the config's pinned ones (``dsl.beam_search``): a hook passed
+        here wins for its slot; a slot left ``None`` keeps the
+        config-pinned hook (to disable a pinned hook, rebuild the config
+        without it). Signatures in
+        ``core/generation.py:SequenceGenerator.generate``."""
+        self._hooks = {"candidate_adjust": candidate_adjust,
+                       "drop_callback": drop_callback,
+                       "norm_or_drop": norm_or_drop,
+                       "stop_beam_search": stop_beam_search}
+
+    def removeBeamSearchControlCallbacks(self):
+        """``removeBeamSearchControlCallbacks``: back to the config's
+        pinned hooks (or none)."""
+        self._hooks = {}
 
     # -------------------------------------------------------------------
     def _build(self):
@@ -931,7 +954,8 @@ class SequenceGenerator:
         tokens, scores, lengths = engine.generate(
             m._params, outer,
             beam_size=self._beam_size if self._beam_size > 0 else None,
-            max_length=self._max_length)
+            max_length=self._max_length,
+            **{k: v for k, v in self._hooks.items() if v is not None})
         tokens = np.asarray(tokens)
         scores = np.asarray(scores)
         lengths = np.asarray(lengths)

@@ -669,13 +669,25 @@ def slope_intercept(input, *, slope: float = 1.0, intercept: float = 0.0,
 
 def beam_search(step, input, *, bos_id: int = None, eos_id: int = None,
                 beam_size: int = 5, max_length: int = 100,
+                candidate_adjust=None, drop_callback=None,
+                norm_or_drop=None, stop_beam_search=None,
                 name: str = None) -> LayerOutput:
     """Generation-mode recurrent group (``beam_search`` in the reference
     DSL; executed by ``RecurrentGradientMachine::generateSequence``). The
     step function receives the embedding of the previously generated word
     for the GeneratedInput slot and must return post-softmax probabilities
     over the vocabulary. Run it with
-    ``paddle_tpu.core.generation.SequenceGenerator``."""
+    ``paddle_tpu.core.generation.SequenceGenerator``.
+
+    The four beam-control hooks (``candidate_adjust``, ``drop_callback``,
+    ``norm_or_drop``, ``stop_beam_search`` —
+    ``RecurrentGradientMachine.h:92-145``, signatures in
+    ``core/generation.py:SequenceGenerator.generate``) pinned here become
+    the defaults for every ``generate`` call on this config, including
+    the SWIG surface and the serving generation endpoint. They are traced
+    into the jitted search; use module-level functions (not lambdas) if
+    the model will be merged for deployment (``--job=merge`` pickles the
+    graph)."""
     global _GRAPH, _GROUP_CTX
     from paddle_tpu.config.model_config import ModelDef as _ModelDef
     inputs = list(input) if isinstance(input, (list, tuple)) else [input]
@@ -735,7 +747,11 @@ def beam_search(step, input, *, bos_id: int = None, eos_id: int = None,
         inputs=[Input(n) for n in outer_in_names], bias=False,
         attrs={"sub_model": sub, "ins": ins_meta, "memories": memories,
                "outputs": [h.name for h in out_handles], "gen": gen_spec,
-               "beam_size": beam_size, "max_length": max_length})
+               "beam_size": beam_size, "max_length": max_length,
+               "candidate_adjust": candidate_adjust,
+               "drop_callback": drop_callback,
+               "norm_or_drop": norm_or_drop,
+               "stop_beam_search": stop_beam_search})
     return _add(ldef)
 
 

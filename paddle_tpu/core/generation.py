@@ -8,9 +8,23 @@ the whole search is ONE jitted ``lax.scan`` with static beam and length
 dims: beams live as a [B, K] axis, finished beams are frozen by masking
 (-inf over non-EOS continuations), and parent-beam reordering is a gather.
 
-The user beam-control hooks (``beamSearchCandidateAdjust`` /
-``DropCallback``, RecurrentGradientMachine.h:101-133) survive as the
-``candidate_adjust`` callable traced into the step.
+The user beam-control hooks (``RecurrentGradientMachine.h:92-145``)
+survive as callables traced into the step:
+
+- ``candidate_adjust`` — ``beamSearchCandidateAdjust``: arbitrary
+  adjustment of the expanded candidate log-probs before selection.
+- ``drop_callback`` — ``DropCallback``: per-node drop decision over the
+  expanded candidates (True = prune that (beam, token) node).
+- ``norm_or_drop`` — ``NormOrDropNode``: rescoring (e.g. length
+  normalization) or dropping (-inf) of a candidate at the moment it
+  finishes (picks EOS).
+- ``stop_beam_search`` — the ``stopBeamSearch`` flag: a predicate that
+  freezes the whole search early (all beams behave as finished from the
+  step it first returns True).
+
+Hooks can be pinned in the config (``dsl.beam_search(...,
+drop_callback=...)``) — the attrs are the defaults every ``generate``
+call (and the serving generation endpoint) honors — or passed per call.
 """
 
 from __future__ import annotations
@@ -59,12 +73,31 @@ class SequenceGenerator:
     def generate(self, params, outer_outputs: Dict[str, Argument], *,
                  beam_size: Optional[int] = None,
                  max_length: Optional[int] = None,
-                 candidate_adjust: Optional[Callable] = None):
+                 candidate_adjust: Optional[Callable] = None,
+                 drop_callback: Optional[Callable] = None,
+                 norm_or_drop: Optional[Callable] = None,
+                 stop_beam_search: Optional[Callable] = None):
         """Run the search.
 
         params: global parameter table (sub-net params are hoisted names).
         outer_outputs: outer-layer Arguments for static/boot inputs, keyed
             by outer layer name (run your encoder Network first).
+
+        Beam-control hooks (``RecurrentGradientMachine.h:92-145``); each
+        defaults to the config attr of the same name so hooks pinned by
+        ``dsl.beam_search`` apply to every call, flat or via SWIG:
+
+        - ``candidate_adjust(logp [B*K, V], state) -> logp``
+        - ``drop_callback(state, total [B, K, V]) -> bool [B, K, V]``
+          (True = drop that expanded node; the forced-EOS continuation
+          of an already-finished beam is exempt — its frozen score must
+          carry)
+        - ``norm_or_drop(eos_scores [B, K], length) -> [B, K]`` applied
+          to candidates finishing at this step (``length`` counts the
+          EOS); return -inf to drop the ending, or a renormalized score
+        - ``stop_beam_search(state, t) -> bool`` (scalar or [B]); True
+          freezes the search from this step on
+
         Returns (tokens [B, K, L] int32, scores [B, K], lengths [B, K]) —
         beams sorted best-first, EOS included in the length.
         """
@@ -72,13 +105,24 @@ class SequenceGenerator:
             beam_size = self.cfg.attrs.get("beam_size", 1)
         if max_length is None:
             max_length = self.cfg.attrs.get("max_length", 100)
-        # key by the callable itself (strong ref) — an id() key could be
-        # recycled after GC and silently serve a stale traced search
-        key = (beam_size, max_length, candidate_adjust)
+        attrs = self.cfg.attrs
+        if candidate_adjust is None:
+            candidate_adjust = attrs.get("candidate_adjust")
+        if drop_callback is None:
+            drop_callback = attrs.get("drop_callback")
+        if norm_or_drop is None:
+            norm_or_drop = attrs.get("norm_or_drop")
+        if stop_beam_search is None:
+            stop_beam_search = attrs.get("stop_beam_search")
+        hooks = (candidate_adjust, drop_callback, norm_or_drop,
+                 stop_beam_search)
+        # key by the callables themselves (strong refs) — an id() key
+        # could be recycled after GC and silently serve a stale search
+        key = (beam_size, max_length) + hooks
         if key not in self._jitted:
             self._jitted[key] = jax.jit(
                 lambda p, feed: self._search(
-                    p, feed, beam_size, max_length, candidate_adjust))
+                    p, feed, beam_size, max_length, hooks))
         static_feed = {}
         for inp, meta in zip(self.cfg.inputs, self.cfg.attrs["ins"]):
             if meta["kind"] in ("static", "boot"):
@@ -86,7 +130,8 @@ class SequenceGenerator:
         return self._jitted[key](params, static_feed)
 
     # ------------------------------------------------------------------
-    def _search(self, params, static_feed, K: int, L: int, adjust):
+    def _search(self, params, static_feed, K: int, L: int, hooks):
+        adjust, drop_cb, norm_or_drop, stop_fn = hooks
         cfg, net, gen = self.cfg, self.net, self.gen
         memories = cfg.attrs["memories"]
         out_name = cfg.attrs["outputs"][0]
@@ -155,6 +200,21 @@ class SequenceGenerator:
             eos_only = jnp.full((1, 1, V), NEG).at[0, 0, eos].set(0.0)
             logp = jnp.where(fin, eos_only, logp)
             total = state["scores"][:, :, None] + logp  # [B, K, V]
+            # the forced EOS continuation of an already-finished beam is
+            # bookkeeping, not a candidate — no hook may touch it, or a
+            # frozen beam's score would drift after it ended
+            forced = fin & (jnp.arange(V) == eos)[None, None, :]
+            if norm_or_drop is not None:
+                # NormOrDropNode: a candidate that ENDS here (picks EOS at
+                # step t, path length t+1 counting the EOS) gets its
+                # cumulative score renormalized or dropped (-inf)
+                ended = norm_or_drop(total[:, :, eos], t + 1)
+                total = total.at[:, :, eos].set(
+                    jnp.where(state["finished"], total[:, :, eos], ended))
+            if drop_cb is not None:
+                drop = drop_cb(state, total)
+                total = jnp.where(jnp.logical_and(drop, ~forced), NEG,
+                                  total)
             flat = total.reshape(B, K * V)
             top_scores, top_idx = lax.top_k(flat, K)     # [B, K]
             parent = top_idx // V
@@ -186,6 +246,14 @@ class SequenceGenerator:
             new_state = {"tokens": tokens, "prev": token,
                          "scores": top_scores, "finished": finished,
                          "mem": new_mem}
+            if stop_fn is not None:
+                # stopBeamSearch: once the predicate fires, every beam
+                # behaves as finished — only zero-cost EOS continuations
+                # from here on, so the search is over in all but shape
+                stop = jnp.asarray(stop_fn(new_state, t), bool)
+                if stop.ndim <= 1:  # scalar or per-batch [B] -> [B, K]
+                    stop = jnp.broadcast_to(stop.reshape((-1, 1)), (B, K))
+                new_state["finished"] = new_state["finished"] | stop
             return new_state, None
 
         state, _ = lax.scan(step, state0, jnp.arange(L))

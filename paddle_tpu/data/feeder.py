@@ -51,12 +51,20 @@ class DataFeeder:
                  pad_multiple: int = 32,
                  length_buckets: Optional[Sequence[int]] = None,
                  batch_buckets: Optional[Sequence[int]] = None,
-                 validate_ids: Optional[bool] = None):
+                 validate_ids: Optional[bool] = None,
+                 shared_length_bucket: bool = False):
         """feeding: data-layer name -> InputType, in feed order if the
         reader yields tuples. ``length_buckets``: fixed menu of padded
         sequence lengths (``data/prefetch.py:LengthBuckets``) overriding
         the pad_multiple ceiling. ``batch_buckets``: menu of batch sizes;
         short batches pad up with dead rows + a ROW_MASK_KEY entry.
+
+        ``shared_length_bucket``: pad EVERY single-level sequence slot of
+        a batch to ONE bucket (of the max raw length across all such
+        slots) instead of bucketing each slot independently. Serving
+        turns this on so its warmed shape menu is the bucket LIST, not
+        the cross-product of per-slot buckets — a multi-sequence-input
+        model otherwise has unwarmed legal shape combinations.
 
         ``validate_ids`` (debug mode; default from the
         ``PADDLE_TPU_VALIDATE_IDS`` env var) checks every INDEX input
@@ -82,6 +90,7 @@ class DataFeeder:
                 else LengthBuckets(length_buckets))
         self.batch_buckets = (sorted(int(b) for b in batch_buckets)
                               if batch_buckets else None)
+        self.shared_length_bucket = bool(shared_length_bucket)
 
     def _pad_len(self, raw_max: int) -> int:
         if self.length_buckets is not None:
@@ -116,9 +125,19 @@ class DataFeeder:
             raise ValueError(
                 f"batch has {len(cols)} columns, feeder expects "
                 f"{len(self.names)} ({self.names})")
+        pad_to = None
+        if self.shared_length_bucket:
+            # one padded length for every single-level sequence slot:
+            # bucket of the global raw max across those slots
+            raw = [len(s) for name, col in zip(self.names, cols)
+                   if self.feeding[name].seq_type == T.SEQUENCE
+                   for s in col]
+            if raw:
+                pad_to = self._pad_len(max(raw))
         feed = {}
         for name, col in zip(self.names, cols):
-            feed[name] = self._convert_one(self.feeding[name], col, name)
+            feed[name] = self._convert_one(self.feeding[name], col, name,
+                                           pad_to=pad_to)
         if row_mask is not None:
             feed[ROW_MASK_KEY] = Argument(value=jnp.asarray(row_mask))
         return feed
@@ -146,7 +165,8 @@ class DataFeeder:
                 "of raising — fix the data or the declared dimension.")
 
     def _convert_one(self, itype: T.InputType, col: Sequence,
-                     name: str = "?") -> Argument:
+                     name: str = "?",
+                     pad_to: Optional[int] = None) -> Argument:
         if itype.seq_type == T.NO_SEQUENCE:
             if itype.type == T.INDEX:
                 arr = np.asarray(col, dtype=np.int32)
@@ -205,7 +225,8 @@ class DataFeeder:
             return Argument(value=jnp.asarray(value),
                             mask=jnp.asarray(mask))
         # sequences: pad to multiple / bucket edge for shape bucketing
-        max_len = self._pad_len(max(len(s) for s in col))
+        # (pad_to = the batch-wide shared bucket, shared_length_bucket)
+        max_len = pad_to or self._pad_len(max(len(s) for s in col))
         bsz = len(col)
         mask = np.zeros((bsz, max_len), dtype=np.float32)
         if itype.type == T.INDEX:

@@ -265,6 +265,11 @@ def jit_cache_size(fn) -> Optional[int]:
         return None
 
 
+class RecompileError(RuntimeError):
+    """A hardened :class:`RecompileGuard` saw the jit cache grow — a
+    shape escaped the warmed bucket menu and compiled on the hot path."""
+
+
 class RecompileGuard:
     """Compilation-cache monitor for a jitted step function.
 
@@ -273,20 +278,42 @@ class RecompileGuard:
     training limps along at compile speed with no error anywhere. The
     guard polls the jit cache (``check()`` per step is cheap) and logs
     one loud warning when the variant count passes ``warn_after`` —
-    pointing at the bucketing knobs that bound it."""
+    pointing at the bucketing knobs that bound it.
+
+    Serving escalates the warning to a hard error: after AOT warmup has
+    compiled every bucket, :meth:`harden` records the cache size as the
+    closed set of legal variants and any later growth raises
+    :class:`RecompileError` — a stray shape can never pay XLA compile
+    time on the request hot path (it is a bug in admission control, not
+    a slow request)."""
 
     def __init__(self, fn, warn_after: int = 8, name: str = "train_step"):
         self.fn = fn
         self.warn_after = int(warn_after)
         self.name = name
         self.warned = False
+        self.hard_baseline: Optional[int] = None
 
     @property
     def count(self) -> Optional[int]:
         return jit_cache_size(self.fn)
 
+    def harden(self) -> Optional[int]:
+        """Freeze the current variant count as the complete set (serving
+        mode, post-warmup); returns it. On jax versions without the cache
+        probe the guard stays advisory (count None)."""
+        self.hard_baseline = self.count
+        return self.hard_baseline
+
     def check(self) -> Optional[int]:
         n = self.count
+        if (self.hard_baseline is not None and n is not None
+                and n > self.hard_baseline):
+            raise RecompileError(
+                f"{self.name}: jit cache grew {self.hard_baseline} -> {n} "
+                "after warmup — a shape outside the warmed bucket menu "
+                "compiled on the hot path. Admission control must reject "
+                "(or the warmup must cover) that shape.")
         if (n is not None and not self.warned and self.warn_after > 0
                 and n > self.warn_after):
             self.warned = True

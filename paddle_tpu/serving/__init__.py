@@ -1,0 +1,38 @@
+"""paddle_tpu.serving — TPU-native model serving.
+
+The inference half of the north star: a merged deploy model
+(``trainer/merge_model.py``, the artifact ``--job=merge`` writes and the
+C API loads) served over HTTP with
+
+- a bucketed, AOT-warmed, donation-friendly predictor whose shape menu
+  is CLOSED (``RecompileGuard.harden()`` — a stray shape is a typed 400,
+  never a hot-path XLA compile),
+- a dynamic micro-batching engine with per-request deadlines, admission
+  control / load shedding, drain-on-SIGTERM, and per-lane isolation of
+  malformed requests,
+- a metrics plane splitting request latency into
+  {queue_wait, pad_overhead, compute, decode} with batch occupancy and
+  per-bucket hit counts, on ``/metrics`` + ``/healthz``.
+
+Entry points: ``python -m paddle_tpu.trainer.cli --job=serve`` (flags
+``--port --batch_timeout_ms --max_batch --queue_depth``), or
+programmatically::
+
+    pred = ServingPredictor.from_merged("model.ptmodel", feeding,
+                                        batch_buckets=[1, 2, 4, 8],
+                                        length_buckets=[32, 64])
+    engine = ServingEngine(pred, batch_timeout_ms=5).start()
+    serve_forever(engine, port=8000)      # or engine.infer(sample)
+
+Design record: ``docs/serving.md``.
+"""
+
+from paddle_tpu.serving.batcher import ServingEngine  # noqa: F401
+from paddle_tpu.serving.client import ServingClient  # noqa: F401
+from paddle_tpu.serving.errors import (BadRequest,  # noqa: F401
+                                       DeadlineExceeded, Overloaded,
+                                       ServingError, ShuttingDown)
+from paddle_tpu.serving.metrics import ServingMetrics  # noqa: F401
+from paddle_tpu.serving.predictor import ServingPredictor  # noqa: F401
+from paddle_tpu.serving.server import (install_signal_handlers,  # noqa: F401
+                                       make_server, serve_forever)

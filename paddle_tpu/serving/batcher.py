@@ -1,0 +1,359 @@
+"""Dynamic micro-batching engine: queue, coalesce, deadline, shed, drain.
+
+The chip-side economics: one jitted call over a padded batch of N costs
+barely more than a batch of 1 (the MXU is wildly under-filled at small
+N), so concurrent single-row requests should ride ONE program launch.
+The engine holds a bounded request queue; a single worker thread
+coalesces whatever is waiting — same endpoint kind, up to ``max_batch``
+— within a ``batch_timeout`` window into the smallest admissible batch
+bucket, runs it, and fans results back out. This is the TensorFlow
+Serving / "dynamic batcher" shape of the problem, sitting on the deploy
+surface the reference ships as merged-model + C API (SURVEY L7b).
+
+Production behaviors, all typed (``serving/errors.py``):
+
+- **Deadlines** — per-request; a request that expires in the queue is
+  answered ``DeadlineExceeded`` without wasting compute, and one whose
+  batch finishes too late is answered the same (the work is sunk, the
+  answer honest).
+- **Admission control / load shedding** — the queue is bounded; past
+  ``shed_watermark`` new requests get ``Overloaded`` with a
+  ``retry_after_ms`` drain estimate (EWMA batch time × queued batches).
+- **Drain** — ``begin_drain()`` (the SIGTERM handler) closes admission
+  (``ShuttingDown``) while the worker finishes every queued request;
+  ``shutdown()`` waits for that and stops the worker.
+- **Lane isolation** — a malformed request discovered at batch-assembly
+  time (conversion failure, e.g. an id outside the declared range)
+  cannot poison the batch it was coalesced into: bad rows are probed
+  out per-lane, replaced with synthetic padding rows, and their row-mask
+  lanes zeroed; the bad request alone gets ``BadRequest``, its
+  neighbors' answers are bit-identical to a clean batch's.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from paddle_tpu.serving.errors import (BadRequest, DeadlineExceeded,
+                                       Overloaded, ServingError,
+                                       ShuttingDown)
+from paddle_tpu.serving.metrics import ServingMetrics
+from paddle_tpu.utils.log import get_logger
+
+logger = get_logger("serving")
+
+
+class _Request:
+    __slots__ = ("sample", "kind", "enqueue_t", "deadline", "event",
+                 "result", "error", "timings")
+
+    def __init__(self, sample, kind: str, deadline: Optional[float]):
+        self.sample = sample
+        self.kind = kind
+        self.enqueue_t = time.perf_counter()
+        self.deadline = deadline  # absolute perf_counter time, or None
+        self.event = threading.Event()
+        self.result = None
+        self.error: Optional[ServingError] = None
+        self.timings: Dict[str, float] = {}
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return (self.deadline is not None
+                and (now or time.perf_counter()) > self.deadline)
+
+
+class ServingEngine:
+    """One predictor + one worker thread + one bounded queue."""
+
+    def __init__(self, predictor, *, max_batch: Optional[int] = None,
+                 batch_timeout_ms: float = 5.0, queue_depth: int = 64,
+                 shed_watermark: Optional[int] = None,
+                 default_deadline_ms: Optional[float] = None,
+                 metrics: Optional[ServingMetrics] = None):
+        self.predictor = predictor
+        self.max_batch = int(max_batch or predictor.batch_buckets[-1])
+        if self.max_batch > predictor.batch_buckets[-1]:
+            raise ValueError(
+                f"max_batch {self.max_batch} exceeds the largest warmed "
+                f"batch bucket {predictor.batch_buckets[-1]}")
+        self.batch_timeout_ms = float(batch_timeout_ms)
+        self.queue_depth = int(queue_depth)
+        # the queue bound is queue_depth, full stop — a watermark above
+        # it would silently unbound the "bounded" queue
+        self.shed_watermark = min(int(shed_watermark or queue_depth),
+                                  self.queue_depth)
+        self.default_deadline_ms = default_deadline_ms
+        self.metrics = metrics or ServingMetrics()
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: List[_Request] = []
+        self._draining = False
+        self._batch_ewma_ms = 10.0  # drain-time estimator seed
+        self._thread: Optional[threading.Thread] = None
+        self.fatal: Optional[BaseException] = None
+
+    # ------------------------------------------------------------ control
+    def start(self, warmup: bool = True) -> "ServingEngine":
+        if warmup and not self.predictor.warmed:
+            self.predictor.warmup(log=logger.info)
+        self._thread = threading.Thread(target=self._work,
+                                        name="serving-batcher", daemon=True)
+        self._thread.start()
+        return self
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def queue_len(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def begin_drain(self):
+        """Close admission; queued and in-flight work still completes.
+        The SIGTERM handler calls this (``serving/server.py``)."""
+        with self._cond:
+            if not self._draining:
+                logger.info("serving: draining (admission closed, "
+                            "%d queued)", len(self._queue))
+            self._draining = True
+            self._cond.notify_all()
+
+    def shutdown(self, drain: bool = True, timeout: float = 30.0):
+        """Drain (default) or abort the queue, then stop the worker."""
+        with self._cond:
+            self._draining = True
+            if not drain:
+                for r in self._queue:
+                    r.error = ShuttingDown(
+                        "server shutting down; request not started")
+                    r.event.set()
+                    self.metrics.inc("shed_total")
+                self._queue.clear()
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+
+    # ---------------------------------------------------------- admission
+    def _retry_after_ms(self) -> float:
+        backlog_batches = max(len(self._queue), 1) / self.max_batch
+        return max(self.batch_timeout_ms,
+                   self._batch_ewma_ms * backlog_batches)
+
+    def submit(self, sample, *, kind: str = "score",
+               deadline_ms: Optional[float] = None,
+               beam_size=None, max_length=None) -> _Request:
+        """Admit one request; raises typed errors synchronously (shed /
+        draining / inadmissible shape). Returns the pending request —
+        wait on ``.event`` and read ``.result`` / ``.error``."""
+        if self.fatal is not None:
+            # the worker is dead (a bug, not load): admitting would
+            # enqueue into a queue nothing drains
+            raise ServingError(f"serving worker died: {self.fatal!r}")
+        if self._draining:
+            raise ShuttingDown("server is draining; retry elsewhere",
+                               retry_after_ms=self._retry_after_ms())
+        if kind == "generate":
+            self.predictor.check_gen_opts(beam_size, max_length)
+        elif kind != "score":
+            raise BadRequest(f"unknown request kind {kind!r}")
+        self.predictor.check_sample(sample)
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        deadline = (time.perf_counter() + float(deadline_ms) / 1e3
+                    if deadline_ms else None)
+        req = _Request(tuple(sample), kind, deadline)
+        with self._cond:
+            if self.fatal is not None:
+                # re-check under the lock: a request racing the worker's
+                # death must not land in a queue nothing drains
+                raise ServingError(
+                    f"serving worker died: {self.fatal!r}")
+            if self._draining:
+                raise ShuttingDown(
+                    "server is draining; retry elsewhere",
+                    retry_after_ms=self._retry_after_ms())
+            if len(self._queue) >= self.shed_watermark:
+                self.metrics.inc("shed_total")
+                raise Overloaded(
+                    f"queue depth {len(self._queue)} at the shed "
+                    f"watermark {self.shed_watermark}",
+                    retry_after_ms=self._retry_after_ms())
+            self._queue.append(req)
+            self.metrics.inc("requests_total")
+            self._cond.notify_all()
+        return req
+
+    def infer(self, sample, *, kind: str = "score",
+              deadline_ms: Optional[float] = None, beam_size=None,
+              max_length=None, wait_timeout: float = 120.0):
+        """Synchronous submit-and-wait; raises the request's typed error
+        or returns its result."""
+        req = self.submit(sample, kind=kind, deadline_ms=deadline_ms,
+                          beam_size=beam_size, max_length=max_length)
+        if not req.event.wait(wait_timeout):
+            raise DeadlineExceeded(
+                f"no answer within wait_timeout={wait_timeout}s")
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    # ------------------------------------------------------------- worker
+    def _expire_locked(self, now: float):
+        live = []
+        for r in self._queue:
+            if r.expired(now):
+                r.error = DeadlineExceeded(
+                    "deadline passed while queued "
+                    f"(queued {1e3 * (now - r.enqueue_t):.1f} ms)")
+                r.timings["queue_wait"] = 1e3 * (now - r.enqueue_t)
+                r.event.set()
+                self.metrics.inc("deadline_exceeded_total")
+            else:
+                live.append(r)
+        self._queue[:] = live
+
+    def _collect(self) -> Optional[List[_Request]]:
+        """Block for the next coalesced batch; None when drained dry."""
+        with self._cond:
+            while True:
+                now = time.perf_counter()
+                self._expire_locked(now)
+                if self._queue:
+                    break
+                if self._draining:
+                    return None
+                self._cond.wait(0.1)
+            head = self._queue[0]
+            window_end = time.perf_counter() + self.batch_timeout_ms / 1e3
+            if head.deadline is not None:
+                # dispatch before the head's deadline, not after
+                window_end = min(window_end, head.deadline)
+            while True:
+                now = time.perf_counter()
+                self._expire_locked(now)
+                batch = [r for r in self._queue
+                         if r.kind == head.kind][:self.max_batch]
+                if len(batch) >= self.max_batch or self._draining:
+                    break
+                remaining = window_end - now
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+            for r in batch:
+                self._queue.remove(r)
+            self._cond.notify_all()
+            return batch
+
+    def _work(self):
+        while True:
+            batch = None
+            try:
+                batch = self._collect()
+                if batch is None:
+                    logger.info("serving: worker drained and stopped")
+                    return
+                if batch:
+                    self._run_batch(batch)
+            except BaseException as e:  # noqa: BLE001 — a worker bug
+                self.fatal = e
+                logger.error("serving worker died: %r", e)
+                err = ServingError(f"serving worker died: {e!r}")
+                # answer EVERYTHING in flight — the collected batch was
+                # already off the queue, so it must be errored explicitly
+                # or its callers would block forever
+                for r in batch or []:
+                    if not r.event.is_set():
+                        r.error = r.error or err
+                        r.event.set()
+                with self._cond:
+                    for r in self._queue:
+                        r.error = err
+                        r.event.set()
+                    self._queue.clear()
+                self.metrics.inc("internal_error_total")
+                raise
+
+    # ------------------------------------------------------------ batches
+    def _predict(self, kind: str, rows, lane_valid=None):
+        if kind == "generate":
+            return self.predictor.generate_rows(rows, lane_valid)
+        return self.predictor.predict_rows(rows, lane_valid)
+
+    def _run_batch(self, reqs: List[_Request]):
+        t_assemble = time.perf_counter()
+        kind = reqs[0].kind
+        rows = [r.sample for r in reqs]
+        lane_valid = [True] * len(reqs)
+        t0 = time.perf_counter()
+        try:
+            outs, info = self._predict(kind, rows)
+        except (BadRequest, ValueError, TypeError, KeyError) as batch_err:
+            # conversion failed somewhere in the batch: probe per lane,
+            # replace bad rows with synthetic padding, zero their mask
+            # lanes, and answer neighbors from the cleaned batch
+            probe = self.predictor.probe_rows(rows)
+            clean_rows = list(rows)
+            for i, err in enumerate(probe):
+                if err is not None:
+                    lane_valid[i] = False
+                    clean_rows[i] = self.predictor.padding_row()
+                    reqs[i].error = (err if isinstance(err, BadRequest)
+                                     else BadRequest(str(err)))
+                    self.metrics.inc("bad_request_total")
+            if all(lane_valid):
+                # conversion failed but no single lane reproduces it —
+                # a batch-level problem; every request gets the error
+                for r in reqs:
+                    r.error = (batch_err
+                               if isinstance(batch_err, BadRequest)
+                               else BadRequest(str(batch_err)))
+                    r.event.set()
+                    self.metrics.inc("bad_request_total")
+                return
+            outs, info = self._predict(kind, clean_rows, lane_valid)
+        except ServingError as e:
+            for r in reqs:
+                r.error = e
+                r.event.set()
+            return
+        wall_ms = 1e3 * (time.perf_counter() - t0)
+        self._batch_ewma_ms += 0.25 * (wall_ms - self._batch_ewma_ms)
+        self.metrics.observe_batch(
+            info["bucket"], real_rows=sum(lane_valid),
+            padded_rows=info["padded_rows"])
+        pad_ms, compute_ms = info["pad_ms"], info["compute_ms"]
+        for i, r in enumerate(reqs):
+            if r.error is not None:  # malformed lane, already typed
+                r.event.set()
+                continue
+            td0 = time.perf_counter()
+            r.result = self._decode(kind, outs, i)
+            now = time.perf_counter()
+            r.timings = {
+                "queue_wait": 1e3 * (t_assemble - r.enqueue_t),
+                "pad_overhead": pad_ms,
+                "compute": compute_ms,
+                "decode": 1e3 * (now - td0),
+            }
+            if r.expired(now):
+                r.error = DeadlineExceeded(
+                    "computed, but past the deadline "
+                    f"(total {1e3 * (now - r.enqueue_t):.1f} ms)")
+                self.metrics.inc("deadline_exceeded_total")
+            else:
+                self.metrics.observe_request(r.timings)
+            r.event.set()
+
+    @staticmethod
+    def _decode(kind: str, outs, lane: int):
+        if kind == "generate":
+            tokens, scores, lengths = outs
+            return {"sequences": [
+                {"tokens": tokens[lane, k, :int(lengths[lane, k])].tolist(),
+                 "score": float(scores[lane, k])}
+                for k in range(tokens.shape[1])]}
+        return {"outputs": {name: v[lane].tolist()
+                            for name, v in outs.items()}}

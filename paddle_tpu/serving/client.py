@@ -1,0 +1,94 @@
+"""ServingClient: typed stdlib client for the serving HTTP plane.
+
+Raises the same typed error family the server answers with
+(``serving/errors.py`` rebuilt from the wire), so caller code branches
+on ``Overloaded.retry_after_ms`` / ``DeadlineExceeded`` instead of
+status-code string matching.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+from typing import List, Optional
+
+from paddle_tpu.serving.errors import ServingError, from_wire
+
+
+class ServingClient:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8000,
+                 timeout: float = 120.0):
+        self.host = host
+        self.port = int(port)
+        self.timeout = timeout
+
+    # ------------------------------------------------------------- wire
+    def _request(self, method: str, path: str, body=None) -> dict:
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=self.timeout)
+        try:
+            payload = json.dumps(body).encode() if body is not None else None
+            headers = {"Content-Type": "application/json"} if payload else {}
+            conn.request(method, path, body=payload, headers=headers)
+            resp = conn.getresponse()
+            raw = resp.read()
+            try:
+                data = json.loads(raw) if raw else {}
+            except json.JSONDecodeError:
+                data = {"raw": raw.decode(errors="replace")}
+            if resp.status >= 400:
+                raise from_wire(data, resp.status)
+            return data
+        finally:
+            conn.close()
+
+    # ---------------------------------------------------------- methods
+    def score(self, sample, deadline_ms: Optional[float] = None) -> dict:
+        """One sample -> ``{"outputs": {layer: values}}``."""
+        body = {"sample": sample}
+        if deadline_ms is not None:
+            body["deadline_ms"] = deadline_ms
+        return self._request("POST", "/v1/score", body)
+
+    def score_rows(self, rows: List,
+                   deadline_ms: Optional[float] = None) -> List[dict]:
+        """Many samples in one HTTP call; per-row results in order (a
+        failed row carries its typed error body instead of outputs)."""
+        body = {"rows": rows}
+        if deadline_ms is not None:
+            body["deadline_ms"] = deadline_ms
+        return self._request("POST", "/v1/score", body)["results"]
+
+    def generate(self, sample, beam_size: Optional[int] = None,
+                 max_length: Optional[int] = None,
+                 deadline_ms: Optional[float] = None) -> dict:
+        """One encoder input -> ``{"sequences": [{tokens, score}, ...]}``
+        (beams best-first)."""
+        body = {"sample": sample}
+        if beam_size is not None:
+            body["beam_size"] = beam_size
+        if max_length is not None:
+            body["max_length"] = max_length
+        if deadline_ms is not None:
+            body["deadline_ms"] = deadline_ms
+        return self._request("POST", "/v1/generate", body)
+
+    def healthz(self) -> dict:
+        return self._request("GET", "/healthz")
+
+    def metrics(self) -> dict:
+        """The structured snapshot (``/metrics?format=json``)."""
+        return self._request("GET", "/metrics?format=json")
+
+    def metrics_text(self) -> str:
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=self.timeout)
+        try:
+            conn.request("GET", "/metrics")
+            resp = conn.getresponse()
+            raw = resp.read().decode()
+            if resp.status >= 400:
+                raise ServingError(raw[:300])
+            return raw
+        finally:
+            conn.close()

@@ -1,0 +1,85 @@
+"""Typed serving errors — the wire contract for everything that is NOT
+a 500.
+
+The reference's C inference API signals failure through ``paddle_error``
+return codes (``paddle/capi/error.h``); an HTTP serving plane needs the
+same discipline: every anticipated failure mode has a *typed* error with
+a stable ``code`` string and the right status class, so clients can
+branch on machine-readable fields instead of parsing tracebacks. Only a
+genuine bug (e.g. :class:`~paddle_tpu.data.prefetch.RecompileError`
+escaping the hardened guard) surfaces as a 500.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class ServingError(Exception):
+    """Base of the typed family. ``status`` is the HTTP status the
+    frontend maps it to; ``code`` is the stable machine-readable
+    discriminator carried in the JSON body."""
+
+    status = 500
+    code = "internal"
+
+    def __init__(self, message: str,
+                 retry_after_ms: Optional[float] = None):
+        super().__init__(message)
+        self.retry_after_ms = retry_after_ms
+
+    def to_wire(self) -> dict:
+        body = {"code": self.code, "message": str(self)}
+        if self.retry_after_ms is not None:
+            body["retry_after_ms"] = round(float(self.retry_after_ms), 1)
+        return {"error": body}
+
+
+class BadRequest(ServingError):
+    """Malformed or inadmissible request: wrong slot count, a sequence
+    longer than the largest warmed length bucket, an id outside the
+    declared range, an unwarmed (beam_size, max_length) pair. 400."""
+
+    status = 400
+    code = "bad_request"
+
+
+class DeadlineExceeded(ServingError):
+    """The request's deadline passed before its result could be
+    delivered (in queue, or compute finished too late). 504 — the typed
+    counterpart of a gateway timeout, never a bare 500."""
+
+    status = 504
+    code = "deadline_exceeded"
+
+
+class Overloaded(ServingError):
+    """Load shed: queue depth crossed the admission watermark. Carries
+    ``retry_after_ms`` (the engine's current drain-time estimate) so
+    well-behaved clients back off instead of retry-storming. 429."""
+
+    status = 429
+    code = "overloaded"
+
+
+class ShuttingDown(Overloaded):
+    """Admission closed because the server is draining (SIGTERM);
+    in-flight work still completes. Same 429/backoff contract."""
+
+    code = "shutting_down"
+
+
+def from_wire(body: dict, status: int) -> ServingError:
+    """Client side: rebuild the typed error from a JSON error body."""
+    err = (body or {}).get("error", {})
+    code = err.get("code", "internal")
+    cls = {
+        BadRequest.code: BadRequest,
+        DeadlineExceeded.code: DeadlineExceeded,
+        Overloaded.code: Overloaded,
+        ShuttingDown.code: ShuttingDown,
+    }.get(code, ServingError)
+    e = cls(err.get("message", f"HTTP {status}"),
+            retry_after_ms=err.get("retry_after_ms"))
+    e.status = status
+    return e

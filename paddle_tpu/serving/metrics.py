@@ -1,0 +1,164 @@
+"""Serving observability plane: latency split, occupancy, bucket hits.
+
+The training side answers "is the chip waiting on the host?" with
+``utils/profiler.StepBreakdown``; the serving side's first-order
+questions are different — *where does a request's latency go* and *how
+full are the batches the chip actually runs*. Four phases partition a
+request's life:
+
+- ``queue_wait``    — enqueue until the batcher picks it into a batch
+  (the dynamic-batching tax; grows with ``batch_timeout`` and load).
+- ``pad_overhead``  — batch assembly: feeder convert, pad-to-bucket,
+  host→device placement.
+- ``compute``       — the jitted forward (or beam search) through the
+  device→host fetch.
+- ``decode``        — slicing the batch back into per-request rows and
+  converting to wire types.
+
+Batch occupancy (real rows / padded rows) is the padding waste the
+bucket menu costs — the serving analogue of the feeder's exactly-ignored
+row masking; per-bucket hit counts show which compiled variants earn
+their warmup. Shed/deadline/bad-request counters complete the picture.
+
+Exported two ways: :meth:`ServingMetrics.snapshot` (the ``/metrics``
+JSON + ``bench.py --serving``) and :meth:`to_prometheus` (text format,
+``# TYPE`` lines included, for scrapers).
+
+Quantiles come from a bounded reservoir of the most recent samples
+(deque, default 4096) — honest recent-window p50/p95/p99 without
+unbounded memory; counts and sums are exact over the process lifetime.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import Counter, deque
+from typing import Dict, Optional
+
+PHASES = ("queue_wait", "pad_overhead", "compute", "decode")
+
+
+class LatencyStat:
+    """Exact count/sum + recent-window quantiles for one phase (ms)."""
+
+    def __init__(self, window: int = 4096):
+        self.count = 0
+        self.sum_ms = 0.0
+        self._recent = deque(maxlen=window)
+
+    def add(self, ms: float):
+        self.count += 1
+        self.sum_ms += ms
+        self._recent.append(ms)
+
+    def quantile(self, q: float) -> Optional[float]:
+        if not self._recent:
+            return None
+        vals = sorted(self._recent)
+        idx = min(int(q * len(vals)), len(vals) - 1)
+        return vals[idx]
+
+    def snapshot(self) -> dict:
+        out = {"count": self.count,
+               "sum_ms": round(self.sum_ms, 3),
+               "mean_ms": round(self.sum_ms / self.count, 3)
+               if self.count else None}
+        for name, q in (("p50", 0.50), ("p95", 0.95), ("p99", 0.99)):
+            v = self.quantile(q)
+            out[f"{name}_ms"] = round(v, 3) if v is not None else None
+        return out
+
+
+class ServingMetrics:
+    """Thread-safe metric registry for one serving engine."""
+
+    COUNTERS = ("requests_total", "responses_total", "batches_total",
+                "shed_total", "deadline_exceeded_total",
+                "bad_request_total", "internal_error_total")
+
+    def __init__(self, window: int = 4096):
+        self._lock = threading.Lock()
+        self.latency: Dict[str, LatencyStat] = {
+            p: LatencyStat(window) for p in PHASES + ("total",)}
+        self.occupancy = LatencyStat(window)  # unit: fraction, not ms
+        self.bucket_hits: Counter = Counter()
+        self.counters = {c: 0 for c in self.COUNTERS}
+        self.real_rows_total = 0
+        self.padded_rows_total = 0
+
+    # ------------------------------------------------------------ record
+    def inc(self, name: str, n: int = 1):
+        with self._lock:
+            self.counters[name] += n
+
+    def observe_request(self, phases_ms: Dict[str, float]):
+        """One answered request's per-phase latency (ms); ``total`` is
+        derived as the sum so the split always partitions it."""
+        with self._lock:
+            total = 0.0
+            for p in PHASES:
+                ms = float(phases_ms.get(p, 0.0))
+                self.latency[p].add(ms)
+                total += ms
+            self.latency["total"].add(total)
+            self.counters["responses_total"] += 1
+
+    def observe_batch(self, bucket_key: str, real_rows: int,
+                      padded_rows: int):
+        with self._lock:
+            self.counters["batches_total"] += 1
+            self.bucket_hits[bucket_key] += 1
+            self.real_rows_total += int(real_rows)
+            self.padded_rows_total += int(padded_rows)
+            if padded_rows:
+                self.occupancy.add(real_rows / padded_rows)
+
+    # ------------------------------------------------------------ export
+    def snapshot(self) -> dict:
+        with self._lock:
+            occ = self.occupancy.snapshot()
+            return {
+                "latency_ms": {p: s.snapshot()
+                               for p, s in self.latency.items()},
+                "batch_occupancy": {
+                    "mean": round(self.real_rows_total
+                                  / self.padded_rows_total, 4)
+                    if self.padded_rows_total else None,
+                    "p50": occ["p50_ms"],  # fraction, reservoir window
+                    "real_rows_total": self.real_rows_total,
+                    "padded_rows_total": self.padded_rows_total,
+                },
+                "bucket_hits": dict(self.bucket_hits),
+                **self.counters,
+            }
+
+    def to_prometheus(self, prefix: str = "paddle_tpu_serving") -> str:
+        s = self.snapshot()
+        lines = []
+        for c in self.COUNTERS:
+            lines.append(f"# TYPE {prefix}_{c} counter")
+            lines.append(f"{prefix}_{c} {s[c]}")
+        lines.append(f"# TYPE {prefix}_latency_ms summary")
+        for phase, st in s["latency_ms"].items():
+            for q, key in (("0.5", "p50_ms"), ("0.95", "p95_ms"),
+                           ("0.99", "p99_ms")):
+                v = st[key]
+                if v is not None:
+                    lines.append(
+                        f'{prefix}_latency_ms{{phase="{phase}",'
+                        f'quantile="{q}"}} {v}')
+            lines.append(
+                f'{prefix}_latency_ms_count{{phase="{phase}"}} '
+                f'{st["count"]}')
+            lines.append(
+                f'{prefix}_latency_ms_sum{{phase="{phase}"}} '
+                f'{st["sum_ms"]}')
+        occ = s["batch_occupancy"]
+        lines.append(f"# TYPE {prefix}_batch_occupancy gauge")
+        if occ["mean"] is not None:
+            lines.append(f"{prefix}_batch_occupancy {occ['mean']}")
+        lines.append(f"# TYPE {prefix}_bucket_hits counter")
+        for bucket, hits in sorted(s["bucket_hits"].items()):
+            lines.append(
+                f'{prefix}_bucket_hits{{bucket="{bucket}"}} {hits}')
+        return "\n".join(lines) + "\n"

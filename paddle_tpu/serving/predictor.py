@@ -1,0 +1,380 @@
+"""Bucketed, AOT-warmed, donation-friendly predictor over a deploy model.
+
+The deploy artifact is the merged model (``trainer/merge_model.py`` —
+the same PTM1 file the C API's ``ptc_load`` consumes), or any live
+(graph, params) pair. On top of it this module enforces the serving
+shape discipline:
+
+- **Closed shape menu.** Batch sizes come from ``batch_buckets`` and
+  padded sequence lengths from ``length_buckets`` — the feeder's own
+  bucketing machinery (``data/feeder.py``), reused verbatim so serving
+  and training pad identically. Unlike training there is NO overflow
+  rule: a sequence longer than the largest edge is *inadmissible*
+  (typed ``BadRequest``), never a new compile.
+- **AOT warmup.** ``warmup()`` drives every (batch, length) bucket pair
+  through the jitted forward — and, for generating configs, the jitted
+  beam search — before the first request, so startup pays all XLA
+  compile time.
+- **Hardened recompile guard.** After warmup every guard is
+  ``harden()``-ed (``data/prefetch.py:RecompileGuard``): jit-cache
+  growth on the hot path raises ``RecompileError`` instead of silently
+  serving at compile speed.
+- **Donation.** Request feeds are fresh arrays, dead after the call, so
+  the jitted forward donates them (TPU/GPU; XLA ignores donation on
+  CPU, where it is skipped to avoid warning spam).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddle_tpu.serving.errors import BadRequest
+
+
+def _is_seq(itype) -> bool:
+    from paddle_tpu.data import types as T
+    return itype.seq_type != T.NO_SEQUENCE
+
+
+def _synth_sample(itype, length: int):
+    """An all-zeros warmup sample for one input slot at padded length
+    ``length`` (sequence slots) — shaped exactly like real traffic so
+    the warmed jit variants are the ones requests hit."""
+    from paddle_tpu.data import types as T
+    if itype.seq_type == T.NO_SEQUENCE:
+        if itype.type == T.INDEX:
+            return 0
+        if itype.type in (T.SPARSE_BINARY, T.SPARSE_FLOAT):
+            return []
+        return np.zeros(itype.dim, dtype=np.float32)
+    # SUB_SEQUENCE never reaches here — the predictor refuses nested
+    # inputs at construction (unbucketed outer axis)
+    if itype.type == T.INDEX:
+        return [0] * length
+    if itype.type in (T.SPARSE_BINARY, T.SPARSE_FLOAT):
+        return [[] for _ in range(length)]
+    return [np.zeros(itype.dim, dtype=np.float32) for _ in range(length)]
+
+
+class ServingPredictor:
+    """Loads a model and serves bucketed batches with zero hot-path
+    compiles. ``predict_rows`` scores; ``generate_rows`` runs the beam
+    search of a generating config (``beam_search_group`` present),
+    honoring any beam-control hooks pinned in the config."""
+
+    def __init__(self, graph, params: Dict[str, Any],
+                 output_names: Sequence[str],
+                 feeding: Dict[str, Any], *,
+                 batch_buckets: Sequence[int],
+                 length_buckets: Optional[Sequence[int]] = None,
+                 gen_beam_size: Optional[int] = None,
+                 gen_max_length: Optional[int] = None,
+                 donate: Optional[bool] = None,
+                 recompile_warn: int = 64):
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.core.network import Network
+        from paddle_tpu.data.feeder import DataFeeder
+        from paddle_tpu.data.prefetch import RecompileGuard
+
+        self.graph = graph
+        self.params = {k: jnp.asarray(v) for k, v in params.items()}
+        self.feeding = dict(feeding)
+        self.names = list(self.feeding)
+        self.batch_buckets = sorted(int(b) for b in batch_buckets)
+        if not self.batch_buckets or self.batch_buckets[0] < 1:
+            raise ValueError(f"bad batch_buckets: {batch_buckets}")
+        from paddle_tpu.data import types as T
+        nested = [n for n, t in self.feeding.items()
+                  if t.seq_type == T.SUB_SEQUENCE]
+        if nested:
+            # the outer subsequence count is an unbounded shape axis the
+            # bucket menu does not close: one well-formed 2-subsequence
+            # request would compile on the hot path and (hardened guard)
+            # kill the worker. Refuse at build time instead.
+            raise ValueError(
+                f"serving does not support nested-sequence (SUB_SEQUENCE)"
+                f" inputs yet: {nested} — the outer subsequence count is"
+                " an unbucketed shape axis")
+        self.has_sequences = any(_is_seq(t) for t in self.feeding.values())
+        self.length_buckets = (sorted(int(e) for e in length_buckets)
+                               if length_buckets and self.has_sequences
+                               else None)
+        if self.has_sequences and not self.length_buckets:
+            # silently unbucketed lengths = every batch pads to its own
+            # max = post-warmup compile = worker death on the first real
+            # request. A sequence model MUST close the length menu.
+            raise ValueError(
+                "this model has sequence inputs; serving needs non-empty "
+                "length_buckets (--serving_length_buckets) so the shape "
+                "menu is closed")
+        self.max_seq_len = (self.length_buckets[-1]
+                            if self.length_buckets else None)
+        # id validation ON: an out-of-range id must be a loud per-lane
+        # BadRequest, not a silent zero-row lookup (feeder validate_ids).
+        # shared_length_bucket ON: every sequence slot of a batch pads to
+        # ONE bucket, so the warmed menu is the bucket list — per-slot
+        # independent bucketing would make legal multi-sequence requests
+        # hit unwarmed cross-product shapes (hot-path compile)
+        self.feeder = DataFeeder(
+            self.feeding, batch_buckets=self.batch_buckets,
+            length_buckets=self.length_buckets, validate_ids=True,
+            shared_length_bucket=True)
+
+        self.output_names = [o.name if hasattr(o, "name") else o
+                             for o in output_names]
+        # the generation group (if any) is served by the beam-search
+        # engine, not the plain forward — score outputs exclude it
+        self._gen_name = next(
+            (n for n, l in graph.layers.items()
+             if l.type == "beam_search_group"), None)
+        score_outputs = [n for n in self.output_names
+                         if n != self._gen_name]
+        self.network = (Network(graph, outputs=score_outputs)
+                        if score_outputs else None)
+
+        if donate is None:
+            donate = jax.default_backend() in ("tpu", "gpu")
+        donate_args = (1,) if donate else ()
+
+        self.guards: List[RecompileGuard] = []
+        if self.network is not None:
+            def _fwd(p, feed):
+                outs = self.network.apply(p, feed, train=False)
+                return {n: outs[n].value for n in score_outputs}
+
+            self._infer = jax.jit(_fwd, donate_argnums=donate_args)
+            self.guards.append(RecompileGuard(
+                self._infer, warn_after=recompile_warn,
+                name="serving_infer"))
+
+        self.engine = None
+        self._encode = None
+        if self._gen_name is not None:
+            from paddle_tpu.core.generation import (
+                SequenceGenerator as EngineGenerator)
+            self.engine = EngineGenerator(graph, self._gen_name)
+            self.gen_beam_size = int(
+                gen_beam_size or self.engine.cfg.attrs.get("beam_size", 1))
+            self.gen_max_length = int(
+                gen_max_length
+                or self.engine.cfg.attrs.get("max_length", 100))
+            enc_outputs = self.engine.static_input_layers()
+            encoder = Network(graph, outputs=enc_outputs)
+
+            def _enc(p, feed):
+                outs = encoder.apply(p, feed, train=False)
+                return {n: outs[n] for n in enc_outputs}
+
+            self._encode = jax.jit(_enc, donate_argnums=donate_args)
+            self.guards.append(RecompileGuard(
+                self._encode, warn_after=recompile_warn,
+                name="serving_encode"))
+
+        self.warmed = False
+
+    # ------------------------------------------------------------ loaders
+    @classmethod
+    def from_merged(cls, path: str, feeding: Dict[str, Any],
+                    **kwargs) -> "ServingPredictor":
+        """Build from a ``--job=merge`` artifact (PTM1 file). ``feeding``
+        still comes from the config — the merged payload carries graph +
+        params + output names, not input type declarations."""
+        from paddle_tpu.trainer.merge_model import load_merged
+        graph, params, outputs = load_merged(path)
+        return cls(graph, params, outputs, feeding, **kwargs)
+
+    # ------------------------------------------------------------- warmup
+    def warmup(self, log=None) -> int:
+        """Compile every bucket variant ahead of traffic; returns the
+        number of warmup executions. Hardens all recompile guards."""
+        lengths = self.length_buckets or [None]
+        t0 = time.perf_counter()
+        runs = 0
+        for b in self.batch_buckets:
+            for ln in lengths:
+                rows = [tuple(_synth_sample(self.feeding[n], ln or 1)
+                              for n in self.names)] * b
+                if self.network is not None:
+                    self.predict_rows(rows)
+                    runs += 1
+                if self.engine is not None:
+                    self.generate_rows(rows)
+                    runs += 1
+        if self.engine is not None:
+            # the engine jits lazily per (beam, length, hooks) key; the
+            # warmup loop above populated it — bring those under guard
+            self._ensure_engine_guard()
+        for g in self.guards:
+            g.harden()
+        self.warmed = True
+        if log:
+            log(f"serving warmup: {runs} bucket variants compiled in "
+                f"{time.perf_counter() - t0:.1f}s "
+                f"(batch={self.batch_buckets}, "
+                f"length={self.length_buckets})")
+        return runs
+
+    def check_guards(self):
+        """Hot-path assertion: raises RecompileError on jit-cache growth
+        after warmup (see module docstring)."""
+        for g in self.guards:
+            g.check()
+
+    # --------------------------------------------------------- admission
+    def check_sample(self, sample):
+        """Cheap host-side admissibility check, run at enqueue time so a
+        doomed request is rejected before it occupies queue space. Raises
+        ``BadRequest``; does NOT validate value types (that is conversion
+        work, isolated per-lane at batch time)."""
+        if not isinstance(sample, (list, tuple)):
+            raise BadRequest(
+                f"sample must be a list of {len(self.names)} input "
+                f"slots ({self.names}), got {type(sample).__name__}")
+        if len(sample) != len(self.names):
+            raise BadRequest(
+                f"sample has {len(sample)} slots, the model needs "
+                f"{len(self.names)} ({self.names})")
+        for name, slot in zip(self.names, sample):
+            itype = self.feeding[name]
+            if not _is_seq(itype):
+                continue
+            if not isinstance(slot, (list, tuple, np.ndarray)):
+                raise BadRequest(
+                    f"input {name!r} is a sequence slot; got "
+                    f"{type(slot).__name__}")
+            n = len(slot)
+            if self.max_seq_len is not None and n > self.max_seq_len:
+                raise BadRequest(
+                    f"input {name!r} has length {n}, beyond the largest "
+                    f"warmed length bucket {self.max_seq_len}; serving "
+                    "shapes are a closed menu (no hot-path compiles)")
+
+    def padding_row(self) -> tuple:
+        """A synthetic all-padding row (what batch-bucket padding uses);
+        the batcher swaps it in for a malformed lane."""
+        return tuple(_synth_sample(self.feeding[n], 1) for n in self.names)
+
+    def probe_rows(self, rows) -> List[Optional[Exception]]:
+        """Per-lane conversion probe for the malformed-batch error path:
+        converts each row alone (padded to the smallest batch bucket with
+        synthetic rows) and returns its exception, or None when clean.
+        Only runs after a full-batch conversion already failed, so the
+        per-row cost is off the happy path."""
+        pad = [self.padding_row()] * (self.batch_buckets[0] - 1)
+        out: List[Optional[Exception]] = []
+        for row in rows:
+            try:
+                self.feeder([tuple(row)] + pad)
+                out.append(None)
+            except Exception as e:  # noqa: BLE001 — typed by the caller
+                out.append(e)
+        return out
+
+    # ------------------------------------------------------------ scoring
+    def _convert(self, rows, lane_valid=None):
+        """rows -> feed dict through the bucketing feeder. ``lane_valid``
+        (bool per row) zeroes the row mask of known-bad lanes so they are
+        exact padding."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.data.feeder import ROW_MASK_KEY
+        feed = self.feeder(list(rows))
+        if lane_valid is not None and ROW_MASK_KEY in feed:
+            mask = feed[ROW_MASK_KEY]
+            lv = np.ones(mask.value.shape[0], dtype=np.float32)
+            lv[:len(lane_valid)] = np.asarray(lane_valid, np.float32)
+            feed[ROW_MASK_KEY] = mask.replace(
+                value=mask.value * jnp.asarray(lv))
+        return feed
+
+    def _bucket_key(self, feed) -> Tuple[str, int]:
+        """(metrics bucket label, padded row count) for a converted
+        feed."""
+        first = feed[self.names[0]].value
+        padded = int(first.shape[0])
+        key = f"b{padded}"
+        for n in self.names:
+            if _is_seq(self.feeding[n]):
+                key += f"_t{int(feed[n].value.shape[1])}"
+                break
+        return key, padded
+
+    def predict_rows(self, rows: List[tuple], lane_valid=None):
+        """Score a bucketed batch. Returns ``(outs, info)`` where
+        ``outs`` maps output layer name -> np array over the PADDED
+        batch (caller slices real lanes) and ``info`` carries
+        ``{bucket, padded_rows, pad_ms, compute_ms}``."""
+        if self.network is None:
+            raise BadRequest("this model has no scoring outputs "
+                             "(generation-only config)")
+        t0 = time.perf_counter()
+        feed = self._convert(rows, lane_valid)
+        key, padded = self._bucket_key(feed)
+        t1 = time.perf_counter()
+        out = self._infer(self.params, feed)
+        out = {n: np.asarray(v) for n, v in out.items()}  # host fetch
+        t2 = time.perf_counter()
+        if self.warmed:
+            self.check_guards()
+        return out, {"bucket": key, "padded_rows": padded,
+                     "pad_ms": (t1 - t0) * 1e3,
+                     "compute_ms": (t2 - t1) * 1e3}
+
+    # --------------------------------------------------------- generation
+    def check_gen_opts(self, beam_size=None, max_length=None):
+        """Serving pins ONE (beam_size, max_length) pair at warmup — any
+        other pair would be a hot-path compile, so it is inadmissible."""
+        if self.engine is None:
+            raise BadRequest("this model has no generation group")
+        if beam_size is not None and int(beam_size) != self.gen_beam_size:
+            raise BadRequest(
+                f"beam_size={beam_size} is not the warmed value "
+                f"{self.gen_beam_size} (closed shape menu)")
+        if (max_length is not None
+                and int(max_length) != self.gen_max_length):
+            raise BadRequest(
+                f"max_length={max_length} is not the warmed value "
+                f"{self.gen_max_length} (closed shape menu)")
+
+    def generate_rows(self, rows: List[tuple], lane_valid=None):
+        """Beam-search a bucketed batch of encoder inputs. Returns
+        ``((tokens, scores, lengths), info)`` — each np, [B, K, ...] over
+        the padded batch. Config-pinned beam-control hooks apply (the
+        engine reads them from the group attrs)."""
+        if self.engine is None:
+            raise BadRequest("this model has no generation group")
+        t0 = time.perf_counter()
+        feed = self._convert(rows, lane_valid)
+        key, padded = self._bucket_key(feed)
+        t1 = time.perf_counter()
+        outer = self._encode(self.params, feed)
+        tokens, scores, lengths = self.engine.generate(
+            self.params, outer, beam_size=self.gen_beam_size,
+            max_length=self.gen_max_length)
+        tokens, scores, lengths = (np.asarray(tokens), np.asarray(scores),
+                                   np.asarray(lengths))
+        t2 = time.perf_counter()
+        if self.warmed:
+            # the serving key set is pinned and fully populated at
+            # warmup (warmup() ran _ensure_engine_guard) — only the
+            # cheap cache-size check belongs on the hot path
+            self.check_guards()
+        return (tokens, scores, lengths), {
+            "bucket": key + f"_k{self.gen_beam_size}",
+            "padded_rows": padded,
+            "pad_ms": (t1 - t0) * 1e3,
+            "compute_ms": (t2 - t1) * 1e3}
+
+    def _ensure_engine_guard(self):
+        from paddle_tpu.data.prefetch import RecompileGuard
+        watched = {id(g.fn) for g in self.guards}
+        for fn in self.engine._jitted.values():
+            if id(fn) not in watched:
+                g = RecompileGuard(fn, name="serving_generate")
+                g.harden()
+                self.guards.append(g)

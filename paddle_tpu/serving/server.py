@@ -1,0 +1,222 @@
+"""Threaded HTTP/JSON frontend over the serving engine. Stdlib only.
+
+Endpoints:
+
+- ``POST /v1/score``     — ``{"sample": [...slot values...],
+  "deadline_ms": 50}`` or ``{"rows": [[...], ...]}`` (each row becomes
+  one engine request; the batcher coalesces them). Answer:
+  ``{"outputs": {layer: row_values}}`` / ``{"results": [...]}``.
+- ``POST /v1/generate``  — ``{"sample": [...], "beam_size": K,
+  "max_length": L}`` (beam/max_length must match the warmed pair).
+  Answer: ``{"sequences": [{"tokens": [...], "score": s}, ...]}``.
+- ``GET /healthz``       — liveness + readiness: warmup state, queue
+  depth, drain state, worker fatal error if any.
+- ``GET /metrics``       — Prometheus text
+  (``serving/metrics.py:to_prometheus``); ``/metrics?format=json`` for
+  the structured snapshot.
+
+Error mapping is the typed contract (``serving/errors.py``): 400
+bad_request, 429 overloaded/shutting_down (with a ``Retry-After``
+header), 504 deadline_exceeded — a malformed or late request is never a
+500. SIGTERM (``install_signal_handlers``) closes admission, lets
+in-flight work finish, then stops the listener — the rolling-restart
+contract a fleet scheduler expects.
+"""
+
+from __future__ import annotations
+
+import json
+import signal
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from paddle_tpu.serving.batcher import ServingEngine
+from paddle_tpu.serving.errors import BadRequest, ServingError
+from paddle_tpu.utils.log import get_logger
+
+logger = get_logger("serving.http")
+
+
+class ServingHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, addr, engine: ServingEngine):
+        super().__init__(addr, _Handler)
+        self.engine = engine
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    # ------------------------------------------------------------ plumbing
+    def log_message(self, fmt, *args):  # stderr spam -> debug log
+        logger.debug("%s " + fmt, self.address_string(), *args)
+
+    def _send(self, status: int, body: dict,
+              content_type: str = "application/json",
+              retry_after_ms: Optional[float] = None):
+        data = (body if isinstance(body, bytes)
+                else json.dumps(body).encode())
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        if retry_after_ms is not None:
+            # Retry-After is whole seconds; keep sub-second hints in the
+            # JSON body's retry_after_ms
+            self.send_header("Retry-After",
+                             str(max(1, round(retry_after_ms / 1e3))))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _send_error(self, e: ServingError):
+        self._send(e.status, e.to_wire(), retry_after_ms=e.retry_after_ms)
+
+    def _body(self) -> dict:
+        n = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(n) if n else b""
+        try:
+            body = json.loads(raw or b"{}")
+        except json.JSONDecodeError as e:
+            raise BadRequest(f"request body is not JSON: {e}") from e
+        if not isinstance(body, dict):
+            raise BadRequest("request body must be a JSON object")
+        return body
+
+    # ------------------------------------------------------------ GET
+    def do_GET(self):
+        engine = self.server.engine
+        path = self.path.split("?", 1)[0]
+        if path == "/healthz":
+            ok = (engine.predictor.warmed and engine.fatal is None
+                  and not engine.draining)
+            self._send(200 if ok else 503, {
+                "status": "ok" if ok else (
+                    "draining" if engine.draining else "unhealthy"),
+                "warmed": engine.predictor.warmed,
+                "draining": engine.draining,
+                "queue_depth": engine.queue_len(),
+                "fatal": repr(engine.fatal) if engine.fatal else None,
+            })
+        elif path == "/metrics":
+            if "format=json" in self.path:
+                self._send(200, engine.metrics.snapshot())
+            else:
+                self._send(200, engine.metrics.to_prometheus().encode(),
+                           content_type="text/plain; version=0.0.4")
+        else:
+            self._send(404, {"error": {"code": "not_found",
+                                       "message": self.path}})
+
+    # ------------------------------------------------------------ POST
+    def do_POST(self):
+        engine = self.server.engine
+        path = self.path.split("?", 1)[0]
+        kind = {"/v1/score": "score", "/v1/generate": "generate"}.get(path)
+        if kind is None:
+            self._send(404, {"error": {"code": "not_found",
+                                       "message": self.path}})
+            return
+        try:
+            body = self._body()
+            deadline_ms = body.get("deadline_ms")
+            gen_opts = {}
+            if kind == "generate":
+                gen_opts = {"beam_size": body.get("beam_size"),
+                            "max_length": body.get("max_length")}
+            if "rows" in body:
+                if not isinstance(body["rows"], list) or not body["rows"]:
+                    raise BadRequest("\"rows\" must be a non-empty list")
+                # per-row contract: one row's admission failure (typed
+                # 400/429) must not abort its siblings — its slot
+                # carries the error body, the rest still serve
+                reqs = []
+                for row in body["rows"]:
+                    try:
+                        reqs.append(engine.submit(
+                            row, kind=kind, deadline_ms=deadline_ms,
+                            **gen_opts))
+                    except ServingError as e:
+                        reqs.append(e)
+                results = []
+                from paddle_tpu.serving.errors import DeadlineExceeded
+                any_err = False
+                for r in reqs:
+                    if isinstance(r, ServingError):
+                        results.append(r.to_wire())
+                        any_err = True
+                        continue
+                    if not r.event.wait(120.0):  # never block a handler
+                        r.error = DeadlineExceeded(
+                            "no answer within the server wait bound")
+                    any_err = any_err or r.error is not None
+                    results.append(r.error.to_wire() if r.error
+                                   else r.result)
+                self._send(200 if not any_err else 207,  # multi-status
+                           {"results": results})
+                return
+            if "sample" not in body:
+                raise BadRequest("need \"sample\" (one request) or "
+                                 "\"rows\" (a list)")
+            result = engine.infer(body["sample"], kind=kind,
+                                  deadline_ms=deadline_ms, **gen_opts)
+            self._send(200, result)
+        except ServingError as e:
+            self._send_error(e)
+        except Exception as e:  # noqa: BLE001 — the only 500 source
+            logger.error("unhandled serving error: %r", e)
+            self._send_error(ServingError(repr(e)))
+
+
+def make_server(engine: ServingEngine, host: str = "127.0.0.1",
+                port: int = 0) -> ServingHTTPServer:
+    """Bind (port=0 = ephemeral, for tests) without serving yet; the
+    bound port is ``server.server_address[1]``."""
+    return ServingHTTPServer((host, port), engine)
+
+
+def install_signal_handlers(engine: ServingEngine,
+                            server: Optional[ServingHTTPServer] = None):
+    """SIGTERM/SIGINT -> drain: close admission immediately, finish
+    in-flight and queued work, then stop the HTTP listener. Returns the
+    previous handlers (tests restore them)."""
+
+    def _drain(signum, frame):
+        logger.info("signal %d: draining", signum)
+        engine.begin_drain()
+
+        def _finish():
+            engine.shutdown(drain=True)
+            if server is not None:
+                server.shutdown()
+
+        threading.Thread(target=_finish, daemon=True,
+                         name="serving-drain").start()
+
+    prev = {}
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        prev[sig] = signal.signal(sig, _drain)
+    return prev
+
+
+def serve_forever(engine: ServingEngine, host: str = "127.0.0.1",
+                  port: int = 8000, ready_line: bool = True):
+    """CLI entry: warm up, bind, install drain handlers, serve until a
+    signal drains us."""
+    engine.start(warmup=True)
+    server = make_server(engine, host, port)
+    install_signal_handlers(engine, server)
+    if ready_line:
+        print(f"serving on http://{host}:{server.server_address[1]} "
+              f"(buckets batch={engine.predictor.batch_buckets}, "
+              f"length={engine.predictor.length_buckets}; "
+              f"max_batch={engine.max_batch}, "
+              f"batch_timeout={engine.batch_timeout_ms}ms, "
+              f"queue_depth={engine.queue_depth})", flush=True)
+    try:
+        server.serve_forever(poll_interval=0.2)
+    finally:
+        server.server_close()
+        engine.shutdown(drain=True)
+    return 0

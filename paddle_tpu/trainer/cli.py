@@ -37,7 +37,8 @@ def parse_args(argv=None):
     p.add_argument("--config", required=True,
                    help="Python model-config file (executed)")
     p.add_argument("--job", default="train",
-                   choices=["train", "test", "time", "checkgrad", "merge"])
+                   choices=["train", "test", "time", "checkgrad", "merge",
+                            "serve"])
     p.add_argument("--config_args", default="",
                    help="comma-separated k=v injected into the config")
     p.add_argument("--num_passes", type=int, default=1)
@@ -119,6 +120,34 @@ def parse_args(argv=None):
                    help="microbatches per batch under --parallel_nn "
                         "(bubble fraction = (S-1)/(S+M-1)); 0 = auto "
                         "(the stage count, or --grad_accum_steps)")
+    # --job=serve (paddle_tpu.serving): the model server
+    p.add_argument("--port", type=int, default=8000,
+                   help="--job=serve: HTTP port (0 = ephemeral)")
+    p.add_argument("--host", default="127.0.0.1",
+                   help="--job=serve: bind address")
+    p.add_argument("--batch_timeout_ms", type=float, default=5.0,
+                   help="--job=serve: how long the dynamic batcher waits "
+                        "to coalesce concurrent requests into one "
+                        "device batch")
+    p.add_argument("--max_batch", type=int, default=32,
+                   help="--job=serve: largest coalesced batch (also the "
+                        "largest warmed batch bucket; buckets double "
+                        "1,2,4,... up to it)")
+    p.add_argument("--queue_depth", type=int, default=128,
+                   help="--job=serve: bounded request queue; past the "
+                        "shed watermark new requests get a typed 429 "
+                        "with Retry-After")
+    p.add_argument("--shed_watermark", type=int, default=0,
+                   help="--job=serve: queue depth that triggers load "
+                        "shedding (0 = queue_depth)")
+    p.add_argument("--serving_length_buckets", default="32,64,128",
+                   help="--job=serve: comma-separated padded sequence "
+                        "lengths to warm (the closed shape menu); "
+                        "requests longer than the largest are rejected "
+                        "with a typed 400")
+    p.add_argument("--serving_deadline_ms", type=float, default=0,
+                   help="--job=serve: default per-request deadline "
+                        "(0 = none; requests may set their own)")
     return p.parse_args(argv)
 
 
@@ -481,6 +510,52 @@ def cmd_merge(ns, args):
     return 0
 
 
+def build_serving_engine(ns, args):
+    """--job=serve wiring, separated so tests (and embedders) can build
+    the engine without entering serve_forever. Parameter source order
+    mirrors --job=test: --init_model_path (checkpoint file, merged
+    .ptmodel, or a reference model dir), else the newest checkpoint in
+    --save_dir; the config supplies graph + feeding + outputs."""
+    from paddle_tpu.serving import ServingEngine, ServingPredictor
+    trainer = _build_trainer(ns, args)
+    if not args.init_model_path and args.save_dir:
+        from paddle_tpu.dist.checkpoint import Checkpointer
+        restored = Checkpointer(args.save_dir).restore()
+        if restored:
+            trainer.load_state(restored[0], restored[1])
+    feeding = ns.get("feeding")
+    if not isinstance(feeding, dict):
+        feeding = getattr(feeding, "feeding", None)
+    if not isinstance(feeding, dict):
+        raise SystemExit("--job=serve needs the config to define "
+                         "`feeding` (data-layer name -> InputType)")
+    outputs = ns.get("outputs")
+    names = ([o.name if hasattr(o, "name") else o for o in outputs]
+             if outputs else [ns["cost"].name])
+    max_batch = max(args.max_batch, 1)
+    batch_buckets = [1]
+    while batch_buckets[-1] < max_batch:
+        batch_buckets.append(min(batch_buckets[-1] * 2, max_batch))
+    length_buckets = [int(x) for x in filter(
+        None, str(args.serving_length_buckets).split(","))]
+    predictor = ServingPredictor(
+        trainer.topology.graph, trainer._flat_params_view(), names,
+        feeding, batch_buckets=batch_buckets,
+        length_buckets=length_buckets)
+    return ServingEngine(
+        predictor, max_batch=max_batch,
+        batch_timeout_ms=args.batch_timeout_ms,
+        queue_depth=args.queue_depth,
+        shed_watermark=args.shed_watermark or None,
+        default_deadline_ms=args.serving_deadline_ms or None)
+
+
+def cmd_serve(ns, args):
+    from paddle_tpu.serving import serve_forever
+    engine = build_serving_engine(ns, args)
+    return serve_forever(engine, host=args.host, port=args.port)
+
+
 def main(argv=None):
     args = parse_args(argv)
     if getattr(args, "fp_anomaly", False):
@@ -488,8 +563,8 @@ def main(argv=None):
         enable_fp_anomaly()
     ns = load_config(args.config, args.config_args)
     return {"train": cmd_train, "test": cmd_test, "time": cmd_time,
-            "checkgrad": cmd_checkgrad, "merge": cmd_merge}[args.job](
-                ns, args)
+            "checkgrad": cmd_checkgrad, "merge": cmd_merge,
+            "serve": cmd_serve}[args.job](ns, args)
 
 
 if __name__ == "__main__":

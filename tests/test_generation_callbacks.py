@@ -1,0 +1,237 @@
+"""Beam-control callback surface (``RecurrentGradientMachine.h:92-145``,
+VERDICT r5 Missing #2): ``drop_callback`` (per-node drop),
+``norm_or_drop`` (rescore/drop a candidate as it finishes) and
+``stop_beam_search`` (freeze the whole search), alongside the existing
+``candidate_adjust``. Each hook must provably change the N-best (prune a
+known candidate), behave identically whether passed per-call or pinned
+in the config (``dsl.beam_search``), stay consistent across step-net
+topologies (shallow vs deep step), and ride the SWIG
+``SequenceGenerator`` via ``registerBeamSearchControlCallbacks``."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.config import dsl
+from paddle_tpu.core.argument import Argument
+from paddle_tpu.core.generation import SequenceGenerator
+from paddle_tpu.core.network import Network
+
+V, E, H = 6, 4, 5
+EOS = 1
+K, L = 3, 8
+
+
+def _build(deep=False, **hooks):
+    """Tiny LM beam-search config; ``deep=True`` adds a second fc +
+    memory stage to the step net (the topology-consistency axis)."""
+    dsl.reset()
+    src = dsl.data("src", size=H)
+    boot = dsl.fc(src, size=H, act="tanh", name="boot", bias_attr=False)
+
+    def step(prev_emb):
+        m = dsl.memory(name="h", size=H, boot_layer=boot)
+        h = dsl.fc([prev_emb, m], size=H, act="tanh", name="h",
+                   bias_attr=False)
+        top = h
+        if deep:
+            m2 = dsl.memory(name="h2", size=H)
+            top = dsl.fc([h, m2], size=H, act="tanh", name="h2",
+                         bias_attr=False)
+        return dsl.fc(top, size=V, act="softmax", name="prob",
+                      bias_attr=False)
+
+    dsl.beam_search(
+        step,
+        [dsl.GeneratedInput(size=V, embedding_name="gen_emb",
+                            embedding_size=E)],
+        bos_id=0, eos_id=EOS, beam_size=K, max_length=L, name="gen",
+        **hooks)
+    return dsl.current_graph()
+
+
+def _params(graph, seed=0):
+    from paddle_tpu.core.registry import get_layer_impl
+    net = Network(graph, outputs=["boot"])
+    params = dict(net.init_params(jax.random.PRNGKey(seed)))
+    rng = np.random.RandomState(seed)
+    impl = get_layer_impl("beam_search_group")
+    for _, spec in impl.params(graph.layers["gen"], []).items():
+        params[spec.absolute_name] = jnp.asarray(
+            rng.randn(*spec.shape).astype(np.float32) * 0.7)
+    params["gen_emb"] = jnp.asarray(rng.randn(V, E).astype(np.float32))
+    return net, params
+
+
+def _outer(net, params, B=2, seed=7):
+    srcv = np.random.RandomState(seed).randn(B, H).astype(np.float32)
+    return net.apply(params, {"src": Argument(value=jnp.asarray(srcv))})
+
+
+# the hooks are module-level so config-pinning them survives pickling
+# (merged models) and so jit keys stay stable across calls
+def _drop_token(x):
+    def drop(state, total):
+        Vd = total.shape[-1]
+        return jnp.broadcast_to((jnp.arange(Vd) == x)[None, None, :],
+                                total.shape)
+    return drop
+
+
+def _boost_eos(logp, state):
+    return logp.at[:, EOS].add(5.0)
+
+
+def _min_len_4(eos_scores, length):
+    return jnp.where(length < 4, jnp.float32(-1e9), eos_scores)
+
+
+def _stop_after_2(state, t):
+    return t >= 2
+
+
+@pytest.mark.parametrize("deep", [False, True])
+def test_drop_callback_prunes_known_candidate(deep):
+    graph = _build(deep=deep)
+    net, params = _params(graph)
+    outer = _outer(net, params)
+    gen = SequenceGenerator(graph, "gen")
+    t0, s0, l0 = gen.generate(params, outer)
+    t0 = np.asarray(t0)
+    # the most common non-EOS token is a KNOWN top candidate; dropping
+    # its node at every step must remove it from every beam and change
+    # the N-best
+    from collections import Counter
+    lens0 = np.asarray(l0)
+    emitted = [int(t0[b, k, i]) for b in range(t0.shape[0])
+               for k in range(K) for i in range(int(lens0[b, k]))]
+    cnt = Counter(x for x in emitted if x != EOS)
+    x = cnt.most_common(1)[0][0]
+    t1, s1, l1 = gen.generate(params, outer,
+                              drop_callback=_drop_token(x))
+    t1, lens1 = np.asarray(t1), np.asarray(l1)
+    for b in range(t1.shape[0]):
+        for k in range(K):
+            assert x not in t1[b, k, :int(lens1[b, k])].tolist()
+    assert not np.array_equal(t0, t1)
+    # beams still sorted best-first (scores are raw cumulative logp, so
+    # nothing monotone can be said vs the baseline: pruning the dominant
+    # token can force EARLIER endings, i.e. shorter = higher scores)
+    assert (np.diff(np.asarray(s1), axis=1) <= 1e-6).all()
+
+
+def test_norm_or_drop_blocks_short_endings():
+    graph = _build()
+    net, params = _params(graph)
+    outer = _outer(net, params)
+    gen = SequenceGenerator(graph, "gen")
+    # candidate_adjust boosts EOS so the baseline ends early...
+    t0, s0, l0 = gen.generate(params, outer, candidate_adjust=_boost_eos)
+    l0 = np.asarray(l0)
+    assert (l0 < 4).any(), "baseline must contain short endings"
+    # ...then NormOrDropNode vetoes endings shorter than 4: every beam
+    # either ends at >= 4 or never ends (L)
+    t2, s2, l2 = gen.generate(params, outer, candidate_adjust=_boost_eos,
+                              norm_or_drop=_min_len_4)
+    l2, t2 = np.asarray(l2), np.asarray(t2)
+    assert (l2 >= 4).all()
+    # beams still come back sorted
+    assert (np.diff(np.asarray(s2), axis=1) <= 1e-6).all()
+
+
+def test_norm_or_drop_rescores_endings():
+    """The 'Norm' half: boosting ending scores (length-normalization
+    style) must pull EOS forward — candidates that end now outrank
+    longer continuations."""
+    graph = _build()
+    net, params = _params(graph)
+    outer = _outer(net, params)
+    gen = SequenceGenerator(graph, "gen")
+    t0, s0, l0 = gen.generate(params, outer)
+
+    def boost_end(eos_scores, length):
+        return eos_scores + 6.0
+
+    t1, s1, l1 = gen.generate(params, outer, norm_or_drop=boost_end)
+    assert (np.asarray(l1) <= np.asarray(l0)).all()
+    assert (np.asarray(l1)[:, 0] == 1).all()  # best beam ends at once
+
+
+@pytest.mark.parametrize("deep", [False, True])
+def test_stop_beam_search_freezes_search(deep):
+    graph = _build(deep=deep)
+    net, params = _params(graph)
+    outer = _outer(net, params)
+    gen = SequenceGenerator(graph, "gen")
+    l0 = np.asarray(gen.generate(params, outer)[2])
+    assert (l0 > 4).any(), "baseline must run past the stop point"
+    t1, s1, l1 = gen.generate(params, outer,
+                              stop_beam_search=_stop_after_2)
+    # frozen after step t=2 -> the forced EOS lands at position 3
+    assert (np.asarray(l1) <= 4).all()
+
+
+def test_config_pinned_hooks_match_explicit_and_serving_path():
+    """Hooks pinned via dsl.beam_search are the defaults for every
+    generate call — bit-identical to passing them explicitly — and the
+    serving generation endpoint (which only uses config defaults)
+    therefore honors them."""
+    x = 2
+    graph_plain = _build()
+    net, params = _params(graph_plain)
+    outer = _outer(net, params)
+    explicit = SequenceGenerator(graph_plain, "gen").generate(
+        params, outer, drop_callback=_drop_token(x))
+
+    graph_pinned = _build(drop_callback=_drop_token(x))
+    pinned = SequenceGenerator(graph_pinned, "gen").generate(
+        params, outer)
+    for a, b in zip(explicit, pinned):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_swig_register_beam_search_control_callbacks():
+    """``registerBeamSearchControlCallbacks`` /
+    ``removeBeamSearchControlCallbacks`` on the SWIG SequenceGenerator
+    (the reference registers them on RecurrentGradientMachine): the
+    registered drop hook changes the N-best exactly as the engine's, and
+    removal restores the unhooked answer."""
+    from paddle_tpu.compat import swig_api as api
+    graph = _build()
+    net, params = _params(graph)
+    m = api.GradientMachine.createFromConfigProto(graph)
+    m._params = dict(params)
+
+    swig_gen = m.asSequenceGenerator(max_length=L, beam_size=K)
+    src = np.random.RandomState(7).randn(2, H).astype(np.float32)
+    args = api.Arguments.createArguments(1)
+    args.setSlotValue(0, api.Matrix.createDenseFromNumpy(src))
+
+    base = swig_gen.generateSequence(args)
+    base_seqs = [base.getSequence(i) for i in range(base.getSize())]
+    flat = [t for s in base_seqs for t in s if t != EOS]
+    from collections import Counter
+    x = Counter(flat).most_common(1)[0][0]
+
+    swig_gen.registerBeamSearchControlCallbacks(
+        drop_callback=_drop_token(x))
+    hooked = swig_gen.generateSequence(args)
+    hooked_seqs = [hooked.getSequence(i) for i in range(hooked.getSize())]
+    assert all(x not in s for s in hooked_seqs)
+    assert hooked_seqs != base_seqs
+
+    # parity with the engine under the same hook
+    outer = _outer(net, params)
+    tk, sc, ln = SequenceGenerator(graph, "gen").generate(
+        params, outer, drop_callback=_drop_token(x))
+    tk, ln = np.asarray(tk), np.asarray(ln)
+    engine_seqs = [tk[b, k, :int(ln[b, k])].tolist()
+                   for b in range(tk.shape[0]) for k in range(K)]
+    assert hooked_seqs == engine_seqs
+
+    swig_gen.removeBeamSearchControlCallbacks()
+    again = swig_gen.generateSequence(args)
+    assert [again.getSequence(i)
+            for i in range(again.getSize())] == base_seqs

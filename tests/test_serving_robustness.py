@@ -1,0 +1,355 @@
+"""Serving robustness pins (ISSUE r09 satellite): deadline-exceeded is a
+typed error (504) not a 500, shed requests carry retry-after, SIGTERM
+drains in-flight work, and a malformed request coalesced into a batch
+cannot poison its neighbors (its lane is masked out, they still answer).
+The subprocess soak test (real SIGTERM against the real CLI server under
+sustained HTTP load) is marked ``slow`` to keep tier-1 within budget."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from paddle_tpu.config import dsl
+from paddle_tpu.data import dense_vector, integer_value
+from paddle_tpu.serving import (BadRequest, DeadlineExceeded, Overloaded,
+                                ServingClient, ServingEngine,
+                                ServingPredictor, ShuttingDown,
+                                install_signal_handlers, make_server)
+
+DIM, CLASSES = 6, 3
+
+
+def _predictor(vocab_check=False):
+    dsl.reset()
+    x = dsl.data(name="x", size=DIM)
+    lab = dsl.data(name="label", size=CLASSES)
+    hid = dsl.fc(input=x, size=8, act="relu", name="hid")
+    out = dsl.fc(input=hid, size=CLASSES, act="softmax", name="out")
+    dsl.classification_cost(input=out, label=lab, name="cost")
+    graph = dsl.current_graph()
+    from paddle_tpu.core.network import Network
+    params = Network(graph, outputs=["out"]).init_params(
+        jax.random.PRNGKey(0))
+    feeding = {"x": dense_vector(DIM), "label": integer_value(CLASSES)}
+    return ServingPredictor(graph, params, ["out"], feeding,
+                            batch_buckets=[1, 2, 4])
+
+
+@pytest.fixture(scope="module")
+def pred():
+    p = _predictor()
+    p.warmup()
+    return p
+
+
+def _slow(pred, delay_s):
+    """Wrap predict_rows with a synthetic stall (monkeypatching the
+    bound method on the ENGINE's view only)."""
+    orig = pred.predict_rows
+
+    def slow(rows, lane_valid=None):
+        time.sleep(delay_s)
+        return orig(rows, lane_valid)
+
+    return orig, slow
+
+
+def test_deadline_exceeded_is_typed_not_500(pred):
+    eng = ServingEngine(pred, max_batch=2, batch_timeout_ms=1.0,
+                        queue_depth=16).start(warmup=False)
+    orig, slow = _slow(pred, 0.08)
+    pred.predict_rows = slow
+    try:
+        sample = ([0.0] * DIM, 0)
+        # (a) computed-but-late: the only in-flight request, compute
+        # takes 80 ms against a 20 ms deadline
+        with pytest.raises(DeadlineExceeded):
+            eng.infer(sample, deadline_ms=20)
+        # (b) expired-in-queue: stall the worker with a long request,
+        # then enqueue one whose deadline lapses while it waits
+        first = eng.submit(sample)
+        late = eng.submit(sample, deadline_ms=10)
+        first.event.wait(30.0)
+        late.event.wait(30.0)
+        assert isinstance(late.error, DeadlineExceeded)
+        assert eng.metrics.snapshot()["deadline_exceeded_total"] >= 2
+    finally:
+        pred.predict_rows = orig
+        eng.shutdown()
+
+
+def test_deadline_exceeded_http_status_504(pred):
+    eng = ServingEngine(pred, max_batch=2, batch_timeout_ms=1.0,
+                        queue_depth=16).start(warmup=False)
+    server = make_server(eng, port=0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    orig, slow = _slow(pred, 0.08)
+    pred.predict_rows = slow
+    try:
+        client = ServingClient(port=server.server_address[1])
+        with pytest.raises(DeadlineExceeded) as ei:
+            client.score(([0.0] * DIM, 0), deadline_ms=20)
+        assert ei.value.status == 504  # typed, not a 500
+    finally:
+        pred.predict_rows = orig
+        server.shutdown()
+        eng.shutdown()
+
+
+def test_load_shedding_carries_retry_after(pred):
+    eng = ServingEngine(pred, max_batch=1, batch_timeout_ms=1.0,
+                        queue_depth=2, shed_watermark=2).start(warmup=False)
+    orig, slow = _slow(pred, 0.1)
+    pred.predict_rows = slow
+    server = make_server(eng, port=0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        sample = ([0.0] * DIM, 0)
+        admitted = []
+        shed = None
+        # flood: the worker is stalled, so the queue fills to the
+        # watermark and the next submit must shed
+        for _ in range(8):
+            try:
+                admitted.append(eng.submit(sample))
+            except Overloaded as e:
+                shed = e
+                break
+        assert shed is not None, "flood never shed"
+        assert shed.retry_after_ms and shed.retry_after_ms > 0
+        assert eng.metrics.snapshot()["shed_total"] >= 1
+        # the HTTP form: 429 + Retry-After header + typed body
+        import http.client
+        conn = http.client.HTTPConnection("127.0.0.1",
+                                          server.server_address[1],
+                                          timeout=30)
+        conn.request("POST", "/v1/score",
+                     body=json.dumps({"sample": sample}),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        if resp.status == 429:  # raced the drain of the stalled queue
+            assert resp.headers["Retry-After"]
+            assert body["error"]["code"] == "overloaded"
+            assert body["error"]["retry_after_ms"] > 0
+        conn.close()
+        for r in admitted:
+            r.event.wait(60.0)
+    finally:
+        pred.predict_rows = orig
+        server.shutdown()
+        eng.shutdown()
+
+
+def test_sigterm_drains_in_flight_work(pred):
+    """Real SIGTERM to this process: the installed handler closes
+    admission immediately (new submits -> ShuttingDown), every queued
+    request still completes, and the worker exits."""
+    eng = ServingEngine(pred, max_batch=2, batch_timeout_ms=1.0,
+                        queue_depth=32).start(warmup=False)
+    orig, slow = _slow(pred, 0.05)
+    pred.predict_rows = slow
+    prev = install_signal_handlers(eng)
+    try:
+        sample = ([0.0] * DIM, 0)
+        inflight = [eng.submit(sample) for _ in range(6)]
+        os.kill(os.getpid(), signal.SIGTERM)
+        # the handler runs in the main thread between bytecodes; give it
+        # a beat, then admission must be closed
+        deadline = time.time() + 10
+        while not eng.draining and time.time() < deadline:
+            time.sleep(0.01)
+        assert eng.draining
+        with pytest.raises(ShuttingDown):
+            eng.submit(sample)
+        # every in-flight request completes with a real answer
+        for r in inflight:
+            assert r.event.wait(60.0)
+            assert r.error is None and "outputs" in r.result
+    finally:
+        pred.predict_rows = orig
+        for sig, h in prev.items():
+            signal.signal(sig, h)
+        eng.shutdown()
+
+
+def test_malformed_lane_cannot_poison_coalesced_batch(pred):
+    """Two requests coalesced into one batch, one malformed (id outside
+    the declared label range -> host-side conversion failure): the bad
+    lane is masked out and answered BadRequest; its neighbor's answer
+    matches a clean solo run."""
+    eng = ServingEngine(pred, max_batch=4,
+                        batch_timeout_ms=120.0,  # force coalescing
+                        queue_depth=16).start(warmup=False)
+    try:
+        good_sample = (list(np.arange(DIM) / DIM), 1)
+        bad_sample = ([0.0] * DIM, 99)  # label way out of range
+        good = eng.submit(good_sample)
+        bad = eng.submit(bad_sample)
+        assert good.event.wait(60.0) and bad.event.wait(60.0)
+        assert isinstance(bad.error, BadRequest)
+        assert "99" in str(bad.error)
+        assert good.error is None
+        # the answered batch really contained both lanes
+        snap = eng.metrics.snapshot()
+        assert snap["bad_request_total"] >= 1
+        assert any(k.startswith("b2") for k in snap["bucket_hits"])
+        # neighbor parity vs a clean solo call
+        solo = eng.infer(good_sample)
+        np.testing.assert_allclose(
+            np.asarray(good.result["outputs"]["out"]),
+            np.asarray(solo["outputs"]["out"]), rtol=1e-5)
+    finally:
+        eng.shutdown()
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_worker_death_answers_in_flight_and_closes_admission():
+    """A bug escaping the batch path (e.g. a RecompileError from the
+    hardened guard) must not strand callers: the collected batch's
+    requests are answered with a typed internal error, the queue is
+    flushed, and later submits are rejected instead of enqueued into a
+    queue nothing drains."""
+    from paddle_tpu.serving.errors import ServingError
+    p = _predictor()
+    p.warmup()
+    eng = ServingEngine(p, max_batch=2, batch_timeout_ms=1.0,
+                        queue_depth=8).start(warmup=False)
+
+    def boom(rows, lane_valid=None):
+        raise RuntimeError("synthetic worker bug")
+
+    p.predict_rows = boom
+    try:
+        sample = ([0.0] * DIM, 0)
+        req = eng.submit(sample)
+        assert req.event.wait(30.0), "in-flight request left hanging"
+        assert isinstance(req.error, ServingError)
+        assert "synthetic worker bug" in str(req.error)
+        # the worker is dead; admission must say so, not enqueue
+        deadline = time.time() + 10
+        while eng.fatal is None and time.time() < deadline:
+            time.sleep(0.01)
+        with pytest.raises(ServingError) as ei:
+            eng.submit(sample)
+        assert not isinstance(ei.value, (Overloaded, BadRequest))
+        assert eng.metrics.snapshot()["internal_error_total"] >= 1
+    finally:
+        eng.shutdown()
+
+
+def test_draining_healthz_and_shutdown_idempotent(pred):
+    eng = ServingEngine(pred, batch_timeout_ms=1.0).start(warmup=False)
+    server = make_server(eng, port=0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        client = ServingClient(port=server.server_address[1])
+        assert client.healthz()["status"] == "ok"
+        eng.begin_drain()
+        from paddle_tpu.serving.errors import ServingError
+        try:
+            h = client.healthz()
+            status = h["status"]
+        except ServingError as e:  # 503 surfaces as typed error
+            status = "draining" if e.status == 503 else "?"
+        assert status == "draining"
+        eng.shutdown()
+        eng.shutdown()  # idempotent
+    finally:
+        server.shutdown()
+
+
+SOAK_CONFIG = textwrap.dedent("""
+    import numpy as np
+    from paddle_tpu.config import dsl
+    from paddle_tpu.data.types import dense_vector, integer_value
+    from paddle_tpu.optim import Momentum
+
+    x = dsl.data(name="x", size=6)
+    lab = dsl.data(name="label", size=3)
+    hid = dsl.fc(input=x, size=8, act="relu", name="hid")
+    out = dsl.fc(input=hid, size=3, act="softmax", name="out")
+    cost = dsl.classification_cost(input=out, label=lab)
+    outputs = [out]
+    optimizer = Momentum(learning_rate=0.1, momentum=0.9)
+    feeding = {"x": dense_vector(6), "label": integer_value(3)}
+
+    def train_reader():
+        rng = np.random.RandomState(0)
+        yield [(rng.randn(6).astype(np.float32), 0) for _ in range(8)]
+""")
+
+
+@pytest.mark.slow
+def test_serving_soak_sigterm_subprocess(tmp_path):
+    """The full production exit path, out of process: the real CLI
+    server under sustained HTTP load receives a real SIGTERM, finishes
+    what it accepted, and exits 0."""
+    config = tmp_path / "conf.py"
+    config.write_text(SOAK_CONFIG)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.trainer.cli",
+         "--config", str(config), "--job", "serve", "--port", "0",
+         "--max_batch", "4", "--batch_timeout_ms", "2",
+         "--queue_depth", "64"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd="/root/repo")
+    try:
+        # the ready line carries the ephemeral port
+        line = ""
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            line = proc.stdout.readline()
+            if line.startswith("serving on http://"):
+                break
+        assert line.startswith("serving on http://"), line
+        port = int(line.split("http://127.0.0.1:")[1].split(" ")[0])
+        client = ServingClient(port=port, timeout=60)
+        stop = threading.Event()
+        answered, errors = [], []
+
+        def load():
+            rng = np.random.RandomState(1)
+            while not stop.is_set():
+                try:
+                    r = client.score((rng.randn(6).tolist(), 0))
+                    answered.append(r)
+                except Exception as e:  # noqa: BLE001 — counted
+                    errors.append(e)
+                    time.sleep(0.01)
+
+        threads = [threading.Thread(target=load) for _ in range(3)]
+        for t in threads:
+            t.start()
+        time.sleep(3.0)  # sustained load
+        assert client.healthz()["status"] == "ok"
+        proc.send_signal(signal.SIGTERM)
+        time.sleep(1.0)
+        stop.set()
+        for t in threads:
+            t.join(30.0)
+        rc = proc.wait(timeout=120)
+        assert rc == 0
+        assert len(answered) > 10  # the soak really served traffic
+        # post-SIGTERM failures must be typed (ShuttingDown / conn
+        # reset), never a 500 body
+        from paddle_tpu.serving.errors import ServingError
+        for e in errors:
+            if isinstance(e, ServingError):
+                assert e.status != 500
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait()
