@@ -642,6 +642,179 @@ def bench_serving(n_requests=None, rounds=None):
     return res
 
 
+def bench_decode(rounds=None, calls=None):
+    """Decode A/B (two axes, interleaved best-of-R per CLAUDE.md's
+    host-drift rule):
+
+    1. **Early-exit chunked search vs full scan** — the same beam search
+       over a short-output workload (every request finishes in <= 2
+       steps, max_length 64): the chunked ``lax.while_loop`` search
+       exits at the first chunk boundary where every beam finished, so
+       it pays ~chunk steps where the full scan pays 64. Tokens/scores
+       are asserted byte-identical between modes (the exactness claim of
+       ``docs/generation.md``), and steps-executed are reported.
+    2. **Continuous batching vs convoy batching** — the same serving
+       engine over a mixed burst (mostly-short + a long tail): convoy
+       mode holds every coalesced batch until its slowest lane's search
+       returns; continuous mode retires finished lanes and admits queued
+       requests at every chunk boundary. Completed-requests/s, plus lane
+       occupancy / mid-decode admissions / steps saved from the metrics
+       plane, and the hardened-guard recompile assertion for both.
+
+    The decode model is length-controlled by construction (EOS logit =
+    3 * sum(memory), memory boots from tanh(2*src)): positive src
+    finishes in <= 2 steps, negative src never emits EOS and runs the
+    full max_length — a deterministic convoy workload with margins too
+    fat for cross-batch-width numeric drift to flip a token. CPU-runnable
+    (``python bench.py --decode`` -> BENCH_r10.json); rides the TPU
+    capture as a child extra."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.config import dsl
+    from paddle_tpu.core.generation import SequenceGenerator
+    from paddle_tpu.core.network import Network
+    from paddle_tpu.core.registry import get_layer_impl
+    from paddle_tpu.data import dense_vector
+    from paddle_tpu.serving import ServingEngine, ServingPredictor
+
+    rounds = int(os.environ.get("BENCH_DECODE_ROUNDS", "3")
+                 if rounds is None else rounds)
+    calls = int(os.environ.get("BENCH_DECODE_CALLS", "4")
+                if calls is None else calls)
+    # sized so step compute (not per-chunk host dispatch) dominates on
+    # the 1-core host — the regime a real accelerator is always in
+    V, E, H, K, L, CHUNK, B = 2048, 64, 256, 4, 64, 8, 8
+
+    dsl.reset()
+    src = dsl.data("src", size=H)
+    boot = dsl.fc(src, size=H, act="tanh", name="boot", bias_attr=False)
+
+    def step(prev_emb):
+        m = dsl.memory(name="h", size=H, boot_layer=boot)
+        h = dsl.fc([prev_emb, m], size=H, act="tanh", name="h",
+                   bias_attr=False)
+        return dsl.fc(h, size=V, act="softmax", name="prob",
+                      bias_attr=False)
+
+    dsl.beam_search(
+        step, [dsl.GeneratedInput(size=V, embedding_name="gen_emb",
+                                  embedding_size=E)],
+        bos_id=0, eos_id=1, beam_size=K, max_length=L, name="gen")
+    graph = dsl.current_graph()
+    net = Network(graph, outputs=["boot"])
+    params = dict(net.init_params(jax.random.PRNGKey(0)))
+    boot_key = next(k for k in params if "boot" in k)
+    params[boot_key] = jnp.asarray(2.0 * np.eye(H, dtype=np.float32))
+    for _, spec in get_layer_impl("beam_search_group").params(
+            graph.layers["gen"], []).items():
+        params[spec.absolute_name] = jnp.zeros(spec.shape, jnp.float32)
+    params["_h.w1"] = jnp.asarray(np.eye(H, dtype=np.float32))
+    u = np.zeros((H, V), np.float32)
+    u[:, 1] = 3.0
+    params["_prob.w0"] = jnp.asarray(u)
+    params["gen_emb"] = jnp.zeros((V, E), jnp.float32)
+
+    res = {"decode_max_length": L, "decode_chunk": CHUNK,
+           "decode_beam": K, "decode_batch": B}
+
+    # ---- axis 1: chunked early-exit vs full scan ---------------------
+    from paddle_tpu.core.argument import Argument
+    gen = SequenceGenerator(graph, "gen")
+    srcv = jnp.asarray(np.ones((B, H), np.float32))  # all-short workload
+    outer = net.apply(params, {"src": Argument(value=srcv)})
+
+    def run_gen(full_scan):
+        t, s, ln = gen.generate(params, outer, full_scan=full_scan,
+                                decode_chunk=CHUNK)
+        jax.block_until_ready(s)
+        return np.asarray(t), np.asarray(s), gen.last_info
+
+    full_out = run_gen(True)       # also warms both compiles
+    chunk_out = run_gen(False)
+    res["decode_bitwise_identical"] = bool(
+        np.array_equal(full_out[0], chunk_out[0])
+        and np.array_equal(full_out[1], chunk_out[1]))
+    res["decode_steps_full"] = full_out[2]["decode_steps"]
+    res["decode_steps_chunked"] = chunk_out[2]["decode_steps"]
+    best = {"full": 0.0, "chunked": 0.0}
+    for _ in range(rounds):
+        for mode, fs in (("full", True), ("chunked", False)):
+            t0 = time.perf_counter()
+            for _ in range(calls):
+                run_gen(fs)
+            dt = time.perf_counter() - t0
+            best[mode] = max(best[mode], calls * B / dt)
+    res["decode_full_scan_gen_per_s"] = round(best["full"], 2)
+    res["decode_chunked_gen_per_s"] = round(best["chunked"], 2)
+    res["decode_chunked_vs_full_scan"] = round(
+        best["chunked"] / max(best["full"], 1e-9), 3)
+
+    # ---- axis 2: continuous vs convoy batching -----------------------
+    n_requests = int(os.environ.get("BENCH_DECODE_REQUESTS", "32"))
+    rng = np.random.RandomState(0)
+    samples = [(([-1.0] * H,) if rng.rand() < 0.2 else ([1.0] * H,))
+               for _ in range(n_requests)]
+
+    def build(continuous):
+        pred = ServingPredictor(graph, params, ["gen"],
+                                {"src": dense_vector(H)},
+                                batch_buckets=[1, 2, 4, 8],
+                                gen_decode_chunk=CHUNK)
+        return ServingEngine(pred, max_batch=8, batch_timeout_ms=2.0,
+                             queue_depth=n_requests + 8,
+                             continuous_batching=continuous).start()
+
+    engines = {"continuous": build(True), "convoy": build(False)}
+    best = {}
+    for _ in range(rounds):
+        for mode, eng in engines.items():
+            t0 = time.perf_counter()
+            reqs = [eng.submit(s, kind="generate") for s in samples]
+            answered = [r.event.wait(300.0) for r in reqs]
+            dt = time.perf_counter() - t0
+            ok = sum(1 for got, r in zip(answered, reqs)
+                     if got and r.error is None)
+            best[mode] = max(best.get(mode, 0.0), ok / dt)
+    res["serving_convoy_rps"] = round(best["convoy"], 2)
+    res["serving_continuous_rps"] = round(best["continuous"], 2)
+    res["serving_continuous_vs_convoy_rps"] = round(
+        best["continuous"] / max(best["convoy"], 1e-9), 3)
+    for mode, eng in engines.items():
+        snap = eng.metrics.snapshot()
+        res[f"serving_{mode}_decode_steps_p50"] = snap["decode_steps"]["p50"]
+        res[f"serving_{mode}_steps_saved_total"] = (
+            snap["decode_steps_saved_total"])
+        # the hardened guard raises (killing the worker) on any hot-path
+        # compile — a clean worker proves zero; a dead one is recorded
+        res[f"serving_{mode}_hot_path_recompiles"] = (
+            0 if eng.fatal is None else repr(eng.fatal)[:120])
+    res["serving_continuous_lane_occupancy"] = (
+        engines["continuous"].metrics.snapshot()["lane_occupancy"]["mean"])
+    res["serving_continuous_admissions"] = (
+        engines["continuous"].metrics.counters[
+            "continuous_admissions_total"])
+    for eng in engines.values():
+        eng.shutdown()
+    return res
+
+
+def decode_main():
+    """``python bench.py --decode``: the off-tunnel decode A/B alone,
+    forced onto CPU; one JSON line, mirrored to BENCH_r10.json."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    result = {"metric": "decode_early_exit_continuous_batching_ab",
+              "platform": jax.devices()[0].platform}
+    result.update(bench_decode())
+    line = json.dumps(result)
+    print(line, flush=True)
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, "BENCH_r10.json"), "w") as f:
+        f.write(line + "\n")
+    return 0
+
+
 def serving_main():
     """``python bench.py --serving``: the off-tunnel serving A/B alone,
     forced onto CPU; one JSON line, mirrored to BENCH_r09.json."""
@@ -791,6 +964,11 @@ def child_main():
     # serving A/B over the real chip: dynamic batching vs batch-size-1
     # (off-tunnel number: BENCH_r09.json via --serving)
     extra("serving", bench_serving)
+    # decode A/B: early-exit chunked search vs full scan + continuous vs
+    # convoy batching — armed here so the next tpu_watch.sh capture
+    # window records on-chip decode numbers for free (off-tunnel number:
+    # BENCH_r10.json via --decode)
+    extra("decode", bench_decode)
     return 0
 
 
@@ -803,6 +981,8 @@ def main():
         return pipeline_main()
     if "--serving" in sys.argv[1:]:
         return serving_main()
+    if "--decode" in sys.argv[1:]:
+        return decode_main()
     if os.environ.get("BENCH_CHILD") == "1":
         return child_main()
 

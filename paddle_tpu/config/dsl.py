@@ -671,6 +671,7 @@ def beam_search(step, input, *, bos_id: int = None, eos_id: int = None,
                 beam_size: int = 5, max_length: int = 100,
                 candidate_adjust=None, drop_callback=None,
                 norm_or_drop=None, stop_beam_search=None,
+                decode_chunk: int = None, full_scan: bool = False,
                 name: str = None) -> LayerOutput:
     """Generation-mode recurrent group (``beam_search`` in the reference
     DSL; executed by ``RecurrentGradientMachine::generateSequence``). The
@@ -687,7 +688,14 @@ def beam_search(step, input, *, bos_id: int = None, eos_id: int = None,
     the SWIG surface and the serving generation endpoint. They are traced
     into the jitted search; use module-level functions (not lambdas) if
     the model will be merged for deployment (``--job=merge`` pickles the
-    graph)."""
+    graph).
+
+    ``decode_chunk`` / ``full_scan`` pin the early-exit decode policy
+    (``docs/generation.md``): the search runs ``decode_chunk`` steps per
+    compiled chunk and exits as soon as every beam finished (byte-
+    identical to the full scan, cost proportional to actual output
+    length); ``full_scan=True`` pins the single length-``max_length``
+    scan."""
     global _GRAPH, _GROUP_CTX
     from paddle_tpu.config.model_config import ModelDef as _ModelDef
     inputs = list(input) if isinstance(input, (list, tuple)) else [input]
@@ -751,7 +759,8 @@ def beam_search(step, input, *, bos_id: int = None, eos_id: int = None,
                "candidate_adjust": candidate_adjust,
                "drop_callback": drop_callback,
                "norm_or_drop": norm_or_drop,
-               "stop_beam_search": stop_beam_search})
+               "stop_beam_search": stop_beam_search,
+               "decode_chunk": decode_chunk, "full_scan": full_scan})
     return _add(ldef)
 
 

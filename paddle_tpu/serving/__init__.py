@@ -9,7 +9,12 @@ C API loads) served over HTTP with
   never a hot-path XLA compile),
 - a dynamic micro-batching engine with per-request deadlines, admission
   control / load shedding, drain-on-SIGTERM, and per-lane isolation of
-  malformed requests,
+  malformed requests — plus continuous batching for the generate path
+  (``continuous_batching=True`` / ``--serving_continuous_batching``):
+  finished lanes retire and queued requests are admitted at every
+  ``decode_chunk`` boundary of the early-exit beam search, so one slow
+  request no longer convoys its batch and deadlines are enforced
+  mid-decode,
 - a metrics plane splitting request latency into
   {queue_wait, pad_overhead, compute, decode} with batch occupancy and
   per-bucket hit counts, on ``/metrics`` + ``/healthz``.

@@ -28,12 +28,24 @@ Production behaviors, all typed (``serving/errors.py``):
   out per-lane, replaced with synthetic padding rows, and their row-mask
   lanes zeroed; the bad request alone gets ``BadRequest``, its
   neighbors' answers are bit-identical to a clean batch's.
+- **Continuous batching** (``continuous_batching=True``) — the generate
+  path stops being convoy-scheduled. Instead of holding a coalesced
+  batch until the slowest lane's full-length beam search returns, the
+  worker drives a fixed-width :class:`~paddle_tpu.core.generation.
+  DecodeSession` chunk by chunk: at every chunk boundary finished lanes
+  retire (their callers answered immediately), expired lanes are
+  answered ``DeadlineExceeded`` *mid-decode* and freed, and queued
+  generate requests are admitted into the freed slots — each encoded
+  ONCE at admission and spliced into the live decode state. One slow
+  request no longer convoys its batch, and a deadline is enforceable at
+  chunk granularity instead of batch granularity.
 """
 
 from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Dict, List, Optional
 
 from paddle_tpu.serving.errors import (BadRequest, DeadlineExceeded,
@@ -71,6 +83,7 @@ class ServingEngine:
                  batch_timeout_ms: float = 5.0, queue_depth: int = 64,
                  shed_watermark: Optional[int] = None,
                  default_deadline_ms: Optional[float] = None,
+                 continuous_batching: bool = False,
                  metrics: Optional[ServingMetrics] = None):
         self.predictor = predictor
         self.max_batch = int(max_batch or predictor.batch_buckets[-1])
@@ -85,6 +98,8 @@ class ServingEngine:
         self.shed_watermark = min(int(shed_watermark or queue_depth),
                                   self.queue_depth)
         self.default_deadline_ms = default_deadline_ms
+        self.continuous_batching = bool(continuous_batching)
+        self._session = None  # DecodeSession, built in start()
         self.metrics = metrics or ServingMetrics()
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -98,6 +113,22 @@ class ServingEngine:
     def start(self, warmup: bool = True) -> "ServingEngine":
         if warmup and not self.predictor.warmed:
             self.predictor.warmup(log=logger.info)
+        if self.continuous_batching and self._session is None:
+            if getattr(self.predictor, "engine", None) is None:
+                logger.warning(
+                    "continuous_batching requested but the model has no "
+                    "generation group — standing down to plain batching")
+                self.continuous_batching = False
+            else:
+                # one warmed fixed-width session for the engine lifetime;
+                # its three device programs come under hardened guards
+                # inside build_session (None = the predictor stood down
+                # with its own warning, e.g. bucket-dependent static
+                # shapes)
+                self._session = self.predictor.build_session(
+                    self.max_batch)
+                if self._session is None:
+                    self.continuous_batching = False
         self._thread = threading.Thread(target=self._work,
                                         name="serving-batcher", daemon=True)
         self._thread.start()
@@ -256,7 +287,11 @@ class ServingEngine:
                     logger.info("serving: worker drained and stopped")
                     return
                 if batch:
-                    self._run_batch(batch)
+                    if (self._session is not None
+                            and batch[0].kind == "generate"):
+                        self._run_generate_continuous(batch)
+                    else:
+                        self._run_batch(batch)
             except BaseException as e:  # noqa: BLE001 — a worker bug
                 self.fatal = e
                 logger.error("serving worker died: %r", e)
@@ -275,6 +310,170 @@ class ServingEngine:
                     self._queue.clear()
                 self.metrics.inc("internal_error_total")
                 raise
+
+    # ------------------------------------------------- continuous decode
+    def _steal_queued(self, kind: str, n: int) -> List[_Request]:
+        """Pop up to ``n`` queued requests of ``kind`` (expiring stale
+        ones first) — the chunk-boundary admission path. Draining does
+        not close this: queued work is answered during drain.
+
+        Fairness: when a request of another kind is waiting, nothing is
+        stolen — the continuous loop then drains its live lanes and
+        returns to ``_collect``, which serves the queue head in arrival
+        order. Without this, sustained generate traffic keeping one lane
+        live forever would starve queued scoring requests."""
+        if n <= 0:
+            return []
+        with self._cond:
+            self._expire_locked(time.perf_counter())
+            if any(r.kind != kind for r in self._queue):
+                return []
+            take = [r for r in self._queue if r.kind == kind][:n]
+            for r in take:
+                self._queue.remove(r)
+            if take:
+                self._cond.notify_all()
+            return take
+
+    def _admit_lane(self, sess, lane: int, req: _Request,
+                    now: float) -> bool:
+        """Encode one request and splice it into ``lane``. Admission is
+        inherently per-request, so a malformed request fails ALONE here
+        (typed 400) — the continuous path gets lane isolation for free,
+        no probe pass needed. Only the feeder/encode conversion is
+        client-attributable; a failure in ``sess.admit`` is a server bug
+        and propagates to the worker-fatal path, never a 400."""
+        t0 = time.perf_counter()
+        try:
+            outer = self.predictor.encode_rows([req.sample])
+        except ServingError as e:
+            req.error = e
+            req.event.set()
+            self.metrics.inc("bad_request_total")
+            return False
+        except (ValueError, TypeError, KeyError) as e:
+            req.error = BadRequest(str(e))
+            req.event.set()
+            self.metrics.inc("bad_request_total")
+            return False
+        sess.admit(lane, outer, row=0)
+        req.timings["queue_wait"] = 1e3 * (now - req.enqueue_t)
+        req.timings["pad_overhead"] = 1e3 * (time.perf_counter() - t0)
+        req.timings["compute"] = 0.0
+        return True
+
+    def _retire_lane(self, sess, lane: int, req: _Request):
+        """Answer a finished lane and free it."""
+        td0 = time.perf_counter()
+        tokens, scores, lengths, steps = sess.peek(lane)
+        sess.release(lane)
+        req.result = {"sequences": [
+            {"tokens": tokens[k, :int(lengths[k])].tolist(),
+             "score": float(scores[k])}
+            for k in range(tokens.shape[0])]}
+        now = time.perf_counter()
+        req.timings["decode"] = 1e3 * (now - td0)
+        self.metrics.observe_decode(steps, sess.L - steps)
+        if req.expired(now):
+            req.error = DeadlineExceeded(
+                "computed, but past the deadline "
+                f"(total {1e3 * (now - req.enqueue_t):.1f} ms)")
+            self.metrics.inc("deadline_exceeded_total")
+        else:
+            self.metrics.observe_request(req.timings)
+        req.event.set()
+        # per-request service time (admission -> retire; queue wait
+        # excluded, or the drain estimate would double-count backlog
+        # when _retry_after_ms multiplies by queued batches) feeds the
+        # estimator — there is no whole-batch wall time in continuous
+        # mode
+        service_ms = max(0.0, 1e3 * (now - req.enqueue_t)
+                         - req.timings.get("queue_wait", 0.0))
+        self._batch_ewma_ms += 0.25 * (service_ms - self._batch_ewma_ms)
+
+    def _run_generate_continuous(self, reqs: List[_Request]):
+        """Drive the decode session until the seed batch AND everything
+        admitted from the queue at chunk boundaries is answered. Returns
+        to ``_collect`` (scoring requests interleave there) only when no
+        generate lane is live."""
+        sess = self._session
+        pending = deque(reqs)
+        lanes: Dict[int, _Request] = {}
+        started = False
+        try:
+            while True:
+                # ---- admit into free lanes: seed batch first, then the
+                # queue (mid-decode admission, the anti-convoy move)
+                free = deque(sess.free_lanes())
+                while free:
+                    if not pending:
+                        pending.extend(
+                            self._steal_queued("generate", len(free)))
+                        if not pending:
+                            break
+                    req = pending.popleft()
+                    now = time.perf_counter()
+                    if req.expired(now):
+                        req.error = DeadlineExceeded(
+                            "deadline passed while queued "
+                            f"(queued {1e3 * (now - req.enqueue_t):.1f} "
+                            "ms)")
+                        req.event.set()
+                        self.metrics.inc("deadline_exceeded_total")
+                        continue
+                    lane = free.popleft()
+                    if self._admit_lane(sess, lane, req, now):
+                        lanes[lane] = req
+                        if started:
+                            self.metrics.inc(
+                                "continuous_admissions_total")
+                    else:
+                        free.append(lane)  # admission failed; still free
+                if not lanes:
+                    return
+                # ---- one chunk for every live lane
+                t0 = time.perf_counter()
+                sess.run_chunk()
+                chunk_ms = 1e3 * (time.perf_counter() - t0)
+                started = True
+                self.metrics.observe_lanes(len(lanes), sess.width)
+                for req in lanes.values():
+                    req.timings["compute"] += chunk_ms
+                # one fused flag fetch serves both the deadline sweep
+                # and the retire sweep — per-accessor fetches would put
+                # several serialized host round-trips on the hot path
+                active, fin, t = sess.poll()
+                # ---- deadlines are checkable mid-decode: an expired
+                # lane is answered and freed NOW, not at search end
+                now = time.perf_counter()
+                for lane, req in list(lanes.items()):
+                    if req.expired(now):
+                        req.error = DeadlineExceeded(
+                            "deadline passed mid-decode "
+                            f"(total {1e3 * (now - req.enqueue_t):.1f} "
+                            f"ms, {int(t[lane])} steps in)")
+                        req.event.set()
+                        self.metrics.inc("deadline_exceeded_total")
+                        sess.release(lane)
+                        del lanes[lane]
+                # ---- retire finished lanes
+                for lane in range(sess.width):
+                    if not (active[lane] and (fin[lane]
+                                              or t[lane] >= sess.L)):
+                        continue
+                    req = lanes.pop(lane, None)
+                    if req is not None:
+                        self._retire_lane(sess, lane, req)
+        except BaseException as e:  # noqa: BLE001 — worker bug
+            # answer every in-flight lane + the unadmitted tail before
+            # _work's handler deals with the shared queue; events set
+            # here make _work's batch sweep skip them
+            err = ServingError(f"serving worker died: {e!r}")
+            for req in list(lanes.values()) + list(pending):
+                if not req.event.is_set():
+                    req.error = req.error or err
+                    req.event.set()
+            raise
 
     # ------------------------------------------------------------ batches
     def _predict(self, kind: str, rows, lane_valid=None):
@@ -329,6 +528,12 @@ class ServingEngine:
             if r.error is not None:  # malformed lane, already typed
                 r.event.set()
                 continue
+            if kind == "generate":
+                # convoy accounting: every rider pays the batch's shared
+                # early-exit step count (continuous mode records each
+                # lane's own)
+                self.metrics.observe_decode(info.get("decode_steps"),
+                                            info.get("steps_saved"))
             td0 = time.perf_counter()
             r.result = self._decode(kind, outs, i)
             now = time.perf_counter()

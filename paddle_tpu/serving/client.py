@@ -3,7 +3,10 @@
 Raises the same typed error family the server answers with
 (``serving/errors.py`` rebuilt from the wire), so caller code branches
 on ``Overloaded.retry_after_ms`` / ``DeadlineExceeded`` instead of
-status-code string matching.
+status-code string matching. Closed-menu 400s carry
+``BadRequest.allowed`` — the warmed values (e.g. the pinned
+``beam_size`` / ``max_length`` / length-bucket menu) the client can
+retry with.
 """
 
 from __future__ import annotations

@@ -18,27 +18,36 @@ from typing import Optional
 class ServingError(Exception):
     """Base of the typed family. ``status`` is the HTTP status the
     frontend maps it to; ``code`` is the stable machine-readable
-    discriminator carried in the JSON body."""
+    discriminator carried in the JSON body. ``allowed`` (when set) is
+    the admissible menu for the rejected field(s) — e.g. the warmed
+    ``{"beam_size": [...], "max_length": [...]}`` pairs — carried on the
+    wire so clients can self-correct instead of guessing."""
 
     status = 500
     code = "internal"
 
     def __init__(self, message: str,
-                 retry_after_ms: Optional[float] = None):
+                 retry_after_ms: Optional[float] = None,
+                 allowed: Optional[dict] = None):
         super().__init__(message)
         self.retry_after_ms = retry_after_ms
+        self.allowed = allowed
 
     def to_wire(self) -> dict:
         body = {"code": self.code, "message": str(self)}
         if self.retry_after_ms is not None:
             body["retry_after_ms"] = round(float(self.retry_after_ms), 1)
+        if self.allowed is not None:
+            body["allowed"] = self.allowed
         return {"error": body}
 
 
 class BadRequest(ServingError):
     """Malformed or inadmissible request: wrong slot count, a sequence
     longer than the largest warmed length bucket, an id outside the
-    declared range, an unwarmed (beam_size, max_length) pair. 400."""
+    declared range, an unwarmed (beam_size, max_length) pair. 400.
+    Closed-menu rejections carry ``allowed`` — the warmed values the
+    client may use."""
 
     status = 400
     code = "bad_request"
@@ -80,6 +89,7 @@ def from_wire(body: dict, status: int) -> ServingError:
         ShuttingDown.code: ShuttingDown,
     }.get(code, ServingError)
     e = cls(err.get("message", f"HTTP {status}"),
-            retry_after_ms=err.get("retry_after_ms"))
+            retry_after_ms=err.get("retry_after_ms"),
+            allowed=err.get("allowed"))
     e.status = status
     return e

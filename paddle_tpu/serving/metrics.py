@@ -20,6 +20,15 @@ bucket menu costs — the serving analogue of the feeder's exactly-ignored
 row masking; per-bucket hit counts show which compiled variants earn
 their warmup. Shed/deadline/bad-request counters complete the picture.
 
+The generate path adds the decode economics (chunked early-exit search +
+continuous batching, ``docs/generation.md``): per-request
+``decode_steps`` actually executed vs ``max_length`` (with
+``decode_steps_saved_total`` the steps the early exit refused to pay)
+and the ``lane_occupancy`` series — live lanes / session width sampled
+at every chunk boundary, the continuous-batching analogue of batch
+occupancy (how full the decode batch the chip actually runs is, now
+that lanes retire and admit mid-flight).
+
 Exported two ways: :meth:`ServingMetrics.snapshot` (the ``/metrics``
 JSON + ``bench.py --serving``) and :meth:`to_prometheus` (text format,
 ``# TYPE`` lines included, for scrapers).
@@ -74,13 +83,17 @@ class ServingMetrics:
 
     COUNTERS = ("requests_total", "responses_total", "batches_total",
                 "shed_total", "deadline_exceeded_total",
-                "bad_request_total", "internal_error_total")
+                "bad_request_total", "internal_error_total",
+                "decode_chunks_total", "continuous_admissions_total",
+                "decode_steps_total", "decode_steps_saved_total")
 
     def __init__(self, window: int = 4096):
         self._lock = threading.Lock()
         self.latency: Dict[str, LatencyStat] = {
             p: LatencyStat(window) for p in PHASES + ("total",)}
         self.occupancy = LatencyStat(window)  # unit: fraction, not ms
+        self.decode_steps = LatencyStat(window)  # unit: steps, not ms
+        self.lane_occupancy = LatencyStat(window)  # unit: fraction
         self.bucket_hits: Counter = Counter()
         self.counters = {c: 0 for c in self.COUNTERS}
         self.real_rows_total = 0
@@ -113,10 +126,30 @@ class ServingMetrics:
             if padded_rows:
                 self.occupancy.add(real_rows / padded_rows)
 
+    def observe_decode(self, steps, saved):
+        """One request's decode-step accounting: ``steps`` actually
+        executed, ``saved`` = max_length - steps the early exit (or
+        mid-flight retirement) refused to pay."""
+        if steps is None:
+            return
+        with self._lock:
+            self.decode_steps.add(float(steps))
+            self.counters["decode_steps_total"] += int(steps)
+            self.counters["decode_steps_saved_total"] += int(saved or 0)
+
+    def observe_lanes(self, live: int, width: int):
+        """Continuous-batching lane occupancy at one chunk boundary."""
+        with self._lock:
+            self.counters["decode_chunks_total"] += 1
+            if width:
+                self.lane_occupancy.add(live / width)
+
     # ------------------------------------------------------------ export
     def snapshot(self) -> dict:
         with self._lock:
             occ = self.occupancy.snapshot()
+            dec = self.decode_steps.snapshot()
+            lanes = self.lane_occupancy.snapshot()
             return {
                 "latency_ms": {p: s.snapshot()
                                for p, s in self.latency.items()},
@@ -127,6 +160,17 @@ class ServingMetrics:
                     "p50": occ["p50_ms"],  # fraction, reservoir window
                     "real_rows_total": self.real_rows_total,
                     "padded_rows_total": self.padded_rows_total,
+                },
+                # the *_ms suffixes below come from LatencyStat's generic
+                # snapshot; units here are decoder steps / lane fraction
+                "decode_steps": {
+                    "count": dec["count"], "mean": dec["mean_ms"],
+                    "p50": dec["p50_ms"], "p95": dec["p95_ms"],
+                    "p99": dec["p99_ms"],
+                },
+                "lane_occupancy": {
+                    "count": lanes["count"], "mean": lanes["mean_ms"],
+                    "p50": lanes["p50_ms"],
                 },
                 "bucket_hits": dict(self.bucket_hits),
                 **self.counters,
@@ -157,6 +201,18 @@ class ServingMetrics:
         lines.append(f"# TYPE {prefix}_batch_occupancy gauge")
         if occ["mean"] is not None:
             lines.append(f"{prefix}_batch_occupancy {occ['mean']}")
+        lines.append(f"# TYPE {prefix}_decode_steps summary")
+        for q, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
+            v = s["decode_steps"][key]
+            if v is not None:
+                lines.append(
+                    f'{prefix}_decode_steps{{quantile="{q}"}} {v}')
+        lines.append(
+            f'{prefix}_decode_steps_count {s["decode_steps"]["count"]}')
+        lines.append(f"# TYPE {prefix}_lane_occupancy gauge")
+        if s["lane_occupancy"]["mean"] is not None:
+            lines.append(
+                f"{prefix}_lane_occupancy {s['lane_occupancy']['mean']}")
         lines.append(f"# TYPE {prefix}_bucket_hits counter")
         for bucket, hits in sorted(s["bucket_hits"].items()):
             lines.append(

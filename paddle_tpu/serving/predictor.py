@@ -32,6 +32,9 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from paddle_tpu.serving.errors import BadRequest
+from paddle_tpu.utils.log import get_logger
+
+logger = get_logger("serving")
 
 
 def _is_seq(itype) -> bool:
@@ -72,6 +75,8 @@ class ServingPredictor:
                  length_buckets: Optional[Sequence[int]] = None,
                  gen_beam_size: Optional[int] = None,
                  gen_max_length: Optional[int] = None,
+                 gen_decode_chunk: Optional[int] = None,
+                 gen_full_scan: Optional[bool] = None,
                  donate: Optional[bool] = None,
                  recompile_warn: int = 64):
         import jax
@@ -163,6 +168,18 @@ class ServingPredictor:
             self.gen_max_length = int(
                 gen_max_length
                 or self.engine.cfg.attrs.get("max_length", 100))
+            # decode-cost policy: chunked early-exit by default (cost
+            # proportional to actual output length), full_scan as the
+            # escape hatch / A-B baseline. None everywhere = inherit the
+            # config's pinned decode policy (dsl.beam_search attrs) —
+            # the same precedence beam-control hooks get. The resolved
+            # values are part of the warmed closed menu, like
+            # (beam, length).
+            if gen_decode_chunk is not None and int(gen_decode_chunk) <= 0:
+                gen_full_scan, gen_decode_chunk = True, None
+            self.gen_full_scan = gen_full_scan
+            self.gen_decode_chunk = (int(gen_decode_chunk)
+                                     if gen_decode_chunk else None)
             enc_outputs = self.engine.static_input_layers()
             encoder = Network(graph, outputs=enc_outputs)
 
@@ -326,26 +343,62 @@ class ServingPredictor:
                      "compute_ms": (t2 - t1) * 1e3}
 
     # --------------------------------------------------------- generation
+    def gen_effective_full_scan(self) -> bool:
+        """The decode policy actually in force: the constructor/CLI
+        override when given (an explicit positive chunk requests chunked
+        decode), else the config's pinned ``full_scan`` — mirroring
+        ``SequenceGenerator._resolve_chunk``'s precedence."""
+        if self.gen_full_scan is not None:
+            return bool(self.gen_full_scan)
+        if self.gen_decode_chunk:
+            return False
+        return bool(self.engine.cfg.attrs.get("full_scan", False))
+
+    def gen_allowed_menu(self) -> dict:
+        """The warmed generation option menu, carried in closed-menu 400s
+        (``serving/errors.py`` wire contract) so clients self-correct."""
+        return {"beam_size": [self.gen_beam_size],
+                "max_length": [self.gen_max_length]}
+
     def check_gen_opts(self, beam_size=None, max_length=None):
         """Serving pins ONE (beam_size, max_length) pair at warmup — any
-        other pair would be a hot-path compile, so it is inadmissible."""
+        other pair would be a hot-path compile, so it is inadmissible.
+        The 400 names the rejected value AND carries the warmed menu
+        (``allowed``) so the client can retry without guessing."""
         if self.engine is None:
             raise BadRequest("this model has no generation group")
         if beam_size is not None and int(beam_size) != self.gen_beam_size:
             raise BadRequest(
                 f"beam_size={beam_size} is not the warmed value "
-                f"{self.gen_beam_size} (closed shape menu)")
+                f"{self.gen_beam_size} (closed shape menu)",
+                allowed=self.gen_allowed_menu())
         if (max_length is not None
                 and int(max_length) != self.gen_max_length):
             raise BadRequest(
                 f"max_length={max_length} is not the warmed value "
-                f"{self.gen_max_length} (closed shape menu)")
+                f"{self.gen_max_length} (closed shape menu)",
+                allowed=self.gen_allowed_menu())
+
+    def encode_rows(self, rows: List[tuple], lane_valid=None):
+        """Run just the encoder over a bucketed batch: rows -> outer
+        layer name -> Argument (padded batch). The continuous batcher
+        encodes each request ONCE here at admission, then splices the
+        result into the live decode state."""
+        if self.engine is None:
+            raise BadRequest("this model has no generation group")
+        feed = self._convert(rows, lane_valid)
+        outer = self._encode(self.params, feed)
+        if self.warmed:
+            self.check_guards()
+        return outer
 
     def generate_rows(self, rows: List[tuple], lane_valid=None):
         """Beam-search a bucketed batch of encoder inputs. Returns
         ``((tokens, scores, lengths), info)`` — each np, [B, K, ...] over
         the padded batch. Config-pinned beam-control hooks apply (the
-        engine reads them from the group attrs)."""
+        engine reads them from the group attrs). ``info`` carries the
+        early-exit accounting: ``decode_steps`` actually executed and
+        ``steps_saved`` (= max_length - decode_steps)."""
         if self.engine is None:
             raise BadRequest("this model has no generation group")
         t0 = time.perf_counter()
@@ -355,7 +408,9 @@ class ServingPredictor:
         outer = self._encode(self.params, feed)
         tokens, scores, lengths = self.engine.generate(
             self.params, outer, beam_size=self.gen_beam_size,
-            max_length=self.gen_max_length)
+            max_length=self.gen_max_length,
+            decode_chunk=self.gen_decode_chunk,
+            full_scan=self.gen_full_scan)
         tokens, scores, lengths = (np.asarray(tokens), np.asarray(scores),
                                    np.asarray(lengths))
         t2 = time.perf_counter()
@@ -364,11 +419,84 @@ class ServingPredictor:
             # warmup (warmup() ran _ensure_engine_guard) — only the
             # cheap cache-size check belongs on the hot path
             self.check_guards()
+        gen_info = self.engine.last_info
         return (tokens, scores, lengths), {
             "bucket": key + f"_k{self.gen_beam_size}",
             "padded_rows": padded,
             "pad_ms": (t1 - t0) * 1e3,
-            "compute_ms": (t2 - t1) * 1e3}
+            "compute_ms": (t2 - t1) * 1e3,
+            "decode_steps": gen_info.get("decode_steps"),
+            "steps_saved": gen_info.get("steps_saved")}
+
+    def build_session(self, width: int):
+        """A warmed continuous-batching :class:`DecodeSession` of
+        ``width`` lanes (``core/generation.py``): admits one synthetic
+        request, runs one chunk, releases it — so the session's three
+        device programs (admit / chunk / release) are compiled — then
+        brings them under hardened recompile guards. The engine calls
+        this from ``start()`` when ``continuous_batching`` is on.
+
+        Returns ``None`` (warn + stand down to convoy batching) when the
+        model's static/boot inputs change shape across length buckets —
+        a sequence-valued ``StaticInput`` (e.g. seq2seq's encoded
+        source) pads to its request's bucket, but a session's lane
+        buffers have ONE fixed shape; admitting a larger-bucket request
+        would be a trace error surfacing as a spurious per-request 400.
+        Fail loudly at startup instead (the closed-menu discipline)."""
+        from paddle_tpu.data.prefetch import RecompileGuard
+        if self.engine is None:
+            raise BadRequest("this model has no generation group")
+        outers, shapes = [], set()
+        for warm_len in (self.length_buckets or [1]):
+            row = tuple(_synth_sample(self.feeding[n], warm_len)
+                        for n in self.names)
+            outer = self.encode_rows([row])
+            feed = self.engine.static_feed_from_outer(outer, row=0)
+            shapes.add(tuple(sorted(
+                (b, a.value.shape[1:],
+                 None if a.mask is None else a.mask.shape[1:])
+                for b, a in feed.items())))
+            outers.append(outer)
+        if len(shapes) > 1:
+            logger.warning(
+                "continuous batching stood down: this model's "
+                "static/boot generation inputs change shape across the "
+                "%d warmed length buckets (a sequence-valued "
+                "StaticInput pads per bucket), but a decode session's "
+                "lane buffers have one fixed shape. Serving falls back "
+                "to convoy batching; use a single "
+                "--serving_length_buckets entry to enable continuous "
+                "batching for this model.", len(self.length_buckets))
+            return None
+        if self.gen_effective_full_scan():
+            # full-scan decode has no chunk boundaries to admit/retire
+            # at — continuous batching would silently override the
+            # requested policy; refuse loudly instead
+            logger.warning(
+                "continuous batching stood down: the decode policy is "
+                "full_scan (--decode_chunk 0, or pinned in the config) "
+                "and a full-length scan has no chunk boundaries to "
+                "admit/retire at. Serving falls back to convoy "
+                "batching; drop the full-scan override to enable "
+                "continuous batching.")
+            return None
+        sess = self.engine.session(
+            self.params, width, beam_size=self.gen_beam_size,
+            max_length=self.gen_max_length,
+            decode_chunk=self.gen_decode_chunk)
+        sess.admit(0, outers[0], row=0)
+        sess.run_chunk()
+        # the lane-flag reductions and result fetch compile on first
+        # use too — pay them here, not inside the first request's decode
+        sess.free_lanes()
+        sess.finished_lanes()
+        sess.peek(0)
+        sess.release(0)
+        for fn in sess.jitted_fns():
+            g = RecompileGuard(fn, name="serving_decode_session")
+            g.harden()
+            self.guards.append(g)
+        return sess
 
     def _ensure_engine_guard(self):
         from paddle_tpu.data.prefetch import RecompileGuard

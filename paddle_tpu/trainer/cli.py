@@ -148,6 +148,22 @@ def parse_args(argv=None):
     p.add_argument("--serving_deadline_ms", type=float, default=0,
                    help="--job=serve: default per-request deadline "
                         "(0 = none; requests may set their own)")
+    p.add_argument("--decode_chunk", type=int, default=None,
+                   help="decoder steps per compiled chunk of the "
+                        "early-exit beam search (core/generation.py): "
+                        "the search exits at the first chunk boundary "
+                        "where every beam finished, so decode cost is "
+                        "proportional to actual output length, not "
+                        "max_length. 0 = full-length scan (the escape "
+                        "hatch / A-B baseline); unset = the config's "
+                        "pinned decode policy, else chunks of 8")
+    p.add_argument("--serving_continuous_batching", action="store_true",
+                   help="--job=serve: continuous batching for "
+                        "/v1/generate — finished lanes retire and "
+                        "queued requests are admitted at every "
+                        "--decode_chunk boundary, so one slow request "
+                        "no longer convoys its batch and deadlines are "
+                        "enforced mid-decode")
     return p.parse_args(argv)
 
 
@@ -510,6 +526,44 @@ def cmd_merge(ns, args):
     return 0
 
 
+def _ensure_generation_params(graph, params):
+    """The trainer only initializes parameters reachable from the cost;
+    a ``beam_search_group``'s hoisted step-net params and generated-word
+    embedding are not, so serving a generating config from a fresh init
+    would KeyError inside the jitted search. Fill the gaps (tiny random
+    init) with a warning — real deployments load them via
+    ``--init_model_path`` / a checkpoint, where the training-time
+    decoder shares the same hoisted names."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.registry import get_layer_impl
+    from paddle_tpu.utils.log import get_logger
+    rng = np.random.RandomState(0)
+    missing = []
+    for name, ldef in graph.layers.items():
+        if ldef.type != "beam_search_group":
+            continue
+        impl = get_layer_impl("beam_search_group")
+        for _, spec in impl.params(ldef, []).items():
+            if spec.absolute_name not in params:
+                missing.append(spec.absolute_name)
+                params[spec.absolute_name] = jnp.asarray(
+                    rng.randn(*spec.shape).astype(np.float32) * 0.01)
+        g = ldef.attrs["gen"]
+        if g["embedding_name"] not in params:
+            missing.append(g["embedding_name"])
+            params[g["embedding_name"]] = jnp.asarray(rng.randn(
+                g["size"], g["embedding_size"]).astype(np.float32) * 0.01)
+    if missing:
+        get_logger("serving").warning(
+            "generation parameters %s were not in the loaded/initialized "
+            "table (the trainer only walks the cost graph); serving with "
+            "fresh small-random values — load a trained model via "
+            "--init_model_path for real generation", missing)
+
+
 def build_serving_engine(ns, args):
     """--job=serve wiring, separated so tests (and embedders) can build
     the engine without entering serve_forever. Parameter source order
@@ -538,16 +592,25 @@ def build_serving_engine(ns, args):
         batch_buckets.append(min(batch_buckets[-1] * 2, max_batch))
     length_buckets = [int(x) for x in filter(
         None, str(args.serving_length_buckets).split(","))]
+    # None = inherit the config's pinned decode policy; 0 = full scan
+    decode_chunk = getattr(args, "decode_chunk", None)
+    params = dict(trainer._flat_params_view())
+    _ensure_generation_params(trainer.topology.graph, params)
     predictor = ServingPredictor(
-        trainer.topology.graph, trainer._flat_params_view(), names,
+        trainer.topology.graph, params, names,
         feeding, batch_buckets=batch_buckets,
-        length_buckets=length_buckets)
+        length_buckets=length_buckets,
+        gen_decode_chunk=decode_chunk,
+        gen_full_scan=(None if decode_chunk is None
+                       else decode_chunk <= 0))
     return ServingEngine(
         predictor, max_batch=max_batch,
         batch_timeout_ms=args.batch_timeout_ms,
         queue_depth=args.queue_depth,
         shed_watermark=args.shed_watermark or None,
-        default_deadline_ms=args.serving_deadline_ms or None)
+        default_deadline_ms=args.serving_deadline_ms or None,
+        continuous_batching=getattr(args, "serving_continuous_batching",
+                                    False))
 
 
 def cmd_serve(ns, args):

@@ -1,0 +1,335 @@
+"""Continuous batching on the serving generate path
+(``serving/batcher.py`` + ``core/generation.py:DecodeSession``).
+
+The engine model is length-controlled by construction: the decoder's
+EOS logit is proportional to the (boot) memory sum, so a positive input
+vector finishes within ~2 steps and a negative one never emits EOS and
+runs to ``max_length`` — deterministic short/long traffic with fat
+margins (no near-ties for cross-batch-width token flips to hide in).
+
+What must hold:
+
+- answers are identical to convoy (non-continuous) batching,
+- short requests retire at chunk boundaries while a long neighbor is
+  still decoding (the anti-convoy property), with queued requests
+  admitted into freed lanes mid-decode,
+- deadlines are enforced *mid-decode*, answering the expired lane
+  without disturbing its neighbors,
+- the closed-menu 400 for off-menu gen opts carries the warmed
+  ``allowed`` menu end-to-end (engine, wire, typed client),
+- the decode observability series (per-request decode_steps,
+  lane occupancy) land in the snapshot and Prometheus export,
+- zero post-warmup recompiles (the hardened guards would kill the
+  worker; ``engine.fatal is None`` asserts it).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.config import dsl
+from paddle_tpu.core.network import Network
+from paddle_tpu.core.registry import get_layer_impl
+from paddle_tpu.data import dense_vector
+from paddle_tpu.serving import (BadRequest, DeadlineExceeded,
+                                ServingClient, ServingEngine,
+                                ServingPredictor, make_server)
+
+V, E, H = 6, 4, 5
+EOS = 1
+K = 3
+
+
+def _length_controlled_graph(max_length, beam_size=K):
+    dsl.reset()
+    src = dsl.data("src", size=H)
+    boot = dsl.fc(src, size=H, act="tanh", name="boot", bias_attr=False)
+
+    def step(prev_emb):
+        m = dsl.memory(name="h", size=H, boot_layer=boot)
+        h = dsl.fc([prev_emb, m], size=H, act="tanh", name="h",
+                   bias_attr=False)
+        return dsl.fc(h, size=V, act="softmax", name="prob",
+                      bias_attr=False)
+
+    dsl.beam_search(
+        step, [dsl.GeneratedInput(size=V, embedding_name="gen_emb",
+                                  embedding_size=E)],
+        bos_id=0, eos_id=EOS, beam_size=beam_size, max_length=max_length,
+        name="gen")
+    return dsl.current_graph()
+
+
+def _length_controlled_params(graph):
+    """EOS logit = 3 * sum(memory); memory = tanh(2*src) decayed by
+    tanh each step. Positive src -> EOS dominates immediately (finish
+    in <= 2 steps); negative src -> EOS is ~e^-14 (never finishes)."""
+    net = Network(graph, outputs=["boot"])
+    params = dict(net.init_params(jax.random.PRNGKey(0)))
+    boot_key = next(k for k in params if "boot" in k)
+    params[boot_key] = jnp.asarray(2.0 * np.eye(H, dtype=np.float32))
+    for _, spec in get_layer_impl("beam_search_group").params(
+            graph.layers["gen"], []).items():
+        params[spec.absolute_name] = jnp.zeros(spec.shape, jnp.float32)
+    params["_h.w1"] = jnp.asarray(np.eye(H, dtype=np.float32))
+    u = np.zeros((H, V), np.float32)
+    u[:, EOS] = 3.0
+    params["_prob.w0"] = jnp.asarray(u)
+    params["gen_emb"] = jnp.zeros((V, E), jnp.float32)
+    return params
+
+
+def _short():
+    return ([1.0] * H,)
+
+
+def _long():
+    return ([-1.0] * H,)
+
+
+def _build_engine(max_length=24, decode_chunk=2, continuous=True,
+                  max_batch=4, **eng_kw):
+    graph = _length_controlled_graph(max_length)
+    params = _length_controlled_params(graph)
+    pred = ServingPredictor(graph, params, ["gen"],
+                            {"src": dense_vector(H)},
+                            batch_buckets=[1, 2, 4][:max(
+                                1, max_batch.bit_length())],
+                            gen_decode_chunk=decode_chunk)
+    return ServingEngine(pred, max_batch=max_batch, batch_timeout_ms=2.0,
+                         continuous_batching=continuous, **eng_kw).start()
+
+
+@pytest.fixture(scope="module")
+def engines():
+    cont = _build_engine(continuous=True)
+    convoy = _build_engine(continuous=False)
+    yield cont, convoy
+    cont.shutdown()
+    convoy.shutdown()
+
+
+def _gather(eng, samples, deadline_ms=None):
+    reqs = [eng.submit(s, kind="generate", deadline_ms=deadline_ms)
+            for s in samples]
+    for r in reqs:
+        assert r.event.wait(120.0), "engine hung"
+    return reqs
+
+
+def test_continuous_answers_match_convoy(engines):
+    cont, convoy = engines
+    samples = [_short(), _long(), _short(), _long(), _short()]
+    got_c = _gather(cont, samples)
+    got_v = _gather(convoy, samples)
+    for s, rc, rv in zip(samples, got_c, got_v):
+        assert rc.error is None and rv.error is None
+        ks = rc.result["sequences"]
+        vs = rv.result["sequences"]
+        assert [q["tokens"] for q in ks] == [q["tokens"] for q in vs], s
+        for a, b in zip(ks, vs):
+            assert abs(a["score"] - b["score"]) < 1e-5
+    # the length control actually controls: shorts end at <= 2 tokens,
+    # longs run the full max_length
+    assert all(len(q["tokens"]) <= 2
+               for q in got_c[0].result["sequences"])
+    assert any(len(q["tokens"]) == 24
+               for q in got_c[1].result["sequences"])
+    assert cont.fatal is None and convoy.fatal is None
+
+
+def test_short_requests_escape_the_convoy(engines):
+    cont, _ = engines
+    base = cont.metrics.counters["continuous_admissions_total"]
+    long_req = cont.submit(_long(), kind="generate")
+    shorts = [cont.submit(_short(), kind="generate") for _ in range(6)]
+    for r in shorts:
+        assert r.event.wait(120.0)
+        assert r.error is None
+    # every short answered while the long lane is still decoding: the
+    # convoy is broken (a coalesced batch would answer them together)
+    assert not long_req.event.is_set(), \
+        "short requests waited for the slow lane (convoy not broken)"
+    assert long_req.event.wait(120.0)
+    assert long_req.error is None
+    # 7 requests through 4 lanes: some were admitted mid-decode
+    assert (cont.metrics.counters["continuous_admissions_total"]
+            > base)
+    snap = cont.metrics.snapshot()
+    assert snap["lane_occupancy"]["count"] > 0
+    assert snap["decode_chunks_total"] > 0
+    # per-request decode accounting: shorts paid ~1 chunk, the long
+    # lane paid max_length steps
+    assert snap["decode_steps"]["count"] >= 7
+    assert cont.metrics.counters["decode_steps_saved_total"] > 0
+    assert cont.fatal is None
+
+
+def test_deadline_enforced_mid_decode():
+    """A lane whose deadline passes while the search is still running is
+    answered ``DeadlineExceeded`` at the next chunk boundary — not when
+    the batch finishes — and its neighbor completes untouched."""
+    # 192 one-step chunks of a never-ending search give a wide window
+    # for the 40 ms deadline to land strictly mid-decode on a host with
+    # +-50% throughput drift: admission takes ~1 chunk (everything is
+    # warmed, including the lane-flag reductions), the full search ~10x
+    # the deadline
+    eng = _build_engine(max_length=192, decode_chunk=1, max_batch=2)
+    try:
+        neighbor = eng.submit(_long(), kind="generate")
+        doomed = eng.submit(_long(), kind="generate", deadline_ms=40.0)
+        assert doomed.event.wait(120.0)
+        assert isinstance(doomed.error, DeadlineExceeded)
+        assert "mid-decode" in str(doomed.error)
+        assert not neighbor.event.is_set(), \
+            "the deadline answer waited for the whole batch"
+        assert neighbor.event.wait(120.0)
+        assert neighbor.error is None
+        assert any(len(q["tokens"]) == 192
+                   for q in neighbor.result["sequences"])
+        assert eng.fatal is None
+    finally:
+        eng.shutdown()
+
+
+def test_convoy_mode_records_decode_steps(engines):
+    _, convoy = engines
+    _gather(convoy, [_short(), _short()])
+    snap = convoy.metrics.snapshot()
+    assert snap["decode_steps"]["count"] > 0
+    # early exit: the chunked search paid less than max_length
+    assert convoy.metrics.counters["decode_steps_saved_total"] > 0
+
+
+def test_gen_opts_400_carries_allowed_menu(engines):
+    cont, _ = engines
+    with pytest.raises(BadRequest) as ei:
+        cont.submit(_short(), kind="generate", beam_size=K + 2)
+    assert ei.value.allowed == {"beam_size": [K], "max_length": [24]}
+    # and over the wire, through the typed client
+    server = make_server(cont, port=0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        client = ServingClient(port=server.server_address[1])
+        with pytest.raises(BadRequest) as ei:
+            client.generate(_short(), max_length=999)
+        assert ei.value.allowed == {"beam_size": [K], "max_length": [24]}
+        got = client.generate(_short())
+        assert len(got["sequences"]) == K
+    finally:
+        server.shutdown()
+
+
+def test_bucket_dependent_static_shapes_stand_down():
+    """A sequence-valued StaticInput (seq2seq's encoded source) pads to
+    its request's length bucket, so its static shape differs per bucket
+    — a fixed-width session cannot hold it. build_session must warn and
+    return None (convoy fallback at startup), not 400 real requests."""
+    from paddle_tpu.data import integer_value_sequence
+    from paddle_tpu.models.seq2seq import seq2seq_attention
+
+    dsl.reset()
+    gen, data_names = seq2seq_attention(
+        src_vocab=40, trg_vocab=40, embed_dim=8, hidden=8,
+        beam_size=2, max_length=6, generating=True)
+    graph = dsl.current_graph()
+    from paddle_tpu.core.network import Network as Net
+    net = Net(graph, outputs=["encoded", "encoded_proj", "decoder_boot"])
+    params = dict(net.init_params(jax.random.PRNGKey(0)))
+    for _, spec in get_layer_impl("beam_search_group").params(
+            graph.layers["gen"], []).items():
+        params.setdefault(spec.absolute_name,
+                          jnp.zeros(spec.shape, jnp.float32))
+    pred = ServingPredictor(
+        graph, params, ["gen"], {"source_words": integer_value_sequence(40)},
+        batch_buckets=[1], length_buckets=[4, 8], gen_decode_chunk=2)
+    assert pred.build_session(2) is None
+    eng = ServingEngine(pred, continuous_batching=True,
+                        batch_timeout_ms=1.0).start(warmup=False)
+    try:
+        assert eng._session is None
+        assert eng.continuous_batching is False  # stood down, warned
+    finally:
+        eng.shutdown()
+
+
+def test_generate_traffic_does_not_starve_queued_score_requests():
+    """Chunk-boundary admission must pause while a scoring request is
+    queued: the session drains and the worker returns to _collect, so
+    sustained generate traffic cannot deny service to /v1/score."""
+    graph = _length_controlled_graph(48)
+    params = _length_controlled_params(graph)
+    pred = ServingPredictor(graph, params, ["gen", "boot"],
+                            {"src": dense_vector(H)},
+                            batch_buckets=[1, 2], gen_decode_chunk=2)
+    eng = ServingEngine(pred, max_batch=2, batch_timeout_ms=1.0,
+                        continuous_batching=True).start()
+    try:
+        # keep the session busy: a stream of long decodes...
+        gens = [eng.submit(_long(), kind="generate") for _ in range(4)]
+        score = eng.submit(_short(), kind="score")
+        gens += [eng.submit(_long(), kind="generate") for _ in range(4)]
+        assert score.event.wait(120.0), "score request starved"
+        assert score.error is None
+        for r in gens:
+            assert r.event.wait(120.0)
+            assert r.error is None
+        assert eng.fatal is None
+    finally:
+        eng.shutdown()
+
+
+def test_config_pinned_full_scan_reaches_serving_and_stands_down():
+    """A config-pinned decode policy (``dsl.beam_search(full_scan=True)``)
+    must flow through the predictor (no silent chunked override), and
+    continuous batching — which needs chunk boundaries — must warn and
+    stand down rather than ignore it. An explicit CLI-style
+    ``gen_decode_chunk`` still overrides the pin."""
+    dsl.reset()
+    src = dsl.data("src", size=H)
+    boot = dsl.fc(src, size=H, act="tanh", name="boot", bias_attr=False)
+
+    def step(prev_emb):
+        m = dsl.memory(name="h", size=H, boot_layer=boot)
+        h = dsl.fc([prev_emb, m], size=H, act="tanh", name="h",
+                   bias_attr=False)
+        return dsl.fc(h, size=V, act="softmax", name="prob",
+                      bias_attr=False)
+
+    dsl.beam_search(
+        step, [dsl.GeneratedInput(size=V, embedding_name="gen_emb",
+                                  embedding_size=4)],
+        bos_id=0, eos_id=EOS, beam_size=2, max_length=6, name="gen",
+        full_scan=True)
+    graph = dsl.current_graph()
+    params = _length_controlled_params(graph)
+    pred = ServingPredictor(graph, params, ["gen"],
+                            {"src": dense_vector(H)}, batch_buckets=[1])
+    assert pred.gen_effective_full_scan()
+    pred.warmup()
+    _, info = pred.generate_rows([_short()])
+    assert info["decode_steps"] == 6  # full scan: no early exit
+    assert pred.build_session(2) is None  # warn + convoy fallback
+    # explicit override beats the pin
+    pred2 = ServingPredictor(graph, params, ["gen"],
+                             {"src": dense_vector(H)}, batch_buckets=[1],
+                             gen_decode_chunk=2)
+    assert not pred2.gen_effective_full_scan()
+    pred2.warmup()
+    _, info2 = pred2.generate_rows([_short()])
+    assert info2["decode_steps"] < 6  # chunked early exit back on
+    assert info2["steps_saved"] > 0
+
+
+def test_prometheus_exports_decode_series(engines):
+    cont, _ = engines
+    text = cont.metrics.to_prometheus()
+    assert "_decode_steps{quantile=" in text
+    assert "_lane_occupancy " in text
+    assert "_decode_chunks_total" in text
+    assert "_continuous_admissions_total" in text
