@@ -11,19 +11,69 @@ trainer — not a hardcoded trainer 0 — can own a save.
 
 Cadence mirrors the v1 trainer flags ``--saving_period`` (passes) and
 ``--saving_period_by_batches`` (`Trainer.cpp:454-462`).
+
+Chaos-hardening round additions:
+
+- **generation order, not mtime**: GC and recovery order checkpoints by
+  the (pass_id, batch_id) generation parsed from the file name —
+  sub-second save bursts and clock skew can tie or invert mtimes, and
+  an mtime-ordered GC can then delete the newest generation.
+- **off-hot-path saves** (``background=True``): the device→host fetch
+  (which must happen before the step loop donates the buffers away)
+  stays synchronous, but serialization + fsync + rename + GC run on a
+  single worker thread — the step loop never blocks on disk. ``flush``
+  drains; ``restore`` flushes first; a worker failure re-raises at the
+  next save/flush (the prefetch pipeline's error contract).
+- **on_save callback**: fires AFTER a generation is durable (post
+  fsync+rename), with that save's meta — the trainer uses it to commit
+  the master's task ledger, so the master never believes work durable
+  that is not (docs/fault_tolerance.md).
+- **exact-resume payload**: ``trainer_state`` (RNG key, carried BPTT
+  state, …) and the reader's task ``ledger`` ride inside the
+  checkpoint (``trainer/checkpoint.py`` ``state::`` namespace / the
+  ``.meta`` JSON).
+- **strict recovery**: a checkpoint without its ``.meta`` sidecar is
+  treated as torn (the data file alone cannot prove integrity), and a
+  corrupt ``.meta`` falls through — restore lands on the previous
+  intact generation, never on torn state.
+- ``testing.chaos`` hook ``checkpoint`` fires per durable generation so
+  a FaultPlan can truncate/bit-flip exactly the Nth save.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import queue
+import re
+import threading
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
-from paddle_tpu.trainer.checkpoint import load_params, save_params
+from paddle_tpu.testing import chaos as _chaos
+from paddle_tpu.trainer.checkpoint import (load_checkpoint, snapshot_arrays,
+                                           write_snapshot)
 from paddle_tpu.utils.log import get_logger
 
 logger = get_logger("dist.checkpoint")
+
+_GEN_RE = re.compile(r"^checkpoint-p(\d+)-b(\d+)\.npz$")
+
+
+def _gen_key(name: str):
+    """Total order on checkpoint file names by training generation.
+
+    (parsed?, pass_id, end_of_pass?, batch_id, name): batch-cadence
+    saves of a pass order by batch, the end-of-pass save (batch 0 by
+    construction — ``maybe_save`` only batch-saves at batch_id>0) is the
+    newest of its pass. Foreign/unparseable names sort oldest. mtime is
+    deliberately NOT consulted: same-second save bursts and clock skew
+    tie or invert it."""
+    m = _GEN_RE.match(name)
+    if not m:
+        return (0, 0, False, 0, name)
+    pass_id, batch_id = int(m.group(1)), int(m.group(2))
+    return (1, pass_id, batch_id == 0, batch_id, name)
 
 
 class Checkpointer:
@@ -32,16 +82,27 @@ class Checkpointer:
     ``should_save`` may be the master client's ``request_save_model``
     partial; default always-true (single-trainer)."""
 
+    # minimum age before an orphaned '.tmp' is GC-swept: young .tmp
+    # files may be another process's in-flight write (shared save dir)
+    ORPHAN_TMP_AGE_S = 60.0
+
     def __init__(self, directory: str, *, saving_period: int = 1,
                  saving_period_by_batches: Optional[int] = None,
                  keep: int = 3,
-                 should_save: Optional[Callable[[], bool]] = None):
+                 should_save: Optional[Callable[[], bool]] = None,
+                 background: bool = False,
+                 on_save: Optional[Callable[[dict], None]] = None):
         self.dir = directory
         self.saving_period = max(1, saving_period)
         self.saving_period_by_batches = saving_period_by_batches
         self.keep = max(1, keep)
         self.should_save = should_save or (lambda: True)
+        self.background = background
+        self.on_save = on_save
         os.makedirs(self.dir, exist_ok=True)
+        self._q: Optional[queue.Queue] = None
+        self._worker: Optional[threading.Thread] = None
+        self._worker_err: Optional[BaseException] = None
 
     # ------------------------------------------------------------ write
 
@@ -50,16 +111,41 @@ class Checkpointer:
                             f"checkpoint-p{pass_id:05d}-b{batch_id:08d}")
 
     def save(self, params: Dict[str, Any], opt_state: Any, *,
-             pass_id: int, batch_id: int = 0, end_of_pass: bool = False):
-        """Unconditional save + pointer update + GC. ``opt_state`` may be
-        a zero-arg callable producing the state — the trainer passes its
-        ZeRO-1 slot-gather lazily so the (device-op) gather only runs for
-        saves that are actually due (resolved by ``save_params``, the
-        single owner of that protocol)."""
+             pass_id: int, batch_id: int = 0, end_of_pass: bool = False,
+             trainer_state: Optional[Any] = None,
+             ledger: Optional[Any] = None):
+        """Unconditional save + pointer update + GC. ``params``,
+        ``opt_state``, ``trainer_state`` and ``ledger`` may be zero-arg
+        callables producing their values — the trainer passes its ZeRO-1
+        slot-gather lazily so the (device-op) gather only runs for saves
+        that are actually due. All device access resolves HERE, on the
+        caller's thread (the step loop donates those buffers right
+        after); in background mode only the file I/O is deferred."""
+        if ledger is not None and callable(ledger):
+            ledger = ledger()
+        meta = {"pass_id": pass_id, "batch_id": batch_id,
+                "end_of_pass": end_of_pass, "time": time.time()}
+        if ledger is not None:
+            meta["ledger"] = ledger
         path = self._ckpt_path(pass_id, batch_id)
-        save_params(path, params, opt_state,
-                    meta={"pass_id": pass_id, "batch_id": batch_id,
-                          "end_of_pass": end_of_pass, "time": time.time()})
+        arrays = snapshot_arrays(params, opt_state, trainer_state)
+        if self.background:
+            self._raise_worker_err()
+            self._ensure_worker()
+            try:
+                self._q.put_nowait((path, arrays, meta))
+            except queue.Full:
+                logger.warning(
+                    "checkpoint writer backlog (disk slower than the "
+                    "save cadence): blocking the step loop until a "
+                    "generation drains")
+                self._q.put((path, arrays, meta))
+        else:
+            self._write(path, arrays, meta)
+        return path
+
+    def _write(self, path: str, arrays, meta: dict):
+        real = write_snapshot(path, arrays, meta)
         # pointer written AFTER the data file is durable: recovery order
         # is pointer → verify → fall back to directory scan
         with open(os.path.join(self.dir, "LATEST.tmp"), "w") as f:
@@ -70,10 +156,73 @@ class Checkpointer:
                    os.path.join(self.dir, "LATEST"))
         self._gc()
         logger.info("checkpoint saved: %s", path)
+        if _chaos._ACTIVE is not None:
+            _chaos._ACTIVE.hit("checkpoint", path=real)
+        if self.on_save is not None:
+            self.on_save(meta)
         return path
 
+    # ------------------------------------------------- background plumbing
+    def _ensure_worker(self):
+        if self._worker is not None and self._worker.is_alive():
+            return
+        # bounded: at most 2 generations in flight keeps worst-case host
+        # memory at ~2 snapshots; a third save blocks (with a warning)
+        # rather than silently dropping a due generation
+        self._q = queue.Queue(maxsize=2)
+        self._worker = threading.Thread(target=self._work, daemon=True,
+                                        name="checkpoint-writer")
+        self._worker.start()
+
+    def _work(self):
+        while True:
+            item = self._q.get()
+            try:
+                if item is None:
+                    return
+                self._write(*item)
+            except BaseException as e:  # surfaced at next save/flush
+                self._worker_err = e
+                logger.error("background checkpoint write failed: %r", e)
+            finally:
+                self._q.task_done()
+
+    def _raise_worker_err(self):
+        if self._worker_err is not None:
+            err, self._worker_err = self._worker_err, None
+            if not isinstance(err, Exception):
+                # a chaos kill (BaseException, e.g. ChaosKilled) parked
+                # by the worker thread: re-raise AS ITSELF so the kill
+                # contract holds in background mode too — the run dies
+                # with the kill's own unwind class at the next
+                # save/flush (deterministic from the seed), not a
+                # downgraded RuntimeError the step loop would survive
+                raise err
+            raise RuntimeError("background checkpoint writer failed") from err
+
+    # public: wait loops that depend on a future on_save commit (the
+    # master reader's durability-gated pass roll) poll this so a dead
+    # writer surfaces as its error, not as a livelock
+    poll_error = _raise_worker_err
+
+    def flush(self):
+        """Drain pending background writes (no-op when synchronous)."""
+        if self._q is not None:
+            self._q.join()
+        self._raise_worker_err()
+
+    def close(self):
+        if self._worker is not None and self._worker.is_alive():
+            self._q.join()
+            self._q.put(None)
+            self._worker.join(timeout=10.0)
+        self._worker = None
+        self._raise_worker_err()
+
     def maybe_save(self, params, opt_state, *, pass_id: int,
-                   batch_id: int = 0, end_of_pass: bool = False) -> bool:
+                   batch_id: int = 0, end_of_pass: bool = False,
+                   trainer_state: Optional[Any] = None,
+                   ledger: Optional[Any] = None) -> bool:
         """Cadence + arbitration gate around save()."""
         due = False
         if end_of_pass and (pass_id + 1) % self.saving_period == 0:
@@ -84,7 +233,8 @@ class Checkpointer:
         if not due or not self.should_save():
             return False
         self.save(params, opt_state, pass_id=pass_id, batch_id=batch_id,
-                  end_of_pass=end_of_pass)
+                  end_of_pass=end_of_pass, trainer_state=trainer_state,
+                  ledger=ledger)
         return True
 
     def _latest_name(self):
@@ -94,18 +244,16 @@ class Checkpointer:
         except FileNotFoundError:
             return None
 
+    def _scan(self):
+        return [n for n in os.listdir(self.dir)
+                if n.startswith("checkpoint-") and n.endswith(".npz")]
+
     def _gc(self):
-        # Keep by recency (mtime), not name: an end-of-pass save
-        # (batch_id=0) is newer than same-pass batch-cadence saves despite
-        # sorting first lexicographically. The LATEST target always stays.
-        def mtime(n):
-            try:
-                return os.path.getmtime(os.path.join(self.dir, n))
-            except OSError:
-                return 0.0
-        ckpts = sorted((n for n in os.listdir(self.dir)
-                        if n.startswith("checkpoint-")
-                        and n.endswith(".npz")), key=lambda n: (mtime(n), n))
+        # Keep by GENERATION (parsed pass/batch, end-of-pass newest of
+        # its pass), never by mtime: a sub-second save burst or clock
+        # skew ties/inverts mtimes and an mtime GC can then delete the
+        # newest generation. The LATEST target always stays.
+        ckpts = sorted(self._scan(), key=_gen_key)
         latest = self._latest_name()
         for name in ckpts[:-self.keep]:
             if name == latest:
@@ -116,46 +264,73 @@ class Checkpointer:
                     os.remove(base + suffix)
                 except FileNotFoundError:
                     pass
+        # sweep orphaned .tmp files: a kill mid-write (exactly what the
+        # chaos soak injects, repeatedly) leaves a full-model-sized
+        # '...npz.tmp' / '...meta.tmp' behind, and nothing else ever
+        # matches it. Within one process writes and GC serialize on one
+        # thread, but the save dir may be SHARED by several trainers
+        # (the request_save_model one-saver-per-window arbitration): a
+        # fresh .tmp can be another process's in-flight write, and
+        # deleting it would crash that trainer's os.replace. Only .tmp
+        # files old enough that no live write plausibly owns them
+        # (crash debris only grows older) are swept.
+        now = time.time()
+        for name in os.listdir(self.dir):
+            if name.startswith("checkpoint-") and name.endswith(".tmp"):
+                path = os.path.join(self.dir, name)
+                try:
+                    if now - os.path.getmtime(path) >= self.ORPHAN_TMP_AGE_S:
+                        os.remove(path)
+                except FileNotFoundError:
+                    pass
 
     # ------------------------------------------------------------- read
 
     def _candidates(self):
         """Newest-first candidate list: the LATEST pointer target, then the
-        directory scan by recency (covers a torn pointer write)."""
+        directory scan by generation (covers a torn pointer write)."""
         names = []
         latest = self._latest_name()
         if latest:
             names.append(latest)
-
-        def mtime(n):
-            try:
-                return os.path.getmtime(os.path.join(self.dir, n))
-            except OSError:
-                return 0.0
-        scanned = sorted((n for n in os.listdir(self.dir)
-                          if n.startswith("checkpoint-")
-                          and n.endswith(".npz")),
-                         key=lambda n: (mtime(n), n), reverse=True)
+        scanned = sorted(self._scan(), key=_gen_key, reverse=True)
         names.extend(n for n in scanned if n not in names)
         return names
 
     def restore(self) -> Optional[Tuple[dict, dict, dict]]:
         """(params, opt_flat, meta) from the newest intact checkpoint, or
-        None. Corrupt files are skipped with a warning (crash recovery)."""
+        None. Corrupt files are skipped with a warning (crash recovery).
+        ``meta["trainer_state"]`` carries the exact-resume state arrays
+        when the checkpoint has them; ``meta["ledger"]`` the reader's
+        task-ledger position.
+
+        Intact means data file AND ``.meta`` sidecar: a data file
+        without its sidecar is a torn save (the sidecar is written last)
+        and nothing can prove the data's integrity — it falls through to
+        the previous generation rather than loading possibly-torn
+        state."""
+        self.flush()
         for name in self._candidates():
             path = os.path.join(self.dir, name)
             if not os.path.exists(path):
                 continue
+            if not os.path.exists(path + ".meta"):
+                logger.warning(
+                    "skipping checkpoint %s: no .meta sidecar (torn save "
+                    "— integrity unprovable)", path)
+                continue
             try:
-                params, opt_flat = load_params(path)
+                with open(path + ".meta") as f:
+                    meta = json.load(f)
+                # hand the parsed sidecar down for the MD5 check — one
+                # read, and the verified bytes are the ones we return
+                params, opt_flat, state = load_checkpoint(path, meta=meta)
             except Exception as e:  # torn .npz raises BadZipFile etc. —
                 # any unreadable candidate falls through to the previous one
                 logger.warning("skipping corrupt checkpoint %s: %s", path, e)
                 continue
-            meta = {}
-            if os.path.exists(path + ".meta"):
-                with open(path + ".meta") as f:
-                    meta = json.load(f)
+            if state:
+                meta["trainer_state"] = state
             logger.info("restored checkpoint %s (pass %s batch %s)", path,
                         meta.get("pass_id"), meta.get("batch_id"))
             return params, opt_flat, meta

@@ -13,10 +13,35 @@ one-for-one (cited by reference line):
 - ``request_save_model`` arbitration: exactly one trainer saves per
   window, so a dead "trainer 0" can't block checkpoints (`service.go:474`)
 
+Elastic-lease extensions beyond the reference (the chaos-hardening
+round; see docs/fault_tolerance.md):
+
+- **heartbeat-renewed leases**: a trainer renews its task lease(s) and
+  its own liveness with ``heartbeat``; a trainer that goes silent for
+  ``trainer_timeout_s`` has its pending lease AND its uncommitted
+  finishes requeued (at the *front*, preserving dispatch order).
+- **idempotent finishes**: ``task_finished`` is at-least-once safe — a
+  duplicate report (lost response + client retry, or a straggler's
+  second copy) dedupes against the done ledger instead of failing.
+- **commit protocol**: with ``defer_commit`` a finished task parks in a
+  per-trainer *uncommitted* buffer until ``commit_tasks`` (sent by the
+  trainer after its checkpoint is durable). Work a trainer finished
+  after its last durable checkpoint is therefore requeued on its death
+  instead of being marked trained-but-lost.
+- **straggler re-dispatch**: when todo is dry, a pending task older than
+  ``straggle_after_s`` is speculatively re-served to an idle trainer;
+  the first finish wins, the duplicate dedupes.
+- **exact resume**: ``resume_lease`` reconciles the queue against the
+  task ledger a resumed trainer restored from its checkpoint — the
+  `trainer/trainer.py` pass-aware resume fix.
+
 etcd is replaced by a ``Store`` interface (atomic checksummed file by
 default — on cloud deployments this maps naturally onto GCS); Go net/rpc
 + gob becomes length-prefixed JSON over TCP; leader election is out of
 scope for a single-master-per-job setup (the Store detects torn writes).
+Fault injection: the RPC codec and the snapshot path carry
+``paddle_tpu.testing.chaos`` hook points (``msg_send`` / ``msg_recv`` /
+``store_save``) — zero-cost unless a FaultPlan is installed.
 """
 
 from __future__ import annotations
@@ -25,6 +50,7 @@ import dataclasses
 import hashlib
 import json
 import os
+import random
 import socket
 import socketserver
 import struct
@@ -32,6 +58,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from paddle_tpu.testing import chaos as _chaos
+from paddle_tpu.utils.backoff import backoff_delay
 from paddle_tpu.utils.log import get_logger
 
 logger = get_logger("dist.master")
@@ -112,22 +140,46 @@ class FileStore:
         return data
 
 
+# "not passed" marker for straggle_after_s: None must stay a meaningful
+# value (speculative re-dispatch disabled), not an alias for the default
+_AUTO_STRAGGLE = object()
+
+
 class MasterService:
     """The task-queue state machine. Thread-safe; every mutation
     snapshots to the store."""
 
     def __init__(self, store=None, *, timeout_s: float = 60.0,
-                 failure_max: int = 3, chunks_per_task: int = 1):
+                 failure_max: int = 3, chunks_per_task: int = 1,
+                 trainer_timeout_s: Optional[float] = None,
+                 straggle_after_s: Optional[float] = _AUTO_STRAGGLE):
         self.store = store or InMemStore()
         self.timeout_s = timeout_s
         self.failure_max = failure_max
         self.chunks_per_task = chunks_per_task
+        # a silent trainer's leases + uncommitted work requeue after this
+        self.trainer_timeout_s = (timeout_s if trainer_timeout_s is None
+                                  else trainer_timeout_s)
+        # a pending task older than this is re-served speculatively when
+        # todo is dry (first finish wins); default half the task timeout.
+        # An explicit None DISABLES speculative re-dispatch — tasks whose
+        # load_chunk has side effects must never run twice
+        self.straggle_after_s = (timeout_s / 2
+                                 if straggle_after_s is _AUTO_STRAGGLE
+                                 else straggle_after_s)
         self._lock = threading.RLock()
         self.todo: List[Task] = []
         self.pending: Dict[int, Task] = {}
         self._deadlines: Dict[int, float] = {}
+        self._dispatch_t: Dict[int, float] = {}  # straggle clock per task
         self._owner: Dict[str, int] = {}  # trainer_id -> leased task id
         self.done: List[Task] = []
+        self._done_ids: set = set()
+        self.done_by: Dict[int, Optional[str]] = {}
+        # finished-but-uncommitted per trainer, in finish order (commit
+        # protocol: these requeue if the trainer dies before committing)
+        self.uncommitted: Dict[str, List[Task]] = {}
+        self._trainer_seen: Dict[str, float] = {}
         self.failed: List[Task] = []
         self.cur_pass = 0
         self._ready = False
@@ -142,12 +194,17 @@ class MasterService:
             "pending": [t.to_dict() for t in self.pending.values()],
             "done": [t.to_dict() for t in self.done],
             "failed": [t.to_dict() for t in self.failed],
+            "uncommitted": {tr: [t.to_dict() for t in ts]
+                            for tr, ts in self.uncommitted.items() if ts},
+            "done_by": {str(tid): tr for tid, tr in self.done_by.items()},
             "cur_pass": self.cur_pass,
             "ready": self._ready,
         }
         return json.dumps(state).encode()
 
     def _snapshot(self):
+        if _chaos._ACTIVE is not None:
+            _chaos._ACTIVE.hit("store_save")
         self.store.save(self._snapshot_bytes())
 
     def _recover(self):
@@ -156,16 +213,36 @@ class MasterService:
             return
         state = json.loads(data.decode())
         self.todo = [Task.from_dict(d) for d in state["todo"]]
-        # pending work was in flight when the master died → requeue
-        # (`service.go:166` region: recovered state resets dispatch)
-        self.todo.extend(Task.from_dict(d) for d in state["pending"])
+        # work that was in flight (pending lease) when the master died →
+        # requeue at the FRONT, in order (`service.go:166` region:
+        # recovered state resets dispatch; the front-requeue keeps a
+        # single-trainer job's dispatch order stable so exact resume
+        # stays exact across a master death). A live trainer that was
+        # mid-way through that very task reconciles via the idempotent
+        # ``task_finished`` (which claims a requeued copy from todo).
+        recovered = [Task.from_dict(d) for d in state["pending"]]
+        self.todo = recovered + self.todo
+        # finished-but-uncommitted work stays PARKED, not requeued: its
+        # trainer may be alive mid-stream (a master-only death) and has
+        # already trained it — requeueing would double-train. Its
+        # liveness clock restarts NOW: if the trainer never returns to
+        # commit (it died too), trainer_timeout_s expiry requeues.
+        self.uncommitted = {
+            tr: [Task.from_dict(d) for d in ts]
+            for tr, ts in state.get("uncommitted", {}).items()}
+        now = time.monotonic()
+        self._trainer_seen = {tr: now for tr in self.uncommitted}
         self.done = [Task.from_dict(d) for d in state["done"]]
+        self._done_ids = {t.id for t in self.done}
+        self.done_by = {int(k): v
+                        for k, v in state.get("done_by", {}).items()
+                        if int(k) in self._done_ids}
         self.failed = [Task.from_dict(d) for d in state["failed"]]
         self.cur_pass = state["cur_pass"]
         self._ready = state["ready"]
-        logger.info("master recovered: %d todo, %d done, %d failed, pass %d",
-                    len(self.todo), len(self.done), len(self.failed),
-                    self.cur_pass)
+        logger.info("master recovered: %d todo (%d requeued), %d done, "
+                    "%d failed, pass %d", len(self.todo), len(recovered),
+                    len(self.done), len(self.failed), self.cur_pass)
 
     # ------------------------------------------------------------- API
 
@@ -184,17 +261,88 @@ class MasterService:
             if tid == task_id:
                 del self._owner[trainer]
 
+    def _touch_trainer(self, trainer_id: Optional[str]):
+        if trainer_id is not None:
+            self._trainer_seen[trainer_id] = time.monotonic()
+
+    def _mark_done(self, task: Task, trainer_id: Optional[str]):
+        task.num_failures = 0
+        self.done.append(task)
+        self._done_ids.add(task.id)
+        self.done_by[task.id] = trainer_id
+
     def _check_timeouts(self):
         now = time.monotonic()
         expired = [tid for tid, dl in self._deadlines.items() if dl <= now]
-        for tid in expired:
+        # _deadlines is insertion-ordered = dispatch-ordered; each
+        # front-insert reverses, so walk the batch BACKWARDS and the
+        # net prepend preserves dispatch order — a survivor re-trains
+        # simultaneous expiries in the order they were first served
+        for tid in reversed(expired):
             task = self.pending.pop(tid)
             del self._deadlines[tid]
+            self._dispatch_t.pop(tid, None)
             self._release_owner(tid)
-            self._process_failure(task, "timeout")
+            self._process_failure(task, "timeout", front=True,
+                                  snapshot=False)
+        if expired:
+            self._snapshot()
+        # trainer liveness: a silent trainer's pending lease AND its
+        # uncommitted finishes go back to the queue — heartbeats stopped,
+        # so waiting out the (possibly much longer) task deadline would
+        # delay re-dispatch past trainer_timeout_s, and requeueing the
+        # lease AFTER the uncommitted finishes here would invert dispatch
+        # order. Front-requeue the in-flight task first, then prepend the
+        # finishes: todo = [finishes..., in-flight, ...rest].
+        dead = [tr for tr, seen in self._trainer_seen.items()
+                if now - seen > self.trainer_timeout_s]
+        for tr in dead:
+            del self._trainer_seen[tr]
+            self._requeue_trainer(tr, "lease expired")
 
-    def _process_failure(self, task: Task, why: str):
-        # `service.go:313` processFailedTask
+    def _requeue_trainer(self, trainer_id: str, why: str) -> int:
+        """Requeue everything a trainer holds — its in-flight lease and
+        its parked uncommitted finishes — preserving dispatch order:
+        todo = [finishes..., in-flight, ...rest]. Shared by liveness
+        expiry (a dead trainer) and the explicit ``release_lease`` (a
+        live-but-unwound one); a per-task map added to one path and
+        missed by the other would silently leak state or diverge the
+        requeue ordering. Caller holds the lock; liveness is the
+        CALLER's business (expiry drops it, release keeps it — the
+        process is alive). Returns how many tasks went back."""
+        n = 0
+        tid = self._owner.pop(trainer_id, None)
+        if tid is not None and tid in self.pending:
+            task = self.pending.pop(tid)
+            self._deadlines.pop(tid, None)
+            self._dispatch_t.pop(tid, None)
+            logger.warning(
+                "trainer %s (%s): requeueing in-flight task %d",
+                trainer_id, why, tid)
+            self._process_failure(task, why, front=True, snapshot=False)
+            n += 1
+        stale = self.uncommitted.pop(trainer_id, [])
+        if stale:
+            logger.warning(
+                "trainer %s (%s): requeueing %d uncommitted task(s) %s",
+                trainer_id, why, len(stale), [t.id for t in stale])
+            for t in stale:
+                t.num_failures = 0
+            self.todo = stale + self.todo
+            n += len(stale)
+        if n:
+            self._snapshot()
+        return n
+
+    def _process_failure(self, task: Task, why: str, front: bool = False,
+                         snapshot: bool = True):
+        # `service.go:313` processFailedTask. Timeout/death requeues go
+        # to the FRONT (the task returns to its place in dispatch order);
+        # reported failures go to the BACK (poison-pill isolation: a bad
+        # chunk must not head-of-line-block the queue while it burns
+        # through failure_max). ``snapshot=False`` lets batch callers
+        # (expiry sweep, trainer requeue) serialize+fsync the store ONCE
+        # for the whole batch instead of per task, all under the lock.
         task.num_failures += 1
         if task.num_failures > self.failure_max:
             logger.warning("task %d discarded after %d failures (%s)",
@@ -203,8 +351,12 @@ class MasterService:
         else:
             logger.info("task %d requeued (%s, failure %d/%d)", task.id,
                         why, task.num_failures, self.failure_max)
-            self.todo.append(task)
-        self._snapshot()
+            if front:
+                self.todo.insert(0, task)
+            else:
+                self.todo.append(task)
+        if snapshot:
+            self._snapshot()
 
     def get_task(self, pass_id: int = 0, trainer_id: Optional[str] = None):
         """("task", task_dict) | ("wait", None) | ("end", None).
@@ -223,10 +375,16 @@ class MasterService:
         holds an unresolved task (its previous response was lost in a
         connection drop and the client re-sent the request), that same
         task is re-served with a fresh deadline instead of leaking a
-        pending lease that would time out and count a spurious failure."""
+        pending lease that would time out and count a spurious failure.
+
+        When todo is dry but a pending task has been out for more than
+        ``straggle_after_s``, it is re-served to the (idle) caller — a
+        speculative second copy; the first ``task_finished`` wins and
+        the loser's report dedupes."""
         with self._lock:
             if not self._ready:
                 return ("wait", None)
+            self._touch_trainer(trainer_id)
             self._check_timeouts()
             if trainer_id is not None and trainer_id in self._owner:
                 tid = self._owner[trainer_id]
@@ -237,40 +395,117 @@ class MasterService:
                 return ("end", None)
             if not self.todo:
                 if self.pending:
+                    task = self._straggler_candidate(trainer_id)
+                    if task is not None:
+                        self._deadlines[task.id] = (time.monotonic()
+                                                    + self.timeout_s)
+                        # restart the straggle clock: the next idle
+                        # caller should cover the next-oldest pending
+                        # task, not stack more copies onto this one
+                        self._dispatch_t[task.id] = time.monotonic()
+                        if trainer_id is not None:
+                            self._owner[trainer_id] = task.id
+                        logger.info(
+                            "task %d re-dispatched to %s (straggler copy)",
+                            task.id, trainer_id)
+                        return ("task", task.to_dict())
                     return ("wait", None)
                 if pass_id == self.cur_pass:
                     return ("end", None)
-                # drained and the caller is a pass ahead → roll
+                # drained and the caller is a pass ahead → roll, but
+                # ONLY once every parked finish has committed. A
+                # trainer's end-of-pass checkpoint may still be fsyncing
+                # on its background writer (the commit arrives via
+                # ``on_save`` AFTER durability) — committing here would
+                # mark work durable that is not, exactly the
+                # trained-but-lost hole the commit protocol closes. The
+                # wait is live: a healthy owner commits (durable save,
+                # or the reader's uncoupled pass-end commit) and the
+                # roll proceeds; a dead owner's liveness expiry requeues
+                # its parked work into THIS pass instead.
+                if any(self.uncommitted.values()):
+                    return ("wait", None)
                 self.todo = self.done + self.failed
                 for t in self.todo:
                     t.num_failures = 0
                 self.done, self.failed = [], []
+                self._done_ids = set()
+                self.done_by = {}
                 self.cur_pass += 1
                 self._snapshot()
             task = self.todo.pop(0)
             task.epoch = self.cur_pass
             self.pending[task.id] = task
             self._deadlines[task.id] = time.monotonic() + self.timeout_s
+            self._dispatch_t.setdefault(task.id, time.monotonic())
             if trainer_id is not None:
                 self._owner[trainer_id] = task.id
             self._snapshot()
             return ("task", task.to_dict())
 
+    def _straggler_candidate(self, trainer_id) -> Optional[Task]:
+        if trainer_id is None or self.straggle_after_s is None:
+            return None
+        now = time.monotonic()
+        oldest, oldest_t = None, None
+        for tid, task in self.pending.items():
+            t0 = self._dispatch_t.get(tid)
+            if t0 is None or now - t0 < self.straggle_after_s:
+                continue
+            if self._owner.get(trainer_id) == tid:
+                continue  # the caller already holds this very lease
+            if oldest_t is None or t0 < oldest_t:
+                oldest, oldest_t = task, t0
+        return oldest
+
     def pass_finished(self) -> bool:
-        """True when every task of the current pass is resolved."""
+        """True when every task of the current pass is resolved
+        (uncommitted finishes count as resolved — they are trained,
+        merely awaiting their trainer's checkpoint commit)."""
         with self._lock:
             self._check_timeouts()
             return self._ready and not self.todo and not self.pending
 
-    def task_finished(self, task_id: int) -> bool:
+    def task_finished(self, task_id: int,
+                      trainer_id: Optional[str] = None,
+                      defer_commit: bool = False) -> bool:
+        """Idempotent, at-least-once-safe finish. True whenever the task
+        is (now) resolved: first report moves it out of pending; a
+        duplicate report (client retry after a lost response, or the
+        losing copy of a straggler re-dispatch) finds it in the done
+        ledger / uncommitted buffer and succeeds as a no-op; a report
+        for a task that timed out back into todo claims it from there
+        (the work WAS done — counting it failed would retrain it).
+        False only for ids this job has never known unresolved."""
         with self._lock:
+            self._touch_trainer(trainer_id)
             task = self.pending.pop(task_id, None)
             self._deadlines.pop(task_id, None)
+            self._dispatch_t.pop(task_id, None)
             self._release_owner(task_id)
             if task is None:
-                return False
-            task.num_failures = 0
-            self.done.append(task)
+                if task_id in self._done_ids:
+                    return True  # duplicate of a committed finish
+                for ts in self.uncommitted.values():
+                    if any(t.id == task_id for t in ts):
+                        return True  # duplicate of an uncommitted finish
+                for i, t in enumerate(self.todo):
+                    # finished after a timeout/death requeue WITHIN this
+                    # pass (epoch = last dispatch pass). A recycled copy
+                    # in a LATER pass keeps its stale epoch until
+                    # re-dispatched — a delayed duplicate finish from the
+                    # previous pass must not mark the new pass's copy
+                    # trained.
+                    if t.id == task_id and t.epoch == self.cur_pass:
+                        task = self.todo.pop(i)
+                        break
+                if task is None:
+                    return False
+            if defer_commit and trainer_id is not None:
+                task.num_failures = 0
+                self.uncommitted.setdefault(trainer_id, []).append(task)
+            else:
+                self._mark_done(task, trainer_id)
             self._snapshot()
             return True
 
@@ -278,11 +513,185 @@ class MasterService:
         with self._lock:
             task = self.pending.pop(task_id, None)
             self._deadlines.pop(task_id, None)
+            self._dispatch_t.pop(task_id, None)
             self._release_owner(task_id)
             if task is None:
                 return False
             self._process_failure(task, "reported")
             return True
+
+    def commit_tasks(self, trainer_id: str,
+                     task_ids: Optional[List[int]] = None) -> int:
+        """Move this trainer's uncommitted finishes to the durable done
+        ledger — sent after the trainer's checkpoint containing that
+        work is durable. ``task_ids=None`` commits everything buffered.
+        Idempotent; returns how many tasks moved."""
+        with self._lock:
+            self._touch_trainer(trainer_id)
+            buf = self.uncommitted.get(trainer_id, [])
+            if task_ids is None:
+                take, keep = buf, []
+            else:
+                want = {int(i) for i in task_ids}
+                take = [t for t in buf if t.id in want]
+                keep = [t for t in buf if t.id not in want]
+            if not take:
+                return 0
+            self.uncommitted[trainer_id] = keep
+            for t in take:
+                if t.id not in self._done_ids:
+                    self._mark_done(t, trainer_id)
+            self._snapshot()
+            return len(take)
+
+    def heartbeat(self, trainer_id: str) -> bool:
+        """Renew the trainer's liveness and the deadline of every task
+        it holds (`etcd lease keepalive` role)."""
+        with self._lock:
+            self._touch_trainer(trainer_id)
+            tid = self._owner.get(trainer_id)
+            if tid is not None and tid in self._deadlines:
+                self._deadlines[tid] = time.monotonic() + self.timeout_s
+            return True
+
+    def current_pass(self) -> int:
+        with self._lock:
+            return self.cur_pass
+
+    def resume_lease(self, trainer_id: str, pass_id: int,
+                     done_ids: List[int],
+                     inflight_id: Optional[int] = None,
+                     prev_trainer_id: Optional[str] = None) -> dict:
+        """Reconcile the queue against the task ledger a resumed trainer
+        restored from its checkpoint (the real fix for the pass-aware
+        mid-pass resume caveat):
+
+        - every task the checkpoint recorded as consumed (``done_ids``)
+          is marked done, wherever it currently sits (requeued by a
+          lease expiry, parked uncommitted, still pending under the
+          trainer's stale lease);
+        - every task THIS trainer finished *beyond* the checkpoint
+          (uncommitted, or committed from a newer-but-lost generation)
+          is requeued — the restored parameters do not contain that
+          training;
+        - the checkpoint's in-flight task (``inflight_id``) moves to the
+          queue front so the resumed reader re-acquires it first and
+          can skip its already-trained record prefix;
+        - the requeued slice is re-sorted by task id and the in-flight
+          task fronted, so a single-trainer job replays the exact
+          dispatch order of the uninterrupted run; the REST of the
+          queue keeps its order (front-requeues, poison-pill backs).
+
+        ``prev_trainer_id`` is the id the checkpoint's ledger was
+        written under (the previous life of this trainer — the default
+        id is pid-derived and NOT stable across restarts): its parked
+        finishes, done-ledger entries, and stale lease are reconciled
+        as this trainer's own, so work the old life committed from a
+        newer-but-LOST checkpoint generation is requeued instead of
+        staying marked trained in parameters that no longer contain it.
+
+        No-op (returns the authoritative pass) when the master has
+        already moved past ``pass_id``."""
+        with self._lock:
+            self._check_timeouts()
+            self._touch_trainer(trainer_id)
+            if pass_id != self.cur_pass:
+                return {"pass": self.cur_pass, "requeued": 0, "done": 0}
+            done_set = {int(i) for i in done_ids}
+            moved = requeued = 0
+            # (a) checkpoint-consumed tasks → done, from wherever —
+            # including finishes parked under a PREVIOUS life's trainer
+            # id (the default id is pid-derived, not stable across
+            # restarts): leaving them parked would hold the
+            # durability-gated pass roll until lease expiry and then
+            # retrain work the checkpoint already proved durable
+            for src in [self.todo] + list(self.uncommitted.values()):
+                for t in [t for t in src if t.id in done_set]:
+                    src.remove(t)
+                    if t.id not in self._done_ids:
+                        self._mark_done(t, trainer_id)
+                        moved += 1
+            for tid in [tid for tid in list(self.pending)
+                        if tid in done_set]:
+                t = self.pending.pop(tid)
+                self._deadlines.pop(tid, None)
+                self._dispatch_t.pop(tid, None)
+                self._release_owner(tid)
+                if t.id not in self._done_ids:
+                    self._mark_done(t, trainer_id)
+                    moved += 1
+            # (b) this trainer's post-checkpoint work → back to todo;
+            # "this trainer" spans its previous life's id too
+            selves = {trainer_id}
+            if prev_trainer_id:
+                selves.add(prev_trainer_id)
+                if prev_trainer_id != trainer_id:
+                    # the old process is gone; don't let its liveness
+                    # entry linger until the timeout fires spuriously
+                    self._trainer_seen.pop(prev_trainer_id, None)
+            back: List[Task] = []
+            for self_id in selves:
+                for t in self.uncommitted.pop(self_id, []):
+                    if t.id not in done_set:
+                        back.append(t)
+            for t in [t for t in self.done
+                      if self.done_by.get(t.id) in selves
+                      and t.id not in done_set]:
+                self.done.remove(t)
+                self._done_ids.discard(t.id)
+                self.done_by.pop(t.id, None)
+                back.append(t)
+            # (c) its stale pending lease(s) are void — the process is
+            # gone (old id) or re-acquiring from scratch (new id)
+            for self_id in selves:
+                stale_tid = self._owner.pop(self_id, None)
+                if stale_tid is not None and stale_tid in self.pending \
+                        and stale_tid not in done_set:
+                    back.append(self.pending.pop(stale_tid))
+                    self._deadlines.pop(stale_tid, None)
+                    self._dispatch_t.pop(stale_tid, None)
+            for t in back:
+                t.num_failures = 0
+            requeued = len(back)
+            # (d) deterministic replay order for the REQUEUED slice only
+            # (a single-trainer job dispatches in id order, so its
+            # resumed prefix must too); the rest of the queue keeps its
+            # placement — front-requeues preserve dispatch order and a
+            # poison pill deliberately sits at the back, neither of
+            # which is this trainer's to rewrite
+            back.sort(key=lambda t: t.id)
+            self.todo = back + self.todo
+            if inflight_id is not None:
+                for i, t in enumerate(self.todo):
+                    if t.id == int(inflight_id):
+                        self.todo.insert(0, self.todo.pop(i))
+                        break
+            self._snapshot()
+            logger.info(
+                "resume_lease(%s, pass %d): %d re-marked done, %d "
+                "requeued, inflight=%s", trainer_id, pass_id, moved,
+                requeued, inflight_id)
+            return {"pass": self.cur_pass, "requeued": requeued,
+                    "done": moved}
+
+    def release_lease(self, trainer_id: str) -> int:
+        """A live process whose training loop unwound mid-pass (a user
+        exception, a NaN anomaly) abandons its work NOW: the in-flight
+        lease and the parked uncommitted finishes requeue immediately.
+        Liveness expiry cannot free them — the client (and its heartbeat
+        thread) may stay open long after train() raised, renewing the
+        trainer's liveness while the commit that would release the
+        durability-gated pass roll can never come. Same ordering as the
+        expiry path: todo = [finishes..., in-flight, ...rest] — both go
+        through ``_requeue_trainer``. Liveness stays: the process is
+        alive and may lease again. Returns how many tasks were
+        requeued."""
+        with self._lock:
+            n = self._requeue_trainer(trainer_id, "lease released")
+            if n:
+                logger.info("trainer %s released its lease: %d task(s) "
+                            "requeued", trainer_id, n)
+            return n
 
     def request_save_model(self, trainer_id: str,
                            block_dur_s: float) -> bool:
@@ -300,11 +709,15 @@ class MasterService:
 # ----------------------------------------------------------------- RPC
 
 def _send_msg(sock: socket.socket, obj: Any):
+    if _chaos._ACTIVE is not None:
+        _chaos._ACTIVE.hit("msg_send")
     data = json.dumps(obj).encode()
     sock.sendall(struct.pack(">I", len(data)) + data)
 
 
 def _recv_msg(sock: socket.socket) -> Any:
+    if _chaos._ACTIVE is not None:
+        _chaos._ACTIVE.hit("msg_recv")
     hdr = _recv_exact(sock, 4)
     (n,) = struct.unpack(">I", hdr)
     return json.loads(_recv_exact(sock, n).decode())
@@ -324,7 +737,8 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 # socket (anything else, including non-callable attributes, is rejected).
 RPC_METHODS = frozenset({
     "set_dataset", "get_task", "task_finished", "task_failed",
-    "pass_finished", "request_save_model",
+    "pass_finished", "request_save_model", "heartbeat", "commit_tasks",
+    "current_pass", "resume_lease", "release_lease",
 })
 
 
@@ -342,6 +756,10 @@ class _Handler(socketserver.BaseRequestHandler):
                     fn = getattr(svc, method)
                     result = fn(**kwargs)
                     _send_msg(self.request, {"ok": True, "result": result})
+                except _chaos.ChaosDropped:
+                    raise  # an injected loss of the RESPONSE: close the
+                    # connection so the client's retry path exercises the
+                    # duplicate-request (idempotency) guarantees
                 except Exception as e:  # report, keep serving
                     _send_msg(self.request, {"ok": False, "error": str(e)})
         except (ConnectionError, OSError):
@@ -377,47 +795,127 @@ class MasterServer:
 
 
 class MasterClient:
-    """Client with re-dial on connection loss (`go/connection/conn.go`)."""
+    """Client with re-dial on connection loss (`go/connection/conn.go`).
+
+    Retries use capped jittered exponential backoff: attempt n sleeps
+    ``min(backoff_cap, retry_delay * 2**n) * uniform(0.5, 1.0)`` — a
+    restarted master is not greeted by a synchronized retry storm from
+    every trainer at once. Each delay is value-seeded from
+    ``(trainer_id, method, attempt)`` — no shared jitter stream the
+    training and heartbeat threads could interleave on — so a chaos
+    run's retry timing reproduces from its seed.
+
+    ``heartbeat_s`` arms a daemon thread renewing this trainer's task
+    lease and liveness at that period (the etcd keepalive role); it
+    starts lazily at the first ``get_task`` and stops at ``close``.
+    It defaults ON (10 s — well inside the master's default 60 s
+    ``trainer_timeout_s``): without a beat, a healthy trainer whose one
+    task trains longer than the lease timeout is declared dead and its
+    work requeued to a peer. Pass ``heartbeat_s=None`` (or 0) to
+    disable, e.g. for a deliberately-silent test client."""
 
     def __init__(self, addr, *, retries: int = 10, retry_delay: float = 0.2,
+                 backoff_cap: float = 5.0,
                  trainer_id: Optional[str] = None,
-                 connect_timeout: float = 30.0):
+                 connect_timeout: float = 30.0,
+                 heartbeat_s: Optional[float] = 10.0):
         self.addr = tuple(addr)
         self.retries = retries
         self.retry_delay = retry_delay
+        self.backoff_cap = backoff_cap
         self.connect_timeout = connect_timeout
+        self.heartbeat_s = heartbeat_s
         # identifies this client's task lease so a retried get_task after a
         # dropped response re-serves the same task instead of leaking it
         self.trainer_id = trainer_id or f"trainer-{os.getpid()}-{id(self):x}"
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
+        self._hb_thread: Optional[threading.Thread] = None
+        self._hb_stop = threading.Event()
 
     def _connect(self):
         s = socket.create_connection(self.addr, timeout=self.connect_timeout)
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._sock = s
 
+    def _backoff(self, attempt: int, method: str = "") -> float:
+        # value-seeded, not a shared Random stream: the training thread
+        # and the heartbeat thread both redial concurrently, and their
+        # scheduler-dependent interleaving on one stream would make the
+        # same seed produce different backoff sequences run to run —
+        # each delay depends only on (trainer_id, method, attempt), the
+        # FaultPlan._bernoulli recipe, so chaos timing reproduces
+        rng = random.Random(f"{self.trainer_id}:{method}:{attempt}")
+        return backoff_delay(attempt, base=self.retry_delay,
+                             cap=self.backoff_cap, rng=rng)
+
     def call(self, method: str, **kwargs):
-        with self._lock:
-            last = None
-            for _ in range(self.retries):
-                try:
-                    if self._sock is None:
-                        self._connect()
-                    _send_msg(self._sock, {"method": method,
-                                           "kwargs": kwargs})
-                    resp = _recv_msg(self._sock)
-                    if not resp["ok"]:
-                        raise RuntimeError(resp["error"])
-                    return resp["result"]
-                except (ConnectionError, OSError) as e:
-                    last = e
-                    self._sock = None
-                    time.sleep(self.retry_delay)
-            raise ConnectionError(
-                f"master at {self.addr} unreachable: {last}")
+        # the lock scopes ONE request/response exchange (no interleaved
+        # frames from the heartbeat thread), NOT the whole retry cycle:
+        # sleeping the backoff under the lock would block the training
+        # thread's RPCs — and close() — for the full redial cycle while
+        # the heartbeat thread waits out a master restart
+        last = None
+        for attempt in range(self.retries):
+            try:
+                with self._lock:
+                    try:
+                        if self._sock is None:
+                            self._connect()
+                        _send_msg(self._sock, {"method": method,
+                                               "kwargs": kwargs})
+                        resp = _recv_msg(self._sock)
+                    except (ConnectionError, OSError):
+                        # a failed exchange leaves the socket desynced
+                        # (request sent, response unread — or vice
+                        # versa): it must be torn down before this lock
+                        # RELEASES, or the heartbeat thread queued on
+                        # the lock would run its own exchange on the
+                        # desynced socket and read the stale response
+                        # as its own, cross-wiring RPC results between
+                        # threads
+                        if self._sock is not None:
+                            try:
+                                self._sock.close()
+                            except OSError:
+                                pass
+                        self._sock = None
+                        raise
+                if not resp["ok"]:
+                    raise RuntimeError(resp["error"])
+                return resp["result"]
+            except (ConnectionError, OSError) as e:
+                last = e
+                if attempt + 1 >= self.retries:
+                    break  # terminal failure: raise now, no dead sleep
+                # interruptible: close() sets the event, so shutdown is
+                # not held hostage by a redial cycle
+                if self._hb_stop.wait(self._backoff(attempt, method)):
+                    break
+        raise ConnectionError(
+            f"master at {self.addr} unreachable: {last}")
+
+    # ---------------------------------------------------- heartbeats
+    def _hb_loop(self):
+        while not self._hb_stop.wait(self.heartbeat_s):
+            try:
+                self.call("heartbeat", trainer_id=self.trainer_id)
+            except (ConnectionError, RuntimeError) as e:
+                # the master may be mid-restart; the next beat retries
+                logger.debug("heartbeat failed (will retry): %s", e)
+
+    def start_heartbeat(self):
+        if self.heartbeat_s and self._hb_thread is None:
+            self._hb_stop.clear()
+            self._hb_thread = threading.Thread(target=self._hb_loop,
+                                               daemon=True)
+            self._hb_thread.start()
 
     def close(self):
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2.0)
+            self._hb_thread = None
         with self._lock:
             if self._sock is not None:
                 self._sock.close()
@@ -428,12 +926,15 @@ class MasterClient:
         return self.call("set_dataset", chunks=chunks)
 
     def get_task(self, pass_id: int = 0):
+        self.start_heartbeat()
         status, tdict = self.call("get_task", pass_id=pass_id,
                                   trainer_id=self.trainer_id)
         return status, (Task.from_dict(tdict) if tdict else None)
 
-    def task_finished(self, task_id: int):
-        return self.call("task_finished", task_id=task_id)
+    def task_finished(self, task_id: int, defer_commit: bool = False):
+        return self.call("task_finished", task_id=task_id,
+                         trainer_id=self.trainer_id,
+                         defer_commit=defer_commit)
 
     def task_failed(self, task_id: int):
         return self.call("task_failed", task_id=task_id)
@@ -441,13 +942,34 @@ class MasterClient:
     def pass_finished(self):
         return self.call("pass_finished")
 
+    def commit_tasks(self, task_ids: Optional[List[int]] = None):
+        return self.call("commit_tasks", trainer_id=self.trainer_id,
+                         task_ids=task_ids)
+
+    def heartbeat(self):
+        return self.call("heartbeat", trainer_id=self.trainer_id)
+
+    def current_pass(self) -> int:
+        return self.call("current_pass")
+
+    def resume_lease(self, pass_id: int, done_ids: List[int],
+                     inflight_id: Optional[int] = None,
+                     prev_trainer_id: Optional[str] = None):
+        return self.call("resume_lease", trainer_id=self.trainer_id,
+                         pass_id=pass_id, done_ids=list(done_ids),
+                         inflight_id=inflight_id,
+                         prev_trainer_id=prev_trainer_id)
+
+    def release_lease(self):
+        return self.call("release_lease", trainer_id=self.trainer_id)
+
     def request_save_model(self, trainer_id: str, block_dur_s: float):
         return self.call("request_save_model", trainer_id=trainer_id,
                          block_dur_s=block_dur_s)
 
 
 def master_reader(client: MasterClient, load_chunk, *,
-                  poll_s: float = 0.05):
+                  poll_s: float = 0.05, defer_commit: bool = True):
     """Reader over master-dispatched tasks (the v2
     `python/paddle/v2/master/client.py` role): pulls tasks, yields records
     from ``load_chunk(chunk)``, reports finish/failure. Each call of the
@@ -457,35 +979,245 @@ def master_reader(client: MasterClient, load_chunk, *,
     The returned reader declares ``pass_aware = True``: the trainer calls
     it as ``reader(pass_id)`` so a checkpoint-resumed run requests the
     right pass from the master instead of getting an instant 'end' for
-    already-finished ones. Caveat (shared with the reference): within a
-    pass the master does not re-serve tasks already finished, so a
-    mid-pass checkpoint restored against a persistent master resumes with
-    only that pass's *remaining* tasks — records between the checkpoint
-    and the crash are trained at-least-once only across passes, not
-    within the interrupted one."""
-    state = {"pass_id": 0}
+    already-finished ones.
+
+    Exact-resume surface (consumed by ``SGD.train``; the fix for the
+    old mid-pass caveat — records between a checkpoint and a crash are
+    no longer lost within the interrupted pass):
+
+    - ``ledger_state()`` — JSON-able position: the running pass, every
+      task id finished so far in it, the in-flight task id and how many
+      of its records have been yielded. The trainer stores this inside
+      each checkpoint.
+    - ``restore_ledger(ledger)`` — arm a resume: the next pass call
+      sends ``resume_lease`` to the master (re-marking consumed tasks
+      done, requeueing this trainer's post-checkpoint work, fronting
+      the in-flight task) and skips the in-flight task's
+      already-trained record prefix.
+    - ``commit_ledger(ledger)`` — commit the finishes named by a (now
+      durable) checkpoint's ledger; called by the checkpoint writer
+      AFTER fsync, so the master never believes work durable that is
+      not. ``None`` commits everything buffered (end-of-pass).
+    - ``sync_pass(start)`` — reconcile a resumed trainer's start pass
+      with the master's authoritative current pass, so a trainer whose
+      cluster moved on neither replays nor starves on long-dead passes.
+
+    ``defer_commit=True`` (default) parks finishes in the master's
+    per-trainer uncommitted buffer until a commit; the master's pass
+    roll WAITS on parked finishes (durability gate), so with no
+    checkpointer wired (``checkpoint_coupled`` False) the reader
+    commits its own buffer when its pass ends."""
+    state = {"pass_id": 0, "run_pass": 0, "finished": [], "cur": None,
+             "resume": None}
 
     def reader(pass_id: Optional[int] = None):
         my_pass = state["pass_id"] if pass_id is None else pass_id
         state["pass_id"] = my_pass + 1
+        state["run_pass"] = my_pass
+        skip_map = {}
+        resume, state["resume"] = state["resume"], None
+        if resume is not None and int(resume.get("pass", -1)) == my_pass:
+            done_ids = [int(i) for i in resume.get("done", [])]
+            inflight = resume.get("inflight")
+            resp = client.resume_lease(
+                my_pass, done_ids, inflight,
+                prev_trainer_id=resume.get("trainer"))
+            auth = (int(resp.get("pass", my_pass))
+                    if isinstance(resp, dict) else my_pass)
+            if auth == my_pass:
+                state["finished"] = list(done_ids)
+                if inflight is not None:
+                    skip_map[int(inflight)] = int(resume.get("offset", 0))
+            else:
+                # the master's authoritative pass moved (a peer rolled
+                # it, or a recovered master lost the run's progress):
+                # the reconciliation no-oped, so NOTHING of our ledger
+                # applies — in particular the in-flight record-prefix
+                # skip, which would silently drop records the served
+                # pass has never trained
+                logger.warning(
+                    "resume_lease no-oped (ledger pass %d, master pass "
+                    "%d): discarding restored ledger, training the "
+                    "served tasks in full", my_pass, auth)
+                state["finished"] = []
+        elif resume is not None and \
+                0 <= int(resume.get("pass", -1)) < my_pass:
+            # a COMPLETED pass's ledger (end-of-pass checkpoint made
+            # durable, its commit RPC lost to the crash): the finishes
+            # it names may still sit parked under the previous life's
+            # id — with a stable trainer id, OUR OWN, whose liveness
+            # every poll renews, so expiry can never free them — holding
+            # the durability-gated roll of a pass the restored
+            # parameters fully contain. Re-mark them done; the master
+            # no-ops if that pass already rolled.
+            done_ids = [int(i) for i in resume.get("done", [])]
+            if done_ids:
+                client.resume_lease(
+                    int(resume["pass"]), done_ids, None,
+                    prev_trainer_id=resume.get("trainer"))
+            # and anything a previous life left parked at the CURRENT
+            # pass (fresh boot with lost disk while the cluster moved
+            # on): the empty reconcile requeues it, no-ops otherwise
+            client.resume_lease(my_pass, [], None,
+                                prev_trainer_id=resume.get("trainer"))
+            state["finished"] = []
+        else:
+            state["finished"] = []
         while True:
             status, task = client.get_task(my_pass)
             if status == "end":
+                # no checkpoint plane is driving commits (the trainer
+                # sets ``checkpoint_coupled`` when it wires on_save):
+                # commit the pass's finishes now, or the master's
+                # durability-gated pass roll would wait on them forever
+                if defer_commit and not reader.checkpoint_coupled:
+                    client.commit_tasks()
                 return
             if status == "wait":
+                # "wait" can be the durability gate holding the pass
+                # roll for OUR OWN uncommitted finishes — if the plane
+                # that would commit them (the background checkpoint
+                # writer) has died, polling would spin forever, each
+                # poll renewing this trainer's liveness so not even the
+                # lease timeout frees the work. The health check turns
+                # that livelock into the writer's error.
+                if reader.health_check is not None:
+                    reader.health_check()
                 time.sleep(poll_s)
                 continue
+            skip = skip_map.pop(task.id, 0)
+            # epoch == the pass the master dispatched this copy in. A
+            # MISMATCH means a liveness repair: the master served a
+            # STALE pass's task (its owner died, no trainer at that
+            # pass remains) to keep the job live. That work is not this
+            # pass's: recorded in OUR ledger, a later crash-resume
+            # would mark the task's recycled next-pass copy done
+            # without the pass ever training it. It stays out of the
+            # ledger (done AND inflight), and its finish commits
+            # immediately — parked, no checkpoint of ours would ever
+            # name it and the durability-gated pass roll would wait on
+            # it forever.
+            mine = getattr(task, "epoch", my_pass) == my_pass
+            cur = [task.id, 0]
+            state["cur"] = cur if mine else None
             try:
+                n = 0
                 for chunk in task.chunks:
                     for rec in load_chunk(chunk):
+                        n += 1
+                        cur[1] = n
+                        if n <= skip:
+                            continue  # already trained before the crash
                         yield rec
             except GeneratorExit:
                 raise
             except Exception as e:
                 logger.warning("task %d failed in reader: %s", task.id, e)
+                state["cur"] = None
                 client.task_failed(task.id)
             else:
-                client.task_finished(task.id)
+                state["cur"] = None
+                client.task_finished(task.id,
+                                     defer_commit=defer_commit and mine)
+                if mine:
+                    state["finished"].append(task.id)
+
+    def ledger_state():
+        cur = state["cur"]
+        return {"pass": state["run_pass"],
+                "done": list(state["finished"]),
+                "inflight": (cur[0] if cur else None),
+                "offset": (cur[1] if cur else 0),
+                # who wrote this ledger: resume_lease reconciles the
+                # previous life's parked/committed work under this id
+                # (the default id is pid-derived, new every restart)
+                "trainer": client.trainer_id}
+
+    def restore_ledger(ledger):
+        state["resume"] = dict(ledger) if ledger else None
+
+    def commit_ledger(ledger=None):
+        if not defer_commit:
+            return 0
+        ids = None if ledger is None else ledger.get("done")
+        return client.commit_tasks(task_ids=ids)
+
+    def sync_pass(start_pass: int = 0) -> int:
+        p = max(int(start_pass), int(client.current_pass()))
+        state["pass_id"] = p
+        return p
 
     reader.pass_aware = True
+    # True once a checkpointer's on_save owns commits (set by SGD.train)
+    reader.checkpoint_coupled = False
+    # zero-arg callable raising if the commit plane is dead (SGD.train
+    # wires the checkpointer's poll_error); polled while status=="wait"
+    reader.health_check = None
+    reader.ledger_state = ledger_state
+    reader.restore_ledger = restore_ledger
+    reader.commit_ledger = commit_ledger
+    reader.sync_pass = sync_pass
+    # called by SGD.train when the loop unwinds on a plain Exception:
+    # the client (and its heartbeat) may stay open, so only an explicit
+    # release frees the in-flight lease and parked finishes
+    reader.release_lease = client.release_lease
     return reader
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """Run a standalone master process (`go/master/master.go` role):
+
+        python -m paddle_tpu.dist.master --port 8765 --store /path/snap
+
+    The task queue recovers from ``--store`` on restart — kill the
+    process and relaunch it and every in-flight lease requeues; clients
+    redial with backoff. ``tools/chaos_soak.py`` drives exactly that."""
+    import argparse
+    import signal
+
+    ap = argparse.ArgumentParser(prog="python -m paddle_tpu.dist.master")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--store", default="",
+                    help="FileStore snapshot path (empty = in-memory)")
+    ap.add_argument("--timeout_s", type=float, default=60.0)
+    ap.add_argument("--trainer_timeout_s", type=float, default=None)
+    ap.add_argument("--failure_max", type=int, default=3)
+    ap.add_argument("--chunks_per_task", type=int, default=1)
+    ap.add_argument("--straggle_after_s", default="auto",
+                    help="seconds before a pending task is speculatively "
+                         "re-served when todo is dry; 'auto' = "
+                         "timeout_s/2, 'off' disables re-dispatch "
+                         "(required when load_chunk has side effects "
+                         "that must never run twice)")
+    args = ap.parse_args(argv)
+
+    if args.straggle_after_s == "auto":
+        straggle = _AUTO_STRAGGLE
+    elif args.straggle_after_s in ("off", "none"):
+        straggle = None
+    else:
+        straggle = float(args.straggle_after_s)
+    _chaos.install_from_env()
+    store = FileStore(args.store) if args.store else None
+    svc = MasterService(store=store, timeout_s=args.timeout_s,
+                        trainer_timeout_s=args.trainer_timeout_s,
+                        failure_max=args.failure_max,
+                        chunks_per_task=args.chunks_per_task,
+                        straggle_after_s=straggle)
+    server = MasterServer(svc, host=args.host, port=args.port)
+    print(f"MASTER {server.addr[0]}:{server.addr[1]}", flush=True)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    server.start()
+    try:
+        stop.wait()
+    finally:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -52,6 +52,7 @@ from paddle_tpu.serving.errors import (BadRequest, DeadlineExceeded,
                                        Overloaded, ServingError,
                                        ShuttingDown)
 from paddle_tpu.serving.metrics import ServingMetrics
+from paddle_tpu.testing import chaos as _chaos
 from paddle_tpu.utils.log import get_logger
 
 logger = get_logger("serving")
@@ -287,6 +288,13 @@ class ServingEngine:
                     logger.info("serving: worker drained and stopped")
                     return
                 if batch:
+                    if _chaos._ACTIVE is not None:
+                        # straggler injection point: a FaultPlan stall
+                        # here models a slow device step — deadline and
+                        # retry_after_ms behavior must stay honest
+                        _chaos._ACTIVE.hit("serve_batch",
+                                           kind=batch[0].kind,
+                                           size=len(batch))
                     if (self._session is not None
                             and batch[0].kind == "generate"):
                         self._run_generate_continuous(batch)

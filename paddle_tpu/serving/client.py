@@ -7,26 +7,64 @@ status-code string matching. Closed-menu 400s carry
 ``BadRequest.allowed`` — the warmed values (e.g. the pinned
 ``beam_size`` / ``max_length`` / length-bucket menu) the client can
 retry with.
+
+Opt-in retries (``retries=N``): every serving request is idempotent
+(stateless inference), so the client may safely re-send on a connection
+reset (a worker restart, a drained-and-relaunched server) and on 429
+load-shed — honoring the server's ``Overloaded.retry_after_ms`` drain
+estimate when present, else capped jittered exponential backoff. Other
+typed errors (400 bad request, 504 deadline) are NOT retried: the same
+request would fail the same way, and a deadline has, by definition,
+already passed.
 """
 
 from __future__ import annotations
 
 import http.client
-import json
+import random
+import time
 from typing import List, Optional
 
-from paddle_tpu.serving.errors import ServingError, from_wire
+import json
+
+from paddle_tpu.serving.errors import Overloaded, ServingError, from_wire
+from paddle_tpu.utils.backoff import backoff_delay, jittered_up
 
 
 class ServingClient:
     def __init__(self, host: str = "127.0.0.1", port: int = 8000,
-                 timeout: float = 120.0):
+                 timeout: float = 120.0, *, retries: int = 0,
+                 backoff_base_ms: float = 50.0,
+                 backoff_cap_ms: float = 2000.0,
+                 backoff_seed: Optional[int] = None):
         self.host = host
         self.port = int(port)
         self.timeout = timeout
+        self.retries = int(retries)
+        self.backoff_base_ms = backoff_base_ms
+        self.backoff_cap_ms = backoff_cap_ms
+        self._jitter = random.Random(backoff_seed)
 
     # ------------------------------------------------------------- wire
-    def _request(self, method: str, path: str, body=None) -> dict:
+    def _sleep_ms(self, ms: float):
+        time.sleep(max(0.0, ms) / 1e3)
+
+    def _backoff_ms(self, attempt: int,
+                    retry_after_ms: Optional[float] = None) -> float:
+        """Capped jittered exponential backoff; a server-provided
+        ``retry_after_ms`` (the 429 drain estimate) takes precedence,
+        jittered UP (``uniform(1.0, 1.5)`` of itself) so a fleet of
+        clients does not return in lockstep at exactly the drain
+        horizon — never below it, since re-sending into a still-full
+        queue burns the retry budget on fresh 429s. For the same
+        reason the client-side cap applies only to its OWN
+        exponential schedule, never to the server's estimate."""
+        if retry_after_ms is not None:
+            return jittered_up(float(retry_after_ms), self._jitter)
+        return backoff_delay(attempt, base=self.backoff_base_ms,
+                             cap=self.backoff_cap_ms, rng=self._jitter)
+
+    def _request_once(self, method: str, path: str, body=None) -> dict:
         conn = http.client.HTTPConnection(self.host, self.port,
                                           timeout=self.timeout)
         try:
@@ -44,6 +82,28 @@ class ServingClient:
             return data
         finally:
             conn.close()
+
+    def _request(self, method: str, path: str, body=None) -> dict:
+        last: Optional[BaseException] = None
+        for attempt in range(self.retries + 1):
+            try:
+                return self._request_once(method, path, body)
+            except Overloaded as e:
+                # 429 (load shed / draining): back off for the server's
+                # drain estimate when it gave one
+                last = e
+                if attempt >= self.retries:
+                    raise
+                self._sleep_ms(self._backoff_ms(attempt, e.retry_after_ms))
+            except (ConnectionError, http.client.HTTPException,
+                    TimeoutError, OSError) as e:
+                # connection reset / refused mid-restart: idempotent
+                # requests may re-send
+                last = e
+                if attempt >= self.retries:
+                    raise
+                self._sleep_ms(self._backoff_ms(attempt))
+        raise ServingError(f"unreachable: {last!r}")  # not reached
 
     # ---------------------------------------------------------- methods
     def score(self, sample, deadline_ms: Optional[float] = None) -> dict:

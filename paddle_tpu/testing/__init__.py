@@ -1,0 +1,9 @@
+"""Test-support runtime pieces that ship with the library.
+
+``paddle_tpu.testing.chaos`` is the deterministic fault-injection plane
+(the analogue of the reference CI's kill-based fault-tolerance drills,
+`go/master/service_internal_test.go` / `paddle/scripts/cluster_train`):
+it lives in the package, not in tests/, because production code carries
+its hook points and ``tools/chaos_soak.py`` drives it across processes.
+Import cost is a few stdlib modules; nothing here imports jax.
+"""

@@ -1,0 +1,257 @@
+"""Deterministic fault injection: the chaos plane.
+
+The reference proved its third-generation fault tolerance by killing
+real processes in CI shell scripts; that is irreproducible and slow.
+Here the production code carries *named hook points* — the trainer's
+step loop, the master RPC codec (``dist/master.py:_send_msg/_recv_msg``),
+the checkpoint writer (``dist/checkpoint.py``), the serving batcher —
+and a seeded :class:`FaultPlan` decides, purely from (site, hit-count,
+seed), whether a given hit kills the process, drops or delays a
+message, corrupts the checkpoint file just written, or injects a
+straggler stall. The same plan therefore produces the same fault
+schedule on every run: a chaos failure reproduces from its seed.
+
+Zero cost when disabled: every hook site guards with
+``if chaos._ACTIVE is not None`` — one module-global load per hit, no
+function call, no allocation. Nothing in this module imports jax.
+
+Fault spec (JSON-able, the format ``tools/chaos_soak.py`` writes into
+``PADDLE_TPU_CHAOS_PLAN``)::
+
+    {"seed": 7, "faults": [
+      {"type": "kill",     "site": "step",  "at": 12, "mode": "exit"},
+      {"type": "drop",     "site": "msg_send", "rate": 0.05},
+      {"type": "delay",    "site": "msg_recv", "every": 7, "seconds": 0.02},
+      {"type": "partition","site": "msg_send", "after": 40, "count": 10},
+      {"type": "corrupt",  "site": "checkpoint", "at": 2,
+       "mode": "truncate"},
+      {"type": "straggle", "site": "serve_batch", "rate": 0.2,
+       "seconds": 0.01}
+    ]}
+
+Sites wired in this codebase:
+
+==============  ========================================================
+``step``        end of each trainer iteration, BEFORE the checkpoint
+                cadence runs (a kill here loses the batch's checkpoint
+                → resume replays it)
+``step_done``   end of each trainer iteration, AFTER checkpointing (a
+                kill here tests resume from the just-written file)
+``msg_send``    master RPC message about to be serialized (client *and*
+                server side)
+``msg_recv``    master RPC message about to be read
+``checkpoint``  a checkpoint generation just became durable (info
+                carries ``path``); ``corrupt`` faults mutate it
+``store_save``  the master is about to persist its task-queue snapshot
+``serve_batch`` the serving worker picked up a batch
+==============  ========================================================
+
+Fault types: ``kill`` (``mode`` ``"exit"`` = ``os._exit(exit_code)``,
+the hard process death; ``"raise"`` = raise :class:`ChaosKilled`, the
+in-process variant tests catch), ``drop`` (raise :class:`ChaosDropped`,
+a ``ConnectionError`` — the RPC layer treats it exactly like a peer
+reset), ``delay`` / ``straggle`` (sleep ``seconds``), ``partition``
+(drop every hit in a count window), ``corrupt`` (mutate the checkpoint
+file at ``info["path"]``: ``truncate`` | ``bitflip`` | ``bitflip_meta``
+| ``delete_meta``).
+
+Triggers (combinable; all compare against the per-site hit counter,
+which starts at 1): ``at`` (exactly the Nth hit), ``after``+``count``
+(a window), ``every`` (every Nth hit), ``rate`` (seeded Bernoulli per
+hit — deterministic in (seed, fault-index, hit-count), independent of
+thread interleaving).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from paddle_tpu.utils.log import get_logger
+
+logger = get_logger("testing.chaos")
+
+ENV_VAR = "PADDLE_TPU_CHAOS_PLAN"
+
+# the one global the hook sites poll; None == chaos disabled
+_ACTIVE: Optional["FaultPlan"] = None
+
+
+class ChaosKilled(BaseException):
+    """In-process stand-in for a process kill (``mode: "raise"``).
+
+    Derives from BaseException so ordinary ``except Exception`` recovery
+    paths cannot swallow it — like a real SIGKILL, nothing downstream of
+    the kill site runs except ``finally`` blocks."""
+
+
+class ChaosDropped(ConnectionError):
+    """An injected message loss. A ``ConnectionError`` on purpose: the
+    RPC client's redial/retry path must treat an injected drop exactly
+    like a real peer reset."""
+
+
+def _corrupt_file(path: str, mode: str):
+    """Mutate a just-written checkpoint generation in place."""
+    npz = path if path.endswith(".npz") else path + ".npz"
+    if mode == "truncate":
+        size = os.path.getsize(npz)
+        with open(npz, "r+b") as f:
+            f.truncate(max(1, size // 2))
+    elif mode == "bitflip":
+        with open(npz, "r+b") as f:
+            f.seek(max(0, os.path.getsize(npz) // 2))
+            b = f.read(1)
+            f.seek(-1, os.SEEK_CUR)
+            f.write(bytes([(b[0] ^ 0xFF) if b else 0xFF]))
+    elif mode == "bitflip_meta":
+        meta = npz + ".meta"
+        if os.path.exists(meta):
+            with open(meta, "r+b") as f:
+                b = f.read(1)
+                f.seek(0)
+                f.write(bytes([(b[0] ^ 0x01) if b else 0x58]))
+    elif mode == "delete_meta":
+        try:
+            os.remove(npz + ".meta")
+        except FileNotFoundError:
+            pass
+    else:
+        raise ValueError(f"unknown corrupt mode {mode!r}")
+    logger.warning("chaos: corrupted checkpoint %s (%s)", npz, mode)
+
+
+class FaultPlan:
+    """A seeded, deterministic schedule of faults over named hook sites.
+
+    Thread-safe: hit counters are per-site under one lock; Bernoulli
+    decisions derive from (seed, fault index, hit count) so concurrent
+    sites cannot perturb each other's schedules."""
+
+    def __init__(self, seed: int = 0,
+                 faults: Optional[List[Dict[str, Any]]] = None,
+                 exit_code: int = 17):
+        self.seed = int(seed)
+        self.faults = [dict(f) for f in (faults or [])]
+        self.exit_code = int(exit_code)
+        self._lock = threading.Lock()
+        self._hits: Dict[str, int] = {}
+        # what fired, for assertions: [(site, hit_n, fault_type)]
+        self.log: List[tuple] = []
+
+    # -------------------------------------------------------- plumbing
+    def to_json(self) -> str:
+        return json.dumps({"seed": self.seed, "exit_code": self.exit_code,
+                           "faults": self.faults})
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        d = json.loads(text)
+        return cls(seed=d.get("seed", 0), faults=d.get("faults"),
+                   exit_code=d.get("exit_code", 17))
+
+    def _bernoulli(self, idx: int, n: int, rate: float) -> bool:
+        # seeded by value, not by a shared Random instance: the decision
+        # for hit n of fault idx never depends on what other sites did
+        return random.Random(f"{self.seed}:{idx}:{n}").random() < rate
+
+    def _matches(self, idx: int, fault: Dict[str, Any], site: str,
+                 n: int) -> bool:
+        # triggers are combinable (conjunction): every trigger present
+        # must agree, so {"after": 10, "rate": 0.3} is a seeded coin
+        # flip on hits 11.. — not "after wins, rate ignored". The empty
+        # conjunction is TRUE: a fault with no trigger at all fires on
+        # every hit ("drop every send"), it is not silently inert.
+        if fault.get("site") != site:
+            return False
+        if "at" in fault and n != int(fault["at"]):
+            return False
+        if "after" in fault:
+            lo = int(fault["after"])
+            if not (lo < n <= lo + int(fault.get("count", 1))):
+                return False
+        if "every" in fault and n % int(fault["every"]) != 0:
+            return False
+        if "rate" in fault and \
+                not self._bernoulli(idx, n, float(fault["rate"])):
+            return False
+        return True
+
+    # ------------------------------------------------------------ hits
+    def hit(self, site: str, **info):
+        """One arrival at ``site``. May sleep, raise, corrupt a file, or
+        kill the process, per the plan."""
+        with self._lock:
+            n = self._hits.get(site, 0) + 1
+            self._hits[site] = n
+            due = [(i, f) for i, f in enumerate(self.faults)
+                   if self._matches(i, f, site, n)]
+            for _, f in due:
+                self.log.append((site, n, f["type"]))
+        for _, f in due:
+            kind = f["type"]
+            if kind == "kill":
+                logger.warning("chaos: kill at %s hit %d (%s)", site, n,
+                               f.get("mode", "exit"))
+                if f.get("mode", "exit") == "raise":
+                    raise ChaosKilled(f"chaos kill at {site} hit {n}")
+                os._exit(f.get("exit_code", self.exit_code))
+            elif kind in ("delay", "straggle"):
+                time.sleep(float(f.get("seconds", 0.01)))
+            elif kind in ("drop", "partition"):
+                raise ChaosDropped(f"chaos dropped {site} hit {n}")
+            elif kind == "corrupt":
+                if "path" in info:
+                    _corrupt_file(info["path"], f.get("mode", "truncate"))
+            else:
+                raise ValueError(f"unknown fault type {kind!r}")
+
+    def hits(self, site: str) -> int:
+        with self._lock:
+            return self._hits.get(site, 0)
+
+
+# ------------------------------------------------------------ install
+
+def install(plan: Optional[FaultPlan]):
+    """Make ``plan`` the active plan (None disables chaos)."""
+    global _ACTIVE
+    _ACTIVE = plan
+    return plan
+
+
+def active() -> Optional[FaultPlan]:
+    return _ACTIVE
+
+
+def install_from_env(env: Optional[Dict[str, str]] = None
+                     ) -> Optional[FaultPlan]:
+    """Install the plan serialized in ``$PADDLE_TPU_CHAOS_PLAN`` (how
+    ``tools/chaos_soak.py`` arms child processes); no-op when unset."""
+    text = (env or os.environ).get(ENV_VAR, "")
+    if not text:
+        return None
+    plan = FaultPlan.from_json(text)
+    logger.warning("chaos plan armed from env: seed=%d, %d faults",
+                   plan.seed, len(plan.faults))
+    return install(plan)
+
+
+class chaos_plan:
+    """``with chaos_plan(FaultPlan(...)) as plan:`` — scoped install for
+    tests; always uninstalls, even when the body dies to a ChaosKilled."""
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+
+    def __enter__(self) -> FaultPlan:
+        install(self.plan)
+        return self.plan
+
+    def __exit__(self, *exc):
+        install(None)
+        return False

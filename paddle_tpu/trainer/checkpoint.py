@@ -5,14 +5,29 @@ Covers ``ParamUtil::saveParametersOnePass`` / ``Parameter::save/load``
 v2's ``Parameters.to_tar/from_tar``: parameters (+ optional optimizer slot
 state) to one .npz with an MD5 integrity sidecar — the integrity-checked
 checkpoint style of the Go pserver (``go/pserver/service.go:75-84``).
+
+Exact-resume extension: a checkpoint may additionally carry *trainer
+state* — everything outside params/opt_state that the training
+trajectory depends on (the step RNG key, truncated-BPTT carried state,
+…) — under a third ``state::`` namespace in the same .npz, so a resumed
+run is bitwise the uninterrupted one (docs/fault_tolerance.md lists the
+full state inventory). Array-valued entries store directly; arbitrary
+pytrees (the carried-state dict) store as a pickled uint8 buffer under
+``stateobj::`` — self-contained, no ``allow_pickle`` at load time for
+the array entries, and the ``stateobj::`` pickles deserialize through a
+restricted unpickler that admits only numpy array machinery and stdlib
+containers (the MD5 sidecar is integrity, not authenticity — a crafted
+checkpoint in a shared save dir must not execute code at restore()).
 """
 
 from __future__ import annotations
 
 import hashlib
+import io
 import json
 import os
-from typing import Any, Dict, Optional
+import pickle
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import numpy as np
@@ -29,7 +44,8 @@ def _flatten(tree: Any, prefix: str = "") -> Dict[str, np.ndarray]:
 
 
 def save_params(path: str, params: Dict[str, Any],
-                opt_state: Optional[Any] = None, meta: Optional[dict] = None):
+                opt_state: Optional[Any] = None, meta: Optional[dict] = None,
+                extra_state: Optional[Dict[str, Any]] = None):
     """``params`` and ``opt_state`` may be zero-arg callables producing
     their trees (lazy export). The trainer's ZeRO-1 mode passes
     ``SGD._opt_state_for_save`` here so sharded optimizer slots are
@@ -37,50 +53,153 @@ def save_params(path: str, params: Dict[str, Any],
     pipeline mode passes ``SGD._params_for_save`` so stage-stacked body
     parameters unstack to their flat per-stage names — the on-disk format
     (keys and shapes) never depends on the update path;
-    ``SGD.load_state`` reshards/restacks on restore."""
+    ``SGD.load_state`` reshards/restacks on restore.
+
+    ``extra_state`` entries: arrays land under ``state::<key>``; any
+    other non-None value (a pytree) is pickled under ``stateobj::<key>``
+    after ``device_get`` (so only host numpy crosses the pickle)."""
+    arrays = snapshot_arrays(params, opt_state, extra_state)
+    write_snapshot(path, arrays, meta)
+
+
+def snapshot_arrays(params, opt_state=None, extra_state=None
+                    ) -> Dict[str, np.ndarray]:
+    """Resolve lazy callables and fetch everything to host numpy — the
+    synchronous half of a save. What remains (``write_snapshot``) is
+    pure file I/O that a background thread can own, after the step loop
+    has moved on and possibly donated the device buffers away."""
     if callable(params):
         params = params()
     if callable(opt_state):
         opt_state = opt_state()
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    if callable(extra_state):
+        extra_state = extra_state()
     arrays = {f"param::{k}": np.asarray(jax.device_get(v))
               for k, v in params.items()}
     if opt_state is not None:
         arrays.update({f"opt::{k}": v
                        for k, v in _flatten(opt_state).items()})
+    for k, v in (extra_state or {}).items():
+        if v is None:
+            continue
+        if hasattr(v, "shape") and hasattr(v, "dtype"):
+            arrays[f"state::{k}"] = np.asarray(jax.device_get(v))
+        else:
+            host = jax.tree_util.tree_map(
+                lambda x: np.asarray(jax.device_get(x))
+                if hasattr(x, "dtype") else x, v)
+            arrays[f"stateobj::{k}"] = np.frombuffer(
+                pickle.dumps(host), dtype=np.uint8)
+    return arrays
+
+
+class _StateUnpickler(pickle.Unpickler):
+    """``stateobj::`` entries are pytrees of HOST numpy arrays (the
+    carried BPTT dict after ``device_get``): the only globals their
+    pickles legitimately reference are numpy's array reconstructors and
+    stdlib containers. Anything else is a tampered checkpoint — the MD5
+    sidecar is integrity, not authenticity, and a plain pickle.loads
+    would execute whatever a crafted file references at restore()."""
+
+    _ALLOWED = {
+        ("numpy.core.multiarray", "_reconstruct"),
+        ("numpy._core.multiarray", "_reconstruct"),
+        ("numpy.core.multiarray", "scalar"),
+        ("numpy._core.multiarray", "scalar"),
+        ("numpy", "ndarray"),
+        ("numpy", "dtype"),
+        ("collections", "OrderedDict"),
+    }
+
+    def find_class(self, module, name):
+        # ml_dtypes: jax's extension dtypes (bfloat16 etc.) — a bf16
+        # carried state pickles a reference to its dtype class, and
+        # rejecting it would make every mixed-precision checkpoint
+        # "corrupt" (restore() would silently fall through all
+        # generations to a fresh start)
+        if (module, name) in self._ALLOWED or \
+                module in ("numpy.dtypes", "ml_dtypes"):
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"checkpoint trainer-state references {module}.{name}; only "
+            "numpy arrays and plain containers restore (tampered or "
+            "incompatible stateobj:: entry)")
+
+
+def _loads_state(raw: bytes):
+    return _StateUnpickler(io.BytesIO(raw)).load()
+
+
+def write_snapshot(path: str, arrays: Dict[str, np.ndarray],
+                   meta: Optional[dict] = None) -> str:
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     real_path = path if path.endswith(".npz") else path + ".npz"
     # atomic: a crash mid-save must never leave a torn file at the final
     # name (the recovery scan would have to skip it, and a torn .npz with
     # no .meta bypasses the MD5 gate)
     tmp = real_path + ".tmp"
+    # serialize ONCE to memory and hash those bytes: the digest covers
+    # exactly what lands on disk, without re-reading a model-sized file
+    # per generation (the load side makes the same single-read pledge)
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    data = buf.getbuffer()  # zero-copy view: ONE serialized copy in RAM
+    md5 = hashlib.md5(data).hexdigest()
     with open(tmp, "wb") as f:
-        np.savez(f, **arrays)
+        f.write(data)
         f.flush()
         os.fsync(f.fileno())
+    del data  # release the exported view before buf goes away
     os.replace(tmp, real_path)
-    md5 = hashlib.md5(open(real_path, "rb").read()).hexdigest()
     with open(real_path + ".meta.tmp", "w") as f:
         json.dump({"md5": md5, **(meta or {})}, f)
         f.flush()
         os.fsync(f.fileno())
     os.replace(real_path + ".meta.tmp", real_path + ".meta")
+    return real_path
+
+
+def load_checkpoint(path: str, check_integrity: bool = True,
+                    meta: Optional[dict] = None) -> Tuple[dict, dict, dict]:
+    """(params, opt_flat, trainer_state) from one checkpoint file.
+
+    ``meta``: the already-parsed ``.meta`` sidecar, when the caller has
+    it in hand (``Checkpointer.restore``) — the integrity check then
+    skips re-opening the sidecar."""
+    real_path = path if path.endswith(".npz") else path + ".npz"
+    # ONE read: the bytes the MD5 gate verifies are the very bytes the
+    # arrays parse from — re-opening the file for np.load would let a
+    # corruption landing between the two reads slip past the gate (and
+    # pay the full-file I/O twice)
+    with open(real_path, "rb") as f:
+        raw = f.read()
+    if check_integrity:
+        if meta is None and os.path.exists(real_path + ".meta"):
+            with open(real_path + ".meta") as f:
+                meta = json.load(f)
+        if meta is not None:
+            md5 = hashlib.md5(raw).hexdigest()
+            if md5 != meta.get("md5"):
+                raise IOError(
+                    f"checkpoint {real_path} failed MD5 integrity check"
+                    " (WrongChecksum, go/pserver/service.go:49)")
+    params = {}
+    opt_flat = {}
+    state = {}
+    with np.load(io.BytesIO(raw)) as data:
+        for k in data.files:
+            if k.startswith("param::"):
+                params[k[len("param::"):]] = data[k]
+            elif k.startswith("opt::"):
+                opt_flat[k[len("opt::"):]] = data[k]
+            elif k.startswith("state::"):
+                state[k[len("state::"):]] = data[k]
+            elif k.startswith("stateobj::"):
+                state[k[len("stateobj::"):]] = _loads_state(
+                    data[k].tobytes())
+    return params, opt_flat, state
 
 
 def load_params(path: str, check_integrity: bool = True):
-    real_path = path if path.endswith(".npz") else path + ".npz"
-    if check_integrity and os.path.exists(real_path + ".meta"):
-        with open(real_path + ".meta") as f:
-            meta = json.load(f)
-        md5 = hashlib.md5(open(real_path, "rb").read()).hexdigest()
-        if md5 != meta.get("md5"):
-            raise IOError(f"checkpoint {real_path} failed MD5 integrity check"
-                          " (WrongChecksum, go/pserver/service.go:49)")
-    data = np.load(real_path)
-    params = {}
-    opt_flat = {}
-    for k in data.files:
-        if k.startswith("param::"):
-            params[k[len("param::"):]] = data[k]
-        elif k.startswith("opt::"):
-            opt_flat[k[len("opt::"):]] = data[k]
+    params, opt_flat, _ = load_checkpoint(path, check_integrity)
     return params, opt_flat

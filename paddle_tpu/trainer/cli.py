@@ -55,6 +55,19 @@ def parse_args(argv=None):
     p.add_argument("--saving_period_by_batches", type=int, default=None)
     p.add_argument("--init_model_path", default=None,
                    help="checkpoint file or merged model to start from")
+    p.add_argument("--auto_resume", default=True,
+                   action=argparse.BooleanOptionalAction,
+                   help="restore the newest intact checkpoint in "
+                        "--save_dir before training (exact resume: RNG, "
+                        "data position and schedule state included); "
+                        "--no-auto_resume makes --save_dir save-only")
+    p.add_argument("--background_save", default=True,
+                   action=argparse.BooleanOptionalAction,
+                   help="write checkpoints on a background thread — the "
+                        "step loop never blocks on serialize/fsync "
+                        "(device state is still snapshotted "
+                        "synchronously, so the saved generation is "
+                        "exact)")
     p.add_argument("--model_path", default=None,
                    help="output path for --job=merge")
     p.add_argument("--test_period", type=int, default=0,
@@ -347,7 +360,8 @@ def cmd_train(ns, args):
         from paddle_tpu.dist.checkpoint import Checkpointer
         ck = Checkpointer(args.save_dir, saving_period=args.saving_period,
                           saving_period_by_batches=(
-                              args.saving_period_by_batches))
+                              args.saving_period_by_batches),
+                          background=getattr(args, "background_save", True))
 
     test_reader = ns.get("test_reader")
     feeder = _feeder(ns)
@@ -375,7 +389,8 @@ def cmd_train(ns, args):
                                               False),
                   zero1=True if getattr(args, "use_zero1", False) else None,
                   grad_accum_steps=getattr(args, "grad_accum_steps", 1),
-                  checkpointer=ck)
+                  checkpointer=ck,
+                  auto_resume=getattr(args, "auto_resume", True))
     return 0
 
 
@@ -621,6 +636,10 @@ def cmd_serve(ns, args):
 
 def main(argv=None):
     args = parse_args(argv)
+    # deterministic fault injection (tools/chaos_soak.py arms children
+    # through the env); a no-op unless PADDLE_TPU_CHAOS_PLAN is set
+    from paddle_tpu.testing import chaos as _chaos
+    _chaos.install_from_env()
     if getattr(args, "fp_anomaly", False):
         from paddle_tpu.utils.fp import enable_fp_anomaly
         enable_fp_anomaly()

@@ -28,6 +28,7 @@ from jax.sharding import PartitionSpec as P_spec
 
 from paddle_tpu.config import dsl as _dsl
 from paddle_tpu.config.model_config import ModelDef
+from paddle_tpu.testing import chaos as _chaos
 from paddle_tpu.core.argument import Argument
 from paddle_tpu.core.network import Network
 from paddle_tpu.optim.optimizers import Optimizer
@@ -880,6 +881,19 @@ class SGD:
             return self._pipe.unstack_params(self.params)
         return self.params
 
+    def _trainer_state_for_save(self):
+        """The exact-resume state inventory beyond params/opt_state: the
+        step RNG key (split once per batch — a resumed run must continue
+        the same key stream) and the truncated-BPTT carried state (the
+        previous batch's final recurrent state, mid-pass only). The LR
+        schedule's step/sample counters live inside opt_state and ride
+        the normal save; the reader's position rides the ``ledger``.
+        See docs/fault_tolerance.md for the full inventory."""
+        state = {"rng": np.asarray(jax.device_get(self._rng))}
+        if self._carried is not None:
+            state["carried"] = self._carried
+        return state
+
     def train(self, reader, *, feeder=None, num_passes: int = 1,
               event_handler: Optional[Callable] = None,
               log_period: int = 0, checkpointer=None,
@@ -889,7 +903,7 @@ class SGD:
               show_step_breakdown: bool = False,
               zero1: Optional[bool] = None,
               grad_accum_steps: Optional[int] = None,
-              pipeline=None):
+              pipeline=None, auto_resume: bool = True):
         """reader yields minibatches (lists of sample tuples); feeder
         converts them to Arguments (or pass feed dicts directly).
         ``log_period``>0 logs a TrainerStats-style line and dumps+resets the
@@ -902,9 +916,23 @@ class SGD:
         layer output's mean/abs-max at each log_period
         (``--show_layer_stat``, ``Flags.cpp:71``). ``checkpointer``
         (dist.Checkpointer) restores the newest intact checkpoint before
-        training — resuming at the pass after the saved one, the
-        ``--start_pass`` semantics of ``Trainer.cpp:229-250`` — and saves
-        on its cadence at batch and pass boundaries.
+        training (``auto_resume=False`` makes it save-only, the
+        ``--no-auto_resume`` CLI spelling) — resuming at the pass after
+        the saved one, the ``--start_pass`` semantics of
+        ``Trainer.cpp:229-250`` — and saves on its cadence at batch and
+        pass boundaries. Resume is EXACT: checkpoints carry the step
+        RNG key, carried BPTT state, LR-schedule counters (inside
+        opt_state) and the data position — a plain deterministic reader
+        is fast-forwarded to the checkpoint's batch (prepared batches
+        discarded, not trained), a pass-aware master reader restores
+        its task ledger through ``resume_lease`` and has its finishes
+        committed only after each checkpoint is durable — so a
+        killed-and-resumed run is bitwise the uninterrupted one
+        (tests/test_exact_resume_matrix.py, docs/fault_tolerance.md).
+        A pass-aware reader's ``sync_pass`` also reconciles the start
+        pass with the master's authoritative pass, so a resumed trainer
+        neither replays nor starves on passes the cluster already
+        resolved.
 
         ``async_load_data`` (the reference's ``--use_async_load_data``,
         ``DataProvider.h:249``) runs decode → pad/bucket → shard →
@@ -943,23 +971,155 @@ class SGD:
         the schedule cannot honor warn and stand down cleanly."""
         from paddle_tpu.utils import global_stat, logger, timer
         self._configure_step(zero1, grad_accum_steps, pipeline)
+        if async_load_data and getattr(reader, "pass_aware", False):
+            # the prefetch worker would advance the master reader's task
+            # ledger (finishes, in-flight offset) ahead of training by
+            # the queue depth; a mid-pass checkpoint would then record
+            # prefetched-but-untrained records as consumed and resume
+            # would skip them — breaking exact resume AND at-least-once.
+            logger.warning(
+                "async_load_data: pass-aware master readers are consumed "
+                "synchronously (the task ledger must track TRAINED "
+                "position, not prefetch position) — ignoring the flag "
+                "for this reader")
+            async_load_data = False
         start_pass = 0
+        resume_base = 0       # batch_id numbering continues here
+        resume_skip = 0       # prepared batches to discard, not train
+        resume_carried = None
         if checkpointer is not None:
+            # commit the master's task ledger only once the checkpoint
+            # holding that work is DURABLE (the writer calls on_save
+            # after fsync+rename — possibly from its background thread)
+            commit = getattr(reader, "commit_ledger", None)
+            # couple when the slot is free OR holds a previous train()
+            # call's coupling (same Checkpointer reused across runs: the
+            # stale closure would commit to the old run's — likely
+            # closed — master client and this reader would never couple);
+            # a user-provided callback is never clobbered
+            if commit is not None and (
+                    getattr(checkpointer, "on_save", None) is None or
+                    getattr(checkpointer.on_save, "_reader_coupled",
+                            False)):
+                def _commit_on_save(meta):
+                    commit(meta.get("ledger"))
+                _commit_on_save._reader_coupled = True
+                checkpointer.on_save = _commit_on_save
+                # the reader must NOT also commit at its pass end: the
+                # durable-save callback owns commits now
+                reader.checkpoint_coupled = True
+                # the master's durability-gated pass roll waits on this
+                # trainer's parked finishes; if the background writer
+                # died no on_save will ever commit them, and each poll
+                # of the wait renews our liveness so even the lease
+                # timeout cannot free the work. Let the wait loop see
+                # the writer's error instead of spinning forever.
+                if hasattr(reader, "health_check") and \
+                        hasattr(checkpointer, "poll_error"):
+                    reader.health_check = checkpointer.poll_error
+        else:
+            commit = None
+        # what this process can prove it trained of the pass it is
+        # about to (re)start: nothing, until a mid-pass checkpoint
+        # says otherwise. A pass-aware reader sends this to the
+        # master (resume_lease) so work a previous life finished
+        # beyond the restored checkpoint is requeued, its stale
+        # lease voided, and dispatch order restored — without it a
+        # crashed-then-restarted trainer starves on (or replays out
+        # of order) its own requeued tasks.
+        ledger = {"pass": 0, "done": [], "inflight": None, "offset": 0}
+        restored_from_disk = False
+        if checkpointer is not None and auto_resume:
             restored = checkpointer.restore()
             if restored is not None:
+                restored_from_disk = True
                 r_params, r_opt, meta = restored
                 self.load_state(r_params, r_opt)
+                tstate = meta.get("trainer_state") or {}
+                if "rng" in tstate:
+                    # continue the uninterrupted run's key stream, not a
+                    # fresh seed's (dropout etc. stay bitwise on track)
+                    self._rng = jnp.asarray(np.asarray(tstate["rng"]))
                 pid = int(meta.get("pass_id", -1))
                 if meta.get("end_of_pass", meta.get("batch_id", 0) == 0):
                     start_pass = pid + 1
+                    led = meta.get("ledger")
+                    if led:
+                        # the completed pass's ledger: its commit may
+                        # have been lost between the fsync and the
+                        # commit RPC — the reader re-marks that work
+                        # done on the master (no-op if the pass
+                        # already rolled)
+                        ledger = led
+                    else:
+                        ledger["pass"] = start_pass
                 else:
-                    # mid-pass (batch-cadence) checkpoint: restart that
-                    # pass from its beginning so no batch goes untrained
-                    # (early batches re-train — at-least-once, like the
-                    # master's task requeue). With a pass-aware master
-                    # reader only the pass's *unfinished* tasks replay —
-                    # see the caveat on dist.master.master_reader.
+                    # mid-pass (batch-cadence) checkpoint: resume INSIDE
+                    # that pass at the exact batch. A pass-aware master
+                    # reader restores its task ledger (resume_lease
+                    # re-marks consumed tasks done and requeues this
+                    # trainer's post-checkpoint work — the old "remaining
+                    # tasks only" caveat is gone); a plain deterministic
+                    # reader is fast-forwarded past the already-trained
+                    # batches instead.
                     start_pass = pid
+                    resume_base = int(meta.get("batch_id", 0))
+                    if getattr(reader, "pass_aware", False):
+                        ledger = meta.get("ledger") or dict(
+                            ledger, **{"pass": start_pass})
+                    else:
+                        resume_skip = resume_base
+                        logger.warning(
+                            "mid-pass resume fast-forwards %d batches of "
+                            "a plain reader: this assumes the reader "
+                            "replays the SAME batch order as the "
+                            "interrupted run — one that shuffles "
+                            "differently per process silently drops "
+                            "untrained records. Seed the shuffle, use a "
+                            "master reader (task-ledger resume), or save "
+                            "only at pass boundaries", resume_base)
+                    carried = tstate.get("carried")
+                    if carried is not None:
+                        resume_carried = jax.tree_util.tree_map(
+                            jnp.asarray, carried)
+        if getattr(reader, "pass_aware", False) and \
+                hasattr(reader, "restore_ledger") and \
+                (restored_from_disk or
+                 not getattr(reader, "_ledger_reconciled", False)):
+            # armed on a FRESH start too (not just an actual restore —
+            # and regardless of auto_resume or a checkpointer at all):
+            # a previous life under the same trainer id that died
+            # before its first durable checkpoint leaves finishes
+            # parked on the master — invisible to this process, yet
+            # its own polling renews the liveness that would otherwise
+            # expire them. Gated behind auto_resume, a
+            # --no-auto_resume restart with a stable trainer id would
+            # livelock the durability-gated pass roll on exactly that
+            # parked work. The empty-ledger reconcile requeues the
+            # lost work (it was trained into parameters that no longer
+            # exist) and no-ops on a genuine first boot; it re-sorts
+            # only its own requeued slice, so queue state other
+            # trainers depend on keeps its order. ONCE per reader: a
+            # later train() on the same reader is a continuation, not
+            # a previous life — an empty re-reconcile would requeue
+            # (and silently retrain) everything this very process
+            # already finished in the current pass. Only an actual
+            # disk restore re-arms, with the restored ledger.
+            reader.restore_ledger(ledger)
+            reader._ledger_reconciled = True
+        if getattr(reader, "sync_pass", None):
+            # the master's pass counter is authoritative: a resumed
+            # trainer whose cluster moved on must neither replay passes
+            # that are fully resolved nor starve through them one empty
+            # reader call at a time
+            synced = int(reader.sync_pass(start_pass))
+            if synced != start_pass:
+                logger.info(
+                    "resume: master is at pass %d (checkpoint suggested "
+                    "%d) — following the master", synced, start_pass)
+                start_pass = synced
+                resume_base = resume_skip = 0
+                resume_carried = None
         event_handler = event_handler or (lambda e: None)
         acc = Accumulator()
         bd = self.breakdown
@@ -972,150 +1132,283 @@ class SGD:
                 "feeder would be silently ignored: this reader is already "
                 "prefetched — pass the feeder to prefetch_reader(...) "
                 "instead")
-        for pass_id in range(start_pass, num_passes):
-            event_handler(ev.BeginPass(pass_id))
-            acc.reset()
-            self._start_host_evaluators()
-            self._carried = None  # reference resets RNN state per pass
-            window_cost, window_n = 0.0, 0
-            dots_pending = False
-            pipe = None
-            if async_load_data and not pre_prepared:
-                from paddle_tpu.data.prefetch import PrefetchPipeline
-                pipe = PrefetchPipeline(
-                    lambda: _call_reader(reader, pass_id), feeder=feeder,
-                    mesh=self.mesh, depth=prefetch_depth)
-                stream = iter(pipe)
-            else:
-                stream = iter(_call_reader(reader, pass_id))
-            batch_id = -1
-            try:
-                while True:
-                    t_step = time.perf_counter()
-                    # blocked-on-data time: the sync reader's own cost, or
-                    # the prefetch queue wait (near zero once it keeps up)
-                    with bd.measure("data_wait"):
-                        data = next(stream, _END_OF_PASS)
-                    if data is _END_OF_PASS:
-                        break
-                    batch_id += 1
-                    event_handler(ev.BeginIteration(pass_id, batch_id))
-                    if pipe is not None or pre_prepared:
-                        feed = data  # decoded + sharded by the worker thread
-                    else:
-                        with bd.measure("h2d"), timer("prepareBatchData"):
-                            feed = feeder(data) if feeder is not None else data
-                            if self.mesh is not None:
-                                feed = mesh_lib.shard_batch(feed, self.mesh)
-                    self._rng, step_rng = jax.random.split(self._rng)
-                    if self._carried is not None:
-                        # a batch-size change (e.g. smaller final batch) makes
-                        # the carried state unusable: reset, like the
-                        # reference's resetState on shape change
-                        b_feed = next(iter(feed.values())).value.shape[0]
-                        b_carry = jax.tree_util.tree_leaves(
-                            self._carried)[0].shape[0]
-                        if b_carry != b_feed:
-                            self._carried = None
-                    with bd.measure("compute"), timer("trainBatch"):
-                        self.params, self.opt_state, metrics = self._train_step(
-                            self.params, self.opt_state, feed, step_rng,
-                            jnp.int32(pass_id), self._carried)
-                        # a real host fetch: on remote devices
-                        # block_until_ready returns before execution finishes
-                        cost = float(metrics["cost"])
-                    self.recompile_guard.check()
-                    t_cb = time.perf_counter()
-                    if self._carry_layers:
-                        self._carried = metrics.pop("carried")
-                    evals = self._accumulate(acc, metrics)
-                    self._feed_host_evaluators(metrics, feed=feed, rng=step_rng)
-                    window_cost += cost
-                    window_n += 1
-                    if dot_period and (batch_id + 1) % dot_period == 0:
-                        print(".", end="", flush=True)
-                        dots_pending = True
-                    stats_due = show_parameter_stats_period and \
-                        (batch_id + 1) % show_parameter_stats_period == 0
-                    log_due = log_period and (batch_id + 1) % log_period == 0
-                    if dots_pending and (stats_due or log_due):
-                        print(flush=True)  # newline before the periodic lines
-                        dots_pending = False
-                    if stats_due:
-                        for pname, st in self.parameter_stats().items():
-                            logger.info(
-                                "Param %s: %s", pname,
-                                " ".join(f"{k}={v:.5g}"
-                                         for k, v in st.items()))
-                    if log_due:
-                        # Cost is windowed (reset each log_period); AvgEval is
-                        # cumulative since pass start, like the reference's
-                        # "Eval:" vs "CurrentEval:" split (TrainerInternal.cpp).
-                        logger.info(
-                            "Pass=%d Batch=%d Cost=%.5f AvgEval: %s", pass_id,
-                            batch_id + 1, window_cost / window_n,
-                            " ".join(f"{k}={v:.5g}" for k, v in
-                                     {**evals, **self.host_eval_values(
-                                         include_printers=False)}.items()))
-                        if show_step_breakdown:
-                            from paddle_tpu.utils.profiler import \
-                                memory_status
-                            logger.info("%s", bd.status())
-                            logger.info("%s", memory_status(
-                                self.params, self.opt_state))
-                        logger.info("\n%s", global_stat.status(reset=True))
-                        window_cost, window_n = 0.0, 0
-                        if show_layer_stat:
-                            for lname, st in self.layer_stats(feed).items():
+        loop_ok = False
+        unwind_exc = None
+        try:
+            for pass_id in range(start_pass, num_passes):
+                event_handler(ev.BeginPass(pass_id))
+                acc.reset()
+                self._start_host_evaluators()
+                # reference resets RNN state per pass; a mid-pass resume
+                # reinstates the checkpointed carry instead
+                resuming = pass_id == start_pass and resume_base > 0
+                self._carried = resume_carried if resuming else None
+                window_cost, window_n = 0.0, 0
+                dots_pending = False
+                pipe = None
+                if async_load_data and not pre_prepared:
+                    from paddle_tpu.data.prefetch import PrefetchPipeline
+                    pipe = PrefetchPipeline(
+                        lambda: _call_reader(reader, pass_id), feeder=feeder,
+                        mesh=self.mesh, depth=prefetch_depth)
+                    stream = iter(pipe)
+                else:
+                    stream = iter(_call_reader(reader, pass_id))
+                batch_id = -1
+                if resuming:
+                    # exact-resume replay: discard the already-trained prefix
+                    # (plain readers; a ledger-restored master reader yields
+                    # only untrained records, so resume_skip is 0) and keep
+                    # the uninterrupted run's batch numbering so checkpoint
+                    # cadence and logs stay aligned
+                    for _ in range(resume_skip):
+                        if next(stream, _END_OF_PASS) is _END_OF_PASS:
+                            break
+                    batch_id = resume_base - 1
+                try:
+                    while True:
+                        t_step = time.perf_counter()
+                        # blocked-on-data time: the sync reader's own cost, or
+                        # the prefetch queue wait (near zero once it keeps up)
+                        with bd.measure("data_wait"):
+                            data = next(stream, _END_OF_PASS)
+                        if data is _END_OF_PASS:
+                            break
+                        batch_id += 1
+                        event_handler(ev.BeginIteration(pass_id, batch_id))
+                        if pipe is not None or pre_prepared:
+                            feed = data  # decoded + sharded by the worker thread
+                        else:
+                            with bd.measure("h2d"), timer("prepareBatchData"):
+                                feed = feeder(data) if feeder is not None else data
+                                if self.mesh is not None:
+                                    feed = mesh_lib.shard_batch(feed, self.mesh)
+                        self._rng, step_rng = jax.random.split(self._rng)
+                        if self._carried is not None:
+                            # a batch-size change (e.g. smaller final batch) makes
+                            # the carried state unusable: reset, like the
+                            # reference's resetState on shape change
+                            b_feed = next(iter(feed.values())).value.shape[0]
+                            b_carry = jax.tree_util.tree_leaves(
+                                self._carried)[0].shape[0]
+                            if b_carry != b_feed:
+                                self._carried = None
+                        with bd.measure("compute"), timer("trainBatch"):
+                            self.params, self.opt_state, metrics = self._train_step(
+                                self.params, self.opt_state, feed, step_rng,
+                                jnp.int32(pass_id), self._carried)
+                            # a real host fetch: on remote devices
+                            # block_until_ready returns before execution finishes
+                            cost = float(metrics["cost"])
+                        self.recompile_guard.check()
+                        t_cb = time.perf_counter()
+                        if self._carry_layers:
+                            self._carried = metrics.pop("carried")
+                        evals = self._accumulate(acc, metrics)
+                        self._feed_host_evaluators(metrics, feed=feed, rng=step_rng)
+                        window_cost += cost
+                        window_n += 1
+                        if dot_period and (batch_id + 1) % dot_period == 0:
+                            print(".", end="", flush=True)
+                            dots_pending = True
+                        stats_due = show_parameter_stats_period and \
+                            (batch_id + 1) % show_parameter_stats_period == 0
+                        log_due = log_period and (batch_id + 1) % log_period == 0
+                        if dots_pending and (stats_due or log_due):
+                            print(flush=True)  # newline before the periodic lines
+                            dots_pending = False
+                        if stats_due:
+                            for pname, st in self.parameter_stats().items():
                                 logger.info(
-                                    "Layer %s: avg_abs=%.5g max_abs=%.5g",
-                                    lname, st["avg_abs"], st["max_abs"])
-                    event_handler(ev.EndIteration(pass_id, batch_id, cost, evals))
-                    if checkpointer is not None:
-                        # the callables defer the (device-op) ZeRO-1 slot
-                        # gather / pipeline unstack to saves actually due
-                        checkpointer.maybe_save(self._params_for_save,
-                                                self._opt_state_for_save,
-                                                pass_id=pass_id,
-                                                batch_id=batch_id + 1)
-                    bd.add("callback", time.perf_counter() - t_cb)
-                    # true wall denominator: work outside the four
-                    # brackets (BeginIteration handlers, rng split) shows
-                    # as a shortfall from 1.0 instead of inflating steps/s
-                    bd.step_done(time.perf_counter() - t_step)
-            finally:
-                # the worker must not outlive this pass — a raising
-                # event handler / step / checkpointer (or Ctrl-C)
-                # would otherwise leak a thread holding `depth`
-                # device batches until GC (and a traceback pinning the
-                # frame defeats GC entirely)
-                if pipe is not None:
-                    pipe.close()
-                close = getattr(stream, "close", None)
-                if close is not None:
-                    close()  # a prefetch_reader stream: its generator's
-                    # finally closes the pipeline it owns; harmless on
-                    # plain generators
-            if dots_pending:
-                print(flush=True)  # close the dot line at pass end
-            # apply deferred sparse-row updates so the pass ends with
-            # current tables (reference catchUpWith before eval/save);
-            # routed through the active updater so a zero1 state always
-            # goes through the delegate that understands its layout
-            self.params, self.opt_state = (
-                self._zero1 or self.optimizer).catch_up(
-                self.params, self.opt_state, self.meta,
-                num_passes=pass_id)
-            if show_step_breakdown:
-                from paddle_tpu.utils.profiler import memory_status
-                logger.info("%s", bd.status())
-                logger.info("%s", memory_status(self.params, self.opt_state))
-            event_handler(ev.EndPass(
-                pass_id, {**acc.result(), **self.host_eval_values()}))
+                                    "Param %s: %s", pname,
+                                    " ".join(f"{k}={v:.5g}"
+                                             for k, v in st.items()))
+                        if log_due:
+                            # Cost is windowed (reset each log_period); AvgEval is
+                            # cumulative since pass start, like the reference's
+                            # "Eval:" vs "CurrentEval:" split (TrainerInternal.cpp).
+                            logger.info(
+                                "Pass=%d Batch=%d Cost=%.5f AvgEval: %s", pass_id,
+                                batch_id + 1, window_cost / window_n,
+                                " ".join(f"{k}={v:.5g}" for k, v in
+                                         {**evals, **self.host_eval_values(
+                                             include_printers=False)}.items()))
+                            if show_step_breakdown:
+                                from paddle_tpu.utils.profiler import \
+                                    memory_status
+                                logger.info("%s", bd.status())
+                                logger.info("%s", memory_status(
+                                    self.params, self.opt_state))
+                            logger.info("\n%s", global_stat.status(reset=True))
+                            window_cost, window_n = 0.0, 0
+                            if show_layer_stat:
+                                for lname, st in self.layer_stats(feed).items():
+                                    logger.info(
+                                        "Layer %s: avg_abs=%.5g max_abs=%.5g",
+                                        lname, st["avg_abs"], st["max_abs"])
+                        event_handler(ev.EndIteration(pass_id, batch_id, cost, evals))
+                        if _chaos._ACTIVE is not None:
+                            # a kill here dies BEFORE this batch could
+                            # checkpoint → resume replays it
+                            _chaos._ACTIVE.hit("step", pass_id=pass_id,
+                                               batch_id=batch_id)
+                        if checkpointer is not None:
+                            # the callables defer the (device-op) ZeRO-1 slot
+                            # gather / pipeline unstack to saves actually due
+                            checkpointer.maybe_save(
+                                self._params_for_save,
+                                self._opt_state_for_save,
+                                pass_id=pass_id, batch_id=batch_id + 1,
+                                trainer_state=self._trainer_state_for_save,
+                                ledger=getattr(reader, "ledger_state", None))
+                        if _chaos._ACTIVE is not None:
+                            # a kill here dies AFTER the cadence ran → resume
+                            # restores the generation just written
+                            _chaos._ACTIVE.hit("step_done", pass_id=pass_id,
+                                               batch_id=batch_id)
+                        bd.add("callback", time.perf_counter() - t_cb)
+                        # true wall denominator: work outside the four
+                        # brackets (BeginIteration handlers, rng split) shows
+                        # as a shortfall from 1.0 instead of inflating steps/s
+                        bd.step_done(time.perf_counter() - t_step)
+                finally:
+                    # the worker must not outlive this pass — a raising
+                    # event handler / step / checkpointer (or Ctrl-C)
+                    # would otherwise leak a thread holding `depth`
+                    # device batches until GC (and a traceback pinning the
+                    # frame defeats GC entirely)
+                    if pipe is not None:
+                        pipe.close()
+                    close = getattr(stream, "close", None)
+                    if close is not None:
+                        close()  # a prefetch_reader stream: its generator's
+                        # finally closes the pipeline it owns; harmless on
+                        # plain generators
+                if dots_pending:
+                    print(flush=True)  # close the dot line at pass end
+                # apply deferred sparse-row updates so the pass ends with
+                # current tables (reference catchUpWith before eval/save);
+                # routed through the active updater so a zero1 state always
+                # goes through the delegate that understands its layout
+                self.params, self.opt_state = (
+                    self._zero1 or self.optimizer).catch_up(
+                    self.params, self.opt_state, self.meta,
+                    num_passes=pass_id)
+                if show_step_breakdown:
+                    from paddle_tpu.utils.profiler import memory_status
+                    logger.info("%s", bd.status())
+                    logger.info("%s", memory_status(self.params, self.opt_state))
+                event_handler(ev.EndPass(
+                    pass_id, {**acc.result(), **self.host_eval_values()}))
+                if checkpointer is not None:
+                    # the pass-boundary save carries the COMPLETED pass's
+                    # ledger: if the crash lands in the durable-but-
+                    # uncommitted window (fsync done, commit RPC lost)
+                    # the restarted trainer re-marks that work done via
+                    # resume_lease — without it the finishes sit parked
+                    # under a liveness the restarted process itself keeps
+                    # renewing (stable trainer id), holding the
+                    # durability-gated roll of a pass its restored
+                    # parameters fully contain
+                    saved = checkpointer.maybe_save(
+                        self._params_for_save, self._opt_state_for_save,
+                        pass_id=pass_id, end_of_pass=True,
+                        trainer_state=self._trainer_state_for_save,
+                        ledger=getattr(reader, "ledger_state", None))
+                    if not saved and commit is not None and \
+                            getattr(reader, "checkpoint_coupled", False):
+                        # no checkpoint was due this pass, so no on_save
+                        # will ever commit its finishes — commit now or the
+                        # master's durability-gated pass roll waits forever.
+                        # Recovery for this pass falls back to the older
+                        # generation (plain at-least-once, the cadence the
+                        # user chose with saving_period>1).
+                        commit(None)
+            loop_ok = True
+        except BaseException as e:
+            unwind_exc = e
+            raise
+        finally:
+            flush_exc = None
             if checkpointer is not None:
-                checkpointer.maybe_save(self._params_for_save,
-                                        self._opt_state_for_save,
-                                        pass_id=pass_id, end_of_pass=True)
+                try:
+                    if hasattr(checkpointer, "flush"):
+                        # drain background writes even when the loop
+                        # unwinds (chaos kill, NaN anomaly,
+                        # KeyboardInterrupt): every generation
+                        # maybe_save() queued must become durable — a
+                        # sync run would have had them on disk already.
+                        # When ALREADY unwinding, a writer error must
+                        # not replace the exception that actually
+                        # killed the run (finally semantics would also
+                        # downgrade a chaos-kill BaseException to a
+                        # plain RuntimeError). The flag, not
+                        # sys.exc_info(), decides: train() called
+                        # inside a caller's except block has ambient
+                        # exc_info even on a clean run, and a clean run
+                        # must NOT swallow the error.
+                        try:
+                            checkpointer.flush()
+                        except Exception as flush_err:
+                            if loop_ok:
+                                # a clean run's flush error IS the
+                                # surfaced failure — but it must not
+                                # skip the lease release below: this
+                                # process (and its heartbeat) lives
+                                # on, so nothing else can ever free
+                                # the parked finishes whose commit the
+                                # dead writer just lost. Park the
+                                # error, release, then re-raise.
+                                flush_exc = flush_err
+                            else:
+                                logger.error(
+                                    "checkpoint flush failed while the "
+                                    "training loop was unwinding: %r",
+                                    flush_err)
+                finally:
+                    # even when a clean-run flush() raised (the
+                    # surfacing path for a dead background writer)
+                    if getattr(getattr(checkpointer, "on_save", None),
+                               "_reader_coupled", False):
+                        # unwire this run's coupling so the
+                        # Checkpointer can be reused with a fresh
+                        # reader/client — and the READER too: left
+                        # True, a reader reused in a later train()
+                        # without (re)coupling would never self-commit
+                        # at pass end and the master's durability-gated
+                        # pass roll would wait forever; the stale
+                        # health_check would poll the OLD run's writer
+                        # and never surface the hang
+                        checkpointer.on_save = None
+                        reader.checkpoint_coupled = False
+                        if hasattr(reader, "health_check"):
+                            reader.health_check = None
+            if (isinstance(unwind_exc, Exception) or
+                    flush_exc is not None) and \
+                    getattr(reader, "release_lease", None) is not None:
+                # the loop unwound on a plain Exception (user callback,
+                # NaN anomaly) — or a clean run's final flush() raised
+                # (dead background writer) — but the process and the
+                # master client's heartbeat thread live on: liveness
+                # expiry can never free this trainer's in-flight lease
+                # or parked uncommitted finishes, so the master's
+                # durability-gated pass roll would wait on them
+                # forever. Release them explicitly. Runs AFTER the
+                # flush above, so generations made durable there have
+                # already committed their finishes via on_save — only
+                # genuinely uncommittable work requeues. BaseException
+                # unwinds (chaos kill, KeyboardInterrupt)
+                # emulate/precede process death and must NOT release:
+                # the heartbeat dies with the process and the
+                # expiry/resume_lease path owns recovery.
+                try:
+                    reader.release_lease()
+                except Exception as release_err:
+                    logger.warning(
+                        "release_lease failed while the training loop "
+                        "was unwinding: %r", release_err)
+            if flush_exc is not None:
+                raise flush_exc
 
     def step_breakdown(self) -> Dict[str, float]:
         """Summary of the last train() call's per-step host-time split

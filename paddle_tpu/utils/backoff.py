@@ -1,0 +1,35 @@
+"""Capped jittered exponential backoff, shared by the retrying clients.
+
+One formula in one place (``MasterClient`` re-dial, ``ServingClient``
+429/connection-reset retry): attempt ``n`` waits
+``min(cap, base * 2**n)`` jittered down to ``uniform(0.5, 1.0)`` of
+itself, so a fleet of clients retrying one restarted server spreads out
+instead of returning in lockstep.  Units (seconds vs milliseconds)
+follow whatever ``base``/``cap`` are expressed in.
+"""
+
+from __future__ import annotations
+
+import random
+
+
+def jittered(value: float, rng: random.Random) -> float:
+    """``value * uniform(0.5, 1.0)`` — spreads a client's OWN schedule;
+    for a server-provided wait use :func:`jittered_up` (shrinking a
+    drain estimate re-sends into a still-full queue)."""
+    return value * (0.5 + 0.5 * rng.random())
+
+
+def jittered_up(value: float, rng: random.Random) -> float:
+    """``value * uniform(1.0, 1.5)`` — for server-provided waits (a 429
+    ``retry_after_ms`` drain estimate): never earlier than the advertised
+    horizon — an early re-send hits the still-full queue and burns a
+    retry-budget slot on a fresh 429 — but spread above it so a fleet of
+    shed clients does not return in lockstep."""
+    return value * (1.0 + 0.5 * rng.random())
+
+
+def backoff_delay(attempt: int, *, base: float, cap: float,
+                  rng: random.Random) -> float:
+    """Capped jittered exponential delay for retry ``attempt`` (0-based)."""
+    return jittered(min(cap, base * (2 ** attempt)), rng)

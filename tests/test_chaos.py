@@ -1,0 +1,295 @@
+"""Fast deterministic chaos subset (tier-1; the multi-process soak is
+``tools/chaos_soak.py`` / test_chaos_soak.py, marked slow).
+
+Every fault here is injected through the REAL hook points in production
+code — the master RPC codec, the checkpoint writer, the trainer step
+loop — by a seeded ``testing.chaos.FaultPlan``, so what is tested is
+the recovery machinery itself: RPC retry + idempotent dedupe under
+message loss, corrupted-generation fallback, and the crown guarantee —
+a master-fed trainer killed mid-run auto-resumes BITWISE onto the
+uninterrupted trajectory via the checkpoint's task ledger and
+``resume_lease``.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.config import dsl
+from paddle_tpu.core.argument import Argument
+from paddle_tpu.dist import (MasterClient, MasterServer, MasterService,
+                             master_reader)
+from paddle_tpu.dist.checkpoint import Checkpointer
+from paddle_tpu.optim import Adam
+from paddle_tpu.testing.chaos import (ChaosKilled, FaultPlan, chaos_plan,
+                                      install_from_env)
+from paddle_tpu.trainer import SGD
+
+pytestmark = pytest.mark.chaos
+
+WIDTH, CLASSES, B = 8, 3, 8
+BATCHES, PASSES = 4, 2
+
+
+# ------------------------------------------------------------ FaultPlan
+
+def test_plan_is_deterministic_and_roundtrips_env():
+    faults = [{"type": "drop", "site": "msg_send", "rate": 0.3},
+              {"type": "kill", "site": "step", "at": 5, "mode": "raise"}]
+    a, b = FaultPlan(seed=9, faults=faults), FaultPlan(seed=9, faults=faults)
+    for n in range(1, 50):
+        assert a._matches(0, faults[0], "msg_send", n) == \
+            b._matches(0, faults[0], "msg_send", n)
+    # a different seed produces a different Bernoulli schedule
+    c = FaultPlan(seed=10, faults=faults)
+    assert any(a._matches(0, faults[0], "msg_send", n)
+               != c._matches(0, faults[0], "msg_send", n)
+               for n in range(1, 200))
+    os.environ["PADDLE_TPU_CHAOS_PLAN"] = a.to_json()
+    try:
+        got = install_from_env()
+        assert got is not None and got.seed == 9 and got.faults == faults
+    finally:
+        del os.environ["PADDLE_TPU_CHAOS_PLAN"]
+        from paddle_tpu.testing import chaos
+        chaos.install(None)
+
+
+def test_plan_triggers_combine_as_conjunction():
+    """Triggers in one fault are combinable (docstring contract): every
+    present trigger must agree, not first-key-wins — {"every": 2,
+    "after": 2} fires on even hits within the window only, and adding
+    "rate" gates those same hits through the seeded coin flip."""
+    f = {"type": "drop", "site": "msg_send", "after": 2, "count": 10,
+         "every": 2}
+    plan = FaultPlan(seed=3, faults=[f])
+    fired = [n for n in range(1, 20) if plan._matches(0, f, "msg_send", n)]
+    assert fired == [4, 6, 8, 10, 12]
+    g = dict(f, rate=0.5)
+    gated = FaultPlan(seed=3, faults=[g])
+    sub = [n for n in range(1, 20) if gated._matches(0, g, "msg_send", n)]
+    assert set(sub) <= set(fired) and sub != fired  # a strict, seeded subset
+    assert [n for n in range(1, 20)
+            if FaultPlan(seed=3, faults=[g])._matches(0, g, "msg_send", n)]         == sub  # still seed-reproducible
+
+
+def test_zero_cost_when_disabled():
+    from paddle_tpu.testing import chaos
+    assert chaos._ACTIVE is None  # the guard every hook site polls
+
+
+# --------------------------------------------------- RPC under fire
+
+def test_message_loss_is_at_least_once_exactly_delivered():
+    """15% of RPC messages dropped (both directions, deterministic
+    seed): the client redials with jittered backoff, get_task re-serves
+    the caller's lease idempotently, task_finished dedupes — one pass
+    delivers every record exactly once, no spurious failures."""
+    svc = MasterService(timeout_s=30.0, failure_max=50, chunks_per_task=1)
+    server = MasterServer(svc).start()
+    plan = FaultPlan(seed=3, faults=[
+        {"type": "drop", "site": "msg_recv", "rate": 0.15},
+        {"type": "delay", "site": "msg_send", "every": 11,
+         "seconds": 0.002}])
+    try:
+        client = MasterClient(server.addr, retries=40, retry_delay=0.01,
+                              backoff_cap=0.05, trainer_id="tr-drop")
+        client.set_dataset([[i] for i in range(12)])
+        with chaos_plan(plan):
+            got = list(master_reader(client, lambda c: c)())
+        assert sorted(got) == list(range(12))
+        assert any(t == "drop" for _, _, t in plan.log), \
+            "the plan never actually fired"
+        assert not svc.failed and not svc.pending
+        client.close()
+    finally:
+        server.stop()
+
+
+# ------------------------------------------- corrupted generations
+
+def _fake_state(seed):
+    rng = np.random.RandomState(seed)
+    return ({"w": rng.randn(3, 3).astype(np.float32)},
+            {"slots": {"w": {"mom": rng.randn(3, 3).astype(np.float32)}}})
+
+
+@pytest.mark.parametrize("mode", ["truncate", "bitflip", "bitflip_meta",
+                                  "delete_meta"])
+def test_plan_corrupts_latest_restore_falls_back(tmp_path, mode):
+    """A FaultPlan corrupting the 2nd durable generation (each mode of
+    mutilation) leaves restore on the previous INTACT one — never a
+    crash, never torn state."""
+    ck = Checkpointer(str(tmp_path), keep=3)
+    plan = FaultPlan(seed=0, faults=[
+        {"type": "corrupt", "site": "checkpoint", "at": 2, "mode": mode}])
+    with chaos_plan(plan):
+        for p in range(2):
+            params, opt = _fake_state(p)
+            ck.save(params, opt, pass_id=p)
+    restored = ck.restore()
+    assert restored is not None
+    params, _, meta = restored
+    assert meta["pass_id"] == 0
+    np.testing.assert_array_equal(params["w"], _fake_state(0)[0]["w"])
+
+
+# ------------------------------------- the crown: master-fed resume
+
+def _batches():
+    rng = np.random.RandomState(13)
+    X = rng.randn(BATCHES * B, WIDTH).astype(np.float32)
+    W = rng.randn(WIDTH, CLASSES)
+    Y = np.argmax(X @ W, axis=1).astype(np.int32)
+    feeds = []
+    for i in range(0, BATCHES * B, B):
+        feeds.append({"x": Argument(value=jnp.asarray(X[i:i + B])),
+                      "label": Argument(value=jnp.asarray(Y[i:i + B]))})
+    return feeds
+
+
+def _build(seed=21):
+    dsl.reset()
+    x = dsl.data(name="x", size=WIDTH)
+    lbl = dsl.data(name="label", size=CLASSES)
+    h = dsl.fc(input=x, size=WIDTH, act="tanh")
+    h = dsl.dropout(input=h, rate=0.25)
+    out = dsl.fc(input=h, size=CLASSES, act="softmax")
+    cost = dsl.classification_cost(input=out, label=lbl)
+    return SGD(cost=cost, update_equation=Adam(learning_rate=3e-3),
+               seed=seed)
+
+
+def _final(tr):
+    return {k: np.asarray(jax.device_get(v)) for k, v in tr.params.items()}
+
+
+@pytest.mark.parametrize("kill_at,site", [(5, "step_done"), (7, "step")],
+                         ids=["after_ckpt_p1b0", "before_ckpt_p1b2"])
+def test_master_fed_kill_resume_bitwise(tmp_path, kill_at, site):
+    """A trainer reading from a live master, killed mid-run, resumes
+    bitwise onto the clean trajectory: the checkpoint's task ledger +
+    ``resume_lease`` re-mark consumed tasks done, requeue this
+    trainer's post-checkpoint work IN ORDER, and skip the in-flight
+    task's already-trained prefix. The master survives the whole drama
+    in-process (only the trainer 'dies')."""
+    feeds = _batches()
+
+    # clean trajectory: a plain reader over the same batch sequence
+    clean = _build()
+    clean.train(lambda: iter(feeds), num_passes=PASSES)
+    want = _final(clean)
+
+    svc = MasterService(timeout_s=30.0, failure_max=50, chunks_per_task=1)
+    server = MasterServer(svc).start()
+    try:
+        def load_chunk(i):
+            yield feeds[i]
+
+        def make_reader():
+            # same trainer identity across "process" restarts, like
+            # dist/launch.py's trainer-{process_id}
+            client = MasterClient(server.addr, trainer_id="tr-0",
+                                  retries=20, retry_delay=0.01)
+            client.set_dataset(list(range(BATCHES)))
+            return master_reader(client, load_chunk)
+
+        plan = FaultPlan(seed=0, faults=[
+            {"type": "kill", "site": site, "at": kill_at,
+             "mode": "raise"}])
+        ck_a = Checkpointer(str(tmp_path), saving_period=1,
+                            saving_period_by_batches=2, background=True)
+        run_a = _build()
+        with chaos_plan(plan):
+            with pytest.raises(ChaosKilled):
+                run_a.train(make_reader(), num_passes=PASSES,
+                            checkpointer=ck_a)
+        ck_a.flush()
+
+        run_b = _build()
+        run_b.train(make_reader(), num_passes=PASSES,
+                    checkpointer=Checkpointer(
+                        str(tmp_path), saving_period=1,
+                        saving_period_by_batches=2, background=True))
+        got = _final(run_b)
+        for k in want:
+            np.testing.assert_array_equal(got[k], want[k], err_msg=k)
+        # the ledger really committed: the master holds no stale state
+        assert not svc.pending and not svc.todo
+    finally:
+        server.stop()
+
+
+def test_master_killed_and_recovered_mid_run(tmp_path):
+    """The MASTER dies mid-pass instead: a new MasterService recovers
+    from the FileStore snapshot (in-flight + uncommitted work requeued
+    in order), the trainer's client redials, and the job still ends
+    with every task resolved and the bitwise-clean parameters."""
+    from paddle_tpu.dist import FileStore
+
+    feeds = _batches()
+    clean = _build()
+    clean.train(lambda: iter(feeds), num_passes=PASSES)
+    want = _final(clean)
+
+    snap = str(tmp_path / "master.snap")
+    svc = MasterService(store=FileStore(snap), timeout_s=30.0,
+                        failure_max=50, chunks_per_task=1)
+    server = MasterServer(svc).start()
+    addr_holder = {"addr": server.addr}
+
+    def load_chunk(i):
+        yield feeds[i]
+
+    client = MasterClient(addr_holder["addr"], trainer_id="tr-0",
+                          retries=60, retry_delay=0.02, backoff_cap=0.2)
+    client.set_dataset(list(range(BATCHES)))
+    reader = master_reader(client, load_chunk)
+
+    killed = {"done": False}
+
+    def handler(e):
+        # kill + restart the master right after pass 0 batch 1, while
+        # tasks are mid-flight — on the SAME port (the client redials)
+        from paddle_tpu.trainer import events as ev
+        if (not killed["done"] and isinstance(e, ev.EndIteration)
+                and e.pass_id == 0 and e.batch_id == 1):
+            killed["done"] = True
+            host, port = addr_holder["addr"]
+            server.stop()
+            svc2 = MasterService(store=FileStore(snap), timeout_s=30.0,
+                                 failure_max=50, chunks_per_task=1)
+            new_server = MasterServer(svc2, host=host, port=port).start()
+            addr_holder["server"] = new_server
+
+    tr = _build()
+    try:
+        tr.train(reader, num_passes=PASSES,
+                 checkpointer=Checkpointer(str(tmp_path / "ck"),
+                                           saving_period=1,
+                                           saving_period_by_batches=2),
+                 event_handler=handler)
+    finally:
+        srv = addr_holder.get("server")
+        if srv is not None:
+            srv.stop()
+        client.close()
+    assert killed["done"], "the mid-run master kill never happened"
+    got = _final(tr)
+    for k in want:
+        np.testing.assert_array_equal(got[k], want[k], err_msg=k)
+
+
+def test_triggerless_fault_fires_on_every_hit():
+    """The empty conjunction is TRUE: {"type": "drop", "site": s} with no
+    at/after/every/rate means "drop every arrival at s" — it must not be
+    silently inert (a fault-free soak would pass with zero injection,
+    faking fault-tolerance coverage)."""
+    f = {"type": "drop", "site": "msg_send"}
+    plan = FaultPlan(seed=0, faults=[f])
+    assert all(plan._matches(0, f, "msg_send", n) for n in range(1, 20))
+    assert not plan._matches(0, f, "msg_recv", 1)   # site still gates

@@ -1,0 +1,34 @@
+"""Multi-process chaos soak (tools/chaos_soak.py) as a pytest, marked
+``slow`` + ``chaos`` — excluded from tier-1 (run with ``-m slow``).
+
+The fast deterministic chaos subset lives in tests/test_chaos.py; this
+drill SIGKILLs real master/worker processes, corrupts checkpoints on
+disk, and asserts the chaotic run's final parameters are bitwise equal
+to a clean run's. See docs/fault_tolerance.md for the fault model."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SOAK = os.path.join(REPO, "tools", "chaos_soak.py")
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_soak_bitwise_equal(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, SOAK, "--seed", "7", "--events", "4",
+         "--passes", "2", "--batches", "4", "--timeout", "300",
+         "--workdir", str(tmp_path)],
+        capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, (
+        f"soak failed: stdout={proc.stdout!r} stderr={proc.stderr[-2000:]!r}")
+    result = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert result["bitwise_equal"], result
+    # the seeded schedule must actually have committed crimes
+    assert any(e.startswith(("kill_", "plan_kill", "corrupt"))
+               for e in result["events"]), result

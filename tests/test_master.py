@@ -317,3 +317,1482 @@ def test_rpc_rejects_unknown_methods():
             c.call("cur_pass")  # non-callable attribute: also rejected
     finally:
         server.stop()
+
+
+# ------------------------------------------------------ elastic leases
+
+def test_task_finished_is_idempotent():
+    """At-least-once dedupe: duplicate reports (lost response + retry,
+    or the losing copy of a straggler re-dispatch) succeed as no-ops;
+    a finish racing a timeout requeue claims the task back from todo."""
+    svc = MasterService(chunks_per_task=1)
+    svc.set_dataset(["a", "b"])
+    _, t = svc.get_task(0, trainer_id="tr-A")
+    assert svc.task_finished(t["id"])
+    assert svc.task_finished(t["id"])          # duplicate → True, no-op
+    assert len(svc.done) == 1
+    assert not svc.task_finished(99)           # truly unknown → False
+    # finish-after-timeout-requeue: the work WAS done
+    svc2 = MasterService(timeout_s=0.01, failure_max=10, chunks_per_task=1)
+    svc2.set_dataset(["a"])
+    _, t = svc2.get_task(0, trainer_id="tr-A")
+    time.sleep(0.02)
+    assert not svc2.pass_finished()  # runs _check_timeouts: requeued
+    assert t["id"] not in svc2.pending
+    assert any(x.id == t["id"] for x in svc2.todo)
+    assert svc2.task_finished(t["id"])         # claimed from todo
+    assert len(svc2.done) == 1 and svc2.pass_finished()
+
+
+def test_heartbeat_renews_task_lease():
+    svc = MasterService(timeout_s=0.08, chunks_per_task=1)
+    svc.set_dataset(["a"])
+    _, t = svc.get_task(0, trainer_id="tr-A")
+    for _ in range(4):                 # hold the lease past 2x timeout
+        time.sleep(0.05)
+        svc.heartbeat("tr-A")
+    assert t["id"] in svc.pending      # never expired
+    assert svc.task_finished(t["id"], trainer_id="tr-A")
+
+
+def test_uncommitted_requeues_on_trainer_death():
+    """Commit protocol: finishes park per-trainer until commit_tasks;
+    a trainer that goes silent has its uncommitted work requeued (its
+    post-checkpoint training is lost with the process), committed work
+    stays done."""
+    svc = MasterService(timeout_s=30.0, trainer_timeout_s=0.05,
+                        chunks_per_task=1)
+    svc.set_dataset(["a", "b", "c"])
+    for _ in range(2):
+        _, t = svc.get_task(0, trainer_id="tr-A")
+        svc.task_finished(t["id"], trainer_id="tr-A", defer_commit=True)
+    assert len(svc.uncommitted["tr-A"]) == 2 and not svc.done
+    svc.commit_tasks("tr-A", task_ids=[0])     # checkpoint covered task 0
+    assert [t.id for t in svc.done] == [0]
+    time.sleep(0.07)                           # tr-A dies silently
+    status, t = svc.get_task(0, trainer_id="tr-B")
+    # task 1 (uncommitted at death) requeued at the front, before task 2
+    assert status == "task" and t["id"] == 1
+    assert "tr-A" not in svc.uncommitted
+
+
+def test_straggler_redispatch_first_finish_wins():
+    svc = MasterService(timeout_s=30.0, straggle_after_s=0.02,
+                        chunks_per_task=1)
+    svc.set_dataset(["a"])
+    _, t1 = svc.get_task(0, trainer_id="tr-slow")
+    time.sleep(0.03)
+    s, t2 = svc.get_task(0, trainer_id="tr-fast")   # speculative copy
+    assert s == "task" and t2["id"] == t1["id"]
+    assert svc.task_finished(t1["id"], trainer_id="tr-fast")
+    assert svc.task_finished(t1["id"], trainer_id="tr-slow")  # dedupes
+    assert len(svc.done) == 1 and svc.pass_finished()
+
+
+def test_resume_lease_reconciles_ledger():
+    """The pass-aware resume fix: a resumed trainer's checkpoint ledger
+    re-marks consumed tasks done, requeues its post-checkpoint work in
+    dispatch order, and fronts the in-flight task."""
+    svc = MasterService(timeout_s=30.0, chunks_per_task=1)
+    svc.set_dataset(["a", "b", "c", "d"])
+    # the pre-crash life: trained 0,1,2 — checkpoint covered only 0;
+    # 1 finished-uncommitted; 2 was in flight (pending lease)
+    for _ in range(3):
+        _, t = svc.get_task(0, trainer_id="tr-A")
+        if t["id"] == 0:
+            svc.task_finished(0, trainer_id="tr-A", defer_commit=True)
+            svc.commit_tasks("tr-A")
+        elif t["id"] == 1:
+            svc.task_finished(1, trainer_id="tr-A", defer_commit=True)
+    assert 2 in svc.pending
+    # the resumed life restores a checkpoint whose ledger says: pass 0,
+    # consumed [0], in flight 1
+    out = svc.resume_lease("tr-A", 0, done_ids=[0], inflight_id=1)
+    assert out["pass"] == 0
+    assert [t.id for t in svc.done] == [0]
+    assert [t.id for t in svc.todo] == [1, 2, 3]   # in-order replay
+    assert not svc.pending and "tr-A" not in svc._owner
+    # a stale-pass resume is a no-op
+    out = svc.resume_lease("tr-A", 5, done_ids=[3])
+    assert out == {"pass": 0, "requeued": 0, "done": 0}
+
+
+def test_sync_pass_follows_master(tmp_path):
+    """Satellite regression (trainer.py pass-aware resume): a resumed
+    trainer whose cluster moved on follows the master's authoritative
+    pass instead of starving through long-dead ones one empty reader
+    call at a time."""
+    svc = MasterService(chunks_per_task=1)
+    server = MasterServer(svc).start()
+    try:
+        # another trainer drove the job to pass 2
+        c_other = MasterClient(server.addr, trainer_id="tr-B")
+        c_other.set_dataset([[0], [1]])
+        for p in range(2):
+            assert sorted(master_reader(c_other, lambda c: c)(p)) == [0, 1]
+        _ = svc.get_task(2, trainer_id="tr-B")  # rolls to pass 2
+        assert svc.cur_pass == 2
+
+        c = MasterClient(server.addr, trainer_id="tr-A")
+        r = master_reader(c, lambda c: c)
+        # checkpoint said "start at pass 1"; the master is at pass 2
+        assert r.sync_pass(1) == 2
+        # ...and a fresh-start trainer is pulled forward likewise
+        assert r.sync_pass(0) == 2
+    finally:
+        server.stop()
+
+
+def test_reader_ledger_state_tracks_position():
+    svc = MasterService(chunks_per_task=1)
+    server = MasterServer(svc).start()
+    try:
+        c = MasterClient(server.addr, trainer_id="tr-A")
+        c.set_dataset([[10, 11], [20, 21]])
+        r = master_reader(c, lambda chunk: chunk)
+        # a checkpointer owns commits (as SGD.train wires it) — otherwise
+        # the reader self-commits at pass end and the manual
+        # commit_ledger calls below would have nothing left to move
+        r.checkpoint_coupled = True
+        g = r(0)
+        assert next(g) == 10
+        led = r.ledger_state()
+        assert led == {"pass": 0, "done": [], "inflight": 0, "offset": 1,
+                       "trainer": "tr-A"}
+        assert next(g) == 11 and next(g) == 20
+        led = r.ledger_state()
+        assert led["done"] == [0] and led["inflight"] == 1 \
+            and led["offset"] == 1
+        assert list(g) == [21]
+        assert r.ledger_state()["inflight"] is None
+        # commit by ledger: only the named finishes move to done
+        r.commit_ledger({"done": [0]})
+        assert [t.id for t in svc.done] == [0]
+        r.commit_ledger(None)   # end-of-pass: everything buffered
+        assert sorted(t.id for t in svc.done) == [0, 1]
+    finally:
+        server.stop()
+
+
+def test_reader_restore_ledger_skips_trained_prefix():
+    """restore_ledger + resume_lease: the resumed reader re-acquires the
+    in-flight task, silently skips its already-trained records, and
+    yields exactly the untrained remainder of the pass."""
+    svc = MasterService(chunks_per_task=1)
+    svc.set_dataset([[10, 11], [20, 21], [30, 31]])
+    server = MasterServer(svc).start()
+    try:
+        # pre-crash life: consumed task 0 fully and 20 of task 1
+        c1 = MasterClient(server.addr, trainer_id="tr-A")
+        g = master_reader(c1, lambda chunk: chunk)(0)
+        assert [next(g) for _ in range(3)] == [10, 11, 20]
+        # resumed life (same trainer id), ledger from "the checkpoint"
+        c2 = MasterClient(server.addr, trainer_id="tr-A")
+        r2 = master_reader(c2, lambda chunk: chunk)
+        r2.restore_ledger({"pass": 0, "done": [0], "inflight": 1,
+                           "offset": 1})
+        assert list(r2(0)) == [21, 30, 31]
+        assert svc.pass_finished()
+    finally:
+        server.stop()
+
+
+# ----------------------------------------- generation-ordered GC/restore
+
+def test_gc_and_candidates_order_by_generation_not_mtime(tmp_path):
+    """Satellite: fast save bursts tie mtimes (and clock skew can invert
+    them); GC and recovery must order by the parsed (pass, batch)
+    generation so the newest generation always survives and restores."""
+    ck = Checkpointer(str(tmp_path), keep=2)
+    params, opt = _fake_state(0)
+    for p in range(4):
+        ck.save(params, opt, pass_id=p, batch_id=0, end_of_pass=True)
+    # force IDENTICAL mtimes (the fast-burst / skewed-clock tie), with
+    # the OLDEST file mtime-newest to catch any mtime fallback
+    now = time.time()
+    for i, name in enumerate(sorted(os.listdir(str(tmp_path)))):
+        os.utime(os.path.join(str(tmp_path), name), (now, now))
+    survivors = sorted(n for n in os.listdir(str(tmp_path))
+                       if n.endswith(".npz"))
+    assert survivors == ["checkpoint-p00002-b00000000.npz",
+                         "checkpoint-p00003-b00000000.npz"]
+    # kill the LATEST pointer: the scan alone must still pick pass 3
+    os.remove(os.path.join(str(tmp_path), "LATEST"))
+    _, _, meta = ck.restore()
+    assert meta["pass_id"] == 3
+
+
+def test_restore_corruption_fallback_matrix(tmp_path):
+    """Satellite: every mutilation of the newest generation — truncated
+    .npz, bit-flipped .meta, .meta deleted outright — falls back to the
+    previous INTACT generation with a warning, never a crash, never
+    torn state."""
+    import shutil
+
+    def fresh(dirpath):
+        ck = Checkpointer(str(dirpath), keep=3)
+        for p in range(2):
+            params, opt = _fake_state(p)
+            ck.save(params, opt, pass_id=p)
+        latest = os.path.join(
+            str(dirpath),
+            open(os.path.join(str(dirpath), "LATEST")).read().strip()
+            + ".npz")
+        return ck, latest
+
+    # (a) truncated data file
+    d = tmp_path / "trunc"
+    ck, latest = fresh(d)
+    with open(latest, "r+b") as f:
+        f.truncate(os.path.getsize(latest) // 2)
+    params, _, meta = ck.restore()
+    assert meta["pass_id"] == 0
+    np.testing.assert_array_equal(params["w"], _fake_state(0)[0]["w"])
+
+    # (b) bit-flipped meta sidecar (MD5 no longer matches / torn JSON)
+    d = tmp_path / "flip"
+    ck, latest = fresh(d)
+    with open(latest + ".meta", "r+b") as f:
+        b = f.read(1)
+        f.seek(0)
+        f.write(bytes([b[0] ^ 0x01]))
+    params, _, meta = ck.restore()
+    assert meta["pass_id"] == 0
+
+    # (c) meta deleted outright: integrity unprovable → treated as torn
+    d = tmp_path / "nometa"
+    ck, latest = fresh(d)
+    os.remove(latest + ".meta")
+    params, _, meta = ck.restore()
+    assert meta["pass_id"] == 0
+    # (d) ALL generations mutilated → restore reports None, not a crash
+    shutil.rmtree(str(d))
+    ck2, latest2 = fresh(d)
+    for n in os.listdir(str(d)):
+        if n.endswith(".meta"):
+            os.remove(os.path.join(str(d), n))
+    assert ck2.restore() is None
+
+
+def test_background_checkpointer_off_hot_path(tmp_path):
+    """Off-hot-path saves: save() returns before the bytes hit disk (the
+    writer thread owns serialize+fsync+GC), flush() drains, restore()
+    sees every due generation, and a corrupted background write surfaces
+    at the next save/flush instead of vanishing."""
+    ck = Checkpointer(str(tmp_path), keep=5, background=True)
+    for p in range(3):
+        params, opt = _fake_state(p)
+        ck.save(params, opt, pass_id=p)
+    ck.flush()
+    files = sorted(n for n in os.listdir(str(tmp_path))
+                   if n.endswith(".npz"))
+    assert len(files) == 3
+    _, _, meta = ck.restore()
+    assert meta["pass_id"] == 2
+    ck.close()
+
+
+def test_background_on_save_fires_after_durable(tmp_path):
+    seen = []
+
+    def on_save(meta):
+        # the generation named by meta must already be durable
+        name = f"checkpoint-p{meta['pass_id']:05d}-b00000000.npz"
+        assert os.path.exists(os.path.join(str(tmp_path), name))
+        assert os.path.exists(os.path.join(str(tmp_path), name + ".meta"))
+        seen.append(meta["pass_id"])
+
+    ck = Checkpointer(str(tmp_path), background=True, on_save=on_save)
+    params, opt = _fake_state(1)
+    ck.save(params, opt, pass_id=0)
+    ck.save(params, opt, pass_id=1)
+    ck.flush()
+    assert seen == [0, 1]
+
+
+# ------------------------------------------- durability-gated pass roll
+
+def test_pass_roll_waits_for_uncommitted_then_proceeds():
+    """The roll to the next pass is a DURABILITY gate: while any finish
+    is parked uncommitted (its owner's checkpoint may still be fsyncing)
+    the master answers 'wait' instead of committing work it cannot prove
+    durable; the commit unblocks it."""
+    svc = MasterService(chunks_per_task=1)
+    svc.set_dataset(["a"])
+    _, t = svc.get_task(0, trainer_id="tr-A")
+    assert svc.task_finished(t["id"], trainer_id="tr-A", defer_commit=True)
+    assert svc.pass_finished()          # resolved, merely parked
+    assert svc.get_task(1, trainer_id="tr-A") == ("wait", None)
+    assert svc.current_pass() == 0      # the roll did NOT happen
+    assert svc.commit_tasks("tr-A") == 1
+    s, t2 = svc.get_task(1, trainer_id="tr-A")
+    assert s == "task" and svc.current_pass() == 1
+
+
+def test_pass_roll_unblocks_when_uncommitted_owner_dies():
+    """A dead owner's parked work requeues into the CURRENT pass via its
+    liveness expiry — the roll waits, then pass 0 resumes with the
+    requeued task instead of rolling past untrained-in-any-durable-
+    checkpoint work."""
+    svc = MasterService(timeout_s=30.0, trainer_timeout_s=0.02,
+                        chunks_per_task=1)
+    svc.set_dataset(["a"])
+    _, t = svc.get_task(0, trainer_id="tr-dead")
+    svc.task_finished(t["id"], trainer_id="tr-dead", defer_commit=True)
+    time.sleep(0.03)
+    # tr-B wants pass 1; tr-dead's expiry requeues its parked finish
+    s, t2 = svc.get_task(1, trainer_id="tr-B")
+    assert svc.current_pass() == 0
+    assert s == "task" and t2["id"] == t["id"]  # pass 0 work re-served
+
+
+def test_stale_finish_after_pass_roll_does_not_claim_new_copy():
+    """A delayed duplicate finish from a PREVIOUS pass (slow network,
+    zombie trainer) must not mark the new pass's recycled copy trained:
+    the claim-from-todo path is epoch-guarded."""
+    svc = MasterService(timeout_s=0.01, failure_max=10, chunks_per_task=1)
+    svc.set_dataset(["a", "b"])
+    _, t0 = svc.get_task(0, trainer_id="tr-A")     # A leases id 0
+    _, t1 = svc.get_task(0, trainer_id="tr-B")     # B leases id 1
+    assert svc.task_finished(t1["id"], trainer_id="tr-B")
+    time.sleep(0.02)
+    assert not svc.pass_finished()    # A's lease expired → id 0 to todo
+    _, t0b = svc.get_task(0, trainer_id="tr-B")    # B rescues id 0
+    assert t0b["id"] == t0["id"]
+    assert svc.task_finished(t0b["id"], trainer_id="tr-B")
+    s, tnew = svc.get_task(1, trainer_id="tr-B")   # roll; B leases one
+    assert s == "task" and svc.current_pass() == 1
+    stale_id = t0["id"] if tnew["id"] != t0["id"] else t1["id"]
+    assert any(x.id == stale_id for x in svc.todo)
+    n_todo = len(svc.todo)
+    # zombie tr-A's duplicate for its long-gone pass-0 lease arrives now
+    assert not svc.task_finished(stale_id, trainer_id="tr-A")
+    assert len(svc.todo) == n_todo    # the recycled copy stays untrained
+    assert not any(t.id == stale_id for t in svc.done)
+
+
+def test_sparse_cadence_master_run_completes(tmp_path):
+    """saving_period>1: no end-of-pass checkpoint is due for most
+    passes, so no on_save will commit them — the trainer's fallback
+    commit must keep the durability-gated roll live (this test hangs,
+    not fails, on a regression)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.config import dsl
+    from paddle_tpu.core.argument import Argument
+    from paddle_tpu.optim import Adam
+    from paddle_tpu.trainer import SGD
+
+    rng = np.random.RandomState(3)
+    X = rng.randn(16, 6).astype(np.float32)
+    Y = rng.randint(0, 3, size=16).astype(np.int32)
+    feeds = [{"x": Argument(value=jnp.asarray(X[i:i + 4])),
+              "label": Argument(value=jnp.asarray(Y[i:i + 4]))}
+             for i in range(0, 16, 4)]
+
+    dsl.reset()
+    x = dsl.data(name="x", size=6)
+    lbl = dsl.data(name="label", size=3)
+    out = dsl.fc(input=x, size=3, act="softmax")
+    cost = dsl.classification_cost(input=out, label=lbl)
+    tr = SGD(cost=cost, update_equation=Adam(learning_rate=1e-3), seed=1)
+
+    svc = MasterService(chunks_per_task=1)
+    server = MasterServer(svc).start()
+    try:
+        client = MasterClient(server.addr, trainer_id="tr-0",
+                              retries=20, retry_delay=0.01)
+        client.set_dataset(list(range(len(feeds))))
+
+        def load_chunk(i):
+            yield feeds[int(i)]
+
+        reader = master_reader(client, load_chunk)
+        ck = Checkpointer(str(tmp_path), saving_period=5,  # never due
+                          background=True)
+        # the writer-death guard is unwired when train() returns —
+        # observe it mid-run
+        armed = []
+        tr.train(reader, num_passes=3, checkpointer=ck,
+                 event_handler=lambda e: armed.append(reader.health_check))
+        assert svc.cur_pass == 2 and not svc.pending
+        assert not any(svc.uncommitted.values())
+        # the coupling block also armed the wait-loop's writer-death
+        # guard (the livelock fix is wired, not just available) — and
+        # train() unwired both at exit so the reader can be reused
+        assert armed and all(h == ck.poll_error for h in armed)
+        assert reader.health_check is None
+        assert reader.checkpoint_coupled is False
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_async_load_data_stands_down_for_pass_aware_reader(tmp_path,
+                                                           caplog):
+    """A prefetch queue would advance the task ledger ahead of the
+    trained position (checkpoints would record prefetched-but-untrained
+    records as consumed); pass-aware readers must be consumed
+    synchronously — the flag stands down with a warning."""
+    import logging
+
+    import jax.numpy as jnp
+
+    from paddle_tpu.config import dsl
+    from paddle_tpu.core.argument import Argument
+    from paddle_tpu.optim import Adam
+    from paddle_tpu.trainer import SGD
+
+    rng = np.random.RandomState(4)
+    feeds = [{"x": Argument(value=jnp.asarray(
+                  rng.randn(4, 6).astype(np.float32))),
+              "label": Argument(value=jnp.asarray(
+                  rng.randint(0, 3, size=4).astype(np.int32)))}
+             for _ in range(3)]
+
+    dsl.reset()
+    x = dsl.data(name="x", size=6)
+    lbl = dsl.data(name="label", size=3)
+    out = dsl.fc(input=x, size=3, act="softmax")
+    cost = dsl.classification_cost(input=out, label=lbl)
+    tr = SGD(cost=cost, update_equation=Adam(learning_rate=1e-3), seed=1)
+
+    svc = MasterService(chunks_per_task=1)
+    server = MasterServer(svc).start()
+    try:
+        client = MasterClient(server.addr, trainer_id="tr-0")
+        client.set_dataset(list(range(len(feeds))))
+
+        def load_chunk(i):
+            yield feeds[int(i)]
+
+        plogger = logging.getLogger("paddle_tpu")  # propagate=False
+        plogger.addHandler(caplog.handler)
+        try:
+            tr.train(master_reader(client, load_chunk), num_passes=1,
+                     async_load_data=True,
+                     checkpointer=Checkpointer(str(tmp_path)))
+        finally:
+            plogger.removeHandler(caplog.handler)
+        assert "consumed synchronously" in caplog.text
+        assert svc.cur_pass == 0 and not svc.pending
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_heartbeat_defaults_on():
+    """The lease/commit protocol depends on liveness renewal: a client
+    built the way production paths build it (launch.py / trainer code —
+    no explicit heartbeat_s) must have the keepalive armed by default,
+    and well inside the master's default 60 s trainer_timeout_s, or a
+    healthy trainer whose one task outlives the lease is declared dead
+    and its parked work requeued to a peer."""
+    c = MasterClient(("127.0.0.1", 1))  # constructor does not connect
+    assert c.heartbeat_s is not None and 0 < c.heartbeat_s < 60.0
+
+
+def test_wait_loop_health_check_surfaces_writer_death(tmp_path):
+    """A dead background checkpoint writer means no on_save will ever
+    commit this trainer's parked finishes — the master answers 'wait'
+    at the pass roll and every poll renews the trainer's liveness, so
+    not even the lease timeout frees the work. The reader's health
+    check must turn that livelock into the writer's error."""
+    svc = MasterService(chunks_per_task=1)
+    server = MasterServer(svc).start()
+    try:
+        client = MasterClient(server.addr, trainer_id="tr-A")
+        client.set_dataset(["a"])
+        reader = master_reader(client, lambda c: [c])
+        # what SGD.train's coupling block wires:
+        ckdir = tmp_path / "ck"
+        ck = Checkpointer(str(ckdir), background=True)
+        reader.checkpoint_coupled = True
+        reader.health_check = ck.poll_error
+        assert list(reader()) == ["a"]  # pass 0: finish parks uncommitted
+        # a failed background write (write_snapshot recreates a removed
+        # directory, so inject at the writer itself)
+        def _dead_write(path, arrays, meta):
+            raise IOError("disk gone")
+        ck._write = _dead_write
+        params, opt = _fake_state(0)
+        ck.save(params, opt, pass_id=0)
+        ck._q.join()  # let the worker hit the error
+        with pytest.raises(RuntimeError,
+                           match="background checkpoint writer failed"):
+            # pass 1 answers 'wait' (durability gate): without the
+            # health check this call never returns
+            list(reader())
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_flush_error_does_not_mask_training_error(tmp_path):
+    """The end-of-train finally flush must not replace the exception
+    that is actually unwinding the loop (finally semantics would also
+    downgrade a chaos-kill BaseException to a flush RuntimeError)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.config import dsl
+    from paddle_tpu.core.argument import Argument
+    from paddle_tpu.optim import Adam
+    from paddle_tpu.trainer import SGD, events
+
+    rng = np.random.RandomState(5)
+    feeds = [{"x": Argument(value=jnp.asarray(
+                  rng.randn(4, 6).astype(np.float32))),
+              "label": Argument(value=jnp.asarray(
+                  rng.randint(0, 3, size=4).astype(np.int32)))}
+             for _ in range(3)]
+
+    dsl.reset()
+    x = dsl.data(name="x", size=6)
+    lbl = dsl.data(name="label", size=3)
+    out = dsl.fc(input=x, size=3, act="softmax")
+    cost = dsl.classification_cost(input=out, label=lbl)
+    tr = SGD(cost=cost, update_equation=Adam(learning_rate=1e-3), seed=1)
+
+    ck = Checkpointer(str(tmp_path), background=True)
+
+    def broken_flush():
+        raise RuntimeError("background checkpoint writer failed")
+
+    ck.flush = broken_flush
+
+    def handler(e):
+        if isinstance(e, events.EndIteration):
+            raise ValueError("real training fault")
+
+    # auto_resume=False: restore() also flushes, which would fire the
+    # injected error before training starts — the finally path is the
+    # one under test
+    with pytest.raises(ValueError, match="real training fault"):
+        tr.train(lambda: iter(feeds), num_passes=1, checkpointer=ck,
+                 event_handler=handler, auto_resume=False)
+    # and with nothing else unwinding, the flush error DOES surface
+    with pytest.raises(RuntimeError,
+                       match="background checkpoint writer failed"):
+        tr.train(lambda: iter(feeds), num_passes=1, checkpointer=ck,
+                 auto_resume=False)
+
+
+def test_resume_lease_preserves_other_queue_order():
+    """resume_lease sorts only ITS requeued slice: a poison pill another
+    trainer's failure sent to the back must not come home to the queue
+    head, and front-requeued dispatch order elsewhere survives."""
+    svc = MasterService(failure_max=5, chunks_per_task=1)
+    svc.set_dataset(["a", "b", "c"])
+    _, t0 = svc.get_task(0, trainer_id="tr-B")
+    assert t0["id"] == 0
+    svc.task_failed(0)                       # reported → BACK of queue
+    assert [t.id for t in svc.todo] == [1, 2, 0]
+    svc.resume_lease("tr-A", 0, [], None)    # empty-ledger resume
+    assert [t.id for t in svc.todo] == [1, 2, 0]
+
+
+def test_dead_trainer_pending_lease_requeues_with_uncommitted():
+    """Liveness expiry must free EVERYTHING a dead trainer holds — its
+    in-flight lease as well as its parked finishes — in dispatch order
+    [finishes..., in-flight], without waiting out the (possibly much
+    longer) task deadline."""
+    svc = MasterService(chunks_per_task=1, timeout_s=60.0,
+                        trainer_timeout_s=0.05)
+    svc.set_dataset(["a", "b", "c"])
+    _, t0 = svc.get_task(0, trainer_id="A")
+    assert svc.task_finished(t0["id"], trainer_id="A", defer_commit=True)
+    _, t1 = svc.get_task(0, trainer_id="A")
+    assert svc.task_finished(t1["id"], trainer_id="A", defer_commit=True)
+    _, t2 = svc.get_task(0, trainer_id="A")  # in flight when A dies
+    time.sleep(0.06)
+    svc._check_timeouts()
+    # the lease did NOT ride the 60 s task deadline
+    assert t2["id"] not in svc.pending and "A" not in svc._owner
+    assert not svc.uncommitted.get("A")
+    # dispatch order preserved: finishes first, then the in-flight task
+    assert [t.id for t in svc.todo] == [t0["id"], t1["id"], t2["id"]]
+
+
+def test_flush_error_surfaces_inside_callers_except_block(tmp_path):
+    """A clean train() run must re-raise a background-writer failure even
+    when the CALLER is inside an except block: ambient sys.exc_info() is
+    non-None there, and deciding 'unwinding' from it would silently
+    swallow the writer's error (queued generations lost, run reported
+    successful)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.config import dsl
+    from paddle_tpu.core.argument import Argument
+    from paddle_tpu.optim import Adam
+    from paddle_tpu.trainer import SGD
+
+    rng = np.random.RandomState(6)
+    feeds = [{"x": Argument(value=jnp.asarray(
+                  rng.randn(4, 6).astype(np.float32))),
+              "label": Argument(value=jnp.asarray(
+                  rng.randint(0, 3, size=4).astype(np.int32)))}
+             for _ in range(2)]
+
+    dsl.reset()
+    x = dsl.data(name="x", size=6)
+    lbl = dsl.data(name="label", size=3)
+    out = dsl.fc(input=x, size=3, act="softmax")
+    cost = dsl.classification_cost(input=out, label=lbl)
+    tr = SGD(cost=cost, update_equation=Adam(learning_rate=1e-3), seed=1)
+
+    ck = Checkpointer(str(tmp_path), background=True)
+
+    def broken_flush():
+        raise RuntimeError("background checkpoint writer failed")
+
+    ck.flush = broken_flush
+    try:
+        raise KeyError("ambient exception being handled by the caller")
+    except KeyError:
+        with pytest.raises(RuntimeError,
+                           match="background checkpoint writer failed"):
+            tr.train(lambda: iter(feeds), num_passes=1, checkpointer=ck,
+                     auto_resume=False)
+
+
+def test_checkpointer_recouples_to_fresh_reader(tmp_path):
+    """One Checkpointer reused across train() calls must couple to the
+    CURRENT run's reader: the first run's on_save closure (committing to
+    that run's — likely closed — master client) is unwired at train end,
+    and a second run with a fresh reader/client couples normally. A
+    user-provided on_save is never clobbered."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.config import dsl
+    from paddle_tpu.core.argument import Argument
+    from paddle_tpu.optim import Adam
+    from paddle_tpu.trainer import SGD
+
+    rng = np.random.RandomState(7)
+    feeds = [{"x": Argument(value=jnp.asarray(
+                  rng.randn(4, 6).astype(np.float32))),
+              "label": Argument(value=jnp.asarray(
+                  rng.randint(0, 3, size=4).astype(np.int32)))}
+             for _ in range(2)]
+
+    dsl.reset()
+    x = dsl.data(name="x", size=6)
+    lbl = dsl.data(name="label", size=3)
+    out = dsl.fc(input=x, size=3, act="softmax")
+    cost = dsl.classification_cost(input=out, label=lbl)
+    tr = SGD(cost=cost, update_equation=Adam(learning_rate=1e-3), seed=1)
+
+    ck = Checkpointer(str(tmp_path), saving_period=1)
+    svc = MasterService(chunks_per_task=1)
+    server = MasterServer(svc).start()
+    try:
+        def run_once(trainer_id):
+            client = MasterClient(server.addr, trainer_id=trainer_id)
+            client.set_dataset(list(range(len(feeds))))
+
+            def load_chunk(i):
+                yield feeds[int(i)]
+
+            reader = master_reader(client, load_chunk)
+            # coupling is unwired when train() returns — observe it
+            # mid-run, then assert the unwinding below
+            seen_coupled = []
+            # auto_resume=False: resuming past the single pass would
+            # train (and emit events) nothing
+            tr.train(reader, num_passes=1, checkpointer=ck,
+                     auto_resume=False,
+                     event_handler=lambda e: seen_coupled.append(
+                         reader.checkpoint_coupled))
+            assert reader.checkpoint_coupled is False  # uncoupled at end
+            assert reader.health_check is None
+            client.close()
+            return any(seen_coupled)
+
+        assert run_once("tr-0") is True
+        assert ck.on_save is None          # unwired at train end
+        assert run_once("tr-1") is True    # fresh reader re-couples
+        # a user-provided callback survives and blocks coupling
+        seen = []
+        user_cb = seen.append
+        ck.on_save = user_cb
+        client = MasterClient(server.addr, trainer_id="tr-2")
+
+        def load_chunk(i):
+            yield feeds[int(i)]
+
+        reader = master_reader(client, load_chunk)
+        # auto_resume would land on the prior runs' end-of-pass
+        # checkpoint and train (and save) nothing — train fresh so a
+        # save actually fires the user's hook
+        tr.train(reader, num_passes=1, checkpointer=ck,
+                 auto_resume=False)
+        assert ck.on_save is user_cb            # never clobbered
+        assert reader.checkpoint_coupled is False
+        assert seen                             # the user's hook fired
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_resume_lease_reconciles_previous_lifes_uncommitted_buffer():
+    """A trainer that dies after its checkpoint is durable but before
+    the on_save commit restarts with a NEW (pid-derived) trainer id.
+    resume_lease must find the checkpoint-proven done tasks parked
+    under the OLD id's uncommitted buffer — leaving them parked would
+    hold the durability-gated pass roll for trainer_timeout_s and then
+    retrain work the checkpoint already contains."""
+    svc = MasterService(chunks_per_task=1)
+    svc.set_dataset(["a", "b", "c"])
+    _, t0 = svc.get_task(0, trainer_id="life-1")
+    assert svc.task_finished(t0["id"], trainer_id="life-1",
+                             defer_commit=True)
+    res = svc.resume_lease("life-2", 0, done_ids=[t0["id"]])
+    assert res["done"] == 1
+    assert t0["id"] in svc._done_ids
+    assert not any(svc.uncommitted.values())  # nothing holds the roll
+
+
+def test_straggler_redispatch_spreads_across_stragglers():
+    """Speculative re-dispatch restarts the straggle clock: two idle
+    trainers must cover two DIFFERENT straggling tasks, not stack two
+    copies onto the globally oldest one."""
+    svc = MasterService(chunks_per_task=1, straggle_after_s=0.0)
+    svc.set_dataset(["a", "b"])
+    _, t1 = svc.get_task(0, trainer_id="A")
+    time.sleep(0.01)
+    _, t2 = svc.get_task(0, trainer_id="B")
+    s1 = svc.get_task(0, trainer_id="C")
+    s2 = svc.get_task(0, trainer_id="D")
+    assert s1[0] == "task" and s2[0] == "task"
+    assert {s1[1]["id"], s2[1]["id"]} == {t1["id"], t2["id"]}
+
+
+def test_client_close_not_blocked_by_peer_threads_redial_backoff():
+    """call() must not sleep its redial backoff under the client lock:
+    close() (and the training thread's RPCs) would block for the whole
+    multi-second retry cycle while the heartbeat thread waits out a
+    master restart. The backoff sleep is also interruptible by close()."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    # nothing listening: every attempt fails; the un-fixed client would
+    # hold the lock through ~10 backoffs (capped at 2 s each)
+    c = MasterClient(("127.0.0.1", port), retries=10, retry_delay=0.5,
+                     backoff_cap=2.0, heartbeat_s=None)
+    errs = []
+
+    def redial():
+        try:
+            c.call("heartbeat", trainer_id="x")
+        except ConnectionError as e:
+            errs.append(e)
+
+    th = threading.Thread(target=redial, daemon=True)
+    th.start()
+    time.sleep(0.3)  # let the thread enter its backoff cycle
+    t0 = time.monotonic()
+    c.close()
+    assert time.monotonic() - t0 < 1.0, "close() blocked on the backoff"
+    th.join(timeout=2.0)
+    assert not th.is_alive(), "redial cycle ignored close()"
+    assert errs  # the call still surfaced its ConnectionError
+
+
+def test_resume_lease_requeues_previous_lifes_lost_generation_commits():
+    """Checkpoint gen N+1 becomes durable and its finishes COMMIT, then
+    the generation is corrupted and the trainer dies; restart restores
+    gen N under a fresh pid-derived id. The old life's commits are not
+    in gen N's done_ids and must be requeued — the restored parameters
+    do not contain that training. The ledger carries the writer's id
+    (``prev_trainer_id``) so resume_lease can claim them."""
+    svc = MasterService(chunks_per_task=1)
+    svc.set_dataset(["a", "b", "c"])
+    _, t0 = svc.get_task(0, trainer_id="life-1")
+    assert svc.task_finished(t0["id"], trainer_id="life-1",
+                             defer_commit=True)
+    assert svc.commit_tasks("life-1") == 1  # gen N+1 durable... then lost
+    res = svc.resume_lease("life-2", 0, done_ids=[],
+                           prev_trainer_id="life-1")
+    assert res["requeued"] == 1
+    assert t0["id"] not in svc._done_ids
+    assert [t.id for t in svc.todo][0] == t0["id"]  # fronted, id order
+    # and the old life's liveness entry is gone (no spurious expiry)
+    assert "life-1" not in svc._trainer_seen
+
+
+def test_reader_discards_restored_ledger_when_master_pass_moved():
+    """resume_lease no-ops when the master's authoritative pass differs
+    from the ledger's (a recovered master that lost the run's progress,
+    or a peer rolled the pass) — the reader must then discard the WHOLE
+    ledger, in particular the in-flight record-prefix skip: armed, it
+    would silently drop records the served pass has never trained."""
+    from itertools import islice
+
+    svc = MasterService(chunks_per_task=1)
+    server = MasterServer(svc).start()
+    try:
+        client = MasterClient(server.addr, trainer_id="t-new")
+        client.set_dataset([list(range(10)), list(range(10, 20))])
+
+        reader = master_reader(client, lambda chunk: chunk)
+        # a mid-task-0 pass-3 checkpoint... but this master is at pass 0
+        reader.restore_ledger({"pass": 3, "done": [], "inflight": 0,
+                               "offset": 5, "trainer": "t-old"})
+        gen = reader(3)
+        got = list(islice(gen, 10))  # exactly task 0's records
+        gen.close()
+        assert got == list(range(10)), \
+            "records 0-4 were skipped against an unreconciled master"
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_uncoupling_survives_flush_error(tmp_path):
+    """A clean run whose final flush() raises (the surfacing path for a
+    dead background writer) must STILL unwire the reader coupling: left
+    coupled, the reader reused in a later train() never self-commits at
+    pass end and the master's durability-gated pass roll waits forever."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.config import dsl
+    from paddle_tpu.core.argument import Argument
+    from paddle_tpu.optim import Adam
+    from paddle_tpu.trainer import SGD
+
+    rng = np.random.RandomState(9)
+    feeds = [{"x": Argument(value=jnp.asarray(
+                  rng.randn(4, 6).astype(np.float32))),
+              "label": Argument(value=jnp.asarray(
+                  rng.randint(0, 3, size=4).astype(np.int32)))}
+             for _ in range(2)]
+
+    dsl.reset()
+    x = dsl.data(name="x", size=6)
+    lbl = dsl.data(name="label", size=3)
+    out = dsl.fc(input=x, size=3, act="softmax")
+    cost = dsl.classification_cost(input=out, label=lbl)
+    tr = SGD(cost=cost, update_equation=Adam(learning_rate=1e-3), seed=1)
+
+    svc = MasterService(chunks_per_task=1)
+    server = MasterServer(svc).start()
+    try:
+        client = MasterClient(server.addr, trainer_id="t-flush")
+        client.set_dataset(list(range(len(feeds))))
+
+        def load_chunk(i):
+            yield feeds[int(i)]
+
+        reader = master_reader(client, load_chunk)
+        ck = Checkpointer(str(tmp_path), saving_period=1)
+
+        def broken_flush():
+            raise RuntimeError("background checkpoint writer failed")
+
+        ck.flush = broken_flush
+        with pytest.raises(RuntimeError,
+                           match="background checkpoint writer failed"):
+            tr.train(reader, num_passes=1, checkpointer=ck,
+                     auto_resume=False)
+        assert reader.checkpoint_coupled is False
+        assert reader.health_check is None
+        assert ck.on_save is None
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_gc_sweeps_crash_orphaned_tmp_files(tmp_path):
+    """A kill mid-write (the chaos soak's bread and butter) leaves
+    full-model-sized '.npz.tmp'/'.meta.tmp' orphans behind; nothing else
+    matches them, so GC must sweep them or a crash-heavy run grows the
+    checkpoint directory without bound. But only OLD ones: the save dir
+    may be shared across trainers (request_save_model arbitration), and
+    a fresh .tmp can be another process's in-flight write — deleting it
+    would crash that trainer's os.replace."""
+    ck = Checkpointer(str(tmp_path), keep=2)
+    old = time.time() - 2 * Checkpointer.ORPHAN_TMP_AGE_S
+    for orphan in ("checkpoint-p00000-b00000007.npz.tmp",
+                   "checkpoint-p00000-b00000007.npz.meta.tmp"):
+        path = os.path.join(str(tmp_path), orphan)
+        with open(path, "wb") as f:
+            f.write(b"torn")
+        os.utime(path, (old, old))  # crash debris only grows older
+    inflight = os.path.join(str(tmp_path),
+                            "checkpoint-p00000-b00000009.npz.tmp")
+    with open(inflight, "wb") as f:
+        f.write(b"another trainer, mid-write")
+    params, opt = _fake_state(0)
+    ck.save(params, opt, pass_id=0)
+    remaining = [n for n in os.listdir(tmp_path) if n.endswith(".tmp")]
+    assert remaining == [os.path.basename(inflight)]
+    assert ck.restore() is not None  # the real generation survived
+
+
+def test_release_lease_frees_live_but_unwound_trainer():
+    """A trainer whose train() loop unwound on an exception while its
+    process — and heartbeat thread — stays alive can never be freed by
+    liveness expiry (every beat renews it); the explicit release requeues
+    its in-flight lease and parked finishes NOW, in the expiry path's
+    dispatch order [finishes..., in-flight, ...rest]."""
+    svc = MasterService(chunks_per_task=1, timeout_s=60.0,
+                        trainer_timeout_s=60.0)
+    svc.set_dataset(["a", "b", "c", "d"])
+    _, t0 = svc.get_task(0, trainer_id="A")
+    assert svc.task_finished(t0["id"], trainer_id="A", defer_commit=True)
+    _, t1 = svc.get_task(0, trainer_id="A")   # in flight at the unwind
+    # the parked finish would gate the pass roll; with the heartbeat
+    # alive nothing would ever free it — until the release
+    assert svc.release_lease("A") == 2
+    assert "A" not in svc._owner and not svc.uncommitted.get("A")
+    assert [t.id for t in svc.todo] == [t0["id"], t1["id"], 2, 3]
+    s, t = svc.get_task(1, trainer_id="B")    # pass 0 work re-served
+    assert s == "task" and t["id"] == t0["id"] and svc.current_pass() == 0
+    assert svc.release_lease("A") == 0        # idempotent
+
+
+def test_unwound_train_releases_lease_on_exception_not_on_kill():
+    """SGD.train's unwinding finally releases the master lease ONLY on a
+    plain-Exception unwind (the process lives on, so its heartbeat blocks
+    liveness expiry forever); a chaos kill emulating process death must
+    NOT gracefully release — the expiry/resume_lease path owns recovery,
+    exactly as it would after a real SIGKILL."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.config import dsl
+    from paddle_tpu.core.argument import Argument
+    from paddle_tpu.optim import Adam
+    from paddle_tpu.testing.chaos import ChaosKilled
+    from paddle_tpu.trainer import SGD, events
+
+    rng = np.random.RandomState(7)
+    feeds = [{"x": Argument(value=jnp.asarray(
+                  rng.randn(4, 6).astype(np.float32))),
+              "label": Argument(value=jnp.asarray(
+                  rng.randint(0, 3, size=4).astype(np.int32)))}
+             for _ in range(3)]
+
+    dsl.reset()
+    x = dsl.data(name="x", size=6)
+    lbl = dsl.data(name="label", size=3)
+    out = dsl.fc(input=x, size=3, act="softmax")
+    cost = dsl.classification_cost(input=out, label=lbl)
+    tr = SGD(cost=cost, update_equation=Adam(learning_rate=1e-3), seed=1)
+
+    released = []
+
+    def make_reader():
+        def reader():
+            return iter(feeds)
+        reader.release_lease = lambda: released.append(1)
+        return reader
+
+    def fault(e):
+        if isinstance(e, events.EndIteration):
+            raise ValueError("user fault")
+
+    with pytest.raises(ValueError, match="user fault"):
+        tr.train(make_reader(), num_passes=1, event_handler=fault,
+                 auto_resume=False)
+    assert released == [1]
+
+    released.clear()
+
+    def kill(e):
+        if isinstance(e, events.EndIteration):
+            raise ChaosKilled("simulated process death")
+
+    with pytest.raises(ChaosKilled):
+        tr.train(make_reader(), num_passes=1, event_handler=kill,
+                 auto_resume=False)
+    assert released == []
+
+    # and a clean run releases nothing
+    tr.train(make_reader(), num_passes=1, auto_resume=False)
+    assert released == []
+
+
+def test_release_lease_over_rpc():
+    """release_lease must be reachable through the real RPC stack (the
+    allowlist gap would reject it server-side, and trainer.py's unwind
+    path only WARNS on a failed release — the livelock it exists to fix
+    would silently come back)."""
+    svc = MasterService(chunks_per_task=1)
+    svc.set_dataset(["a", "b"])
+    server = MasterServer(svc).start()
+    try:
+        c = MasterClient(server.addr, trainer_id="tr-A", heartbeat_s=0.0)
+        _, t = c.get_task(0)
+        c.task_finished(t.id, defer_commit=True)
+        _, t2 = c.get_task(0)            # in flight at the unwind
+        assert c.release_lease() == 2
+        assert not svc.uncommitted.get("tr-A")
+        assert [x.id for x in svc.todo] == [t.id, t2.id]
+        c.close()
+    finally:
+        server.stop()
+
+
+def test_completed_pass_ledger_unblocks_roll_after_lost_commit():
+    """End-of-pass checkpoint durable, commit RPC lost to the crash: the
+    restarted trainer (STABLE id — its own polling renews the liveness
+    that would otherwise expire the buffer) re-marks the completed
+    pass's parked finishes done from the restored ledger, so the
+    durability-gated roll proceeds instead of livelocking, and nothing
+    is retrained on parameters that already contain it."""
+    svc = MasterService(chunks_per_task=1, timeout_s=60.0,
+                        trainer_timeout_s=60.0)
+    server = MasterServer(svc).start()
+    try:
+        c1 = MasterClient(server.addr, trainer_id="tr-stable",
+                          heartbeat_s=0.0)
+        c1.set_dataset(["a", "b"])
+        r1 = master_reader(c1, lambda ch: [ch])
+        r1.checkpoint_coupled = True     # a checkpointer owns commits
+        assert sorted(list(r1(0))) == ["a", "b"]
+        ledger = r1.ledger_state()       # what the end-of-pass save stored
+        assert ledger["pass"] == 0 and len(ledger["done"]) == 2
+        assert len(svc.uncommitted["tr-stable"]) == 2  # commit never landed
+        c1.close()                       # process dies
+
+        # new life, SAME trainer id, restored end-of-pass checkpoint
+        c2 = MasterClient(server.addr, trainer_id="tr-stable",
+                          heartbeat_s=0.0)
+        r2 = master_reader(c2, lambda ch: [ch])
+        r2.checkpoint_coupled = True
+        r2.restore_ledger(ledger)
+        assert r2.sync_pass(1) == 1
+        got, done = [], threading.Event()
+
+        def drain():
+            got.extend(r2(1))            # hangs forever without the fix
+            done.set()
+
+        t = threading.Thread(target=drain, daemon=True)
+        t.start()
+        assert done.wait(10), "pass roll livelocked on parked finishes"
+        assert sorted(got) == ["a", "b"] and svc.cur_pass == 1
+        # what sits parked now is PASS 1's own finishes (nothing commits
+        # them in this test) — no pass-0 copy was requeued or retrained
+        assert [t.epoch for t in svc.uncommitted["tr-stable"]] == [1, 1]
+        c2.close()
+    finally:
+        server.stop()
+
+
+def test_duck_typed_checkpointer_without_on_save():
+    """train() must tolerate a minimal checkpointer exposing only
+    maybe_save()/restore() — the unwinding finally's coupling teardown
+    dereferences on_save and would AttributeError (masking the run's
+    real outcome) if accessed unguarded."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.config import dsl
+    from paddle_tpu.core.argument import Argument
+    from paddle_tpu.optim import Adam
+    from paddle_tpu.trainer import SGD
+
+    rng = np.random.RandomState(11)
+    feeds = [{"x": Argument(value=jnp.asarray(
+                  rng.randn(4, 6).astype(np.float32))),
+              "label": Argument(value=jnp.asarray(
+                  rng.randint(0, 3, size=4).astype(np.int32)))}
+             for _ in range(2)]
+
+    dsl.reset()
+    x = dsl.data(name="x", size=6)
+    lbl = dsl.data(name="label", size=3)
+    out = dsl.fc(input=x, size=3, act="softmax")
+    cost = dsl.classification_cost(input=out, label=lbl)
+    tr = SGD(cost=cost, update_equation=Adam(learning_rate=1e-3), seed=1)
+
+    class MinimalCheckpointer:
+        saves = 0
+
+        def maybe_save(self, *a, **k):
+            type(self).saves += 1
+            return False
+
+        def restore(self):
+            return None
+
+    tr.train(lambda: iter(feeds), num_passes=1,
+             checkpointer=MinimalCheckpointer())
+    assert MinimalCheckpointer.saves >= 1
+
+
+def test_client_backoff_deterministic_and_no_terminal_sleep():
+    """Retry delays are value-seeded from (trainer_id, method, attempt)
+    — no shared jitter stream for the training and heartbeat threads to
+    interleave on, so chaos timing reproduces — and a terminal failure
+    raises immediately instead of sleeping one last dead backoff."""
+    a = MasterClient(("127.0.0.1", 1), trainer_id="tr-X", heartbeat_s=0.0)
+    b = MasterClient(("127.0.0.1", 1), trainer_id="tr-X", heartbeat_s=0.0)
+    assert [a._backoff(n, "get_task") for n in range(4)] == \
+        [b._backoff(n, "get_task") for n in range(4)]
+    assert a._backoff(0, "get_task") != a._backoff(0, "heartbeat")
+    # retries=1 → single attempt; a huge retry_delay would show up as a
+    # terminal sleep if one happened
+    c = MasterClient(("127.0.0.1", 1), retries=1, retry_delay=30.0,
+                     connect_timeout=0.2, heartbeat_s=0.0)
+    t0 = time.perf_counter()
+    with pytest.raises(ConnectionError):
+        c.call("current_pass")
+    assert time.perf_counter() - t0 < 5.0
+
+
+def test_fresh_boot_requeues_previous_lifes_parked_finishes():
+    """A trainer that dies before its FIRST durable checkpoint leaves
+    finishes parked under its id; the restarted process (stable id, no
+    checkpoint to restore) arms an EMPTY ledger whose reconcile requeues
+    that lost work — it was trained into parameters that no longer
+    exist — instead of letting it sit parked under a liveness the new
+    life's own polling renews (livelock), or worse, letting an
+    end-of-pass commit mark it done untrained (silent data loss: the
+    seed-11 soak schedule)."""
+    svc = MasterService(chunks_per_task=1, timeout_s=60.0,
+                        trainer_timeout_s=60.0)
+    server = MasterServer(svc).start()
+    try:
+        c1 = MasterClient(server.addr, trainer_id="tr-stable",
+                          heartbeat_s=0.0)
+        c1.set_dataset(["a", "b", "c"])
+        _, t0 = c1.get_task(0)
+        c1.task_finished(t0.id, defer_commit=True)   # parked, no commit
+        c1.close()                                   # dies pre-checkpoint
+
+        c2 = MasterClient(server.addr, trainer_id="tr-stable",
+                          heartbeat_s=0.0)
+        r2 = master_reader(c2, lambda ch: [ch])
+        r2.checkpoint_coupled = True
+        # what SGD.train arms on a fresh start (restore() found nothing)
+        r2.restore_ledger({"pass": 0, "done": [], "inflight": None,
+                           "offset": 0})
+        got, done = [], threading.Event()
+
+        def drain():
+            got.extend(r2(0))
+            done.set()
+
+        t = threading.Thread(target=drain, daemon=True)
+        t.start()
+        assert done.wait(10), "fresh boot starved on its own parked work"
+        # the lost task was REQUEUED and retrained, not marked done
+        assert sorted(got) == ["a", "b", "c"]
+        assert len(svc.done) + len(svc.uncommitted["tr-stable"]) >= 3
+        c2.close()
+    finally:
+        server.stop()
+
+
+def test_failed_exchange_tears_down_socket_before_lock_release():
+    """MasterClient.call must close a desynced socket INSIDE the same
+    lock hold as the failed exchange: released with the stale response
+    still buffered, the heartbeat thread queued on the lock would run
+    its own request on that socket and read the previous call's
+    response as its own, cross-wiring RPC results between threads."""
+    from paddle_tpu.dist import master as master_mod
+
+    svc = MasterService(chunks_per_task=1)
+    svc.set_dataset(["a"])
+    server = MasterServer(svc).start()
+    try:
+        c = MasterClient(server.addr, trainer_id="tr-desync",
+                         heartbeat_s=0.0, retry_delay=0.01)
+        # record whether the socket was torn down by the time each lock
+        # hold ENDS — the instant a queued heartbeat thread could get in
+        sock_at_release = []
+        inner = c._lock
+
+        class RecordingLock:
+            def __enter__(self):
+                inner.acquire()
+
+            def __exit__(self, *exc):
+                sock_at_release.append(c._sock is None)
+                inner.release()
+
+        c._lock = RecordingLock()
+
+        real_recv = master_mod._recv_msg
+        fails = {"n": 1}
+
+        def flaky_recv(sock):
+            if fails["n"]:
+                fails["n"] -= 1
+                raise ConnectionError("injected pre-read drop")
+            return real_recv(sock)
+
+        master_mod._recv_msg = flaky_recv
+        try:
+            _, t = c.get_task(0)          # fails once, redials, succeeds
+        finally:
+            master_mod._recv_msg = real_recv
+        assert t.id == 0
+        # first lock hold = the failed exchange: socket already None at
+        # release; second = the successful redial exchange
+        assert sock_at_release[0] is True
+        c._lock = inner
+        c.close()
+    finally:
+        server.stop()
+
+
+def test_clean_run_flush_error_still_releases_lease(tmp_path):
+    """A clean run whose final flush() raises (dead background writer)
+    must still release the master lease: the process and its heartbeat
+    live on, so liveness expiry can never free the parked uncommitted
+    finishes whose commit the dead writer just lost — without the
+    release they gate the master's pass roll forever. The flush error
+    itself must still surface to the caller."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.config import dsl
+    from paddle_tpu.core.argument import Argument
+    from paddle_tpu.optim import Adam
+    from paddle_tpu.trainer import SGD
+
+    rng = np.random.RandomState(11)
+    feeds = [{"x": Argument(value=jnp.asarray(
+                  rng.randn(4, 6).astype(np.float32))),
+              "label": Argument(value=jnp.asarray(
+                  rng.randint(0, 3, size=4).astype(np.int32)))}
+             for _ in range(3)]
+
+    dsl.reset()
+    x = dsl.data(name="x", size=6)
+    lbl = dsl.data(name="label", size=3)
+    out = dsl.fc(input=x, size=3, act="softmax")
+    cost = dsl.classification_cost(input=out, label=lbl)
+    tr = SGD(cost=cost, update_equation=Adam(learning_rate=1e-3), seed=1)
+
+    ck = Checkpointer(str(tmp_path), background=True)
+
+    def broken_flush():
+        raise RuntimeError("background checkpoint writer failed")
+
+    ck.flush = broken_flush
+
+    released = []
+
+    def make_reader():
+        def reader():
+            return iter(feeds)
+        reader.release_lease = lambda: released.append(1)
+        return reader
+
+    with pytest.raises(RuntimeError,
+                       match="background checkpoint writer failed"):
+        tr.train(make_reader(), num_passes=1, checkpointer=ck,
+                 auto_resume=False)
+    assert released == [1]
+
+
+def test_straggle_after_none_disables_speculative_redispatch():
+    """An explicit ``straggle_after_s=None`` must mean DISABLED (tasks
+    whose load_chunk has side effects can never run twice), not silently
+    alias the timeout/2 default."""
+    svc = MasterService(chunks_per_task=1, timeout_s=3600.0,
+                        straggle_after_s=None)
+    svc.set_dataset(["a"])
+    _, t1 = svc.get_task(0, trainer_id="A")
+    # backdate the straggle clock an hour: ANY finite threshold would
+    # re-serve this lease (the deadline itself has not expired) —
+    # disabled must still answer wait
+    svc._dispatch_t[t1["id"]] = time.monotonic() - 3599.0
+    assert svc.get_task(0, trainer_id="B") == ("wait", None)
+    # and the not-passed default (timeout_s/2) still straggles, via the
+    # straggler path proper — the lease deadline is far from expiry
+    svc2 = MasterService(chunks_per_task=1, timeout_s=3600.0)
+    svc2.set_dataset(["a"])
+    _, u1 = svc2.get_task(0, trainer_id="A")
+    svc2._dispatch_t[u1["id"]] = time.monotonic() - 1801.0
+    got = svc2.get_task(0, trainer_id="B")
+    assert got[0] == "task" and got[1]["id"] == u1["id"]
+
+
+def test_restore_ledger_armed_without_auto_resume_or_checkpointer():
+    """The ledger reconcile (resume_lease) must arm for EVERY pass-aware
+    reader — a --no-auto_resume restart (or a run with no checkpointer
+    at all) under a stable trainer id otherwise livelocks the master's
+    durability-gated pass roll on a previous life's parked finishes,
+    which this very process's polling keeps alive."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.config import dsl
+    from paddle_tpu.core.argument import Argument
+    from paddle_tpu.optim import Adam
+    from paddle_tpu.trainer import SGD
+
+    rng = np.random.RandomState(3)
+    feeds = [{"x": Argument(value=jnp.asarray(
+                  rng.randn(4, 6).astype(np.float32))),
+              "label": Argument(value=jnp.asarray(
+                  rng.randint(0, 3, size=4).astype(np.int32)))}
+             for _ in range(2)]
+
+    dsl.reset()
+    x = dsl.data(name="x", size=6)
+    lbl = dsl.data(name="label", size=3)
+    out = dsl.fc(input=x, size=3, act="softmax")
+    cost = dsl.classification_cost(input=out, label=lbl)
+    tr = SGD(cost=cost, update_equation=Adam(learning_rate=1e-3), seed=1)
+
+    armed = []
+
+    def make_reader():
+        def reader(pass_id):          # pass-aware readers take the pass
+            return iter(feeds)
+        reader.pass_aware = True
+        reader.restore_ledger = lambda led: armed.append(led)
+        return reader
+
+    empty = {"pass": 0, "done": [], "inflight": None, "offset": 0}
+
+    tr.train(make_reader(), num_passes=1)            # no checkpointer
+    assert armed == [empty]
+
+    armed.clear()
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        tr.train(make_reader(), num_passes=1,
+                 checkpointer=Checkpointer(d), auto_resume=False)
+    assert armed == [empty]
+
+    # but ONCE per reader: a second train() on the SAME reader is a
+    # continuation, not a restarted previous life — an empty
+    # re-reconcile would requeue (and silently retrain) everything this
+    # very process already finished in the current pass
+    armed.clear()
+    rd = make_reader()
+    tr.train(rd, num_passes=1)
+    tr.train(rd, num_passes=1)
+    assert armed == [empty]
+
+
+def test_stale_pass_liveness_dispatch_stays_out_of_ledger():
+    """A task the master serves ACROSS a pass boundary (liveness repair:
+    its owner died, no trainer at that pass remains) must not enter the
+    serving reader's pass ledger — recorded there, a later crash-resume
+    would mark the recycled next-pass copy done for a pass that never
+    trained it. Its finish commits immediately (parked, no checkpoint of
+    ours would ever name it and the durability gate would livelock)."""
+    svc = MasterService(chunks_per_task=1, timeout_s=60.0,
+                        trainer_timeout_s=0.05, straggle_after_s=None)
+    svc.set_dataset(["a", "b"])
+    server = MasterServer(svc).start()
+    try:
+        cS = MasterClient(server.addr, trainer_id="S", heartbeat_s=0.0)
+        cT = MasterClient(server.addr, trainer_id="T", heartbeat_s=0.0)
+        _, t0 = cS.get_task(0)                       # task 0 → S
+        cS.task_finished(t0.id, defer_commit=True)   # parked under S
+        cS.close()                                   # S goes silent
+
+        r = master_reader(cT, lambda ch: [ch])
+        r.checkpoint_coupled = True                  # no self-commit
+        assert list(r(0)) == ["b"]                   # T's pass 0: task 1
+        t1_id = r.ledger_state()["done"][0]
+
+        time.sleep(0.06)        # S's liveness expires at the next poll:
+        # its parked finish (task 0, epoch 0) requeues into pass 0's
+        # todo while T is already requesting pass 1
+        gen = r(1)
+        assert next(gen) == "a"                      # the stale repair
+        snap = r.ledger_state()
+        # honest ledger: the foreign-epoch task claims NOTHING
+        assert snap["done"] == [] and snap["inflight"] is None
+        # unblock the roll: T's own pass-0 finish commits (the durable
+        # end-of-pass checkpoint's on_save in a real run)
+        cT.commit_tasks(task_ids=[t1_id])
+        assert sorted(gen) == ["a", "b"]             # pass 1 in full
+        final = r.ledger_state()
+        assert sorted(final["done"]) == [0, 1]       # pass 1's own work
+        # the roll happened: the repair finish committed immediately
+        # instead of parking under T (where no checkpoint would ever
+        # name it) and jamming the durability gate
+        assert svc.cur_pass == 1
+        parked = [t for ts in svc.uncommitted.values() for t in ts]
+        assert all(t.epoch == 1 for t in parked)     # only pass-1's own
+        cT.close()
+    finally:
+        server.stop()
+
+
+def test_simultaneous_expiries_requeue_in_dispatch_order():
+    """Two leases expiring in the same _check_timeouts sweep must come
+    back in their DISPATCH order — per-task front-inserts would reverse
+    them, and a survivor would retrain the pass in inverted order,
+    diverging from the uninterrupted run."""
+    svc = MasterService(chunks_per_task=1, timeout_s=0.01,
+                        straggle_after_s=None)
+    svc.set_dataset(["a", "b", "c"])
+    _, t0 = svc.get_task(0, trainer_id="A")
+    _, t1 = svc.get_task(0, trainer_id="B")
+    time.sleep(0.02)
+    svc._check_timeouts()
+    assert [t.id for t in svc.todo] == [t0["id"], t1["id"], 2]
+
+
+def test_stateobj_restore_rejects_foreign_globals(tmp_path):
+    """The stateobj:: carried-state pickles restore through a restricted
+    unpickler: numpy arrays and plain containers round-trip, but a
+    crafted checkpoint referencing any other global (the MD5 sidecar is
+    integrity, not authenticity) must refuse to load, not execute."""
+    import pickle
+
+    from paddle_tpu.trainer.checkpoint import (load_checkpoint,
+                                               snapshot_arrays,
+                                               write_snapshot)
+
+    import ml_dtypes
+
+    carried = {"h": np.arange(6, dtype=np.float32).reshape(2, 3),
+               # bf16: mixed-precision carried state pickles a reference
+               # to its ml_dtypes class — must stay restorable
+               "hb": np.ones((2, 2), dtype=ml_dtypes.bfloat16),
+               "nest": [(np.float32(1.5), {"k": np.ones(2)})]}
+    arrays = snapshot_arrays({}, None, {"carried": carried})
+    p = write_snapshot(str(tmp_path / "ok"), arrays, {})
+    _, _, state = load_checkpoint(p)
+    np.testing.assert_array_equal(state["carried"]["h"], carried["h"])
+    assert state["carried"]["hb"].dtype == ml_dtypes.bfloat16
+    np.testing.assert_array_equal(state["carried"]["nest"][0][1]["k"],
+                                  np.ones(2))
+
+    evil = np.frombuffer(pickle.dumps(os.system), dtype=np.uint8)
+    p2 = write_snapshot(str(tmp_path / "evil"),
+                        {"stateobj::carried": evil}, {})
+    with pytest.raises(pickle.UnpicklingError, match="system"):
+        load_checkpoint(p2)
+
+
+def test_background_writer_preserves_chaos_kill_class(tmp_path):
+    """A ChaosKilled raised inside a background write must surface AS
+    ChaosKilled at the next save/flush — wrapped in RuntimeError, the
+    step loop's `except Exception` recovery would survive a kill the
+    plan scheduled, and kill-at-checkpoint schedules would not reproduce
+    between sync and background modes."""
+    from paddle_tpu.testing.chaos import ChaosKilled
+
+    ck = Checkpointer(str(tmp_path), background=True)
+
+    def boom(*a, **k):
+        raise ChaosKilled("chaos: kill at checkpoint")
+
+    ck._write = boom
+    ck.save({"w": np.zeros(2)}, None, pass_id=0, batch_id=1)
+    ck._q.join()
+    with pytest.raises(ChaosKilled):
+        ck.flush()
+    # a PLAIN writer error still surfaces as the documented RuntimeError
+    def fail(*a, **k):
+        raise IOError("disk full")
+
+    ck._write = fail
+    ck.save({"w": np.zeros(2)}, None, pass_id=0, batch_id=2)
+    ck._q.join()
+    with pytest.raises(RuntimeError, match="background checkpoint"):
+        ck.flush()

@@ -1,0 +1,173 @@
+"""ServingClient retry/backoff (satellite): opt-in ``retries=`` with
+capped jittered backoff that honors the server's 429 drain estimate
+(``Overloaded.retry_after_ms``) and re-sends idempotent requests on a
+connection reset — HTTP-tested against a scripted stdlib server, so the
+wire behavior (not a mock) is what's pinned."""
+
+import json
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from paddle_tpu.serving.client import ServingClient
+from paddle_tpu.serving.errors import (BadRequest, DeadlineExceeded,
+                                       Overloaded)
+
+
+class _Script(BaseHTTPRequestHandler):
+    """Answers from the server's scripted response list; records hits."""
+
+    def _respond(self):
+        srv = self.server
+        srv.hits.append(self.path)
+        if not srv.script:
+            action = ("200", {"outputs": {}})
+        else:
+            action = srv.script.pop(0)
+        kind, payload = action
+        if kind == "reset":
+            # simulate a worker crash mid-response: raw RST, no HTTP
+            self.connection.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                                       b"\x01\x00\x00\x00\x00\x00\x00\x00")
+            self.connection.close()
+            return
+        status = int(kind)
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    do_POST = _respond
+    do_GET = _respond
+
+    def log_message(self, *a):
+        pass
+
+
+def _server(script):
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _Script)
+    srv.script = list(script)
+    srv.hits = []
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def _overloaded(retry_after_ms):
+    return {"error": {"code": "overloaded", "message": "shed",
+                      "retry_after_ms": retry_after_ms}}
+
+
+def test_retries_off_by_default_429_raises():
+    srv = _server([("429", _overloaded(5.0))])
+    try:
+        c = ServingClient(port=srv.server_address[1])
+        with pytest.raises(Overloaded):
+            c.score([0.0])
+        assert len(srv.hits) == 1
+    finally:
+        srv.shutdown()
+
+
+def test_retry_honors_retry_after_ms_on_429():
+    srv = _server([("429", _overloaded(40.0)),
+                   ("429", _overloaded(40.0)),
+                   ("200", {"outputs": {"out": [1.0]}})])
+    try:
+        c = ServingClient(port=srv.server_address[1], retries=3,
+                          backoff_seed=0)
+        t0 = time.perf_counter()
+        out = c.score([0.0])
+        waited = time.perf_counter() - t0
+        assert out["outputs"] == {"out": [1.0]}
+        assert len(srv.hits) == 3
+        # two waits, each jittered UP in [1.0, 1.5] x 40 ms — never
+        # below the server's drain estimate (an early re-send would hit
+        # the still-full queue)
+        assert 0.08 <= waited < 1.0
+    finally:
+        srv.shutdown()
+
+
+def test_retry_gives_up_after_budget():
+    srv = _server([("429", _overloaded(1.0))] * 10)
+    try:
+        c = ServingClient(port=srv.server_address[1], retries=2,
+                          backoff_seed=0)
+        with pytest.raises(Overloaded):
+            c.score([0.0])
+        assert len(srv.hits) == 3  # 1 try + 2 retries
+    finally:
+        srv.shutdown()
+
+
+def test_retry_on_connection_reset_idempotent_resend():
+    srv = _server([("reset", None),
+                   ("200", {"outputs": {"out": [2.0]}})])
+    try:
+        c = ServingClient(port=srv.server_address[1], retries=2,
+                          backoff_base_ms=1.0, backoff_seed=0)
+        out = c.score([0.0])
+        assert out["outputs"] == {"out": [2.0]}
+        # at least one re-send happened (no-retry would surface the
+        # reset, hits == 1); the EXACT count races with when the
+        # handler thread records a hit vs when the client sees the RST
+        # on a loaded host, so >= not ==
+        assert len(srv.hits) >= 2
+    finally:
+        srv.shutdown()
+
+
+def test_connection_refused_retries_then_surfaces():
+    # an unbound port: connection refused immediately, every attempt
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    c = ServingClient(port=port, retries=2, backoff_base_ms=1.0,
+                      backoff_seed=0)
+    with pytest.raises(OSError):
+        c.healthz()
+
+
+def test_non_retryable_errors_fail_fast():
+    """400 and 504 are not retried: the same request would fail the
+    same way (and a passed deadline cannot un-pass)."""
+    srv = _server([("400", {"error": {"code": "bad_request",
+                                      "message": "off menu",
+                                      "allowed": {"beam_size": [4]}}}),
+                   ("504", {"error": {"code": "deadline_exceeded",
+                                      "message": "late"}})])
+    try:
+        c = ServingClient(port=srv.server_address[1], retries=5,
+                          backoff_seed=0)
+        with pytest.raises(BadRequest) as ei:
+            c.score([0.0])
+        assert ei.value.allowed == {"beam_size": [4]}
+        with pytest.raises(DeadlineExceeded):
+            c.score([0.0])
+        assert len(srv.hits) == 2  # one hit each, zero retries
+    finally:
+        srv.shutdown()
+
+
+def test_retry_after_ms_not_clamped_by_client_cap():
+    """The server's 429 drain estimate is honored even when it exceeds
+    the client's own exponential-backoff cap — clamping it would re-send
+    into a still-full queue and burn the retry budget on fresh 429s."""
+    srv = _server([("429", _overloaded(120.0)),
+                   ("200", {"outputs": {"out": [1.0]}})])
+    try:
+        c = ServingClient(port=srv.server_address[1], retries=1,
+                          backoff_cap_ms=5.0, backoff_seed=0)
+        t0 = time.perf_counter()
+        out = c.score([0.0])
+        waited = time.perf_counter() - t0
+        assert out["outputs"] == {"out": [1.0]}
+        # jitter floor is 1.0 x 120 ms, far above the 5 ms client cap
+        assert waited >= 0.12
+    finally:
+        srv.shutdown()
