@@ -1,0 +1,30 @@
+"""graftlint: framework-aware static analysis for paddle_tpu.
+
+Three passes (``python -m paddle_tpu.analysis`` runs them all):
+
+1. **AST invariant lints** (``ast_lints.py``) — pure source analysis
+   over ``paddle_tpu/``, ``tests/``, ``tools/``: closure-captured
+   arrays in jitted functions, masks cast below f32, ``jnp.pad`` in
+   bit-exact pack paths, unguarded persistent jits on hot paths, broad
+   ``pkill -f`` patterns, and layer-grad-matrix coverage.
+2. **Jaxpr/lowering audit** (``jaxpr_audit.py``) — traces the driver
+   entry (``__graft_entry__.entry()``), a representative train step,
+   and the serving warm path; asserts no model-sized embedded
+   constants, full donation of donatable buffers, and mask dtypes
+   surviving as f32 through the traced program.
+3. **Lock-order checker** (``lockorder.py``) — a static
+   lock-acquisition graph over the threaded modules (serving batcher,
+   master, checkpoint writers, prefetch) with cycle detection; the
+   runtime twin is ``paddle_tpu.testing.lockcheck``.
+
+Plus the ``BENCH_*.json`` artifact schema check (``bench_schema.py``)
+that ``tools/lint.py`` runs alongside.
+
+Findings carry file:line + stable rule ids (``findings.RULES``); the
+suppression policy and rule catalog live in ``docs/static_analysis.md``.
+``analysis/baseline.toml`` may park known findings — it must stay empty
+or shrink (enforced by ``tests/test_lint_clean.py``).
+"""
+
+from paddle_tpu.analysis.findings import (Finding, RULES,  # noqa: F401
+                                          format_report, rule_counts)

@@ -1,0 +1,144 @@
+"""CLI: ``python -m paddle_tpu.analysis`` — run the three graftlint
+passes (plus the bench-artifact schema check) over the repo.
+
+Exit status 0 = clean; 1 = findings; 2 = analysis itself failed.
+``tools/lint.py`` is the thin CI wrapper over this module.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from typing import List
+
+from paddle_tpu.analysis.baseline import apply_baseline, load_baseline
+from paddle_tpu.analysis.findings import (RULE_BY_NAME, RULES, Finding,
+                                          format_report)
+
+
+from paddle_tpu.analysis._astutil import repo_root
+
+
+def run(argv: List[str] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="graftlint: framework-aware static analysis "
+                    "(AST invariant lints, jaxpr/donation audits, "
+                    "lock-order checker, bench-artifact schema)")
+    ap.add_argument("--root", default=repo_root())
+    ap.add_argument("--skip-ast", action="store_true")
+    ap.add_argument("--skip-jaxpr", action="store_true",
+                    help="skip the trace-time audits (the slow pass)")
+    ap.add_argument("--skip-locks", action="store_true")
+    ap.add_argument("--skip-schema", action="store_true")
+    ap.add_argument("--no-entry", action="store_true",
+                    help="jaxpr pass without the flagship "
+                         "__graft_entry__ build (~20s on 1 core)")
+    ap.add_argument("--describe-locks", action="store_true",
+                    help="print the lock graph even when clean")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline.toml path (default: the package's)")
+    args = ap.parse_args(argv)
+
+    findings: List[Finding] = []
+    inline_suppressed = 0
+    # rule bands whose pass actually ran — stale-baseline detection is
+    # scoped to these, or a baselined PT2xx entry would read as STALE
+    # under --skip-jaxpr and the fast/full paths could never both pass
+    ran_prefixes: List[str] = []
+    t0 = time.time()
+
+    if not args.skip_jaxpr:
+        # force the CPU platform BEFORE any jax import: the audit
+        # traces real programs, and on the TPU host a wedged axon
+        # tunnel would otherwise hang the lint for hours (CLAUDE.md)
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:  # noqa: BLE001 — pass 2 will surface it
+            pass
+
+    if not args.skip_ast:
+        from paddle_tpu.analysis.ast_lints import run_pass1
+        fs, sup = run_pass1(args.root)
+        print(f"[pass 1] AST invariant lints: {len(fs)} findings "
+              f"({sup} inline-suppressed)")
+        findings.extend(fs)
+        inline_suppressed += sup
+        ran_prefixes.append("PT1")
+
+    if not args.skip_locks:
+        from paddle_tpu.analysis.lockorder import run_pass3
+        fs, checker = run_pass3(args.root)
+        print(f"[pass 3] lock-order: {len(checker.locks)} locks, "
+              f"{len(checker.edges)} order edges, {len(fs)} findings")
+        if args.describe_locks:
+            print(checker.describe())
+        findings.extend(fs)
+        ran_prefixes.append("PT3")
+
+    if not args.skip_schema:
+        from paddle_tpu.analysis.bench_schema import run_schema_check
+        fs = run_schema_check(args.root)
+        print(f"[schema] BENCH_*.json: {len(fs)} findings")
+        findings.extend(fs)
+        ran_prefixes.append("PT4")
+
+    if not args.skip_jaxpr:
+        from paddle_tpu.analysis.jaxpr_audit import run_pass2
+        print("[pass 2] jaxpr/lowering audits:")
+        try:
+            fs = run_pass2(args.root, log=print,
+                           include_entry=not args.no_entry)
+        except Exception as e:  # noqa: BLE001 — surfaced as exit 2
+            print(f"[pass 2] AUDIT FAILED to run: {e!r}")
+            if findings:
+                # the crash must not bury what the other passes found
+                print(format_report(
+                    findings, "findings collected before the crash:"))
+            return 2
+        print(f"[pass 2] {len(fs)} findings")
+        findings.extend(fs)
+        ran_prefixes.append("PT2")
+
+    try:
+        entries = load_baseline(args.baseline)
+    except ValueError as e:
+        print(f"baseline error: {e}")
+        return 2
+    findings, baselined, stale = apply_baseline(findings, entries)
+    from paddle_tpu.analysis.baseline import default_baseline_path
+    baseline_rel = os.path.relpath(
+        args.baseline or default_baseline_path(), args.root)
+    for e in stale:
+        rid = RULE_BY_NAME.get(e.rule, e.rule)
+        if rid in RULES and not any(rid.startswith(p)
+                                    for p in ran_prefixes):
+            continue  # its pass was skipped this run — not evidence
+        # unknown/typo'd rules fall through: they can never match any
+        # pass's findings, so they are stale on EVERY run and must be
+        # reported, or they sit in the baseline forever unexamined
+        findings.append(Finding(
+            rid, baseline_rel, 1,
+            f"STALE baseline entry (rule={e.rule} path={e.path!r} "
+            f"line={e.line}) matches nothing — delete it (the "
+            "baseline only shrinks)"))
+
+    dt = time.time() - t0
+    print(f"\ngraftlint: {len(findings)} findings, "
+          f"{baselined} baselined, {inline_suppressed} "
+          f"inline-suppressed ({dt:.1f}s)")
+    if findings:
+        print(format_report(findings))
+        return 1
+    print("rule catalog: " + ", ".join(
+        f"{rid}({name})" for rid, (name, _) in sorted(RULES.items())))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run())

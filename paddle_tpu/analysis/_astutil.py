@@ -1,0 +1,28 @@
+"""Shared helpers for the analysis passes (one copy — ast_lints,
+lockorder, jaxpr_audit and the CLI must not drift)."""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None (calls,
+    subscripts and literals are not simple names)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def repo_root() -> str:
+    """The repository root this package lives in (…/paddle_tpu/analysis
+    → two packages up)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))

@@ -1,0 +1,115 @@
+"""Baseline (suppression file) handling for graftlint.
+
+``paddle_tpu/analysis/baseline.toml`` may park known findings so a rule
+can land before its last violation is fixed. Policy (enforced by
+``tests/test_lint_clean.py``): **the baseline must stay empty or
+shrink** — every entry carries a reason and an owner-visible rule id,
+and new violations can never be baselined silently (the lint fails
+first).
+
+Format (a TOML subset parsed here so the py3.10 container needs no
+third-party toml package):
+
+    [[suppress]]
+    rule = "PT104"
+    path = "paddle_tpu/models/gan.py"
+    line = 78            # optional: any line in the file when absent
+    reason = "why this is parked, and the issue that will unpark it"
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from paddle_tpu.analysis.findings import RULE_BY_NAME, Finding
+
+_KV_RE = re.compile(
+    r"^\s*([A-Za-z_][A-Za-z0-9_]*)\s*=\s*(\"([^\"]*)\"|'([^']*)'|(\d+))"
+    r"\s*(#.*)?$")
+
+
+class BaselineEntry:
+    __slots__ = ("rule", "path", "line", "reason")
+
+    def __init__(self, rule: str = "", path: str = "",
+                 line: Optional[int] = None, reason: str = ""):
+        self.rule = rule
+        self.path = path
+        self.line = line
+        self.reason = reason
+
+    def matches(self, f: Finding) -> bool:
+        rule = RULE_BY_NAME.get(self.rule, self.rule)
+        if rule != f.rule:
+            return False
+        if self.path and self.path != f.path:
+            return False
+        if self.line is not None and self.line != f.line:
+            return False
+        return True
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.toml")
+
+
+def load_baseline(path: Optional[str] = None) -> List[BaselineEntry]:
+    path = path or default_baseline_path()
+    if not os.path.exists(path):
+        return []
+    entries: List[BaselineEntry] = []
+    current: Optional[BaselineEntry] = None
+    for raw in open(path, encoding="utf-8"):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line == "[[suppress]]":
+            current = BaselineEntry()
+            entries.append(current)
+            continue
+        m = _KV_RE.match(raw)
+        if m and current is not None:
+            key = m.group(1)
+            val = m.group(3) if m.group(3) is not None else (
+                m.group(4) if m.group(4) is not None else m.group(5))
+            if key == "line":
+                current.line = int(val)
+            elif key in ("rule", "path", "reason"):
+                setattr(current, key, val)
+            continue
+        if m and current is None:
+            raise ValueError(
+                f"baseline {path}: key outside a [[suppress]] table: "
+                f"{line!r}")
+        raise ValueError(f"baseline {path}: unparseable line {line!r}")
+    for e in entries:
+        if not e.rule or not e.reason:
+            raise ValueError(
+                f"baseline {path}: every [[suppress]] needs rule= and "
+                "reason=")
+    return entries
+
+
+def apply_baseline(findings: List[Finding],
+                   entries: List[BaselineEntry]
+                   ) -> Tuple[List[Finding], int, List[BaselineEntry]]:
+    """(kept-findings, suppressed-count, stale-entries). A stale entry
+    matches nothing — it must be deleted (the baseline only shrinks)."""
+    used = [False] * len(entries)
+    kept: List[Finding] = []
+    suppressed = 0
+    for f in findings:
+        hit = False
+        for i, e in enumerate(entries):
+            if e.matches(f):
+                used[i] = True
+                hit = True
+        if hit:
+            suppressed += 1
+        else:
+            kept.append(f)
+    stale = [e for i, e in enumerate(entries) if not used[i]]
+    return kept, suppressed, stale

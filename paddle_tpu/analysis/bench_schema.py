@@ -1,0 +1,119 @@
+"""``BENCH_*.json`` artifact schema check (PT401).
+
+Bench artifacts are the perf evidence trail (one JSON object per line /
+file, per-metric best-of structure, CLAUDE.md's interleaved best-of-R
+discipline). A malformed artifact — truncated JSON, a NaN ratio, an
+A/B metric missing its sides — should fail at *lint* time, not at
+ROADMAP-review time when the run that produced it is long gone.
+
+Recognized shapes (all are real generations of bench output in this
+repo):
+
+- **metric style** (r07+, also BENCH_LIVE): ``{"metric": str,
+  "platform": str, ...}``; every ``*_vs_*`` ratio key must be a finite
+  number (or null when a side was skipped), and both sides of an A/B
+  must be present when the ratio is.
+- **harness style** (r01–r05): ``{"n": ..., "cmd": str, "rc": int,
+  ...}``.
+- **watcher style** (r06): ``{"round": ..., "cmd": ..., "parsed":
+  dict, ...}``.
+
+Everything must parse as one JSON object with finite numbers
+throughout (NaN/Infinity are emitted by a crashed averaging step and
+json.dumps happily writes them).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import math
+import os
+from typing import Any, List, Optional, Sequence
+
+from paddle_tpu.analysis.findings import Finding
+
+
+def _walk_numbers(obj: Any, path: str = "$"):
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            yield from _walk_numbers(v, f"{path}.{k}")
+    elif isinstance(obj, (list, tuple)):
+        for i, v in enumerate(obj):
+            yield from _walk_numbers(v, f"{path}[{i}]")
+    elif isinstance(obj, float):
+        yield path, obj
+
+
+def check_bench_file(path: str, rel: str) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def bad(msg: str):
+        findings.append(Finding("PT401", rel, 1, msg))
+
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError) as e:
+        bad(f"unparseable bench artifact: {e}")
+        return findings
+    if not isinstance(data, dict):
+        bad(f"bench artifact must be one JSON object, got "
+            f"{type(data).__name__}")
+        return findings
+    # shape identification
+    if "metric" in data:
+        if not (isinstance(data["metric"], str) and data["metric"]):
+            bad("'metric' must be a non-empty string")
+        if not isinstance(data.get("platform"), str):
+            bad("metric-style artifact missing 'platform'")
+        for key, val in data.items():
+            if "_vs_" not in key:
+                continue
+            if val is None:
+                continue  # a skipped side is recorded as null
+            if not isinstance(val, (int, float)) or isinstance(
+                    val, bool):
+                bad(f"ratio key {key!r} must be a number or null, got "
+                    f"{type(val).__name__}")
+                continue
+            # per-metric best-of structure: an A/B ratio needs both
+            # sides present so the best-of evidence is re-checkable
+            stem, _, b_side = key.partition("_vs_")
+            sides = [k for k in data
+                     if k != key and isinstance(
+                         data[k], (int, float))
+                     and (k.startswith(stem.rsplit("_", 1)[0])
+                          or b_side.split("_")[0] in k)]
+            if len(sides) < 2:
+                bad(f"A/B ratio {key!r} lacks its two sides in the "
+                    "artifact (per-metric best-of structure)")
+    elif "parsed" in data or "round" in data:
+        if not isinstance(data.get("cmd"), (str, list)):
+            bad("watcher-style artifact missing 'cmd'")
+        if "parsed" in data and not isinstance(data["parsed"],
+                                               (dict, type(None))):
+            bad("'parsed' must be an object")
+    elif "n" in data and "cmd" in data:
+        if "rc" in data and not isinstance(data["rc"], int):
+            bad("'rc' must be an int")
+    else:
+        bad("unrecognized bench artifact shape: expected metric-style "
+            "('metric'+'platform'), watcher-style ('parsed'/'round'), "
+            "or harness-style ('n'+'cmd') keys")
+    for npath, val in _walk_numbers(data):
+        if math.isnan(val) or math.isinf(val):
+            bad(f"non-finite number at {npath} (a crashed averaging "
+                "step wrote NaN/Infinity)")
+    return findings
+
+
+def run_schema_check(root: str,
+                     patterns: Sequence[str] = ("BENCH_*.json",)
+                     ) -> List[Finding]:
+    findings: List[Finding] = []
+    for pattern in patterns:
+        for path in sorted(glob.glob(os.path.join(root, pattern))):
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            findings.extend(check_bench_file(path, rel))
+    return findings

@@ -1,0 +1,122 @@
+"""Finding model + rule catalog for the graftlint static analyzer.
+
+Every rule encodes one hard-won repo invariant (the incident that earned
+it is recorded in ``docs/static_analysis.md``). Rule ids are stable —
+suppressions and the baseline reference them — and grouped by pass:
+
+- ``PT1xx`` — Pass 1, AST invariant lints (pure source analysis).
+- ``PT2xx`` — Pass 2, trace-time jaxpr/lowering audits.
+- ``PT3xx`` — Pass 3, lock-order analysis (static graph + runtime
+  tracker ``paddle_tpu/testing/lockcheck.py``).
+- ``PT4xx`` — artifact schema checks (``BENCH_*.json``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+# rule id -> (short-name, one-line description)
+RULES: Dict[str, Tuple[str, str]] = {
+    "PT100": (
+        "unparseable-source",
+        "a scanned source file failed to parse — no rule can check "
+        "what the AST pass cannot read (never baseline this; fix the "
+        "file)"),
+    "PT101": (
+        "jit-closure-capture",
+        "jitted function closure-captures an array-like binding; XLA "
+        "embeds closure captures as program constants (the measured "
+        "~4x/step deopt) — pass arrays as traced arguments"),
+    "PT102": (
+        "mask-bf16-cast",
+        "mask tensor cast to bfloat16/float16; masks are f32 COUNT data "
+        "(bf16 saturates at 256) and must never be down-cast"),
+    "PT103": (
+        "pad-in-bitexact-pack",
+        "jnp.pad inside a bit-exact pack path; a pad fused into "
+        "downstream elementwise math rounds real elements differently "
+        "on XLA:CPU — pack with concatenate/slices"),
+    "PT104": (
+        "unguarded-jit",
+        "persistent jax.jit in a hot-path module with no RecompileGuard "
+        "registration and no documented cache policy — silent recompile "
+        "thrash stays silent"),
+    "PT105": (
+        "broad-pkill",
+        "broad `pkill -f` pattern in tools; pkill -f matches your own "
+        "shell's command string (exit 144 self-kill)"),
+    "PT106": (
+        "layer-grad-matrix-row",
+        "registered layer type missing its row in "
+        "tests/test_layer_grad_matrix.py (static twin of "
+        "test_registry_fully_covered)"),
+    "PT201": (
+        "jaxpr-embedded-constant",
+        "traced program embeds a model-sized constant (closure-captured "
+        "device array became an XLA constant)"),
+    "PT202": (
+        "jaxpr-donation",
+        "a donatable input buffer is not donated/aliased in the lowered "
+        "program"),
+    "PT203": (
+        "jaxpr-mask-dtype",
+        "a mask input is converted below float32 inside the traced "
+        "program"),
+    "PT301": (
+        "lock-order-inversion",
+        "two locks are acquired in inconsistent order on different "
+        "paths (deadlock window)"),
+    "PT302": (
+        "lock-self-deadlock",
+        "a non-reentrant lock can be re-acquired while already held on "
+        "the same call path"),
+    "PT401": (
+        "bench-schema",
+        "BENCH_*.json artifact violates the bench schema (keys, "
+        "per-metric best-of structure, finite numbers)"),
+}
+
+# name -> id (suppression comments may use either spelling)
+RULE_BY_NAME = {name: rid for rid, (name, _) in RULES.items()}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str          # "PT101"
+    path: str          # repo-relative path
+    line: int
+    message: str
+
+    @property
+    def name(self) -> str:
+        # tolerant of unknown ids (e.g. a typo'd baseline entry being
+        # REPORTED as stale) — the report must never crash on the path
+        # whose job is telling the operator what to fix
+        return RULES.get(self.rule, (self.rule, ""))[0]
+
+    def __str__(self) -> str:
+        return (f"{self.path}:{self.line}: {self.rule}"
+                f"({self.name}): {self.message}")
+
+
+def rule_counts(findings: List[Finding]) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    return dict(sorted(counts.items()))
+
+
+def format_report(findings: List[Finding],
+                  header: Optional[str] = None) -> str:
+    lines = []
+    if header:
+        lines.append(header)
+    for f in findings:
+        lines.append(str(f))
+    if findings:
+        lines.append("")
+        lines.append("rule counts: " + ", ".join(
+            f"{rid}({RULES.get(rid, (rid, ''))[0]})={n}"
+            for rid, n in rule_counts(findings).items()))
+    return "\n".join(lines)

@@ -1,0 +1,422 @@
+"""Pass 2 — trace-time jaxpr/lowering audits.
+
+Static graphs are what make whole-program analysis tractable (the
+TensorFlow-paper argument; PAPERS.md), and jitted JAX gives us exactly
+that: every hot program in this repo is one traced, inspectable jaxpr.
+This pass traces the real programs — the driver entry
+(``__graft_entry__.entry()``), a representative bf16 train step, and
+the serving warm-path executables — and asserts three invariants the
+AST pass can only approximate:
+
+- **PT201 no embedded constants**: a closure-captured device array
+  becomes an XLA constant baked into the program (the measured
+  ~4x/step deopt, ``core/generation.py:_make_step``). The audit walks
+  the traced jaxpr (recursing through pjit/scan/while sub-jaxprs) and
+  fails on any constant above ``CONST_LIMIT_BYTES`` — params must be
+  traced arguments.
+- **PT202 full donation**: every donated input buffer that *can* alias
+  an output (matching shape+dtype — XLA's own aliasing precondition)
+  must actually be recorded as aliased in the lowered program
+  (``tf.aliasing_output``). The train step must donate params and
+  optimizer state fully; programs with nothing aliasable pass
+  vacuously but still must *declare* their donation.
+- **PT203 masks stay f32**: mask leaves of the traced inputs must
+  never be converted below f32 inside the program (masks are count
+  data; bf16 saturates at 256 — trainer/trainer.py:_cast_compute).
+  Taint flows through shape-only ops (reshape/broadcast/slice/...).
+
+Heavy imports (jax, model builders) stay inside functions: Pass 1/3
+must not pay them.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from paddle_tpu.analysis.findings import Finding
+
+# anything bigger than this embedded in a program is a captured tensor,
+# not a legitimate trace-time constant (iota tables, eos rows and
+# similar scaffolding stay well under it)
+CONST_LIMIT_BYTES = 64 * 1024
+
+_SHAPE_ONLY_OPS = {
+    "reshape", "broadcast_in_dim", "squeeze", "expand_dims",
+    "transpose", "slice", "dynamic_slice", "copy", "rev",
+}
+_LOW_DTYPES = ("bfloat16", "float16")
+
+
+# ---------------------------------------------------------------- helpers
+def _walk_consts(closed) -> List[Tuple[Any, str]]:
+    """(const, where) for every const of a ClosedJaxpr, recursing into
+    sub-jaxprs carried in eqn params (pjit/scan/while/cond bodies)."""
+    out: List[Tuple[Any, str]] = []
+    seen = set()
+
+    def rec(cj, where):
+        if id(cj) in seen:
+            return
+        seen.add(id(cj))
+        consts = getattr(cj, "consts", None) or []
+        for c in consts:
+            out.append((c, where))
+        jaxpr = getattr(cj, "jaxpr", cj)
+        for eqn in getattr(jaxpr, "eqns", []):
+            for k, v in eqn.params.items():
+                for sub in (v if isinstance(v, (list, tuple)) else [v]):
+                    if hasattr(sub, "jaxpr") or hasattr(sub, "eqns"):
+                        rec(sub, f"{where}/{eqn.primitive.name}")
+
+    rec(closed, "jaxpr")
+    return out
+
+
+def _const_findings(closed, name: str, anchor: str) -> List[Finding]:
+    findings = []
+    for const, where in _walk_consts(closed):
+        nbytes = getattr(const, "nbytes", 0)
+        if nbytes and nbytes > CONST_LIMIT_BYTES:
+            findings.append(Finding(
+                "PT201", anchor, 1,
+                f"{name}: traced program embeds a "
+                f"{int(nbytes)}-byte constant "
+                f"(shape {getattr(const, 'shape', '?')}, at {where}) — "
+                "a closure-captured array became an XLA program "
+                "constant; pass it as a traced argument"))
+    return findings
+
+
+def _mask_findings(closed, mask_positions: Sequence[int], name: str,
+                   anchor: str) -> List[Finding]:
+    """Taint mask invars; flag converts below f32."""
+    findings: List[Finding] = []
+
+    def is_var(v) -> bool:
+        # jaxpr operands are Vars or (unhashable) Literals
+        return not hasattr(v, "val")
+
+    def rec(jaxpr, tainted):
+        for eqn in jaxpr.eqns:
+            sub_jaxprs = []
+            for v in eqn.params.values():
+                for sub in (v if isinstance(v, (list, tuple)) else [v]):
+                    if hasattr(sub, "jaxpr"):
+                        sub_jaxprs.append(sub.jaxpr)
+                    elif hasattr(sub, "eqns"):
+                        sub_jaxprs.append(sub)
+            prim = eqn.primitive.name
+            in_taint = [is_var(v) and v in tainted
+                        for v in eqn.invars]
+            if prim == "convert_element_type" and any(in_taint):
+                new_dtype = str(eqn.params.get("new_dtype"))
+                if any(d in new_dtype for d in _LOW_DTYPES):
+                    findings.append(Finding(
+                        "PT203", anchor, 1,
+                        f"{name}: a mask input is converted to "
+                        f"{new_dtype} inside the traced program; "
+                        "masks are f32 count data (bf16 saturates at "
+                        "256)"))
+                continue
+            if sub_jaxprs:
+                # map outer invars -> each sub-jaxpr's invars by
+                # position tail-aligned (scan/pjit prepend consts)
+                for sj in sub_jaxprs:
+                    inner_tainted = set()
+                    n = min(len(eqn.invars), len(sj.invars))
+                    for i in range(1, n + 1):
+                        v = eqn.invars[-i]
+                        if is_var(v) and v in tainted:
+                            inner_tainted.add(sj.invars[-i])
+                    if inner_tainted:
+                        rec(sj, inner_tainted)
+                # a call's outputs may also carry taint; propagating
+                # through would need per-output dataflow — the direct
+                # convert check above already covers the _cast_compute
+                # shape of the bug
+            if prim in _SHAPE_ONLY_OPS and any(in_taint):
+                for ov in eqn.outvars:
+                    tainted.add(ov)
+
+    jaxpr = closed.jaxpr
+    tainted = {jaxpr.invars[i] for i in mask_positions
+               if i < len(jaxpr.invars)}
+    if tainted:
+        rec(jaxpr, tainted)
+    return findings
+
+
+def _flatten_with_names(tree) -> List[Tuple[str, Any]]:
+    import jax
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [(jax.tree_util.keystr(path), leaf) for path, leaf in flat]
+
+
+def _donation_findings(jitted, args, donate_argnums: Sequence[int],
+                       name: str, anchor: str,
+                       require_aliasable: bool = False
+                       ) -> Tuple[List[Finding], Dict[str, int]]:
+    """Lower and verify aliasing: every donated leaf whose (shape,
+    dtype) matches an output leaf must be recorded aliased. Returns
+    (findings, stats)."""
+    import warnings
+
+    import jax
+    findings: List[Finding] = []
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # unusable-donation warnings
+        lowered = jitted.lower(*args)
+        out_shape = jax.eval_shape(jitted, *args)
+    txt = lowered.as_text()
+    aliased = txt.count("tf.aliasing_output")
+    donated_leaves = []
+    for i in donate_argnums:
+        donated_leaves.extend(
+            leaf for _n, leaf in _flatten_with_names(args[i]))
+    out_leaves = [leaf for _n, leaf in _flatten_with_names(out_shape)]
+    out_pool: Dict[Tuple[Tuple[int, ...], str], int] = {}
+    for leaf in out_leaves:
+        key = (tuple(leaf.shape), str(leaf.dtype))
+        out_pool[key] = out_pool.get(key, 0) + 1
+    expected = 0
+    for leaf in donated_leaves:
+        key = (tuple(getattr(leaf, "shape", ())),
+               str(getattr(leaf, "dtype", "")))
+        if out_pool.get(key, 0) > 0:
+            out_pool[key] -= 1
+            expected += 1
+    stats = {"donated_leaves": len(donated_leaves),
+             "aliasable": expected, "aliased": aliased}
+    if aliased < expected:
+        findings.append(Finding(
+            "PT202", anchor, 1,
+            f"{name}: {expected} donated buffers can alias an output "
+            f"(matching shape+dtype) but only {aliased} are recorded "
+            "aliased in the lowered program — donation is not "
+            "reaching XLA"))
+    if require_aliasable and expected == 0 and donated_leaves:
+        findings.append(Finding(
+            "PT202", anchor, 1,
+            f"{name}: donation declared but no donated buffer can "
+            "alias any output — the donate_argnums are wrong"))
+    if not donated_leaves and donate_argnums:
+        findings.append(Finding(
+            "PT202", anchor, 1,
+            f"{name}: donate_argnums {tuple(donate_argnums)} cover no "
+            "array leaves"))
+    return findings, stats
+
+
+def _mask_positions(args) -> List[int]:
+    return [i for i, (pname, _leaf)
+            in enumerate(_flatten_with_names(args))
+            if "mask" in pname.lower()]
+
+
+# ---------------------------------------------------------------- audits
+def audit_entry(log=print, root: Optional[str] = None) -> List[Finding]:
+    """``__graft_entry__.entry()``: the flagship forward step. Params
+    are traced args by contract — zero embedded constants; the
+    per-call image buffer is donated (vacuously aliased on a forward
+    whose outputs share no buffer shape — the declaration is what the
+    audit pins)."""
+    import sys
+
+    import jax
+    sys.path.insert(0, root or _repo_root())
+    try:
+        import __graft_entry__ as graft
+    finally:
+        sys.path.pop(0)
+    fn, example = graft.entry()
+    anchor = "__graft_entry__.py"
+    closed = jax.make_jaxpr(fn)(*example)
+    findings = _const_findings(closed, "entry()", anchor)
+    jitted = jax.jit(fn, donate_argnums=(1,))
+    dfind, stats = _donation_findings(jitted, example, (1,),
+                                      "entry()", anchor)
+    findings.extend(dfind)
+    findings.extend(_mask_findings(closed, _mask_positions(example),
+                                   "entry()", anchor))
+    if log:
+        log(f"  entry(): consts clean, donation {stats}")
+    return findings
+
+
+def _repo_root() -> str:
+    from paddle_tpu.analysis._astutil import repo_root
+    return repo_root()
+
+
+def audit_train_step(log=print) -> List[Finding]:
+    """A representative bf16 train step (masked LSTM classifier):
+    params+opt_state donate fully, masks survive as f32 through the
+    lowered program, no embedded constants."""
+    import jax
+    import numpy as np
+
+    from paddle_tpu.config import dsl
+    from paddle_tpu.data import (DataFeeder, integer_value,
+                                 integer_value_sequence)
+    from paddle_tpu.models import lstm_text_classifier
+    from paddle_tpu.optim import Adam
+    from paddle_tpu.trainer import SGD
+
+    anchor = "paddle_tpu/trainer/trainer.py"
+    dsl.reset()
+    cost, _out, _ = lstm_text_classifier(
+        vocab_size=32, embed_dim=8, hidden=8, num_layers=1, classes=2)
+    trainer = SGD(cost=cost, update_equation=Adam(learning_rate=1e-3),
+                  compute_dtype="bfloat16", seed=0)
+    rng = np.random.RandomState(0)
+    data = [(list(rng.randint(0, 32, size=rng.randint(3, 8))),
+             int(rng.randint(0, 2))) for _ in range(4)]
+    feeder = DataFeeder({"words": integer_value_sequence(32),
+                         "label": integer_value(2)}, pad_multiple=8)
+    feed = feeder(data)
+    args = (trainer.params, trainer.opt_state, feed,
+            jax.random.PRNGKey(0), 0, None)
+    closed = jax.make_jaxpr(trainer._train_step)(*args)
+    findings = _const_findings(closed, "train_step", anchor)
+    dfind, stats = _donation_findings(
+        trainer._train_step, args, (0, 1), "train_step", anchor,
+        require_aliasable=True)
+    findings.extend(dfind)
+    mask_pos = _mask_positions(args)
+    if not mask_pos:
+        findings.append(Finding(
+            "PT203", anchor, 1,
+            "train_step audit: expected mask leaves in the feed "
+            "(audit setup broke)"))
+    findings.extend(_mask_findings(closed, mask_pos, "train_step",
+                                   anchor))
+    if log:
+        log(f"  train_step: donation {stats}, "
+            f"{len(mask_pos)} mask leaves traced f32-clean")
+    return findings
+
+
+def audit_serving(log=print) -> List[Finding]:
+    """The serving warm path: a bucketed scoring predictor's ``_infer``
+    (masked sequence model) and a generating predictor's ``_encode``,
+    lowered exactly as warmup would compile them (donate=True — the
+    TPU/GPU configuration; CPU merely ignores it at run time)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.config import dsl
+    from paddle_tpu.core.network import Network
+    from paddle_tpu.core.registry import get_layer_impl
+    from paddle_tpu.data import (dense_vector, integer_value,
+                                 integer_value_sequence)
+    from paddle_tpu.serving.predictor import (ServingPredictor,
+                                              _synth_sample)
+
+    anchor = "paddle_tpu/serving/predictor.py"
+    findings: List[Finding] = []
+
+    # ---- scoring path (_infer), masked sequence input
+    V = 16
+    dsl.reset()
+    w = dsl.data(name="w", size=V)
+    lab = dsl.data(name="label", size=2)
+    emb = dsl.embedding(input=w, size=6, name="emb")
+    pooled = dsl.pooling(input=emb, pooling_type="avg", name="pool")
+    out = dsl.fc(input=pooled, size=2, act="softmax", name="out")
+    dsl.classification_cost(input=out, label=lab, name="cost")
+    graph = dsl.current_graph()
+    params = Network(graph, outputs=["out"]).init_params(
+        jax.random.PRNGKey(0))
+    pred = ServingPredictor(
+        graph, params, ["out"],
+        {"w": integer_value_sequence(V), "label": integer_value(2)},
+        batch_buckets=[2], length_buckets=[8], donate=True)
+    rows = [tuple(_synth_sample(pred.feeding[n], 4)
+                  for n in pred.names)] * 2
+    feed = pred.feeder(list(rows))
+    args = (pred.params, feed)
+    closed = jax.make_jaxpr(pred._infer)(*args)
+    findings.extend(_const_findings(closed, "serving._infer", anchor))
+    dfind, stats = _donation_findings(pred._infer, args, (1,),
+                                      "serving._infer", anchor)
+    findings.extend(dfind)
+    mask_pos = _mask_positions(args)
+    if not mask_pos:
+        findings.append(Finding(
+            "PT203", anchor, 1,
+            "serving audit: expected mask leaves in the feed (audit "
+            "setup broke)"))
+    findings.extend(_mask_findings(closed, mask_pos, "serving._infer",
+                                   anchor))
+    if log:
+        log(f"  serving._infer: donation {stats}, "
+            f"{len(mask_pos)} mask leaves traced f32-clean")
+
+    # ---- generation warm path (_encode of a generating config)
+    Vg, E, H = 6, 4, 5
+    dsl.reset()
+    src = dsl.data("src", size=H)
+    boot = dsl.fc(src, size=H, act="tanh", name="boot", bias_attr=False)
+
+    def step(prev_emb):
+        m = dsl.memory(name="h", size=H, boot_layer=boot)
+        h = dsl.fc([prev_emb, m], size=H, act="tanh", name="h",
+                   bias_attr=False)
+        return dsl.fc(h, size=Vg, act="softmax", name="prob",
+                      bias_attr=False)
+
+    dsl.beam_search(
+        step, [dsl.GeneratedInput(size=Vg, embedding_name="gen_emb",
+                                  embedding_size=E)],
+        bos_id=0, eos_id=1, beam_size=2, max_length=4, name="gen")
+    ggraph = dsl.current_graph()
+    gnet = Network(ggraph, outputs=["boot"])
+    gparams = dict(gnet.init_params(jax.random.PRNGKey(0)))
+    grng = np.random.RandomState(0)
+    for _, spec in get_layer_impl("beam_search_group").params(
+            ggraph.layers["gen"], []).items():
+        gparams[spec.absolute_name] = jnp.asarray(
+            grng.randn(*spec.shape).astype(np.float32) * 0.7)
+    gparams["gen_emb"] = jnp.asarray(
+        grng.randn(Vg, E).astype(np.float32))
+    gpred = ServingPredictor(ggraph, gparams, ["gen"],
+                             {"src": dense_vector(H)},
+                             batch_buckets=[2], donate=True)
+    grows = [tuple(_synth_sample(gpred.feeding[n], 1)
+                   for n in gpred.names)] * 2
+    gfeed = gpred.feeder(list(grows))
+    gargs = (gpred.params, gfeed)
+    gclosed = jax.make_jaxpr(gpred._encode)(*gargs)
+    findings.extend(_const_findings(gclosed, "serving._encode", anchor))
+    dfind, gstats = _donation_findings(gpred._encode, gargs, (1,),
+                                       "serving._encode", anchor)
+    findings.extend(dfind)
+    if log:
+        log(f"  serving._encode: donation {gstats}, consts clean")
+    return findings
+
+
+def run_pass2(root: Optional[str] = None, log=print,
+              include_entry: bool = True) -> List[Finding]:
+    """All trace-time audits. ``include_entry=False`` skips the
+    flagship ResNet-50 build (~20 s on the 1-core host) for quick
+    iteration; the CLI default runs it.
+
+    ``root`` retargets only the ``__graft_entry__`` import: the
+    train-step and serving audits trace the paddle_tpu package THIS
+    process imported — a foreign checkout's library code cannot be
+    audited without running in that checkout."""
+    import os
+    findings: List[Finding] = []
+    if root is not None and os.path.realpath(root) != os.path.realpath(
+            _repo_root()) and log:
+        log(f"  NOTE: --root {root} applies to the entry import only; "
+            "the train-step/serving audits trace the IMPORTED "
+            "paddle_tpu package — run the lint from inside that "
+            "checkout to audit its library code")
+    findings.extend(audit_train_step(log=log))
+    findings.extend(audit_serving(log=log))
+    if include_entry:
+        findings.extend(audit_entry(log=log, root=root))
+    return findings

@@ -1,0 +1,473 @@
+"""Pass 3 — static lock-acquisition graph over the threaded modules.
+
+The threaded surface this repo grew (serving batcher, master RPC +
+heartbeat, background checkpoint writers, prefetch) is exactly where
+PR 6's review found real bugs (the ``MasterClient`` socket-desync-
+under-lock cross-wiring). A deadlock needs two ingredients a linter can
+see statically: two locks, and two code paths acquiring them in
+opposite orders. This pass builds the acquisition graph and fails on
+cycles (PT301) and on same-lock re-acquisition of a non-reentrant lock
+along one call path (PT302).
+
+Model:
+
+- **Lock identities** are ``module.Class.attr`` for ``self.attr =
+  threading.Lock()/RLock()/Condition(...)`` assignments.
+  ``Condition(self._lock)`` aliases the underlying lock (one identity).
+- **Acquisitions** are ``with self.attr:`` blocks (and
+  ``self.attr.acquire()`` calls) inside methods of the owning class.
+- **Call edges** resolve ``self.m()`` to the same class,
+  ``self.attr.m()`` through attribute types recorded from ``__init__``
+  assignments / annotations (``self.metrics = ServingMetrics()``), and
+  bare names to module functions. Unresolvable calls (callbacks,
+  duck-typed parameters) contribute no edges — the runtime tracker
+  (``paddle_tpu.testing.lockcheck``) covers those dynamically.
+- Holding lock A while reaching (transitively) an acquisition of lock
+  B adds edge A -> B. A cycle in the graph = order inversion.
+
+The default scope is the five threaded modules plus the classes they
+lock through (metrics, chaos, stat registries).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from paddle_tpu.analysis.findings import Finding
+
+# the five threaded modules the tentpole names, plus lock-holding
+# classes they call into while holding their own locks
+DEFAULT_MODULES = (
+    "paddle_tpu/serving/batcher.py",
+    "paddle_tpu/dist/master.py",
+    "paddle_tpu/dist/checkpoint.py",
+    "paddle_tpu/trainer/checkpoint.py",
+    "paddle_tpu/data/prefetch.py",
+    # supporting lock owners reachable from the above
+    "paddle_tpu/serving/metrics.py",
+    "paddle_tpu/testing/chaos.py",
+    "paddle_tpu/utils/stat.py",
+    "paddle_tpu/native/__init__.py",
+)
+
+_LOCK_CTORS = {"Lock": False, "RLock": True}  # name -> reentrant
+
+
+from paddle_tpu.analysis._astutil import dotted as _dotted
+
+
+class LockInfo:
+    __slots__ = ("ident", "reentrant", "path", "line")
+
+    def __init__(self, ident: str, reentrant: bool, path: str, line: int):
+        self.ident = ident
+        self.reentrant = reentrant
+        self.path = path
+        self.line = line
+
+
+class MethodInfo:
+    """Per-method facts: lock acquisitions (with held-set context) and
+    calls (with held-set context). ``module``/``cls`` are carried
+    explicitly — deriving them by splitting the qual mis-parses
+    module-level functions in dotted packages."""
+
+    def __init__(self, qual: str, module: str = "",
+                 cls: Optional[str] = None):
+        self.qual = qual  # module.Class.method or module.function
+        self.module = module
+        self.cls = cls    # module.Class, or None for module functions
+        # (held-locks-tuple, lock-ident, line)
+        self.acquires: List[Tuple[Tuple[str, ...], str, int]] = []
+        # (held-locks-tuple, callee-token, line); callee-token is
+        # "self.m", "self.attr.m", or a bare dotted name
+        self.calls: List[Tuple[Tuple[str, ...], str, int]] = []
+
+
+class LockOrderChecker:
+    def __init__(self, root: str,
+                 modules: Sequence[str] = DEFAULT_MODULES):
+        self.root = root
+        self.modules = list(modules)
+        self.locks: Dict[str, LockInfo] = {}
+        self.methods: Dict[str, MethodInfo] = {}
+        # class name -> module.Class (for attr-type resolution); last
+        # writer wins which is fine inside this closed module set
+        self.class_qual: Dict[str, str] = {}
+        # module.Class -> {attr -> class-name}
+        self.attr_types: Dict[str, Dict[str, str]] = {}
+        # module.Class -> {lock-attr -> lock-ident}
+        self.class_locks: Dict[str, Dict[str, str]] = {}
+        self.findings: List[Finding] = []
+
+    # ------------------------------------------------------- collection
+    def load(self):
+        self._trees = []
+        for rel in self.modules:
+            path = os.path.join(self.root, rel)
+            if not os.path.exists(path):
+                continue
+            source = open(path, encoding="utf-8").read()
+            tree = ast.parse(source, filename=path)
+            modname = rel[:-3].replace("/", ".").replace(
+                ".__init__", "")
+            self._trees.append((tree, modname, rel))
+        # phase 1: register every class in the set (so cross-module
+        # attribute typing — batcher's ServingMetrics — resolves no
+        # matter the module order); phase 2: scan attribute assigns
+        for tree, modname, _rel in self._trees:
+            for node in tree.body:
+                if isinstance(node, ast.ClassDef):
+                    qual = f"{modname}.{node.name}"
+                    self.class_qual[node.name] = qual
+                    self.attr_types.setdefault(qual, {})
+                    self.class_locks.setdefault(qual, {})
+        for tree, modname, rel in self._trees:
+            for node in tree.body:
+                if isinstance(node, ast.ClassDef):
+                    qual = f"{modname}.{node.name}"
+                    for sub in ast.walk(node):
+                        self._scan_attr_assign(sub, qual, rel)
+        self._collect_bodies()
+
+    def _scan_attr_assign(self, node: ast.AST, class_qual: str,
+                          rel: str):
+        """self.X = <ctor> assignments: lock attrs and typed attrs."""
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            if isinstance(node, ast.AnnAssign) and node.annotation is \
+                    not None and isinstance(node.target, ast.Attribute) \
+                    and _dotted(node.target.value) == "self":
+                ann = ast.unparse(node.annotation)
+                for cname in self.class_qual:
+                    if cname in ann:
+                        self.attr_types[class_qual][
+                            node.target.attr] = cname
+            return
+        tgt = node.targets[0]
+        if not (isinstance(tgt, ast.Attribute)
+                and _dotted(tgt.value) == "self"):
+            return
+        val = node.value
+        if not isinstance(val, ast.Call):
+            # `self.metrics = metrics or ServingMetrics()` shells: any
+            # ctor call of an analyzed class types the attribute
+            for sub in ast.walk(val):
+                if isinstance(sub, ast.Call):
+                    cd = (_dotted(sub.func) or "").split(".")[-1]
+                    if cd in self.class_qual:
+                        self.attr_types[class_qual][tgt.attr] = cd
+                        return
+            return
+        d = _dotted(val.func) or ""
+        leaf = d.split(".")[-1]
+        if leaf in _LOCK_CTORS and ("threading" in d or d == leaf):
+            ident = f"{class_qual}.{tgt.attr}"
+            self.locks[ident] = LockInfo(ident, _LOCK_CTORS[leaf],
+                                         rel, node.lineno)
+            self.class_locks[class_qual][tgt.attr] = ident
+        elif leaf == "Condition":
+            # Condition(self._lock) aliases the lock it wraps;
+            # Condition() owns a fresh (reentrant) RLock
+            if val.args and isinstance(val.args[0], ast.Attribute) \
+                    and _dotted(val.args[0].value) == "self":
+                base = val.args[0].attr
+                base_ident = self.class_locks[class_qual].get(base)
+                if base_ident is not None:
+                    self.class_locks[class_qual][tgt.attr] = base_ident
+                    return
+            ident = f"{class_qual}.{tgt.attr}"
+            self.locks[ident] = LockInfo(ident, True, rel, node.lineno)
+            self.class_locks[class_qual][tgt.attr] = ident
+        else:
+            # typed attribute (self.metrics = ServingMetrics(...), also
+            # `metrics or ServingMetrics()` shells)
+            for sub in ast.walk(val):
+                if isinstance(sub, ast.Call):
+                    cd = (_dotted(sub.func) or "").split(".")[-1]
+                    if cd in self.class_qual:
+                        self.attr_types[class_qual][tgt.attr] = cd
+                        return
+
+    # ----------------------------------------------------- method bodies
+    def _collect_bodies(self):
+        for tree, modname, rel in self._trees:
+            for node in tree.body:
+                if isinstance(node, ast.ClassDef):
+                    qual = f"{modname}.{node.name}"
+                    for item in node.body:
+                        if isinstance(item, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef)):
+                            self._scan_method(item, qual, rel)
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    mi = MethodInfo(f"{modname}.{node.name}",
+                                    module=modname)
+                    self._walk_body(node.body, (), None, mi, rel)
+                    self.methods[mi.qual] = mi
+
+    def _scan_method(self, fn: ast.AST, class_qual: str, rel: str):
+        mi = MethodInfo(f"{class_qual}.{fn.name}",
+                        module=class_qual.rsplit(".", 1)[0],
+                        cls=class_qual)
+        self._walk_body(fn.body, (), class_qual, mi, rel)
+        self.methods[mi.qual] = mi
+
+    def _lock_of_expr(self, expr: ast.AST,
+                      class_qual: Optional[str]) -> Optional[str]:
+        if class_qual is None:
+            return None
+        if isinstance(expr, ast.Attribute) \
+                and _dotted(expr.value) == "self":
+            return self.class_locks.get(class_qual, {}).get(expr.attr)
+        return None
+
+    def _walk_body(self, body: List[ast.stmt], held: Tuple[str, ...],
+                   class_qual: Optional[str], mi: MethodInfo, rel: str):
+        """Recurse through EVERY compound statement carrying the held
+        set — a `with self._lock:` nested under if/try/for/while (i.e.
+        virtually every worker-loop lock site) must be seen with its
+        true context, or the graph silently undercounts."""
+        for stmt in body:
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                new_held = held
+                for item in stmt.items:
+                    ident = self._lock_of_expr(item.context_expr,
+                                               class_qual)
+                    if ident is not None:
+                        mi.acquires.append((new_held, ident,
+                                            stmt.lineno))
+                        new_held = new_held + (ident,)
+                    else:
+                        # scanned with the held set AS OF this item —
+                        # `with self._lock, self._make_cm():` runs
+                        # _make_cm() while the lock is already held
+                        self._scan_exprs([item.context_expr], new_held,
+                                         mi, class_qual=class_qual)
+                self._walk_body(stmt.body, new_held, class_qual, mi,
+                                rel)
+                continue
+            # nested defs: their bodies run LATER, possibly on another
+            # thread (Thread targets), under unknown lock context —
+            # record them as their OWN method ("<locals>" qual) so a
+            # synchronous bare-name call still resolves to them, but a
+            # closure handed to a Thread contributes nothing to the
+            # enclosing method's transitive lockset
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                sub = MethodInfo(f"{mi.qual}.<locals>.{stmt.name}",
+                                 module=mi.module, cls=mi.cls)
+                self._walk_body(stmt.body, (), class_qual, sub, rel)
+                self.methods[sub.qual] = sub
+                continue
+            if isinstance(stmt, ast.If):
+                self._scan_exprs([stmt.test], held, mi,
+                                 class_qual=class_qual)
+                self._walk_body(stmt.body, held, class_qual, mi, rel)
+                self._walk_body(stmt.orelse, held, class_qual, mi, rel)
+                continue
+            if isinstance(stmt, ast.While):
+                self._scan_exprs([stmt.test], held, mi,
+                                 class_qual=class_qual)
+                self._walk_body(stmt.body, held, class_qual, mi, rel)
+                self._walk_body(stmt.orelse, held, class_qual, mi, rel)
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._scan_exprs([stmt.iter], held, mi,
+                                 class_qual=class_qual)
+                self._walk_body(stmt.body, held, class_qual, mi, rel)
+                self._walk_body(stmt.orelse, held, class_qual, mi, rel)
+                continue
+            if isinstance(stmt, ast.Try):
+                self._walk_body(stmt.body, held, class_qual, mi, rel)
+                for handler in stmt.handlers:
+                    self._walk_body(handler.body, held, class_qual, mi,
+                                    rel)
+                self._walk_body(stmt.orelse, held, class_qual, mi, rel)
+                self._walk_body(stmt.finalbody, held, class_qual, mi,
+                                rel)
+                continue
+            self._scan_exprs([stmt], held, mi, class_qual=class_qual)
+
+    def _scan_exprs(self, nodes, held: Tuple[str, ...], mi: MethodInfo,
+                    class_qual: Optional[str] = None):
+        """Calls (and explicit .acquire()s) inside leaf statements and
+        guard expressions, recorded with the current held set."""
+        for root in nodes:
+            if root is None:
+                continue
+            for node in ast.walk(root):
+                if isinstance(node, ast.Call):
+                    d = _dotted(node.func)
+                    if d is None:
+                        continue
+                    if d.endswith(".acquire"):
+                        ident = self._lock_of_expr(
+                            node.func.value, class_qual)
+                        if ident is not None:
+                            mi.acquires.append((held, ident,
+                                                node.lineno))
+                            continue
+                    mi.calls.append((held, d, node.lineno))
+
+    # known module-global singletons whose methods run under the
+    # caller's locks (the chaos plane is hit from inside several
+    # with-blocks); token prefix -> class name
+    SINGLETONS = {
+        "_chaos._ACTIVE": "FaultPlan",
+        "chaos._ACTIVE": "FaultPlan",
+    }
+
+    # ------------------------------------------------------- resolution
+    def _resolve_callee(self, token: str,
+                        caller: str) -> Optional[str]:
+        """Callee token -> method qual, within the analyzed set."""
+        for prefix, cname in self.SINGLETONS.items():
+            if token.startswith(prefix + ".") and cname in \
+                    self.class_qual:
+                meth = token[len(prefix) + 1:]
+                q = f"{self.class_qual[cname]}.{meth}"
+                if q in self.methods:
+                    return q
+        parts = token.split(".")
+        caller_mi = self.methods.get(caller)
+        caller_mod = caller_mi.module if caller_mi else ""
+        caller_class = caller_mi.cls if caller_mi else None
+        if parts[0] == "self" and caller_class is not None:
+            if len(parts) == 2:
+                q = f"{caller_class}.{parts[1]}"
+                return q if q in self.methods else None
+            if len(parts) == 3:
+                cname = self.attr_types.get(caller_class, {}).get(
+                    parts[1])
+                if cname is not None:
+                    q = f"{self.class_qual[cname]}.{parts[2]}"
+                    return q if q in self.methods else None
+            return None
+        if len(parts) == 1:
+            # a synchronous call of a nested def shadows the module
+            # namespace — try the caller's locals first
+            q = f"{caller}.<locals>.{parts[0]}"
+            if q in self.methods:
+                return q
+            q = f"{caller_mod}.{parts[0]}"
+            return q if q in self.methods else None
+        return None
+
+    def _transitive_locks(self, qual: str,
+                          seen: Optional[Set[str]] = None
+                          ) -> Set[Tuple[str, int, str]]:
+        """Locks acquired by ``qual`` or anything it calls:
+        {(lock-ident, line, at-method)}."""
+        if seen is None:
+            seen = set()
+        if qual in seen:
+            return set()
+        seen.add(qual)
+        out: Set[Tuple[str, int, str]] = set()
+        mi = self.methods.get(qual)
+        if mi is None:
+            return out
+        for _held, ident, line in mi.acquires:
+            out.add((ident, line, qual))
+        for _held, token, _line in mi.calls:
+            callee = self._resolve_callee(token, qual)
+            if callee is not None:
+                out |= self._transitive_locks(callee, seen)
+        return out
+
+    # ----------------------------------------------------------- check
+    def run(self) -> List[Finding]:
+        self.load()
+        # edge (A, B) -> evidence string
+        edges: Dict[Tuple[str, str], str] = {}
+
+        def add_edge(a: str, b: str, where: str, line: int):
+            if a == b:
+                info = self.locks.get(a)
+                if info is not None and not info.reentrant:
+                    rel = self._rel_of(where)
+                    self.findings.append(Finding(
+                        "PT302", rel, line,
+                        f"non-reentrant lock {a} can be re-acquired "
+                        f"while already held (path through {where})"))
+                return
+            edges.setdefault((a, b),
+                             f"{where}:{line}")
+
+        for qual, mi in self.methods.items():
+            for held, ident, line in mi.acquires:
+                for h in held:
+                    add_edge(h, ident, qual, line)
+            for held, token, line in mi.calls:
+                if not held:
+                    continue
+                callee = self._resolve_callee(token, qual)
+                if callee is None:
+                    continue
+                for ident, lline, lqual in self._transitive_locks(
+                        callee):
+                    for h in held:
+                        add_edge(h, ident, f"{qual} -> {lqual}", line)
+
+        # cycle detection over the lock graph
+        adj: Dict[str, List[str]] = {}
+        for (a, b) in edges:
+            adj.setdefault(a, []).append(b)
+        color: Dict[str, int] = {}
+        stack: List[str] = []
+
+        def dfs(u: str):
+            color[u] = 1
+            stack.append(u)
+            for v in adj.get(u, []):
+                if color.get(v, 0) == 0:
+                    dfs(v)
+                elif color.get(v) == 1:
+                    cyc = stack[stack.index(v):] + [v]
+                    ev = "; ".join(
+                        f"{x}->{y} at {edges[(x, y)]}"
+                        for x, y in zip(cyc, cyc[1:]))
+                    first = self.locks.get(cyc[0])
+                    self.findings.append(Finding(
+                        "PT301",
+                        first.path if first else "<unknown>",
+                        first.line if first else 1,
+                        "lock-order inversion: "
+                        + " -> ".join(cyc) + f" ({ev})"))
+            stack.pop()
+            color[u] = 2
+
+        for node in sorted(adj):
+            if color.get(node, 0) == 0:
+                dfs(node)
+
+        self.edges = edges
+        return self.findings
+
+    def _rel_of(self, where: str) -> str:
+        mod = where.split(" -> ")[-1]
+        for ident, info in self.locks.items():
+            if mod.startswith(ident.rsplit(".", 1)[0].rsplit(".", 1)[0]):
+                return info.path
+        return self.modules[0]
+
+    # ------------------------------------------------------- reporting
+    def describe(self) -> str:
+        lines = [f"locks: {len(self.locks)}"]
+        for ident in sorted(self.locks):
+            info = self.locks[ident]
+            kind = "RLock/Condition" if info.reentrant else "Lock"
+            lines.append(f"  {ident} ({kind}) {info.path}:{info.line}")
+        lines.append(f"acquisition-order edges: {len(self.edges)}")
+        for (a, b), ev in sorted(self.edges.items()):
+            lines.append(f"  {a} -> {b}  [{ev}]")
+        return "\n".join(lines)
+
+
+def run_pass3(root: str,
+              modules: Sequence[str] = DEFAULT_MODULES
+              ) -> Tuple[List[Finding], "LockOrderChecker"]:
+    checker = LockOrderChecker(root, modules)
+    findings = checker.run()
+    return findings, checker

@@ -550,6 +550,12 @@ class GradientMachine:
             lambda p, f, r: self._network.apply(p, f, train=True, rng=r))
         self._fwd_test = jax.jit(
             lambda p, f: self._network.apply(p, f, train=False))
+        from paddle_tpu.data.prefetch import RecompileGuard
+        self._jit_guards = [
+            RecompileGuard(self._fwd, warn_after=16, name="swig_fwd"),
+            RecompileGuard(self._fwd_test, warn_after=16,
+                           name="swig_fwd_test"),
+        ]
 
         def loss_fn(p, f, r):
             # apply_with_state: batch-norm moving statistics update during
@@ -563,6 +569,8 @@ class GradientMachine:
             return total, (outputs, updates)
 
         self._grad_fn = jax.jit(jax.value_and_grad(loss_fn, has_aux=True))
+        self._jit_guards.append(RecompileGuard(
+            self._grad_fn, warn_after=16, name="swig_grad"))
 
     # -- construction ---------------------------------------------------
     @staticmethod
@@ -663,6 +671,8 @@ class GradientMachine:
         else:
             outputs = self._fwd_test(self._params, feed)
         self._last_outputs, self._last_feed = outputs, feed
+        for g in self._jit_guards:
+            g.check()
         self._fill_out(outputs, outArgs)
 
     def forwardBackward(self, inArgs: Arguments, outArgs: Arguments,
@@ -677,6 +687,8 @@ class GradientMachine:
         # the scalar the loss_fn actually optimized (batch-mean over every
         # cost layer) — callers read this instead of sniffing output slots
         self._last_cost = float(jax.device_get(cost))
+        for g in self._jit_guards:
+            g.check()
         self._fill_out(outputs, outArgs)
 
     def backward(self, callback=None):

@@ -240,6 +240,9 @@ class SequenceGenerator:
         if fn is not None:
             self._jitted.move_to_end(key)
             return fn
+        # graftlint: jit-cache: LRU-bounded (_JIT_CACHE_CAP) with a
+        # loud eviction warning; serving brings the warmed entries
+        # under hardened guards via _ensure_engine_guard
         fn = jax.jit(lambda p, feed: self._search(p, feed, K, L, hooks,
                                                   chunk))
         self._jitted[key] = fn
@@ -664,9 +667,12 @@ class DecodeSession:
                 state["finished"], jnp.ones((1, K), bool), (lane, 0))
             return state
 
+        # graftlint: jit-cache: exactly 3 compiles per session, exposed
+        # via jitted_fns() and hardened by the serving predictor's
+        # RecompileGuards after warmup (build_session)
         self._admit_fn = jax.jit(_admit)
-        self._chunk_fn = jax.jit(_chunk)
-        self._release_fn = jax.jit(_release)
+        self._chunk_fn = jax.jit(_chunk)  # graftlint: jit-cache: ^
+        self._release_fn = jax.jit(_release)  # graftlint: jit-cache: ^
 
     # ------------------------------------------------------------ lanes
     def jitted_fns(self) -> List[Callable]:

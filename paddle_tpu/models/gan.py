@@ -75,8 +75,13 @@ class GANTrainer:
         self._push_d_into_g()
         self._rng = jax.random.PRNGKey(seed + 2)
         net = self.g.network
+        from paddle_tpu.data.prefetch import RecompileGuard
         self._gen_fwd = jax.jit(
             lambda p, f: net.apply(p, f, train=False)["g_out"].value)
+        # generate(n) compiles one variant per sample count — legal,
+        # but a caller sweeping n would thrash silently without this
+        self._gen_guard = RecompileGuard(self._gen_fwd, warn_after=8,
+                                         name="gan_gen_fwd")
 
     def _push_d_into_g(self):
         for name, v in self.d.params.items():
@@ -93,7 +98,9 @@ class GANTrainer:
         noise = jax.random.normal(k, (n, self.noise_dim), jnp.float32)
         feed = {"noise": Argument(value=noise),
                 "label": Argument(value=jnp.ones((n,), jnp.int32))}
-        return self._gen_fwd(self.g.params, feed), feed
+        out = self._gen_fwd(self.g.params, feed), feed
+        self._gen_guard.check()
+        return out
 
     def train_round(self, real_batch) -> Dict[str, float]:
         """One alternation: D on real(1)+fake(0), then G toward 1."""

@@ -33,6 +33,7 @@ import numpy as np
 
 from paddle_tpu.serving.errors import BadRequest
 from paddle_tpu.utils.log import get_logger
+from paddle_tpu.utils.masks import assert_feed_masks_f32
 
 logger = get_logger("serving")
 
@@ -301,6 +302,9 @@ class ServingPredictor:
 
         from paddle_tpu.data.feeder import ROW_MASK_KEY
         feed = self.feeder(list(rows))
+        # runtime twin of graftlint PT102: every mask the feeder built
+        # must be f32 before it reaches the warmed executables
+        assert_feed_masks_f32(feed, "serving feed")
         if lane_valid is not None and ROW_MASK_KEY in feed:
             mask = feed[ROW_MASK_KEY]
             lv = np.ones(mask.value.shape[0], dtype=np.float32)

@@ -7,3 +7,9 @@ it lives in the package, not in tests/, because production code carries
 its hook points and ``tools/chaos_soak.py`` drives it across processes.
 Import cost is a few stdlib modules; nothing here imports jax.
 """
+
+# lock-order tracking is the same opt-in pattern as the chaos plane:
+# armed by $PADDLE_TPU_LOCKCHECK, zero cost otherwise
+from paddle_tpu.testing import lockcheck as _lockcheck  # noqa: E402
+
+_lockcheck.maybe_install_from_env()

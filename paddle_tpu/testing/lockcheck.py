@@ -1,0 +1,350 @@
+"""Runtime lock-order tracker: the dynamic twin of
+``paddle_tpu.analysis.lockorder``.
+
+The static pass sees ``with self._lock:`` blocks; it cannot see locks
+reached through callbacks, duck-typed parameters, or module globals.
+This tracker can: it wraps lock construction so every acquisition
+records (per thread) the stack of locks currently held, builds a global
+*acquisition-order graph* keyed by lock **creation site** (file:line —
+instances of the same class share a site, so an inversion between two
+instances of the same pool still keys consistently), and raises
+:class:`LockOrderError` the moment an acquisition creates a cycle —
+i.e. some other thread/path acquired the same two sites in the
+opposite order. A deadlock that would otherwise need an unlucky
+interleaving to bite becomes a deterministic test failure on ANY
+interleaving that exercises both orders.
+
+Chaos-style opt-in, zero cost when off:
+
+- ``install()`` / ``uninstall()`` patch ``threading.Lock`` /
+  ``threading.RLock`` so locks created *after* install are tracked
+  (``threading.Condition`` composes transparently — it drives the
+  wrapped lock's ``acquire``/``release``).
+- ``tracking()`` is the context-manager form tests use.
+- ``PADDLE_TPU_LOCKCHECK=1`` arms it process-wide at import of
+  ``paddle_tpu.testing`` (the ``$PADDLE_TPU_CHAOS_PLAN`` pattern).
+- ``wrap(lock, name)`` adopts a pre-existing lock object into the
+  tracker (for singletons created before install).
+
+Also detected: same-thread re-acquisition of a non-reentrant tracked
+lock — WARNED (``SelfDeadlockWarning``, the PT302 static rule's
+runtime twin), not raised: ``release()`` legally supports cross-thread
+handoff, so the blocking re-acquire may be a rendezvous; a genuine
+self-deadlock hangs at the warned acquire with the warning naming it.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+import warnings
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["LockOrderError", "SelfDeadlockWarning", "install",
+           "uninstall", "tracking", "wrap", "edges", "reset",
+           "installed"]
+
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+
+class LockOrderError(RuntimeError):
+    """Two lock sites were acquired in both orders (a deadlock
+    window, detected transitively over the recorded graph)."""
+
+
+class SelfDeadlockWarning(UserWarning):
+    """A holding thread re-acquired its own non-reentrant lock. Legal
+    only under a cross-thread handoff release — warned, not raised,
+    because the tracker patches locks process-wide and must never
+    fail a correct rendezvous; a genuine self-deadlock hangs at the
+    warned acquire, with the warning naming it."""
+
+
+class _State:
+    def __init__(self):
+        self.lock = _REAL_LOCK()  # guards the graph, never tracked
+        # (site_a, site_b) -> short evidence string of first witness
+        self.edges: Dict[Tuple[str, str], str] = {}
+        self.tls = threading.local()
+
+    def held(self) -> List["_TrackedLock"]:
+        h = getattr(self.tls, "held", None)
+        if h is None:
+            h = self.tls.held = []
+        return h
+
+
+_STATE = _State()
+_INSTALLED = False
+
+
+def _reaches_locked(src: str, dst: str):
+    """Edge-path src ->* dst over the recorded graph (caller holds
+    _STATE.lock); returns the site path or None."""
+    if src == dst:
+        return [src]
+    adj: Dict[str, List[str]] = {}
+    for (a, b) in _STATE.edges:
+        adj.setdefault(a, []).append(b)
+    stack = [(src, [src])]
+    seen = {src}
+    while stack:
+        node, path = stack.pop()
+        for nxt in adj.get(node, []):
+            if nxt == dst:
+                return path + [nxt]
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+def _creation_site(skip: int) -> str:
+    """file:line of the lock constructor's caller, repo-relative-ish."""
+    for frame in reversed(traceback.extract_stack()[:-skip]):
+        fn = frame.filename
+        if os.sep + "lockcheck" in fn or fn.endswith("lockcheck.py"):
+            continue
+        if os.sep + "threading" in fn and fn.endswith("threading.py"):
+            continue
+        parts = fn.replace(os.sep, "/").split("/")
+        short = "/".join(parts[-3:])
+        return f"{short}:{frame.lineno}"
+    return "<unknown>"
+
+
+class _TrackedLock:
+    """Wraps a real lock; quacks enough for ``with``, ``acquire``,
+    ``release`` and ``threading.Condition``."""
+
+    def __init__(self, real, site: str, reentrant: bool,
+                 name: Optional[str] = None):
+        self._real = real
+        self.site = name or site
+        self._reentrant = reentrant
+        # the held-lists this lock currently sits on, newest last —
+        # threading.Lock may legally be release()d from a DIFFERENT
+        # thread (handoff pattern), and the entry must come off the
+        # ACQUIRER's per-thread stack, not the releaser's
+        self._owner_lists: List[list] = []
+
+    # ------------------------------------------------------- tracking
+    def _before_acquire(self, blocking: bool):
+        if not blocking:
+            return  # try-locks never deadlock; don't order-constrain
+        with _STATE.lock:
+            # snapshot: a cross-thread handoff release may mutate this
+            # thread's held list while we walk it
+            held = list(_STATE.held())
+        for h in held:
+            if h is self and not self._reentrant:
+                # NOT a hard error: release() legally supports
+                # cross-thread handoff, so a holder blocking on a
+                # second acquire may be a rendezvous another thread
+                # will release. A REAL self-deadlock hangs right here
+                # — with this warning already on record naming it.
+                warnings.warn(
+                    f"lockcheck: thread "
+                    f"{threading.current_thread().name} re-acquires "
+                    f"non-reentrant lock {self.site} it already holds "
+                    "— self-deadlock unless another thread releases "
+                    "it (handoff)", SelfDeadlockWarning, stacklevel=4)
+                continue
+            if h.site == self.site:
+                continue  # same-site pool churn: no order info
+            fwd = (h.site, self.site)
+            with _STATE.lock:
+                if fwd not in _STATE.edges:
+                    # adding h->self closes a cycle iff self already
+                    # REACHES h through recorded edges — the 2-lock
+                    # inversion is just the length-1 case; A->B->C->A
+                    # deadlock windows need the transitive check
+                    path = _reaches_locked(self.site, h.site)
+                    if path is not None:
+                        chain = " -> ".join(path)
+                        raise LockOrderError(
+                            "lock-order inversion: this thread holds "
+                            f"{h.site} and acquires {self.site}, but "
+                            f"the opposite order is already on record "
+                            f"({chain}; first witness: "
+                            f"{_STATE.edges[(path[0], path[1])]}) — a "
+                            "deadlock window")
+                _STATE.edges.setdefault(
+                    fwd, f"{h.site} -> {self.site} in thread "
+                         f"{threading.current_thread().name}")
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        self._before_acquire(blocking)
+        got = (self._real.acquire(blocking, timeout)
+               if timeout != -1 else self._real.acquire(blocking))
+        if got:
+            held = _STATE.held()
+            with _STATE.lock:
+                # append under the graph lock: a cross-thread handoff
+                # release may be mutating this very list concurrently
+                held.append(self)
+                self._owner_lists.append(held)
+        return got
+
+    def release(self):
+        # take the entry off the list it was acquired on (usually this
+        # thread's; a cross-thread handoff release pops the acquirer's).
+        # The scan-and-delete stays under the graph lock: two handoff
+        # releases racing on one acquirer's stack would otherwise
+        # index-shift each other and delete the wrong entry
+        with _STATE.lock:
+            held = (self._owner_lists.pop() if self._owner_lists
+                    else _STATE.held())
+            for i in range(len(held) - 1, -1, -1):
+                if held[i] is self:
+                    del held[i]
+                    break
+        self._real.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._real.locked() if hasattr(self._real, "locked") \
+            else None
+
+    # ---- threading.Condition integration. Condition probes for
+    # _release_save/_acquire_restore: on an RLock they release/restore
+    # ALL recursion levels around wait(). Without forwarding them, a
+    # Condition on a tracked RLock held recursively would release only
+    # ONE level in wait() — the waiter keeps the lock, the notifier
+    # can never acquire it, and the tracker itself manufactures a
+    # deadlock in code that is correct untracked.
+    def _pop_all_current_thread(self) -> int:
+        """Remove every held entry for this lock from the calling
+        thread's stack (+ matching owner-list refs); returns count."""
+        with _STATE.lock:
+            held = _STATE.held()
+            n = 0
+            for i in range(len(held) - 1, -1, -1):
+                if held[i] is self:
+                    del held[i]
+                    n += 1
+            removed = 0
+            for i in range(len(self._owner_lists) - 1, -1, -1):
+                if removed >= n:
+                    break
+                if self._owner_lists[i] is held:
+                    del self._owner_lists[i]
+                    removed += 1
+        return n
+
+    def _push_n_current_thread(self, n: int):
+        held = _STATE.held()
+        with _STATE.lock:
+            for _ in range(n):
+                held.append(self)
+                self._owner_lists.append(held)
+
+    def _release_save(self):
+        if hasattr(self._real, "_release_save"):
+            n = self._pop_all_current_thread()
+            state = self._real._release_save()
+            return (state, n)
+        self.release()  # plain Lock: single-level, like Condition's own fallback
+        return (None, 1)
+
+    def _acquire_restore(self, token):
+        state, n = token
+        if state is not None and hasattr(self._real,
+                                         "_acquire_restore"):
+            self._real._acquire_restore(state)
+            self._push_n_current_thread(n)
+            return
+        self.acquire()
+
+    def _is_owned(self):
+        if hasattr(self._real, "_is_owned"):
+            return self._real._is_owned()
+        if self._real.acquire(False):
+            self._real.release()
+            return False
+        return True
+
+    def __repr__(self):
+        return f"<TrackedLock {self.site} wrapping {self._real!r}>"
+
+
+def _tracked_lock_factory():
+    return _TrackedLock(_REAL_LOCK(), _creation_site(2), False)
+
+
+def _tracked_rlock_factory():
+    return _TrackedLock(_REAL_RLOCK(), _creation_site(2), True)
+
+
+def installed() -> bool:
+    return _INSTALLED
+
+
+def install():
+    """Patch lock construction; locks created from here on are
+    tracked. Idempotent."""
+    global _INSTALLED
+    if _INSTALLED:
+        return
+    threading.Lock = _tracked_lock_factory
+    threading.RLock = _tracked_rlock_factory
+    _INSTALLED = True
+
+
+def uninstall():
+    global _INSTALLED
+    threading.Lock = _REAL_LOCK
+    threading.RLock = _REAL_RLOCK
+    _INSTALLED = False
+
+
+def reset():
+    """Drop the recorded order graph (NOT the held stacks — only call
+    between quiesced phases)."""
+    with _STATE.lock:
+        _STATE.edges.clear()
+
+
+def edges() -> Dict[Tuple[str, str], str]:
+    with _STATE.lock:
+        return dict(_STATE.edges)
+
+
+def wrap(lock, name: str) -> _TrackedLock:
+    """Adopt an existing lock object (singleton created pre-install)."""
+    reentrant = type(lock).__name__ == "RLock" or hasattr(
+        lock, "_is_owned")
+    return _TrackedLock(lock, name, reentrant, name=name)
+
+
+@contextmanager
+def tracking(fresh: bool = True):
+    """Install for the duration of a test; on exit restores the
+    PRIOR state (so a ``PADDLE_TPU_LOCKCHECK=1`` process-wide install,
+    or an outer ``tracking()`` block, stays armed) and — by default,
+    only when this block did the installing — clears the graph."""
+    was_installed = _INSTALLED
+    install()
+    try:
+        yield
+    finally:
+        if not was_installed:
+            uninstall()
+            if fresh:
+                reset()
+
+
+def maybe_install_from_env():
+    val = os.environ.get("PADDLE_TPU_LOCKCHECK", "")
+    if val.strip().lower() not in ("", "0", "false", "off", "no"):
+        install()

@@ -481,12 +481,16 @@ def cmd_checkgrad(ns, args, *, epsilon=None, rtol=5e-2, samples=6):
     # stage-stacked, but the check runs the plain graph
     tparams = trainer._flat_params_view()
 
+    # feed is a traced argument, not a closure capture: XLA embeds
+    # captures as program constants (graftlint PT101, the ~4x/step
+    # deopt class) — and the numeric loop below re-calls loss_fn with
+    # perturbed params against the SAME embedded batch either way
     @jax.jit
-    def loss_fn(params):
+    def loss_fn(params, feed):
         out = network.apply(params, feed, train=False)
         return jnp.sum(out[cost_name].value) / out[cost_name].value.shape[0]
 
-    analytic = jax.jit(jax.grad(loss_fn))(tparams)
+    analytic = jax.jit(jax.grad(loss_fn))(tparams, feed)
     rng = np.random.RandomState(args.seed)
     worst = 0.0
     failed = []
@@ -503,7 +507,8 @@ def cmd_checkgrad(ns, args, *, epsilon=None, rtol=5e-2, samples=6):
             pp[name] = jnp.asarray(p0 + delta, jnp.float32)
             pm = dict(tparams)
             pm[name] = jnp.asarray(p0 - delta, jnp.float32)
-            num = (float(loss_fn(pp)) - float(loss_fn(pm))) / (2 * epsilon)
+            num = (float(loss_fn(pp, feed))
+                   - float(loss_fn(pm, feed))) / (2 * epsilon)
             ana = float(np.asarray(g).reshape(-1)[idx])
             denom = max(abs(num), abs(ana), 1e-4)
             rel = abs(num - ana) / denom

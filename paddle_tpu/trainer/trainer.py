@@ -31,6 +31,8 @@ from paddle_tpu.config.model_config import ModelDef
 from paddle_tpu.testing import chaos as _chaos
 from paddle_tpu.core.argument import Argument
 from paddle_tpu.core.network import Network
+from paddle_tpu.data import prefetch as _prefetch
+from paddle_tpu.utils.masks import assert_mask_f32
 from paddle_tpu.optim.optimizers import Optimizer
 from paddle_tpu.parallel import mesh as mesh_lib
 from paddle_tpu.trainer import events as ev
@@ -181,10 +183,13 @@ class SGD:
         # recompile-guard: a ragged corpus with unbucketed shapes silently
         # retraces the step per batch; the guard makes that loud
         # (data/prefetch.py:RecompileGuard; warn_after=recompile_warn)
-        from paddle_tpu.data.prefetch import RecompileGuard
         from paddle_tpu.utils.profiler import StepBreakdown
-        self.recompile_guard = RecompileGuard(self._train_step,
-                                              warn_after=recompile_warn)
+        self.recompile_guard = _prefetch.RecompileGuard(
+            self._train_step, warn_after=recompile_warn)
+        # the eval forward thrashes the same way on unbucketed test
+        # corpora (graftlint PT104): guard it like the train step
+        self.eval_recompile_guard = _prefetch.RecompileGuard(
+            self._eval_step, warn_after=recompile_warn, name="eval_step")
         self.breakdown = StepBreakdown()
 
     def _cast_compute(self, tree):
@@ -216,6 +221,10 @@ class SGD:
                 # leaves too, so a mask carried anywhere in state (e.g. a
                 # group's state["nested"] Argument, layers/group.py) is
                 # exempted structurally — by type, not by key name.
+                # The runtime side of graftlint PT102/PT203: a mask that
+                # arrives below f32 fails AT TRACE TIME, here, not as a
+                # silently saturated denominator steps later.
+                assert_mask_f32(x.mask, "_cast_compute")
                 return x.replace(
                     value=jax.tree_util.tree_map(cast, x.value),
                     state=jax.tree_util.tree_map(
@@ -630,10 +639,9 @@ class SGD:
         self._rebuild_train_step()
 
     def _rebuild_train_step(self):
-        from paddle_tpu.data.prefetch import RecompileGuard
         self._train_step = self._build_train_step()
-        self.recompile_guard = RecompileGuard(self._train_step,
-                                              warn_after=self._recompile_warn)
+        self.recompile_guard = _prefetch.RecompileGuard(
+            self._train_step, warn_after=self._recompile_warn)
 
     # ------------------------------------------------------------ pipeline
     def enable_pipeline(self, microbatches: Optional[int] = None) -> bool:
@@ -1470,6 +1478,7 @@ class SGD:
             if self.mesh is not None:
                 feed = mesh_lib.shard_batch(feed, self.mesh)
             metrics = self._eval_step(self.params, feed)
+            self.eval_recompile_guard.check()
             total_cost += float(metrics["cost"])
             batches += 1
             self._accumulate(acc, metrics)
@@ -1554,6 +1563,7 @@ class SGD:
         program for the whole table (per-parameter eager reductions would
         trigger dozens of tiny compilations)."""
         raw = jax.device_get(_param_stats_jit(self.params))
+        _param_stats_guard.check()
         return {n: {"avg_abs": float(a), "max_abs": float(m),
                     "size": int(self.params[n].size)}
                 for n, (a, m) in raw.items()}
@@ -1591,8 +1601,11 @@ class SGD:
                         and jnp.issubdtype(a.value.dtype, jnp.inexact)}
 
             self._layer_stat_fn = stat_fn
+            self._layer_stat_guard = _prefetch.RecompileGuard(
+                stat_fn, warn_after=8, name="layer_stats")
         raw = jax.device_get(self._layer_stat_fn(self._flat_params_view(),
                                                  feed))
+        self._layer_stat_guard.check()
         return {n: {"avg_abs": float(a), "max_abs": float(m)}
                 for n, (a, m) in raw.items()}
 
@@ -1609,3 +1622,10 @@ class SGD:
 def _param_stats_jit(params):
     return {n: (jnp.mean(jnp.abs(v)), jnp.max(jnp.abs(v)))
             for n, v in params.items()}
+
+
+# module-level jit = one cache across every SGD instance in the
+# process; the guard makes per-topology cache growth loud (each
+# distinct param-dict structure is one legitimate variant)
+_param_stats_guard = _prefetch.RecompileGuard(
+    _param_stats_jit, warn_after=32, name="param_stats")

@@ -1,0 +1,72 @@
+"""Mask dtype invariant, enforced from both sides.
+
+Masks in this codebase are **f32 count data**: they are summed for
+token counts, per-row lengths and batch denominators, where bfloat16's
+8-bit mantissa saturates at 256 — a silently wrong denominator, not an
+error. The invariant is enforced three ways:
+
+- statically: graftlint PT102 (``paddle_tpu/analysis/ast_lints.py``)
+  flags source that casts a mask below f32;
+- at trace time: graftlint PT203 walks the jaxpr for converts of mask
+  inputs;
+- at run/trace time: :func:`assert_mask_f32` here, called where masks
+  enter compute (``trainer/trainer.py:_cast_compute``,
+  ``serving/predictor.py``) — dtype is static under tracing, so the
+  check is free inside jit and raises at trace time, before a single
+  step runs with a saturating mask.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+class MaskDtypeError(RuntimeError):
+    """A mask tensor is not float32 (the count-data invariant).
+
+    Deliberately NOT a TypeError/ValueError: the serving batcher's
+    bad-request funnel catches those and answers clients 400 — but a
+    sub-f32 mask is a SERVER bug (the feeder built it), and it must
+    take the loud worker-fatal path, never be blamed on the request."""
+
+
+# the invariant is "never BELOW f32": float64 (numpy's default — jax
+# canonicalizes it to f32 at trace time) and int/bool masks carry full
+# count precision and pass; only mantissa-losing float dtypes violate
+_SUB_F32 = {"bfloat16", "float16", "half"}
+
+
+def assert_mask_f32(mask: Any, where: str = "mask") -> Any:
+    """Validate (and return) a mask leaf: reject sub-f32 FLOAT dtypes
+    (bf16/f16 — the saturating ones). ``None`` passes through — dense
+    inputs have no mask. Works on traced values: ``dtype`` is static,
+    so inside jit this raises at trace time with zero runtime cost."""
+    if mask is None:
+        return None
+    dtype = getattr(mask, "dtype", None)
+    if dtype is None:
+        return mask  # python scalars/lists — feeder normalizes later
+    if str(dtype) in _SUB_F32:
+        raise MaskDtypeError(
+            f"{where}: mask dtype {dtype} — masks are f32 COUNT data "
+            "(summed for lengths/denominators; bf16 saturates at 256) "
+            "and must never be cast below float32. See "
+            "docs/static_analysis.md (PT102/PT203).")
+    return mask
+
+
+def assert_feed_masks_f32(feed: Any, where: str = "feed") -> Any:
+    """Validate every ``Argument.mask`` in a feed dict (recursing into
+    Argument state the way ``_cast_compute`` does); returns the feed."""
+    from paddle_tpu.core.argument import Argument
+
+    def go(name: str, x):
+        if isinstance(x, Argument):
+            assert_mask_f32(x.mask, f"{where}[{name}].mask")
+            if isinstance(x.state, dict):
+                for k, v in x.state.items():
+                    go(f"{name}.state[{k}]", v)
+    if isinstance(feed, dict):
+        for name, x in feed.items():
+            go(str(name), x)
+    return feed

@@ -1,0 +1,1057 @@
+"""graftlint analyzer self-tests: every rule has one known-bad fixture
+(the lint must flag it) and one known-good twin (the lint must stay
+silent), plus the runtime lock tracker's inversion tests — including
+the PR 6 ``MasterClient`` bug-class regression.
+"""
+
+import json
+import os
+import textwrap
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.analysis import jaxpr_audit as ja
+from paddle_tpu.analysis.ast_lints import (lint_layer_matrix, run_pass1)
+from paddle_tpu.analysis.baseline import (apply_baseline, load_baseline)
+from paddle_tpu.analysis.bench_schema import check_bench_file
+from paddle_tpu.analysis.findings import Finding
+from paddle_tpu.analysis.lockorder import run_pass3
+from paddle_tpu.testing import lockcheck
+
+
+# ---------------------------------------------------------------- helpers
+def _lint_snippet(tmp_path, source, rel="paddle_tpu/serving/mod.py"):
+    """Write one fixture module into a fake repo root and lint it."""
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    findings, suppressed = run_pass1(str(tmp_path), paths=[str(path)])
+    return findings, suppressed
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+# ------------------------------------------------------ PT101 fixtures
+BAD_CLOSURE = """
+    import jax
+    import jax.numpy as jnp
+
+    def make_step():
+        params = jnp.ones((4, 4))
+
+        def step(x):
+            return x @ params  # captured device array
+
+        return jax.jit(step)
+"""
+
+GOOD_CLOSURE = """
+    import jax
+    import jax.numpy as jnp
+
+    def make_step():
+        def step(params, x):
+            return x @ params
+
+        return jax.jit(step)
+"""
+
+
+def test_pt101_flags_closure_captured_array(tmp_path):
+    findings, _ = _lint_snippet(tmp_path, BAD_CLOSURE)
+    assert "PT101" in _rules(findings)
+    assert "params" in [f for f in findings
+                        if f.rule == "PT101"][0].message
+
+
+def test_pt101_silent_on_traced_args(tmp_path):
+    findings, _ = _lint_snippet(tmp_path, GOOD_CLOSURE)
+    assert "PT101" not in _rules(findings)
+
+
+def test_pt101_name_heuristic_catches_feed_capture(tmp_path):
+    # the exact shape of the cmd_checkgrad violation this PR fixed
+    findings, _ = _lint_snippet(tmp_path, """
+        import jax
+
+        def check(feeder, data, net):
+            feed = feeder(data) if feeder is not None else data
+
+            @jax.jit
+            def loss_fn(params):
+                return net.apply(params, feed)
+
+            return loss_fn
+    """)
+    assert "PT101" in _rules(findings)
+
+
+def test_pt101_catches_parameter_capture(tmp_path):
+    """Review regression: capturing an enclosing function's PARAMETER
+    (not a local assignment) is the same embedded-constant deopt and
+    must flag; passing it as a traced arg stays silent."""
+    findings, _ = _lint_snippet(tmp_path, """
+        import jax
+
+        def check(net, feed):
+            @jax.jit
+            def loss_fn(params):
+                return net.apply(params, feed)
+
+            return loss_fn
+    """)
+    assert "PT101" in _rules(findings)
+    findings, _ = _lint_snippet(tmp_path, """
+        import jax
+
+        def check(net, feed):
+            @jax.jit
+            def loss_fn(params, feed):
+                return net.apply(params, feed)
+
+            return loss_fn
+    """)
+    assert "PT101" not in _rules(findings)
+
+
+# ------------------------------------------------------ PT102 fixtures
+def test_pt102_flags_mask_bf16_cast(tmp_path):
+    findings, _ = _lint_snippet(tmp_path, """
+        import jax.numpy as jnp
+
+        def cast(feed):
+            return feed["mask"].astype(jnp.bfloat16)
+    """)
+    assert "PT102" in _rules(findings)
+
+
+def test_pt102_silent_on_value_cast_and_f32_mask(tmp_path):
+    findings, _ = _lint_snippet(tmp_path, """
+        import jax.numpy as jnp
+
+        def cast(feed):
+            v = feed["value"].astype(jnp.bfloat16)   # values may cast
+            m = feed["mask"].astype(jnp.float32)     # masks stay f32
+            return v, m
+    """)
+    assert "PT102" not in _rules(findings)
+
+
+# ------------------------------------------------------ PT103 fixtures
+def test_pt103_flags_pad_in_optim(tmp_path):
+    findings, _ = _lint_snippet(tmp_path, """
+        import jax.numpy as jnp
+
+        def _pack(flat, n, chunk):
+            flat = jnp.pad(flat, (0, n * chunk - flat.shape[0]))
+            return flat.reshape(n, chunk)
+    """, rel="paddle_tpu/optim/packer.py")
+    assert "PT103" in _rules(findings)
+
+
+def test_pt103_flags_marked_function_outside_optim(tmp_path):
+    findings, _ = _lint_snippet(tmp_path, """
+        import jax.numpy as jnp
+
+        # graftlint: bit-exact
+        def pack(flat, pad):
+            return jnp.pad(flat, (0, pad))
+    """, rel="paddle_tpu/parallel/util.py")
+    assert "PT103" in _rules(findings)
+
+
+def test_pt103_silent_on_concatenate_pack_and_layer_pad(tmp_path):
+    findings, _ = _lint_snippet(tmp_path, """
+        import jax.numpy as jnp
+
+        def _pack(flat, n, chunk):
+            pad = n * chunk - flat.shape[0]
+            if pad:
+                flat = jnp.concatenate(
+                    [flat, jnp.zeros((pad,), flat.dtype)])
+            return flat.reshape(n, chunk)
+    """, rel="paddle_tpu/optim/packer.py")
+    assert "PT103" not in _rules(findings)
+    # jnp.pad with padding SEMANTICS (a pad layer) is legal outside
+    findings, _ = _lint_snippet(tmp_path, """
+        import jax.numpy as jnp
+
+        def pad_layer(x, ph, pw):
+            return jnp.pad(x, ((0, 0), ph, pw, (0, 0)))
+    """, rel="paddle_tpu/layers/padding.py")
+    assert "PT103" not in _rules(findings)
+
+
+# ------------------------------------------------------ PT104 fixtures
+def test_pt104_flags_unguarded_persistent_jit(tmp_path):
+    findings, _ = _lint_snippet(tmp_path, """
+        import jax
+
+        class Predictor:
+            def __init__(self, fwd):
+                self._infer = jax.jit(fwd)
+    """)
+    assert "PT104" in _rules(findings)
+
+
+def test_pt104_satisfied_by_guard_or_policy_note(tmp_path):
+    findings, _ = _lint_snippet(tmp_path, """
+        import jax
+        from paddle_tpu.data.prefetch import RecompileGuard
+
+        class Predictor:
+            def __init__(self, fwd, enc):
+                self._infer = jax.jit(fwd)
+                self.guard = RecompileGuard(self._infer)
+                # graftlint: jit-cache: LRU-bounded elsewhere
+                self._encode = jax.jit(enc)
+    """)
+    assert "PT104" not in _rules(findings)
+
+
+def test_pt104_one_shot_jit_exempt_and_scope_limited(tmp_path):
+    findings, _ = _lint_snippet(tmp_path, """
+        import jax
+
+        def once(fwd, x):
+            return jax.jit(fwd)(x)   # immediately invoked: one-shot
+    """)
+    assert "PT104" not in _rules(findings)
+    # outside the hot-path module scope the rule does not apply
+    findings, _ = _lint_snippet(tmp_path, """
+        import jax
+
+        class Builder:
+            def __init__(self, fn):
+                self.jitted = jax.jit(fn)
+    """, rel="paddle_tpu/parallel/helper.py")
+    assert "PT104" not in _rules(findings)
+
+
+def test_pt104_sees_through_builder_return_chain(tmp_path):
+    # `return jax.jit(...)` inside _build_x, assigned via
+    # self._step = self._build_x(), guarded under the attr name —
+    # the trainer.py shape
+    findings, _ = _lint_snippet(tmp_path, """
+        import jax
+        from paddle_tpu.data.prefetch import RecompileGuard
+
+        class T:
+            def __init__(self, fn):
+                self._step = self._build_step(fn)
+                self.guard = RecompileGuard(self._step)
+
+            def _build_step(self, fn):
+                return jax.jit(fn)
+    """)
+    assert "PT104" not in _rules(findings)
+
+
+# ------------------------------------------------------ PT105 fixtures
+def test_pt105_flags_broad_pkill_in_shell_and_python(tmp_path):
+    sh = tmp_path / "tools" / "watch.sh"
+    sh.parent.mkdir(parents=True, exist_ok=True)
+    sh.write_text("#!/bin/bash\npkill -f python\n")
+    findings, _ = run_pass1(str(tmp_path), paths=[str(sh)])
+    assert "PT105" in _rules(findings)
+    findings, _ = _lint_snippet(tmp_path, """
+        import os
+
+        def stop():
+            os.system("pkill -f jax")
+    """, rel="tools/stop.py")
+    assert "PT105" in _rules(findings)
+
+
+def test_pt105_silent_on_narrow_pattern_and_docstrings(tmp_path):
+    sh = tmp_path / "tools" / "watch.sh"
+    sh.parent.mkdir(parents=True, exist_ok=True)
+    sh.write_text("#!/bin/bash\n"
+                  "pkill -f 'tools/tpu_evidence.py --round r99'\n")
+    findings, _ = run_pass1(str(tmp_path), paths=[str(sh)])
+    assert "PT105" not in _rules(findings)
+    # a docstring MENTIONING pkill -f python is not a kill command
+    findings, _ = _lint_snippet(tmp_path, '''
+        def helper():
+            """Never run `pkill -f python` on this host."""
+            return 1
+    ''', rel="tools/doc.py")
+    assert "PT105" not in _rules(findings)
+
+
+# ------------------------------------------------------ PT106 fixtures
+def _matrix_tree(tmp_path, covered):
+    (tmp_path / "paddle_tpu" / "layers").mkdir(parents=True,
+                                               exist_ok=True)
+    (tmp_path / "tests").mkdir(exist_ok=True)
+    (tmp_path / "paddle_tpu" / "layers" / "x.py").write_text(
+        textwrap.dedent("""
+            from paddle_tpu.core.registry import register_layer
+
+            @register_layer("zzz_test_layer")
+            class Z:
+                pass
+        """))
+    rows = '"zzz_test_layer": None' if covered else ""
+    (tmp_path / "tests" / "test_layer_grad_matrix.py").write_text(
+        f"GRAD_CASES = {{{rows}}}\nFWD_CASES = {{}}\n"
+        "COVERED_ELSEWHERE = {}\n")
+
+
+def test_pt106_flags_missing_matrix_row(tmp_path):
+    _matrix_tree(tmp_path, covered=False)
+    findings = lint_layer_matrix(str(tmp_path))
+    assert [f.rule for f in findings] == ["PT106"]
+    assert "zzz_test_layer" in findings[0].message
+
+
+def test_pt106_silent_when_covered(tmp_path):
+    _matrix_tree(tmp_path, covered=True)
+    assert lint_layer_matrix(str(tmp_path)) == []
+
+
+# ------------------------------------------------- inline suppression
+def test_inline_suppression_counts_and_silences(tmp_path):
+    findings, suppressed = _lint_snippet(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        def make_step():
+            params = jnp.ones((4, 4))
+
+            # graftlint: disable=jit-closure-capture
+            def step(x):
+                return x @ params
+
+            return jax.jit(step)
+    """)
+    assert "PT101" not in _rules(findings)
+    assert suppressed == 1
+
+
+# ------------------------------------------------------ PT2xx audits
+def test_pt201_flags_embedded_constant():
+    big = jnp.ones((200, 200), jnp.float32)  # 160 KB > CONST_LIMIT
+
+    def bad(x):
+        return x @ big
+
+    closed = jax.make_jaxpr(bad)(jnp.ones((2, 200)))
+    findings = ja._const_findings(closed, "bad", "x.py")
+    assert [f.rule for f in findings] == ["PT201"]
+
+    def good(w, x):
+        return x @ w
+
+    closed = jax.make_jaxpr(good)(big, jnp.ones((2, 200)))
+    assert ja._const_findings(closed, "good", "x.py") == []
+
+
+def test_pt203_flags_mask_convert_to_bf16():
+    ex = ({"v": jnp.ones((2, 3)), "mask": jnp.ones((2, 3))},)
+
+    def bad(feed):
+        # deliberate bad fixture for the jaxpr-level check below
+        m16 = feed["mask"].astype(jnp.bfloat16)  # graftlint: disable=PT102
+        return (feed["v"].astype(jnp.bfloat16) * m16).sum()
+
+    closed = jax.make_jaxpr(bad)(*ex)
+    findings = ja._mask_findings(closed, ja._mask_positions(ex),
+                                 "bad", "x.py")
+    assert [f.rule for f in findings] == ["PT203"]
+
+    def good(feed):
+        return (feed["v"].astype(jnp.bfloat16).astype(jnp.float32)
+                * feed["mask"]).sum()
+
+    closed = jax.make_jaxpr(good)(*ex)
+    assert ja._mask_findings(closed, ja._mask_positions(ex),
+                             "good", "x.py") == []
+
+
+def test_pt203_taint_flows_through_reshape():
+    ex = ({"mask": jnp.ones((2, 3))},)
+
+    def bad(feed):
+        # graftlint: disable=mask-bf16-cast — deliberate bad fixture
+        return feed["mask"].reshape(-1).astype(jnp.bfloat16).sum()
+
+    closed = jax.make_jaxpr(bad)(*ex)
+    findings = ja._mask_findings(closed, ja._mask_positions(ex),
+                                 "bad", "x.py")
+    assert [f.rule for f in findings] == ["PT203"]
+
+
+def test_pt202_donation_detects_missing_alias():
+    x = jnp.ones((8,), jnp.float32)
+    good = jax.jit(lambda a: a * 2, donate_argnums=(0,))
+    findings, stats = ja._donation_findings(good, (x,), (0,), "g",
+                                            "x.py")
+    assert findings == [] and stats["aliased"] == 1
+    # donation NOT declared but buffer aliasable: audit of an
+    # undonated jit reports the gap when asked to treat arg 0 donated
+    bad = jax.jit(lambda a: a * 2)
+    findings, stats = ja._donation_findings(bad, (x,), (0,), "b",
+                                            "x.py")
+    assert [f.rule for f in findings] == ["PT202"]
+    assert stats["aliased"] == 0 and stats["aliasable"] == 1
+
+
+# ------------------------------------------------------ PT3xx static
+BAD_LOCK_MODULE = """
+    import threading
+
+    class Wire:
+        def __init__(self):
+            self._sock_lock = threading.Lock()
+            self._resp_lock = threading.Lock()
+
+        def call(self):
+            with self._sock_lock:
+                with self._resp_lock:
+                    pass
+
+        def heartbeat(self):
+            with self._resp_lock:
+                with self._sock_lock:
+                    pass
+"""
+
+GOOD_LOCK_MODULE = """
+    import threading
+
+    class Wire:
+        def __init__(self):
+            self._sock_lock = threading.Lock()
+            self._resp_lock = threading.Lock()
+
+        def call(self):
+            with self._sock_lock:
+                with self._resp_lock:
+                    pass
+
+        def heartbeat(self):
+            with self._sock_lock:
+                with self._resp_lock:
+                    pass
+"""
+
+
+def _lock_check(tmp_path, source, name="wire.py"):
+    path = tmp_path / name
+    path.write_text(textwrap.dedent(source))
+    findings, checker = run_pass3(str(tmp_path), modules=[name])
+    return findings, checker
+
+
+def test_pt301_flags_static_lock_inversion(tmp_path):
+    findings, _ = _lock_check(tmp_path, BAD_LOCK_MODULE)
+    assert "PT301" in [f.rule for f in findings]
+
+
+def test_pt301_silent_on_consistent_order(tmp_path):
+    findings, checker = _lock_check(tmp_path, GOOD_LOCK_MODULE)
+    assert findings == []
+    assert len(checker.edges) == 1  # sock -> resp recorded once
+
+
+def test_pt302_flags_self_deadlock_through_call_chain(tmp_path):
+    findings, _ = _lock_check(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    pass
+    """)
+    assert "PT302" in [f.rule for f in findings]
+
+
+def test_pt301_sees_locks_nested_under_control_flow(tmp_path):
+    """Review regression: a `with self._lock:` under try/for/if (i.e.
+    virtually every worker-loop lock site) must be recorded with its
+    held context — the first cut silently skipped them."""
+    findings, checker = _lock_check(tmp_path, """
+        import threading
+
+        class Wire:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def call(self):
+                for _attempt in range(3):
+                    try:
+                        with self._a:
+                            if _attempt:
+                                with self._b:
+                                    pass
+                    except OSError:
+                        pass
+
+            def teardown(self):
+                while True:
+                    with self._b:
+                        with self._a:
+                            return
+    """)
+    assert "PT301" in [f.rule for f in findings]
+
+
+def test_pass3_records_worker_loop_acquisitions():
+    """The real modules' loop/try-nested lock sites are in the graph:
+    MasterClient.call's exchange lock (the PR 6 site, under for+try)
+    and the batcher worker's except-path lock."""
+    from paddle_tpu.analysis.lockorder import LockOrderChecker
+    ck = LockOrderChecker(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    ck.run()
+    call = ck.methods["paddle_tpu.dist.master.MasterClient.call"]
+    assert any(i == "paddle_tpu.dist.master.MasterClient._lock"
+               for _h, i, _l in call.acquires)
+    work = ck.methods["paddle_tpu.serving.batcher.ServingEngine._work"]
+    assert any(i == "paddle_tpu.serving.batcher.ServingEngine._lock"
+               for _h, i, _l in work.acquires)
+
+
+def test_pt301_module_level_function_call_edges(tmp_path):
+    """Review regression: callers that are MODULE-LEVEL functions (not
+    methods) must still resolve bare-name callees in the same module —
+    the first resolver mis-split dotted module names and dropped these
+    edges entirely."""
+    # a dotted fake-package path mirrors the real modules' depth
+    findings, checker = _lock_check(tmp_path, """
+        import threading
+
+        class Holder:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+        H = None
+
+        def path_one(h):
+            with h._a_proxy:
+                pass
+
+        class A:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def fwd(self):
+                with self._a:
+                    helper_b(self)
+
+            def rev(self):
+                with self._b:
+                    helper_a(self)
+
+        def helper_a(obj):
+            obj._a.acquire()
+            obj._a.release()
+
+        def helper_b(obj):
+            obj._b.acquire()
+            obj._b.release()
+    """, name="pkg_mod.py")
+    # helper_a/_b are module functions; their .acquire on a passed
+    # object is unresolvable by design — but the METHOD->module-fn
+    # call edge must resolve, which requires the module qual to be
+    # computed right. Assert resolution works at all:
+    assert checker._resolve_callee(
+        "helper_b", "pkg_mod.A.fwd") == "pkg_mod.helper_b"
+    # and for a module-level caller in the same module:
+    assert checker._resolve_callee(
+        "helper_a", "pkg_mod.helper_b") == "pkg_mod.helper_a"
+
+
+def test_pt301_thread_target_closure_not_attributed_to_caller(tmp_path):
+    """Review regression: a nested def handed to Thread(target=...)
+    runs LATER on another thread with nothing held — its acquires must
+    not fold into the enclosing method's transitive lockset (false
+    A->B edge), while a SYNCHRONOUS nested-def call must still count."""
+    findings, checker = _lock_check(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def start(self):
+                def _worker():
+                    with self._b:
+                        pass
+                with self._a:
+                    threading.Thread(target=_worker).start()
+
+            def other(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """)
+    # no a->b edge from the closure => no cycle with other()'s b->a
+    assert findings == [], [str(f) for f in findings]
+    findings, _ = _lock_check(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def start(self):
+                def _helper():
+                    with self._b:
+                        pass
+                with self._a:
+                    _helper()          # synchronous: edge a->b is real
+
+            def other(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """)
+    assert "PT301" in [f.rule for f in findings]
+
+
+def test_pt301_multi_item_with_keeps_held_for_later_items(tmp_path):
+    """Review regression: in `with self._a, make():` the make() call
+    runs with _a already held — its transitive locks must edge."""
+    findings, _ = _lock_check(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def fwd(self):
+                with self._a, self.make():
+                    pass
+
+            def make(self):
+                with self._b:
+                    return open("/dev/null")
+
+            def rev(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """)
+    assert "PT301" in [f.rule for f in findings]
+
+
+def test_lockcheck_detects_three_lock_cycle():
+    """Review regression: the tracker's contract is cycles, not just
+    2-lock inversions — A->B, B->C recorded, then C->A must raise."""
+    with lockcheck.tracking():
+        a = threading.Lock()
+        b = threading.Lock()
+        c = threading.Lock()
+        with a:
+            with b:
+                pass
+        with b:
+            with c:
+                pass
+        with pytest.raises(lockcheck.LockOrderError):
+            with c:
+                with a:
+                    pass
+
+
+def test_lockcheck_env_zero_means_off(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_LOCKCHECK", "0")
+    lockcheck.maybe_install_from_env()
+    assert not lockcheck.installed()
+    monkeypatch.setenv("PADDLE_TPU_LOCKCHECK", "1")
+    lockcheck.maybe_install_from_env()
+    try:
+        assert lockcheck.installed()
+    finally:
+        lockcheck.uninstall()
+        lockcheck.reset()
+
+
+def test_pt100_parse_failure_has_own_rule(tmp_path):
+    path = tmp_path / "tools" / "broken.py"
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text("def broken(:\n")
+    findings, _ = run_pass1(str(tmp_path), paths=[str(path)])
+    assert [f.rule for f in findings] == ["PT100"]
+
+
+def test_lockcheck_cross_thread_release_no_stale_held():
+    """Review regression: threading.Lock legally releases from another
+    thread (handoff pattern); the entry must come off the ACQUIRER's
+    held stack, or every later acquire in that thread records edges
+    from a lock it no longer holds (spurious LockOrderError)."""
+    with lockcheck.tracking():
+        handoff = threading.Lock()
+        other = threading.Lock()
+        handoff.acquire()          # main thread acquires
+
+        t = threading.Thread(target=handoff.release)  # other releases
+        t.start()
+        t.join()
+        assert handoff not in lockcheck._STATE.held(), \
+            "stale held entry after cross-thread release"
+        # no bogus handoff->other edge from this acquire (edges from
+        # handoff to Thread-internal locks taken during t.start() are
+        # real — main DID hold handoff then)
+        with other:
+            pass
+        assert (handoff.site, other.site) not in lockcheck.edges(), \
+            "edge recorded from a released lock"
+
+
+def test_pt302_silent_for_rlock(tmp_path):
+    findings, _ = _lock_check(tmp_path, """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    pass
+    """)
+    assert findings == []
+
+
+def test_pass3_repo_scope_covers_the_five_threaded_modules():
+    findings, checker = run_pass3(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert findings == []
+    covered = set(checker.modules)
+    for mod in ("paddle_tpu/serving/batcher.py",
+                "paddle_tpu/dist/master.py",
+                "paddle_tpu/dist/checkpoint.py",
+                "paddle_tpu/trainer/checkpoint.py",
+                "paddle_tpu/data/prefetch.py"):
+        assert mod in covered
+    # the graph is real: the engine lock is ordered before the metrics
+    # lock, and the master's RLock before its store/chaos locks
+    idents = {a.rsplit(".", 1)[-1] + "->" + b.rsplit(".", 1)[-1]
+              for a, b in checker.edges}
+    assert len(checker.locks) >= 8
+
+
+# ---------------------------------------------------- runtime tracker
+def test_lockcheck_detects_inversion_deterministically():
+    with lockcheck.tracking():
+        a = threading.Lock()
+        b = threading.Lock()
+        with a:
+            with b:
+                pass
+        with pytest.raises(lockcheck.LockOrderError):
+            with b:
+                with a:
+                    pass
+
+
+def test_lockcheck_self_deadlock_warns_and_handoff_completes():
+    """A holder's blocking re-acquire WARNS (real self-deadlocks hang
+    at the warned line) but must complete under a legal cross-thread
+    handoff release — raising here would fail correct rendezvous code
+    process-wide (review round 7)."""
+    with lockcheck.tracking():
+        lk = threading.Lock()
+        with pytest.warns(lockcheck.SelfDeadlockWarning):
+            lk.acquire()
+            import time
+            releaser = threading.Thread(
+                target=lambda: (time.sleep(0.05), lk.release()))
+            releaser.start()
+            lk.acquire()       # warned; completes after the handoff
+            releaser.join()
+        lk.release()
+
+
+def test_lockcheck_condition_composes():
+    with lockcheck.tracking():
+        cond = threading.Condition(threading.Lock())
+        hit = []
+
+        def waiter():
+            with cond:
+                cond.wait(timeout=2.0)
+                hit.append(1)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        import time
+        time.sleep(0.05)
+        with cond:
+            cond.notify_all()
+        t.join(timeout=5.0)
+        assert hit == [1]
+
+
+def test_lockcheck_pr6_masterclient_bug_class_regression():
+    """The PR 6 bug class: MasterClient's RPC exchange vs its heartbeat
+    thread. Pre-fix, the exchange path and the teardown/bookkeeping
+    path touched the socket state under DIFFERENT lock orders, cross-
+    wiring one thread's response into another. Reintroduce the shape —
+    call() takes sock-lock then state-lock, heartbeat takes state-lock
+    then sock-lock — and the tracker must fail the test, from a SINGLE
+    interleaving, no lucky race needed."""
+    with lockcheck.tracking():
+
+        class BuggyClient:
+            def __init__(self):
+                self._sock_lock = threading.Lock()
+                self._state_lock = threading.Lock()
+                self.desynced = False
+
+            def call(self):
+                with self._sock_lock:      # exchange scope
+                    with self._state_lock:  # records seq numbers
+                        pass
+
+            def heartbeat_teardown(self):
+                # the buggy order: bookkeeping first, socket second
+                with self._state_lock:
+                    with self._sock_lock:
+                        self.desynced = True
+
+        c = BuggyClient()
+        c.call()
+        with pytest.raises(lockcheck.LockOrderError):
+            c.heartbeat_teardown()
+
+
+def test_lockcheck_tracking_restores_prior_install_state():
+    """Review regression: a tracking() block inside a process armed
+    via PADDLE_TPU_LOCKCHECK must not disarm it on exit (and nested
+    blocks must not disarm the outer one)."""
+    lockcheck.install()
+    try:
+        with lockcheck.tracking():
+            with lockcheck.tracking():
+                assert lockcheck.installed()
+            assert lockcheck.installed()
+        assert lockcheck.installed(), \
+            "tracking() disarmed the process-wide install"
+    finally:
+        lockcheck.uninstall()
+        lockcheck.reset()
+    with lockcheck.tracking():
+        assert lockcheck.installed()
+    assert not lockcheck.installed()  # this block DID own the install
+
+
+def test_stale_baseline_with_unknown_rule_reports_not_crashes(tmp_path):
+    """Review regression: a typo'd rule id in a stale baseline entry
+    must come back as a printed finding (exit 1), not a KeyError on
+    the report path."""
+    from paddle_tpu.analysis.__main__ import run
+    bl = tmp_path / "baseline.toml"
+    bl.write_text('[[suppress]]\nrule = "PT1O4"\n'  # letter O typo
+                  'reason = "typo on purpose"\n')
+    rc = run(["--skip-jaxpr", "--baseline", str(bl)])
+    assert rc == 1
+    # a typo'd SHORT NAME can match no pass ever — it must be
+    # reported stale on every run, including --fast (review round 4)
+    bl.write_text('[[suppress]]\nrule = "unguarded-jits"\n'
+                  'reason = "typo on purpose"\n')
+    rc = run(["--skip-jaxpr", "--baseline", str(bl)])
+    assert rc == 1
+
+
+def test_lockcheck_condition_on_recursively_held_rlock():
+    """Review regression: Condition.wait() on a tracked RLock held at
+    TWO recursion levels must release both (via forwarded
+    _release_save) so a notifier can acquire and wake the waiter —
+    without the forwarding, the tracker itself deadlocked code that is
+    correct untracked."""
+    with lockcheck.tracking():
+        cond = threading.Condition(threading.RLock())
+        woke = []
+
+        def waiter():
+            with cond:
+                with cond:           # second recursion level
+                    if cond.wait(timeout=5.0):
+                        woke.append(1)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        import time
+        time.sleep(0.1)
+        got = cond.acquire(timeout=3.0)  # fails if wait kept a level
+        assert got, "notifier could not acquire: wait() kept the lock"
+        cond.notify_all()
+        cond.release()
+        t.join(timeout=5.0)
+        assert woke == [1]
+        assert cond._lock not in lockcheck._STATE.held()
+
+
+def test_lockcheck_clean_on_real_prefetch_pipeline():
+    """Real threaded code under the tracker: a full prefetch pass
+    (worker thread + bounded queue + consumer) records edges but no
+    inversion."""
+    with lockcheck.tracking():
+        from paddle_tpu.data.prefetch import PrefetchPipeline
+
+        def reader():
+            return iter([[1, 2], [3, 4], [5, 6]])
+
+        got = list(PrefetchPipeline(reader, feeder=lambda b: b,
+                                    place=False))
+        assert got == [[1, 2], [3, 4], [5, 6]]
+
+
+# ------------------------------------------------------ PT401 schema
+def test_pt401_schema_good_and_bad(tmp_path):
+    good = tmp_path / "BENCH_good.json"
+    good.write_text(json.dumps({
+        "metric": "x_ab", "platform": "cpu",
+        "a_steps_per_sec": 10.0, "b_steps_per_sec": 5.0,
+        "a_vs_b": 2.0}))
+    assert check_bench_file(str(good), "BENCH_good.json") == []
+
+    bad = tmp_path / "BENCH_bad.json"
+    bad.write_text("{truncated")
+    fs = check_bench_file(str(bad), "BENCH_bad.json")
+    assert [f.rule for f in fs] == ["PT401"]
+
+    nan = tmp_path / "BENCH_nan.json"
+    nan.write_text('{"metric": "m", "platform": "cpu", '
+                   '"a": 1.0, "b": 2.0, "a_vs_b": NaN}')
+    fs = check_bench_file(str(nan), "BENCH_nan.json")
+    assert any("non-finite" in f.message for f in fs)
+
+    shapeless = tmp_path / "BENCH_shapeless.json"
+    shapeless.write_text('{"hello": 1}')
+    fs = check_bench_file(str(shapeless), "BENCH_shapeless.json")
+    assert any("unrecognized" in f.message for f in fs)
+
+    # ratio without its sides: best-of evidence not re-checkable
+    lonely = tmp_path / "BENCH_lonely.json"
+    lonely.write_text('{"metric": "m", "platform": "cpu", '
+                      '"a_vs_b": 2.0}')
+    fs = check_bench_file(str(lonely), "BENCH_lonely.json")
+    assert any("lacks its two sides" in f.message for f in fs)
+
+
+# ----------------------------------------------------------- baseline
+def test_baseline_parse_apply_and_stale(tmp_path):
+    bl = tmp_path / "baseline.toml"
+    bl.write_text(textwrap.dedent("""
+        # comment
+        [[suppress]]
+        rule = "PT104"
+        path = "paddle_tpu/models/gan.py"
+        line = 78
+        reason = "parked for the example"
+
+        [[suppress]]
+        rule = "jit-closure-capture"
+        path = "paddle_tpu/x.py"
+        reason = "stale entry"
+    """))
+    entries = load_baseline(str(bl))
+    assert len(entries) == 2
+    findings = [Finding("PT104", "paddle_tpu/models/gan.py", 78, "m")]
+    kept, suppressed, stale = apply_baseline(findings, entries)
+    assert kept == [] and suppressed == 1
+    assert len(stale) == 1 and stale[0].path == "paddle_tpu/x.py"
+
+
+def test_stale_baseline_scoped_to_passes_that_ran(tmp_path):
+    """Review regression: a baselined PT2xx entry must not read as
+    STALE when the jaxpr pass was skipped (--fast), or the fast and
+    full CI paths could never both be green with a non-empty
+    baseline."""
+    from paddle_tpu.analysis.__main__ import run
+    bl = tmp_path / "baseline.toml"
+    bl.write_text('[[suppress]]\nrule = "PT202"\n'
+                  'reason = "parked pending donation fix"\n')
+    rc = run(["--skip-jaxpr", "--baseline", str(bl)])
+    assert rc == 0  # unused PT202 entry, but its pass did not run
+    bl.write_text('[[suppress]]\nrule = "PT401"\n'
+                  'path = "BENCH_never_existed.json"\n'
+                  'reason = "stale on purpose"\n')
+    rc = run(["--skip-jaxpr", "--baseline", str(bl)])
+    assert rc == 1  # schema pass ran; its stale entry is a finding
+
+
+def test_baseline_rejects_reasonless_entries(tmp_path):
+    bl = tmp_path / "baseline.toml"
+    bl.write_text('[[suppress]]\nrule = "PT104"\n')
+    with pytest.raises(ValueError, match="reason"):
+        load_baseline(str(bl))
+
+
+# ------------------------------------------------- masks.py satellite
+def test_assert_mask_f32_two_sided():
+    from paddle_tpu.core.argument import Argument
+    from paddle_tpu.utils.masks import (MaskDtypeError, assert_mask_f32,
+                                        assert_feed_masks_f32)
+    ok = jnp.ones((2, 3), jnp.float32)
+    assert assert_mask_f32(ok) is ok
+    assert assert_mask_f32(None) is None
+    # the invariant is "never BELOW f32": float64 (numpy's default,
+    # canonicalized by jax), int and bool masks carry full count
+    # precision and must pass — only the saturating floats reject
+    assert_mask_f32(np.ones((2, 3)))              # float64
+    assert_mask_f32(np.ones((2, 3), np.int32))
+    assert_mask_f32(np.ones((2, 3), bool))
+    with pytest.raises(MaskDtypeError):
+        assert_mask_f32(jnp.ones((2, 3), jnp.bfloat16))
+    with pytest.raises(MaskDtypeError):
+        assert_mask_f32(np.ones((2, 3), np.float16))
+    feed = {"x": Argument(value=jnp.ones((2, 3)), mask=ok)}
+    assert assert_feed_masks_f32(feed) is feed
+    bad = {"x": Argument(value=jnp.ones((2, 3)),
+                         mask=jnp.ones((2, 3), jnp.bfloat16))}
+    with pytest.raises(MaskDtypeError, match="x"):
+        assert_feed_masks_f32(bad)
+
+
+def test_cast_compute_rejects_bf16_mask_at_trace_time():
+    """The trainer-side wiring: a sub-f32 mask entering _cast_compute
+    raises immediately (trace time), not after a saturated sum."""
+    from paddle_tpu.config import dsl
+    from paddle_tpu.optim import Adam
+    from paddle_tpu.trainer import SGD
+    from paddle_tpu.core.argument import Argument
+    from paddle_tpu.utils.masks import MaskDtypeError
+
+    dsl.reset()
+    x = dsl.data(name="x", size=4, is_sequence=True)
+    lab = dsl.data(name="label", size=2)
+    pooled = dsl.pooling(input=x, pooling_type="avg", name="pool")
+    out = dsl.fc(input=pooled, size=2, act="softmax", name="out")
+    cost = dsl.classification_cost(input=out, label=lab)
+    tr = SGD(cost=cost, update_equation=Adam(learning_rate=1e-3),
+             compute_dtype="bfloat16")
+    feed = {"x": Argument(value=jnp.ones((2, 3, 4)),
+                          mask=jnp.ones((2, 3), jnp.bfloat16)),
+            "label": Argument(value=jnp.zeros((2,), jnp.int32))}
+    with pytest.raises(MaskDtypeError):
+        tr._cast_compute(feed)

@@ -174,12 +174,24 @@ def test_deadline_enforced_mid_decode():
     """A lane whose deadline passes while the search is still running is
     answered ``DeadlineExceeded`` at the next chunk boundary — not when
     the batch finishes — and its neighbor completes untouched."""
-    # 192 one-step chunks of a never-ending search give a wide window
-    # for the 40 ms deadline to land strictly mid-decode on a host with
-    # +-50% throughput drift: admission takes ~1 chunk (everything is
-    # warmed, including the lane-flag reductions), the full search ~10x
-    # the deadline
+    # 192 one-step chunks of a never-ending search, with a floor put
+    # under each chunk's wall time: relying on the model being slow
+    # enough broke when host drift made the warmed tiny search outrun
+    # the 40 ms deadline entirely (the whole decode beat the deadline,
+    # doomed was answered cleanly). 5 ms/chunk pins the full search at
+    # >= ~1 s regardless of drift, so the deadline ALWAYS lands
+    # strictly mid-decode: admission takes ~1 chunk, expiry by ~chunk 8
+    # of 192 — same spirit as the chaos plane's straggler injection,
+    # modeling a slower device step without touching semantics.
     eng = _build_engine(max_length=192, decode_chunk=1, max_batch=2)
+    real_chunk = eng._session.run_chunk
+
+    def slow_chunk(*a, **kw):
+        out = real_chunk(*a, **kw)
+        time.sleep(0.005)
+        return out
+
+    eng._session.run_chunk = slow_chunk
     try:
         neighbor = eng.submit(_long(), kind="generate")
         doomed = eng.submit(_long(), kind="generate", deadline_ms=40.0)

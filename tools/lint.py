@@ -1,0 +1,33 @@
+#!/usr/bin/env python
+"""CI lint entry: graftlint's three passes + the bench-artifact schema
+check, with rule-count summary and non-zero exit on any finding.
+
+    python tools/lint.py            # everything (jaxpr audit included)
+    python tools/lint.py --fast     # AST + locks + schema only
+    python tools/lint.py --no-entry # audit without the ResNet build
+
+This is a thin wrapper over ``python -m paddle_tpu.analysis`` so CI
+and humans run the identical engine; see docs/static_analysis.md for
+the rule catalog and suppression policy.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    # CPU-platform forcing (wedged-tunnel protection) lives in ONE
+    # place: paddle_tpu.analysis.__main__.run(), which this calls
+    argv = sys.argv[1:]
+    if "--fast" in argv:
+        argv = [a for a in argv if a != "--fast"] + ["--skip-jaxpr"]
+    from paddle_tpu.analysis.__main__ import run
+
+    return run(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
