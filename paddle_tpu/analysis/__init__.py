@@ -1,6 +1,6 @@
 """graftlint: framework-aware static analysis for paddle_tpu.
 
-Three passes (``python -m paddle_tpu.analysis`` runs them all):
+Four passes (``python -m paddle_tpu.analysis`` runs them all):
 
 1. **AST invariant lints** (``ast_lints.py``) — pure source analysis
    over ``paddle_tpu/``, ``tests/``, ``tools/``: closure-captured
@@ -16,9 +16,16 @@ Three passes (``python -m paddle_tpu.analysis`` runs them all):
    lock-acquisition graph over the threaded modules (serving batcher,
    master, checkpoint writers, prefetch) with cycle detection; the
    runtime twin is ``paddle_tpu.testing.lockcheck``.
+4. **Sharding & collective audit** (``shard_audit.py``) — compiles
+   the real parallel programs (dp train, zero1, GPipe pipeline, TP
+   embedding, ring attention, serving warm path) on the 8-device
+   virtual mesh and pins their collective manifest against
+   ``comm_budget.toml`` (only-shrinks), plus unintended-replication,
+   unpinned-pack, reshard-copy, and ``rule_for``-table checks.
 
-Plus the ``BENCH_*.json`` artifact schema check (``bench_schema.py``)
-that ``tools/lint.py`` runs alongside.
+Plus the evidence-artifact schema check (``bench_schema.py``:
+``BENCH_*``/``MULTICHIP_*``/``ACCURACY_*.json``) that ``tools/lint.py``
+runs alongside.
 
 Findings carry file:line + stable rule ids (``findings.RULES``); the
 suppression policy and rule catalog live in ``docs/static_analysis.md``.

@@ -1,5 +1,5 @@
-"""CLI: ``python -m paddle_tpu.analysis`` — run the three graftlint
-passes (plus the bench-artifact schema check) over the repo.
+"""CLI: ``python -m paddle_tpu.analysis`` — run the four graftlint
+passes (plus the artifact schema check) over the repo.
 
 Exit status 0 = clean; 1 = findings; 2 = analysis itself failed.
 ``tools/lint.py`` is the thin CI wrapper over this module.
@@ -8,6 +8,7 @@ Exit status 0 = clean; 1 = findings; 2 = analysis itself failed.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 import time
@@ -15,7 +16,7 @@ from typing import List
 
 from paddle_tpu.analysis.baseline import apply_baseline, load_baseline
 from paddle_tpu.analysis.findings import (RULE_BY_NAME, RULES, Finding,
-                                          format_report)
+                                          format_report, rule_counts)
 
 
 from paddle_tpu.analysis._astutil import repo_root
@@ -26,13 +27,18 @@ def run(argv: List[str] = None) -> int:
         prog="python -m paddle_tpu.analysis",
         description="graftlint: framework-aware static analysis "
                     "(AST invariant lints, jaxpr/donation audits, "
-                    "lock-order checker, bench-artifact schema)")
+                    "lock-order checker, sharding/collective audit, "
+                    "artifact schema)")
     ap.add_argument("--root", default=repo_root())
     ap.add_argument("--skip-ast", action="store_true")
     ap.add_argument("--skip-jaxpr", action="store_true",
                     help="skip the trace-time audits (the slow pass)")
     ap.add_argument("--skip-locks", action="store_true")
     ap.add_argument("--skip-schema", action="store_true")
+    ap.add_argument("--skip-shard", action="store_true",
+                    help="skip pass 4 (sharding/collective audit of "
+                         "the parallel programs; the slowest pass — "
+                         "it compiles on the 8-device virtual mesh)")
     ap.add_argument("--no-entry", action="store_true",
                     help="jaxpr pass without the flagship "
                          "__graft_entry__ build (~20s on 1 core)")
@@ -40,7 +46,34 @@ def run(argv: List[str] = None) -> int:
                     help="print the lock graph even when clean")
     ap.add_argument("--baseline", default=None,
                     help="baseline.toml path (default: the package's)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output: one JSON object on "
+                         "stdout (findings + counts); progress goes "
+                         "to stderr")
     args = ap.parse_args(argv)
+
+    # with --json, stdout is the machine contract — progress narration
+    # moves to stderr so `python -m paddle_tpu.analysis --json | jq .`
+    # always parses, INCLUDING the exit-2 paths (an audit crash still
+    # hands the JSON consumer the findings collected before it)
+    if args.json:
+        def emit(*a, **k):
+            print(*a, file=sys.stderr, **k)
+    else:
+        emit = print
+
+    def finding_dicts(fs):
+        return [{"rule": f.rule, "name": f.name, "file": f.path,
+                 "line": f.line, "message": f.message} for f in fs]
+
+    def fail_json(error: str, collected) -> int:
+        if args.json:
+            print(json.dumps({
+                "error": error,
+                "findings": finding_dicts(collected),
+                "counts": rule_counts(collected),
+            }, indent=1))
+        return 2
 
     findings: List[Finding] = []
     inline_suppressed = 0
@@ -49,24 +82,31 @@ def run(argv: List[str] = None) -> int:
     # under --skip-jaxpr and the fast/full paths could never both pass
     ran_prefixes: List[str] = []
     t0 = time.time()
+    pass4_dt = None
 
-    if not args.skip_jaxpr:
-        # force the CPU platform BEFORE any jax import: the audit
-        # traces real programs, and on the TPU host a wedged axon
-        # tunnel would otherwise hang the lint for hours (CLAUDE.md)
+    if not (args.skip_jaxpr and args.skip_shard):
+        # force the CPU platform BEFORE any jax import: the audits
+        # trace real programs, and on the TPU host a wedged axon
+        # tunnel would otherwise hang the lint for hours (CLAUDE.md).
+        # Pass 4 additionally needs the 8-device virtual mesh.
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
         try:
             import jax
 
             jax.config.update("jax_platforms", "cpu")
-        except Exception:  # noqa: BLE001 — pass 2 will surface it
+        except Exception:  # noqa: BLE001 — pass 2/4 will surface it
             pass
 
     if not args.skip_ast:
         from paddle_tpu.analysis.ast_lints import run_pass1
         fs, sup = run_pass1(args.root)
-        print(f"[pass 1] AST invariant lints: {len(fs)} findings "
-              f"({sup} inline-suppressed)")
+        emit(f"[pass 1] AST invariant lints: {len(fs)} findings "
+             f"({sup} inline-suppressed)")
         findings.extend(fs)
         inline_suppressed += sup
         ran_prefixes.append("PT1")
@@ -74,42 +114,63 @@ def run(argv: List[str] = None) -> int:
     if not args.skip_locks:
         from paddle_tpu.analysis.lockorder import run_pass3
         fs, checker = run_pass3(args.root)
-        print(f"[pass 3] lock-order: {len(checker.locks)} locks, "
-              f"{len(checker.edges)} order edges, {len(fs)} findings")
+        emit(f"[pass 3] lock-order: {len(checker.locks)} locks, "
+             f"{len(checker.edges)} order edges, {len(fs)} findings")
         if args.describe_locks:
-            print(checker.describe())
+            emit(checker.describe())
         findings.extend(fs)
         ran_prefixes.append("PT3")
 
     if not args.skip_schema:
         from paddle_tpu.analysis.bench_schema import run_schema_check
         fs = run_schema_check(args.root)
-        print(f"[schema] BENCH_*.json: {len(fs)} findings")
+        emit(f"[schema] BENCH/MULTICHIP/ACCURACY artifacts: "
+             f"{len(fs)} findings")
         findings.extend(fs)
         ran_prefixes.append("PT4")
 
     if not args.skip_jaxpr:
         from paddle_tpu.analysis.jaxpr_audit import run_pass2
-        print("[pass 2] jaxpr/lowering audits:")
+        emit("[pass 2] jaxpr/lowering audits:")
         try:
-            fs = run_pass2(args.root, log=print,
+            fs = run_pass2(args.root, log=emit,
                            include_entry=not args.no_entry)
         except Exception as e:  # noqa: BLE001 — surfaced as exit 2
-            print(f"[pass 2] AUDIT FAILED to run: {e!r}")
+            emit(f"[pass 2] AUDIT FAILED to run: {e!r}")
             if findings:
                 # the crash must not bury what the other passes found
-                print(format_report(
+                emit(format_report(
                     findings, "findings collected before the crash:"))
-            return 2
-        print(f"[pass 2] {len(fs)} findings")
+            return fail_json(f"pass 2 audit failed to run: {e!r}",
+                             findings)
+        emit(f"[pass 2] {len(fs)} findings")
         findings.extend(fs)
         ran_prefixes.append("PT2")
+
+    if not args.skip_shard:
+        from paddle_tpu.analysis.shard_audit import run_pass4
+        emit("[pass 4] sharding/collective audit (8-device virtual "
+             "mesh):")
+        t4 = time.time()
+        try:
+            fs = run_pass4(args.root, log=emit)
+        except Exception as e:  # noqa: BLE001 — surfaced as exit 2
+            emit(f"[pass 4] AUDIT FAILED to run: {e!r}")
+            if findings:
+                emit(format_report(
+                    findings, "findings collected before the crash:"))
+            return fail_json(f"pass 4 audit failed to run: {e!r}",
+                             findings)
+        pass4_dt = time.time() - t4
+        emit(f"[pass 4] {len(fs)} findings ({pass4_dt:.1f}s)")
+        findings.extend(fs)
+        ran_prefixes.append("PT5")
 
     try:
         entries = load_baseline(args.baseline)
     except ValueError as e:
-        print(f"baseline error: {e}")
-        return 2
+        emit(f"baseline error: {e}")
+        return fail_json(f"baseline error: {e}", findings)
     findings, baselined, stale = apply_baseline(findings, entries)
     from paddle_tpu.analysis.baseline import default_baseline_path
     baseline_rel = os.path.relpath(
@@ -129,9 +190,23 @@ def run(argv: List[str] = None) -> int:
             "baseline only shrinks)"))
 
     dt = time.time() - t0
-    print(f"\ngraftlint: {len(findings)} findings, "
-          f"{baselined} baselined, {inline_suppressed} "
-          f"inline-suppressed ({dt:.1f}s)")
+    # the pass-4 wall time rides the summary line so runtime creep in
+    # the compile-heavy pass is visible run over run
+    p4 = f", pass4 {pass4_dt:.1f}s" if pass4_dt is not None else ""
+    emit(f"\ngraftlint: {len(findings)} findings, "
+         f"{baselined} baselined, {inline_suppressed} "
+         f"inline-suppressed ({dt:.1f}s{p4})")
+    if args.json:
+        print(json.dumps({
+            "findings": finding_dicts(findings),
+            "counts": rule_counts(findings),
+            "baselined": baselined,
+            "inline_suppressed": inline_suppressed,
+            "elapsed_s": round(dt, 3),
+            "pass4_s": (round(pass4_dt, 3)
+                        if pass4_dt is not None else None),
+        }, indent=1))
+        return 1 if findings else 0
     if findings:
         print(format_report(findings))
         return 1

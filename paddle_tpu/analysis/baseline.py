@@ -30,6 +30,43 @@ _KV_RE = re.compile(
     r"\s*(#.*)?$")
 
 
+def parse_toml_tables(path: str, label: str, header: str, factory,
+                      int_keys=(), str_keys=()):
+    """Shared TOML-subset array-of-tables parser (the py3.10 container
+    has no tomllib): ``[[header]]`` rows of ``key = "str" | int``
+    pairs. Used by both suppression files — the baseline here and
+    ``shard_audit``'s comm budget — so a parser fix lands in one
+    place. Keys outside ``int_keys``/``str_keys`` are ignored (forward
+    compatible); a key before the first table or an unparseable line
+    raises ``ValueError`` naming ``label``."""
+    entries = []
+    current = None
+    for raw in open(path, encoding="utf-8"):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line == header:
+            current = factory()
+            entries.append(current)
+            continue
+        m = _KV_RE.match(raw)
+        if m and current is not None:
+            key = m.group(1)
+            val = m.group(3) if m.group(3) is not None else (
+                m.group(4) if m.group(4) is not None else m.group(5))
+            if key in int_keys:
+                setattr(current, key, int(val))
+            elif key in str_keys:
+                setattr(current, key, val)
+            continue
+        if m and current is None:
+            raise ValueError(
+                f"{label} {path}: key outside a {header} table: "
+                f"{line!r}")
+        raise ValueError(f"{label} {path}: unparseable line {line!r}")
+    return entries
+
+
 class BaselineEntry:
     __slots__ = ("rule", "path", "line", "reason")
 
@@ -60,31 +97,9 @@ def load_baseline(path: Optional[str] = None) -> List[BaselineEntry]:
     path = path or default_baseline_path()
     if not os.path.exists(path):
         return []
-    entries: List[BaselineEntry] = []
-    current: Optional[BaselineEntry] = None
-    for raw in open(path, encoding="utf-8"):
-        line = raw.strip()
-        if not line or line.startswith("#"):
-            continue
-        if line == "[[suppress]]":
-            current = BaselineEntry()
-            entries.append(current)
-            continue
-        m = _KV_RE.match(raw)
-        if m and current is not None:
-            key = m.group(1)
-            val = m.group(3) if m.group(3) is not None else (
-                m.group(4) if m.group(4) is not None else m.group(5))
-            if key == "line":
-                current.line = int(val)
-            elif key in ("rule", "path", "reason"):
-                setattr(current, key, val)
-            continue
-        if m and current is None:
-            raise ValueError(
-                f"baseline {path}: key outside a [[suppress]] table: "
-                f"{line!r}")
-        raise ValueError(f"baseline {path}: unparseable line {line!r}")
+    entries = parse_toml_tables(
+        path, "baseline", "[[suppress]]", BaselineEntry,
+        int_keys=("line",), str_keys=("rule", "path", "reason"))
     for e in entries:
         if not e.rule or not e.reason:
             raise ValueError(

@@ -1,22 +1,30 @@
-"""``BENCH_*.json`` artifact schema check (PT401).
+"""Evidence-artifact schema check (PT401): ``BENCH_*.json``,
+``MULTICHIP_*.json`` and ``ACCURACY_*.json``.
 
-Bench artifacts are the perf evidence trail (one JSON object per line /
-file, per-metric best-of structure, CLAUDE.md's interleaved best-of-R
-discipline). A malformed artifact — truncated JSON, a NaN ratio, an
-A/B metric missing its sides — should fail at *lint* time, not at
-ROADMAP-review time when the run that produced it is long gone.
+These artifacts are the evidence trail (perf best-of-R discipline,
+multichip dryruns, real-corpus accuracy runs). A malformed artifact —
+truncated JSON, a NaN ratio, an A/B metric missing its sides — should
+fail at *lint* time, not at ROADMAP-review time when the run that
+produced it is long gone.
 
-Recognized shapes (all are real generations of bench output in this
-repo):
+The artifact FAMILY is keyed by filename (content sniffing would let a
+truncated artifact of one family quietly validate against another's
+looser schema):
 
-- **metric style** (r07+, also BENCH_LIVE): ``{"metric": str,
-  "platform": str, ...}``; every ``*_vs_*`` ratio key must be a finite
-  number (or null when a side was skipped), and both sides of an A/B
-  must be present when the ratio is.
-- **harness style** (r01–r05): ``{"n": ..., "cmd": str, "rc": int,
-  ...}``.
-- **watcher style** (r06): ``{"round": ..., "cmd": ..., "parsed":
-  dict, ...}``.
+- ``MULTICHIP_*``: ``{"n_devices": int, "rc": int, "ok": bool,
+  "skipped": bool, "tail": str}`` — the ``dryrun_multichip`` capture;
+  the tail is the re-checkable evidence and must be present even on a
+  skip.
+- ``ACCURACY_*``: ``{"platform": str, ...}`` plus at least one named
+  run section (a dict) — an accuracy artifact with no run sections
+  recorded nothing.
+- ``BENCH_*`` (shape-sniffed among its real generations):
+  **metric style** (r07+, also BENCH_LIVE) ``{"metric": str,
+  "platform": str, ...}`` where every ``*_vs_*`` ratio key must be a
+  finite number (or null when a side was skipped) with both A/B sides
+  present; **harness style** (r01–r05) ``{"n": ..., "cmd": str, "rc":
+  int, ...}``; **watcher style** (r06) ``{"round": ..., "cmd": ...,
+  "parsed": dict, ...}``.
 
 Everything must parse as one JSON object with finite numbers
 throughout (NaN/Infinity are emitted by a crashed averaging step and
@@ -61,8 +69,34 @@ def check_bench_file(path: str, rel: str) -> List[Finding]:
         bad(f"bench artifact must be one JSON object, got "
             f"{type(data).__name__}")
         return findings
-    # shape identification
-    if "metric" in data:
+    # the artifact FAMILY comes from the filename, not sniffed content:
+    # a BENCH file whose crashed writer dropped 'metric' but kept
+    # 'platform' must fail as an unrecognized bench shape, not
+    # quietly validate against the (looser) accuracy schema
+    base = os.path.basename(rel)
+    if base.startswith("MULTICHIP_"):
+        # the dryrun_multichip capture
+        if not isinstance(data.get("n_devices"), int) or isinstance(
+                data.get("n_devices"), bool):
+            bad("multichip artifact missing int 'n_devices'")
+        if not isinstance(data.get("rc"), int) or isinstance(
+                data.get("rc"), bool):
+            bad("multichip artifact missing int 'rc'")
+        for key in ("ok", "skipped"):
+            if not isinstance(data.get(key), bool):
+                bad(f"multichip artifact missing bool {key!r}")
+        if not isinstance(data.get("tail"), str):
+            bad("multichip artifact missing str 'tail' (the "
+                "re-checkable dryrun evidence)")
+    elif base.startswith("ACCURACY_"):
+        # platform + named run sections
+        if not (isinstance(data.get("platform"), str)
+                and data.get("platform")):
+            bad("accuracy artifact needs a non-empty str 'platform'")
+        if not any(isinstance(v, dict) for v in data.values()):
+            bad("accuracy artifact has no named run section "
+                "(at least one config's results object)")
+    elif "metric" in data:
         if not (isinstance(data["metric"], str) and data["metric"]):
             bad("'metric' must be a non-empty string")
         if not isinstance(data.get("platform"), str):
@@ -109,7 +143,9 @@ def check_bench_file(path: str, rel: str) -> List[Finding]:
 
 
 def run_schema_check(root: str,
-                     patterns: Sequence[str] = ("BENCH_*.json",)
+                     patterns: Sequence[str] = ("BENCH_*.json",
+                                                "MULTICHIP_*.json",
+                                                "ACCURACY_*.json")
                      ) -> List[Finding]:
     findings: List[Finding] = []
     for pattern in patterns:

@@ -8,7 +8,11 @@ suppressions and the baseline reference them — and grouped by pass:
 - ``PT2xx`` — Pass 2, trace-time jaxpr/lowering audits.
 - ``PT3xx`` — Pass 3, lock-order analysis (static graph + runtime
   tracker ``paddle_tpu/testing/lockcheck.py``).
-- ``PT4xx`` — artifact schema checks (``BENCH_*.json``).
+- ``PT4xx`` — artifact schema checks (``BENCH_*``/``MULTICHIP_*``/
+  ``ACCURACY_*.json``).
+- ``PT5xx`` — Pass 4, sharding & collective-communication audit of the
+  real parallel programs on the 8-device virtual mesh
+  (``shard_audit.py``; budget in ``comm_budget.toml``).
 """
 
 from __future__ import annotations
@@ -73,8 +77,36 @@ RULES: Dict[str, Tuple[str, str]] = {
         "the same call path"),
     "PT401": (
         "bench-schema",
-        "BENCH_*.json artifact violates the bench schema (keys, "
-        "per-metric best-of structure, finite numbers)"),
+        "evidence artifact (BENCH_*/MULTICHIP_*/ACCURACY_*.json) "
+        "violates its schema (keys, per-metric best-of structure, "
+        "finite numbers)"),
+    "PT501": (
+        "collective-budget",
+        "a traced parallel program's collective footprint (op sites / "
+        "byte volume per mesh axis) drifted from the committed "
+        "analysis/comm_budget.toml manifest — communication grew "
+        "unjustified, or a win was left unpinned (the budget only "
+        "shrinks)"),
+    "PT502": (
+        "unintended-replication",
+        "a large parameter/optimizer slot a program's contract says is "
+        "sharded is placed fully replicated despite a matching mesh "
+        "axis — every device pays its full bytes"),
+    "PT503": (
+        "unpinned-shard-map-pack",
+        "a packed (concatenate/pad) buffer enters a shard_map's "
+        "sharded in_spec with no with_sharding_constraint pin; "
+        "propagation can rewrite the producing backward (the r07 2x "
+        "regression)"),
+    "PT504": (
+        "reshard-copy",
+        "the same value chain is pinned to two different shardings in "
+        "one program — each transition is a reshard copy"),
+    "PT505": (
+        "dead-shard-rule",
+        "a rule_for table key is dead (matches no parameter), an "
+        "=-exact key that exact-matches nothing, or is fully shadowed "
+        "by an earlier key"),
 }
 
 # name -> id (suppression comments may use either spelling)

@@ -296,27 +296,20 @@ def audit_train_step(log=print) -> List[Finding]:
     return findings
 
 
-def audit_serving(log=print) -> List[Finding]:
-    """The serving warm path: a bucketed scoring predictor's ``_infer``
-    (masked sequence model) and a generating predictor's ``_encode``,
-    lowered exactly as warmup would compile them (donate=True — the
-    TPU/GPU configuration; CPU merely ignores it at run time)."""
+def build_scoring_predictor():
+    """The bucketed scoring predictor warm path (masked sequence
+    model), built exactly as warmup would compile it (donate=True —
+    the TPU/GPU configuration; CPU merely ignores it at run time).
+    Shared by the pass-2 donation/constant audit and the pass-4
+    collective audit (shard_audit.build_serving_warm): one build, two
+    invariants. Returns ``(pred, (params, feed))``."""
     import jax
-    import jax.numpy as jnp
-    import numpy as np
 
     from paddle_tpu.config import dsl
     from paddle_tpu.core.network import Network
-    from paddle_tpu.core.registry import get_layer_impl
-    from paddle_tpu.data import (dense_vector, integer_value,
-                                 integer_value_sequence)
+    from paddle_tpu.data import integer_value, integer_value_sequence
     from paddle_tpu.serving.predictor import (ServingPredictor,
                                               _synth_sample)
-
-    anchor = "paddle_tpu/serving/predictor.py"
-    findings: List[Finding] = []
-
-    # ---- scoring path (_infer), masked sequence input
     V = 16
     dsl.reset()
     w = dsl.data(name="w", size=V)
@@ -335,7 +328,29 @@ def audit_serving(log=print) -> List[Finding]:
     rows = [tuple(_synth_sample(pred.feeding[n], 4)
                   for n in pred.names)] * 2
     feed = pred.feeder(list(rows))
-    args = (pred.params, feed)
+    return pred, (pred.params, feed)
+
+
+def audit_serving(log=print) -> List[Finding]:
+    """The serving warm path: a bucketed scoring predictor's ``_infer``
+    (masked sequence model) and a generating predictor's ``_encode``,
+    lowered exactly as warmup would compile them (donate=True — the
+    TPU/GPU configuration; CPU merely ignores it at run time)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.config import dsl
+    from paddle_tpu.core.network import Network
+    from paddle_tpu.core.registry import get_layer_impl
+    from paddle_tpu.data import dense_vector
+    from paddle_tpu.serving.predictor import ServingPredictor, _synth_sample
+
+    anchor = "paddle_tpu/serving/predictor.py"
+    findings: List[Finding] = []
+
+    # ---- scoring path (_infer), masked sequence input
+    pred, args = build_scoring_predictor()
     closed = jax.make_jaxpr(pred._infer)(*args)
     findings.extend(_const_findings(closed, "serving._infer", anchor))
     dfind, stats = _donation_findings(pred._infer, args, (1,),

@@ -1,0 +1,845 @@
+"""Pass 4 — sharding & collective-communication audit of the real
+parallel programs.
+
+ROADMAP item 1 (the unified 4D ``data x fsdp x tp x pipe`` layout) will
+refactor every parallel program in this repo, and nothing machine-checks
+what those programs actually *communicate*: the zero1
+``with_sharding_constraint`` pins and the pipeline ``P(pipe)`` rules are
+conventions a refactor can silently break (the r07 incident —
+propagation rewrote the backward ~2x slower when the fused buffers were
+left unpinned). Same premise as pass 2: jitted JAX gives us static
+graphs, so audit the *lowered program*, not the source — but one level
+deeper: pass 4 runs the SPMD partitioner (``.lower().compile()`` on the
+8-device virtual mesh) and reads the optimized HLO, because the
+collectives that cost real ICI time only exist after partitioning.
+
+Traced programs (kept deliberately tiny — the op *structure* is what the
+manifest pins, and XLA emits the same collective program for a 12-wide
+fc as for a 12288-wide one):
+
+- ``dp_train``   — the plain data-parallel train step (grad all-reduce).
+- ``zero1``      — ZeRO-1 sharded optimizer step (the ONE fused
+  all-gather + the pinned pack buffers, ``optim/zero1.py``).
+- ``pipeline``   — the GPipe shard_map'd scan (stage-handoff
+  collective-permutes + pipe-axis psum, composed with the data axis).
+- ``tp_embed``   — tensor parallelism: a model-axis row-sharded
+  embedding table through a full train step.
+- ``seq_ring``   — ring attention fwd+bwd over the seq axis
+  (``parallel/ring.py`` ppermute ring).
+- ``serving_warm`` — the serving warm path; its manifest is pinned
+  EMPTY (serving must never grow a collective).
+
+Checks:
+
+- **PT501 collective budget**: every ``all-reduce`` / ``all-gather`` /
+  ``reduce-scatter`` / ``collective-permute`` / ``all-to-all`` in the
+  optimized HLO, counted per (program, op, mesh-axis) with byte volume,
+  must match ``analysis/comm_budget.toml`` exactly. Counts are static
+  program-text sites (an op inside a scan body counts once). Growth is
+  drift; shrinkage means the budget must be tightened (the only-shrinks
+  policy of baseline.toml, applied to communication).
+- **PT502 unintended replication**: a large (> ``BIG_BYTES``) parameter
+  or optimizer slot in a program's must-shard contract whose *placed*
+  sharding is fully replicated despite a mesh axis that divides it.
+- **PT503 unpinned pack**: a shard_map operand with a sharded in_spec
+  built by a pack op (``concatenate``/``pad``) with no
+  ``with_sharding_constraint`` between the pack and the shard_map —
+  the exact r07 backward-rewrite class.
+- **PT504 reshard copy**: two conflicting sharding constraints on the
+  same value chain inside one program (each transition is a real
+  device-to-device copy on TPU).
+- **PT505 rule-table hygiene** (``parallel/mesh.py:rule_for``): dead
+  keys matching no parameter, ``=``-exact keys that exact-match
+  nothing, and keys shadowed by an earlier match on every name they
+  cover — checked against the rule tables the traced programs actually
+  construct (trainer shard_rules, pipeline plan rules).
+
+Heavy imports (jax, trainers, model builders) stay inside functions:
+pass 1/3 and ``--fast`` must not pay them.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+from paddle_tpu.analysis.findings import Finding
+
+# a leaf below this is scaffolding, not model state — same rationale as
+# jaxpr_audit.CONST_LIMIT_BYTES
+BIG_BYTES = 64 * 1024
+
+COLLECTIVE_OPS = ("all-reduce", "all-gather", "reduce-scatter",
+                  "collective-permute", "all-to-all")
+
+# ops PT503/PT504 chains look *through* (value-preserving): shape-only
+# ops plus dtype casts
+_THROUGH_OPS = {
+    "reshape", "broadcast_in_dim", "squeeze", "expand_dims",
+    "transpose", "slice", "dynamic_slice", "copy", "rev",
+    "convert_element_type",
+}
+_PACK_OPS = {"concatenate", "pad"}
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2,
+    "bf16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
+    "f64": 8, "c64": 8, "c128": 16,
+}
+
+
+# ============================================================ comm budget
+def default_budget_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "comm_budget.toml")
+
+
+class BudgetEntry:
+    __slots__ = ("program", "op", "axis", "ops", "bytes")
+
+    def __init__(self):
+        self.program = ""
+        self.op = ""
+        self.axis = ""
+        self.ops = 0
+        self.bytes = 0
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.program, self.op, self.axis)
+
+
+def load_budget(path: Optional[str] = None) -> List[BudgetEntry]:
+    """Parse ``comm_budget.toml`` (the shared TOML-subset table parser
+    from baseline.py — the py3.10 container has no tomllib)."""
+    from paddle_tpu.analysis.baseline import parse_toml_tables
+    path = path or default_budget_path()
+    if not os.path.exists(path):
+        return []
+    entries = parse_toml_tables(
+        path, "comm budget", "[[collective]]", BudgetEntry,
+        int_keys=("ops", "bytes"), str_keys=("program", "op", "axis"))
+    seen: Dict[Tuple[str, str, str], int] = {}
+    for e in entries:
+        if not e.program or not e.op or not e.axis:
+            raise ValueError(
+                f"comm budget {path}: every [[collective]] needs "
+                "program=, op= and axis=")
+        if e.ops < 1 or e.bytes < 1:
+            # pinning zero sites is spelled by ABSENCE of the entry;
+            # a missing/zero ops= or bytes= would otherwise surface as
+            # a baffling 'GREW past its budget 0 / 0' drift report
+            raise ValueError(
+                f"comm budget {path}: entry (program={e.program} "
+                f"op={e.op} axis={e.axis!r}) needs ops= and bytes= "
+                ">= 1 (zero is pinned by deleting the entry)")
+        if e.key() in seen:
+            raise ValueError(
+                f"comm budget {path}: duplicate entry for "
+                f"(program={e.program} op={e.op} axis={e.axis!r}) — "
+                "merge-conflict leftovers would silently resolve to "
+                "the last one")
+        seen[e.key()] = 1
+    return entries
+
+
+# ====================================================== manifest (HLO side)
+_SHAPE_RE = re.compile(r"([a-z]+\d*)\[([\d,]*)\]")
+_OP_RE = re.compile(
+    r"=\s*(\([^)]*\)|\S+)\s+"
+    r"(all-reduce|all-gather|reduce-scatter|collective-permute|"
+    r"all-to-all)(-start)?\(")
+_GROUPS_RE = re.compile(r"replica_groups=\{(\{[\d,{} ]*\})\}")
+_IOTA_GROUPS_RE = re.compile(
+    r"replica_groups=\[(\d+),(\d+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?")
+_PAIRS_RE = re.compile(r"source_target_pairs=\{([\d,{} ]*)\}")
+
+
+def _shape_bytes(shape_txt: str, async_start: bool = False) -> int:
+    """Payload bytes of an HLO result shape (tuple shapes sum). An
+    async ``-start`` op's result tuple carries BOTH the operand and
+    output buffers — count only the output half, so the same
+    collective budgets identically whichever spelling XLA picks."""
+    elems = []
+    for dtype, dims in _SHAPE_RE.findall(shape_txt):
+        width = _DTYPE_BYTES.get(dtype)
+        if width is None:
+            continue  # token/opaque — carries no payload
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        elems.append(n * width)
+    if async_start and len(elems) > 1:
+        elems = elems[len(elems) // 2:]
+    return sum(elems)
+
+
+def _mesh_axis_groups(mesh) -> Dict[str, frozenset]:
+    """{axis-label: groups} for every non-trivial combination of mesh
+    axes, as frozensets of frozensets of *device ids* (the compiled
+    HLO's ``use_global_device_ids`` currency). Combination labels join
+    axis names with ``+`` in mesh order."""
+    import itertools
+
+    import numpy as np
+    if mesh is None:
+        return {}
+    ids = np.vectorize(lambda d: d.id)(np.asarray(mesh.devices))
+    names = list(mesh.axis_names)
+    axes = list(range(ids.ndim))
+    out: Dict[str, frozenset] = {}
+    real = [i for i in axes if ids.shape[i] > 1]
+    for r in range(1, len(real) + 1):
+        for combo in itertools.combinations(real, r):
+            others = [i for i in axes if i not in combo]
+            size = 1
+            for i in combo:
+                size *= ids.shape[i]
+            g = ids.transpose(others + list(combo)).reshape(-1, size)
+            label = "+".join(names[i] for i in combo)
+            out[label] = frozenset(frozenset(int(x) for x in row)
+                                   for row in g)
+    return out
+
+
+def _parse_groups(line: str):
+    """Replica groups on an HLO line -> frozenset of frozensets, or
+    None when the line carries none (flat/default grouping)."""
+    m = _GROUPS_RE.search(line)
+    if m:
+        groups = re.findall(r"\{([\d, ]*)\}", m.group(1))
+        return frozenset(
+            frozenset(int(x) for x in g.replace(" ", "").split(",") if x)
+            for g in groups)
+    m = _IOTA_GROUPS_RE.search(line)
+    if m:
+        import numpy as np
+        n_groups, g_size = int(m.group(1)), int(m.group(2))
+        dims = [int(d) for d in m.group(3).split(",")]
+        ids = np.arange(int(np.prod(dims))).reshape(dims)
+        if m.group(4):
+            ids = ids.transpose([int(p) for p in m.group(4).split(",")])
+        flat = ids.reshape(n_groups, g_size)
+        return frozenset(frozenset(int(x) for x in row) for row in flat)
+    return None
+
+
+def _axis_of_pairs(line: str, mesh) -> Optional[str]:
+    """Label a collective-permute by the mesh axis its source→target
+    pairs move along (every pair differs in exactly one coordinate)."""
+    import numpy as np
+    m = _PAIRS_RE.search(line)
+    if m is None or mesh is None:
+        return None
+    pairs = [tuple(int(x) for x in p.split(","))
+             for p in re.findall(r"\{(\d+,\d+)\}", m.group(0))]
+    if not pairs:
+        return None
+    ids = np.vectorize(lambda d: d.id)(np.asarray(mesh.devices))
+    coord = {int(ids[idx]): idx for idx in np.ndindex(ids.shape)}
+    names = list(mesh.axis_names)
+    moved = set()
+    for s, t in pairs:
+        cs, ct = coord.get(s), coord.get(t)
+        if cs is None or ct is None:
+            return None
+        diff = [i for i in range(len(cs)) if cs[i] != ct[i]]
+        if len(diff) != 1:
+            return None
+        moved.add(diff[0])
+    if len(moved) == 1:
+        return names[moved.pop()]
+    return None
+
+
+def collect_manifest(hlo_text: str, mesh) -> Dict[Tuple[str, str],
+                                                  List[int]]:
+    """{(op-kind, axis-label): [site count, total result bytes]} from
+    optimized HLO text. Sites are static program text — an op inside a
+    while/scan body counts once. ``-done`` halves of async pairs are
+    not separate sites (the regex matches only the ``-start``/sync
+    spelling, which carries the shape)."""
+    axis_groups = _mesh_axis_groups(mesh)
+    n_dev = 0
+    if mesh is not None:
+        for _ax, sz in dict(mesh.shape).items():
+            n_dev = (n_dev or 1) * sz
+    manifest: Dict[Tuple[str, str], List[int]] = {}
+    for line in hlo_text.splitlines():
+        if "-done(" in line:
+            continue
+        m = _OP_RE.search(line)
+        if m is None:
+            continue
+        shape_txt, kind = m.group(1), m.group(2)
+        is_start = m.group(3) is not None
+        if kind == "collective-permute":
+            axis = _axis_of_pairs(line, mesh) or "other"
+        else:
+            groups = _parse_groups(line)
+            axis = "other"
+            if groups is None:
+                # no/default grouping = one group of the whole mesh
+                full = [lbl for lbl, g in axis_groups.items()
+                        if len(g) == 1 and len(next(iter(g))) == n_dev]
+                axis = full[0] if full else "all"
+            else:
+                for label, g in axis_groups.items():
+                    if groups == g:
+                        axis = label
+                        break
+        cell = manifest.setdefault((kind, axis), [0, 0])
+        cell[0] += 1
+        cell[1] += _shape_bytes(shape_txt, async_start=is_start)
+    return manifest
+
+
+def format_manifest(manifest: Dict[Tuple[str, str], List[int]]) -> str:
+    if not manifest:
+        return "no collectives"
+    return ", ".join(
+        f"{kind}x{n} ({axis}, {nbytes}B)"
+        for (kind, axis), (n, nbytes) in sorted(manifest.items()))
+
+
+def check_budget(program: str, manifest: Dict[Tuple[str, str], List[int]],
+                 entries: List[BudgetEntry], anchor: str,
+                 budget_rel: str) -> Tuple[List[Finding], List[int]]:
+    """Compare one program's manifest against its budget entries.
+    Returns (findings, indices of entries consumed)."""
+    findings: List[Finding] = []
+    used: List[int] = []
+    by_key = {}
+    for i, e in enumerate(entries):
+        if e.program == program:
+            by_key[(e.op, e.axis)] = (i, e)
+    for (kind, axis), (n, nbytes) in sorted(manifest.items()):
+        hit = by_key.get((kind, axis))
+        if hit is None:
+            findings.append(Finding(
+                "PT501", anchor, 1,
+                f"{program}: UNBUDGETED collective {kind} over "
+                f"{axis!r} (x{n}, {nbytes} bytes) — the program grew "
+                f"communication; justify it by adding the entry to "
+                f"{budget_rel} in the same change, or remove the "
+                "collective"))
+            continue
+        i, e = hit
+        used.append(i)
+        if n > e.ops or nbytes > e.bytes:
+            findings.append(Finding(
+                "PT501", anchor, 1,
+                f"{program}: collective {kind} over {axis!r} GREW past "
+                f"its budget: {n} sites / {nbytes} bytes vs budgeted "
+                f"{e.ops} / {e.bytes} — communication drift (the r07 "
+                "incident class); fix the program or justify the new "
+                f"budget in {budget_rel}"))
+        elif n < e.ops or nbytes < e.bytes:
+            findings.append(Finding(
+                "PT501", budget_rel, 1,
+                f"{program}: collective {kind} over {axis!r} SHRANK to "
+                f"{n} sites / {nbytes} bytes vs budgeted {e.ops} / "
+                f"{e.bytes} — tighten the budget entry (the budget "
+                "only shrinks; lock the win in)"))
+    return findings, used
+
+
+# ================================================= jaxpr checks (503/504)
+def _sub_jaxprs(eqn):
+    out = []
+    for v in eqn.params.values():
+        for sub in (v if isinstance(v, (list, tuple)) else [v]):
+            if hasattr(sub, "jaxpr") or hasattr(sub, "eqns"):
+                out.append(sub)
+    return out
+
+
+def _is_literal(v) -> bool:
+    return hasattr(v, "val")
+
+
+def _shardmap_in_sharded(eqn) -> List[bool]:
+    """Per-operand: does the shard_map view this operand as split over
+    a mesh axis? (in_names dicts on jax<=0.4; in_specs on newer.)"""
+    names = eqn.params.get("in_names")
+    if names is not None:
+        return [bool(n) for n in names]
+    specs = eqn.params.get("in_specs")
+    if specs is not None:
+        return [any(s is not None for s in spec) for spec in specs]
+    return [True] * len(eqn.invars)
+
+
+def shardmap_pin_findings(closed, name: str, anchor: str) -> List[Finding]:
+    """PT503: shard_map operands with a sharded in_spec whose value was
+    built by a pack op (concatenate/pad) with no sharding_constraint in
+    between. Without the pin, sharding propagation leaks the
+    shard_map's per-device demand into the producing program — in r07
+    that rewrote the whole backward ~2x slower (``optim/zero1.py``
+    pins both fused buffers replicated for exactly this reason).
+    Origins are tracked through pjit/scan sub-jaxprs; operands that are
+    program inputs, constants, or pinned values are exempt."""
+    findings: List[Finding] = []
+
+    INVAR, CONST, PINNED = "invar", "const", "pinned"
+
+    def resolve(v, origin):
+        if _is_literal(v):
+            return CONST
+        return origin.get(v, INVAR)
+
+    def scan(jaxpr, origin):
+        for eqn in jaxpr.eqns:
+            prim = eqn.primitive.name
+            for sub in _sub_jaxprs(eqn):
+                inner = getattr(sub, "jaxpr", sub)
+                inner_origin = {cv: CONST
+                                for cv in getattr(inner, "constvars", [])}
+                n = min(len(eqn.invars), len(inner.invars))
+                for i in range(1, n + 1):  # tail-aligned (consts prepend)
+                    inner_origin[inner.invars[-i]] = resolve(
+                        eqn.invars[-i], origin)
+                scan(inner, inner_origin)
+            if prim == "shard_map":
+                sharded = _shardmap_in_sharded(eqn)
+                for i, v in enumerate(eqn.invars):
+                    if i < len(sharded) and not sharded[i]:
+                        continue
+                    cat = resolve(v, origin)
+                    if cat in _PACK_OPS:
+                        shape = getattr(getattr(v, "aval", None),
+                                        "shape", "?")
+                        findings.append(Finding(
+                            "PT503", anchor, 1,
+                            f"{name}: shard_map operand {i} (shape "
+                            f"{shape}) enters a sharded in_spec "
+                            f"straight from a {cat} pack with no "
+                            "with_sharding_constraint pin — "
+                            "propagation can rewrite the producing "
+                            "backward (the r07 2x regression); pin the "
+                            "packed buffer (optim/zero1.py:update)"))
+            if prim == "sharding_constraint":
+                cat = PINNED
+            elif prim in _THROUGH_OPS and eqn.invars:
+                cat = resolve(eqn.invars[0], origin)
+            else:
+                cat = prim
+            for ov in eqn.outvars:
+                origin[ov] = cat
+
+    scan(closed.jaxpr, {cv: CONST for cv in closed.jaxpr.constvars})
+    return findings
+
+
+def reshard_findings(closed, name: str, anchor: str) -> List[Finding]:
+    """PT504: a value pinned to one sharding and then re-pinned to a
+    DIFFERENT one along the same (value-preserving) chain — each such
+    transition is a real reshard copy in the compiled program."""
+    findings: List[Finding] = []
+
+    def spec_of(eqn) -> str:
+        s = eqn.params.get("sharding")
+        return str(getattr(s, "spec", s))
+
+    def scan(jaxpr):
+        pinned: Dict[Any, str] = {}  # var -> spec-string it carries
+        for eqn in jaxpr.eqns:
+            prim = eqn.primitive.name
+            for sub in _sub_jaxprs(eqn):
+                scan(getattr(sub, "jaxpr", sub))
+            if prim == "sharding_constraint":
+                v = eqn.invars[0]
+                prev = None if _is_literal(v) else pinned.get(v)
+                spec = spec_of(eqn)
+                if prev is not None and prev != spec:
+                    findings.append(Finding(
+                        "PT504", anchor, 1,
+                        f"{name}: value pinned {prev} is re-pinned "
+                        f"{spec} in the same program — a reshard copy "
+                        "per transition; pin once at the producer"))
+                for ov in eqn.outvars:
+                    pinned[ov] = spec
+            elif prim in _THROUGH_OPS and eqn.invars:
+                v = eqn.invars[0]
+                if not _is_literal(v) and v in pinned:
+                    for ov in eqn.outvars:
+                        pinned[ov] = pinned[v]
+
+    scan(closed.jaxpr)
+    return findings
+
+
+# ===================================================== placement (PT502)
+def replication_findings(args, must_shard, name: str,
+                         anchor: str) -> List[Finding]:
+    """PT502: leaves selected by a program's must-shard contract that
+    are big (> BIG_BYTES), placed fully replicated, yet have a mesh
+    axis (size > 1) dividing one of their dims. ``must_shard`` is a
+    list of (label, path-predicate) pairs over
+    ``jax.tree_util.keystr`` paths of the program args."""
+    import jax
+    findings: List[Finding] = []
+    if not must_shard:
+        return findings
+    flat, _ = jax.tree_util.tree_flatten_with_path(args)
+    for path, leaf in flat:
+        pstr = jax.tree_util.keystr(path)
+        sharding = getattr(leaf, "sharding", None)
+        nbytes = getattr(leaf, "nbytes", 0)
+        if sharding is None or nbytes <= BIG_BYTES:
+            continue
+        for label, pred in must_shard:
+            if not pred(pstr):
+                continue
+            if sharding.is_fully_replicated:
+                mesh = getattr(sharding, "mesh", None)
+                axes = [f"{ax}({sz})"
+                        for ax, sz in dict(getattr(mesh, "shape",
+                                                   {})).items()
+                        if sz > 1 and any(d % sz == 0 and d >= sz
+                                          for d in leaf.shape)]
+                if not axes:
+                    # no axis divides any dim: placement legitimately
+                    # falls back to replicated (shard_opt_state's
+                    # non-divisible warning path) — not a violation
+                    continue
+                findings.append(Finding(
+                    "PT502", anchor, 1,
+                    f"{name}: {label} leaf {pstr} ({nbytes} bytes, "
+                    f"shape {tuple(leaf.shape)}) is FULLY REPLICATED "
+                    f"despite matching mesh axes {', '.join(axes)} — "
+                    "every device pays its full bytes; restore the "
+                    "sharding rule/placement this program's contract "
+                    "promises"))
+    return findings
+
+
+# ====================================================== rule tables (505)
+def check_rule_table(rules, names: Iterable[str], anchor: str,
+                     where: str, line: int = 1) -> List[Finding]:
+    """PT505 hygiene for one ``rule_for`` table against the parameter
+    names it governs: dead keys, ``=``-exact misses, shadowed keys.
+    Matching/precedence come from ``parallel/mesh.py`` itself
+    (``key_matches``/``rule_key_for``), so the audit can never drift
+    from the semantics ``rule_for`` actually applies."""
+    from paddle_tpu.parallel.mesh import key_matches, rule_key_for
+    findings: List[Finding] = []
+    if not rules:
+        return findings
+    names = list(names)
+    for pat in rules:
+        matched = [n for n in names if key_matches(pat, n)]
+        if not matched:
+            kind = ("exact-match key matches no parameter"
+                    if pat.startswith("=") else
+                    "substring key matches no parameter")
+            findings.append(Finding(
+                "PT505", anchor, line,
+                f"{where}: rule key {pat!r} is DEAD ({kind} of "
+                f"{len(names)}) — delete it or fix the name it meant "
+                "to target"))
+            continue
+        effective = [n for n in matched if rule_key_for(n, rules) == pat]
+        if not effective:
+            shadows = sorted({rule_key_for(n, rules) for n in matched})
+            findings.append(Finding(
+                "PT505", anchor, line,
+                f"{where}: rule key {pat!r} is fully SHADOWED by "
+                f"{shadows} — every name it matches resolves to "
+                "another key under rule_for precedence (=-exact keys "
+                "first, then table order); delete it or retarget it"))
+    return findings
+
+
+# ======================================================== traced programs
+class ProgramSpec:
+    """One traced parallel program: a jitted fn + committed-sharding
+    args + its mesh and contracts."""
+
+    def __init__(self, name: str, anchor: str, fn, args, mesh,
+                 must_shard=(), rule_tables=()):
+        self.name = name
+        self.anchor = anchor
+        self.fn = fn
+        self.args = args
+        self.mesh = mesh
+        self.must_shard = list(must_shard)
+        # (rules, names, where) triples for PT505
+        self.rule_tables = list(rule_tables)
+
+
+def _classifier_trainer(mesh, width=16, hidden=32, classes=4,
+                        optimizer=None, shard_rules=None, seed=7):
+    import numpy as np
+
+    from paddle_tpu.config import dsl
+    from paddle_tpu.data import DataFeeder, dense_vector, integer_value
+    from paddle_tpu.optim import Momentum
+    from paddle_tpu.trainer import SGD
+    dsl.reset()
+    x = dsl.data(name="x", size=width)
+    lab = dsl.data(name="label", size=classes)
+    h = dsl.fc(input=x, size=hidden, act="relu", name="h")
+    out = dsl.fc(input=h, size=classes, act="softmax", name="out")
+    cost = dsl.classification_cost(input=out, label=lab)
+    tr = SGD(cost=cost,
+             update_equation=optimizer or Momentum(learning_rate=0.1,
+                                                   momentum=0.9),
+             mesh=mesh, shard_rules=shard_rules, seed=seed)
+    feeder = DataFeeder({"x": dense_vector(width),
+                         "label": integer_value(classes)})
+    rng = np.random.RandomState(0)
+    data = [(rng.randn(width).astype(np.float32), int(rng.randint(classes)))
+            for _ in range(16)]
+    return tr, feeder(data)
+
+
+def _step_args(tr, feed):
+    import jax
+
+    from paddle_tpu.parallel import mesh as mesh_lib
+    feed = mesh_lib.shard_batch(feed, tr.mesh)
+    return (tr.params, tr.opt_state, feed, jax.random.PRNGKey(0), 0, None)
+
+
+def build_dp_train() -> ProgramSpec:
+    """Plain data-parallel SGD: batch P(data) over all 8 devices,
+    params replicated — the gradient all-reduce is the whole story."""
+    from paddle_tpu.parallel.mesh import create_mesh
+    mesh = create_mesh(n_data=8)
+    tr, feed = _classifier_trainer(mesh)
+    return ProgramSpec("dp_train", "paddle_tpu/trainer/trainer.py",
+                       tr._train_step, _step_args(tr, feed), mesh)
+
+
+def build_zero1() -> ProgramSpec:
+    """ZeRO-1: slots packed (N, chunk) P(data), pinned fused pack
+    buffers, ONE all-gather back (optim/zero1.py). The _h.w0 fc is
+    sized past BIG_BYTES so the slot contract has teeth."""
+    from paddle_tpu.optim import Adam
+    from paddle_tpu.parallel.mesh import create_mesh
+    mesh = create_mesh(n_data=8)
+    tr, feed = _classifier_trainer(mesh, width=128, hidden=136,
+                                   optimizer=Adam(learning_rate=1e-3))
+    tr.enable_zero1()
+    planned = sorted(tr._zero1.plan)
+    must = [(f"zero1 slot of {n!r}",
+             (lambda p, n=n: "'slots'" in p and f"'{n}'" in p))
+            for n in planned]
+    return ProgramSpec("zero1", "paddle_tpu/optim/zero1.py",
+                       tr._train_step, _step_args(tr, feed), mesh,
+                       must_shard=must)
+
+
+def build_pipeline() -> ProgramSpec:
+    """The GPipe schedule: 4 identical fc stages stage-stacked P(pipe)
+    composed with a 2-way data axis; handoff collective-permutes + the
+    last-stage psum, and the usual grad all-reduce over data."""
+    import numpy as np
+
+    from paddle_tpu.config import dsl
+    from paddle_tpu.data import DataFeeder, dense_vector, integer_value
+    from paddle_tpu.optim import Adam
+    from paddle_tpu.parallel.mesh import create_mesh
+    from paddle_tpu.trainer import SGD
+    width, classes, S = 8, 3, 4
+    dsl.reset()
+    x = dsl.data(name="x", size=width)
+    lab = dsl.data(name="label", size=classes)
+    h = x
+    for s in range(S):
+        h = dsl.fc(input=h, size=width, act="tanh", name=f"blk{s}",
+                   layer_attr={"device": s})
+    out = dsl.fc(input=h, size=classes, act="softmax", name="out")
+    cost = dsl.classification_cost(input=out, label=lab)
+    mesh = create_mesh(n_data=2, n_pipe=S)
+    tr = SGD(cost=cost, update_equation=Adam(learning_rate=3e-3),
+             mesh=mesh, seed=7)
+    if not tr.enable_pipeline():
+        raise RuntimeError("pipeline audit program stood down "
+                           "(enable_pipeline returned False)")
+    feeder = DataFeeder({"x": dense_vector(width),
+                         "label": integer_value(classes)})
+    rng = np.random.RandomState(0)
+    data = [(rng.randn(width).astype(np.float32), int(rng.randint(classes)))
+            for _ in range(8)]
+    feed = feeder(data)
+    plan = tr._pipe
+    stacked = sorted(plan.stacked_map)
+    must = [(f"stage-stacked {k!r}", (lambda p, k=k: f"'{k}'" in p))
+            for k in stacked]
+    slot_names = set(tr.opt_state.get("slots", {}))
+    tables = [(plan.shard_rules(),
+               sorted(set(tr.params) | slot_names),
+               "parallel/pipeline.py:PipelineTrainPlan.shard_rules")]
+    if tr._shard_rules:
+        tables.append((tr._shard_rules, sorted(set(tr.params) | slot_names),
+                       "trainer shard_rules (pipeline program)"))
+    return ProgramSpec("pipeline", "paddle_tpu/parallel/pipeline.py",
+                       tr._train_step, _step_args(tr, feed), mesh,
+                       must_shard=must, rule_tables=tables)
+
+
+def build_tp_embed() -> ProgramSpec:
+    """Tensor parallelism: embedding rows sharded P(model) through a
+    full train step (the SparseRowMatrix row-slice placement); the
+    table is sized past BIG_BYTES so PT502 guards the rule."""
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.config import dsl
+    from paddle_tpu.data import (DataFeeder, integer_value,
+                                 integer_value_sequence)
+    from paddle_tpu.optim import Momentum
+    from paddle_tpu.parallel.mesh import create_mesh
+    from paddle_tpu.trainer import SGD
+    vocab, dim = 1056, 16  # 1056*16*4 = 67584 B > BIG_BYTES
+    dsl.reset()
+    words = dsl.data(name="w", size=vocab, is_sequence=True)
+    lab = dsl.data(name="label", size=2)
+    emb = dsl.embedding(input=words, size=dim, vocab_size=vocab,
+                        name="emb")
+    pooled = dsl.pooling(input=emb, pooling_type="max")
+    out = dsl.fc(input=pooled, size=2, act="softmax", name="out")
+    cost = dsl.classification_cost(input=out, label=lab)
+    mesh = create_mesh(n_data=4, n_model=2)
+    tr = SGD(cost=cost, update_equation=Momentum(learning_rate=0.1),
+             mesh=mesh, shard_rules={"_emb.w0": P("model", None)},
+             seed=7)
+    feeder = DataFeeder({"w": integer_value_sequence(vocab),
+                         "label": integer_value(2)}, pad_multiple=8)
+    rng = np.random.RandomState(0)
+    data = [(list(rng.randint(0, vocab, size=rng.randint(2, 8))),
+             int(rng.randint(0, 2))) for _ in range(16)]
+    feed = feeder(data)
+    must = [("model-sharded table '_emb.w0'",
+             lambda p: "'_emb.w0'" in p)]
+    tables = [(tr._shard_rules, sorted(tr.params),
+               "trainer shard_rules (tp_embed program)")]
+    return ProgramSpec("tp_embed", "paddle_tpu/parallel/mesh.py",
+                       tr._train_step, _step_args(tr, feed), mesh,
+                       must_shard=must, rule_tables=tables)
+
+
+def build_seq_ring() -> ProgramSpec:
+    """Sequence parallelism: ring attention fwd+bwd over a 4-way seq
+    axis — the KV ppermute ring (parallel/ring.py), backward included
+    because training is what rides it."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.parallel.mesh import create_mesh
+    from paddle_tpu.parallel.ring import make_ring_attention
+    mesh = create_mesh(n_data=2, n_seq=4)
+    attn = make_ring_attention(mesh, "seq", kind="ring", causal=True)
+
+    def loss(q, k, v, mask):
+        return jnp.sum(attn(q, k, v, mask) ** 2)
+
+    fn = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))
+    B, N, T, D = 2, 2, 8, 4
+    spec = NamedSharding(mesh, P(None, None, "seq", None))
+    mspec = NamedSharding(mesh, P(None, "seq"))
+    key = jax.random.PRNGKey(0)
+    ks = jax.random.split(key, 3)
+    q, k, v = (jax.device_put(
+        jax.random.normal(ks[i], (B, N, T, D), jnp.float32), spec)
+        for i in range(3))
+    mask = jax.device_put(jnp.ones((B, T), jnp.float32), mspec)
+    return ProgramSpec("seq_ring", "paddle_tpu/parallel/ring.py",
+                       fn, (q, k, v, mask), mesh)
+
+
+def build_serving_warm() -> ProgramSpec:
+    """The serving warm path (_infer of a masked scorer, donate=True,
+    exactly as warmup compiles it). Its budget is pinned EMPTY: the
+    single-program serving step must never grow a collective."""
+    from paddle_tpu.analysis.jaxpr_audit import build_scoring_predictor
+    pred, args = build_scoring_predictor()
+    import jax
+    fn = jax.jit(pred._infer, donate_argnums=(1,))
+    return ProgramSpec("serving_warm", "paddle_tpu/serving/predictor.py",
+                       fn, args, None)
+
+
+PROGRAM_BUILDERS: List[Callable[[], ProgramSpec]] = [
+    build_dp_train, build_zero1, build_pipeline, build_tp_embed,
+    build_seq_ring, build_serving_warm,
+]
+
+PROGRAM_NAMES = ("dp_train", "zero1", "pipeline", "tp_embed",
+                 "seq_ring", "serving_warm")
+
+
+# ============================================================== the pass
+def audit_program(spec: ProgramSpec, entries: List[BudgetEntry],
+                  budget_rel: str, log=None
+                  ) -> Tuple[List[Finding], List[int]]:
+    """All pass-4 checks for one traced program."""
+    import warnings
+
+    import jax
+    findings: List[Finding] = []
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # unusable-donation warnings
+        lowered = spec.fn.lower(*spec.args) if hasattr(spec.fn, "lower") \
+            else jax.jit(spec.fn).lower(*spec.args)
+        hlo = lowered.compile().as_text()
+    manifest = collect_manifest(hlo, spec.mesh)
+    bfind, used = check_budget(spec.name, manifest, entries,
+                               spec.anchor, budget_rel)
+    findings.extend(bfind)
+    closed = jax.make_jaxpr(spec.fn)(*spec.args)
+    findings.extend(shardmap_pin_findings(closed, spec.name, spec.anchor))
+    findings.extend(reshard_findings(closed, spec.name, spec.anchor))
+    findings.extend(replication_findings(spec.args, spec.must_shard,
+                                         spec.name, spec.anchor))
+    for rules, names, where in spec.rule_tables:
+        findings.extend(check_rule_table(rules, names, spec.anchor,
+                                         where))
+    if log:
+        log(f"  {spec.name}: {format_manifest(manifest)}")
+    return findings, used
+
+
+def run_pass4(root: Optional[str] = None, log=print,
+              budget_path: Optional[str] = None) -> List[Finding]:
+    """Trace, partition, and audit all parallel programs; enforce the
+    committed collective budget including its stale-entry policy."""
+    budget_path = budget_path or default_budget_path()
+    budget_rel = os.path.relpath(
+        budget_path, root or os.getcwd()).replace(os.sep, "/")
+    entries = load_budget(budget_path)
+    findings: List[Finding] = []
+    used: set = set()
+    for build in PROGRAM_BUILDERS:
+        spec = build()
+        fs, u = audit_program(spec, entries, budget_rel, log=log)
+        findings.extend(fs)
+        used.update(u)
+    findings.extend(stale_budget_findings(entries, used, budget_rel))
+    return findings
+
+
+def stale_budget_findings(entries: List[BudgetEntry], used,
+                          budget_rel: str) -> List[Finding]:
+    """Budget entries no traced program consumed: same policy as stale
+    baseline entries — they must be deleted, or they sit pinned to a
+    collective that no longer exists and hide the next regression."""
+    findings: List[Finding] = []
+    for i, e in enumerate(entries):
+        if i in used:
+            continue
+        if e.program not in PROGRAM_NAMES:
+            why = f"names unknown program {e.program!r}"
+        else:
+            why = (f"matches no collective the traced {e.program} "
+                   "program emits")
+        findings.append(Finding(
+            "PT501", budget_rel, 1,
+            f"STALE budget entry (program={e.program} op={e.op} "
+            f"axis={e.axis!r}) {why} — delete it (the budget only "
+            "shrinks)"))
+    return findings

@@ -45,6 +45,13 @@ to its parameter's full shape before a save (``trainer/checkpoint.py``
 stores the same keys as a replicated run), and ``pack_for_load`` reshards a
 full-shape slot on restore — so resume crosses sharded<->replicated modes
 in both directions.
+
+The communication contract is machine-checked (graftlint pass 4,
+``analysis/shard_audit.py``): the step's ONE fused all-gather and its
+unchanged backward all-reduce are pinned in ``analysis/comm_budget.toml``
+(PT501), the pack-buffer ``with_sharding_constraint`` pins below are
+asserted at the jaxpr level (PT503 — removing one fails tier-1), and a
+planned slot that loses its ``P(data)`` placement is PT502.
 """
 
 from __future__ import annotations

@@ -210,20 +210,39 @@ def replicate(tree, mesh: Mesh):
         lambda x: jax.device_put(x, NamedSharding(mesh, P())), tree)
 
 
-def rule_for(name: str, rules: Optional[Dict[str, P]]) -> P:
-    """First rule whose key matches ``name``; replicated default.
-
-    A key starting with ``=`` matches the full name EXACTLY (used by the
-    auto-added per-parameter rules so a rule for ``_emb.w0`` can never
+def key_matches(pat: str, name: str) -> bool:
+    """Does one rule key cover ``name``? A key starting with ``=``
+    matches the full name EXACTLY (so a rule for ``_emb.w0`` can never
     capture ``_user_emb.w0``); any other key matches as a substring."""
+    if pat.startswith("="):
+        return pat[1:] == name
+    return pat in name
+
+
+def rule_key_for(name: str, rules: Optional[Dict[str, P]]
+                 ) -> Optional[str]:
+    """The key ``rule_for`` resolves ``name`` to, or None. Exact keys
+    are consulted FIRST, then substring keys in table order — an
+    ``=``-pin for one parameter always beats a broad substring rule,
+    wherever it sits in the table (precedence pinned by
+    tests/test_analysis.py; graftlint PT505's dead/shadowed-key
+    analysis calls this same function, so the audit can never drift
+    from the semantics it audits)."""
     if rules:
-        for pat, s in rules.items():
-            if pat.startswith("="):
-                if pat[1:] == name:
-                    return s
-            elif pat in name:
-                return s
-    return P()
+        for pat in rules:
+            if pat.startswith("=") and key_matches(pat, name):
+                return pat
+        for pat in rules:
+            if not pat.startswith("=") and key_matches(pat, name):
+                return pat
+    return None
+
+
+def rule_for(name: str, rules: Optional[Dict[str, P]]) -> P:
+    """First rule whose key matches ``name`` (see ``rule_key_for`` for
+    the precedence contract); replicated default."""
+    key = rule_key_for(name, rules)
+    return rules[key] if key is not None else P()
 
 
 def shard_params(params: Dict[str, jax.Array], mesh: Mesh,
@@ -260,7 +279,13 @@ def effective_rules(param_specs, mesh: Mesh,
     if mesh.shape.get(MODEL_AXIS, 1) <= 1:
         return out
     for name, spec in param_specs.items():
-        if getattr(spec, "sparse_grad", False) and rule_for(name, out) == P():
+        # guard on "no key matches", NOT on rule_for(...) == P(): a
+        # user's explicit P() replication rule must win over the
+        # sparse default (same contract as device_attr_rules), and
+        # under exact-first precedence an auto-added "=" pin would
+        # otherwise override the user's substring rule
+        if getattr(spec, "sparse_grad", False) \
+                and rule_key_for(name, out) is None:
             out["=" + name] = P(MODEL_AXIS)  # exact: no substring capture
     return out
 

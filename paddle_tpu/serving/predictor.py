@@ -22,6 +22,10 @@ shape discipline:
 - **Donation.** Request feeds are fresh arrays, dead after the call, so
   the jitted forward donates them (TPU/GPU; XLA ignores donation on
   CPU, where it is skipped to avoid warning spam).
+- **Collective-free.** The warm path is a single-device program and
+  must stay one: graftlint pass 4 compiles ``_infer`` and pins its
+  collective manifest EMPTY (``analysis/comm_budget.toml`` — any
+  collective the serving step grows is PT501 drift at lint time).
 """
 
 from __future__ import annotations

@@ -1055,3 +1055,478 @@ def test_cast_compute_rejects_bf16_mask_at_trace_time():
             "label": Argument(value=jnp.zeros((2,), jnp.int32))}
     with pytest.raises(MaskDtypeError):
         tr._cast_compute(feed)
+
+
+# ================================================= pass 4 (PT501-PT505)
+# The sharding & collective-communication audit: every rule gets its
+# known-bad fixture + known-good twin, against the same machinery the
+# pass runs on the real parallel programs (shard_audit.py).
+
+from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: E402
+
+from paddle_tpu.analysis import shard_audit as sa  # noqa: E402
+from paddle_tpu.parallel.mesh import (create_mesh, rule_for,  # noqa: E402
+                                      shard_map_compat)
+
+
+def _mesh8():
+    return create_mesh(n_data=8)
+
+
+# ------------------------------------------------- budget file parsing
+def test_comm_budget_parses_and_validates_entries(tmp_path):
+    entry = ("[[collective]]\n"
+             'program = "zero1"\n'
+             'op = "all-gather"\n'
+             'axis = "data"\n'
+             "ops = 1\n"
+             "bytes = 72384\n")
+    p = tmp_path / "comm_budget.toml"
+    p.write_text("# pinned\n" + entry)
+    (e,) = sa.load_budget(str(p))
+    assert e.key() == ("zero1", "all-gather", "data")
+    assert (e.ops, e.bytes) == (1, 72384)
+    p.write_text("[[collective]]\nops = 3\n")
+    with pytest.raises(ValueError, match="program=, op= and axis="):
+        sa.load_budget(str(p))
+    p.write_text("[[collective]]\nprogram = ???\n")
+    with pytest.raises(ValueError, match="unparseable"):
+        sa.load_budget(str(p))
+    # zero/omitted counts: pinning zero is spelled by entry ABSENCE —
+    # a 0/0 entry would otherwise report as baffling 'GREW past 0 / 0'
+    p.write_text(entry.replace("ops = 1", "ops = 0"))
+    with pytest.raises(ValueError, match="deleting the entry"):
+        sa.load_budget(str(p))
+    p.write_text("\n".join(entry.splitlines()[:-1]) + "\n")  # no bytes=
+    with pytest.raises(ValueError, match="deleting the entry"):
+        sa.load_budget(str(p))
+    # duplicate (program, op, axis): merge-conflict leftovers must not
+    # silently resolve to whichever entry parses last
+    p.write_text(entry + entry.replace("72384", "9"))
+    with pytest.raises(ValueError, match="duplicate entry"):
+        sa.load_budget(str(p))
+
+
+def test_manifest_parses_hlo_groups_tuples_and_permutes():
+    """Synthetic optimized-HLO lines: literal and iota replica groups
+    map to mesh axes, tuple shapes sum bytes, async -done halves are
+    not separate sites, permute pairs label their axis."""
+    mesh = create_mesh(n_data=4, n_model=2)
+    hlo = "\n".join([
+        "  %ar = f32[16,16]{1,0} all-reduce(f32[16,16]{1,0} %x), "
+        "channel_id=1, replica_groups={{0,2,4,6},{1,3,5,7}}, "
+        "use_global_device_ids=true",
+        "  %ag = (f32[8]{0}, f32[8]{0}) all-gather-start(%a, %b), "
+        "replica_groups=[4,2]<=[8], dimensions={0}",
+        "  %agd = (f32[8]{0}, f32[8]{0}) all-gather-done(%ag)",
+        "  %cp = f32[4]{0} collective-permute(%c), "
+        "source_target_pairs={{0,2},{2,4},{4,6},{6,0}}",
+    ])
+    manifest = sa.collect_manifest(hlo, mesh)
+    assert manifest[("all-reduce", "data")] == [1, 16 * 16 * 4]
+    # iota groups [4,2]<=[8] are {0,1},{2,3},... = the model axis;
+    # the -done half of the async pair contributes no second site, and
+    # the -start result tuple (operand, output) counts only the OUTPUT
+    # half — the same collective budgets identically in either spelling
+    assert manifest[("all-gather", "model")] == [1, 8 * 4]
+    # pairs step flat ids by 2 = neighbors along the data axis
+    assert manifest[("collective-permute", "data")] == [1, 4 * 4]
+    assert len(manifest) == 3
+
+
+# -------------------------------------------------------------- PT501
+def _fixture_gather_program(mesh):
+    """A tiny sharded program whose ONE collective is an added
+    all-gather — the drift fixture of the acceptance criteria."""
+    import jax
+
+    def f(x):
+        def local(s):
+            return jax.lax.all_gather(s * 2.0, axis_name="data",
+                                      axis=0, tiled=True)
+        return shard_map_compat(local, mesh, in_specs=(P("data"),),
+                                out_specs=P())(x)
+
+    x = jax.device_put(jnp.ones((8, 4), jnp.float32),
+                       NamedSharding(mesh, P("data")))
+    hlo = jax.jit(f).lower(x).compile().as_text()
+    return sa.collect_manifest(hlo, mesh)
+
+
+def _entry(program, op, axis, ops, nbytes):
+    e = sa.BudgetEntry()
+    e.program, e.op, e.axis, e.ops, e.bytes = (program, op, axis, ops,
+                                               nbytes)
+    return e
+
+
+def test_pt501_added_all_gather_is_unbudgeted_drift():
+    manifest = _fixture_gather_program(_mesh8())
+    ((kind, axis), (n, nbytes)) = next(iter(manifest.items()))
+    assert (kind, axis, n) == ("all-gather", "data", 1)
+    findings, used = sa.check_budget("fixture", manifest, [],
+                                     "x.py", "comm_budget.toml")
+    assert [f.rule for f in findings] == ["PT501"]
+    assert "UNBUDGETED" in findings[0].message and used == []
+    # good twin: the budget pins exactly what the program emits
+    good = [_entry("fixture", "all-gather", "data", 1, nbytes)]
+    findings, used = sa.check_budget("fixture", manifest, good,
+                                     "x.py", "comm_budget.toml")
+    assert findings == [] and used == [0]
+
+
+def test_pt501_growth_and_shrink_both_flag():
+    manifest = {("all-gather", "data"): [2, 1024]}
+    grew = [_entry("p", "all-gather", "data", 1, 1024)]
+    findings, _ = sa.check_budget("p", manifest, grew, "x.py", "b.toml")
+    assert [f.rule for f in findings] == ["PT501"]
+    assert "GREW" in findings[0].message
+    # the only-shrinks side: an improvement must be locked in
+    shrank = [_entry("p", "all-gather", "data", 4, 4096)]
+    findings, _ = sa.check_budget("p", manifest, shrank, "x.py",
+                                  "b.toml")
+    assert [f.rule for f in findings] == ["PT501"]
+    assert "SHRANK" in findings[0].message
+    exact = [_entry("p", "all-gather", "data", 2, 1024)]
+    findings, _ = sa.check_budget("p", manifest, exact, "x.py", "b.toml")
+    assert findings == []
+
+
+def test_pt501_stale_budget_entries_flag():
+    entries = [_entry("zero1", "all-gather", "data", 1, 10),
+               _entry("no_such_program", "all-reduce", "data", 1, 10)]
+    findings = sa.stale_budget_findings(entries, {0}, "b.toml")
+    assert [f.rule for f in findings] == ["PT501"]
+    assert "unknown program" in findings[0].message
+    findings = sa.stale_budget_findings(
+        [_entry("zero1", "all-to-all", "data", 1, 10)], set(), "b.toml")
+    assert "matches no collective" in findings[0].message
+
+
+# -------------------------------------------------------------- PT502
+def test_pt502_replicated_big_slot_flags_and_sharded_twin_passes():
+    mesh = _mesh8()
+    big_rep = jax.device_put(jnp.ones((256, 128)),
+                             NamedSharding(mesh, P()))
+    big_sharded = jax.device_put(jnp.ones((256, 128)),
+                                 NamedSharding(mesh, P("data")))
+    small_rep = jax.device_put(jnp.ones((8, 8)),
+                               NamedSharding(mesh, P()))
+    must = [("slot", lambda p: "'slots'" in p)]
+    findings = sa.replication_findings(
+        {"slots": {"w": big_rep}}, must, "fx", "x.py")
+    assert [f.rule for f in findings] == ["PT502"]
+    assert "FULLY REPLICATED" in findings[0].message
+    assert "data(8)" in findings[0].message  # the matching axis named
+    assert sa.replication_findings(
+        {"slots": {"w": big_sharded}}, must, "fx", "x.py") == []
+    # below BIG_BYTES is scaffolding, not model state
+    assert sa.replication_findings(
+        {"slots": {"w": small_rep}}, must, "fx", "x.py") == []
+    # leaves outside the must-shard contract (e.g. dp params) pass
+    assert sa.replication_findings(
+        {"params": {"w": big_rep}}, must, "fx", "x.py") == []
+    # no mesh axis divides any dim: replication is the legitimate
+    # fallback (shard_opt_state's non-divisible warning path), not a
+    # violation — review fix, the rule matches its documentation
+    indivisible = jax.device_put(jnp.ones((255, 129)),
+                                 NamedSharding(mesh, P()))
+    assert sa.replication_findings(
+        {"slots": {"w": indivisible}}, must, "fx", "x.py") == []
+
+
+# -------------------------------------------------------------- PT503
+def _pack_program(mesh, pin):
+    def f(a, b):
+        packed = jnp.concatenate([a, b], axis=0).reshape(8, -1)
+        if pin:
+            packed = jax.lax.with_sharding_constraint(
+                packed, NamedSharding(mesh, P()))
+
+        def local(x):
+            return jax.lax.all_gather(x * 2.0, axis_name="data",
+                                      axis=0, tiled=True)
+
+        return shard_map_compat(local, mesh, in_specs=(P("data"),),
+                                out_specs=P())(packed)
+
+    return f
+
+
+def test_pt503_unpinned_pack_flags_and_pinned_twin_passes():
+    mesh = _mesh8()
+    a = jnp.ones((8, 4))
+    closed = jax.make_jaxpr(jax.jit(_pack_program(mesh, pin=False)))(a, a)
+    findings = sa.shardmap_pin_findings(closed, "fx", "x.py")
+    assert [f.rule for f in findings] == ["PT503"]
+    assert "concatenate" in findings[0].message
+    closed = jax.make_jaxpr(jax.jit(_pack_program(mesh, pin=True)))(a, a)
+    assert sa.shardmap_pin_findings(closed, "fx", "x.py") == []
+
+
+def test_pt503_deliberately_unpinned_zero1_pack(monkeypatch):
+    """The acceptance fixture: the REAL ZeRO-1 train step with its
+    with_sharding_constraint pins stripped (exactly the pre-r07-fix
+    program) raises PT503; the shipped (pinned) step is its good
+    twin."""
+    import numpy as np
+
+    from paddle_tpu.config import dsl
+    from paddle_tpu.data import DataFeeder, dense_vector, integer_value
+    from paddle_tpu.optim import Adam
+    from paddle_tpu.parallel import mesh as mesh_lib
+    from paddle_tpu.trainer import SGD
+
+    def build():
+        dsl.reset()
+        x = dsl.data(name="x", size=8)
+        lab = dsl.data(name="label", size=2)
+        h = dsl.fc(input=x, size=8, act="relu", name="h")
+        out = dsl.fc(input=h, size=2, act="softmax", name="out")
+        cost = dsl.classification_cost(input=out, label=lab)
+        tr = SGD(cost=cost, update_equation=Adam(learning_rate=1e-3),
+                 mesh=_mesh8(), seed=0)
+        tr.enable_zero1()
+        feeder = DataFeeder({"x": dense_vector(8),
+                             "label": integer_value(2)})
+        rng = np.random.RandomState(0)
+        data = [(rng.randn(8).astype(np.float32), int(rng.randint(2)))
+                for _ in range(8)]
+        feed = mesh_lib.shard_batch(feeder(data), tr.mesh)
+        return tr, (tr.params, tr.opt_state, feed,
+                    jax.random.PRNGKey(0), 0, None)
+
+    tr, args = build()
+    closed = jax.make_jaxpr(tr._train_step)(*args)
+    assert sa.shardmap_pin_findings(closed, "zero1", "z.py") == []
+    # strip the pins: trace again with the constraint a no-op
+    monkeypatch.setattr(jax.lax, "with_sharding_constraint",
+                        lambda x, s: x)
+    tr2, args2 = build()
+    closed = jax.make_jaxpr(tr2._train_step)(*args2)
+    findings = sa.shardmap_pin_findings(closed, "zero1", "z.py")
+    assert "PT503" in [f.rule for f in findings]
+
+
+# -------------------------------------------------------------- PT504
+def test_pt504_conflicting_pins_flag_and_single_pin_passes():
+    mesh = _mesh8()
+
+    def double(a):
+        x = jax.lax.with_sharding_constraint(
+            a, NamedSharding(mesh, P("data")))
+        y = jax.lax.with_sharding_constraint(
+            x.reshape(4, 16), NamedSharding(mesh, P()))
+        return y * 1.0
+
+    closed = jax.make_jaxpr(jax.jit(double))(jnp.ones((8, 8)))
+    findings = sa.reshard_findings(closed, "fx", "x.py")
+    assert [f.rule for f in findings] == ["PT504"]
+    assert "re-pinned" in findings[0].message
+
+    def single(a):
+        x = jax.lax.with_sharding_constraint(
+            a, NamedSharding(mesh, P("data")))
+        return x * 1.0
+
+    closed = jax.make_jaxpr(jax.jit(single))(jnp.ones((8, 8)))
+    assert sa.reshard_findings(closed, "fx", "x.py") == []
+    # re-pinning the SAME sharding is not a reshard
+
+    def same(a):
+        x = jax.lax.with_sharding_constraint(
+            a, NamedSharding(mesh, P("data")))
+        y = jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, P("data")))
+        return y * 1.0
+
+    closed = jax.make_jaxpr(jax.jit(same))(jnp.ones((8, 8)))
+    assert sa.reshard_findings(closed, "fx", "x.py") == []
+
+
+# ------------------------------------------- PT505 + rule_for semantics
+def test_rule_for_exact_beats_substring_regardless_of_order():
+    """The precedence contract the pipeline/zero1 composition relies
+    on: plan.shard_rules()'s '=<stacked key>' pins are merged AFTER
+    user rules (trainer.py:enable_pipeline), and a broad user
+    substring rule must not capture the stacked keys."""
+    sub_first = {"blk": P("data"), "=_blk0.w0": P("pipe", None)}
+    assert rule_for("_blk0.w0", sub_first) == P("pipe", None)
+    exact_first = {"=_blk0.w0": P("pipe", None), "blk": P("data")}
+    assert rule_for("_blk0.w0", exact_first) == P("pipe", None)
+    # non-exact names still take the substring rule
+    assert rule_for("_blk1.w0", sub_first) == P("data")
+
+
+def test_rule_for_first_substring_match_wins_in_table_order():
+    rules = {"emb": P("model", None), "w0": P("data")}
+    assert rule_for("_emb.w0", rules) == P("model", None)
+    assert rule_for("_out.w0", rules) == P("data")
+    assert rule_for("_bias.b0", rules) == P()
+
+
+def test_rule_for_exact_key_never_captures_superstring():
+    rules = {"=_emb.w0": P("model", None)}
+    assert rule_for("_emb.w0", rules) == P("model", None)
+    assert rule_for("_user_emb.w0", rules) == P()
+
+
+def test_effective_rules_respects_explicit_replication_request():
+    """Review regression (round 3): a user's explicit P() rule on a
+    sparse_grad table must keep it replicated — the sparse default may
+    only fill in when NO key matches, or under exact-first precedence
+    its auto-added '=' pin would override the user's substring rule."""
+    from paddle_tpu.core.registry import ParamSpec
+    from paddle_tpu.parallel.mesh import effective_rules
+
+    mesh = create_mesh(n_data=4, n_model=2)
+    spec = ParamSpec(shape=(64, 16), sparse_grad=True,
+                     absolute_name="_emb.w0")
+    # no user rule: the sparse default row-shards over model
+    auto = effective_rules({"_emb.w0": spec}, mesh, None)
+    assert rule_for("_emb.w0", auto) == P("model")
+    # explicit P() replication request: no auto-pin may be added
+    out = effective_rules({"_emb.w0": spec}, mesh, {"emb": P()})
+    assert "=_emb.w0" not in out
+    assert rule_for("_emb.w0", out) == P()
+
+
+def test_pt505_bad_table_and_good_twin():
+    names = ["_emb.w0", "_out.w0", "_blk0.w0"]
+    bad = {
+        "=_emb.w0": P("model", None),
+        "_emb": P("data"),        # fully shadowed by the exact pin
+        "conv": P("data"),        # dead: matches nothing
+        "=_out": P("data"),       # exact key that exact-matches nothing
+    }
+    findings = sa.check_rule_table(bad, names, "x.py", "fixture")
+    msgs = {f.message.split("rule key ")[1].split(" ")[0]: f.message
+            for f in findings}
+    assert all(f.rule == "PT505" for f in findings)
+    assert "SHADOWED" in msgs["'_emb'"]
+    assert "'=_emb.w0'" in msgs["'_emb'"]  # names the shadowing key
+    assert "DEAD" in msgs["'conv'"]
+    assert "exact-match key" in msgs["'=_out'"]
+    assert len(findings) == 3
+    good = {"=_emb.w0": P("model", None), "_out": P("data"),
+            "blk": P("pipe", None)}
+    assert sa.check_rule_table(good, names, "x.py", "fixture") == []
+    # empty/None tables are vacuously clean
+    assert sa.check_rule_table({}, names, "x.py", "fixture") == []
+    assert sa.check_rule_table(None, names, "x.py", "fixture") == []
+
+
+# ---------------------------------------- PT401 multichip / accuracy
+def test_pt401_multichip_shape(tmp_path):
+    good = {"n_devices": 8, "rc": 0, "ok": True, "skipped": False,
+            "tail": "dryrun ok"}
+    p = tmp_path / "MULTICHIP_rXX.json"
+    p.write_text(json.dumps(good))
+    assert check_bench_file(str(p), "MULTICHIP_rXX.json") == []
+    bad = dict(good)
+    del bad["tail"]
+    bad["ok"] = "yes"
+    p.write_text(json.dumps(bad))
+    findings = check_bench_file(str(p), "MULTICHIP_rXX.json")
+    assert {f.rule for f in findings} == {"PT401"}
+    assert any("'tail'" in f.message for f in findings)
+    assert any("'ok'" in f.message for f in findings)
+
+
+def test_pt401_accuracy_shape(tmp_path):
+    good = {"platform": "cpu",
+            "light_mnist": {"final_err": 0.08, "passes": 3}}
+    p = tmp_path / "ACCURACY_rXX.json"
+    p.write_text(json.dumps(good))
+    assert check_bench_file(str(p), "ACCURACY_rXX.json") == []
+    p.write_text(json.dumps({"platform": "cpu", "note": "nothing ran"}))
+    findings = check_bench_file(str(p), "ACCURACY_rXX.json")
+    assert [f.rule for f in findings] == ["PT401"]
+    assert "run section" in findings[0].message
+    # NaN anywhere still rejects (shared finite-number walk)
+    p.write_text('{"platform": "cpu", "m": {"err": NaN}}')
+    findings = check_bench_file(str(p), "ACCURACY_rXX.json")
+    assert any("non-finite" in f.message for f in findings)
+
+
+def test_pt401_family_keyed_by_filename_not_content(tmp_path):
+    """Review regression: a truncated BENCH artifact that kept
+    'platform' but lost 'metric' must fail as an unrecognized bench
+    shape — not quietly validate against the looser accuracy schema;
+    likewise a MULTICHIP file with accuracy-shaped content."""
+    doc = json.dumps({"platform": "cpu", "zero1": {"steps_per_s": 12.0}})
+    p = tmp_path / "BENCH_r99.json"
+    p.write_text(doc)
+    findings = check_bench_file(str(p), "BENCH_r99.json")
+    assert [f.rule for f in findings] == ["PT401"]
+    assert "unrecognized bench artifact shape" in findings[0].message
+    p = tmp_path / "MULTICHIP_r99.json"
+    p.write_text(doc)
+    findings = check_bench_file(str(p), "MULTICHIP_r99.json")
+    assert findings and all(f.rule == "PT401" for f in findings)
+    assert any("n_devices" in f.message for f in findings)
+
+
+def test_schema_check_scans_multichip_and_accuracy_patterns(tmp_path):
+    from paddle_tpu.analysis.bench_schema import run_schema_check
+    (tmp_path / "MULTICHIP_r99.json").write_text("{broken")
+    (tmp_path / "ACCURACY_r99.json").write_text('{"platform": "cpu"}')
+    findings = run_schema_check(str(tmp_path))
+    assert sorted(f.path for f in findings) == [
+        "ACCURACY_r99.json", "MULTICHIP_r99.json"]
+
+
+# ------------------------------------------------------- --json mode
+def test_json_output_round_trips_findings(tmp_path, capsys):
+    """CI contract: --json emits ONE parseable JSON object on stdout
+    (progress on stderr) whose findings mirror the text report's."""
+    from paddle_tpu.analysis.__main__ import run
+    (tmp_path / "BENCH_r99.json").write_text('{"metric": ""}')
+    rc = run(["--root", str(tmp_path), "--json", "--skip-ast",
+              "--skip-locks", "--skip-jaxpr", "--skip-shard"])
+    out = capsys.readouterr().out
+    doc = json.loads(out)
+    assert rc == 1
+    assert doc["counts"] == {"PT401": len(doc["findings"])}
+    f = doc["findings"][0]
+    assert f["rule"] == "PT401" and f["name"] == "bench-schema"
+    assert f["file"] == "BENCH_r99.json" and f["line"] == 1
+    assert "metric" in f["message"]
+    # the same scan through the API agrees field by field
+    from paddle_tpu.analysis.bench_schema import run_schema_check
+    direct = run_schema_check(str(tmp_path))
+    assert [(d["rule"], d["file"], d["line"], d["message"])
+            for d in doc["findings"]] == \
+        [(g.rule, g.path, g.line, g.message) for g in direct]
+
+
+def test_json_output_exit2_still_emits_one_object(tmp_path, capsys):
+    """Review regression: the exit-2 paths (audit crash, baseline load
+    error) must still put ONE JSON object on stdout carrying the
+    findings collected before the failure — `--json | jq .` always
+    parses, per the documented contract."""
+    from paddle_tpu.analysis.__main__ import run
+    (tmp_path / "BENCH_r99.json").write_text('{"metric": ""}')
+    bad_baseline = tmp_path / "baseline.toml"
+    bad_baseline.write_text("[[suppress]]\nrule = ???\n")
+    rc = run(["--root", str(tmp_path), "--json", "--skip-ast",
+              "--skip-locks", "--skip-jaxpr", "--skip-shard",
+              "--baseline", str(bad_baseline)])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 2
+    assert "unparseable" in doc["error"]
+    # the schema findings collected before the crash ride along
+    assert doc["counts"] == {"PT401": len(doc["findings"])}
+    assert doc["findings"][0]["file"] == "BENCH_r99.json"
+
+
+def test_json_output_clean_tree_exits_zero(tmp_path, capsys):
+    from paddle_tpu.analysis.__main__ import run
+    (tmp_path / "BENCH_r99.json").write_text(
+        '{"metric": "steps", "platform": "cpu", "a": 1.0, "b": 2.0}')
+    rc = run(["--root", str(tmp_path), "--json", "--skip-ast",
+              "--skip-locks", "--skip-jaxpr", "--skip-shard"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["findings"] == [] and doc["counts"] == {}
+    assert doc["pass4_s"] is None  # pass 4 skipped: no wall time

@@ -1,11 +1,13 @@
-"""Tier-1 enforcement: graftlint's three passes run CLEAN over this
+"""Tier-1 enforcement: graftlint's four passes run CLEAN over this
 repo with an EMPTY baseline.
 
 This is the test that turns the rule catalog from advice into an
 invariant: a PR that closure-captures params into a jit, down-casts a
 mask, packs with jnp.pad, adds an unguarded hot-path jit, registers a
-layer without a grad-matrix row, inverts a lock order, or commits a
-malformed BENCH artifact fails HERE, with file:line and a rule id.
+layer without a grad-matrix row, inverts a lock order, commits a
+malformed evidence artifact, grows a parallel program's collective
+footprint past comm_budget.toml, drops a zero1 pin, or leaves a dead
+shard rule fails HERE, with file:line and a rule id.
 """
 
 import os
@@ -69,6 +71,31 @@ def test_pass2_jaxpr_audit_train_and_serving():
     findings = audit_train_step(log=None) + audit_serving(log=None)
     assert not findings, "\n" + format_report(
         findings, "Pass 2 (jaxpr audit) found violations:")
+
+
+def test_pass4_shard_audit_clean_and_budget_pins_all_programs():
+    """The collective manifest of every traced parallel program —
+    dp_train's grad all-reduce, zero1's ONE fused all-gather plus its
+    pinned pack buffers, the GPipe handoff ppermutes, the TP model-axis
+    reduce, the ring-attention rotation — matches comm_budget.toml
+    exactly; placements honor each program's must-shard contract; the
+    rule tables the programs construct carry no dead/shadowed keys.
+    This is the FSDP-refactor contract: ROADMAP item 1 lands against
+    these budgets, not against hope."""
+    from paddle_tpu.analysis.findings import format_report
+    from paddle_tpu.analysis.shard_audit import (PROGRAM_NAMES,
+                                                 load_budget, run_pass4)
+    findings = run_pass4(ROOT, log=None)
+    assert not findings, "\n" + format_report(
+        findings, "Pass 4 (sharding/collective audit) found violations:")
+    budgeted = {e.program for e in load_budget()}
+    for name in ("dp_train", "zero1", "pipeline", "tp_embed",
+                 "seq_ring"):
+        assert name in budgeted, f"{name} lost its pinned manifest"
+    assert set(budgeted) <= set(PROGRAM_NAMES)
+    # serving stays collective-free BY ABSENCE: any collective it
+    # grows is unbudgeted drift (PT501), so no entry may name it
+    assert "serving_warm" not in budgeted
 
 
 def test_pass2_jaxpr_audit_entry():
