@@ -799,6 +799,215 @@ def bench_decode(rounds=None, calls=None):
     return res
 
 
+def bench_fleet(rounds=None, n_requests=None):
+    """Fleet serving A/B (``python bench.py --fleet`` -> BENCH_r13.json):
+
+    1. **Cold start: live trace vs AOT cache** — the SAME LSTM deploy
+       model built + warmed + answering its first request, (a) tracing
+       every bucket variant live vs (b) deserializing the warmed menu
+       from the AOT cache (``serving/aot_cache.py``). Interleaved
+       best-of-R per CLAUDE.md's host-drift rule. This is the number
+       that decides whether kill-and-respawn under load is a non-event:
+       a respawned replica pays (b), not (a).
+    2. **Kill-and-respawn under open-loop load** — three router-fronted
+       replicas (each its own predictor, all warmed from the shared
+       cache) under a fixed-rate open-loop request schedule; mid-run a
+       seeded chaos fault kills one replica's serving worker
+       (``serve_batch`` kill, the in-process SIGKILL analogue). The
+       router fails the in-flight request over, ejects the replica, and
+       respawns it from the cache. Reported: zero failed non-shed
+       requests (asserted), fleet p50/p99 through the router, failover /
+       respawn counters, and the respawn's warm time.
+    """
+    import tempfile
+    import threading
+
+    import numpy as np
+    import jax
+    from paddle_tpu.config import dsl
+    from paddle_tpu.data import integer_value, integer_value_sequence
+    from paddle_tpu.models import lstm_text_classifier
+    from paddle_tpu.serving import (EngineTransport, Overloaded,
+                                    ReplicaRouter, ServingEngine,
+                                    ServingError, ServingPredictor)
+    from paddle_tpu.testing import chaos
+    from paddle_tpu.trainer.trainer import Topology
+
+    rounds = int(os.environ.get("BENCH_FLEET_ROUNDS", "2")
+                 if rounds is None else rounds)
+    n_requests = int(os.environ.get("BENCH_FLEET_REQUESTS", "60")
+                     if n_requests is None else n_requests)
+    vocab, seqlen = 1000, 32
+    dsl.reset()
+    cost, out, _ = lstm_text_classifier(
+        vocab_size=vocab, embed_dim=32, hidden=48, num_layers=1, classes=2)
+    topo = Topology(cost)
+    params = topo.network.init_params(jax.random.PRNGKey(0))
+    feeding = {"words": integer_value_sequence(vocab),
+               "label": integer_value(2)}
+    rng = np.random.RandomState(0)
+
+    def mk_sample():
+        return (list(rng.randint(0, vocab, size=seqlen)),
+                int(rng.randint(0, 2)))
+
+    cache_dir = tempfile.mkdtemp(prefix="paddle_tpu_aot_bench_")
+
+    def build_pred(cached: bool):
+        return ServingPredictor(
+            topo.graph, params, [out.name], feeding,
+            batch_buckets=[1, 4], length_buckets=[seqlen],
+            aot_cache=cache_dir if cached else None)
+
+    sample = mk_sample()
+
+    def cold_start_ms(cached: bool) -> float:
+        """Build + warm + first answer, the full respawn path."""
+        t0 = time.perf_counter()
+        pred = build_pred(cached)
+        pred.warmup()
+        pred.predict_rows([sample])
+        return 1e3 * (time.perf_counter() - t0)
+
+    # prime the cache once (not timed as the cache arm — it is the live
+    # arm's work product), then interleave live/cache rounds
+    prime_ms = cold_start_ms(True)
+    best = {"live": float("inf"), "cache": float("inf")}
+    for _ in range(rounds):
+        best["live"] = min(best["live"], cold_start_ms(False))
+        best["cache"] = min(best["cache"], cold_start_ms(True))
+    res = {
+        "cold_start_live_ms": round(best["live"], 1),
+        "cold_start_cache_ms": round(best["cache"], 1),
+        "cold_start_live_vs_cache": round(
+            best["live"] / max(best["cache"], 1e-9), 2),
+        "cold_start_prime_ms": round(prime_ms, 1),
+        "fleet_rounds": rounds,
+    }
+
+    # ---- kill-and-respawn under open-loop load -----------------------
+    def build_engine():
+        return ServingEngine(build_pred(True), max_batch=4,
+                             batch_timeout_ms=2.0,
+                             queue_depth=n_requests + 8
+                             ).start(warmup=True)
+
+    best_round = None
+    failed_all_rounds = 0  # the zero-drop invariant is PER ROUND —
+    # best-of-R applies to perf numbers, never to a correctness counter
+    for _ in range(rounds):
+        engines = [build_engine() for _ in range(3)]
+        router = ReplicaRouter(
+            [EngineTransport(e) for e in engines],
+            spawn=lambda rid: EngineTransport(build_engine()),
+            health_poll_ms=25.0).start()
+        # calibrate the open-loop rate off sequential dispatches, then
+        # offer ~2x that rate so queues form and failover runs hot
+        t0 = time.perf_counter()
+        for _ in range(8):
+            router.dispatch(sample)
+        interval = (time.perf_counter() - t0) / 8 / 2.0
+        from paddle_tpu.serving import RouterMetrics
+        router.metrics = RouterMetrics()
+        # the seeded fault: kill whichever replica serves the Nth batch
+        # mid-run; the schedule reproduces from the seed
+        plan = chaos.FaultPlan(seed=13, faults=[
+            {"type": "kill", "site": "serve_batch", "at": 6,
+             "mode": "raise"}])
+        counts = {"ok": 0, "shed": 0, "failed": 0}
+        lock = threading.Lock()
+
+        def one(s):
+            from paddle_tpu.serving import Unavailable
+            try:
+                router.dispatch(s)
+                key = "ok"
+            except Unavailable:
+                # NO ready replica = outage, not backpressure — it must
+                # fail the zero-drop assertion (Unavailable subclasses
+                # Overloaded, so this arm must come first)
+                key = "failed"
+            except Overloaded:
+                key = "shed"  # typed backpressure is not a failure
+            except ServingError:
+                key = "failed"
+            with lock:
+                counts[key] += 1
+
+        threads = []
+        samples = [mk_sample() for _ in range(n_requests)]
+        t_start = time.perf_counter()
+        with chaos.chaos_plan(plan):
+            for i, s in enumerate(samples):
+                target = t_start + i * interval
+                now = time.perf_counter()
+                if target > now:
+                    time.sleep(target - now)
+                th = threading.Thread(target=one, args=(s,))
+                th.start()
+                threads.append(th)
+            for th in threads:
+                th.join(120.0)
+        elapsed = time.perf_counter() - t_start
+        # give the health loop a beat to finish the respawn
+        deadline = time.perf_counter() + 10.0
+        while (time.perf_counter() < deadline
+               and router.metrics.snapshot()["respawns_total"] < 1):
+            time.sleep(0.05)
+        snap = router.metrics.snapshot()
+        health = router.fleet_health()
+        round_res = {
+            "fleet_requests": n_requests,
+            "fleet_open_loop_interval_ms": round(interval * 1e3, 3),
+            "fleet_ok": counts["ok"],
+            "fleet_shed": counts["shed"],
+            "fleet_failed_non_shed": counts["failed"],
+            "fleet_rps": round(counts["ok"] / elapsed, 2),
+            "fleet_p50_ms": snap["fleet_latency_ms"]["p50_ms"],
+            "fleet_p99_ms": snap["fleet_latency_ms"]["p99_ms"],
+            "fleet_failovers_total": snap["failovers_total"],
+            "fleet_replica_deaths_total": snap["replica_deaths_total"],
+            "fleet_respawns_total": snap["respawns_total"],
+            "fleet_respawn_warm_ms": next(
+                (round(r["last_spawn_ms"], 1)
+                 for r in health["replicas"]
+                 if r["last_spawn_ms"] is not None), None),
+            "fleet_ready_after": health["ready_replicas"],
+        }
+        router.shutdown()
+        failed_all_rounds += counts["failed"]
+        # best-of across rounds: most clean answers, then lowest p99
+        keyf = (round_res["fleet_ok"],
+                -(round_res["fleet_p99_ms"] or 1e9))
+        if best_round is None or keyf > best_round[0]:
+            best_round = (keyf, round_res)
+    res.update(best_round[1])
+    # report (and assert) the SUM over every round: a round where the
+    # kill DID fail requests must not hide behind a cleaner best-of
+    res["fleet_failed_non_shed"] = failed_all_rounds
+    # the acceptance invariant, asserted where the evidence is made:
+    # a replica SIGKILL under load must not fail a single non-shed
+    # request in ANY round (failover + respawn absorb it)
+    assert failed_all_rounds == 0, res
+    return res
+
+
+def fleet_main():
+    """``python bench.py --fleet``: the off-tunnel fleet bench alone,
+    forced onto CPU; one JSON line, mirrored to BENCH_r13.json."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    result = {"metric": "serving_fleet_failover_and_aot_cold_start",
+              "platform": jax.devices()[0].platform}
+    result.update(bench_fleet())
+    line = json.dumps(result)
+    print(line, flush=True)
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, "BENCH_r13.json"), "w") as f:
+        f.write(line + "\n")
+    return 0
+
+
 def decode_main():
     """``python bench.py --decode``: the off-tunnel decode A/B alone,
     forced onto CPU; one JSON line, mirrored to BENCH_r10.json."""
@@ -969,6 +1178,11 @@ def child_main():
     # window records on-chip decode numbers for free (off-tunnel number:
     # BENCH_r10.json via --decode)
     extra("decode", bench_decode)
+    # fleet: AOT cold-start A/B + kill-and-respawn under load — on a
+    # real chip the live-trace arm pays the tunnel's multi-minute XLA
+    # compiles, which is exactly where the cache matters most
+    # (off-tunnel number: BENCH_r13.json via --fleet)
+    extra("fleet", bench_fleet)
     return 0
 
 
@@ -983,6 +1197,8 @@ def main():
         return serving_main()
     if "--decode" in sys.argv[1:]:
         return decode_main()
+    if "--fleet" in sys.argv[1:]:
+        return fleet_main()
     if os.environ.get("BENCH_CHILD") == "1":
         return child_main()
 

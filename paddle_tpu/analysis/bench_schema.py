@@ -24,7 +24,12 @@ looser schema):
   finite number (or null when a side was skipped) with both A/B sides
   present; **harness style** (r01–r05) ``{"n": ..., "cmd": str, "rc":
   int, ...}``; **watcher style** (r06) ``{"round": ..., "cmd": ...,
-  "parsed": dict, ...}``.
+  "parsed": dict, ...}``. Metric-style artifacts whose metric starts
+  with ``serving_fleet`` (BENCH_r13, the kill-and-respawn bench) must
+  additionally carry the cold-start A/B sides (``cold_start_live_ms`` /
+  ``cold_start_cache_ms``), ``fleet_p99_ms``, and the
+  ``fleet_failovers_total`` / ``fleet_failed_non_shed`` counters — the
+  failover and zero-drop evidence.
 
 Everything must parse as one JSON object with finite numbers
 throughout (NaN/Infinity are emitted by a crashed averaging step and
@@ -101,6 +106,20 @@ def check_bench_file(path: str, rel: str) -> List[Finding]:
             bad("'metric' must be a non-empty string")
         if not isinstance(data.get("platform"), str):
             bad("metric-style artifact missing 'platform'")
+        if str(data.get("metric", "")).startswith("serving_fleet"):
+            # the r13 fleet artifact (BENCH_r13): kill-and-respawn
+            # evidence is only evidence with the cold-start A/B sides,
+            # the fleet p99, and the failover/zero-drop counters present
+            for k in ("cold_start_live_ms", "cold_start_cache_ms",
+                      "fleet_p99_ms"):
+                v = data.get(k)
+                if not isinstance(v, (int, float)) or isinstance(v, bool):
+                    bad(f"fleet artifact missing numeric {k!r}")
+            for k in ("fleet_failovers_total", "fleet_failed_non_shed"):
+                v = data.get(k)
+                if not isinstance(v, int) or isinstance(v, bool):
+                    bad(f"fleet artifact missing int {k!r} (the "
+                        "failover / zero-drop evidence)")
         for key, val in data.items():
             if "_vs_" not in key:
                 continue

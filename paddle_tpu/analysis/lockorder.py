@@ -37,10 +37,12 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from paddle_tpu.analysis.findings import Finding
 
-# the five threaded modules the tentpole names, plus lock-holding
-# classes they call into while holding their own locks
+# the threaded modules the tentpole names (r12: five; r13 adds the
+# replica router — health thread + per-request dispatch/hedge threads),
+# plus lock-holding classes they call into while holding their own locks
 DEFAULT_MODULES = (
     "paddle_tpu/serving/batcher.py",
+    "paddle_tpu/serving/router.py",
     "paddle_tpu/dist/master.py",
     "paddle_tpu/dist/checkpoint.py",
     "paddle_tpu/trainer/checkpoint.py",

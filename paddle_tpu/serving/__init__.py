@@ -17,11 +17,22 @@ C API loads) served over HTTP with
   mid-decode,
 - a metrics plane splitting request latency into
   {queue_wait, pad_overhead, compute, decode} with batch occupancy and
-  per-bucket hit counts, on ``/metrics`` + ``/healthz``.
+  per-bucket hit counts, on ``/metrics`` + ``/healthz`` (readiness) /
+  ``/livez`` (liveness),
+- a fleet tier (``--replicas N``, ``serving/router.py``): N replica
+  engines behind a health-aware router — failover of definite replica
+  failures, per-replica circuit breakers with half-open probing, capped
+  hedged retries for idempotent score requests (never generate),
+  auto-respawn of dead replicas, rolling hot-swap reload with zero
+  queued drops, fleet-wide 429 backpressure — with an AOT warmup cache
+  (``--aot_cache_dir``, ``serving/aot_cache.py``) that persists the
+  warmed bucket menu as serialized compiled executables so a respawned
+  replica cold-starts in milliseconds instead of re-tracing the shape
+  cross-product.
 
 Entry points: ``python -m paddle_tpu.trainer.cli --job=serve`` (flags
-``--port --batch_timeout_ms --max_batch --queue_depth``), or
-programmatically::
+``--port --batch_timeout_ms --max_batch --queue_depth --replicas
+--aot_cache_dir``), or programmatically::
 
     pred = ServingPredictor.from_merged("model.ptmodel", feeding,
                                         batch_buckets=[1, 2, 4, 8],
@@ -32,12 +43,19 @@ programmatically::
 Design record: ``docs/serving.md``.
 """
 
+from paddle_tpu.serving.aot_cache import AOTCache  # noqa: F401
 from paddle_tpu.serving.batcher import ServingEngine  # noqa: F401
 from paddle_tpu.serving.client import ServingClient  # noqa: F401
 from paddle_tpu.serving.errors import (BadRequest,  # noqa: F401
                                        DeadlineExceeded, Overloaded,
-                                       ServingError, ShuttingDown)
-from paddle_tpu.serving.metrics import ServingMetrics  # noqa: F401
+                                       ServingError, ShuttingDown,
+                                       Unavailable)
+from paddle_tpu.serving.metrics import (RouterMetrics,  # noqa: F401
+                                        ServingMetrics)
 from paddle_tpu.serving.predictor import ServingPredictor  # noqa: F401
 from paddle_tpu.serving.server import (install_signal_handlers,  # noqa: F401
                                        make_server, serve_forever)
+from paddle_tpu.serving.router import (EngineTransport,  # noqa: F401
+                                       HTTPTransport, ReplicaRouter,
+                                       make_router_server,
+                                       serve_router_forever)

@@ -143,6 +143,49 @@ class ServingEngine:
         with self._lock:
             return len(self._queue)
 
+    def backlog_hint_ms(self) -> float:
+        """Drain-time estimate (EWMA batch time x queued batches) for
+        admission hints: the single-replica 429 ``retry_after_ms`` and
+        the router's fleet-wide capacity math. Lock-free read of an
+        estimator — a stale value only skews a hint."""
+        return self._retry_after_ms()
+
+    def health(self) -> dict:
+        """Liveness vs readiness, split (the ``/healthz`` payload and
+        the router's poll target):
+
+        - **live** — the process is worth keeping: the worker thread has
+          not died to a bug. A DRAINING replica is still live (killing
+          it mid-drain would drop its queued requests).
+        - **ready** — dispatchable: warmed, not draining, worker alive.
+          The router stops routing to a replica the moment this flips,
+          instead of discovering it via a refused request.
+        """
+        live = self.fatal is None
+        warmed = bool(self.predictor.warmed)
+        ready = live and warmed and not self._draining
+        if ready:
+            status = "ok"
+        elif self._draining:
+            status = "draining"
+        elif live and not warmed:
+            status = "warming"
+        else:
+            status = "unhealthy"
+        h = {
+            "status": status, "live": live, "ready": ready,
+            "warmed": warmed, "draining": self._draining,
+            "queue_depth": self.queue_len(),
+            "backlog_ms": round(self.backlog_hint_ms(), 1),
+            "model_version": getattr(self.predictor, "model_version",
+                                     None),
+            "fatal": repr(self.fatal) if self.fatal else None,
+        }
+        cache = getattr(self.predictor, "aot_cache", None)
+        if cache is not None:
+            h["aot_cache"] = dict(cache.stats)
+        return h
+
     def begin_drain(self):
         """Close admission; queued and in-flight work still completes.
         The SIGTERM handler calls this (``serving/server.py``)."""

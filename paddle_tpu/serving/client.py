@@ -8,6 +8,15 @@ status-code string matching. Closed-menu 400s carry
 ``beam_size`` / ``max_length`` / length-bucket menu) the client can
 retry with.
 
+Against the replica router (``serving/router.py``) the client also
+surfaces routing provenance: every response carries the router's
+``X-Replica-Id`` / ``X-Failovers`` / ``X-Hedged`` headers as
+``last_provenance`` (and as a ``"provenance"`` key on successful result
+dicts; typed errors carry ``.provenance``). Router 429s put the
+FLEET-wide backlog estimate in ``retry_after_ms`` — the min over
+replica drain hints, since queues drain in parallel — so the existing
+backoff honors fleet capacity, not one replica's private EWMA.
+
 Opt-in retries (``retries=N``): every serving request is idempotent
 (stateless inference), so the client may safely re-send on a connection
 reset (a worker restart, a drained-and-relaunched server) and on 429
@@ -44,6 +53,11 @@ class ServingClient:
         self.backoff_base_ms = backoff_base_ms
         self.backoff_cap_ms = backoff_cap_ms
         self._jitter = random.Random(backoff_seed)
+        # routing provenance of the LAST response (None for a single-
+        # replica server): {"replica", "failovers", "hedges"} — also
+        # attached to successful router responses under "provenance"
+        # and to raised typed errors as .provenance
+        self.last_provenance: Optional[dict] = None
 
     # ------------------------------------------------------------- wire
     def _sleep_ms(self, ms: float):
@@ -64,7 +78,31 @@ class ServingClient:
         return backoff_delay(attempt, base=self.backoff_base_ms,
                              cap=self.backoff_cap_ms, rng=self._jitter)
 
+    def _provenance_from(self, resp) -> Optional[dict]:
+        """Routing provenance the replica router attaches as headers —
+        which replica answered, how many failovers/hedges the request
+        survived. None when talking to a single-replica server. ANY of
+        the three headers marks a router response: an error that never
+        landed on a replica has no X-Replica-Id but its failover count
+        is still provenance worth surfacing."""
+        prov = {}
+        rid = resp.getheader("X-Replica-Id")
+        if rid is not None:
+            prov["replica"] = rid
+        for header, key in (("X-Failovers", "failovers"),
+                            ("X-Hedged", "hedges")):
+            v = resp.getheader(header)
+            if v is not None:
+                try:
+                    prov[key] = int(v)
+                except ValueError:
+                    prov[key] = v
+        return prov or None
+
     def _request_once(self, method: str, path: str, body=None) -> dict:
+        # cleared up front: a connection-level failure below must not
+        # leave the PREVIOUS response's replica attributed to this one
+        self.last_provenance = None
         conn = http.client.HTTPConnection(self.host, self.port,
                                           timeout=self.timeout)
         try:
@@ -77,8 +115,15 @@ class ServingClient:
                 data = json.loads(raw) if raw else {}
             except json.JSONDecodeError:
                 data = {"raw": raw.decode(errors="replace")}
+            # retry provenance rides every router response, errors
+            # included (last_provenance survives a raise below)
+            self.last_provenance = self._provenance_from(resp)
             if resp.status >= 400:
-                raise from_wire(data, resp.status)
+                err = from_wire(data, resp.status)
+                err.provenance = self.last_provenance
+                raise err
+            if self.last_provenance is not None and isinstance(data, dict):
+                data.setdefault("provenance", self.last_provenance)
             return data
         finally:
             conn.close()

@@ -78,6 +78,17 @@ class ShuttingDown(Overloaded):
     code = "shutting_down"
 
 
+class Unavailable(Overloaded):
+    """The replica router has no ready replica to dispatch to (all
+    ejected/draining/dead, or every failover attempt burned). 503 with
+    the same retry/backoff contract as 429 — ``retry_after_ms`` carries
+    the router's FLEET-wide capacity estimate (the earliest any replica
+    frees up), not one replica's private EWMA."""
+
+    status = 503
+    code = "unavailable"
+
+
 def from_wire(body: dict, status: int) -> ServingError:
     """Client side: rebuild the typed error from a JSON error body."""
     err = (body or {}).get("error", {})
@@ -87,6 +98,7 @@ def from_wire(body: dict, status: int) -> ServingError:
         DeadlineExceeded.code: DeadlineExceeded,
         Overloaded.code: Overloaded,
         ShuttingDown.code: ShuttingDown,
+        Unavailable.code: Unavailable,
     }.get(code, ServingError)
     e = cls(err.get("message", f"HTTP {status}"),
             retry_after_ms=err.get("retry_after_ms"),

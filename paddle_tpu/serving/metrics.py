@@ -29,6 +29,13 @@ at every chunk boundary, the continuous-batching analogue of batch
 occupancy (how full the decode batch the chip actually runs is, now
 that lanes retire and admit mid-flight).
 
+The fleet tier adds :class:`RouterMetrics` — the front-tier router's
+view: per-replica dispatch counts, failovers, hedges (fired vs won),
+ejections/respawns/reloads, and the fleet-wide end-to-end latency
+reservoir (what a CLIENT sees through the router, queue + failover +
+hedge wait included — the number the kill-and-respawn bench reports as
+fleet p99).
+
 Exported two ways: :meth:`ServingMetrics.snapshot` (the ``/metrics``
 JSON + ``bench.py --serving``) and :meth:`to_prometheus` (text format,
 ``# TYPE`` lines included, for scrapers).
@@ -177,44 +184,105 @@ class ServingMetrics:
             }
 
     def to_prometheus(self, prefix: str = "paddle_tpu_serving") -> str:
+        return _serving_prometheus(self, prefix)
+
+
+class RouterMetrics:
+    """Thread-safe metric registry for one replica router."""
+
+    COUNTERS = ("dispatches_total", "responses_total", "failovers_total",
+                "hedges_total", "hedge_wins_total", "ejections_total",
+                "breaker_open_total", "respawns_total", "reloads_total",
+                "shed_total", "replica_deaths_total")
+
+    def __init__(self, window: int = 4096):
+        self._lock = threading.Lock()
+        self.counters = {c: 0 for c in self.COUNTERS}
+        # fleet-wide end-to-end latency (ms) as seen THROUGH the router:
+        # replica service time + failover/hedge overhead
+        self.fleet_latency = LatencyStat(window)
+        self.replica_dispatches: Counter = Counter()
+
+    def inc(self, name: str, n: int = 1):
+        with self._lock:
+            self.counters[name] += n
+
+    def observe_dispatch(self, replica_id: str, ms: Optional[float]):
+        with self._lock:
+            self.counters["responses_total"] += 1
+            self.replica_dispatches[replica_id] += 1
+            if ms is not None:
+                self.fleet_latency.add(ms)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "fleet_latency_ms": self.fleet_latency.snapshot(),
+                "replica_dispatches": dict(self.replica_dispatches),
+                **self.counters,
+            }
+
+    def to_prometheus(self, prefix: str = "paddle_tpu_router") -> str:
         s = self.snapshot()
         lines = []
         for c in self.COUNTERS:
             lines.append(f"# TYPE {prefix}_{c} counter")
             lines.append(f"{prefix}_{c} {s[c]}")
-        lines.append(f"# TYPE {prefix}_latency_ms summary")
-        for phase, st in s["latency_ms"].items():
-            for q, key in (("0.5", "p50_ms"), ("0.95", "p95_ms"),
-                           ("0.99", "p99_ms")):
-                v = st[key]
-                if v is not None:
-                    lines.append(
-                        f'{prefix}_latency_ms{{phase="{phase}",'
-                        f'quantile="{q}"}} {v}')
+        lines.append(f"# TYPE {prefix}_fleet_latency_ms summary")
+        lat = s["fleet_latency_ms"]
+        for q, key in (("0.5", "p50_ms"), ("0.95", "p95_ms"),
+                       ("0.99", "p99_ms")):
+            if lat[key] is not None:
+                lines.append(
+                    f'{prefix}_fleet_latency_ms{{quantile="{q}"}} '
+                    f'{lat[key]}')
+        lines.append(f"{prefix}_fleet_latency_ms_count {lat['count']}")
+        lines.append(f"# TYPE {prefix}_replica_dispatches counter")
+        for rid, n in sorted(s["replica_dispatches"].items()):
             lines.append(
-                f'{prefix}_latency_ms_count{{phase="{phase}"}} '
-                f'{st["count"]}')
-            lines.append(
-                f'{prefix}_latency_ms_sum{{phase="{phase}"}} '
-                f'{st["sum_ms"]}')
-        occ = s["batch_occupancy"]
-        lines.append(f"# TYPE {prefix}_batch_occupancy gauge")
-        if occ["mean"] is not None:
-            lines.append(f"{prefix}_batch_occupancy {occ['mean']}")
-        lines.append(f"# TYPE {prefix}_decode_steps summary")
-        for q, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
-            v = s["decode_steps"][key]
+                f'{prefix}_replica_dispatches{{replica="{rid}"}} {n}')
+        return "\n".join(lines) + "\n"
+
+
+def _serving_prometheus(m: "ServingMetrics", prefix: str) -> str:
+    s = m.snapshot()
+    lines = []
+    for c in m.COUNTERS:
+        lines.append(f"# TYPE {prefix}_{c} counter")
+        lines.append(f"{prefix}_{c} {s[c]}")
+    lines.append(f"# TYPE {prefix}_latency_ms summary")
+    for phase, st in s["latency_ms"].items():
+        for q, key in (("0.5", "p50_ms"), ("0.95", "p95_ms"),
+                       ("0.99", "p99_ms")):
+            v = st[key]
             if v is not None:
                 lines.append(
-                    f'{prefix}_decode_steps{{quantile="{q}"}} {v}')
+                    f'{prefix}_latency_ms{{phase="{phase}",'
+                    f'quantile="{q}"}} {v}')
         lines.append(
-            f'{prefix}_decode_steps_count {s["decode_steps"]["count"]}')
-        lines.append(f"# TYPE {prefix}_lane_occupancy gauge")
-        if s["lane_occupancy"]["mean"] is not None:
+            f'{prefix}_latency_ms_count{{phase="{phase}"}} '
+            f'{st["count"]}')
+        lines.append(
+            f'{prefix}_latency_ms_sum{{phase="{phase}"}} '
+            f'{st["sum_ms"]}')
+    occ = s["batch_occupancy"]
+    lines.append(f"# TYPE {prefix}_batch_occupancy gauge")
+    if occ["mean"] is not None:
+        lines.append(f"{prefix}_batch_occupancy {occ['mean']}")
+    lines.append(f"# TYPE {prefix}_decode_steps summary")
+    for q, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
+        v = s["decode_steps"][key]
+        if v is not None:
             lines.append(
-                f"{prefix}_lane_occupancy {s['lane_occupancy']['mean']}")
-        lines.append(f"# TYPE {prefix}_bucket_hits counter")
-        for bucket, hits in sorted(s["bucket_hits"].items()):
-            lines.append(
-                f'{prefix}_bucket_hits{{bucket="{bucket}"}} {hits}')
-        return "\n".join(lines) + "\n"
+                f'{prefix}_decode_steps{{quantile="{q}"}} {v}')
+    lines.append(
+        f'{prefix}_decode_steps_count {s["decode_steps"]["count"]}')
+    lines.append(f"# TYPE {prefix}_lane_occupancy gauge")
+    if s["lane_occupancy"]["mean"] is not None:
+        lines.append(
+            f"{prefix}_lane_occupancy {s['lane_occupancy']['mean']}")
+    lines.append(f"# TYPE {prefix}_bucket_hits counter")
+    for bucket, hits in sorted(s["bucket_hits"].items()):
+        lines.append(
+            f'{prefix}_bucket_hits{{bucket="{bucket}"}} {hits}')
+    return "\n".join(lines) + "\n"

@@ -83,7 +83,8 @@ class ServingPredictor:
                  gen_decode_chunk: Optional[int] = None,
                  gen_full_scan: Optional[bool] = None,
                  donate: Optional[bool] = None,
-                 recompile_warn: int = 64):
+                 recompile_warn: int = 64,
+                 aot_cache=None, model_hash: Optional[str] = None):
         import jax
         import jax.numpy as jnp
 
@@ -93,6 +94,23 @@ class ServingPredictor:
 
         self.graph = graph
         self.params = {k: jnp.asarray(v) for k, v in params.items()}
+        # model identity: the PTM1 digest for merged artifacts (passed by
+        # from_merged), else a structural fingerprint — keys the AOT
+        # warmup cache and names the version /healthz + rolling reload
+        # report
+        if model_hash is None:
+            from paddle_tpu.serving.aot_cache import model_fingerprint
+            model_hash = model_fingerprint(graph, self.params)
+        self.model_hash = str(model_hash)
+        self.model_version = self.model_hash[:12]
+        if isinstance(aot_cache, str):
+            from paddle_tpu.serving.aot_cache import AOTCache
+            aot_cache = AOTCache(aot_cache, self.model_hash)
+        self.aot_cache = aot_cache
+        # (name, bucket key) -> jax.stages.Compiled: the warmed menu as
+        # ready-to-call executables (populated only when a cache is
+        # configured; without one the plain jit path serves as before)
+        self._aot: Dict[Tuple[str, str], Any] = {}
         self.feeding = dict(feeding)
         self.names = list(self.feeding)
         self.batch_buckets = sorted(int(b) for b in batch_buckets)
@@ -205,15 +223,20 @@ class ServingPredictor:
                     **kwargs) -> "ServingPredictor":
         """Build from a ``--job=merge`` artifact (PTM1 file). ``feeding``
         still comes from the config — the merged payload carries graph +
-        params + output names, not input type declarations."""
-        from paddle_tpu.trainer.merge_model import load_merged
+        params + output names, not input type declarations. The PTM1
+        payload digest becomes the model hash (AOT-cache key + reported
+        version), unless the caller pins its own."""
+        from paddle_tpu.trainer.merge_model import load_merged, \
+            merged_digest
         graph, params, outputs = load_merged(path)
+        kwargs.setdefault("model_hash", merged_digest(path))
         return cls(graph, params, outputs, feeding, **kwargs)
 
     # ------------------------------------------------------------- warmup
     def warmup(self, log=None) -> int:
-        """Compile every bucket variant ahead of traffic; returns the
-        number of warmup executions. Hardens all recompile guards."""
+        """Compile (or deserialize from the AOT cache) every bucket
+        variant ahead of traffic; returns the number of warmup
+        executions. Hardens all recompile guards."""
         lengths = self.length_buckets or [None]
         t0 = time.perf_counter()
         runs = 0
@@ -222,10 +245,10 @@ class ServingPredictor:
                 rows = [tuple(_synth_sample(self.feeding[n], ln or 1)
                               for n in self.names)] * b
                 if self.network is not None:
-                    self.predict_rows(rows)
+                    self._warm_score(rows)
                     runs += 1
                 if self.engine is not None:
-                    self.generate_rows(rows)
+                    self._warm_generate(rows)
                     runs += 1
         if self.engine is not None:
             # the engine jits lazily per (beam, length, hooks) key; the
@@ -235,11 +258,66 @@ class ServingPredictor:
             g.harden()
         self.warmed = True
         if log:
-            log(f"serving warmup: {runs} bucket variants compiled in "
+            cache = ""
+            if self.aot_cache is not None:
+                s = self.aot_cache.stats
+                cache = (f"; aot_cache hits={s['hits']} "
+                         f"misses={s['misses'] + s['stale']} "
+                         f"quarantined={s['quarantined']}")
+            log(f"serving warmup: {runs} bucket variants ready in "
                 f"{time.perf_counter() - t0:.1f}s "
                 f"(batch={self.batch_buckets}, "
-                f"length={self.length_buckets})")
+                f"length={self.length_buckets}{cache})")
         return runs
+
+    def _aot_executable(self, name: str, sig: str, args, build):
+        """One warmed executable: deserialize from the cache when it has
+        a valid entry (verified by executing against the warmup
+        ``args``), else ``build()`` the live compile and persist it."""
+        comp = self.aot_cache.load(name, sig, verify_args=args)
+        if comp is not None:
+            return comp
+        comp = build()
+        comp(*args)  # first-call buffer touch, symmetric with the
+        # loaded path's verification run
+        self.aot_cache.save(name, sig, comp)
+        return comp
+
+    def _warm_score(self, rows):
+        if self.aot_cache is None:
+            self.predict_rows(rows)
+            return
+        feed = self._convert(rows)
+        key, _ = self._bucket_key(feed)
+        args = (self.params, feed)
+        self._aot[("infer", key)] = self._aot_executable(
+            "infer", key, args,
+            lambda: self._infer.lower(*args).compile())
+
+    def _warm_generate(self, rows):
+        if self.aot_cache is None:
+            self.generate_rows(rows)
+            return
+        feed = self._convert(rows)
+        key, _ = self._bucket_key(feed)
+        eargs = (self.params, feed)
+        enc = self._aot_executable(
+            "encode", key, eargs,
+            lambda: self._encode.lower(*eargs).compile())
+        self._aot[("encode", key)] = enc
+        outer = enc(self.params, feed)
+        static_feed = self.engine.static_feed_from_outer(outer)
+        K, L = self.gen_beam_size, self.gen_max_length
+        hooks = self.engine._resolve_hooks(None, None, None, None)
+        chunk = self.engine._resolve_chunk(L, self.gen_decode_chunk,
+                                           self.gen_full_scan)
+        gargs = (self.params, static_feed)
+        gsig = f"{key}_k{K}_l{L}" + ("" if chunk is None else f"_c{chunk}")
+        self._aot[("generate", key)] = self._aot_executable(
+            "generate", gsig, gargs,
+            lambda: self.engine._jit_for(
+                (K, L, chunk) + hooks, K, L, hooks,
+                chunk).lower(*gargs).compile())
 
     def check_guards(self):
         """Hot-path assertion: raises RecompileError on jit-cache growth
@@ -341,7 +419,12 @@ class ServingPredictor:
         feed = self._convert(rows, lane_valid)
         key, padded = self._bucket_key(feed)
         t1 = time.perf_counter()
-        out = self._infer(self.params, feed)
+        # warmed AOT executable when the cache populated one for this
+        # bucket; the plain jit path otherwise (and as the fall-through
+        # a hardened guard turns into a loud RecompileError)
+        comp = self._aot.get(("infer", key))
+        out = (comp if comp is not None else self._infer)(
+            self.params, feed)
         out = {n: np.asarray(v) for n, v in out.items()}  # host fetch
         t2 = time.perf_counter()
         if self.warmed:
@@ -395,7 +478,10 @@ class ServingPredictor:
         if self.engine is None:
             raise BadRequest("this model has no generation group")
         feed = self._convert(rows, lane_valid)
-        outer = self._encode(self.params, feed)
+        comp = (self._aot.get(("encode", self._bucket_key(feed)[0]))
+                if self._aot else None)
+        outer = (comp if comp is not None else self._encode)(
+            self.params, feed)
         if self.warmed:
             self.check_guards()
         return outer
@@ -413,12 +499,26 @@ class ServingPredictor:
         feed = self._convert(rows, lane_valid)
         key, padded = self._bucket_key(feed)
         t1 = time.perf_counter()
-        outer = self._encode(self.params, feed)
-        tokens, scores, lengths = self.engine.generate(
-            self.params, outer, beam_size=self.gen_beam_size,
-            max_length=self.gen_max_length,
-            decode_chunk=self.gen_decode_chunk,
-            full_scan=self.gen_full_scan)
+        enc = self._aot.get(("encode", key))
+        outer = (enc if enc is not None else self._encode)(
+            self.params, feed)
+        comp = self._aot.get(("generate", key))
+        if comp is not None:
+            # warmed AOT search executable: same program the engine
+            # would jit for the pinned (beam, length, chunk, hooks) key
+            static_feed = self.engine.static_feed_from_outer(outer)
+            tokens, scores, lengths, steps = comp(self.params,
+                                                  static_feed)
+            steps = int(steps)
+            gen_info = {"decode_steps": steps,
+                        "steps_saved": self.gen_max_length - steps}
+        else:
+            tokens, scores, lengths = self.engine.generate(
+                self.params, outer, beam_size=self.gen_beam_size,
+                max_length=self.gen_max_length,
+                decode_chunk=self.gen_decode_chunk,
+                full_scan=self.gen_full_scan)
+            gen_info = self.engine.last_info
         tokens, scores, lengths = (np.asarray(tokens), np.asarray(scores),
                                    np.asarray(lengths))
         t2 = time.perf_counter()
@@ -427,7 +527,6 @@ class ServingPredictor:
             # warmup (warmup() ran _ensure_engine_guard) — only the
             # cheap cache-size check belongs on the hot path
             self.check_guards()
-        gen_info = self.engine.last_info
         return (tokens, scores, lengths), {
             "bucket": key + f"_k{self.gen_beam_size}",
             "padded_rows": padded,

@@ -1,0 +1,955 @@
+"""Front-tier replica router: health-aware failover, circuit breakers,
+hedged score retries, respawn, and rolling hot-swap reload.
+
+One serving process owns one device; a fleet needs N replicas behind a
+tier that (a) never routes to a replica that cannot answer, (b) turns a
+replica dying mid-request into a retry the client never sees, and (c)
+can swap model versions without dropping a single queued request. This
+module is that tier, over two replica transports:
+
+- :class:`EngineTransport` — an in-process :class:`~paddle_tpu.serving.
+  batcher.ServingEngine` (the ``--job=serve --replicas N`` shape: N
+  engines, one process, each with its own predictor warmed from the
+  shared AOT cache).
+- :class:`HTTPTransport` — a separately-launched single-replica server
+  reached over HTTP (the multi-process / multi-host shape; pass a
+  ``proc`` handle and drain uses the real SIGTERM machinery).
+
+Dispatch policy (one request through :meth:`ReplicaRouter.dispatch`):
+
+- **pick** — least-inflight READY replica (round-robin tiebreak);
+  WARMING / DRAINING / EJECTED / DEAD replicas are never candidates, so
+  ``begin_drain()`` stops new traffic at the router, not at the
+  replica's refused-request surface.
+- **failover** — a *definite* replica failure (connection error,
+  worker-died 500, an injected ``route_dispatch`` drop) re-dispatches
+  the request to the next replica: serving is stateless, so re-running
+  is safe for both kinds. A replica's 429 shed is "busy, not broken":
+  the router tries the next replica without charging the breaker, and
+  only when EVERY ready replica sheds does the client see a 429 — with
+  ``retry_after_ms`` set to the FLEET-wide capacity estimate (the min
+  over replica drain hints: a request needs one free slot and queues
+  drain in parallel), not one replica's private EWMA.
+- **hedging** — idempotent ``score`` requests past ``hedge_ms`` with no
+  answer fire a capped second attempt at another replica; first answer
+  wins, the loser's compute is sunk (and still scored for breaker
+  accounting when it completes). NEVER for ``generate``: a speculative
+  duplicate of a long beam search is the one workload where hedging
+  costs more capacity than it saves.
+- **circuit breaker** — ``eject_after`` consecutive failures opens the
+  replica's breaker (EJECTED, no dispatch) for ``breaker_cooldown_ms``;
+  the health loop then HALF-OPENs it with a single probe — success
+  closes the breaker, failure re-opens it with doubled cooldown
+  (capped), so a flapping replica converges to rare probes instead of
+  eating live traffic.
+- **typed 4xx/504 pass through** — a BadRequest or DeadlineExceeded is
+  the CLIENT's outcome from a healthy replica; it is never failed over
+  (the retry would fail identically) and never charges the breaker.
+
+The health loop polls every replica's readiness (``/healthz`` payload /
+``ServingEngine.health()``) on ``health_poll_ms``; a replica whose
+worker died (liveness false) is DEAD and — when a ``spawn`` factory is
+configured — respawned in place (chaos site ``replica_spawn``). With the
+AOT warmup cache a respawned replica deserializes its whole bucket menu
+instead of re-tracing it, which is what makes kill-and-respawn under
+load a non-event (``bench.py --fleet``).
+
+Rolling reload (:meth:`ReplicaRouter.rolling_reload`) hot-swaps model
+versions replica by replica: mark DRAINING (router dispatch stops
+immediately), drain through the existing SIGTERM machinery (every queued
+request completes — zero drops by construction), swap in the new
+version's transport, wait READY, next. The fleet serves mixed versions
+mid-roll by design; ``/healthz`` reports each replica's
+``model_version``.
+
+Lock discipline (graftlint pass-3 scope): the router lock guards replica
+state bookkeeping ONLY — dispatch, transport calls, chaos hits, and
+metrics all happen outside it, so the router adds no lock-order edges
+over the engine/metrics graph.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from http.server import ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Tuple
+
+from paddle_tpu.serving.errors import (BadRequest, DeadlineExceeded,
+                                       Overloaded, ServingError,
+                                       ShuttingDown, Unavailable)
+from paddle_tpu.serving.metrics import RouterMetrics
+from paddle_tpu.serving.server import JSONHandler
+from paddle_tpu.testing import chaos as _chaos
+from paddle_tpu.utils.log import get_logger
+
+logger = get_logger("serving.router")
+
+# replica states; only READY receives dispatches
+WARMING, READY, DRAINING, EJECTED, HALF_OPEN, DEAD = (
+    "warming", "ready", "draining", "ejected", "half_open", "dead")
+
+
+class PendingCall:
+    """One in-flight attempt at one replica. ``outcome()`` classifies
+    the completed attempt:
+
+    - ``("ok", result)``      — answer for the client
+    - ``("client", error)``   — typed 400/429-wire/504 that belongs to
+      the CLIENT (never failed over, never charges the breaker)
+    - ``("busy", error)``     — the replica shed or is draining; the
+      request never ran — try another replica, no breaker charge
+    - ``("failed", exc)``     — definite replica failure (connection
+      reset, worker died); failover + breaker charge
+    """
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.result = None
+        self.error: Optional[ServingError] = None
+        self.transport_failure: Optional[BaseException] = None
+        self._req = None  # EngineTransport bridges the engine _Request
+        self.is_hedge = False  # launched as a hedge (win attribution)
+
+    def outcome(self) -> Tuple[str, object]:
+        if self._req is not None:
+            self.error, self.result = self._req.error, self._req.result
+        if self.transport_failure is not None:
+            return "failed", self.transport_failure
+        e = self.error
+        if e is None:
+            return "ok", self.result
+        if isinstance(e, (ShuttingDown, Overloaded)):
+            return "busy", e
+        if isinstance(e, (BadRequest, DeadlineExceeded)):
+            return "client", e
+        if e.status >= 500:
+            return "failed", e  # "serving worker died" and kin
+        return "client", e
+
+
+class EngineTransport:
+    """In-process replica: one started :class:`ServingEngine`."""
+
+    def __init__(self, engine):
+        self.engine = engine
+
+    def ready_hint(self) -> bool:
+        """Lock-free instantaneous readiness — consulted at pick time
+        so dispatch stops THE MOMENT ``begin_drain()`` fires (or the
+        worker dies), without waiting for the next health sweep. Plain
+        attribute reads: no lock, no lock-order edge."""
+        e = self.engine
+        return (e.fatal is None and not e.draining
+                and e.predictor.warmed)
+
+    def start_call(self, kind: str, sample, deadline_ms,
+                   gen_opts: Dict) -> PendingCall:
+        p = PendingCall()
+        try:
+            req = self.engine.submit(
+                sample, kind=kind, deadline_ms=deadline_ms,
+                beam_size=gen_opts.get("beam_size"),
+                max_length=gen_opts.get("max_length"))
+        except ServingError as e:
+            p.error = e
+            p.event.set()
+            return p
+        # share the engine request's completion event — zero polling
+        p.event = req.event
+        p._req = req
+        return p
+
+    def healthz(self) -> dict:
+        return self.engine.health()
+
+    def begin_drain(self):
+        self.engine.begin_drain()
+
+    def drain_wait(self, timeout: float = 60.0):
+        """Blocks until every queued + in-flight request of this replica
+        is answered (the zero-drop half of rolling reload)."""
+        self.engine.shutdown(drain=True, timeout=timeout)
+
+
+class HTTPTransport:
+    """A replica reached over HTTP — a separately-launched single-
+    replica server process. ``proc`` (a ``subprocess.Popen``) makes
+    drain use the real SIGTERM machinery; without it, drain is the
+    operator's job and ``begin_drain`` only logs. The wire layer is
+    :class:`ServingClient`'s (retries=0 — retry policy belongs to the
+    router's failover, not the transport)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 120.0,
+                 proc=None):
+        from paddle_tpu.serving.client import ServingClient
+        self.host, self.port = host, int(port)
+        self.timeout = timeout
+        self.proc = proc
+        self._client = ServingClient(host, port, timeout=timeout)
+
+    def start_call(self, kind: str, sample, deadline_ms,
+                   gen_opts: Dict) -> PendingCall:
+        p = PendingCall()
+        path = {"score": "/v1/score", "generate": "/v1/generate"}[kind]
+        body = {"sample": sample}
+        if deadline_ms is not None:
+            body["deadline_ms"] = deadline_ms
+        for k in ("beam_size", "max_length"):
+            if gen_opts.get(k) is not None:
+                body[k] = gen_opts[k]
+
+        def run():
+            try:
+                p.result = self._client._request_once("POST", path, body)
+            except ServingError as e:
+                p.error = e
+            except Exception as e:  # noqa: BLE001 — conn reset/refused
+                p.transport_failure = e
+            finally:
+                p.event.set()
+
+        threading.Thread(target=run, daemon=True,
+                         name="router-http-call").start()
+        return p
+
+    def healthz(self) -> dict:
+        # NOT _request_once: that raises on any >=400 status, but a 503
+        # healthz still carries the {live, ready, draining, ...} split
+        # the router routes on — the body must be read whatever the
+        # status
+        import http.client
+        import json
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=5.0)
+        try:
+            conn.request("GET", "/healthz")
+            resp = conn.getresponse()
+            data = json.loads(resp.read() or b"{}")
+            if not isinstance(data, dict) or "live" not in data:
+                raise ConnectionError(
+                    f"healthz from {self.host}:{self.port} is not a "
+                    f"health payload (HTTP {resp.status})")
+            return data
+        finally:
+            conn.close()
+
+    def begin_drain(self):
+        if self.proc is not None:
+            import signal
+            self.proc.send_signal(signal.SIGTERM)
+        else:
+            logger.warning(
+                "HTTPTransport %s:%d has no process handle; drain must "
+                "be driven out of band (SIGTERM the replica yourself)",
+                self.host, self.port)
+
+    def drain_wait(self, timeout: float = 60.0):
+        if self.proc is not None:
+            self.proc.wait(timeout=timeout)
+
+
+class Replica:
+    """Router-side state for one replica slot. The transport may be
+    swapped (respawn, rolling reload); the slot identity persists."""
+
+    def __init__(self, replica_id: str, transport):
+        self.id = str(replica_id)
+        self.transport = transport
+        self.state = WARMING
+        self.inflight = 0
+        self.consecutive_failures = 0
+        self.poll_failures = 0
+        self.breaker_until = 0.0  # monotonic deadline while EJECTED
+        self.breaker_cooldown_ms: Optional[float] = None  # doubles
+        self.last_health: dict = {}
+        self.last_spawn_ms: Optional[float] = None
+
+    def snapshot(self) -> dict:
+        return {"id": self.id, "state": self.state,
+                "inflight": self.inflight,
+                "consecutive_failures": self.consecutive_failures,
+                "model_version": self.last_health.get("model_version"),
+                "queue_depth": self.last_health.get("queue_depth"),
+                "backlog_ms": self.last_health.get("backlog_ms"),
+                "last_spawn_ms": self.last_spawn_ms}
+
+
+class ReplicaRouter:
+    """Owns admission for a fleet of replicas. See the module docstring
+    for the dispatch/breaker/hedge/reload policies."""
+
+    def __init__(self, transports, *,
+                 spawn: Optional[Callable[[str], object]] = None,
+                 health_poll_ms: float = 100.0,
+                 eject_after: int = 3,
+                 breaker_cooldown_ms: float = 1000.0,
+                 breaker_cooldown_max_ms: float = 30000.0,
+                 hedge_ms: Optional[float] = None,
+                 max_hedges: int = 1,
+                 wait_timeout: float = 120.0,
+                 metrics: Optional[RouterMetrics] = None):
+        self.replicas: List[Replica] = [
+            t if isinstance(t, Replica) else Replica(f"r{i}", t)
+            for i, t in enumerate(transports)]
+        if len({r.id for r in self.replicas}) != len(self.replicas):
+            raise ValueError("replica ids must be unique")
+        self.spawn = spawn
+        self.health_poll_ms = float(health_poll_ms)
+        self.eject_after = int(eject_after)
+        self.breaker_cooldown_ms = float(breaker_cooldown_ms)
+        self.breaker_cooldown_max_ms = float(breaker_cooldown_max_ms)
+        self.hedge_ms = hedge_ms if hedge_ms is None else float(hedge_ms)
+        self.max_hedges = int(max_hedges)
+        self.wait_timeout = float(wait_timeout)
+        self.metrics = metrics or RouterMetrics()
+        self._lock = threading.Lock()
+        self._rr = 0  # round-robin tiebreak counter
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._reloading = False
+
+    # ------------------------------------------------------------ control
+    def start(self, poll_now: bool = True) -> "ReplicaRouter":
+        if poll_now:
+            self.poll_once()
+        self._thread = threading.Thread(target=self._health_loop,
+                                        name="router-health", daemon=True)
+        self._thread.start()
+        return self
+
+    def shutdown(self, drain: bool = True, timeout: float = 60.0):
+        """Stop the health loop and drain every replica (zero queued
+        drops, same as single-replica SIGTERM)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        for rep in self.replicas:
+            with self._lock:
+                rep.state = DRAINING
+            try:
+                rep.transport.begin_drain()
+            except Exception as e:  # noqa: BLE001 — best-effort drain
+                logger.warning("drain of %s failed: %r", rep.id, e)
+        if drain:
+            for rep in self.replicas:
+                try:
+                    rep.transport.drain_wait(timeout=timeout)
+                except Exception as e:  # noqa: BLE001
+                    logger.warning("drain wait of %s failed: %r",
+                                   rep.id, e)
+
+    # ------------------------------------------------------------- health
+    def _health_loop(self):
+        while not self._stop.wait(self.health_poll_ms / 1e3):
+            try:
+                self.poll_once()
+            except Exception as e:  # noqa: BLE001 — the loop must live
+                logger.error("router health poll crashed: %r", e)
+
+    def poll_once(self):
+        """One health sweep: readiness transitions, breaker half-open
+        probes, dead-replica respawn. Also callable inline (tests, and
+        ``start(poll_now=True)`` so the first dispatch has states)."""
+        now = time.monotonic()
+        with self._lock:
+            snapshot = list(self.replicas)
+        for rep in snapshot:
+            if rep.state == DEAD:
+                self._maybe_respawn(rep)
+                continue
+            if rep.state == EJECTED:
+                if now < rep.breaker_until:
+                    continue
+                with self._lock:
+                    rep.state = HALF_OPEN
+                logger.info("router: %s breaker half-open, probing",
+                            rep.id)
+            try:
+                h = rep.transport.healthz()
+            except Exception as e:  # noqa: BLE001 — any probe failure
+                self._poll_failed(rep, e)
+                continue
+            self._apply_health(rep, h)
+
+    def _poll_failed(self, rep: Replica, exc: BaseException):
+        with self._lock:
+            rep.poll_failures += 1
+            half_open = rep.state == HALF_OPEN
+            should_eject = (rep.poll_failures >= self.eject_after
+                            and rep.state in (READY, WARMING, DRAINING))
+        if half_open:
+            self._reopen_breaker(rep)
+        elif should_eject:
+            logger.warning("router: ejecting %s after %d failed health "
+                           "probes (%r)", rep.id, rep.poll_failures, exc)
+            self._eject(rep)
+
+    def _apply_health(self, rep: Replica, h: dict):
+        with self._lock:
+            rep.poll_failures = 0
+            rep.last_health = dict(h)
+            if not h.get("live", True):
+                if rep.state != DEAD:
+                    logger.warning(
+                        "router: replica %s is dead (worker fatal: %s)",
+                        rep.id, h.get("fatal"))
+                    rep.state = DEAD
+                    dead = True
+                else:
+                    dead = False
+            elif h.get("draining"):
+                rep.state = DRAINING
+                dead = False
+            elif not h.get("ready", False):
+                if rep.state != HALF_OPEN:
+                    rep.state = WARMING
+                dead = False
+            else:
+                closed = rep.state in (HALF_OPEN, EJECTED)
+                rep.state = READY
+                rep.consecutive_failures = 0
+                if closed:
+                    rep.breaker_cooldown_ms = None
+                    logger.info("router: %s breaker closed (probe ok)",
+                                rep.id)
+                dead = False
+        if dead:
+            self.metrics.inc("replica_deaths_total")
+            self._maybe_respawn(rep)
+
+    def _maybe_respawn(self, rep: Replica):
+        """Replace a dead replica's transport via the spawn factory.
+        Synchronous on the health thread: the fleet serves on the other
+        replicas while the new one warms (ms with the AOT cache)."""
+        if self.spawn is None:
+            return
+        try:
+            if _chaos._ACTIVE is not None:
+                _chaos._ACTIVE.hit("replica_spawn", replica=rep.id)
+            t0 = time.perf_counter()
+            new = self.spawn(rep.id)
+            spawn_ms = 1e3 * (time.perf_counter() - t0)
+        except Exception as e:  # noqa: BLE001 — retry next sweep
+            logger.warning("router: respawn of %s failed (%r); will "
+                           "retry", rep.id, e)
+            return
+        with self._lock:
+            rep.transport = new
+            rep.state = WARMING
+            rep.consecutive_failures = 0
+            rep.poll_failures = 0
+            rep.breaker_cooldown_ms = None
+            rep.last_spawn_ms = spawn_ms
+        self.metrics.inc("respawns_total")
+        logger.info("router: respawned %s in %.1f ms", rep.id, spawn_ms)
+        try:
+            self._apply_health(rep, rep.transport.healthz())
+        except Exception:  # noqa: BLE001 — next sweep will see it
+            pass
+
+    # ------------------------------------------------------------ breaker
+    def _eject(self, rep: Replica):
+        with self._lock:
+            cooldown = rep.breaker_cooldown_ms or self.breaker_cooldown_ms
+            rep.breaker_cooldown_ms = min(2 * cooldown,
+                                          self.breaker_cooldown_max_ms)
+            rep.state = EJECTED
+            rep.breaker_until = time.monotonic() + cooldown / 1e3
+        self.metrics.inc("ejections_total")
+        self.metrics.inc("breaker_open_total")
+
+    def _reopen_breaker(self, rep: Replica):
+        logger.warning("router: %s failed its half-open probe; breaker "
+                       "re-opened", rep.id)
+        self._eject(rep)
+
+    def _record_failure(self, rep: Replica, exc: BaseException):
+        with self._lock:
+            rep.consecutive_failures += 1
+            eject = (rep.consecutive_failures >= self.eject_after
+                     and rep.state == READY)
+        logger.warning("router: dispatch to %s failed (%r)", rep.id, exc)
+        if eject:
+            logger.warning("router: ejecting %s after %d consecutive "
+                           "dispatch failures", rep.id,
+                           rep.consecutive_failures)
+            self._eject(rep)
+
+    def _record_success(self, rep: Replica):
+        with self._lock:
+            rep.consecutive_failures = 0
+
+    # ----------------------------------------------------------- dispatch
+    def _pick(self, exclude) -> Optional[Replica]:
+        with self._lock:
+            # state is the health loop's view; ready_hint (where the
+            # transport offers one — in-process engines) is the LIVE
+            # view, so a begin_drain or worker death stops dispatch
+            # immediately, not at the next poll
+            ready = [r for r in self.replicas
+                     if r.state == READY and r.id not in exclude
+                     and getattr(r.transport, "ready_hint",
+                                 lambda: True)()]
+            if not ready:
+                return None
+            self._rr += 1
+            rr = self._rr
+            n = len(self.replicas)
+            rep = min(ready, key=lambda r: (
+                r.inflight, (self.replicas.index(r) + rr) % n))
+            rep.inflight += 1
+            return rep
+
+    def _end_inflight(self, rep: Replica):
+        with self._lock:
+            rep.inflight = max(0, rep.inflight - 1)
+
+    def _abandon(self, rep: Replica, pend: PendingCall):
+        """A hedge lost the race: its compute is sunk, but its outcome
+        still matters to the breaker, so reap it off-thread."""
+
+        def run():
+            pend.event.wait(self.wait_timeout)
+            self._end_inflight(rep)
+            kind, payload = pend.outcome()
+            if kind == "failed":
+                self._record_failure(rep, payload)
+            elif kind == "ok":
+                self._record_success(rep)
+
+        threading.Thread(target=run, daemon=True,
+                         name="router-abandoned-hedge").start()
+
+    def fleet_retry_after_ms(self, hints=()) -> float:
+        """Earliest-capacity estimate across the fleet: the MIN over
+        per-replica drain hints — a request needs ONE free slot and
+        replica queues drain in parallel, so the fleet frees up as fast
+        as its least-loaded member, not as slow as its average."""
+        # None-checks, not truthiness: 0.0 is a legitimate hint (an
+        # idle replica IS the fleet's earliest capacity)
+        vals = [float(h) for h in hints if h is not None]
+        with self._lock:
+            for r in self.replicas:
+                if r.state in (READY, DRAINING, WARMING):
+                    b = r.last_health.get("backlog_ms")
+                    if b is not None:
+                        vals.append(float(b))
+        return min(vals) if vals else 50.0
+
+    def dispatch(self, sample, *, kind: str = "score",
+                 deadline_ms: Optional[float] = None,
+                 beam_size=None, max_length=None) -> Tuple[dict, dict]:
+        """Route one request; returns ``(result, provenance)`` or raises
+        the typed error the client should see. ``provenance`` =
+        ``{"replica", "failovers", "hedges"}`` (the HTTP frontend
+        surfaces it as ``X-Replica-Id`` / ``X-Failovers`` /
+        ``X-Hedged``)."""
+        if kind not in ("score", "generate"):
+            raise BadRequest(f"unknown request kind {kind!r}")
+        gen_opts = {"beam_size": beam_size, "max_length": max_length}
+        t0 = time.perf_counter()
+        tried: set = set()
+        busy: List[ServingError] = []
+        prov = {"replica": None, "failovers": 0, "hedges": 0}
+        live: List[Tuple[Replica, PendingCall]] = []
+        self.metrics.inc("dispatches_total")
+
+        def launch(as_hedge: bool = False) -> str:
+            """Start one attempt. Returns "live" (attempt in flight),
+            "consumed" (a replica was tried but the dispatch itself
+            failed — recorded as a failover, NOT as a fired hedge), or
+            "none" (no untried ready replica)."""
+            rep = self._pick(tried)
+            if rep is None:
+                return "none"
+            tried.add(rep.id)
+            try:
+                if _chaos._ACTIVE is not None:
+                    # seeded fault site: a "drop" here is a dispatch
+                    # that never reached the replica — the failover
+                    # path, deterministic from the plan seed
+                    _chaos._ACTIVE.hit("route_dispatch",
+                                       replica=rep.id, kind=kind)
+                pend = rep.transport.start_call(kind, sample,
+                                                deadline_ms, gen_opts)
+            except Exception as e:  # noqa: BLE001 — incl. ChaosDropped
+                self._end_inflight(rep)
+                self._record_failure(rep, e)
+                prov["failovers"] += 1
+                self.metrics.inc("failovers_total")
+                return "consumed"
+            pend.is_hedge = as_hedge
+            if as_hedge:
+                prov["hedges"] += 1
+                self.metrics.inc("hedges_total")
+            live.append((rep, pend))
+            return "live"
+
+        launch()
+        hedge_at = (t0 + self.hedge_ms / 1e3
+                    if (kind == "score" and self.hedge_ms is not None)
+                    else None)
+        hedges = 0
+        while True:
+            now = time.perf_counter()
+            if now - t0 > self.wait_timeout:
+                for rep, pend in live:
+                    self._abandon(rep, pend)
+                raise DeadlineExceeded(
+                    f"router got no replica answer within "
+                    f"{self.wait_timeout}s")
+            progressed = False
+            for rep, pend in list(live):
+                if not pend.event.is_set():
+                    continue
+                progressed = True
+                live.remove((rep, pend))
+                self._end_inflight(rep)
+                okind, payload = pend.outcome()
+                if okind == "ok":
+                    self._record_success(rep)
+                    prov["replica"] = rep.id
+                    if pend.is_hedge:
+                        # only a HEDGE beating its primary is a win; a
+                        # primary outrunning its hedge is not
+                        self.metrics.inc("hedge_wins_total")
+                    for orep, opend in live:
+                        self._abandon(orep, opend)
+                    self.metrics.observe_dispatch(
+                        rep.id, 1e3 * (time.perf_counter() - t0))
+                    return payload, prov
+                if okind == "client":
+                    # a typed 400/504 from a healthy replica IS the
+                    # answer; failing over would fail identically
+                    self._record_success(rep)
+                    prov["replica"] = rep.id
+                    for orep, opend in live:
+                        self._abandon(orep, opend)
+                    payload.provenance = prov
+                    raise payload
+                if okind == "busy":
+                    busy.append(payload)
+                    launch()
+                    continue
+                # definite failure -> failover
+                self._record_failure(rep, payload)
+                prov["failovers"] += 1
+                self.metrics.inc("failovers_total")
+                launch()
+            if not live:
+                if launch() != "none":
+                    continue
+                self.metrics.inc("shed_total")
+                retry = self.fleet_retry_after_ms(
+                    [getattr(e, "retry_after_ms", None) for e in busy])
+                err: ServingError
+                if busy:
+                    err = Overloaded(
+                        "every ready replica is shedding load "
+                        f"({len(busy)} tried); fleet at capacity",
+                        retry_after_ms=retry)
+                else:
+                    err = Unavailable(
+                        "no ready replica to dispatch to",
+                        retry_after_ms=retry)
+                err.provenance = prov
+                raise err
+            if (hedge_at is not None and now >= hedge_at
+                    and hedges < self.max_hedges):
+                st = launch(as_hedge=True)
+                if st == "live":
+                    hedges += 1
+                    hedge_at = now + self.hedge_ms / 1e3
+                    if hedges >= self.max_hedges:
+                        hedge_at = None
+                elif st == "none":
+                    hedge_at = None  # nobody to hedge at; stop trying
+                # "consumed": the attempt burned as a failover before
+                # any hedge fired — the hedge budget is NOT spent; the
+                # next loop iteration may try another replica
+                continue
+            # wait on the oldest pending attempt's event: up to the
+            # hedge deadline when one is armed, a short poll while
+            # several attempts race, else the full remaining budget —
+            # the common single-attempt case must not spin at 200 Hz
+            if hedge_at is not None:
+                timeout = max(0.001, hedge_at - now)
+            elif len(live) > 1:
+                timeout = 0.005
+            else:
+                timeout = max(0.001, self.wait_timeout - (now - t0))
+            live[0][1].event.wait(timeout)
+
+    # ------------------------------------------------------------- reload
+    def rolling_reload(self, build: Callable[[str], object],
+                       wait_ready_s: float = 300.0) -> List[str]:
+        """Hot-swap the model one replica at a time, zero queued drops:
+        DRAINING (dispatch stops now) -> drain via the SIGTERM machinery
+        (queued + in-flight requests all complete) -> swap in
+        ``build(replica_id)`` (a started transport for the new version;
+        ms-fast when its predictor warms from the AOT cache) -> wait
+        READY -> next replica. Returns the per-replica model versions
+        after the roll. Raises if a swapped replica never turns ready —
+        earlier replicas stay swapped (mixed-version fleet; roll back by
+        reloading again with the old artifact)."""
+        with self._lock:
+            if self._reloading:
+                raise RuntimeError("a rolling reload is already running")
+            self._reloading = True
+        try:
+            versions = []
+            for rep in list(self.replicas):
+                with self._lock:
+                    rep.state = DRAINING
+                logger.info("rolling reload: draining %s", rep.id)
+                rep.transport.begin_drain()
+                rep.transport.drain_wait()
+                new = build(rep.id)
+                with self._lock:
+                    rep.transport = new
+                    rep.state = WARMING
+                    rep.consecutive_failures = 0
+                    rep.poll_failures = 0
+                    rep.breaker_cooldown_ms = None
+                self.metrics.inc("reloads_total")
+                deadline = time.monotonic() + wait_ready_s
+                while True:
+                    try:
+                        h = rep.transport.healthz()
+                        self._apply_health(rep, h)
+                        if rep.state == READY:
+                            versions.append(h.get("model_version"))
+                            break
+                    except Exception:  # noqa: BLE001 — keep waiting
+                        pass
+                    if time.monotonic() > deadline:
+                        raise RuntimeError(
+                            f"rolling reload: replica {rep.id} did not "
+                            f"turn ready within {wait_ready_s}s; roll "
+                            "halted (earlier replicas are on the new "
+                            "version)")
+                    time.sleep(0.01)
+                logger.info("rolling reload: %s ready on version %s",
+                            rep.id, versions[-1])
+            return versions
+        finally:
+            with self._lock:
+                self._reloading = False
+
+    # ------------------------------------------------------------- health
+    def fleet_health(self) -> dict:
+        with self._lock:
+            reps = [r.snapshot() for r in self.replicas]
+        ready = sum(1 for r in reps if r["state"] == READY)
+        return {
+            "status": "ok" if ready else "unavailable",
+            "ready": ready > 0,
+            "live": True,
+            "ready_replicas": ready,
+            "replicas": reps,
+            "reloading": self._reloading,
+        }
+
+
+# ------------------------------------------------------------- HTTP tier
+
+class RouterHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, addr, router: ReplicaRouter, reload_builder=None):
+        super().__init__(addr, _RouterHandler)
+        self.router = router
+        self.reload_builder = reload_builder
+
+
+class _RouterHandler(JSONHandler):
+    """The router's HTTP frontend: same endpoint contract as the single-
+    replica server (a client cannot tell them apart), plus routing
+    provenance headers (``X-Replica-Id``, ``X-Failovers``, ``X-Hedged``)
+    and the fleet admin surface (``POST /admin/reload``)."""
+
+    # -------------------------------------------------------------- GET
+    def do_GET(self):
+        router: ReplicaRouter = self.server.router
+        path = self.path.split("?", 1)[0]
+        if path == "/healthz":
+            h = router.fleet_health()
+            self._send(200 if h["ready"] else 503, h)
+        elif path == "/livez":
+            self._send(200, {"status": "ok", "live": True})
+        elif path == "/metrics":
+            if "format=json" in self.path:
+                snap = router.metrics.snapshot()
+                snap["fleet"] = router.fleet_health()
+                self._send(200, snap)
+            else:
+                self._send(200, router.metrics.to_prometheus().encode(),
+                           content_type="text/plain; version=0.0.4")
+        else:
+            self._send(404, {"error": {"code": "not_found",
+                                       "message": self.path}})
+
+    # ------------------------------------------------------------- POST
+    def do_POST(self):
+        router: ReplicaRouter = self.server.router
+        path = self.path.split("?", 1)[0]
+        if path == "/admin/reload":
+            self._admin_reload()
+            return
+        kind = {"/v1/score": "score", "/v1/generate": "generate"}.get(path)
+        if kind is None:
+            self._send(404, {"error": {"code": "not_found",
+                                       "message": self.path}})
+            return
+        prov: Dict = {}
+        try:
+            body = self._body()
+            deadline_ms = body.get("deadline_ms")
+            gen = ({"beam_size": body.get("beam_size"),
+                    "max_length": body.get("max_length")}
+                   if kind == "generate" else {})
+            if "rows" in body:
+                self._rows(router, kind, body, deadline_ms, gen)
+                return
+            if "sample" not in body:
+                raise BadRequest("need \"sample\" (one request) or "
+                                 "\"rows\" (a list)")
+            result, prov = router.dispatch(
+                body["sample"], kind=kind, deadline_ms=deadline_ms, **gen)
+            self._send(200, result, headers=self._prov_headers(prov))
+        except ServingError as e:
+            prov = getattr(e, "provenance", prov)
+            self._send_error(e, headers=self._prov_headers(prov))
+        except Exception as e:  # noqa: BLE001 — the only 500 source
+            logger.error("unhandled router error: %r", e)
+            self._send_error(ServingError(repr(e)))
+
+    @staticmethod
+    def _prov_headers(prov: Dict) -> Dict:
+        if not prov:
+            return {}
+        return {"X-Replica-Id": prov.get("replica"),
+                "X-Failovers": prov.get("failovers"),
+                "X-Hedged": prov.get("hedges")}
+
+    def _rows(self, router, kind, body, deadline_ms, gen):
+        if not isinstance(body["rows"], list) or not body["rows"]:
+            raise BadRequest("\"rows\" must be a non-empty list")
+        # rows dispatch CONCURRENTLY: the replicas' batchers coalesce
+        # same-kind rows landing together, so a rows call keeps the
+        # batching win it has on the single-replica server (sequential
+        # dispatch would serialize one device launch per row)
+        rows = body["rows"]
+        results = [None] * len(rows)
+        any_err = [False]
+
+        def one(i, row):
+            try:
+                result, prov = router.dispatch(
+                    row, kind=kind, deadline_ms=deadline_ms, **gen)
+                result = dict(result)
+                result["replica"] = prov.get("replica")
+                results[i] = result
+            except ServingError as e:
+                results[i] = e.to_wire()
+                any_err[0] = True
+
+        workers = [threading.Thread(target=one, args=(i, row),
+                                    daemon=True)
+                   for i, row in enumerate(rows)]
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join(120.0)
+        for i, r in enumerate(results):
+            if r is None:  # a worker thread hung past the join bound
+                results[i] = DeadlineExceeded(
+                    "no answer within the server wait bound").to_wire()
+                any_err[0] = True
+        self._send(200 if not any_err[0] else 207, {"results": results})
+
+    def _admin_reload(self):
+        """Rolling hot-swap to a new merged model: ``{"model_path":
+        "/path/new.ptmodel"}``. Synchronous — the response carries the
+        per-replica versions after the roll (long request by design; the
+        fleet keeps serving throughout)."""
+        builder = self.server.reload_builder
+        try:
+            if builder is None:
+                raise BadRequest(
+                    "this router was started without a reload builder "
+                    "(--job=serve --replicas N wires one); rolling "
+                    "reload over HTTP is unavailable")
+            body = self._body()
+            path = body.get("model_path")
+            if not path:
+                raise BadRequest("need \"model_path\" (a merged PTM1 "
+                                 "artifact)")
+            versions = self.server.router.rolling_reload(
+                lambda rid: builder(path, rid))
+            self._send(200, {"status": "ok", "versions": versions})
+        except ServingError as e:
+            self._send_error(e)
+        except Exception as e:  # noqa: BLE001
+            logger.error("rolling reload failed: %r", e)
+            self._send(500, {"error": {"code": "reload_failed",
+                                       "message": repr(e)}})
+
+
+def make_router_server(router: ReplicaRouter, host: str = "127.0.0.1",
+                       port: int = 0, reload_builder=None):
+    """Bind the router frontend (port=0 = ephemeral, for tests); the
+    bound port is ``server.server_address[1]``."""
+    return RouterHTTPServer((host, port), router,
+                            reload_builder=reload_builder)
+
+
+def install_router_signal_handlers(router: ReplicaRouter,
+                                   server=None):
+    """SIGTERM/SIGINT -> drain EVERY replica (zero queued drops), then
+    stop the router listener. Returns the previous handlers (tests and
+    embedders restore them) — the fleet twin of ``server.py:
+    install_signal_handlers``."""
+    import signal
+
+    def _drain(signum, frame):
+        logger.info("signal %d: draining the fleet", signum)
+
+        def _finish():
+            router.shutdown(drain=True)
+            if server is not None:
+                server.shutdown()
+
+        threading.Thread(target=_finish, daemon=True,
+                         name="router-drain").start()
+
+    prev = {}
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        prev[sig] = signal.signal(sig, _drain)
+    return prev
+
+
+def serve_router_forever(router: ReplicaRouter, host: str = "127.0.0.1",
+                         port: int = 8000, reload_builder=None,
+                         ready_line: bool = True):
+    """CLI entry for ``--job=serve --replicas N``: start the health
+    loop, bind, install SIGTERM handlers that drain EVERY replica (zero
+    queued drops), serve until drained."""
+    router.start()
+    server = make_router_server(router, host, port,
+                                reload_builder=reload_builder)
+    install_router_signal_handlers(router, server)
+    if ready_line:
+        h = router.fleet_health()
+        print(f"router serving on http://{host}:"
+              f"{server.server_address[1]} "
+              f"({h['ready_replicas']}/{len(router.replicas)} replicas "
+              "ready)", flush=True)
+    try:
+        server.serve_forever(poll_interval=0.2)
+    finally:
+        server.server_close()
+        router.shutdown(drain=True)
+    return 0

@@ -9,8 +9,15 @@ Endpoints:
 - ``POST /v1/generate``  — ``{"sample": [...], "beam_size": K,
   "max_length": L}`` (beam/max_length must match the warmed pair).
   Answer: ``{"sequences": [{"tokens": [...], "score": s}, ...]}``.
-- ``GET /healthz``       — liveness + readiness: warmup state, queue
-  depth, drain state, worker fatal error if any.
+- ``GET /healthz``       — READINESS (200 only when dispatchable:
+  warmed, not draining, worker alive; the replica router and k8s-style
+  readiness probes poll this). Body carries the full
+  ``ServingEngine.health()`` split: live/ready/warming/draining, queue
+  depth, backlog estimate, model version, AOT-cache stats.
+- ``GET /livez``         — LIVENESS (200 while the worker has not died
+  to a bug). A draining or warming replica is live-but-not-ready —
+  restart-worthy and routable are different questions, split so a
+  scheduler never kills a replica mid-drain.
 - ``GET /metrics``       — Prometheus text
   (``serving/metrics.py:to_prometheus``); ``/metrics?format=json`` for
   the structured snapshot.
@@ -47,7 +54,11 @@ class ServingHTTPServer(ThreadingHTTPServer):
         self.engine = engine
 
 
-class _Handler(BaseHTTPRequestHandler):
+class JSONHandler(BaseHTTPRequestHandler):
+    """Shared JSON request/response plumbing for the serving HTTP planes
+    (this single-replica frontend and the replica router's,
+    ``serving/router.py``)."""
+
     protocol_version = "HTTP/1.1"
 
     # ------------------------------------------------------------ plumbing
@@ -56,7 +67,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _send(self, status: int, body: dict,
               content_type: str = "application/json",
-              retry_after_ms: Optional[float] = None):
+              retry_after_ms: Optional[float] = None,
+              headers: Optional[dict] = None):
         data = (body if isinstance(body, bytes)
                 else json.dumps(body).encode())
         self.send_response(status)
@@ -67,11 +79,16 @@ class _Handler(BaseHTTPRequestHandler):
             # JSON body's retry_after_ms
             self.send_header("Retry-After",
                              str(max(1, round(retry_after_ms / 1e3))))
+        for k, v in (headers or {}).items():
+            if v is not None:
+                self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(data)
 
-    def _send_error(self, e: ServingError):
-        self._send(e.status, e.to_wire(), retry_after_ms=e.retry_after_ms)
+    def _send_error(self, e: ServingError,
+                    headers: Optional[dict] = None):
+        self._send(e.status, e.to_wire(), retry_after_ms=e.retry_after_ms,
+                   headers=headers)
 
     def _body(self) -> dict:
         n = int(self.headers.get("Content-Length") or 0)
@@ -84,21 +101,26 @@ class _Handler(BaseHTTPRequestHandler):
             raise BadRequest("request body must be a JSON object")
         return body
 
+
+class _Handler(JSONHandler):
+
     # ------------------------------------------------------------ GET
     def do_GET(self):
         engine = self.server.engine
         path = self.path.split("?", 1)[0]
         if path == "/healthz":
-            ok = (engine.predictor.warmed and engine.fatal is None
-                  and not engine.draining)
-            self._send(200 if ok else 503, {
-                "status": "ok" if ok else (
-                    "draining" if engine.draining else "unhealthy"),
-                "warmed": engine.predictor.warmed,
-                "draining": engine.draining,
-                "queue_depth": engine.queue_len(),
-                "fatal": repr(engine.fatal) if engine.fatal else None,
-            })
+            # READINESS: route traffic here? 503 on warming/draining/
+            # dead so a poller (the replica router, a k8s readiness
+            # probe) stops dispatching the moment begin_drain() fires —
+            # the full split lives in the body (ServingEngine.health)
+            h = engine.health()
+            self._send(200 if h["ready"] else 503, h)
+        elif path == "/livez":
+            # LIVENESS: keep the process? A draining or warming replica
+            # is LIVE (killing it mid-drain drops queued requests);
+            # only a dead worker (engine.fatal) warrants a restart
+            h = engine.health()
+            self._send(200 if h["live"] else 503, h)
         elif path == "/metrics":
             if "format=json" in self.path:
                 self._send(200, engine.metrics.snapshot())

@@ -43,7 +43,17 @@ Sites wired in this codebase:
 ``checkpoint``  a checkpoint generation just became durable (info
                 carries ``path``); ``corrupt`` faults mutate it
 ``store_save``  the master is about to persist its task-queue snapshot
-``serve_batch`` the serving worker picked up a batch
+``serve_batch`` the serving worker picked up a batch (a ``kill`` with
+                ``mode: "raise"`` here is the replica-death fault: the
+                worker dies, in-flight requests are answered 500, and
+                the replica router fails them over / respawns)
+``route_dispatch`` the replica router is about to hand one request to a
+                replica (info: ``replica``, ``kind``); a ``drop`` is a
+                dispatch that never reached the replica — the failover
+                path, deterministic from the plan seed
+``replica_spawn`` the router is about to respawn a dead replica (info:
+                ``replica``); ``drop`` fails the spawn attempt (retried
+                next health sweep), ``delay`` models a slow cold start
 ==============  ========================================================
 
 Fault types: ``kill`` (``mode`` ``"exit"`` = ``os._exit(exit_code)``,

@@ -177,6 +177,27 @@ def parse_args(argv=None):
                         "--decode_chunk boundary, so one slow request "
                         "no longer convoys its batch and deadlines are "
                         "enforced mid-decode")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="--job=serve: run N replica engines behind the "
+                        "health-aware router (serving/router.py): "
+                        "failover on replica death, circuit breakers, "
+                        "auto-respawn, rolling reload via POST "
+                        "/admin/reload. Each replica warms from the "
+                        "shared --aot_cache_dir, so replicas 2..N (and "
+                        "every respawn) cold-start in milliseconds")
+    p.add_argument("--aot_cache_dir", default=None,
+                   help="--job=serve: persist the warmed bucket menu as "
+                        "serialized compiled executables keyed by model "
+                        "hash x bucket x jax/XLA version "
+                        "(serving/aot_cache.py); a respawned replica "
+                        "deserializes the menu instead of re-tracing "
+                        "it. Misses/stale/corrupt entries fall back to "
+                        "the live trace")
+    p.add_argument("--hedge_ms", type=float, default=0,
+                   help="--job=serve with --replicas>1: fire a capped "
+                        "second attempt for an unanswered idempotent "
+                        "score request after this many ms (never for "
+                        "generate); 0 = hedging off")
     return p.parse_args(argv)
 
 
@@ -584,13 +605,13 @@ def _ensure_generation_params(graph, params):
             "--init_model_path for real generation", missing)
 
 
-def build_serving_engine(ns, args):
-    """--job=serve wiring, separated so tests (and embedders) can build
-    the engine without entering serve_forever. Parameter source order
-    mirrors --job=test: --init_model_path (checkpoint file, merged
-    .ptmodel, or a reference model dir), else the newest checkpoint in
-    --save_dir; the config supplies graph + feeding + outputs."""
-    from paddle_tpu.serving import ServingEngine, ServingPredictor
+def _serving_plan(ns, args):
+    """The shared --job=serve wiring: (graph, params, output names,
+    feeding, predictor kwargs, engine kwargs) — everything a replica
+    engine is built from. Parameter source order mirrors --job=test:
+    --init_model_path (checkpoint file, merged .ptmodel, or a reference
+    model dir), else the newest checkpoint in --save_dir; the config
+    supplies graph + feeding + outputs."""
     trainer = _build_trainer(ns, args)
     if not args.init_model_path and args.save_dir:
         from paddle_tpu.dist.checkpoint import Checkpointer
@@ -616,24 +637,74 @@ def build_serving_engine(ns, args):
     decode_chunk = getattr(args, "decode_chunk", None)
     params = dict(trainer._flat_params_view())
     _ensure_generation_params(trainer.topology.graph, params)
-    predictor = ServingPredictor(
-        trainer.topology.graph, params, names,
-        feeding, batch_buckets=batch_buckets,
-        length_buckets=length_buckets,
+    pred_kwargs = dict(
+        batch_buckets=batch_buckets, length_buckets=length_buckets,
         gen_decode_chunk=decode_chunk,
         gen_full_scan=(None if decode_chunk is None
-                       else decode_chunk <= 0))
-    return ServingEngine(
-        predictor, max_batch=max_batch,
+                       else decode_chunk <= 0),
+        aot_cache=getattr(args, "aot_cache_dir", None))
+    eng_kwargs = dict(
+        max_batch=max_batch,
         batch_timeout_ms=args.batch_timeout_ms,
         queue_depth=args.queue_depth,
         shed_watermark=args.shed_watermark or None,
         default_deadline_ms=args.serving_deadline_ms or None,
         continuous_batching=getattr(args, "serving_continuous_batching",
                                     False))
+    return trainer.topology.graph, params, names, feeding, \
+        pred_kwargs, eng_kwargs
+
+
+def build_serving_engine(ns, args):
+    """One replica engine from the serving plan (tests and embedders
+    build the engine without entering serve_forever)."""
+    from paddle_tpu.serving import ServingEngine, ServingPredictor
+    graph, params, names, feeding, pk, ek = _serving_plan(ns, args)
+    return ServingEngine(
+        ServingPredictor(graph, params, names, feeding, **pk), **ek)
+
+
+def build_serving_fleet(ns, args):
+    """--replicas N: N replica engines (each its own predictor, all
+    warming from the shared --aot_cache_dir — replica 0 traces live and
+    populates the cache, replicas 1..N-1 and every respawn deserialize
+    it) behind the health-aware router. Returns ``(router,
+    reload_builder)`` — the builder backs ``POST /admin/reload``
+    (rolling hot-swap to a new merged artifact)."""
+    from paddle_tpu.serving import (EngineTransport, ReplicaRouter,
+                                    ServingEngine, ServingPredictor)
+    graph, params, names, feeding, pk, ek = _serving_plan(ns, args)
+
+    def make_engine(from_model_path=None):
+        if from_model_path is not None:
+            pred = ServingPredictor.from_merged(
+                from_model_path, feeding, **pk)
+        else:
+            pred = ServingPredictor(graph, params, names, feeding, **pk)
+        return ServingEngine(pred, **ek).start(warmup=True)
+
+    transports = [EngineTransport(make_engine())
+                  for _ in range(max(1, args.replicas))]
+    # the respawn factory rebuilds a replica after worker death; the
+    # reload builder swaps in a NEW artifact (both warm from the cache)
+    router = ReplicaRouter(
+        transports,
+        spawn=lambda rid: EngineTransport(make_engine()),
+        hedge_ms=(args.hedge_ms or None))
+
+    def reload_builder(model_path, rid):
+        return EngineTransport(make_engine(from_model_path=model_path))
+
+    return router, reload_builder
 
 
 def cmd_serve(ns, args):
+    if getattr(args, "replicas", 1) > 1:
+        from paddle_tpu.serving import serve_router_forever
+        router, reload_builder = build_serving_fleet(ns, args)
+        return serve_router_forever(router, host=args.host,
+                                    port=args.port,
+                                    reload_builder=reload_builder)
     from paddle_tpu.serving import serve_forever
     engine = build_serving_engine(ns, args)
     return serve_forever(engine, host=args.host, port=args.port)

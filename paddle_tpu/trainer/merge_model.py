@@ -39,6 +39,17 @@ def merge_model(path: str, graph, params: Dict[str, np.ndarray],
         f.write(_MAGIC + hashlib.md5(payload).digest() + payload)
 
 
+def merged_digest(path: str) -> str:
+    """The PTM1 payload MD5 (hex) without unpickling the payload — the
+    model-version key the serving AOT warmup cache and rolling reload
+    use (``serving/aot_cache.py``)."""
+    with open(path, "rb") as f:
+        head = f.read(20)
+    if head[:4] != _MAGIC:
+        raise IOError(f"{path}: not a merged model (bad magic)")
+    return head[4:20].hex()
+
+
 def load_merged(path: str):
     """-> (graph, params, output_names); raises on corruption.
     Only load files from trusted sources (pickle payload — see module

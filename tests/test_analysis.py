@@ -958,6 +958,39 @@ def test_pt401_schema_good_and_bad(tmp_path):
     assert any("lacks its two sides" in f.message for f in fs)
 
 
+def test_pt401_fleet_artifact_requires_failover_evidence(tmp_path):
+    """The r13 fleet generation: a serving_fleet artifact must carry the
+    cold-start A/B sides, the fleet p99, and the failover / zero-drop
+    counters — a kill-and-respawn bench that recorded none of them is
+    not evidence."""
+    good = tmp_path / "BENCH_fleet.json"
+    good.write_text(json.dumps({
+        "metric": "serving_fleet_failover_and_aot_cold_start",
+        "platform": "cpu",
+        "cold_start_live_ms": 500.0, "cold_start_cache_ms": 25.0,
+        "cold_start_live_vs_cache": 20.0,
+        "fleet_p99_ms": 8.0, "fleet_failovers_total": 3,
+        "fleet_failed_non_shed": 0}))
+    assert check_bench_file(str(good), "BENCH_fleet.json") == []
+
+    # missing the zero-drop counter and one cold-start side
+    bad = tmp_path / "BENCH_fleet_bad.json"
+    bad.write_text(json.dumps({
+        "metric": "serving_fleet_failover_and_aot_cold_start",
+        "platform": "cpu",
+        "cold_start_live_ms": 500.0, "fleet_p99_ms": 8.0,
+        "fleet_failovers_total": 3}))
+    fs = check_bench_file(str(bad), "BENCH_fleet_bad.json")
+    assert any("cold_start_cache_ms" in f.message for f in fs)
+    assert any("fleet_failed_non_shed" in f.message for f in fs)
+
+    # the committed artifact itself stays valid
+    import os as _os
+    root = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    r13 = _os.path.join(root, "BENCH_r13.json")
+    assert check_bench_file(r13, "BENCH_r13.json") == []
+
+
 # ----------------------------------------------------------- baseline
 def test_baseline_parse_apply_and_stale(tmp_path):
     bl = tmp_path / "baseline.toml"

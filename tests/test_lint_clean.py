@@ -32,14 +32,16 @@ def test_pass3_lock_order_clean_and_covers_threaded_modules():
     assert not findings, "\n" + format_report(
         findings, "Pass 3 (lock-order) found violations:")
     for mod in ("paddle_tpu/serving/batcher.py",
+                "paddle_tpu/serving/router.py",
                 "paddle_tpu/dist/master.py",
                 "paddle_tpu/dist/checkpoint.py",
                 "paddle_tpu/trainer/checkpoint.py",
                 "paddle_tpu/data/prefetch.py"):
         assert mod in checker.modules
-    # the analysis is not vacuous: it found the repo's locks and real
+    # the analysis is not vacuous: it found the repo's locks (incl. the
+    # replica router's state lock and RouterMetrics) and real
     # held-while-acquiring edges (engine->metrics, master->store/chaos)
-    assert len(checker.locks) >= 8
+    assert len(checker.locks) >= 10
     assert len(checker.edges) >= 3
 
 

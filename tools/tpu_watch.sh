@@ -14,6 +14,12 @@
 # (no compile), the deadline is generous (240s), and failed probes back
 # off 20 minutes so kills are rare.
 #
+# The bench child carries per-round extras (bench.py:child_main) — a
+# capture window records them all for free: input_pipeline, zero1,
+# pipeline, serving, decode, and (r13) fleet — the AOT cold-start A/B,
+# which on a real chip measures the tunnel's multi-minute XLA compiles
+# against a millisecond cache deserialize.
+#
 # Usage: bash tools/tpu_watch.sh [round_tag]   (default r04)
 set -u
 cd "$(dirname "$0")/.."
