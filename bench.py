@@ -992,18 +992,406 @@ def bench_fleet(rounds=None, n_requests=None):
     return res
 
 
+def bench_fleet_autoscale():
+    """Autoscale under a traffic ramp (``--fleet`` → BENCH_r14.json):
+    one replica behind the router; open-loop traffic at ~3× its
+    calibrated capacity makes the EWMA backlog cross the scale-up
+    threshold, the autoscaler grows the fleet (warm via the shared AOT
+    cache — this is the scale-up-latency half of the cold-start A/B),
+    and sustained idle shrinks it back to the floor. Reported: the
+    replica-count trajectory (must follow the ramp inside
+    [min, max] — asserted), p99 through the ramp (bounded — asserted),
+    zero failed non-shed (asserted), and the scale action counters.
+
+    Honesty note (CLAUDE.md): on this 1-core host extra replicas add no
+    real compute parallelism — the evidence here is the CONTROL LOOP
+    (signal → sustained-threshold → bounded scaling → hysteresis back
+    down), not a throughput win; on a pod each replica is its own chip.
+    """
+    import tempfile
+    import threading
+
+    import numpy as np
+    import jax
+    from paddle_tpu.config import dsl
+    from paddle_tpu.data import integer_value, integer_value_sequence
+    from paddle_tpu.models import lstm_text_classifier
+    from paddle_tpu.serving import (Autoscaler, EngineTransport,
+                                    InProcessFleet, Overloaded,
+                                    ReplicaRouter, ServingEngine,
+                                    ServingError, ServingPredictor)
+    from paddle_tpu.trainer.trainer import Topology
+
+    vocab, seqlen = 1000, 32
+    n_ramp = int(os.environ.get("BENCH_AUTOSCALE_REQUESTS", "60"))
+    max_replicas = 3
+    dsl.reset()
+    cost, out, _ = lstm_text_classifier(
+        vocab_size=vocab, embed_dim=32, hidden=48, num_layers=1,
+        classes=2)
+    topo = Topology(cost)
+    params = topo.network.init_params(jax.random.PRNGKey(0))
+    feeding = {"words": integer_value_sequence(vocab),
+               "label": integer_value(2)}
+    rng = np.random.RandomState(0)
+
+    def mk_sample():
+        return (list(rng.randint(0, vocab, size=seqlen)),
+                int(rng.randint(0, 2)))
+
+    cache_dir = tempfile.mkdtemp(prefix="paddle_tpu_aot_scale_")
+
+    def build_engine():
+        pred = ServingPredictor(
+            topo.graph, params, [out.name], feeding,
+            batch_buckets=[1, 4], length_buckets=[seqlen],
+            aot_cache=cache_dir)
+        return ServingEngine(pred, max_batch=4, batch_timeout_ms=2.0,
+                             queue_depth=n_ramp + 8
+                             ).start(warmup=True)
+
+    # scale-up latency warm-vs-cold: the FIRST engine build traces live
+    # and populates the cache; every autoscale scale-up deserializes it
+    t0 = time.perf_counter()
+    first = build_engine()
+    scaleup_cold_ms = 1e3 * (time.perf_counter() - t0)
+    router = ReplicaRouter([EngineTransport(first)],
+                           health_poll_ms=25.0).start()
+    sample = mk_sample()
+    # calibrate single-replica service time (per CLAUDE.md: no absolute
+    # thresholds on a ±50%-drift host — everything relative to this)
+    t0 = time.perf_counter()
+    for _ in range(8):
+        router.dispatch(sample)
+    base_ms = 1e3 * (time.perf_counter() - t0) / 8
+    from paddle_tpu.serving import RouterMetrics
+    router.metrics = RouterMetrics()
+
+    scaleup_ms = []
+
+    def build():
+        t0 = time.perf_counter()
+        e = build_engine()
+        scaleup_ms.append(1e3 * (time.perf_counter() - t0))
+        return EngineTransport(e)
+
+    fleet = InProcessFleet(router, build)
+    counts = {"ok": 0, "shed": 0, "failed": 0}
+    lock = threading.Lock()
+
+    def one(s):
+        try:
+            router.dispatch(s)
+            key = "ok"
+        except Overloaded as e:
+            from paddle_tpu.serving import Unavailable
+            key = "failed" if isinstance(e, Unavailable) else "shed"
+        except ServingError:
+            key = "failed"
+        with lock:
+            counts[key] += 1
+
+    # ---- the ramp: closed-loop saturation ---------------------------
+    # single-dispatch rate understates capacity (the batcher coalesces
+    # max_batch rows per launch), so pace-to-a-rate can sit inside
+    # batched capacity on a fast host and never queue. A CLOSED loop
+    # of many concurrent callers queues by construction —
+    # host-drift-proof saturation, the same discipline as best-of-R.
+    stop_load = threading.Event()
+    pool = [mk_sample() for _ in range(32)]
+
+    def worker(w):
+        i = w
+        while not stop_load.is_set():
+            one(pool[i % len(pool)])
+            i += 1
+
+    ramp_s = float(os.environ.get("BENCH_AUTOSCALE_RAMP_S", "5.0"))
+    threads = [threading.Thread(target=worker, args=(w,))
+               for w in range(64)]
+    for th in threads:
+        th.start()
+    # thresholds SELF-CALIBRATE against the loaded signal: the first
+    # second of the ramp (autoscaler not yet running) samples the
+    # 1-replica backlog hint the policy will read; scale-up triggers at
+    # half the typical loaded signal (2x crossing margin at any host
+    # speed), scale-down just above the engine's IDLE floor (its
+    # batch_timeout) — absolute ms thresholds would be host-drift bait
+    samples = []
+    cal_deadline = time.monotonic() + 1.0
+    while time.monotonic() < cal_deadline:
+        b = router.load_backlog_ms()
+        if b is not None:
+            samples.append(b)
+        time.sleep(0.025)
+    samples.sort()
+    sig = (samples[len(samples) // 2] if samples
+           and samples[len(samples) // 2] > 0
+           else (samples[-1] if samples else 10.0))
+    down_ms = max(1.6 * 2.0, 0.15 * sig)
+    up_ms = max(2.2 * down_ms, 0.5 * sig)
+    scaler = Autoscaler(
+        fleet, min_replicas=1, max_replicas=max_replicas,
+        up_backlog_ms=up_ms, down_backlog_ms=down_ms,
+        sustain_up_s=0.2, sustain_down_s=1.0, cooldown_s=0.5,
+        poll_ms=50.0).start()
+    ramp_deadline = time.monotonic() + ramp_s
+    while time.monotonic() < ramp_deadline:
+        time.sleep(0.05)
+    stop_load.set()
+    for th in threads:
+        th.join(120.0)
+    ramp_snap = router.metrics.snapshot()
+    peak = max(n for _, n in scaler.trajectory)
+    # ---- sustained idle: the fleet must come back to the floor ------
+    idle_deadline = time.monotonic() + 30.0
+    while (fleet.replica_count() > 1
+           and time.monotonic() < idle_deadline):
+        time.sleep(0.1)
+    scaler.stop()
+    final = fleet.replica_count()
+    traj = [n for _, n in scaler.trajectory]
+    snap = router.metrics.snapshot()
+    res = {
+        "autoscale_closed_loop_callers": 64,
+        "autoscale_ramp_s": ramp_s,
+        "autoscale_base_ms": round(base_ms, 2),
+        "autoscale_loaded_signal_ms": round(sig, 2),
+        "autoscale_up_backlog_ms": round(up_ms, 2),
+        "autoscale_down_backlog_ms": round(down_ms, 2),
+        "autoscale_replica_trajectory": traj,
+        "autoscale_trajectory_t_s": [t for t, _ in scaler.trajectory],
+        "autoscale_peak_replicas": peak,
+        "autoscale_final_replicas": final,
+        "autoscale_min_replicas": 1,
+        "autoscale_max_replicas": max_replicas,
+        "autoscale_p99_ms": ramp_snap["fleet_latency_ms"]["p99_ms"],
+        "autoscale_p50_ms": ramp_snap["fleet_latency_ms"]["p50_ms"],
+        "autoscale_ok": counts["ok"],
+        "autoscale_shed": counts["shed"],
+        "autoscale_failed_non_shed": counts["failed"],
+        "autoscale_scale_up_total": snap["scale_up_total"],
+        "autoscale_scale_down_total": snap["scale_down_total"],
+        "scaleup_cold_trace_ms": round(scaleup_cold_ms, 1),
+        "scaleup_warm_cache_ms": (round(min(scaleup_ms), 1)
+                                  if scaleup_ms else None),
+    }
+    # the acceptance invariants, asserted where the evidence is made
+    assert counts["failed"] == 0, res
+    assert peak > 1, ("the ramp never scaled up", res)
+    assert all(1 <= n <= max_replicas for n in traj), res
+    assert final == 1, ("idle never scaled back down", res)
+    p99 = res["autoscale_p99_ms"]
+    assert p99 is not None and p99 < 1e3 * 60, res  # bounded, not hung
+    router.shutdown(drain=False)
+    return res
+
+
+def bench_router_failover():
+    """Router-kill failover time (``--fleet`` → BENCH_r14.json): two
+    role-fenced routers (active + warm standby) front two replicas;
+    open-loop traffic rides HA client endpoints; a seeded chaos
+    partition silences the active's lease renewals and the harness
+    tears its listener down at the seeded moment (the router-process
+    kill). Reported: kill → standby-adoption lag and kill → first
+    standby-answered OK (both must land within the lease ttl plus a
+    few health intervals — asserted), with zero failed non-shed
+    requests (asserted)."""
+    import tempfile
+    import threading
+
+    import numpy as np
+    import jax
+    from paddle_tpu.config import dsl
+    from paddle_tpu.core.network import Network
+    from paddle_tpu.data import dense_vector, integer_value
+    from paddle_tpu.dist.master import InMemStore, RoleLease
+    from paddle_tpu.serving import (EngineTransport, Overloaded,
+                                    ReplicaRouter, RouterHA,
+                                    ServingClient, ServingEngine,
+                                    ServingError, ServingPredictor,
+                                    Unavailable, make_router_server)
+    from paddle_tpu.testing import chaos
+
+    dim, classes = 8, 4
+    dsl.reset()
+    x = dsl.data(name="x", size=dim)
+    lab = dsl.data(name="label", size=classes)
+    out = dsl.fc(input=x, size=classes, act="softmax", name="out")
+    dsl.classification_cost(input=out, label=lab, name="cost")
+    graph = dsl.current_graph()
+    params = Network(graph, outputs=["out"]).init_params(
+        jax.random.PRNGKey(0))
+    feeding = {"x": dense_vector(dim), "label": integer_value(classes)}
+    cache_dir = tempfile.mkdtemp(prefix="paddle_tpu_aot_ha_")
+
+    def build_engine():
+        pred = ServingPredictor(graph, params, ["out"], feeding,
+                                batch_buckets=[1, 2],
+                                aot_cache=cache_dir)
+        return ServingEngine(pred, max_batch=2, batch_timeout_ms=1.0,
+                             queue_depth=64).start(warmup=True)
+
+    sample = ((np.arange(dim, dtype=float) / dim).tolist(), 1)
+    ttl, interval_ms = 0.4, 100.0
+    engs = [build_engine() for _ in range(2)]
+    store = InMemStore()
+    lease_a = RoleLease(store, "A", ttl_s=ttl, settle_s=0.0)
+    lease_b = RoleLease(store, "B", ttl_s=ttl, settle_s=0.0)
+    active = ReplicaRouter([EngineTransport(e) for e in engs],
+                           fence=lease_a, health_poll_ms=25.0)
+    standby = ReplicaRouter([], fence=lease_b, health_poll_ms=25.0)
+    srv_a = make_router_server(active, port=0)
+    srv_b = make_router_server(standby, port=0)
+    for s in (srv_a, srv_b):
+        threading.Thread(target=s.serve_forever, daemon=True).start()
+    by_id = {f"r{i}": e for i, e in enumerate(engs)}
+
+    def peer_healthz():
+        import http.client
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", srv_a.server_address[1], timeout=1.0)
+        try:
+            conn.request("GET", "/healthz")
+            return json.loads(conn.getresponse().read())
+        finally:
+            conn.close()
+
+    def adopt(snaps):
+        return [(s["id"], EngineTransport(by_id[s["id"]]))
+                for s in snaps if s["id"] in by_id]
+
+    assert lease_a.try_acquire()
+    active.start()
+    standby.start()
+    ha_a = RouterHA(active, lease_a, interval_ms=interval_ms).start()
+    ha_b = RouterHA(standby, lease_b, peer_healthz=peer_healthz,
+                    adopt=adopt, adopt_after=2,
+                    interval_ms=interval_ms).start()
+    plan = chaos.FaultPlan(seed=17, faults=[
+        # drop holder A's renewals only — the adopted standby's own
+        # renewals must sail through (chaos "match" targeting)
+        {"type": "partition", "site": "lease_renew", "after": 4,
+         "count": 100000, "match": {"holder": "A"}}])
+    n_requests, req_interval = 40, 0.05
+    counts = {"ok": 0, "shed": 0, "failed": 0}
+    lock = threading.Lock()
+    endpoints = [f"127.0.0.1:{srv_a.server_address[1]}",
+                 f"127.0.0.1:{srv_b.server_address[1]}"]
+    killed = {"t": None}
+    first_standby_ok = {"t": None}
+
+    def kill_watch():
+        while plan.hits("lease_renew") < 5:
+            time.sleep(0.01)
+        killed["t"] = time.monotonic()
+        # the active router "process" dies: stop the accept loop AND
+        # close the listening socket (a real death frees the port;
+        # shutdown() alone would backlog-blackhole new connections)
+        srv_a.shutdown()
+        srv_a.server_close()
+
+    def one(i):
+        client = ServingClient(endpoints=list(endpoints), timeout=10.0,
+                               retries=8, backoff_base_ms=20.0,
+                               backoff_seed=1000 + i)
+        try:
+            client.score(sample)
+            key = "ok"
+            # EXACT endpoint compare: a suffix match on the port digits
+            # could credit the ACTIVE (e.g. :18080 ends with "8080")
+            ep = (client.last_provenance or {}).get("endpoint", "")
+            if ep == f"127.0.0.1:{srv_b.server_address[1]}":
+                with lock:
+                    if first_standby_ok["t"] is None:
+                        first_standby_ok["t"] = time.monotonic()
+        except Unavailable:
+            key = "failed"
+        except Overloaded:
+            key = "shed"
+        except (ServingError, OSError):
+            key = "failed"
+        with lock:
+            counts[key] += 1
+
+    threads = []
+    with chaos.chaos_plan(plan):
+        watcher = threading.Thread(target=kill_watch, daemon=True)
+        watcher.start()
+        t0 = time.monotonic()
+        for i in range(n_requests):
+            target = t0 + i * req_interval
+            d = target - time.monotonic()
+            if d > 0:
+                time.sleep(d)
+            th = threading.Thread(target=one, args=(i,))
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(60.0)
+        watcher.join(10.0)
+        deadline = time.monotonic() + 10.0
+        while ha_b.adoptions == 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+    assert killed["t"] is not None and ha_b.adoptions == 1
+    adoption_lag_ms = 1e3 * (ha_b.adopted_at - killed["t"])
+    answer_lag_ms = (1e3 * (first_standby_ok["t"] - killed["t"])
+                     if first_standby_ok["t"] is not None else None)
+    res = {
+        "failover_requests": n_requests,
+        "failover_ok": counts["ok"],
+        "failover_shed": counts["shed"],
+        "fleet_failed_non_shed_failover": counts["failed"],
+        "failover_adoption_lag_ms": round(adoption_lag_ms, 1),
+        "failover_kill_to_first_standby_ok_ms": (
+            round(answer_lag_ms, 1) if answer_lag_ms else None),
+        "failover_lease_ttl_ms": ttl * 1e3,
+        "failover_health_interval_ms": interval_ms,
+        "failover_adoptions": ha_b.adoptions,
+        "failover_fenced_total": (
+            active.metrics.snapshot()["fenced_total"]),
+    }
+    # acceptance: zero failed non-shed, and the standby ANSWERED within
+    # one health interval of becoming eligible (lease ttl after the
+    # kill), with scheduling slack for the 1-core host
+    assert counts["failed"] == 0, res
+    budget_ms = ttl * 1e3 + 3 * interval_ms + 500.0
+    assert adoption_lag_ms < budget_ms, res
+    assert answer_lag_ms is not None and answer_lag_ms < budget_ms + \
+        500.0, res
+    ha_a.shutdown(release=False)
+    ha_b.shutdown(release=False)
+    srv_b.shutdown()
+    for e in engs:
+        e.shutdown(drain=False)
+    return res
+
+
 def fleet_main():
-    """``python bench.py --fleet``: the off-tunnel fleet bench alone,
-    forced onto CPU; one JSON line, mirrored to BENCH_r13.json."""
+    """``python bench.py --fleet``: the off-tunnel fleet benches alone,
+    forced onto CPU; one JSON line, mirrored to BENCH_r14.json. Three
+    scenarios in one artifact: the r13 cold-start A/B + replica-kill
+    rounds (still the respawn-warmth evidence), the autoscale traffic
+    ramp (replica count follows load inside [min, max], p99 bounded,
+    zero failed non-shed), and the router-kill HA failover (standby
+    answers within one health interval, zero failed non-shed)."""
     import jax
     jax.config.update("jax_platforms", "cpu")
-    result = {"metric": "serving_fleet_failover_and_aot_cold_start",
+    result = {"metric": "serving_fleet_autoscale_ha_failover",
               "platform": jax.devices()[0].platform}
     result.update(bench_fleet())
+    result.update(bench_fleet_autoscale())
+    result.update(bench_router_failover())
+    # the headline zero-drop number sums EVERY scenario's counter —
+    # no failure hides behind a sibling scenario
+    result["fleet_failed_non_shed"] = (
+        result["fleet_failed_non_shed"]
+        + result["autoscale_failed_non_shed"]
+        + result["fleet_failed_non_shed_failover"])
     line = json.dumps(result)
     print(line, flush=True)
     here = os.path.dirname(os.path.abspath(__file__))
-    with open(os.path.join(here, "BENCH_r13.json"), "w") as f:
+    with open(os.path.join(here, "BENCH_r14.json"), "w") as f:
         f.write(line + "\n")
     return 0
 
@@ -1181,8 +1569,13 @@ def child_main():
     # fleet: AOT cold-start A/B + kill-and-respawn under load — on a
     # real chip the live-trace arm pays the tunnel's multi-minute XLA
     # compiles, which is exactly where the cache matters most
-    # (off-tunnel number: BENCH_r13.json via --fleet)
+    # (off-tunnel number: BENCH_r14.json via --fleet)
     extra("fleet", bench_fleet)
+    # self-operating fleet (r14): autoscale ramp + router-kill HA
+    # failover — the control loops are host-agnostic, but on-chip the
+    # scale-up arm shows the real cache-vs-trace gap
+    extra("fleet_autoscale", bench_fleet_autoscale)
+    extra("fleet_ha", bench_router_failover)
     return 0
 
 

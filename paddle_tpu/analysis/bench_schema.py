@@ -29,7 +29,12 @@ looser schema):
   additionally carry the cold-start A/B sides (``cold_start_live_ms`` /
   ``cold_start_cache_ms``), ``fleet_p99_ms``, and the
   ``fleet_failovers_total`` / ``fleet_failed_non_shed`` counters — the
-  failover and zero-drop evidence.
+  failover and zero-drop evidence. Metrics starting with
+  ``serving_fleet_autoscale`` (BENCH_r14, the self-operating fleet)
+  must FURTHER carry ``autoscale_replica_trajectory`` (a non-empty list
+  of replica counts — did the count follow the ramp inside the
+  bounds?), ``autoscale_p99_ms``, and ``fleet_failed_non_shed`` summed
+  across rounds.
 
 Everything must parse as one JSON object with finite numbers
 throughout (NaN/Infinity are emitted by a crashed averaging step and
@@ -120,6 +125,30 @@ def check_bench_file(path: str, rel: str) -> List[Finding]:
                 if not isinstance(v, int) or isinstance(v, bool):
                     bad(f"fleet artifact missing int {k!r} (the "
                         "failover / zero-drop evidence)")
+        if str(data.get("metric", "")).startswith(
+                "serving_fleet_autoscale"):
+            # the r14 self-operating-fleet generation: an autoscale
+            # claim is only evidence with the replica-count TRAJECTORY
+            # (did the count actually follow load, inside the bounds?),
+            # the p99 under the ramp, and the zero-failed counter
+            # SUMMED across rounds (a failing round must not hide
+            # behind a best-of)
+            traj = data.get("autoscale_replica_trajectory")
+            if (not isinstance(traj, list) or not traj
+                    or not all(isinstance(n, int)
+                               and not isinstance(n, bool)
+                               for n in traj)):
+                bad("autoscale artifact missing "
+                    "'autoscale_replica_trajectory' (non-empty list of "
+                    "replica counts — the follow-the-load evidence)")
+            v = data.get("autoscale_p99_ms")
+            if not isinstance(v, (int, float)) or isinstance(v, bool):
+                bad("autoscale artifact missing numeric "
+                    "'autoscale_p99_ms' (the bounded-latency evidence)")
+            v = data.get("fleet_failed_non_shed")
+            if not isinstance(v, int) or isinstance(v, bool):
+                bad("autoscale artifact missing int "
+                    "'fleet_failed_non_shed' summed across rounds")
         for key, val in data.items():
             if "_vs_" not in key:
                 continue

@@ -38,11 +38,14 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 from paddle_tpu.analysis.findings import Finding
 
 # the threaded modules the tentpole names (r12: five; r13 adds the
-# replica router — health thread + per-request dispatch/hedge threads),
+# replica router — health thread + per-request dispatch/hedge threads;
+# r14 adds the replica supervisor — monitor thread + scale/shutdown
+# callers over one bookkeeping lock),
 # plus lock-holding classes they call into while holding their own locks
 DEFAULT_MODULES = (
     "paddle_tpu/serving/batcher.py",
     "paddle_tpu/serving/router.py",
+    "paddle_tpu/serving/supervisor.py",
     "paddle_tpu/dist/master.py",
     "paddle_tpu/dist/checkpoint.py",
     "paddle_tpu/trainer/checkpoint.py",

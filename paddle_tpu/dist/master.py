@@ -145,6 +145,179 @@ class FileStore:
 _AUTO_STRAGGLE = object()
 
 
+class LeaseTable:
+    """Heartbeat-renewed liveness ledger — the lease primitive the
+    master's trainer liveness always was, extracted so the replica
+    supervisor (``serving/supervisor.py``) can lease replica processes
+    through the SAME machinery instead of reinventing it.
+
+    A holder renews its lease with :meth:`renew`; :meth:`expired` pops
+    and returns every holder whose last renewal is older than
+    ``timeout_s``. Monotonic clock, single-process. NOT itself
+    thread-safe: the owner (MasterService under its RLock, the
+    supervisor under its own lock) serializes access — a second lock
+    here would add a lock-order edge for no isolation gain."""
+
+    def __init__(self, timeout_s: float):
+        self.timeout_s = float(timeout_s)
+        self._seen: Dict[str, float] = {}
+
+    def renew(self, holder: Optional[str]):
+        if holder is not None:
+            self._seen[holder] = time.monotonic()
+
+    def renew_all(self, holders):
+        now = time.monotonic()
+        for h in holders:
+            self._seen[h] = now
+
+    def drop(self, holder: str):
+        self._seen.pop(holder, None)
+
+    def expired(self, now: Optional[float] = None) -> List[str]:
+        """Pop and return every holder past ``timeout_s`` — each is
+        reported exactly once (the caller owns the consequence; a
+        holder that renews again afterwards simply re-enters)."""
+        now = time.monotonic() if now is None else now
+        dead = [h for h, seen in self._seen.items()
+                if now - seen > self.timeout_s]
+        for h in dead:
+            del self._seen[h]
+        return dead
+
+    def age(self, holder: str) -> Optional[float]:
+        t = self._seen.get(holder)
+        return None if t is None else time.monotonic() - t
+
+    def holders(self) -> List[str]:
+        return list(self._seen)
+
+    def __contains__(self, holder) -> bool:
+        return holder in self._seen
+
+
+class RoleLease:
+    """Fenced single-holder role lease over a :class:`Store` — the
+    "active router" election for router HA (``serving/router.py:
+    RouterHA``).
+
+    The record is tiny JSON in the store: ``{role, holder, epoch,
+    nonce, renewed_at}`` with a WALL-clock ``renewed_at`` (two processes
+    cannot compare monotonic clocks). Semantics:
+
+    - :meth:`try_acquire` takes the role when it is free, released, or
+      stale (``renewed_at`` older than ``ttl_s``), bumping ``epoch`` —
+      the fencing token. Last-writer-wins with a ``settle_s`` read-back
+      window (the FileStore has atomic replace but no CAS; a real
+      multi-host deployment backs the Store with etcd/GCS preconditions
+      — the epoch fence below bounds the damage of the race either
+      way).
+    - :meth:`renew` re-reads first: if the record no longer names this
+      holder AND epoch, the role was taken with a higher epoch — the
+      renew FAILS and local validity drops, so the old holder fences
+      itself within one renewal period. The chaos site ``lease_renew``
+      fires here: a ``drop`` is a lost renewal (the partition fault).
+    - :meth:`valid` is the lock-free fencing check the router's
+      dispatch path polls: true only within ``ttl_s`` of the last
+      SUCCESSFUL acquire/renew. A partitioned old active whose renewals
+      stop dispatching within one ttl — the r11 epoch-guard idea
+      (a zombie's stale action must not land) applied to routing.
+    """
+
+    def __init__(self, store, holder_id: str, *, ttl_s: float = 3.0,
+                 role: str = "active", settle_s: float = 0.05):
+        self.store = store
+        self.holder_id = str(holder_id)
+        self.ttl_s = float(ttl_s)
+        self.role = str(role)
+        self.settle_s = float(settle_s)
+        self.epoch = 0
+        # monotonic deadline of local validity; plain float read/write
+        # (atomic in CPython) — dispatch polls this lock-free
+        self._valid_until = 0.0
+
+    # ------------------------------------------------------------ store
+    def _read(self) -> Optional[dict]:
+        raw = self.store.load()
+        if not raw:
+            return None
+        try:
+            rec = json.loads(raw.decode())
+        except (ValueError, UnicodeDecodeError):
+            return None  # torn/foreign record reads as "free"
+        return rec if isinstance(rec, dict) else None
+
+    def _write(self, rec: dict):
+        self.store.save(json.dumps(rec).encode())
+
+    def peek(self) -> Optional[dict]:
+        """The current record, whoever holds it (standby's watch)."""
+        return self._read()
+
+    # ------------------------------------------------------------- role
+    def valid(self) -> bool:
+        return time.monotonic() < self._valid_until
+
+    def try_acquire(self) -> bool:
+        """Take the role if free/stale/ours. Returns True only after a
+        read-back confirms our write survived the settle window."""
+        rec = self._read()
+        now = time.time()
+        if (rec and rec.get("holder")
+                and rec.get("holder") != self.holder_id
+                and now - float(rec.get("renewed_at", 0)) <= self.ttl_s):
+            return False  # live foreign holder
+        # epoch only grows — even re-acquiring our own stale record
+        # bumps it, so every acquisition is a fresh fencing token
+        epoch = int(rec.get("epoch", 0) if rec else 0) + 1
+        nonce = f"{self.holder_id}:{epoch}:{os.urandom(4).hex()}"
+        self._write({"role": self.role, "holder": self.holder_id,
+                     "epoch": epoch, "nonce": nonce, "renewed_at": now})
+        if self.settle_s:
+            time.sleep(self.settle_s)
+        back = self._read()
+        if (back and back.get("holder") == self.holder_id
+                and back.get("nonce") == nonce):
+            self.epoch = epoch
+            self._valid_until = time.monotonic() + self.ttl_s
+            logger.info("role %r acquired by %s (epoch %d)", self.role,
+                        self.holder_id, epoch)
+            return True
+        return False
+
+    def renew(self) -> bool:
+        """Renew while we hold the role; False (and local validity
+        drops at its ttl) once a higher epoch took it. Raises
+        ``ChaosDropped`` under an injected ``lease_renew`` drop — the
+        caller treats that exactly like a lost renewal."""
+        if _chaos._ACTIVE is not None:
+            _chaos._ACTIVE.hit("lease_renew", holder=self.holder_id,
+                               role=self.role)
+        rec = self._read()
+        if (not rec or rec.get("holder") != self.holder_id
+                or int(rec.get("epoch", -1)) != self.epoch):
+            # fenced: the role moved on with a higher epoch — this
+            # holder must NOT keep acting on its stale validity window
+            self._valid_until = 0.0
+            return False
+        rec["renewed_at"] = time.time()
+        self._write(rec)
+        self._valid_until = time.monotonic() + self.ttl_s
+        return True
+
+    def release(self):
+        """Explicit hand-back (clean shutdown): the record keeps its
+        epoch (tokens only grow) but drops the holder, so a standby
+        acquires without waiting out the ttl."""
+        self._valid_until = 0.0
+        rec = self._read()
+        if (rec and rec.get("holder") == self.holder_id
+                and int(rec.get("epoch", -1)) == self.epoch):
+            rec["holder"] = None
+            rec["renewed_at"] = 0.0
+            self._write(rec)
+
+
 class MasterService:
     """The task-queue state machine. Thread-safe; every mutation
     snapshots to the store."""
@@ -179,7 +352,9 @@ class MasterService:
         # finished-but-uncommitted per trainer, in finish order (commit
         # protocol: these requeue if the trainer dies before committing)
         self.uncommitted: Dict[str, List[Task]] = {}
-        self._trainer_seen: Dict[str, float] = {}
+        # trainer liveness = heartbeat-renewed leases (the same
+        # LeaseTable the replica supervisor leases processes through)
+        self._trainer_seen = LeaseTable(self.trainer_timeout_s)
         self.failed: List[Task] = []
         self.cur_pass = 0
         self._ready = False
@@ -230,8 +405,7 @@ class MasterService:
         self.uncommitted = {
             tr: [Task.from_dict(d) for d in ts]
             for tr, ts in state.get("uncommitted", {}).items()}
-        now = time.monotonic()
-        self._trainer_seen = {tr: now for tr in self.uncommitted}
+        self._trainer_seen.renew_all(self.uncommitted)
         self.done = [Task.from_dict(d) for d in state["done"]]
         self._done_ids = {t.id for t in self.done}
         self.done_by = {int(k): v
@@ -262,8 +436,7 @@ class MasterService:
                 del self._owner[trainer]
 
     def _touch_trainer(self, trainer_id: Optional[str]):
-        if trainer_id is not None:
-            self._trainer_seen[trainer_id] = time.monotonic()
+        self._trainer_seen.renew(trainer_id)
 
     def _mark_done(self, task: Task, trainer_id: Optional[str]):
         task.num_failures = 0
@@ -294,10 +467,7 @@ class MasterService:
         # lease AFTER the uncommitted finishes here would invert dispatch
         # order. Front-requeue the in-flight task first, then prepend the
         # finishes: todo = [finishes..., in-flight, ...rest].
-        dead = [tr for tr, seen in self._trainer_seen.items()
-                if now - seen > self.trainer_timeout_s]
-        for tr in dead:
-            del self._trainer_seen[tr]
+        for tr in self._trainer_seen.expired(now):
             self._requeue_trainer(tr, "lease expired")
 
     def _requeue_trainer(self, trainer_id: str, why: str) -> int:
@@ -628,7 +798,7 @@ class MasterService:
                 if prev_trainer_id != trainer_id:
                     # the old process is gone; don't let its liveness
                     # entry linger until the timeout fires spuriously
-                    self._trainer_seen.pop(prev_trainer_id, None)
+                    self._trainer_seen.drop(prev_trainer_id)
             back: List[Task] = []
             for self_id in selves:
                 for t in self.uncommitted.pop(self_id, []):

@@ -28,7 +28,15 @@ C API loads) served over HTTP with
   (``--aot_cache_dir``, ``serving/aot_cache.py``) that persists the
   warmed bucket menu as serialized compiled executables so a respawned
   replica cold-starts in milliseconds instead of re-tracing the shape
-  cross-product.
+  cross-product,
+- a self-operating tier (``--job=serve_fleet``,
+  ``serving/supervisor.py``): a replica supervisor that spawns, leases
+  (``dist/master.py:LeaseTable``), kills and respawns real
+  single-replica server processes (reap-gated — no double spawn),
+  router HA via a warm standby adopting the fleet over an epoch-fenced
+  ``RoleLease`` (a partitioned old active provably stops dispatching),
+  and load-driven autoscaling with hysteresis inside
+  ``[--min_replicas, --max_replicas]``.
 
 Entry points: ``python -m paddle_tpu.trainer.cli --job=serve`` (flags
 ``--port --batch_timeout_ms --max_batch --queue_depth --replicas
@@ -57,5 +65,8 @@ from paddle_tpu.serving.server import (install_signal_handlers,  # noqa: F401
                                        make_server, serve_forever)
 from paddle_tpu.serving.router import (EngineTransport,  # noqa: F401
                                        HTTPTransport, ReplicaRouter,
-                                       make_router_server,
+                                       RouterHA, make_router_server,
                                        serve_router_forever)
+from paddle_tpu.serving.supervisor import (Autoscaler,  # noqa: F401
+                                           InProcessFleet,
+                                           ReplicaSupervisor)

@@ -107,6 +107,11 @@ class ServingEngine:
         self._queue: List[_Request] = []
         self._draining = False
         self._batch_ewma_ms = 10.0  # drain-time estimator seed
+        # rows of the batch the worker is running RIGHT NOW (0 between
+        # batches). Written only by the worker thread, read lock-free by
+        # health() — a remote drain_wait polls queue_depth+inflight to
+        # know every queued AND in-flight request has been answered.
+        self._inflight = 0
         self._thread: Optional[threading.Thread] = None
         self.fatal: Optional[BaseException] = None
 
@@ -176,6 +181,7 @@ class ServingEngine:
             "status": status, "live": live, "ready": ready,
             "warmed": warmed, "draining": self._draining,
             "queue_depth": self.queue_len(),
+            "inflight": self._inflight,
             "backlog_ms": round(self.backlog_hint_ms(), 1),
             "model_version": getattr(self.predictor, "model_version",
                                      None),
@@ -319,6 +325,12 @@ class ServingEngine:
                 self._cond.wait(remaining)
             for r in batch:
                 self._queue.remove(r)
+            # claim the in-flight window BEFORE releasing the queue
+            # lock: a remote drain_wait polling /healthz must never
+            # observe queue_depth==0 AND inflight==0 while a popped
+            # batch has not run yet (it would reap the process under
+            # the batch)
+            self._inflight = len(batch)
             self._cond.notify_all()
             return batch
 
@@ -331,18 +343,22 @@ class ServingEngine:
                     logger.info("serving: worker drained and stopped")
                     return
                 if batch:
-                    if _chaos._ACTIVE is not None:
-                        # straggler injection point: a FaultPlan stall
-                        # here models a slow device step — deadline and
-                        # retry_after_ms behavior must stay honest
-                        _chaos._ACTIVE.hit("serve_batch",
-                                           kind=batch[0].kind,
-                                           size=len(batch))
-                    if (self._session is not None
-                            and batch[0].kind == "generate"):
-                        self._run_generate_continuous(batch)
-                    else:
-                        self._run_batch(batch)
+                    try:
+                        if _chaos._ACTIVE is not None:
+                            # straggler injection point: a FaultPlan
+                            # stall here models a slow device step —
+                            # deadline and retry_after_ms behavior must
+                            # stay honest
+                            _chaos._ACTIVE.hit("serve_batch",
+                                               kind=batch[0].kind,
+                                               size=len(batch))
+                        if (self._session is not None
+                                and batch[0].kind == "generate"):
+                            self._run_generate_continuous(batch)
+                        else:
+                            self._run_batch(batch)
+                    finally:
+                        self._inflight = 0
             except BaseException as e:  # noqa: BLE001 — a worker bug
                 self.fatal = e
                 logger.error("serving worker died: %r", e)

@@ -17,6 +17,13 @@ FLEET-wide backlog estimate in ``retry_after_ms`` — the min over
 replica drain hints, since queues drain in parallel — so the existing
 backoff honors fleet capacity, not one replica's private EWMA.
 
+Router HA (``endpoints=["host:port", ...]``): the client may be given
+the active router AND its warm standby(s). A connection reset (the
+active died) or a 503 ``Unavailable`` (a fenced old active / a standby
+that has not adopted yet) rotates to the next endpoint inside the same
+retry budget, and ``last_provenance["endpoint"]`` records which one
+finally answered.
+
 Opt-in retries (``retries=N``): every serving request is idempotent
 (stateless inference), so the client may safely re-send on a connection
 reset (a worker restart, a drained-and-relaunched server) and on 429
@@ -36,7 +43,8 @@ from typing import List, Optional
 
 import json
 
-from paddle_tpu.serving.errors import Overloaded, ServingError, from_wire
+from paddle_tpu.serving.errors import (Overloaded, ServingError,
+                                       Unavailable, from_wire)
 from paddle_tpu.utils.backoff import backoff_delay, jittered_up
 
 
@@ -45,9 +53,31 @@ class ServingClient:
                  timeout: float = 120.0, *, retries: int = 0,
                  backoff_base_ms: float = 50.0,
                  backoff_cap_ms: float = 2000.0,
-                 backoff_seed: Optional[int] = None):
-        self.host = host
-        self.port = int(port)
+                 backoff_seed: Optional[int] = None,
+                 endpoints: Optional[List] = None):
+        # ``endpoints`` = HA address list ["host:port", ...] (or
+        # (host, port) tuples): the ACTIVE router and its warm
+        # standby(s). On a connection reset — the active died — or a
+        # 503 Unavailable — a fenced/un-adopted router answered — the
+        # client rotates to the next endpoint inside the SAME retry
+        # budget/backoff it already has, and ``last_provenance``
+        # carries which endpoint finally answered. Default: the single
+        # (host, port), with rotation a no-op.
+        self._endpoints: List[tuple] = []
+        for ep in (endpoints if endpoints else [(host, port)]):
+            if isinstance(ep, str):
+                h, _, p = ep.rpartition(":")
+                self._endpoints.append((h or "127.0.0.1", int(p)))
+            else:
+                self._endpoints.append((ep[0], int(ep[1])))
+        self._ep_idx = 0
+        self.host, self.port = self._endpoints[0]
+        # an HA list with the DEFAULT retries=0 would be silently
+        # inert (rotation only happens on a retried attempt): floor
+        # the budget at one attempt per extra endpoint. An explicit
+        # retries>0 is honored as given.
+        if len(self._endpoints) > 1 and retries == 0:
+            retries = len(self._endpoints) - 1
         self.timeout = timeout
         self.retries = int(retries)
         self.backoff_base_ms = backoff_base_ms
@@ -60,6 +90,13 @@ class ServingClient:
         self.last_provenance: Optional[dict] = None
 
     # ------------------------------------------------------------- wire
+    def _rotate_endpoint(self):
+        """Advance to the next endpoint of the HA list (no-op with
+        one): the connection-reset / 503 re-resolution path."""
+        if len(self._endpoints) > 1:
+            self._ep_idx = (self._ep_idx + 1) % len(self._endpoints)
+            self.host, self.port = self._endpoints[self._ep_idx]
+
     def _sleep_ms(self, ms: float):
         time.sleep(max(0.0, ms) / 1e3)
 
@@ -118,6 +155,13 @@ class ServingClient:
             # retry provenance rides every router response, errors
             # included (last_provenance survives a raise below)
             self.last_provenance = self._provenance_from(resp)
+            if len(self._endpoints) > 1:
+                # HA list: surface WHICH endpoint answered (the active
+                # vs a standby that adopted) alongside the router's
+                # replica provenance
+                prov = self.last_provenance or {}
+                prov["endpoint"] = f"{self.host}:{self.port}"
+                self.last_provenance = prov
             if resp.status >= 400:
                 err = from_wire(data, resp.status)
                 err.provenance = self.last_provenance
@@ -139,14 +183,22 @@ class ServingClient:
                 last = e
                 if attempt >= self.retries:
                     raise
+                if isinstance(e, Unavailable):
+                    # 503: THIS endpoint has no capacity to offer (a
+                    # fenced old active, an un-adopted standby, a fleet
+                    # with no ready replica) — re-resolve to the next
+                    # endpoint of the HA list before retrying
+                    self._rotate_endpoint()
                 self._sleep_ms(self._backoff_ms(attempt, e.retry_after_ms))
             except (ConnectionError, http.client.HTTPException,
                     TimeoutError, OSError) as e:
                 # connection reset / refused mid-restart: idempotent
-                # requests may re-send
+                # requests may re-send — against the NEXT endpoint of
+                # the HA list (a dead active's standby) when one exists
                 last = e
                 if attempt >= self.retries:
                     raise
+                self._rotate_endpoint()
                 self._sleep_ms(self._backoff_ms(attempt))
         raise ServingError(f"unreachable: {last!r}")  # not reached
 

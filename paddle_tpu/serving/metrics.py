@@ -193,7 +193,13 @@ class RouterMetrics:
     COUNTERS = ("dispatches_total", "responses_total", "failovers_total",
                 "hedges_total", "hedge_wins_total", "ejections_total",
                 "breaker_open_total", "respawns_total", "reloads_total",
-                "shed_total", "replica_deaths_total")
+                "shed_total", "replica_deaths_total",
+                # HA + elastic-capacity plane (r14): fenced dispatch
+                # refusals (the old active provably stopped), standby
+                # fleet adoptions, autoscale actions, supervisor kills
+                "fenced_total", "adoptions_total",
+                "scale_up_total", "scale_down_total",
+                "replica_kills_total", "lease_renew_lost_total")
 
     def __init__(self, window: int = 4096):
         self._lock = threading.Lock()

@@ -174,18 +174,26 @@ class EngineTransport:
 
 class HTTPTransport:
     """A replica reached over HTTP — a separately-launched single-
-    replica server process. ``proc`` (a ``subprocess.Popen``) makes
-    drain use the real SIGTERM machinery; without it, drain is the
-    operator's job and ``begin_drain`` only logs. The wire layer is
+    replica server process. Drain is uniform whether or not we hold the
+    process handle: ``begin_drain`` POSTs the replica's
+    ``/admin/drain`` (admission closes, queued + in-flight work
+    completes), so a supervisor-owned and an externally-launched
+    replica drain identically; ``proc`` (a ``subprocess.Popen``) lets
+    ``drain_wait`` additionally SIGTERM and reap the drained process,
+    while a Popen-less transport watches ``/healthz`` until
+    ``queue_depth`` and ``inflight`` are dry. The wire layer is
     :class:`ServingClient`'s (retries=0 — retry policy belongs to the
     router's failover, not the transport)."""
 
     def __init__(self, host: str, port: int, timeout: float = 120.0,
-                 proc=None):
+                 proc=None, healthz_timeout: float = 5.0):
         from paddle_tpu.serving.client import ServingClient
         self.host, self.port = host, int(port)
         self.timeout = timeout
         self.proc = proc
+        # the supervisor probes with a SHORT deadline (a hung replica
+        # must not stall the sweep for the default 5 s)
+        self.healthz_timeout = float(healthz_timeout)
         self._client = ServingClient(host, port, timeout=timeout)
 
     def start_call(self, kind: str, sample, deadline_ms,
@@ -221,7 +229,7 @@ class HTTPTransport:
         import http.client
         import json
         conn = http.client.HTTPConnection(self.host, self.port,
-                                          timeout=5.0)
+                                          timeout=self.healthz_timeout)
         try:
             conn.request("GET", "/healthz")
             resp = conn.getresponse()
@@ -235,18 +243,57 @@ class HTTPTransport:
             conn.close()
 
     def begin_drain(self):
-        if self.proc is not None:
-            import signal
-            self.proc.send_signal(signal.SIGTERM)
-        else:
+        """Close the replica's admission via ``POST /admin/drain`` —
+        the ONE drain path for supervisor-owned and externally-launched
+        replicas alike. Falls back to SIGTERM when the endpoint is
+        unreachable and we hold the process handle (e.g. the listener
+        already died but the process lingers)."""
+        try:
+            self._client._request_once("POST", "/admin/drain")
+            return
+        except Exception as e:  # noqa: BLE001 — endpoint unreachable
+            if self.proc is not None and self.proc.poll() is not None:
+                return  # the process already exited (an earlier drain
+                # completed, or it died): nothing left to drain
+            if self.proc is None:
+                logger.warning(
+                    "HTTPTransport %s:%d drain endpoint unreachable "
+                    "(%r) and no process handle; drain must be driven "
+                    "out of band", self.host, self.port, e)
+                return
             logger.warning(
-                "HTTPTransport %s:%d has no process handle; drain must "
-                "be driven out of band (SIGTERM the replica yourself)",
-                self.host, self.port)
+                "HTTPTransport %s:%d drain endpoint unreachable (%r); "
+                "falling back to SIGTERM", self.host, self.port, e)
+            import signal
+            try:
+                self.proc.send_signal(signal.SIGTERM)
+            except (ProcessLookupError, OSError):
+                pass  # already gone — drain_wait reaps
 
     def drain_wait(self, timeout: float = 60.0):
+        """Block until every queued + in-flight request is answered.
+        With a process handle the drained replica is then SIGTERMed and
+        reaped (the rolling-reload / shutdown contract); without one we
+        watch ``/healthz`` until the drain runs dry — an unreachable
+        replica counts as drained (it can hold no queued work)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                h = self.healthz()
+            except Exception:  # noqa: BLE001 — gone = drained
+                break
+            if (h.get("draining") and not h.get("queue_depth")
+                    and not h.get("inflight")):
+                break
+            time.sleep(0.02)
         if self.proc is not None:
-            self.proc.wait(timeout=timeout)
+            import signal
+            try:
+                self.proc.send_signal(signal.SIGTERM)
+            except (ProcessLookupError, OSError):
+                pass
+            self.proc.wait(timeout=max(1.0,
+                                       deadline - time.monotonic()))
 
 
 class Replica:
@@ -266,13 +313,21 @@ class Replica:
         self.last_spawn_ms: Optional[float] = None
 
     def snapshot(self) -> dict:
+        t = self.transport
+        # HTTP-reachable replicas advertise their address so a warm
+        # standby router can rebuild this fleet from /healthz polls
+        # alone (router HA: adoption is re-poll + re-arm, no shared db)
+        addr = (f"{t.host}:{t.port}"
+                if getattr(t, "host", None) is not None
+                and getattr(t, "port", None) is not None else None)
         return {"id": self.id, "state": self.state,
                 "inflight": self.inflight,
                 "consecutive_failures": self.consecutive_failures,
                 "model_version": self.last_health.get("model_version"),
                 "queue_depth": self.last_health.get("queue_depth"),
                 "backlog_ms": self.last_health.get("backlog_ms"),
-                "last_spawn_ms": self.last_spawn_ms}
+                "last_spawn_ms": self.last_spawn_ms,
+                "addr": addr}
 
 
 class ReplicaRouter:
@@ -288,6 +343,7 @@ class ReplicaRouter:
                  hedge_ms: Optional[float] = None,
                  max_hedges: int = 1,
                  wait_timeout: float = 120.0,
+                 fence=None,
                  metrics: Optional[RouterMetrics] = None):
         self.replicas: List[Replica] = [
             t if isinstance(t, Replica) else Replica(f"r{i}", t)
@@ -295,6 +351,11 @@ class ReplicaRouter:
         if len({r.id for r in self.replicas}) != len(self.replicas):
             raise ValueError("replica ids must be unique")
         self.spawn = spawn
+        # optional role fence (a RoleLease, or anything with .valid()):
+        # dispatch refuses while the fence is invalid, so a partitioned
+        # old ACTIVE router provably stops dispatching within one lease
+        # ttl of losing the role (router HA; the r11 epoch-guard idea)
+        self.fence = fence
         self.health_poll_ms = float(health_poll_ms)
         self.eject_after = int(eject_after)
         self.breaker_cooldown_ms = float(breaker_cooldown_ms)
@@ -308,6 +369,10 @@ class ReplicaRouter:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._reloading = False
+        # monotonic id source for scale-up slots: ids never recycle, so
+        # a drained-away "r2" and a later scale-up replica can never be
+        # confused in logs/metrics/provenance
+        self._next_id = len(self.replicas)
 
     # ------------------------------------------------------------ control
     def start(self, poll_now: bool = True) -> "ReplicaRouter":
@@ -547,6 +612,16 @@ class ReplicaRouter:
         ``X-Hedged``)."""
         if kind not in ("score", "generate"):
             raise BadRequest(f"unknown request kind {kind!r}")
+        if self.fence is not None and not self.fence.valid():
+            # fenced: we lost (or never held) the active-role lease —
+            # a zombie active must NOT keep dispatching while a standby
+            # serves the same fleet. 503 so clients re-resolve to the
+            # other endpoint (ServingClient rotates on Unavailable).
+            self.metrics.inc("fenced_total")
+            raise Unavailable(
+                "router fenced: not the active role holder (the lease "
+                "lapsed or a standby adopted the fleet); retry against "
+                "the other router endpoint", retry_after_ms=50.0)
         gen_opts = {"beam_size": beam_size, "max_length": max_length}
         t0 = time.perf_counter()
         tried: set = set()
@@ -737,19 +812,303 @@ class ReplicaRouter:
             with self._lock:
                 self._reloading = False
 
+    # ------------------------------------------------------ elastic fleet
+    def set_transport(self, replica_id: str, transport,
+                      state: str = WARMING) -> bool:
+        """Swap a replica slot's transport in place (the supervisor's
+        respawn push: it killed and relaunched the process, the slot
+        identity persists). Resets the slot's failure/breaker state —
+        the new process has no history. False when the slot is unknown
+        (the caller should ``add_replica`` instead)."""
+        with self._lock:
+            rep = next((r for r in self.replicas
+                        if r.id == str(replica_id)), None)
+            if rep is None:
+                return False
+            rep.transport = transport
+            rep.state = state
+            rep.consecutive_failures = 0
+            rep.poll_failures = 0
+            rep.breaker_cooldown_ms = None
+        return True
+
+    def add_replica(self, transport, replica_id: Optional[str] = None,
+                    state: str = WARMING) -> str:
+        """Grow the fleet by one slot (autoscale scale-up, standby
+        adoption). The new replica starts WARMING (or the given state)
+        and enters dispatch at the next health observation — callers
+        that need it routable NOW follow with ``poll_once()``. Returns
+        the slot id (monotonic, never recycled)."""
+        with self._lock:
+            rid = str(replica_id) if replica_id is not None \
+                else f"r{self._next_id}"
+            if any(r.id == rid for r in self.replicas):
+                raise ValueError(f"replica id {rid!r} already exists")
+            self._next_id += 1
+            rep = Replica(rid, transport)
+            rep.state = state
+            self.replicas.append(rep)
+        logger.info("router: replica %s added (fleet size %d)", rid,
+                    len(self.replicas))
+        return rid
+
+    def remove_replica(self, replica_id: str, drain: bool = True,
+                       timeout: float = 60.0):
+        """Shrink the fleet by one slot (autoscale scale-down): the
+        replica leaves the dispatch set IMMEDIATELY (state DRAINING
+        under the lock), then — outside the lock — drains via the
+        uniform ``begin_drain`` path so zero queued requests drop, and
+        is popped from the table. Returns the removed transport (the
+        caller owns reaping its process)."""
+        with self._lock:
+            rep = next((r for r in self.replicas if r.id == replica_id),
+                       None)
+            if rep is None:
+                raise KeyError(f"no replica {replica_id!r}")
+            rep.state = DRAINING
+        if drain:
+            try:
+                rep.transport.begin_drain()
+                rep.transport.drain_wait(timeout=timeout)
+            except Exception as e:  # noqa: BLE001 — best-effort drain
+                logger.warning("drain of removed replica %s failed: %r",
+                               replica_id, e)
+        with self._lock:
+            self.replicas = [r for r in self.replicas
+                             if r.id != replica_id]
+        logger.info("router: replica %s removed (fleet size %d)",
+                    replica_id, len(self.replicas))
+        return rep.transport
+
+    def adopt_replicas(self, pairs) -> List[str]:
+        """Replace the whole replica set — the standby's takeover path
+        (``RouterHA``). ``pairs`` = ``[(replica_id, transport), ...]``
+        mirrored from the dead active's last ``/healthz`` snapshot.
+        State is tiny by design: breakers and inflight counts
+        reconstruct from the ``poll_once()`` the caller issues next —
+        adoption is re-poll + re-arm, not state transfer."""
+        with self._lock:
+            self.replicas = []
+            self._rr = 0
+            for rid, t in pairs:
+                rep = Replica(str(rid), t)
+                self.replicas.append(rep)
+            if len({r.id for r in self.replicas}) != len(self.replicas):
+                raise ValueError("adopted replica ids must be unique")
+            self._next_id = max(self._next_id, len(self.replicas))
+        logger.info("router: adopted %d replica(s): %s",
+                    len(self.replicas),
+                    [r.id for r in self.replicas])
+        return [r.id for r in self.replicas]
+
+    def load_backlog_ms(self) -> Optional[float]:
+        """Fleet pressure signal for the autoscaler: the MEAN backlog
+        over routable replicas (capacity needs the average — the
+        fleet-min is the 429 retry hint's business, not sizing's).
+        None when no replica has reported health yet."""
+        with self._lock:
+            vals = [float(r.last_health["backlog_ms"])
+                    for r in self.replicas
+                    if r.state in (READY, WARMING)
+                    and r.last_health.get("backlog_ms") is not None]
+        return sum(vals) / len(vals) if vals else None
+
     # ------------------------------------------------------------- health
     def fleet_health(self) -> dict:
         with self._lock:
             reps = [r.snapshot() for r in self.replicas]
         ready = sum(1 for r in reps if r["state"] == READY)
+        fenced = self.fence is not None and not self.fence.valid()
         return {
-            "status": "ok" if ready else "unavailable",
-            "ready": ready > 0,
+            "status": ("fenced" if fenced
+                       else "ok" if ready else "unavailable"),
+            "ready": ready > 0 and not fenced,
             "live": True,
             "ready_replicas": ready,
             "replicas": reps,
             "reloading": self._reloading,
+            "role_held": (None if self.fence is None else not fenced),
+            "role_epoch": getattr(self.fence, "epoch", None),
         }
+
+
+class RouterHA:
+    """Active/standby controller for one :class:`ReplicaRouter` — the
+    warm-standby half of router HA.
+
+    Two router processes front one fleet; a :class:`~paddle_tpu.dist.
+    master.RoleLease` over a shared Store elects the ACTIVE. Each side
+    runs a ``RouterHA`` over its (fenced) router:
+
+    - **holding the role** — renew the lease every ``interval_ms``
+      (chaos site ``lease_renew``: a drop is a lost renewal — enough of
+      them and the lease lapses, the router's fence trips, and dispatch
+      stops within one ttl: the partitioned-zombie-active guarantee).
+    - **standing by** — poll the peer router's ``/healthz`` every
+      ``interval_ms``, mirroring its replica snapshot (ids + addrs).
+      The standby is WARM: its HTTP frontend is bound and answering
+      (503 ``Unavailable`` while fenced, which ``ServingClient``
+      rotates away from), so takeover needs no process start.
+    - **takeover** — after ``adopt_after`` consecutive failed peer
+      polls, ``try_acquire`` the role; the lease gates it (a live
+      active's renewals make acquisition impossible, so a standby that
+      merely cannot REACH the active cannot split-brain the fleet).
+      On winning: chaos site ``router_failover`` fires, the mirrored
+      replica set is adopted (default: one :class:`HTTPTransport` per
+      advertised addr; in-process fleets inject ``adopt``), and one
+      inline ``poll_once`` re-arms states/breakers — adoption is
+      re-poll + re-arm because router state is tiny by design.
+
+    ``step()`` runs one iteration inline (deterministic tests);
+    ``start()`` runs it on a daemon thread at ``interval_ms``.
+    """
+
+    def __init__(self, router: ReplicaRouter, lease, *,
+                 peer: Optional[Tuple[str, int]] = None,
+                 peer_healthz: Optional[Callable[[], dict]] = None,
+                 adopt: Optional[Callable[[List[dict]], List[Tuple[str, object]]]] = None,
+                 interval_ms: float = 100.0,
+                 adopt_after: int = 2):
+        if router.fence is None:
+            router.fence = lease
+        self.router = router
+        self.lease = lease
+        self.peer = peer
+        self._peer_healthz = peer_healthz
+        self._adopt_builder = adopt
+        self.interval_ms = float(interval_ms)
+        self.adopt_after = int(adopt_after)
+        self.peer_failures = 0
+        self.last_peer_snapshot: List[dict] = []
+        self.adoptions = 0
+        self.adopted_at: Optional[float] = None  # monotonic
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ----------------------------------------------------------- control
+    def start(self, take_role: bool = False) -> "RouterHA":
+        if take_role:
+            self.lease.try_acquire()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="router-ha", daemon=True)
+        self._thread.start()
+        return self
+
+    def shutdown(self, release: bool = True):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        if release and self.lease.valid():
+            try:
+                self.lease.release()
+            except Exception as e:  # noqa: BLE001 — best-effort
+                logger.warning("role release failed: %r", e)
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_ms / 1e3):
+            try:
+                self.step()
+            except Exception as e:  # noqa: BLE001 — the loop must live
+                logger.error("router HA step crashed: %r", e)
+
+    # -------------------------------------------------------------- duty
+    def step(self):
+        """One HA iteration: renew while active, watch + maybe adopt
+        while standing by."""
+        if self.lease.valid():
+            try:
+                renewed = self.lease.renew()
+            except ConnectionError as e:
+                # an injected lease_renew drop or a store hiccup: the
+                # renewal is LOST (validity keeps ticking down; enough
+                # losses and the fence trips) — never fatal here
+                logger.warning("active-role renewal lost: %r", e)
+                renewed = False
+            if not renewed and not self.lease.valid():
+                logger.warning(
+                    "router %s FENCED: lost the active role (epoch "
+                    "moved or lease lapsed); dispatch now refuses",
+                    self.lease.holder_id)
+            self.peer_failures = 0
+            return
+        # ------------------------------------------------ standby watch
+        try:
+            h = self._poll_peer()
+        except Exception as e:  # noqa: BLE001 — peer unreachable
+            self.peer_failures += 1
+            logger.debug("peer poll failed (%d/%d): %r",
+                         self.peer_failures, self.adopt_after, e)
+        else:
+            reps = h.get("replicas") or []
+            if reps:
+                self.last_peer_snapshot = reps
+            # a peer that answers but cannot serve (fenced, no ready
+            # replica, dead) counts as failed — but the LEASE decides:
+            # a healthy active's renewals make try_acquire impossible
+            self.peer_failures = (0 if h.get("ready")
+                                  else self.peer_failures + 1)
+        if self.peer_failures >= self.adopt_after \
+                and self.lease.try_acquire():
+            self._take_over()
+
+    def _poll_peer(self) -> dict:
+        if self._peer_healthz is not None:
+            return self._peer_healthz()
+        if self.peer is None:
+            raise RuntimeError("standby has no peer to watch (pass "
+                               "peer=(host, port) or peer_healthz=)")
+        import http.client
+        import json as _json
+        host, port = self.peer
+        conn = http.client.HTTPConnection(host, int(port), timeout=2.0)
+        try:
+            # a 503 body still carries the fleet snapshot — read it
+            # whatever the status (same contract as replica healthz)
+            conn.request("GET", "/healthz")
+            resp = conn.getresponse()
+            data = _json.loads(resp.read() or b"{}")
+            if not isinstance(data, dict) or "live" not in data:
+                raise ConnectionError(
+                    f"peer {host}:{port} healthz is not a health "
+                    f"payload (HTTP {resp.status})")
+            return data
+        finally:
+            conn.close()
+
+    def _take_over(self):
+        """Adopt the fleet: rebuild the replica set from the last peer
+        snapshot, re-arm via one poll, start answering."""
+        if _chaos._ACTIVE is not None:
+            _chaos._ACTIVE.hit("router_failover",
+                               holder=self.lease.holder_id,
+                               epoch=self.lease.epoch)
+        snaps = self.last_peer_snapshot
+        if self._adopt_builder is not None:
+            pairs = self._adopt_builder(snaps)
+        else:
+            pairs = []
+            for s in snaps:
+                addr = s.get("addr")
+                if not addr:
+                    logger.warning(
+                        "adoption: replica %s advertises no addr "
+                        "(in-process transport?); skipped",
+                        s.get("id"))
+                    continue
+                host, _, port = addr.rpartition(":")
+                pairs.append((s["id"], HTTPTransport(host, int(port))))
+        if pairs:
+            self.router.adopt_replicas(pairs)
+        self.router.poll_once()
+        self.adoptions += 1
+        self.adopted_at = time.monotonic()
+        self.peer_failures = 0
+        self.router.metrics.inc("adoptions_total")
+        logger.warning(
+            "router %s ADOPTED the fleet (epoch %d): %d replica(s), "
+            "%d ready", self.lease.holder_id, self.lease.epoch,
+            len(self.router.replicas),
+            self.router.fleet_health()["ready_replicas"])
 
 
 # ------------------------------------------------------------- HTTP tier

@@ -21,6 +21,13 @@ Endpoints:
 - ``GET /metrics``       — Prometheus text
   (``serving/metrics.py:to_prometheus``); ``/metrics?format=json`` for
   the structured snapshot.
+- ``POST /admin/drain``  — remote drain: admission closes immediately,
+  queued + in-flight work completes, the process stays up. The
+  Popen-less twin of the SIGTERM drain, so a replica the supervisor (or
+  an operator) launched on another host drains through the same path as
+  a local one; the response (and subsequent ``/healthz`` polls) carries
+  ``queue_depth`` / ``inflight`` so the caller knows when the drain is
+  dry.
 
 Error mapping is the typed contract (``serving/errors.py``): 400
 bad_request, 429 overloaded/shutting_down (with a ``Retry-After``
@@ -135,6 +142,18 @@ class _Handler(JSONHandler):
     def do_POST(self):
         engine = self.server.engine
         path = self.path.split("?", 1)[0]
+        if path == "/admin/drain":
+            # remote drain: close admission NOW, let queued + in-flight
+            # work finish; the process stays up (answering 429
+            # ShuttingDown and this health surface) so the caller — a
+            # replica supervisor, a rolling reload, an operator — can
+            # watch queue_depth+inflight hit zero before reaping it.
+            # This is the Popen-less twin of the SIGTERM drain: a
+            # supervisor-owned and an externally-launched replica drain
+            # through the SAME endpoint (HTTPTransport.begin_drain).
+            engine.begin_drain()
+            self._send(200, engine.health())
+            return
         kind = {"/v1/score": "score", "/v1/generate": "generate"}.get(path)
         if kind is None:
             self._send(404, {"error": {"code": "not_found",

@@ -1,0 +1,703 @@
+"""Replica supervisor + load-driven autoscaling: the operator, built in.
+
+The r13 router fully manages IN-PROCESS replicas but treats remote ones
+as externally scheduled — spawn and SIGTERM were the operator's job.
+This module is that operator as tested framework behavior, over the
+primitives the repo already chaos-proved:
+
+- **a replica is a task with a lease** — the supervisor monitors each
+  spawned single-replica server process through the SAME
+  :class:`~paddle_tpu.dist.master.LeaseTable` the master leases
+  trainers with. A successful ``/healthz`` probe renews the replica's
+  lease (chaos site ``lease_renew``: a dropped renewal ages the lease
+  exactly like a hung replica would). Lease expiry ⇒ SIGTERM, a grace
+  window, SIGKILL, and an UNCONDITIONAL reap before any respawn — two
+  live processes serving one replica id are impossible by construction
+  (the no-double-spawn invariant, asserted at the spawn site).
+- **kill-discrimination matrix** — a CRASHED replica (process exited)
+  is reaped and respawned immediately; a HUNG replica (process alive,
+  health probes failing) dies by lease expiry; a SLOW-BUT-HEARTBEATING
+  straggler keeps renewing and is NEVER killed — slowness is the
+  breaker/hedge plane's business (router), not the lifecycle plane's.
+- **warm respawns** — the spawn factory threads ``--aot_cache_dir``
+  through to every child, so a respawned replica deserializes its
+  bucket menu (ms) instead of re-tracing it (BENCH_r13: 58 ms vs
+  476 ms). Spawns fire the chaos site ``supervisor_spawn`` (a drop =
+  failed spawn, retried next sweep).
+- **uniform drain** — scale-down and shutdown drain through
+  ``POST /admin/drain`` (:meth:`HTTPTransport.begin_drain`), identical
+  for supervisor-owned and externally-launched replicas, then reap.
+
+:class:`Autoscaler` closes the loop on capacity: an EWMA of the
+fleet's backlog estimate (the same ``backlog_ms`` the 429
+``retry_after_ms`` hint is built from) crossing ``up_backlog_ms`` for a
+sustained window scales up; sustained idle below ``down_backlog_ms``
+scales down; a cooldown after every action plus the two separate
+sustain windows give the hysteresis that keeps flapping load from
+thrashing spawn/drain. Replica count stays inside
+``[min_replicas, max_replicas]`` unconditionally.
+
+Lock discipline (graftlint pass-3 scope): the supervisor lock guards
+replica-table / lease / event bookkeeping ONLY — process signals,
+transport probes, chaos hits, and metrics all happen outside it, so the
+supervisor adds no lock-order edges over the router/metrics graph. The
+autoscaler is single-writer (its own loop thread or a test driving
+:meth:`Autoscaler.observe`) and holds no lock at all.
+"""
+
+from __future__ import annotations
+
+import socket
+import subprocess
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from paddle_tpu.dist.master import LeaseTable
+from paddle_tpu.serving.metrics import RouterMetrics
+from paddle_tpu.serving.router import HTTPTransport
+from paddle_tpu.testing import chaos as _chaos
+from paddle_tpu.utils.log import get_logger
+
+logger = get_logger("serving.supervisor")
+
+
+def free_port(host: str = "127.0.0.1") -> int:
+    """An OS-assigned free TCP port (bind-0 probe). Racy by nature —
+    fine for spawn factories on one host; real deployments pass fixed
+    ports."""
+    s = socket.socket()
+    try:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+    finally:
+        s.close()
+
+
+class SupervisedReplica:
+    """Supervisor-side state for one replica process slot."""
+
+    def __init__(self, replica_id: str):
+        self.id = str(replica_id)
+        self.proc: Optional[subprocess.Popen] = None
+        self.transport: Optional[HTTPTransport] = None
+        self.respawns = 0
+        self.last_spawn_ms: Optional[float] = None
+        self.last_health: dict = {}
+        # lifecycle claim: exactly ONE thread (monitor sweep, scaler,
+        # shutdown) may run this slot's kill/spawn transition at a time
+        # — claimed under the supervisor lock, held across the (slow,
+        # unlocked) process work. THIS is what makes no-double-spawn
+        # hold between threads, not just within one.
+        self.busy = False
+        # boot tracking: a freshly (re)spawned process gets boot grace
+        # (it cannot renew until its server listens); `booted` flips at
+        # the first successful probe and normal lease aging takes over
+        self.booted = False
+        self.spawned_t: Optional[float] = None
+
+    def snapshot(self) -> dict:
+        return {"id": self.id,
+                "pid": (self.proc.pid if self.proc is not None
+                        and self.proc.poll() is None else None),
+                "respawns": self.respawns,
+                "last_spawn_ms": self.last_spawn_ms,
+                "addr": (f"{self.transport.host}:{self.transport.port}"
+                         if self.transport is not None else None)}
+
+
+class ReplicaSupervisor:
+    """Spawns, leases, kills, reaps, and respawns real single-replica
+    server processes behind :class:`HTTPTransport`. See the module
+    docstring for the lifecycle contract.
+
+    ``spawn(replica_id) -> (proc, host, port)`` is the process factory
+    (the CLI's builds ``python -m paddle_tpu.trainer.cli --job=serve``
+    children with the AOT cache dir threaded through; tests use stub
+    servers). ``attach_router`` connects a :class:`ReplicaRouter` so
+    respawns swap the fresh transport into the router's slot — the
+    router's OWN ``spawn`` factory must stay ``None`` in that wiring
+    (two spawners racing one replica id is exactly the double-spawn
+    this module exists to prevent).
+    """
+
+    def __init__(self, spawn: Callable[[str], Tuple[subprocess.Popen,
+                                                    str, int]], *,
+                 replicas: int = 1,
+                 lease_timeout_s: float = 3.0,
+                 poll_ms: float = 200.0,
+                 grace_s: float = 2.0,
+                 boot_grace_s: float = 600.0,
+                 healthz_timeout_s: Optional[float] = None,
+                 metrics: Optional[RouterMetrics] = None):
+        self.spawn = spawn
+        self.lease_timeout_s = float(lease_timeout_s)
+        self.poll_ms = float(poll_ms)
+        self.grace_s = float(grace_s)
+        # how long a (re)spawned process may take to answer its FIRST
+        # probe before it counts as hung — a child booting jax + the
+        # model cannot renew a lease yet, and killing it mid-boot would
+        # crash-loop forever (the lease ttl only governs replicas that
+        # have answered at least once since their spawn)
+        self.boot_grace_s = float(boot_grace_s)
+        self.healthz_timeout_s = (float(healthz_timeout_s)
+                                  if healthz_timeout_s is not None
+                                  else max(0.5, self.lease_timeout_s / 3))
+        self.metrics = metrics or RouterMetrics()
+        self.router = None
+        self._lock = threading.Lock()
+        self._replicas: Dict[str, SupervisedReplica] = {
+            f"r{i}": SupervisedReplica(f"r{i}")
+            for i in range(int(replicas))}
+        self._next_id = int(replicas)
+        self._leases = LeaseTable(self.lease_timeout_s)
+        # audit trail for tests/ops: (monotonic, kind, replica_id, info)
+        self.events: List[tuple] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ---------------------------------------------------------- plumbing
+    def _event(self, kind: str, rid: str, **info):
+        with self._lock:
+            self.events.append((time.monotonic(), kind, rid, info))
+
+    def _claim(self, rep: SupervisedReplica) -> bool:
+        """Claim a slot's lifecycle (kill/spawn) transition. False when
+        another thread holds it OR the slot left the table (a scaled-
+        away replica must never be respawned by a stale sweep
+        snapshot)."""
+        with self._lock:
+            if rep.busy or self._replicas.get(rep.id) is not rep:
+                return False
+            rep.busy = True
+            return True
+
+    def _release(self, rep: SupervisedReplica):
+        with self._lock:
+            rep.busy = False
+
+    def attach_router(self, router) -> "ReplicaSupervisor":
+        if router.spawn is not None:
+            raise ValueError(
+                "the router's own spawn factory must be None under a "
+                "supervisor: two independent spawners for one replica "
+                "id is the double-spawn hazard")
+        self.router = router
+        return self
+
+    # ----------------------------------------------------------- control
+    def start(self, wait_ready_s: Optional[float] = None
+              ) -> List[HTTPTransport]:
+        """Spawn every configured replica (failures retry on the
+        monitor sweep) and return the transports, in slot order, for
+        router construction. ``wait_ready_s`` blocks until each spawned
+        replica's ``/healthz`` turns ready (or the bound passes)."""
+        with self._lock:
+            reps = list(self._replicas.values())
+        for rep in reps:
+            if not self._claim(rep):
+                continue  # a (prematurely started) monitor got here
+            try:
+                self._respawn(rep, why="start")
+            finally:
+                self._release(rep)
+        if wait_ready_s:
+            self.wait_ready(wait_ready_s)
+        with self._lock:
+            return [r.transport for r in self._replicas.values()
+                    if r.transport is not None]
+
+    def wait_ready(self, timeout_s: float = 60.0) -> bool:
+        deadline = time.monotonic() + float(timeout_s)
+        while time.monotonic() < deadline:
+            with self._lock:
+                reps = list(self._replicas.values())
+            states = []
+            for rep in reps:
+                if rep.transport is None:
+                    states.append(False)
+                    continue
+                try:
+                    h = rep.transport.healthz()
+                except Exception:  # noqa: BLE001 — still booting
+                    states.append(False)
+                else:
+                    # ANY successful probe ends boot grace — from here
+                    # the lease ttl governs (a later hang must expire,
+                    # not ride the boot budget)
+                    rep.booted = True
+                    states.append(bool(h.get("ready")))
+            if states and all(states):
+                return True
+            time.sleep(0.05)
+        return False
+
+    def start_monitor(self) -> "ReplicaSupervisor":
+        self._thread = threading.Thread(target=self._monitor,
+                                        name="replica-supervisor",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def shutdown(self, drain: bool = True, timeout: float = 60.0):
+        """Stop the monitor, drain every replica through the uniform
+        ``/admin/drain`` path, then reap the processes."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        with self._lock:
+            reps = list(self._replicas.values())
+        for rep in reps:
+            if rep.transport is None:
+                continue
+            if drain:
+                try:
+                    rep.transport.begin_drain()
+                    rep.transport.drain_wait(timeout=timeout)
+                except Exception as e:  # noqa: BLE001 — best effort
+                    logger.warning("drain of %s failed: %r", rep.id, e)
+            self._kill(rep, escalate_only=not drain)
+
+    # ------------------------------------------------------------ monitor
+    def _monitor(self):
+        while not self._stop.wait(self.poll_ms / 1e3):
+            try:
+                self.poll_once()
+            except Exception as e:  # noqa: BLE001 — the loop must live
+                logger.error("supervisor sweep crashed: %r", e)
+
+    def poll_once(self):
+        """One supervision sweep: probe each replica (a live answer
+        renews its lease), respawn crashed/down slots, escalate-kill
+        and respawn expired leases. Callable inline for deterministic
+        tests. Every kill/spawn transition runs under the slot's
+        lifecycle CLAIM, so a concurrent scaler (scale_up's spawn in
+        flight, scale_down's retire) and this sweep can never both
+        transition one slot — the cross-thread half of the
+        no-double-spawn invariant."""
+        with self._lock:
+            reps = list(self._replicas.values())
+        for rep in reps:
+            if self._stop.is_set():
+                return
+            if rep.proc is not None and rep.proc.poll() is not None:
+                # CRASHED: the process exited on its own; poll() reaped
+                # it, so the no-double-spawn precondition already holds
+                if not self._claim(rep):
+                    continue  # scaled away / mid-transition elsewhere
+                try:
+                    self._event("crashed", rep.id,
+                                rc=rep.proc.returncode)
+                    with self._lock:
+                        self._leases.drop(rep.id)
+                    self._respawn(rep, why="crashed")
+                finally:
+                    self._release(rep)
+                continue
+            if rep.proc is None:
+                # a failed spawn left the slot down; retry
+                if not self._claim(rep):
+                    continue
+                try:
+                    self._respawn(rep, why="down")
+                finally:
+                    self._release(rep)
+                continue
+            try:
+                h = rep.transport.healthz()
+            except Exception:  # noqa: BLE001 — hung, or still booting
+                if not rep.booted and rep.spawned_t is not None \
+                        and (time.monotonic() - rep.spawned_t
+                             <= self.boot_grace_s):
+                    # boot grace: a child that has never answered yet
+                    # (jax import, model build) cannot renew — extend
+                    # its lease directly (no lease_renew chaos site:
+                    # this is not a heartbeat) until the first answer
+                    # or the boot budget runs out, else respawns
+                    # crash-loop on any boot longer than the ttl
+                    with self._lock:
+                        self._leases.renew(rep.id)
+                continue  # booted & silent: the lease ages to expiry
+            rep.last_health = h
+            if h.get("live", False):
+                # a SLOW answer still lands here: a straggler that
+                # heartbeats within the probe timeout renews and is
+                # never killed — slowness is the router's business
+                rep.booted = True
+                self._renew(rep)
+        with self._lock:
+            expired = self._leases.expired()
+        for rid in expired:
+            with self._lock:
+                rep = self._replicas.get(rid)
+            if rep is None:
+                continue  # scaled away while its lease aged
+            if not self._claim(rep):
+                continue
+            try:
+                self._event("lease_expired", rid)
+                logger.warning("supervisor: replica %s lease expired "
+                               "(hung or partitioned); escalating", rid)
+                self._kill(rep)
+                self._respawn(rep, why="lease expired")
+            finally:
+                self._release(rep)
+
+    def _renew(self, rep: SupervisedReplica):
+        if _chaos._ACTIVE is not None:
+            try:
+                _chaos._ACTIVE.hit("lease_renew", replica=rep.id,
+                                   role="replica")
+            except ConnectionError:  # an injected drop: renewal LOST
+                self.metrics.inc("lease_renew_lost_total")
+                self._event("lease_renew_lost", rep.id)
+                return
+        with self._lock:
+            self._leases.renew(rep.id)
+
+    # ---------------------------------------------------------- lifecycle
+    def _kill(self, rep: SupervisedReplica, escalate_only: bool = False):
+        """SIGTERM → ``grace_s`` → SIGKILL → reap. Returns only once
+        the process is REAPED (``poll()`` non-None): every respawn is
+        gated on this, which is what makes two live processes per
+        replica id impossible."""
+        proc = rep.proc
+        if proc is None or proc.poll() is not None:
+            return  # nothing running (never spawned, or already
+            # reaped): a "kill" of a dead process is not an event
+        escalated = False
+        if proc.poll() is None:
+            try:
+                proc.terminate()
+            except (ProcessLookupError, OSError):
+                pass
+            try:
+                proc.wait(timeout=self.grace_s)
+            except subprocess.TimeoutExpired:
+                escalated = True
+                try:
+                    proc.kill()
+                except (ProcessLookupError, OSError):
+                    pass
+                proc.wait()
+        if proc.poll() is None:  # pragma: no cover — SIGKILL is final
+            raise RuntimeError(
+                f"replica {rep.id} survived SIGKILL (pid {proc.pid})")
+        self._event("killed", rep.id, pid=proc.pid,
+                    escalated=escalated)
+        self.metrics.inc("replica_kills_total")
+        if not escalate_only:
+            logger.warning("supervisor: replica %s pid %d killed "
+                           "(%s)", rep.id, proc.pid,
+                           "SIGKILL after grace" if escalated
+                           else "SIGTERM")
+
+    def _respawn(self, rep: SupervisedReplica, why: str):
+        """Spawn (or re-spawn) a replica slot's process. A spawn
+        failure (including an injected ``supervisor_spawn`` drop)
+        leaves the slot down; the next sweep retries."""
+        if rep.proc is not None and rep.proc.poll() is None:
+            # the no-double-spawn invariant, enforced at the spawn
+            # site itself: whatever path got here with a live process
+            # must kill+reap first
+            self._kill(rep)
+        try:
+            if _chaos._ACTIVE is not None:
+                _chaos._ACTIVE.hit("supervisor_spawn", replica=rep.id,
+                                   why=why)
+            t0 = time.perf_counter()
+            proc, host, port = self.spawn(rep.id)
+            spawn_ms = 1e3 * (time.perf_counter() - t0)
+        except Exception as e:  # noqa: BLE001 — retry next sweep
+            with self._lock:
+                rep.proc = None
+            self._event("spawn_failed", rep.id, error=repr(e))
+            logger.warning("supervisor: spawn of %s failed (%r); will "
+                           "retry", rep.id, e)
+            return
+        transport = HTTPTransport(
+            host, port, proc=proc,
+            healthz_timeout=self.healthz_timeout_s)
+        with self._lock:
+            rep.proc = proc
+            rep.transport = transport
+            rep.respawns += 1
+            rep.last_spawn_ms = spawn_ms
+            rep.spawned_t = time.monotonic()
+            rep.booted = False  # boot grace until the first answer
+            # a fresh process gets a fresh lease grace period
+            self._leases.renew(rep.id)
+        self._event("spawned", rep.id, pid=proc.pid, why=why,
+                    spawn_ms=round(spawn_ms, 1))
+        self.metrics.inc("respawns_total")
+        if self.router is not None:
+            if not self.router.set_transport(rep.id, transport):
+                self.router.add_replica(transport, rep.id)
+        logger.info("supervisor: replica %s spawned (pid %d, %s, "
+                    "%.1f ms)", rep.id, proc.pid, why, spawn_ms)
+
+    # ------------------------------------------------------ scale target
+    def replica_count(self) -> int:
+        with self._lock:
+            return len(self._replicas)
+
+    def scale_up(self) -> bool:
+        """Grow the fleet by one supervised replica (warm via the AOT
+        cache the spawn factory threads through). The new slot is BORN
+        CLAIMED, so the monitor sweep cannot see its momentary
+        proc-is-None state and race a second spawn into it."""
+        with self._lock:
+            rid = f"r{self._next_id}"
+            self._next_id += 1
+            rep = SupervisedReplica(rid)
+            rep.busy = True  # born claimed: released after the spawn
+            self._replicas[rid] = rep
+        try:
+            self._respawn(rep, why="scale-up")
+        finally:
+            self._release(rep)
+        if rep.proc is None:  # spawn failed; drop the slot
+            with self._lock:
+                self._replicas.pop(rid, None)
+                self._leases.drop(rid)
+            return False
+        self.metrics.inc("scale_up_total")
+        self._event("scale_up", rid)
+        return True
+
+    def scale_down(self) -> bool:
+        """Retire the newest replica: claim its lifecycle (waiting out
+        a sweep mid-transition on it), pop it from the table — from
+        here no stale sweep snapshot can respawn it (`_claim` checks
+        membership) — then out of dispatch immediately, drained
+        through ``/admin/drain`` (zero queued drops), and reaped."""
+        with self._lock:
+            if not self._replicas:
+                return False
+            rid, rep = next(reversed(self._replicas.items()))
+        deadline = time.monotonic() + 30.0
+        while not self._claim(rep):
+            with self._lock:
+                if self._replicas.get(rid) is not rep:
+                    return False  # someone else retired it meanwhile
+            if time.monotonic() > deadline:
+                logger.warning("scale-down of %s timed out waiting for "
+                               "its lifecycle claim", rid)
+                return False
+            time.sleep(0.02)
+        try:
+            with self._lock:
+                self._replicas.pop(rid, None)
+                self._leases.drop(rid)
+            if self.router is not None:
+                try:
+                    self.router.remove_replica(rid, drain=True)
+                except KeyError:
+                    pass
+            elif rep.transport is not None:
+                try:
+                    rep.transport.begin_drain()
+                    rep.transport.drain_wait()
+                except Exception as e:  # noqa: BLE001 — best effort
+                    logger.warning("scale-down drain of %s failed: %r",
+                                   rid, e)
+            self._kill(rep, escalate_only=True)
+        finally:
+            self._release(rep)
+        self.metrics.inc("scale_down_total")
+        self._event("scale_down", rid)
+        return True
+
+    def load_backlog_ms(self) -> Optional[float]:
+        if self.router is not None:
+            return self.router.load_backlog_ms()
+        with self._lock:
+            vals = [float(r.last_health["backlog_ms"])
+                    for r in self._replicas.values()
+                    if r.last_health.get("backlog_ms") is not None]
+        return sum(vals) / len(vals) if vals else None
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"replicas": [r.snapshot()
+                                 for r in self._replicas.values()],
+                    "leased": self._leases.holders()}
+
+
+class InProcessFleet:
+    """Autoscaler target over a router of in-process
+    :class:`EngineTransport` replicas (bench + tests) — the
+    process-backed twin is :class:`ReplicaSupervisor`. ``build``
+    returns a started transport (an EngineTransport over an engine
+    warmed from the shared AOT cache, so scale-up is warm here too)."""
+
+    def __init__(self, router, build: Callable[[], object]):
+        self.router = router
+        self.build = build
+
+    def replica_count(self) -> int:
+        # lock-free snapshot read (CPython list ops are atomic; a
+        # momentarily stale count only delays one policy tick)
+        return len(self.router.replicas)
+
+    def scale_up(self) -> bool:
+        rid = self.router.add_replica(self.build())
+        self.router.poll_once()  # routable NOW, not at the next sweep
+        self.router.metrics.inc("scale_up_total")
+        logger.info("in-process fleet: scaled up (+%s)", rid)
+        return True
+
+    def scale_down(self) -> bool:
+        reps = list(self.router.replicas)
+        if not reps:
+            return False
+        rid = reps[-1].id
+        self.router.remove_replica(rid, drain=True)
+        self.router.metrics.inc("scale_down_total")
+        logger.info("in-process fleet: scaled down (-%s)", rid)
+        return True
+
+    def load_backlog_ms(self) -> Optional[float]:
+        return self.router.load_backlog_ms()
+
+
+class Autoscaler:
+    """Metrics-driven elastic capacity with hysteresis.
+
+    Policy (see the module docstring): EWMA of the fleet backlog
+    estimate above ``up_backlog_ms`` for ``sustain_up_s`` ⇒ scale up;
+    below ``down_backlog_ms`` for ``sustain_down_s`` ⇒ scale down;
+    ``cooldown_s`` of quiet after every action; count clamped to
+    ``[min_replicas, max_replicas]`` (bound repair runs even when the
+    load signal is absent). The up/down thresholds are deliberately far
+    apart and the sustain windows separate — THAT is the hysteresis
+    that keeps flapping load from thrashing spawn/drain.
+
+    Single-writer: state is touched only by the loop thread (or a test
+    driving :meth:`observe` inline with an explicit clock), so there is
+    no lock to order against the router/supervisor graph.
+    """
+
+    def __init__(self, target, *, min_replicas: int = 1,
+                 max_replicas: int = 4,
+                 up_backlog_ms: float = 50.0,
+                 down_backlog_ms: float = 5.0,
+                 sustain_up_s: float = 0.5,
+                 sustain_down_s: float = 2.0,
+                 cooldown_s: float = 1.0,
+                 poll_ms: float = 100.0,
+                 ewma_alpha: float = 0.3):
+        if not (0 < min_replicas <= max_replicas):
+            raise ValueError("need 0 < min_replicas <= max_replicas")
+        if down_backlog_ms >= up_backlog_ms:
+            raise ValueError("down_backlog_ms must sit BELOW "
+                             "up_backlog_ms (the hysteresis band)")
+        self.target = target
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        self.up_backlog_ms = float(up_backlog_ms)
+        self.down_backlog_ms = float(down_backlog_ms)
+        self.sustain_up_s = float(sustain_up_s)
+        self.sustain_down_s = float(sustain_down_s)
+        self.cooldown_s = float(cooldown_s)
+        self.poll_ms = float(poll_ms)
+        self.ewma_alpha = float(ewma_alpha)
+        self.ewma: Optional[float] = None
+        self._above_since: Optional[float] = None
+        self._below_since: Optional[float] = None
+        self._last_action_t: Optional[float] = None
+        self._t0: Optional[float] = None
+        # [(seconds-since-start, replica_count)] — recorded at start
+        # and after every change: the bench's replica-count trajectory
+        self.trajectory: List[Tuple[float, int]] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ----------------------------------------------------------- control
+    def start(self) -> "Autoscaler":
+        self._thread = threading.Thread(target=self._loop,
+                                        name="autoscaler", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def _loop(self):
+        while not self._stop.wait(self.poll_ms / 1e3):
+            try:
+                self.observe()
+            except Exception as e:  # noqa: BLE001 — the loop must live
+                logger.error("autoscaler tick crashed: %r", e)
+
+    # ------------------------------------------------------------ policy
+    def _record(self, now: float, n: int):
+        if self._t0 is None:
+            self._t0 = now
+        self.trajectory.append((round(now - self._t0, 3), n))
+
+    def _cooling(self, now: float) -> bool:
+        return (self._last_action_t is not None
+                and now - self._last_action_t < self.cooldown_s)
+
+    def observe(self, backlog_ms: Optional[float] = None,
+                now: Optional[float] = None):
+        """One policy tick. ``backlog_ms``/``now`` injectable so tests
+        drive the hysteresis deterministically."""
+        now = time.monotonic() if now is None else now
+        n = self.target.replica_count()
+        if not self.trajectory:
+            self._record(now, n)
+        # bound repair first: min/max hold even with no load signal
+        if n < self.min_replicas:
+            if self.target.scale_up():
+                self._last_action_t = now
+                self._record(now, self.target.replica_count())
+            return
+        if n > self.max_replicas:
+            if self.target.scale_down():
+                self._last_action_t = now
+                self._record(now, self.target.replica_count())
+            return
+        if backlog_ms is None:
+            backlog_ms = self.target.load_backlog_ms()
+        if backlog_ms is None:
+            return  # no health observation yet — no policy, no clocks
+        self.ewma = (float(backlog_ms) if self.ewma is None
+                     else self.ewma_alpha * float(backlog_ms)
+                     + (1 - self.ewma_alpha) * self.ewma)
+        if self.ewma > self.up_backlog_ms:
+            self._below_since = None
+            if self._above_since is None:
+                self._above_since = now
+            if (now - self._above_since >= self.sustain_up_s
+                    and not self._cooling(now) and n < self.max_replicas):
+                if self.target.scale_up():
+                    self._last_action_t = now
+                    self._above_since = None
+                    self._record(now, self.target.replica_count())
+                    logger.info(
+                        "autoscaler: scale UP (ewma backlog %.1f ms > "
+                        "%.1f ms sustained)", self.ewma,
+                        self.up_backlog_ms)
+        elif self.ewma < self.down_backlog_ms:
+            self._above_since = None
+            if self._below_since is None:
+                self._below_since = now
+            if (now - self._below_since >= self.sustain_down_s
+                    and not self._cooling(now) and n > self.min_replicas):
+                if self.target.scale_down():
+                    self._last_action_t = now
+                    self._below_since = None
+                    self._record(now, self.target.replica_count())
+                    logger.info(
+                        "autoscaler: scale DOWN (ewma backlog %.1f ms "
+                        "< %.1f ms sustained)", self.ewma,
+                        self.down_backlog_ms)
+        else:
+            # inside the hysteresis band: both sustain clocks reset —
+            # a flap back into the band forfeits its progress
+            self._above_since = None
+            self._below_since = None

@@ -54,6 +54,21 @@ Sites wired in this codebase:
 ``replica_spawn`` the router is about to respawn a dead replica (info:
                 ``replica``); ``drop`` fails the spawn attempt (retried
                 next health sweep), ``delay`` models a slow cold start
+``supervisor_spawn`` the replica supervisor is about to spawn/respawn a
+                replica PROCESS (info: ``replica``, ``why``); ``drop``
+                fails the spawn (the slot stays down, retried next
+                sweep), ``delay`` models a slow exec/cold start
+``lease_renew`` a lease renewal is about to be recorded — the replica
+                supervisor renewing a replica's liveness lease after a
+                live health probe (info: ``replica``), or a router
+                renewing its active-role lease (info: ``holder``,
+                ``role``). A ``drop`` is a LOST renewal: enough of them
+                and the lease expires exactly as if the holder hung —
+                the supervisor's kill/respawn (no-double-spawn) path
+                and the router's self-fencing path both run
+``router_failover`` a standby router won the active-role lease and is
+                about to adopt the fleet (info: ``holder``, ``epoch``);
+                ``delay`` models a slow takeover
 ==============  ========================================================
 
 Fault types: ``kill`` (``mode`` ``"exit"`` = ``os._exit(exit_code)``,
@@ -69,7 +84,12 @@ Triggers (combinable; all compare against the per-site hit counter,
 which starts at 1): ``at`` (exactly the Nth hit), ``after``+``count``
 (a window), ``every`` (every Nth hit), ``rate`` (seeded Bernoulli per
 hit — deterministic in (seed, fault-index, hit-count), independent of
-thread interleaving).
+thread interleaving), ``match`` (a dict compared against the hit's
+``info`` kwargs — e.g. ``{"match": {"holder": "A"}}`` partitions ONE
+router's lease renewals while its standby's sail through, or
+``{"match": {"replica": "r0"}}`` targets one replica's faults; a key
+the site does not report never matches). ``match`` filters which hits
+a fault CAN fire on; the per-site hit counter still counts every hit.
 """
 
 from __future__ import annotations
@@ -170,7 +190,7 @@ class FaultPlan:
         return random.Random(f"{self.seed}:{idx}:{n}").random() < rate
 
     def _matches(self, idx: int, fault: Dict[str, Any], site: str,
-                 n: int) -> bool:
+                 n: int, info: Optional[Dict[str, Any]] = None) -> bool:
         # triggers are combinable (conjunction): every trigger present
         # must agree, so {"after": 10, "rate": 0.3} is a seeded coin
         # flip on hits 11.. — not "after wins, rate ignored". The empty
@@ -178,6 +198,15 @@ class FaultPlan:
         # every hit ("drop every send"), it is not silently inert.
         if fault.get("site") != site:
             return False
+        m = fault.get("match")
+        if m:
+            # info-scoped targeting: every match key must equal the
+            # hit's reported info (string-compared — plans arrive as
+            # JSON); a key the site never reports can never match
+            if any((info or {}).get(k) is None
+                   or str((info or {}).get(k)) != str(v)
+                   for k, v in m.items()):
+                return False
         if "at" in fault and n != int(fault["at"]):
             return False
         if "after" in fault:
@@ -199,7 +228,7 @@ class FaultPlan:
             n = self._hits.get(site, 0) + 1
             self._hits[site] = n
             due = [(i, f) for i, f in enumerate(self.faults)
-                   if self._matches(i, f, site, n)]
+                   if self._matches(i, f, site, n, info)]
             for _, f in due:
                 self.log.append((site, n, f["type"]))
         for _, f in due:

@@ -38,7 +38,7 @@ def parse_args(argv=None):
                    help="Python model-config file (executed)")
     p.add_argument("--job", default="train",
                    choices=["train", "test", "time", "checkgrad", "merge",
-                            "serve"])
+                            "serve", "serve_fleet"])
     p.add_argument("--config_args", default="",
                    help="comma-separated k=v injected into the config")
     p.add_argument("--num_passes", type=int, default=1)
@@ -198,6 +198,42 @@ def parse_args(argv=None):
                         "second attempt for an unanswered idempotent "
                         "score request after this many ms (never for "
                         "generate); 0 = hedging off")
+    # --job=serve_fleet (serving/supervisor.py): the self-operating
+    # fleet — supervisor-spawned single-replica server PROCESSES behind
+    # the router, load-driven autoscaling, router HA via a warm standby
+    p.add_argument("--min_replicas", type=int, default=1,
+                   help="--job=serve_fleet: autoscale floor (the "
+                        "supervisor spawns this many replica processes "
+                        "at start)")
+    p.add_argument("--max_replicas", type=int, default=None,
+                   help="--job=serve_fleet: autoscale ceiling (default: "
+                        "min_replicas — autoscaling pinned off)")
+    p.add_argument("--standby", action="store_true",
+                   help="--job=serve_fleet: run this router as the WARM "
+                        "STANDBY — frontend bound and answering (503 "
+                        "until adoption), watching --peer's /healthz; "
+                        "on the active's death it takes the role lease "
+                        "and adopts the replica set")
+    p.add_argument("--peer", default=None,
+                   help="--job=serve_fleet --standby: host:port of the "
+                        "active router frontend to watch")
+    p.add_argument("--fleet_lease", default=None,
+                   help="--job=serve_fleet: path of the active-role "
+                        "lease file BOTH routers share (FileStore; the "
+                        "epoch-fenced election record). Required when a "
+                        "--standby is deployed")
+    p.add_argument("--lease_timeout_s", type=float, default=5.0,
+                   help="--job=serve_fleet: replica liveness lease — a "
+                        "replica whose health probes stop renewing for "
+                        "this long is SIGTERM/SIGKILLed and respawned; "
+                        "also the active-role lease ttl")
+    p.add_argument("--autoscale_up_backlog_ms", type=float, default=50.0,
+                   help="--job=serve_fleet: EWMA fleet backlog above "
+                        "this (sustained) scales up")
+    p.add_argument("--autoscale_down_backlog_ms", type=float,
+                   default=5.0,
+                   help="--job=serve_fleet: EWMA fleet backlog below "
+                        "this (sustained) scales down")
     return p.parse_args(argv)
 
 
@@ -698,6 +734,119 @@ def build_serving_fleet(ns, args):
     return router, reload_builder
 
 
+def _replica_cmd(args, port):
+    """The child command line for one supervised single-replica server:
+    the parent's serving config re-spelled as ``--job=serve`` on its own
+    port, with ``--aot_cache_dir`` threaded through so every respawn
+    deserializes its bucket menu instead of re-tracing it."""
+    cmd = [sys.executable, "-m", "paddle_tpu.trainer.cli",
+           "--config", args.config, "--job", "serve",
+           "--host", args.host, "--port", str(port),
+           "--batch_timeout_ms", str(args.batch_timeout_ms),
+           "--max_batch", str(args.max_batch),
+           "--queue_depth", str(args.queue_depth),
+           "--serving_length_buckets", str(args.serving_length_buckets)]
+    if args.config_args:
+        cmd += ["--config_args", args.config_args]
+    if args.shed_watermark:
+        cmd += ["--shed_watermark", str(args.shed_watermark)]
+    if args.serving_deadline_ms:
+        cmd += ["--serving_deadline_ms", str(args.serving_deadline_ms)]
+    if args.decode_chunk is not None:
+        cmd += ["--decode_chunk", str(args.decode_chunk)]
+    if args.serving_continuous_batching:
+        cmd += ["--serving_continuous_batching"]
+    if args.aot_cache_dir:
+        cmd += ["--aot_cache_dir", args.aot_cache_dir]
+    if args.init_model_path:
+        cmd += ["--init_model_path", args.init_model_path]
+    elif args.save_dir:
+        cmd += ["--save_dir", args.save_dir]
+    return cmd
+
+
+def cmd_serve_fleet(ns, args):
+    """``--job=serve_fleet``: the self-operating fleet. The supervisor
+    spawns ``--min_replicas`` real single-replica server processes
+    (``--job=serve`` children) and leases their liveness; the router
+    fronts them over HTTPTransports; the autoscaler moves the count
+    inside ``[--min_replicas, --max_replicas]`` on the EWMA backlog
+    signal. With ``--fleet_lease`` the router is role-fenced;
+    ``--standby`` runs the warm-standby side instead (bound frontend,
+    watching ``--peer``, adopting the fleet on the active's death)."""
+    import subprocess
+
+    from paddle_tpu.dist.master import FileStore, RoleLease
+    from paddle_tpu.serving import (Autoscaler, ReplicaRouter,
+                                    ReplicaSupervisor, RouterHA,
+                                    serve_router_forever)
+    from paddle_tpu.serving.supervisor import free_port
+
+    min_r = max(1, args.min_replicas)
+    max_r = args.max_replicas if args.max_replicas else min_r
+    lease = None
+    if args.fleet_lease:
+        holder = f"{'standby' if args.standby else 'active'}-{os.getpid()}"
+        lease = RoleLease(FileStore(args.fleet_lease), holder,
+                          ttl_s=args.lease_timeout_s)
+    elif args.standby:
+        raise SystemExit("--standby needs --fleet_lease (the shared "
+                         "role-election record both routers read)")
+
+    if args.standby:
+        if not args.peer:
+            raise SystemExit("--standby needs --peer host:port (the "
+                             "active router frontend to watch)")
+        host, _, port = str(args.peer).rpartition(":")
+        router = ReplicaRouter([], fence=lease)
+        ha = RouterHA(router, lease,
+                      peer=(host or "127.0.0.1", int(port)),
+                      interval_ms=max(100.0,
+                                      args.lease_timeout_s * 1e3 / 4))
+        ha.start()
+        try:
+            return serve_router_forever(router, host=args.host,
+                                        port=args.port)
+        finally:
+            ha.shutdown()
+
+    def spawn(replica_id):
+        port = free_port(args.host)
+        proc = subprocess.Popen(_replica_cmd(args, port))
+        return proc, args.host, port
+
+    supervisor = ReplicaSupervisor(
+        spawn, replicas=min_r, lease_timeout_s=args.lease_timeout_s,
+        poll_ms=max(100.0, args.lease_timeout_s * 1e3 / 4))
+    transports = supervisor.start(wait_ready_s=600.0)
+    router = ReplicaRouter(transports, spawn=None, fence=lease,
+                           hedge_ms=(args.hedge_ms or None),
+                           metrics=supervisor.metrics)
+    supervisor.attach_router(router)
+    supervisor.start_monitor()
+    ha = None
+    if lease is not None:
+        ha = RouterHA(router, lease,
+                      interval_ms=max(100.0,
+                                      args.lease_timeout_s * 1e3 / 4))
+        ha.start(take_role=True)
+    scaler = None
+    if max_r > min_r:
+        scaler = Autoscaler(
+            supervisor, min_replicas=min_r, max_replicas=max_r,
+            up_backlog_ms=args.autoscale_up_backlog_ms,
+            down_backlog_ms=args.autoscale_down_backlog_ms).start()
+    try:
+        return serve_router_forever(router, host=args.host,
+                                    port=args.port)
+    finally:
+        if scaler is not None:
+            scaler.stop()
+        if ha is not None:
+            ha.shutdown()
+        supervisor.shutdown(drain=True)
+
+
 def cmd_serve(ns, args):
     if getattr(args, "replicas", 1) > 1:
         from paddle_tpu.serving import serve_router_forever
@@ -722,7 +871,8 @@ def main(argv=None):
     ns = load_config(args.config, args.config_args)
     return {"train": cmd_train, "test": cmd_test, "time": cmd_time,
             "checkgrad": cmd_checkgrad, "merge": cmd_merge,
-            "serve": cmd_serve}[args.job](ns, args)
+            "serve": cmd_serve,
+            "serve_fleet": cmd_serve_fleet}[args.job](ns, args)
 
 
 if __name__ == "__main__":

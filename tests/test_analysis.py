@@ -991,6 +991,50 @@ def test_pt401_fleet_artifact_requires_failover_evidence(tmp_path):
     assert check_bench_file(r13, "BENCH_r13.json") == []
 
 
+def test_pt401_autoscale_artifact_requires_trajectory_evidence(tmp_path):
+    """The r14 self-operating-fleet generation: a serving_fleet_autoscale
+    artifact must carry the replica-count trajectory, the ramp p99, and
+    the zero-failed counter summed across rounds — an autoscale claim
+    without the count actually following load is not evidence. The base
+    serving_fleet keys are still required (it IS a fleet artifact)."""
+    base = {
+        "metric": "serving_fleet_autoscale_ha_failover",
+        "platform": "cpu",
+        "cold_start_live_ms": 500.0, "cold_start_cache_ms": 25.0,
+        "cold_start_live_vs_cache": 20.0,
+        "fleet_p99_ms": 8.0, "fleet_failovers_total": 1,
+        "fleet_failed_non_shed": 0}
+    good = tmp_path / "BENCH_auto.json"
+    good.write_text(json.dumps(dict(
+        base, autoscale_replica_trajectory=[1, 2, 3, 3, 2, 1],
+        autoscale_p99_ms=40.0)))
+    assert check_bench_file(str(good), "BENCH_auto.json") == []
+
+    # a trajectory that is not a list of counts, and a missing p99
+    bad = tmp_path / "BENCH_auto_bad.json"
+    bad.write_text(json.dumps(dict(
+        base, autoscale_replica_trajectory="1->3->1")))
+    fs = check_bench_file(str(bad), "BENCH_auto_bad.json")
+    assert any("autoscale_replica_trajectory" in f.message for f in fs)
+    assert any("autoscale_p99_ms" in f.message for f in fs)
+
+    # an r13-generation metric stays exempt from the autoscale keys
+    old = tmp_path / "BENCH_old.json"
+    old.write_text(json.dumps(dict(
+        base, metric="serving_fleet_failover_and_aot_cold_start")))
+    assert check_bench_file(str(old), "BENCH_old.json") == []
+
+    # the committed r14 artifact itself carries the evidence
+    import os as _os
+    root = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    r14 = _os.path.join(root, "BENCH_r14.json")
+    assert check_bench_file(r14, "BENCH_r14.json") == []
+    data = json.loads(open(r14).read())
+    traj = data["autoscale_replica_trajectory"]
+    assert data["fleet_failed_non_shed"] == 0
+    assert min(traj) >= 1 and max(traj) > min(traj)
+
+
 # ----------------------------------------------------------- baseline
 def test_baseline_parse_apply_and_stale(tmp_path):
     bl = tmp_path / "baseline.toml"

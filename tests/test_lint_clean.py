@@ -33,16 +33,26 @@ def test_pass3_lock_order_clean_and_covers_threaded_modules():
         findings, "Pass 3 (lock-order) found violations:")
     for mod in ("paddle_tpu/serving/batcher.py",
                 "paddle_tpu/serving/router.py",
+                "paddle_tpu/serving/supervisor.py",
                 "paddle_tpu/dist/master.py",
                 "paddle_tpu/dist/checkpoint.py",
                 "paddle_tpu/trainer/checkpoint.py",
                 "paddle_tpu/data/prefetch.py"):
         assert mod in checker.modules
     # the analysis is not vacuous: it found the repo's locks (incl. the
-    # replica router's state lock and RouterMetrics) and real
-    # held-while-acquiring edges (engine->metrics, master->store/chaos)
-    assert len(checker.locks) >= 10
+    # replica router's state lock, RouterMetrics, and the r14 replica
+    # supervisor's bookkeeping lock — exactly ONE new lock, no new
+    # edges: the supervisor calls no transport/chaos/metrics code while
+    # holding it) and real held-while-acquiring edges
+    # (engine->metrics, master->store/chaos)
+    assert len(checker.locks) >= 11
     assert len(checker.edges) >= 3
+    sup_locks = [l for l in checker.locks if "supervisor" in str(l)]
+    assert sup_locks == [
+        "paddle_tpu.serving.supervisor.ReplicaSupervisor._lock"]
+    assert not any("supervisor" in str(a) or "supervisor" in str(b)
+                   for a, b in checker.edges), (
+        "the supervisor lock must stay edge-free (bookkeeping only)")
 
 
 def test_bench_schema_clean():

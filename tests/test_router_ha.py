@@ -1,0 +1,471 @@
+"""Router HA + load-driven autoscaling: the self-operating fleet tier.
+
+The r14 acceptance spine: a warm standby router adopts the replica set
+when the active dies (state reconstructs from health polls — adoption
+is re-poll + re-arm), the role lease's epoch guard provably FENCES a
+partitioned old active (it stops dispatching within one ttl, and its
+renewals are refused forever after the takeover), clients re-resolve
+across the router endpoints with provenance, and the autoscaler moves
+real replica capacity up and down with hysteresis inside
+``[min, max]``. The slow+chaos soak at the bottom kills the ACTIVE
+router mid-open-loop-load, twice from one seed: the standby answers
+within one health interval of the lease lapsing and not one non-shed
+request fails, with the fault log reproducing bitwise.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from paddle_tpu.config import dsl
+from paddle_tpu.core.network import Network
+from paddle_tpu.data import dense_vector, integer_value
+from paddle_tpu.dist.master import InMemStore, RoleLease
+from paddle_tpu.serving import (Autoscaler, EngineTransport,
+                                InProcessFleet, Overloaded,
+                                ReplicaRouter, RouterHA, ServingClient,
+                                ServingEngine, ServingError,
+                                ServingPredictor, Unavailable,
+                                make_router_server)
+from paddle_tpu.testing import chaos
+
+DIM, CLASSES = 8, 4
+SAMPLE = ((np.arange(DIM, dtype=float) / DIM).tolist(), 1)
+
+
+@pytest.fixture(scope="module")
+def engines(tmp_path_factory):
+    """Two warmed in-process replica engines over a shared AOT cache
+    (module-scoped: the 1-core host cannot afford per-test warmup).
+    Tests build ROUTERS over these per test; none may drain them."""
+    cache_dir = str(tmp_path_factory.mktemp("aot"))
+    dsl.reset()
+    x = dsl.data(name="x", size=DIM)
+    lab = dsl.data(name="label", size=CLASSES)
+    out = dsl.fc(input=x, size=CLASSES, act="softmax", name="out")
+    dsl.classification_cost(input=out, label=lab, name="cost")
+    graph = dsl.current_graph()
+    params = Network(graph, outputs=["out"]).init_params(
+        jax.random.PRNGKey(0))
+    feeding = {"x": dense_vector(DIM), "label": integer_value(CLASSES)}
+
+    def build_engine():
+        pred = ServingPredictor(graph, params, ["out"], feeding,
+                                batch_buckets=[1, 2],
+                                aot_cache=cache_dir)
+        return ServingEngine(pred, max_batch=2, batch_timeout_ms=1.0,
+                             queue_depth=64).start(warmup=True)
+
+    engs = [build_engine() for _ in range(2)]
+    yield {"engines": engs, "build_engine": build_engine}
+    for e in engs:
+        e.shutdown(drain=False)
+
+
+def _ha_pair(engines, ttl_s=0.4):
+    """An ACTIVE router (holding the role) and a WARM STANDBY (empty,
+    fenced, mirroring the active via an injected peer_healthz) over one
+    shared role-lease store. Deterministic: no background threads —
+    tests drive RouterHA.step() and poll_once() inline."""
+    store = InMemStore()
+    lease_a = RoleLease(store, "A", ttl_s=ttl_s, settle_s=0.0)
+    lease_b = RoleLease(store, "B", ttl_s=ttl_s, settle_s=0.0)
+    active = ReplicaRouter([EngineTransport(e)
+                            for e in engines["engines"]],
+                           fence=lease_a)
+    active.poll_once()
+    peer_alive = {"up": True}
+
+    def peer_healthz():
+        if not peer_alive["up"]:
+            raise ConnectionError("active router is dead")
+        return active.fleet_health()
+
+    by_id = {f"r{i}": e for i, e in enumerate(engines["engines"])}
+
+    def adopt(snaps):
+        return [(s["id"], EngineTransport(by_id[s["id"]]))
+                for s in snaps if s["id"] in by_id]
+
+    standby = ReplicaRouter([], fence=lease_b)
+    ha_a = RouterHA(active, lease_a)
+    ha_b = RouterHA(standby, lease_b, peer_healthz=peer_healthz,
+                    adopt=adopt, adopt_after=2)
+    assert lease_a.try_acquire()
+    return {"active": active, "standby": standby, "ha_a": ha_a,
+            "ha_b": ha_b, "lease_a": lease_a, "lease_b": lease_b,
+            "peer_alive": peer_alive}
+
+
+# ------------------------------------------------------------ fencing
+def test_standby_is_fenced_until_adoption(engines):
+    pair = _ha_pair(engines)
+    with pytest.raises(Unavailable) as ei:
+        pair["standby"].dispatch(SAMPLE)
+    assert "fenced" in str(ei.value)
+    assert pair["standby"].metrics.snapshot()["fenced_total"] == 1
+    h = pair["standby"].fleet_health()
+    assert h["status"] == "fenced" and not h["ready"]
+    # the active serves normally, role held
+    result, prov = pair["active"].dispatch(SAMPLE)
+    assert "outputs" in result
+    assert pair["active"].fleet_health()["role_held"] is True
+
+
+def test_standby_adopts_on_active_death_within_one_interval(engines):
+    """Kill the active (stops renewing AND stops answering): after the
+    lease lapses, the standby's very next HA step adopts and serves —
+    'answers within one health interval' as a deterministic statement.
+    Provenance and replica identity carry over (same replica ids)."""
+    pair = _ha_pair(engines, ttl_s=0.3)
+    ha_b = pair["ha_b"]
+    # healthy watch: the standby mirrors the active's replica set
+    ha_b.step()
+    assert [s["id"] for s in ha_b.last_peer_snapshot] == ["r0", "r1"]
+    assert ha_b.adoptions == 0
+    # ACTIVE DIES: renewals stop, healthz unreachable
+    pair["peer_alive"]["up"] = False
+    ha_b.step()  # failure 1
+    ha_b.step()  # failure 2 → adopt_after reached, but the lease is
+    # still live — takeover is lease-GATED, no split brain
+    assert ha_b.adoptions == 0 and not pair["lease_b"].valid()
+    time.sleep(0.35)  # the dead active's lease lapses
+    t0 = time.monotonic()
+    ha_b.step()  # ONE step: acquire + adopt + re-arm
+    adopt_ms = 1e3 * (time.monotonic() - t0)
+    assert ha_b.adoptions == 1
+    assert pair["lease_b"].valid()
+    assert pair["lease_b"].epoch == pair["lease_a"].epoch + 1
+    result, prov = pair["standby"].dispatch(SAMPLE)
+    assert "outputs" in result and prov["replica"] in ("r0", "r1")
+    snap = pair["standby"].metrics.snapshot()
+    assert snap["adoptions_total"] == 1
+    # the takeover itself is sub-interval work (re-poll + re-arm of an
+    # in-process fleet is milliseconds; the budget is the 100ms default
+    # health interval)
+    assert adopt_ms < 1000.0, adopt_ms
+
+
+@pytest.mark.chaos
+def test_partitioned_active_is_fenced_and_epoch_guarded(engines):
+    """A seeded partition drops every active-role renewal: the old
+    active self-fences within one ttl (dispatch raises Unavailable,
+    PROVABLY stopped), the standby takes over with a bumped epoch, and
+    even after the partition heals the old active's renew is refused
+    (epoch guard) — the r11 zombie-finish protection applied to
+    routing."""
+    pair = _ha_pair(engines, ttl_s=0.3)
+    ha_a, ha_b = pair["ha_a"], pair["ha_b"]
+    plan = chaos.FaultPlan(seed=7, faults=[
+        {"type": "partition", "site": "lease_renew", "after": 0,
+         "count": 1000}])
+    with chaos.chaos_plan(plan):
+        ha_a.step()  # renewal LOST (dropped), validity keeps ticking
+        assert pair["lease_a"].valid()  # not yet fenced...
+        time.sleep(0.35)  # ttl lapses with the renewal lost
+        assert not pair["lease_a"].valid()
+        ha_a.step()  # now fenced: the loop stops renewing entirely
+        # (it watches for a chance to RE-acquire instead)
+        with pytest.raises(Unavailable) as ei:
+            pair["active"].dispatch(SAMPLE)
+        assert "fenced" in str(ei.value)
+        # standby watches a peer that ANSWERS but is not ready (fenced)
+        pair["ha_b"].step()
+        pair["ha_b"].step()
+        assert ha_b.adoptions == 1  # lease was free: adopted at once
+    assert plan.hits("lease_renew") == 1  # fenced holders stop renewing
+    # partition healed: the old active's renew hits the epoch guard
+    assert not pair["lease_a"].renew()
+    assert not pair["lease_a"].valid()
+    with pytest.raises(Unavailable):
+        pair["active"].dispatch(SAMPLE)
+    # the adopted fleet serves
+    result, _ = pair["standby"].dispatch(SAMPLE)
+    assert "outputs" in result
+
+
+# ----------------------------------------------------- client endpoints
+def test_client_rotates_endpoints_with_provenance(engines):
+    """ServingClient(endpoints=[dead, live]) rides its existing backoff
+    to the answering endpoint and surfaces it in last_provenance."""
+    router = ReplicaRouter([EngineTransport(engines["engines"][0])])
+    router.poll_once()
+    server = make_router_server(router, port=0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        live = server.server_address[1]
+        from paddle_tpu.serving.supervisor import free_port
+        dead = free_port()  # nothing listens here
+        client = ServingClient(
+            endpoints=[f"127.0.0.1:{dead}", f"127.0.0.1:{live}"],
+            retries=3, backoff_base_ms=5.0, backoff_seed=0)
+        result = client.score(SAMPLE)
+        assert "outputs" in result
+        assert client.last_provenance["endpoint"] == f"127.0.0.1:{live}"
+        assert client.last_provenance["replica"] == "r0"
+        assert result["provenance"]["endpoint"] == f"127.0.0.1:{live}"
+    finally:
+        server.shutdown()
+
+
+def test_client_rotates_off_fenced_router_on_503(engines):
+    """A fenced router's 503 Unavailable makes the client re-resolve to
+    the next endpoint — the standby-then-active discovery path."""
+    lease = RoleLease(InMemStore(), "X", ttl_s=0.2, settle_s=0.0)
+    fenced = ReplicaRouter([EngineTransport(engines["engines"][0])],
+                           fence=lease)  # never acquired: fenced
+    fenced.poll_once()
+    live = ReplicaRouter([EngineTransport(engines["engines"][1])])
+    live.poll_once()
+    s1 = make_router_server(fenced, port=0)
+    s2 = make_router_server(live, port=0)
+    for s in (s1, s2):
+        threading.Thread(target=s.serve_forever, daemon=True).start()
+    try:
+        client = ServingClient(
+            endpoints=[f"127.0.0.1:{s1.server_address[1]}",
+                       f"127.0.0.1:{s2.server_address[1]}"],
+            retries=2, backoff_base_ms=5.0, backoff_seed=0)
+        result = client.score(SAMPLE)
+        assert "outputs" in result
+        assert client.last_provenance["endpoint"] == \
+            f"127.0.0.1:{s2.server_address[1]}"
+    finally:
+        s1.shutdown()
+        s2.shutdown()
+
+
+# ----------------------------------------------------------- autoscale
+def test_autoscaler_scales_real_in_process_fleet(engines):
+    """The autoscaler against a REAL router fleet (InProcessFleet):
+    scale-up builds a warmed engine (AOT cache) and the new replica
+    takes dispatches; sustained idle scales back down to the floor;
+    the trajectory records the whole path and never leaves [min,max]."""
+    router = ReplicaRouter([EngineTransport(engines["engines"][0])])
+    router.poll_once()
+    new_engines = []
+
+    def build():
+        e = engines["build_engine"]()
+        new_engines.append(e)
+        return EngineTransport(e)
+
+    fleet = InProcessFleet(router, build)
+    sc = Autoscaler(fleet, min_replicas=1, max_replicas=3,
+                    up_backlog_ms=50.0, down_backlog_ms=5.0,
+                    sustain_up_s=0.2, sustain_down_s=0.2,
+                    cooldown_s=0.0)
+    try:
+        now = 0.0
+        while fleet.replica_count() < 3 and now < 20.0:
+            sc.observe(backlog_ms=200.0, now=now)
+            now += 0.3
+        assert fleet.replica_count() == 3
+        sc.observe(backlog_ms=200.0, now=now)  # at max: clamped
+        assert fleet.replica_count() == 3
+        # the grown fleet actually serves on its new replicas
+        seen = set()
+        for _ in range(12):
+            _, prov = router.dispatch(SAMPLE)
+            seen.add(prov["replica"])
+        assert len(seen) >= 2
+        # sustained idle: back down to the floor (draining, zero drops)
+        guard = 0
+        while fleet.replica_count() > 1 and guard < 100:
+            sc.observe(backlog_ms=0.0, now=now)
+            now += 0.3
+            guard += 1
+        assert fleet.replica_count() == 1
+        counts = [n for _, n in sc.trajectory]
+        assert max(counts) == 3 and counts[-1] == 1
+        assert all(1 <= n <= 3 for n in counts)
+        snap = router.metrics.snapshot()
+        assert snap["scale_up_total"] == 2
+        assert snap["scale_down_total"] == 2
+        # the survivor still serves
+        result, _ = router.dispatch(SAMPLE)
+        assert "outputs" in result
+    finally:
+        for e in new_engines:
+            e.shutdown(drain=False)
+
+
+# ------------------------------------------------------------- the soak
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_kill_active_router_under_open_loop_load_soak(tmp_path):
+    """THE acceptance drill: open-loop load through HA client endpoints
+    while the ACTIVE router process is killed mid-run (listener torn
+    down, renewals stop — the in-process analogue of a SIGKILL). The
+    warm standby adopts once the lease lapses and answers within one
+    health interval; summed across BOTH seeded rounds, zero non-shed
+    requests fail; and the chaos fault log reproduces bitwise from the
+    seed."""
+    import jax as _jax  # noqa: F401
+    dsl.reset()
+    x = dsl.data(name="x", size=DIM)
+    lab = dsl.data(name="label", size=CLASSES)
+    out = dsl.fc(input=x, size=CLASSES, act="softmax", name="out")
+    dsl.classification_cost(input=out, label=lab, name="cost")
+    graph = dsl.current_graph()
+    params = Network(graph, outputs=["out"]).init_params(
+        jax.random.PRNGKey(0))
+    feeding = {"x": dense_vector(DIM), "label": integer_value(CLASSES)}
+    cache_dir = str(tmp_path / "aot")
+
+    def build_engine():
+        pred = ServingPredictor(graph, params, ["out"], feeding,
+                                batch_buckets=[1, 2],
+                                aot_cache=cache_dir)
+        return ServingEngine(pred, max_batch=2, batch_timeout_ms=1.0,
+                             queue_depth=64).start(warmup=True)
+
+    def run_round(seed):
+        engs = [build_engine() for _ in range(2)]
+        store = InMemStore()
+        ttl = 0.4
+        interval_ms = 100.0
+        lease_a = RoleLease(store, "A", ttl_s=ttl, settle_s=0.0)
+        lease_b = RoleLease(store, "B", ttl_s=ttl, settle_s=0.0)
+        active = ReplicaRouter([EngineTransport(e) for e in engs],
+                               fence=lease_a, health_poll_ms=25.0)
+        standby = ReplicaRouter([], fence=lease_b, health_poll_ms=25.0)
+        srv_a = make_router_server(active, port=0)
+        srv_b = make_router_server(standby, port=0)
+        for s in (srv_a, srv_b):
+            threading.Thread(target=s.serve_forever,
+                             daemon=True).start()
+        by_id = {f"r{i}": e for i, e in enumerate(engs)}
+
+        def peer_healthz():
+            import http.client
+            import json as _json
+            conn = http.client.HTTPConnection(
+                "127.0.0.1", srv_a.server_address[1], timeout=1.0)
+            try:
+                conn.request("GET", "/healthz")
+                return _json.loads(conn.getresponse().read())
+            finally:
+                conn.close()
+
+        def adopt(snaps):
+            return [(s["id"], EngineTransport(by_id[s["id"]]))
+                    for s in snaps if s["id"] in by_id]
+
+        assert lease_a.try_acquire()
+        active.start()
+        standby.start()
+        ha_a = RouterHA(active, lease_a,
+                        interval_ms=interval_ms).start()
+        ha_b = RouterHA(standby, lease_b, peer_healthz=peer_healthz,
+                        adopt=adopt, adopt_after=2,
+                        interval_ms=interval_ms).start()
+        plan = chaos.FaultPlan(seed=seed, faults=[
+            # the seeded kill trigger: from the Nth renewal on, EVERY
+            # renewal of holder A — and only A's — is dropped (the
+            # standby's own renewals after adoption must sail through);
+            # the harness tears A's listener down when it observes the
+            # first drop. A silenced, unreachable active = the kill.
+            {"type": "partition", "site": "lease_renew", "after": 4,
+             "count": 100000, "match": {"holder": "A"}}])
+        n_requests, interval_s = 40, 0.05
+        counts = {"ok": 0, "shed": 0, "failed": 0}
+        lock = threading.Lock()
+        endpoints = [f"127.0.0.1:{srv_a.server_address[1]}",
+                     f"127.0.0.1:{srv_b.server_address[1]}"]
+        killed = {"t": None}
+        answered_by = []
+
+        def kill_watch():
+            while plan.hits("lease_renew") < 5:
+                time.sleep(0.01)
+            # the active router "process" dies: accept loop stopped AND
+            # the listening socket CLOSED — a real process death frees
+            # the port; shutdown() alone would leave the kernel backlog
+            # swallowing new connections into a black hole
+            killed["t"] = time.monotonic()
+            srv_a.shutdown()
+            srv_a.server_close()
+
+        def one(i):
+            client = ServingClient(endpoints=list(endpoints),
+                                   timeout=10.0,
+                                   retries=8, backoff_base_ms=20.0,
+                                   backoff_seed=seed * 1000 + i)
+            try:
+                client.score(SAMPLE)
+                key = "ok"
+                with lock:
+                    answered_by.append(
+                        (client.last_provenance or {}).get("endpoint"))
+            except Unavailable:
+                key = "failed"  # outage, not backpressure
+            except Overloaded:
+                key = "shed"
+            except ServingError:
+                key = "failed"
+            except OSError:
+                key = "failed"
+            with lock:
+                counts[key] += 1
+
+        watcher = threading.Thread(target=kill_watch, daemon=True)
+        threads = []
+        with chaos.chaos_plan(plan):
+            watcher.start()
+            t0 = time.monotonic()
+            for i in range(n_requests):
+                target = t0 + i * interval_s
+                d = target - time.monotonic()
+                if d > 0:
+                    time.sleep(d)
+                th = threading.Thread(target=one, args=(i,))
+                th.start()
+                threads.append(th)
+            for th in threads:
+                th.join(60.0)
+            watcher.join(10.0)
+            # the standby adopted within one health interval of the
+            # lease lapsing (kill time + ttl + one interval + slack)
+            deadline = time.monotonic() + 10.0
+            while ha_b.adoptions == 0 and time.monotonic() < deadline:
+                time.sleep(0.02)
+        assert killed["t"] is not None, "the kill never fired"
+        assert ha_b.adoptions == 1
+        adoption_lag = ha_b.adopted_at - killed["t"]
+        assert adoption_lag < ttl + 3 * (interval_ms / 1e3) + 0.5, \
+            f"standby took {adoption_lag:.2f}s to adopt"
+        # both endpoints actually answered traffic across the kill
+        # (exact compare — a port-digit suffix match could credit the
+        # active, e.g. ":18080".endswith("8080"))
+        standby_ep = f"127.0.0.1:{srv_b.server_address[1]}"
+        assert any(e == standby_ep for e in answered_by), \
+            "standby never answered"
+        ha_a.shutdown(release=False)
+        ha_b.shutdown(release=False)
+        srv_b.shutdown()
+        active._stop.set()
+        standby._stop.set()
+        for e in engs:
+            e.shutdown(drain=False)
+        return counts, list(plan.log)
+
+    c1, log1 = run_round(11)
+    c2, log2 = run_round(11)
+    # zero failed non-shed SUMMED across rounds — a failing round
+    # cannot hide behind a better sibling
+    assert c1["failed"] + c2["failed"] == 0, (c1, c2)
+    assert c1["ok"] + c2["ok"] > 0
+    # the seeded fault SCHEDULE reproduces: the kill lands at exactly
+    # the same hit in both rounds, and every fired fault is the
+    # targeted partition (how MANY drops land before the active fences
+    # is wall-clock — the schedule, not the count, is the seed's
+    # contract)
+    assert log1[0] == log2[0] == ("lease_renew", 5, "partition")
+    for log in (log1, log2):
+        assert all(site == "lease_renew" and kind == "partition"
+                   for site, _, kind in log)

@@ -1,0 +1,353 @@
+"""Replica supervisor: process lifecycle as tested framework behavior.
+
+The r14 kill-discrimination contract, driven against REAL child
+processes (tiny stub replica servers — the supervisor only ever talks
+HTTP + signals, so the stub exercises the identical surface the CLI's
+``--job=serve`` children do, in milliseconds instead of model-warmup
+seconds):
+
+- a HUNG replica (process alive, health probes never answered) dies by
+  LEASE EXPIRY: SIGTERM → grace → SIGKILL → reap → respawn;
+- a CRASHED replica (process exited) is reaped and respawned
+  immediately;
+- a SLOW-BUT-HEARTBEATING straggler is NEVER killed — slowness is the
+  router's breaker/hedge business, not the lifecycle plane's;
+- dropped lease renewals (chaos site ``lease_renew``) expire a healthy
+  replica's lease — and even then two live processes serving one
+  replica id are impossible (the reap gates every respawn);
+- spawns ride the ``supervisor_spawn`` chaos site: a dropped spawn
+  leaves the slot down and the next sweep retries.
+
+Plus the RoleLease election/fencing unit contract and the remote-drain
+satellite: ``POST /admin/drain`` on the real single-replica server,
+and ``HTTPTransport`` draining Popen-less replicas through it.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import textwrap
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from paddle_tpu.dist.master import (FileStore, InMemStore, LeaseTable,
+                                    RoleLease)
+from paddle_tpu.serving.router import HTTPTransport
+from paddle_tpu.serving.supervisor import ReplicaSupervisor, free_port
+from paddle_tpu.testing import chaos
+
+# --------------------------------------------------------------- stub
+# A stand-in replica process: answers the same /healthz + /admin/drain
+# surface a real single-replica server does, with control endpoints to
+# make it hang (stop answering health), crash (exit), or slow down.
+STUB = textwrap.dedent("""
+    import json, os, sys, threading, time
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    state = {"hang": False, "slow_s": 0.0, "draining": False}
+
+    class H(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        def log_message(self, *a): pass
+        def _send(self, code, body):
+            data = json.dumps(body).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+        def do_GET(self):
+            if self.path == "/healthz":
+                if state["hang"]:
+                    time.sleep(3600)
+                if state["slow_s"]:
+                    time.sleep(state["slow_s"])
+                self._send(200, {"status": "ok", "live": True,
+                                 "ready": not state["draining"],
+                                 "draining": state["draining"],
+                                 "queue_depth": 0, "inflight": 0,
+                                 "backlog_ms": 1.0,
+                                 "model_version": "stub",
+                                 "pid": os.getpid()})
+            else:
+                self._send(404, {})
+        def do_POST(self):
+            if self.path == "/admin/drain":
+                state["draining"] = True
+                self._send(200, {"draining": True})
+            elif self.path == "/admin/hang":
+                state["hang"] = True
+                self._send(200, {})
+            elif self.path == "/admin/slow":
+                state["slow_s"] = 0.3
+                self._send(200, {})
+            elif self.path == "/admin/die":
+                self._send(200, {})
+                os._exit(7)
+            else:
+                self._send(404, {})
+
+    srv = ThreadingHTTPServer(("127.0.0.1", int(sys.argv[1])), H)
+    srv.daemon_threads = True
+    srv.serve_forever()
+""")
+
+
+def _stub_spawn_factory(tmpdir):
+    path = os.path.join(tmpdir, "stub_replica.py")
+    with open(path, "w") as f:
+        f.write(STUB)
+
+    def spawn(replica_id):
+        port = free_port()
+        proc = subprocess.Popen([sys.executable, path, str(port)])
+        return proc, "127.0.0.1", port
+
+    return spawn
+
+
+def _post(transport, path):
+    url = f"http://{transport.host}:{transport.port}{path}"
+    with urllib.request.urlopen(
+            urllib.request.Request(url, data=b"{}", method="POST"),
+            timeout=5.0) as r:
+        return json.loads(r.read() or b"{}")
+
+
+def _drive(sup, until, timeout=20.0, settle=0.05):
+    """Deterministically drive supervision sweeps until ``until()``."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        sup.poll_once()
+        if until():
+            return True
+        time.sleep(settle)
+    return until()
+
+
+@pytest.fixture
+def stub_spawn(tmp_path):
+    return _stub_spawn_factory(str(tmp_path))
+
+
+def _events(sup, kind, rid=None):
+    return [e for e in sup.events
+            if e[1] == kind and (rid is None or e[2] == rid)]
+
+
+# ------------------------------------------------------------- matrix
+def test_lease_expiry_matrix_hung_crashed_straggler(stub_spawn):
+    """The kill-discrimination matrix: hung → lease-expiry kill +
+    respawn; crashed → reap + respawn; slow-but-heartbeating → never
+    killed (same pid end to end)."""
+    sup = ReplicaSupervisor(stub_spawn, replicas=3,
+                            lease_timeout_s=1.0, grace_s=0.5,
+                            healthz_timeout_s=0.6)
+    try:
+        sup.start(wait_ready_s=20.0)
+        pids0 = {r["id"]: r["pid"] for r in sup.snapshot()["replicas"]}
+        assert all(pids0.values())
+        with sup._lock:
+            reps = dict(sup._replicas)
+        _post(reps["r2"].transport, "/admin/slow")   # straggler
+        _post(reps["r0"].transport, "/admin/hang")   # hung
+        _post(reps["r1"].transport, "/admin/die")    # crashed
+        # wait for the RE-spawns (the initial start() spawn is event
+        # one, so the bar is two per affected replica)
+        assert _drive(sup, lambda:
+                      len(_events(sup, "spawned", "r0")) >= 2
+                      and len(_events(sup, "spawned", "r1")) >= 2)
+        # r0 died by LEASE EXPIRY → escalate → respawn with a new pid
+        assert _events(sup, "lease_expired", "r0")
+        assert _events(sup, "killed", "r0")
+        # r1 crashed on its own: reaped + respawned, never signalled
+        assert _events(sup, "spawned", "r1")
+        assert not _events(sup, "killed", "r1")
+        assert not _events(sup, "lease_expired", "r1")
+        # the straggler answered (slowly) every probe: untouched
+        assert not _events(sup, "killed", "r2")
+        assert not _events(sup, "lease_expired", "r2")
+        pids1 = {r["id"]: r["pid"] for r in sup.snapshot()["replicas"]}
+        assert pids1["r2"] == pids0["r2"]
+        assert pids1["r0"] not in (None, pids0["r0"])
+        assert pids1["r1"] not in (None, pids0["r1"])
+        # the killed/old pids are truly gone (reaped, not zombied-live)
+        for old in (pids0["r0"], pids0["r1"]):
+            with pytest.raises(ProcessLookupError):
+                os.kill(old, 0)
+    finally:
+        sup.shutdown(drain=False)
+
+
+@pytest.mark.chaos
+def test_dropped_lease_renewals_cannot_double_spawn(stub_spawn):
+    """Seeded chaos drops EVERY lease renewal after the first: the
+    (healthy) replica's lease expires and the supervisor kills +
+    respawns it — but at no point do two live processes serve the same
+    replica id: every ``spawned`` event is preceded by the previous
+    process's reap, and only the final pid is alive afterwards."""
+    plan = chaos.FaultPlan(seed=23, faults=[
+        {"type": "drop", "site": "lease_renew", "after": 1,
+         "count": 10_000}])
+    sup = ReplicaSupervisor(stub_spawn, replicas=1,
+                            lease_timeout_s=0.8, grace_s=0.4,
+                            healthz_timeout_s=0.5)
+    try:
+        with chaos.chaos_plan(plan):
+            sup.start(wait_ready_s=20.0)
+            assert _drive(sup, lambda: len(_events(sup, "spawned",
+                                                   "r0")) >= 2,
+                          settle=0.2)
+        assert plan.hits("lease_renew") > 1
+        assert _events(sup, "lease_renew_lost", "r0")
+        assert _events(sup, "lease_expired", "r0")
+        spawned = _events(sup, "spawned", "r0")
+        killed_or_crashed = (_events(sup, "killed", "r0")
+                             + _events(sup, "crashed", "r0"))
+        # between consecutive spawns there is always a completed reap
+        for a, b in zip(spawned, spawned[1:]):
+            assert any(a[0] < e[0] < b[0] for e in killed_or_crashed), \
+                "a respawn fired without reaping the previous process"
+        pids = [e[3]["pid"] for e in spawned]
+        live = [p for p in pids if _alive(p)]
+        assert live == [pids[-1]], (
+            f"multiple live processes for one replica id: {live}")
+    finally:
+        sup.shutdown(drain=False)
+
+
+def _alive(pid):
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+
+
+def test_spawn_drop_leaves_slot_down_and_retries(stub_spawn):
+    """An injected ``supervisor_spawn`` drop fails the spawn; the slot
+    stays down and the next sweep retries successfully."""
+    plan = chaos.FaultPlan(seed=5, faults=[
+        {"type": "drop", "site": "supervisor_spawn", "at": 1}])
+    sup = ReplicaSupervisor(stub_spawn, replicas=1,
+                            lease_timeout_s=2.0)
+    try:
+        with chaos.chaos_plan(plan):
+            sup.start()  # first spawn dropped
+            assert _events(sup, "spawn_failed", "r0")
+            assert sup.snapshot()["replicas"][0]["pid"] is None
+            sup.poll_once()  # retry path: slot down → respawn
+        assert _events(sup, "spawned", "r0")
+        assert sup.wait_ready(20.0)
+    finally:
+        sup.shutdown(drain=False)
+
+
+# ------------------------------------------------------- remote drain
+def test_admin_drain_and_popen_less_http_transport(serving_engine_http):
+    """The remote-drain satellite against the REAL single-replica
+    server: ``POST /admin/drain`` closes admission (429 shutting_down),
+    and a Popen-LESS HTTPTransport drains through the endpoint — the
+    r13 'drain must be driven out of band' warning path is gone."""
+    host, port, engine = serving_engine_http
+    t = HTTPTransport(host, port)  # no proc handle on purpose
+    assert t.healthz()["ready"]
+    t.begin_drain()
+    assert engine.draining
+    h = t.healthz()
+    assert h["draining"] and not h["ready"]
+    assert "inflight" in h
+    t.drain_wait(timeout=10.0)  # queue is empty → returns promptly
+    from paddle_tpu.serving import ServingClient
+    from paddle_tpu.serving.errors import Overloaded
+    with pytest.raises(Overloaded):  # admission is closed: 429
+        ServingClient(host, port).score([[0.1] * 8, 0])
+
+
+@pytest.fixture(scope="module")
+def serving_engine_http():
+    """One real tiny engine + HTTP frontend (module-scoped: the 1-core
+    host cannot afford per-test warmup)."""
+    import numpy as np  # noqa: F401
+    import jax
+    from paddle_tpu.config import dsl
+    from paddle_tpu.core.network import Network
+    from paddle_tpu.data import dense_vector, integer_value
+    from paddle_tpu.serving import ServingEngine, ServingPredictor
+    from paddle_tpu.serving.server import make_server
+
+    dsl.reset()
+    x = dsl.data(name="x", size=8)
+    lab = dsl.data(name="label", size=4)
+    out = dsl.fc(input=x, size=4, act="softmax", name="out")
+    dsl.classification_cost(input=out, label=lab, name="cost")
+    graph = dsl.current_graph()
+    params = Network(graph, outputs=["out"]).init_params(
+        jax.random.PRNGKey(0))
+    feeding = {"x": dense_vector(8), "label": integer_value(4)}
+    pred = ServingPredictor(graph, params, ["out"], feeding,
+                            batch_buckets=[1, 2])
+    engine = ServingEngine(pred, max_batch=2,
+                           batch_timeout_ms=1.0).start(warmup=True)
+    server = make_server(engine, port=0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    host, port = server.server_address
+    yield host, port, engine
+    server.shutdown()
+    engine.shutdown()
+
+
+# ---------------------------------------------------------- RoleLease
+def test_role_lease_acquire_renew_expire_and_epoch_fence(tmp_path):
+    """The election/fencing contract: one live holder at a time; a
+    stale lease is taken with a BUMPED epoch; the old holder's next
+    renew sees the foreign epoch, fails, and self-fences."""
+    store = FileStore(str(tmp_path / "role.json"))
+    a = RoleLease(store, "A", ttl_s=0.3, settle_s=0.0)
+    b = RoleLease(store, "B", ttl_s=0.3, settle_s=0.0)
+    assert a.try_acquire() and a.valid() and a.epoch == 1
+    assert not b.try_acquire()  # live foreign holder
+    assert a.renew()
+    time.sleep(0.35)  # A stops renewing: lease goes stale
+    assert not a.valid()
+    assert b.try_acquire() and b.epoch == 2
+    # the zombie's renew is refused by the epoch guard, permanently
+    assert not a.renew() and not a.valid()
+    assert b.renew() and b.valid()
+    # clean release → immediate takeover, no ttl wait, epoch still grows
+    b.release()
+    assert not b.valid()
+    assert a.try_acquire() and a.epoch == 3
+
+
+def test_role_lease_renew_rides_the_lease_renew_chaos_site():
+    """A dropped renewal (`lease_renew` drop) is a LOST message: the
+    holder keeps its validity only until ttl, then self-fences."""
+    lease = RoleLease(InMemStore(), "A", ttl_s=0.25, settle_s=0.0)
+    assert lease.try_acquire()
+    plan = chaos.FaultPlan(seed=3, faults=[
+        {"type": "drop", "site": "lease_renew"}])
+    with chaos.chaos_plan(plan):
+        with pytest.raises(ConnectionError):
+            lease.renew()
+    assert plan.hits("lease_renew") == 1
+    assert lease.valid()  # validity persists until the ttl runs out...
+    time.sleep(0.3)
+    assert not lease.valid()  # ...then the holder is fenced
+
+
+def test_lease_table_reports_each_expiry_once():
+    lt = LeaseTable(0.1)
+    lt.renew("x")
+    lt.renew("y")
+    time.sleep(0.15)
+    lt.renew("y")
+    assert lt.expired() == ["x"]
+    assert lt.expired() == []  # x reported exactly once
+    assert "y" in lt and "x" not in lt
