@@ -22,6 +22,77 @@ from paddle_tpu.analysis.findings import (RULE_BY_NAME, RULES, Finding,
 from paddle_tpu.analysis._astutil import repo_root
 
 
+def print_budget_tables(emit, as_json: bool = False) -> int:
+    """``--budgets``: compile the traced programs once and print
+    current-vs-pinned for both ratchet files. Strictly read-only — a
+    drifted row is shown with a ``!`` marker, but updating a budget
+    stays a deliberate manual edit (and the lint, not this report,
+    enforces it). With ``--json``, the same data goes to stdout as the
+    one JSON object the mode promises (tables to stderr via emit)."""
+    from paddle_tpu.analysis import mem_audit, shard_audit
+    programs = shard_audit.compile_programs(log=emit)
+    comm = {e.key(): e for e in shard_audit.load_budget()}
+    comm_rows = []
+    seen = set()
+    for cp in programs:
+        manifest = shard_audit.collect_manifest(cp.hlo, cp.spec.mesh)
+        for (op, axis), (n, nbytes) in sorted(manifest.items()):
+            e = comm.get((cp.spec.name, op, axis))
+            seen.add((cp.spec.name, op, axis))
+            comm_rows.append({
+                "program": cp.spec.name, "op": op, "axis": axis,
+                "current": {"ops": n, "bytes": nbytes},
+                "pinned": ({"ops": e.ops, "bytes": e.bytes}
+                           if e else None)})
+    for key in sorted(set(comm) - seen):
+        e = comm[key]
+        comm_rows.append({
+            "program": key[0], "op": key[1], "axis": key[2],
+            "current": None,
+            "pinned": {"ops": e.ops, "bytes": e.bytes}})
+    mem = {e.program: e for e in mem_audit.load_mem_budget()}
+    mem_rows = []
+    for cp in programs:
+        manifest = mem_audit.memory_manifest(cp)
+        e = mem.get(cp.spec.name)
+        for f in mem_audit.MANIFEST_FIELDS:
+            mem_rows.append({
+                "program": cp.spec.name, "field": f,
+                "current": manifest[f],
+                "pinned": getattr(e, f) if e else None})
+    for name in sorted(set(mem) - {cp.spec.name for cp in programs}):
+        mem_rows.append({"program": name, "field": "(stale entry)",
+                         "current": None,
+                         "pinned": mem[name].arg_bytes})
+
+    emit("\ncomm_budget.toml (pass 4) — current vs pinned:")
+    emit(f"  {'program':<14}{'op':<20}{'axis':<12}"
+         f"{'current':>16}{'pinned':>16}")
+    for r in comm_rows:
+        cur = (f"{r['current']['ops']}x/{r['current']['bytes']}B"
+               if r["current"] else "(absent)")
+        pin = (f"{r['pinned']['ops']}x/{r['pinned']['bytes']}B"
+               if r["pinned"] else "UNPINNED")
+        mark = " " if r["current"] == r["pinned"] else "!"
+        emit(f" {mark}{r['program']:<14}{r['op']:<20}{r['axis']:<12}"
+             f"{cur:>16}{pin:>16}")
+    emit("\nmem_budget.toml (pass 5) — current vs pinned, "
+         "bytes/device:")
+    emit(f"  {'program':<14}{'field':<16}{'current':>12}{'pinned':>12}")
+    for r in mem_rows:
+        cur = r["current"] if r["current"] is not None else "(absent)"
+        pin = r["pinned"] if r["pinned"] is not None else "UNPINNED"
+        mark = " " if r["current"] == r["pinned"] else "!"
+        emit(f" {mark}{r['program']:<14}{r['field']:<16}{cur:>12}"
+             f"{pin:>12}")
+    emit("\nread-only report: the ratchet is enforced by the lint "
+         "passes, and budget edits stay deliberate")
+    if as_json:
+        print(json.dumps({"comm_budget": comm_rows,
+                          "mem_budget": mem_rows}, indent=1))
+    return 0
+
+
 def run(argv: List[str] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m paddle_tpu.analysis",
@@ -39,6 +110,17 @@ def run(argv: List[str] = None) -> int:
                     help="skip pass 4 (sharding/collective audit of "
                          "the parallel programs; the slowest pass — "
                          "it compiles on the 8-device virtual mesh)")
+    ap.add_argument("--skip-mem", action="store_true",
+                    help="skip pass 5 (per-device memory-footprint "
+                         "audit; reuses pass 4's compiles, so it is "
+                         "cheap when pass 4 runs and compile-heavy "
+                         "alone)")
+    ap.add_argument("--budgets", action="store_true",
+                    help="READ-ONLY: compile the traced programs and "
+                         "print both budgets' current-vs-pinned "
+                         "tables (comm_budget.toml + mem_budget.toml)"
+                         ", then exit 0; regenerating a budget stays "
+                         "a deliberate manual edit (ratchet policy)")
     ap.add_argument("--no-entry", action="store_true",
                     help="jaxpr pass without the flagship "
                          "__graft_entry__ build (~20s on 1 core)")
@@ -83,8 +165,14 @@ def run(argv: List[str] = None) -> int:
     ran_prefixes: List[str] = []
     t0 = time.time()
     pass4_dt = None
+    pass5_dt = None
+    mem_manifests = None
+    # pass 4 and pass 5 audit the SAME compiled executables — whichever
+    # runs first pays the compile, the other reuses it
+    programs = None
 
-    if not (args.skip_jaxpr and args.skip_shard):
+    if args.budgets or not (args.skip_jaxpr and args.skip_shard
+                            and args.skip_mem):
         # force the CPU platform BEFORE any jax import: the audits
         # trace real programs, and on the TPU host a wedged axon
         # tunnel would otherwise hang the lint for hours (CLAUDE.md).
@@ -99,8 +187,11 @@ def run(argv: List[str] = None) -> int:
             import jax
 
             jax.config.update("jax_platforms", "cpu")
-        except Exception:  # noqa: BLE001 — pass 2/4 will surface it
+        except Exception:  # noqa: BLE001 — pass 2/4/5 will surface it
             pass
+
+    if args.budgets:
+        return print_budget_tables(emit, as_json=args.json)
 
     if not args.skip_ast:
         from paddle_tpu.analysis.ast_lints import run_pass1
@@ -148,12 +239,14 @@ def run(argv: List[str] = None) -> int:
         ran_prefixes.append("PT2")
 
     if not args.skip_shard:
-        from paddle_tpu.analysis.shard_audit import run_pass4
+        from paddle_tpu.analysis.shard_audit import (compile_programs,
+                                                     run_pass4)
         emit("[pass 4] sharding/collective audit (8-device virtual "
              "mesh):")
         t4 = time.time()
         try:
-            fs = run_pass4(args.root, log=emit)
+            programs = compile_programs()
+            fs = run_pass4(args.root, log=emit, programs=programs)
         except Exception as e:  # noqa: BLE001 — surfaced as exit 2
             emit(f"[pass 4] AUDIT FAILED to run: {e!r}")
             if findings:
@@ -165,6 +258,31 @@ def run(argv: List[str] = None) -> int:
         emit(f"[pass 4] {len(fs)} findings ({pass4_dt:.1f}s)")
         findings.extend(fs)
         ran_prefixes.append("PT5")
+
+    if not args.skip_mem:
+        from paddle_tpu.analysis.mem_audit import run_pass5
+        emit("[pass 5] per-device memory-footprint audit"
+             + (" (reusing pass 4's compiles):" if programs is not None
+                else " (compiling the traced programs):"))
+        t5 = time.time()
+        try:
+            if programs is None:
+                from paddle_tpu.analysis.shard_audit import \
+                    compile_programs
+                programs = compile_programs()
+            fs, mem_manifests = run_pass5(args.root, log=emit,
+                                          programs=programs)
+        except Exception as e:  # noqa: BLE001 — surfaced as exit 2
+            emit(f"[pass 5] AUDIT FAILED to run: {e!r}")
+            if findings:
+                emit(format_report(
+                    findings, "findings collected before the crash:"))
+            return fail_json(f"pass 5 audit failed to run: {e!r}",
+                             findings)
+        pass5_dt = time.time() - t5
+        emit(f"[pass 5] {len(fs)} findings ({pass5_dt:.1f}s)")
+        findings.extend(fs)
+        ran_prefixes.append("PT6")
 
     try:
         entries = load_baseline(args.baseline)
@@ -190,12 +308,13 @@ def run(argv: List[str] = None) -> int:
             "baseline only shrinks)"))
 
     dt = time.time() - t0
-    # the pass-4 wall time rides the summary line so runtime creep in
-    # the compile-heavy pass is visible run over run
+    # the pass-4/5 wall times ride the summary line so runtime creep in
+    # the compile-heavy passes is visible run over run
     p4 = f", pass4 {pass4_dt:.1f}s" if pass4_dt is not None else ""
+    p5 = f", pass5 {pass5_dt:.1f}s" if pass5_dt is not None else ""
     emit(f"\ngraftlint: {len(findings)} findings, "
          f"{baselined} baselined, {inline_suppressed} "
-         f"inline-suppressed ({dt:.1f}s{p4})")
+         f"inline-suppressed ({dt:.1f}s{p4}{p5})")
     if args.json:
         print(json.dumps({
             "findings": finding_dicts(findings),
@@ -205,6 +324,13 @@ def run(argv: List[str] = None) -> int:
             "elapsed_s": round(dt, 3),
             "pass4_s": (round(pass4_dt, 3)
                         if pass4_dt is not None else None),
+            "pass5_s": (round(pass5_dt, 3)
+                        if pass5_dt is not None else None),
+            # the MEM_* snapshot family: `--json | jq .mem_manifest
+            # > MEM_rNN.json` commits a per-program per-device bytes
+            # trend point; PT401 schema-checks committed ones
+            "mem_manifest": ({"programs": mem_manifests}
+                             if mem_manifests is not None else None),
         }, indent=1))
         return 1 if findings else 0
     if findings:

@@ -1,5 +1,5 @@
 """Evidence-artifact schema check (PT401): ``BENCH_*.json``,
-``MULTICHIP_*.json`` and ``ACCURACY_*.json``.
+``MULTICHIP_*.json``, ``ACCURACY_*.json`` and ``MEM_*.json``.
 
 These artifacts are the evidence trail (perf best-of-R discipline,
 multichip dryruns, real-corpus accuracy runs). A malformed artifact —
@@ -18,6 +18,12 @@ looser schema):
 - ``ACCURACY_*``: ``{"platform": str, ...}`` plus at least one named
   run section (a dict) — an accuracy artifact with no run sections
   recorded nothing.
+- ``MEM_*`` (optional trend snapshots of graftlint pass 5's
+  per-program per-device byte manifests, emitted by
+  ``python -m paddle_tpu.analysis --json | jq .mem_manifest``):
+  ``{"programs": {name: {field: int >= 0, ...}, ...}}`` with a
+  non-empty programs map — a malformed snapshot is a finding, not a
+  silently unplottable file.
 - ``BENCH_*`` (shape-sniffed among its real generations):
   **metric style** (r07+, also BENCH_LIVE) ``{"metric": str,
   "platform": str, ...}`` where every ``*_vs_*`` ratio key must be a
@@ -98,6 +104,23 @@ def check_bench_file(path: str, rel: str) -> List[Finding]:
         if not isinstance(data.get("tail"), str):
             bad("multichip artifact missing str 'tail' (the "
                 "re-checkable dryrun evidence)")
+    elif base.startswith("MEM_"):
+        # a pass-5 memory-manifest trend snapshot
+        progs = data.get("programs")
+        if not (isinstance(progs, dict) and progs):
+            bad("mem artifact needs a non-empty 'programs' object "
+                "(per-program per-device byte manifests)")
+        else:
+            for name, fields in progs.items():
+                if not isinstance(fields, dict) or not fields:
+                    bad(f"mem artifact program {name!r} must map to a "
+                        "non-empty object of byte fields")
+                    continue
+                for k, v in fields.items():
+                    if (not isinstance(v, int) or isinstance(v, bool)
+                            or v < 0):
+                        bad(f"mem artifact {name}.{k} must be a "
+                            f"non-negative int byte count, got {v!r}")
     elif base.startswith("ACCURACY_"):
         # platform + named run sections
         if not (isinstance(data.get("platform"), str)
@@ -193,7 +216,8 @@ def check_bench_file(path: str, rel: str) -> List[Finding]:
 def run_schema_check(root: str,
                      patterns: Sequence[str] = ("BENCH_*.json",
                                                 "MULTICHIP_*.json",
-                                                "ACCURACY_*.json")
+                                                "ACCURACY_*.json",
+                                                "MEM_*.json")
                      ) -> List[Finding]:
     findings: List[Finding] = []
     for pattern in patterns:

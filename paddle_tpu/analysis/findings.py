@@ -13,6 +13,8 @@ suppressions and the baseline reference them — and grouped by pass:
 - ``PT5xx`` — Pass 4, sharding & collective-communication audit of the
   real parallel programs on the 8-device virtual mesh
   (``shard_audit.py``; budget in ``comm_budget.toml``).
+- ``PT6xx`` — Pass 5, per-device memory-footprint audit of the same
+  compiled programs (``mem_audit.py``; budget in ``mem_budget.toml``).
 """
 
 from __future__ import annotations
@@ -107,6 +109,40 @@ RULES: Dict[str, Tuple[str, str]] = {
         "a rule_for table key is dead (matches no parameter), an "
         "=-exact key that exact-matches nothing, or is fully shadowed "
         "by an earlier key"),
+    "PT601": (
+        "mem-budget",
+        "a traced program's per-device memory manifest (argument/"
+        "output/temp/alias bytes + the params/opt-slots/activations "
+        "role breakdown) drifted from the committed "
+        "analysis/mem_budget.toml pin — footprint grew unjustified, a "
+        "win was left unpinned (the budget only shrinks), or a traced "
+        "program has no pin at all"),
+    "PT602": (
+        "sharding-efficiency-law",
+        "a program's declared per-role scaling law is violated: bytes "
+        "per device exceed global-bytes/N for the mesh axis the "
+        "program promises to shard over (zero1 slots ~1/N over data, "
+        "pipeline stacked body ~1/S over pipe, TP tables ~1/M over "
+        "model)"),
+    "PT603": (
+        "donation-dishonesty",
+        "a donated leaf the jaxpr audit (PT202) records as aliasable "
+        "does not reach the compiled executable's input_output_alias/"
+        "buffer_donor set, or aliasing shrinks nothing "
+        "(alias bytes = 0) — the annotation is carried but the "
+        "argument+temp footprint never shrinks"),
+    "PT604": (
+        "temp-blowup",
+        "a single temp buffer in the compiled program is larger than "
+        "the program's total per-device param bytes (and past the "
+        "64 KiB scaffolding floor) — the full-gather-materialization "
+        "smell an FSDP refactor must not regress into"),
+    "PT605": (
+        "mem-static-runtime-mismatch",
+        "the compiled manifest's per-role bytes/device disagree with "
+        "utils/profiler.memory_stats on the same params/opt_state/"
+        "activations — the static audit and the runtime accounting "
+        "must enforce ONE invariant from both sides"),
 }
 
 # name -> id (suppression comments may use either spelling)

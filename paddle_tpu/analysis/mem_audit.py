@@ -1,0 +1,558 @@
+"""Pass 5 — per-device memory-footprint audit of the compiled
+parallel programs.
+
+Pass 4 pins what the programs *communicate*; this pass pins what they
+*hold*. ROADMAP item 1's acceptance criterion — "param bytes/device
+scale ~1/N" under FSDP — and item 4's memory-aware admission both need
+byte budgets that are artifacts, not hopes (the 2017 reference's whole
+v1 memory story is per-parameter device placement, ``paddle/memory``;
+the TensorFlow cluster/placement design in PAPERS.md argues the same).
+Nothing before this pass caught a refactor that silently replicates a
+buffer, doubles a temp, or un-donates an aliased leaf.
+
+The pass reuses pass 4's ``.lower().compile()`` of the same six real
+programs on the 8-device virtual mesh (``shard_audit.compile_programs``
+— ONE compile feeds both passes) and reads each executable's
+``memory_analysis()``: per-device argument / output / temp / alias
+bytes, plus a per-role breakdown (params / opt slots / activations)
+computed from the compiled input shardings the way
+``utils/profiler.memory_stats`` computes it from live arrays.
+
+Checks:
+
+- **PT601 memory budget**: the manifest must match
+  ``analysis/mem_budget.toml`` exactly, with the proven ratchet
+  semantics — growth is drift, unpinned shrinkage fails so wins lock
+  in, stale entries are findings, and (unlike the comm budget, where
+  zero is spelled by absence) EVERY traced program must be pinned:
+  memory is never zero, and serving_warm's resident working set is the
+  item-4 admission number.
+- **PT602 sharding-efficiency law**: per-role bytes/device must match
+  the program's declared scaling (zero1 slots ~1/N over data, pipeline
+  stacked body ~1/S over pipe, the TP table ~1/M over model). The FSDP
+  PR's "param bytes ~1/N" lands against this rule.
+- **PT603 donation honesty**: every donated leaf the jaxpr audit
+  (PT202) records as aliasable must reach the compiled executable's
+  ``input_output_alias``/``buffer_donor`` set, and aliasing must
+  actually shrink the footprint (``alias_size_in_bytes > 0``) — not
+  just carry the StableHLO annotation.
+- **PT604 temp blow-up**: no single temp buffer may exceed the
+  program's total per-device param bytes (floored at ``BIG_BYTES`` so
+  tiny audit models don't false-positive) — the
+  full-gather-materialization smell FSDP must not regress into.
+- **PT605 static-vs-runtime agreement**: the manifest's per-role
+  bytes/device must reconcile exactly with
+  ``utils/profiler.memory_stats`` on the same params / opt_state /
+  activations — one invariant enforced from both sides (the
+  ``assert_mask_f32`` pattern).
+
+Heavy imports (jax, the program builders) stay inside functions:
+pass 1/3 and ``--fast`` must not pay them.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from paddle_tpu.analysis.findings import Finding
+from paddle_tpu.analysis.shard_audit import (BIG_BYTES, CompiledProgram,
+                                             PROGRAM_NAMES,
+                                             compile_programs)
+
+# the pinned manifest fields, in budget/report order; all per-device
+MANIFEST_FIELDS = ("arg_bytes", "out_bytes", "temp_bytes", "alias_bytes",
+                   "resident_bytes", "param_bytes", "slot_bytes",
+                   "act_bytes")
+
+# compiled-HLO opcodes whose result is not its own device allocation:
+# parameters are argument bytes, tuples/GTEs/bitcasts alias existing
+# buffers, while/conditional/call results alias their body buffers
+_NON_ALLOC_OPS = {"parameter", "tuple", "get-tuple-element", "bitcast",
+                  "while", "conditional", "call"}
+
+_HLO_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(\([^=]*?\)|\S+)\s+([a-z][\w\-]*)\(")
+_ALIAS_ENTRY_RE = re.compile(r"\}:\s*\((\d+),")
+_DONOR_ENTRY_RE = re.compile(r"\((\d+),")
+
+# the ZeRO-1 fused all-gather result is the packed param set plus its
+# chunk padding (optim/zero1.py rounds each leaf up to a multiple of
+# the shard count) — a legitimate buffer a hair over param bytes; the
+# smell PT604 hunts is a MULTIPLE of the param set, so a few percent
+# of pack slack never masks it
+PACK_SLACK = 1.05
+
+
+# ============================================================ mem budget
+def default_budget_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "mem_budget.toml")
+
+
+class MemBudgetEntry:
+    __slots__ = ("program",) + MANIFEST_FIELDS
+
+    def __init__(self):
+        self.program = ""
+        for f in MANIFEST_FIELDS:
+            setattr(self, f, 0)
+
+
+def load_mem_budget(path: Optional[str] = None) -> List[MemBudgetEntry]:
+    """Parse ``mem_budget.toml`` (the shared TOML-subset table parser
+    from baseline.py). Unlike the comm budget, zero is a legal pinned
+    value for most fields (seq_ring donates nothing, so its alias
+    bytes ARE 0) — only ``arg_bytes`` must be >= 1 (a program with no
+    argument bytes was not compiled from real inputs), and
+    ``resident_bytes`` must reconcile with its components so a hand
+    edit cannot silently break the admission number."""
+    from paddle_tpu.analysis.baseline import parse_toml_tables
+    path = path or default_budget_path()
+    if not os.path.exists(path):
+        return []
+    entries = parse_toml_tables(
+        path, "mem budget", "[[memory]]", MemBudgetEntry,
+        int_keys=MANIFEST_FIELDS, str_keys=("program",))
+    seen: Dict[str, int] = {}
+    for e in entries:
+        if not e.program:
+            raise ValueError(
+                f"mem budget {path}: every [[memory]] needs program=")
+        if e.arg_bytes < 1:
+            raise ValueError(
+                f"mem budget {path}: entry for {e.program} needs "
+                "arg_bytes >= 1 (every compiled program has argument "
+                "bytes; a zero here means the pin was never generated)")
+        for f in MANIFEST_FIELDS:
+            if getattr(e, f) < 0:
+                raise ValueError(
+                    f"mem budget {path}: entry for {e.program} has "
+                    f"negative {f}")
+        derived = (e.arg_bytes + e.out_bytes + e.temp_bytes
+                   - e.alias_bytes)
+        if e.resident_bytes != derived:
+            raise ValueError(
+                f"mem budget {path}: entry for {e.program} pins "
+                f"resident_bytes={e.resident_bytes} but arg+out+temp"
+                f"-alias = {derived} — the admission number must "
+                "reconcile with its components")
+        if e.program in seen:
+            raise ValueError(
+                f"mem budget {path}: duplicate entry for "
+                f"{e.program} — merge-conflict leftovers would "
+                "silently resolve to the last one")
+        seen[e.program] = 1
+    return entries
+
+
+# ===================================================== manifest extraction
+def _leaf_rows(cp: CompiledProgram) -> List[Tuple[Optional[int], int,
+                                                  str, object, object]]:
+    """``(flat_hlo_param_idx, argnum, path, leaf, compiled_sharding)``
+    per input leaf. Shardings come from the COMPILED executable — what
+    the partitioner actually placed — not from the arg arrays; PT605
+    closes the loop against the array side. A leaf jit PRUNED from the
+    executable (an unused rng key / step counter: its sharding subtree
+    is ``None``) gets ``(None, ..., sharding=None)`` — it occupies no
+    device bytes and no HLO parameter slot."""
+    import jax.tree_util as jtu
+    in_shardings = cp.compiled.input_shardings[0]
+    rows: List[Tuple[Optional[int], int, str, object, object]] = []
+    flat_idx = 0
+    for argnum, arg in enumerate(cp.spec.args):
+        flat, _ = jtu.tree_flatten_with_path(arg)
+        stree = (in_shardings[argnum] if argnum < len(in_shardings)
+                 else None)
+        sflat, _ = jtu.tree_flatten_with_path(stree)
+        by_path = {jtu.keystr(p): s for p, s in sflat}
+        leaf_paths = {jtu.keystr(p) for p, _l in flat}
+        extra = sorted(set(by_path) - leaf_paths)[:3]
+        if extra:
+            raise RuntimeError(
+                f"{cp.spec.name}: arg {argnum} compiled shardings "
+                f"carry paths absent from the arg pytree ({extra}) — "
+                "the audit's leaf/parameter alignment broke")
+        for path, leaf in flat:
+            key = jtu.keystr(path)
+            sharding = by_path.get(key)
+            rows.append((flat_idx if sharding is not None else None,
+                         argnum, key, leaf, sharding))
+            if sharding is not None:
+                flat_idx += 1
+    return rows
+
+
+def _leaf_device_bytes(leaf, sharding) -> int:
+    """Bytes ONE device holds for a leaf under the compiled sharding
+    (the ``utils/profiler._leaf_device_bytes`` accounting, applied to
+    the partitioner's own placement)."""
+    shape = tuple(getattr(leaf, "shape", ()))
+    dtype = getattr(leaf, "dtype", None)
+    if dtype is None:
+        return 0
+    if sharding is not None and hasattr(sharding, "shard_shape"):
+        try:
+            shape = sharding.shard_shape(shape)
+        except (TypeError, ValueError):
+            pass
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * dtype.itemsize
+
+
+def role_bytes(cp: CompiledProgram) -> Dict[str, int]:
+    """Per-role per-device bytes from the compiled input shardings.
+    Roles a spec does not declare report 0; leaves no role claims
+    (rng keys, step counters) are deliberately unclassified."""
+    out = {"param_bytes": 0, "slot_bytes": 0, "act_bytes": 0}
+    key = {"params": "param_bytes", "opt_slots": "slot_bytes",
+           "acts": "act_bytes"}
+    for flat_idx, argnum, path, leaf, sharding in _leaf_rows(cp):
+        if flat_idx is None:
+            continue  # pruned from the executable: no device bytes
+        for role, rnum, pred in cp.spec.mem_roles:
+            if rnum == argnum and (pred is None or pred(path)):
+                out[key[role]] += _leaf_device_bytes(leaf, sharding)
+                break
+    return out
+
+
+def memory_manifest(cp: CompiledProgram) -> Dict[str, int]:
+    """The per-device memory manifest of one compiled program:
+    ``memory_analysis()`` totals + the role breakdown.
+    ``resident_bytes`` — arguments + outputs + temps − aliased — is
+    the resident working set a device needs to admit the program (the
+    ROADMAP item-4 admission number)."""
+    ma = cp.compiled.memory_analysis()
+    m = {"arg_bytes": int(ma.argument_size_in_bytes),
+         "out_bytes": int(ma.output_size_in_bytes),
+         "temp_bytes": int(ma.temp_size_in_bytes),
+         "alias_bytes": int(ma.alias_size_in_bytes)}
+    m["resident_bytes"] = (m["arg_bytes"] + m["out_bytes"]
+                           + m["temp_bytes"] - m["alias_bytes"])
+    m.update(role_bytes(cp))
+    return m
+
+
+def format_mem_manifest(m: Dict[str, int]) -> str:
+    return (f"resident {m['resident_bytes']}B (arg {m['arg_bytes']} + "
+            f"out {m['out_bytes']} + temp {m['temp_bytes']} - alias "
+            f"{m['alias_bytes']}); roles param {m['param_bytes']} / "
+            f"slot {m['slot_bytes']} / act {m['act_bytes']}")
+
+
+# ================================================================ PT601
+def check_mem_budget(program: str, manifest: Dict[str, int],
+                     entries: List[MemBudgetEntry], anchor: str,
+                     budget_rel: str) -> Tuple[List[Finding], List[int]]:
+    """Exact two-sided comparison of one program's manifest against its
+    pinned entry. Returns (findings, indices of entries consumed)."""
+    findings: List[Finding] = []
+    used: List[int] = []
+    hit = None
+    for i, e in enumerate(entries):
+        if e.program == program:
+            hit = (i, e)
+            break
+    if hit is None:
+        findings.append(Finding(
+            "PT601", budget_rel, 1,
+            f"{program}: UNPINNED traced program — every program's "
+            f"memory manifest must be committed ({format_mem_manifest(manifest)}); "
+            f"add its [[memory]] entry to {budget_rel}"))
+        return findings, used
+    i, e = hit
+    used.append(i)
+    for f in MANIFEST_FIELDS:
+        cur, pin = manifest[f], getattr(e, f)
+        if cur > pin:
+            findings.append(Finding(
+                "PT601", anchor, 1,
+                f"{program}: {f} GREW past its budget: {cur} vs "
+                f"pinned {pin} — per-device footprint drift (the "
+                "silently-replicated-buffer class); fix the program "
+                f"or justify the new pin in {budget_rel}"))
+        elif cur < pin:
+            findings.append(Finding(
+                "PT601", budget_rel, 1,
+                f"{program}: {f} SHRANK to {cur} vs pinned {pin} — "
+                "tighten the budget entry (the budget only shrinks; "
+                "lock the win in)"))
+    return findings, used
+
+
+def stale_mem_budget_findings(entries: List[MemBudgetEntry], used,
+                              budget_rel: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for i, e in enumerate(entries):
+        if i in used:
+            continue
+        why = ("names unknown program " + repr(e.program)
+               if e.program not in PROGRAM_NAMES
+               else "was not consumed by the traced programs")
+        findings.append(Finding(
+            "PT601", budget_rel, 1,
+            f"STALE mem budget entry (program={e.program}) {why} — "
+            "delete it (the budget only shrinks)"))
+    return findings
+
+
+# ================================================================ PT602
+def scaling_findings(cp: CompiledProgram) -> List[Finding]:
+    """Each declared law: the selected leaves' per-device bytes (under
+    the COMPILED shardings) must stay within global/divisor * slack. A
+    law whose selector matches nothing is itself a finding — a renamed
+    key must not silently vacate the contract."""
+    findings: List[Finding] = []
+    if not cp.spec.mem_laws:
+        return findings
+    rows = _leaf_rows(cp)
+    for label, argnum, pred, divisor, slack in cp.spec.mem_laws:
+        global_b = 0
+        device_b = 0
+        matched = 0
+        for flat_idx, anum, path, leaf, sharding in rows:
+            if anum != argnum or (pred is not None and not pred(path)):
+                continue
+            matched += 1
+            global_b += _leaf_device_bytes(leaf, None)
+            if flat_idx is not None:  # pruned leaves hold no bytes
+                device_b += _leaf_device_bytes(leaf, sharding)
+        if not matched:
+            findings.append(Finding(
+                "PT602", cp.spec.anchor, 1,
+                f"{cp.spec.name}: scaling law {label!r} selects no "
+                "input leaf — the law's selector no longer matches "
+                "the program (audit contract broke; fix the selector "
+                "or the program)"))
+            continue
+        allowed = int(global_b / divisor * slack)
+        if device_b > allowed:
+            findings.append(Finding(
+                "PT602", cp.spec.anchor, 1,
+                f"{cp.spec.name}: scaling law {label!r} VIOLATED — "
+                f"{matched} leaves hold {device_b} bytes/device vs "
+                f"allowed {allowed} ({global_b} global / {divisor}, "
+                f"slack {slack}) — the program's promised per-device "
+                "scaling regressed toward replication"))
+    return findings
+
+
+# ================================================================ PT603
+def _brace_block(text: str, key: str) -> str:
+    """The brace-balanced payload of ``key={...}`` in HLO header text
+    (the entries themselves contain nested ``{0}: (0, {}, ...)``
+    braces, which a regex alternation mis-scans)."""
+    i = text.find(key + "={")
+    if i < 0:
+        return ""
+    j = i + len(key) + 2
+    depth = 1
+    start = j
+    while j < len(text) and depth:
+        if text[j] == "{":
+            depth += 1
+        elif text[j] == "}":
+            depth -= 1
+        j += 1
+    return text[start:j - 1]
+
+
+def _compiled_alias_params(hlo: str) -> set:
+    """Flat parameter numbers the compiled module records as aliased
+    (``input_output_alias``) or donated (``buffer_donor``)."""
+    params: set = set()
+    params.update(int(p) for p in _ALIAS_ENTRY_RE.findall(
+        _brace_block(hlo, "input_output_alias")))
+    params.update(int(p) for p in _DONOR_ENTRY_RE.findall(
+        _brace_block(hlo, "buffer_donor")))
+    return params
+
+
+def donation_findings(cp: CompiledProgram,
+                      manifest: Dict[str, int]) -> List[Finding]:
+    """Donation honesty: every donated leaf whose (shape, dtype)
+    matches an output leaf — the same aliasing precondition PT202
+    checks at the StableHLO level — must appear in the COMPILED
+    module's ``input_output_alias``/``buffer_donor`` header, and when
+    any such leaf exists the executable's alias bytes must be > 0 (the
+    annotation must shrink the argument+temp footprint, not just ride
+    along)."""
+    import jax
+    spec = cp.spec
+    findings: List[Finding] = []
+    if not spec.donated:
+        return findings
+    rows = _leaf_rows(cp)
+    out_pool: Dict[Tuple[Tuple[int, ...], str], int] = {}
+    for leaf in jax.tree_util.tree_leaves(
+            jax.eval_shape(spec.fn, *spec.args)):
+        k = (tuple(leaf.shape), str(leaf.dtype))
+        out_pool[k] = out_pool.get(k, 0) + 1
+    compiled_set = _compiled_alias_params(cp.hlo)
+    aliasable = 0
+    for flat_idx, argnum, path, leaf, _s in rows:
+        if argnum not in spec.donated or flat_idx is None:
+            continue
+        k = (tuple(getattr(leaf, "shape", ())),
+             str(getattr(leaf, "dtype", "")))
+        if out_pool.get(k, 0) <= 0:
+            continue
+        out_pool[k] -= 1
+        aliasable += 1
+        if flat_idx not in compiled_set:
+            findings.append(Finding(
+                "PT603", spec.anchor, 1,
+                f"{spec.name}: donated leaf arg{argnum}{path} "
+                f"(shape {k[0]}, {k[1]}) is aliasable but missing "
+                "from the compiled module's input_output_alias/"
+                "buffer_donor set — the donation annotation did not "
+                "survive compilation; the device will hold input AND "
+                "output copies"))
+    if aliasable and manifest["alias_bytes"] == 0:
+        findings.append(Finding(
+            "PT603", spec.anchor, 1,
+            f"{spec.name}: {aliasable} donated leaves are aliasable "
+            "but the compiled executable aliases 0 bytes — donation "
+            "carries the annotation without shrinking the "
+            "argument+temp footprint"))
+    return findings
+
+
+# ================================================================ PT604
+def largest_temp(hlo: str) -> Tuple[int, str]:
+    """(bytes, description) of the largest single allocated buffer in
+    the compiled module, skipping fusion bodies (their intermediates
+    stay virtual) and non-allocating opcodes."""
+    from paddle_tpu.analysis.shard_audit import _shape_bytes
+    best, what = 0, ""
+    in_fused = False
+    for line in hlo.splitlines():
+        if line and not line[0].isspace():
+            # a computation header: %name (args) -> result {  /  ENTRY
+            in_fused = "fused_computation" in line
+            continue
+        if in_fused:
+            continue
+        m = _HLO_INSTR_RE.match(line)
+        if m is None:
+            continue
+        shape_txt, op = m.group(1), m.group(2)
+        if op in _NON_ALLOC_OPS or op.endswith("-done"):
+            continue
+        # an async -start result tuple carries BOTH the operand and
+        # output buffers; count only the output half, so a sync<->
+        # async spelling flip cannot double-count into a false PT604
+        # (the same rule pass 4's byte accounting applies)
+        nbytes = _shape_bytes(shape_txt,
+                              async_start=op.endswith("-start"))
+        if nbytes > best:
+            best, what = nbytes, f"{op} -> {shape_txt.strip()}"
+    return best, what
+
+
+def temp_findings(cp: CompiledProgram,
+                  manifest: Dict[str, int]) -> List[Finding]:
+    threshold = int(max(manifest["param_bytes"], BIG_BYTES) * PACK_SLACK)
+    nbytes, what = largest_temp(cp.hlo)
+    if nbytes > threshold:
+        return [Finding(
+            "PT604", cp.spec.anchor, 1,
+            f"{cp.spec.name}: single temp buffer of {nbytes} bytes "
+            f"({what}) exceeds the program's total per-device param "
+            f"bytes ({manifest['param_bytes']}, floor {BIG_BYTES}, "
+            f"pack slack {PACK_SLACK}) — "
+            "the full-gather-materialization smell; the program "
+            "materializes more than one full copy of its state in "
+            "one buffer")]
+    return []
+
+
+# ================================================================ PT605
+def reconcile_findings(cp: CompiledProgram,
+                       manifest: Dict[str, int]) -> List[Finding]:
+    """Static-vs-runtime agreement: the compiled manifest's role bytes
+    must equal ``utils/profiler.memory_stats`` on the same state. The
+    profiler reads the ARRAYS' shardings; the manifest reads the
+    PARTITIONER's — when they disagree, either the profiler lies to
+    the bench/admission path or the compiled placement drifted."""
+    from paddle_tpu.utils.profiler import memory_stats
+    spec = cp.spec
+    findings: List[Finding] = []
+    roles = {r: argnum for r, argnum, _p in spec.mem_roles}
+    params = spec.args[roles["params"]] if "params" in roles else {}
+    opt_state = (spec.args[roles["opt_slots"]]
+                 if "opt_slots" in roles else None)
+    # activations: only the leaves the executable CONSUMES — a feed
+    # field jit prunes (serving feeds carry label slots _infer never
+    # reads) holds no device bytes, and the profiler must be handed
+    # the same live set or the comparison measures the feeder, not
+    # the program
+    act_argnums = {argnum for r, argnum, _p in spec.mem_roles
+                   if r == "acts"}
+    acts = [leaf for flat_idx, argnum, _path, leaf, _s in _leaf_rows(cp)
+            if argnum in act_argnums and flat_idx is not None]
+    stats = memory_stats(params, opt_state,
+                         activations=acts or None)
+    pairs = [("param_bytes", "param_bytes_per_device", "params" in roles),
+             ("slot_bytes", "slot_bytes_per_device",
+              "opt_slots" in roles),
+             ("act_bytes", "act_bytes_per_device", bool(acts))]
+    for mkey, skey, declared in pairs:
+        if not declared:
+            continue
+        if manifest[mkey] != stats.get(skey):
+            findings.append(Finding(
+                "PT605", spec.anchor, 1,
+                f"{spec.name}: manifest {mkey}={manifest[mkey]} but "
+                f"utils/profiler.memory_stats reports {skey}="
+                f"{stats.get(skey)} on the same state — the static "
+                "audit and the runtime accounting disagree; one side "
+                "drifted (the profiler feeds the bench and the "
+                "admission path, the manifest feeds the ratchet)"))
+    return findings
+
+
+# ============================================================== the pass
+def audit_memory(cp: CompiledProgram, entries: List[MemBudgetEntry],
+                 budget_rel: str, log=None
+                 ) -> Tuple[List[Finding], List[int], Dict[str, int]]:
+    """All pass-5 checks for one compiled program."""
+    manifest = memory_manifest(cp)
+    findings, used = check_mem_budget(cp.spec.name, manifest, entries,
+                                      cp.spec.anchor, budget_rel)
+    findings.extend(scaling_findings(cp))
+    findings.extend(donation_findings(cp, manifest))
+    findings.extend(temp_findings(cp, manifest))
+    findings.extend(reconcile_findings(cp, manifest))
+    if log:
+        log(f"  {cp.spec.name}: {format_mem_manifest(manifest)}")
+    return findings, used, manifest
+
+
+def run_pass5(root: Optional[str] = None, log=print,
+              budget_path: Optional[str] = None,
+              programs: Optional[List[CompiledProgram]] = None
+              ) -> Tuple[List[Finding], Dict[str, Dict[str, int]]]:
+    """Audit every compiled program's per-device memory manifest
+    against the committed budget. Returns ``(findings, manifests)`` —
+    the manifests ride ``--json`` as the ``MEM_*`` snapshot family.
+    Pass ``programs`` from ``shard_audit.compile_programs`` to reuse
+    pass 4's compiles (the CLI does)."""
+    budget_path = budget_path or default_budget_path()
+    budget_rel = os.path.relpath(
+        budget_path, root or os.getcwd()).replace(os.sep, "/")
+    entries = load_mem_budget(budget_path)
+    findings: List[Finding] = []
+    manifests: Dict[str, Dict[str, int]] = {}
+    used: set = set()
+    for cp in programs if programs is not None else compile_programs():
+        fs, u, manifest = audit_memory(cp, entries, budget_rel, log=log)
+        findings.extend(fs)
+        used.update(u)
+        manifests[cp.spec.name] = manifest
+    findings.extend(stale_mem_budget_findings(entries, used, budget_rel))
+    return findings, manifests

@@ -555,10 +555,26 @@ def check_rule_table(rules, names: Iterable[str], anchor: str,
 # ======================================================== traced programs
 class ProgramSpec:
     """One traced parallel program: a jitted fn + committed-sharding
-    args + its mesh and contracts."""
+    args + its mesh and contracts (one build feeds BOTH pass 4 and
+    pass 5 — the ``build_scoring_predictor`` precedent).
+
+    Pass-5 (``mem_audit``) contract fields:
+
+    - ``mem_roles`` — ``(role, argnum, path-predicate-or-None)``
+      triples classifying input leaves into the manifest's role
+      breakdown (``params`` / ``opt_slots`` / ``acts``); leaves no
+      triple claims are unclassified scaffolding (rng keys, step
+      counters).
+    - ``mem_laws`` — ``(label, argnum, path-predicate, divisor,
+      slack)`` scaling laws (PT602): the selected leaves' per-device
+      bytes must stay within ``global_bytes / divisor * slack``.
+    - ``donated`` — the top-level argnums the program donates (PT603
+      checks their aliasable leaves reach the compiled alias set).
+    """
 
     def __init__(self, name: str, anchor: str, fn, args, mesh,
-                 must_shard=(), rule_tables=()):
+                 must_shard=(), rule_tables=(), mem_roles=(),
+                 mem_laws=(), donated=()):
         self.name = name
         self.anchor = anchor
         self.fn = fn
@@ -567,6 +583,43 @@ class ProgramSpec:
         self.must_shard = list(must_shard)
         # (rules, names, where) triples for PT505
         self.rule_tables = list(rule_tables)
+        self.mem_roles = list(mem_roles)
+        self.mem_laws = list(mem_laws)
+        self.donated = tuple(donated)
+
+
+class CompiledProgram:
+    """A ProgramSpec compiled ONCE on the virtual mesh; pass 4 reads
+    the optimized HLO for collectives, pass 5 reads the same
+    executable's memory analysis — one compile, two audits."""
+
+    def __init__(self, spec: ProgramSpec, compiled, hlo: str):
+        self.spec = spec
+        self.compiled = compiled
+        self.hlo = hlo
+
+
+def compile_program(spec: ProgramSpec) -> CompiledProgram:
+    import warnings
+
+    import jax
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # unusable-donation warnings
+        jitted = spec.fn if hasattr(spec.fn, "lower") else jax.jit(spec.fn)
+        compiled = jitted.lower(*spec.args).compile()
+        return CompiledProgram(spec, compiled, compiled.as_text())
+
+
+def compile_programs(log=None) -> List["CompiledProgram"]:
+    """Build + SPMD-compile every traced program (the expensive step,
+    shared by passes 4 and 5)."""
+    out = []
+    for build in PROGRAM_BUILDERS:
+        spec = build()
+        if log:
+            log(f"  compiling {spec.name}...")
+        out.append(compile_program(spec))
+    return out
 
 
 def _classifier_trainer(mesh, width=16, hidden=32, classes=4,
@@ -603,6 +656,14 @@ def _step_args(tr, feed):
     return (tr.params, tr.opt_state, feed, jax.random.PRNGKey(0), 0, None)
 
 
+# the train-step arg layout (params, opt_state, feed, rng, step, state):
+# the shared role classification every trainer-built program uses
+_TRAIN_ROLES = (("params", 0, None),
+                ("opt_slots", 1, lambda p: "'slots'" in p),
+                ("acts", 2, None))
+_TRAIN_DONATED = (0, 1)  # _build_train_step's donate_argnums
+
+
 def build_dp_train() -> ProgramSpec:
     """Plain data-parallel SGD: batch P(data) over all 8 devices,
     params replicated — the gradient all-reduce is the whole story."""
@@ -610,7 +671,8 @@ def build_dp_train() -> ProgramSpec:
     mesh = create_mesh(n_data=8)
     tr, feed = _classifier_trainer(mesh)
     return ProgramSpec("dp_train", "paddle_tpu/trainer/trainer.py",
-                       tr._train_step, _step_args(tr, feed), mesh)
+                       tr._train_step, _step_args(tr, feed), mesh,
+                       mem_roles=_TRAIN_ROLES, donated=_TRAIN_DONATED)
 
 
 def build_zero1() -> ProgramSpec:
@@ -627,9 +689,16 @@ def build_zero1() -> ProgramSpec:
     must = [(f"zero1 slot of {n!r}",
              (lambda p, n=n: "'slots'" in p and f"'{n}'" in p))
             for n in planned]
+
+    def planned_slot(p, names=tuple(planned)):
+        return "'slots'" in p and any(f"'{n}'" in p for n in names)
+
+    laws = [("zero1 planned slots shard ~1/8 over data", 1,
+             planned_slot, 8, 1.1)]
     return ProgramSpec("zero1", "paddle_tpu/optim/zero1.py",
                        tr._train_step, _step_args(tr, feed), mesh,
-                       must_shard=must)
+                       must_shard=must, mem_roles=_TRAIN_ROLES,
+                       mem_laws=laws, donated=_TRAIN_DONATED)
 
 
 def build_pipeline() -> ProgramSpec:
@@ -676,9 +745,19 @@ def build_pipeline() -> ProgramSpec:
     if tr._shard_rules:
         tables.append((tr._shard_rules, sorted(set(tr.params) | slot_names),
                        "trainer shard_rules (pipeline program)"))
+
+    def stacked_leaf(p, keys=tuple(stacked)):
+        return any(f"'{k}'" in p for k in keys)
+
+    laws = [("stage-stacked body params shard 1/4 over pipe", 0,
+             stacked_leaf, S, 1.05),
+            ("stage-stacked body slots shard 1/4 over pipe", 1,
+             (lambda p: "'slots'" in p and stacked_leaf(p)), S, 1.05)]
     return ProgramSpec("pipeline", "paddle_tpu/parallel/pipeline.py",
                        tr._train_step, _step_args(tr, feed), mesh,
-                       must_shard=must, rule_tables=tables)
+                       must_shard=must, rule_tables=tables,
+                       mem_roles=_TRAIN_ROLES, mem_laws=laws,
+                       donated=_TRAIN_DONATED)
 
 
 def build_tp_embed() -> ProgramSpec:
@@ -717,9 +796,13 @@ def build_tp_embed() -> ProgramSpec:
              lambda p: "'_emb.w0'" in p)]
     tables = [(tr._shard_rules, sorted(tr.params),
                "trainer shard_rules (tp_embed program)")]
+    laws = [("model-sharded table '_emb.w0' shards 1/2 over model", 0,
+             (lambda p: "'_emb.w0'" in p), 2, 1.05)]
     return ProgramSpec("tp_embed", "paddle_tpu/parallel/mesh.py",
                        tr._train_step, _step_args(tr, feed), mesh,
-                       must_shard=must, rule_tables=tables)
+                       must_shard=must, rule_tables=tables,
+                       mem_roles=_TRAIN_ROLES, mem_laws=laws,
+                       donated=_TRAIN_DONATED)
 
 
 def build_seq_ring() -> ProgramSpec:
@@ -749,7 +832,8 @@ def build_seq_ring() -> ProgramSpec:
         for i in range(3))
     mask = jax.device_put(jnp.ones((B, T), jnp.float32), mspec)
     return ProgramSpec("seq_ring", "paddle_tpu/parallel/ring.py",
-                       fn, (q, k, v, mask), mesh)
+                       fn, (q, k, v, mask), mesh,
+                       mem_roles=[("acts", i, None) for i in range(4)])
 
 
 def build_serving_warm() -> ProgramSpec:
@@ -761,7 +845,9 @@ def build_serving_warm() -> ProgramSpec:
     import jax
     fn = jax.jit(pred._infer, donate_argnums=(1,))
     return ProgramSpec("serving_warm", "paddle_tpu/serving/predictor.py",
-                       fn, args, None)
+                       fn, args, None,
+                       mem_roles=(("params", 0, None), ("acts", 1, None)),
+                       donated=(1,))
 
 
 PROGRAM_BUILDERS: List[Callable[[], ProgramSpec]] = [
@@ -774,20 +860,14 @@ PROGRAM_NAMES = ("dp_train", "zero1", "pipeline", "tp_embed",
 
 
 # ============================================================== the pass
-def audit_program(spec: ProgramSpec, entries: List[BudgetEntry],
+def audit_program(cp: CompiledProgram, entries: List[BudgetEntry],
                   budget_rel: str, log=None
                   ) -> Tuple[List[Finding], List[int]]:
-    """All pass-4 checks for one traced program."""
-    import warnings
-
+    """All pass-4 checks for one compiled program."""
     import jax
+    spec = cp.spec
     findings: List[Finding] = []
-    with warnings.catch_warnings():
-        warnings.simplefilter("ignore")  # unusable-donation warnings
-        lowered = spec.fn.lower(*spec.args) if hasattr(spec.fn, "lower") \
-            else jax.jit(spec.fn).lower(*spec.args)
-        hlo = lowered.compile().as_text()
-    manifest = collect_manifest(hlo, spec.mesh)
+    manifest = collect_manifest(cp.hlo, spec.mesh)
     bfind, used = check_budget(spec.name, manifest, entries,
                                spec.anchor, budget_rel)
     findings.extend(bfind)
@@ -805,18 +885,21 @@ def audit_program(spec: ProgramSpec, entries: List[BudgetEntry],
 
 
 def run_pass4(root: Optional[str] = None, log=print,
-              budget_path: Optional[str] = None) -> List[Finding]:
+              budget_path: Optional[str] = None,
+              programs: Optional[List[CompiledProgram]] = None
+              ) -> List[Finding]:
     """Trace, partition, and audit all parallel programs; enforce the
-    committed collective budget including its stale-entry policy."""
+    committed collective budget including its stale-entry policy.
+    ``programs`` lets the CLI compile once and feed both pass 4 and
+    pass 5 (``mem_audit.run_pass5``) from the same executables."""
     budget_path = budget_path or default_budget_path()
     budget_rel = os.path.relpath(
         budget_path, root or os.getcwd()).replace(os.sep, "/")
     entries = load_budget(budget_path)
     findings: List[Finding] = []
     used: set = set()
-    for build in PROGRAM_BUILDERS:
-        spec = build()
-        fs, u = audit_program(spec, entries, budget_rel, log=log)
+    for cp in programs if programs is not None else compile_programs():
+        fs, u = audit_program(cp, entries, budget_rel, log=log)
         findings.extend(fs)
         used.update(u)
     findings.extend(stale_budget_findings(entries, used, budget_rel))

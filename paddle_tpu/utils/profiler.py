@@ -63,8 +63,14 @@ def tree_device_bytes(tree) -> int:
 
 
 def device_peak_bytes():
-    """Device-reported peak allocation (TPU/GPU ``memory_stats``;
-    None on backends that don't expose it, e.g. CPU)."""
+    """Device-reported peak allocation (TPU/GPU ``memory_stats``).
+
+    Returns ``None`` — NOT 0 — on backends that don't expose the
+    counter (XLA:CPU among them, so every off-tunnel run): ``None``
+    means "unmeasured", and treating it as 0 would make a CPU dryrun
+    look like it fits any admission budget. Callers must branch on
+    ``is None`` (``memory_stats`` omits the key entirely in that
+    case)."""
     try:
         stats = jax.local_devices()[0].memory_stats()
     except Exception:  # noqa: BLE001 — absent on some backends
@@ -74,18 +80,44 @@ def device_peak_bytes():
     return stats.get("peak_bytes_in_use")
 
 
-def memory_stats(params, opt_state=None) -> dict:
-    """Per-device memory accounting for the training state: parameter
-    bytes, optimizer-slot bytes (the quantity ZeRO-1 divides by the
-    data-parallel degree), model-averaging bytes, and the device's peak
-    allocation when the backend reports one. The bench's ``--zero1`` A/B
-    and ``--show_step_breakdown`` both read this."""
+def memory_stats(params, opt_state=None, activations=None,
+                 temp_estimator=None) -> dict:
+    """Per-device memory accounting for the training state. The
+    bench's ``--zero1`` A/B, ``--show_step_breakdown``, and graftlint
+    pass 5 (PT605 reconciles the compiled manifest against this exact
+    accounting) all read it, so the return schema is a contract:
+
+    - ``param_bytes_per_device`` (always) — parameter bytes one
+      device holds under the leaves' shardings.
+    - ``slot_bytes_per_device`` (when ``opt_state`` is a dict) —
+      optimizer-slot bytes (``opt_state["slots"]``; the quantity
+      ZeRO-1 divides by the data-parallel degree).
+    - ``avg_bytes_per_device`` (when ``opt_state`` carries ``avg``) —
+      model-averaging shadow bytes.
+    - ``act_bytes_per_device`` (when ``activations`` is given) —
+      bytes of a representative input batch / activation pytree, the
+      live-input side of the serving admission number.
+    - ``temp_bytes_per_device`` (when ``temp_estimator`` is given and
+      returns a number) — XLA scratch estimate for the compiled step;
+      pass e.g. ``lambda: compiled.memory_analysis()
+      .temp_size_in_bytes`` so admission can account scratch without
+      this module importing the executable.
+    - ``device_peak_bytes`` (only when the backend reports one) — the
+      device's peak allocation; ABSENT on XLA:CPU (see
+      ``device_peak_bytes`` — None/absent means unmeasured, never 0).
+    """
     out = {"param_bytes_per_device": tree_device_bytes(params)}
     if opt_state is not None and isinstance(opt_state, dict):
         out["slot_bytes_per_device"] = tree_device_bytes(
             opt_state.get("slots", {}))
         if "avg" in opt_state:
             out["avg_bytes_per_device"] = tree_device_bytes(opt_state["avg"])
+    if activations is not None:
+        out["act_bytes_per_device"] = tree_device_bytes(activations)
+    if temp_estimator is not None:
+        temp = temp_estimator()
+        if temp is not None:
+            out["temp_bytes_per_device"] = int(temp)
     peak = device_peak_bytes()
     if peak is not None:
         out["device_peak_bytes"] = int(peak)

@@ -1560,7 +1560,8 @@ def test_json_output_round_trips_findings(tmp_path, capsys):
     from paddle_tpu.analysis.__main__ import run
     (tmp_path / "BENCH_r99.json").write_text('{"metric": ""}')
     rc = run(["--root", str(tmp_path), "--json", "--skip-ast",
-              "--skip-locks", "--skip-jaxpr", "--skip-shard"])
+              "--skip-locks", "--skip-jaxpr", "--skip-shard",
+              "--skip-mem"])
     out = capsys.readouterr().out
     doc = json.loads(out)
     assert rc == 1
@@ -1588,6 +1589,7 @@ def test_json_output_exit2_still_emits_one_object(tmp_path, capsys):
     bad_baseline.write_text("[[suppress]]\nrule = ???\n")
     rc = run(["--root", str(tmp_path), "--json", "--skip-ast",
               "--skip-locks", "--skip-jaxpr", "--skip-shard",
+              "--skip-mem",
               "--baseline", str(bad_baseline)])
     doc = json.loads(capsys.readouterr().out)
     assert rc == 2
@@ -1602,8 +1604,291 @@ def test_json_output_clean_tree_exits_zero(tmp_path, capsys):
     (tmp_path / "BENCH_r99.json").write_text(
         '{"metric": "steps", "platform": "cpu", "a": 1.0, "b": 2.0}')
     rc = run(["--root", str(tmp_path), "--json", "--skip-ast",
-              "--skip-locks", "--skip-jaxpr", "--skip-shard"])
+              "--skip-locks", "--skip-jaxpr", "--skip-shard",
+              "--skip-mem"])
     doc = json.loads(capsys.readouterr().out)
     assert rc == 0
     assert doc["findings"] == [] and doc["counts"] == {}
     assert doc["pass4_s"] is None  # pass 4 skipped: no wall time
+
+
+# ======================================================= pass 5 (mem)
+# Per-device memory-footprint audit: budget ratchet (PT601), scaling
+# laws (PT602), donation honesty (PT603), temp blow-up (PT604), and
+# the static-vs-runtime reconciliation (PT605).
+
+from paddle_tpu.analysis import mem_audit as mem  # noqa: E402
+
+
+def _mem_spec(fn, args, mesh, **kw):
+    return sa.ProgramSpec("fixture", "x.py", fn, args, mesh, **kw)
+
+
+def _mem_entry(program, **fields):
+    e = mem.MemBudgetEntry()
+    e.program = program
+    for k, v in fields.items():
+        setattr(e, k, v)
+    return e
+
+
+_GOOD_MEM_TOML = ("[[memory]]\n"
+                  'program = "zero1"\n'
+                  "arg_bytes = 100\n"
+                  "out_bytes = 90\n"
+                  "temp_bytes = 50\n"
+                  "alias_bytes = 40\n"
+                  "resident_bytes = 200\n"
+                  "param_bytes = 60\n"
+                  "slot_bytes = 20\n"
+                  "act_bytes = 10\n")
+
+
+def test_mem_budget_parses_and_validates_entries(tmp_path):
+    p = tmp_path / "mem_budget.toml"
+    p.write_text("# pinned\n" + _GOOD_MEM_TOML)
+    (e,) = mem.load_mem_budget(str(p))
+    assert (e.program, e.arg_bytes, e.resident_bytes) == ("zero1", 100,
+                                                          200)
+    # program is mandatory
+    p.write_text("[[memory]]\narg_bytes = 1\n")
+    with pytest.raises(ValueError, match="needs program="):
+        mem.load_mem_budget(str(p))
+    # arg_bytes >= 1: a zero means the pin was never generated
+    p.write_text(_GOOD_MEM_TOML.replace("arg_bytes = 100",
+                                        "arg_bytes = 0"))
+    with pytest.raises(ValueError, match="arg_bytes >= 1"):
+        mem.load_mem_budget(str(p))
+    # the admission number must reconcile with its components
+    p.write_text(_GOOD_MEM_TOML.replace("resident_bytes = 200",
+                                        "resident_bytes = 150"))
+    with pytest.raises(ValueError, match="reconcile with its "
+                                         "components"):
+        mem.load_mem_budget(str(p))
+    # duplicate program: merge leftovers must not last-wins
+    p.write_text(_GOOD_MEM_TOML + _GOOD_MEM_TOML)
+    with pytest.raises(ValueError, match="duplicate entry"):
+        mem.load_mem_budget(str(p))
+
+
+# -------------------------------------------------------------- PT601
+def _manifest(**over):
+    m = {"arg_bytes": 100, "out_bytes": 90, "temp_bytes": 50,
+         "alias_bytes": 40, "resident_bytes": 200, "param_bytes": 60,
+         "slot_bytes": 20, "act_bytes": 10}
+    m.update(over)
+    return m
+
+
+def test_pt601_growth_shrink_unpinned_and_exact():
+    pinned = _mem_entry("p", arg_bytes=100, out_bytes=90, temp_bytes=50,
+                        alias_bytes=40, resident_bytes=200,
+                        param_bytes=60, slot_bytes=20, act_bytes=10)
+    findings, used = mem.check_mem_budget("p", _manifest(), [pinned],
+                                          "x.py", "mem_budget.toml")
+    assert findings == [] and used == [0]
+    # growth = drift, anchored at the program
+    grew = _manifest(temp_bytes=51, resident_bytes=201)
+    findings, _ = mem.check_mem_budget("p", grew, [pinned], "x.py",
+                                       "mem_budget.toml")
+    assert [f.rule for f in findings] == ["PT601", "PT601"]
+    assert "temp_bytes GREW" in findings[0].message
+    assert findings[0].path == "x.py"
+    # unpinned shrinkage fails too — the win must be locked in
+    shrank = _manifest(param_bytes=30, arg_bytes=70,
+                       resident_bytes=170)
+    findings, _ = mem.check_mem_budget("p", shrank, [pinned], "x.py",
+                                       "mem_budget.toml")
+    assert all(f.rule == "PT601" for f in findings)
+    assert any("SHRANK" in f.message for f in findings)
+    assert all(f.path == "mem_budget.toml" for f in findings)
+    # a traced program with no entry at all is a finding (memory is
+    # never zero — absence cannot mean "pinned empty" here)
+    findings, used = mem.check_mem_budget("p", _manifest(), [], "x.py",
+                                          "mem_budget.toml")
+    assert [f.rule for f in findings] == ["PT601"] and used == []
+    assert "UNPINNED" in findings[0].message
+
+
+def test_pt601_stale_mem_budget_entries_flag():
+    entries = [_mem_entry("zero1", arg_bytes=1),
+               _mem_entry("no_such_program", arg_bytes=1)]
+    findings = mem.stale_mem_budget_findings(entries, {0}, "b.toml")
+    assert [f.rule for f in findings] == ["PT601"]
+    assert "unknown program" in findings[0].message
+    findings = mem.stale_mem_budget_findings(
+        [_mem_entry("zero1", arg_bytes=1)], set(), "b.toml")
+    assert "was not consumed" in findings[0].message
+
+
+# -------------------------------------------------------------- PT602
+def test_pt602_replicated_breaks_law_and_sharded_twin_holds():
+    mesh = _mesh8()
+
+    def f(w):
+        return (w * 2.0).sum()
+
+    law = [("slots shard ~1/8 over data", 0, None, 8, 1.1)]
+    w_rep = jax.device_put(jnp.ones((256, 128)),
+                           NamedSharding(mesh, P()))
+    cp = sa.compile_program(_mem_spec(jax.jit(f), (w_rep,), mesh,
+                                      mem_laws=law))
+    findings = mem.scaling_findings(cp)
+    assert [f.rule for f in findings] == ["PT602"]
+    assert "VIOLATED" in findings[0].message
+    w_sh = jax.device_put(jnp.ones((256, 128)),
+                          NamedSharding(mesh, P("data")))
+    cp = sa.compile_program(_mem_spec(jax.jit(f), (w_sh,), mesh,
+                                      mem_laws=law))
+    assert mem.scaling_findings(cp) == []
+    # a law whose selector matches nothing is itself a finding — a
+    # renamed key must not silently vacate the contract
+    dead = [("law over nothing", 0, (lambda p: False), 8, 1.1)]
+    cp = sa.compile_program(_mem_spec(jax.jit(f), (w_sh,), mesh,
+                                      mem_laws=dead))
+    findings = mem.scaling_findings(cp)
+    assert [f.rule for f in findings] == ["PT602"]
+    assert "selects no input leaf" in findings[0].message
+
+
+# -------------------------------------------------------------- PT603
+def test_pt603_dropped_donation_flags_and_donated_twin_passes():
+    def f(x):
+        return x + 1.0
+
+    x = jnp.ones((64, 64))
+    # good twin: donation reaches the compiled module's alias header
+    cp = sa.compile_program(_mem_spec(
+        jax.jit(f, donate_argnums=(0,)), (x,), None, donated=(0,)))
+    manifest = mem.memory_manifest(cp)
+    assert manifest["alias_bytes"] == 64 * 64 * 4
+    assert mem.donation_findings(cp, manifest) == []
+    # bad twin: the spec CLAIMS donation but the executable was built
+    # without it — the annotation never reached compilation
+    cp = sa.compile_program(_mem_spec(jax.jit(f), (x,), None,
+                                      donated=(0,)))
+    manifest = mem.memory_manifest(cp)
+    assert manifest["alias_bytes"] == 0
+    findings = mem.donation_findings(cp, manifest)
+    assert findings and all(f.rule == "PT603" for f in findings)
+    assert any("missing from the compiled module" in f.message
+               for f in findings)
+    assert any("aliases 0 bytes" in f.message for f in findings)
+    # a program that donates nothing has nothing to prove
+    cp = sa.compile_program(_mem_spec(jax.jit(f), (x,), None))
+    assert mem.donation_findings(cp, mem.memory_manifest(cp)) == []
+
+
+# -------------------------------------------------------------- PT604
+def test_pt604_temp_blowup_flags_and_small_twin_passes():
+    def blowup(x):
+        # the (1024, 1024) intermediate (4 MiB) must MATERIALIZE as
+        # sort's operand — a single temp far past the params (= x,
+        # 4 KiB); sin() blocks the (x xT) x algebraic rewrite and a
+        # plain elementwise chain would loop-fuse away to temp 0
+        return jnp.sort(jnp.sin(jnp.outer(x, x)), axis=1).sum()
+
+    x = jnp.ones((1024,), jnp.float32)
+    cp = sa.compile_program(_mem_spec(
+        jax.jit(blowup), (x,), None, mem_roles=(("params", 0, None),)))
+    manifest = mem.memory_manifest(cp)
+    nbytes, what = mem.largest_temp(cp.hlo)
+    assert nbytes >= 1024 * 1024 * 4
+    findings = mem.temp_findings(cp, manifest)
+    assert [f.rule for f in findings] == ["PT604"]
+    assert "single temp buffer" in findings[0].message
+
+    def small(x):
+        return (x * 2.0).sum()
+
+    cp = sa.compile_program(_mem_spec(
+        jax.jit(small), (x,), None, mem_roles=(("params", 0, None),)))
+    assert mem.temp_findings(cp, mem.memory_manifest(cp)) == []
+
+
+def test_largest_temp_counts_async_start_output_half_only():
+    """A sync<->async collective spelling flip must not double-count
+    into a false PT604: the -start result tuple carries operand AND
+    output buffers, and only the output half allocates new bytes
+    (the same accounting pass 4's _shape_bytes applies)."""
+    sync = ("ENTRY %main (p: f32[8]) -> f32[8] {\n"
+            "  %ag = f32[64]{0} all-gather(f32[8]{0} %p), dimensions={0}\n"
+            "}\n")
+    async_ = ("ENTRY %main (p: f32[8]) -> f32[8] {\n"
+              "  %ag = (f32[8]{0}, f32[64]{0}) all-gather-start("
+              "f32[8]{0} %p), dimensions={0}\n"
+              "  %agd = f32[64]{0} all-gather-done(%ag)\n"
+              "}\n")
+    assert mem.largest_temp(sync)[0] == 64 * 4
+    assert mem.largest_temp(async_)[0] == 64 * 4
+
+
+# -------------------------------------------------------------- PT605
+def test_pt605_manifest_must_match_profiler_accounting():
+    mesh = _mesh8()
+
+    def f(w, batch):
+        return (batch @ w).sum()
+
+    w = jax.device_put(jnp.ones((128, 16)), NamedSharding(mesh, P()))
+    batch = jax.device_put(jnp.ones((8, 128)),
+                           NamedSharding(mesh, P("data")))
+    cp = sa.compile_program(_mem_spec(
+        jax.jit(f), (w, batch), mesh,
+        mem_roles=(("params", 0, None), ("acts", 1, None))))
+    manifest = mem.memory_manifest(cp)
+    assert manifest["param_bytes"] == 128 * 16 * 4  # replicated
+    assert manifest["act_bytes"] == 8 * 128 * 4 // 8  # 1/8 shard
+    assert mem.reconcile_findings(cp, manifest) == []
+    # tampered manifest (= a drifted static accounting) must flag
+    bad = dict(manifest)
+    bad["param_bytes"] += 4
+    findings = mem.reconcile_findings(cp, bad)
+    assert [f.rule for f in findings] == ["PT605"]
+    assert "memory_stats" in findings[0].message
+
+
+# ------------------------------------------------- PT401 MEM_* family
+def test_pt401_mem_artifact_shape(tmp_path):
+    good = {"programs": {"zero1": {"arg_bytes": 91504,
+                                   "resident_bytes": 236708}}}
+    p = tmp_path / "MEM_r15.json"
+    p.write_text(json.dumps(good))
+    assert check_bench_file(str(p), "MEM_r15.json") == []
+    # missing programs map
+    p.write_text(json.dumps({"zero1": {"arg_bytes": 1}}))
+    findings = check_bench_file(str(p), "MEM_r15.json")
+    assert [f.rule for f in findings] == ["PT401"]
+    assert "'programs'" in findings[0].message
+    # non-int / negative byte counts
+    p.write_text(json.dumps({"programs": {"zero1": {"arg_bytes": -1},
+                                          "bad": 7}}))
+    findings = check_bench_file(str(p), "MEM_r15.json")
+    assert findings and all(f.rule == "PT401" for f in findings)
+    assert any("non-negative int" in f.message for f in findings)
+    assert any("non-empty object" in f.message for f in findings)
+    # empty programs map recorded nothing
+    p.write_text(json.dumps({"programs": {}}))
+    findings = check_bench_file(str(p), "MEM_r15.json")
+    assert [f.rule for f in findings] == ["PT401"]
+
+
+def test_schema_check_scans_mem_pattern(tmp_path):
+    from paddle_tpu.analysis.bench_schema import run_schema_check
+    (tmp_path / "MEM_r15.json").write_text("{broken")
+    findings = run_schema_check(str(tmp_path))
+    assert [f.path for f in findings] == ["MEM_r15.json"]
+
+
+def test_json_output_carries_pass5_fields(tmp_path, capsys):
+    """The --json contract grew pass5_s and mem_manifest; when pass 5
+    is skipped both are null (the keys are always present so CI
+    consumers need no existence checks)."""
+    from paddle_tpu.analysis.__main__ import run
+    rc = run(["--root", str(tmp_path), "--json", "--skip-ast",
+              "--skip-locks", "--skip-jaxpr", "--skip-shard",
+              "--skip-mem"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert "pass5_s" in doc and doc["pass5_s"] is None
+    assert "mem_manifest" in doc and doc["mem_manifest"] is None

@@ -1,4 +1,4 @@
-"""Tier-1 enforcement: graftlint's four passes run CLEAN over this
+"""Tier-1 enforcement: graftlint's five passes run CLEAN over this
 repo with an EMPTY baseline.
 
 This is the test that turns the rule catalog from advice into an
@@ -6,8 +6,10 @@ invariant: a PR that closure-captures params into a jit, down-casts a
 mask, packs with jnp.pad, adds an unguarded hot-path jit, registers a
 layer without a grad-matrix row, inverts a lock order, commits a
 malformed evidence artifact, grows a parallel program's collective
-footprint past comm_budget.toml, drops a zero1 pin, or leaves a dead
-shard rule fails HERE, with file:line and a rule id.
+footprint past comm_budget.toml, drops a zero1 pin, leaves a dead
+shard rule, replicates a must-shard buffer past mem_budget.toml,
+un-donates an aliased leaf, or materializes a full-gather temp fails
+HERE, with file:line and a rule id.
 """
 
 import os
@@ -85,7 +87,17 @@ def test_pass2_jaxpr_audit_train_and_serving():
         findings, "Pass 2 (jaxpr audit) found violations:")
 
 
-def test_pass4_shard_audit_clean_and_budget_pins_all_programs():
+@pytest.fixture(scope="module")
+def compiled_programs():
+    """ONE SPMD-compile of the six traced programs feeding both the
+    pass-4 and pass-5 tier-1 tests — the same sharing the CLI does
+    (compile is the slowest step on the 1-core host)."""
+    from paddle_tpu.analysis.shard_audit import compile_programs
+    return compile_programs()
+
+
+def test_pass4_shard_audit_clean_and_budget_pins_all_programs(
+        compiled_programs):
     """The collective manifest of every traced parallel program —
     dp_train's grad all-reduce, zero1's ONE fused all-gather plus its
     pinned pack buffers, the GPipe handoff ppermutes, the TP model-axis
@@ -97,7 +109,7 @@ def test_pass4_shard_audit_clean_and_budget_pins_all_programs():
     from paddle_tpu.analysis.findings import format_report
     from paddle_tpu.analysis.shard_audit import (PROGRAM_NAMES,
                                                  load_budget, run_pass4)
-    findings = run_pass4(ROOT, log=None)
+    findings = run_pass4(ROOT, log=None, programs=compiled_programs)
     assert not findings, "\n" + format_report(
         findings, "Pass 4 (sharding/collective audit) found violations:")
     budgeted = {e.program for e in load_budget()}
@@ -108,6 +120,35 @@ def test_pass4_shard_audit_clean_and_budget_pins_all_programs():
     # serving stays collective-free BY ABSENCE: any collective it
     # grows is unbudgeted drift (PT501), so no entry may name it
     assert "serving_warm" not in budgeted
+
+
+def test_pass5_mem_audit_clean_and_budget_pins_all_programs(
+        compiled_programs):
+    """The per-device memory manifest of every traced program —
+    memory_analysis() totals, the params/slots/activations role split,
+    zero1's ~1/8 slot law, the pipeline 1/S stacked-body law, the TP
+    half-table law, donation reaching every compiled alias set —
+    matches mem_budget.toml exactly. Unlike the comm budget, EVERY
+    program must be pinned: serving_warm's resident working set is the
+    ROADMAP item-4 admission number, committed as an artifact. This is
+    the second half of the FSDP-refactor contract (pass 4 pins what
+    the programs communicate; this pins what they hold)."""
+    from paddle_tpu.analysis.findings import format_report
+    from paddle_tpu.analysis.mem_audit import load_mem_budget, run_pass5
+    from paddle_tpu.analysis.shard_audit import PROGRAM_NAMES
+    findings, manifests = run_pass5(ROOT, log=None,
+                                    programs=compiled_programs)
+    assert not findings, "\n" + format_report(
+        findings, "Pass 5 (memory-footprint audit) found violations:")
+    pinned = {e.program for e in load_mem_budget()}
+    assert pinned == set(PROGRAM_NAMES), (
+        "every traced program needs its memory manifest pinned "
+        f"(missing: {set(PROGRAM_NAMES) - pinned})")
+    # the item-4 admission number is a committed artifact
+    serving = {e.program: e for e in load_mem_budget()}["serving_warm"]
+    assert serving.resident_bytes > 0
+    assert manifests["serving_warm"]["resident_bytes"] == \
+        serving.resident_bytes
 
 
 def test_pass2_jaxpr_audit_entry():
