@@ -1367,14 +1367,179 @@ def bench_router_failover():
     return res
 
 
+def bench_fleet_trace(rounds=None, n_requests=None):
+    """Tracing pays for itself (``--fleet`` → BENCH_r15.json +
+    TRACE_r15.json): two replicas behind the router HTTP frontend,
+    scored sequentially with tracing OFF and ON in interleaved
+    best-of-R rounds (CLAUDE.md host-drift rule: a single A/B pair is
+    meaningless on this box — each mode keeps its best p50). Reported:
+    p50 per mode, the on-vs-off overhead in percent (asserted ≤ 5%, the
+    docs/observability.md policy bound), and the acceptance trace — one
+    scored request with an induced failover whose spans reconstruct the
+    client-observed latency (root ``client.request`` wall time within
+    5% of the measured call) with the failover visible as sibling
+    ``router.attempt`` spans; the trace dumps to ``TRACE_r15.json``
+    and must pass its own PT401 schema before this function returns."""
+    import statistics
+    import tempfile
+    import threading
+
+    import numpy as np
+    import jax
+    from paddle_tpu.config import dsl
+    from paddle_tpu.core.network import Network
+    from paddle_tpu.data import dense_vector, integer_value
+    from paddle_tpu.obs import trace as _trace
+    from paddle_tpu.serving import (EngineTransport, ReplicaRouter,
+                                    ServingClient, ServingEngine,
+                                    ServingPredictor,
+                                    make_router_server)
+    from paddle_tpu.testing import chaos
+
+    rounds = int(os.environ.get("BENCH_TRACE_ROUNDS", "3")
+                 if rounds is None else rounds)
+    n_requests = int(os.environ.get("BENCH_TRACE_REQUESTS", "30")
+                     if n_requests is None else n_requests)
+    dim, classes = 8, 4
+    dsl.reset()
+    x = dsl.data(name="x", size=dim)
+    lab = dsl.data(name="label", size=classes)
+    out = dsl.fc(input=x, size=classes, act="softmax", name="out")
+    dsl.classification_cost(input=out, label=lab, name="cost")
+    graph = dsl.current_graph()
+    params = Network(graph, outputs=["out"]).init_params(
+        jax.random.PRNGKey(0))
+    feeding = {"x": dense_vector(dim), "label": integer_value(classes)}
+    cache_dir = tempfile.mkdtemp(prefix="paddle_tpu_aot_trace_")
+
+    def build_engine():
+        pred = ServingPredictor(graph, params, ["out"], feeding,
+                                batch_buckets=[1, 2],
+                                aot_cache=cache_dir)
+        return ServingEngine(pred, max_batch=2, batch_timeout_ms=1.0,
+                             queue_depth=64).start(warmup=True)
+
+    engines = [build_engine() for _ in range(2)]
+    router = ReplicaRouter([EngineTransport(e) for e in engines],
+                           health_poll_ms=25.0).start()
+    server = make_router_server(router, port=0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    client = ServingClient(port=server.server_address[1])
+    sample = ((np.arange(dim, dtype=float) / dim).tolist(), 1)
+    client.score(sample)  # connection path + menu warm before timing
+
+    # ---- the A/B: interleaved PER REQUEST (host throughput drifts
+    # ±50% between windows — alternating modes request by request puts
+    # both arms under the same drift, and best-of-R rounds on top
+    # absorbs what alternation cannot), each mode keeps its best p50
+    ab_tracer = _trace.Tracer("bench", buffer=65536)
+
+    def p50_pair():
+        lat = {"off": [], "on": []}
+        for i in range(2 * n_requests):
+            mode = "on" if i % 2 else "off"
+            _trace.install(ab_tracer if mode == "on" else None)
+            t0 = time.perf_counter()
+            client.score(sample)
+            lat[mode].append(1e3 * (time.perf_counter() - t0))
+        _trace.install(None)
+        return (statistics.median(lat["off"]),
+                statistics.median(lat["on"]))
+
+    best = {"off": float("inf"), "on": float("inf")}
+    try:
+        for _ in range(rounds):
+            off, on = p50_pair()
+            best["off"] = min(best["off"], off)
+            best["on"] = min(best["on"], on)
+        overhead_pct = 1e2 * (best["on"] - best["off"]) / best["off"]
+
+        # ---- the acceptance trace: one scored request, induced
+        # failover, spans reconstruct the client measurement ----------
+        tracer = _trace.install(_trace.Tracer("bench"))
+        plan = chaos.FaultPlan(seed=15, faults=[
+            {"type": "drop", "site": "route_dispatch", "at": 1},
+            {"type": "delay", "site": "serve_batch", "at": 1,
+             "seconds": 0.05}])
+        with chaos.chaos_plan(plan):
+            t0 = time.perf_counter()
+            result = client.score(sample)
+            measured_ms = 1e3 * (time.perf_counter() - t0)
+        prov = result["provenance"]
+        tid = prov["trace_id"]
+        # the worker emits replica.score THEN its phase children; wait
+        # for phase.decode (the last write of that sequence) so the
+        # committed artifact always carries the full phase split
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            spans = tracer.spans(tid)
+            if any(s["name"] == "phase.decode" for s in spans):
+                break
+            time.sleep(0.01)
+        else:
+            raise RuntimeError(
+                "acceptance trace never grew its phase.decode span — "
+                "refusing to commit an incomplete TRACE artifact "
+                f"(got {sorted(s['name'] for s in spans)})")
+        attempts = [s for s in spans if s["name"] == "router.attempt"]
+        roots = [s for s in spans if s["name"] == "client.request"]
+        root_ms = roots[0]["dur_ms"] if roots else None
+    finally:
+        _trace.install(None)
+        server.shutdown()
+        server.server_close()  # free the listening socket, not just
+        # the accept loop — shutdown() alone backlog-blackholes
+        router.shutdown(drain=False)
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    trace_path = os.path.join(here, "TRACE_r15.json")
+    with open(trace_path, "w") as f:
+        json.dump({"metric": "failover_trace", "trace_id": tid,
+                   "client_measured_ms": round(measured_ms, 3),
+                   "spans": spans}, f, indent=1)
+    from paddle_tpu.analysis.bench_schema import check_bench_file
+    schema_findings = check_bench_file(trace_path, "TRACE_r15.json")
+    res = {
+        "trace_rounds": rounds,
+        "trace_requests_per_round": n_requests,
+        "trace_off_p50_ms": round(best["off"], 3),
+        "trace_on_p50_ms": round(best["on"], 3),
+        "trace_overhead_pct": round(overhead_pct, 2),
+        "trace_failovers": prov["failovers"],
+        "trace_attempt_spans": len(attempts),
+        "trace_span_count": len(spans),
+        "trace_client_measured_ms": round(measured_ms, 3),
+        "trace_root_span_ms": (round(root_ms, 3)
+                               if root_ms is not None else None),
+        "trace_root_delta_pct": (
+            round(1e2 * abs(measured_ms - root_ms) / measured_ms, 2)
+            if root_ms is not None else None),
+        "trace_schema_findings": len(schema_findings),
+    }
+    # acceptance, asserted where the evidence is made: the failover is
+    # two sibling attempts of ONE trace, the root span reconstructs the
+    # client measurement within 5%, the artifact passes its schema, and
+    # tracing costs ≤ 5% on the interleaved best-of p50 (honest about
+    # drift: both arms already kept their best round)
+    assert prov["failovers"] == 1 and len(attempts) == 2, res
+    assert len({a["parent_id"] for a in attempts}) == 1, res
+    assert root_ms is not None \
+        and abs(measured_ms - root_ms) <= 0.05 * measured_ms, res
+    assert schema_findings == [], [f.message for f in schema_findings]
+    assert overhead_pct <= 5.0, res
+    return res
+
+
 def fleet_main():
     """``python bench.py --fleet``: the off-tunnel fleet benches alone,
-    forced onto CPU; one JSON line, mirrored to BENCH_r14.json. Three
+    forced onto CPU; one JSON line, mirrored to BENCH_r15.json. Four
     scenarios in one artifact: the r13 cold-start A/B + replica-kill
     rounds (still the respawn-warmth evidence), the autoscale traffic
     ramp (replica count follows load inside [min, max], p99 bounded,
-    zero failed non-shed), and the router-kill HA failover (standby
-    answers within one health interval, zero failed non-shed)."""
+    zero failed non-shed), the router-kill HA failover (standby answers
+    within one health interval, zero failed non-shed), and the r15
+    tracing A/B (on-vs-off p50 overhead ≤ 5%, failover trace →
+    TRACE_r15.json)."""
     import jax
     jax.config.update("jax_platforms", "cpu")
     result = {"metric": "serving_fleet_autoscale_ha_failover",
@@ -1382,6 +1547,7 @@ def fleet_main():
     result.update(bench_fleet())
     result.update(bench_fleet_autoscale())
     result.update(bench_router_failover())
+    result.update(bench_fleet_trace())
     # the headline zero-drop number sums EVERY scenario's counter —
     # no failure hides behind a sibling scenario
     result["fleet_failed_non_shed"] = (
@@ -1391,7 +1557,7 @@ def fleet_main():
     line = json.dumps(result)
     print(line, flush=True)
     here = os.path.dirname(os.path.abspath(__file__))
-    with open(os.path.join(here, "BENCH_r14.json"), "w") as f:
+    with open(os.path.join(here, "BENCH_r15.json"), "w") as f:
         f.write(line + "\n")
     return 0
 
@@ -1576,6 +1742,11 @@ def child_main():
     # scale-up arm shows the real cache-vs-trace gap
     extra("fleet_autoscale", bench_fleet_autoscale)
     extra("fleet_ha", bench_router_failover)
+    # observability (r15): tracing on-vs-off p50 overhead through the
+    # router + the failover trace artifact — on-chip the compute phase
+    # dominates, so the off-tunnel CPU number is the overhead's honest
+    # worst case (off-tunnel number: BENCH_r15.json via --fleet)
+    extra("fleet_trace", bench_fleet_trace)
     return 0
 
 

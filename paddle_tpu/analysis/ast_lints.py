@@ -658,6 +658,141 @@ def lint_shell_file(path: str, rel: str, source: str) -> List[Finding]:
     return findings
 
 
+# -------------------------------------------------------------- PT107
+_CHAOS_REL = "paddle_tpu/testing/chaos.py"
+_FLIGHT_MATRIX_REL = "tests/test_obs_flight.py"
+
+
+def _hit_sites_from_tree(tree: ast.Module) -> List[Tuple[str, int]]:
+    """(site-name, line) per ``_chaos._ACTIVE.hit("<site>", ...)`` call
+    — the canonical production spelling (the receiver must end in
+    ``_ACTIVE``, so a test's ``plan.hit(...)`` never counts)."""
+    out: List[Tuple[str, int]] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "hit"):
+            continue
+        recv = _dotted(node.func.value) or ""
+        if not recv.endswith("_ACTIVE"):
+            continue
+        if node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            out.append((node.args[0].value, node.lineno))
+    return out
+
+
+def _sites_from_tree(tree: ast.Module
+                     ) -> Tuple[Optional[Set[str]], int]:
+    """chaos.py's declared ``SITES`` tuple (None when missing)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == "SITES" \
+                and isinstance(node.value, (ast.Tuple, ast.List)):
+            sites = {e.value for e in node.value.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, str)}
+            return sites, node.lineno
+    return None, 1
+
+
+def _site_cases_from_tree(tree: ast.Module) -> Optional[Set[str]]:
+    """The flight matrix's ``SITE_CASES`` dict keys (None = absent)."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and node.targets[0].id == "SITE_CASES" \
+                and isinstance(node.value, ast.Dict):
+            return {k.value for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)}
+    return None
+
+
+def _chaos_site_findings(hits: Dict[str, Tuple[str, int]],
+                         chaos_tree: Optional[ast.Module],
+                         matrix_tree: Optional[ast.Module]
+                         ) -> List[Finding]:
+    """PT107: every .hit site declared in chaos.SITES; every declared
+    site exercised by the closure-enforced flight matrix AND by at
+    least one production hit (a dead declaration is drift too)."""
+    if chaos_tree is None:
+        return [Finding("PT107", _CHAOS_REL, 1,
+                        "chaos module missing/unparsed — chaos-site "
+                        "coverage cannot be checked")]
+    sites, sites_line = _sites_from_tree(chaos_tree)
+    if sites is None:
+        return [Finding("PT107", _CHAOS_REL, 1,
+                        "chaos.SITES catalog missing — declare the "
+                        "closed set of hook sites")]
+    findings: List[Finding] = []
+    for site, (rel, line) in sorted(hits.items()):
+        if site not in sites:
+            findings.append(Finding(
+                "PT107", rel, line,
+                f"chaos site {site!r} fired here but is not declared "
+                "in chaos.SITES — declare it (and add its "
+                "tests/test_obs_flight.py SITE_CASES row) so the "
+                "flight-recorder matrix and the docs cover it"))
+    cases = (_site_cases_from_tree(matrix_tree)
+             if matrix_tree is not None else None)
+    if cases is None:
+        findings.append(Finding(
+            "PT107", _FLIGHT_MATRIX_REL, 1,
+            "flight-recorder matrix (SITE_CASES) missing — every "
+            "chaos.SITES member needs a firing row proving it emits "
+            "its flight event"))
+    else:
+        for site in sorted(sites - cases):
+            findings.append(Finding(
+                "PT107", _CHAOS_REL, sites_line,
+                f"chaos site {site!r} declared without a firing row "
+                "in tests/test_obs_flight.py:SITE_CASES — a site "
+                "without its matrix row ships without its postmortem "
+                "event"))
+    for site in sorted(sites - set(hits)):
+        findings.append(Finding(
+            "PT107", _CHAOS_REL, sites_line,
+            f"chaos site {site!r} declared in chaos.SITES but no "
+            "_chaos._ACTIVE.hit(...) in paddle_tpu/ fires it — dead "
+            "declaration (remove it, with its matrix row)"))
+    return findings
+
+
+def lint_chaos_sites(root: str) -> List[Finding]:
+    """Standalone PT107 (fixture tests use this directly); the repo
+    driver aggregates from run_pass1's already-parsed trees."""
+    hits: Dict[str, Tuple[str, int]] = {}
+    pkg = os.path.join(root, "paddle_tpu")
+    chaos_tree = None
+    for dirpath, _dirs, files in os.walk(pkg):
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            try:
+                tree = ast.parse(open(path, encoding="utf-8").read(),
+                                 filename=path)
+            except (SyntaxError, OSError):
+                continue
+            if rel == _CHAOS_REL:
+                chaos_tree = tree
+            for site, line in _hit_sites_from_tree(tree):
+                hits.setdefault(site, (rel, line))
+    matrix_path = os.path.join(root, _FLIGHT_MATRIX_REL)
+    matrix_tree = None
+    if os.path.exists(matrix_path):
+        try:
+            matrix_tree = ast.parse(
+                open(matrix_path, encoding="utf-8").read(),
+                filename=matrix_path)
+        except SyntaxError:
+            matrix_tree = None
+    return _chaos_site_findings(hits, chaos_tree, matrix_tree)
+
+
 # -------------------------------------------------------------- PT106
 def _registrations_from_tree(tree: ast.Module) -> List[Tuple[str, int]]:
     """(canonical-type-name, line) per register_layer decorator."""
@@ -760,11 +895,14 @@ def run_pass1(root: str,
     """(findings, suppressed-count) over the repo (or explicit paths)."""
     findings: List[Finding] = []
     suppressed = 0
-    # PT106 rides the same parse: registrations and the matrix tree
-    # are collected from the linters' ASTs (re-walking the package
-    # would double the fast lint's parse work)
+    # PT106/PT107 ride the same parse: registrations, chaos hit sites,
+    # and the matrix trees are collected from the linters' ASTs
+    # (re-walking the package would double the fast lint's parse work)
     registered: Dict[str, Tuple[str, int]] = {}
+    hit_sites: Dict[str, Tuple[str, int]] = {}
     matrix_tree: Optional[ast.Module] = None
+    chaos_tree: Optional[ast.Module] = None
+    flight_matrix_tree: Optional[ast.Module] = None
     files = list(paths) if paths else list(_iter_source_files(root))
     for path in files:
         rel = os.path.relpath(path, root)
@@ -787,11 +925,19 @@ def run_pass1(root: str,
         suppressed += linter.suppressed
         if linter.rel == _MATRIX_REL:
             matrix_tree = linter.tree
+        elif linter.rel == _FLIGHT_MATRIX_REL:
+            flight_matrix_tree = linter.tree
         elif linter.rel.startswith("paddle_tpu/"):
+            if linter.rel == _CHAOS_REL:
+                chaos_tree = linter.tree
             for canonical, line in _registrations_from_tree(
                     linter.tree):
                 registered.setdefault(canonical, (linter.rel, line))
+            for site, line in _hit_sites_from_tree(linter.tree):
+                hit_sites.setdefault(site, (linter.rel, line))
     if paths is None:
         findings.extend(_matrix_findings(registered, matrix_tree))
+        findings.extend(_chaos_site_findings(hit_sites, chaos_tree,
+                                             flight_matrix_tree))
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings, suppressed

@@ -18,6 +18,13 @@ looser schema):
 - ``ACCURACY_*``: ``{"platform": str, ...}`` plus at least one named
   run section (a dict) — an accuracy artifact with no run sections
   recorded nothing.
+- ``TRACE_*`` (committed distributed-trace evidence, e.g. the
+  ``bench.py --fleet`` failover trace): ``{"spans": [...]}`` with a
+  NON-EMPTY span list, every span carrying string ``trace_id`` /
+  ``span_id`` / ``name``, numeric ``ts`` and ``dur_ms >= 0``, spans
+  sorted by ``ts`` (monotone file order), and every non-null
+  ``parent_id`` resolving to another span's ``span_id`` in the same
+  file — a trace whose parents dangle reconstructs nothing.
 - ``MEM_*`` (optional trend snapshots of graftlint pass 5's
   per-program per-device byte manifests, emitted by
   ``python -m paddle_tpu.analysis --json | jq .mem_manifest``):
@@ -104,6 +111,40 @@ def check_bench_file(path: str, rel: str) -> List[Finding]:
         if not isinstance(data.get("tail"), str):
             bad("multichip artifact missing str 'tail' (the "
                 "re-checkable dryrun evidence)")
+    elif base.startswith("TRACE_"):
+        spans = data.get("spans")
+        if not (isinstance(spans, list) and spans):
+            bad("trace artifact needs a non-empty 'spans' list")
+        else:
+            ids = {s.get("span_id") for s in spans
+                   if isinstance(s, dict)}
+            last_ts = None
+            for i, s in enumerate(spans):
+                if not isinstance(s, dict):
+                    bad(f"span[{i}] must be an object")
+                    continue
+                for k in ("trace_id", "span_id", "name"):
+                    if not (isinstance(s.get(k), str) and s.get(k)):
+                        bad(f"span[{i}] missing non-empty str {k!r}")
+                ts, dur = s.get("ts"), s.get("dur_ms")
+                if not isinstance(ts, (int, float)) or isinstance(
+                        ts, bool):
+                    bad(f"span[{i}] missing numeric 'ts'")
+                    ts = None
+                if (not isinstance(dur, (int, float))
+                        or isinstance(dur, bool) or dur < 0):
+                    bad(f"span[{i}] needs numeric 'dur_ms' >= 0")
+                if ts is not None:
+                    if last_ts is not None and ts < last_ts:
+                        bad(f"span[{i}] breaks monotone file order "
+                            f"(ts {ts} < previous {last_ts}) — the "
+                            "writer sorts by start time")
+                    last_ts = ts
+                parent = s.get("parent_id")
+                if parent is not None and parent not in ids:
+                    bad(f"span[{i}] parent_id {parent!r} resolves to "
+                        "no span in this file — a dangling parent "
+                        "reconstructs nothing")
     elif base.startswith("MEM_"):
         # a pass-5 memory-manifest trend snapshot
         progs = data.get("programs")
@@ -217,7 +258,8 @@ def run_schema_check(root: str,
                      patterns: Sequence[str] = ("BENCH_*.json",
                                                 "MULTICHIP_*.json",
                                                 "ACCURACY_*.json",
-                                                "MEM_*.json")
+                                                "MEM_*.json",
+                                                "TRACE_*.json")
                      ) -> List[Finding]:
     findings: List[Finding] = []
     for pattern in patterns:

@@ -57,6 +57,14 @@ RULES: Dict[str, Tuple[str, str]] = {
         "registered layer type missing its row in "
         "tests/test_layer_grad_matrix.py (static twin of "
         "test_registry_fully_covered)"),
+    "PT107": (
+        "chaos-site-flight-coverage",
+        "a chaos hook site is not closed over by the observability "
+        "plane: a _chaos._ACTIVE.hit(...) call names a site missing "
+        "from chaos.SITES, a declared site has no firing row in "
+        "tests/test_obs_flight.py:SITE_CASES (the closure-enforced "
+        "flight-recorder matrix), or a declared site is dead — a new "
+        "chaos site cannot ship without its postmortem event"),
     "PT201": (
         "jaxpr-embedded-constant",
         "traced program embeds a model-sized constant (closure-captured "
@@ -79,9 +87,10 @@ RULES: Dict[str, Tuple[str, str]] = {
         "the same call path"),
     "PT401": (
         "bench-schema",
-        "evidence artifact (BENCH_*/MULTICHIP_*/ACCURACY_*.json) "
-        "violates its schema (keys, per-metric best-of structure, "
-        "finite numbers)"),
+        "evidence artifact (BENCH_*/MULTICHIP_*/ACCURACY_*/MEM_*/"
+        "TRACE_*.json) violates its schema (keys, per-metric best-of "
+        "structure, finite numbers; TRACE files need non-empty spans, "
+        "monotone timestamps, resolvable parent refs)"),
     "PT501": (
         "collective-budget",
         "a traced parallel program's collective footprint (op sites / "

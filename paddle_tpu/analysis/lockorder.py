@@ -55,6 +55,15 @@ DEFAULT_MODULES = (
     "paddle_tpu/testing/chaos.py",
     "paddle_tpu/utils/stat.py",
     "paddle_tpu/native/__init__.py",
+    # the observability plane (r15): the tracer's span-buffer lock and
+    # the metrics registry's provider-table lock are pinned EDGE-FREE
+    # (tests/test_lint_clean.py) — obs code must never call back into
+    # a subsystem while holding them, and subsystems record spans only
+    # outside their own locks. The flight ring is deliberately
+    # lock-free (GIL-atomic deque), so it cannot appear here at all.
+    "paddle_tpu/obs/trace.py",
+    "paddle_tpu/obs/flight.py",
+    "paddle_tpu/obs/registry.py",
 )
 
 _LOCK_CTORS = {"Lock": False, "RLock": True}  # name -> reentrant
@@ -321,6 +330,15 @@ class LockOrderChecker:
     SINGLETONS = {
         "_chaos._ACTIVE": "FaultPlan",
         "chaos._ACTIVE": "FaultPlan",
+        # the obs plane's module globals: calls through them from
+        # inside a with-block DO count as lock acquisitions of the
+        # tracer lock, which is how the edge-free pin is enforceable
+        # rather than vacuous (the flight recorder has no lock — see
+        # obs/flight.py — so _flight._ACTIVE maps to a lockless class)
+        "_trace._TRACER": "Tracer",
+        "trace._TRACER": "Tracer",
+        "_flight._ACTIVE": "FlightRecorder",
+        "flight._ACTIVE": "FlightRecorder",
     }
 
     # ------------------------------------------------------- resolution

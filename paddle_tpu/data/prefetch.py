@@ -43,6 +43,7 @@ import time
 from queue import Empty, Full, Queue
 from typing import Callable, Optional, Sequence
 
+from paddle_tpu.obs import flight as _flight
 from paddle_tpu.utils.log import get_logger
 from paddle_tpu.utils.stat import StatRegistry, global_stat, timer
 
@@ -309,6 +310,14 @@ class RecompileGuard:
         n = self.count
         if (self.hard_baseline is not None and n is not None
                 and n > self.hard_baseline):
+            if _flight._ACTIVE is not None:
+                # a guard trip is exactly the kind of transition a
+                # postmortem wants dated: which request/step first
+                # escaped the warmed menu
+                _flight._ACTIVE.record("recompile_guard_trip",
+                                       guard=self.name,
+                                       baseline=self.hard_baseline,
+                                       count=n)
             raise RecompileError(
                 f"{self.name}: jit cache grew {self.hard_baseline} -> {n} "
                 "after warmup — a shape outside the warmed bucket menu "
@@ -317,6 +326,9 @@ class RecompileGuard:
         if (n is not None and not self.warned and self.warn_after > 0
                 and n > self.warn_after):
             self.warned = True
+            if _flight._ACTIVE is not None:
+                _flight._ACTIVE.record("recompile_guard_warn",
+                                       guard=self.name, count=n)
             logger.warning(
                 "%s recompiled %d times — the input shapes are thrashing "
                 "XLA's compile cache. Bucket your batch shapes (DataFeeder "

@@ -50,6 +50,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from paddle_tpu.obs import flight as _flight
 from paddle_tpu.testing import chaos as _chaos
 from paddle_tpu.trainer.checkpoint import (load_checkpoint, snapshot_arrays,
                                            write_snapshot)
@@ -156,6 +157,14 @@ class Checkpointer:
                    os.path.join(self.dir, "LATEST"))
         self._gc()
         logger.info("checkpoint saved: %s", path)
+        if _flight._ACTIVE is not None:
+            # a generation turning durable is a postmortem anchor: the
+            # commit-after-durable protocol and exact-resume both pivot
+            # on WHICH generation existed when a kill landed
+            _flight._ACTIVE.record("checkpoint_durable",
+                                   path=os.path.basename(path),
+                                   pass_id=meta.get("pass_id"),
+                                   batch_id=meta.get("batch_id"))
         if _chaos._ACTIVE is not None:
             _chaos._ACTIVE.hit("checkpoint", path=real)
         if self.on_save is not None:

@@ -58,6 +58,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from paddle_tpu.obs import flight as _flight
+from paddle_tpu.obs import trace as _trace
 from paddle_tpu.testing import chaos as _chaos
 from paddle_tpu.utils.backoff import backoff_delay
 from paddle_tpu.utils.log import get_logger
@@ -282,6 +284,15 @@ class RoleLease:
             self._valid_until = time.monotonic() + self.ttl_s
             logger.info("role %r acquired by %s (epoch %d)", self.role,
                         self.holder_id, epoch)
+            if _flight._ACTIVE is not None:
+                # the fencing epoch is the postmortem's ordering token:
+                # "who held the role when" reads off these events
+                _flight._ACTIVE.record(
+                    "role_acquire", role=self.role,
+                    holder=self.holder_id, epoch=epoch,
+                    took_over_stale=bool(rec and rec.get("holder")
+                                         and rec.get("holder")
+                                         != self.holder_id))
             return True
         return False
 
@@ -299,6 +310,12 @@ class RoleLease:
             # fenced: the role moved on with a higher epoch — this
             # holder must NOT keep acting on its stale validity window
             self._valid_until = 0.0
+            if _flight._ACTIVE is not None:
+                _flight._ACTIVE.record(
+                    "role_renew_refused", role=self.role,
+                    holder=self.holder_id, epoch=self.epoch,
+                    record_epoch=(rec or {}).get("epoch"),
+                    record_holder=(rec or {}).get("holder"))
             return False
         rec["renewed_at"] = time.time()
         self._write(rec)
@@ -362,6 +379,25 @@ class MasterService:
         self._recover()
 
     # ------------------------------------------------------------ state
+
+    def metrics_snapshot(self) -> dict:
+        """Queue/lease counters for the ``--metrics_port`` exporter
+        (metrics federation: the master scrapes like everything else).
+        Counts only — task payloads stay out of the metrics plane."""
+        with self._lock:
+            return {
+                "cur_pass": self.cur_pass,
+                "ready": self._ready,
+                "todo": len(self.todo),
+                "pending": len(self.pending),
+                "done": len(self.done),
+                "failed": len(self.failed),
+                "uncommitted_tasks": sum(
+                    len(ts) for ts in self.uncommitted.values()),
+                "uncommitted_trainers": sum(
+                    1 for ts in self.uncommitted.values() if ts),
+                "live_trainers": len(self._trainer_seen.holders()),
+            }
 
     def _snapshot_bytes(self) -> bytes:
         state = {
@@ -468,6 +504,11 @@ class MasterService:
         # order. Front-requeue the in-flight task first, then prepend the
         # finishes: todo = [finishes..., in-flight, ...rest].
         for tr in self._trainer_seen.expired(now):
+            if _flight._ACTIVE is not None:
+                # the flight ring is lock-free by design, so recording
+                # under the master RLock adds no lock-order edge
+                _flight._ACTIVE.record("trainer_lease_expired",
+                                       trainer=tr)
             self._requeue_trainer(tr, "lease expired")
 
     def _requeue_trainer(self, trainer_id: str, why: str) -> int:
@@ -924,7 +965,17 @@ class _Handler(socketserver.BaseRequestHandler):
                     if method not in RPC_METHODS:
                         raise ValueError(f"unknown RPC method: {method!r}")
                     fn = getattr(svc, method)
-                    result = fn(**kwargs)
+                    if _trace._TRACER is not None:
+                        # the server half of the training-side trace:
+                        # parented under the trainer's rpc.<method>
+                        # span via the envelope's "trace" field
+                        parent = _trace.TraceContext.from_header(
+                            req.get("trace"))
+                        with _trace.span(f"rpc.server.{method}",
+                                         parent=parent, method=method):
+                            result = fn(**kwargs)
+                    else:
+                        result = fn(**kwargs)
                     _send_msg(self.request, {"ok": True, "result": result})
                 except _chaos.ChaosDropped:
                     raise  # an injected loss of the RESPONSE: close the
@@ -1020,11 +1071,25 @@ class MasterClient:
                              cap=self.backoff_cap, rng=rng)
 
     def call(self, method: str, **kwargs):
+        # one rpc.<method> span per call when tracing is armed — the
+        # get_task / task_finished / heartbeat / commit spans of the
+        # training side; the context rides the envelope's "trace"
+        # field so the master's rpc.server.<method> span parents under
+        # it. Guarded: the un-traced hot path pays one global load.
+        if _trace._TRACER is not None:
+            with _trace.span(f"rpc.{method}", method=method) as tctx:
+                return self._call_retrying(method, kwargs, tctx)
+        return self._call_retrying(method, kwargs, None)
+
+    def _call_retrying(self, method: str, kwargs: dict, tctx):
         # the lock scopes ONE request/response exchange (no interleaved
         # frames from the heartbeat thread), NOT the whole retry cycle:
         # sleeping the backoff under the lock would block the training
         # thread's RPCs — and close() — for the full redial cycle while
         # the heartbeat thread waits out a master restart
+        envelope = {"method": method, "kwargs": kwargs}
+        if tctx is not None:
+            envelope["trace"] = tctx.to_header()
         last = None
         for attempt in range(self.retries):
             try:
@@ -1032,8 +1097,7 @@ class MasterClient:
                     try:
                         if self._sock is None:
                             self._connect()
-                        _send_msg(self._sock, {"method": method,
-                                               "kwargs": kwargs})
+                        _send_msg(self._sock, envelope)
                         resp = _recv_msg(self._sock)
                     except (ConnectionError, OSError):
                         # a failed exchange leaves the socket desynced
@@ -1360,6 +1424,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "timeout_s/2, 'off' disables re-dispatch "
                          "(required when load_chunk has side effects "
                          "that must never run twice)")
+    ap.add_argument("--metrics_port", type=int, default=0,
+                    help="bind a /metrics exporter (Prometheus text + "
+                         "?format=json) with the master's queue/lease "
+                         "counters; 0 disables")
     args = ap.parse_args(argv)
 
     if args.straggle_after_s == "auto":
@@ -1369,6 +1437,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         straggle = float(args.straggle_after_s)
     _chaos.install_from_env()
+    from paddle_tpu import obs
+    obs.arm_from_env("master")
     store = FileStore(args.store) if args.store else None
     svc = MasterService(store=store, timeout_s=args.timeout_s,
                         trainer_timeout_s=args.trainer_timeout_s,
@@ -1376,6 +1446,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                         chunks_per_task=args.chunks_per_task,
                         straggle_after_s=straggle)
     server = MasterServer(svc, host=args.host, port=args.port)
+    metrics_srv = None
+    if args.metrics_port:
+        from paddle_tpu.obs import MetricsRegistry, serve_metrics
+        registry = MetricsRegistry().register("master",
+                                              svc.metrics_snapshot)
+        metrics_srv = serve_metrics(registry, host=args.host,
+                                    port=args.metrics_port)
+        print(f"MASTER-METRICS {args.host}:"
+              f"{metrics_srv.server_address[1]}", flush=True)
     print(f"MASTER {server.addr[0]}:{server.addr[1]}", flush=True)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *_: stop.set())
@@ -1384,6 +1463,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     try:
         stop.wait()
     finally:
+        if metrics_srv is not None:
+            metrics_srv.shutdown()
+            metrics_srv.server_close()
         server.stop()
     return 0
 

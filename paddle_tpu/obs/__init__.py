@@ -1,0 +1,60 @@
+"""Unified observability plane: tracing, flight recorder, metrics.
+
+Three pillars, one package (the TensorFlow paper treats cluster-wide
+monitoring as a first-class system component; this is that component
+for the five process kinds this fleet runs — client, router/standby,
+replica server, supervisor, master/trainers):
+
+- :mod:`paddle_tpu.obs.trace` — distributed tracing. A
+  :class:`~paddle_tpu.obs.trace.TraceContext` (trace_id / span_id /
+  parent) rides an ``X-Trace-Id`` header through ServingClient →
+  router → replica HTTP → batcher → predictor, and a ``trace`` field
+  through the master RPC codec; spans land in a bounded in-process
+  buffer and dump to JSONL. The serving ``queue_wait / pad_overhead /
+  compute / decode`` phase split becomes real child spans; failovers
+  and hedges appear as sibling attempt spans under one trace.
+- :mod:`paddle_tpu.obs.flight` — flight recorder. A process-wide ring
+  buffer of structured events fed by the state transitions the code
+  already makes (breaker trips, drains, lease grants/expiries, HA
+  takeovers + fencing epochs, autoscale decisions, checkpoint
+  generations, RecompileGuard trips, chaos-site fires), dumped to
+  ``$PADDLE_TPU_FLIGHT_DIR`` on SIGTERM / worker-fatal / atexit.
+  ``tools/blackbox.py`` merges per-process dumps into one ordered
+  fleet timeline — a chaos postmortem becomes a readable artifact
+  instead of a seed re-run.
+- :mod:`paddle_tpu.obs.registry` — metrics federation. The snapshot +
+  Prometheus machinery shared by serving/router/train/master/
+  supervisor exporters; ``serve_metrics`` binds a ``/metrics``
+  endpoint for processes that have no serving frontend (``--job=train
+  --metrics_port``, ``python -m paddle_tpu.dist.master
+  --metrics_port``).
+
+Cost discipline mirrors the chaos plane: every hot-path hook guards on
+a module global (``trace._TRACER`` / ``flight._ACTIVE`` is None ==
+disabled, one load per hit), and nothing in this package imports jax.
+Trace/span IDs are generated even when tracing is off — every HTTP
+response must echo ``X-Trace-Id`` so a caller can always name the
+trace that answered (or refused) them; id generation is string work,
+the buffer append is the part the guard gates. See
+``docs/observability.md`` for the span taxonomy and event catalog.
+"""
+
+from paddle_tpu.obs import flight, trace
+from paddle_tpu.obs.registry import (MetricsRegistry, prom_from_dict,
+                                     serve_metrics)
+from paddle_tpu.obs.trace import TraceContext, Tracer
+
+
+def arm_from_env(service: str):
+    """Arm both exporters from the environment (the cross-process
+    switch, mirroring ``chaos.install_from_env``): a tracer when
+    ``$PADDLE_TPU_TRACE_DIR`` is set, a flight recorder when
+    ``$PADDLE_TPU_FLIGHT_DIR`` is set; both dump at exit. No-op (and
+    zero ongoing cost) when neither is set."""
+    trace.arm_from_env(service)
+    flight.arm_from_env(service)
+
+
+__all__ = ["trace", "flight", "Tracer", "TraceContext",
+           "MetricsRegistry", "prom_from_dict", "serve_metrics",
+           "arm_from_env"]

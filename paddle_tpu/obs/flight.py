@@ -1,0 +1,192 @@
+"""Flight recorder: a process-wide ring buffer of structured events.
+
+The fleet's state machines already make every transition that matters
+to a postmortem — breaker open/half-open/close, drain begin/end, lease
+grant/renew-loss/expiry, HA takeover + fencing epoch, autoscale
+decisions, checkpoint generations turning durable, RecompileGuard
+trips, chaos-site fires. This module gives those transitions one cheap
+sink: ``flight.record("breaker_open", replica="r2")`` appends a
+timestamped record to a bounded deque; the buffer dumps to
+``$PADDLE_TPU_FLIGHT_DIR`` on SIGTERM, worker-fatal, and atexit; and
+``tools/blackbox.py`` merges per-process dumps into one wall-clock-
+ordered fleet timeline. A chaos soak's takeover sequence (lease expiry
+→ adoption → first standby answer) then reads straight out of the
+dumps — no seed re-run.
+
+Event catalog: ``docs/observability.md``. Discipline:
+
+- **Zero cost when disabled** — every production hook guards with
+  ``if flight._ACTIVE is not None`` (one module-global load, the chaos
+  pattern); the convenience :func:`record` wrapper exists for cold
+  paths.
+- **Lock-free-ish** — the ring holds NO lock at all: ``deque.append``
+  / ``list(deque)`` are GIL-atomic in CPython and ``itertools.count``
+  hands out sequence numbers atomically, so recording from inside a
+  caller's lock hold (the chaos plane fires under the master RPC
+  exchange lock) can never add a lock-order edge (graftlint pass 3
+  sees no lock here by construction). The ``dropped`` eviction counter
+  is best-effort under races — an approximate count of lost history is
+  the right trade against a lock on every event.
+- **Bounded** — the ring keeps the most recent ``capacity`` events and
+  counts evictions (``dropped``); a postmortem wants the last minutes,
+  not an unbounded log.
+"""
+
+from __future__ import annotations
+
+import atexit
+import itertools
+import json
+import os
+import signal
+import time
+from collections import deque
+from typing import List, Optional
+
+ENV_DIR = "PADDLE_TPU_FLIGHT_DIR"
+
+# the one global the hook sites poll; None == recorder disabled
+_ACTIVE: Optional["FlightRecorder"] = None
+
+
+class FlightRecorder:
+    """Bounded structured-event ring for one process."""
+
+    def __init__(self, service: str = "", capacity: int = 4096):
+        self.service = str(service)
+        self.pid = os.getpid()
+        # no lock by design (see module docstring): deque ops are
+        # GIL-atomic and the seq counter is an atomic itertools.count
+        self._ring: deque = deque(maxlen=int(capacity))
+        self._seq = itertools.count(1)
+        self.dropped = 0  # best-effort (racy increment is acceptable)
+
+    # ------------------------------------------------------------ record
+    #: keys every record owns; caller fields may not shadow them —
+    #: ``tools/blackbox.py`` merges on (ts, pid, seq) and attributes
+    #: lines to service/pid, so a caller passing e.g. ``pid=`` (a
+    #: CHILD's pid, as the supervisor lifecycle does) must not
+    #: re-attribute the record to another process
+    _CORE = frozenset({"ts", "mono", "service", "pid", "event", "seq"})
+
+    def record(self, event: str, /, **fields):
+        """One event. ``fields`` must be JSON-able scalars/containers;
+        the record carries wall-clock ``ts`` (cross-process merge key),
+        a monotonic ``mono`` (in-process ordering under clock steps),
+        and a per-process ``seq`` (total order even at equal
+        timestamps). A field colliding with a core key is kept under
+        ``x_<key>`` (``event`` is positional-only so even that name is
+        a usable field)."""
+        rec = {"ts": round(time.time(), 6),
+               "mono": round(time.monotonic(), 6),
+               "service": self.service, "pid": self.pid,
+               "event": str(event)}
+        for k, v in fields.items():
+            if v is not None:
+                rec["x_" + k if k in self._CORE else k] = v
+        rec["seq"] = next(self._seq)
+        if len(self._ring) == self._ring.maxlen:
+            self.dropped += 1
+        self._ring.append(rec)
+
+    # ------------------------------------------------------------ export
+    def events(self, event: Optional[str] = None) -> List[dict]:
+        out = sorted(self._ring, key=lambda e: e["seq"])
+        if event is not None:
+            out = [e for e in out if e["event"] == event]
+        return out
+
+    def clear(self):
+        self._ring.clear()
+
+    def dump_jsonl(self, path: Optional[str] = None) -> Optional[str]:
+        """Write the ring (in seq order) one JSON object per line.
+        Default path ``$PADDLE_TPU_FLIGHT_DIR/flight-<service>-
+        <pid>.jsonl``; None (and no env dir) skips quietly so the
+        atexit/signal hooks can call this unconditionally."""
+        if path is None:
+            d = os.environ.get(ENV_DIR, "")
+            if not d:
+                return None
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(
+                d, f"flight-{self.service or 'proc'}-{self.pid}.jsonl")
+        with open(path, "w", encoding="utf-8") as f:
+            for rec in self.events():
+                f.write(json.dumps(rec) + "\n")
+        return path
+
+
+# ------------------------------------------------------------- install
+
+def install(rec: Optional[FlightRecorder]) -> Optional[FlightRecorder]:
+    """Make ``rec`` the active recorder (None disables)."""
+    global _ACTIVE
+    _ACTIVE = rec
+    return rec
+
+
+def active() -> Optional[FlightRecorder]:
+    return _ACTIVE
+
+
+def record(event: str, /, **fields):
+    """Convenience for cold paths; hot paths inline the guard."""
+    rec = _ACTIVE
+    if rec is not None:
+        rec.record(event, **fields)
+
+
+def dump_now() -> Optional[str]:
+    """Dump the active recorder to its env-dir path immediately — the
+    worker-fatal hook (a dying serving worker must leave its black box
+    behind even though the process may linger), the SIGTERM handler,
+    and the pre-``os._exit`` chaos-kill hook call this. Those paths
+    MUST complete whether or not the dump can be written (a full disk
+    must not un-kill a chaos kill or leak a SIGTERM), so a failed
+    write returns None instead of raising."""
+    rec = _ACTIVE
+    if rec is None:
+        return None
+    try:
+        return rec.dump_jsonl()
+    except Exception:  # noqa: BLE001 — a full disk (OSError) or an
+        # unserializable event field (TypeError from json.dumps of an
+        # open **fields value) must not surface here
+        return None
+
+
+def arm_from_env(service: str) -> Optional[FlightRecorder]:
+    """Install a recorder (plus atexit dump, plus a SIGTERM
+    dump-then-default handler when no handler is installed yet) when
+    ``$PADDLE_TPU_FLIGHT_DIR`` is set; no-op otherwise.
+
+    Signal ordering matters: processes that install their OWN SIGTERM
+    handler (the serving drain, the master's stop event) do so AFTER
+    arming and exit cleanly through atexit, so this hook only covers
+    the default-disposition case (``--job=train`` and kin), where a
+    bare SIGTERM would otherwise skip atexit entirely."""
+    if not os.environ.get(ENV_DIR, ""):
+        return None
+    rec = install(FlightRecorder(service))
+
+    def _dump_quietly(r=rec):
+        # same contract as dump_now: a full disk must not turn a
+        # clean exit into an atexit traceback
+        try:
+            r.dump_jsonl()
+        except Exception:  # noqa: BLE001
+            pass
+
+    atexit.register(_dump_quietly)
+    try:
+        if signal.getsignal(signal.SIGTERM) == signal.SIG_DFL:
+            def _dump_and_die(signum, frame):
+                dump_now()
+                signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                os.kill(os.getpid(), signal.SIGTERM)
+
+            signal.signal(signal.SIGTERM, _dump_and_die)
+    except (ValueError, OSError):
+        pass  # not the main thread / restricted env: atexit still covers
+    return rec

@@ -1,0 +1,189 @@
+"""Metrics federation: one registry, one scrape, the whole fleet.
+
+``serving/metrics.py`` grew the snapshot + Prometheus export pattern
+for the serving engine and the router; this module is that machinery
+extracted so every process kind exports the same way:
+
+- a **provider** is anything with ``snapshot() -> dict`` (or a plain
+  callable returning a dict). ``ServingMetrics`` / ``RouterMetrics``
+  qualify as-is; the trainer registers a closure over its
+  ``StepBreakdown`` + ``memory_stats``; the master registers its queue
+  counters; the supervisor its replica table.
+- :class:`MetricsRegistry` names providers and federates them: one
+  ``snapshot()`` = ``{name: provider_snapshot}``, one
+  ``to_prometheus()`` = each provider's native text when it has one,
+  else :func:`prom_from_dict` (generic numeric-leaf flattening with
+  optional constant labels — how the router re-exports per-replica
+  serving snapshots under ``replica="rN"`` without every metrics class
+  learning about labels).
+- :func:`serve_metrics` binds a stdlib ``/metrics`` endpoint (text +
+  ``?format=json``) plus a trivial ``/healthz`` for processes that
+  have no serving frontend: ``--job=train --metrics_port``,
+  ``python -m paddle_tpu.dist.master --metrics_port``, the
+  supervisor's registry riding the router frontend.
+
+Lock discipline (graftlint pass-3 scope): the registry lock guards the
+provider TABLE only; provider calls happen outside it (a provider's
+own lock — the engine metrics lock, the router lock — must never nest
+under the registry's), so the lock is pinned edge-free.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Union
+
+_KEY_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _sanitize(key: str) -> str:
+    return _KEY_RE.sub("_", str(key))
+
+
+def prom_from_dict(prefix: str, data: dict,
+                   labels: Optional[dict] = None) -> List[str]:
+    """Flatten a snapshot dict's numeric leaves into Prometheus gauge
+    lines ``<prefix>_<path>{labels} <value>`` (path = sanitized key
+    chain; non-numeric leaves and None are skipped; bools export as
+    0/1). This is the generic half of federation: any provider's JSON
+    snapshot becomes scrapeable without bespoke export code."""
+    label_str = ""
+    if labels:
+        inner = ",".join(f'{_sanitize(k)}="{v}"'
+                         for k, v in sorted(labels.items()))
+        label_str = "{" + inner + "}"
+    lines: List[str] = []
+
+    def walk(obj, path: str):
+        if isinstance(obj, dict):
+            for k, v in obj.items():
+                walk(v, f"{path}_{_sanitize(k)}" if path else _sanitize(k))
+        elif isinstance(obj, bool):
+            lines.append(f"{prefix}_{path}{label_str} {int(obj)}")
+        elif isinstance(obj, (int, float)):
+            lines.append(f"{prefix}_{path}{label_str} {obj}")
+        # lists/strings/None: not a gauge — skipped by design
+
+    walk(data, "")
+    return lines
+
+
+Provider = Union[Callable[[], dict], object]
+
+
+class MetricsRegistry:
+    """Named providers -> one federated snapshot / scrape."""
+
+    def __init__(self, prefix: str = "paddle_tpu"):
+        self.prefix = str(prefix)
+        self._lock = threading.Lock()
+        self._providers: Dict[str, Provider] = {}
+
+    def register(self, name: str, provider: Provider
+                 ) -> "MetricsRegistry":
+        """``provider``: an object with ``snapshot()`` (and optionally
+        ``to_prometheus()``) or a zero-arg callable returning a dict.
+        Re-registering a name replaces it (a reloaded component keeps
+        its slot)."""
+        with self._lock:
+            self._providers[str(name)] = provider
+        return self
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._providers)
+
+    def _items(self):
+        with self._lock:
+            return list(self._providers.items())
+
+    @staticmethod
+    def _snap(provider: Provider) -> dict:
+        snap_fn = getattr(provider, "snapshot", None)
+        try:
+            out = snap_fn() if callable(snap_fn) else provider()
+        except Exception as e:  # noqa: BLE001 — one sick provider must
+            # not take down the whole scrape; the error IS the metric
+            return {"error": repr(e)}
+        return out if isinstance(out, dict) else {"value": out}
+
+    def snapshot(self) -> dict:
+        # providers run OUTSIDE the registry lock (their own locks must
+        # never nest under it — edge-free pin, graftlint pass 3)
+        return {name: self._snap(p) for name, p in self._items()}
+
+    def to_prometheus(self) -> str:
+        chunks: List[str] = []
+        for name, p in self._items():
+            native = getattr(p, "to_prometheus", None)
+            try:
+                if callable(native):
+                    chunks.append(native().rstrip("\n"))
+                    continue
+                chunks.extend(prom_from_dict(
+                    f"{self.prefix}_{_sanitize(name)}", self._snap(p)))
+            except Exception as e:  # noqa: BLE001 — same contract as
+                # _snap: one sick provider must not take down the
+                # whole scrape; the error IS the metric
+                chunks.append(f"# provider {name} scrape error: {e!r}")
+                chunks.append(
+                    f"{self.prefix}_{_sanitize(name)}_scrape_error 1")
+        return "\n".join(chunks) + "\n"
+
+
+# ---------------------------------------------------------- HTTP export
+
+class MetricsHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+    def __init__(self, addr, registry: MetricsRegistry):
+        super().__init__(addr, _MetricsHandler)
+        self.registry = registry
+
+
+class _MetricsHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # scrapers are chatty; stay quiet
+        pass
+
+    def _send(self, status: int, data: bytes, content_type: str):
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            if "format=json" in self.path:
+                self._send(200,
+                           json.dumps(self.server.registry.snapshot())
+                           .encode(), "application/json")
+            else:
+                self._send(200,
+                           self.server.registry.to_prometheus().encode(),
+                           "text/plain; version=0.0.4")
+        elif path == "/healthz":
+            self._send(200, b'{"status": "ok"}', "application/json")
+        else:
+            self._send(404, b'{"error": "not_found"}',
+                       "application/json")
+
+
+def serve_metrics(registry: MetricsRegistry, host: str = "127.0.0.1",
+                  port: int = 0, daemon: bool = True
+                  ) -> MetricsHTTPServer:
+    """Bind and start a background ``/metrics`` exporter (port=0 =
+    ephemeral, for tests; the bound port is
+    ``server.server_address[1]``). Callers stop it with
+    ``server.shutdown(); server.server_close()``."""
+    server = MetricsHTTPServer((host, port), registry)
+    threading.Thread(target=server.serve_forever, daemon=daemon,
+                     name="metrics-exporter").start()
+    return server

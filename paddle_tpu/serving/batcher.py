@@ -48,6 +48,8 @@ import time
 from collections import deque
 from typing import Dict, List, Optional
 
+from paddle_tpu.obs import flight as _flight
+from paddle_tpu.obs import trace as _trace
 from paddle_tpu.serving.errors import (BadRequest, DeadlineExceeded,
                                        Overloaded, ServingError,
                                        ShuttingDown)
@@ -57,10 +59,14 @@ from paddle_tpu.utils.log import get_logger
 
 logger = get_logger("serving")
 
+# the serving phase split IS the span taxonomy: these four children
+# partition a request's replica-side parent span by construction
+_PHASES = ("queue_wait", "pad_overhead", "compute", "decode")
+
 
 class _Request:
     __slots__ = ("sample", "kind", "enqueue_t", "deadline", "event",
-                 "result", "error", "timings")
+                 "result", "error", "timings", "trace", "wall_t")
 
     def __init__(self, sample, kind: str, deadline: Optional[float]):
         self.sample = sample
@@ -71,6 +77,11 @@ class _Request:
         self.result = None
         self.error: Optional[ServingError] = None
         self.timings: Dict[str, float] = {}
+        # the submitter's ambient trace context (the HTTP handler's /
+        # router attempt's span): the worker thread parents this
+        # request's replica-side spans under it at answer time
+        self.trace = _trace.current()
+        self.wall_t = time.time()  # wall twin of enqueue_t (span ts)
 
     def expired(self, now: Optional[float] = None) -> bool:
         return (self.deadline is not None
@@ -112,6 +123,12 @@ class ServingEngine:
         # health() — a remote drain_wait polls queue_depth+inflight to
         # know every queued AND in-flight request has been answered.
         self._inflight = 0
+        # requests answered while the engine lock is held (queue expiry,
+        # drain=False shed, worker-fatal): their spans are recorded
+        # later by _drain_trace_backlog OUTSIDE the lock — the obs
+        # plane must never nest under a subsystem lock (deque ops are
+        # GIL-atomic, so no extra lock either)
+        self._trace_backlog: deque = deque()
         self._thread: Optional[threading.Thread] = None
         self.fatal: Optional[BaseException] = None
 
@@ -196,11 +213,17 @@ class ServingEngine:
         """Close admission; queued and in-flight work still completes.
         The SIGTERM handler calls this (``serving/server.py``)."""
         with self._cond:
-            if not self._draining:
-                logger.info("serving: draining (admission closed, "
-                            "%d queued)", len(self._queue))
+            first = not self._draining
+            queued = len(self._queue)
             self._draining = True
             self._cond.notify_all()
+        if first:
+            # log + flight OUTSIDE the engine lock (lock discipline:
+            # the obs plane never nests under a subsystem lock)
+            logger.info("serving: draining (admission closed, "
+                        "%d queued)", queued)
+            if _flight._ACTIVE is not None:
+                _flight._ACTIVE.record("drain_begin", queued=queued)
 
     def shutdown(self, drain: bool = True, timeout: float = 30.0):
         """Drain (default) or abort the queue, then stop the worker."""
@@ -211,9 +234,11 @@ class ServingEngine:
                     r.error = ShuttingDown(
                         "server shutting down; request not started")
                     r.event.set()
+                    self._trace_backlog.append(r)
                     self.metrics.inc("shed_total")
                 self._queue.clear()
             self._cond.notify_all()
+        self._drain_trace_backlog()
         if self._thread is not None:
             self._thread.join(timeout=timeout)
 
@@ -291,6 +316,7 @@ class ServingEngine:
                     f"(queued {1e3 * (now - r.enqueue_t):.1f} ms)")
                 r.timings["queue_wait"] = 1e3 * (now - r.enqueue_t)
                 r.event.set()
+                self._trace_backlog.append(r)
                 self.metrics.inc("deadline_exceeded_total")
             else:
                 live.append(r)
@@ -339,8 +365,11 @@ class ServingEngine:
             batch = None
             try:
                 batch = self._collect()
+                self._drain_trace_backlog()
                 if batch is None:
                     logger.info("serving: worker drained and stopped")
+                    if _flight._ACTIVE is not None:
+                        _flight._ACTIVE.record("drain_end")
                     return
                 if batch:
                     try:
@@ -359,6 +388,7 @@ class ServingEngine:
                             self._run_batch(batch)
                     finally:
                         self._inflight = 0
+                        self._drain_trace_backlog()
             except BaseException as e:  # noqa: BLE001 — a worker bug
                 self.fatal = e
                 logger.error("serving worker died: %r", e)
@@ -370,12 +400,22 @@ class ServingEngine:
                     if not r.event.is_set():
                         r.error = r.error or err
                         r.event.set()
+                        self._emit_trace(r)
                 with self._cond:
                     for r in self._queue:
                         r.error = err
                         r.event.set()
+                        self._trace_backlog.append(r)
                     self._queue.clear()
+                self._drain_trace_backlog()
                 self.metrics.inc("internal_error_total")
+                if _flight._ACTIVE is not None:
+                    # worker-fatal is EXACTLY what a black box is for:
+                    # record and dump now (the process may linger
+                    # answering health checks, never reaching atexit
+                    # with anything this recent)
+                    _flight._ACTIVE.record("worker_fatal", error=repr(e))
+                    _flight.dump_now()
                 raise
 
     # ------------------------------------------------- continuous decode
@@ -449,6 +489,7 @@ class ServingEngine:
         else:
             self.metrics.observe_request(req.timings)
         req.event.set()
+        self._emit_trace(req)
         # per-request service time (admission -> retire; queue wait
         # excluded, or the drain estimate would double-count backlog
         # when _retry_after_ms multiplies by queued batches) feeds the
@@ -486,6 +527,7 @@ class ServingEngine:
                             f"(queued {1e3 * (now - req.enqueue_t):.1f} "
                             "ms)")
                         req.event.set()
+                        self._emit_trace(req)
                         self.metrics.inc("deadline_exceeded_total")
                         continue
                     lane = free.popleft()
@@ -520,6 +562,7 @@ class ServingEngine:
                             f"(total {1e3 * (now - req.enqueue_t):.1f} "
                             f"ms, {int(t[lane])} steps in)")
                         req.event.set()
+                        self._emit_trace(req)
                         self.metrics.inc("deadline_exceeded_total")
                         sess.release(lane)
                         del lanes[lane]
@@ -540,7 +583,47 @@ class ServingEngine:
                 if not req.event.is_set():
                     req.error = req.error or err
                     req.event.set()
+                    self._emit_trace(req)
             raise
+
+    # ------------------------------------------------------------- spans
+    def _drain_trace_backlog(self):
+        """Record the spans of requests that were answered while the
+        engine lock was held (queue expiry, drain=False shed,
+        worker-fatal). Called from lock-free contexts only; the deque's
+        popleft is GIL-atomic against concurrent appends."""
+        while True:
+            try:
+                req = self._trace_backlog.popleft()
+            except IndexError:
+                return
+            self._emit_trace(req)
+
+    def _emit_trace(self, req: _Request):
+        """Turn one answered request's timing split into real spans:
+        a ``replica.<kind>`` parent covering enqueue → answer and the
+        four phase children, laid end to end from the enqueue wall
+        time (they partition the parent by construction). Worker
+        thread, after ``event.set()``, no engine lock held — the obs
+        plane never nests under a subsystem lock."""
+        tracer = _trace._TRACER
+        if tracer is None or req.trace is None:
+            return
+        total = sum(req.timings.get(p, 0.0) for p in _PHASES)
+        parent = tracer.record_span(
+            f"replica.{req.kind}", trace_id=req.trace.trace_id,
+            parent_id=req.trace.span_id, ts=req.wall_t, dur_ms=total,
+            status="ok" if req.error is None else "error",
+            error=(type(req.error).__name__ if req.error else None))
+        t = req.wall_t
+        for p in _PHASES:
+            ms = req.timings.get(p)
+            if ms is None:
+                continue
+            tracer.record_span(f"phase.{p}",
+                               trace_id=req.trace.trace_id,
+                               parent_id=parent, ts=t, dur_ms=ms)
+            t += ms / 1e3
 
     # ------------------------------------------------------------ batches
     def _predict(self, kind: str, rows, lane_valid=None):
@@ -577,6 +660,7 @@ class ServingEngine:
                                if isinstance(batch_err, BadRequest)
                                else BadRequest(str(batch_err)))
                     r.event.set()
+                    self._emit_trace(r)
                     self.metrics.inc("bad_request_total")
                 return
             outs, info = self._predict(kind, clean_rows, lane_valid)
@@ -584,6 +668,7 @@ class ServingEngine:
             for r in reqs:
                 r.error = e
                 r.event.set()
+                self._emit_trace(r)
             return
         wall_ms = 1e3 * (time.perf_counter() - t0)
         self._batch_ewma_ms += 0.25 * (wall_ms - self._batch_ewma_ms)
@@ -594,6 +679,7 @@ class ServingEngine:
         for i, r in enumerate(reqs):
             if r.error is not None:  # malformed lane, already typed
                 r.event.set()
+                self._emit_trace(r)
                 continue
             if kind == "generate":
                 # convoy accounting: every rider pays the batch's shared
@@ -618,6 +704,7 @@ class ServingEngine:
             else:
                 self.metrics.observe_request(r.timings)
             r.event.set()
+            self._emit_trace(r)
 
     @staticmethod
     def _decode(kind: str, outs, lane: int):

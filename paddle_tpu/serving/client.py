@@ -43,6 +43,7 @@ from typing import List, Optional
 
 import json
 
+from paddle_tpu.obs import trace as _trace
 from paddle_tpu.serving.errors import (Overloaded, ServingError,
                                        Unavailable, from_wire)
 from paddle_tpu.utils.backoff import backoff_delay, jittered_up
@@ -118,10 +119,13 @@ class ServingClient:
     def _provenance_from(self, resp) -> Optional[dict]:
         """Routing provenance the replica router attaches as headers —
         which replica answered, how many failovers/hedges the request
-        survived. None when talking to a single-replica server. ANY of
-        the three headers marks a router response: an error that never
-        landed on a replica has no X-Replica-Id but its failover count
-        is still provenance worth surfacing."""
+        survived — plus the ``X-Trace-Id`` echo every serving response
+        (errors and fenced 503s included) carries, so a caller can
+        always NAME the trace that answered or refused it. None only
+        when no provenance header came back at all. ANY header marks a
+        provenance-bearing response: an error that never landed on a
+        replica has no X-Replica-Id but its failover count and trace id
+        are still provenance worth surfacing."""
         prov = {}
         rid = resp.getheader("X-Replica-Id")
         if rid is not None:
@@ -134,6 +138,11 @@ class ServingClient:
                     prov[key] = int(v)
                 except ValueError:
                     prov[key] = v
+        tid = resp.getheader(_trace.HEADER)
+        if tid is not None:
+            # the echo is a bare trace id (the request's); keep only
+            # the trace part if a full trace-span pair ever shows up
+            prov["trace_id"] = tid.partition("-")[0]
         return prov or None
 
     def _request_once(self, method: str, path: str, body=None) -> dict:
@@ -142,9 +151,23 @@ class ServingClient:
         self.last_provenance = None
         conn = http.client.HTTPConnection(self.host, self.port,
                                           timeout=self.timeout)
+        # one client-side span per HTTP attempt — the ROOT span of a
+        # serving trace when this client originates it (its wall time
+        # IS the client-observed latency the replica-side children
+        # must reconstruct), a child hop when a router transport calls
+        # through with an ambient attempt context. The context (and
+        # the X-Trace-Id header) flows whether or not a tracer is
+        # installed; only the span record is gated.
+        with _trace.span("client.request", method=method,
+                         path=path) as tctx:
+            return self._exchange(conn, method, path, body, tctx)
+
+    def _exchange(self, conn, method: str, path: str, body,
+                  tctx) -> dict:
         try:
             payload = json.dumps(body).encode() if body is not None else None
             headers = {"Content-Type": "application/json"} if payload else {}
+            headers[_trace.HEADER] = tctx.to_header()
             conn.request(method, path, body=payload, headers=headers)
             resp = conn.getresponse()
             raw = resp.read()

@@ -75,12 +75,15 @@ import time
 from http.server import ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Tuple
 
+from paddle_tpu.obs import flight as _flight
+from paddle_tpu.obs import trace as _trace
 from paddle_tpu.serving.errors import (BadRequest, DeadlineExceeded,
                                        Overloaded, ServingError,
                                        ShuttingDown, Unavailable)
 from paddle_tpu.serving.metrics import RouterMetrics
 from paddle_tpu.serving.server import JSONHandler
 from paddle_tpu.testing import chaos as _chaos
+from paddle_tpu.utils.log import event as log_event
 from paddle_tpu.utils.log import get_logger
 
 logger = get_logger("serving.router")
@@ -88,6 +91,24 @@ logger = get_logger("serving.router")
 # replica states; only READY receives dispatches
 WARMING, READY, DRAINING, EJECTED, HALF_OPEN, DEAD = (
     "warming", "ready", "draining", "ejected", "half_open", "dead")
+
+
+def _get_json(host: str, port: int, path: str,
+              timeout: float) -> Tuple[int, dict]:
+    """One bounded GET returning ``(status, parsed body)`` — the body
+    is read WHATEVER the status (health/metrics payloads ride 503s
+    too). The one wire block behind ``HTTPTransport.healthz`` /
+    ``.metrics_snapshot`` and ``RouterHA._poll_peer``; callers apply
+    their own payload validation."""
+    import http.client
+    import json
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read() or b"{}")
+    finally:
+        conn.close()
 
 
 class PendingCall:
@@ -110,6 +131,13 @@ class PendingCall:
         self.transport_failure: Optional[BaseException] = None
         self._req = None  # EngineTransport bridges the engine _Request
         self.is_hedge = False  # launched as a hedge (win attribution)
+        # span bookkeeping for this attempt (set by dispatch.launch):
+        # the attempt's own TraceContext + launch times, recorded as a
+        # router.attempt span when the outcome settles — failovers and
+        # hedges then read as SIBLING attempts under one dispatch span
+        self.trace_ctx = None
+        self.t0_wall = 0.0
+        self.t0_perf = 0.0
 
     def outcome(self) -> Tuple[str, object]:
         if self._req is not None:
@@ -163,6 +191,11 @@ class EngineTransport:
     def healthz(self) -> dict:
         return self.engine.health()
 
+    def metrics_snapshot(self) -> dict:
+        """This replica's serving metrics — the router's ``/metrics``
+        federates these so one scrape shows the whole fleet."""
+        return self.engine.metrics.snapshot()
+
     def begin_drain(self):
         self.engine.begin_drain()
 
@@ -207,9 +240,25 @@ class HTTPTransport:
             if gen_opts.get(k) is not None:
                 body[k] = gen_opts[k]
 
+        # contextvars do NOT flow into new threads: capture the
+        # dispatcher's ambient attempt context here and re-scope it in
+        # the call thread, so the wire hop's X-Trace-Id carries the
+        # attempt's span and the remote replica parents under it
+        tctx = _trace.current()
+
         def run():
             try:
-                p.result = self._client._request_once("POST", path, body)
+                with _trace.use(tctx):
+                    p.result = self._client._request_once(
+                        "POST", path, body)
+                if isinstance(p.result, dict):
+                    # the inner client attached ITS provenance (the
+                    # replica's X-Trace-Id echo) to the body; forwarded
+                    # verbatim it would pre-empt the end client's
+                    # setdefault and eat the router's replica/failover
+                    # provenance — this hop's details are not the
+                    # caller's provenance
+                    p.result.pop("provenance", None)
             except ServingError as e:
                 p.error = e
             except Exception as e:  # noqa: BLE001 — conn reset/refused
@@ -224,23 +273,26 @@ class HTTPTransport:
     def healthz(self) -> dict:
         # NOT _request_once: that raises on any >=400 status, but a 503
         # healthz still carries the {live, ready, draining, ...} split
-        # the router routes on — the body must be read whatever the
-        # status
-        import http.client
-        import json
-        conn = http.client.HTTPConnection(self.host, self.port,
-                                          timeout=self.healthz_timeout)
-        try:
-            conn.request("GET", "/healthz")
-            resp = conn.getresponse()
-            data = json.loads(resp.read() or b"{}")
-            if not isinstance(data, dict) or "live" not in data:
-                raise ConnectionError(
-                    f"healthz from {self.host}:{self.port} is not a "
-                    f"health payload (HTTP {resp.status})")
-            return data
-        finally:
-            conn.close()
+        # the router routes on
+        status, data = _get_json(self.host, self.port, "/healthz",
+                                 self.healthz_timeout)
+        if not isinstance(data, dict) or "live" not in data:
+            raise ConnectionError(
+                f"healthz from {self.host}:{self.port} is not a "
+                f"health payload (HTTP {status})")
+        return data
+
+    def metrics_snapshot(self) -> dict:
+        """The remote replica's ``/metrics?format=json`` snapshot (the
+        federation hook; probe-timeout bounded like healthz)."""
+        status, data = _get_json(self.host, self.port,
+                                 "/metrics?format=json",
+                                 self.healthz_timeout)
+        if status >= 400 or not isinstance(data, dict):
+            raise ConnectionError(
+                f"metrics from {self.host}:{self.port} unavailable "
+                f"(HTTP {status})")
+        return data
 
     def begin_drain(self):
         """Close the replica's admission via ``POST /admin/drain`` —
@@ -369,6 +421,11 @@ class ReplicaRouter:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._reloading = False
+        # set by adopt_replicas: the NEXT successful dispatch records
+        # the flight event closing a takeover postmortem (lease expiry
+        # -> adoption -> first standby answer); plain attr, read on the
+        # dispatch hot path without the lock
+        self._first_answer_pending = False
         # monotonic id source for scale-up slots: ids never recycle, so
         # a drained-away "r2" and a later scale-up replica can never be
         # confused in logs/metrics/provenance
@@ -428,8 +485,9 @@ class ReplicaRouter:
                     continue
                 with self._lock:
                     rep.state = HALF_OPEN
-                logger.info("router: %s breaker half-open, probing",
-                            rep.id)
+                log_event(logger, "breaker_half_open",
+                          "router: %s breaker half-open, probing",
+                          rep.id, level=20, replica=rep.id)
             try:
                 h = rep.transport.healthz()
             except Exception as e:  # noqa: BLE001 — any probe failure
@@ -451,18 +509,13 @@ class ReplicaRouter:
             self._eject(rep)
 
     def _apply_health(self, rep: Replica, h: dict):
+        closed = False
         with self._lock:
             rep.poll_failures = 0
             rep.last_health = dict(h)
             if not h.get("live", True):
-                if rep.state != DEAD:
-                    logger.warning(
-                        "router: replica %s is dead (worker fatal: %s)",
-                        rep.id, h.get("fatal"))
-                    rep.state = DEAD
-                    dead = True
-                else:
-                    dead = False
+                dead = rep.state != DEAD
+                rep.state = DEAD
             elif h.get("draining"):
                 rep.state = DRAINING
                 dead = False
@@ -476,10 +529,17 @@ class ReplicaRouter:
                 rep.consecutive_failures = 0
                 if closed:
                     rep.breaker_cooldown_ms = None
-                    logger.info("router: %s breaker closed (probe ok)",
-                                rep.id)
                 dead = False
+        # events (log + flight) outside the router lock
+        if closed:
+            log_event(logger, "breaker_close",
+                      "router: %s breaker closed (probe ok)", rep.id,
+                      level=20, replica=rep.id)
         if dead:
+            log_event(logger, "replica_dead",
+                      "router: replica %s is dead (worker fatal: %s)",
+                      rep.id, h.get("fatal"), replica=rep.id,
+                      fatal=h.get("fatal"))
             self.metrics.inc("replica_deaths_total")
             self._maybe_respawn(rep)
 
@@ -496,8 +556,9 @@ class ReplicaRouter:
             new = self.spawn(rep.id)
             spawn_ms = 1e3 * (time.perf_counter() - t0)
         except Exception as e:  # noqa: BLE001 — retry next sweep
-            logger.warning("router: respawn of %s failed (%r); will "
-                           "retry", rep.id, e)
+            log_event(logger, "respawn_failed",
+                      "router: respawn of %s failed (%r); will retry",
+                      rep.id, e, replica=rep.id, error=repr(e))
             return
         with self._lock:
             rep.transport = new
@@ -507,7 +568,10 @@ class ReplicaRouter:
             rep.breaker_cooldown_ms = None
             rep.last_spawn_ms = spawn_ms
         self.metrics.inc("respawns_total")
-        logger.info("router: respawned %s in %.1f ms", rep.id, spawn_ms)
+        log_event(logger, "respawn",
+                  "router: respawned %s in %.1f ms", rep.id, spawn_ms,
+                  level=20, replica=rep.id,
+                  spawn_ms=round(spawn_ms, 1))
         try:
             self._apply_health(rep, rep.transport.healthz())
         except Exception:  # noqa: BLE001 — next sweep will see it
@@ -523,6 +587,10 @@ class ReplicaRouter:
             rep.breaker_until = time.monotonic() + cooldown / 1e3
         self.metrics.inc("ejections_total")
         self.metrics.inc("breaker_open_total")
+        log_event(logger, "breaker_open",
+                  "router: %s breaker opened (cooldown %.0f ms)",
+                  rep.id, cooldown, replica=rep.id,
+                  cooldown_ms=round(cooldown, 1))
 
     def _reopen_breaker(self, rep: Replica):
         logger.warning("router: %s failed its half-open probe; breaker "
@@ -534,7 +602,9 @@ class ReplicaRouter:
             rep.consecutive_failures += 1
             eject = (rep.consecutive_failures >= self.eject_after
                      and rep.state == READY)
-        logger.warning("router: dispatch to %s failed (%r)", rep.id, exc)
+        log_event(logger, "dispatch_failed",
+                  "router: dispatch to %s failed (%r)", rep.id, exc,
+                  replica=rep.id, error=repr(exc))
         if eject:
             logger.warning("router: ejecting %s after %d consecutive "
                            "dispatch failures", rep.id,
@@ -575,9 +645,19 @@ class ReplicaRouter:
         still matters to the breaker, so reap it off-thread."""
 
         def run():
-            pend.event.wait(self.wait_timeout)
+            settled = pend.event.wait(self.wait_timeout)
             self._end_inflight(rep)
             kind, payload = pend.outcome()
+            # a reap that timed out never answered: outcome() would
+            # read ("ok", None) from the empty call — the span must
+            # say "unanswered", and neither breaker counter may move
+            # (crediting a hung replica with a success would mask it)
+            self._record_attempt(rep.id, pend.trace_ctx, pend.t0_wall,
+                                 pend.t0_perf,
+                                 kind if settled else "unanswered",
+                                 pend.is_hedge, abandoned=True)
+            if not settled:
+                return
             if kind == "failed":
                 self._record_failure(rep, payload)
             elif kind == "ok":
@@ -602,14 +682,44 @@ class ReplicaRouter:
                         vals.append(float(b))
         return min(vals) if vals else 50.0
 
+    def _record_attempt(self, rep_id: str, ctx, t0_wall: float,
+                        t0_perf: float, outcome: str, hedge: bool,
+                        abandoned: bool = False):
+        """One settled attempt -> one ``router.attempt`` span. Sibling
+        attempts under one dispatch span ARE the failover/hedge story a
+        trace tells; "ok"/"client" are healthy-replica outcomes."""
+        tracer = _trace._TRACER
+        if tracer is None or ctx is None:
+            return
+        tracer.record("router.attempt", ctx, ts=t0_wall,
+                      dur_ms=1e3 * (time.perf_counter() - t0_perf),
+                      status=("ok" if outcome in ("ok", "client")
+                              else "error"),
+                      replica=rep_id, outcome=outcome,
+                      hedge=True if hedge else None,
+                      abandoned=True if abandoned else None)
+
     def dispatch(self, sample, *, kind: str = "score",
                  deadline_ms: Optional[float] = None,
-                 beam_size=None, max_length=None) -> Tuple[dict, dict]:
+                 beam_size=None, max_length=None,
+                 trace_parent=None) -> Tuple[dict, dict]:
         """Route one request; returns ``(result, provenance)`` or raises
         the typed error the client should see. ``provenance`` =
         ``{"replica", "failovers", "hedges"}`` (the HTTP frontend
         surfaces it as ``X-Replica-Id`` / ``X-Failovers`` /
-        ``X-Hedged``)."""
+        ``X-Hedged``). ``trace_parent`` roots the routing decision's
+        ``router.dispatch`` span (and its per-attempt children) under
+        the caller's context — the HTTP frontend passes the parsed
+        ``X-Trace-Id``."""
+        with _trace.span("router.dispatch", parent=trace_parent,
+                         kind=kind):
+            return self._dispatch(sample, kind=kind,
+                                  deadline_ms=deadline_ms,
+                                  beam_size=beam_size,
+                                  max_length=max_length)
+
+    def _dispatch(self, sample, *, kind: str, deadline_ms,
+                  beam_size, max_length) -> Tuple[dict, dict]:
         if kind not in ("score", "generate"):
             raise BadRequest(f"unknown request kind {kind!r}")
         if self.fence is not None and not self.fence.valid():
@@ -618,6 +728,8 @@ class ReplicaRouter:
             # serves the same fleet. 503 so clients re-resolve to the
             # other endpoint (ServingClient rotates on Unavailable).
             self.metrics.inc("fenced_total")
+            if _flight._ACTIVE is not None:
+                _flight._ACTIVE.record("fenced_dispatch", kind=kind)
             raise Unavailable(
                 "router fenced: not the active role holder (the lease "
                 "lapsed or a standby adopted the fleet); retry against "
@@ -639,6 +751,11 @@ class ReplicaRouter:
             if rep is None:
                 return "none"
             tried.add(rep.id)
+            # one attempt = one child span of the dispatch span; the
+            # ambient context is scoped around start_call so both
+            # transports (engine submit / HTTP hop) parent under it
+            actx = _trace.child(_trace.current())
+            t0_wall, t0_perf = time.time(), time.perf_counter()
             try:
                 if _chaos._ACTIVE is not None:
                     # seeded fault site: a "drop" here is a dispatch
@@ -646,15 +763,20 @@ class ReplicaRouter:
                     # path, deterministic from the plan seed
                     _chaos._ACTIVE.hit("route_dispatch",
                                        replica=rep.id, kind=kind)
-                pend = rep.transport.start_call(kind, sample,
-                                                deadline_ms, gen_opts)
+                with _trace.use(actx):
+                    pend = rep.transport.start_call(
+                        kind, sample, deadline_ms, gen_opts)
             except Exception as e:  # noqa: BLE001 — incl. ChaosDropped
                 self._end_inflight(rep)
                 self._record_failure(rep, e)
                 prov["failovers"] += 1
                 self.metrics.inc("failovers_total")
+                self._record_attempt(rep.id, actx, t0_wall, t0_perf,
+                                     "failed", as_hedge)
                 return "consumed"
             pend.is_hedge = as_hedge
+            pend.trace_ctx = actx
+            pend.t0_wall, pend.t0_perf = t0_wall, t0_perf
             if as_hedge:
                 prov["hedges"] += 1
                 self.metrics.inc("hedges_total")
@@ -682,9 +804,26 @@ class ReplicaRouter:
                 live.remove((rep, pend))
                 self._end_inflight(rep)
                 okind, payload = pend.outcome()
+                self._record_attempt(rep.id, pend.trace_ctx,
+                                     pend.t0_wall, pend.t0_perf,
+                                     okind, pend.is_hedge)
                 if okind == "ok":
                     self._record_success(rep)
                     prov["replica"] = rep.id
+                    if self._first_answer_pending:
+                        # the first answer after a standby takeover is
+                        # the postmortem's closing bracket (lease
+                        # expiry -> adoption -> THIS); the unlocked
+                        # read keeps the hot path cheap, the locked
+                        # swap keeps the event singular when two
+                        # dispatches race past the read
+                        with self._lock:
+                            won = self._first_answer_pending
+                            self._first_answer_pending = False
+                        if won and _flight._ACTIVE is not None:
+                            _flight._ACTIVE.record(
+                                "first_answer_after_takeover",
+                                replica=rep.id)
                     if pend.is_hedge:
                         # only a HEDGE beating its primary is a win; a
                         # primary outrunning its hedge is not
@@ -896,6 +1035,7 @@ class ReplicaRouter:
             if len({r.id for r in self.replicas}) != len(self.replicas):
                 raise ValueError("adopted replica ids must be unique")
             self._next_id = max(self._next_id, len(self.replicas))
+            self._first_answer_pending = True
         logger.info("router: adopted %d replica(s): %s",
                     len(self.replicas),
                     [r.id for r in self.replicas])
@@ -912,6 +1052,45 @@ class ReplicaRouter:
                     if r.state in (READY, WARMING)
                     and r.last_health.get("backlog_ms") is not None]
         return sum(vals) / len(vals) if vals else None
+
+    def replica_metrics(self) -> Dict[str, dict]:
+        """Per-replica serving-metrics snapshots — ONE router scrape
+        then shows the whole fleet (metrics federation). Transports
+        without the hook (duck-typed fakes) and unreachable replicas
+        report an ``error`` entry instead of failing the scrape;
+        transport calls run outside the router lock, and CONCURRENTLY —
+        a wedged replica costs the scrape one probe timeout, not one
+        per sick replica in series."""
+        with self._lock:
+            pairs = [(r.id, r.transport) for r in self.replicas]
+        out: Dict[str, dict] = {}
+
+        def one(rid, transport):
+            try:
+                out[rid] = transport.metrics_snapshot()
+            except Exception as e:  # noqa: BLE001 — one sick replica
+                # must not take down the fleet scrape
+                out[rid] = {"error": repr(e)}
+
+        threads = []
+        for rid, transport in pairs:
+            if not callable(getattr(transport, "metrics_snapshot",
+                                    None)):
+                continue
+            th = threading.Thread(target=one, args=(rid, transport),
+                                  daemon=True,
+                                  name=f"metrics-scrape-{rid}")
+            th.start()
+            threads.append((rid, th))
+        deadline = time.monotonic() + 5.0
+        for rid, th in threads:
+            th.join(max(0.0, deadline - time.monotonic()))
+            if th.is_alive():
+                # the transport outlived its own probe timeout; the
+                # scrape moves on (the thread dies with its socket)
+                out.setdefault(rid, {"error": "metrics scrape timed "
+                                              "out"})
+        return out
 
     # ------------------------------------------------------------- health
     def fleet_health(self) -> dict:
@@ -982,6 +1161,12 @@ class RouterHA:
         self.last_peer_snapshot: List[dict] = []
         self.adoptions = 0
         self.adopted_at: Optional[float] = None  # monotonic
+        # True while the last step held a valid active role: the
+        # active→lapsed transition must be DATED even when the lease
+        # dies silently (renewals dropped by a partition never reach
+        # the store, so no refusal ever fires) — the postmortem's
+        # "lease expiry" bracket comes from exactly this edge
+        self._was_active = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -1025,12 +1210,28 @@ class RouterHA:
                 logger.warning("active-role renewal lost: %r", e)
                 renewed = False
             if not renewed and not self.lease.valid():
-                logger.warning(
+                self._was_active = False
+                log_event(
+                    logger, "role_fenced",
                     "router %s FENCED: lost the active role (epoch "
                     "moved or lease lapsed); dispatch now refuses",
-                    self.lease.holder_id)
+                    self.lease.holder_id, holder=self.lease.holder_id,
+                    epoch=self.lease.epoch)
+            else:
+                self._was_active = True
             self.peer_failures = 0
             return
+        if self._was_active:
+            # the lease lapsed BETWEEN steps (e.g. every renewal was
+            # partitioned away and never refused): this edge is the
+            # only place the silent expiry can be dated
+            self._was_active = False
+            log_event(
+                logger, "role_fenced",
+                "router %s FENCED: active-role lease lapsed (renewals "
+                "lost); dispatch now refuses",
+                self.lease.holder_id, holder=self.lease.holder_id,
+                epoch=self.lease.epoch)
         # ------------------------------------------------ standby watch
         try:
             h = self._poll_peer()
@@ -1057,23 +1258,15 @@ class RouterHA:
         if self.peer is None:
             raise RuntimeError("standby has no peer to watch (pass "
                                "peer=(host, port) or peer_healthz=)")
-        import http.client
-        import json as _json
         host, port = self.peer
-        conn = http.client.HTTPConnection(host, int(port), timeout=2.0)
-        try:
-            # a 503 body still carries the fleet snapshot — read it
-            # whatever the status (same contract as replica healthz)
-            conn.request("GET", "/healthz")
-            resp = conn.getresponse()
-            data = _json.loads(resp.read() or b"{}")
-            if not isinstance(data, dict) or "live" not in data:
-                raise ConnectionError(
-                    f"peer {host}:{port} healthz is not a health "
-                    f"payload (HTTP {resp.status})")
-            return data
-        finally:
-            conn.close()
+        # a 503 body still carries the fleet snapshot — _get_json reads
+        # it whatever the status (same contract as replica healthz)
+        status, data = _get_json(host, port, "/healthz", 2.0)
+        if not isinstance(data, dict) or "live" not in data:
+            raise ConnectionError(
+                f"peer {host}:{port} healthz is not a health "
+                f"payload (HTTP {status})")
+        return data
 
     def _take_over(self):
         """Adopt the fleet: rebuild the replica set from the last peer
@@ -1104,11 +1297,14 @@ class RouterHA:
         self.adopted_at = time.monotonic()
         self.peer_failures = 0
         self.router.metrics.inc("adoptions_total")
-        logger.warning(
+        log_event(
+            logger, "ha_takeover",
             "router %s ADOPTED the fleet (epoch %d): %d replica(s), "
             "%d ready", self.lease.holder_id, self.lease.epoch,
             len(self.router.replicas),
-            self.router.fleet_health()["ready_replicas"])
+            self.router.fleet_health()["ready_replicas"],
+            holder=self.lease.holder_id, epoch=self.lease.epoch,
+            replicas=len(self.router.replicas))
 
 
 # ------------------------------------------------------------- HTTP tier
@@ -1117,10 +1313,15 @@ class RouterHTTPServer(ThreadingHTTPServer):
     daemon_threads = True
     allow_reuse_address = True
 
-    def __init__(self, addr, router: ReplicaRouter, reload_builder=None):
+    def __init__(self, addr, router: ReplicaRouter, reload_builder=None,
+                 registry=None):
         super().__init__(addr, _RouterHandler)
         self.router = router
         self.reload_builder = reload_builder
+        # optional obs.MetricsRegistry: extra federated providers (the
+        # serve_fleet supervisor + autoscaler) riding this frontend's
+        # /metrics so one scrape covers the whole process
+        self.registry = registry
 
 
 class _RouterHandler(JSONHandler):
@@ -1131,6 +1332,7 @@ class _RouterHandler(JSONHandler):
 
     # -------------------------------------------------------------- GET
     def do_GET(self):
+        self._tctx = _trace.ctx_from_headers(self.headers)
         router: ReplicaRouter = self.server.router
         path = self.path.split("?", 1)[0]
         if path == "/healthz":
@@ -1139,12 +1341,27 @@ class _RouterHandler(JSONHandler):
         elif path == "/livez":
             self._send(200, {"status": "ok", "live": True})
         elif path == "/metrics":
+            registry = getattr(self.server, "registry", None)
             if "format=json" in self.path:
                 snap = router.metrics.snapshot()
                 snap["fleet"] = router.fleet_health()
+                # federation: per-replica serving snapshots + any extra
+                # registered providers — one scrape, the whole fleet
+                snap["replicas_metrics"] = router.replica_metrics()
+                if registry is not None:
+                    snap["federation"] = registry.snapshot()
                 self._send(200, snap)
             else:
-                self._send(200, router.metrics.to_prometheus().encode(),
+                from paddle_tpu.obs.registry import prom_from_dict
+                chunks = [router.metrics.to_prometheus().rstrip("\n")]
+                for rid, rsnap in sorted(
+                        router.replica_metrics().items()):
+                    chunks.extend(prom_from_dict(
+                        "paddle_tpu_replica", rsnap,
+                        labels={"replica": rid}))
+                if registry is not None:
+                    chunks.append(registry.to_prometheus().rstrip("\n"))
+                self._send(200, ("\n".join(chunks) + "\n").encode(),
                            content_type="text/plain; version=0.0.4")
         else:
             self._send(404, {"error": {"code": "not_found",
@@ -1152,6 +1369,7 @@ class _RouterHandler(JSONHandler):
 
     # ------------------------------------------------------------- POST
     def do_POST(self):
+        self._tctx = _trace.ctx_from_headers(self.headers)
         router: ReplicaRouter = self.server.router
         path = self.path.split("?", 1)[0]
         if path == "/admin/reload":
@@ -1176,7 +1394,8 @@ class _RouterHandler(JSONHandler):
                 raise BadRequest("need \"sample\" (one request) or "
                                  "\"rows\" (a list)")
             result, prov = router.dispatch(
-                body["sample"], kind=kind, deadline_ms=deadline_ms, **gen)
+                body["sample"], kind=kind, deadline_ms=deadline_ms,
+                trace_parent=self._tctx, **gen)
             self._send(200, result, headers=self._prov_headers(prov))
         except ServingError as e:
             prov = getattr(e, "provenance", prov)
@@ -1204,10 +1423,13 @@ class _RouterHandler(JSONHandler):
         results = [None] * len(rows)
         any_err = [False]
 
+        tctx = self._tctx  # worker threads get no ambient contextvars
+
         def one(i, row):
             try:
                 result, prov = router.dispatch(
-                    row, kind=kind, deadline_ms=deadline_ms, **gen)
+                    row, kind=kind, deadline_ms=deadline_ms,
+                    trace_parent=tctx, **gen)
                 result = dict(result)
                 result["replica"] = prov.get("replica")
                 results[i] = result
@@ -1258,11 +1480,14 @@ class _RouterHandler(JSONHandler):
 
 
 def make_router_server(router: ReplicaRouter, host: str = "127.0.0.1",
-                       port: int = 0, reload_builder=None):
+                       port: int = 0, reload_builder=None,
+                       registry=None):
     """Bind the router frontend (port=0 = ephemeral, for tests); the
-    bound port is ``server.server_address[1]``."""
+    bound port is ``server.server_address[1]``. ``registry`` federates
+    extra metric providers (supervisor, autoscaler) into ``/metrics``."""
     return RouterHTTPServer((host, port), router,
-                            reload_builder=reload_builder)
+                            reload_builder=reload_builder,
+                            registry=registry)
 
 
 def install_router_signal_handlers(router: ReplicaRouter,
@@ -1292,13 +1517,14 @@ def install_router_signal_handlers(router: ReplicaRouter,
 
 def serve_router_forever(router: ReplicaRouter, host: str = "127.0.0.1",
                          port: int = 8000, reload_builder=None,
-                         ready_line: bool = True):
+                         ready_line: bool = True, registry=None):
     """CLI entry for ``--job=serve --replicas N``: start the health
     loop, bind, install SIGTERM handlers that drain EVERY replica (zero
     queued drops), serve until drained."""
     router.start()
     server = make_router_server(router, host, port,
-                                reload_builder=reload_builder)
+                                reload_builder=reload_builder,
+                                registry=registry)
     install_router_signal_handlers(router, server)
     if ready_line:
         h = router.fleet_health()

@@ -45,6 +45,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from paddle_tpu.obs import trace as _trace
 from paddle_tpu.serving.batcher import ServingEngine
 from paddle_tpu.serving.errors import BadRequest, ServingError
 from paddle_tpu.utils.log import get_logger
@@ -72,6 +73,17 @@ class JSONHandler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # stderr spam -> debug log
         logger.debug("%s " + fmt, self.address_string(), *args)
 
+    def trace_ctx(self) -> _trace.TraceContext:
+        """This request's trace context: the caller's ``X-Trace-Id``
+        parsed, or a fresh root when none was sent (the server then
+        NAMES the trace). Cached per request so ``_send`` echoes the
+        same id the handler propagated."""
+        ctx = getattr(self, "_tctx", None)
+        if ctx is None:
+            ctx = _trace.ctx_from_headers(self.headers)
+            self._tctx = ctx
+        return ctx
+
     def _send(self, status: int, body: dict,
               content_type: str = "application/json",
               retry_after_ms: Optional[float] = None,
@@ -81,6 +93,10 @@ class JSONHandler(BaseHTTPRequestHandler):
         self.send_response(status)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(data)))
+        # EVERY response — 2xx, typed 4xx/5xx, fenced 503s, 404s —
+        # echoes the request's trace id, so a caller can always name
+        # the trace that answered (or refused) it
+        self.send_header(_trace.HEADER, self.trace_ctx().trace_id)
         if retry_after_ms is not None:
             # Retry-After is whole seconds; keep sub-second hints in the
             # JSON body's retry_after_ms
@@ -113,6 +129,9 @@ class _Handler(JSONHandler):
 
     # ------------------------------------------------------------ GET
     def do_GET(self):
+        # per-request: a keep-alive connection reuses the handler, so
+        # the ctx must re-derive from THIS request's headers
+        self._tctx = _trace.ctx_from_headers(self.headers)
         engine = self.server.engine
         path = self.path.split("?", 1)[0]
         if path == "/healthz":
@@ -140,6 +159,7 @@ class _Handler(JSONHandler):
 
     # ------------------------------------------------------------ POST
     def do_POST(self):
+        self._tctx = _trace.ctx_from_headers(self.headers)
         engine = self.server.engine
         path = self.path.split("?", 1)[0]
         if path == "/admin/drain":
@@ -173,35 +193,48 @@ class _Handler(JSONHandler):
                 # 400/429) must not abort its siblings — its slot
                 # carries the error body, the rest still serve
                 reqs = []
-                for row in body["rows"]:
-                    try:
-                        reqs.append(engine.submit(
-                            row, kind=kind, deadline_ms=deadline_ms,
-                            **gen_opts))
-                    except ServingError as e:
-                        reqs.append(e)
-                results = []
-                from paddle_tpu.serving.errors import DeadlineExceeded
-                any_err = False
-                for r in reqs:
-                    if isinstance(r, ServingError):
-                        results.append(r.to_wire())
-                        any_err = True
-                        continue
-                    if not r.event.wait(120.0):  # never block a handler
-                        r.error = DeadlineExceeded(
-                            "no answer within the server wait bound")
-                    any_err = any_err or r.error is not None
-                    results.append(r.error.to_wire() if r.error
-                                   else r.result)
+                with _trace.span(f"http.{kind}", parent=self._tctx,
+                                 rows=len(body["rows"])):
+                    # the span's context is ambient while rows submit,
+                    # so each engine request parents its replica-side
+                    # spans under this HTTP hop — and the span covers
+                    # the answer waits too, or its wall time would
+                    # exclude almost all of the request and read
+                    # SHORTER than its replica-side children
+                    for row in body["rows"]:
+                        try:
+                            reqs.append(engine.submit(
+                                row, kind=kind, deadline_ms=deadline_ms,
+                                **gen_opts))
+                        except ServingError as e:
+                            reqs.append(e)
+                    results = []
+                    from paddle_tpu.serving.errors import \
+                        DeadlineExceeded
+                    any_err = False
+                    for r in reqs:
+                        if isinstance(r, ServingError):
+                            results.append(r.to_wire())
+                            any_err = True
+                            continue
+                        if not r.event.wait(120.0):  # never block a
+                            # handler forever
+                            r.error = DeadlineExceeded(
+                                "no answer within the server wait "
+                                "bound")
+                        any_err = any_err or r.error is not None
+                        results.append(r.error.to_wire() if r.error
+                                       else r.result)
                 self._send(200 if not any_err else 207,  # multi-status
                            {"results": results})
                 return
             if "sample" not in body:
                 raise BadRequest("need \"sample\" (one request) or "
                                  "\"rows\" (a list)")
-            result = engine.infer(body["sample"], kind=kind,
-                                  deadline_ms=deadline_ms, **gen_opts)
+            with _trace.span(f"http.{kind}", parent=self._tctx):
+                result = engine.infer(body["sample"], kind=kind,
+                                      deadline_ms=deadline_ms,
+                                      **gen_opts)
             self._send(200, result)
         except ServingError as e:
             self._send_error(e)

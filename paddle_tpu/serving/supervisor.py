@@ -54,9 +54,11 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from paddle_tpu.dist.master import LeaseTable
+from paddle_tpu.obs import flight as _flight
 from paddle_tpu.serving.metrics import RouterMetrics
 from paddle_tpu.serving.router import HTTPTransport
 from paddle_tpu.testing import chaos as _chaos
+from paddle_tpu.utils.log import event as log_event
 from paddle_tpu.utils.log import get_logger
 
 logger = get_logger("serving.supervisor")
@@ -160,6 +162,18 @@ class ReplicaSupervisor:
     def _event(self, kind: str, rid: str, **info):
         with self._lock:
             self.events.append((time.monotonic(), kind, rid, info))
+        # the audit trail doubles as the flight-recorder feed: the
+        # SAME lifecycle transitions (crashed / lease_expired / killed
+        # / spawned / spawn_failed / scale_up / scale_down /
+        # lease_renew_lost) land in the merged postmortem timeline —
+        # recorded OUTSIDE the supervisor lock (edge-free discipline);
+        # the child's pid travels as replica_pid — the record's own
+        # ``pid`` is the supervisor's (blackbox merges/attributes on it)
+        if _flight._ACTIVE is not None:
+            _flight._ACTIVE.record(
+                f"replica_{kind}", replica=rid,
+                **{("replica_pid" if k == "pid" else k): v
+                   for k, v in info.items()})
 
     def _claim(self, rep: SupervisedReplica) -> bool:
         """Claim a slot's lifecycle (kill/spawn) transition. False when
@@ -678,10 +692,13 @@ class Autoscaler:
                     self._last_action_t = now
                     self._above_since = None
                     self._record(now, self.target.replica_count())
-                    logger.info(
+                    log_event(
+                        logger, "autoscale_up",
                         "autoscaler: scale UP (ewma backlog %.1f ms > "
                         "%.1f ms sustained)", self.ewma,
-                        self.up_backlog_ms)
+                        self.up_backlog_ms, level=20,
+                        ewma_backlog_ms=round(self.ewma, 1),
+                        replicas=self.target.replica_count())
         elif self.ewma < self.down_backlog_ms:
             self._above_since = None
             if self._below_since is None:
@@ -692,10 +709,13 @@ class Autoscaler:
                     self._last_action_t = now
                     self._below_since = None
                     self._record(now, self.target.replica_count())
-                    logger.info(
+                    log_event(
+                        logger, "autoscale_down",
                         "autoscaler: scale DOWN (ewma backlog %.1f ms "
                         "< %.1f ms sustained)", self.ewma,
-                        self.down_backlog_ms)
+                        self.down_backlog_ms, level=20,
+                        ewma_backlog_ms=round(self.ewma, 1),
+                        replicas=self.target.replica_count())
         else:
             # inside the hysteresis band: both sustain clocks reset —
             # a flap back into the band forfeits its progress

@@ -101,11 +101,24 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from paddle_tpu.obs import flight as _flight
 from paddle_tpu.utils.log import get_logger
 
 logger = get_logger("testing.chaos")
 
 ENV_VAR = "PADDLE_TPU_CHAOS_PLAN"
+
+# The CLOSED catalog of chaos hook sites wired in this codebase (the
+# table above documents each). Every ``_ACTIVE.hit("<site>")`` call in
+# paddle_tpu/ must name a member (graftlint PT107 — the static twin),
+# and every member must have a firing row in the closure-enforced
+# flight-recorder matrix (tests/test_obs_flight.py:SITE_CASES) — a new
+# chaos site cannot ship without its postmortem event.
+SITES = (
+    "step", "step_done", "msg_send", "msg_recv", "checkpoint",
+    "store_save", "serve_batch", "route_dispatch", "replica_spawn",
+    "supervisor_spawn", "lease_renew", "router_failover",
+)
 
 # the one global the hook sites poll; None == chaos disabled
 _ACTIVE: Optional["FaultPlan"] = None
@@ -233,11 +246,21 @@ class FaultPlan:
                 self.log.append((site, n, f["type"]))
         for _, f in due:
             kind = f["type"]
+            if _flight._ACTIVE is not None:
+                # the fired fault IS postmortem evidence: record BEFORE
+                # the effect runs, so even a kill leaves its trace in
+                # the black box (dumped below for the no-atexit exit)
+                _flight._ACTIVE.record("chaos_fire", site=site, hit=n,
+                                       fault=kind,
+                                       mode=f.get("mode"))
             if kind == "kill":
                 logger.warning("chaos: kill at %s hit %d (%s)", site, n,
                                f.get("mode", "exit"))
                 if f.get("mode", "exit") == "raise":
                     raise ChaosKilled(f"chaos kill at {site} hit {n}")
+                # os._exit skips atexit — the flight dump must happen
+                # HERE or the kill erases the black box describing it
+                _flight.dump_now()
                 os._exit(f.get("exit_code", self.exit_code))
             elif kind in ("delay", "straggle"):
                 time.sleep(float(f.get("seconds", 0.01)))

@@ -100,6 +100,12 @@ def parse_args(argv=None):
     p.add_argument("--prefetch_depth", type=int, default=2,
                    help="batches in flight under --use_async_load_data "
                         "(2 = double buffer)")
+    p.add_argument("--metrics_port", type=int, default=0,
+                   help="bind a /metrics exporter (Prometheus text + "
+                        "?format=json) for this process: --job=train "
+                        "exports the live StepBreakdown + per-device "
+                        "memory_stats (the serving jobs already expose "
+                        "/metrics on their HTTP frontend); 0 disables")
     p.add_argument("--show_step_breakdown", action="store_true",
                    help="log the per-step host-time split {data_wait, "
                         "h2d, compute, callback} and per-device "
@@ -433,21 +439,52 @@ def cmd_train(ns, args):
                 print(f"  Test: cost={res.cost:.5g} " + " ".join(
                     f"{k}={v:.5g}" for k, v in res.evaluator.items()))
 
-    trainer.train(reader, feeder=feeder, num_passes=args.num_passes,
-                  event_handler=handler, log_period=args.log_period,
-                  dot_period=args.dot_period,
-                  show_parameter_stats_period=(
-                      args.show_parameter_stats_period),
-                  show_layer_stat=args.show_layer_stat,
-                  async_load_data=getattr(args, "use_async_load_data",
-                                          False),
-                  prefetch_depth=getattr(args, "prefetch_depth", 2),
-                  show_step_breakdown=getattr(args, "show_step_breakdown",
+    metrics_srv = None
+    if getattr(args, "metrics_port", 0):
+        # metrics federation for the training side: the SAME scrape
+        # surface the serving fleet has, exporting the live
+        # StepBreakdown split + per-device memory accounting
+        from paddle_tpu.obs import MetricsRegistry, serve_metrics
+
+        def train_snapshot():
+            out = {"step_breakdown": trainer.breakdown.summary()}
+            try:
+                from paddle_tpu.utils.profiler import memory_stats
+                out["memory"] = memory_stats(
+                    trainer.params, getattr(trainer, "opt_state", None))
+            except Exception as e:  # noqa: BLE001 — a scrape must
+                # never interrupt training
+                out["memory"] = {"error": repr(e)}
+            return out
+
+        registry = MetricsRegistry().register("train", train_snapshot)
+        metrics_srv = serve_metrics(registry, host=args.host,
+                                    port=args.metrics_port)
+        print(f"train metrics on http://{args.host}:"
+              f"{metrics_srv.server_address[1]}/metrics", flush=True)
+    try:
+        trainer.train(reader, feeder=feeder, num_passes=args.num_passes,
+                      event_handler=handler, log_period=args.log_period,
+                      dot_period=args.dot_period,
+                      show_parameter_stats_period=(
+                          args.show_parameter_stats_period),
+                      show_layer_stat=args.show_layer_stat,
+                      async_load_data=getattr(args, "use_async_load_data",
                                               False),
-                  zero1=True if getattr(args, "use_zero1", False) else None,
-                  grad_accum_steps=getattr(args, "grad_accum_steps", 1),
-                  checkpointer=ck,
-                  auto_resume=getattr(args, "auto_resume", True))
+                      prefetch_depth=getattr(args, "prefetch_depth", 2),
+                      show_step_breakdown=getattr(args,
+                                                  "show_step_breakdown",
+                                                  False),
+                      zero1=True if getattr(args, "use_zero1", False)
+                      else None,
+                      grad_accum_steps=getattr(args, "grad_accum_steps",
+                                               1),
+                      checkpointer=ck,
+                      auto_resume=getattr(args, "auto_resume", True))
+    finally:
+        if metrics_srv is not None:
+            metrics_srv.shutdown()
+            metrics_srv.server_close()
     return 0
 
 
@@ -836,9 +873,22 @@ def cmd_serve_fleet(ns, args):
             supervisor, min_replicas=min_r, max_replicas=max_r,
             up_backlog_ms=args.autoscale_up_backlog_ms,
             down_backlog_ms=args.autoscale_down_backlog_ms).start()
+    # metrics federation: the router frontend's /metrics additionally
+    # carries the supervisor's replica table (+ the autoscale
+    # trajectory) so ONE scrape shows the whole self-operating fleet
+    from paddle_tpu.obs import MetricsRegistry
+    registry = MetricsRegistry().register("supervisor",
+                                          supervisor.snapshot)
+    if scaler is not None:
+        registry.register(
+            "autoscaler",
+            lambda: {"replicas": supervisor.replica_count(),
+                     "ewma_backlog_ms": scaler.ewma,
+                     "trajectory": [list(p) for p in
+                                    scaler.trajectory[-64:]]})
     try:
         return serve_router_forever(router, host=args.host,
-                                    port=args.port)
+                                    port=args.port, registry=registry)
     finally:
         if scaler is not None:
             scaler.stop()
@@ -865,6 +915,12 @@ def main(argv=None):
     # through the env); a no-op unless PADDLE_TPU_CHAOS_PLAN is set
     from paddle_tpu.testing import chaos as _chaos
     _chaos.install_from_env()
+    # observability plane (a no-op unless $PADDLE_TPU_TRACE_DIR /
+    # $PADDLE_TPU_FLIGHT_DIR are set): spans + flight events dump at
+    # exit, tagged with this process's job kind so tools/blackbox.py
+    # can merge a whole fleet's dumps into one named timeline
+    from paddle_tpu import obs
+    obs.arm_from_env(args.job)
     if getattr(args, "fp_anomaly", False):
         from paddle_tpu.utils.fp import enable_fp_anomaly
         enable_fp_anomaly()

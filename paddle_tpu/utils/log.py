@@ -1,33 +1,100 @@
-"""glog-style logging (``paddle/utils/Logging.h``).
+"""glog-style logging (``paddle/utils/Logging.h``) + structured mode.
 
 One shared logger with the glog line format
 ``I0729 12:00:00.123456 module.py:42] message``; unbuffered like the
 reference's trainer main (``TrainerMain.cpp:34``).
+
+Structured (JSONL) mode — ``PADDLE_TPU_LOG_JSON=1`` or
+:func:`enable_structured` — emits one JSON object per record
+(``{ts, level, logger, src, msg, event?, fields?, trace_id?,
+span_id?}``), stamping the ACTIVE trace context
+(``paddle_tpu/obs/trace.py``) into every record so a grep for one
+trace_id pulls a request's log lines across the fleet's processes.
+
+:func:`event` is the taggable-event helper the router / supervisor
+failover paths use instead of ad-hoc f-string warnings: one call logs
+a structured record (``event`` + machine-readable ``fields``) AND
+records the same event into the flight recorder when one is armed
+(``paddle_tpu/obs/flight.py``) — the log line is for humans tailing a
+process, the flight event is for the merged postmortem timeline.
 """
 
 from __future__ import annotations
 
+import json
 import logging
+import os
 import sys
 
 _FMT = ("%(levelname).1s%(asctime)s.%(msecs)03d "
         "%(filename)s:%(lineno)d] %(message)s")
 _DATEFMT = "%m%d %H:%M:%S"
 
+ENV_JSON = "PADDLE_TPU_LOG_JSON"
+
 _configured = False
+_handler: logging.Handler = None
+
+
+class _StructuredFormatter(logging.Formatter):
+    """One JSON object per record; trace ids stamped when a trace
+    context is active on the emitting thread."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        out = {"ts": round(record.created, 6),
+               "level": record.levelname,
+               "logger": record.name,
+               "src": f"{record.filename}:{record.lineno}",
+               "msg": record.getMessage()}
+        ev = getattr(record, "event", None)
+        if ev:
+            out["event"] = ev
+        fields = getattr(record, "fields", None)
+        if fields:
+            out["fields"] = fields
+        try:
+            from paddle_tpu.obs import trace as _trace
+            ctx = _trace.current()
+            if ctx is not None:
+                out["trace_id"] = ctx.trace_id
+                out["span_id"] = ctx.span_id
+        except Exception:  # noqa: BLE001 — logging must never raise
+            pass
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        try:
+            return json.dumps(out)
+        except (TypeError, ValueError):
+            out["fields"] = repr(fields)
+            return json.dumps(out)
 
 
 def _configure():
-    global _configured
+    global _configured, _handler
     if _configured:
         return
-    handler = logging.StreamHandler(sys.stderr)
-    handler.setFormatter(logging.Formatter(_FMT, datefmt=_DATEFMT))
+    _handler = logging.StreamHandler(sys.stderr)
+    if os.environ.get(ENV_JSON, "").lower() in ("1", "true", "on"):
+        _handler.setFormatter(_StructuredFormatter())
+    else:
+        _handler.setFormatter(logging.Formatter(_FMT, datefmt=_DATEFMT))
     root = logging.getLogger("paddle_tpu")
-    root.addHandler(handler)
+    root.addHandler(_handler)
     root.setLevel(logging.INFO)
     root.propagate = False
     _configured = True
+
+
+def enable_structured():
+    """Flip the shared handler to JSONL records (idempotent)."""
+    _configure()
+    _handler.setFormatter(_StructuredFormatter())
+
+
+def disable_structured():
+    """Back to the glog line format (tests restore state with this)."""
+    _configure()
+    _handler.setFormatter(logging.Formatter(_FMT, datefmt=_DATEFMT))
 
 
 def get_logger(name: str = "paddle_tpu") -> logging.Logger:
@@ -35,6 +102,24 @@ def get_logger(name: str = "paddle_tpu") -> logging.Logger:
     if name == "paddle_tpu" or name.startswith("paddle_tpu."):
         return logging.getLogger(name)
     return logging.getLogger("paddle_tpu." + name)
+
+
+def event(log: logging.Logger, name: str, msg: str, *args,
+          level: int = logging.WARNING, **fields):
+    """A taggable structured event: ``event(logger, "breaker_open",
+    "breaker opened for %s", rid, replica=rid)``. In structured mode
+    the record carries ``event`` + ``fields`` (+ active trace ids); in
+    glog mode the same human line prints. When a flight recorder is
+    armed the event also lands in the ring, so failover paths feed the
+    postmortem timeline with the exact call that warned the operator.
+
+    Call OUTSIDE any lock hold: the log handler serializes on the
+    logging module's own lock."""
+    log.log(level, msg, *args,
+            extra={"event": name, "fields": fields or None})
+    from paddle_tpu.obs import flight as _flight
+    if _flight._ACTIVE is not None:
+        _flight._ACTIVE.record(name, **fields)
 
 
 logger = get_logger()

@@ -514,13 +514,16 @@ def test_pt301_sees_locks_nested_under_control_flow(tmp_path):
 
 def test_pass3_records_worker_loop_acquisitions():
     """The real modules' loop/try-nested lock sites are in the graph:
-    MasterClient.call's exchange lock (the PR 6 site, under for+try)
-    and the batcher worker's except-path lock."""
+    MasterClient's per-exchange lock (the PR 6 site, under for+try —
+    since r15 the retry cycle lives in ``_call_retrying``, with
+    ``call`` a thin tracing wrapper above it) and the batcher worker's
+    except-path lock."""
     from paddle_tpu.analysis.lockorder import LockOrderChecker
     ck = LockOrderChecker(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     ck.run()
-    call = ck.methods["paddle_tpu.dist.master.MasterClient.call"]
+    call = ck.methods[
+        "paddle_tpu.dist.master.MasterClient._call_retrying"]
     assert any(i == "paddle_tpu.dist.master.MasterClient._lock"
                for _h, i, _l in call.acquires)
     work = ck.methods["paddle_tpu.serving.batcher.ServingEngine._work"]

@@ -39,15 +39,17 @@ def test_pass3_lock_order_clean_and_covers_threaded_modules():
                 "paddle_tpu/dist/master.py",
                 "paddle_tpu/dist/checkpoint.py",
                 "paddle_tpu/trainer/checkpoint.py",
-                "paddle_tpu/data/prefetch.py"):
+                "paddle_tpu/data/prefetch.py",
+                "paddle_tpu/obs/trace.py",
+                "paddle_tpu/obs/flight.py",
+                "paddle_tpu/obs/registry.py"):
         assert mod in checker.modules
     # the analysis is not vacuous: it found the repo's locks (incl. the
-    # replica router's state lock, RouterMetrics, and the r14 replica
-    # supervisor's bookkeeping lock — exactly ONE new lock, no new
-    # edges: the supervisor calls no transport/chaos/metrics code while
-    # holding it) and real held-while-acquiring edges
+    # replica router's state lock, RouterMetrics, the r14 replica
+    # supervisor's bookkeeping lock, and the r15 obs plane's tracer +
+    # metrics-registry locks) and real held-while-acquiring edges
     # (engine->metrics, master->store/chaos)
-    assert len(checker.locks) >= 11
+    assert len(checker.locks) >= 13
     assert len(checker.edges) >= 3
     sup_locks = [l for l in checker.locks if "supervisor" in str(l)]
     assert sup_locks == [
@@ -55,6 +57,19 @@ def test_pass3_lock_order_clean_and_covers_threaded_modules():
     assert not any("supervisor" in str(a) or "supervisor" in str(b)
                    for a, b in checker.edges), (
         "the supervisor lock must stay edge-free (bookkeeping only)")
+    # r15 observability pins: the tracer's span-buffer lock and the
+    # registry's provider-table lock exist AND sit edge-free in the
+    # graph (obs never calls back into a subsystem under its locks;
+    # subsystems record spans only outside their own). The flight
+    # ring is LOCK-FREE by design — it must not contribute a lock at
+    # all, or recording under the master RPC lock would grow edges.
+    obs_locks = sorted(l for l in checker.locks if ".obs." in str(l))
+    assert obs_locks == [
+        "paddle_tpu.obs.registry.MetricsRegistry._lock",
+        "paddle_tpu.obs.trace.Tracer._lock"]
+    assert not any(".obs." in str(a) or ".obs." in str(b)
+                   for a, b in checker.edges), (
+        "obs locks must stay edge-free (append/snapshot only)")
 
 
 def test_bench_schema_clean():

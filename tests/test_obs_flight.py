@@ -1,0 +1,237 @@
+"""Flight recorder: ring semantics, the closure-enforced chaos-site
+matrix, dumps, and the blackbox merge.
+
+The matrix is the runtime half of graftlint PT107: ``SITE_CASES`` must
+cover EXACTLY ``chaos.SITES`` (closure-enforced below), and firing a
+fault at every site must land a ``chaos_fire`` event in the armed
+recorder — a new chaos hook site cannot ship without its postmortem
+event (the static twin checks the same closure at lint time, so the
+gap is visible without running tests).
+"""
+
+import json
+import logging
+import os
+
+import pytest
+
+from paddle_tpu.obs import flight
+from paddle_tpu.testing import chaos
+from paddle_tpu.utils import log as ptlog
+
+# ----------------------------------------------------------- the matrix
+# site -> representative info kwargs (the schema each production call
+# site reports; ``match`` triggers key off these, so the row doubles as
+# documentation of what a plan can target at that site)
+SITE_CASES = {
+    "step": {"pass_id": 0, "batch_id": 3},
+    "step_done": {"pass_id": 0, "batch_id": 3},
+    "msg_send": {},
+    "msg_recv": {},
+    "checkpoint": {"path": "checkpoint-p00000-b00000003.npz"},
+    "store_save": {},
+    "serve_batch": {"kind": "score", "size": 2},
+    "route_dispatch": {"replica": "r0", "kind": "score"},
+    "replica_spawn": {"replica": "r0"},
+    "supervisor_spawn": {"replica": "r0", "why": "start"},
+    "lease_renew": {"holder": "A", "role": "active"},
+    "router_failover": {"holder": "B", "epoch": 2},
+}
+
+
+@pytest.fixture
+def recorder():
+    rec = flight.install(flight.FlightRecorder("test"))
+    try:
+        yield rec
+    finally:
+        flight.install(None)
+
+
+def test_site_matrix_is_closed_over_chaos_sites():
+    """Closure enforcement: every declared chaos site has a matrix row
+    and no row names an undeclared site — the runtime twin of PT107."""
+    assert set(SITE_CASES) == set(chaos.SITES), (
+        "chaos.SITES and SITE_CASES diverged — a site without its "
+        "matrix row ships without its flight event "
+        f"(missing rows: {set(chaos.SITES) - set(SITE_CASES)}; "
+        f"stale rows: {set(SITE_CASES) - set(chaos.SITES)})")
+
+
+@pytest.mark.parametrize("site", sorted(SITE_CASES))
+def test_every_chaos_site_emits_a_flight_event_when_it_fires(
+        site, recorder):
+    """A fault firing at ANY hook site records a ``chaos_fire`` event
+    (before the effect runs — the black box survives what it
+    describes)."""
+    info = SITE_CASES[site]
+    plan = chaos.FaultPlan(seed=1, faults=[
+        {"type": "delay", "site": site, "at": 1, "seconds": 0.0}])
+    with chaos.chaos_plan(plan):
+        plan.hit(site, **info)
+        plan.hit(site, **info)  # at=1 only: exactly one fire
+    fired = recorder.events("chaos_fire")
+    assert len(fired) == 1
+    assert fired[0]["site"] == site
+    assert fired[0]["fault"] == "delay"
+    assert fired[0]["hit"] == 1
+
+
+def test_kill_raise_records_before_raising(recorder):
+    plan = chaos.FaultPlan(seed=2, faults=[
+        {"type": "kill", "site": "serve_batch", "at": 1,
+         "mode": "raise"}])
+    with chaos.chaos_plan(plan):
+        with pytest.raises(chaos.ChaosKilled):
+            plan.hit("serve_batch", kind="score", size=1)
+    fired = recorder.events("chaos_fire")
+    assert len(fired) == 1 and fired[0]["fault"] == "kill"
+    assert fired[0]["mode"] == "raise"
+
+
+# ------------------------------------------------------- ring semantics
+def test_ring_is_bounded_and_counts_evictions():
+    rec = flight.FlightRecorder("b", capacity=8)
+    for i in range(20):
+        rec.record("tick", i=i)
+    events = rec.events()
+    assert len(events) == 8
+    assert [e["i"] for e in events] == list(range(12, 20))
+    assert rec.dropped == 12
+    # seq is a total order even at equal wall timestamps
+    assert [e["seq"] for e in events] == list(range(13, 21))
+
+
+def test_caller_fields_cannot_clobber_core_keys():
+    """blackbox merges on (ts, pid, seq) and attributes lines to
+    service/pid — a caller field named after a core key (the
+    supervisor lifecycle passes a CHILD's pid) must not re-attribute
+    the record; it lands under x_<key> instead. ``event`` is
+    positional-only, so even that name is a usable field."""
+    rec = flight.FlightRecorder("guard")
+    rec.record("replica_killed", pid=424242, event="boom", ts=1.0)
+    (e,) = rec.events()
+    assert e["pid"] == os.getpid()
+    assert e["event"] == "replica_killed"
+    assert e["x_pid"] == 424242
+    assert e["x_event"] == "boom"
+    assert e["x_ts"] == 1.0
+    assert isinstance(e["ts"], float) and e["ts"] > 1.0
+
+
+def test_module_record_is_noop_when_disarmed():
+    flight.install(None)
+    flight.record("nobody_home", x=1)  # must not raise
+    assert flight.active() is None
+
+
+# --------------------------------------------------- dumps and blackbox
+def test_dump_and_blackbox_merge_orders_across_processes(tmp_path):
+    """Two per-process dumps merge into one wall-clock-ordered
+    timeline; a torn tail line (a process died mid-write) is skipped,
+    not fatal."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import blackbox
+
+    a = flight.FlightRecorder("router")
+    b = flight.FlightRecorder("replica")
+    a.record("lease_expired", holder="A")
+    b.record("ha_takeover", holder="B", epoch=2)
+    a.record("first_answer_after_takeover", replica="r1")
+    pa = a.dump_jsonl(str(tmp_path / "flight-router-1.jsonl"))
+    pb = b.dump_jsonl(str(tmp_path / "flight-replica-2.jsonl"))
+    assert pa and pb
+    # torn tail: truncated JSON must be skipped with a warning
+    with open(pb, "a", encoding="utf-8") as f:
+        f.write('{"ts": 1, "event": "torn')
+    merged = blackbox.merge_dir(str(tmp_path))
+    assert [e["event"] for e in merged] == [
+        "lease_expired", "ha_takeover", "first_answer_after_takeover"]
+    text = blackbox.format_timeline(merged)
+    assert "lease_expired" in text and "holder=A" in text
+    # round-trip: the merged list is JSON-able (the --json contract)
+    json.dumps(merged)
+
+
+def test_dump_jsonl_skips_quietly_without_env_dir(recorder,
+                                                 monkeypatch):
+    monkeypatch.delenv(flight.ENV_DIR, raising=False)
+    assert recorder.dump_jsonl() is None
+    assert flight.dump_now() is None
+
+
+def test_dump_now_never_raises_on_unwritable_dir(recorder, tmp_path,
+                                                 monkeypatch):
+    """The crash-path callers (chaos os._exit kill, SIGTERM handler,
+    worker-fatal) must complete whether or not the dump lands: a full
+    disk must not un-kill a kill."""
+    blocked = tmp_path / "blocked"
+    blocked.write_text("a file, not a dir")  # makedirs -> OSError
+    monkeypatch.setenv(flight.ENV_DIR, str(blocked / "sub"))
+    recorder.record("doomed")
+    assert flight.dump_now() is None  # swallowed, not raised
+
+
+def test_arm_from_env_installs_and_dumps(tmp_path, monkeypatch):
+    monkeypatch.setenv(flight.ENV_DIR, str(tmp_path))
+    prev = flight.active()
+    try:
+        rec = flight.arm_from_env("unit")
+        assert rec is not None and flight.active() is rec
+        rec.record("armed_event", n=1)
+        path = flight.dump_now()
+        assert path and os.path.exists(path)
+        with open(path, encoding="utf-8") as f:
+            events = [json.loads(line) for line in f]
+        assert events and events[-1]["event"] == "armed_event"
+        assert events[-1]["service"] == "unit"
+    finally:
+        flight.install(prev)
+
+
+# -------------------------------------------- log.event taggable events
+def test_log_event_feeds_flight_and_structured_records(recorder,
+                                                       capsys):
+    """One ``log.event`` call = a human log line AND a flight event;
+    in structured mode the record is one JSON object carrying the
+    event tag + machine-readable fields."""
+    logger = ptlog.get_logger("test.obs")
+    handler_records = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            handler_records.append(
+                ptlog._StructuredFormatter().format(record))
+
+    cap = _Capture()
+    logger.addHandler(cap)
+    try:
+        ptlog.event(logger, "breaker_open",
+                    "breaker opened for %s", "r2",
+                    replica="r2", cooldown_ms=100.0)
+    finally:
+        logger.removeHandler(cap)
+    fired = recorder.events("breaker_open")
+    assert len(fired) == 1
+    assert fired[0]["replica"] == "r2"
+    assert fired[0]["cooldown_ms"] == 100.0
+    rec = json.loads(handler_records[0])
+    assert rec["event"] == "breaker_open"
+    assert rec["fields"] == {"replica": "r2", "cooldown_ms": 100.0}
+    assert rec["msg"] == "breaker opened for r2"
+
+
+def test_structured_formatter_stamps_active_trace_ids():
+    from paddle_tpu.obs import trace
+    fmt = ptlog._StructuredFormatter()
+    record = logging.LogRecord("paddle_tpu.t", logging.INFO, "f.py", 1,
+                               "hello", None, None)
+    with trace.span("op") as ctx:
+        out = json.loads(fmt.format(record))
+    assert out["trace_id"] == ctx.trace_id
+    assert out["span_id"] == ctx.span_id
+    # outside any span: no ids stamped
+    out2 = json.loads(fmt.format(record))
+    assert "trace_id" not in out2

@@ -1,0 +1,203 @@
+"""Metrics federation: one registry, one scrape, the whole fleet.
+
+The r15 federation contracts: `prom_from_dict` turns any JSON snapshot
+into scrapeable gauges, a sick provider degrades to an ``error`` leaf
+instead of taking down the scrape, `serve_metrics` gives frontend-less
+processes (``--job=train --metrics_port``, the master) the same
+surface, and the router's ``/metrics`` re-exports per-replica
+snapshots so one scrape shows the fleet.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from paddle_tpu.obs.registry import (MetricsRegistry, prom_from_dict,
+                                     serve_metrics)
+
+
+# ------------------------------------------------------------- flattening
+def test_prom_from_dict_flattens_numeric_leaves_with_labels():
+    lines = prom_from_dict("pfx", {
+        "a": 1, "b": {"c": 2.5, "d": True, "skip": "str"},
+        "none": None, "lst": [1, 2]}, labels={"replica": "r0"})
+    assert 'pfx_a{replica="r0"} 1' in lines
+    assert 'pfx_b_c{replica="r0"} 2.5' in lines
+    assert 'pfx_b_d{replica="r0"} 1' in lines  # bools export 0/1
+    # strings / None / lists are not gauges
+    assert not any("skip" in l or "none" in l or "lst" in l
+                   for l in lines)
+
+
+def test_registry_isolates_a_sick_provider():
+    reg = MetricsRegistry()
+    reg.register("good", lambda: {"x": 1})
+    reg.register("sick", lambda: 1 / 0)
+    snap = reg.snapshot()
+    assert snap["good"] == {"x": 1}
+    assert "error" in snap["sick"]  # the error IS the metric
+    # the prometheus text still renders the healthy provider
+    assert "paddle_tpu_good_x 1" in reg.to_prometheus()
+
+
+def test_registry_reregistering_a_name_replaces_it():
+    reg = MetricsRegistry()
+    reg.register("c", lambda: {"v": 1}).register("c", lambda: {"v": 2})
+    assert reg.snapshot() == {"c": {"v": 2}}
+    assert reg.names() == ["c"]
+
+
+# ---------------------------------------------------------- the exporter
+def test_serve_metrics_endpoint_text_json_healthz():
+    reg = MetricsRegistry().register("unit", lambda: {"n": 7})
+    srv = serve_metrics(reg, port=0)
+    try:
+        port = srv.server_address[1]
+        txt = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics").read().decode()
+        assert "paddle_tpu_unit_n 7" in txt
+        js = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics?format=json").read())
+        assert js == {"unit": {"n": 7}}
+        hz = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz").read())
+        assert hz["status"] == "ok"
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/nope")
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+# ------------------------------------------------------ router federation
+class _FakeMetricsTransport:
+    """Scripted replica transport with the federation hook."""
+
+    def __init__(self, snap=None, sick=False):
+        self._snap = snap or {"requests_total": 3}
+        self.sick = sick
+
+    def healthz(self):
+        return {"live": True, "ready": True, "draining": False,
+                "status": "ok"}
+
+    def metrics_snapshot(self):
+        if self.sick:
+            raise ConnectionError("replica unreachable")
+        return dict(self._snap)
+
+    def begin_drain(self):
+        pass
+
+    def drain_wait(self, timeout=60.0):
+        pass
+
+
+def test_router_metrics_federate_per_replica_snapshots():
+    """ONE router scrape shows every replica's serving snapshot —
+    labeled in the Prometheus text, keyed in the JSON — and a sick
+    replica degrades to an error entry instead of failing the scrape."""
+    from paddle_tpu.serving import ReplicaRouter, make_router_server
+    router = ReplicaRouter(
+        [_FakeMetricsTransport({"requests_total": 3}),
+         _FakeMetricsTransport(sick=True)],
+        health_poll_ms=1e6)
+    router.poll_once()
+    per = router.replica_metrics()
+    assert per["r0"] == {"requests_total": 3}
+    assert "error" in per["r1"]
+    extra = MetricsRegistry().register("supervisor",
+                                       lambda: {"replicas": 2})
+    server = make_router_server(router, port=0, registry=extra)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        port = server.server_address[1]
+        js = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics?format=json").read())
+        assert js["replicas_metrics"]["r0"] == {"requests_total": 3}
+        assert "error" in js["replicas_metrics"]["r1"]
+        assert js["federation"]["supervisor"] == {"replicas": 2}
+        txt = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics").read().decode()
+        assert ('paddle_tpu_replica_requests_total{replica="r0"} 3'
+                in txt)
+        assert "paddle_tpu_supervisor_replicas 2" in txt
+    finally:
+        server.shutdown()
+        server.server_close()
+        router._stop.set()
+
+
+# ------------------------------------------------------ the training side
+def test_train_cli_metrics_port_exports_breakdown_and_memory(tmp_path):
+    """``--job=train --metrics_port P``: the live StepBreakdown +
+    memory_stats scrape answers WHILE training runs (the serving fleet's
+    surface for the training process kind), and the exporter is torn
+    down when training returns."""
+    import socket
+    import textwrap
+
+    from paddle_tpu.trainer import cli
+
+    config = tmp_path / "conf.py"
+    config.write_text(textwrap.dedent("""
+        import numpy as np
+        from paddle_tpu.config import dsl
+        from paddle_tpu.data.types import dense_vector, integer_value
+        from paddle_tpu.optim import Momentum
+
+        x = dsl.data(name="x", size=8)
+        lab = dsl.data(name="label", size=4)
+        out = dsl.fc(input=x, size=4, act="softmax")
+        cost = dsl.classification_cost(input=out, label=lab)
+        outputs = [out]
+        optimizer = Momentum(learning_rate=lr, momentum=0.9)
+        feeding = {"x": dense_vector(8), "label": integer_value(4)}
+
+        _rng = np.random.RandomState(0)
+        _X = _rng.randn(64, 8).astype(np.float32)
+        _Y = np.argmax(_X[:, :4], axis=1)
+
+        def train_reader():
+            for i in range(0, 64, 32):
+                yield [(_X[j], int(_Y[j])) for j in range(i, i + 32)]
+    """))
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    scraped = {}
+
+    def scrape():
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline and "json" not in scraped:
+            try:
+                scraped["json"] = json.loads(urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics?format=json",
+                    timeout=2.0).read())
+                scraped["text"] = urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics",
+                    timeout=2.0).read().decode()
+            except Exception:  # noqa: BLE001 — not bound yet; retry
+                time.sleep(0.05)
+
+    th = threading.Thread(target=scrape, daemon=True)
+    th.start()
+    rc = cli.main(["--config", str(config), "--config_args", "lr=0.1",
+                   "--job=train", "--num_passes", "2",
+                   "--metrics_port", str(port)])
+    assert rc == 0
+    th.join(70.0)
+    js = scraped.get("json")
+    assert js, "the scrape never answered while training ran"
+    assert "step_breakdown" in js["train"]
+    assert "memory" in js["train"]
+    assert "paddle_tpu_train_" in scraped["text"]
+    # torn down with training: the port must refuse now
+    with pytest.raises(Exception):
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=1.0)

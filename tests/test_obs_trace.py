@@ -1,0 +1,360 @@
+"""Distributed tracing: one trace_id end to end through the fleet.
+
+The r15 acceptance spine: a scored request driven through a 2-replica
+router with an induced failover yields a SINGLE trace whose spans
+reconstruct the client-observed latency — the ``client.request`` root's
+wall time lands within 5% of the latency the caller measured around
+``client.score``, the failover reads as two sibling ``router.attempt``
+spans (one error, one ok) under one ``router.dispatch``, and the JSONL
+dump satisfies the TRACE_* artifact schema (PT401: non-empty spans,
+monotone timestamps, parent refs resolve). Plus the propagation
+contracts: hedges as sibling attempts, the ``X-Trace-Id`` echo on typed
+errors and fenced-standby 503s, and the master RPC codec pairing
+``rpc.<method>`` / ``rpc.server.<method>`` under one trace.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from paddle_tpu.config import dsl
+from paddle_tpu.data import dense_vector, integer_value
+from paddle_tpu.obs import trace
+from paddle_tpu.serving import (BadRequest, EngineTransport,
+                                ReplicaRouter, ServingClient,
+                                ServingEngine, ServingPredictor,
+                                Unavailable, make_router_server)
+from paddle_tpu.serving.router import PendingCall
+from paddle_tpu.testing import chaos
+
+DIM, CLASSES = 8, 4
+SAMPLE = ((np.arange(DIM, dtype=float) / DIM).tolist(), 1)
+HEX = set("0123456789abcdef")
+
+
+@pytest.fixture
+def tracer():
+    t = trace.install(trace.Tracer("test"))
+    try:
+        yield t
+    finally:
+        trace.install(None)
+
+
+@pytest.fixture(scope="module")
+def fleet(tmp_path_factory):
+    """Two in-process replicas behind the router HTTP frontend (the
+    shared AOT cache keeps the 1-core warmup affordable)."""
+    cache_dir = str(tmp_path_factory.mktemp("aot"))
+    dsl.reset()
+    x = dsl.data(name="x", size=DIM)
+    lab = dsl.data(name="label", size=CLASSES)
+    out = dsl.fc(input=x, size=CLASSES, act="softmax", name="out")
+    dsl.classification_cost(input=out, label=lab, name="cost")
+    graph = dsl.current_graph()
+    from paddle_tpu.core.network import Network
+    params = Network(graph, outputs=["out"]).init_params(
+        jax.random.PRNGKey(0))
+    feeding = {"x": dense_vector(DIM), "label": integer_value(CLASSES)}
+
+    def build_engine():
+        pred = ServingPredictor(graph, params, ["out"], feeding,
+                                batch_buckets=[1, 2],
+                                aot_cache=cache_dir)
+        return ServingEngine(pred, max_batch=2, batch_timeout_ms=1.0,
+                             queue_depth=32).start(warmup=True)
+
+    engines = [build_engine() for _ in range(2)]
+    router = ReplicaRouter([EngineTransport(e) for e in engines],
+                           health_poll_ms=25.0).start()
+    server = make_router_server(router, port=0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    client = ServingClient(port=server.server_address[1])
+    yield {"router": router, "server": server, "client": client,
+           "engines": engines}
+    server.shutdown()
+    server.server_close()
+    router.shutdown()
+
+
+def _spans_settled(tracer, trace_id, names, timeout=5.0):
+    """The batcher emits replica/phase spans from the worker thread
+    AFTER answering the waiter; give them a beat to land."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        got = {s["name"] for s in tracer.spans(trace_id)}
+        if names <= got:
+            return tracer.spans(trace_id)
+        time.sleep(0.01)
+    return tracer.spans(trace_id)
+
+
+# ----------------------------------------------------------- propagation
+def test_one_trace_id_survives_router_dispatch_over_http(fleet, tracer):
+    """client → router HTTP → dispatch → in-process replica → batcher:
+    every span of the hop chain carries ONE trace_id, the phase split
+    is real child spans, and the parent chain resolves link by link."""
+    result = fleet["client"].score(SAMPLE)
+    tid = result["provenance"]["trace_id"]
+    assert len(tid) == 32 and set(tid) <= HEX
+    assert fleet["client"].last_provenance["trace_id"] == tid
+    spans = _spans_settled(tracer, tid, {
+        "client.request", "router.dispatch", "router.attempt",
+        "replica.score", "phase.queue_wait", "phase.compute"})
+    by_name = {}
+    for s in spans:
+        assert s["trace_id"] == tid
+        by_name.setdefault(s["name"], []).append(s)
+    assert len(by_name["client.request"]) == 1
+    root = by_name["client.request"][0]
+    assert root["parent_id"] is None
+    # the chain: dispatch under the client root (via the X-Trace-Id
+    # header), attempt under dispatch, replica.score under the attempt,
+    # phases under replica.score
+    dispatch = by_name["router.dispatch"][0]
+    assert dispatch["parent_id"] == root["span_id"]
+    attempt = by_name["router.attempt"][0]
+    assert attempt["parent_id"] == dispatch["span_id"]
+    score = by_name["replica.score"][0]
+    assert score["parent_id"] == attempt["span_id"]
+    for phase in ("phase.queue_wait", "phase.pad_overhead",
+                  "phase.compute"):
+        for s in by_name.get(phase, []):
+            assert s["parent_id"] == score["span_id"]
+    # the phase children partition the replica span by construction
+    phase_ms = sum(s["dur_ms"] for s in spans
+                   if s["name"].startswith("phase."))
+    assert phase_ms == pytest.approx(score["dur_ms"], rel=1e-6, abs=1e-3)
+
+
+def test_caller_supplied_context_roots_the_trace(fleet, tracer):
+    """A caller already inside a span keeps naming the trace: the
+    client HTTP attempt parents under the ambient context, so the
+    caller's trace_id is the one the fleet echoes back."""
+    with trace.span("caller.batch") as ctx:
+        result = fleet["client"].score(SAMPLE)
+    assert result["provenance"]["trace_id"] == ctx.trace_id
+    reqs = [s for s in tracer.spans(ctx.trace_id)
+            if s["name"] == "client.request"]
+    assert len(reqs) == 1 and reqs[0]["parent_id"] == ctx.span_id
+
+
+# ------------------------------------------------- the acceptance drill
+def test_failover_trace_reconstructs_client_latency(fleet, tracer,
+                                                    tmp_path):
+    """One scored request, 2-replica router, induced failover: a single
+    trace whose root span wall time lands within 5% of the latency the
+    client measured, with the failover visible as sibling attempts —
+    and whose JSONL dump passes the TRACE_* artifact schema."""
+    # the first dispatch attempt is dropped (failover); the answering
+    # batch is delayed 50 ms so the 5% reconstruction bound dwarfs
+    # host jitter and the sub-span client overhead
+    plan = chaos.FaultPlan(seed=7, faults=[
+        {"type": "drop", "site": "route_dispatch", "at": 1},
+        {"type": "delay", "site": "serve_batch", "at": 1,
+         "seconds": 0.05}])
+    with chaos.chaos_plan(plan):
+        t0 = time.perf_counter()
+        result = fleet["client"].score(SAMPLE)
+        measured_ms = 1e3 * (time.perf_counter() - t0)
+    prov = result["provenance"]
+    assert prov["failovers"] == 1
+    tid = prov["trace_id"]
+    # phase.decode is the LAST write of the worker's emit sequence:
+    # once present, the trace is complete and the dump below races
+    # nothing
+    spans = _spans_settled(tracer, tid, {
+        "client.request", "router.dispatch", "router.attempt",
+        "replica.score", "phase.decode"})
+
+    # failover = two sibling attempts under ONE dispatch span: the
+    # dropped attempt errored, the answering one ok, on a different
+    # replica
+    attempts = sorted((s for s in spans if s["name"] == "router.attempt"),
+                      key=lambda s: s["ts"])
+    assert len(attempts) == 2
+    assert len({a["parent_id"] for a in attempts}) == 1
+    assert attempts[0]["status"] == "error"
+    assert attempts[0]["attrs"]["outcome"] == "failed"
+    assert attempts[1]["status"] == "ok"
+    assert (attempts[0]["attrs"]["replica"]
+            != attempts[1]["attrs"]["replica"])
+
+    # the root span reconstructs the client-observed latency within 5%
+    roots = [s for s in spans if s["name"] == "client.request"]
+    assert len(roots) == 1 and roots[0]["parent_id"] is None
+    root_ms = roots[0]["dur_ms"]
+    assert measured_ms >= root_ms  # the span nests inside the measure
+    assert abs(measured_ms - root_ms) <= 0.05 * measured_ms, (
+        f"root span {root_ms:.2f} ms vs client-measured "
+        f"{measured_ms:.2f} ms")
+
+    # the dump is a valid TRACE_* artifact: non-empty spans, monotone
+    # file order, every parent ref resolving in-file (PT401 is the
+    # judge, not a re-implementation of it)
+    path = tracer.dump_jsonl(str(tmp_path / "trace.jsonl"),
+                             trace_id=tid)
+    import json
+    with open(path, encoding="utf-8") as f:
+        dumped = [json.loads(line) for line in f]
+    assert {s["span_id"] for s in dumped} == {s["span_id"] for s in spans}
+    artifact = tmp_path / "TRACE_failover.json"
+    artifact.write_text(json.dumps({"spans": dumped}))
+    from paddle_tpu.analysis.bench_schema import check_bench_file
+    findings = check_bench_file(str(artifact), "TRACE_failover.json")
+    assert findings == [], [f.message for f in findings]
+
+
+# ----------------------------------------------------------------- hedge
+class _FakeTransport:
+    """Minimal scripted replica (the test_serving_fleet idiom) for the
+    hedge-span shape — no jax, deterministic timing."""
+
+    def __init__(self, delay=0.0):
+        self.delay = delay
+
+    def start_call(self, kind, sample, deadline_ms, gen_opts):
+        p = PendingCall()
+        # the attempt context is ambient at start_call; a real
+        # transport propagates it onward — the fake only answers
+        def finish():
+            p.result = {"outputs": {"out": [1.0]}}
+            p.event.set()
+
+        if self.delay:
+            threading.Timer(self.delay, finish).start()
+        else:
+            finish()
+        return p
+
+    def healthz(self):
+        return {"live": True, "ready": True, "draining": False,
+                "status": "ok"}
+
+    def begin_drain(self):
+        pass
+
+    def drain_wait(self, timeout=60.0):
+        pass
+
+
+def test_hedged_score_appears_as_sibling_hedge_attempt(tracer):
+    """A hedge is a SIBLING attempt under the same dispatch span,
+    attributed ``hedge=True``; the outrun primary settles later as an
+    abandoned attempt of the same trace."""
+    slow = _FakeTransport(delay=0.25)
+    fast = _FakeTransport()
+    router = ReplicaRouter([slow, fast], health_poll_ms=1e6,
+                           hedge_ms=20.0)
+    router.poll_once()
+    router.replicas[1].inflight = 1  # deterministic: slow picked first
+    res, prov = router.dispatch(SAMPLE, kind="score")
+    assert prov["hedges"] == 1 and prov["replica"] == "r1"
+    tid = {s["trace_id"] for s in tracer.spans()
+           if s["name"] == "router.dispatch"}.pop()
+    # the abandoned primary records when its timer fires (~0.25 s)
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        attempts = [s for s in tracer.spans(tid)
+                    if s["name"] == "router.attempt"]
+        if len(attempts) == 2:
+            break
+        time.sleep(0.01)
+    assert len(attempts) == 2
+    assert len({a["parent_id"] for a in attempts}) == 1
+    hedge = [a for a in attempts if a["attrs"].get("hedge")]
+    primary = [a for a in attempts if not a["attrs"].get("hedge")]
+    assert len(hedge) == 1 and hedge[0]["attrs"]["replica"] == "r1"
+    assert len(primary) == 1 and primary[0]["attrs"].get("abandoned")
+
+
+# ------------------------------------------------------------- the echo
+def test_typed_errors_echo_trace_id(fleet):
+    """A 4xx carries the X-Trace-Id echo into ``error.provenance`` —
+    with NO tracer installed, proving the echo contract is not gated
+    on recording."""
+    assert trace.active() is None
+    with pytest.raises(BadRequest) as ei:
+        fleet["client"].score("not-a-sample")
+    tid = ei.value.provenance["trace_id"]
+    assert len(tid) == 32 and set(tid) <= HEX
+
+
+def test_fenced_standby_503_echoes_trace_id(tmp_path):
+    """A fenced standby's refusal still names the trace that refused:
+    the 503 carries the echo and the client surfaces it."""
+    from paddle_tpu.dist.master import FileStore, RoleLease
+    store = FileStore(str(tmp_path / "store"))
+    fence = RoleLease(store, "standby", ttl_s=30.0, settle_s=0.0)
+    standby = ReplicaRouter([], fence=fence)  # never acquired: fenced
+    server = make_router_server(standby, port=0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        client = ServingClient(port=server.server_address[1], retries=0)
+        with pytest.raises(Unavailable) as ei:
+            client.score(SAMPLE)
+        tid = ei.value.provenance["trace_id"]
+        assert len(tid) == 32 and set(tid) <= HEX
+    finally:
+        server.shutdown()
+        server.server_close()
+        standby._stop.set()
+
+
+def test_remote_replica_provenance_survives_the_router_hop(fleet):
+    """Regression: the replica server now echoes X-Trace-Id, so the
+    router's INNER client attaches a partial provenance to the replica
+    body — forwarded verbatim it would pre-empt the end client's
+    setdefault and eat replica/failover provenance. The transport
+    strips it; the end client must still see the router's full
+    provenance (plus the trace id) on a remote-replica fleet."""
+    from paddle_tpu.serving.router import HTTPTransport
+    from paddle_tpu.serving.server import make_server
+    rep_srv = make_server(fleet["engines"][0], port=0)
+    threading.Thread(target=rep_srv.serve_forever, daemon=True).start()
+    router = ReplicaRouter(
+        [HTTPTransport("127.0.0.1", rep_srv.server_address[1])],
+        health_poll_ms=1e6)
+    router.poll_once()
+    srv = make_router_server(router, port=0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        client = ServingClient(port=srv.server_address[1])
+        res = client.score(SAMPLE)
+        prov = res["provenance"]
+        assert prov["replica"] == "r0"
+        assert prov["failovers"] == 0
+        assert len(prov["trace_id"]) == 32 and set(prov["trace_id"]) <= HEX
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        router._stop.set()
+        rep_srv.shutdown()
+        rep_srv.server_close()
+
+
+# ------------------------------------------------------ training plane
+def test_master_rpc_spans_pair_under_one_trace(tracer):
+    """The master RPC codec: the trainer-side ``rpc.heartbeat`` span
+    and the master-side ``rpc.server.heartbeat`` span share one trace,
+    parent-linked through the envelope's ``trace`` field."""
+    from paddle_tpu.dist import MasterClient, MasterServer, MasterService
+    svc = MasterService()
+    server = MasterServer(svc).start()
+    try:
+        client = MasterClient(server.addr, trainer_id="tr-0",
+                              retries=5, retry_delay=0.05)
+        client.heartbeat()
+        client.close()
+    finally:
+        server.stop()
+    spans = tracer.spans()
+    cli = [s for s in spans if s["name"] == "rpc.heartbeat"]
+    srv = [s for s in spans if s["name"] == "rpc.server.heartbeat"]
+    assert len(cli) == 1 and len(srv) == 1
+    assert srv[0]["trace_id"] == cli[0]["trace_id"]
+    assert srv[0]["parent_id"] == cli[0]["span_id"]

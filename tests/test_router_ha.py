@@ -297,15 +297,25 @@ def test_autoscaler_scales_real_in_process_fleet(engines):
 # ------------------------------------------------------------- the soak
 @pytest.mark.slow
 @pytest.mark.chaos
-def test_kill_active_router_under_open_loop_load_soak(tmp_path):
+def test_kill_active_router_under_open_loop_load_soak(tmp_path,
+                                                      monkeypatch):
     """THE acceptance drill: open-loop load through HA client endpoints
     while the ACTIVE router process is killed mid-run (listener torn
     down, renewals stop — the in-process analogue of a SIGKILL). The
     warm standby adopts once the lease lapses and answers within one
     health interval; summed across BOTH seeded rounds, zero non-shed
     requests fail; and the chaos fault log reproduces bitwise from the
-    seed."""
+    seed.
+
+    r15: the soak runs with a flight recorder ARMED and dumps through
+    the ``$PADDLE_TPU_FLIGHT_DIR`` path per round; the blackbox merge
+    then names the takeover sequence — renewals dropped (chaos fires)
+    → old active FENCED → stale lease adopted → HA takeover → first
+    standby-served answer — from the dumps alone, no seed re-run."""
     import jax as _jax  # noqa: F401
+    from paddle_tpu.obs import flight
+    flight_dir = tmp_path / "flight"
+    monkeypatch.setenv(flight.ENV_DIR, str(flight_dir))
     dsl.reset()
     x = dsl.data(name="x", size=DIM)
     lab = dsl.data(name="label", size=CLASSES)
@@ -324,7 +334,19 @@ def test_kill_active_router_under_open_loop_load_soak(tmp_path):
         return ServingEngine(pred, max_batch=2, batch_timeout_ms=1.0,
                              queue_depth=64).start(warmup=True)
 
-    def run_round(seed):
+    def run_round(seed, tag):
+        # one recorder per "fleet" (this in-process pair is one
+        # process; a real fleet dumps one file per process) — the
+        # service name keys the per-round dump file. Armed under
+        # try/finally: a failing round must not leak the installed
+        # recorder into every later test in this process.
+        flight.install(flight.FlightRecorder(f"soak{tag}"))
+        try:
+            return _run_round(seed, tag)
+        finally:
+            flight.install(None)
+
+    def _run_round(seed, tag):
         engs = [build_engine() for _ in range(2)]
         store = InMemStore()
         ttl = 0.4
@@ -452,10 +474,15 @@ def test_kill_active_router_under_open_loop_load_soak(tmp_path):
         standby._stop.set()
         for e in engs:
             e.shutdown(drain=False)
+        # the dump path the acceptance requires: through the env-dir
+        # naming (what SIGTERM/atexit/worker-fatal use), not an
+        # explicit path
+        dump = flight.dump_now()
+        assert dump is not None and dump.startswith(str(flight_dir))
         return counts, list(plan.log)
 
-    c1, log1 = run_round(11)
-    c2, log2 = run_round(11)
+    c1, log1 = run_round(11, "a")
+    c2, log2 = run_round(11, "b")
     # zero failed non-shed SUMMED across rounds — a failing round
     # cannot hide behind a better sibling
     assert c1["failed"] + c2["failed"] == 0, (c1, c2)
@@ -469,3 +496,47 @@ def test_kill_active_router_under_open_loop_load_soak(tmp_path):
     for log in (log1, log2):
         assert all(site == "lease_renew" and kind == "partition"
                    for site, _, kind in log)
+
+    # ---- the postmortem reads off the black boxes alone -------------
+    # merge BOTH rounds' dumps fleet-wide, then name each round's
+    # takeover sequence by event order — no seed re-run, no in-process
+    # state: everything below comes from the JSONL dumps
+    import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import blackbox
+    merged = blackbox.merge_dir(str(flight_dir))
+    assert merged, "no flight events survived the soak"
+    for tag in ("a", "b"):
+        ev = [e for e in merged if e["service"] == f"soak{tag}"]
+
+        def first(name, **match):
+            for i, e in enumerate(ev):
+                if e["event"] == name and all(
+                        e.get(k) == v for k, v in match.items()):
+                    return i
+            raise AssertionError(
+                f"round {tag}: no {name} {match} in the black box: "
+                + blackbox.format_timeline(ev))
+
+        i_drop = first("chaos_fire", site="lease_renew")
+        i_fenced = first("role_fenced", holder="A")
+        i_adopt = first("role_acquire", holder="B",
+                        took_over_stale=True)
+        i_takeover = first("ha_takeover", holder="B")
+        i_answer = first("first_answer_after_takeover")
+        # lease expiry (renewals dropped, old active fenced) →
+        # adoption (stale lease claimed, fleet adopted) → first
+        # standby answer: the whole story, in order, from the dumps
+        assert i_drop < i_adopt <= i_takeover < i_answer, (
+            blackbox.format_timeline(ev))
+        assert i_fenced > i_drop, blackbox.format_timeline(ev)
+        adopt_rec = ev[i_adopt]
+        takeover_rec = ev[i_takeover]
+        assert takeover_rec["epoch"] == adopt_rec["epoch"]
+    # the human-readable timeline carries the same story
+    text = blackbox.format_timeline(merged)
+    for name in ("role_fenced", "role_acquire", "ha_takeover",
+                 "first_answer_after_takeover"):
+        assert name in text

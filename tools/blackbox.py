@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Merge per-process flight-recorder dumps into one fleet timeline.
+
+    python tools/blackbox.py /path/to/flight_dir [--json] [--event E]
+
+Every process in a fleet run dumps its own
+``flight-<service>-<pid>.jsonl`` ring (``paddle_tpu/obs/flight.py``,
+armed via ``$PADDLE_TPU_FLIGHT_DIR``) on SIGTERM / worker-fatal /
+atexit — and BEFORE an ``os._exit`` chaos kill, which is the whole
+point: the black box survives the crash it describes. This tool merges
+those dumps by wall-clock ``ts`` (tie-broken by (pid, seq) so one
+process's events keep their own order) and prints a readable timeline,
+so a chaos postmortem — "lease expiry → adoption → first standby
+answer" — is read off the artifact instead of re-run from the seed.
+
+Importable: ``merge_dir(path)`` returns the ordered event list (the
+soaks assert takeover sequences against it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import List, Optional
+
+
+def load_dump(path: str) -> List[dict]:
+    events: List[dict] = []
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                # a torn tail line (the process died mid-write) is
+                # expected in a black box; keep what parses
+                sys.stderr.write(f"{path}:{i}: torn record skipped\n")
+                continue
+            if isinstance(rec, dict) and "event" in rec:
+                events.append(rec)
+    return events
+
+
+def merge_dir(path: str, pattern: str = "flight-*.jsonl") -> List[dict]:
+    """All dumps under ``path`` merged into one wall-clock-ordered
+    list. Sort key (ts, pid, seq): wall clock across processes,
+    per-process seq within one (two processes' clocks may skew — the
+    per-record ``mono`` field is there for forensic ordering within a
+    process when they do)."""
+    events: List[dict] = []
+    for f in sorted(glob.glob(os.path.join(path, pattern))):
+        events.extend(load_dump(f))
+    events.sort(key=lambda e: (e.get("ts", 0.0), e.get("pid", 0),
+                               e.get("seq", 0)))
+    return events
+
+
+_CORE = ("ts", "mono", "seq", "service", "pid", "event")
+
+
+def format_timeline(events: List[dict]) -> str:
+    if not events:
+        return "(no flight events)"
+    t0 = events[0].get("ts", 0.0)
+    lines = []
+    for e in events:
+        extra = " ".join(f"{k}={e[k]}" for k in e if k not in _CORE)
+        lines.append(
+            f"+{e.get('ts', 0.0) - t0:9.3f}s "
+            f"[{e.get('service', '?')}/{e.get('pid', '?')}] "
+            f"{e['event']}" + (f" {extra}" if extra else ""))
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(prog="python tools/blackbox.py")
+    ap.add_argument("dir", help="directory of flight-*.jsonl dumps "
+                               "($PADDLE_TPU_FLIGHT_DIR)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the merged event list as JSON")
+    ap.add_argument("--event", default=None,
+                    help="filter to one event name")
+    args = ap.parse_args(argv)
+    events = merge_dir(args.dir)
+    if args.event:
+        events = [e for e in events if e["event"] == args.event]
+    if args.json:
+        print(json.dumps(events, indent=1))
+    else:
+        print(format_timeline(events))
+    return 0 if events else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
