@@ -799,6 +799,129 @@ def bench_decode(rounds=None, calls=None):
     return res
 
 
+def bench_health(batches=None, batch_size=64, rounds=None):
+    """Training-health overhead A/B (``python bench.py --health`` ->
+    BENCH_r16.json + HEALTH_r16.json): the SAME LSTM-classifier config
+    stepped with the health plane FULLY armed — per-layer stats fused
+    into EVERY step (period=1, the worst case), sentry on, JSONL
+    timeline appending — vs disarmed. Interleaved best-of-R per the
+    host-drift rule (each mode keeps its best pass-median step time,
+    modes alternate so drift hits both): the headline is the p50
+    ratio. Bitwise trajectory identity is asserted IN-BENCH: after all
+    rounds both trainers must hold bit-identical parameters, or this
+    raises — the overhead number is only meaningful for a telemetry
+    that changed nothing."""
+    import time as _time
+
+    import numpy as np
+
+    from paddle_tpu.config import dsl
+    from paddle_tpu.data import (DataFeeder, integer_value,
+                                 integer_value_sequence)
+    from paddle_tpu.models import lstm_text_classifier
+    from paddle_tpu.optim import Adam
+    from paddle_tpu.trainer import SGD
+    from paddle_tpu.trainer import events as ev
+
+    batches = int(os.environ.get("BENCH_HEALTH_BATCHES", "12")
+                  if batches is None else batches)
+    rounds = int(os.environ.get("BENCH_HEALTH_ROUNDS", "4")
+                 if rounds is None else rounds)
+    # hidden=256 on purpose: the param-stat reduction's cost is
+    # ~constant per parameter (a handful of passes over params/grads)
+    # while the step's compute scales with batch*seq*hidden^2, so a
+    # toy-sized model would measure XLA:CPU's reduce throughput, not
+    # the telemetry's overhead on a real training step (on TPU the
+    # same reductions fuse into the update for ~free)
+    vocab, seqlen = 5000, 64
+    types = {"words": integer_value_sequence(vocab),
+             "label": integer_value(2)}
+    rng = np.random.RandomState(0)
+    data = [(list(rng.randint(0, vocab, size=seqlen)),
+             int(rng.randint(0, 2))) for _ in range(batch_size)]
+    feeder = DataFeeder(types, pad_multiple=seqlen)
+
+    def reader():
+        for _ in range(batches):
+            yield data
+
+    import tempfile
+    log_path = os.path.join(tempfile.mkdtemp(prefix="bench_health_"),
+                            "timeline.jsonl")
+
+    def build(armed):
+        dsl.reset()
+        cost, out, _ = lstm_text_classifier(
+            vocab_size=vocab, embed_dim=64, hidden=256, num_layers=1,
+            classes=2)
+        tr = SGD(cost=cost, update_equation=Adam(learning_rate=1e-3),
+                 seed=0)
+        health = ({"period": 1, "sentry": True,
+                   "log_path": log_path} if armed else None)
+        # warm/compile outside the measured passes (both variants)
+        tr.train(lambda: iter([data, data]), feeder=feeder,
+                 num_passes=1, health=health)
+        return tr
+
+    trainers = {False: build(False), True: build(True)}
+
+    def timed_pass(tr):
+        ts = []
+
+        def handler(e):
+            if isinstance(e, ev.BeginIteration):
+                ts.append(_time.perf_counter())
+
+        tr.train(reader, feeder=feeder, num_passes=1,
+                 event_handler=handler)
+        return float(np.median(np.diff(ts)))
+
+    best = {False: float("inf"), True: float("inf")}
+    for _ in range(rounds):
+        for armed, tr in trainers.items():
+            best[armed] = min(best[armed], timed_pass(tr))
+    off_s, on_s = best[False], best[True]
+
+    # the neutrality claim, asserted in-bench: identical batch/seed
+    # streams => bit-identical parameters, or the ratio above measured
+    # a telemetry that changed the training it observed
+    import jax
+    identical = True
+    p_off = {k: np.asarray(jax.device_get(v))
+             for k, v in trainers[False].params.items()}
+    for k, v in trainers[True].params.items():
+        if not np.array_equal(p_off[k], np.asarray(jax.device_get(v))):
+            identical = False
+            break
+    if not identical:
+        raise RuntimeError(
+            "health telemetry changed the trajectory: stats-on params "
+            "differ from stats-off after identical streams")
+
+    hm = trainers[True]._health
+    hm.close()
+    from paddle_tpu.obs.events import load_timeline
+    timeline = [r for r in load_timeline(log_path)
+                if r.get("event") in ("step", "divergence")]
+    snap = hm.snapshot()
+    return {
+        "health_period": 1,
+        "health_sentry": True,
+        "health_batches": batches,
+        "health_rounds": rounds,
+        "health_on_ms_per_step_p50": round(on_s * 1e3, 3),
+        "health_off_ms_per_step_p50": round(off_s * 1e3, 3),
+        "health_on_vs_off_p50": (round(on_s / off_s, 4)
+                                 if off_s > 0 else None),
+        "health_overhead_frac": (round(on_s / off_s - 1.0, 4)
+                                 if off_s > 0 else None),
+        "health_bitwise_identical": identical,
+        "health_sentry_trips": snap["sentry_trips"],
+        "health_timeline_events": len(timeline),
+        "_health_timeline": timeline,  # stripped into HEALTH_r16.json
+    }
+
+
 def bench_fleet(rounds=None, n_requests=None):
     """Fleet serving A/B (``python bench.py --fleet`` -> BENCH_r13.json):
 
@@ -1636,6 +1759,39 @@ def zero1_main():
     return 0
 
 
+def health_main():
+    """``python bench.py --health``: the off-tunnel training-health A/B
+    alone, forced onto CPU (no tunnel involvement); one JSON line,
+    mirrored to BENCH_r16.json, with the armed run's sampled timeline
+    committed as HEALTH_r16.json (the PT401 ``HEALTH_*`` family —
+    ``tools/healthview.py`` renders/diffs it)."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    result = {"metric": "training_health_telemetry_ab",
+              "platform": jax.devices()[0].platform}
+    result.update(bench_health())
+    timeline = result.pop("_health_timeline")
+    here = os.path.dirname(os.path.abspath(__file__))
+    health_doc = {
+        "run": "bench-r16-health",
+        "platform": result["platform"],
+        "period": result["health_period"],
+        "sentry_trips": result["health_sentry_trips"],
+        # the final measured pass's steps: a representative, bounded
+        # sample of the per-step schema (full runs live in --health_log
+        # JSONL files, not in git)
+        "events": timeline[-result["health_batches"]:],
+    }
+    with open(os.path.join(here, "HEALTH_r16.json"), "w") as f:
+        json.dump(health_doc, f, indent=1)
+        f.write("\n")
+    line = json.dumps(result)
+    print(line, flush=True)
+    with open(os.path.join(here, "BENCH_r16.json"), "w") as f:
+        f.write(line + "\n")
+    return 0
+
+
 def input_pipeline_main():
     """``python bench.py --input-pipeline``: the off-tunnel metric alone,
     forced onto CPU (no tunnel involvement), one JSON line."""
@@ -1747,6 +1903,12 @@ def child_main():
     # dominates, so the off-tunnel CPU number is the overhead's honest
     # worst case (off-tunnel number: BENCH_r15.json via --fleet)
     extra("fleet_trace", bench_fleet_trace)
+    # training-health plane (r16): stats-fused-into-the-step overhead
+    # A/B + in-bench bitwise neutrality — rides the tpu_watch capture
+    # so the on-chip overhead number comes for free (off-tunnel number:
+    # BENCH_r16.json via --health; the timeline artifact stays CPU's)
+    extra("health", lambda: {k: v for k, v in bench_health().items()
+                             if not k.startswith("_")})
     return 0
 
 
@@ -1763,6 +1925,8 @@ def main():
         return decode_main()
     if "--fleet" in sys.argv[1:]:
         return fleet_main()
+    if "--health" in sys.argv[1:]:
+        return health_main()
     if os.environ.get("BENCH_CHILD") == "1":
         return child_main()
 

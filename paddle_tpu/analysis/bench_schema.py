@@ -1,5 +1,6 @@
 """Evidence-artifact schema check (PT401): ``BENCH_*.json``,
-``MULTICHIP_*.json``, ``ACCURACY_*.json`` and ``MEM_*.json``.
+``MULTICHIP_*.json``, ``ACCURACY_*.json``, ``MEM_*.json`` and
+``HEALTH_*.json``.
 
 These artifacts are the evidence trail (perf best-of-R discipline,
 multichip dryruns, real-corpus accuracy runs). A malformed artifact —
@@ -25,6 +26,14 @@ looser schema):
   sorted by ``ts`` (monotone file order), and every non-null
   ``parent_id`` resolving to another span's ``span_id`` in the same
   file — a trace whose parents dangle reconstructs nothing.
+- ``HEALTH_*`` (committed training-health timelines: the sampled
+  run `bench.py --health` writes, or a snapshot of an
+  ``obs/events.py`` JSONL bundled as one object):
+  ``{"run": str, "period": int >= 0, "events": [...]}`` with a
+  NON-EMPTY events list, every event carrying an int ``step >= 0``
+  in monotone non-decreasing order and a finite numeric ``loss`` —
+  a timeline with no steps, shuffled steps, or NaN losses recorded
+  nothing diffable (``tools/healthview.py`` is the consumer).
 - ``MEM_*`` (optional trend snapshots of graftlint pass 5's
   per-program per-device byte manifests, emitted by
   ``python -m paddle_tpu.analysis --json | jq .mem_manifest``):
@@ -145,6 +154,43 @@ def check_bench_file(path: str, rel: str) -> List[Finding]:
                     bad(f"span[{i}] parent_id {parent!r} resolves to "
                         "no span in this file — a dangling parent "
                         "reconstructs nothing")
+    elif base.startswith("HEALTH_"):
+        # a committed training-health timeline (obs/events.py records
+        # bundled as one object; tools/healthview.py renders/diffs it)
+        if not (isinstance(data.get("run"), str) and data.get("run")):
+            bad("health artifact needs a non-empty str 'run'")
+        period = data.get("period")
+        if (not isinstance(period, int) or isinstance(period, bool)
+                or period < 0):
+            bad("health artifact needs int 'period' >= 0 (the stat "
+                "cadence the timeline was recorded at)")
+        events = data.get("events")
+        if not (isinstance(events, list) and events):
+            bad("health artifact needs a non-empty 'events' list "
+                "(a timeline with no steps recorded nothing)")
+        else:
+            last_step = None
+            for i, e in enumerate(events):
+                if not isinstance(e, dict):
+                    bad(f"events[{i}] must be an object")
+                    continue
+                step = e.get("step")
+                if (not isinstance(step, int) or isinstance(step, bool)
+                        or step < 0):
+                    bad(f"events[{i}] missing int 'step' >= 0")
+                    step = None
+                if step is not None:
+                    if last_step is not None and step < last_step:
+                        bad(f"events[{i}] breaks monotone step order "
+                            f"(step {step} < previous {last_step}) — "
+                            "a shuffled timeline diffs nothing")
+                    last_step = step
+                loss = e.get("loss")
+                if (not isinstance(loss, (int, float))
+                        or isinstance(loss, bool)):
+                    bad(f"events[{i}] missing numeric 'loss'")
+                # non-finite losses are caught by the global
+                # finite-number walk below, with their exact path
     elif base.startswith("MEM_"):
         # a pass-5 memory-manifest trend snapshot
         progs = data.get("programs")
@@ -259,7 +305,8 @@ def run_schema_check(root: str,
                                                 "MULTICHIP_*.json",
                                                 "ACCURACY_*.json",
                                                 "MEM_*.json",
-                                                "TRACE_*.json")
+                                                "TRACE_*.json",
+                                                "HEALTH_*.json")
                      ) -> List[Finding]:
     findings: List[Finding] = []
     for pattern in patterns:

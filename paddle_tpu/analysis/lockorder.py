@@ -64,6 +64,13 @@ DEFAULT_MODULES = (
     "paddle_tpu/obs/trace.py",
     "paddle_tpu/obs/flight.py",
     "paddle_tpu/obs/registry.py",
+    # the training-health plane (r16): the event-timeline writer's
+    # queue lock and the health monitor's snapshot lock join the same
+    # edge-free pin — serialization/file I/O happen on the writer
+    # thread outside the lock, and the monitor never appends to the
+    # timeline / records flight events under its own lock.
+    "paddle_tpu/obs/events.py",
+    "paddle_tpu/obs/health.py",
 )
 
 _LOCK_CTORS = {"Lock": False, "RLock": True}  # name -> reentrant

@@ -1,6 +1,7 @@
-"""Unified observability plane: tracing, flight recorder, metrics.
+"""Unified observability plane: tracing, flight recorder, metrics,
+training health.
 
-Three pillars, one package (the TensorFlow paper treats cluster-wide
+Four pillars, one package (the TensorFlow paper treats cluster-wide
 monitoring as a first-class system component; this is that component
 for the five process kinds this fleet runs — client, router/standby,
 replica server, supervisor, master/trainers):
@@ -28,6 +29,15 @@ replica server, supervisor, master/trainers):
   endpoint for processes that have no serving frontend (``--job=train
   --metrics_port``, ``python -m paddle_tpu.dist.master
   --metrics_port``).
+- :mod:`paddle_tpu.obs.health` + :mod:`paddle_tpu.obs.events` —
+  training health. The trainer folds per-layer param/grad/update/
+  activation stats and a divergence sentry INTO the compiled train
+  step (the jax half lives in ``trainer/trainer.py``); this package
+  owns the host side: the per-run JSONL scalar timeline
+  (``EventLog``, bounded background writer), the sentry policies
+  (``halt | skip_batch | dump``), the ``train.divergence`` flight
+  event + postmortem bundles ``tools/blackbox.py`` merges, and the
+  registry provider ``--metrics_port`` exports.
 
 Cost discipline mirrors the chaos plane: every hot-path hook guards on
 a module global (``trace._TRACER`` / ``flight._ACTIVE`` is None ==
@@ -40,6 +50,9 @@ the buffer append is the part the guard gates. See
 """
 
 from paddle_tpu.obs import flight, trace
+from paddle_tpu.obs.events import EventLog
+from paddle_tpu.obs.health import (DivergenceError, HealthConfig,
+                                   HealthMonitor)
 from paddle_tpu.obs.registry import (MetricsRegistry, prom_from_dict,
                                      serve_metrics)
 from paddle_tpu.obs.trace import TraceContext, Tracer
@@ -57,4 +70,5 @@ def arm_from_env(service: str):
 
 __all__ = ["trace", "flight", "Tracer", "TraceContext",
            "MetricsRegistry", "prom_from_dict", "serve_metrics",
-           "arm_from_env"]
+           "arm_from_env", "EventLog", "HealthConfig", "HealthMonitor",
+           "DivergenceError"]

@@ -1,0 +1,219 @@
+"""Scalar event timeline: an append-only per-run JSONL log.
+
+The flight recorder answers "what transitions led to this crash"; this
+module answers "what did the run look like, step by step" — the
+training-side analogue of the reference's TrainerStats log lines and
+of TensorFlow's scalar-summary stream, but as a machine-readable
+artifact: one JSON object per line, `{step, loss, lr, per-layer stats,
+data_wait/compute, ...}`, rendered/diffed by ``tools/healthview.py``
+and snapshotted into the committed ``HEALTH_*.json`` artifact family
+(graftlint PT401).
+
+Discipline (the flight-recorder rules, adapted to a *streaming* file):
+
+- **Bounded background writer** — :meth:`EventLog.append` enqueues
+  into a bounded deque and returns; a daemon thread drains batches to
+  the file. The hot step loop never blocks on disk, and a full queue
+  DROPS (counted in ``dropped``) instead of growing without bound — a
+  stalled disk must cost history, not training throughput.
+- **Edge-free lock** (graftlint pass 3 pin, tests/test_lint_clean.py)
+  — the one lock guards the queue only. Serialization and file I/O
+  happen on the writer thread OUTSIDE the lock, and ``append`` never
+  calls into another subsystem while holding it, so the lock
+  contributes no acquisition edges by construction.
+- **Crash-tolerant format** — JSONL with per-batch flush: a process
+  that dies mid-write leaves at most one torn tail line, which
+  ``tools/healthview.py`` (like ``tools/blackbox.py``) skips.
+
+Nothing in this module imports jax (the obs-package invariant): the
+trainer hands already-fetched host scalars in.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from collections import deque
+from typing import List, Optional
+
+
+def _finite_or_str(obj):
+    """Non-finite floats -> their string spelling ("nan"/"inf"/"-inf")
+    so every emitted line is strict RFC-8259 JSON; ``float(...)`` on
+    the reader side round-trips them (tools/healthview.py does)."""
+    if isinstance(obj, float) and not math.isfinite(obj):
+        return repr(obj)
+    if isinstance(obj, dict):
+        return {k: _finite_or_str(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_finite_or_str(v) for v in obj]
+    return obj
+
+
+class EventLog:
+    """Append-only JSONL scalar timeline with a bounded background
+    writer thread. ``append`` stamps wall-clock ``ts`` and a
+    per-process ``seq`` so records merge/order exactly like flight
+    events."""
+
+    def __init__(self, path: str, service: str = "",
+                 capacity: int = 4096, flush_every: int = 32):
+        self.path = str(path)
+        self.service = str(service)
+        self.pid = os.getpid()
+        self.capacity = int(capacity)
+        self.flush_every = max(1, int(flush_every))
+        self.appended = 0
+        self.written = 0
+        self.dropped = 0
+        self.error: Optional[str] = None
+        self._seq = 0
+        self._closed = False
+        # the ONE lock (pinned edge-free): queue + counters only; the
+        # condition aliases it so wait/notify share the identity
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queue: deque = deque()
+        d = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(d, exist_ok=True)
+        self._file = open(self.path, "a", encoding="utf-8")
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"event-log-{self.service or 'run'}")
+        self._thread.start()
+
+    # ------------------------------------------------------------ write
+    def append(self, record: dict) -> bool:
+        """Enqueue one record (False = dropped: queue full or log
+        closed). The record is shallow-copied and stamped with ``ts``
+        / ``service`` / ``pid`` / ``seq``; caller keys win except for
+        those four (same core-key rule as the flight ring, minus the
+        ``x_`` remap — a timeline record's schema is the caller's)."""
+        rec = dict(record)
+        rec["ts"] = round(time.time(), 6)
+        rec["service"] = self.service
+        rec["pid"] = self.pid
+        with self._lock:
+            if self._closed or len(self._queue) >= self.capacity:
+                self.dropped += 1
+                return False
+            self._seq += 1
+            rec["seq"] = self._seq
+            self._queue.append(rec)
+            self.appended += 1
+            self._cond.notify()
+        return True
+
+    def _run(self):
+        while True:
+            with self._lock:
+                while not self._queue and not self._closed:
+                    self._cond.wait(timeout=0.5)
+                batch: List[dict] = []
+                while self._queue and len(batch) < self.flush_every:
+                    batch.append(self._queue.popleft())
+                done = self._closed and not self._queue and not batch
+            if done:
+                return
+            if not batch:
+                continue
+            # serialize + write OUTSIDE the lock (edge-free pin): a
+            # slow disk stalls this thread, never an appender. Failures
+            # are per-RECORD: one unserializable field costs that one
+            # record (counted in dropped), never the rest of the batch,
+            # and a later healthy write clears the error so flush()
+            # never short-circuits on stale history.
+            wrote = False
+            for rec in batch:
+                try:
+                    # allow_nan=False: a divergence step's NaN loss
+                    # must not produce a strictly-invalid JSON line
+                    # (jq/JSON.parse reject bare NaN) — non-finite
+                    # floats serialize as strings instead
+                    line = json.dumps(_finite_or_str(rec),
+                                      allow_nan=False)
+                except (ValueError, TypeError) as e:
+                    self.dropped += 1
+                    self.error = repr(e)
+                    # still counts toward flush()'s written target:
+                    # the record is resolved, just not as a line
+                    self.written += 1
+                    continue
+                try:
+                    self._file.write(line + "\n")
+                    self.written += 1
+                    wrote = True
+                except (OSError, ValueError) as e:
+                    self.dropped += 1
+                    self.written += 1
+                    self.error = repr(e)
+            if wrote:
+                try:
+                    self._file.flush()
+                    self.error = None
+                except (OSError, ValueError) as e:
+                    self.error = repr(e)
+
+    # ------------------------------------------------------------ drain
+    def flush(self, timeout: float = 5.0):
+        """Block until everything appended so far is on disk (or the
+        timeout passes — a dead writer thread must not hang the
+        caller's finally block). Waits on the WRITTEN counter, not an
+        empty queue: the writer may have popped a batch it has not
+        yet flushed, and an empty queue says nothing about the file."""
+        with self._lock:
+            target = self._seq  # records enqueued so far
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.written >= target or self.error is not None:
+                break
+            time.sleep(0.005)
+        try:
+            self._file.flush()
+        except (OSError, ValueError):
+            pass
+
+    def close(self, timeout: float = 5.0):
+        """Flush, stop the writer thread, close the file. Idempotent;
+        appends after close are counted as drops."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify_all()
+        self._thread.join(timeout=timeout)
+        try:
+            self._file.flush()
+            self._file.close()
+        except (OSError, ValueError):
+            pass
+
+    # ---------------------------------------------------------- observe
+    def snapshot(self) -> dict:
+        with self._lock:
+            queued = len(self._queue)
+        return {"path": self.path, "appended": self.appended,
+                "written": self.written, "dropped": self.dropped,
+                "queued": queued, "closed": self._closed,
+                "error": self.error}
+
+
+def load_timeline(path: str) -> List[dict]:
+    """Read a timeline back (torn tail lines skipped — the writer may
+    have died mid-record; same tolerance as ``tools/blackbox.py``)."""
+    out: List[dict] = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+    return out

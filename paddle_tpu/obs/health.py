@@ -1,0 +1,352 @@
+"""Training-health plane: pillar 4 of the observability package.
+
+The 2017 reference treated training health as a first-class surface —
+``--show_parameter_stats_period`` parameter dumps, the
+``--log_error_clipping`` / ``error_clipping_threshold`` pair, per-layer
+output stats. This module is the host side of that surface rebuilt on
+the r15 obs substrate:
+
+- **In-step telemetry** — the trainer folds per-layer param-norm /
+  grad-norm / update-ratio / activation abs-max (and sparse
+  touched-row counts) INTO the compiled train step as a period-gated
+  fused reduction (``trainer/trainer.py:_health_metrics`` — the jax
+  half lives there; nothing in ``obs/`` imports jax). This module
+  receives the already-fetched host values and owns everything after
+  the fetch: the snapshot the dedup'd ``parameter_stats()`` /
+  ``layer_stats()`` readers serve, the metrics-registry provider, the
+  timeline, the sentry policy.
+- **Event timeline** — one :class:`~paddle_tpu.obs.events.EventLog`
+  JSONL per run: ``{step, pass, batch, loss, lr, data_wait_ms,
+  compute_ms, grad_absmax, per-layer stats on period steps, sentry
+  trips}``; ``tools/healthview.py`` renders/diffs it and the
+  ``HEALTH_*.json`` artifact family (PT401) pins the committed shape.
+- **Divergence sentry** — a per-step finiteness + threshold check on
+  loss/grads (a cheap scalar reduction riding the same fused pass).
+  Policies: ``halt`` (dump a postmortem, raise
+  :class:`DivergenceError`), ``skip_batch`` (the reference
+  error-clipping semantics: the poisoned batch's update is discarded
+  IN-GRAPH and the RNG split rolled back, so the post-skip trajectory
+  is bitwise the run that never saw the batch), ``dump`` (postmortem
+  only, training continues). Any trip emits a ``train.divergence``
+  flight event and writes a postmortem bundle to
+  ``$PADDLE_TPU_FLIGHT_DIR`` (offending step/batch, per-layer stat
+  snapshot, RNG key, reader-ledger position) which
+  ``tools/blackbox.py`` merges into the ordered fleet timeline.
+
+Lock discipline (graftlint pass-3 pin, tests/test_lint_clean.py): the
+monitor's lock guards its snapshot fields only; the timeline append,
+flight record, log line and postmortem write all happen OUTSIDE it, so
+the lock is pinned edge-free like every other obs lock.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+import threading
+
+from paddle_tpu.obs import flight as _flight
+from paddle_tpu.obs.events import EventLog, _finite_or_str
+from paddle_tpu.utils.log import get_logger
+
+logger = get_logger("obs.health")
+
+#: sentry policies (the reference's error-clipping semantics is
+#: ``skip_batch``; ``halt`` is feenableexcept-like; ``dump`` is
+#: postmortem-only)
+POLICIES = ("halt", "skip_batch", "dump")
+
+ENV_DIR = _flight.ENV_DIR  # postmortems land beside the black boxes
+
+
+class DivergenceError(RuntimeError):
+    """Raised by the ``halt`` policy after the postmortem bundle is on
+    disk. A plain Exception on purpose: the trainer's unwind path
+    releases master leases for Exceptions (the process lives on), which
+    is exactly right for a deliberate halt."""
+
+
+@dataclasses.dataclass
+class HealthConfig:
+    """What the training-health plane watches.
+
+    - ``period``: fold the full per-layer stat reduction into every
+      Nth step (0 = telemetry off; the trainer also warms the stats-on
+      program variant on the first batch so no compile lands mid-run).
+    - ``sentry``: arm the per-step finiteness check on loss/grads.
+    - ``grad_threshold``: additionally trip when max|grad| exceeds
+      this (0 = finiteness only) — the reference's
+      ``error_clipping_threshold`` machine-mapped.
+    - ``policy``: ``halt`` | ``skip_batch`` | ``dump``.
+    - ``log_clipping``: log each trip (``--log_error_clipping``).
+    - ``log_path``: write the JSONL event timeline here (None = keep
+      the bounded in-memory tail only).
+    - ``service``: tag for timeline/postmortem records (defaults to
+      ``train``).
+    """
+
+    period: int = 0
+    sentry: bool = False
+    grad_threshold: float = 0.0
+    policy: str = "skip_batch"
+    log_clipping: bool = False
+    log_path: Optional[str] = None
+    service: str = "train"
+
+    def __post_init__(self):
+        self.period = int(self.period)
+        self.grad_threshold = float(self.grad_threshold)
+        if self.period < 0:
+            raise ValueError(f"period must be >= 0, got {self.period}")
+        if self.policy not in POLICIES:
+            raise ValueError(
+                f"unknown sentry policy {self.policy!r}; pick one of "
+                f"{POLICIES}")
+
+    @property
+    def armed(self) -> bool:
+        return self.period > 0 or self.sentry
+
+    @classmethod
+    def coerce(cls, value) -> "HealthConfig":
+        if isinstance(value, cls):
+            return value
+        if isinstance(value, dict):
+            return cls(**value)
+        raise TypeError(
+            f"health config must be a HealthConfig or dict, got "
+            f"{type(value).__name__}")
+
+
+def postmortem_path(directory: str, service: str, pid: int,
+                    step: int) -> str:
+    return os.path.join(directory,
+                        f"postmortem-{service or 'train'}-{pid}"
+                        f"-s{int(step):08d}.json")
+
+
+def write_postmortem(bundle: dict,
+                     directory: Optional[str] = None) -> Optional[str]:
+    """Write one divergence postmortem bundle as a standalone JSON file
+    (``$PADDLE_TPU_FLIGHT_DIR`` by default — beside the flight dumps,
+    where ``tools/blackbox.py`` picks it up). Returns the path, or None
+    when no directory is configured / the write fails (a full disk must
+    not turn a sentry trip into a second crash)."""
+    d = directory or os.environ.get(ENV_DIR, "")
+    if not d:
+        return None
+    try:
+        os.makedirs(d, exist_ok=True)
+        path = postmortem_path(d, bundle.get("service", "train"),
+                               int(bundle.get("pid", os.getpid())),
+                               int(bundle.get("step", 0)))
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(bundle, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+        return path
+    except (OSError, TypeError, ValueError):
+        return None
+
+
+class HealthMonitor:
+    """Host-side aggregation of the in-step telemetry for one trainer.
+
+    The trainer calls :meth:`on_step` once per finished step with
+    already-fetched scalars (and, on period steps, the per-layer stat
+    dicts); :meth:`on_divergence` when the sentry scalar tripped. The
+    metrics registry reads :meth:`snapshot`; the dedup'd
+    ``parameter_stats()`` / ``layer_stats()`` read
+    :attr:`param_stats` / :attr:`act_stats`.
+    """
+
+    def __init__(self, cfg: HealthConfig,
+                 postmortem_dir: Optional[str] = None,
+                 tail_capacity: int = 512):
+        self.cfg = cfg
+        self.postmortem_dir = postmortem_dir
+        self.pid = os.getpid()
+        self.steps = 0
+        self.sentry_trips = 0
+        self.skipped_batches = 0
+        self.last_postmortem: Optional[str] = None
+        self.param_stats: Optional[Dict[str, Dict[str, float]]] = None
+        self.act_stats: Optional[Dict[str, Dict[str, float]]] = None
+        self._last_record: Optional[dict] = None
+        self._tail: List[dict] = []
+        self._tail_capacity = int(tail_capacity)
+        self._timeline: Optional[EventLog] = None
+        # guards the snapshot fields above ONLY (edge-free pin): no
+        # timeline append / flight record / log call under this lock
+        self._lock = threading.Lock()
+
+    # --------------------------------------------------------- timeline
+    def open_timeline(self):
+        """(Re)open the JSONL event log when the config names one; a
+        second ``train()`` on the same trainer appends to (a possibly
+        different) run file. A config that DROPPED log_path detaches
+        the stale closed log — otherwise every later step would count
+        a bogus drop against it."""
+        if not self.cfg.log_path:
+            self._timeline = None
+        elif (self._timeline is None
+                or self._timeline.snapshot()["closed"]
+                or self._timeline.path != self.cfg.log_path):
+            self._timeline = EventLog(self.cfg.log_path,
+                                      service=self.cfg.service)
+        return self._timeline
+
+    def close(self):
+        """Flush and stop the timeline writer (the trainer's finally
+        block); the monitor itself stays usable — snapshots and the
+        stat readers keep serving between ``train()`` calls."""
+        if self._timeline is not None:
+            self._timeline.close()
+
+    def _emit(self, record: dict):
+        # called OUTSIDE self._lock (edge-free pin)
+        if self._timeline is not None:
+            self._timeline.append(record)
+
+    # ------------------------------------------------------------ steps
+    def on_step(self, *, pass_id: int, batch_id: int, loss: float,
+                lr: Optional[float] = None,
+                grad_absmax: Optional[float] = None,
+                data_wait_ms: Optional[float] = None,
+                compute_ms: Optional[float] = None,
+                param_stats: Optional[dict] = None,
+                act_stats: Optional[dict] = None,
+                skipped: bool = False) -> dict:
+        """One finished (or skipped) step. Returns the timeline record
+        (tests and the bench read it back)."""
+        rec: Dict[str, Any] = {"event": "step", "pass": int(pass_id),
+                               "batch": int(batch_id), "loss": loss}
+        if lr is not None:
+            rec["lr"] = lr
+        if grad_absmax is not None:
+            rec["grad_absmax"] = grad_absmax
+        if data_wait_ms is not None:
+            rec["data_wait_ms"] = round(data_wait_ms, 4)
+        if compute_ms is not None:
+            rec["compute_ms"] = round(compute_ms, 4)
+        if skipped:
+            rec["skipped"] = True
+        if param_stats is not None:
+            rec["param_stats"] = param_stats
+        if act_stats is not None:
+            rec["act_stats"] = act_stats
+        with self._lock:
+            rec["step"] = self.steps
+            self.steps += 1
+            if param_stats is not None:
+                self.param_stats = param_stats
+            if act_stats is not None:
+                self.act_stats = act_stats
+            self._last_record = rec
+            self._tail.append(rec)
+            if len(self._tail) > self._tail_capacity:
+                del self._tail[:len(self._tail) - self._tail_capacity]
+        self._emit(rec)
+        return rec
+
+    # ------------------------------------------------------- divergence
+    def on_divergence(self, *, pass_id: int, batch_id: int, loss: float,
+                      grad_absmax: float,
+                      layer_grad_absmax: Optional[dict] = None,
+                      rng: Optional[list] = None,
+                      ledger: Optional[dict] = None,
+                      param_stats: Optional[dict] = None,
+                      act_stats: Optional[dict] = None) -> str:
+        """The sentry tripped on this step. Writes the postmortem
+        bundle, emits the ``train.divergence`` flight event and the
+        timeline record, logs when ``log_clipping`` asks, and returns
+        the policy the trainer must apply (the in-graph update select
+        already ran for ``skip_batch`` — the host side only rolls the
+        RNG/carried state back and skips accumulation)."""
+        cfg = self.cfg
+        worst = None
+        if layer_grad_absmax:
+            worst = max(layer_grad_absmax, key=layer_grad_absmax.get)
+        with self._lock:
+            step = self.steps  # the step being judged (on_step follows)
+            self.sentry_trips += 1
+            if cfg.policy == "skip_batch":
+                self.skipped_batches += 1
+            snap_params = param_stats or self.param_stats
+            snap_acts = act_stats or self.act_stats
+        bundle = {
+            "schema": "train.divergence.postmortem",
+            "service": cfg.service, "pid": self.pid,
+            "ts": round(time.time(), 6),
+            "step": step, "pass_id": int(pass_id),
+            "batch_id": int(batch_id),
+            "loss": loss, "grad_absmax": grad_absmax,
+            "worst_layer": worst,
+            "layer_grad_absmax": layer_grad_absmax,
+            "policy": cfg.policy,
+            "grad_threshold": cfg.grad_threshold,
+            "rng": rng, "ledger": ledger,
+            "param_stats": snap_params, "act_stats": snap_acts,
+        }
+        path = write_postmortem(bundle, self.postmortem_dir)
+        with self._lock:
+            self.last_postmortem = path
+        if _flight._ACTIVE is not None:
+            _flight._ACTIVE.record(
+                "train.divergence", step=step, pass_id=int(pass_id),
+                batch_id=int(batch_id), loss=loss,
+                grad_absmax=grad_absmax, worst_layer=worst,
+                policy=cfg.policy, postmortem=path)
+        self._emit({"event": "divergence", "step": step,
+                    "pass": int(pass_id), "batch": int(batch_id),
+                    "loss": loss, "grad_absmax": grad_absmax,
+                    "worst_layer": worst, "policy": cfg.policy,
+                    "postmortem": path})
+        if cfg.log_clipping or cfg.policy == "halt":
+            logger.warning(
+                "divergence sentry tripped at pass=%d batch=%d (step %d): "
+                "loss=%r max|grad|=%r worst_layer=%s policy=%s "
+                "postmortem=%s", pass_id, batch_id, step, loss,
+                grad_absmax, worst, cfg.policy, path)
+        return cfg.policy
+
+    # ---------------------------------------------------------- observe
+    def timeline_tail(self, n: int = 512) -> List[dict]:
+        with self._lock:
+            return list(self._tail[-n:])
+
+    def snapshot(self) -> dict:
+        """Metrics-registry provider: the live trainer-health surface
+        (``--metrics_port`` and any federating scrape show it)."""
+        with self._lock:
+            last = dict(self._last_record) if self._last_record else None
+            out = {
+                "armed": self.cfg.armed,
+                "period": self.cfg.period,
+                "sentry": self.cfg.sentry,
+                "policy": self.cfg.policy,
+                "grad_threshold": self.cfg.grad_threshold,
+                "steps": self.steps,
+                "sentry_trips": self.sentry_trips,
+                "skipped_batches": self.skipped_batches,
+                "last_postmortem": self.last_postmortem,
+            }
+        if last is not None:
+            # per-layer dicts stay out of the scrape (cardinality);
+            # the scalar health of the last step rides along
+            out["last_step"] = {
+                k: last[k] for k in ("step", "pass", "batch", "loss",
+                                     "lr", "grad_absmax",
+                                     "data_wait_ms", "compute_ms")
+                if k in last}
+        timeline = self._timeline
+        if timeline is not None:
+            out["timeline"] = timeline.snapshot()
+        # a diverged step's NaN/inf must not break a strict-JSON
+        # scraper at exactly the moment it matters — same spelling
+        # discipline as the JSONL timeline (obs/events.py)
+        return _finite_or_str(out)

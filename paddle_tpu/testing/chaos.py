@@ -37,6 +37,14 @@ Sites wired in this codebase:
                 → resume replays it)
 ``step_done``   end of each trainer iteration, AFTER checkpointing (a
                 kill here tests resume from the just-written file)
+``step_stats``  the trainer's training-health plane, just before an
+                armed step dispatches (info: ``pass_id``,
+                ``batch_id``; fires only while the divergence sentry
+                is armed). A ``corrupt`` fault here carries no file
+                path — instead the trainer reads the fired kinds from
+                ``hit()``'s return and poisons ONE gradient leaf to
+                NaN in-graph (``trainer.py:_poison_grads``), the
+                deterministic divergence-sentry drill
 ``msg_send``    master RPC message about to be serialized (client *and*
                 server side)
 ``msg_recv``    master RPC message about to be read
@@ -115,9 +123,10 @@ ENV_VAR = "PADDLE_TPU_CHAOS_PLAN"
 # flight-recorder matrix (tests/test_obs_flight.py:SITE_CASES) — a new
 # chaos site cannot ship without its postmortem event.
 SITES = (
-    "step", "step_done", "msg_send", "msg_recv", "checkpoint",
-    "store_save", "serve_batch", "route_dispatch", "replica_spawn",
-    "supervisor_spawn", "lease_renew", "router_failover",
+    "step", "step_done", "step_stats", "msg_send", "msg_recv",
+    "checkpoint", "store_save", "serve_batch", "route_dispatch",
+    "replica_spawn", "supervisor_spawn", "lease_renew",
+    "router_failover",
 )
 
 # the one global the hook sites poll; None == chaos disabled
@@ -236,7 +245,11 @@ class FaultPlan:
     # ------------------------------------------------------------ hits
     def hit(self, site: str, **info):
         """One arrival at ``site``. May sleep, raise, corrupt a file, or
-        kill the process, per the plan."""
+        kill the process, per the plan. Returns the tuple of fired
+        fault TYPES (empty when nothing fired) so value-carrying sites
+        — ``step_stats``'s in-graph gradient poison — can read the
+        decision without a side channel; kill/drop paths never
+        return."""
         with self._lock:
             n = self._hits.get(site, 0) + 1
             self._hits[site] = n
@@ -267,10 +280,14 @@ class FaultPlan:
             elif kind in ("drop", "partition"):
                 raise ChaosDropped(f"chaos dropped {site} hit {n}")
             elif kind == "corrupt":
+                # with a path the fault mutates that file; without one
+                # (step_stats) the caller reads the returned kind and
+                # applies the corruption itself (in-graph poison)
                 if "path" in info:
                     _corrupt_file(info["path"], f.get("mode", "truncate"))
             else:
                 raise ValueError(f"unknown fault type {kind!r}")
+        return tuple(f["type"] for _, f in due)
 
     def hits(self, site: str) -> int:
         with self._lock:

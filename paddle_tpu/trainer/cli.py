@@ -48,7 +48,37 @@ def parse_args(argv=None):
     p.add_argument("--show_parameter_stats_period", type=int, default=0,
                    help="log the parameter health dump every N batches")
     p.add_argument("--show_layer_stat", action="store_true",
-                   help="log per-layer output stats at each log_period")
+                   help="log per-layer output stats at each log_period "
+                        "(read from the in-step telemetry when "
+                        "--show_parameter_stats_period arms it)")
+    p.add_argument("--log_error_clipping", action="store_true",
+                   help="arm the divergence sentry and log each trip "
+                        "(the reference's --log_error_clipping, "
+                        "Flags.cpp:69, machine-mapped: loss/grad "
+                        "finiteness plus --error_clipping_threshold "
+                        "checked INSIDE the compiled step)")
+    p.add_argument("--error_clipping_threshold", type=float, default=0.0,
+                   help="divergence-sentry gradient threshold: trip "
+                        "when max|grad| exceeds this (0 = finiteness "
+                        "only; the reference's per-layer "
+                        "error_clipping_threshold attr as a global "
+                        "training-health knob). The policy on a trip "
+                        "is --divergence_policy; skip_batch reproduces "
+                        "the reference error-clipping semantics")
+    p.add_argument("--divergence_policy", default="skip_batch",
+                   choices=["halt", "skip_batch", "dump"],
+                   help="what a sentry trip does: halt (postmortem + "
+                        "DivergenceError), skip_batch (discard the "
+                        "poisoned batch's update in-graph — the "
+                        "post-skip trajectory is bitwise the run that "
+                        "never saw the batch), dump (postmortem only, "
+                        "keep training)")
+    p.add_argument("--health_log", default=None,
+                   help="append the per-step training-health timeline "
+                        "(step, loss, lr, per-layer stats on period "
+                        "steps, data_wait/compute) to this JSONL file "
+                        "(obs/events.py; render/diff with "
+                        "tools/healthview.py)")
     p.add_argument("--save_dir", default=None,
                    help="checkpoint directory (train) / source (test,merge)")
     p.add_argument("--saving_period", type=int, default=1)
@@ -439,11 +469,30 @@ def cmd_train(ns, args):
                 print(f"  Test: cost={res.cost:.5g} " + " ".join(
                     f"{k}={v:.5g}" for k, v in res.evaluator.items()))
 
+    # training-health plane: the sentry flags arm the in-step
+    # finiteness/threshold check; --health_log adds the JSONL scalar
+    # timeline; --show_parameter_stats_period arms the fused per-layer
+    # telemetry inside trainer.train (the dedupe — no second forward)
+    health = None
+    sentry = bool(getattr(args, "error_clipping_threshold", 0.0)
+                  or getattr(args, "log_error_clipping", False))
+    if sentry or getattr(args, "health_log", None):
+        health = {
+            "sentry": sentry,
+            "grad_threshold": getattr(args, "error_clipping_threshold",
+                                      0.0),
+            "policy": getattr(args, "divergence_policy", "skip_batch"),
+            "log_clipping": getattr(args, "log_error_clipping", False),
+            "log_path": getattr(args, "health_log", None),
+        }
+
     metrics_srv = None
     if getattr(args, "metrics_port", 0):
         # metrics federation for the training side: the SAME scrape
         # surface the serving fleet has, exporting the live
-        # StepBreakdown split + per-device memory accounting
+        # StepBreakdown split + per-device memory accounting + the
+        # training-health snapshot (pillar 4) — so the router-side
+        # federation pattern shows trainer health with zero extra code
         from paddle_tpu.obs import MetricsRegistry, serve_metrics
 
         def train_snapshot():
@@ -457,7 +506,12 @@ def cmd_train(ns, args):
                 out["memory"] = {"error": repr(e)}
             return out
 
+        def health_snapshot():
+            hm = getattr(trainer, "_health", None)
+            return hm.snapshot() if hm is not None else {"armed": False}
+
         registry = MetricsRegistry().register("train", train_snapshot)
+        registry.register("health", health_snapshot)
         metrics_srv = serve_metrics(registry, host=args.host,
                                     port=args.metrics_port)
         print(f"train metrics on http://{args.host}:"
@@ -480,7 +534,8 @@ def cmd_train(ns, args):
                       grad_accum_steps=getattr(args, "grad_accum_steps",
                                                1),
                       checkpointer=ck,
-                      auto_resume=getattr(args, "auto_resume", True))
+                      auto_resume=getattr(args, "auto_resume", True),
+                      health=health)
     finally:
         if metrics_srv is not None:
             metrics_srv.shutdown()

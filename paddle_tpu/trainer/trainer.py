@@ -178,14 +178,25 @@ class SGD:
         self.compute_dtype = (jnp.dtype(compute_dtype)
                               if compute_dtype is not None else None)
         self._rng = jax.random.PRNGKey(seed + 1)
-        self._train_step = self._build_train_step()
+        # training-health plane (obs/health.py): None until train()
+        # arms it (health= kwarg or --show_parameter_stats_period);
+        # while armed, _rebuild_train_step pins TWO program variants —
+        # stats-off (the hot step, + the sentry scalars when the sentry
+        # is armed) and stats-on (the same step with the per-layer stat
+        # reduction fused in), each behind its own RecompileGuard
+        self._health_cfg = None
+        self._health = None
+        self._health_param_names = ()
+        self._health_act_names = ()
+        self._train_step_stats = None
+        self.stats_recompile_guard = None
+        self._stats_warm_pending = False
+        self._rebuild_train_step()
         self._eval_step = self._build_eval_step()
-        # recompile-guard: a ragged corpus with unbucketed shapes silently
-        # retraces the step per batch; the guard makes that loud
-        # (data/prefetch.py:RecompileGuard; warn_after=recompile_warn)
+        # (recompile-guard rationale: a ragged corpus with unbucketed
+        # shapes silently retraces the step per batch; the guards make
+        # that loud — data/prefetch.py:RecompileGuard)
         from paddle_tpu.utils.profiler import StepBreakdown
-        self.recompile_guard = _prefetch.RecompileGuard(
-            self._train_step, warn_after=recompile_warn)
         # the eval forward thrashes the same way on unbucketed test
         # corpora (graftlint PT104): guard it like the train step
         self.eval_recompile_guard = _prefetch.RecompileGuard(
@@ -309,6 +320,188 @@ class SGD:
                 for n in self._eval_layers}
         return metrics
 
+    # ------------------------------------------------- health telemetry
+    #: param-table columns (the [P, 6] packed layout — ONE jit output
+    #: for the whole table; P separate scalar outputs cost ~30us of
+    #: dispatch EACH on the 1-core host, which alone blew the <=5%
+    #: overhead budget before packing)
+    _HEALTH_PARAM_COLS = ("avg_abs", "max_abs", "norm", "grad_norm",
+                          "update_ratio", "touched_rows")
+
+    def _act_stat_table(self, outputs):
+        """Per-layer activation (avg_abs, max_abs, live-weight) over
+        the executed graph's outputs, packed as ONE [L, 3] array — the
+        in-step half
+        of ``--show_layer_stat`` (same mask-aware math as the
+        standalone ``layer_stats`` jit, fused into the train step
+        instead of a second forward). Records the layer-name order on
+        the trainer at trace time; returns None when no output is
+        inexact."""
+        names = [n for n, a in outputs.items()
+                 if hasattr(a.value, "dtype")
+                 and jnp.issubdtype(a.value.dtype, jnp.inexact)]
+        self._health_act_names = tuple(names)
+        if not names:
+            return None
+
+        def fenced(a):
+            # the reductions must read the MATERIALIZED layer outputs:
+            # unfenced, XLA duplicates producer computation into the
+            # stat consumers (measured ~20 ms/step on the bench model
+            # vs ~3 ms for the reductions themselves) — and the fence
+            # doubles as the bitwise-neutrality guarantee the param
+            # side gets from its own barrier
+            value = jax.lax.optimization_barrier(a.value)
+            mask = (jax.lax.optimization_barrier(a.mask)
+                    if a.mask is not None else None)
+            return a.replace(value=value, mask=mask)
+
+        rows = [jnp.stack([jnp.asarray(s, jnp.float32)
+                           for s in _arg_abs_stats(fenced(outputs[n]))])
+                for n in names]
+        return jnp.stack(rows)
+
+    @staticmethod
+    def _poison_grads(grads, poison):
+        """Chaos ``step_stats`` corrupt trigger: NaN into element 0 of
+        the first (sorted) gradient leaf when ``poison > 0``. With
+        ``poison == 0`` the ``.at[0].set`` writes the element's own
+        value back — a bitwise no-op — so ONE compiled program serves
+        both the poisoned and the clean step and the fault stays
+        deterministic in the plan seed."""
+        if poison is None:
+            return grads
+        name = sorted(grads)[0]
+        g = grads[name]
+        flat = g.reshape((-1,))
+        bad = jnp.asarray(jnp.nan, flat.dtype)
+        flat = flat.at[0].set(jnp.where(poison > 0, bad, flat[0]))
+        out = dict(grads)
+        out[name] = flat.reshape(g.shape)
+        return out
+
+    def _health_metrics(self, loss, params, grads, new_params, new_opt,
+                        num_passes, act_table, with_stats):
+        """The in-step training-health reduction (obs/health.py owns
+        the host side). Returns extra metrics entries:
+
+        - ``sentry`` (when the sentry is armed): the per-step
+          finiteness+threshold scalars — ``trip``, the global
+          ``grad_absmax``, and a [P] per-parameter grad-absmax vector
+          (fetched only on a trip, for the postmortem bundle).
+        - ``health`` (stats-on variant only): a packed [P, 6]
+          per-parameter table (columns ``_HEALTH_PARAM_COLS``) plus
+          the [L, 3] activation table — packed because P+L separate
+          scalar outputs cost more in dispatch than the reductions
+          themselves on the 1-core host.
+        - ``health_lr``: the step's effective base learning rate.
+
+        Name order rides ``self._health_param_names`` /
+        ``self._health_act_names``, recorded at trace time (static
+        per program variant).
+
+        Everything reduces from ``optimization_barrier``-fenced views
+        of params/grads/new_params so XLA cannot fuse the stat
+        reductions back into the update path's producers — the
+        stats-on and stats-off programs must round the TRAINED values
+        identically (the bitwise-neutrality matrix,
+        tests/test_health_matrix.py, is the enforcement)."""
+        cfg = self._health_cfg
+        out: Dict[str, Any] = {}
+        if cfg is None or not cfg.armed:
+            return out
+        p_b, g_b, np_b = jax.lax.optimization_barrier(
+            (params, grads, new_params))
+        names = sorted(p_b)
+        self._health_param_names = tuple(names)
+        loss_f = jnp.asarray(loss, jnp.float32)
+        if cfg.sentry:
+            per = jnp.stack([jnp.max(jnp.abs(g_b[n])).astype(jnp.float32)
+                             for n in names]) if names \
+                else jnp.zeros((0,), jnp.float32)
+            gmax = (jnp.max(per) if names
+                    else jnp.zeros((), jnp.float32))
+            trip = ~jnp.isfinite(loss_f) | ~jnp.isfinite(gmax)
+            if cfg.grad_threshold > 0:
+                trip = trip | (gmax > cfg.grad_threshold)
+            out["sentry"] = {"trip": trip, "grad_absmax": gmax,
+                             "layer_grad_absmax": per}
+        opt = self.optimizer
+        ns = (new_opt.get("num_samples")
+              if isinstance(new_opt, dict) else None)
+        if ns is not None and hasattr(opt, "learning_rate"):
+            from paddle_tpu.optim.schedules import learning_rate_at
+            out["health_lr"] = learning_rate_at(
+                getattr(opt, "learning_rate_schedule", "constant"),
+                opt.learning_rate,
+                getattr(opt, "learning_rate_decay_a", 0.0),
+                getattr(opt, "learning_rate_decay_b", 0.0), ns,
+                args=getattr(opt, "learning_rate_args", ""),
+                num_passes=num_passes)
+        if with_stats:
+            def l2(x):
+                return jnp.sqrt(jnp.sum(
+                    jnp.square(x.astype(jnp.float32))))
+
+            nan = jnp.asarray(jnp.nan, jnp.float32)
+            rows = []
+            for n in names:
+                p = p_b[n]
+                g = g_b.get(n)
+                npv = np_b.get(n)
+                pn = l2(p)
+                row = [jnp.mean(jnp.abs(p)).astype(jnp.float32),
+                       jnp.max(jnp.abs(p)).astype(jnp.float32), pn]
+                row.append(l2(g) if g is not None else nan)
+                row.append(l2(npv - p) / jnp.maximum(pn, 1e-12)
+                           if npv is not None else nan)
+                if g is not None and g.ndim >= 2 \
+                        and self.optimizer._is_sparse(self.meta.get(n)):
+                    # sparse tables: rows this batch touched (the
+                    # reference's per-row update bookkeeping made
+                    # observable); -1 marks the non-sparse rows the
+                    # host drops
+                    row.append(jnp.sum(jnp.any(
+                        g != 0, axis=tuple(range(1, g.ndim))
+                    ).astype(jnp.float32)))
+                else:
+                    row.append(jnp.asarray(-1.0, jnp.float32))
+                rows.append(jnp.stack(row))
+            out["health"] = {
+                "param_table": (jnp.stack(rows) if rows
+                                else jnp.zeros((0, 6), jnp.float32)),
+                "act_table": (act_table if act_table is not None
+                              else jnp.zeros((0, 3), jnp.float32)),
+            }
+        return out
+
+    def _apply_skip_select(self, health, params, opt_state, new_params,
+                           new_opt):
+        """``skip_batch`` policy, in-graph: a tripped sentry discards
+        the whole update — params, optimizer slots AND schedule
+        counters revert to the step's inputs — so the post-skip
+        trajectory is bitwise the run that never saw the batch (the
+        host side rolls the RNG split back). Donation-safe: the
+        selects read the donated inputs elementwise, which XLA
+        resolves with copies only where aliasing actually needs
+        them."""
+        cfg = self._health_cfg
+        sentry = health.get("sentry") if health else None
+        if sentry is None or cfg.policy != "skip_batch":
+            return new_params, new_opt
+        # ONE cond over the whole state, not a per-leaf where: the
+        # untripped (hot) branch must not pay an elementwise select
+        # over every param + slot (~10 ms/step of pure memory traffic
+        # on the 1-core CPU host — the difference between passing and
+        # blowing the <=5% overhead budget). The moving-stat merge keys
+        # of new_params are a superset-safe dict: revert those to the
+        # step's input params too.
+        old_params = {k: params[k] for k in new_params}
+        return jax.lax.cond(
+            sentry["trip"],
+            lambda: (old_params, opt_state),
+            lambda: (new_params, new_opt))
+
     def _accum_k_for(self, batch_size: int) -> int:
         """Effective accumulation factor for one batch shape. The FIRST
         batch shape must be divisible by ``grad_accum_steps`` — a k the
@@ -365,7 +558,7 @@ class SGD:
 
         return jax.tree_util.tree_map(split, feed)
 
-    def _build_pipe_step(self):
+    def _build_pipe_step(self, with_stats=False):
         """The pipelined train step: body forward through the GPipe
         schedule (``PipelineTrainPlan.fwd`` — a shard_map'd scan whose
         ``jax.grad`` is the reverse-order backward pipeline), cost head
@@ -373,7 +566,10 @@ class SGD:
         the whole-batch gradient. Loss math is identical to the
         unpipelined step's (same denominators, same clip/decay point), so
         the step is gradient-exact on deterministic bodies — pinned by
-        tests/test_pipeline_train.py."""
+        tests/test_pipeline_train.py. ``with_stats`` fuses the
+        training-health stat reduction in (``_health_metrics``; the
+        activation stats cover the head layers + gathered body output —
+        the fetched surface of the pipelined graph)."""
         import math
 
         from paddle_tpu.core.argument import Argument
@@ -386,7 +582,8 @@ class SGD:
         M_cfg = self._pipe_microbatches
         n_data = mesh_lib.data_parallel_degree(self.mesh)
 
-        def step(params, opt_state, feed, rng, num_passes, carried=None):
+        def step(params, opt_state, feed, rng, num_passes, carried=None,
+                 poison=None):
             del carried  # rejected at enable time (no prev_batch_state)
             B = next(iter(feed.values())).value.shape[0]
             b_loc = B // n_data
@@ -415,8 +612,9 @@ class SGD:
                 return (self._total_cost(outputs, self._row_mask(feed)),
                         (outputs, updates))
 
-            (_, (outputs, updates)), grads = jax.value_and_grad(
+            (loss, (outputs, updates)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params, feed, rng)
+            grads = self._poison_grads(grads, poison)
             updates = self._cast_f32(updates)
             row_mask = self._row_mask(feed)
             bsz = (jnp.sum(row_mask) if row_mask is not None
@@ -425,16 +623,23 @@ class SGD:
                 grads, opt_state, params, meta, batch_size=bsz,
                 num_passes=num_passes)
             new_params.update(updates)
+            health = self._health_metrics(
+                loss, params, grads, new_params, new_opt, num_passes,
+                self._act_stat_table(outputs) if with_stats else None,
+                with_stats)
+            new_params, new_opt = self._apply_skip_select(
+                health, params, opt_state, new_params, new_opt)
             metrics = self._metrics(outputs, feed)
+            metrics.update(health)
             return new_params, new_opt, metrics
 
         return jax.jit(step, donate_argnums=(0, 1))
 
-    def _build_train_step(self):
+    def _build_train_step(self, with_stats=False):
         if self._pipe is not None:
             # the schedule's microbatching subsumes grad_accum_steps
             # (absorbed in enable_pipeline); accum/carry paths don't apply
-            return self._build_pipe_step()
+            return self._build_pipe_step(with_stats=with_stats)
         network, optimizer, meta = self.network, self.optimizer, self.meta
         # the ZeRO-1 updater is a drop-in for the optimizer's update
         # protocol (optim/zero1.py); everything upstream of the update —
@@ -462,7 +667,8 @@ class SGD:
             return (self._total_cost(outputs, self._row_mask(feed)),
                     (outputs, updates))
 
-        def step(params, opt_state, feed, rng, num_passes, carried=None):
+        def step(params, opt_state, feed, rng, num_passes, carried=None,
+                 poison=None):
             if carried is not None:
                 # truncated BPTT: no gradient across the batch boundary
                 carried = jax.lax.stop_gradient(carried)
@@ -473,13 +679,14 @@ class SGD:
                 probes = {n: jnp.zeros(shapes[n].value.shape,
                                        shapes[n].value.dtype)
                           for n in grad_watch}
-                (_, (outputs, updates)), (grads, probe_grads) = \
+                (loss, (outputs, updates)), (grads, probe_grads) = \
                     jax.value_and_grad(loss_fn, argnums=(0, 4),
                                        has_aux=True)(
                         params, feed, rng, carried, probes)
             else:
-                (_, (outputs, updates)), grads = jax.value_and_grad(
+                (loss, (outputs, updates)), grads = jax.value_and_grad(
                     loss_fn, has_aux=True)(params, feed, rng, carried)
+            grads = self._poison_grads(grads, poison)
             # grads are already f32 (cotangents take the f32 params' dtype);
             # only the moving-stat updates computed in bf16 need casting
             updates = self._cast_f32(updates)
@@ -492,7 +699,14 @@ class SGD:
                 grads, opt_state, params, meta, batch_size=bsz,
                 num_passes=num_passes)
             new_params.update(updates)  # moving statistics (batch_norm)
+            health = self._health_metrics(
+                loss, params, grads, new_params, new_opt, num_passes,
+                self._act_stat_table(outputs) if with_stats else None,
+                with_stats)
+            new_params, new_opt = self._apply_skip_select(
+                health, params, opt_state, new_params, new_opt)
             metrics = self._metrics(outputs, feed)
+            metrics.update(health)
             if carry_layers:
                 graph = self.topology.graph
 
@@ -513,7 +727,7 @@ class SGD:
             return new_params, new_opt, metrics
 
         def accum_step(params, opt_state, feed, rng, num_passes,
-                       carried=None):
+                       carried=None, poison=None):
             """Microbatch gradient accumulation: ``lax.scan`` over k
             equal slices of the batch, one forward+backward per slice (so
             only one microbatch's activations are ever live), gradients
@@ -547,12 +761,17 @@ class SGD:
                 (loss, (outputs, updates)), grads = jax.value_and_grad(
                     loss_micro, has_aux=True)(params, mfeed, mrng)
                 g_acc = jax.tree_util.tree_map(jnp.add, g_acc, grads)
+                acts = (self._act_stat_table(outputs)
+                        if with_stats else None)
                 return g_acc, (loss, self._cast_f32(updates),
-                               self._metrics(outputs, mfeed))
+                               self._metrics(outputs, mfeed),
+                               acts if acts is not None
+                               else jnp.zeros((0, 3), jnp.float32))
 
             g_zero = jax.tree_util.tree_map(jnp.zeros_like, params)
-            grads, (losses, updates_k, metrics_k) = jax.lax.scan(
+            grads, (losses, updates_k, metrics_k, acts_k) = jax.lax.scan(
                 micro, g_zero, (micro_feed, rngs))
+            grads = self._poison_grads(grads, poison)
             # moving statistics (batch_norm): mean over microbatches —
             # for equal-size unmasked microbatches this IS the k×-batch
             # update (the EMA is affine in the batch mean)
@@ -579,6 +798,25 @@ class SGD:
                 grads, opt_state, params, meta, batch_size=bsz,
                 num_passes=num_passes)
             new_params.update(updates)
+            act_table = None
+            if with_stats and acts_k.shape[1] > 0:
+                # (k, L, 3)-stacked per-microbatch tables -> the
+                # whole-batch view: max over microbatches is exact,
+                # and the avg reweights each micro's masked mean by
+                # its live-element count — the whole-batch masked mean
+                # even when padded rows land unevenly across
+                # microbatches (a plain mean-of-means would bias it)
+                w = acts_k[:, :, 2]
+                w_tot = jnp.maximum(jnp.sum(w, axis=0), 1.0)
+                act_table = jnp.stack(
+                    [jnp.sum(acts_k[:, :, 0] * w, axis=0) / w_tot,
+                     jnp.max(acts_k[:, :, 1], axis=0), w_tot], axis=1)
+            health = self._health_metrics(
+                metrics["cost"], params, grads, new_params, new_opt,
+                num_passes, act_table, with_stats)
+            new_params, new_opt = self._apply_skip_select(
+                health, params, opt_state, new_params, new_opt)
+            metrics.update(health)
             return new_params, new_opt, metrics
 
         return jax.jit(accum_step if accum_k > 1 else step,
@@ -642,6 +880,22 @@ class SGD:
         self._train_step = self._build_train_step()
         self.recompile_guard = _prefetch.RecompileGuard(
             self._train_step, warn_after=self._recompile_warn)
+        cfg = self._health_cfg
+        if cfg is not None and cfg.period > 0:
+            # the stats-on program variant: the SAME step with the
+            # per-layer stat reduction fused in, pinned + guarded like
+            # the hot variant; the loop warms it on the first batch so
+            # no compile lands mid-run (warmed once, then zero growth)
+            self._train_step_stats = self._build_train_step(
+                with_stats=True)
+            self.stats_recompile_guard = _prefetch.RecompileGuard(
+                self._train_step_stats, warn_after=self._recompile_warn,
+                name="train_step_stats")
+            self._stats_warm_pending = True
+        else:
+            self._train_step_stats = None
+            self.stats_recompile_guard = None
+            self._stats_warm_pending = False
 
     # ------------------------------------------------------------ pipeline
     def enable_pipeline(self, microbatches: Optional[int] = None) -> bool:
@@ -866,6 +1120,141 @@ class SGD:
             self.grad_accum_steps = grad_accum_steps
             self._rebuild_train_step()
 
+    def _configure_health(self, health, show_parameter_stats_period=0):
+        """Arm/disarm the training-health plane. Tri-state like zero1:
+        ``None`` keeps the current mode, ``False`` disarms, a
+        ``HealthConfig``/dict arms. A bare
+        ``show_parameter_stats_period > 0`` arms the in-step telemetry
+        on that period (the dedupe: the periodic parameter dump reads
+        the fused reduction instead of running a second program), and
+        fills the period of an explicit config that left it 0. A config
+        change rebuilds the step variants; the monitor (and its
+        counters/snapshot) survives unchanged configs across train()
+        calls."""
+        import dataclasses as _dc
+
+        from paddle_tpu.obs.health import HealthConfig, HealthMonitor
+        from paddle_tpu.utils import logger
+        cfg = self._health_cfg
+        if health is False:
+            cfg = None
+        elif health is not None:
+            cfg = HealthConfig.coerce(health)
+        if show_parameter_stats_period:
+            if cfg is None:
+                cfg = HealthConfig(
+                    period=int(show_parameter_stats_period))
+            elif cfg.period == 0:
+                cfg = _dc.replace(
+                    cfg, period=int(show_parameter_stats_period))
+            elif cfg.period != int(show_parameter_stats_period):
+                # the dump reads the telemetry's period-N snapshot:
+                # with misaligned cadences a dump line can be up to
+                # N-1 batches stale — loud, not silent
+                logger.warning(
+                    "show_parameter_stats_period=%d but the health "
+                    "telemetry period is %d: the periodic parameter "
+                    "dump reads the in-step snapshot, which refreshes "
+                    "every %d batches — align the periods (or drop "
+                    "the explicit health period) for current-step "
+                    "dumps", show_parameter_stats_period, cfg.period,
+                    cfg.period)
+
+        def graph_sig(c):
+            # the compiled-program-affecting subset: the sentry
+            # scalars + threshold + skip-select policy, and WHETHER a
+            # stats variant exists. Host-only fields (log_path,
+            # log_clipping, service, the period VALUE) must not cost
+            # a recompile of warmed variants.
+            if c is None:
+                return None
+            return (c.sentry, c.grad_threshold, c.policy, c.period > 0)
+
+        rebuild = graph_sig(cfg) != graph_sig(self._health_cfg)
+        self._health_cfg = cfg
+        if cfg is None:
+            self._health = None
+        elif self._health is None:
+            self._health = HealthMonitor(cfg)
+        else:
+            # keep the monitor (counters, snapshots, timeline tail)
+            # across config tweaks — one training session, one story;
+            # open_timeline() picks up a changed log_path next train()
+            self._health.cfg = cfg
+        if rebuild:
+            self._rebuild_train_step()
+
+    def _health_step(self, hm, sentry_host, health_raw, health_lr, cost,
+                     pass_id, batch_id, reader, prev_rng) -> bool:
+        """Host side of one armed step: fetch the sentry scalars,
+        convert the stats-on snapshot, apply the sentry policy, append
+        the timeline record. Returns True when the batch was skipped
+        (``skip_batch`` trip: the in-graph select already discarded the
+        update; here the RNG split rolls back and the caller skips
+        accumulation/carry, so the trajectory is bitwise the run that
+        never saw the batch)."""
+        bd = self.breakdown
+        cfg = self._health_cfg
+        param_snap = act_snap = None
+        if health_raw is not None:
+            # two packed tables -> the reader-facing dicts (name order
+            # was recorded at trace time)
+            table, act = jax.device_get((health_raw["param_table"],
+                                         health_raw["act_table"]))
+            param_snap = {}
+            for i, n in enumerate(self._health_param_names):
+                vals = table[i]
+                d = {"avg_abs": float(vals[0]),
+                     "max_abs": float(vals[1]),
+                     "norm": float(vals[2]),
+                     "grad_norm": float(vals[3]),
+                     "update_ratio": float(vals[4]),
+                     "size": int(self.params[n].size)}
+                if vals[5] >= 0:
+                    d["touched_rows"] = float(vals[5])
+                param_snap[n] = d
+            act_snap = {n: {"avg_abs": float(act[i, 0]),
+                            "max_abs": float(act[i, 1])}
+                        for i, n in enumerate(self._health_act_names)}
+        grad_absmax = None
+        tripped = False
+        if sentry_host is not None:
+            trip, gmax = jax.device_get((sentry_host["trip"],
+                                         sentry_host["grad_absmax"]))
+            tripped = bool(trip)
+            grad_absmax = float(gmax)
+        skipped = False
+        if tripped:
+            per_vec = jax.device_get(sentry_host["layer_grad_absmax"])
+            per = {n: float(per_vec[i])
+                   for i, n in enumerate(self._health_param_names)}
+            policy = hm.on_divergence(
+                pass_id=pass_id, batch_id=batch_id, loss=cost,
+                grad_absmax=grad_absmax, layer_grad_absmax=per,
+                rng=np.asarray(jax.device_get(prev_rng)).tolist(),
+                ledger=getattr(reader, "ledger_state", None),
+                param_stats=param_snap, act_stats=act_snap)
+            skipped = policy == "skip_batch"
+            if skipped:
+                # the clean run never split a key for this batch
+                self._rng = prev_rng
+        hm.on_step(pass_id=pass_id, batch_id=batch_id, loss=cost,
+                   lr=(float(health_lr) if health_lr is not None
+                       else None),
+                   grad_absmax=grad_absmax,
+                   data_wait_ms=bd.last.get("data_wait", 0.0) * 1e3,
+                   compute_ms=bd.last.get("compute", 0.0) * 1e3,
+                   param_stats=param_snap, act_stats=act_snap,
+                   skipped=skipped)
+        if tripped and cfg.policy == "halt":
+            from paddle_tpu.obs.health import DivergenceError
+            raise DivergenceError(
+                f"divergence sentry tripped at pass={pass_id} "
+                f"batch={batch_id}: loss={cost!r} "
+                f"max|grad|={grad_absmax!r} (postmortem: "
+                f"{hm.last_postmortem})")
+        return skipped
+
     def _opt_state_for_save(self):
         """Checkpoint view of the optimizer state: with ZeRO-1 active the
         sharded slots are gathered back to their parameters' full shapes,
@@ -911,7 +1300,8 @@ class SGD:
               show_step_breakdown: bool = False,
               zero1: Optional[bool] = None,
               grad_accum_steps: Optional[int] = None,
-              pipeline=None, auto_resume: bool = True):
+              pipeline=None, auto_resume: bool = True,
+              health=None):
         """reader yields minibatches (lists of sample tuples); feeder
         converts them to Arguments (or pass feed dicts directly).
         ``log_period``>0 logs a TrainerStats-style line and dumps+resets the
@@ -968,6 +1358,24 @@ class SGD:
         memory. Like ``zero1``, sticky: ``None`` (default) keeps the
         previously configured value.
 
+        ``health`` arms the training-health plane
+        (``obs/health.py:HealthConfig`` or a kwargs dict; tri-state
+        like ``zero1``: ``None`` keeps, ``False`` disarms). While the
+        telemetry period is armed — explicitly, or implicitly by
+        ``show_parameter_stats_period`` — per-layer param/grad/update/
+        activation stats fold INTO the compiled step every Nth batch
+        (no second forward: the periodic dumps and
+        ``parameter_stats()``/``layer_stats()`` read the in-step
+        values), each step appends to the JSONL event timeline when
+        ``log_path`` is set, and the divergence sentry (finiteness +
+        ``grad_threshold`` on loss/grads, the reference's
+        ``--error_clipping_threshold``) applies its policy on a trip:
+        ``halt`` | ``skip_batch`` (discard the batch's update in-graph
+        and roll the RNG split back — bitwise the run that never saw
+        the batch) | ``dump``; every trip writes a postmortem bundle
+        and a ``train.divergence`` flight event
+        (docs/observability.md, pillar 4).
+
         ``pipeline`` (the reference-spelled ``--parallel_nn`` flag,
         ``Flags.cpp:23`` / ``ParallelNeuralNetwork.h:23-62``) runs the
         config's device-attr-staged body through the GPipe microbatch
@@ -979,6 +1387,10 @@ class SGD:
         the schedule cannot honor warn and stand down cleanly."""
         from paddle_tpu.utils import global_stat, logger, timer
         self._configure_step(zero1, grad_accum_steps, pipeline)
+        self._configure_health(health, show_parameter_stats_period)
+        hm = self._health
+        if hm is not None:
+            hm.open_timeline()
         if async_load_data and getattr(reader, "pass_aware", False):
             # the prefetch worker would advance the master reader's task
             # ledger (finishes, in-flight offset) ahead of training by
@@ -1191,6 +1603,7 @@ class SGD:
                                 feed = feeder(data) if feeder is not None else data
                                 if self.mesh is not None:
                                     feed = mesh_lib.shard_batch(feed, self.mesh)
+                        prev_rng = self._rng  # skip_batch rolls back here
                         self._rng, step_rng = jax.random.split(self._rng)
                         if self._carried is not None:
                             # a batch-size change (e.g. smaller final batch) makes
@@ -1201,21 +1614,69 @@ class SGD:
                                 self._carried)[0].shape[0]
                             if b_carry != b_feed:
                                 self._carried = None
+                        stats_on = self._train_step_stats is not None and (
+                            (batch_id + 1) % self._health_cfg.period == 0
+                            or self._stats_warm_pending)
+                        self._stats_warm_pending = False
+                        poison = None
+                        if hm is not None and self._health_cfg.sentry:
+                            fired = ()
+                            if _chaos._ACTIVE is not None:
+                                # the health plane's own chaos site: a
+                                # `corrupt` fault here poisons one
+                                # gradient leaf IN-GRAPH (the traced
+                                # `poison` scalar), the divergence-
+                                # sentry drill
+                                fired = _chaos._ACTIVE.hit(
+                                    "step_stats", pass_id=pass_id,
+                                    batch_id=batch_id) or ()
+                            poison = jnp.float32(
+                                1.0 if "corrupt" in fired else 0.0)
                         with bd.measure("compute"), timer("trainBatch"):
-                            self.params, self.opt_state, metrics = self._train_step(
-                                self.params, self.opt_state, feed, step_rng,
-                                jnp.int32(pass_id), self._carried)
+                            step_fn = (self._train_step_stats if stats_on
+                                       else self._train_step)
+                            if hm is not None:
+                                self.params, self.opt_state, metrics = \
+                                    step_fn(self.params, self.opt_state,
+                                            feed, step_rng,
+                                            jnp.int32(pass_id),
+                                            self._carried, poison)
+                            else:
+                                self.params, self.opt_state, metrics = \
+                                    step_fn(self.params, self.opt_state,
+                                            feed, step_rng,
+                                            jnp.int32(pass_id),
+                                            self._carried)
                             # a real host fetch: on remote devices
                             # block_until_ready returns before execution finishes
                             cost = float(metrics["cost"])
-                        self.recompile_guard.check()
+                        (self.stats_recompile_guard if stats_on
+                         else self.recompile_guard).check()
                         t_cb = time.perf_counter()
+                        sentry_host = metrics.pop("sentry", None)
+                        health_raw = metrics.pop("health", None)
+                        health_lr = metrics.pop("health_lr", None)
+                        skipped = False
+                        if hm is not None:
+                            skipped = self._health_step(
+                                hm, sentry_host, health_raw, health_lr,
+                                cost, pass_id, batch_id, reader,
+                                prev_rng)
                         if self._carry_layers:
-                            self._carried = metrics.pop("carried")
-                        evals = self._accumulate(acc, metrics)
-                        self._feed_host_evaluators(metrics, feed=feed, rng=step_rng)
-                        window_cost += cost
-                        window_n += 1
+                            carried_new = metrics.pop("carried")
+                            if not skipped:
+                                self._carried = carried_new
+                        if skipped:
+                            # the clean run never saw this batch:
+                            # nothing accumulates, the log window and
+                            # host evaluators stay untouched
+                            evals = acc.result()
+                        else:
+                            evals = self._accumulate(acc, metrics)
+                            self._feed_host_evaluators(metrics, feed=feed,
+                                                       rng=step_rng)
+                            window_cost += cost
+                            window_n += 1
                         if dot_period and (batch_id + 1) % dot_period == 0:
                             print(".", end="", flush=True)
                             dots_pending = True
@@ -1237,7 +1698,8 @@ class SGD:
                             # "Eval:" vs "CurrentEval:" split (TrainerInternal.cpp).
                             logger.info(
                                 "Pass=%d Batch=%d Cost=%.5f AvgEval: %s", pass_id,
-                                batch_id + 1, window_cost / window_n,
+                                batch_id + 1,
+                                window_cost / max(window_n, 1),
                                 " ".join(f"{k}={v:.5g}" for k, v in
                                          {**evals, **self.host_eval_values(
                                              include_printers=False)}.items()))
@@ -1337,6 +1799,12 @@ class SGD:
             unwind_exc = e
             raise
         finally:
+            if self._health is not None:
+                # drain the event timeline's background writer so the
+                # run's JSONL artifact is complete even when the loop
+                # unwinds; the monitor (counters, stat snapshots)
+                # stays armed for the next train()/reader calls
+                self._health.close()
             flush_exc = None
             if checkpointer is not None:
                 try:
@@ -1559,9 +2027,19 @@ class SGD:
 
     def parameter_stats(self) -> Dict[str, Dict[str, float]]:
         """Parameter health dump — per-parameter mean |v| and max |v|
-        (``showParameterStats``, ``TrainerInternal.cpp:186+``). One jitted
-        program for the whole table (per-parameter eager reductions would
-        trigger dozens of tiny compilations)."""
+        (``showParameterStats``, ``TrainerInternal.cpp:186+``). With the
+        in-step telemetry armed (``train(health=...)`` /
+        ``show_parameter_stats_period``) this READS the last fused
+        reduction's snapshot — no extra program runs, and the table
+        additionally carries norm/grad_norm/update_ratio (and sparse
+        touched_rows). The standalone jit below remains only for the
+        stats-off cold path (a dump requested before any armed step)."""
+        hm = self._health
+        if hm is not None and hm.param_stats is not None:
+            # a COPY: the monitor's dict is also queued for timeline
+            # serialization — a caller reformatting the returned rows
+            # must not corrupt the JSONL record behind it
+            return {n: dict(d) for n, d in hm.param_stats.items()}
         raw = jax.device_get(_param_stats_jit(self.params))
         _param_stats_guard.check()
         return {n: {"avg_abs": float(a), "max_abs": float(m),
@@ -1570,8 +2048,15 @@ class SGD:
 
     def layer_stats(self, feed) -> Dict[str, Dict[str, float]]:
         """Per-layer output stats on one batch (``--show_layer_stat``,
-        ``Flags.cpp:71``): a jitted full-graph forward that returns every
-        layer's mean |out| and max |out| (compiled once, cached)."""
+        ``Flags.cpp:71``). With the in-step telemetry armed this READS
+        the last stats-on step's activation snapshot (the fused
+        reduction already saw the executed forward — no second
+        forward); the jitted standalone forward below remains only for
+        the stats-off cold path (compiled once, cached)."""
+        hm = self._health
+        if hm is not None and hm.act_stats is not None:
+            # same copy rationale as parameter_stats above
+            return {n: dict(d) for n, d in hm.act_stats.items()}
         if not hasattr(self, "_layer_stat_fn"):
             # the EXECUTED subgraph only (self.network): off-path layers
             # have no parameters in self.params and possibly no feeds.
@@ -1584,19 +2069,8 @@ class SGD:
             def stat_fn(params, feed):
                 outs = net.apply(self._cast_compute(params),
                                  self._cast_compute(feed), train=False)
-
-                def stats(a):
-                    v = jnp.abs(a.value)
-                    if a.mask is not None and v.ndim >= 2 \
-                            and a.mask.shape == v.shape[:a.mask.ndim]:
-                        m = a.mask.reshape(
-                            a.mask.shape + (1,) * (v.ndim - a.mask.ndim))
-                        n = jnp.maximum(jnp.sum(m), 1.0) * (
-                            v.size / max(1, m.size))
-                        return (jnp.sum(v * m) / n, jnp.max(v * m))
-                    return jnp.mean(v), jnp.max(v)
-
-                return {n: stats(a) for n, a in outs.items()
+                return {n: _arg_abs_stats(a)[:2]
+                        for n, a in outs.items()
                         if hasattr(a.value, "dtype")
                         and jnp.issubdtype(a.value.dtype, jnp.inexact)}
 
@@ -1616,6 +2090,36 @@ class SGD:
         if output_names is None:
             return outputs
         return {n: outputs[n] for n in output_names}
+
+
+def _arg_abs_stats(a):
+    """(avg |out|, max |out|) of one layer output Argument — mask-aware
+    (padded positions excluded from both). Shared by the standalone
+    ``layer_stats`` jit and the in-step telemetry's fused activation
+    reduction (``SGD._act_stat_table``), so both paths report the same
+    numbers. Reduces the contiguous trailing feature axes FIRST (a
+    vectorizable row reduce, ~2x the throughput of XLA:CPU's
+    whole-tensor reduce on the big [B, T, H] sequences) and applies
+    the mask to the [B, T] partials — masked positions contribute 0
+    to the sum and are max'd against 0 exactly as the elementwise
+    form did (|out| >= 0).
+
+    Returns ``(avg_abs, max_abs, weight)`` — the weight is the live
+    element count the avg divided by, so a consumer combining PARTIAL
+    batches (the grad-accum microbatch scan) can reweight the avgs
+    into the exact whole-batch masked mean instead of a biased mean
+    of means."""
+    v = jnp.abs(a.value)
+    if a.mask is not None and v.ndim >= 2 \
+            and a.mask.shape == v.shape[:a.mask.ndim]:
+        feat_axes = tuple(range(a.mask.ndim, v.ndim))
+        s = jnp.sum(v, axis=feat_axes) if feat_axes else v
+        mx = jnp.max(v, axis=feat_axes) if feat_axes else v
+        n = jnp.maximum(jnp.sum(a.mask), 1.0) * (
+            v.size / max(1, a.mask.size))
+        return (jnp.sum(s * a.mask) / n, jnp.max(mx * a.mask), n)
+    return (jnp.mean(v), jnp.max(v),
+            jnp.asarray(float(v.size), jnp.float32))
 
 
 @jax.jit

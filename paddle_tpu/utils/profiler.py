@@ -196,6 +196,10 @@ class StepBreakdown:
         self.steps = 0
         self.wall = 0.0  # true per-step wall time, when the caller times it
         self.totals = {p: 0.0 for p in self.PARTS}
+        # most recent single measurement per part: the health plane's
+        # per-step timeline reads {data_wait, compute} from here
+        # without having to delta the cumulative totals
+        self.last = {p: 0.0 for p in self.PARTS}
         # set by SGD.enable_pipeline; reset() survives it (a pass reset
         # must not silently drop the schedule identity from summaries)
         if not hasattr(self, "pipeline"):
@@ -209,6 +213,7 @@ class StepBreakdown:
 
     def add(self, part: str, seconds: float):
         self.totals[part] += seconds
+        self.last[part] = seconds
         self.registry.get(f"step/{part}").add(seconds)
 
     @contextmanager

@@ -1883,6 +1883,48 @@ def test_schema_check_scans_mem_pattern(tmp_path):
     assert [f.path for f in findings] == ["MEM_r15.json"]
 
 
+# --------------------------------------------- PT401 health timelines
+def test_pt401_health_artifact_shape(tmp_path):
+    """The HEALTH_* family (training-health timelines): non-empty
+    monotone step events, each with a finite numeric loss — the good
+    twin validates, and each defect fires with its own message."""
+    good = {"run": "bench-r16", "period": 1, "sentry_trips": 0,
+            "events": [
+                {"step": 0, "loss": 1.25, "lr": 0.001},
+                {"step": 1, "loss": 1.19,
+                 "param_stats": {"w": {"norm": 3.0}}},
+            ]}
+    p = tmp_path / "HEALTH_r16.json"
+    p.write_text(json.dumps(good))
+    assert check_bench_file(str(p), "HEALTH_r16.json") == []
+    # empty events recorded nothing
+    p.write_text(json.dumps({"run": "x", "period": 1, "events": []}))
+    findings = check_bench_file(str(p), "HEALTH_r16.json")
+    assert [f.rule for f in findings] == ["PT401"]
+    assert "non-empty 'events'" in findings[0].message
+    # shuffled steps, missing loss, missing run/period
+    bad = {"events": [{"step": 3, "loss": 1.0}, {"step": 1}]}
+    p.write_text(json.dumps(bad))
+    findings = check_bench_file(str(p), "HEALTH_r16.json")
+    assert findings and all(f.rule == "PT401" for f in findings)
+    assert any("monotone step order" in f.message for f in findings)
+    assert any("'loss'" in f.message for f in findings)
+    assert any("'run'" in f.message for f in findings)
+    assert any("'period'" in f.message for f in findings)
+    # a NaN loss rejects via the shared finite-number walk
+    p.write_text('{"run": "x", "period": 0, '
+                 '"events": [{"step": 0, "loss": NaN}]}')
+    findings = check_bench_file(str(p), "HEALTH_r16.json")
+    assert any("non-finite" in f.message for f in findings)
+
+
+def test_schema_check_scans_health_pattern(tmp_path):
+    from paddle_tpu.analysis.bench_schema import run_schema_check
+    (tmp_path / "HEALTH_r16.json").write_text("{broken")
+    findings = run_schema_check(str(tmp_path))
+    assert [f.path for f in findings] == ["HEALTH_r16.json"]
+
+
 def test_json_output_carries_pass5_fields(tmp_path, capsys):
     """The --json contract grew pass5_s and mem_manifest; when pass 5
     is skipped both are null (the keys are always present so CI

@@ -94,3 +94,71 @@ def test_checkgrad_eps_reaches_checkgrad():
     from paddle_tpu.trainer import cli
     args = cli.parse_args(["--config", "x.py", "--checkgrad_eps", "5e-3"])
     assert args.checkgrad_eps == pytest.approx(5e-3)
+
+
+# ------------------------------------- training-health flags (T-rows)
+def _t_rows():
+    rows = []
+    for line in DOC.read_text().splitlines():
+        m = re.match(r"\|\s*T(\d+)\s*\|\s*`--([a-z_]+)`\s*\|\s*"
+                     r"\*{0,2}(spelled|absorbed|N/A-on-TPU)", line)
+        if m:
+            rows.append((int(m.group(1)), m.group(2), m.group(3)))
+    return rows
+
+
+def test_training_health_table_is_machine_mapped():
+    """The round-14 supplementary table: the three reference
+    training-health flags are present, spelled, and parse through the
+    CLI — docs and parser cannot drift apart (same contract as the
+    24-row core audit)."""
+    rows = _t_rows()
+    names = [name for _, name, _ in rows]
+    assert names == ["show_parameter_stats_period", "log_error_clipping",
+                     "error_clipping_threshold"]
+    assert all(st == "spelled" for _, _, st in rows)
+    from paddle_tpu.trainer import cli
+    args = cli.parse_args([
+        "--config", "x.py",
+        "--show_parameter_stats_period", "5",
+        "--log_error_clipping",
+        "--error_clipping_threshold", "25.0",
+        "--divergence_policy", "halt",
+        "--health_log", "/tmp/h.jsonl"])
+    assert args.show_parameter_stats_period == 5
+    assert args.log_error_clipping is True
+    assert args.error_clipping_threshold == pytest.approx(25.0)
+    assert args.divergence_policy == "halt"
+    assert args.health_log == "/tmp/h.jsonl"
+
+
+def test_error_clipping_threshold_reaches_the_sentry():
+    """--error_clipping_threshold is not parse-and-drop: through the
+    trainer it arms the divergence sentry with that threshold and an
+    over-threshold gradient trips it (reference error-clipping
+    semantics under --divergence_policy)."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.config import dsl
+    from paddle_tpu.core.argument import Argument
+    from paddle_tpu.optim import Momentum
+    from paddle_tpu.trainer import SGD
+
+    dsl.reset()
+    x = dsl.data(name="x", size=8)
+    lbl = dsl.data(name="label", size=2)
+    h = dsl.fc(input=x, size=8, act="tanh")
+    out = dsl.fc(input=h, size=2, act="softmax", name="out")
+    cost = dsl.classification_cost(input=out, label=lbl)
+    trainer = SGD(cost=cost, update_equation=Momentum(learning_rate=0.1))
+    rng = np.random.RandomState(0)
+    feed = {"x": Argument(value=jnp.asarray(
+        rng.randn(8, 8).astype(np.float32))),
+        "label": Argument(value=jnp.asarray(
+            rng.randint(0, 2, 8).astype(np.int32)))}
+    # the CLI's health dict, as cmd_train builds it from the flags
+    trainer.train(lambda: iter([feed]), num_passes=1,
+                  health={"sentry": True, "grad_threshold": 1e-9,
+                          "policy": "dump", "log_clipping": True})
+    assert trainer._health_cfg.grad_threshold == pytest.approx(1e-9)
+    assert trainer._health.snapshot()["sentry_trips"] == 1

@@ -1,0 +1,471 @@
+"""Training-health plane (ISSUE 14 tentpole): in-step telemetry, the
+scalar event timeline, and the divergence sentry with flight-recorder
+postmortems.
+
+Covers the pillar-4 contracts:
+
+- the in-step fused reduction feeds ``parameter_stats()`` /
+  ``layer_stats()`` with NO second forward (the standalone jits stay
+  cold while armed);
+- the chaos ``step_stats`` corrupt trigger poisons one gradient leaf,
+  the sentry trips WITHIN that step, ``skip_batch`` leaves the
+  post-skip trajectory bitwise equal to a run that never saw the
+  poisoned batch, and the postmortem reproduces from the plan seed;
+- ``halt`` raises after the bundle is durable; ``dump`` keeps going;
+- the timeline JSONL, ``tools/healthview.py`` render/diff, the
+  ``train.divergence`` flight event and the ``tools/blackbox.py``
+  merged ordering;
+- the metrics-registry provider (the ``--metrics_port`` surface).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.config import dsl
+from paddle_tpu.core.argument import Argument
+from paddle_tpu.obs import flight
+from paddle_tpu.obs.events import EventLog, load_timeline
+from paddle_tpu.obs.health import (DivergenceError, HealthConfig,
+                                   HealthMonitor)
+from paddle_tpu.optim import Adam
+from paddle_tpu.testing.chaos import FaultPlan, chaos_plan
+from paddle_tpu.trainer import SGD
+
+WIDTH, CLASSES, B, BATCHES = 8, 3, 16, 4
+
+
+def _build(seed=5):
+    dsl.reset()
+    x = dsl.data(name="x", size=WIDTH)
+    lbl = dsl.data(name="label", size=CLASSES)
+    h = dsl.fc(input=x, size=WIDTH, act="tanh", name="h0")
+    out = dsl.fc(input=h, size=CLASSES, act="softmax", name="out")
+    cost = dsl.classification_cost(input=out, label=lbl)
+    return SGD(cost=cost, update_equation=Adam(learning_rate=3e-3),
+               seed=seed)
+
+
+def _data():
+    rng = np.random.RandomState(11)
+    X = rng.randn(BATCHES * B, WIDTH).astype(np.float32)
+    W = rng.randn(WIDTH, CLASSES)
+    Y = np.argmax(X @ W, axis=1).astype(np.int32)
+    return X, Y
+
+
+def _batch(X, Y, i):
+    return {"x": Argument(value=jnp.asarray(X[i * B:(i + 1) * B])),
+            "label": Argument(value=jnp.asarray(Y[i * B:(i + 1) * B]))}
+
+
+def _reader(X, Y, skip=None):
+    """skip: {pass_n: {batch_i, ...}} batches to withhold (the
+    'never saw the poisoned batch' twin)."""
+    passes = {"n": -1}
+
+    def reader():
+        passes["n"] += 1
+        for i in range(BATCHES):
+            if skip and i in skip.get(passes["n"], ()):
+                continue
+            yield _batch(X, Y, i)
+
+    return reader
+
+
+def _state(tr):
+    from paddle_tpu.trainer.checkpoint import _flatten
+    params = {k: np.asarray(jax.device_get(v))
+              for k, v in tr._params_for_save().items()}
+    opt = _flatten(tr._opt_state_for_save())
+    return params, opt, np.asarray(jax.device_get(tr._rng))
+
+
+# ------------------------------------------------------------ EventLog
+def test_event_log_is_bounded_background_and_readable(tmp_path):
+    p = str(tmp_path / "run.jsonl")
+    log = EventLog(p, service="t", capacity=4, flush_every=2)
+    for i in range(3):
+        assert log.append({"event": "step", "step": i, "loss": 0.5})
+    log.flush()
+    log.close()
+    # append after close is a counted drop, not an error
+    assert not log.append({"event": "step", "step": 9})
+    assert log.dropped == 1
+    rows = load_timeline(p)
+    assert [r["step"] for r in rows] == [0, 1, 2]
+    assert all(r["service"] == "t" and "ts" in r and "seq" in r
+               for r in rows)
+    snap = log.snapshot()
+    assert snap["appended"] == 3 and snap["written"] == 3
+    assert snap["closed"] is True
+
+
+def test_event_log_tolerates_torn_tail(tmp_path):
+    p = tmp_path / "run.jsonl"
+    p.write_text('{"event": "step", "step": 0, "loss": 1.0}\n'
+                 '{"event": "step", "st')  # died mid-write
+    rows = load_timeline(str(p))
+    assert len(rows) == 1 and rows[0]["step"] == 0
+
+
+def test_health_config_validates():
+    with pytest.raises(ValueError):
+        HealthConfig(policy="explode")
+    with pytest.raises(ValueError):
+        HealthConfig(period=-1)
+    assert not HealthConfig().armed
+    assert HealthConfig(period=3).armed
+    assert HealthConfig(sentry=True).armed
+    assert HealthConfig.coerce({"period": 2}).period == 2
+
+
+# --------------------------------------------- in-step telemetry dedupe
+def test_in_step_telemetry_feeds_stat_readers_without_second_forward():
+    X, Y = _data()
+    tr = _build()
+    tr.train(_reader(X, Y), num_passes=1,
+             health={"period": 1, "sentry": True})
+    # parameter_stats is a READER of the fused reduction: the richer
+    # schema proves it (the standalone jit knows no grad_norm)
+    ps = tr.parameter_stats()
+    row = next(iter(ps.values()))
+    assert {"avg_abs", "max_abs", "size", "norm", "grad_norm",
+            "update_ratio"} <= set(row)
+    # layer_stats likewise reads the in-step activation snapshot — the
+    # standalone full-graph forward was never even built
+    ls = tr.layer_stats(None)
+    assert "out" in ls and {"avg_abs", "max_abs"} <= set(ls["out"])
+    assert not hasattr(tr, "_layer_stat_fn")
+    assert all(np.isfinite(list(d.values())).all() for d in ls.values())
+    # both program variants warmed exactly once, zero hot-path growth
+    assert (tr.stats_recompile_guard.count or 0) <= 1
+    snap = tr._health.snapshot()
+    assert snap["steps"] == BATCHES and snap["sentry_trips"] == 0
+
+
+def test_in_step_param_stats_match_numpy_on_first_step():
+    """The stats-on variant reduces the PRE-update params of its step:
+    one armed batch => the snapshot is the init params' stats."""
+    X, Y = _data()
+    tr = _build()
+    init = {k: np.asarray(jax.device_get(v))
+            for k, v in tr.params.items()}
+    tr.train(lambda: iter([_batch(X, Y, 0)]), num_passes=1,
+             health={"period": 1})
+    ps = tr.parameter_stats()
+    for name, row in ps.items():
+        v = init[name]
+        np.testing.assert_allclose(row["avg_abs"],
+                                   np.mean(np.abs(v)), rtol=1e-5)
+        np.testing.assert_allclose(row["max_abs"],
+                                   np.max(np.abs(v)), rtol=1e-6)
+        np.testing.assert_allclose(
+            row["norm"], np.sqrt(np.sum(np.square(v))), rtol=1e-5)
+        assert row["size"] == v.size
+        assert row["update_ratio"] >= 0.0
+
+
+def test_show_parameter_stats_period_arms_the_telemetry():
+    """The dedupe flag path: a bare show_parameter_stats_period arms
+    the in-step reduction (no explicit health config needed)."""
+    X, Y = _data()
+    tr = _build()
+    tr.train(_reader(X, Y), num_passes=1, show_parameter_stats_period=2)
+    assert tr._health_cfg is not None and tr._health_cfg.period == 2
+    assert tr._health.param_stats is not None
+    assert tr._train_step_stats is not None
+
+
+def test_event_log_flush_means_on_disk(tmp_path):
+    """flush() waits on the WRITTEN counter, not an empty queue: a
+    reader opening the file right after flush() sees every appended
+    record even while the writer thread holds a popped batch."""
+    p = str(tmp_path / "run.jsonl")
+    log = EventLog(p, service="t", flush_every=8)
+    for i in range(50):
+        log.append({"event": "step", "step": i, "loss": 0.0})
+    log.flush()
+    assert len(load_timeline(p)) == 50
+    log.close()
+
+
+def test_host_only_config_tweaks_keep_monitor_and_programs(tmp_path):
+    """A log_path (or other host-only) change between train() calls
+    must neither recompile the warmed variants nor zero the monitor's
+    counters — one training session, one story."""
+    X, Y = _data()
+    tr = _build()
+    tr.train(_reader(X, Y), num_passes=1,
+             health={"period": 1, "sentry": True,
+                     "log_path": str(tmp_path / "a.jsonl")})
+    hm = tr._health
+    step_fn = tr._train_step_stats
+    n_before = tr.stats_recompile_guard.count
+    tr.train(_reader(X, Y), num_passes=1,
+             health={"period": 1, "sentry": True,
+                     "log_path": str(tmp_path / "b.jsonl")})
+    assert tr._health is hm  # counters survived
+    assert tr._health.snapshot()["steps"] == 2 * BATCHES
+    assert tr._train_step_stats is step_fn  # no rebuild
+    assert tr.stats_recompile_guard.count == n_before  # no recompile
+    # both run files exist with their own records
+    assert load_timeline(str(tmp_path / "a.jsonl"))
+    assert load_timeline(str(tmp_path / "b.jsonl"))
+    # a graph-affecting change (policy) DOES rebuild
+    tr.train(_reader(X, Y), num_passes=1,
+             health={"period": 1, "sentry": True, "policy": "dump"})
+    assert tr._train_step_stats is not step_fn
+
+
+def test_accum_act_stats_reweight_uneven_masks():
+    """Grad-accum act stats combine per-microbatch masked means by
+    LIVE-ELEMENT WEIGHT: with sequence masks landing unevenly across
+    the microbatches, the fused avg must equal the whole-batch masked
+    mean the standalone layer_stats forward computes (a plain
+    mean-of-means would bias toward the sparser microbatch)."""
+    from paddle_tpu.optim import Momentum
+    T = 6
+
+    def build():
+        dsl.reset()
+        x = dsl.data(name="x", size=WIDTH, is_sequence=True)
+        lbl = dsl.data(name="label", size=CLASSES)
+        r = dsl.lstmemory(input=x, name="lstm")
+        pooled = dsl.last_seq(r)
+        out = dsl.fc(input=pooled, size=CLASSES, act="softmax")
+        cost = dsl.classification_cost(input=out, label=lbl)
+        return SGD(cost=cost,
+                   update_equation=Momentum(learning_rate=0.05), seed=3)
+
+    rng = np.random.RandomState(5)
+    X = rng.randn(B, T, WIDTH).astype(np.float32)
+    Y = rng.randint(0, CLASSES, size=B).astype(np.int32)
+    # first half: full-length rows; second half: 2 live steps — with
+    # grad_accum_steps=2 each microbatch sees a very different mask
+    M = np.ones((B, T), np.float32)
+    M[B // 2:, 2:] = 0.0
+    feed = {"x": Argument(value=jnp.asarray(X), mask=jnp.asarray(M)),
+            "label": Argument(value=jnp.asarray(Y))}
+
+    armed = build()
+    armed.train(lambda: iter([feed]), num_passes=1, grad_accum_steps=2,
+                health={"period": 1})
+    fused = armed.layer_stats(None)
+
+    clean = build()
+    want = clean.layer_stats(feed)  # the standalone whole-batch jit
+    for name, row in want.items():
+        np.testing.assert_allclose(
+            fused[name]["avg_abs"], row["avg_abs"], rtol=1e-5,
+            err_msg=f"avg_abs of {name}")
+        np.testing.assert_allclose(
+            fused[name]["max_abs"], row["max_abs"], rtol=1e-6,
+            err_msg=f"max_abs of {name}")
+
+
+# -------------------------------------------------------- the timeline
+def test_timeline_records_steps_and_healthview_renders(tmp_path):
+    from tools import healthview
+    p = str(tmp_path / "run.jsonl")
+    X, Y = _data()
+    tr = _build()
+    tr.train(_reader(X, Y), num_passes=2,
+             health={"period": 2, "sentry": True, "log_path": p})
+    rows = load_timeline(p)
+    steps = [r for r in rows if r.get("event") == "step"]
+    assert len(steps) == 2 * BATCHES
+    assert [r["step"] for r in steps] == list(range(2 * BATCHES))
+    assert all(np.isfinite(r["loss"]) for r in steps)
+    assert all("lr" in r and "data_wait_ms" in r and "compute_ms" in r
+               for r in steps)
+    # period steps carry the per-layer dicts (plus the batch-0 warm)
+    with_stats = [r for r in steps if "param_stats" in r]
+    assert len(with_stats) == BATCHES + 1
+    meta, events = healthview.load(p)
+    text = healthview.format_run(meta, events)
+    assert "loss" in text and str(len(steps) - 1) in text
+    d = healthview.diff(events, events)
+    assert d["first_diverging_step"] is None
+    assert d["compared"] == len(steps)
+
+
+def test_healthview_diff_finds_first_divergence():
+    from tools import healthview
+    a = [{"event": "step", "step": i, "loss": 1.0 - 0.1 * i}
+         for i in range(5)]
+    b = [dict(r) for r in a]
+    b[3]["loss"] += 0.25
+    d = healthview.diff(a, b)
+    assert d["first_diverging_step"] == 3
+    assert d["max_abs_delta"] == pytest.approx(0.25)
+
+
+# ------------------------------------------------- the divergence drill
+SENTRY = {"period": 1, "sentry": True, "policy": "skip_batch"}
+# corrupt the 2nd armed step => pass 0, batch 1 gets the NaN gradient
+POISON_PLAN = [{"type": "corrupt", "site": "step_stats", "at": 2}]
+
+
+@pytest.mark.chaos
+def test_chaos_poison_trips_sentry_and_skip_matches_clean_run(tmp_path):
+    X, Y = _data()
+    os.environ["PADDLE_TPU_FLIGHT_DIR"] = str(tmp_path)
+    rec = flight.install(flight.FlightRecorder("train"))
+    try:
+        a = _build()
+        with chaos_plan(FaultPlan(seed=0, faults=POISON_PLAN)) as plan:
+            a.train(_reader(X, Y), num_passes=2, health=SENTRY)
+        assert plan.hits("step_stats") == 2 * BATCHES
+        assert plan.log == [("step_stats", 2, "corrupt")]
+        snap = a._health.snapshot()
+        # tripped WITHIN the poisoned step, skipped exactly once
+        assert snap["sentry_trips"] == 1
+        assert snap["skipped_batches"] == 1
+        # the flight event + the postmortem bundle exist
+        fired = rec.events("train.divergence")
+        assert len(fired) == 1 and fired[0]["pass_id"] == 0 \
+            and fired[0]["batch_id"] == 1
+        bundle = json.load(open(a._health.last_postmortem))
+        assert bundle["schema"] == "train.divergence.postmortem"
+        assert bundle["pass_id"] == 0 and bundle["batch_id"] == 1
+        assert not np.isfinite(bundle["grad_absmax"])
+        assert bundle["worst_layer"] in bundle["layer_grad_absmax"]
+        assert bundle["policy"] == "skip_batch"
+        assert isinstance(bundle["rng"], list) and bundle["rng"]
+        assert bundle["param_stats"] is not None
+    finally:
+        flight.install(None)
+        del os.environ["PADDLE_TPU_FLIGHT_DIR"]
+
+    # the twin that NEVER saw pass-0 batch 1: bitwise identical
+    b = _build()
+    b.train(_reader(X, Y, skip={0: {1}}), num_passes=2, health=SENTRY)
+    pa, oa, ra = _state(a)
+    pb, ob, rb = _state(b)
+    for k in pa:
+        np.testing.assert_array_equal(pa[k], pb[k], err_msg=k)
+    for k in oa:
+        np.testing.assert_array_equal(oa[k], ob[k], err_msg=k)
+    np.testing.assert_array_equal(ra, rb)
+
+
+@pytest.mark.chaos
+def test_postmortem_reproduces_from_the_seed(tmp_path):
+    """Same plan seed, fresh process state => the SAME postmortem
+    (modulo wall-clock/pid): the bundle is evidence, not luck."""
+    X, Y = _data()
+    volatile = ("ts", "pid", "ledger")
+
+    def run(sub):
+        d = tmp_path / sub
+        d.mkdir()
+        os.environ["PADDLE_TPU_FLIGHT_DIR"] = str(d)
+        try:
+            tr = _build()
+            with chaos_plan(FaultPlan(seed=0, faults=POISON_PLAN)):
+                tr.train(_reader(X, Y), num_passes=1, health=SENTRY)
+            bundle = json.load(open(tr._health.last_postmortem))
+        finally:
+            del os.environ["PADDLE_TPU_FLIGHT_DIR"]
+        return {k: v for k, v in bundle.items() if k not in volatile}
+
+    first, second = run("a"), run("b")
+    assert first == second
+    assert first["step"] == 1 and first["batch_id"] == 1
+
+
+@pytest.mark.chaos
+def test_blackbox_merges_postmortem_into_ordered_timeline(tmp_path):
+    from tools import blackbox
+    X, Y = _data()
+    os.environ["PADDLE_TPU_FLIGHT_DIR"] = str(tmp_path)
+    rec = flight.install(flight.FlightRecorder("train"))
+    try:
+        tr = _build()
+        with chaos_plan(FaultPlan(seed=0, faults=POISON_PLAN)):
+            tr.train(_reader(X, Y), num_passes=1, health=SENTRY)
+        rec.dump_jsonl()
+    finally:
+        flight.install(None)
+        del os.environ["PADDLE_TPU_FLIGHT_DIR"]
+    events = blackbox.merge_dir(str(tmp_path))
+    names = [e["event"] for e in events]
+    # chaos_fire precedes the divergence it caused; the postmortem
+    # bundle rides the same ordered timeline
+    assert "chaos_fire" in names and "train.divergence" in names
+    assert "train.divergence.postmortem" in names
+    assert names.index("chaos_fire") < names.index("train.divergence")
+    pm = events[names.index("train.divergence.postmortem")]
+    assert pm["batch_id"] == 1 and pm["bundle"].startswith("postmortem-")
+    text = blackbox.format_timeline(events)
+    assert "train.divergence" in text
+
+
+@pytest.mark.chaos
+def test_halt_policy_raises_after_postmortem(tmp_path):
+    X, Y = _data()
+    os.environ["PADDLE_TPU_FLIGHT_DIR"] = str(tmp_path)
+    try:
+        tr = _build()
+        cfg = dict(SENTRY, policy="halt")
+        with chaos_plan(FaultPlan(seed=0, faults=POISON_PLAN)):
+            with pytest.raises(DivergenceError):
+                tr.train(_reader(X, Y), num_passes=2, health=cfg)
+        assert tr._health.last_postmortem is not None
+        assert os.path.exists(tr._health.last_postmortem)
+        assert tr._health.snapshot()["steps"] == 2  # stopped at batch 1
+    finally:
+        del os.environ["PADDLE_TPU_FLIGHT_DIR"]
+
+
+@pytest.mark.chaos
+def test_dump_policy_keeps_training(tmp_path):
+    X, Y = _data()
+    tr = _build()
+    mon_dir = str(tmp_path)
+    cfg = dict(SENTRY, policy="dump")
+    with chaos_plan(FaultPlan(seed=0, faults=POISON_PLAN)):
+        tr.train(_reader(X, Y), num_passes=1, health=cfg)
+    # postmortem dir unset and no flight dir: the bundle is skipped
+    # quietly, training continued — and because dump APPLIES the
+    # poisoned update, every step after the poison trips too (the
+    # policy observes divergence, it does not undo it)
+    snap = tr._health.snapshot()
+    assert snap["sentry_trips"] == BATCHES - 1
+    assert snap["skipped_batches"] == 0
+    assert snap["steps"] == BATCHES
+    assert mon_dir  # tmp_path unused by design: dump != write-required
+
+
+def test_sentry_grad_threshold_trips_without_nan():
+    """The reference error_clipping_threshold semantics: a finite but
+    over-threshold gradient trips the sentry too."""
+    X, Y = _data()
+    tr = _build()
+    tr.train(_reader(X, Y), num_passes=1,
+             health={"sentry": True, "grad_threshold": 1e-9,
+                     "policy": "dump"})
+    assert tr._health.snapshot()["sentry_trips"] == BATCHES
+
+
+# ------------------------------------------------------- registry wire
+def test_health_snapshot_federates_through_metrics_registry():
+    from paddle_tpu.obs import MetricsRegistry
+    X, Y = _data()
+    tr = _build()
+    tr.train(_reader(X, Y), num_passes=1,
+             health={"period": 1, "sentry": True})
+    reg = MetricsRegistry().register("health", tr._health.snapshot)
+    snap = reg.snapshot()["health"]
+    assert snap["armed"] is True and snap["steps"] == BATCHES
+    assert snap["last_step"]["loss"] is not None
+    prom = reg.to_prometheus()
+    assert "paddle_tpu_health_steps" in prom
+    assert "paddle_tpu_health_sentry_trips" in prom

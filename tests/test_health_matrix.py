@@ -1,0 +1,161 @@
+"""Bitwise neutrality of the in-step telemetry: stats-on training IS
+stats-off training, across every step-composition feature.
+
+The tentpole claim of the training-health plane (ISSUE 14): folding
+the per-layer stat reduction + divergence sentry INTO the compiled
+train step must not change a single trained bit — the stat reductions
+read ``optimization_barrier``-fenced views so XLA cannot refuse the
+update path's original fusion/rounding. Closure-enforced matrix (the
+``test_exact_resume_matrix`` pattern): every step-composition feature
+— {zero1, pipeline, grad_accum, async_input} — appears in at least one
+cell, at least one cell composes several, and every cell asserts
+zero hot-path recompiles through the hardened guards (each pinned
+program variant compiles exactly once).
+
+``period=2`` on purpose: the run alternates the stats-on and
+stats-off program variants mid-stream (warm on batch 0, stats on every
+2nd batch), so the equality also proves the VARIANT SWITCH itself is
+trajectory-neutral — the production shape of
+``--show_parameter_stats_period``.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.config import dsl
+from paddle_tpu.core.argument import Argument
+from paddle_tpu.optim import Adam
+from paddle_tpu.parallel import create_mesh
+from paddle_tpu.trainer import SGD
+
+WIDTH, CLASSES, B = 8, 3, 16
+BATCHES, PASSES = 4, 2
+
+# cell -> {features}; the closure vocabulary matches the resume matrix
+MATRIX = {
+    "baseline": set(),
+    "zero1": {"zero1"},
+    "grad_accum": {"grad_accum"},
+    "async_input": {"async_input"},
+    "pipeline": {"pipeline"},
+    "zero1_grad_accum_async": {"zero1", "grad_accum", "async_input"},
+}
+REQUIRED_FEATURES = {"zero1", "pipeline", "grad_accum", "async_input"}
+
+HEALTH = {"period": 2, "sentry": True, "policy": "skip_batch"}
+
+
+def test_matrix_closure():
+    seen = set().union(*MATRIX.values())
+    missing = REQUIRED_FEATURES - seen
+    assert not missing, f"health matrix lost coverage for {missing}"
+    assert any(len(f) >= 2 for f in MATRIX.values()), \
+        "need at least one composed cell"
+
+
+def _build(features, seed=5):
+    dsl.reset()
+    x = dsl.data(name="x", size=WIDTH)
+    lbl = dsl.data(name="label", size=CLASSES)
+    if "pipeline" in features:
+        h = dsl.fc(input=x, size=WIDTH, act="tanh", name="blk0_0",
+                   layer_attr={"device": 0})
+        h = dsl.fc(input=h, size=WIDTH, act="tanh", name="blk1_0",
+                   layer_attr={"device": 1})
+        mesh = create_mesh(n_data=2, n_pipe=2)
+    else:
+        h = dsl.fc(input=x, size=WIDTH, act="tanh")
+        h = dsl.dropout(input=h, rate=0.25)
+        mesh = create_mesh(n_data=2) if "zero1" in features else None
+    out = dsl.fc(input=h, size=CLASSES, act="softmax", name="out")
+    cost = dsl.classification_cost(input=out, label=lbl)
+    return SGD(cost=cost, update_equation=Adam(learning_rate=3e-3),
+               mesh=mesh, seed=seed)
+
+
+def _reader():
+    rng = np.random.RandomState(11)
+    X = rng.randn(BATCHES * B, WIDTH).astype(np.float32)
+    W = rng.randn(WIDTH, CLASSES)
+    Y = np.argmax(X @ W, axis=1).astype(np.int32)
+
+    def reader():
+        for i in range(0, BATCHES * B, B):
+            yield {"x": Argument(value=jnp.asarray(X[i:i + B])),
+                   "label": Argument(value=jnp.asarray(Y[i:i + B]))}
+
+    return reader
+
+
+def _train_kwargs(features):
+    kw = {}
+    if "zero1" in features:
+        kw["zero1"] = True
+    if "grad_accum" in features:
+        kw["grad_accum_steps"] = 2
+    if "async_input" in features:
+        kw["async_load_data"] = True
+    if "pipeline" in features:
+        kw["pipeline"] = True
+    return kw
+
+
+def _final_state(tr):
+    from paddle_tpu.trainer.checkpoint import _flatten
+    params = {k: np.asarray(jax.device_get(v))
+              for k, v in tr._params_for_save().items()}
+    opt = _flatten(tr._opt_state_for_save())
+    return params, opt, np.asarray(jax.device_get(tr._rng))
+
+
+@pytest.mark.parametrize("cell", sorted(MATRIX), ids=sorted(MATRIX))
+def test_stats_on_is_bitwise_stats_off(cell):
+    features = MATRIX[cell]
+    kw = _train_kwargs(features)
+    reader = _reader()
+
+    # both sides train as two one-pass calls so the armed side can
+    # HARDEN its guards between warm and steady state (below)
+    off = _build(features)
+    for _ in range(PASSES):
+        off.train(reader, num_passes=1, **kw)
+    want_params, want_opt, want_rng = _final_state(off)
+    assert off._train_step_stats is None  # really the stats-off path
+
+    on = _build(features)
+    on.train(reader, num_passes=1, health=HEALTH, **kw)
+    # zero hot-path recompiles, the hardened form: freeze both pinned
+    # variants' cache sizes after the warm pass — ANY later growth
+    # raises RecompileError inside the loop's check()
+    on.recompile_guard.harden()
+    on.stats_recompile_guard.harden()
+    on.train(reader, num_passes=1, **kw)  # health sticky (None keeps)
+    got_params, got_opt, got_rng = _final_state(on)
+
+    assert set(got_params) == set(want_params)
+    for k in want_params:
+        np.testing.assert_array_equal(got_params[k], want_params[k],
+                                      err_msg=f"param {k} ({cell})")
+    assert set(got_opt) == set(want_opt)
+    for k in want_opt:
+        np.testing.assert_array_equal(got_opt[k], want_opt[k],
+                                      err_msg=f"opt {k} ({cell})")
+    np.testing.assert_array_equal(got_rng, want_rng)
+
+    # the telemetry really ran (snapshot present, nothing tripped) ...
+    snap = on._health.snapshot()
+    assert snap["steps"] == BATCHES * PASSES
+    assert snap["sentry_trips"] == 0
+    assert on._health.param_stats is not None
+    # ... and the telemetry added exactly ONE program beyond the
+    # stats-off run's own variant count (the pipeline step legitimately
+    # traces twice while input shardings settle — on both sides)
+    off_n = off.recompile_guard.count
+    on_n = ((on.recompile_guard.count or 0)
+            + (on.stats_recompile_guard.count or 0))
+    if off_n is not None:
+        assert on_n <= off_n + 1, (
+            f"telemetry grew the program set {off_n} -> {on_n} ({cell})")

@@ -42,7 +42,9 @@ def test_pass3_lock_order_clean_and_covers_threaded_modules():
                 "paddle_tpu/data/prefetch.py",
                 "paddle_tpu/obs/trace.py",
                 "paddle_tpu/obs/flight.py",
-                "paddle_tpu/obs/registry.py"):
+                "paddle_tpu/obs/registry.py",
+                "paddle_tpu/obs/events.py",
+                "paddle_tpu/obs/health.py"):
         assert mod in checker.modules
     # the analysis is not vacuous: it found the repo's locks (incl. the
     # replica router's state lock, RouterMetrics, the r14 replica
@@ -63,8 +65,15 @@ def test_pass3_lock_order_clean_and_covers_threaded_modules():
     # subsystems record spans only outside their own). The flight
     # ring is LOCK-FREE by design — it must not contribute a lock at
     # all, or recording under the master RPC lock would grow edges.
+    # r16 training-health pins join the same contract: the event
+    # timeline's queue lock (serialization + file I/O happen on the
+    # writer thread OUTSIDE it) and the health monitor's snapshot
+    # lock (the monitor appends to the timeline / records flight
+    # events only after releasing it).
     obs_locks = sorted(l for l in checker.locks if ".obs." in str(l))
     assert obs_locks == [
+        "paddle_tpu.obs.events.EventLog._lock",
+        "paddle_tpu.obs.health.HealthMonitor._lock",
         "paddle_tpu.obs.registry.MetricsRegistry._lock",
         "paddle_tpu.obs.trace.Tracer._lock"]
     assert not any(".obs." in str(a) or ".obs." in str(b)

@@ -26,6 +26,7 @@ from paddle_tpu.utils import log as ptlog
 SITE_CASES = {
     "step": {"pass_id": 0, "batch_id": 3},
     "step_done": {"pass_id": 0, "batch_id": 3},
+    "step_stats": {"pass_id": 0, "batch_id": 3},
     "msg_send": {},
     "msg_recv": {},
     "checkpoint": {"path": "checkpoint-p00000-b00000003.npz"},

@@ -46,15 +46,50 @@ def load_dump(path: str) -> List[dict]:
     return events
 
 
-def merge_dir(path: str, pattern: str = "flight-*.jsonl") -> List[dict]:
-    """All dumps under ``path`` merged into one wall-clock-ordered
-    list. Sort key (ts, pid, seq): wall clock across processes,
-    per-process seq within one (two processes' clocks may skew — the
-    per-record ``mono`` field is there for forensic ordering within a
-    process when they do)."""
+def load_postmortem(path: str) -> Optional[dict]:
+    """One divergence postmortem bundle (``obs/health.py:
+    write_postmortem``) summarized as a timeline event: the bundle's
+    own wall-clock ts/pid keep it ordered among the flight events of
+    the trainer that dumped it; the full bundle stays on disk, the
+    merged line carries the pointer."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            bundle = json.load(f)
+    except (OSError, ValueError):
+        sys.stderr.write(f"{path}: torn postmortem skipped\n")
+        return None
+    if not isinstance(bundle, dict):
+        return None
+    ev = {"ts": bundle.get("ts", 0.0), "pid": bundle.get("pid", 0),
+          "seq": 0, "service": bundle.get("service", "train"),
+          "event": "train.divergence.postmortem",
+          "bundle": os.path.basename(path)}
+    for k in ("step", "pass_id", "batch_id", "loss", "grad_absmax",
+              "worst_layer", "policy"):
+        if bundle.get(k) is not None:
+            ev[k] = bundle[k]
+    return ev
+
+
+def merge_dir(path: str, pattern: str = "flight-*.jsonl",
+              postmortems: Optional[str] = "postmortem-*.json"
+              ) -> List[dict]:
+    """All dumps under ``path`` — flight rings matching ``pattern``
+    AND divergence postmortem bundles matching ``postmortems`` (its
+    own glob so a ring-scoped ``pattern`` keeps its filtering
+    contract; pass ``postmortems=None`` to exclude bundles) — merged
+    into one wall-clock-ordered list. Sort key (ts, pid, seq): wall
+    clock across processes, per-process seq within one (two
+    processes' clocks may skew — the per-record ``mono`` field is
+    there for forensic ordering within a process when they do)."""
     events: List[dict] = []
     for f in sorted(glob.glob(os.path.join(path, pattern))):
         events.extend(load_dump(f))
+    for f in (sorted(glob.glob(os.path.join(path, postmortems)))
+              if postmortems else ()):
+        ev = load_postmortem(f)
+        if ev is not None:
+            events.append(ev)
     events.sort(key=lambda e: (e.get("ts", 0.0), e.get("pid", 0),
                                e.get("seq", 0)))
     return events
