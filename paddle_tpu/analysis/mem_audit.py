@@ -10,7 +10,7 @@ the TensorFlow cluster/placement design in PAPERS.md argues the same).
 Nothing before this pass caught a refactor that silently replicates a
 buffer, doubles a temp, or un-donates an aliased leaf.
 
-The pass reuses pass 4's ``.lower().compile()`` of the same six real
+The pass reuses pass 4's ``.lower().compile()`` of the same eight real
 programs on the 8-device virtual mesh (``shard_audit.compile_programs``
 — ONE compile feeds both passes) and reads each executable's
 ``memory_analysis()``: per-device argument / output / temp / alias

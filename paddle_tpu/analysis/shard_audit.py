@@ -26,6 +26,11 @@ fc as for a 12288-wide one):
   embedding table through a full train step.
 - ``seq_ring``   — ring attention fwd+bwd over the seq axis
   (``parallel/ring.py`` ppermute ring).
+- ``fsdp_train`` — full FSDP: parameters flat-packed 1/8 over the
+  fsdp axis, ONE all-gather per layer on use, gradients reduced back
+  into the packed layout (``optim/zero1.py:FsdpUpdater``).
+- ``fsdp_pipe``  — the composed plane: stage-stacked body over pipe +
+  fsdp-packed head, both plans derived from one SpecLayout table.
 - ``serving_warm`` — the serving warm path; its manifest is pinned
   EMPTY (serving must never grow a collective).
 
@@ -479,6 +484,13 @@ def replication_findings(args, must_shard, name: str,
     list of (label, path-predicate) pairs over
     ``jax.tree_util.keystr`` paths of the program args."""
     import jax
+
+    # the dividing-axis gate is THE shared decision
+    # (parallel/layout.py:axis_divides): the same predicate
+    # SpecLayout.slot_sharding uses for its replicated fallback, so
+    # the audit and the placement can never disagree about when
+    # replication is legitimate
+    from paddle_tpu.parallel.layout import axis_divides
     findings: List[Finding] = []
     if not must_shard:
         return findings
@@ -497,11 +509,11 @@ def replication_findings(args, must_shard, name: str,
                 axes = [f"{ax}({sz})"
                         for ax, sz in dict(getattr(mesh, "shape",
                                                    {})).items()
-                        if sz > 1 and any(d % sz == 0 and d >= sz
-                                          for d in leaf.shape)]
+                        if any(axis_divides(int(d), int(sz))
+                               for d in leaf.shape)]
                 if not axes:
                     # no axis divides any dim: placement legitimately
-                    # falls back to replicated (shard_opt_state's
+                    # falls back to replicated (SpecLayout's
                     # non-divisible warning path) — not a violation
                     continue
                 findings.append(Finding(
@@ -836,6 +848,147 @@ def build_seq_ring() -> ProgramSpec:
                        mem_roles=[("acts", i, None) for i in range(4)])
 
 
+def build_fsdp_train() -> ProgramSpec:
+    """Full FSDP: parameters flat-packed 1/8 over the dedicated fsdp
+    axis (``optim/zero1.py:FsdpUpdater``) with ONE all-gather per layer
+    on use, gradients reduced back into the packed layout, and the
+    shard-wise update keeping everything sharded (no trailing gather).
+    Sized so the contracts have teeth: per-device param bytes exceed
+    ``BIG_BYTES``, so PT604's largest-temp threshold tracks the REAL
+    param bytes — a refactor that gathers the whole packed set into one
+    buffer (~8× the per-device params) fails PT604, and the ~1/8
+    per-device scaling is a PT602 law, not an aspiration (ROADMAP
+    item 1's acceptance criterion)."""
+    import numpy as np
+
+    from paddle_tpu.config import dsl
+    from paddle_tpu.data import DataFeeder, dense_vector, integer_value
+    from paddle_tpu.optim import Adam
+    from paddle_tpu.parallel.mesh import create_mesh
+    from paddle_tpu.trainer import SGD
+    width, depth, classes = 136, 8, 4
+    dsl.reset()
+    x = dsl.data(name="x", size=16)
+    lab = dsl.data(name="label", size=classes)
+    h = dsl.fc(input=x, size=width, act="relu", name="fin")
+    for i in range(depth):
+        h = dsl.fc(input=h, size=width, act="relu", name=f"fh{i}")
+    out = dsl.fc(input=h, size=classes, act="softmax", name="fout")
+    cost = dsl.classification_cost(input=out, label=lab)
+    mesh = create_mesh(n_fsdp=8)
+    tr = SGD(cost=cost, update_equation=Adam(learning_rate=1e-3),
+             mesh=mesh, seed=7)
+    if not tr.enable_fsdp():
+        raise RuntimeError("fsdp audit program stood down "
+                           "(enable_fsdp returned False)")
+    feeder = DataFeeder({"x": dense_vector(16),
+                         "label": integer_value(classes)})
+    rng = np.random.RandomState(0)
+    data = [(rng.randn(16).astype(np.float32), int(rng.randint(classes)))
+            for _ in range(16)]
+    feed = feeder(data)
+    planned = tuple(sorted(tr._fsdp.plan))
+
+    # mem_laws preds see PER-ARG paths (the argnum filters the role);
+    # must_shard preds see the whole-args-tuple paths ([0] prefix)
+    def planned_leaf(p, names=planned):
+        return any(f"'{n}'" in p for n in names)
+
+    def planned_slot(p, names=planned):
+        return "'slots'" in p and planned_leaf(p, names)
+
+    must = [(f"fsdp-packed param {n!r}",
+             (lambda p, n=n: p.startswith("[0]") and f"'{n}'" in p))
+            for n in planned]
+    laws = [("fsdp params shard ~1/8 over fsdp", 0, planned_leaf, 8,
+             1.1),
+            ("fsdp slots shard ~1/8 over fsdp", 1, planned_slot, 8,
+             1.1)]
+    return ProgramSpec("fsdp_train", "paddle_tpu/optim/zero1.py",
+                       tr._train_step, _step_args(tr, feed), mesh,
+                       must_shard=must, mem_roles=_TRAIN_ROLES,
+                       mem_laws=laws, donated=_TRAIN_DONATED)
+
+
+def build_fsdp_pipe() -> ProgramSpec:
+    """The composed plane: GPipe stage-stacked body over ``pipe`` WITH
+    the unstaged head flat-packed over ``fsdp`` — the two plans carved
+    from ONE SpecLayout rule table (the stacked keys' ``P(pipe)`` pins
+    exclude them from the fsdp plan; ``parallel/layout.py``). Both
+    scaling laws hold simultaneously: body 1/S over pipe, head ~1/2
+    over fsdp."""
+    import numpy as np
+
+    from paddle_tpu.config import dsl
+    from paddle_tpu.data import DataFeeder, dense_vector, integer_value
+    from paddle_tpu.optim import Adam
+    from paddle_tpu.parallel.mesh import create_mesh
+    from paddle_tpu.trainer import SGD
+    width, classes, S = 8, 3, 4
+    dsl.reset()
+    x = dsl.data(name="x", size=width)
+    lab = dsl.data(name="label", size=classes)
+    h = x
+    for s in range(S):
+        h = dsl.fc(input=h, size=width, act="tanh", name=f"fpb{s}",
+                   layer_attr={"device": s})
+    out = dsl.fc(input=h, size=classes, act="softmax", name="fpout")
+    cost = dsl.classification_cost(input=out, label=lab)
+    mesh = create_mesh(n_data=1, n_fsdp=2, n_pipe=S)
+    tr = SGD(cost=cost, update_equation=Adam(learning_rate=3e-3),
+             mesh=mesh, seed=7)
+    if not tr.enable_pipeline():
+        raise RuntimeError("fsdp_pipe audit program stood down "
+                           "(enable_pipeline returned False)")
+    if not tr.enable_fsdp():
+        raise RuntimeError("fsdp_pipe audit program stood down "
+                           "(enable_fsdp returned False)")
+    feeder = DataFeeder({"x": dense_vector(width),
+                         "label": integer_value(classes)})
+    rng = np.random.RandomState(0)
+    data = [(rng.randn(width).astype(np.float32), int(rng.randint(classes)))
+            for _ in range(8)]
+    feed = feeder(data)
+    plan = tr._pipe
+    stacked = tuple(sorted(plan.stacked_map))
+    planned = tuple(sorted(tr._fsdp.plan))
+    assert not set(stacked) & set(planned), (
+        "layout leak: stage-stacked keys entered the fsdp plan")
+    slot_names = set(tr.opt_state.get("slots", {}))
+    tables = [(plan.shard_rules(),
+               sorted(set(tr.params) | slot_names),
+               "parallel/pipeline.py:PipelineTrainPlan.shard_rules "
+               "(fsdp_pipe)")]
+
+    def stacked_leaf(p, keys=stacked):
+        return any(f"'{k}'" in p for k in keys)
+
+    def planned_leaf(p, names=planned):
+        return any(f"'{n}'" in p for n in names)
+
+    def planned_slot(p, names=planned):
+        return "'slots'" in p and planned_leaf(p, names)
+
+    must = [(f"stage-stacked {k!r}", (lambda p, k=k: f"'{k}'" in p))
+            for k in stacked] + \
+           [(f"fsdp-packed head param {n!r}",
+             (lambda p, n=n: p.startswith("[0]") and f"'{n}'" in p))
+            for n in planned]
+    laws = [("stage-stacked body params shard 1/4 over pipe", 0,
+             stacked_leaf, S, 1.05),
+            ("stage-stacked body slots shard 1/4 over pipe", 1,
+             (lambda p: "'slots'" in p and stacked_leaf(p)), S, 1.05),
+            ("fsdp head params shard ~1/2 over fsdp", 0, planned_leaf,
+             2, 1.1),
+            ("fsdp head slots shard ~1/2 over fsdp", 1, planned_slot,
+             2, 1.1)]
+    return ProgramSpec("fsdp_pipe", "paddle_tpu/parallel/layout.py",
+                       tr._train_step, _step_args(tr, feed), mesh,
+                       must_shard=must, rule_tables=tables,
+                       mem_roles=_TRAIN_ROLES, mem_laws=laws,
+                       donated=_TRAIN_DONATED)
+
+
 def build_serving_warm() -> ProgramSpec:
     """The serving warm path (_infer of a masked scorer, donate=True,
     exactly as warmup compiles it). Its budget is pinned EMPTY: the
@@ -852,11 +1005,12 @@ def build_serving_warm() -> ProgramSpec:
 
 PROGRAM_BUILDERS: List[Callable[[], ProgramSpec]] = [
     build_dp_train, build_zero1, build_pipeline, build_tp_embed,
-    build_seq_ring, build_serving_warm,
+    build_seq_ring, build_fsdp_train, build_fsdp_pipe,
+    build_serving_warm,
 ]
 
 PROGRAM_NAMES = ("dp_train", "zero1", "pipeline", "tp_embed",
-                 "seq_ring", "serving_warm")
+                 "seq_ring", "fsdp_train", "fsdp_pipe", "serving_warm")
 
 
 # ============================================================== the pass

@@ -2,4 +2,4 @@ from paddle_tpu.optim.optimizers import (  # noqa: F401
     Optimizer, Momentum, AdaGrad, AdaDelta, RMSProp, DecayedAdaGrad, Adam,
     Adamax, create_optimizer)
 from paddle_tpu.optim.schedules import learning_rate_at  # noqa: F401
-from paddle_tpu.optim.zero1 import Zero1Updater  # noqa: F401
+from paddle_tpu.optim.zero1 import FsdpUpdater, Zero1Updater  # noqa: F401

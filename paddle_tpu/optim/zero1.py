@@ -52,6 +52,21 @@ unchanged backward all-reduce are pinned in ``analysis/comm_budget.toml``
 (PT501), the pack-buffer ``with_sharding_constraint`` pins below are
 asserted at the jaxpr level (PT503 — removing one fails tier-1), and a
 planned slot that loses its ``P(data)`` placement is PT502.
+
+r17 generalized this module into the full-FSDP plane
+(:class:`FsdpUpdater`): the same flat ``(N, chunk)`` packing applied to
+the PARAMETERS themselves, partitioned over the mesh's dedicated
+``fsdp`` axis with gather-on-use — each device permanently holds 1/N of
+every eligible parameter and slot, the forward all-gathers each
+parameter per layer, the backward reduce-scatters its gradient, and the
+shard-wise update needs NO trailing gather (the next step re-gathers).
+Eligibility for both updaters is ONE question asked of the canonical
+layout (``parallel/layout.py:SpecLayout.fsdp_eligible``), so
+model-sharded tables and pipeline stage-stacked keys are excluded by
+the same rule table that places them. The fsdp programs' collectives
+and per-device bytes are pinned like zero1's (``fsdp_train`` /
+``fsdp_pipe`` in both budgets; the ~1/N param-bytes law is graftlint
+PT602, a full-gather materialization fails PT604).
 """
 
 from __future__ import annotations
@@ -79,28 +94,42 @@ class Zero1Updater:
 
     def __init__(self, optimizer: Optimizer, mesh, params: Dict[str, Any],
                  meta: Optional[Dict[str, ParamSpec]] = None,
-                 rules: Optional[Dict[str, P]] = None):
+                 rules: Optional[Dict[str, P]] = None,
+                 fsdp: bool = False):
+        from paddle_tpu.parallel.layout import SpecLayout
         self.opt = optimizer
         self.mesh = mesh
         self.meta = meta or {}
-        self.axes = mesh_lib.batch_axes(mesh)
-        self.n = mesh_lib.data_parallel_degree(mesh)
+        # the partition axes and sharding are THE layout's packed-role
+        # derivation (SpecLayout.packed_*): the batch axes for ZeRO-1
+        # (slots follow the gradient partition), the dedicated fsdp
+        # axis for FsdpUpdater — one packing, two layouts, derived in
+        # one place
+        layout = SpecLayout(mesh, rules=rules)
+        self.axes = layout.packed_axes(fsdp=fsdp)
+        self._packed_sharding = layout.packed_sharding(fsdp=fsdp)
+        n = 1
+        for a in self.axes:
+            n *= int(dict(mesh.shape).get(a, 1))
+        self.n = n
         if self.n <= 1:
             raise ValueError(
-                "ZeRO-1 needs a data-parallel degree > 1; on a 1-device "
-                "data axis there is nothing to partition (callers fall "
-                "back to the replicated update)")
+                "ZeRO-1/FSDP needs a partition degree > 1 over "
+                f"{self.axes or 'the batch axes'}; with one device "
+                "there is nothing to partition (callers fall back to "
+                "the replicated update)")
         # plan: name -> (orig_shape, size, chunk). Only these params take
         # the sharded path; everything else falls back per-parameter.
+        # Eligibility is the canonical layout's ONE question
+        # (SpecLayout.fsdp_eligible): static and sparse-lazy params are
+        # out, and so is anything the rule table already places —
+        # model-sharded tables and pipeline stage-stacked keys follow
+        # their own rule instead of the flat packing.
         self.plan: Dict[str, tuple] = {}
         for name, p in params.items():
             spec = self.meta.get(name)
-            if spec is not None and getattr(spec, "is_static", False):
+            if not layout.fsdp_eligible(name, spec, optimizer):
                 continue
-            if optimizer._is_sparse(spec):
-                continue  # row-lazy t_rows bookkeeping is not flat-wise
-            if mesh_lib.rule_for(name, rules) != P():
-                continue  # model-sharded: slots already follow the table
             shape = tuple(int(d) for d in p.shape)
             size = 1
             for d in shape:
@@ -139,7 +168,7 @@ class Zero1Updater:
         return flat.reshape(self.n, chunk)
 
     def _slot_sharding(self) -> NamedSharding:
-        return NamedSharding(self.mesh, P(self.axes))
+        return self._packed_sharding
 
     # ------------------------------------------------------------ lifecycle
     def init(self, params, meta=None):
@@ -315,3 +344,236 @@ class Zero1Updater:
 
     def averaged_params(self, state, params):
         return self.opt.averaged_params(state, params)
+
+
+class FsdpUpdater(Zero1Updater):
+    """Full FSDP (ZeRO stage 3): parameters AND optimizer slots
+    partitioned 1/N over the mesh's dedicated ``fsdp`` axis.
+
+    Same flat ``(N, chunk)`` packing as ZeRO-1, promoted from optimizer
+    slots to the parameters themselves:
+
+    - **storage** — every planned parameter lives packed ``(N, chunk)``
+      sharded ``P(fsdp)`` (``pack_params``); each device permanently
+      holds 1/N of it. The fsdp axis ALSO carries batch rows
+      (``mesh.batch_axes`` includes it), so the data-parallel story is
+      unchanged — only parameter residency shrinks, which is how a
+      model ~N× one device's memory trains on an N-device mesh.
+    - **gather-on-use** — ``full_params`` rebuilds each full parameter
+      inside the jitted step with ONE all-gather over fsdp per
+      parameter (a ``with_sharding_constraint`` to replicated, then the
+      unpad/reshape). Per layer, deliberately: the largest gathered
+      buffer is one layer's parameter, never the whole model — the
+      full-gather-materialization smell graftlint PT604 rejects.
+    - **backward** — the gather's transpose makes XLA reduce the
+      per-device partial gradients back INTO the packed layout
+      (reduce-scatter, or all-reduce + local slice — whichever the
+      partitioner picks is pinned in ``analysis/comm_budget.toml``).
+    - **update** — the ZeRO shard-wise update on the local rows, with
+      NO trailing all-gather: the updated parameter stays sharded and
+      the next step's forward re-gathers it. Slots pack identically
+      (``convert_state`` inherited), so ``--use_zero1`` composes as a
+      no-op — FSDP already holds slots at 1/N.
+
+    Packing padding stays EXACTLY zero across steps: the unpack slice's
+    transpose writes zero cotangents into the pad region and every
+    dense optimizer maps (0 param, 0 grad, 0 slots) to 0, so the
+    gather-on-save/reshard-on-load checkpoint round trip (full shapes
+    on disk, the zero1/pipeline format precedent) is lossless.
+
+    Exactness: the gathered forward is bit-identical to the unsharded
+    one (the gather reconstructs exact bits) and the shard-wise update
+    is the proven zero1 elementwise math; only the gradient REDUCTION
+    order may differ from plain DP's all-reduce, so parity vs the
+    unsharded step is asserted at 1e-7, not bitwise
+    (``tests/test_fsdp.py``) — while exact resume (same program twice)
+    stays bitwise (``tests/test_exact_resume_matrix.py``).
+    """
+
+    def __init__(self, optimizer: Optimizer, mesh, params: Dict[str, Any],
+                 meta: Optional[Dict[str, ParamSpec]] = None,
+                 rules: Optional[Dict[str, P]] = None):
+        if mesh_lib.FSDP_AXIS not in mesh.axis_names or \
+                dict(mesh.shape)[mesh_lib.FSDP_AXIS] <= 1:
+            raise ValueError(
+                f"FSDP needs a {mesh_lib.FSDP_AXIS!r} mesh axis of size "
+                "> 1; build one with create_mesh(n_fsdp=N) (callers "
+                "stand down to the replicated step)")
+        super().__init__(optimizer, mesh, params, meta, rules=rules,
+                         fsdp=True)
+
+    # -------------------------------------------------- parameter layout
+    def _is_packed(self, x, name: str) -> bool:
+        _, _, chunk = self.plan[name]
+        return (getattr(x, "ndim", 0) == 2
+                and tuple(x.shape) == (self.n, chunk))
+
+    def pack_params(self, params):
+        """Full-shape params -> the storage layout: planned leaves
+        packed ``(N, chunk)`` sharded ``P(fsdp)``. Eager (enable/load
+        time); idempotent on already-packed-and-placed leaves. A leaf
+        whose FULL shape happens to equal ``(N, chunk)`` (an N-row fc
+        weight) is a shape coincidence, not a packed leaf — packing is
+        the identity reshape for it, but it must still be RESHARDED or
+        it sits replicated at full per-device bytes (review-round
+        finding; regression-tested)."""
+        sharding = self._slot_sharding()
+        out = dict(params)
+        for name in self.plan:
+            leaf = out.get(name)
+            if leaf is None:
+                continue
+            if self._is_packed(leaf, name) and \
+                    getattr(leaf, "sharding", None) == sharding:
+                continue
+            if not self._is_packed(leaf, name):
+                leaf = self._pack_host(jax.device_get(leaf), name)
+            out[name] = jax.device_put(leaf, sharding)
+        return out
+
+    def unpack_params(self, params):
+        """Storage -> full shapes (jnp ops: works eagerly for the
+        checkpoint/eval view and under a trace). The eager spelling
+        performs the gather as a device op — ``_params_for_save`` passes
+        this lazily so saves not due pay nothing."""
+        out = dict(params)
+        for name in self.plan:
+            leaf = out.get(name)
+            if leaf is not None and self._is_packed(leaf, name):
+                out[name] = self._unpack(leaf, name)
+        return out
+
+    def full_params(self, params):
+        """The gather-on-use view inside the jitted step: per planned
+        parameter, pin the packed leaf replicated (ONE all-gather over
+        the fsdp axis) and unpad/reshape to the full shape. The rest of
+        the step — forward, backward, metrics — consumes the result
+        exactly as it consumes replicated parameters."""
+        rep = NamedSharding(self.mesh, P())
+        out = dict(params)
+        for name in self.plan:
+            leaf = out.get(name)
+            if leaf is not None:
+                out[name] = self._unpack(
+                    jax.lax.with_sharding_constraint(leaf, rep), name)
+        return out
+
+    def pack_params_host(self, params):
+        """Host-side packing of a restored full-shape param dict (numpy
+        in, numpy out) so ``SGD.load_state``'s place() sees arrays
+        matching the live packed leaves."""
+        out = dict(params)
+        for name in self.plan:
+            if name in out:
+                arr = np.asarray(out[name])
+                _, _, chunk = self.plan[name]
+                if arr.ndim == 2 and arr.shape == (self.n, chunk):
+                    continue  # already packed (a same-mode resume)
+                out[name] = self._pack_host(arr, name)
+        return out
+
+    # --------------------------------------------------------------- update
+    def update(self, grads, state, params,
+               meta: Optional[Dict[str, ParamSpec]] = None,
+               batch_size=1, num_passes=0):
+        """Shard-wise update on the packed storage: planned parameters
+        and their gradients arrive ``(N, chunk)`` (the gather's
+        transpose already reduced the cotangent into the packed
+        layout), fuse along the chunk dim, update each device's row,
+        and RETURN THE SHARDS — no trailing all-gather; the next
+        forward's per-layer gather is the only reconstruction."""
+        from paddle_tpu.optim.schedules import learning_rate_at
+        if "avg" in state:
+            raise ValueError(
+                "FSDP does not compose with model averaging ('avg' "
+                "state is consumed whole at eval/save time); "
+                "enable_fsdp stands down before building this updater")
+        opt = self.opt
+        meta = meta if meta is not None else self.meta
+
+        t = state["t"] + 1
+        num_samples = state["num_samples"] + batch_size
+        lr_t = learning_rate_at(
+            opt.learning_rate_schedule, opt.learning_rate,
+            opt.learning_rate_decay_a, opt.learning_rate_decay_b,
+            num_samples, args=opt.learning_rate_args, num_passes=num_passes)
+        if opt.sum_gradients:
+            bsz = jnp.asarray(batch_size, jnp.float32)
+            grads = {n: g * bsz for n, g in grads.items()}
+
+        new_params = dict(params)
+        new_slots = {n: s for n, s in state["slots"].items()
+                     if n not in grads}
+        z_names = sorted(n for n in grads
+                         if n in self.plan and n in state["slots"])
+
+        # fallback set: sparse lazy tables, ruled (model/pipe) params,
+        # grads for slot-less params — the replicated per-param body,
+        # identical to Optimizer.update (and to Zero1Updater's)
+        for name, g in grads.items():
+            if name in z_names:
+                continue
+            if name not in state["slots"]:
+                new_params[name] = params[name]
+                continue
+            spec = meta.get(name) if meta else None
+            p_new, s_new = opt._update_param(
+                g, params[name], state["slots"][name], spec, lr_t, t)
+            new_params[name] = p_new
+            new_slots[name] = s_new
+
+        if z_names:
+            # one fused (N, sum_chunks) buffer per role, exactly the
+            # zero1 bucketing — except the operands are ALREADY packed
+            # and sharded, so the concatenate runs shard-wise. The pins
+            # keep propagation honest (graftlint PT503: a pack feeding
+            # a sharded shard_map in_spec must carry a constraint).
+            offs, off = {}, 0
+            for n in z_names:
+                chunk = self.plan[n][2]
+                offs[n] = (off, off + chunk)
+                off += chunk
+            shd = self._slot_sharding()
+            p_fused = jax.lax.with_sharding_constraint(jnp.concatenate(
+                [params[n] for n in z_names], axis=1), shd)
+            g_fused = jax.lax.with_sharding_constraint(jnp.concatenate(
+                [grads[n] for n in z_names], axis=1), shd)
+            s_sh = {n: state["slots"][n] for n in z_names}
+            specs = {n: (meta.get(n) if meta else None) for n in z_names}
+
+            def shard_update(p_loc, g_loc, s_sh, lr_t, t):
+                # this device's (1, sum_chunks) row + its slot rows:
+                # the elementwise update math is the replicated path's,
+                # applied to 1/N of every parameter — and the result
+                # STAYS here (no gather; the next forward re-gathers)
+                out_p, out_s = [], {}
+                for n in z_names:
+                    lo, hi = offs[n]
+                    p1, s1 = opt._update_param(
+                        g_loc[:, lo:hi], p_loc[:, lo:hi], s_sh[n],
+                        specs[n], lr_t, t)
+                    out_p.append(p1)
+                    out_s[n] = s1
+                return jnp.concatenate(out_p, axis=1), out_s
+
+            fused_new, s_new = mesh_lib.shard_map_compat(
+                shard_update, self.mesh,
+                in_specs=(P(self.axes), P(self.axes), P(self.axes),
+                          P(), P()),
+                out_specs=(P(self.axes), P(self.axes)))(p_fused, g_fused,
+                                                        s_sh, lr_t, t)
+            for n in z_names:
+                lo, hi = offs[n]
+                new_params[n] = jax.lax.with_sharding_constraint(
+                    fused_new[:, lo:hi], shd)
+                new_slots[n] = s_new[n]
+
+        return new_params, {"slots": new_slots, "t": t,
+                            "num_samples": num_samples}
+
+    # ------------------------------------------------- delegated protocol
+    def prune_params(self, params, state):
+        """Pruning masks live at full shapes: gather, prune, re-pack."""
+        full = self.unpack_params(params)
+        pruned = self.opt.prune_params(full, self.gather_opt_state(state))
+        return self.pack_params(pruned)

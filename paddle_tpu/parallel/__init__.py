@@ -1,3 +1,4 @@
+from paddle_tpu.parallel.layout import SpecLayout  # noqa: F401
 from paddle_tpu.parallel.mesh import (  # noqa: F401
     create_mesh, create_multislice_mesh, param_shardings, replicate,
     shard_batch, shard_opt_state, shard_params)

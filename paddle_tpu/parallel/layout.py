@@ -1,0 +1,292 @@
+"""SpecLayout — the canonical 4D sharding plane (data × fsdp × tp × pipe).
+
+Every parallelism axis in this repo was added one PR at a time, and their
+pairwise composition used to be hand-negotiated: ZeRO-1 excluded the
+pipeline's stage-stacked keys via ``P(pipe)`` rules it had to know about,
+``shard_opt_state`` and graftlint PT502 each re-derived the
+non-divisible-dim replicated fallback, and init / the train step /
+checkpoint load each called their own chain of
+``effective_rules``/``device_attr_rules``/``rule_for``. This module is
+the single placement layer the TensorFlow paper (PAPERS.md) argues for
+and modern TPU stacks spell as one named-axis PartitionSpec table
+(SNIPPETS.md [2]): ONE ``SpecLayout`` object owns the canonical per-role
+spec map, and init, the train step, ZeRO-1/FSDP, the pipeline,
+checkpointing, and serving reshard all *derive* their shardings from it.
+
+Mesh axes and what each one means:
+
+==========  =============================================================
+axis        role
+==========  =============================================================
+``data``    batch rows; gradients all-reduce over it (pure DP).
+``fsdp``    batch rows AND flat-packed parameter/optimizer state: the
+            batch is split over ``data × fsdp`` jointly, while eligible
+            parameters live packed ``(N, chunk)`` sharded 1/N over this
+            axis with gather-on-use (``optim/zero1.py:FsdpUpdater``).
+``model``   tensor parallelism (the ``tp`` plane): row/column-sharded
+            tables and projections via per-name rules.
+``seq``     sequence/context parallelism (ring/ulysses attention).
+``pipe``    GPipe stages: stage-stacked body state, one stage per slot.
+``dcn``     cross-slice data parallelism (leading, multi-slice meshes).
+==========  =============================================================
+
+Roles the layout answers for (the derivation map each subsystem uses is
+tabulated in ``docs/spec_layout.md``):
+
+- **batch**    — ``batch_spec``/``batch_sharding``: dim 0 over
+  ``(dcn, data, fsdp)``, the rest replicated.
+- **param**    — ``param_spec``/``param_sharding``/``param_shardings``:
+  the canonical rule table (user rules + the sparse-table row-sharding
+  default + ``--parallel_nn`` device-attr hints + any pipeline pins),
+  resolved with ``rule_for``'s exact-before-substring precedence.
+- **slot**     — ``slot_sharding``: optimizer slots follow their owning
+  parameter's spec, trimmed to the leaf's rank, with THE
+  non-divisible-dim replicated fallback (``fits``/``axis_divides`` —
+  the same decision graftlint PT502 gates on, so the audit and the
+  placement can never disagree about when replication is legitimate).
+- **packed**   — ``packed_sharding``: the flat ``(N, chunk)`` layout
+  ZeRO-1/FSDP state uses, over the partition axes the updater declares.
+- **stacked**  — pipeline pins installed with ``pin``/``unpin``: the
+  stage-stacked keys become ordinary exact-match rules in the one
+  table, so ZeRO-1/FSDP eligibility, ``shard_opt_state`` and PT505
+  hygiene all see them through the same query.
+
+Construction is cheap (no device ops); placement methods
+(``place_params``/``place_opt_state``) perform the device_puts.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def axis_divides(dim: int, axis_size: int) -> bool:
+    """THE dividing-axis decision, shared by the slot-placement fallback
+    (``SpecLayout.slot_sharding``) and graftlint PT502's gate
+    (``analysis/shard_audit.py:replication_findings``): a mesh axis of
+    size ``s`` divides a dim ``d`` when ``s > 1``, ``d >= s`` and
+    ``d % s == 0``. A leaf none of whose ruled dims pass this test is
+    LEGITIMATELY replicated (one warning, not a PT502 finding) — one
+    predicate, consulted from both sides, so the placement and the
+    audit report the same decision."""
+    return axis_size > 1 and dim >= axis_size and dim % axis_size == 0
+
+
+class SpecLayout:
+    """The canonical per-role PartitionSpec map for one mesh.
+
+    ``param_specs`` may be a ``{name: ParamSpec}`` dict (enables the
+    sparse-table default and device-attr hints) or any iterable of
+    names (rules only). ``graph`` supplies the per-layer ``device``
+    attrs for the ``--parallel_nn`` shard-hint form."""
+
+    def __init__(self, mesh, param_specs=None, graph=None,
+                 rules: Optional[Dict[str, P]] = None):
+        from paddle_tpu.parallel import mesh as mesh_lib
+        self.mesh = mesh
+        self.param_specs = (param_specs
+                            if isinstance(param_specs, dict) else None)
+        # the canonical rule table: user rules + the sparse row-sharding
+        # default + per-layer device placement mapped to model-axis
+        # sharding — built ONCE here instead of per-call-site
+        rules = mesh_lib.effective_rules(param_specs or {}, mesh, rules)
+        rules = mesh_lib.device_attr_rules(graph, self.param_specs, mesh,
+                                           rules)
+        self.rules: Dict[str, P] = dict(rules)
+
+    # ------------------------------------------------------------- axes
+    def axis_size(self, axis: str) -> int:
+        return int(dict(self.mesh.shape).get(axis, 1))
+
+    @property
+    def data(self) -> int:
+        from paddle_tpu.parallel.mesh import DATA_AXIS
+        return self.axis_size(DATA_AXIS)
+
+    @property
+    def fsdp(self) -> int:
+        from paddle_tpu.parallel.mesh import FSDP_AXIS
+        return self.axis_size(FSDP_AXIS)
+
+    @property
+    def tp(self) -> int:
+        from paddle_tpu.parallel.mesh import MODEL_AXIS
+        return self.axis_size(MODEL_AXIS)
+
+    @property
+    def seq(self) -> int:
+        from paddle_tpu.parallel.mesh import SEQ_AXIS
+        return self.axis_size(SEQ_AXIS)
+
+    @property
+    def pipe(self) -> int:
+        from paddle_tpu.parallel.mesh import PIPE_AXIS
+        return self.axis_size(PIPE_AXIS)
+
+    # ------------------------------------------------------------ batch
+    def batch_axes(self) -> tuple:
+        from paddle_tpu.parallel import mesh as mesh_lib
+        return mesh_lib.batch_axes(self.mesh)
+
+    def batch_spec(self, ndim: int = 1) -> P:
+        # delegates: mesh.batch_sharding is the one construction site
+        # (data/prefetch device_put and shard_batch ride it too)
+        return self.batch_sharding(ndim).spec
+
+    def batch_sharding(self, ndim: int = 1) -> NamedSharding:
+        from paddle_tpu.parallel import mesh as mesh_lib
+        return mesh_lib.batch_sharding(self.mesh, ndim)
+
+    # ----------------------------------------------------------- params
+    def rule_key(self, name: str) -> Optional[str]:
+        from paddle_tpu.parallel.mesh import rule_key_for
+        return rule_key_for(name, self.rules)
+
+    def param_spec(self, name: str) -> P:
+        from paddle_tpu.parallel.mesh import rule_for
+        return rule_for(name, self.rules)
+
+    def is_replicated(self, name: str) -> bool:
+        return self.param_spec(name) == P()
+
+    def param_sharding(self, name: str) -> NamedSharding:
+        return NamedSharding(self.mesh, self.param_spec(name))
+
+    def param_shardings(self, names: Iterable[str]
+                        ) -> Dict[str, NamedSharding]:
+        """NamedSharding per parameter name — the INIT derivation: jit
+        out_shardings so big sharded tables are created in place."""
+        return {n: self.param_sharding(n) for n in names}
+
+    def place_params(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        import jax
+        return {n: jax.device_put(p, self.param_sharding(n))
+                for n, p in params.items()}
+
+    # ------------------------------------------------------------ slots
+    def fits(self, shape, spec: P) -> Optional[str]:
+        """Does ``spec`` place a leaf of ``shape`` without a
+        non-dividing ruled dim? None when it fits; otherwise a reason
+        string naming the first dim/axis that fails ``axis_divides``
+        (the caller replicates the leaf and warns with it)."""
+        for i, entry in enumerate(spec):
+            if entry is None:
+                continue
+            names = entry if isinstance(entry, tuple) else (entry,)
+            sz = 1
+            for a in names:
+                sz *= self.axis_size(a)
+            if sz > 1 and not axis_divides(int(shape[i]), sz):
+                return (f"dim {i} of size {shape[i]} not divisible by "
+                        f"mesh axis {entry!r} (size {sz})")
+        return None
+
+    def slot_sharding(self, name: str, leaf) -> NamedSharding:
+        """The sharding an optimizer-slot leaf of ``name`` takes: the
+        owning parameter's spec trimmed to the leaf's rank (slots may
+        have fewer dims, e.g. per-row timestamps [V] vs the table
+        [V, D]); a spec that doesn't fit falls back to replicated,
+        LOUDLY — one warning, one decision path (graftlint PT502 gates
+        on the same ``axis_divides`` predicate)."""
+        from paddle_tpu.utils.log import logger
+        spec = P(*self.param_spec(name)[:leaf.ndim])
+        why = self.fits(leaf.shape, spec)
+        if why is not None:
+            logger.warning(
+                "SpecLayout: slot of %r: %s — keeping this leaf "
+                "replicated (every device pays its full bytes); pad "
+                "the parameter or drop the rule", name, why)
+            return NamedSharding(self.mesh, P())
+        return NamedSharding(self.mesh, spec)
+
+    def place_opt_state(self, opt_state: Dict[str, Any]) -> Dict[str, Any]:
+        """Shard an optimizer-state pytree: entries of per-parameter
+        dicts (``slots``, ``avg``, any ``{param_name: ...}`` value)
+        follow their owning parameter's rule; everything else
+        replicates."""
+        import jax
+        rep = NamedSharding(self.mesh, P())
+        out = {}
+        for key, val in opt_state.items():
+            if isinstance(val, dict):
+                out[key] = {
+                    name: jax.tree_util.tree_map(
+                        lambda x, n=name: jax.device_put(
+                            x, self.slot_sharding(n, x)), sub)
+                    for name, sub in val.items()}
+            else:
+                out[key] = jax.device_put(val, rep)
+        return out
+
+    # ----------------------------------------------------------- packed
+    def packed_axes(self, fsdp: bool = False) -> tuple:
+        """The partition axes the flat-packed ``(N, chunk)`` state uses:
+        the batch axes for ZeRO-1 (slots follow the gradient
+        partition), the fsdp axis alone for FSDP (parameters must stay
+        replicated over plain data so the batch axes can keep carrying
+        independent rows)."""
+        from paddle_tpu.parallel.mesh import FSDP_AXIS
+        if fsdp:
+            return (FSDP_AXIS,)
+        return self.batch_axes()
+
+    def packed_spec(self, fsdp: bool = False) -> P:
+        return P(self.packed_axes(fsdp))
+
+    def packed_sharding(self, fsdp: bool = False) -> NamedSharding:
+        return NamedSharding(self.mesh, self.packed_spec(fsdp))
+
+    # ------------------------------------------------------------- pins
+    def pin(self, rules: Dict[str, P]) -> None:
+        """Install exact-match pins (the pipeline's stage-stacked keys)
+        into the canonical table — they become ordinary rules every
+        derivation (slots, ZeRO-1/FSDP eligibility, PT505 hygiene)
+        sees through the same query."""
+        self.rules.update(rules)
+
+    def unpin(self, keys: Iterable[str]) -> None:
+        for k in keys:
+            self.rules.pop(k, None)
+
+    # ------------------------------------------------- FSDP eligibility
+    def fsdp_eligible(self, name: str, spec=None, optimizer=None) -> bool:
+        """Is ``name`` in the FSDP/ZeRO flat-packed plan? Excluded:
+        static parameters (no slots), sparse lazy tables (row-structured
+        bookkeeping), and anything the canonical table already places
+        (model-sharded tables, pipeline stage-stacked keys) — their
+        state follows that rule instead. The ONE eligibility question
+        ZeRO-1 and FSDP both ask (``optim/zero1.py``)."""
+        if spec is not None and getattr(spec, "is_static", False):
+            return False
+        if optimizer is not None and optimizer._is_sparse(spec):
+            return False
+        return self.is_replicated(name)
+
+    # ------------------------------------------------------------ table
+    def describe(self, names: Iterable[str] = ()) -> List[Tuple[str, str,
+                                                                str]]:
+        """(name, role, spec) rows — the human-readable derivation
+        table ``docs/spec_layout.md`` documents; handy in a REPL."""
+        rows = [("<batch>", "batch", str(self.batch_spec(2)))]
+        for n in names:
+            spec = self.param_spec(n)
+            role = "param"
+            key = self.rule_key(n)
+            if key is not None and key.startswith("=") and \
+                    any(a == "pipe" for a in _flat_axes(spec)):
+                role = "stacked"
+            elif not self.is_replicated(n):
+                role = "tp/ruled"
+            rows.append((n, role, str(spec)))
+        return rows
+
+
+def _flat_axes(spec: P):
+    out = []
+    for entry in spec:
+        if entry is None:
+            continue
+        out.extend(entry if isinstance(entry, tuple) else (entry,))
+    return out

@@ -17,8 +17,17 @@ parallel/communication stack:
   synchronous fabric; executed as sync SGD (documented approximation,
   SURVEY §2 checklist).
 
-Axes: ``data`` (batch), ``model`` (tensor/embedding sharding). Multi-host
-DCN maps to extra leading mesh dims transparently through jax.devices().
+Axes: ``data`` (batch), ``fsdp`` (batch + flat-packed parameter/optimizer
+state, 1/N per device — ``optim/zero1.py:FsdpUpdater``), ``model``
+(tensor/embedding sharding), ``seq`` (sequence parallelism), ``pipe``
+(GPipe stages). Multi-host DCN maps to extra leading mesh dims
+transparently through jax.devices().
+
+Since r17 the canonical placement derivations (batch/param/slot/packed
+specs, the non-divisible replicated fallback) live in ONE object —
+``parallel/layout.py:SpecLayout`` — and the placement helpers below
+(``shard_params``/``param_shardings``/``shard_opt_state``) are thin
+compatibility wrappers over it (``docs/spec_layout.md``).
 """
 
 from __future__ import annotations
@@ -32,6 +41,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from paddle_tpu.core.argument import Argument
 
 DATA_AXIS = "data"
+FSDP_AXIS = "fsdp"  # batch + flat-packed param/slot shards (zero1.py)
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
 PIPE_AXIS = "pipe"  # GPipe stage axis (parallel/pipeline.py)
@@ -39,7 +49,8 @@ DCN_AXIS = "dcn"  # cross-slice (data-center network) leading axis
 
 
 def create_mesh(n_data: Optional[int] = None, n_model: int = 1,
-                n_seq: int = 1, devices=None, n_pipe: int = 1) -> Mesh:
+                n_seq: int = 1, devices=None, n_pipe: int = 1,
+                n_fsdp: int = 1) -> Mesh:
     """Build a (data, model) mesh — or (data, seq, model) when
     ``n_seq > 1`` for sequence/context parallelism (ring/ulysses
     attention shards the time axis over ``seq``; the axis sits between
@@ -49,17 +60,46 @@ def create_mesh(n_data: Optional[int] = None, n_model: int = 1,
     stage-handoff ppermute rides ICI; ``--parallel_nn``,
     ``trainer/trainer.py:enable_pipeline``). Defaults to all visible
     devices on the data axis (pure DP, the reference's trainer_count
-    semantics)."""
+    semantics).
+
+    ``n_fsdp > 1`` inserts the ``fsdp`` axis right after ``data``: the
+    batch shards over BOTH (DP degree = data × fsdp, the same rows/
+    gradients story), while eligible parameters and optimizer slots
+    live flat-packed 1/n_fsdp per device with gather-on-use
+    (``--fsdp``, ``optim/zero1.py:FsdpUpdater``,
+    ``docs/spec_layout.md``). The 4D composition forms are
+    (data, fsdp, pipe), (data, fsdp, seq, pipe) and
+    (data, fsdp, seq, model)."""
     devices = devices if devices is not None else jax.devices()
-    if n_pipe > 1 and (n_model > 1 or n_seq > 1):
+    if n_pipe > 1 and n_model > 1:
         raise ValueError(
-            "n_pipe composes with n_data only (a pipeline stage owns its "
-            "whole layer; shard within a stage via shard_rules instead)")
+            "n_pipe does not compose with n_model (a pipeline stage owns "
+            "its whole layer; shard within a stage via shard_rules "
+            "instead)")
     if n_data is None:
-        n_data = len(devices) // (n_model * n_seq * n_pipe)
+        n_data = len(devices) // (n_model * n_seq * n_pipe * n_fsdp)
     if n_pipe > 1:
-        devs = np.asarray(devices[: n_data * n_pipe]).reshape(n_data, n_pipe)
-        return Mesh(devs, (DATA_AXIS, PIPE_AXIS))
+        dims = [(DATA_AXIS, n_data)]
+        if n_fsdp > 1:
+            dims.append((FSDP_AXIS, n_fsdp))
+        if n_seq > 1:
+            dims.append((SEQ_AXIS, n_seq))
+        dims.append((PIPE_AXIS, n_pipe))
+        total = 1
+        for _, sz in dims:
+            total *= sz
+        devs = np.asarray(devices[:total]).reshape(
+            tuple(sz for _, sz in dims))
+        return Mesh(devs, tuple(ax for ax, _ in dims))
+    if n_fsdp > 1:
+        if n_seq > 1 or n_model > 1:
+            devs = np.asarray(
+                devices[: n_data * n_fsdp * n_seq * n_model]).reshape(
+                n_data, n_fsdp, n_seq, n_model)
+            return Mesh(devs, (DATA_AXIS, FSDP_AXIS, SEQ_AXIS, MODEL_AXIS))
+        devs = np.asarray(devices[: n_data * n_fsdp]).reshape(
+            n_data, n_fsdp)
+        return Mesh(devs, (DATA_AXIS, FSDP_AXIS))
     if n_seq > 1:
         devs = np.asarray(devices[: n_data * n_seq * n_model]).reshape(
             n_data, n_seq, n_model)
@@ -147,14 +187,20 @@ def shard_map_compat(f, mesh: Mesh, in_specs, out_specs,
 
 
 def batch_axes(mesh: Mesh):
-    """Mesh axes the batch dimension is split over (dcn is part of DP).
-    A mesh WITHOUT a data axis (e.g. a pure ("pipe",) stage mesh) has no
+    """Mesh axes the batch dimension is split over (dcn is part of DP,
+    and so is fsdp — FSDP devices carry independent batch rows exactly
+    like plain DP; only the PARAMETER placement differs). A mesh
+    WITHOUT a data axis (e.g. a pure ("pipe",) stage mesh) has no
     batch axes: the batch replicates and DP degree is 1."""
     if DATA_AXIS not in mesh.axis_names:
         return ()
+    axes = []
     if DCN_AXIS in mesh.axis_names:
-        return (DCN_AXIS, DATA_AXIS)
-    return (DATA_AXIS,)
+        axes.append(DCN_AXIS)
+    axes.append(DATA_AXIS)
+    if FSDP_AXIS in mesh.axis_names:
+        axes.append(FSDP_AXIS)
+    return tuple(axes)
 
 
 def data_parallel_degree(mesh: Mesh) -> int:
@@ -249,9 +295,12 @@ def shard_params(params: Dict[str, jax.Array], mesh: Mesh,
                  rules: Optional[Dict[str, P]] = None):
     """Place parameters: replicated by default; ``rules`` maps param-name
     substrings to PartitionSpecs (e.g. shard embedding rows on MODEL_AXIS,
-    the sparse-embedding model parallelism of SURVEY §2 #5)."""
-    return {name: jax.device_put(p, NamedSharding(mesh, rule_for(name, rules)))
-            for name, p in params.items()}
+    the sparse-embedding model parallelism of SURVEY §2 #5).
+    Compatibility wrapper over ``SpecLayout.place_params`` — the rules
+    passed here are assumed already effective (the trainer builds them
+    through its layout)."""
+    from paddle_tpu.parallel.layout import SpecLayout
+    return SpecLayout(mesh, rules=rules).place_params(params)
 
 
 def param_shardings(param_names, mesh: Mesh,
@@ -262,10 +311,11 @@ def param_shardings(param_names, mesh: Mesh,
     ``param_names`` may be a {name: ParamSpec} dict: parameters flagged
     ``sparse_grad`` (embedding tables) default to row-sharding over the
     model axis when no explicit rule names them — the ``SparseRowMatrix``
-    row-slice placement, without configs having to spell it out."""
-    rules = effective_rules(param_names, mesh, rules)
-    return {name: NamedSharding(mesh, rule_for(name, rules))
-            for name in param_names}
+    row-slice placement, without configs having to spell it out.
+    Compatibility wrapper over ``SpecLayout.param_shardings``."""
+    from paddle_tpu.parallel.layout import SpecLayout
+    layout = SpecLayout(mesh, param_specs=param_names, rules=rules)
+    return layout.param_shardings(param_names)
 
 
 def effective_rules(param_specs, mesh: Mesh,
@@ -361,45 +411,11 @@ def shard_opt_state(opt_state, mesh: Mesh,
 
     A dimension a rule would shard that is NOT divisible by the mesh axis
     size keeps that leaf replicated — loudly: the warning names the
-    parameter, the dim, and the axis. (Previously the mismatch surfaced
-    as a bare ``jax.device_put`` ValueError with no parameter name; now
-    placement succeeds, at full per-device bytes, and says which rule to
-    fix.)"""
-    from paddle_tpu.utils.log import logger
-
-    def axis_size(entry) -> int:
-        names = entry if isinstance(entry, tuple) else (entry,)
-        n = 1
-        for a in names:
-            n *= mesh.shape[a]
-        return n
-
-    def leaf_sharding(x, rule, name):
-        # slots may have fewer dims than their parameter (e.g. the sparse
-        # path's per-row timestamps [V] vs the table [V, D]): trim the spec
-        spec = P(*rule[:x.ndim])
-        for i, entry in enumerate(spec):
-            if entry is None:
-                continue
-            sz = axis_size(entry)
-            if sz > 1 and x.shape[i] % sz != 0:
-                logger.warning(
-                    "shard_opt_state: slot of %r has dim %d of size %d, "
-                    "not divisible by mesh axis %r (size %d) — keeping "
-                    "this leaf replicated (every device pays its full "
-                    "bytes); pad the parameter or drop the rule",
-                    name, i, x.shape[i], entry, sz)
-                return NamedSharding(mesh, P())
-        return NamedSharding(mesh, spec)
-
-    out = {}
-    for key, val in opt_state.items():
-        if isinstance(val, dict):
-            out[key] = {
-                name: jax.tree_util.tree_map(
-                    lambda x, n=name: jax.device_put(
-                        x, leaf_sharding(x, rule_for(n, rules), n)), sub)
-                for name, sub in val.items()}
-        else:
-            out[key] = jax.device_put(val, NamedSharding(mesh, P()))
-    return out
+    parameter, the dim, and the axis. Since r17 the fallback decision
+    lives in ``parallel/layout.py:SpecLayout.slot_sharding`` (one
+    ``axis_divides`` predicate, shared with graftlint PT502's
+    dividing-axis gate, so the placement and the audit always report
+    the same decision); this is a compatibility wrapper over
+    ``SpecLayout.place_opt_state``."""
+    from paddle_tpu.parallel.layout import SpecLayout
+    return SpecLayout(mesh, rules=rules).place_opt_state(opt_state)

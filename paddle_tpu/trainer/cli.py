@@ -146,6 +146,20 @@ def parse_args(argv=None):
                         "holds 1/N of every slot), update shard-wise, "
                         "all-gather params — the pserver's sharded "
                         "update (ParameterServer2.cpp:362), TPU-native")
+    p.add_argument("--fsdp", action="store_true",
+                   help="full FSDP: shard PARAMETERS (not just optimizer "
+                        "slots) flat-packed 1/N over a dedicated fsdp "
+                        "mesh axis with one all-gather per layer on use "
+                        "and reduce-scattered gradients "
+                        "(optim/zero1.py:FsdpUpdater; "
+                        "docs/spec_layout.md) — a model ~N× one "
+                        "device's memory trains on N devices. The "
+                        "--trainer_count width moves onto the fsdp axis "
+                        "(batch rows still split over it, so the DP "
+                        "degree is unchanged); composes with "
+                        "--parallel_nn, --use_zero1 and seq-parallel "
+                        "configs. Checkpoints stay format-compatible "
+                        "crossing --fsdp on/off")
     p.add_argument("--grad_accum_steps", type=int, default=1,
                    help="split each batch into k microbatches scanned "
                         "inside the jitted step, applying the optimizer "
@@ -365,10 +379,26 @@ def _build_trainer(ns, args):
                 "devices, have %d — training unpipelined",
                 n_pipe, n_data, n_pipe * n_data, len(jax.devices()))
             n_pipe = 1
-    if n_pipe > 1:
+    n_fsdp = 1
+    if getattr(args, "fsdp", False):
+        # the data-parallel width moves onto the fsdp axis: batch rows
+        # still split over it (mesh.batch_axes includes fsdp), but
+        # parameters/slots pack 1/N per device instead of replicating
+        import jax
+        n_fsdp = (max(args.trainer_count, 1) if args.trainer_count > 1
+                  else len(jax.devices()) // max(n_pipe, 1))
+        if n_fsdp <= 1:
+            from paddle_tpu.utils import logger
+            logger.warning(
+                "--fsdp: only %d device(s) available per pipeline "
+                "stage — nothing to shard parameters over; training "
+                "with the replicated layout", n_fsdp)
+            n_fsdp = 1
+    if n_pipe > 1 or n_fsdp > 1:
         from paddle_tpu.parallel import create_mesh
-        mesh = create_mesh(n_data=max(args.trainer_count, 1),
-                           n_pipe=n_pipe)
+        mesh = create_mesh(
+            n_data=(max(args.trainer_count, 1) if n_fsdp == 1 else 1),
+            n_fsdp=n_fsdp, n_pipe=n_pipe)
     elif args.trainer_count > 1:
         from paddle_tpu.parallel import create_mesh
         mesh = create_mesh(n_data=args.trainer_count)
@@ -388,6 +418,10 @@ def _build_trainer(ns, args):
         # pipelined step; SGD.train(pipeline=None) keeps the mode sticky
         trainer.enable_pipeline(
             microbatches=getattr(args, "pipeline_microbatches", 0) or None)
+    if n_fsdp > 1:
+        # likewise HERE (after the pipeline stacks its body, so the
+        # fsdp plan sees the final layout); train(fsdp=None) is sticky
+        trainer.enable_fsdp()
     return trainer
 
 
@@ -531,6 +565,7 @@ def cmd_train(ns, args):
                                                   False),
                       zero1=True if getattr(args, "use_zero1", False)
                       else None,
+                      fsdp=True if getattr(args, "fsdp", False) else None,
                       grad_accum_steps=getattr(args, "grad_accum_steps",
                                                1),
                       checkpointer=ck,

@@ -118,6 +118,15 @@ class SGD:
         # train(zero1=True) / enable_zero1(); the updater replaces the
         # optimizer in the jitted step, everything else is unchanged
         self._zero1 = None
+        # full FSDP (optim/zero1.py:FsdpUpdater): disabled until
+        # train(fsdp=True) / enable_fsdp(); while active, eligible
+        # parameters live flat-packed (N, chunk) sharded 1/N over the
+        # mesh's fsdp axis, the step gathers each one per layer on use,
+        # and the shard-wise update keeps them sharded (--fsdp,
+        # docs/spec_layout.md)
+        self._fsdp = None
+        self._zero1_subsumed = False  # zero1 asked for while fsdp holds
+        # slots at 1/N already; re-armed if fsdp is later disabled
         # pipeline parallelism (parallel/pipeline.py:PipelineTrainPlan):
         # disabled until train(pipeline=...) / enable_pipeline(); while
         # active, body parameters live stage-stacked [S, ...] sharded
@@ -132,24 +141,29 @@ class SGD:
         key = jax.random.PRNGKey(seed)
         self.meta = self.network.param_meta()
         if mesh is not None:
-            # user rules + the sparse-table row-sharding default + the
-            # config's per-layer device placement (--parallel_nn) mapped
-            # to model-axis sharding
-            shard_rules = mesh_lib.effective_rules(
-                self.network.param_specs, mesh, shard_rules)
-            shard_rules = mesh_lib.device_attr_rules(
-                self.topology.graph, self.network.param_specs, mesh,
-                shard_rules)
-        self._shard_rules = shard_rules if mesh is not None else None
+            # the canonical sharding plane (parallel/layout.py): user
+            # rules + the sparse-table row-sharding default + the
+            # config's per-layer device placement (--parallel_nn) fold
+            # into ONE SpecLayout every derivation below queries —
+            # init shardings, slot placement, ZeRO-1/FSDP eligibility,
+            # and the pipeline's stage-stacked pins (installed via
+            # layout.pin in enable_pipeline)
+            from paddle_tpu.parallel.layout import SpecLayout
+            self.layout = SpecLayout(mesh, self.network.param_specs,
+                                     self.topology.graph, shard_rules)
+            # alias, not a copy: pipeline pins flow through both names
+            self._shard_rules = self.layout.rules
+        else:
+            self.layout = None
+            self._shard_rules = None
         if parameters is not None:
-            self.params = (mesh_lib.shard_params(parameters, mesh, shard_rules)
+            self.params = (self.layout.place_params(parameters)
                            if mesh is not None else parameters)
         else:
             # with a mesh, create parameters directly in their final
             # sharding (big tables never materialize on one device)
-            shardings = (mesh_lib.param_shardings(
-                self.network.param_specs, mesh, shard_rules)
-                if mesh is not None else None)
+            shardings = (self.layout.param_shardings(
+                self.network.param_specs) if mesh is not None else None)
             self.params = self.network.init_params(key, shardings=shardings)
         self.opt_state = self.optimizer.init(self.params, self.meta)
         # StaticPruningHook: masked weights are zero from step 0
@@ -157,8 +171,7 @@ class SGD:
                                                   self.opt_state)
         if mesh is not None:
             # slots/avg follow their owning parameter; scalars replicate
-            self.opt_state = mesh_lib.shard_opt_state(
-                self.opt_state, mesh, shard_rules)
+            self.opt_state = self.layout.place_opt_state(self.opt_state)
         # --prev_batch_state truncated BPTT (Trainer.cpp:396-418,
         # Flags.cpp:73): forward recurrent layers start each batch from the
         # previous batch's final state instead of zeros. Gradients are cut
@@ -575,7 +588,8 @@ class SGD:
         from paddle_tpu.core.argument import Argument
         plan = self._pipe
         head_net = self._pipe_head_net
-        updater = self._zero1 or self.optimizer
+        updater = self._fsdp or self._zero1 or self.optimizer
+        fsdp = self._fsdp
         meta = self.meta
         cost_name = self.topology.cost_name
         body_names = list(plan.body_param_names())
@@ -599,6 +613,11 @@ class SGD:
             fwd = plan.fwd(m_eff, train=True)
 
             def loss_fn(params, feed, rng):
+                if fsdp is not None:
+                    # gather-on-use: head parameters rebuild per layer
+                    # from their fsdp shards (stage-stacked body keys
+                    # are excluded from the plan by their P(pipe) pins)
+                    params = fsdp.full_params(params)
                 cast_params = self._cast_compute(params)
                 cast_feed = self._cast_compute(feed)
                 x = cast_feed[plan.body_in].value
@@ -641,10 +660,14 @@ class SGD:
             # (absorbed in enable_pipeline); accum/carry paths don't apply
             return self._build_pipe_step(with_stats=with_stats)
         network, optimizer, meta = self.network, self.optimizer, self.meta
-        # the ZeRO-1 updater is a drop-in for the optimizer's update
-        # protocol (optim/zero1.py); everything upstream of the update —
-        # forward, backward, metrics — is shared
-        updater = self._zero1 or self.optimizer
+        # the ZeRO-1/FSDP updaters are drop-ins for the optimizer's
+        # update protocol (optim/zero1.py); everything upstream of the
+        # update — forward, backward, metrics — is shared. Under FSDP
+        # the loss_fn additionally rebuilds each planned parameter from
+        # its shards (full_params: one all-gather per layer) before the
+        # forward, and the gradients flow back INTO the packed layout.
+        updater = self._fsdp or self._zero1 or self.optimizer
+        fsdp = self._fsdp
         accum_k = self.grad_accum_steps
         cost_name = self.topology.cost_name
         carry_layers = self._carry_layers
@@ -660,6 +683,8 @@ class SGD:
             if n in self.network.shape_infos})
 
         def loss_fn(params, feed, rng, carried, probes=None):
+            if fsdp is not None:
+                params = fsdp.full_params(params)
             outputs, updates = network.apply_with_state(
                 self._cast_compute(params), self._cast_compute(feed),
                 train=True, rng=rng, carried=carried, probes=probes,
@@ -748,6 +773,10 @@ class SGD:
             rngs = jax.random.split(rng, k_eff)
 
             def loss_micro(params, mfeed, mrng):
+                if fsdp is not None:
+                    # per-microbatch gather: the scan body re-gathers,
+                    # so only one microbatch's full params are live
+                    params = fsdp.full_params(params)
                 outputs, updates = network.apply_with_state(
                     self._cast_compute(params), self._cast_compute(mfeed),
                     train=True, rng=mrng, mesh=self.mesh)
@@ -849,6 +878,18 @@ class SGD:
         if self._zero1 is not None:
             return
         from paddle_tpu.utils import logger
+        if self._fsdp is not None:
+            # subsumption, not composition-by-negotiation: the FSDP
+            # updater already holds every planned slot at 1/N over the
+            # fsdp axis — remember the request so disabling fsdp later
+            # re-arms the plain zero1 layout instead of silently
+            # dropping it
+            logger.info(
+                "zero1 requested with FSDP active — already subsumed "
+                "(the fsdp updater partitions optimizer slots 1/N over "
+                "the fsdp axis alongside the parameters)")
+            self._zero1_subsumed = True
+            return
         if self.mesh is None or mesh_lib.data_parallel_degree(self.mesh) <= 1:
             logger.warning(
                 "zero1 requested but the mesh has no data-parallel axis "
@@ -864,16 +905,91 @@ class SGD:
     def disable_zero1(self):
         """Back to the replicated update: gather the sharded slots to
         their full shapes, restore the rule-driven placement
-        (``shard_opt_state``), drop the updater, rebuild the step. The
-        inverse of :meth:`enable_zero1`, so A/B comparisons on one SGD
-        instance measure what they claim to."""
+        (``SpecLayout.place_opt_state``), drop the updater, rebuild the
+        step. The inverse of :meth:`enable_zero1`, so A/B comparisons
+        on one SGD instance measure what they claim to."""
+        self._zero1_subsumed = False
         if self._zero1 is None:
             return
         self.opt_state = self._zero1.gather_opt_state(self.opt_state)
         self._zero1 = None
         if self.mesh is not None:
-            self.opt_state = mesh_lib.shard_opt_state(
-                self.opt_state, self.mesh, self._shard_rules)
+            self.opt_state = self.layout.place_opt_state(self.opt_state)
+        self._rebuild_train_step()
+
+    # ---------------------------------------------------------------- fsdp
+    def enable_fsdp(self) -> bool:
+        """Switch to full FSDP (``--fsdp``,
+        ``optim/zero1.py:FsdpUpdater``): eligible parameters AND their
+        optimizer slots reshard to flat-packed 1/N partitions of the
+        mesh's ``fsdp`` axis, the jitted step gathers each parameter
+        per layer on use, and the shard-wise update keeps everything
+        sharded — a model ~N× one device's memory trains on an N-way
+        fsdp axis. Eligibility comes from the canonical layout
+        (``SpecLayout.fsdp_eligible``), so model-sharded tables and
+        pipeline stage-stacked keys keep their own placement and the
+        modes compose. Returns True when FSDP is active; meshes without
+        an fsdp axis (and models with model averaging) WARN and stand
+        down — training continues with the replicated layout."""
+        if self._fsdp is not None:
+            return True
+        from paddle_tpu.utils import logger
+        if self.mesh is None or \
+                dict(self.mesh.shape).get(mesh_lib.FSDP_AXIS, 1) <= 1:
+            logger.warning(
+                "fsdp requested but the mesh has no %r axis to "
+                "partition parameters over (mesh=%s) — keeping the "
+                "replicated parameter layout; build one with "
+                "create_mesh(n_fsdp=N)", mesh_lib.FSDP_AXIS,
+                dict(self.mesh.shape) if self.mesh is not None else None)
+            return False
+        if "avg" in self.opt_state:
+            logger.warning(
+                "fsdp requested but model averaging ('avg' optimizer "
+                "state) is consumed whole at eval/save time and is not "
+                "packed — keeping the replicated parameter layout")
+            return False
+        # zero1 composes by subsumption: unwind its batch-axis slot
+        # layout first; the fsdp updater repartitions the same slots
+        # over the fsdp axis next to their parameters
+        if self._zero1 is not None:
+            self.disable_zero1()
+            self._zero1_subsumed = True
+        from paddle_tpu.optim.zero1 import FsdpUpdater
+        upd = FsdpUpdater(self.optimizer, self.mesh, self.params,
+                          self.meta, rules=self._shard_rules)
+        self.params = upd.pack_params(self.params)
+        self.opt_state = upd.convert_state(self.opt_state)
+        self._fsdp = upd
+        logger.info(
+            "fsdp enabled: %d parameters packed 1/%d over the %r axis "
+            "(gather-on-use per layer; slots follow)", len(upd.plan),
+            upd.n, mesh_lib.FSDP_AXIS)
+        self._rebuild_train_step()
+        return True
+
+    def disable_fsdp(self, _rearm_subsumed: bool = True):
+        """Back to the replicated parameter layout: unpack every planned
+        parameter and slot to full shapes, restore the rule-driven
+        placement, drop the updater — and re-arm plain ZeRO-1 when it
+        was subsumed by :meth:`enable_fsdp`. The inverse of
+        ``enable_fsdp``, so A/B runs and checkpoint crossings measure
+        what they claim to. ``_rearm_subsumed=False`` is the pipeline
+        toggle's private spelling: fsdp re-enables right after the
+        restack and re-subsumes directly, so the intermediate ZeRO-1
+        repack/gather round trips of the whole slot state would be
+        pure churn."""
+        if self._fsdp is None:
+            return
+        self.opt_state = self._fsdp.gather_opt_state(self.opt_state)
+        self.params = self._fsdp.unpack_params(self.params)
+        resub, self._zero1_subsumed = self._zero1_subsumed, False
+        self._fsdp = None
+        if self.mesh is not None:
+            self.params = self.layout.place_params(self.params)
+            self.opt_state = self.layout.place_opt_state(self.opt_state)
+        if resub and _rearm_subsumed:
+            self.enable_zero1()
         self._rebuild_train_step()
 
     def _rebuild_train_step(self):
@@ -983,9 +1099,17 @@ class SGD:
                 "body parameters %s take the sparse lazy update (per-row "
                 "t_rows bookkeeping is not stage-stackable)", sparse[:3])
 
-        # ZeRO-1 must wrap the STACKED layout: unwind it first, re-arm
-        # after (its plan excludes the stacked keys via the pipe rules and
-        # keeps partitioning the replicated head over the data axis)
+        # ZeRO-1/FSDP must wrap the STACKED layout: unwind them first,
+        # re-arm after (their plans exclude the stacked keys via the
+        # pipe pins the layout carries, and keep partitioning the
+        # replicated head over their own axes). A SUBSUMED zero1 is
+        # remembered, not re-armed: fsdp re-enables right after the
+        # restack and subsumes it again — re-arming in between would
+        # repack+gather the whole slot state twice for nothing.
+        refsdp = self._fsdp is not None
+        resub = refsdp and self._zero1_subsumed
+        if refsdp:
+            self.disable_fsdp(_rearm_subsumed=False)
         rezero = self._zero1 is not None
         if rezero:
             self.disable_zero1()
@@ -996,8 +1120,10 @@ class SGD:
         self.opt_state = plan.stack_opt_state(self.opt_state)
         self._flat_meta = self.meta
         self.meta = plan.stacked_meta(self.meta)
-        self._shard_rules = {**(self._shard_rules or {}),
-                             **plan.shard_rules()}
+        # the stage-stacked pins enter the CANONICAL layout, so every
+        # downstream derivation (slot placement, ZeRO-1/FSDP
+        # eligibility, PT505 hygiene) sees them through one table
+        self.layout.pin(plan.shard_rules())
         self._pipe = plan
         if microbatches:
             self._pipe_microbatches = int(microbatches)
@@ -1021,6 +1147,9 @@ class SGD:
             (plan.S - 1) / (plan.S + self._pipe_microbatches - 1))
         if rezero:
             self.enable_zero1()
+        if refsdp:
+            self.enable_fsdp()
+            self._zero1_subsumed = self._zero1_subsumed or resub
         self._rebuild_train_step()
         return True
 
@@ -1032,42 +1161,51 @@ class SGD:
         on/off freely."""
         if self._pipe is None:
             return
+        refsdp = self._fsdp is not None
+        resub = refsdp and self._zero1_subsumed
+        if refsdp:
+            self.disable_fsdp(_rearm_subsumed=False)
         rezero = self._zero1 is not None
         if rezero:
             self.disable_zero1()
         plan = self._pipe
-        for key in plan.shard_rules():
-            self._shard_rules.pop(key, None)
+        self.layout.unpin(plan.shard_rules())
         self.params = plan.unstack_params(self.params)
         self.opt_state = plan.unstack_opt_state(self.opt_state)
         self.meta = self._flat_meta or self.meta
         self._flat_meta = None
         if self.mesh is not None:
-            self.params = mesh_lib.shard_params(self.params, self.mesh,
-                                                self._shard_rules)
-            self.opt_state = mesh_lib.shard_opt_state(
-                self.opt_state, self.mesh, self._shard_rules)
+            self.params = self.layout.place_params(self.params)
+            self.opt_state = self.layout.place_opt_state(self.opt_state)
         self._pipe = None
         self._pipe_head_net = None
         self.breakdown.set_pipeline(0, 0)
         if rezero:
             self.enable_zero1()
+        if refsdp:
+            self.enable_fsdp()
+            self._zero1_subsumed = self._zero1_subsumed or resub
         self._rebuild_train_step()
 
     def _flat_params_view(self, params=None):
-        """Flat per-stage view of (possibly stage-stacked) params — jnp
-        slicing, so it works both eagerly and under a trace. Identity
-        when the pipeline is off."""
+        """Full flat view of the live params — fsdp-packed leaves
+        gathered back to their parameter shapes and stage-stacked
+        arrays unstacked to flat per-stage names. jnp ops, so it works
+        both eagerly and under a trace; identity when neither mode is
+        on. Eval, forward, merge, checkgrad and serving all read the
+        model through this one view."""
         params = self.params if params is None else params
+        if self._fsdp is not None:
+            params = self._fsdp.unpack_params(params)
         if self._pipe is not None:
-            return self._pipe.unstack_params(params)
+            params = self._pipe.unstack_params(params)
         return params
 
     def _configure_step(self, zero1: Optional[bool],
                         grad_accum_steps: Optional[int],
-                        pipeline=None):
-        # pipeline first: zero1 must build its plan over the final
-        # (possibly stage-stacked) parameter layout
+                        pipeline=None, fsdp: Optional[bool] = None):
+        # pipeline first: zero1/fsdp must build their plans over the
+        # final (possibly stage-stacked) parameter layout
         if pipeline is not None:
             if pipeline is False or pipeline == 0:
                 # 0 (a CLI-derived int flag) means OFF, same as False —
@@ -1112,6 +1250,10 @@ class SGD:
                     "here because the exactness claim holds only for "
                     "batch-stat-free models (moving averages are still "
                     "averaged across microbatches)", bn)
+        if fsdp is True:
+            self.enable_fsdp()
+        elif fsdp is False:
+            self.disable_fsdp()    # None = keep the current mode
         if zero1 is True:
             self.enable_zero1()
         elif zero1 is False:
@@ -1264,6 +1406,8 @@ class SGD:
         sharded<->replicated and pipelined<->unpipelined in any
         combination."""
         state = self.opt_state
+        if self._fsdp is not None:
+            state = self._fsdp.gather_opt_state(state)
         if self._zero1 is not None:
             state = self._zero1.gather_opt_state(state)
         if self._pipe is not None:
@@ -1271,12 +1415,12 @@ class SGD:
         return state
 
     def _params_for_save(self):
-        """Checkpoint view of the parameters: stage-stacked body params
-        unstack to the flat per-stage names (``_blk3.w0`` etc.), identical
-        to an unpipelined run's file."""
-        if self._pipe is not None:
-            return self._pipe.unstack_params(self.params)
-        return self.params
+        """Checkpoint view of the parameters: fsdp-packed leaves gather
+        to full shapes and stage-stacked body params unstack to the
+        flat per-stage names (``_blk3.w0`` etc.) — the on-disk format
+        (keys AND shapes) never depends on the run's layout, so resume
+        crosses fsdp/pipeline on/off in any combination."""
+        return self._flat_params_view()
 
     def _trainer_state_for_save(self):
         """The exact-resume state inventory beyond params/opt_state: the
@@ -1301,7 +1445,7 @@ class SGD:
               zero1: Optional[bool] = None,
               grad_accum_steps: Optional[int] = None,
               pipeline=None, auto_resume: bool = True,
-              health=None):
+              health=None, fsdp: Optional[bool] = None):
         """reader yields minibatches (lists of sample tuples); feeder
         converts them to Arguments (or pass feed dicts directly).
         ``log_period``>0 logs a TrainerStats-style line and dumps+resets the
@@ -1351,6 +1495,19 @@ class SGD:
         reference pserver's sharded update, ``ParameterServer2.cpp:362``).
         Tri-state: ``True`` enables, ``False`` disables (resharding the
         slots back), ``None`` (default) keeps the current mode.
+        ``fsdp`` (the ``--fsdp`` flag) goes further: eligible
+        PARAMETERS (not just slots) live flat-packed 1/N over the
+        mesh's dedicated ``fsdp`` axis with one all-gather per layer on
+        use and gradients reduce-scattered back into the packed layout
+        (``optim/zero1.py:FsdpUpdater``; ``docs/spec_layout.md``), so a
+        model ~N× one device's memory trains on the mesh. Same
+        tri-state; composes with ``pipeline`` (stage-stacked body keys
+        keep their pipe placement, the head shards over fsdp),
+        seq-parallel, and ``zero1`` (subsumed: slots already ride the
+        fsdp partition). Meshes without an fsdp axis
+        (``create_mesh(n_fsdp=N)``) warn and stand down. Checkpoints
+        stay format-compatible (gather-on-save, reshard-on-load), so
+        resume crosses fsdp on/off in both directions.
         ``grad_accum_steps`` (``--grad_accum_steps``) splits each batch
         into k microbatches scanned inside the jitted step, applying the
         optimizer (and clipping/decay) once on the accumulated gradient —
@@ -1386,7 +1543,7 @@ class SGD:
         parameters), ``None`` keeps the current mode. Configs or meshes
         the schedule cannot honor warn and stand down cleanly."""
         from paddle_tpu.utils import global_stat, logger, timer
-        self._configure_step(zero1, grad_accum_steps, pipeline)
+        self._configure_step(zero1, grad_accum_steps, pipeline, fsdp)
         self._configure_health(health, show_parameter_stats_period)
         hm = self._health
         if hm is not None:
@@ -1761,7 +1918,7 @@ class SGD:
                 # routed through the active updater so a zero1 state always
                 # goes through the delegate that understands its layout
                 self.params, self.opt_state = (
-                    self._zero1 or self.optimizer).catch_up(
+                    self._fsdp or self._zero1 or self.optimizer).catch_up(
                     self.params, self.opt_state, self.meta,
                     num_passes=pass_id)
                 if show_step_breakdown:
@@ -1903,6 +2060,12 @@ class SGD:
         if self._pipe is not None:
             params, opt_flat = self._pipe.restack_checkpoint(params,
                                                              opt_flat)
+        if self._fsdp is not None:
+            # checkpoints always store full-shape parameters
+            # (_params_for_save gathers): repack the planned ones into
+            # this run's (N, chunk) fsdp partition on the host so the
+            # placement below sees matching shapes
+            params = self._fsdp.pack_params_host(params)
 
         def place(new, old):
             arr = jnp.asarray(new, dtype=old.dtype)
@@ -1928,11 +2091,12 @@ class SGD:
                 if key not in opt_flat:
                     return tree
                 new = opt_flat[key]
-                if self._zero1 is not None:
+                upd = self._fsdp or self._zero1
+                if upd is not None:
                     # checkpoints always store full-shape slots
                     # (_opt_state_for_save gathers): reshard a planned
                     # slot into this run's (N, chunk) partition
-                    new = self._zero1.pack_for_load(key, new, tree)
+                    new = upd.pack_for_load(key, new, tree)
                 return place(new, tree)
 
             self.opt_state = restore(self.opt_state)

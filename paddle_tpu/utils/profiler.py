@@ -88,7 +88,9 @@ def memory_stats(params, opt_state=None, activations=None,
     accounting) all read it, so the return schema is a contract:
 
     - ``param_bytes_per_device`` (always) — parameter bytes one
-      device holds under the leaves' shardings.
+      device holds under the leaves' shardings (an FSDP run's packed
+      ``(N, chunk)`` leaves carry ``P(fsdp)``, so the ~1/N drop reads
+      straight off the real placement — no special case).
     - ``slot_bytes_per_device`` (when ``opt_state`` is a dict) —
       optimizer-slot bytes (``opt_state["slots"]``; the quantity
       ZeRO-1 divides by the data-parallel degree).

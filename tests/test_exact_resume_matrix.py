@@ -42,8 +42,15 @@ MATRIX = {
     "async_input": {"async_input"},
     "pipeline": {"pipeline"},
     "zero1_grad_accum_async": {"zero1", "grad_accum", "async_input"},
+    # full FSDP (r17): params packed 1/N over the fsdp axis — resume
+    # restores through the gather-on-save/reshard-on-load round trip
+    # and must stay BITWISE (same program twice; the pack padding
+    # provably stays zero, optim/zero1.py:FsdpUpdater docstring)
+    "fsdp": {"fsdp"},
+    "fsdp_grad_accum": {"fsdp", "grad_accum"},
 }
-REQUIRED_FEATURES = {"zero1", "pipeline", "grad_accum", "async_input"}
+REQUIRED_FEATURES = {"zero1", "pipeline", "grad_accum", "async_input",
+                     "fsdp"}
 
 # kill at the 7th training step (0-based global step 6 = pass 1, batch
 # 2): past the pass-1 batch-cadence save at batch 2, before the next —
@@ -75,7 +82,12 @@ def _build(features, seed=5):
     else:
         h = dsl.fc(input=x, size=WIDTH, act="tanh")
         h = dsl.dropout(input=h, rate=0.25)
-        mesh = create_mesh(n_data=2) if "zero1" in features else None
+        if "fsdp" in features:
+            mesh = create_mesh(n_data=2, n_fsdp=2)
+        elif "zero1" in features:
+            mesh = create_mesh(n_data=2)
+        else:
+            mesh = None
     out = dsl.fc(input=h, size=CLASSES, act="softmax", name="out")
     cost = dsl.classification_cost(input=out, label=lbl)
     return SGD(cost=cost, update_equation=Adam(learning_rate=3e-3),
@@ -106,6 +118,8 @@ def _train_kwargs(features):
         kw["async_load_data"] = True
     if "pipeline" in features:
         kw["pipeline"] = True
+    if "fsdp" in features:
+        kw["fsdp"] = True
     return kw
 
 

@@ -132,6 +132,64 @@ def test_training_health_table_is_machine_mapped():
     assert args.health_log == "/tmp/h.jsonl"
 
 
+# ------------------------------------------ TPU-native flags (X-rows)
+def _x_rows():
+    rows = []
+    for line in DOC.read_text().splitlines():
+        m = re.match(r"\|\s*X(\d+)\s*\|\s*`--([a-z_]+)`\s*\|\s*"
+                     r"\*{0,2}(spelled|absorbed|N/A-on-TPU)", line)
+        if m:
+            rows.append((int(m.group(1)), m.group(2), m.group(3)))
+    return rows
+
+
+def test_fsdp_row_is_machine_mapped():
+    """The round-16 supplementary table: --fsdp is present, spelled,
+    and parses through the CLI (same drift-proof contract as the core
+    and T-row audits)."""
+    rows = _x_rows()
+    assert [name for _, name, _ in rows] == ["fsdp"]
+    assert all(st == "spelled" for _, _, st in rows)
+    from paddle_tpu.trainer import cli
+    args = cli.parse_args(["--config", "x.py", "--fsdp"])
+    assert args.fsdp is True
+
+
+def test_fsdp_reaches_the_trainer():
+    """--fsdp is not parse-and-drop: through `_build_trainer` it builds
+    the fsdp mesh, packs the parameters 1/N, and one step actually
+    trains on the packed layout."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.config import dsl
+    from paddle_tpu.core.argument import Argument
+    from paddle_tpu.optim import Momentum
+    from paddle_tpu.trainer import cli
+
+    dsl.reset()
+    x = dsl.data(name="x", size=8)
+    lbl = dsl.data(name="label", size=2)
+    h = dsl.fc(input=x, size=8, act="tanh", name="fh")
+    out = dsl.fc(input=h, size=2, act="softmax", name="out")
+    cost = dsl.classification_cost(input=out, label=lbl)
+    ns = {"cost": cost, "optimizer": Momentum(learning_rate=0.1)}
+    args = cli.parse_args(["--config", "x.py", "--fsdp"])
+    trainer = cli._build_trainer(ns, args)
+    assert trainer._fsdp is not None and trainer._fsdp.n == 8
+    assert "fsdp" in trainer.mesh.axis_names
+    rng = np.random.RandomState(0)
+    feed = {"x": Argument(value=jnp.asarray(
+        rng.randn(8, 8).astype(np.float32))),
+        "label": Argument(value=jnp.asarray(
+            rng.randint(0, 2, 8).astype(np.int32)))}
+    costs = []
+    from paddle_tpu.trainer import events
+    trainer.train(lambda: iter([feed]), num_passes=1,
+                  event_handler=lambda e: costs.append(e.cost)
+                  if isinstance(e, events.EndIteration) else None)
+    assert costs and np.isfinite(costs).all()
+
+
 def test_error_clipping_threshold_reaches_the_sentry():
     """--error_clipping_threshold is not parse-and-drop: through the
     trainer it arms the divergence sentry with that threshold and an

@@ -1,0 +1,158 @@
+"""SpecLayout — the canonical 4D sharding plane (parallel/layout.py).
+
+One object owns the per-role PartitionSpec derivations every subsystem
+used to re-negotiate: batch placement (data × fsdp), the param rule
+table (user rules + sparse default + device-attr hints + pipeline
+pins), slot placement with THE non-divisible replicated fallback, and
+ZeRO-1/FSDP plan eligibility. These tests pin the derivation contracts
+— and that the fallback decision is the SAME predicate graftlint PT502
+gates on (``axis_divides``), so the placement and the audit can never
+disagree."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.parallel import create_mesh
+from paddle_tpu.parallel.layout import SpecLayout, axis_divides
+from paddle_tpu.parallel.mesh import (FSDP_AXIS, batch_axes,
+                                      data_parallel_degree)
+
+
+@pytest.fixture(scope="module")
+def mesh_df():
+    return create_mesh(n_data=2, n_fsdp=4)
+
+
+def test_fsdp_axis_joins_the_batch_axes(mesh_df):
+    """The fsdp axis carries batch rows exactly like data (HSDP): DP
+    degree is data × fsdp and the batch spec splits dim 0 over both."""
+    assert batch_axes(mesh_df) == ("data", "fsdp")
+    assert data_parallel_degree(mesh_df) == 8
+    lay = SpecLayout(mesh_df)
+    assert lay.batch_spec(2) == P(("data", "fsdp"), None)
+    assert lay.data == 2 and lay.fsdp == 4
+
+
+def test_mesh_composition_forms():
+    """create_mesh grows the documented 4D forms; pipe still refuses
+    model (a stage owns its whole layer)."""
+    m = create_mesh(n_data=1, n_fsdp=2, n_seq=2, n_pipe=2)
+    assert tuple(m.axis_names) == ("data", "fsdp", "seq", "pipe")
+    m2 = create_mesh(n_data=1, n_fsdp=2, n_seq=2, n_model=2)
+    assert tuple(m2.axis_names) == ("data", "fsdp", "seq", "model")
+    with pytest.raises(ValueError, match="n_model"):
+        create_mesh(n_model=2, n_pipe=2)
+
+
+def test_param_spec_precedence_exact_before_substring(mesh_df):
+    """The canonical table resolves =-exact keys first regardless of
+    insertion order — rule_for's proven contract, queried through the
+    layout."""
+    lay = SpecLayout(mesh_df, rules={"w0": P("data"),
+                                     "=_emb.w0": P(None)})
+    assert lay.rule_key("_emb.w0") == "=_emb.w0"
+    assert lay.param_spec("_emb.w0") == P(None)
+    assert lay.param_spec("_h.w0") == P("data")
+    assert lay.is_replicated("_other.b") is True
+
+
+def test_pins_flow_through_every_derivation(mesh_df):
+    """Pipeline stage-stacked pins become ordinary rules: after pin(),
+    the key stops being replicated (so the FSDP plan excludes it) and
+    slot placement follows the pinned spec; unpin() restores."""
+    lay = SpecLayout(mesh_df)
+    assert lay.fsdp_eligible("_blk.w0") is True
+    lay.pin({"=_blk.w0": P("data", None)})
+    assert lay.is_replicated("_blk.w0") is False
+    assert lay.fsdp_eligible("_blk.w0") is False
+    leaf = jnp.zeros((8, 4), jnp.float32)
+    assert lay.slot_sharding("_blk.w0", leaf).spec == P("data", None)
+    lay.unpin(["=_blk.w0"])
+    assert lay.fsdp_eligible("_blk.w0") is True
+
+
+def test_slot_fallback_is_the_pt502_gate(mesh_df, caplog):
+    """The non-divisible replicated fallback and graftlint PT502's
+    dividing-axis gate are ONE predicate (axis_divides): a dim the
+    predicate rejects falls back loudly, a dim it accepts shards."""
+    import logging
+    lay = SpecLayout(mesh_df, rules={"w": P("data", None)})
+    bad = jnp.zeros((13, 4), jnp.float32)   # 13 % 2 != 0
+    plogger = logging.getLogger("paddle_tpu")
+    plogger.addHandler(caplog.handler)
+    try:
+        sh = lay.slot_sharding("w", bad)
+    finally:
+        plogger.removeHandler(caplog.handler)
+    assert sh.spec == P() and "not divisible" in caplog.text
+    assert not axis_divides(13, 2)
+    good = jnp.zeros((6, 4), jnp.float32)
+    assert lay.slot_sharding("w", good).spec == P("data", None)
+    assert axis_divides(6, 2)
+    # the audit-side spelling of the same decision
+    assert lay.fits((13, 4), P("data", None)) is not None
+    assert lay.fits((6, 4), P("data", None)) is None
+
+
+def test_packed_layout_specs(mesh_df):
+    """ZeRO-1 packs over the batch axes; FSDP packs over the fsdp axis
+    alone (params must stay replicated across plain data so the batch
+    axes keep carrying independent rows)."""
+    lay = SpecLayout(mesh_df)
+    assert lay.packed_spec() == P(("data", "fsdp"))
+    assert lay.packed_spec(fsdp=True) == P((FSDP_AXIS,))
+
+
+def test_place_params_and_opt_state_derive_from_one_table(mesh_df):
+    lay = SpecLayout(mesh_df, rules={"=w": P("data", None)})
+    params = {"w": jnp.ones((8, 4)), "b": jnp.ones((4,))}
+    placed = lay.place_params(params)
+    assert placed["w"].sharding.spec == P("data", None)
+    assert placed["b"].sharding.is_fully_replicated
+    state = {"slots": {"w": {"m": jnp.ones((8, 4))},
+                       "b": {"m": jnp.ones((4,))}},
+             "t": jnp.zeros(())}
+    st = lay.place_opt_state(state)
+    assert st["slots"]["w"]["m"].sharding.spec == P("data", None)
+    assert st["slots"]["b"]["m"].sharding.is_fully_replicated
+    assert st["t"].sharding.is_fully_replicated
+
+
+def test_mesh_wrappers_delegate_to_the_layout(mesh_df):
+    """shard_params/param_shardings/shard_opt_state are compatibility
+    wrappers over SpecLayout — same placements either way."""
+    from paddle_tpu.parallel import mesh as mesh_lib
+    rules = {"=w": P("data", None)}
+    params = {"w": jnp.ones((8, 4)), "b": jnp.ones((4,))}
+    a = mesh_lib.shard_params(params, mesh_df, rules)
+    b = SpecLayout(mesh_df, rules=rules).place_params(params)
+    for k in params:
+        assert a[k].sharding == b[k].sharding
+    sh = mesh_lib.param_shardings(["w", "b"], mesh_df, rules)
+    assert sh["w"].spec == P("data", None)
+
+
+def test_trainer_layout_is_the_single_source():
+    """SGD builds ONE SpecLayout; its rules object IS _shard_rules (an
+    alias, so pipeline pins installed via layout.pin are visible
+    everywhere), and the fsdp plan asks the same table."""
+    from paddle_tpu.config import dsl
+    from paddle_tpu.optim import Momentum
+    from paddle_tpu.trainer import SGD
+    dsl.reset()
+    x = dsl.data(name="x", size=8)
+    lab = dsl.data(name="label", size=2)
+    h = dsl.fc(input=x, size=8, act="tanh", name="h")
+    out = dsl.fc(input=h, size=2, act="softmax", name="out")
+    cost = dsl.classification_cost(input=out, label=lab)
+    tr = SGD(cost=cost, update_equation=Momentum(learning_rate=0.1),
+             mesh=create_mesh(n_data=8), seed=0)
+    assert tr.layout is not None
+    assert tr._shard_rules is tr.layout.rules
+    rows = tr.layout.describe(sorted(tr.params))
+    assert rows[0][1] == "batch"
+    assert {r[0] for r in rows[1:]} == set(tr.params)

@@ -113,7 +113,7 @@ def test_pass2_jaxpr_audit_train_and_serving():
 
 @pytest.fixture(scope="module")
 def compiled_programs():
-    """ONE SPMD-compile of the six traced programs feeding both the
+    """ONE SPMD-compile of the eight traced programs feeding both the
     pass-4 and pass-5 tier-1 tests — the same sharing the CLI does
     (compile is the slowest step on the 1-core host)."""
     from paddle_tpu.analysis.shard_audit import compile_programs
@@ -138,7 +138,7 @@ def test_pass4_shard_audit_clean_and_budget_pins_all_programs(
         findings, "Pass 4 (sharding/collective audit) found violations:")
     budgeted = {e.program for e in load_budget()}
     for name in ("dp_train", "zero1", "pipeline", "tp_embed",
-                 "seq_ring"):
+                 "seq_ring", "fsdp_train", "fsdp_pipe"):
         assert name in budgeted, f"{name} lost its pinned manifest"
     assert set(budgeted) <= set(PROGRAM_NAMES)
     # serving stays collective-free BY ABSENCE: any collective it

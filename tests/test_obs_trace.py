@@ -355,6 +355,11 @@ def test_master_rpc_spans_pair_under_one_trace(tracer):
     spans = tracer.spans()
     cli = [s for s in spans if s["name"] == "rpc.heartbeat"]
     srv = [s for s in spans if s["name"] == "rpc.server.heartbeat"]
-    assert len(cli) == 1 and len(srv) == 1
-    assert srv[0]["trace_id"] == cli[0]["trace_id"]
-    assert srv[0]["parent_id"] == cli[0]["span_id"]
+    # a slow 1-core host can refuse the FIRST connect, and each client
+    # retry legitimately records its own attempt span — the contract
+    # under test is the PAIRING (the answered attempt and the server
+    # span share one trace, parent-linked), not the attempt count
+    assert cli and len(srv) == 1
+    mate = [c for c in cli if c["trace_id"] == srv[0]["trace_id"]]
+    assert len(mate) == 1, (srv, cli)
+    assert srv[0]["parent_id"] == mate[0]["span_id"]

@@ -283,3 +283,58 @@ def test_pipeline_microbatch_gcd_fallback():
 
     cs = _train(tr, reader, passes=1, pipeline={"microbatches": 4})
     assert len(cs) == 2 and np.isfinite(cs).all()
+
+
+def test_pipeline_composes_with_seq_parallel_head():
+    """A (data, seq, pipe) mesh — no fsdp — trains a device-attr-staged
+    body with a ring seq-parallel attention HEAD gradient-exact vs the
+    unsharded run: the pipeline's shard_map leaves the seq axis
+    unmentioned (replicated across it) while the head's attention runs
+    its own ring schedule over seq. Pins the create_mesh composition
+    form the r17 relaxation opened (previously seq+pipe raised)."""
+    W, T, B_ = 8, 4, 8
+
+    def model():
+        dsl.reset()
+        x = dsl.data(name="x", size=W)
+        s = dsl.data(name="s", size=W, is_sequence=True)
+        lbl = dsl.data(name="label", size=CLASSES)
+        h = dsl.fc(input=x, size=W, act="tanh", name="sp0",
+                   layer_attr={"device": 0})
+        h = dsl.fc(input=h, size=W, act="tanh", name="sp1",
+                   layer_attr={"device": 1})
+        att = dsl.multi_head_attention(s, num_heads=2,
+                                       seq_parallel="ring", name="satt")
+        pooled = dsl.pooling(input=att, pooling_type="avg", name="spool")
+        comb = dsl.fc(input=[h, pooled], size=W, act="tanh", name="scmb")
+        out = dsl.fc(input=comb, size=CLASSES, act="softmax", name="sout")
+        return dsl.classification_cost(input=out, label=lbl)
+
+    rng = np.random.RandomState(9)
+    X = rng.randn(2 * B_, W).astype(np.float32)
+    S = rng.randn(2 * B_, T, W).astype(np.float32)
+    Y = rng.randint(0, CLASSES, 2 * B_).astype(np.int32)
+
+    def reader():
+        for i in range(0, 2 * B_, B_):
+            yield {"x": Argument(value=jnp.asarray(X[i:i + B_])),
+                   "s": Argument(value=jnp.asarray(S[i:i + B_]),
+                                 mask=jnp.ones((B_, T), jnp.float32)),
+                   "label": Argument(value=jnp.asarray(Y[i:i + B_]))}
+
+    def run(mesh, pipeline):
+        tr = SGD(cost=model(), update_equation=Adam(learning_rate=3e-3),
+                 mesh=mesh, seed=4)
+        tr.train(reader, num_passes=2, pipeline=pipeline)
+        return tr
+
+    base = run(None, None)
+    mesh = create_mesh(n_data=2, n_seq=2, n_pipe=2)
+    assert tuple(mesh.axis_names) == ("data", "seq", "pipe")
+    tr = run(mesh, True)
+    assert tr._pipe is not None and tr._pipe.S == 2
+    got = tr._params_for_save()
+    for k in base.params:
+        np.testing.assert_allclose(np.asarray(base.params[k]),
+                                   np.asarray(got[k]),
+                                   rtol=0, atol=1e-7, err_msg=k)

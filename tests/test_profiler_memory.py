@@ -73,3 +73,16 @@ def test_tree_device_bytes_counts_shard_not_global():
     sharded = jax.device_put(jnp.ones((64, 4)),
                              NamedSharding(mesh, P("data", None)))
     assert tree_device_bytes([sharded]) == 64 * 4 * 4 // 8
+
+
+def test_memory_stats_reports_fsdp_packed_param_bytes():
+    """FSDP param accounting needs no special case: the packed (N,
+    chunk) leaves carry their P(fsdp) sharding, so memory_stats reads
+    the 1/N per-device bytes straight from the REAL shardings — the
+    figure --show_step_breakdown logs and PT605 reconciles against
+    the compiled fsdp_train manifest."""
+    mesh = create_mesh(n_fsdp=8)
+    packed = jax.device_put(jnp.ones((8, 16)),
+                            NamedSharding(mesh, P("fsdp", None)))
+    stats = memory_stats({"w": packed})
+    assert stats["param_bytes_per_device"] == 8 * 16 * 4 // 8
