@@ -525,6 +525,127 @@ def bench_fsdp(batches=None, batch_size=64):
     return out
 
 
+def bench_overlap(batches=None, batch_size=64):
+    """FSDP gather-overlap x fused-kernel 2x2 A/B (r18): the SAME
+    LSTM-classifier config trained on the fsdp mesh under every
+    combination of {sync, overlap-forced} gather spelling x {inline,
+    fused} LSTM-cell + optimizer kernels. Reports each arm's best-of
+    steps/s (interleaved rounds, the host-drift rule) plus the
+    exposed-collective split from ``StepBreakdown``: the sync spelling
+    exposes every gather + reduce (2 per layer), the double-buffered
+    chain exposes only the first gather and last reduce — the
+    ``fsdp_exposed_*`` keys are the structural claim a 1-core CPU
+    can certify even though its step-time ratio is dispatch-bound
+    (on ICI the step time is where the overlap pays). All four arms'
+    final params are ASSERTED bitwise identical in-bench — the
+    overlap chain is an ``optimization_barrier`` (identity on
+    values) and the fused kernels' fallback spelling IS the inline
+    math, so a nonzero diff is a correctness bug, not noise.
+    CPU-runnable off-tunnel (``python bench.py --overlap`` writes
+    BENCH_r18.json); rides the tpu_watch capture as a child extra."""
+    import jax
+    import numpy as np
+    from paddle_tpu import kernels
+    from paddle_tpu.config import dsl
+    from paddle_tpu.data import (DataFeeder, integer_value,
+                                 integer_value_sequence)
+    from paddle_tpu.models import lstm_text_classifier
+    from paddle_tpu.optim import Adam
+    from paddle_tpu.optim import zero1
+    from paddle_tpu.parallel import create_mesh
+    from paddle_tpu.trainer import SGD
+
+    batches = int(os.environ.get("BENCH_OVERLAP_BATCHES", "12")
+                  if batches is None else batches)
+    vocab, seqlen = 5000, 32
+    n_dev = len(jax.devices())
+    mesh = create_mesh(n_fsdp=n_dev)
+
+    types = {"words": integer_value_sequence(vocab),
+             "label": integer_value(2)}
+    rng = np.random.RandomState(0)
+    data = [(list(rng.randint(0, vocab, size=seqlen)),
+             int(rng.randint(0, 2))) for _ in range(batch_size)]
+    feeder = DataFeeder(types, pad_multiple=seqlen)
+
+    def reader():
+        for _ in range(batches):
+            yield data
+
+    def arm_ctx(overlap, fused):
+        """The trace-time switches an arm runs under — held for BOTH
+        the compiling warmup and the timed passes ("force"/"off"
+        rather than auto so the A/B is honest on CPU too)."""
+        import contextlib
+        st = contextlib.ExitStack()
+        st.enter_context(
+            zero1.overlap_spelling("force" if overlap else "off"))
+        st.enter_context(kernels.fused_rnn(fused))
+        st.enter_context(kernels.fused_optimizer(fused))
+        return st
+
+    def build(overlap, fused):
+        dsl.reset()
+        cost, out, _ = lstm_text_classifier(
+            vocab_size=vocab, embed_dim=64, hidden=96, num_layers=1,
+            classes=2)
+        tr = SGD(cost=cost, update_equation=Adam(learning_rate=1e-3),
+                 mesh=mesh, seed=0)
+        with arm_ctx(overlap, fused):
+            # compile + packing conversion outside the measured passes
+            tr.train(lambda: iter([data, data]), feeder=feeder,
+                     num_passes=1, fsdp=True, fsdp_overlap=overlap)
+        return tr
+
+    arms = [(False, False), (True, False), (False, True), (True, True)]
+    trainers = {a: build(*a) for a in arms}
+    best = {a: 0.0 for a in arms}
+    for _ in range(int(os.environ.get("BENCH_OVERLAP_ROUNDS", "2"))):
+        for a, tr in trainers.items():
+            with arm_ctx(*a):
+                tr.train(reader, feeder=feeder, num_passes=1)
+            best[a] = max(best[a],
+                          tr.step_breakdown()["steps_per_sec"])
+    # the acceptance claim is bitwise neutrality of BOTH planes:
+    # every arm must land on the baseline's exact trajectory
+    base = {k: np.asarray(jax.device_get(v)) for k, v in
+            trainers[(False, False)]._params_for_save().items()}
+    for a in arms[1:]:
+        for k, v in trainers[a]._params_for_save().items():
+            assert np.array_equal(base[k], np.asarray(jax.device_get(v))), \
+                f"arm overlap={a[0]} fused={a[1]} diverged at {k}"
+    sb_off = trainers[(False, False)].step_breakdown()
+    sb_on = trainers[(True, False)].step_breakdown()
+    with arm_ctx(True, False):
+        peak_overlap = trainers[(True, False)]._gather_peak()
+    with arm_ctx(False, False):
+        peak_sync = trainers[(False, False)]._gather_peak()
+    return {
+        "overlap_devices": n_dev,
+        "overlap_off_steps_per_sec": round(best[(False, False)], 3),
+        "overlap_on_steps_per_sec": round(best[(True, False)], 3),
+        "overlap_vs_sync_steps": (
+            round(best[(True, False)] / best[(False, False)], 3)
+            if best[(False, False)] else None),
+        "fused_steps_per_sec": round(best[(False, True)], 3),
+        "overlap_fused_steps_per_sec": round(best[(True, True)], 3),
+        "exposed_collectives_overlap_off":
+            int(sb_off["fsdp_exposed_collectives"]),
+        "exposed_collectives_overlap_on":
+            int(sb_on["fsdp_exposed_collectives"]),
+        "exposed_comm_frac_overlap_off":
+            round(sb_off["fsdp_exposed_comm_frac"], 4),
+        "exposed_comm_frac_overlap_on":
+            round(sb_on["fsdp_exposed_comm_frac"], 4),
+        "overlap_gathers_per_step": int(sb_on["fsdp_gathers_per_step"]),
+        "overlap_gather_peak_bytes": int(peak_overlap or 0),
+        "sync_gather_peak_bytes": int(peak_sync or 0),
+        "overlap_bitwise_identical": True,
+        "overlap_batches": batches,
+        "overlap_batch_size": batch_size,
+    }
+
+
 def bench_pipeline(batches=None, batch_size=64, hidden=256, n_stages=4,
                    layers_per_stage=4, microbatches=None):
     """Pipeline-parallel A/B: the SAME deep-MLP config (per-layer device
@@ -1891,6 +2012,28 @@ def fsdp_main():
     return 0
 
 
+def overlap_main():
+    """``python bench.py --overlap``: the off-tunnel FSDP-overlap x
+    fused-kernel 2x2 A/B alone, forced onto an 8-virtual-device CPU
+    mesh (no tunnel involvement); one JSON line, mirrored to
+    BENCH_r18.json."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    result = {"metric": "overlap_fsdp_fused_ab",
+              "platform": jax.devices()[0].platform}
+    result.update(bench_overlap())
+    line = json.dumps(result)
+    print(line, flush=True)
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, "BENCH_r18.json"), "w") as f:
+        f.write(line + "\n")
+    return 0
+
+
 def health_main():
     """``python bench.py --health``: the off-tunnel training-health A/B
     alone, forced onto CPU (no tunnel involvement); one JSON line,
@@ -2013,6 +2156,12 @@ def child_main():
     # so the on-chip capture is where the ratio gets honest (off-tunnel
     # number: BENCH_r17.json via --fsdp)
     extra("fsdp", bench_fsdp)
+    # FSDP gather-overlap x fused-kernel 2x2 (r18): on ICI the overlap
+    # arm is where the exposed-comm shrink turns into step time, and
+    # the fused arms take the real Pallas path — the on-chip capture
+    # is the honest one (off-tunnel number: BENCH_r18.json via
+    # --overlap)
+    extra("overlap", bench_overlap)
     # pipeline-parallel A/B over the real mesh — on ICI the ppermute
     # hand-off overlaps compute, so this is where the schedule's win can
     # actually show (off-tunnel number: BENCH_r08.json via --pipeline)
@@ -2056,6 +2205,8 @@ def main():
         return zero1_main()
     if "--fsdp" in sys.argv[1:]:
         return fsdp_main()
+    if "--overlap" in sys.argv[1:]:
+        return overlap_main()
     if "--pipeline" in sys.argv[1:]:
         return pipeline_main()
     if "--serving" in sys.argv[1:]:

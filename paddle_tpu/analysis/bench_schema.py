@@ -56,7 +56,13 @@ looser schema):
   must FURTHER carry ``autoscale_replica_trajectory`` (a non-empty list
   of replica counts — did the count follow the ramp inside the
   bounds?), ``autoscale_p99_ms``, and ``fleet_failed_non_shed`` summed
-  across rounds.
+  across rounds. Metrics starting with ``overlap`` (BENCH_r18, the
+  FSDP gather-overlap x fused-kernel 2x2) must carry the step-time A/B
+  sides (``overlap_on_steps_per_sec`` / ``overlap_off_steps_per_sec``),
+  the int exposed-collective counts
+  (``exposed_collectives_overlap_on`` / ``..._off``) and the numeric
+  exposed-comm fractions (``exposed_comm_frac_overlap_on`` /
+  ``..._off``) — the structural overlap evidence.
 
 Everything must parse as one JSON object with finite numbers
 throughout (NaN/Infinity are emitted by a crashed averaging step and
@@ -259,6 +265,26 @@ def check_bench_file(path: str, rel: str) -> List[Finding]:
             if not isinstance(v, int) or isinstance(v, bool):
                 bad("autoscale artifact missing int "
                     "'fleet_failed_non_shed' summed across rounds")
+        if str(data.get("metric", "")).startswith("overlap"):
+            # the r18 FSDP-overlap generation (BENCH_r18): the overlap
+            # claim is only evidence with BOTH step-time sides AND the
+            # exposed-collective split — the structural number a 1-core
+            # CPU certifies even when its step-time ratio is
+            # dispatch-bound
+            for k in ("overlap_on_steps_per_sec",
+                      "overlap_off_steps_per_sec",
+                      "exposed_comm_frac_overlap_on",
+                      "exposed_comm_frac_overlap_off"):
+                v = data.get(k)
+                if not isinstance(v, (int, float)) or isinstance(v, bool):
+                    bad(f"overlap artifact missing numeric {k!r} "
+                        "(the A/B sides + exposed-comm evidence)")
+            for k in ("exposed_collectives_overlap_on",
+                      "exposed_collectives_overlap_off"):
+                v = data.get(k)
+                if not isinstance(v, int) or isinstance(v, bool):
+                    bad(f"overlap artifact missing int {k!r} (the "
+                        "exposed-collective count per side)")
         for key, val in data.items():
             if "_vs_" not in key:
                 continue

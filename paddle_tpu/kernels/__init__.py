@@ -1,0 +1,21 @@
+"""Fused-kernel plane: Pallas-on-TPU cells with bitwise fallback
+spellings, selected at trace time (``docs/kernels.md``).
+
+No threads, no device state — pure trace-time dispatch (the pass-3
+lock audit's scope assertion in ``tests/test_kernels.py`` pins this).
+"""
+
+from paddle_tpu.kernels import opt_update
+from paddle_tpu.kernels.dispatch import (fused_optimizer,
+                                         fused_optimizer_enabled,
+                                         fused_rnn, rnn_cells_enabled,
+                                         set_fused_optimizer,
+                                         set_fused_rnn)
+from paddle_tpu.kernels.rnn_cells import gru_cell, lstm_cell
+
+__all__ = [
+    "opt_update", "lstm_cell", "gru_cell",
+    "fused_rnn", "fused_optimizer",
+    "rnn_cells_enabled", "fused_optimizer_enabled",
+    "set_fused_rnn", "set_fused_optimizer",
+]

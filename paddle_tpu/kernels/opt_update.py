@@ -1,0 +1,160 @@
+"""Fused optimizer-update kernels (the dense Momentum/Adam chains).
+
+Reference precedent: ``paddle/math/TrainingAlgorithmOp.cu`` fuses each
+optimizer's whole elementwise update into one kernel; the jnp spelling
+in ``optim/optimizers.py`` stages it as 6-10 separate HBM-bound HLOs
+per parameter. ``apply_one`` is the single routing point: called from
+``Optimizer._update_param``'s dense branch, so the replicated step, the
+ZeRO-1 shard-wise update and the packed FSDP update all reuse it.
+
+Contract (``docs/kernels.md``):
+
+- the fallback IS ``Optimizer._apply_one`` — off-TPU (or for any
+  optimizer/slot/dtype shape the kernels don't cover) the routing is
+  the identity, bitwise by construction;
+- the Pallas spelling is numerically the same chain; its outputs feed
+  the same slot dict shape ``_update_param`` expects (``prune_mask``
+  re-attachment happens in the caller, as for ``_apply_one``);
+- operands flatten and zero-pad to ``[rows x LANE]`` tiles via
+  ``concatenate`` (CLAUDE.md bit-stability note); the padded region is
+  a fixed point of both chains (all-zero in, all-zero out — Adam's
+  ``eps`` keeps the quotient finite), so the unpad slice is exact.
+
+Traced scalars (lr / Adam's bias-corrected alpha) ride SMEM ``(1, 1)``
+blocks; static hyper-parameters are kernel constants.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops import common
+
+
+def _ceil_to(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+def _pad_flat(x):
+    """Flatten and zero-pad to an ``[R, LANE]`` tile, R a multiple of 8."""
+    n = x.size
+    cols = common.LANE
+    rows = max(8, _ceil_to(-(-n // cols), 8))
+    flat = jnp.reshape(x, (n,))
+    pad = rows * cols - n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), x.dtype)])
+    return jnp.reshape(flat, (rows, cols))
+
+
+def _unpad_flat(y, like):
+    return jnp.reshape(jnp.reshape(y, (-1,))[:like.size], like.shape)
+
+
+def _smem_scalar(v):
+    return jnp.reshape(jnp.asarray(v, jnp.float32), (1, 1))
+
+
+def _specs(n_tiles, tile_shape, n_scalars):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    tile = pl.BlockSpec(tile_shape, lambda t: (0, 0),
+                        memory_space=pltpu.VMEM)
+    scalar = pl.BlockSpec((1, 1), lambda t: (0, 0),
+                          memory_space=pltpu.SMEM)
+    return [tile] * n_tiles + [scalar] * n_scalars, tile
+
+
+def _eligible(arrays):
+    shape = arrays[0].shape
+    for a in arrays:
+        if a.dtype != jnp.float32 or a.shape != shape:
+            return False
+    rows = max(8, _ceil_to(-(-arrays[0].size // common.LANE), 8))
+    resident = (len(arrays) * 2) * rows * common.LANE * 4
+    return common.use_pallas(resident)
+
+
+# --------------------------------------------------------------- momentum
+
+def _momentum_kernel(mu, p_ref, g_ref, m_ref, lr_ref, decay_ref,
+                     p_out, m_out):
+    lr = lr_ref[0, 0]
+    decay = decay_ref[0, 0]
+    mom = mu * m_ref[:] - lr * (g_ref[:] + decay * p_ref[:])
+    p_out[:] = p_ref[:] + mom
+    m_out[:] = mom
+
+
+def _momentum_fused(p, g, m, lr, mu, decay):
+    from jax.experimental import pallas as pl
+    pp, gp, mp = _pad_flat(p), _pad_flat(g), _pad_flat(m)
+    in_specs, tile = _specs(3, pp.shape, 2)
+    p2, m2 = pl.pallas_call(
+        functools.partial(_momentum_kernel, mu),
+        grid=(1,),
+        in_specs=in_specs,
+        out_specs=(tile, tile),
+        out_shape=(jax.ShapeDtypeStruct(pp.shape, jnp.float32),
+                   jax.ShapeDtypeStruct(pp.shape, jnp.float32)),
+        interpret=common.interpret(),
+    )(pp, gp, mp, _smem_scalar(lr), _smem_scalar(decay))
+    return _unpad_flat(p2, p), {"mom": _unpad_flat(m2, m)}
+
+
+# ------------------------------------------------------------------- adam
+
+def _adam_kernel(b1, b2, eps, p_ref, g_ref, m_ref, v_ref, alpha_ref,
+                 decay_ref, p_out, m_out, v_out):
+    alpha = alpha_ref[0, 0]
+    decay = decay_ref[0, 0]
+    g = g_ref[:] + decay * p_ref[:]
+    mom = b1 * m_ref[:] + (1 - b1) * g
+    v = b2 * v_ref[:] + (1 - b2) * jnp.square(g)
+    p_out[:] = p_ref[:] - alpha * mom / (jnp.sqrt(v) + eps)
+    m_out[:] = mom
+    v_out[:] = v
+
+
+def _adam_fused(p, g, m, v, lr, t, b1, b2, eps, decay):
+    from jax.experimental import pallas as pl
+    tf = t.astype(jnp.float32)
+    # the bias correction is scalar math — hoisted out of the kernel
+    alpha = lr * jnp.sqrt(1 - jnp.power(b2, tf)) / (1 - jnp.power(b1, tf))
+    pp, gp, mp, vp = (_pad_flat(p), _pad_flat(g), _pad_flat(m),
+                      _pad_flat(v))
+    in_specs, tile = _specs(4, pp.shape, 2)
+    p2, m2, v2 = pl.pallas_call(
+        functools.partial(_adam_kernel, b1, b2, eps),
+        grid=(1,),
+        in_specs=in_specs,
+        out_specs=(tile, tile, tile),
+        out_shape=(jax.ShapeDtypeStruct(pp.shape, jnp.float32),) * 3,
+        interpret=common.interpret(),
+    )(pp, gp, mp, vp, _smem_scalar(alpha), _smem_scalar(decay))
+    return _unpad_flat(p2, p), {"mom": _unpad_flat(m2, m),
+                                "v": _unpad_flat(v2, v)}
+
+
+# ---------------------------------------------------------------- routing
+
+def apply_one(opt, p, g, slots, lr, decay, t):
+    """Fused stand-in for ``opt._apply_one`` on the dense path. The slot
+    dict may carry ``prune_mask`` (ignored here, re-attached by
+    ``_update_param``, matching ``_apply_one``'s contract)."""
+    from paddle_tpu.kernels import dispatch
+    if not dispatch.fused_optimizer_enabled():
+        return opt._apply_one(p, g, slots, lr, decay, t)
+    kind = type(opt).__name__
+    keys = set(slots) - {"prune_mask"}
+    if (kind == "Momentum" and not getattr(opt, "nesterov", False)
+            and keys == {"mom"} and _eligible((p, g, slots["mom"]))):
+        return _momentum_fused(p, g, slots["mom"], lr, opt.momentum, decay)
+    if (kind == "Adam" and keys == {"mom", "v"}
+            and _eligible((p, g, slots["mom"], slots["v"]))):
+        return _adam_fused(p, g, slots["mom"], slots["v"], lr, t,
+                           opt.beta1, opt.beta2, opt.epsilon, decay)
+    return opt._apply_one(p, g, slots, lr, decay, t)

@@ -71,7 +71,9 @@ PT602, a full-gather materialization fails PT604).
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+import contextlib
+import os
+from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -81,6 +83,51 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from paddle_tpu.core.registry import ParamSpec
 from paddle_tpu.optim.optimizers import Optimizer
 from paddle_tpu.parallel import mesh as mesh_lib
+
+# Overlap-spelling override for the FSDP gather path (r18): None = auto
+# (double-buffer chain on TPU, sync spelling elsewhere — the CPU audit
+# compiles must stage the exact program the budgets were pinned on);
+# "force" = stage the chain regardless of backend (tests, bench A/B);
+# "off" = pin the sync spelling.
+_OVERLAP_FORCED: Optional[str] = os.environ.get(
+    "PADDLE_TPU_FSDP_OVERLAP") or None
+
+
+@contextlib.contextmanager
+def overlap_spelling(mode: Optional[str]):
+    """Force the FSDP gather-overlap spelling for a scope ("force" /
+    "off" / None=auto). Trace-time only — it picks which program gets
+    staged; re-jit after changing it."""
+    global _OVERLAP_FORCED
+    prev, _OVERLAP_FORCED = _OVERLAP_FORCED, mode
+    try:
+        yield
+    finally:
+        _OVERLAP_FORCED = prev
+
+
+@jax.custom_vjp
+def _prefetch_fence(leaf, prev_gathered):
+    """``optimization_barrier`` on (next gather's input, previous
+    gather's output): identity on values, but the scheduler cannot
+    start gather k+1 before gather k materialises. custom_vjp because
+    the primitive has no differentiation rule — and the backward we
+    want is the SAME fence on the cotangents, which serializes the
+    grad reduce-scatters pairwise in reverse schedule order (each one
+    overlapping the previous layer's backward compute)."""
+    return jax.lax.optimization_barrier((leaf, prev_gathered))
+
+
+def _prefetch_fence_fwd(leaf, prev_gathered):
+    return jax.lax.optimization_barrier((leaf, prev_gathered)), None
+
+
+def _prefetch_fence_bwd(_, ct):
+    ct_leaf, ct_prev = ct
+    return jax.lax.optimization_barrier((ct_leaf, ct_prev))
+
+
+_prefetch_fence.defvjp(_prefetch_fence_fwd, _prefetch_fence_bwd)
 
 
 class Zero1Updater:
@@ -106,6 +153,7 @@ class Zero1Updater:
         # axis for FsdpUpdater — one packing, two layouts, derived in
         # one place
         layout = SpecLayout(mesh, rules=rules)
+        self.layout = layout
         self.axes = layout.packed_axes(fsdp=fsdp)
         self._packed_sharding = layout.packed_sharding(fsdp=fsdp)
         n = 1
@@ -126,6 +174,7 @@ class Zero1Updater:
         # model-sharded tables and pipeline stage-stacked keys follow
         # their own rule instead of the flat packing.
         self.plan: Dict[str, tuple] = {}
+        self.dtypes: Dict[str, np.dtype] = {}
         for name, p in params.items():
             spec = self.meta.get(name)
             if not layout.fsdp_eligible(name, spec, optimizer):
@@ -136,6 +185,7 @@ class Zero1Updater:
                 size *= d
             chunk = -(-size // self.n)  # ceil
             self.plan[name] = (shape, size, chunk)
+            self.dtypes[name] = np.dtype(p.dtype)
 
     # ------------------------------------------------------- layout helpers
     def _pack(self, x, name: str):
@@ -392,7 +442,8 @@ class FsdpUpdater(Zero1Updater):
 
     def __init__(self, optimizer: Optimizer, mesh, params: Dict[str, Any],
                  meta: Optional[Dict[str, ParamSpec]] = None,
-                 rules: Optional[Dict[str, P]] = None):
+                 rules: Optional[Dict[str, P]] = None,
+                 overlap=True, graph=None):
         if mesh_lib.FSDP_AXIS not in mesh.axis_names or \
                 dict(mesh.shape)[mesh_lib.FSDP_AXIS] <= 1:
             raise ValueError(
@@ -401,6 +452,53 @@ class FsdpUpdater(Zero1Updater):
                 "stand down to the replicated step)")
         super().__init__(optimizer, mesh, params, meta, rules=rules,
                          fsdp=True)
+        # the double-buffer prefetch order: planned names sorted by
+        # first consumer in the network's topo order (SpecLayout is the
+        # ONE derivation point; falls back to the given — alphabetical
+        # init — order without a graph)
+        self.schedule: List[str] = self.layout.prefetch_schedule(
+            list(self.plan), graph)
+        if overlap and len(self.plan) < 2:
+            from paddle_tpu.utils.log import logger
+            logger.warning(
+                "FSDP overlap: only %d planned parameter(s) — nothing "
+                "to double-buffer; standing down to the sync gather "
+                "spelling", len(self.plan))
+            overlap = False
+        # True/False = auto (chain on TPU only); "force" = always chain
+        self.overlap_mode = overlap
+
+    def _overlap_active(self) -> bool:
+        """Does THIS trace stage the double-buffer gather chain? Forced
+        mode wins (tests / bench A/B); otherwise the chain is TPU-only —
+        the CPU audit compiles must stage the sync spelling the pinned
+        comm/mem budgets describe (the byte-identity is separately
+        regression-tested by forcing the chain, ``tests/test_analysis``)."""
+        if _OVERLAP_FORCED == "off":
+            return False
+        if _OVERLAP_FORCED == "force" or self.overlap_mode == "force":
+            return True
+        if not self.overlap_mode:
+            return False
+        return jax.default_backend() == "tpu"
+
+    def gather_peak_bytes(self) -> int:
+        """Per-device transient gathered-buffer peak: the largest single
+        gathered parameter under the sync spelling, the largest ADJACENT
+        PAIR in schedule order under double-buffering (two layers'
+        buffers live while gather k+1 flies behind layer k's compute) —
+        the number ``utils/profiler.py:memory_stats`` reports so
+        ``--show_step_breakdown`` agrees with the compiled truth."""
+        sizes = []
+        for name in self.schedule:
+            _, _, chunk = self.plan[name]
+            itemsize = self.dtypes.get(name, np.dtype(np.float32)).itemsize
+            sizes.append(self.n * chunk * itemsize)
+        if not sizes:
+            return 0
+        if not self._overlap_active() or len(sizes) == 1:
+            return max(sizes)
+        return max(a + b for a, b in zip(sizes, sizes[1:]))
 
     # -------------------------------------------------- parameter layout
     def _is_packed(self, x, name: str) -> bool:
@@ -448,14 +546,41 @@ class FsdpUpdater(Zero1Updater):
         parameter, pin the packed leaf replicated (ONE all-gather over
         the fsdp axis) and unpad/reshape to the full shape. The rest of
         the step — forward, backward, metrics — consumes the result
-        exactly as it consumes replicated parameters."""
+        exactly as it consumes replicated parameters.
+
+        Overlap spelling (``_overlap_active``): the gathers are chained
+        with ``optimization_barrier`` in prefetch-schedule order — the
+        packed input of gather k+1 is fenced on gather k's OUTPUT, so
+        the scheduler can fly at most one gather ahead of its consumer
+        (gather k+1 behind layer k's compute: classic double-buffering,
+        peak = two gathered layers, never the whole model) while each
+        layer's compute is free to overlap the next gather. The barrier
+        is the identity on values, adds NO collectives (graftlint pass 4
+        budgets byte-identically; regression-tested), and its transpose
+        is the same chain reversed — the backward's grad reduce-scatters
+        are fenced pairwise too, overlapping the PREVIOUS layer's
+        backward compute symmetrically."""
         rep = NamedSharding(self.mesh, P())
         out = dict(params)
-        for name in self.plan:
-            leaf = out.get(name)
-            if leaf is not None:
-                out[name] = self._unpack(
-                    jax.lax.with_sharding_constraint(leaf, rep), name)
+        if not self._overlap_active():
+            for name in self.plan:
+                leaf = out.get(name)
+                if leaf is not None:
+                    out[name] = self._unpack(
+                        jax.lax.with_sharding_constraint(leaf, rep), name)
+            return out
+        names = [n for n in self.schedule if out.get(n) is not None]
+        gathered: Dict[str, Any] = {}
+        prev = None
+        for name in names:
+            leaf = out[name]
+            if prev is not None:
+                leaf, gathered[prev] = _prefetch_fence(
+                    leaf, gathered[prev])
+            gathered[name] = jax.lax.with_sharding_constraint(leaf, rep)
+            prev = name
+        for name in names:
+            out[name] = self._unpack(gathered[name], name)
         return out
 
     def pack_params_host(self, params):

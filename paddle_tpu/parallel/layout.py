@@ -250,6 +250,32 @@ class SpecLayout:
         for k in keys:
             self.rules.pop(k, None)
 
+    # --------------------------------------------------------- prefetch
+    def prefetch_schedule(self, names: Iterable[str],
+                          graph=None) -> List[str]:
+        """Order FSDP-planned parameter names by FIRST CONSUMER: the
+        position (in the network's topological layer order) of the layer
+        that owns each parameter. This is the double-buffer schedule the
+        overlapped gather path walks (``optim/zero1.py:
+        FsdpUpdater.full_params``; ``docs/spec_layout.md`` overlap
+        section) — gather k+1 is legal to issue exactly when its
+        consumer sits after gather k's consumer, so consumption order IS
+        the prefetch order. Without a graph (or for names the graph
+        doesn't own) the given order is kept: ``init_params`` iterates
+        ``sorted(param_specs)``, a deterministic (if consumption-blind)
+        fallback. Stable sort, so ties keep the caller's order."""
+        names = list(names)
+        if graph is None:
+            return names
+        rank: Dict[str, int] = {}
+        order = list(getattr(graph, "order", ()))
+        for idx, layer in enumerate(order):
+            for pname in getattr(graph, "_layer_params", {}).get(
+                    layer, {}).values():
+                if pname not in rank:
+                    rank[pname] = idx
+        return sorted(names, key=lambda n: rank.get(n, len(order)))
+
     # ------------------------------------------------- FSDP eligibility
     def fsdp_eligible(self, name: str, spec=None, optimizer=None) -> bool:
         """Is ``name`` in the FSDP/ZeRO flat-packed plan? Excluded:

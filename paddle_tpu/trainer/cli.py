@@ -160,6 +160,24 @@ def parse_args(argv=None):
                         "--parallel_nn, --use_zero1 and seq-parallel "
                         "configs. Checkpoints stay format-compatible "
                         "crossing --fsdp on/off")
+    p.add_argument("--fsdp_overlap", default="on",
+                   choices=["on", "off", "force"],
+                   help="--fsdp: overlap each layer's param all-gather "
+                        "with the previous layer's compute (and the "
+                        "grad reduce-scatters with backward) via an "
+                        "optimization-barrier prefetch chain, double-"
+                        "buffering at most two gathered layers "
+                        "(optim/zero1.py:FsdpUpdater.full_params; "
+                        "docs/spec_layout.md). 'on' engages on TPU "
+                        "backends only (audit compiles on CPU keep the "
+                        "sync spelling), 'force' engages everywhere, "
+                        "'off' keeps the sync spelling")
+    p.add_argument("--fused_rnn", action="store_true",
+                   help="route LSTM/GRU cell math through the fused "
+                        "kernel plane (paddle_tpu/kernels/): one Pallas "
+                        "kernel per cell step on TPU, the bitwise-"
+                        "identical jnp spelling elsewhere "
+                        "(docs/kernels.md)")
     p.add_argument("--grad_accum_steps", type=int, default=1,
                    help="split each batch into k microbatches scanned "
                         "inside the jitted step, applying the optimizer "
@@ -404,6 +422,9 @@ def _build_trainer(ns, args):
         mesh = create_mesh(n_data=args.trainer_count)
     optimizer = ns.get("optimizer") or Momentum(learning_rate=0.01,
                                                 momentum=0.9)
+    if getattr(args, "fused_rnn", False):
+        from paddle_tpu import kernels
+        kernels.set_fused_rnn(True)
     dtype = getattr(args, "compute_dtype", None)
     trainer = SGD(cost=topo, update_equation=optimizer, mesh=mesh,
                   seed=args.seed, evaluators=ns.get("evaluators"),
@@ -421,7 +442,9 @@ def _build_trainer(ns, args):
     if n_fsdp > 1:
         # likewise HERE (after the pipeline stacks its body, so the
         # fsdp plan sees the final layout); train(fsdp=None) is sticky
-        trainer.enable_fsdp()
+        overlap = {"on": True, "off": False, "force": "force"}[
+            getattr(args, "fsdp_overlap", "on")]
+        trainer.enable_fsdp(overlap=overlap)
     return trainer
 
 

@@ -125,6 +125,12 @@ class SGD:
         # and the shard-wise update keeps them sharded (--fsdp,
         # docs/spec_layout.md)
         self._fsdp = None
+        # gather-overlap mode for the fsdp step (--fsdp_overlap):
+        # True = double-buffer the next layer's all-gather behind the
+        # current layer's compute (TPU traces only; the CPU spelling
+        # stays sync so audit budgets pin one program), False = sync,
+        # "force" = stage the chain on any backend (tests/bench)
+        self._fsdp_overlap = True
         self._zero1_subsumed = False  # zero1 asked for while fsdp holds
         # slots at 1/N already; re-armed if fsdp is later disabled
         # pipeline parallelism (parallel/pipeline.py:PipelineTrainPlan):
@@ -918,7 +924,7 @@ class SGD:
         self._rebuild_train_step()
 
     # ---------------------------------------------------------------- fsdp
-    def enable_fsdp(self) -> bool:
+    def enable_fsdp(self, overlap=None) -> bool:
         """Switch to full FSDP (``--fsdp``,
         ``optim/zero1.py:FsdpUpdater``): eligible parameters AND their
         optimizer slots reshard to flat-packed 1/N partitions of the
@@ -928,11 +934,25 @@ class SGD:
         fsdp axis. Eligibility comes from the canonical layout
         (``SpecLayout.fsdp_eligible``), so model-sharded tables and
         pipeline stage-stacked keys keep their own placement and the
-        modes compose. Returns True when FSDP is active; meshes without
-        an fsdp axis (and models with model averaging) WARN and stand
-        down — training continues with the replicated layout."""
+        modes compose. ``overlap`` (``--fsdp_overlap``) picks the
+        gather spelling: True (default) double-buffers the next
+        parameter's all-gather behind the current layer's compute in
+        the SpecLayout prefetch order, False keeps every gather
+        synchronous, "force" stages the chain on any backend; None
+        keeps the trainer's current mode. Returns True when FSDP is
+        active; meshes without an fsdp axis (and models with model
+        averaging) WARN and stand down — training continues with the
+        replicated layout."""
+        if overlap is not None:
+            self._fsdp_overlap = overlap
         if self._fsdp is not None:
-            return True
+            if overlap is not None and \
+                    self._fsdp.overlap_mode != self._fsdp_overlap:
+                # same plan, different gather spelling: rebuild the
+                # updater (cheap, no device ops) and re-jit
+                self.disable_fsdp(_rearm_subsumed=False)
+            else:
+                return True
         from paddle_tpu.utils import logger
         if self.mesh is None or \
                 dict(self.mesh.shape).get(mesh_lib.FSDP_AXIS, 1) <= 1:
@@ -957,14 +977,16 @@ class SGD:
             self._zero1_subsumed = True
         from paddle_tpu.optim.zero1 import FsdpUpdater
         upd = FsdpUpdater(self.optimizer, self.mesh, self.params,
-                          self.meta, rules=self._shard_rules)
+                          self.meta, rules=self._shard_rules,
+                          overlap=self._fsdp_overlap, graph=self.network)
         self.params = upd.pack_params(self.params)
         self.opt_state = upd.convert_state(self.opt_state)
         self._fsdp = upd
+        self.breakdown.set_fsdp(len(upd.plan), bool(upd.overlap_mode))
         logger.info(
             "fsdp enabled: %d parameters packed 1/%d over the %r axis "
-            "(gather-on-use per layer; slots follow)", len(upd.plan),
-            upd.n, mesh_lib.FSDP_AXIS)
+            "(gather-on-use per layer, overlap=%s; slots follow)",
+            len(upd.plan), upd.n, mesh_lib.FSDP_AXIS, upd.overlap_mode)
         self._rebuild_train_step()
         return True
 
@@ -985,6 +1007,7 @@ class SGD:
         self.params = self._fsdp.unpack_params(self.params)
         resub, self._zero1_subsumed = self._zero1_subsumed, False
         self._fsdp = None
+        self.breakdown.set_fsdp(0, False)
         if self.mesh is not None:
             self.params = self.layout.place_params(self.params)
             self.opt_state = self.layout.place_opt_state(self.opt_state)
@@ -1203,7 +1226,8 @@ class SGD:
 
     def _configure_step(self, zero1: Optional[bool],
                         grad_accum_steps: Optional[int],
-                        pipeline=None, fsdp: Optional[bool] = None):
+                        pipeline=None, fsdp: Optional[bool] = None,
+                        fsdp_overlap=None):
         # pipeline first: zero1/fsdp must build their plans over the
         # final (possibly stage-stacked) parameter layout
         if pipeline is not None:
@@ -1250,10 +1274,14 @@ class SGD:
                     "here because the exactness claim holds only for "
                     "batch-stat-free models (moving averages are still "
                     "averaged across microbatches)", bn)
-        if fsdp is True:
-            self.enable_fsdp()
+        if fsdp is True or (fsdp is None and fsdp_overlap is not None
+                            and self._fsdp is not None):
+            # fsdp on (or already on with a new overlap mode requested)
+            self.enable_fsdp(overlap=fsdp_overlap)
         elif fsdp is False:
             self.disable_fsdp()    # None = keep the current mode
+        elif fsdp_overlap is not None:
+            self._fsdp_overlap = fsdp_overlap  # sticky for a later enable
         if zero1 is True:
             self.enable_zero1()
         elif zero1 is False:
@@ -1445,7 +1473,8 @@ class SGD:
               zero1: Optional[bool] = None,
               grad_accum_steps: Optional[int] = None,
               pipeline=None, auto_resume: bool = True,
-              health=None, fsdp: Optional[bool] = None):
+              health=None, fsdp: Optional[bool] = None,
+              fsdp_overlap=None):
         """reader yields minibatches (lists of sample tuples); feeder
         converts them to Arguments (or pass feed dicts directly).
         ``log_period``>0 logs a TrainerStats-style line and dumps+resets the
@@ -1508,6 +1537,19 @@ class SGD:
         (``create_mesh(n_fsdp=N)``) warn and stand down. Checkpoints
         stay format-compatible (gather-on-save, reshard-on-load), so
         resume crosses fsdp on/off in both directions.
+        ``fsdp_overlap`` (the ``--fsdp_overlap`` flag) picks the fsdp
+        gather spelling: ``True`` (the default mode) double-buffers
+        each next parameter's all-gather behind the current layer's
+        compute — and, by transposition, each backward reduce-scatter
+        behind the previous layer's backward — in the SpecLayout
+        prefetch order (``optim/zero1.py:FsdpUpdater.full_params``);
+        ``False`` keeps every gather synchronous; ``"force"`` stages
+        the overlap chain on any backend (tests/bench — normally the
+        chain is TPU-only so CPU audit compiles pin one program);
+        ``None`` keeps the current mode. Bitwise-identical training
+        trajectory either way (the chain is an
+        ``optimization_barrier``, identity on values;
+        ``tests/test_fsdp_overlap_matrix.py``).
         ``grad_accum_steps`` (``--grad_accum_steps``) splits each batch
         into k microbatches scanned inside the jitted step, applying the
         optimizer (and clipping/decay) once on the accumulated gradient —
@@ -1543,7 +1585,8 @@ class SGD:
         parameters), ``None`` keeps the current mode. Configs or meshes
         the schedule cannot honor warn and stand down cleanly."""
         from paddle_tpu.utils import global_stat, logger, timer
-        self._configure_step(zero1, grad_accum_steps, pipeline, fsdp)
+        self._configure_step(zero1, grad_accum_steps, pipeline, fsdp,
+                             fsdp_overlap)
         self._configure_health(health, show_parameter_stats_period)
         hm = self._health
         if hm is not None:
@@ -1865,7 +1908,8 @@ class SGD:
                                     memory_status
                                 logger.info("%s", bd.status())
                                 logger.info("%s", memory_status(
-                                    self.params, self.opt_state))
+                                    self.params, self.opt_state,
+                                    gather_peak=self._gather_peak()))
                             logger.info("\n%s", global_stat.status(reset=True))
                             window_cost, window_n = 0.0, 0
                             if show_layer_stat:
@@ -1924,7 +1968,9 @@ class SGD:
                 if show_step_breakdown:
                     from paddle_tpu.utils.profiler import memory_status
                     logger.info("%s", bd.status())
-                    logger.info("%s", memory_status(self.params, self.opt_state))
+                    logger.info("%s", memory_status(
+                        self.params, self.opt_state,
+                        gather_peak=self._gather_peak()))
                 event_handler(ev.EndPass(
                     pass_id, {**acc.result(), **self.host_eval_values()}))
                 if checkpointer is not None:
@@ -2046,8 +2092,17 @@ class SGD:
     def step_breakdown(self) -> Dict[str, float]:
         """Summary of the last train() call's per-step host-time split
         (plus the prefetch worker's queue-wait total): the bench's
-        ``input_pipeline_steps_per_sec`` / ``data_wait_frac`` source."""
+        ``input_pipeline_steps_per_sec`` / ``data_wait_frac`` source.
+        Under fsdp it carries the ``fsdp_exposed_*`` collective
+        accounting (``utils/profiler.py:fsdp_overlap_stats``)."""
         return self.breakdown.summary()
+
+    def _gather_peak(self):
+        """FSDP transient gathered-buffer peak for memory reports
+        (None when fsdp is off): two layers live under the overlap
+        double-buffer, one under the sync spelling."""
+        return (self._fsdp.gather_peak_bytes()
+                if self._fsdp is not None else None)
 
     def load_state(self, params: Dict[str, Any], opt_flat=None):
         """Install restored parameters (+ optionally a flattened optimizer

@@ -81,7 +81,7 @@ def device_peak_bytes():
 
 
 def memory_stats(params, opt_state=None, activations=None,
-                 temp_estimator=None) -> dict:
+                 temp_estimator=None, gather_peak=None) -> dict:
     """Per-device memory accounting for the training state. The
     bench's ``--zero1`` A/B, ``--show_step_breakdown``, and graftlint
     pass 5 (PT605 reconciles the compiled manifest against this exact
@@ -107,6 +107,13 @@ def memory_stats(params, opt_state=None, activations=None,
     - ``device_peak_bytes`` (only when the backend reports one) — the
       device's peak allocation; ABSENT on XLA:CPU (see
       ``device_peak_bytes`` — None/absent means unmeasured, never 0).
+    - ``gathered_peak_bytes_per_device`` (when ``gather_peak`` is
+      given) — the FSDP transient gathered-buffer peak: ONE layer's
+      full parameter under the sync gather spelling, the largest
+      adjacent schedule PAIR under overlap (two layers live while the
+      next gather flies behind the current compute) — pass
+      ``FsdpUpdater.gather_peak_bytes()`` so this report and the
+      compiled truth agree under ``--fsdp_overlap``.
     """
     out = {"param_bytes_per_device": tree_device_bytes(params)}
     if opt_state is not None and isinstance(opt_state, dict):
@@ -120,6 +127,8 @@ def memory_stats(params, opt_state=None, activations=None,
         temp = temp_estimator()
         if temp is not None:
             out["temp_bytes_per_device"] = int(temp)
+    if gather_peak is not None:
+        out["gathered_peak_bytes_per_device"] = int(gather_peak)
     peak = device_peak_bytes()
     if peak is not None:
         out["device_peak_bytes"] = int(peak)
@@ -130,8 +139,8 @@ def _fmt_bytes(v: int) -> str:
     return f"{v / 1e6:.2f}MB" if v >= 1e5 else f"{v / 1e3:.2f}KB"
 
 
-def memory_status(params, opt_state=None) -> str:
-    s = memory_stats(params, opt_state)
+def memory_status(params, opt_state=None, gather_peak=None) -> str:
+    s = memory_stats(params, opt_state, gather_peak=gather_peak)
     parts = " ".join(f"{k.replace('_bytes_per_device', '')}="
                      f"{_fmt_bytes(v)}" for k, v in s.items()
                      if k.endswith("_bytes_per_device"))
@@ -164,6 +173,33 @@ def pipeline_bubble_stats(n_stages: int, n_microbatches: int) -> dict:
         "pipeline_ticks": ticks,
         "pipeline_bubble_frac": (S - 1) / ticks,
         "pipeline_bubble_frac_per_stage": per_stage,
+    }
+
+
+def fsdp_overlap_stats(n_gathers: int, overlap: bool) -> dict:
+    """FSDP exposed-communication accounting (``optim/zero1.py:
+    FsdpUpdater``), the collective-plane analogue of
+    ``pipeline_bubble_stats``.
+
+    The step issues one all-gather per planned parameter on the forward
+    and one reduce-scatter (the gather's transpose) on the backward —
+    ``2L`` collectives for ``L = n_gathers``. Under the sync spelling
+    every one of them sits exposed on the critical path. Under the
+    double-buffer chain (``full_params`` overlap spelling) gather k+1
+    flies behind layer k's compute and reduce-scatter k-1 behind layer
+    k's backward, so only the FIRST forward gather (nothing to hide it
+    behind) and the LAST backward reduce-scatter (its producer is the
+    final backward op) stay exposed — 2 of 2L, the double-buffering
+    steady state. Analytic by construction, like the pipeline bubble:
+    the 1-core CPU host can't measure real collective/compute overlap,
+    and on TPU the schedule, not the wall clock, is the contract."""
+    L = int(n_gathers)
+    exposed = (2 if L else 0) if overlap else 2 * L
+    return {
+        "fsdp_gathers_per_step": L,
+        "fsdp_overlap": bool(overlap),
+        "fsdp_exposed_collectives": exposed,
+        "fsdp_exposed_comm_frac": (exposed / (2 * L)) if L else 0.0,
     }
 
 
@@ -206,12 +242,22 @@ class StepBreakdown:
         # must not silently drop the schedule identity from summaries)
         if not hasattr(self, "pipeline"):
             self.pipeline = None
+        # set by SGD.enable_fsdp; survives reset() like the pipeline
+        if not hasattr(self, "fsdp"):
+            self.fsdp = None
 
     def set_pipeline(self, n_stages: int, n_microbatches: int):
         """Record the active GPipe schedule so ``summary()`` carries the
         bubble-fraction estimate next to steps/s (None disables)."""
         self.pipeline = ((int(n_stages), int(n_microbatches))
                          if n_stages else None)
+
+    def set_fsdp(self, n_gathers: int, overlap: bool):
+        """Record the active FSDP gather plan so ``summary()`` carries
+        the exposed-comm estimate (``fsdp_overlap_stats``) next to
+        steps/s (0 gathers disables)."""
+        self.fsdp = ((int(n_gathers), bool(overlap))
+                     if n_gathers else None)
 
     def add(self, part: str, seconds: float):
         self.totals[part] += seconds
@@ -249,6 +295,8 @@ class StepBreakdown:
                 1e3 * self.totals[p] / self.steps if self.steps else 0.0)
         if self.pipeline is not None:
             out.update(pipeline_bubble_stats(*self.pipeline))
+        if self.fsdp is not None:
+            out.update(fsdp_overlap_stats(*self.fsdp))
         return out
 
     def status(self) -> str:
@@ -261,5 +309,10 @@ class StepBreakdown:
             pipe = (f" pipeline=S{s['pipeline_stages']}/M"
                     f"{s['pipeline_microbatches']}"
                     f" bubble={s['pipeline_bubble_frac'] * 100:.1f}%")
+        if self.fsdp is not None:
+            pipe += (f" fsdp_gathers={s['fsdp_gathers_per_step']}"
+                     f" overlap={'on' if s['fsdp_overlap'] else 'off'}"
+                     f" exposed_comm="
+                     f"{s['fsdp_exposed_comm_frac'] * 100:.1f}%")
         return (f"StepBreakdown: steps={self.steps} "
                 f"steps/s={s['steps_per_sec']:.3f} {parts}{pipe}")

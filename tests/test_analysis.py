@@ -1038,6 +1038,89 @@ def test_pt401_autoscale_artifact_requires_trajectory_evidence(tmp_path):
     assert min(traj) >= 1 and max(traj) > min(traj)
 
 
+def test_pt401_overlap_artifact_requires_exposed_comm_evidence(tmp_path):
+    """The r18 FSDP-overlap generation: an ``overlap*`` metric must
+    carry both step-time sides AND the exposed-collective split (count
+    + fraction per side) — on a 1-core host the step-time ratio is
+    dispatch-bound noise, so the structural exposed-comm numbers ARE
+    the overlap evidence; an artifact without them recorded nothing."""
+    good = tmp_path / "BENCH_ov.json"
+    good.write_text(json.dumps({
+        "metric": "overlap_fsdp_fused_ab", "platform": "cpu",
+        "overlap_on_steps_per_sec": 14.5,
+        "overlap_off_steps_per_sec": 12.8,
+        "overlap_vs_sync_steps": 1.13,
+        "exposed_collectives_overlap_on": 2,
+        "exposed_collectives_overlap_off": 14,
+        "exposed_comm_frac_overlap_on": 0.143,
+        "exposed_comm_frac_overlap_off": 1.0}))
+    assert check_bench_file(str(good), "BENCH_ov.json") == []
+
+    # missing one step-time side; collective count recorded as a string
+    bad = tmp_path / "BENCH_ov_bad.json"
+    bad.write_text(json.dumps({
+        "metric": "overlap_fsdp_fused_ab", "platform": "cpu",
+        "overlap_on_steps_per_sec": 14.5,
+        "exposed_collectives_overlap_on": "2",
+        "exposed_collectives_overlap_off": 14,
+        "exposed_comm_frac_overlap_on": 0.143,
+        "exposed_comm_frac_overlap_off": 1.0}))
+    fs = check_bench_file(str(bad), "BENCH_ov_bad.json")
+    assert any("overlap_off_steps_per_sec" in f.message for f in fs)
+    assert any("exposed_collectives_overlap_on" in f.message for f in fs)
+
+    # a non-overlap metric stays exempt from the overlap keys
+    other = tmp_path / "BENCH_other.json"
+    other.write_text(json.dumps(
+        {"metric": "fsdp_full_param_sharding_ab", "platform": "cpu"}))
+    assert check_bench_file(str(other), "BENCH_other.json") == []
+
+    # the committed r18 artifact itself carries the evidence, and the
+    # overlap side exposes strictly fewer collectives
+    import os as _os
+    root = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    r18 = _os.path.join(root, "BENCH_r18.json")
+    assert check_bench_file(r18, "BENCH_r18.json") == []
+    data = json.loads(open(r18).read())
+    assert (data["exposed_collectives_overlap_on"]
+            < data["exposed_collectives_overlap_off"])
+    assert data["overlap_bitwise_identical"] is True
+
+
+def test_pass4_overlap_spelling_budgets_identically():
+    """The sync->async flip must budget IDENTICALLY: the overlap chain
+    is an ``optimization_barrier`` spelling of the SAME gathers, so the
+    pass-4 collective manifest of the pinned fsdp programs — op counts,
+    axes, byte volumes — is byte-identical with the chain forced on,
+    and ``comm_budget.toml`` needs no edit. This is the regression
+    fence for anyone 'optimizing' the chain into extra collectives."""
+    import jax
+
+    from paddle_tpu.analysis import shard_audit as sa
+    from paddle_tpu.optim import zero1
+
+    if len(jax.devices()) < 8:
+        import pytest
+        pytest.skip("needs the 8-device virtual mesh")
+    entries = sa.load_budget()
+    for build in (sa.build_fsdp_train, sa.build_fsdp_pipe):
+        with zero1.overlap_spelling("off"):
+            base = sa.compile_program(build())
+        with zero1.overlap_spelling("force"):
+            forced = sa.compile_program(build())
+        m_sync = sa.collect_manifest(base.hlo, base.spec.mesh)
+        m_over = sa.collect_manifest(forced.hlo, forced.spec.mesh)
+        assert m_sync == m_over, (
+            f"{base.spec.name}: overlap spelling changed the collective "
+            f"manifest\n sync: {sa.format_manifest(m_sync)}\n"
+            f" over: {sa.format_manifest(m_over)}")
+        # and the forced program still lands ON the pinned budget
+        findings, _ = sa.check_budget(
+            forced.spec.name, m_over, entries, forced.spec.anchor,
+            "analysis/comm_budget.toml")
+        assert findings == [], [f.message for f in findings]
+
+
 # ----------------------------------------------------------- baseline
 def test_baseline_parse_apply_and_stale(tmp_path):
     bl = tmp_path / "baseline.toml"

@@ -1,0 +1,279 @@
+"""Fused-kernel plane (``paddle_tpu/kernels/``) parity + scope tests.
+
+Three contracts from ``docs/kernels.md``:
+
+- the Pallas spelling of each kernel (run here in interpreter mode on
+  CPU, ``tests/test_ops_pallas.py`` precedent) matches the fallback
+  reference spelling to float32 roundoff, forward AND backward;
+- the fallback IS the existing inline math — routing through the plane
+  with Pallas unavailable is bitwise-invisible (``_apply_one`` for the
+  optimizer chains, the ``layers/recurrent.py`` step spelling for the
+  cells);
+- the plane is pure trace-time dispatch: NO threads, NO locks — the
+  pass-3 lock-graph scope stays exactly as it was (asserted statically
+  here, so a future kernels module that grows a thread must also
+  register itself with the lock audit).
+"""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import kernels
+from paddle_tpu.kernels import dispatch, opt_update, rnn_cells
+from paddle_tpu.ops import common
+from paddle_tpu.optim.optimizers import Adam, Momentum
+
+B, H = 5, 10  # deliberately unaligned: exercises the pad/slice path
+
+
+def _rng(seed=0):
+    return np.random.RandomState(seed)
+
+
+def _lstm_operands(seed=0):
+    r = _rng(seed)
+    gates = jnp.asarray(r.randn(B, 4 * H).astype(np.float32))
+    c = jnp.asarray(r.randn(B, H).astype(np.float32))
+    checks = [jnp.asarray(r.randn(H).astype(np.float32))
+              for _ in range(3)]
+    return gates, c, checks
+
+
+def _gru_operands(seed=0):
+    r = _rng(seed)
+    x = jnp.asarray(r.randn(B, 3 * H).astype(np.float32))
+    h = jnp.asarray(r.randn(B, H).astype(np.float32))
+    w_gate = jnp.asarray(r.randn(H, 2 * H).astype(np.float32) * 0.3)
+    w_state = jnp.asarray(r.randn(H, H).astype(np.float32) * 0.3)
+    return x, h, w_gate, w_state
+
+
+# ------------------------------------------------- cell kernel parity
+
+def test_lstm_cell_interpret_matches_fallback():
+    gates, c, checks = _lstm_operands()
+    with common.force_mode("ref"):
+        ref_out, ref_state = rnn_cells.lstm_cell(gates, c, *checks)
+    with common.force_mode("interpret"):
+        out, state = rnn_cells.lstm_cell(gates, c, *checks)
+    np.testing.assert_allclose(out, ref_out, rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(state, ref_state, rtol=1e-6, atol=1e-6)
+
+
+def test_lstm_cell_interpret_grads_match_fallback():
+    gates, c, checks = _lstm_operands(1)
+    w = jnp.asarray(_rng(9).randn(B, H).astype(np.float32))
+
+    def loss(mode, g_, c_):
+        with common.force_mode(mode):
+            out, state = rnn_cells.lstm_cell(g_, c_, *checks)
+        return jnp.sum(out * w) + jnp.sum(state * w)
+
+    for arg in (0, 1):
+        g_ref = jax.grad(lambda a, b: loss("ref", a, b), argnums=arg)(
+            gates, c)
+        g_int = jax.grad(lambda a, b: loss("interpret", a, b),
+                         argnums=arg)(gates, c)
+        np.testing.assert_allclose(g_int, g_ref, rtol=1e-5, atol=1e-5)
+
+
+def test_gru_cell_interpret_matches_fallback():
+    x, h, w_gate, w_state = _gru_operands()
+    with common.force_mode("ref"):
+        ref = rnn_cells.gru_cell(x, h, w_gate, w_state)
+    with common.force_mode("interpret"):
+        out = rnn_cells.gru_cell(x, h, w_gate, w_state)
+    np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-6)
+
+
+def test_gru_cell_interpret_grads_match_fallback():
+    x, h, w_gate, w_state = _gru_operands(2)
+    w = jnp.asarray(_rng(9).randn(B, H).astype(np.float32))
+
+    def loss(mode, x_, h_, wg_, ws_):
+        with common.force_mode(mode):
+            return jnp.sum(rnn_cells.gru_cell(x_, h_, wg_, ws_) * w)
+
+    for arg in range(4):
+        g_ref = jax.grad(loss, argnums=1 + arg)(
+            "ref", x, h, w_gate, w_state)
+        g_int = jax.grad(loss, argnums=1 + arg)(
+            "interpret", x, h, w_gate, w_state)
+        np.testing.assert_allclose(g_int, g_ref, rtol=1e-5, atol=1e-5)
+
+
+def test_non_default_activations_take_fallback():
+    """A non-default activation set must NOT reach the Pallas kernel
+    (its activations are baked in) — even with Pallas forced on, the
+    cell answers with the reference spelling of the requested acts."""
+    gates, c, checks = _lstm_operands(3)
+    with common.force_mode("interpret"):
+        out, state = rnn_cells.lstm_cell(gates, c, *checks,
+                                         act_input="relu")
+    ref_out, ref_state = rnn_cells._lstm_math(
+        gates, c, *checks, act_in=rnn_cells._act("relu"),
+        act_gate=rnn_cells._act("sigmoid"),
+        act_state=rnn_cells._act("tanh"))
+    assert np.array_equal(np.asarray(out), np.asarray(ref_out))
+    assert np.array_equal(np.asarray(state), np.asarray(ref_state))
+
+
+# -------------------------------------------- optimizer kernel parity
+
+def _opt_operands(seed=0, shape=(13, 7)):
+    r = _rng(seed)
+    mk = lambda: jnp.asarray(r.randn(*shape).astype(np.float32))
+    return mk(), mk(), mk(), mk()  # p, g, mom, v
+
+
+def test_momentum_fused_interpret_matches_apply_one():
+    opt = Momentum(learning_rate=0.1, momentum=0.9)
+    p, g, m, _ = _opt_operands()
+    lr = jnp.float32(0.05)
+    t = jnp.int32(3)
+    ref_p, ref_s = opt._apply_one(p, g, {"mom": m}, lr, 1e-4, t)
+    with common.force_mode("interpret"):
+        got_p, got_s = opt_update.apply_one(opt, p, g, {"mom": m},
+                                            lr, 1e-4, t)
+    assert set(got_s) == set(ref_s) == {"mom"}
+    np.testing.assert_allclose(got_p, ref_p, rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(got_s["mom"], ref_s["mom"],
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_adam_fused_interpret_matches_apply_one():
+    opt = Adam(learning_rate=0.1)
+    p, g, m, v = _opt_operands(4)
+    v = jnp.abs(v)  # second-moment slots are non-negative
+    lr = jnp.float32(0.02)
+    t = jnp.int32(7)
+    ref_p, ref_s = opt._apply_one(p, g, {"mom": m, "v": v}, lr, 1e-4, t)
+    with common.force_mode("interpret"):
+        got_p, got_s = opt_update.apply_one(
+            opt, p, g, {"mom": m, "v": v}, lr, 1e-4, t)
+    assert set(got_s) == set(ref_s) == {"mom", "v"}
+    np.testing.assert_allclose(got_p, ref_p, rtol=1e-6, atol=1e-7)
+    for k in ref_s:
+        np.testing.assert_allclose(got_s[k], ref_s[k],
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_fused_optimizer_fallback_is_apply_one_bitwise():
+    """Off-TPU (mode 'ref') the routing is the identity: apply_one
+    returns exactly what _apply_one returns, bit for bit."""
+    opt = Adam(learning_rate=0.1)
+    p, g, m, v = _opt_operands(5)
+    v = jnp.abs(v)
+    lr = jnp.float32(0.02)
+    t = jnp.int32(2)
+    with common.force_mode("ref"):
+        got_p, got_s = opt_update.apply_one(
+            opt, p, g, {"mom": m, "v": v}, lr, 0.0, t)
+    ref_p, ref_s = opt._apply_one(p, g, {"mom": m, "v": v}, lr, 0.0, t)
+    assert np.array_equal(np.asarray(got_p), np.asarray(ref_p))
+    for k in ref_s:
+        assert np.array_equal(np.asarray(got_s[k]), np.asarray(ref_s[k]))
+
+
+def test_ineligible_shapes_route_to_apply_one():
+    """Nesterov momentum, exotic slots and disabled dispatch all fall
+    back to the optimizer's own _apply_one (results identical)."""
+    p, g, m, _ = _opt_operands(6)
+    lr = jnp.float32(0.05)
+    t = jnp.int32(1)
+    nest = Momentum(learning_rate=0.1, momentum=0.9, nesterov=True)
+    with common.force_mode("interpret"):
+        got = opt_update.apply_one(nest, p, g, {"mom": m}, lr, 0.0, t)
+    ref = nest._apply_one(p, g, {"mom": m}, lr, 0.0, t)
+    assert np.array_equal(np.asarray(got[0]), np.asarray(ref[0]))
+
+    # dispatch off: identity routing even when Pallas would be legal
+    opt = Momentum(learning_rate=0.1, momentum=0.9)
+    with common.force_mode("interpret"), dispatch.fused_optimizer(False):
+        got = opt_update.apply_one(opt, p, g, {"mom": m}, lr, 0.0, t)
+    ref = opt._apply_one(p, g, {"mom": m}, lr, 0.0, t)
+    assert np.array_equal(np.asarray(got[0]), np.asarray(ref[0]))
+
+
+def test_prune_mask_slot_rides_through_fused_path():
+    """A prune_mask slot must not break eligibility (the mask is the
+    CALLER's to re-apply, matching _apply_one's contract) and must not
+    appear in the fused path's returned slots."""
+    opt = Momentum(learning_rate=0.1, momentum=0.9)
+    p, g, m, _ = _opt_operands(7)
+    mask = jnp.ones_like(p)
+    lr = jnp.float32(0.05)
+    t = jnp.int32(1)
+    with common.force_mode("interpret"):
+        got_p, got_s = opt_update.apply_one(
+            opt, p, g, {"mom": m, "prune_mask": mask}, lr, 0.0, t)
+    ref_p, ref_s = opt._apply_one(
+        p, g, {"mom": m, "prune_mask": mask}, lr, 0.0, t)
+    assert set(got_s) == set(ref_s) == {"mom"}
+    np.testing.assert_allclose(got_p, ref_p, rtol=1e-6, atol=1e-7)
+
+
+# --------------------------------------------------- dispatch switches
+
+def test_dispatch_flags_and_contexts():
+    assert not dispatch.rnn_cells_enabled()  # default off
+    with kernels.fused_rnn(True):
+        assert dispatch.rnn_cells_enabled()
+        with kernels.fused_rnn(False):
+            assert not dispatch.rnn_cells_enabled()
+        assert dispatch.rnn_cells_enabled()
+    assert not dispatch.rnn_cells_enabled()
+
+    assert dispatch.fused_optimizer_enabled()  # default on
+    with kernels.fused_optimizer(False):
+        assert not dispatch.fused_optimizer_enabled()
+    assert dispatch.fused_optimizer_enabled()
+
+
+def test_env_flag_parsing():
+    for raw, want in (("", False), ("0", False), ("off", False),
+                      ("no", False), ("FALSE", False), ("1", True),
+                      ("on", True), ("true", True)):
+        os.environ["_PT_KERNELS_TEST_FLAG"] = raw
+        try:
+            assert dispatch._env_flag("_PT_KERNELS_TEST_FLAG",
+                                      True) is want, raw
+        finally:
+            del os.environ["_PT_KERNELS_TEST_FLAG"]
+    assert dispatch._env_flag("_PT_KERNELS_TEST_UNSET", True) is True
+    assert dispatch._env_flag("_PT_KERNELS_TEST_UNSET", False) is False
+
+
+# --------------------------------------------- lock-audit scope fence
+
+def test_kernels_plane_adds_no_threaded_module():
+    """The pass-3 lock-graph scope assertion the tentpole promises: the
+    kernel plane is pure trace-time dispatch — no threading primitives
+    anywhere under paddle_tpu/kernels/, and consequently no kernels
+    entry in the lock audit's module list. If either half ever changes,
+    BOTH must change together (add the module to DEFAULT_MODULES and
+    drop the source assertion)."""
+    from paddle_tpu.analysis import lockorder
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sources = sorted(glob.glob(
+        os.path.join(root, "paddle_tpu", "kernels", "*.py")))
+    assert sources, "kernels plane vanished?"
+    for path in sources:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        for needle in ("import threading", "threading.", "Thread(",
+                       "Lock(", "RLock(", "Condition("):
+            assert needle not in text, (
+                f"{os.path.basename(path)} grew a threading primitive "
+                f"({needle!r}): register it with "
+                "analysis/lockorder.DEFAULT_MODULES and update this test")
+    assert not any("kernels" in m for m in lockorder.DEFAULT_MODULES), (
+        "kernels module in the lock audit scope but the plane is "
+        "supposed to be thread-free")

@@ -355,11 +355,14 @@ def test_master_rpc_spans_pair_under_one_trace(tracer):
     spans = tracer.spans()
     cli = [s for s in spans if s["name"] == "rpc.heartbeat"]
     srv = [s for s in spans if s["name"] == "rpc.server.heartbeat"]
-    # a slow 1-core host can refuse the FIRST connect, and each client
-    # retry legitimately records its own attempt span — the contract
-    # under test is the PAIRING (the answered attempt and the server
-    # span share one trace, parent-linked), not the attempt count
-    assert cli and len(srv) == 1
-    mate = [c for c in cli if c["trace_id"] == srv[0]["trace_id"]]
-    assert len(mate) == 1, (srv, cli)
-    assert srv[0]["parent_id"] == mate[0]["span_id"]
+    # a slow 1-core host can time out the FIRST attempt: the client
+    # retries (each attempt legitimately records its own span) and the
+    # server may still answer the stale attempt late — so BOTH sides
+    # can have >1 span. The contract under test is the PAIRING: every
+    # server span is parent-linked to exactly one client attempt span
+    # within one trace, not the attempt count on either side.
+    assert cli and srv
+    for s in srv:
+        mate = [c for c in cli if c["trace_id"] == s["trace_id"]]
+        assert len(mate) == 1, (srv, cli)
+        assert s["parent_id"] == mate[0]["span_id"]

@@ -86,3 +86,52 @@ def test_memory_stats_reports_fsdp_packed_param_bytes():
                             NamedSharding(mesh, P("fsdp", None)))
     stats = memory_stats({"w": packed})
     assert stats["param_bytes_per_device"] == 8 * 16 * 4 // 8
+
+
+def test_memory_stats_reports_gathered_buffer_peak():
+    """The r18 overlap plane: memory_stats surfaces the TRANSIENT
+    gathered-buffer peak the fsdp updater computes (two layers live
+    under double-buffering, one under the sync spelling) as its own
+    key — it is temp memory, not resident params, so it must not fold
+    into param_bytes_per_device."""
+    stats = memory_stats({}, gather_peak=4096)
+    assert stats["gathered_peak_bytes_per_device"] == 4096
+    assert "gathered_peak_bytes_per_device" not in memory_stats({})
+    # and the human-readable status line renders it like any other
+    # *_bytes_per_device figure
+    from paddle_tpu.utils.profiler import memory_status
+    assert "gathered_peak" in memory_status({}, gather_peak=4096)
+
+
+def test_fsdp_overlap_stats_exposed_comm_split():
+    from paddle_tpu.utils.profiler import fsdp_overlap_stats
+
+    sync = fsdp_overlap_stats(6, False)
+    assert sync["fsdp_exposed_collectives"] == 12  # every gather+reduce
+    assert sync["fsdp_exposed_comm_frac"] == 1.0
+    over = fsdp_overlap_stats(6, True)
+    assert over["fsdp_exposed_collectives"] == 2  # first gather+last reduce
+    assert abs(over["fsdp_exposed_comm_frac"] - 2 / 12) < 1e-12
+    assert fsdp_overlap_stats(0, True)["fsdp_exposed_collectives"] == 0
+
+
+def test_gather_peak_is_adjacent_pair_under_overlap():
+    """FsdpUpdater.gather_peak_bytes: largest single gathered layer
+    under the sync spelling, largest ADJACENT PAIR in prefetch-schedule
+    order under the overlap chain (exactly two buffers ever live)."""
+    from paddle_tpu.optim.zero1 import FsdpUpdater, overlap_spelling
+    from paddle_tpu.optim import Adam
+
+    mesh = create_mesh(n_fsdp=8)
+    params = {"a": jnp.ones((8, 16)), "b": jnp.ones((24, 16)),
+              "c": jnp.ones((16, 16))}
+    upd = FsdpUpdater(Adam(learning_rate=0.1), mesh, params)
+    assert len(upd.plan) == 3
+    sizes = {n: 8 * upd.plan[n][2] * 4 for n in upd.plan}
+    order = upd.schedule
+    with overlap_spelling("off"):
+        assert upd.gather_peak_bytes() == max(sizes.values())
+    with overlap_spelling("force"):
+        want = max(sizes[a] + sizes[b]
+                   for a, b in zip(order, order[1:]))
+        assert upd.gather_peak_bytes() == want
