@@ -874,6 +874,107 @@ def bench_serving(n_requests=None, rounds=None):
     return res
 
 
+def bench_serving_quant(rounds=None, calls=None):
+    """Quantized-serving three-way A/B: the SAME LSTM-classifier deploy
+    model merged fp32 / ``--quantize=bf16`` / ``--quantize=int8``, each
+    artifact loaded by the serving predictor exactly as deploy would
+    (storage-dtype leaves + fused dequant view) and WARMED THROUGH THE
+    ACCURACY GATE in-bench — a drifted quantized artifact aborts the
+    bench instead of publishing a speedup for a model that answers
+    wrong. Interleaved best-of-R per CLAUDE.md's host-drift rule: the
+    three precision tiers alternate within every round and each
+    reports its best per-round median batch-predict latency. The gate
+    deltas and verdict ride the artifact (PT401's ``serving_quant``
+    schema refuses the speedup without them). CPU-runnable
+    (``python bench.py --quant`` -> BENCH_r19.json); rides along as a
+    TPU child extra."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    import jax
+    from paddle_tpu import quant as quant_lib
+    from paddle_tpu.config import dsl
+    from paddle_tpu.data import integer_value, integer_value_sequence
+    from paddle_tpu.models import lstm_text_classifier
+    from paddle_tpu.serving import ServingPredictor
+    from paddle_tpu.trainer.merge_model import merge_model
+    from paddle_tpu.trainer.trainer import Topology
+
+    rounds = int(os.environ.get("BENCH_QUANT_ROUNDS", "3")
+                 if rounds is None else rounds)
+    calls = int(os.environ.get("BENCH_QUANT_CALLS", "12")
+                if calls is None else calls)
+    vocab, seqlen = 1000, 32
+    dsl.reset()
+    cost, out, _ = lstm_text_classifier(
+        vocab_size=vocab, embed_dim=32, hidden=48, num_layers=1,
+        classes=2)
+    topo = Topology(cost)
+    params = topo.network.init_params(jax.random.PRNGKey(0))
+    params = {k: np.asarray(v) for k, v in params.items()}
+    feeding = {"words": integer_value_sequence(vocab),
+               "label": integer_value(2)}
+    golden = quant_lib.golden_section(topo.graph, params, [out.name],
+                                      feeding)
+    rng = np.random.RandomState(0)
+    rows = [(list(rng.randint(0, vocab, size=seqlen)),
+             int(rng.randint(0, 2))) for _ in range(8)]
+
+    preds = {}
+    versions = {}
+    tmp = tempfile.mkdtemp(prefix="bench_quant_")
+    try:
+        for dt in ("fp32", "bf16", "int8"):
+            path = os.path.join(tmp, f"{dt}.ptmodel")
+            if dt == "fp32":
+                merge_model(path, topo.graph, params,
+                            outputs=[out.name])
+            else:
+                q, meta = quant_lib.quantize_params(params, dt,
+                                                    sparse_names=set())
+                merge_model(path, topo.graph, q, outputs=[out.name],
+                            quant=meta, golden=golden)
+            pred = ServingPredictor.from_merged(
+                path, feeding, batch_buckets=[8],
+                length_buckets=[seqlen])
+            # warmup REPLAYS THE GOLDEN GATE for the quantized tiers:
+            # a drifted artifact raises QuantGateError right here
+            pred.warmup()
+            preds[dt] = pred
+            versions[dt] = pred.model_version
+
+        def one_call(pred):
+            t0 = time.perf_counter()
+            pred.predict_rows(rows)
+            return (time.perf_counter() - t0) * 1e3
+
+        best = {}
+        for _ in range(rounds):
+            for dt, pred in preds.items():  # interleaved within round
+                ms = sorted(one_call(pred) for _ in range(calls))
+                med = ms[len(ms) // 2]
+                best[dt] = min(best.get(dt, float("inf")), med)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    assert len(set(versions.values())) == 3, (
+        f"precision tiers must publish distinct versions: {versions}")
+    res = {"quant_calls": calls, "quant_rows_per_call": len(rows),
+           "quant_model_versions": versions}
+    for dt in ("fp32", "bf16", "int8"):
+        res[f"quant_{dt}_p50_ms"] = round(best[dt], 3)
+    res["quant_bf16_vs_fp32"] = round(best["bf16"] / best["fp32"], 3)
+    res["quant_int8_vs_fp32"] = round(best["int8"] / best["fp32"], 3)
+    gates = {dt: preds[dt].quant_gate for dt in ("bf16", "int8")}
+    for dt, g in gates.items():
+        res[f"quant_gate_delta_{dt}"] = g["max_delta"]
+        res[f"quant_gate_tol_{dt}"] = g["tol"]
+    res["quant_gate_passed"] = all(g["passed"] for g in gates.values())
+    return res
+
+
 def bench_decode(rounds=None, calls=None):
     """Decode A/B (two axes, interleaved best-of-R per CLAUDE.md's
     host-drift rule):
@@ -1949,6 +2050,23 @@ def serving_main():
     return 0
 
 
+def quant_main():
+    """``python bench.py --quant``: the off-tunnel quantized-serving
+    three-way alone, forced onto CPU; one JSON line, mirrored to
+    BENCH_r19.json."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    result = {"metric": "serving_quant_ab",
+              "platform": jax.devices()[0].platform}
+    result.update(bench_serving_quant())
+    line = json.dumps(result)
+    print(line, flush=True)
+    here = os.path.dirname(os.path.abspath(__file__))
+    with open(os.path.join(here, "BENCH_r19.json"), "w") as f:
+        f.write(line + "\n")
+    return 0
+
+
 def pipeline_main():
     """``python bench.py --pipeline``: the off-tunnel pipeline A/B alone,
     forced onto an 8-virtual-device CPU mesh; one JSON line, mirrored to
@@ -2169,6 +2287,10 @@ def child_main():
     # serving A/B over the real chip: dynamic batching vs batch-size-1
     # (off-tunnel number: BENCH_r09.json via --serving)
     extra("serving", bench_serving)
+    # quantized serving three-way (fp32/bf16/int8) with the warmup
+    # accuracy gate asserted in-bench (off-tunnel: BENCH_r19.json via
+    # --quant)
+    extra("quant", bench_serving_quant)
     # decode A/B: early-exit chunked search vs full scan + continuous vs
     # convoy batching — armed here so the next tpu_watch.sh capture
     # window records on-chip decode numbers for free (off-tunnel number:
@@ -2211,6 +2333,8 @@ def main():
         return pipeline_main()
     if "--serving" in sys.argv[1:]:
         return serving_main()
+    if "--quant" in sys.argv[1:]:
+        return quant_main()
     if "--decode" in sys.argv[1:]:
         return decode_main()
     if "--fleet" in sys.argv[1:]:

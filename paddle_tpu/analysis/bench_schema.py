@@ -62,7 +62,12 @@ looser schema):
   the int exposed-collective counts
   (``exposed_collectives_overlap_on`` / ``..._off``) and the numeric
   exposed-comm fractions (``exposed_comm_frac_overlap_on`` /
-  ``..._off``) — the structural overlap evidence.
+  ``..._off``) — the structural overlap evidence. Metrics starting
+  with ``serving_quant`` (BENCH_r19, the quantized serving three-way)
+  must carry all three precision sides (``quant_fp32_p50_ms`` /
+  ``quant_bf16_p50_ms`` / ``quant_int8_p50_ms``), FINITE gate deltas
+  (``quant_gate_delta_bf16`` / ``quant_gate_delta_int8``) and the
+  bool ``quant_gate_passed`` — an un-gated speedup is not evidence.
 
 Everything must parse as one JSON object with finite numbers
 throughout (NaN/Infinity are emitted by a crashed averaging step and
@@ -265,6 +270,21 @@ def check_bench_file(path: str, rel: str) -> List[Finding]:
             if not isinstance(v, int) or isinstance(v, bool):
                 bad("autoscale artifact missing int "
                     "'fleet_failed_non_shed' summed across rounds")
+        if str(data.get("metric", "")).startswith("serving_quant"):
+            # the r19 quantized-serving generation (BENCH_r19): a
+            # quantization claim is only evidence with all THREE
+            # precision sides, the gate deltas FINITE (the in-bench
+            # accuracy gate actually replayed), and the gate verdict
+            for k in ("quant_fp32_p50_ms", "quant_bf16_p50_ms",
+                      "quant_int8_p50_ms", "quant_gate_delta_bf16",
+                      "quant_gate_delta_int8"):
+                v = data.get(k)
+                if not isinstance(v, (int, float)) or isinstance(v, bool):
+                    bad(f"quant artifact missing numeric {k!r} (the "
+                        "three-sided A/B + gate-delta evidence)")
+            if not isinstance(data.get("quant_gate_passed"), bool):
+                bad("quant artifact missing bool 'quant_gate_passed' "
+                    "(the in-bench warmup gate verdict)")
         if str(data.get("metric", "")).startswith("overlap"):
             # the r18 FSDP-overlap generation (BENCH_r18): the overlap
             # claim is only evidence with BOTH step-time sides AND the

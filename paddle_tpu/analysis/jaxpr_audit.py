@@ -331,6 +331,52 @@ def build_scoring_predictor():
     return pred, (pred.params, feed)
 
 
+def build_quant_predictor():
+    """The int8-quantized twin of :func:`build_scoring_predictor`:
+    the SAME model, quantized the way ``--job=merge --quantize=int8``
+    writes it, loaded the way the predictor serves it (int8 leaves +
+    traced ``::scale`` siblings, dequant fused inside ``_infer``).
+    Feeds the pass-4/5 ``serving_quant`` program: its pinned
+    per-device bytes ARE the quantization win, and its PT602 law
+    measures the params argument against the fp32 twin's byte count —
+    a refactor that re-materializes f32 residents fails the audit.
+    Returns ``(pred, (params, feed), f32_param_bytes)``."""
+    import numpy as np
+
+    import jax
+
+    from paddle_tpu import quant as quant_lib
+    from paddle_tpu.config import dsl
+    from paddle_tpu.core.network import Network
+    from paddle_tpu.data import integer_value, integer_value_sequence
+    from paddle_tpu.serving.predictor import (ServingPredictor,
+                                              _synth_sample)
+    V = 16
+    dsl.reset()
+    w = dsl.data(name="w", size=V)
+    lab = dsl.data(name="label", size=2)
+    emb = dsl.embedding(input=w, size=6, name="emb")
+    pooled = dsl.pooling(input=emb, pooling_type="avg", name="pool")
+    out = dsl.fc(input=pooled, size=2, act="softmax", name="out")
+    dsl.classification_cost(input=out, label=lab, name="cost")
+    graph = dsl.current_graph()
+    params = Network(graph, outputs=["out"]).init_params(
+        jax.random.PRNGKey(0))
+    params = {k: np.asarray(v) for k, v in params.items()}
+    f32_bytes = sum(np.asarray(v).astype(np.float32).nbytes
+                    for v in params.values())
+    qparams, meta = quant_lib.quantize_params(params, "int8",
+                                              sparse_names=set())
+    pred = ServingPredictor(
+        graph, qparams, ["out"],
+        {"w": integer_value_sequence(V), "label": integer_value(2)},
+        batch_buckets=[2], length_buckets=[8], donate=True, quant=meta)
+    rows = [tuple(_synth_sample(pred.feeding[n], 4)
+                  for n in pred.names)] * 2
+    feed = pred.feeder(list(rows))
+    return pred, (pred.params, feed), f32_bytes
+
+
 def audit_serving(log=print) -> List[Finding]:
     """The serving warm path: a bucketed scoring predictor's ``_infer``
     (masked sequence model) and a generating predictor's ``_encode``,

@@ -10,7 +10,7 @@ the TensorFlow cluster/placement design in PAPERS.md argues the same).
 Nothing before this pass caught a refactor that silently replicates a
 buffer, doubles a temp, or un-donates an aliased leaf.
 
-The pass reuses pass 4's ``.lower().compile()`` of the same eight real
+The pass reuses pass 4's ``.lower().compile()`` of the same nine real
 programs on the 8-device virtual mesh (``shard_audit.compile_programs``
 — ONE compile feeds both passes) and reads each executable's
 ``memory_analysis()``: per-device argument / output / temp / alias
@@ -303,14 +303,21 @@ def stale_mem_budget_findings(entries: List[MemBudgetEntry], used,
 # ================================================================ PT602
 def scaling_findings(cp: CompiledProgram) -> List[Finding]:
     """Each declared law: the selected leaves' per-device bytes (under
-    the COMPILED shardings) must stay within global/divisor * slack. A
-    law whose selector matches nothing is itself a finding — a renamed
-    key must not silently vacate the contract."""
+    the COMPILED shardings) must stay within base/divisor * slack,
+    where base is the matched leaves' global bytes — or the law's
+    explicit override (the optional 6th element): quantization laws
+    pass the f32-EQUIVALENT byte count there, so an int8 program whose
+    leaves silently regress to f32 storage blows the law even though
+    "its own" global bytes grew in lockstep. A law whose selector
+    matches nothing is itself a finding — a renamed key must not
+    silently vacate the contract."""
     findings: List[Finding] = []
     if not cp.spec.mem_laws:
         return findings
     rows = _leaf_rows(cp)
-    for label, argnum, pred, divisor, slack in cp.spec.mem_laws:
+    for law in cp.spec.mem_laws:
+        label, argnum, pred, divisor, slack = law[:5]
+        override_b = law[5] if len(law) > 5 else None
         global_b = 0
         device_b = 0
         matched = 0
@@ -329,15 +336,18 @@ def scaling_findings(cp: CompiledProgram) -> List[Finding]:
                 "the program (audit contract broke; fix the selector "
                 "or the program)"))
             continue
-        allowed = int(global_b / divisor * slack)
+        base_b = override_b if override_b is not None else global_b
+        allowed = int(base_b / divisor * slack)
         if device_b > allowed:
+            base_src = ("override" if override_b is not None
+                        else "global")
             findings.append(Finding(
                 "PT602", cp.spec.anchor, 1,
                 f"{cp.spec.name}: scaling law {label!r} VIOLATED — "
                 f"{matched} leaves hold {device_b} bytes/device vs "
-                f"allowed {allowed} ({global_b} global / {divisor}, "
+                f"allowed {allowed} ({base_b} {base_src} / {divisor}, "
                 f"slack {slack}) — the program's promised per-device "
-                "scaling regressed toward replication"))
+                "scaling regressed"))
     return findings
 
 

@@ -578,8 +578,13 @@ class ProgramSpec:
       triple claims are unclassified scaffolding (rng keys, step
       counters).
     - ``mem_laws`` — ``(label, argnum, path-predicate, divisor,
-      slack)`` scaling laws (PT602): the selected leaves' per-device
-      bytes must stay within ``global_bytes / divisor * slack``.
+      slack[, override_bytes])`` scaling laws (PT602): the selected
+      leaves' per-device bytes must stay within
+      ``base / divisor * slack`` where ``base`` is their global bytes,
+      or the optional 6th element when given — quantization laws pass
+      the f32-equivalent byte count so a silent regression to f32
+      storage violates even though the program's own global bytes
+      track it.
     - ``donated`` — the top-level argnums the program donates (PT603
       checks their aliasable leaves reach the compiled alias set).
     """
@@ -1003,14 +1008,38 @@ def build_serving_warm() -> ProgramSpec:
                        donated=(1,))
 
 
+def build_serving_quant() -> ProgramSpec:
+    """The int8-quantized serving warm path: the SAME scorer as
+    serving_warm with ``--quantize=int8`` storage (int8 leaves +
+    traced scale siblings, dequant fused in-trace). Collective budget
+    pinned EMPTY like serving_warm; its PT601 pin IS the quantization
+    footprint win, and the PT602 law compares the params argument
+    against the fp32 twin's byte count (the 6th law element) — a
+    quantized program whose weights silently re-materialize as f32
+    residents violates even though its own global bytes grew in
+    lockstep."""
+    from paddle_tpu.analysis.jaxpr_audit import build_quant_predictor
+    pred, args, f32_bytes = build_quant_predictor()
+    import jax
+    fn = jax.jit(pred._infer, donate_argnums=(1,))
+    laws = [("int8 params resident ~1/4 of the fp32 twin", 0, None,
+             3, 1.35, f32_bytes)]
+    return ProgramSpec("serving_quant",
+                       "paddle_tpu/serving/predictor.py",
+                       fn, args, None,
+                       mem_roles=(("params", 0, None), ("acts", 1, None)),
+                       mem_laws=laws, donated=(1,))
+
+
 PROGRAM_BUILDERS: List[Callable[[], ProgramSpec]] = [
     build_dp_train, build_zero1, build_pipeline, build_tp_embed,
     build_seq_ring, build_fsdp_train, build_fsdp_pipe,
-    build_serving_warm,
+    build_serving_warm, build_serving_quant,
 ]
 
 PROGRAM_NAMES = ("dp_train", "zero1", "pipeline", "tp_embed",
-                 "seq_ring", "fsdp_train", "fsdp_pipe", "serving_warm")
+                 "seq_ring", "fsdp_train", "fsdp_pipe", "serving_warm",
+                 "serving_quant")
 
 
 # ============================================================== the pass

@@ -107,6 +107,13 @@ class SequenceGenerator:
         self.gen = self.cfg.attrs["gen"]  # GeneratedInput spec dict
         self._jitted: "OrderedDict[Any, Callable]" = OrderedDict()
         self._evict_warned = False
+        #: optional params-view hook applied INSIDE the jitted step (the
+        #: single interior site where params are consumed). The serving
+        #: predictor installs ``quant.materialize`` here for quantized
+        #: artifacts: weights stay in storage dtype as traced arguments
+        #: and the dequant converts fuse into their consumers. None =
+        #: identity (the traced structure is untouched).
+        self._param_view = None
         #: observability for the last ``generate`` call:
         #: ``{decode_steps, steps_saved, max_length, decode_chunk,
         #: full_scan}`` — the serving predictor forwards it per request.
@@ -282,6 +289,8 @@ class SequenceGenerator:
         gen_boundary = gen["boundary"]
 
         def step(params, flat_static, state, t):
+            if self._param_view is not None:
+                params = self._param_view(params)
             emb = params[gen["embedding_name"]]
             prev_emb = emb[state["prev"].reshape(-1)]  # [B*K, E]
             feed = dict(flat_static)

@@ -11,10 +11,12 @@ from paddle_tpu.kernels.dispatch import (fused_optimizer,
                                          fused_rnn, rnn_cells_enabled,
                                          set_fused_optimizer,
                                          set_fused_rnn)
-from paddle_tpu.kernels.rnn_cells import gru_cell, lstm_cell
+from paddle_tpu.kernels.rnn_cells import (gru_cell, gru_cell_infer,
+                                          lstm_cell, lstm_cell_infer)
 
 __all__ = [
     "opt_update", "lstm_cell", "gru_cell",
+    "lstm_cell_infer", "gru_cell_infer",
     "fused_rnn", "fused_optimizer",
     "rnn_cells_enabled", "fused_optimizer_enabled",
     "set_fused_rnn", "set_fused_optimizer",

@@ -243,3 +243,42 @@ def gru_cell(x, h, w_gate, w_state, act_input="tanh", act_gate="sigmoid"):
         return _gru_fused(x, h, w_gate, w_state)
     return _gru_math(x, h, w_gate, w_state,
                      _act(act_input), _act(act_gate))
+
+
+# -------------------------------------------------- inference variants
+
+def lstm_cell_infer(gates, c_prev, check_i, check_f, check_o,
+                    act_input="tanh", act_gate="sigmoid",
+                    act_state="tanh"):
+    """``lstm_cell`` for the no-grad serving path: the PRIMAL spelling
+    only. The training entry wraps the Pallas call in a ``custom_vjp``
+    whose forward saves the full operand tuple as residuals and whose
+    backward re-traces the reference math — plumbing a scoring/generate
+    step never uses but still carries through tracing. This variant
+    calls the Pallas primal directly: no residual tuple, no backward
+    spelling in the program, and ``jax.grad`` through it fails loudly
+    (``pallas_call`` has no AD rule), which PINS it to no-grad routing
+    — layers select it only under ``train=False``. The fallback is the
+    same verbatim inline math, so off-TPU routing stays bit-invisible
+    (``docs/kernels.md``)."""
+    default = (act_input in ("tanh", "", None)
+               and act_gate in ("sigmoid", "", None)
+               and act_state in ("tanh", "", None))
+    if _lstm_pallas_ok(gates, c_prev, (check_i, check_f, check_o),
+                       default):
+        return _lstm_pallas(gates, c_prev, check_i, check_f, check_o)
+    return _lstm_math(gates, c_prev, check_i, check_f, check_o,
+                      _act(act_input), _act(act_gate), _act(act_state))
+
+
+def gru_cell_infer(x, h, w_gate, w_state, act_input="tanh",
+                   act_gate="sigmoid"):
+    """``gru_cell`` for the no-grad serving path — primal-only, same
+    contract as :func:`lstm_cell_infer` (no residuals, no backward
+    spelling; ``jax.grad`` through the Pallas path fails loudly)."""
+    default = (act_input in ("tanh", "", None)
+               and act_gate in ("sigmoid", "", None))
+    if _gru_pallas_ok(x, h, default):
+        return _gru_pallas(x, h, w_gate, w_state)
+    return _gru_math(x, h, w_gate, w_state,
+                     _act(act_input), _act(act_gate))

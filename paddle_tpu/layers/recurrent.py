@@ -126,8 +126,11 @@ class LstmLayer(LayerImpl):
             if _kernels.rnn_cells_enabled():
                 # fused cell (kernels/rnn_cells.py): the fallback
                 # spelling is this inline math verbatim, so the flag is
-                # bitwise-invisible off-TPU
-                out, state = _kernels.lstm_cell(
+                # bitwise-invisible off-TPU; no-grad serving takes the
+                # primal-only inference spelling (no residual plumbing)
+                cell = (_kernels.lstm_cell if ctx.train
+                        else _kernels.lstm_cell_infer)
+                out, state = cell(
                     gates, c, check_i, check_f, check_o,
                     act_in_name, act_gate_name, act_state_name)
                 return (out, state), out
@@ -195,8 +198,10 @@ class GruLayer(LayerImpl):
             (h,) = carry
             x_t = x_t + bias
             if _kernels.rnn_cells_enabled():
-                out = _kernels.gru_cell(x_t, h, w_gate, w_state,
-                                        act_in_name, act_gate_name)
+                cell = (_kernels.gru_cell if ctx.train
+                        else _kernels.gru_cell_infer)
+                out = cell(x_t, h, w_gate, w_state,
+                           act_in_name, act_gate_name)
                 return (out,), out
             zr = x_t[:, : 2 * size] + h @ w_gate
             z = act_gate(zr[:, :size])
@@ -279,7 +284,9 @@ class GruStepLayer(LayerImpl):
         w_gate = params["w0"][:, : 2 * size]
         w_state = params["w0"][:, 2 * size:]
         if _kernels.rnn_cells_enabled():
-            return Argument(value=_kernels.gru_cell(
+            cell = (_kernels.gru_cell if ctx.train
+                    else _kernels.gru_cell_infer)
+            return Argument(value=cell(
                 x, h, w_gate, w_state,
                 cfg.attrs.get("active_type", "tanh"),
                 cfg.attrs.get("active_gate_type", "sigmoid")))
@@ -328,7 +335,9 @@ class LstmStepLayer(LayerImpl):
             z = jnp.zeros((size,), gates.dtype)
             check_i = check_f = check_o = z
         if _kernels.rnn_cells_enabled():
-            out, state = _kernels.lstm_cell(
+            cell = (_kernels.lstm_cell if ctx.train
+                    else _kernels.lstm_cell_infer)
+            out, state = cell(
                 gates, c_prev, check_i, check_f, check_o,
                 cfg.attrs.get("active_type", "tanh"),
                 cfg.attrs.get("active_gate_type", "sigmoid"),

@@ -1,0 +1,245 @@
+"""Deploy-time weight quantization (the serving precision tier).
+
+``--job=merge --quantize=bf16|int8`` calls :func:`quantize_params` and
+writes the result into the PTM1 artifact as an optional ``quant``
+section (``trainer/merge_model.py`` — an old reader of an unquantized
+file sees no change). The serving predictor reverses it lazily:
+quantized leaves stay in their storage dtype in HBM (int8 weights ARE
+int8 device arrays) and :func:`materialize` rebuilds the compute-dtype
+view *inside* the jitted forward, so XLA fuses each dequant convert
+into its consumer instead of materializing an f32 copy of the model —
+the whole point of the exercise (graftlint pass 5 pins the
+``serving_quant`` program's per-device bytes so a regression back to
+f32 residents is PT602 drift, not a hope).
+
+Scheme:
+
+- **bf16** — storage cast, no scales. Every floating leaf is kept as
+  bfloat16 and converted back to f32 at point of use.
+- **int8** — per-tensor symmetric: ``scale = max|w| / 127`` (a
+  zero-range/constant tensor pins ``scale = 1`` — no div-by-zero, the
+  quantized zeros round-trip exactly), ``q = clip(round(w / scale))``.
+  Tables with sparse gradients quantize **row-wise** (one scale per
+  leading row, so a hot row's range cannot be crushed by a cold
+  outlier row); a sparse table row-wise cannot express (ndim < 2)
+  stands down to f32 with a named entry in ``meta["skipped"]`` — never
+  silently. 1-D leaves (biases, norm gains: a rounding error there
+  shifts every logit) and non-float leaves also stay f32/as-is, also
+  named in ``skipped``.
+
+Masks never enter this module: quantization sees the parameter table
+only, and the feed funnel keeps its f32-mask invariant
+(``utils/masks.assert_feed_masks_f32``, graftlint PT102/PT203).
+
+The gate half lives here too: :func:`make_golden_rows` +
+:func:`golden_section` record a deterministic golden-request set with
+fp32 reference outputs at merge time; the predictor replays it at
+warmup and refuses READY past the per-dtype tolerance
+(:data:`GATE_TOLERANCES`, override via ``--quantize_tol``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from paddle_tpu.utils.log import get_logger
+
+logger = get_logger("quant")
+
+QUANT_DTYPES = ("bf16", "int8")
+
+#: warmup-gate tolerance on the normalized max-abs output delta
+#: (|quant - fp32|_max / max(1, |fp32|_max)), per storage dtype.
+#: bf16 keeps 8 mantissa bits (~2-3 significant digits); int8
+#: per-tensor rounding is an order coarser.
+GATE_TOLERANCES = {"bf16": 2e-2, "int8": 1e-1}
+
+#: params-dict key suffix the predictor uses for traced scale leaves
+#: (they ride the same pytree as the weights so they are jit ARGUMENTS,
+#: never closed-over constants — graftlint PT101/PT201 discipline).
+SCALE_SUFFIX = "::scale"
+
+
+def _is_float(arr) -> bool:
+    return np.issubdtype(np.asarray(arr).dtype, np.floating)
+
+
+def int8_scale(w: np.ndarray, axis=None) -> np.ndarray:
+    """Symmetric per-tensor (``axis=None``) or per-row scale with the
+    zero-range guard: a constant/empty range pins scale=1 so the
+    quantized zeros round-trip exactly and nothing divides by zero."""
+    amax = np.max(np.abs(w), axis=axis, keepdims=axis is not None)
+    amax = np.asarray(amax, np.float32)
+    return np.where(amax > 0, amax / 127.0, np.float32(1.0))
+
+
+def quantize_params(params: Dict[str, np.ndarray], dtype: str,
+                    sparse_names: Iterable[str] = ()
+                    ) -> Tuple[Dict[str, np.ndarray], Dict]:
+    """-> ``(qparams, meta)``. ``meta`` is the PTM1 ``quant`` section:
+    ``{"dtype", "scales": {name: np f32}, "skipped": {name: reason},
+    "tol"}``. ``sparse_names`` (from ``trainer.meta``'s
+    ``ParamSpec.sparse_grad``) selects row-wise int8 scales."""
+    if dtype not in QUANT_DTYPES:
+        raise ValueError(f"--quantize must be one of {QUANT_DTYPES}, "
+                         f"got {dtype!r}")
+    sparse = set(sparse_names)
+    qparams: Dict[str, np.ndarray] = {}
+    scales: Dict[str, np.ndarray] = {}
+    skipped: Dict[str, str] = {}
+    for name, v in params.items():
+        w = np.asarray(v)
+        if not _is_float(w):
+            qparams[name] = w
+            skipped[name] = f"non-float dtype {w.dtype}"
+            continue
+        if dtype == "bf16":
+            import jax.numpy as jnp
+            qparams[name] = np.asarray(
+                jnp.asarray(w, jnp.float32).astype(jnp.bfloat16))
+            continue
+        # int8
+        if w.ndim < 2:
+            qparams[name] = np.asarray(w, np.float32)
+            skipped[name] = (
+                "sparse table with ndim < 2: row-wise int8 scales are "
+                "not expressible, kept f32" if name in sparse else
+                "1-D leaf (bias/norm) kept f32: per-element rounding "
+                "would shift every logit")
+            if name in sparse:
+                logger.warning("quantize: %s STOOD DOWN to f32 (%s)",
+                               name, skipped[name])
+            continue
+        axis = tuple(range(1, w.ndim)) if name in sparse else None
+        s = int8_scale(w.astype(np.float32), axis=axis)
+        q = np.clip(np.rint(w.astype(np.float32) / s), -127, 127)
+        qparams[name] = q.astype(np.int8)
+        scales[name] = np.asarray(s, np.float32)
+    meta = {"dtype": dtype, "scales": scales, "skipped": skipped,
+            "tol": GATE_TOLERANCES[dtype]}
+    return qparams, meta
+
+
+def scale_leaves(meta: Dict) -> Dict[str, np.ndarray]:
+    """The traced scale leaves, keyed for the predictor's params dict
+    (``name + SCALE_SUFFIX``). Empty for bf16."""
+    return {name + SCALE_SUFFIX: s
+            for name, s in meta.get("scales", {}).items()}
+
+
+def materialize(params: Dict, meta: Dict,
+                compute_dtype=None) -> Dict:
+    """The compute-dtype view of a quantized params dict, built INSIDE
+    a trace: int8 leaves dequantize against their traced
+    ``name::scale`` sibling, bf16 leaves upcast, f32 stand-downs pass
+    through, scale keys are stripped. All ops are elementwise converts
+    XLA fuses into each weight's consumer — no f32 twin of the model
+    ever becomes a resident buffer."""
+    import jax.numpy as jnp
+    compute_dtype = compute_dtype or jnp.float32
+    out = {}
+    for name, leaf in params.items():
+        if name.endswith(SCALE_SUFFIX):
+            continue
+        skey = name + SCALE_SUFFIX
+        if skey in params:
+            out[name] = (leaf.astype(compute_dtype)
+                         * params[skey].astype(compute_dtype))
+        elif jnp.issubdtype(leaf.dtype, jnp.floating) \
+                and leaf.dtype != compute_dtype:
+            out[name] = leaf.astype(compute_dtype)
+        else:
+            out[name] = leaf
+    return out
+
+
+def dequantize_params(qparams: Dict[str, np.ndarray],
+                      meta: Dict) -> Dict[str, np.ndarray]:
+    """Host-side eager dequant (tests / offline tooling): the same
+    arithmetic as :func:`materialize`, on numpy."""
+    out = {}
+    scales = meta.get("scales", {})
+    for name, v in qparams.items():
+        w = np.asarray(v)
+        if name in scales:
+            out[name] = w.astype(np.float32) * np.asarray(scales[name],
+                                                          np.float32)
+        elif _is_float(w):
+            out[name] = w.astype(np.float32)
+        else:
+            out[name] = w
+    return out
+
+
+# ------------------------------------------------------------- golden set
+def make_golden_rows(feeding: Dict, n: int = 4, length: int = 4,
+                     seed: int = 7) -> List[tuple]:
+    """A deterministic pseudo-random golden-request set shaped like
+    real traffic for every input slot (dense values, in-range ids,
+    sparse index lists). Short sequences (``length``) so the set stays
+    admissible under any serving length-bucket menu."""
+    from paddle_tpu.data import types as T
+    rng = np.random.RandomState(seed)
+    rows: List[tuple] = []
+    for _ in range(n):
+        row = []
+        for name in feeding:
+            itype = feeding[name]
+            if itype.seq_type == T.SUB_SEQUENCE:
+                raise ValueError(
+                    f"golden set: input {name!r} is a nested sequence; "
+                    "serving refuses SUB_SEQUENCE inputs, so a "
+                    "quantized artifact cannot gate on one")
+            steps = length if itype.seq_type == T.SEQUENCE else None
+
+            def one():
+                if itype.type == T.INDEX:
+                    return int(rng.randint(itype.dim))
+                if itype.type in (T.SPARSE_BINARY, T.SPARSE_FLOAT):
+                    k = min(2, itype.dim)
+                    ids = sorted(rng.choice(itype.dim, size=k,
+                                            replace=False).tolist())
+                    if itype.type == T.SPARSE_FLOAT:
+                        return list(zip(
+                            ids, rng.rand(k).astype(float).tolist()))
+                    return ids
+                return rng.randn(itype.dim).astype(np.float32)
+
+            row.append([one() for _ in range(steps)]
+                       if steps is not None else one())
+        rows.append(tuple(row))
+    return rows
+
+
+def golden_section(graph, params: Dict, output_names: List[str],
+                   feeding: Dict, n: int = 4) -> Optional[Dict]:
+    """The PTM1 ``golden`` section: rows + their fp32 reference
+    outputs, computed on the UNQUANTIZED params through the plain
+    (unbucketed) feed path. Returns None (with a named warning) for a
+    generation-only config — the gate covers score outputs."""
+    from paddle_tpu.core.network import Network
+    from paddle_tpu.data.feeder import DataFeeder
+    score = [name for name in output_names
+             if graph.layers[name].type != "beam_search_group"]
+    if not score:
+        logger.warning(
+            "quantize: config has no scoring outputs (generation-only)"
+            " — no golden gate set recorded; the warmup gate will "
+            "stand down with a named warning")
+        return None
+    rows = make_golden_rows(feeding, n=n)
+    feed = DataFeeder(feeding)(list(rows))
+    outs = Network(graph, outputs=score).apply(params, feed, train=False)
+    refs = {name: np.asarray(outs[name].value) for name in score}
+    return {"rows": rows, "outputs": refs, "n": n}
+
+
+def gate_delta(got: np.ndarray, ref: np.ndarray) -> float:
+    """Normalized max-abs output delta the warmup gate compares against
+    the per-dtype tolerance."""
+    got = np.asarray(got, np.float64)
+    ref = np.asarray(ref, np.float64)
+    return float(np.max(np.abs(got - ref))
+                 / max(1.0, float(np.max(np.abs(ref)))))

@@ -56,6 +56,7 @@ from paddle_tpu.serving.batcher import ServingEngine  # noqa: F401
 from paddle_tpu.serving.client import ServingClient  # noqa: F401
 from paddle_tpu.serving.errors import (BadRequest,  # noqa: F401
                                        DeadlineExceeded, Overloaded,
+                                       QuantGateError, ReloadRejected,
                                        ServingError, ShuttingDown,
                                        Unavailable)
 from paddle_tpu.serving.metrics import (RouterMetrics,  # noqa: F401

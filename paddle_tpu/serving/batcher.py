@@ -204,6 +204,12 @@ class ServingEngine:
                                      None),
             "fatal": repr(self.fatal) if self.fatal else None,
         }
+        quant = getattr(self.predictor, "quant_health", None)
+        if quant is not None:
+            # precision tier + warmup accuracy-gate verdict: a canary
+            # (and the rolling-reload report) reads this to know which
+            # precision answered and whether the gate vouched for it
+            h["quant"] = quant()
         cache = getattr(self.predictor, "aot_cache", None)
         if cache is not None:
             h["aot_cache"] = dict(cache.stats)

@@ -89,6 +89,44 @@ class Unavailable(Overloaded):
     code = "unavailable"
 
 
+class QuantGateError(ServingError):
+    """A quantized artifact drifted past the warmup accuracy gate: the
+    golden-request replay's per-output delta vs the recorded fp32
+    references exceeded the per-dtype tolerance. Raised at warmup — the
+    replica never reports READY (same discipline as the closed shape
+    menu, applied to accuracy). Carries the gate evidence so the
+    reload/rollback path can report WHY the artifact was refused."""
+
+    status = 503
+    code = "quant_gate"
+
+    def __init__(self, message: str, dtype: Optional[str] = None,
+                 deltas: Optional[dict] = None,
+                 tol: Optional[float] = None, **kw):
+        super().__init__(message, **kw)
+        self.dtype = dtype
+        self.deltas = deltas
+        self.tol = tol
+
+    def to_wire(self) -> dict:
+        body = super().to_wire()
+        gate = {"dtype": self.dtype, "tol": self.tol,
+                "deltas": self.deltas}
+        body["error"]["gate"] = gate
+        return body
+
+
+class ReloadRejected(ServingError):
+    """A rolling reload's replacement replica failed to build (warmup
+    error, quant gate refusal, corrupt artifact); the fleet ROLLED BACK
+    to the previous artifact instead of publishing the bad one. 409 —
+    the reload is refused, the fleet is still healthy on the old
+    version. ``str(self)`` names the underlying refusal."""
+
+    status = 409
+    code = "reload_rejected"
+
+
 def from_wire(body: dict, status: int) -> ServingError:
     """Client side: rebuild the typed error from a JSON error body."""
     err = (body or {}).get("error", {})
@@ -99,9 +137,16 @@ def from_wire(body: dict, status: int) -> ServingError:
         Overloaded.code: Overloaded,
         ShuttingDown.code: ShuttingDown,
         Unavailable.code: Unavailable,
+        QuantGateError.code: QuantGateError,
+        ReloadRejected.code: ReloadRejected,
     }.get(code, ServingError)
     e = cls(err.get("message", f"HTTP {status}"),
             retry_after_ms=err.get("retry_after_ms"),
             allowed=err.get("allowed"))
+    if isinstance(e, QuantGateError):
+        gate = err.get("gate") or {}
+        e.dtype = gate.get("dtype")
+        e.tol = gate.get("tol")
+        e.deltas = gate.get("deltas")
     e.status = status
     return e

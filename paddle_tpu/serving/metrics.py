@@ -193,7 +193,8 @@ class RouterMetrics:
     COUNTERS = ("dispatches_total", "responses_total", "failovers_total",
                 "hedges_total", "hedge_wins_total", "ejections_total",
                 "breaker_open_total", "respawns_total", "reloads_total",
-                "shed_total", "replica_deaths_total",
+                "reload_rollbacks_total", "shed_total",
+                "replica_deaths_total",
                 # HA + elastic-capacity plane (r14): fenced dispatch
                 # refusals (the old active provably stopped), standby
                 # fleet adoptions, autoscale actions, supervisor kills

@@ -26,6 +26,20 @@ shape discipline:
   must stay one: graftlint pass 4 compiles ``_infer`` and pins its
   collective manifest EMPTY (``analysis/comm_budget.toml`` — any
   collective the serving step grows is PT501 drift at lint time).
+- **Quantized tier.** A ``--quantize`` PTM1 artifact loads with its
+  weights in STORAGE dtype (int8 stays int8 in HBM, bf16 stays bf16)
+  plus traced per-tensor scale leaves; ``paddle_tpu/quant.py:
+  materialize`` rebuilds the f32 view inside each jitted program so
+  XLA fuses the dequant converts at point of use — no resident f32
+  twin (graftlint pass 5 pins the ``serving_quant`` footprint). At
+  warmup the embedded golden-request set replays through the real
+  bucketed path and the per-output delta vs the recorded fp32
+  references must stay within the artifact's per-dtype tolerance — a
+  drifted quantized model raises ``QuantGateError`` and never goes
+  READY (the closed-shape-menu discipline applied to accuracy); the
+  gate verdict rides ``/healthz`` and the rolling-reload report.
+  Masks are feed-side and stay f32 through the quantized funnel
+  (``assert_feed_masks_f32`` in ``_convert``, unchanged).
 """
 
 from __future__ import annotations
@@ -84,7 +98,9 @@ class ServingPredictor:
                  gen_full_scan: Optional[bool] = None,
                  donate: Optional[bool] = None,
                  recompile_warn: int = 64,
-                 aot_cache=None, model_hash: Optional[str] = None):
+                 aot_cache=None, model_hash: Optional[str] = None,
+                 quant: Optional[Dict[str, Any]] = None,
+                 golden: Optional[Dict[str, Any]] = None):
         import jax
         import jax.numpy as jnp
 
@@ -103,6 +119,25 @@ class ServingPredictor:
             model_hash = model_fingerprint(graph, self.params)
         self.model_hash = str(model_hash)
         self.model_version = self.model_hash[:12]
+        # quantized artifacts: weights stay in storage dtype, traced
+        # scale leaves join the params pytree, and every jitted program
+        # sees the f32 view through _materialize (dequant fused at
+        # point of use). The dtype suffix makes precision part of the
+        # published version so canaries/provenance can tell tiers apart
+        # even before reading /healthz's quant block.
+        self.quant = dict(quant) if quant else None
+        self.golden = golden
+        self.quant_gate: Optional[Dict[str, Any]] = None
+        self._materialize = None
+        if self.quant:
+            from paddle_tpu import quant as quant_lib
+            self.params.update(
+                {k: jnp.asarray(v) for k, v in
+                 quant_lib.scale_leaves(self.quant).items()})
+            meta = self.quant
+            self._materialize = (
+                lambda p: quant_lib.materialize(p, meta))
+            self.model_version += "+" + str(self.quant["dtype"])
         if isinstance(aot_cache, str):
             from paddle_tpu.serving.aot_cache import AOTCache
             aot_cache = AOTCache(aot_cache, self.model_hash)
@@ -172,7 +207,12 @@ class ServingPredictor:
         self.guards: List[RecompileGuard] = []
         if self.network is not None:
             def _fwd(p, feed):
-                outs = self.network.apply(p, feed, train=False)
+                # quantized models: dequant INSIDE the trace, so XLA
+                # fuses the converts into each weight's consumer; the
+                # fp32 path is structurally untouched (identical jaxpr)
+                pp = (self._materialize(p) if self._materialize
+                      else p)
+                outs = self.network.apply(pp, feed, train=False)
                 return {n: outs[n].value for n in score_outputs}
 
             self._infer = jax.jit(_fwd, donate_argnums=donate_args)
@@ -186,6 +226,11 @@ class ServingPredictor:
             from paddle_tpu.core.generation import (
                 SequenceGenerator as EngineGenerator)
             self.engine = EngineGenerator(graph, self._gen_name)
+            if self._materialize is not None:
+                # the generation engine consumes params at exactly one
+                # interior site (SequenceGenerator.step); the view hook
+                # dequantizes there, inside the jitted search
+                self.engine._param_view = self._materialize
             self.gen_beam_size = int(
                 gen_beam_size or self.engine.cfg.attrs.get("beam_size", 1))
             self.gen_max_length = int(
@@ -207,7 +252,9 @@ class ServingPredictor:
             encoder = Network(graph, outputs=enc_outputs)
 
             def _enc(p, feed):
-                outs = encoder.apply(p, feed, train=False)
+                pp = (self._materialize(p) if self._materialize
+                      else p)
+                outs = encoder.apply(pp, feed, train=False)
                 return {n: outs[n] for n in enc_outputs}
 
             self._encode = jax.jit(_enc, donate_argnums=donate_args)
@@ -225,11 +272,19 @@ class ServingPredictor:
         still comes from the config — the merged payload carries graph +
         params + output names, not input type declarations. The PTM1
         payload digest becomes the model hash (AOT-cache key + reported
-        version), unless the caller pins its own."""
-        from paddle_tpu.trainer.merge_model import load_merged, \
+        version), unless the caller pins its own. A ``--quantize``
+        artifact's optional sections thread through automatically:
+        ``quant`` activates the storage-dtype load + dequant view,
+        ``golden`` arms the warmup accuracy gate. The quantized payload
+        digest differs from the fp32 merge of the same model, so the
+        AOT cache and the published version can never collide across
+        precision tiers."""
+        from paddle_tpu.trainer.merge_model import load_merged_ex, \
             merged_digest
-        graph, params, outputs = load_merged(path)
+        graph, params, outputs, extras = load_merged_ex(path)
         kwargs.setdefault("model_hash", merged_digest(path))
+        kwargs.setdefault("quant", extras.get("quant"))
+        kwargs.setdefault("golden", extras.get("golden"))
         return cls(graph, params, outputs, feeding, **kwargs)
 
     # ------------------------------------------------------------- warmup
@@ -256,6 +311,10 @@ class ServingPredictor:
             self._ensure_engine_guard()
         for g in self.guards:
             g.harden()
+        # quantized artifacts must PASS the accuracy gate before this
+        # predictor may report warmed/READY — a drifted model raises
+        # here, exactly like a shape outside the closed menu would
+        self._run_quant_gate(log)
         self.warmed = True
         if log:
             cache = ""
@@ -269,6 +328,76 @@ class ServingPredictor:
                 f"(batch={self.batch_buckets}, "
                 f"length={self.length_buckets}{cache})")
         return runs
+
+    # ------------------------------------------------------- quant gate
+    def quant_health(self) -> Dict[str, Any]:
+        """The precision tier + gate verdict ``/healthz`` publishes (a
+        canary reads this to know which precision answered)."""
+        return {"dtype": (self.quant["dtype"] if self.quant else "fp32"),
+                "gate": self.quant_gate}
+
+    def _run_quant_gate(self, log=None):
+        """Replay the artifact's golden-request set through the REAL
+        bucketed scoring path and compare per-output deltas against the
+        recorded fp32 references. Raises ``QuantGateError`` past the
+        per-dtype tolerance; records the verdict either way. A
+        quantized artifact without a usable golden set (generation-only
+        config) stands down with a NAMED warning — never silently."""
+        if not self.quant:
+            return
+        from paddle_tpu import quant as quant_lib
+        from paddle_tpu.serving.errors import QuantGateError
+        dtype = str(self.quant["dtype"])
+        tol = float(self.quant.get("tol",
+                                   quant_lib.GATE_TOLERANCES[dtype]))
+        golden = self.golden
+        if (self.network is None or not golden
+                or not golden.get("rows")):
+            reason = ("no scoring outputs (generation-only config)"
+                      if self.network is None
+                      else "artifact carries no golden section")
+
+            self.quant_gate = {"checked": False, "dtype": dtype,
+                               "tol": tol, "reason": reason}
+            logger.warning(
+                "quantized model %s: warmup accuracy gate STOOD DOWN "
+                "(%s) — serving %s weights unverified",
+                self.model_version, reason, dtype)
+            return
+        rows = [tuple(r) for r in golden["rows"]]
+        refs = golden["outputs"]
+        bmax = self.batch_buckets[-1]
+        deltas: Dict[str, float] = {n: 0.0 for n in refs}
+        try:
+            for i in range(0, len(rows), bmax):
+                chunk = rows[i:i + bmax]
+                outs, _info = self.predict_rows(chunk)
+                for name, ref in refs.items():
+                    got = outs[name][:len(chunk)]
+                    d = quant_lib.gate_delta(got,
+                                             ref[i:i + len(chunk)])
+                    deltas[name] = max(deltas[name], d)
+        except BadRequest as e:
+            raise QuantGateError(
+                f"warmup accuracy gate could not replay the golden "
+                f"set through the serving menu: {e}", dtype=dtype,
+                deltas={}, tol=tol) from e
+        worst = max(deltas.values())
+        passed = worst <= tol
+        self.quant_gate = {"checked": True, "dtype": dtype, "tol": tol,
+                           "max_delta": worst, "passed": passed,
+                           "outputs": dict(deltas)}
+        if not passed:
+            raise QuantGateError(
+                f"quantized model {self.model_version} drifted past "
+                f"the warmup accuracy gate: max output delta "
+                f"{worst:.4g} > tolerance {tol:g} for {dtype} "
+                f"(per-output: {deltas}) — refusing to go READY",
+                dtype=dtype, deltas=deltas, tol=tol)
+        if log:
+            log(f"quant gate PASSED ({dtype}): max output delta "
+                f"{worst:.4g} <= tol {tol:g} over "
+                f"{len(rows)} golden rows")
 
     def _aot_executable(self, name: str, sig: str, args, build):
         """One warmed executable: deserialize from the cache when it has

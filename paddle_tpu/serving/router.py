@@ -810,6 +810,8 @@ class ReplicaRouter:
                 if okind == "ok":
                     self._record_success(rep)
                     prov["replica"] = rep.id
+                    prov["model_version"] = rep.last_health.get(
+                        "model_version")
                     if self._first_answer_pending:
                         # the first answer after a standby takeover is
                         # the postmortem's closing bracket (lease
@@ -838,6 +840,8 @@ class ReplicaRouter:
                     # answer; failing over would fail identically
                     self._record_success(rep)
                     prov["replica"] = rep.id
+                    prov["model_version"] = rep.last_health.get(
+                        "model_version")
                     for orep, opend in live:
                         self._abandon(orep, opend)
                     payload.provenance = prov
@@ -897,16 +901,29 @@ class ReplicaRouter:
 
     # ------------------------------------------------------------- reload
     def rolling_reload(self, build: Callable[[str], object],
-                       wait_ready_s: float = 300.0) -> List[str]:
+                       wait_ready_s: float = 300.0,
+                       fallback_build: Optional[
+                           Callable[[str], object]] = None) -> List[str]:
         """Hot-swap the model one replica at a time, zero queued drops:
         DRAINING (dispatch stops now) -> drain via the SIGTERM machinery
         (queued + in-flight requests all complete) -> swap in
         ``build(replica_id)`` (a started transport for the new version;
         ms-fast when its predictor warms from the AOT cache) -> wait
         READY -> next replica. Returns the per-replica model versions
-        after the roll. Raises if a swapped replica never turns ready —
-        earlier replicas stay swapped (mixed-version fleet; roll back by
-        reloading again with the old artifact)."""
+        after the roll.
+
+        **Rollback**: when ``build`` itself raises — a corrupt artifact,
+        or a quantized model refused by the warmup accuracy gate
+        (``QuantGateError``) — and ``fallback_build`` is given, the
+        drained replica is REBUILT on the previous artifact and the roll
+        aborts with a typed :class:`~paddle_tpu.serving.errors.
+        ReloadRejected` naming the refusal: the bad version is never
+        published and the fleet stays whole on the old one. Without a
+        fallback the old behavior stands (the replica is left drained;
+        the caller must reload again with a good artifact). Raises if a
+        swapped replica never turns ready — earlier replicas stay
+        swapped (mixed-version fleet; roll back by reloading again with
+        the old artifact)."""
         with self._lock:
             if self._reloading:
                 raise RuntimeError("a rolling reload is already running")
@@ -919,7 +936,30 @@ class ReplicaRouter:
                 logger.info("rolling reload: draining %s", rep.id)
                 rep.transport.begin_drain()
                 rep.transport.drain_wait()
-                new = build(rep.id)
+                try:
+                    new = build(rep.id)
+                except Exception as e:  # noqa: BLE001 — typed below
+                    if fallback_build is None:
+                        raise
+                    from paddle_tpu.serving.errors import ReloadRejected
+                    logger.warning(
+                        "rolling reload: new artifact REFUSED on %s "
+                        "(%s); rolling back to the previous artifact",
+                        rep.id, e)
+                    old = fallback_build(rep.id)
+                    with self._lock:
+                        rep.transport = old
+                        rep.state = WARMING
+                        rep.consecutive_failures = 0
+                        rep.poll_failures = 0
+                        rep.breaker_cooldown_ms = None
+                    self.metrics.inc("reload_rollbacks_total")
+                    self._wait_replica_ready(rep, wait_ready_s)
+                    raise ReloadRejected(
+                        f"reload rejected: replica {rep.id} refused the "
+                        f"new artifact ({e}); fleet rolled back to the "
+                        "previous version (no replica serves the bad "
+                        "artifact)") from e
                 with self._lock:
                     rep.transport = new
                     rep.state = WARMING
@@ -927,29 +967,34 @@ class ReplicaRouter:
                     rep.poll_failures = 0
                     rep.breaker_cooldown_ms = None
                 self.metrics.inc("reloads_total")
-                deadline = time.monotonic() + wait_ready_s
-                while True:
-                    try:
-                        h = rep.transport.healthz()
-                        self._apply_health(rep, h)
-                        if rep.state == READY:
-                            versions.append(h.get("model_version"))
-                            break
-                    except Exception:  # noqa: BLE001 — keep waiting
-                        pass
-                    if time.monotonic() > deadline:
-                        raise RuntimeError(
-                            f"rolling reload: replica {rep.id} did not "
-                            f"turn ready within {wait_ready_s}s; roll "
-                            "halted (earlier replicas are on the new "
-                            "version)")
-                    time.sleep(0.01)
+                versions.append(self._wait_replica_ready(rep,
+                                                         wait_ready_s))
                 logger.info("rolling reload: %s ready on version %s",
                             rep.id, versions[-1])
             return versions
         finally:
             with self._lock:
                 self._reloading = False
+
+    def _wait_replica_ready(self, rep, wait_ready_s: float):
+        """Poll one replica until READY; returns its reported model
+        version. Raises RuntimeError past the deadline."""
+        deadline = time.monotonic() + wait_ready_s
+        while True:
+            try:
+                h = rep.transport.healthz()
+                self._apply_health(rep, h)
+                if rep.state == READY:
+                    return h.get("model_version")
+            except Exception:  # noqa: BLE001 — keep waiting
+                pass
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"rolling reload: replica {rep.id} did not "
+                    f"turn ready within {wait_ready_s}s; roll "
+                    "halted (earlier replicas are on the new "
+                    "version)")
+            time.sleep(0.01)
 
     # ------------------------------------------------------ elastic fleet
     def set_transport(self, replica_id: str, transport,
@@ -1314,10 +1359,14 @@ class RouterHTTPServer(ThreadingHTTPServer):
     allow_reuse_address = True
 
     def __init__(self, addr, router: ReplicaRouter, reload_builder=None,
-                 registry=None):
+                 registry=None, model_path=None):
         super().__init__(addr, _RouterHandler)
         self.router = router
         self.reload_builder = reload_builder
+        # the artifact path the fleet currently serves — the rollback
+        # anchor for /admin/reload (a refused artifact rolls the fleet
+        # back to this path instead of leaving a replica down)
+        self.current_model_path = model_path
         # optional obs.MetricsRegistry: extra federated providers (the
         # serve_fleet supervisor + autoscaler) riding this frontend's
         # /metrics so one scrape covers the whole process
@@ -1409,6 +1458,7 @@ class _RouterHandler(JSONHandler):
         if not prov:
             return {}
         return {"X-Replica-Id": prov.get("replica"),
+                "X-Model-Version": prov.get("model_version"),
                 "X-Failovers": prov.get("failovers"),
                 "X-Hedged": prov.get("hedges")}
 
@@ -1455,7 +1505,11 @@ class _RouterHandler(JSONHandler):
         """Rolling hot-swap to a new merged model: ``{"model_path":
         "/path/new.ptmodel"}``. Synchronous — the response carries the
         per-replica versions after the roll (long request by design; the
-        fleet keeps serving throughout)."""
+        fleet keeps serving throughout). When the new artifact refuses a
+        replica (warmup failure — notably a quantized artifact drifting
+        past the accuracy gate), the fleet ROLLS BACK to the previously
+        served path and the call answers a typed 409 ``reload_rejected``
+        carrying the refusal; the bad artifact is never published."""
         builder = self.server.reload_builder
         try:
             if builder is None:
@@ -1468,8 +1522,12 @@ class _RouterHandler(JSONHandler):
             if not path:
                 raise BadRequest("need \"model_path\" (a merged PTM1 "
                                  "artifact)")
+            prev = self.server.current_model_path
+            fallback = ((lambda rid: builder(prev, rid))
+                        if prev else None)
             versions = self.server.router.rolling_reload(
-                lambda rid: builder(path, rid))
+                lambda rid: builder(path, rid), fallback_build=fallback)
+            self.server.current_model_path = path
             self._send(200, {"status": "ok", "versions": versions})
         except ServingError as e:
             self._send_error(e)
@@ -1481,13 +1539,14 @@ class _RouterHandler(JSONHandler):
 
 def make_router_server(router: ReplicaRouter, host: str = "127.0.0.1",
                        port: int = 0, reload_builder=None,
-                       registry=None):
+                       registry=None, model_path=None):
     """Bind the router frontend (port=0 = ephemeral, for tests); the
     bound port is ``server.server_address[1]``. ``registry`` federates
-    extra metric providers (supervisor, autoscaler) into ``/metrics``."""
+    extra metric providers (supervisor, autoscaler) into ``/metrics``;
+    ``model_path`` seeds the rollback anchor for ``/admin/reload``."""
     return RouterHTTPServer((host, port), router,
                             reload_builder=reload_builder,
-                            registry=registry)
+                            registry=registry, model_path=model_path)
 
 
 def install_router_signal_handlers(router: ReplicaRouter,
@@ -1517,14 +1576,15 @@ def install_router_signal_handlers(router: ReplicaRouter,
 
 def serve_router_forever(router: ReplicaRouter, host: str = "127.0.0.1",
                          port: int = 8000, reload_builder=None,
-                         ready_line: bool = True, registry=None):
+                         ready_line: bool = True, registry=None,
+                         model_path=None):
     """CLI entry for ``--job=serve --replicas N``: start the health
     loop, bind, install SIGTERM handlers that drain EVERY replica (zero
     queued drops), serve until drained."""
     router.start()
     server = make_router_server(router, host, port,
                                 reload_builder=reload_builder,
-                                registry=registry)
+                                registry=registry, model_path=model_path)
     install_router_signal_handlers(router, server)
     if ready_line:
         h = router.fleet_health()

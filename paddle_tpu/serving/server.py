@@ -235,7 +235,11 @@ class _Handler(JSONHandler):
                 result = engine.infer(body["sample"], kind=kind,
                                       deadline_ms=deadline_ms,
                                       **gen_opts)
-            self._send(200, result)
+            # provenance: which artifact answered (a quantized model's
+            # version carries its dtype suffix, e.g. ``...+int8``)
+            self._send(200, result, headers={
+                "X-Model-Version": getattr(engine.predictor,
+                                           "model_version", None)})
         except ServingError as e:
             self._send_error(e)
         except Exception as e:  # noqa: BLE001 — the only 500 source

@@ -100,6 +100,16 @@ def parse_args(argv=None):
                         "exact)")
     p.add_argument("--model_path", default=None,
                    help="output path for --job=merge")
+    p.add_argument("--quantize", default=None, choices=["bf16", "int8"],
+                   help="--job=merge: quantize weights into the PTM1 "
+                        "artifact (per-tensor int8 scales / bf16 "
+                        "storage cast, paddle_tpu/quant.py) and embed "
+                        "the golden-request set the serving warmup "
+                        "accuracy gate replays")
+    p.add_argument("--quantize_tol", type=float, default=None,
+                   help="override the per-dtype warmup-gate tolerance "
+                        "recorded in the quantized artifact "
+                        "(quant.GATE_TOLERANCES)")
     p.add_argument("--test_period", type=int, default=0,
                    help="run the test reader every N passes during train")
     p.add_argument("--trainer_count", type=int, default=1,
@@ -746,10 +756,32 @@ def cmd_merge(ns, args):
     outputs = ns.get("outputs")
     names = ([o.name if hasattr(o, "name") else o for o in outputs]
              if outputs else [ns["cost"].name])
-    merge_model(out_path, trainer.topology.graph,
-                trainer._params_for_save(),
-                outputs=names)
-    print(f"merged model written to {out_path}")
+    params = trainer._params_for_save()
+    quant_meta = golden = None
+    if args.quantize:
+        from paddle_tpu import quant as quant_lib
+        feeding = ns.get("feeding")
+        if not isinstance(feeding, dict):
+            feeding = getattr(feeding, "feeding", None)
+        if not isinstance(feeding, dict):
+            raise SystemExit(
+                "--quantize needs the config to define `feeding` "
+                "(data-layer name -> InputType) so the golden "
+                "warmup-gate set can be recorded with the artifact")
+        # golden refs come from the UNQUANTIZED params — the fp32
+        # reference side of the warmup accuracy gate
+        golden = quant_lib.golden_section(
+            trainer.topology.graph, params, names, feeding)
+        sparse = {name for name, spec in trainer.meta.items()
+                  if getattr(spec, "sparse_grad", False)}
+        params, quant_meta = quant_lib.quantize_params(
+            params, args.quantize, sparse_names=sparse)
+        if args.quantize_tol is not None:
+            quant_meta["tol"] = float(args.quantize_tol)
+    merge_model(out_path, trainer.topology.graph, params,
+                outputs=names, quant=quant_meta, golden=golden)
+    tag = f" ({args.quantize} quantized)" if args.quantize else ""
+    print(f"merged model written to {out_path}{tag}")
     return 0
 
 
@@ -822,13 +854,30 @@ def _serving_plan(ns, args):
     # None = inherit the config's pinned decode policy; 0 = full scan
     decode_chunk = getattr(args, "decode_chunk", None)
     params = dict(trainer._flat_params_view())
-    _ensure_generation_params(trainer.topology.graph, params)
     pred_kwargs = dict(
         batch_buckets=batch_buckets, length_buckets=length_buckets,
         gen_decode_chunk=decode_chunk,
         gen_full_scan=(None if decode_chunk is None
                        else decode_chunk <= 0),
         aot_cache=getattr(args, "aot_cache_dir", None))
+    mp = args.init_model_path
+    if mp and mp.endswith(".ptmodel"):
+        # A merged artifact owns its serving identity: the PTM1 digest
+        # keys the AOT cache and names the published model_version (the
+        # same identity the fleet reload path reports), and a
+        # ``--quantize`` artifact's optional sections MUST reach the
+        # predictor — the trainer round-trip above goes through the
+        # extras-ignoring old reader, which would silently serve raw
+        # storage-dtype leaves with no scales and no warmup gate.
+        from paddle_tpu.trainer.merge_model import (load_merged_ex,
+                                                    merged_digest)
+        _, mparams, _, extras = load_merged_ex(mp)
+        pred_kwargs["model_hash"] = merged_digest(mp)
+        if extras.get("quant") or extras.get("golden"):
+            params = dict(mparams)  # storage-dtype leaves, scales apart
+            pred_kwargs["quant"] = extras.get("quant")
+            pred_kwargs["golden"] = extras.get("golden")
+    _ensure_generation_params(trainer.topology.graph, params)
     eng_kwargs = dict(
         max_batch=max_batch,
         batch_timeout_ms=args.batch_timeout_ms,
@@ -1014,9 +1063,10 @@ def cmd_serve(ns, args):
     if getattr(args, "replicas", 1) > 1:
         from paddle_tpu.serving import serve_router_forever
         router, reload_builder = build_serving_fleet(ns, args)
-        return serve_router_forever(router, host=args.host,
-                                    port=args.port,
-                                    reload_builder=reload_builder)
+        return serve_router_forever(
+            router, host=args.host, port=args.port,
+            reload_builder=reload_builder,
+            model_path=getattr(args, "model_path", None))
     from paddle_tpu.serving import serve_forever
     engine = build_serving_engine(ns, args)
     return serve_forever(engine, host=args.host, port=args.port)

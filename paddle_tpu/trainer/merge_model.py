@@ -7,7 +7,14 @@ artifact the C inference API loads (`paddle/capi`), and what
 
 Format: ``b"PTM1" + md5(payload)[16 bytes] + pickle(payload)`` where
 payload = {"graph": ModelDef, "params": {name: np.ndarray},
-"outputs": [names]}.
+"outputs": [names]}. Two OPTIONAL sections ride ``--quantize`` merges
+(``paddle_tpu/quant.py``): ``"quant"`` (storage dtype + per-tensor
+scales + named stand-downs) and ``"golden"`` (the warmup accuracy
+gate's request set with fp32 reference outputs). Both are strictly
+additive — an unquantized merge writes byte-identical payloads to the
+old format, and :func:`load_merged` ignores unknown keys, so an old
+reader of an unquantized file sees no change and a quantized artifact
+fed to an old reader still loads (as its raw storage-dtype params).
 
 SECURITY: the MD5 gives *integrity* (torn-file detection), not
 *authenticity* — the payload is a pickle, so ``load_merged`` (and the C
@@ -27,14 +34,23 @@ _MAGIC = b"PTM1"
 
 
 def merge_model(path: str, graph, params: Dict[str, np.ndarray],
-                outputs: Optional[List[str]] = None):
+                outputs: Optional[List[str]] = None,
+                quant: Optional[Dict] = None,
+                golden: Optional[Dict] = None):
     import jax
-    payload = pickle.dumps({
+    data = {
         "graph": graph,
         "params": {k: np.asarray(jax.device_get(v))
                    for k, v in params.items()},
         "outputs": list(outputs or graph.output_layer_names or []),
-    }, protocol=pickle.HIGHEST_PROTOCOL)
+    }
+    # optional sections only when present: the unquantized payload must
+    # stay byte-identical to the pre-quant format (digest stability)
+    if quant is not None:
+        data["quant"] = quant
+    if golden is not None:
+        data["golden"] = golden
+    payload = pickle.dumps(data, protocol=pickle.HIGHEST_PROTOCOL)
     with open(path, "wb") as f:
         f.write(_MAGIC + hashlib.md5(payload).digest() + payload)
 
@@ -54,6 +70,16 @@ def load_merged(path: str):
     """-> (graph, params, output_names); raises on corruption.
     Only load files from trusted sources (pickle payload — see module
     docstring)."""
+    graph, params, outputs, _extras = load_merged_ex(path)
+    return graph, params, outputs
+
+
+def load_merged_ex(path: str):
+    """-> (graph, params, output_names, extras) where ``extras`` holds
+    the optional sections a quantized merge adds (``"quant"``,
+    ``"golden"`` — empty dict for a plain fp32 artifact). The serving
+    predictor loads through here; :func:`load_merged` stays the
+    old-reader surface."""
     with open(path, "rb") as f:
         raw = f.read()
     if raw[:4] != _MAGIC:
@@ -62,4 +88,5 @@ def load_merged(path: str):
     if hashlib.md5(payload).digest() != digest:
         raise IOError(f"{path}: merged model failed MD5 integrity check")
     data = pickle.loads(payload)
-    return data["graph"], data["params"], data["outputs"]
+    extras = {k: data[k] for k in ("quant", "golden") if k in data}
+    return data["graph"], data["params"], data["outputs"], extras

@@ -1087,6 +1087,51 @@ def test_pt401_overlap_artifact_requires_exposed_comm_evidence(tmp_path):
     assert data["overlap_bitwise_identical"] is True
 
 
+def test_pt401_quant_artifact_requires_gate_evidence(tmp_path):
+    """The r19 quantized-serving generation: a ``serving_quant*``
+    metric must carry all three precision sides, FINITE gate deltas,
+    and the bool gate verdict — a quantization speedup for a model
+    whose accuracy gate never replayed (or failed) is not evidence."""
+    base = {"metric": "serving_quant_ab", "platform": "cpu",
+            "quant_fp32_p50_ms": 1.0, "quant_bf16_p50_ms": 0.9,
+            "quant_int8_p50_ms": 0.8,
+            "quant_bf16_vs_fp32": 0.9, "quant_int8_vs_fp32": 0.8,
+            "quant_gate_delta_bf16": 1e-4,
+            "quant_gate_delta_int8": 5e-4,
+            "quant_gate_passed": True}
+    good = tmp_path / "BENCH_q.json"
+    good.write_text(json.dumps(base))
+    assert check_bench_file(str(good), "BENCH_q.json") == []
+
+    # missing the int8 side + the verdict; a NaN gate delta
+    bad = dict(base)
+    del bad["quant_int8_p50_ms"], bad["quant_gate_passed"]
+    badf = tmp_path / "BENCH_q_bad.json"
+    badf.write_text(json.dumps(bad).replace("0.0001", "NaN"))
+    fs = check_bench_file(str(badf), "BENCH_q_bad.json")
+    assert any("quant_int8_p50_ms" in f.message for f in fs)
+    assert any("quant_gate_passed" in f.message for f in fs)
+    assert any("non-finite" in f.message for f in fs)
+
+    # a non-quant serving metric stays exempt
+    other = tmp_path / "BENCH_o.json"
+    other.write_text(json.dumps(
+        {"metric": "serving_dynamic_batching_ab", "platform": "cpu"}))
+    assert check_bench_file(str(other), "BENCH_o.json") == []
+
+    # the committed r19 artifact itself carries the evidence: three
+    # distinct versions, gates green, deltas inside tolerance
+    import os as _os
+    root = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    r19 = _os.path.join(root, "BENCH_r19.json")
+    assert check_bench_file(r19, "BENCH_r19.json") == []
+    data = json.loads(open(r19).read())
+    assert data["quant_gate_passed"] is True
+    assert data["quant_gate_delta_bf16"] <= data["quant_gate_tol_bf16"]
+    assert data["quant_gate_delta_int8"] <= data["quant_gate_tol_int8"]
+    assert len(set(data["quant_model_versions"].values())) == 3
+
+
 def test_pass4_overlap_spelling_budgets_identically():
     """The sync->async flip must budget IDENTICALLY: the overlap chain
     is an ``optimization_barrier`` spelling of the SAME gathers, so the

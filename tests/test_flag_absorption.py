@@ -144,15 +144,20 @@ def _x_rows():
 
 
 def test_fsdp_row_is_machine_mapped():
-    """The round-16 supplementary table: --fsdp is present, spelled,
-    and parses through the CLI (same drift-proof contract as the core
-    and T-row audits)."""
+    """The TPU-native supplementary table: --fsdp (round 16) and
+    --quantize (round 19) are present, spelled, and parse through the
+    CLI (same drift-proof contract as the core and T-row audits)."""
     rows = _x_rows()
-    assert [name for _, name, _ in rows] == ["fsdp"]
+    assert [name for _, name, _ in rows] == ["fsdp", "quantize"]
     assert all(st == "spelled" for _, _, st in rows)
     from paddle_tpu.trainer import cli
     args = cli.parse_args(["--config", "x.py", "--fsdp"])
     assert args.fsdp is True
+    args = cli.parse_args(["--config", "x.py", "--job", "merge",
+                           "--quantize", "int8",
+                           "--quantize_tol", "0.05"])
+    assert args.quantize == "int8"
+    assert args.quantize_tol == pytest.approx(0.05)
 
 
 def test_fsdp_reaches_the_trainer():

@@ -124,6 +124,98 @@ def test_non_default_activations_take_fallback():
     assert np.array_equal(np.asarray(state), np.asarray(ref_state))
 
 
+# -------------------------------- inference variants (r19, no-grad)
+
+def test_lstm_infer_ref_mode_is_inline_math_bitwise():
+    gates, c, checks = _lstm_operands(4)
+    with common.force_mode("ref"):
+        out, state = rnn_cells.lstm_cell_infer(gates, c, *checks)
+    ref_out, ref_state = rnn_cells._lstm_math(
+        gates, c, *checks, act_in=rnn_cells._act("tanh"),
+        act_gate=rnn_cells._act("sigmoid"),
+        act_state=rnn_cells._act("tanh"))
+    assert np.array_equal(np.asarray(out), np.asarray(ref_out))
+    assert np.array_equal(np.asarray(state), np.asarray(ref_state))
+
+
+def test_gru_infer_ref_mode_is_inline_math_bitwise():
+    x, h, w_gate, w_state = _gru_operands(4)
+    with common.force_mode("ref"):
+        out = rnn_cells.gru_cell_infer(x, h, w_gate, w_state)
+    ref = rnn_cells._gru_math(
+        x, h, w_gate, w_state, act_in=rnn_cells._act("tanh"),
+        act_gate=rnn_cells._act("sigmoid"))
+    assert np.array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_infer_interpret_matches_training_forward():
+    """The Pallas primal of the inference variant is the SAME kernel
+    the training spelling runs — interpreter-mode forward agrees with
+    both the training cell and the fallback math to f32 roundoff."""
+    gates, c, checks = _lstm_operands(5)
+    with common.force_mode("interpret"):
+        i_out, i_state = rnn_cells.lstm_cell_infer(gates, c, *checks)
+        t_out, t_state = rnn_cells.lstm_cell(gates, c, *checks)
+    np.testing.assert_allclose(i_out, t_out, rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(i_state, t_state, rtol=1e-6, atol=1e-6)
+
+    x, h, w_gate, w_state = _gru_operands(5)
+    with common.force_mode("interpret"):
+        gi = rnn_cells.gru_cell_infer(x, h, w_gate, w_state)
+        gt = rnn_cells.gru_cell(x, h, w_gate, w_state)
+    np.testing.assert_allclose(gi, gt, rtol=1e-6, atol=1e-6)
+
+
+def test_infer_variants_refuse_grad_on_pallas_path():
+    """No custom_vjp on the inference spelling: jax.grad through the
+    Pallas path fails loudly, pinning the variants to no-grad routing
+    (docs/kernels.md 'Inference variants')."""
+    gates, c, checks = _lstm_operands(6)
+
+    def lstm_loss(g_):
+        with common.force_mode("interpret"):
+            out, state = rnn_cells.lstm_cell_infer(g_, c, *checks)
+        return jnp.sum(out) + jnp.sum(state)
+
+    with pytest.raises(Exception):
+        jax.grad(lstm_loss)(gates)
+
+    x, h, w_gate, w_state = _gru_operands(6)
+
+    def gru_loss(x_):
+        with common.force_mode("interpret"):
+            return jnp.sum(rnn_cells.gru_cell_infer(x_, h, w_gate,
+                                                    w_state))
+
+    with pytest.raises(Exception):
+        jax.grad(gru_loss)(x)
+
+    # the TRAINING spellings still differentiate on the same operands
+    def train_loss(g_):
+        with common.force_mode("interpret"):
+            out, state = rnn_cells.lstm_cell(g_, c, *checks)
+        return jnp.sum(out) + jnp.sum(state)
+
+    g = jax.grad(train_loss)(gates)
+    assert np.isfinite(np.asarray(g)).all()
+
+
+def test_infer_non_default_activations_take_fallback():
+    x, h, w_gate, w_state = _gru_operands(7)
+    with common.force_mode("interpret"):
+        out = rnn_cells.gru_cell_infer(x, h, w_gate, w_state,
+                                       act_input="relu")
+    ref = rnn_cells._gru_math(
+        x, h, w_gate, w_state, act_in=rnn_cells._act("relu"),
+        act_gate=rnn_cells._act("sigmoid"))
+    assert np.array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_infer_variants_exported_from_plane():
+    assert kernels.lstm_cell_infer is rnn_cells.lstm_cell_infer
+    assert kernels.gru_cell_infer is rnn_cells.gru_cell_infer
+
+
 # -------------------------------------------- optimizer kernel parity
 
 def _opt_operands(seed=0, shape=(13, 7)):

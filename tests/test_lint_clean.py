@@ -113,7 +113,7 @@ def test_pass2_jaxpr_audit_train_and_serving():
 
 @pytest.fixture(scope="module")
 def compiled_programs():
-    """ONE SPMD-compile of the eight traced programs feeding both the
+    """ONE SPMD-compile of the nine traced programs feeding both the
     pass-4 and pass-5 tier-1 tests — the same sharing the CLI does
     (compile is the slowest step on the 1-core host)."""
     from paddle_tpu.analysis.shard_audit import compile_programs
@@ -142,8 +142,10 @@ def test_pass4_shard_audit_clean_and_budget_pins_all_programs(
         assert name in budgeted, f"{name} lost its pinned manifest"
     assert set(budgeted) <= set(PROGRAM_NAMES)
     # serving stays collective-free BY ABSENCE: any collective it
-    # grows is unbudgeted drift (PT501), so no entry may name it
+    # grows is unbudgeted drift (PT501), so no entry may name it —
+    # the quantized twin holds to the same contract
     assert "serving_warm" not in budgeted
+    assert "serving_quant" not in budgeted
 
 
 def test_pass5_mem_audit_clean_and_budget_pins_all_programs(
@@ -169,10 +171,17 @@ def test_pass5_mem_audit_clean_and_budget_pins_all_programs(
         "every traced program needs its memory manifest pinned "
         f"(missing: {set(PROGRAM_NAMES) - pinned})")
     # the item-4 admission number is a committed artifact
-    serving = {e.program: e for e in load_mem_budget()}["serving_warm"]
+    by_name = {e.program: e for e in load_mem_budget()}
+    serving = by_name["serving_warm"]
     assert serving.resident_bytes > 0
     assert manifests["serving_warm"]["resident_bytes"] == \
         serving.resident_bytes
+    # the quantization win is a committed artifact too: the int8
+    # scorer's pinned param residency beats its fp32 twin by >= 3x,
+    # and the matching temp bytes prove the dequant stayed fused
+    quant = by_name["serving_quant"]
+    assert quant.param_bytes * 3 <= serving.param_bytes
+    assert quant.temp_bytes == serving.temp_bytes
 
 
 def test_pass2_jaxpr_audit_entry():

@@ -1,0 +1,351 @@
+"""Quantized serving tier (r19): quantize-on-merge + warmup gate.
+
+Four contracts from ``docs/serving.md`` ("Quantized serving tier"):
+
+- **the quantization matrix** — closure-enforced over every servable
+  data-type family (``data/types.py``, non-SUB_SEQUENCE constructors)
+  × {bf16, int8}, the ``test_layer_grad_matrix.py`` pattern: a new
+  servable family registered without a matrix row fails the closure
+  test. Each row merges a quantized artifact, loads it through the
+  serving predictor, and asserts the warmup gate passes, scores match
+  the fp32 references within the per-dtype tolerance, and the feed
+  funnel's masks-f32 invariant holds through the quantized path;
+- **int8 scale edge cases** — zero-range tensors pin scale=1 (no
+  div-by-zero, exact zero round-trip), sparse tables quantize row-wise,
+  and a sparse table row-wise cannot express stands down to f32 with a
+  NAMED ``skipped`` entry — never silently;
+- **the gate refuses READY** — a drifted int8 artifact raises a typed
+  ``QuantGateError`` at warmup, the engine never goes ready, and
+  ``/healthz`` carries the gate evidence;
+- **rolling hot-swap rolls back** — a reload to a gate-refused
+  artifact aborts with ``ReloadRejected``, the fleet is rebuilt on the
+  previous artifact (``reload_rollbacks_total``), and provenance keeps
+  answering with the old precision-suffixed version. The dtype-suffixed
+  ``model_version`` (= AOT-cache key) is the collision regression:
+  fp32/bf16/int8 merges of ONE model have three distinct digests.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from paddle_tpu import quant as quant_lib
+from paddle_tpu.config import dsl
+from paddle_tpu.core.network import Network
+from paddle_tpu.data import types as T
+from paddle_tpu.serving import (EngineTransport, ReplicaRouter,
+                                ServingEngine, ServingPredictor)
+from paddle_tpu.serving.errors import QuantGateError, ReloadRejected
+from paddle_tpu.trainer.merge_model import (load_merged, load_merged_ex,
+                                            merge_model, merged_digest)
+from paddle_tpu.utils.masks import assert_feed_masks_f32
+
+DIM, VOCAB, CLASSES = 6, 12, 2
+
+
+# ------------------------------------------------------------ the matrix
+def _servable_families():
+    """Every non-nested InputType constructor in ``data/types.py`` —
+    the feed funnel serves exactly these; SUB_SEQUENCE families are
+    refused at admission (and by ``make_golden_rows``)."""
+    fams = []
+    for name in dir(T):
+        if name.startswith("_"):
+            continue
+        fn = getattr(T, name)
+        if not callable(fn) or isinstance(fn, type):
+            continue
+        try:
+            itype = fn(4)
+        except TypeError:
+            continue
+        if isinstance(itype, T.InputType) \
+                and itype.seq_type != T.SUB_SEQUENCE:
+            fams.append(name)
+    return sorted(fams)
+
+
+#: family name -> InputType for the single input slot of its demo
+#: config. The builder below turns each into a servable scoring graph
+#: (index inputs route through an embedding table, sequences pool).
+MATRIX = {
+    "dense_vector": T.dense_vector(DIM),
+    "dense_vector_sequence": T.dense_vector_sequence(DIM),
+    "integer_value": T.integer_value(VOCAB),
+    "integer_value_sequence": T.integer_value_sequence(VOCAB),
+    "sparse_binary_vector": T.sparse_binary_vector(DIM),
+    "sparse_binary_vector_sequence": T.sparse_binary_vector_sequence(DIM),
+    "sparse_float_vector": T.sparse_float_vector(DIM),
+    "sparse_float_vector_sequence": T.sparse_float_vector_sequence(DIM),
+}
+
+
+def test_matrix_is_closed_over_servable_families():
+    """The closure property: every servable data-type family has a
+    quantization matrix row; a new constructor in ``data/types.py``
+    fails here until it gets one (and the gate coverage it implies)."""
+    assert sorted(MATRIX) == _servable_families()
+
+
+def _demo(itype, seed=0):
+    """(graph, params, feeding) for one matrix row: a tiny scoring
+    config that actually consumes the family's feed layout."""
+    dsl.reset()
+    x = dsl.data(name="x", size=itype.dim)
+    h = x
+    if itype.type == T.INDEX:
+        h = dsl.embedding(input=h, size=5, name="emb")
+    if itype.seq_type == T.SEQUENCE:
+        h = dsl.pooling(input=h, pooling_type="avg", name="pool")
+    dsl.fc(input=h, size=CLASSES, act="softmax", name="out")
+    graph = dsl.current_graph()
+    params = Network(graph, outputs=["out"]).init_params(
+        jax.random.PRNGKey(seed))
+    params = {k: np.asarray(v) for k, v in params.items()}
+    return graph, params, {"x": itype}
+
+
+@pytest.mark.parametrize("family", sorted(MATRIX))
+def test_quantization_matrix_row(family, tmp_path):
+    """One family, both dtypes: merge quantized, serve, gate green,
+    scores within the per-dtype tolerance of the recorded fp32
+    references, masks stay f32 through the quantized feed funnel."""
+    itype = MATRIX[family]
+    graph, params, feeding = _demo(itype)
+    golden = quant_lib.golden_section(graph, params, ["out"], feeding)
+    assert golden is not None
+    refs = golden["outputs"]["out"]
+    rows = [tuple(r) for r in golden["rows"]]
+    sparse = {"emb"} if itype.type == T.INDEX else set()
+
+    for dt in quant_lib.QUANT_DTYPES:
+        qparams, meta = quant_lib.quantize_params(
+            params, dt, sparse_names=sparse)
+        path = os.path.join(str(tmp_path), f"{family}.{dt}.ptmodel")
+        merge_model(path, graph, qparams, outputs=["out"],
+                    quant=meta, golden=golden)
+        pred = ServingPredictor.from_merged(
+            path, feeding, batch_buckets=[len(rows)],
+            length_buckets=[4])
+        pred.warmup()
+        tol = quant_lib.GATE_TOLERANCES[dt]
+        assert pred.quant_gate["passed"] is True
+        assert pred.quant_gate["max_delta"] <= tol
+        assert pred.quant_health()["dtype"] == dt
+        assert pred.model_version.endswith("+" + dt)
+        # scores through the public path match fp32 within tolerance
+        outs, _ = pred.predict_rows(rows)
+        got = np.asarray(outs["out"])[:len(rows)]
+        assert quant_lib.gate_delta(got, refs) <= tol
+        # masks-f32 invariant through the quantized feed funnel (the
+        # runtime twin of graftlint PT102; loud, not incidental)
+        feed = pred.feeder(rows)
+        assert_feed_masks_f32(feed, f"quantized {family} feed")
+
+
+# ------------------------------------------------- int8 scale edge cases
+def test_int8_zero_range_scale_pins_to_one():
+    w = np.zeros((3, 4), np.float32)
+    assert quant_lib.int8_scale(w) == np.float32(1.0)
+    q, meta = quant_lib.quantize_params({"w": w}, "int8")
+    assert q["w"].dtype == np.int8 and not q["w"].any()
+    assert "w" in meta["scales"]
+    # the quantized zeros round-trip exactly
+    np.testing.assert_array_equal(
+        quant_lib.dequantize_params(q, meta)["w"], w)
+
+
+def test_int8_rowwise_scale_guards_each_zero_row():
+    w = np.array([[0.0, 0.0], [3.0, -4.0]], np.float32)
+    s = quant_lib.int8_scale(w, axis=(1,))
+    assert s.shape == (2, 1)
+    assert s[0, 0] == np.float32(1.0)  # zero row: no div-by-zero
+    assert s[1, 0] == pytest.approx(4.0 / 127.0)
+
+
+def test_sparse_table_quantizes_rowwise():
+    """A sparse-grad table gets one scale per row, so a hot row's
+    range is not crushed by a cold outlier row: per-row dequant error
+    stays within half its OWN row's step."""
+    r = np.random.RandomState(3)
+    w = r.randn(8, 4).astype(np.float32)
+    w[2] *= 100.0  # the outlier row
+    q, meta = quant_lib.quantize_params({"emb": w}, "int8",
+                                        sparse_names={"emb"})
+    s = meta["scales"]["emb"]
+    assert s.shape == (8, 1)
+    deq = quant_lib.dequantize_params(q, meta)["emb"]
+    assert np.all(np.abs(deq - w) <= s / 2 + 1e-6)
+    # per-tensor (the non-sparse spelling) would have been crushed:
+    # the outlier's scale is ~100x a normal row's
+    assert s[2, 0] > 10 * np.median(s)
+
+
+def test_sparse_ndim1_stands_down_named_never_silently():
+    v = np.arange(5, dtype=np.float32)
+    q, meta = quant_lib.quantize_params({"t": v}, "int8",
+                                        sparse_names={"t"})
+    assert q["t"].dtype == np.float32
+    np.testing.assert_array_equal(q["t"], v)
+    assert "row-wise" in meta["skipped"]["t"]
+    assert "t" not in meta["scales"]
+
+
+def test_1d_and_non_float_leaves_stay_put_named():
+    b = np.arange(3, dtype=np.float32)
+    steps = np.arange(4, dtype=np.int32)
+    q, meta = quant_lib.quantize_params({"bias": b, "steps": steps},
+                                        "int8")
+    assert q["bias"].dtype == np.float32
+    assert "1-D" in meta["skipped"]["bias"]
+    np.testing.assert_array_equal(q["steps"], steps)
+    assert "non-float" in meta["skipped"]["steps"]
+
+
+def test_bf16_casts_every_float_leaf_no_scales():
+    import jax.numpy as jnp
+    b = np.arange(3, dtype=np.float32)
+    w = np.eye(3, dtype=np.float32)
+    q, meta = quant_lib.quantize_params({"w": w, "bias": b}, "bf16")
+    assert q["w"].dtype == jnp.bfloat16 and q["bias"].dtype == jnp.bfloat16
+    assert meta["scales"] == {} and meta["skipped"] == {}
+
+
+def test_unknown_quant_dtype_is_a_typed_refusal():
+    with pytest.raises(ValueError, match="fp8"):
+        quant_lib.quantize_params({"w": np.eye(2, dtype=np.float32)},
+                                  "fp8")
+
+
+# ----------------------------------------- digest / version collision
+def test_quantized_artifacts_never_collide_with_fp32(tmp_path):
+    """The AOT-cache key and model_version are the PTM1 payload digest
+    (+ dtype suffix): fp32/bf16/int8 merges of ONE model are three
+    distinct artifacts — a canary reading provenance can always tell
+    which precision answered, and warmed executables never cross."""
+    graph, params, feeding = _demo(T.dense_vector(DIM))
+    golden = quant_lib.golden_section(graph, params, ["out"], feeding)
+    paths, versions = {}, {}
+    for dt in ("fp32",) + quant_lib.QUANT_DTYPES:
+        p = os.path.join(str(tmp_path), f"m.{dt}.ptmodel")
+        if dt == "fp32":
+            merge_model(p, graph, params, outputs=["out"])
+        else:
+            qparams, meta = quant_lib.quantize_params(params, dt)
+            merge_model(p, graph, qparams, outputs=["out"],
+                        quant=meta, golden=golden)
+        paths[dt] = p
+        pred = ServingPredictor.from_merged(
+            p, feeding, batch_buckets=[2])
+        versions[dt] = pred.model_version
+        assert pred.model_hash == merged_digest(p)
+    digests = {dt: merged_digest(p) for dt, p in paths.items()}
+    assert len(set(digests.values())) == 3, digests
+    assert len(set(versions.values())) == 3, versions
+    assert versions["bf16"].endswith("+bf16")
+    assert versions["int8"].endswith("+int8")
+    assert "+" not in versions["fp32"]
+    # backward compatibility both ways: the fp32 artifact carries no
+    # optional sections, and the OLD reader surface still loads a
+    # quantized file (it just sees the storage-dtype table)
+    assert load_merged_ex(paths["fp32"])[3] == {}
+    g, qp, outs = load_merged(paths["int8"])
+    assert outs == ["out"]
+
+
+# --------------------------------------------- gate refusal, not READY
+def _drifted_int8(tmp_path, graph, params, feeding):
+    """Merge an int8 artifact whose quantized table was corrupted
+    AFTER the golden refs were recorded — the gate must catch it."""
+    golden = quant_lib.golden_section(graph, params, ["out"], feeding)
+    qparams, meta = quant_lib.quantize_params(params, "int8")
+    name = next(k for k, v in qparams.items() if v.dtype == np.int8)
+    bad = dict(qparams)
+    bad[name] = np.clip(bad[name].astype(np.int32) * -3,
+                        -127, 127).astype(np.int8)
+    p = os.path.join(str(tmp_path), "drifted.int8.ptmodel")
+    merge_model(p, graph, bad, outputs=["out"], quant=meta,
+                golden=golden)
+    return p
+
+
+def test_drifted_artifact_refuses_ready_with_gate_evidence(tmp_path):
+    graph, params, feeding = _demo(T.dense_vector(DIM))
+    p = _drifted_int8(tmp_path, graph, params, feeding)
+    pred = ServingPredictor.from_merged(p, feeding, batch_buckets=[4])
+    with pytest.raises(QuantGateError) as ei:
+        pred.warmup()
+    assert ei.value.dtype == "int8"
+    assert ei.value.status == 503
+    assert max(ei.value.deltas.values()) > ei.value.tol
+    assert pred.warmed is False
+    assert pred.quant_gate["passed"] is False
+
+    # through the engine: start(warmup=True) propagates, the replica
+    # never goes ready, and /healthz carries the verdict
+    pred2 = ServingPredictor.from_merged(p, feeding, batch_buckets=[4])
+    eng = ServingEngine(pred2, batch_timeout_ms=1.0)
+    try:
+        with pytest.raises(QuantGateError):
+            eng.start(warmup=True)
+        h = eng.health()
+        assert h["ready"] is False
+        assert h["quant"]["dtype"] == "int8"
+        assert h["quant"]["gate"]["passed"] is False
+    finally:
+        eng.shutdown(drain=False)
+
+
+# ------------------------------------------------ rolling-swap rollback
+def test_rolling_reload_to_drifted_artifact_rolls_back(tmp_path):
+    """Hot-swapping the fleet to a gate-refused int8 artifact must NOT
+    publish it: the roll aborts with the typed ``ReloadRejected``, the
+    drained replica is rebuilt on the previous (bf16) artifact, the
+    rollback is counted, and dispatch keeps answering with the old
+    precision-suffixed version in provenance."""
+    graph, params, feeding = _demo(T.dense_vector(DIM))
+    golden = quant_lib.golden_section(graph, params, ["out"], feeding)
+    qparams, meta = quant_lib.quantize_params(params, "bf16")
+    good = os.path.join(str(tmp_path), "good.bf16.ptmodel")
+    merge_model(good, graph, qparams, outputs=["out"], quant=meta,
+                golden=golden)
+    bad = _drifted_int8(tmp_path, graph, params, feeding)
+    cache = str(tmp_path / "aot")  # rebuilds warm in ms, not compiles
+
+    def build(path):
+        def _build(rid):
+            pred = ServingPredictor.from_merged(
+                path, feeding, batch_buckets=[4], aot_cache=cache)
+            return EngineTransport(ServingEngine(
+                pred, batch_timeout_ms=1.0).start(warmup=True))
+        return _build
+
+    router = ReplicaRouter([build(good)("r0")],
+                           health_poll_ms=25.0).start()
+    try:
+        h = router.fleet_health()
+        assert h["ready_replicas"] == 1
+        v_good = h["replicas"][0]["model_version"]
+        assert v_good.endswith("+bf16")
+
+        with pytest.raises(ReloadRejected) as ei:
+            router.rolling_reload(build(bad), fallback_build=build(good))
+        assert ei.value.status == 409
+        assert isinstance(ei.value.__cause__, QuantGateError)
+
+        # fleet whole on the OLD artifact; the bad version never served
+        h = router.fleet_health()
+        assert h["ready_replicas"] == 1
+        assert h["replicas"][0]["model_version"] == v_good
+        assert router.metrics.counters["reload_rollbacks_total"] == 1
+        sample = (np.zeros(DIM, dtype=np.float32).tolist(),)
+        result, prov = router.dispatch(sample)
+        assert prov["model_version"] == v_good
+        assert "out" in result["outputs"]
+    finally:
+        for rep in router.replicas:
+            rep.transport.engine.shutdown(drain=False)
+        router.shutdown()

@@ -16,9 +16,11 @@
 #
 # The bench child carries per-round extras (bench.py:child_main) — a
 # capture window records them all for free: input_pipeline, zero1,
-# pipeline, serving, decode, and (r13) fleet — the AOT cold-start A/B,
+# pipeline, serving, decode, (r13) fleet — the AOT cold-start A/B,
 # which on a real chip measures the tunnel's multi-minute XLA compiles
-# against a millisecond cache deserialize.
+# against a millisecond cache deserialize — and (r19) quant: the
+# fp32/bf16/int8 serving three-way with the warmup accuracy gate
+# asserted in-bench.
 #
 # Usage: bash tools/tpu_watch.sh [round_tag]   (default r04)
 set -u
