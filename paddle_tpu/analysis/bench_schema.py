@@ -68,6 +68,14 @@ looser schema):
   ``quant_bf16_p50_ms`` / ``quant_int8_p50_ms``), FINITE gate deltas
   (``quant_gate_delta_bf16`` / ``quant_gate_delta_int8``) and the
   bool ``quant_gate_passed`` — an un-gated speedup is not evidence.
+  Metrics starting with ``serve_train`` (BENCH_r20, the online
+  learning loop) must carry ``serve_train_error_trajectory`` (a
+  non-empty list of finite held-out error numbers, one per published
+  version — the does-online-training-actually-learn evidence), the
+  int ``fleet_failed_non_shed`` summed over every round (the fleet
+  stayed up through the hot-swaps), and the int ``publishes_total`` /
+  ``rollbacks_total`` counters (how many versions went live, and how
+  many refused artifacts rolled back to the incumbent).
 
 Everything must parse as one JSON object with finite numbers
 throughout (NaN/Infinity are emitted by a crashed averaging step and
@@ -285,6 +293,32 @@ def check_bench_file(path: str, rel: str) -> List[Finding]:
             if not isinstance(data.get("quant_gate_passed"), bool):
                 bad("quant artifact missing bool 'quant_gate_passed' "
                     "(the in-bench warmup gate verdict)")
+        if str(data.get("metric", "")).startswith("serve_train"):
+            # the r20 online-learning generation (BENCH_r20): an
+            # online-loop claim is only evidence with the held-out
+            # error TRAJECTORY (one point per published version — did
+            # the stream actually teach the model?), the zero-drop
+            # counter summed over every round, and the publish /
+            # rollback ledger
+            traj = data.get("serve_train_error_trajectory")
+            if (not isinstance(traj, list) or not traj
+                    or not all(isinstance(x, (int, float))
+                               and not isinstance(x, bool)
+                               for x in traj)):
+                bad("serve_train artifact missing "
+                    "'serve_train_error_trajectory' (non-empty list "
+                    "of held-out error numbers, one per published "
+                    "version — the learning evidence)")
+            v = data.get("fleet_failed_non_shed")
+            if not isinstance(v, int) or isinstance(v, bool):
+                bad("serve_train artifact missing int "
+                    "'fleet_failed_non_shed' summed over every round "
+                    "(the fleet-stayed-up-through-the-swaps evidence)")
+            for k in ("publishes_total", "rollbacks_total"):
+                v = data.get(k)
+                if not isinstance(v, int) or isinstance(v, bool):
+                    bad(f"serve_train artifact missing int {k!r} (the "
+                        "publish/rollback ledger)")
         if str(data.get("metric", "")).startswith("overlap"):
             # the r18 FSDP-overlap generation (BENCH_r18): the overlap
             # claim is only evidence with BOTH step-time sides AND the

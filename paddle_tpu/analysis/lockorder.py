@@ -71,6 +71,16 @@ DEFAULT_MODULES = (
     # timeline / records flight events under its own lock.
     "paddle_tpu/obs/events.py",
     "paddle_tpu/obs/health.py",
+    # the online loop (r20): the replay writer's append lock is the one
+    # new lock — the chaos hit fires UNDER it (replay -> chaos, the
+    # same precedent as master -> chaos), and sealing never calls out
+    # of the module. The tailer's scanner thread and the publisher are
+    # deliberately lock-free (master's RLock + GIL-atomic state), so
+    # they contribute scope, not locks.
+    "paddle_tpu/online/replay.py",
+    "paddle_tpu/online/tailer.py",
+    "paddle_tpu/online/publish.py",
+    "paddle_tpu/online/loop.py",
 )
 
 _LOCK_CTORS = {"Lock": False, "RLock": True}  # name -> reentrant

@@ -375,6 +375,11 @@ class MasterService:
         self.failed: List[Task] = []
         self.cur_pass = 0
         self._ready = False
+        # streaming mode (the r20 online loop): while a stream is open
+        # the task list GROWS (``extend_dataset``) and a drained queue
+        # answers "wait" instead of "end" — the pass ends only when the
+        # producer closes the stream
+        self._stream_open = False
         self._last_save: float = -1e30
         self._recover()
 
@@ -410,6 +415,7 @@ class MasterService:
             "done_by": {str(tid): tr for tid, tr in self.done_by.items()},
             "cur_pass": self.cur_pass,
             "ready": self._ready,
+            "stream": self._stream_open,
         }
         return json.dumps(state).encode()
 
@@ -450,6 +456,7 @@ class MasterService:
         self.failed = [Task.from_dict(d) for d in state["failed"]]
         self.cur_pass = state["cur_pass"]
         self._ready = state["ready"]
+        self._stream_open = state.get("stream", False)
         logger.info("master recovered: %d todo (%d requeued), %d done, "
                     "%d failed, pass %d", len(self.todo), len(recovered),
                     len(self.done), len(self.failed), self.cur_pass)
@@ -464,6 +471,67 @@ class MasterService:
                 return
             self.todo = partition_chunks(chunks, self.chunks_per_task)
             self._ready = True
+            self._snapshot()
+
+    # -------------------------------------------------------- streaming
+    # The r20 online loop's surface (in-process only — deliberately NOT
+    # in RPC_METHODS: the tailer owns its master, there is no remote
+    # producer). A stream is one never-rolling pass whose task list
+    # grows as replay segments seal; "end" arrives only after
+    # ``end_stream``.
+
+    def open_stream(self):
+        """Begin (or resume) streaming ingest: the job is ready with an
+        initially-empty, growable task list. Idempotent against a
+        recovered snapshot — a restarted tailer re-opens the stream it
+        crashed out of without disturbing the recovered ledger."""
+        with self._lock:
+            self._stream_open = True
+            self._ready = True
+            self._snapshot()
+
+    def extend_dataset(self, chunks: List[Any]) -> int:
+        """Append newly-visible chunks to the open stream, deduplicated
+        by chunk VALUE against everything this job has ever queued —
+        the periodic tail scan re-reports old segments and a restarted
+        scanner re-reports ALL of them, so idempotence lives here, not
+        in the caller. Returns how many chunks were actually new."""
+        with self._lock:
+            if not self._stream_open:
+                raise RuntimeError("extend_dataset on a closed stream")
+            known = set()
+            for bucket in (self.todo, self.pending.values(), self.done,
+                           self.failed):
+                for t in bucket:
+                    known.update(t.chunks)
+            for ts in self.uncommitted.values():
+                for t in ts:
+                    known.update(t.chunks)
+            fresh = [c for c in chunks if c not in known]
+            if not fresh:
+                return 0
+            next_id = 1 + max(
+                (t.id for bucket in (self.todo, self.pending.values(),
+                                     self.done, self.failed)
+                 for t in bucket),
+                default=-1)
+            for ts in self.uncommitted.values():
+                for t in ts:
+                    next_id = max(next_id, t.id + 1)
+            new_tasks = partition_chunks(fresh, self.chunks_per_task)
+            for t in new_tasks:
+                t.id += next_id
+                t.epoch = self.cur_pass
+            self.todo.extend(new_tasks)
+            self._snapshot()
+            return len(fresh)
+
+    def end_stream(self):
+        """Close the stream: no more ``extend_dataset`` calls are
+        coming, and a drained queue may now answer "end" — the reader
+        finishes its pass and the loop unwinds."""
+        with self._lock:
+            self._stream_open = False
             self._snapshot()
 
     def _release_owner(self, task_id: int):
@@ -622,7 +690,17 @@ class MasterService:
                         return ("task", task.to_dict())
                     return ("wait", None)
                 if pass_id == self.cur_pass:
+                    # an open stream's pass never drains to "end": the
+                    # tail may grow any moment — the caller polls until
+                    # the producer closes the stream
+                    if self._stream_open:
+                        return ("wait", None)
                     return ("end", None)
+                if self._stream_open:
+                    # a stream is ONE pass by construction; a caller
+                    # from a later pass (stale resume state) waits
+                    # rather than rolling the stream's ledger
+                    return ("wait", None)
                 # drained and the caller is a pass ahead → roll, but
                 # ONLY once every parked finish has committed. A
                 # trainer's end-of-pass checkpoint may still be fsyncing

@@ -1,0 +1,39 @@
+"""Online learning loop (`--job=serve_train`): serving traffic streams
+into the sparse CTR trainer with zero-downtime hot-swap.
+
+The 2017 production story the reference framework existed for —
+PaddlePaddle's sparse CTR models trained continuously on live traffic
+behind a parameter server — recast onto this repo's primitives:
+
+- ``replay.py``   — durable replay shards the serving engine appends
+                    answered rows to (length-delimited CRC records,
+                    fsync'd segment roll, schema'd header).
+- ``tailer.py``   — the exactly-once tailer: sealed segments become
+                    ledger tasks in the r11 ``dist/master.py``
+                    lease/commit machinery, over a stream whose tail
+                    grows while training.
+- ``publish.py``  — the versioned publisher: merge a PTM1 artifact on
+                    a cadence (optionally quantized through the r19
+                    warmup gate) and ``rolling_reload`` the fleet with
+                    an explicit ``model_hash`` pin; gate refusals stay
+                    typed and the incumbent keeps serving.
+- ``loop.py``     — the supervised loop wiring trainer + tailer +
+                    publisher + divergence sentry into one process
+                    group.
+
+Architecture record: ``docs/online_learning.md``.
+"""
+
+from paddle_tpu.online.loop import OnlineLoopConfig, ServeTrainLoop
+from paddle_tpu.online.publish import ModelPublisher, PublishResult
+from paddle_tpu.online.replay import (ReplayCorrupt, ReplayWriter,
+                                      load_segment, parse_segment,
+                                      quarantine, scan_segments)
+from paddle_tpu.online.tailer import LocalMasterClient, ReplayTailer
+
+__all__ = [
+    "OnlineLoopConfig", "ServeTrainLoop", "ModelPublisher",
+    "PublishResult", "ReplayCorrupt", "ReplayWriter", "load_segment",
+    "parse_segment", "quarantine", "scan_segments", "LocalMasterClient",
+    "ReplayTailer",
+]

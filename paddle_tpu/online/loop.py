@@ -1,0 +1,103 @@
+"""`--job=serve_train`: the supervised loop wiring it all together.
+
+One process group closes serving→training→publish→serving:
+
+1. the serving fleet answers score traffic and its engines append every
+   successfully-answered row to the replay log (``replay.ReplayWriter``
+   as the engines' ``replay_sink``);
+2. the tailer feeds sealed segments through the ledger exactly-once
+   into ``trainer.train`` (the streaming pass — the trainer's existing
+   commit-after-durable-checkpoint coupling does the rest);
+3. the publisher merges + hot-swaps on a batch cadence, divergence
+   sentry upstream, rollback downstream.
+
+``run()`` blocks in ``trainer.train`` until the stream ends; ``stop()``
+(any thread — typically the traffic driver finishing, or a signal
+handler) seals the replay tail and closes the stream, letting the
+reader drain to "end" so the trainer unwinds through its normal
+end-of-pass commit. A ``ChaosKilled`` mid-loop unwinds like a process
+death: re-build the loop over the same directories and ``run()``
+resumes exactly-once from the checkpoint + ledger
+(``auto_resume=True`` → ``resume_lease`` reconciliation).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from paddle_tpu.trainer import events as _ev
+from paddle_tpu.utils.log import get_logger
+
+logger = get_logger("online.loop")
+
+
+@dataclasses.dataclass
+class OnlineLoopConfig:
+    """The serve_train flag surface (``docs/flag_absorption.md`` rows
+    X3–X5; ``docs/online_learning.md`` has the full table)."""
+    replay_dir: str
+    model_dir: str
+    publish_every: int = 50        # --publish_every (batches)
+    segment_records: int = 200     # --replay_segment_records
+    batch_rows: int = 100          # train batch assembled per segment read
+    quantize: Optional[str] = None  # ride --quantize on publish merges
+    scan_period_s: float = 0.2
+    checkpoint_period_batches: Optional[int] = 20
+
+
+class ServeTrainLoop:
+    """Glue object: owns nothing it didn't build, stops cleanly, and
+    resumes exactly-once when rebuilt over the same directories."""
+
+    def __init__(self, trainer, *, tailer, publisher, feeder=None,
+                 writer=None, checkpointer=None, health=None,
+                 max_batches: Optional[int] = None, log_period: int = 0):
+        self.trainer = trainer
+        self.tailer = tailer
+        self.publisher = publisher
+        self.feeder = feeder
+        self.writer = writer
+        self.checkpointer = checkpointer
+        self.health = health
+        self.max_batches = max_batches
+        self.log_period = log_period
+        self.batches_trained = 0
+        self._stopping = False
+
+    # ----------------------------------------------------------- control
+    def stop(self):
+        """Seal the replay tail, close the stream. Idempotent; callable
+        from any thread. The reader drains every already-sealed segment
+        before answering "end", so nothing durable is dropped."""
+        if self._stopping:
+            return
+        self._stopping = True
+        if self.writer is not None:
+            self.writer.seal()
+        self.tailer.end_stream()
+
+    # -------------------------------------------------------------- run
+    def _handle(self, event):
+        if isinstance(event, _ev.EndIteration):
+            self.batches_trained += 1
+            self.publisher.on_batch()
+            if (self.max_batches is not None and not self._stopping
+                    and self.batches_trained >= self.max_batches):
+                logger.info("serve_train: max_batches=%d reached, "
+                            "closing the stream", self.max_batches)
+                self.stop()
+
+    def run(self):
+        """Block until the stream ends (``stop()``, or ``max_batches``).
+        Returns the trainer (its params now hold the stream)."""
+        self.tailer.start()
+        try:
+            self.trainer.train(
+                self.tailer.reader, feeder=self.feeder, num_passes=1,
+                event_handler=self._handle,
+                checkpointer=self.checkpointer, health=self.health,
+                log_period=self.log_period)
+        finally:
+            self.tailer.close()
+        return self.trainer

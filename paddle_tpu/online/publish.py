@@ -1,0 +1,201 @@
+"""The versioned publisher: training→serving edge of the online loop.
+
+On a cadence (every ``every_batches`` trained batches), merge the live
+trainer parameters into a PTM1 artifact — optionally quantized through
+the r19 warmup accuracy gate — and roll it across the serving fleet
+with ``ReplicaRouter.rolling_reload``, pinned to the artifact's
+``merged_digest`` as the explicit ``model_hash``.
+
+The swap is weight-only by construction: the fleet's AOT bucket menu,
+feeding order, and generation pins come from the serving plan, not the
+artifact, so a reload recompiles NOTHING — every replica re-warms
+through the shared AOT cache and its hardened ``RecompileGuard``s
+would raise on any hot-path compile (the bench asserts their silence).
+
+Rollback state machine (``docs/online_learning.md`` has the diagram):
+
+- merge fails / artifact corrupt / warmup gate refuses → the build of
+  the FIRST replica raises (``QuantGateError`` stays typed through the
+  router as ``ReloadRejected``), ``fallback_build`` restores the
+  incumbent artifact, the router counts ``reload_rollbacks_total`` —
+  and the INCUMBENT keeps serving. The publisher keeps training; the
+  next cadence tries again with newer weights.
+- success → ``last_good`` advances to the new artifact (the next
+  rollback target) and every replica reports the new model_version.
+
+Every attempt is a flight-recorder event (``publish`` /
+``publish_rejected``) — a bad cycle is postmortem-able from
+``tools/blackbox.py`` alone. The divergence sentry upstream
+(``trainer.train(health=...)``) keeps poisoned updates out of the
+parameters the merge reads, so a "bad publish" requires a poisoned
+batch to get PAST the sentry — the online test matrix pins that it
+cannot.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Callable, List, Optional
+
+from paddle_tpu import quant as quant_lib
+from paddle_tpu.obs import flight as _flight
+from paddle_tpu.serving.errors import ReloadRejected
+from paddle_tpu.testing import chaos as _chaos
+from paddle_tpu.trainer.merge_model import merge_model, merged_digest
+from paddle_tpu.utils.log import get_logger
+
+logger = get_logger("online.publish")
+
+
+@dataclasses.dataclass
+class PublishResult:
+    version: Optional[str]  # merged_digest hex, None when rolled back
+    path: str
+    ok: bool
+    error: Optional[str] = None
+
+
+class ModelPublisher:
+    """Merge-and-roll on a batch cadence.
+
+    ``build_transport(model_path, replica_id)`` is the serving plan's
+    reload builder (``trainer/cli.py:build_serving_fleet``): it
+    constructs a started engine transport from an artifact path. The
+    publisher wraps it into ``rolling_reload``'s ``build`` /
+    ``fallback_build`` pair around the artifact it just wrote and the
+    last known-good one.
+
+    ``router=None`` publishes artifacts without a fleet (the merge
+    cadence alone — useful for tests and the bench's trainer-only
+    mode); the version history still advances.
+    """
+
+    def __init__(self, trainer, *, model_dir: str,
+                 outputs: List[str],
+                 router=None,
+                 build_transport: Optional[Callable] = None,
+                 every_batches: int = 50,
+                 quantize: Optional[str] = None,
+                 feeding=None,
+                 golden_fn: Optional[Callable] = None):
+        self.trainer = trainer
+        self.model_dir = model_dir
+        self.outputs = list(outputs)
+        self.router = router
+        self.build_transport = build_transport
+        if router is not None and build_transport is None:
+            raise ValueError("a fleet publisher needs build_transport")
+        self.every_batches = int(every_batches)
+        self.quantize = quantize
+        self.feeding = feeding
+        self.golden_fn = golden_fn
+        self.versions: List[str] = []  # digests actually serving, in order
+        self.last_good: Optional[str] = None  # artifact path
+        self.publishes_total = 0
+        self.rollbacks_total = 0
+        self._batches_since = 0
+        self._vnum = 0
+        os.makedirs(model_dir, exist_ok=True)
+
+    # ---------------------------------------------------------- cadence
+    def on_batch(self) -> Optional[PublishResult]:
+        """Call once per trained batch (the ``EndIteration`` hook);
+        publishes when the cadence is due."""
+        self._batches_since += 1
+        if self._batches_since < self.every_batches:
+            return None
+        self._batches_since = 0
+        return self.publish()
+
+    # ---------------------------------------------------------- publish
+    def _merge(self, path: str) -> str:
+        params = self.trainer._params_for_save()
+        graph = self.trainer.topology.graph
+        quant_meta = golden = None
+        if self.quantize:
+            if self.golden_fn is not None:
+                golden = self.golden_fn(graph, params)
+            elif self.feeding is not None:
+                golden = quant_lib.golden_section(
+                    graph, params, self.outputs, self.feeding)
+            sparse = {name for name, spec in self.trainer.meta.items()
+                      if getattr(spec, "sparse_grad", False)}
+            params, quant_meta = quant_lib.quantize_params(
+                params, self.quantize, sparse_names=sparse)
+        tmp = path + ".tmp"
+        merge_model(tmp, graph, params, outputs=self.outputs,
+                    quant=quant_meta, golden=golden)
+        os.replace(tmp, path)
+        return merged_digest(path)
+
+    def publish(self) -> PublishResult:
+        path = os.path.join(self.model_dir,
+                            f"model-v{self._vnum:04d}.ptmodel")
+        self._vnum += 1
+        digest = self._merge(path)
+        if _chaos._ACTIVE is not None:
+            # fires AFTER the artifact exists so "corrupt" has a file
+            # to mutate (PTM1, not .npz → caller-applied, the
+            # step_stats pattern — info key is NOT "path", which would
+            # invoke the plan's built-in checkpoint corruptor): the
+            # flipped byte fails the payload MD5 inside the reload
+            # build, driving the rollback path
+            kinds = _chaos._ACTIVE.hit("publish", version=digest[:12],
+                                       artifact=os.path.basename(path))
+            if "corrupt" in kinds:
+                with open(path, "r+b") as f:
+                    f.seek(os.path.getsize(path) // 2)
+                    b = f.read(1)
+                    f.seek(-1, os.SEEK_CUR)
+                    f.write(bytes([(b[0] ^ 0xFF) if b else 0xFF]))
+                logger.warning("chaos: corrupted published artifact %s",
+                               os.path.basename(path))
+        if self.router is None:
+            self.versions.append(digest)
+            self.last_good = path
+            self.publishes_total += 1
+            if _flight._ACTIVE is not None:
+                _flight._ACTIVE.record("publish", version=digest[:12],
+                                       path=os.path.basename(path),
+                                       fleet=False)
+            return PublishResult(version=digest, path=path, ok=True)
+
+        incumbent = self.last_good
+
+        def build(replica_id: str):
+            return self.build_transport(path, replica_id)
+
+        fallback = None
+        if incumbent is not None:
+            def fallback(replica_id: str):
+                return self.build_transport(incumbent, replica_id)
+
+        try:
+            self.router.rolling_reload(build, fallback_build=fallback)
+        except ReloadRejected as e:
+            # typed refusal (QuantGateError → ReloadRejected, or a
+            # corrupt artifact's integrity error): the incumbent is
+            # back in every swapped slot and KEEPS SERVING; training
+            # continues and the next cadence retries with newer weights
+            self.rollbacks_total += 1
+            logger.warning("publish %s rejected, incumbent restored: %s",
+                           digest[:12], e)
+            if _flight._ACTIVE is not None:
+                _flight._ACTIVE.record("publish_rejected",
+                                       version=digest[:12],
+                                       error=type(
+                                           e.__cause__ or e).__name__,
+                                       reason=str(e)[:200])
+            return PublishResult(version=None, path=path, ok=False,
+                                 error=str(e))
+        self.versions.append(digest)
+        self.last_good = path
+        self.publishes_total += 1
+        logger.info("published %s (%s) across the fleet", digest[:12],
+                    os.path.basename(path))
+        if _flight._ACTIVE is not None:
+            _flight._ACTIVE.record("publish", version=digest[:12],
+                                   path=os.path.basename(path),
+                                   fleet=True)
+        return PublishResult(version=digest, path=path, ok=True)

@@ -1,0 +1,304 @@
+"""Durable replay shards: the serving→training edge of the online loop.
+
+The serving engine appends every successfully-answered score row to a
+replay log; the online tailer (``online/tailer.py``) trains on sealed
+segments exactly-once through the dist master's ledger. The format is
+deliberately checkpoint-grade — a chaos-corruptible artifact with the
+same honesty rules as ``dist/checkpoint.py``:
+
+Segment file (``replay-NNNNNNNN.ptrl``)::
+
+    b"PTRL1\\n"                                   magic
+    >I header_len | header JSON                   {"schema": [slot
+                                                  names], "seq": N,
+                                                  "created": ts}
+    >II payload_len, crc32 | payload JSON         one record per
+    ...                                           answered row
+
+Durability contract: rows accumulate in ``replay-NNNNNNNN.open``; at
+``segment_records`` the writer flush+fsyncs, then ``os.replace``s to
+the sealed ``.ptrl`` name and fsyncs the directory — a sealed segment
+is durable the way a renamed checkpoint generation is, and ONLY sealed
+segments are visible to the tailer. The unsealed tail is therefore
+at-most-once (a crash loses it, exactly like requests answered between
+checkpoints); the exactly-once guarantee starts at the seal boundary.
+
+Corruption contract: :func:`parse_segment` validates the WHOLE segment
+(magic, header, every record length + CRC) before returning anything,
+so a torn or bit-flipped file can never yield a partial batch;
+:func:`load_segment` answers corruption with **quarantine + skip** —
+rename to ``.bad``, warn, return no rows — never an exception into the
+training loop. Chaos sites ``replay_append`` (writer) and
+``replay_tail`` (reader) drive both paths deterministically; their
+``corrupt`` kind is caller-applied (the ``step_stats`` pattern) since
+``_corrupt_file`` assumes ``.npz`` checkpoints.
+
+Nothing in this module imports jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+import time
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+from paddle_tpu.obs import flight as _flight
+from paddle_tpu.testing import chaos as _chaos
+from paddle_tpu.utils.log import get_logger
+
+logger = get_logger("online.replay")
+
+MAGIC = b"PTRL1\n"
+SEALED_SUFFIX = ".ptrl"
+OPEN_SUFFIX = ".open"
+_REC_HEAD = struct.Struct(">II")  # payload length, crc32(payload)
+_HDR_LEN = struct.Struct(">I")
+
+
+class ReplayCorrupt(IOError):
+    """A replay segment failed whole-file validation (bad magic, torn
+    record, CRC mismatch, undecodable payload). The tailer answers
+    this with quarantine + skip, never a torn train batch."""
+
+
+def segment_name(seq: int, *, sealed: bool = True) -> str:
+    return f"replay-{seq:08d}" + (SEALED_SUFFIX if sealed else OPEN_SUFFIX)
+
+
+def scan_segments(directory: str) -> List[str]:
+    """Sorted absolute paths of the SEALED segments in ``directory`` —
+    the only files the tailer may train on (the open tail is not yet
+    durable; ``.bad`` quarantines are never revisited)."""
+    try:
+        names = os.listdir(directory)
+    except FileNotFoundError:
+        return []
+    return [os.path.join(directory, n) for n in sorted(names)
+            if n.startswith("replay-") and n.endswith(SEALED_SUFFIX)]
+
+
+class ReplayWriter:
+    """Append answered rows to the replay log; seal segments durably.
+
+    Thread-safe: replicas of an in-process fleet share ONE writer (the
+    log is the merge point of the fleet's answered traffic), so append
+    serializes under ``_lock``. The chaos hit fires under it — the
+    replay→chaos edge mirrors the master→chaos precedent in the
+    lock-order graph.
+    """
+
+    def __init__(self, directory: str, *, segment_records: int = 256,
+                 schema: Optional[List[str]] = None):
+        if segment_records < 1:
+            raise ValueError("segment_records must be >= 1")
+        self.directory = directory
+        self.segment_records = int(segment_records)
+        self.schema = list(schema or [])
+        self._lock = threading.Lock()
+        self._file = None
+        self._records = 0  # records in the open segment
+        self.records_total = 0
+        self.segments_sealed = 0
+        os.makedirs(directory, exist_ok=True)
+        self._seq = self._recover()
+
+    # ------------------------------------------------------------ setup
+    def _recover(self) -> int:
+        """Orphan any unsealed tail a crashed writer left behind (its
+        rows were answered but never made durable — at-most-once
+        upstream of the seal boundary) and continue numbering after
+        every name ever used."""
+        top = 0
+        for name in os.listdir(self.directory):
+            if not name.startswith("replay-"):
+                continue
+            stem = name.split(".", 1)[0]
+            try:
+                top = max(top, int(stem.split("-", 1)[1]) + 1)
+            except (IndexError, ValueError):
+                continue
+            if name.endswith(OPEN_SUFFIX):
+                path = os.path.join(self.directory, name)
+                os.replace(path, path + ".orphan")
+                logger.warning(
+                    "replay: orphaned unsealed tail %s (rows before the "
+                    "seal boundary are at-most-once)", name)
+        return top
+
+    # ----------------------------------------------------------- append
+    def _open_locked(self):
+        path = os.path.join(self.directory,
+                            segment_name(self._seq, sealed=False))
+        f = open(path, "wb")
+        header = json.dumps({"schema": self.schema, "seq": self._seq,
+                             "created": time.time()},
+                            separators=(",", ":")).encode()
+        f.write(MAGIC + _HDR_LEN.pack(len(header)) + header)
+        self._file = f
+        self._records = 0
+
+    def append(self, row) -> None:
+        """Append one answered row (a feeding-order sample tuple). May
+        raise ``ChaosDropped`` (a lost append — the caller counts and
+        sheds it; the row is NOT in the log) per the active plan."""
+        payload = json.dumps(row, separators=(",", ":")).encode()
+        with self._lock:
+            # fire BEFORE the write: a "drop" here is an append that
+            # never reached the log, and a "kill" loses the row exactly
+            # like replica death would
+            kinds = ()
+            if _chaos._ACTIVE is not None:
+                kinds = _chaos._ACTIVE.hit("replay_append",
+                                           segment=self._seq,
+                                           records=self._records)
+            if self._file is None:
+                self._open_locked()
+            rec_off = self._file.tell()
+            self._file.write(_REC_HEAD.pack(
+                len(payload), zlib.crc32(payload) & 0xFFFFFFFF) + payload)
+            if "corrupt" in kinds:
+                # caller-applied corruption (replay shards are not the
+                # .npz files _corrupt_file mutates): flip one payload
+                # byte of the record just written, so the sealed
+                # segment fails its CRC at tail time
+                self._file.flush()
+                path = self._file.name
+                with open(path, "r+b") as g:
+                    g.seek(rec_off + _REC_HEAD.size + len(payload) // 2)
+                    b = g.read(1)
+                    g.seek(-1, os.SEEK_CUR)
+                    g.write(bytes([(b[0] ^ 0xFF) if b else 0xFF]))
+                logger.warning("chaos: corrupted replay record in %s",
+                               os.path.basename(path))
+            self._records += 1
+            self.records_total += 1
+            if self._records >= self.segment_records:
+                self._seal_locked()
+
+    def _seal_locked(self):
+        f, self._file = self._file, None
+        if f is None or self._records == 0:
+            if f is not None:
+                f.close()
+                os.remove(f.name)
+            return
+        f.flush()
+        os.fsync(f.fileno())
+        f.close()
+        sealed = os.path.join(self.directory, segment_name(self._seq))
+        os.replace(f.name, sealed)
+        dirfd = os.open(self.directory, os.O_RDONLY)
+        try:
+            os.fsync(dirfd)
+        finally:
+            os.close(dirfd)
+        if _flight._ACTIVE is not None:
+            _flight._ACTIVE.record("replay_seal",
+                                   segment=os.path.basename(sealed),
+                                   records=self._records)
+        self.segments_sealed += 1
+        self._seq += 1
+        self._records = 0
+
+    def seal(self) -> None:
+        """Seal the open partial segment (loop shutdown: the answered
+        tail becomes durable and trainable before the stream closes)."""
+        with self._lock:
+            self._seal_locked()
+
+    def close(self) -> None:
+        self.seal()
+
+
+# ---------------------------------------------------------------- read
+
+def parse_segment(path: str) -> Tuple[Dict[str, Any], List[Any]]:
+    """-> (header, rows). Validates the ENTIRE segment — magic, header,
+    every record's length and CRC — before returning anything, so a
+    torn file can never surface as a partial batch. Raises
+    :class:`ReplayCorrupt` on any violation."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    if raw[:len(MAGIC)] != MAGIC:
+        raise ReplayCorrupt(f"{path}: bad magic")
+    off = len(MAGIC)
+    try:
+        (hdr_len,) = _HDR_LEN.unpack_from(raw, off)
+        off += _HDR_LEN.size
+        if off + hdr_len > len(raw):
+            raise ReplayCorrupt(f"{path}: truncated header")
+        header = json.loads(raw[off:off + hdr_len].decode())
+        off += hdr_len
+        rows: List[Any] = []
+        while off < len(raw):
+            if off + _REC_HEAD.size > len(raw):
+                raise ReplayCorrupt(f"{path}: torn record head "
+                                    f"at byte {off}")
+            length, crc = _REC_HEAD.unpack_from(raw, off)
+            off += _REC_HEAD.size
+            if off + length > len(raw):
+                raise ReplayCorrupt(f"{path}: torn record payload "
+                                    f"at byte {off}")
+            payload = raw[off:off + length]
+            if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                raise ReplayCorrupt(f"{path}: CRC mismatch on record "
+                                    f"{len(rows)}")
+            rows.append(json.loads(payload.decode()))
+            off += length
+    except ReplayCorrupt:
+        raise
+    except (struct.error, ValueError, UnicodeDecodeError) as e:
+        raise ReplayCorrupt(f"{path}: {e}") from e
+    return header, rows
+
+
+def quarantine(path: str, *, reason: str = "") -> str:
+    """Rename a corrupt segment to ``.bad`` so it is skipped forever —
+    with a warning and a flight event, never silently."""
+    bad = path + ".bad"
+    os.replace(path, bad)
+    logger.warning("replay: quarantined corrupt segment %s -> %s (%s)",
+                   os.path.basename(path), os.path.basename(bad),
+                   reason or "failed validation")
+    if _flight._ACTIVE is not None:
+        _flight._ACTIVE.record("replay_quarantine",
+                               segment=os.path.basename(path),
+                               reason=reason or "failed validation")
+    return bad
+
+
+def load_segment(path: str) -> List[Any]:
+    """Read one sealed segment for training. A corrupt segment is
+    quarantined and yields NO rows (the ledger task completes empty and
+    is never retried) — the torn-batch-free contract. The
+    ``replay_tail`` chaos site fires first; its ``corrupt`` kind flips
+    a byte of the file before parsing (caller-applied, deterministic
+    drill for the quarantine path)."""
+    if _chaos._ACTIVE is not None:
+        kinds = _chaos._ACTIVE.hit("replay_tail",
+                                   segment=os.path.basename(path))
+        if "corrupt" in kinds:
+            size = os.path.getsize(path)
+            with open(path, "r+b") as f:
+                f.seek(max(len(MAGIC) + _HDR_LEN.size, size // 2))
+                b = f.read(1)
+                f.seek(-1, os.SEEK_CUR)
+                f.write(bytes([(b[0] ^ 0xFF) if b else 0xFF]))
+            logger.warning("chaos: corrupted replay segment %s",
+                           os.path.basename(path))
+    try:
+        _header, rows = parse_segment(path)
+    except ReplayCorrupt as e:
+        quarantine(path, reason=str(e))
+        return []
+    except FileNotFoundError:
+        # already quarantined by an earlier attempt of this task
+        # (timeout redispatch): skip, matching the quarantine outcome
+        logger.warning("replay: segment %s gone (already quarantined?)",
+                       os.path.basename(path))
+        return []
+    return rows

@@ -1,0 +1,188 @@
+"""The exactly-once replay tailer: sealed segments become ledger tasks.
+
+The r11 master already owns every guarantee the online loop needs —
+lease/commit-after-durable-checkpoint, crash-resume reconciliation,
+idempotent finishes (``tests/test_exact_resume_matrix.py`` pins them).
+What a STREAM adds is only that the task list grows while training:
+``MasterService.extend_dataset`` over an open stream, fed by a scanner
+thread watching the replay directory for newly-sealed segments. One
+segment = one task; ``load_chunk`` reads it through
+``replay.load_segment`` (whole-segment validation, quarantine + skip on
+corruption) and re-batches the rows for the feeder.
+
+Two deliberate choices:
+
+- **In-process client.** The tailer owns its master (one process group
+  is the serve_train deployment unit), so :class:`LocalMasterClient`
+  satisfies ``master_reader``'s client surface by direct call — no TCP,
+  no heartbeat thread (liveness renews on every ``get_task`` poll), and
+  the streaming methods stay off ``RPC_METHODS``.
+- **Stable trainer id.** ``MasterClient``'s default id is pid-derived;
+  a resumed tailer must present the SAME id its checkpoint ledger was
+  written under or ``resume_lease`` reconciles against a stranger.
+  (The reader still passes ``prev_trainer_id`` from the ledger, so even
+  an operator-changed id reconciles — stable is belt and braces.)
+
+The scanner thread holds NO lock of its own: dedupe against
+already-queued segments lives inside the master's RLock
+(``extend_dataset``), so concurrent scans and a racing ``end_stream``
+serialize there.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, List, Optional
+
+from paddle_tpu.dist.master import (FileStore, MasterService, Task,
+                                    master_reader)
+from paddle_tpu.online.replay import load_segment, scan_segments
+from paddle_tpu.utils.log import get_logger
+
+logger = get_logger("online.tailer")
+
+
+class LocalMasterClient:
+    """``MasterClient``'s call surface over an in-process
+    :class:`MasterService` — everything ``master_reader`` touches,
+    minus sockets and the heartbeat thread."""
+
+    def __init__(self, service: MasterService,
+                 trainer_id: str = "serve_train-0"):
+        self.service = service
+        self.trainer_id = trainer_id
+
+    def get_task(self, pass_id: int = 0):
+        status, tdict = self.service.get_task(pass_id, self.trainer_id)
+        return status, (Task.from_dict(tdict) if tdict else None)
+
+    def task_finished(self, task_id: int,
+                      defer_commit: bool = False) -> bool:
+        return self.service.task_finished(task_id, self.trainer_id,
+                                          defer_commit=defer_commit)
+
+    def task_failed(self, task_id: int) -> bool:
+        return self.service.task_failed(task_id)
+
+    def commit_tasks(self, task_ids: Optional[List[int]] = None) -> int:
+        return self.service.commit_tasks(self.trainer_id, task_ids)
+
+    def current_pass(self) -> int:
+        return self.service.current_pass()
+
+    def resume_lease(self, pass_id: int, done_ids: List[int],
+                     inflight_id: Optional[int] = None,
+                     prev_trainer_id: Optional[str] = None) -> dict:
+        return self.service.resume_lease(self.trainer_id, pass_id,
+                                         done_ids, inflight_id,
+                                         prev_trainer_id)
+
+    def release_lease(self) -> int:
+        return self.service.release_lease(self.trainer_id)
+
+    def heartbeat(self) -> bool:
+        return self.service.heartbeat(self.trainer_id)
+
+    def close(self):
+        pass
+
+
+class ReplayTailer:
+    """Watch a replay directory; feed its sealed segments through the
+    ledger exactly-once.
+
+    ``tailer.reader`` is a ``master_reader`` — hand it straight to
+    ``trainer.train`` and the commit protocol couples to the
+    checkpointer automatically (commit-after-durable-checkpoint). Call
+    :meth:`start` to begin scanning, :meth:`end_stream` to let the
+    reader drain to "end" (shutdown), :meth:`close` to stop the
+    scanner.
+    """
+
+    def __init__(self, replay_dir: str, *, batch_rows: int = 100,
+                 scan_period_s: float = 0.2, poll_s: float = 0.05,
+                 trainer_id: str = "serve_train-0",
+                 ledger_path: Optional[str] = None,
+                 trainer_timeout_s: float = 3600.0):
+        self.replay_dir = replay_dir
+        self.batch_rows = int(batch_rows)
+        self.scan_period_s = float(scan_period_s)
+        os.makedirs(replay_dir, exist_ok=True)
+        # trainer_timeout_s is LONG on purpose: this is a single-trainer
+        # loop whose liveness is the process itself — a compile pause
+        # must not expire the lease and requeue uncommitted work the
+        # resume path will reconcile anyway
+        self.master = MasterService(
+            store=FileStore(ledger_path
+                            or os.path.join(replay_dir, "ledger.snap")),
+            chunks_per_task=1,
+            # a segment read has a side effect (quarantine renames) and
+            # the stream is single-trainer: never speculate a second copy
+            straggle_after_s=None,
+            trainer_timeout_s=trainer_timeout_s)
+        self.master.open_stream()
+        self.client = LocalMasterClient(self.master, trainer_id)
+        self.reader = master_reader(self.client, self._load_chunk,
+                                    poll_s=poll_s, defer_commit=True)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ scan
+    def scan_once(self) -> int:
+        """One tail scan: every sealed segment not yet queued becomes a
+        task (dedupe is the master's, under its lock)."""
+        return self.master.extend_dataset(scan_segments(self.replay_dir))
+
+    def start(self) -> "ReplayTailer":
+        try:
+            self.scan_once()
+        except RuntimeError:
+            # stream already closed (drain mode: all traffic pre-sealed
+            # and end_stream called up front) — the queued tasks drain
+            # without a scanner
+            return self
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._scan_loop, name="replay-tail-scan",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def _scan_loop(self):
+        while not self._stop.wait(self.scan_period_s):
+            try:
+                self.scan_once()
+            except RuntimeError:
+                return  # stream closed under us: shutdown race, done
+            except OSError as e:
+                logger.warning("replay tail scan failed: %r", e)
+
+    def end_stream(self):
+        """Final scan, then close the stream: the reader sees every
+        sealed segment, drains, and answers "end" to the trainer."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        try:
+            self.scan_once()
+        except RuntimeError:
+            pass
+        self.master.end_stream()
+
+    def close(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    # ------------------------------------------------------------ read
+    def _load_chunk(self, segment_path: str) -> List[List[Any]]:
+        """One sealed segment -> a list of training batches (the
+        reader's records). Row tuples JSON-round-trip as lists; the
+        feeder accepts either. A quarantined segment yields NO batches
+        — the task completes empty and the ledger moves on."""
+        rows = load_segment(segment_path)
+        return [rows[i:i + self.batch_rows]
+                for i in range(0, len(rows), self.batch_rows)]

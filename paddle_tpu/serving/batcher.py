@@ -96,8 +96,15 @@ class ServingEngine:
                  shed_watermark: Optional[int] = None,
                  default_deadline_ms: Optional[float] = None,
                  continuous_batching: bool = False,
-                 metrics: Optional[ServingMetrics] = None):
+                 metrics: Optional[ServingMetrics] = None,
+                 replay_sink=None):
         self.predictor = predictor
+        # the online loop's serving→training edge: successfully-answered
+        # score rows are appended here (``online/replay.py:ReplayWriter``
+        # — replicas of one fleet share the writer). Best-effort by
+        # contract: a failed append is counted and shed, never an error
+        # to the caller whose request DID get answered.
+        self.replay_sink = replay_sink
         self.max_batch = int(max_batch or predictor.batch_buckets[-1])
         if self.max_batch > predictor.batch_buckets[-1]:
             raise ValueError(
@@ -711,6 +718,29 @@ class ServingEngine:
                 self.metrics.observe_request(r.timings)
             r.event.set()
             self._emit_trace(r)
+        self._maybe_replay(kind, reqs, lane_valid)
+
+    def _maybe_replay(self, kind: str, reqs: List[_Request], lane_valid):
+        """Append this batch's successfully-answered score rows to the
+        replay sink. Worker thread, AFTER every caller is answered and
+        with no engine lock held — replay durability is never on a
+        request's latency path. A failed append (full disk, or a chaos
+        ``drop`` at ``replay_append``) sheds the rows with a counter;
+        ``ChaosKilled`` is a BaseException and still takes the worker
+        down, the replica-death drill."""
+        if self.replay_sink is None or kind != "score":
+            return
+        rows = [r.sample for i, r in enumerate(reqs)
+                if lane_valid[i] and r.error is None]
+        if not rows:
+            return
+        try:
+            for row in rows:
+                self.replay_sink.append(row)
+        except OSError as e:  # ChaosDropped is a ConnectionError too
+            self.metrics.inc("replay_dropped_total", len(rows))
+            logger.warning("replay append shed %d row(s): %r",
+                           len(rows), e)
 
     @staticmethod
     def _decode(kind: str, outs, lane: int):

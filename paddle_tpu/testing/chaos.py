@@ -77,6 +77,33 @@ Sites wired in this codebase:
 ``router_failover`` a standby router won the active-role lease and is
                 about to adopt the fleet (info: ``holder``, ``epoch``);
                 ``delay`` models a slow takeover
+``replay_append`` the serving engine's replay sink is about to append
+                one answered row to the open replay segment (info:
+                ``segment``, ``records``). A ``corrupt`` fault carries
+                no usable path semantics for ``_corrupt_file`` (replay
+                shards are not .npz) — the writer reads the fired kinds
+                from ``hit()``'s return and flips a byte of the record
+                it just wrote (the ``step_stats`` pattern); a ``drop``
+                is a lost append the engine counts and sheds (the row
+                is NOT trained on — at-most-once upstream of the
+                sealed-segment exactly-once boundary); a ``kill`` is
+                replica death mid-append
+``replay_tail`` the online tailer is about to read one sealed replay
+                segment as a ledger task (info: ``segment``). A
+                ``corrupt`` fault makes the tailer flip a byte of the
+                segment file BEFORE parsing (same caller-applied
+                pattern) — the whole-segment CRC validation must then
+                quarantine it (rename ``.bad`` + warning), never yield
+                a torn batch; a ``kill`` here is the
+                trainer-died-mid-tail resume drill
+``publish``     the online publisher just wrote a PTM1 artifact and is
+                about to roll it across the fleet (info: ``version``,
+                ``path``). ``corrupt`` carries no ``path`` effect
+                (PTM1, not .npz) — the publisher reads the fired kind
+                and flips a byte of its own artifact, driving the
+                ``rolling_reload`` rollback path (bad digest →
+                build fails → incumbent restored); a ``kill`` is
+                trainer death mid-publish
 ==============  ========================================================
 
 Fault types: ``kill`` (``mode`` ``"exit"`` = ``os._exit(exit_code)``,
@@ -126,7 +153,7 @@ SITES = (
     "step", "step_done", "step_stats", "msg_send", "msg_recv",
     "checkpoint", "store_save", "serve_batch", "route_dispatch",
     "replica_spawn", "supervisor_spawn", "lease_renew",
-    "router_failover",
+    "router_failover", "replay_append", "replay_tail", "publish",
 )
 
 # the one global the hook sites poll; None == chaos disabled

@@ -38,7 +38,7 @@ def parse_args(argv=None):
                    help="Python model-config file (executed)")
     p.add_argument("--job", default="train",
                    choices=["train", "test", "time", "checkgrad", "merge",
-                            "serve", "serve_fleet"])
+                            "serve", "serve_fleet", "serve_train"])
     p.add_argument("--config_args", default="",
                    help="comma-separated k=v injected into the config")
     p.add_argument("--num_passes", type=int, default=1)
@@ -312,7 +312,42 @@ def parse_args(argv=None):
                    default=5.0,
                    help="--job=serve_fleet: EWMA fleet backlog below "
                         "this (sustained) scales down")
-    return p.parse_args(argv)
+    # --job=serve_train (paddle_tpu/online): the online learning loop —
+    # serving traffic streams into the trainer, publishes roll back out
+    p.add_argument("--replay_dir", default=None,
+                   help="--job=serve_train: replay-log directory — the "
+                        "serving engines append answered score rows "
+                        "here (durable PTRL1 segments), the tailer "
+                        "trains them exactly-once through the ledger "
+                        "(its snapshot lives here too), and the loop "
+                        "resumes from it after a crash")
+    p.add_argument("--publish_dir", default=None,
+                   help="--job=serve_train: directory for published "
+                        "PTM1 artifacts (model-vNNNN.ptmodel; default "
+                        "<replay_dir>/published). --quantize applies "
+                        "to every publish merge, gated by the serving "
+                        "warmup accuracy gate — a refused artifact "
+                        "rolls back and the incumbent keeps serving")
+    p.add_argument("--publish_every", type=int, default=50,
+                   help="--job=serve_train: publish + rolling hot-swap "
+                        "cadence in trained batches")
+    p.add_argument("--replay_segment_records", type=int, default=200,
+                   help="--job=serve_train: rows per replay segment "
+                        "before the fsync'd seal makes it visible to "
+                        "the tailer (the durability granularity of the "
+                        "serving->training edge)")
+    p.add_argument("--replay_batch_rows", type=int, default=100,
+                   help="--job=serve_train: rows per training batch "
+                        "assembled from a sealed segment")
+    p.add_argument("--serve_train_batches", type=int, default=0,
+                   help="--job=serve_train: close the stream after this "
+                        "many trained batches (0 = run until killed; "
+                        "the durable replay+ledger+checkpoint state "
+                        "resumes the loop exactly-once on restart)")
+    args = p.parse_args(argv)
+    if args.publish_dir is None and args.replay_dir:
+        args.publish_dir = os.path.join(args.replay_dir, "published")
+    return args
 
 
 def load_config(path: str, config_args: str = ""):
@@ -1059,6 +1094,128 @@ def cmd_serve_fleet(ns, args):
         supervisor.shutdown(drain=True)
 
 
+def build_serve_train_loop(ns, args, *, start_fleet=True):
+    """The --job=serve_train wiring, reusable by bench/tests: returns
+    ``(loop, router, writer)`` — a ready :class:`ServeTrainLoop`, the
+    serving fleet fronting the published artifact (None when
+    ``start_fleet=False``: the trainer-only mode), and the replay
+    writer the engines append through.
+
+    The loop closes over ONE trainer; the fleet never serves live
+    trainer params — replicas are always built from a published PTM1
+    artifact (v0 is merged before the first replica warms), so the
+    running model is exactly the artifact its ``model_hash`` pins and a
+    reload is a weight-only swap against an unchanged AOT menu."""
+    from paddle_tpu.online import (ModelPublisher, ReplayTailer,
+                                   ReplayWriter, ServeTrainLoop)
+    if not args.replay_dir:
+        raise SystemExit("--job=serve_train needs --replay_dir")
+    graph, _params, names, feeding, pk, ek = _serving_plan(ns, args)
+    del graph
+    trainer = _build_trainer(ns, args)
+    if not args.init_model_path and args.save_dir:
+        from paddle_tpu.dist.checkpoint import Checkpointer
+        restored = Checkpointer(args.save_dir).restore()
+        if restored:
+            trainer.load_state(restored[0], restored[1])
+    publish_dir = args.publish_dir or os.path.join(args.replay_dir,
+                                                   "published")
+    writer = ReplayWriter(args.replay_dir,
+                          segment_records=args.replay_segment_records,
+                          schema=list(feeding))
+    ek = dict(ek, replay_sink=writer)
+
+    def make_engine(model_path):
+        from paddle_tpu.serving import ServingEngine, ServingPredictor
+        pred = ServingPredictor.from_merged(model_path, feeding, **pk)
+        return ServingEngine(pred, **ek).start(warmup=True)
+
+    def build_transport(model_path, rid):
+        from paddle_tpu.serving import EngineTransport
+        return EngineTransport(make_engine(model_path))
+
+    publisher = ModelPublisher(
+        trainer, model_dir=publish_dir, outputs=names,
+        build_transport=build_transport,
+        every_batches=args.publish_every,
+        quantize=getattr(args, "quantize", None), feeding=feeding)
+    router = None
+    if start_fleet:
+        from paddle_tpu.serving import EngineTransport, ReplicaRouter
+        publisher.publish()  # v0: the fleet's starting artifact
+        transports = [EngineTransport(make_engine(publisher.last_good))
+                      for _ in range(max(1, args.replicas))]
+        router = ReplicaRouter(
+            transports,
+            spawn=lambda rid: EngineTransport(
+                make_engine(publisher.last_good)),
+            hedge_ms=(args.hedge_ms or None))
+        publisher.router = router
+
+    ck = None
+    if args.save_dir:
+        from paddle_tpu.dist.checkpoint import Checkpointer
+        ck = Checkpointer(
+            args.save_dir, saving_period=args.saving_period,
+            saving_period_by_batches=(args.saving_period_by_batches
+                                      or 20),
+            background=getattr(args, "background_save", True))
+    tailer = ReplayTailer(args.replay_dir,
+                          batch_rows=args.replay_batch_rows)
+    # the divergence sentry is armed BY DEFAULT in-loop: an unattended
+    # trainer fed by live traffic must not publish a poisoned update
+    # (skip_batch discards it in-graph; flags tighten/loosen as in
+    # --job=train)
+    health = {
+        "sentry": True,
+        "grad_threshold": getattr(args, "error_clipping_threshold", 0.0),
+        "policy": getattr(args, "divergence_policy", "skip_batch"),
+        "log_clipping": getattr(args, "log_error_clipping", False),
+        "log_path": getattr(args, "health_log", None),
+    }
+    loop = ServeTrainLoop(
+        trainer, tailer=tailer, publisher=publisher, feeder=_feeder(ns),
+        writer=writer, checkpointer=ck, health=health,
+        max_batches=(args.serve_train_batches or None),
+        log_period=args.log_period)
+    return loop, router, writer
+
+
+def cmd_serve_train(ns, args):
+    """``--job=serve_train``: one supervised process group closing
+    serving→training→publish→serving. The fleet serves (and its HTTP
+    frontend binds) while the main thread trains the replay stream; on
+    the batch budget (or SIGTERM) the stream closes, the reader drains,
+    and the trainer unwinds through its end-of-pass commit."""
+    import threading
+
+    from paddle_tpu.serving.router import (
+        install_router_signal_handlers, make_router_server)
+    loop, router, writer = build_serve_train_loop(ns, args)
+    router.start()
+    server = make_router_server(router, args.host, args.port)
+    install_router_signal_handlers(router, server)
+    print(f"serve_train: router on http://{args.host}:"
+          f"{server.server_address[1]}, publishing every "
+          f"{args.publish_every} batches", flush=True)
+    frontend = threading.Thread(target=server.serve_forever,
+                                kwargs={"poll_interval": 0.2},
+                                name="serve-train-frontend", daemon=True)
+    frontend.start()
+    try:
+        loop.run()
+    finally:
+        loop.stop()
+        server.shutdown()
+        server.server_close()
+        router.shutdown(drain=True)
+        writer.close()
+    print(f"serve_train: {loop.batches_trained} batches trained, "
+          f"{loop.publisher.publishes_total} publishes "
+          f"({loop.publisher.rollbacks_total} rollbacks)", flush=True)
+    return 0
+
+
 def cmd_serve(ns, args):
     if getattr(args, "replicas", 1) > 1:
         from paddle_tpu.serving import serve_router_forever
@@ -1090,8 +1247,8 @@ def main(argv=None):
     ns = load_config(args.config, args.config_args)
     return {"train": cmd_train, "test": cmd_test, "time": cmd_time,
             "checkgrad": cmd_checkgrad, "merge": cmd_merge,
-            "serve": cmd_serve,
-            "serve_fleet": cmd_serve_fleet}[args.job](ns, args)
+            "serve": cmd_serve, "serve_fleet": cmd_serve_fleet,
+            "serve_train": cmd_serve_train}[args.job](ns, args)
 
 
 if __name__ == "__main__":

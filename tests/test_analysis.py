@@ -1132,6 +1132,60 @@ def test_pt401_quant_artifact_requires_gate_evidence(tmp_path):
     assert len(set(data["quant_model_versions"].values())) == 3
 
 
+def test_pt401_serve_train_artifact_requires_learning_evidence(tmp_path):
+    """The r20 online-learning generation: a ``serve_train*`` metric
+    must carry the held-out error trajectory (one finite point per
+    published version), the zero-drop counter summed over every round,
+    and the publish/rollback ledger — an online loop that published
+    nothing, learned nothing, or dropped requests mid-swap is not
+    evidence."""
+    base = {"metric": "serve_train_loop", "platform": "cpu",
+            "serve_train_error_trajectory": [0.48, 0.41, 0.37],
+            "fleet_failed_non_shed": 0,
+            "publishes_total": 3, "rollbacks_total": 1}
+    good = tmp_path / "BENCH_st.json"
+    good.write_text(json.dumps(base))
+    assert check_bench_file(str(good), "BENCH_st.json") == []
+
+    # an empty trajectory, a missing drop counter, a bool counter
+    bad = dict(base)
+    bad["serve_train_error_trajectory"] = []
+    del bad["fleet_failed_non_shed"]
+    bad["publishes_total"] = True
+    badf = tmp_path / "BENCH_st_bad.json"
+    badf.write_text(json.dumps(bad))
+    fs = check_bench_file(str(badf), "BENCH_st_bad.json")
+    assert any("serve_train_error_trajectory" in f.message for f in fs)
+    assert any("fleet_failed_non_shed" in f.message for f in fs)
+    assert any("publishes_total" in f.message for f in fs)
+
+    # a NaN trajectory point is caught by the global finite-number
+    # walk (json.loads admits NaN literals)
+    nanf = tmp_path / "BENCH_st_nan.json"
+    nanf.write_text(json.dumps(base).replace("0.41", "NaN"))
+    fs = check_bench_file(str(nanf), "BENCH_st_nan.json")
+    assert any("non-finite" in f.message for f in fs)
+
+    # the serving_* prefixes do not capture serve_train and vice versa
+    other = tmp_path / "BENCH_sv.json"
+    other.write_text(json.dumps(
+        {"metric": "serving_dynamic_batching_ab", "platform": "cpu"}))
+    assert check_bench_file(str(other), "BENCH_sv.json") == []
+
+    # the committed r20 artifact itself carries the evidence: the
+    # held-out error falls across >= 2 published versions, the fleet
+    # dropped nothing, and at least one rollback drill is on record
+    import os as _os
+    root = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    r20 = _os.path.join(root, "BENCH_r20.json")
+    assert check_bench_file(r20, "BENCH_r20.json") == []
+    data = json.loads(open(r20).read())
+    traj = data["serve_train_error_trajectory"]
+    assert len(traj) >= 2 and traj[-1] < traj[0]
+    assert data["fleet_failed_non_shed"] == 0
+    assert data["publishes_total"] >= 2
+
+
 def test_pass4_overlap_spelling_budgets_identically():
     """The sync->async flip must budget IDENTICALLY: the overlap chain
     is an ``optimization_barrier`` spelling of the SAME gathers, so the

@@ -144,11 +144,14 @@ def _x_rows():
 
 
 def test_fsdp_row_is_machine_mapped():
-    """The TPU-native supplementary table: --fsdp (round 16) and
-    --quantize (round 19) are present, spelled, and parse through the
-    CLI (same drift-proof contract as the core and T-row audits)."""
+    """The TPU-native supplementary table: --fsdp (round 16),
+    --quantize (round 19) and the serve_train family (round 20) are
+    present, spelled, and parse through the CLI (same drift-proof
+    contract as the core and T-row audits)."""
     rows = _x_rows()
-    assert [name for _, name, _ in rows] == ["fsdp", "quantize"]
+    assert [name for _, name, _ in rows] == [
+        "fsdp", "quantize", "replay_dir", "publish_every",
+        "serve_train_batches"]
     assert all(st == "spelled" for _, _, st in rows)
     from paddle_tpu.trainer import cli
     args = cli.parse_args(["--config", "x.py", "--fsdp"])
@@ -158,6 +161,33 @@ def test_fsdp_row_is_machine_mapped():
                            "--quantize_tol", "0.05"])
     assert args.quantize == "int8"
     assert args.quantize_tol == pytest.approx(0.05)
+
+
+def test_serve_train_flags_are_machine_mapped():
+    """The round-20 online-loop flag family parses as one job surface:
+    the replay plumbing (dir / seal cadence / batch rows), the publish
+    cadence and dir, and the bench's loop bound — with the documented
+    defaults (publish_dir derives from replay_dir when unset)."""
+    from paddle_tpu.trainer import cli
+    args = cli.parse_args([
+        "--config", "x.py", "--job", "serve_train",
+        "--replay_dir", "/tmp/rp",
+        "--publish_every", "25",
+        "--replay_segment_records", "64",
+        "--replay_batch_rows", "32",
+        "--serve_train_batches", "100"])
+    assert args.job == "serve_train"
+    assert args.replay_dir == "/tmp/rp"
+    assert args.publish_dir == "/tmp/rp/published"  # derived default
+    assert args.publish_every == 25
+    assert args.replay_segment_records == 64
+    assert args.replay_batch_rows == 32
+    assert args.serve_train_batches == 100
+    # an explicit publish_dir wins over the derivation
+    args = cli.parse_args([
+        "--config", "x.py", "--job", "serve_train",
+        "--replay_dir", "/tmp/rp", "--publish_dir", "/tmp/pub"])
+    assert args.publish_dir == "/tmp/pub"
 
 
 def test_fsdp_reaches_the_trainer():

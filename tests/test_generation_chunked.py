@@ -18,9 +18,10 @@ import jax.numpy as jnp
 
 from paddle_tpu.core.generation import (DEFAULT_DECODE_CHUNK, _HOOK_NAMES,
                                         SequenceGenerator)
-from tests.test_generation_callbacks import (EOS, K, L, _boost_eos, _build,
-                                             _drop_token, _min_len_4,
-                                             _outer, _params,
+from paddle_tpu.kernels.dispatch import fused_rnn
+from tests.test_generation_callbacks import (EOS, H, K, L, V, _boost_eos,
+                                             _build, _drop_token,
+                                             _min_len_4, _outer, _params,
                                              _stop_after_2)
 
 # one matrix row per hook kind (+ the hookless row); norm_or_drop rides
@@ -179,3 +180,72 @@ def test_session_matches_dedicated_search_with_staggered_admission():
         assert np.array_equal(scores, ref[1][lane]), lane
         assert np.array_equal(lengths, ref[2][lane]), lane
         assert 0 < steps <= L
+
+
+def _build_cell_decoder(cell):
+    """Beam-search config whose step net runs a real recurrent cell —
+    the no-grad decode loop the fused inference cells serve."""
+    from paddle_tpu.config import dsl
+    dsl.reset()
+    src = dsl.data("src", size=H)
+    boot = dsl.fc(src, size=H, act="tanh", name="boot", bias_attr=False)
+
+    if cell == "gru":
+        def step(prev_emb):
+            m = dsl.memory(name="g", size=H, boot_layer=boot)
+            x = dsl.fc(prev_emb, size=3 * H, act="linear", name="xg",
+                       bias_attr=False)
+            g = dsl.gru_step_layer(x, m, name="g")
+            return dsl.fc(g, size=V, act="softmax", name="prob",
+                          bias_attr=False)
+    else:
+        def step(prev_emb):
+            out_m = dsl.memory(name="h", size=H, boot_layer=boot)
+            c_m = dsl.memory(name="cst", size=H)
+            gates = dsl.fc([prev_emb, out_m], size=4 * H, act="linear",
+                           name="gates", bias_attr=False)
+            h = dsl.lstm_step_layer(gates, c_m, name="h")
+            dsl.get_output_layer(h, arg_name="state", size=H, name="cst")
+            return dsl.fc(h, size=V, act="softmax", name="prob",
+                          bias_attr=False)
+
+    dsl.beam_search(
+        step,
+        [dsl.GeneratedInput(size=V, embedding_name="gen_emb",
+                            embedding_size=4)],
+        bos_id=0, eos_id=EOS, beam_size=K, max_length=L, name="gen")
+    return dsl.current_graph()
+
+
+@pytest.mark.parametrize("cell", ["gru", "lstm"])
+def test_fused_infer_cells_bitwise_and_distinct_program(cell):
+    """The generation-matrix fused-RNN row: the no-grad decode loop
+    routes through ``lstm_cell_infer``/``gru_cell_infer`` when the
+    fused switch is on, and (a) the toggle is BITWISE-invisible off-TPU
+    — the fallback spelling is the step's inline math verbatim (the
+    three-spelling contract, ``docs/kernels.md``) — while (b) each flag
+    state is its own compiled program: the switch resolves at trace
+    time inside the step net, so ``_jit_for`` folds it into the compile
+    key (a stale hit would silently serve the wrong spelling after a
+    toggle)."""
+    graph = _build_cell_decoder(cell)
+    net, params = _params(graph)
+    outer = _outer(net, params, B=3)
+    gen = SequenceGenerator(graph, "gen")
+
+    base = [np.asarray(x) for x in gen.generate(params, outer,
+                                                beam_size=K)]
+    n0 = len(gen._jitted)
+    with fused_rnn(True):
+        fused = [np.asarray(x) for x in gen.generate(params, outer,
+                                                     beam_size=K)]
+    # distinct program identity per flag state, same everything else
+    assert len(gen._jitted) == n0 + 1
+    for name, a, b in zip(("tokens", "scores", "lengths"), base, fused):
+        assert np.array_equal(a, b), (cell, name)
+    # toggling back reuses the original entry — no third compile
+    again = [np.asarray(x) for x in gen.generate(params, outer,
+                                                 beam_size=K)]
+    assert len(gen._jitted) == n0 + 1
+    for name, a, b in zip(("tokens", "scores", "lengths"), base, again):
+        assert np.array_equal(a, b), (cell, name)

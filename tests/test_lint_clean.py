@@ -44,7 +44,11 @@ def test_pass3_lock_order_clean_and_covers_threaded_modules():
                 "paddle_tpu/obs/flight.py",
                 "paddle_tpu/obs/registry.py",
                 "paddle_tpu/obs/events.py",
-                "paddle_tpu/obs/health.py"):
+                "paddle_tpu/obs/health.py",
+                "paddle_tpu/online/replay.py",
+                "paddle_tpu/online/tailer.py",
+                "paddle_tpu/online/publish.py",
+                "paddle_tpu/online/loop.py"):
         assert mod in checker.modules
     # the analysis is not vacuous: it found the repo's locks (incl. the
     # replica router's state lock, RouterMetrics, the r14 replica
@@ -79,6 +83,21 @@ def test_pass3_lock_order_clean_and_covers_threaded_modules():
     assert not any(".obs." in str(a) or ".obs." in str(b)
                    for a, b in checker.edges), (
         "obs locks must stay edge-free (append/snapshot only)")
+    # r20 online-loop pins: the replay writer's append lock is the
+    # subsystem's ONLY lock (tailer scanner + publisher are lock-free
+    # over the master's RLock / GIL-atomic state), and the chaos hit
+    # firing under it is the one edge it may grow — the same
+    # master->chaos precedent, needed so a seeded fault can lose the
+    # row it targets instead of a neighboring one.
+    online_locks = sorted(l for l in checker.locks
+                          if ".online." in str(l))
+    assert online_locks == [
+        "paddle_tpu.online.replay.ReplayWriter._lock"]
+    for a, b in checker.edges:
+        if ".online." in str(a) or ".online." in str(b):
+            assert (str(a), str(b)) == (
+                "paddle_tpu.online.replay.ReplayWriter._lock",
+                "paddle_tpu.testing.chaos.FaultPlan._lock"), (a, b)
 
 
 def test_bench_schema_clean():

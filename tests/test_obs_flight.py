@@ -37,6 +37,9 @@ SITE_CASES = {
     "supervisor_spawn": {"replica": "r0", "why": "start"},
     "lease_renew": {"holder": "A", "role": "active"},
     "router_failover": {"holder": "B", "epoch": 2},
+    "replay_append": {"segment": 0, "records": 3},
+    "replay_tail": {"segment": "replay-00000000.ptrl"},
+    "publish": {"version": "0123456789ab", "path": "model-v0001.ptmodel"},
 }
 
 

@@ -1,0 +1,367 @@
+"""The online-loop matrix (ISSUE 20 satellite S3): serving traffic
+replayed into the sparse CTR trainer with cadence publishing, under
+fault injection.
+
+Closure-enforced cells — {dense, sparse_lazy} updater × {clean, killed,
+poison} fault, across ≥2 publish cadences (``test_matrix_closure``
+pins the product stays covered). Every cell drives the REAL loop
+object (``online.loop.ServeTrainLoop``) over a REAL replay directory
+in drain mode: traffic is pre-sealed, the stream closes up front, and
+the reader drains through the ledger exactly-once. What each fault
+asserts:
+
+- **clean**: held-out CTR error FALLS across the stream; the publisher
+  lands every cadence artifact with distinct digests.
+- **killed**: a chaos kill mid-loop (the in-process SIGKILL stand-in),
+  then a rebuilt loop over the same directories resumes exactly-once —
+  final params/optimizer/RNG are BITWISE the never-killed twin run over
+  a pristine copy of the same replay log (double-trained or dropped
+  batches cannot hide from bitwise).
+- **poison**: a NaN-poisoned gradient mid-stream trips the divergence
+  sentry, the batch's update is skipped, training completes, and every
+  published artifact holds all-finite parameters — ZERO bad publishes.
+
+Publisher edges that need no trainer stream get unit cells below:
+stub-router rollback bookkeeping (``ReloadRejected`` → incumbent
+stays), and the ``publish`` chaos site corrupting an artifact into an
+MD5 integrity failure.
+"""
+
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+import jax
+
+from paddle_tpu.config import dsl
+from paddle_tpu.config.model_config import ParamAttr
+from paddle_tpu.data import (DataFeeder, integer_value,
+                             integer_value_sequence)
+from paddle_tpu.dist.checkpoint import Checkpointer
+from paddle_tpu.online.loop import ServeTrainLoop
+from paddle_tpu.online.publish import ModelPublisher
+from paddle_tpu.online.replay import ReplayWriter
+from paddle_tpu.online.tailer import ReplayTailer
+from paddle_tpu.optim import Momentum
+from paddle_tpu.serving.errors import ReloadRejected
+from paddle_tpu.testing.chaos import ChaosKilled, FaultPlan, chaos_plan
+from paddle_tpu.trainer import SGD
+from paddle_tpu.trainer import events as tev
+from paddle_tpu.trainer.merge_model import load_merged_ex
+
+V, EMB, HID, MAX_LEN = 30, 8, 8, 16
+MARKER = 2                      # the learnable signal token
+N_ROWS, SEG_RECORDS, BATCH_ROWS = 120, 20, 10
+N_BATCHES = N_ROWS // BATCH_ROWS        # 12
+N_HELD = 60
+KILL_AT, CK_CADENCE = 7, 2
+POISON_AT = 5
+
+# cell -> {updater, fault, cadence}. The closure below keeps the
+# updater × fault product full and the cadence axis ≥2-valued.
+MATRIX = {
+    "dense_clean": {"updater": "dense", "fault": "clean", "cadence": 4},
+    "dense_killed": {"updater": "dense", "fault": "killed", "cadence": 4},
+    "dense_poison": {"updater": "dense", "fault": "poison", "cadence": 5},
+    "sparse_clean": {"updater": "sparse_lazy", "fault": "clean",
+                     "cadence": 5},
+    "sparse_killed": {"updater": "sparse_lazy", "fault": "killed",
+                      "cadence": 4},
+    "sparse_poison": {"updater": "sparse_lazy", "fault": "poison",
+                      "cadence": 4},
+}
+
+
+def test_matrix_closure():
+    pairs = {(c["updater"], c["fault"]) for c in MATRIX.values()}
+    want = {(u, f) for u in ("dense", "sparse_lazy")
+            for f in ("clean", "killed", "poison")}
+    missing = want - pairs
+    assert not missing, f"online matrix lost coverage for {missing}"
+    assert len({c["cadence"] for c in MATRIX.values()}) >= 2, \
+        "need at least two publish cadences in the matrix"
+
+
+# ------------------------------------------------------------ fixtures
+def _build(updater, seed=0):
+    """The quick_start CTR shape (models/ctr.py) at test size. The
+    embedding table is ALWAYS sparse_grad (the engine's embedding
+    default); the updater axis is the OPTIMIZER's path selector —
+    nesterov Momentum has no closed-form row catch-up so it takes the
+    dense path on the same table, plain Momentum the lazy
+    touched-rows one (optim/optimizers.py:_is_sparse)."""
+    sparse = updater == "sparse_lazy"
+    dsl.reset()
+    words = dsl.data(name="words", size=V, is_sequence=True)
+    label = dsl.data(name="label", size=2)
+    emb = dsl.embedding(input=words, size=EMB, vocab_size=V, name="embed",
+                        param_attr=ParamAttr(sparse_grad=True))
+    pooled = dsl.pooling(input=emb, pooling_type="average", name="avg_pool")
+    h = dsl.fc(input=pooled, size=HID, act="relu", name="hidden")
+    out = dsl.fc(input=h, size=2, act="softmax", name="output")
+    cost = dsl.classification_cost(input=out, label=label, name="cost")
+    tr = SGD(cost=cost,
+             update_equation=Momentum(learning_rate=0.1, momentum=0.9,
+                                      nesterov=not sparse), seed=seed)
+    assert tr.meta["_embed.w0"].sparse_grad
+    assert ("t_rows" in tr.opt_state["slots"]["_embed.w0"]) is sparse, \
+        f"{updater} cell took the wrong optimizer path"
+    return tr
+
+
+def _feeder():
+    return DataFeeder({"words": integer_value_sequence(V),
+                       "label": integer_value(2)}, pad_multiple=MAX_LEN)
+
+
+def _make_rows(n, seed):
+    """Learnable CTR traffic: label = presence of the MARKER token
+    (positives carry it ~30% of positions, so average pooling sees it
+    through the padding)."""
+    rng = np.random.RandomState(seed)
+    rows = []
+    for _ in range(n):
+        length = int(rng.randint(5, MAX_LEN + 1))
+        ids = rng.randint(3, V, size=length)
+        label = int(rng.rand() < 0.5)
+        if label:
+            k = max(1, length // 3)
+            ids[rng.choice(length, size=k, replace=False)] = MARKER
+        rows.append(([int(i) for i in ids], label))
+    return rows
+
+
+def _seed_replay(replay_dir, rows):
+    w = ReplayWriter(replay_dir, segment_records=SEG_RECORDS,
+                     schema=["words", "label"])
+    for r in rows:
+        w.append(r)
+    w.seal()
+
+
+def _held_reader(rows):
+    def r():
+        for i in range(0, len(rows), BATCH_ROWS):
+            yield rows[i:i + BATCH_ROWS]
+    return r
+
+
+def _heldout_error(tr, held, feeder):
+    res = tr.test(_held_reader(held), feeder=feeder)
+    return float(res.evaluator.get("classification_error"))
+
+
+def _make_loop(tr, replay_dir, model_dir, cadence, *, ck_dir=None,
+               health=None):
+    tailer = ReplayTailer(replay_dir, batch_rows=BATCH_ROWS, poll_s=0.01)
+    pub = ModelPublisher(tr, model_dir=model_dir, outputs=["output"],
+                         every_batches=cadence)
+    ck = None
+    if ck_dir is not None:
+        ck = Checkpointer(str(ck_dir), saving_period=1,
+                          saving_period_by_batches=CK_CADENCE,
+                          background=True)
+    loop = ServeTrainLoop(tr, tailer=tailer, publisher=pub,
+                          feeder=_feeder(), checkpointer=ck, health=health)
+    # drain mode: all traffic pre-sealed — close the stream up front so
+    # the reader drains to "end" instead of waiting on a live tail
+    tailer.end_stream()
+    return loop, pub, ck
+
+
+def _final_state(tr):
+    params = {k: np.asarray(jax.device_get(v))
+              for k, v in tr._params_for_save().items()}
+    from paddle_tpu.trainer.checkpoint import _flatten
+    opt = _flatten(tr._opt_state_for_save())
+    return params, opt, np.asarray(jax.device_get(tr._rng))
+
+
+def _assert_bitwise(got, want, cell):
+    for g, w, what in zip(got, want, ("param", "opt", "rng")):
+        if what == "rng":
+            np.testing.assert_array_equal(g, w, err_msg=f"rng ({cell})")
+            continue
+        assert set(g) == set(w)
+        for k in w:
+            np.testing.assert_array_equal(g[k], w[k],
+                                          err_msg=f"{what} {k} ({cell})")
+
+
+# ------------------------------------------------------------- matrix
+@pytest.mark.chaos
+@pytest.mark.parametrize("cell", sorted(MATRIX), ids=sorted(MATRIX))
+def test_online_loop_matrix(cell, tmp_path):
+    cfg = MATRIX[cell]
+    cadence = cfg["cadence"]
+    rows = _make_rows(N_ROWS, seed=7)
+    held = _make_rows(N_HELD, seed=8)
+    replay = str(tmp_path / "replay")
+    _seed_replay(replay, rows)
+
+    if cfg["fault"] == "killed":
+        # twin directories BEFORE any tailer exists (the tailer's
+        # construction writes the ledger snapshot into the replay dir)
+        twin = str(tmp_path / "replay_twin")
+        shutil.copytree(replay, twin)
+
+        # ---- the run that never dies, over the pristine copy
+        clean_tr = _build(cfg["updater"])
+        loop_c, _, _ = _make_loop(clean_tr, twin, str(tmp_path / "m_twin"),
+                                  cadence, ck_dir=tmp_path / "ck_twin")
+        loop_c.run()
+        assert loop_c.batches_trained == N_BATCHES
+        want = _final_state(clean_tr)
+
+        # ---- the run that dies mid-stream...
+        plan = FaultPlan(seed=0, faults=[
+            {"type": "kill", "site": "step_done", "at": KILL_AT,
+             "mode": "raise"}])
+        tr_a = _build(cfg["updater"])
+        loop_a, _, ck_a = _make_loop(tr_a, replay, str(tmp_path / "m"),
+                                     cadence, ck_dir=tmp_path / "ck")
+        with chaos_plan(plan):
+            with pytest.raises(ChaosKilled):
+                loop_a.run()
+        assert plan.hits("step_done") == KILL_AT
+        ck_a.flush()
+
+        # ---- ...and a REBUILT loop over the same directories resumes
+        tr_b = _build(cfg["updater"])
+        loop_b, _, _ = _make_loop(tr_b, replay, str(tmp_path / "m"),
+                                  cadence, ck_dir=tmp_path / "ck")
+        begins = []
+        inner = loop_b._handle
+
+        def spy(event):
+            if isinstance(event, tev.BeginIteration):
+                begins.append((event.pass_id, event.batch_id))
+            inner(event)
+
+        loop_b._handle = spy
+        loop_b.run()
+        # it resumed MID-STREAM from the batch-cadence checkpoint (the
+        # kill landed past the batch-6 save), not from a fresh pass
+        assert begins[0] == (0, KILL_AT - 1 - (KILL_AT - 1) % CK_CADENCE)
+        # exactly-once: bitwise the never-killed twin — a replayed or
+        # dropped batch cannot produce identical params+opt+rng
+        _assert_bitwise(_final_state(tr_b), want, cell)
+        return
+
+    tr = _build(cfg["updater"])
+    feeder = _feeder()
+    err_before = _heldout_error(tr, held, feeder)
+
+    if cfg["fault"] == "poison":
+        health = {"period": 1, "sentry": True, "policy": "skip_batch"}
+        plan = FaultPlan(seed=0, faults=[
+            {"type": "corrupt", "site": "step_stats", "at": POISON_AT}])
+        loop, pub, _ = _make_loop(tr, replay, str(tmp_path / "m"), cadence,
+                                  health=health)
+        with chaos_plan(plan):
+            loop.run()
+        snap = tr._health.snapshot()
+        assert snap["sentry_trips"] == 1
+        assert snap["skipped_batches"] == 1
+    else:
+        loop, pub, _ = _make_loop(tr, replay, str(tmp_path / "m"), cadence)
+        loop.run()
+
+    # the full stream trained (a skipped batch still iterates)
+    assert loop.batches_trained == N_BATCHES
+    # the publisher landed every cadence artifact, each a distinct model
+    assert pub.publishes_total == N_BATCHES // cadence >= 2
+    assert len(set(pub.versions)) == pub.publishes_total
+    assert pub.last_good is not None and os.path.exists(pub.last_good)
+
+    # ZERO bad publishes: every artifact on disk decodes (MD5 holds)
+    # with all-finite parameters — the sentry kept the poison out
+    arts = sorted(p for p in os.listdir(tmp_path / "m")
+                  if p.endswith(".ptmodel"))
+    assert len(arts) == pub.publishes_total
+    for a in arts:
+        _, params, _, _ = load_merged_ex(str(tmp_path / "m" / a))
+        for k, v in params.items():
+            assert np.isfinite(v).all(), (a, k)
+
+    # the loop LEARNED the stream: held-out CTR error falls
+    err_after = _heldout_error(tr, held, feeder)
+    assert err_after < err_before, (err_before, err_after)
+
+
+# ---------------------------------------------------- publisher units
+class _StubRouter:
+    """rolling_reload's surface, scripted: fail exactly when told."""
+
+    def __init__(self):
+        self.fail_next = False
+        self.reloads = []
+
+    def rolling_reload(self, build, fallback_build=None):
+        self.reloads.append((build, fallback_build))
+        if self.fail_next:
+            self.fail_next = False
+            raise ReloadRejected("warmup gate refused READY")
+        build("replica-0")
+
+
+def test_publisher_rollback_keeps_incumbent(tmp_path):
+    tr = _build("dense")
+    router = _StubRouter()
+    built = []
+    pub = ModelPublisher(
+        tr, model_dir=str(tmp_path), outputs=["output"], router=router,
+        build_transport=lambda path, rid: built.append((path, rid)),
+        every_batches=1)
+
+    r0 = pub.publish()
+    assert r0.ok and pub.publishes_total == 1
+    incumbent = pub.last_good
+
+    router.fail_next = True
+    r1 = pub.publish()
+    # typed refusal: counted as a rollback, incumbent stays last_good,
+    # the version history does NOT advance
+    assert not r1.ok and r1.version is None
+    assert pub.rollbacks_total == 1 and pub.publishes_total == 1
+    assert pub.last_good == incumbent
+    # the fallback the router got really rebuilds the incumbent
+    _, fallback = router.reloads[-1]
+    fallback("replica-0")
+    assert built[-1] == (incumbent, "replica-0")
+
+    r2 = pub.publish()
+    # the next cadence retries with newer weights and advances
+    assert r2.ok and pub.publishes_total == 2
+    assert pub.last_good == r2.path != incumbent
+    assert len(pub.versions) == 2
+
+
+def test_fleet_publisher_requires_build_transport(tmp_path):
+    tr = _build("dense")
+    with pytest.raises(ValueError):
+        ModelPublisher(tr, model_dir=str(tmp_path), outputs=["output"],
+                       router=_StubRouter())
+
+
+@pytest.mark.chaos
+def test_chaos_publish_corrupt_fails_artifact_integrity(tmp_path):
+    """The `publish` chaos site flips a byte AFTER the artifact lands:
+    the PTM1 payload MD5 no longer verifies, which is exactly the error
+    a reload build surfaces (→ ReloadRejected → rollback)."""
+    tr = _build("dense")
+    pub = ModelPublisher(tr, model_dir=str(tmp_path), outputs=["output"],
+                         every_batches=1)
+    plan = FaultPlan(seed=0, faults=[
+        {"type": "corrupt", "site": "publish", "at": 1}])
+    with chaos_plan(plan):
+        res = pub.publish()
+    assert plan.hits("publish") == 1
+    with pytest.raises(IOError, match="MD5 integrity"):
+        load_merged_ex(res.path)
+    # the next publish (chaos quiet) is intact again
+    res2 = pub.publish()
+    _, params, _, _ = load_merged_ex(res2.path)
+    assert params

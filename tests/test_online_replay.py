@@ -1,0 +1,315 @@
+"""Replay-log format + streaming-master units (the r20 online loop's
+serving→training edge, `paddle_tpu/online/`):
+
+- PTRL1 segments: append/seal round trip, whole-file validation (any
+  torn byte fails the WHOLE segment, never a partial batch), quarantine
+  + skip, orphaned-tail recovery after a writer crash.
+- Chaos sites ``replay_append`` / ``replay_tail``: a dropped append is
+  a row that never reaches the log; a corrupted record/segment drives
+  the quarantine path deterministically.
+- The master's streaming pass: ``extend_dataset`` over an open stream
+  dedupes by chunk value, ``get_task`` answers "wait" (not a pass roll)
+  while the stream is open, and the stream flag + grown task list
+  survive a FileStore recovery.
+- The tailer end to end: sealed segments -> ledger tasks -> re-batched
+  rows, exactly-once committed.
+"""
+
+import json
+import os
+import zlib
+
+import pytest
+
+from paddle_tpu.dist.master import FileStore, MasterService
+from paddle_tpu.online.replay import (MAGIC, ReplayCorrupt, ReplayWriter,
+                                      load_segment, parse_segment,
+                                      quarantine, scan_segments,
+                                      segment_name)
+from paddle_tpu.online.tailer import ReplayTailer
+from paddle_tpu.testing.chaos import ChaosDropped, FaultPlan, chaos_plan
+
+
+def _rows(n, start=0):
+    return [[[start + i, start + i + 1], (start + i) % 2]
+            for i in range(n)]
+
+
+# ------------------------------------------------------------ format
+def test_append_seal_roundtrip_and_scan(tmp_path):
+    w = ReplayWriter(str(tmp_path), segment_records=3,
+                     schema=["words", "label"])
+    for r in _rows(7):
+        w.append(r)
+    # 7 rows at 3/segment: two sealed, one open tail of 1
+    assert w.segments_sealed == 2 and w.records_total == 7
+    sealed = scan_segments(str(tmp_path))
+    assert [os.path.basename(p) for p in sealed] == [
+        segment_name(0), segment_name(1)]
+    hdr, rows = parse_segment(sealed[0])
+    assert hdr["schema"] == ["words", "label"] and hdr["seq"] == 0
+    assert rows == _rows(3)
+    _, rows1 = parse_segment(sealed[1])
+    assert rows1 == _rows(3, start=3)
+    # the open tail is invisible until sealed
+    w.seal()
+    sealed = scan_segments(str(tmp_path))
+    assert len(sealed) == 3
+    _, rows2 = parse_segment(sealed[2])
+    assert rows2 == _rows(1, start=6)
+    # sealing with nothing open is a no-op, not an empty segment
+    w.seal()
+    assert len(scan_segments(str(tmp_path))) == 3
+
+
+def test_whole_segment_validation_never_partial(tmp_path):
+    w = ReplayWriter(str(tmp_path), segment_records=4)
+    for r in _rows(4):
+        w.append(r)
+    (path,) = scan_segments(str(tmp_path))
+    raw = open(path, "rb").read()
+
+    # flip a byte in the LAST record's payload: the earlier, intact
+    # records must NOT surface — all-or-nothing
+    torn = bytearray(raw)
+    torn[-2] ^= 0xFF
+    open(path, "wb").write(bytes(torn))
+    with pytest.raises(ReplayCorrupt, match="CRC"):
+        parse_segment(path)
+
+    # truncation mid-record: torn, not partial
+    open(path, "wb").write(raw[:-3])
+    with pytest.raises(ReplayCorrupt, match="torn record"):
+        parse_segment(path)
+
+    # bad magic
+    open(path, "wb").write(b"NOPE" + raw[4:])
+    with pytest.raises(ReplayCorrupt, match="magic"):
+        parse_segment(path)
+
+    # intact round trip still parses (control)
+    open(path, "wb").write(raw)
+    _, rows = parse_segment(path)
+    assert rows == _rows(4)
+
+
+def test_load_segment_quarantines_and_skips(tmp_path):
+    w = ReplayWriter(str(tmp_path), segment_records=2)
+    for r in _rows(2):
+        w.append(r)
+    (path,) = scan_segments(str(tmp_path))
+    raw = bytearray(open(path, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    open(path, "wb").write(bytes(raw))
+    # corruption answers quarantine + NO rows, never an exception
+    assert load_segment(path) == []
+    assert not os.path.exists(path)
+    assert os.path.exists(path + ".bad")
+    # the quarantined name is invisible to the scanner forever
+    assert scan_segments(str(tmp_path)) == []
+    # a later redispatch of the same task finds the file gone: same
+    # skip outcome, no crash
+    assert load_segment(path) == []
+
+
+def test_orphaned_open_tail_recovery(tmp_path):
+    w1 = ReplayWriter(str(tmp_path), segment_records=10)
+    for r in _rows(4):
+        w1.append(r)
+    # crash: the writer dies without seal() — the .open tail remains
+    w1._file.flush()
+    open_name = segment_name(0, sealed=False)
+    assert os.path.exists(tmp_path / open_name)
+
+    w2 = ReplayWriter(str(tmp_path), segment_records=10)
+    # the unsealed tail was orphaned (at-most-once before the seal
+    # boundary), numbering continues past every name ever used
+    assert os.path.exists(str(tmp_path / open_name) + ".orphan")
+    assert not os.path.exists(tmp_path / open_name)
+    w2.append(_rows(1)[0])
+    w2.seal()
+    assert [os.path.basename(p)
+            for p in scan_segments(str(tmp_path))] == [segment_name(1)]
+
+
+# ------------------------------------------------------- chaos sites
+@pytest.mark.chaos
+def test_chaos_replay_append_drop_loses_exactly_that_row(tmp_path):
+    w = ReplayWriter(str(tmp_path), segment_records=3)
+    plan = FaultPlan(seed=0, faults=[
+        {"type": "drop", "site": "replay_append", "at": 2}])
+    with chaos_plan(plan):
+        w.append(_rows(1)[0])
+        with pytest.raises(ChaosDropped):
+            w.append([[99, 99], 1])  # the dropped append
+        w.append(_rows(1, start=5)[0])
+        w.append(_rows(1, start=6)[0])
+    assert plan.hits("replay_append") == 4
+    (path,) = scan_segments(str(tmp_path))
+    _, rows = parse_segment(path)
+    # the dropped row is NOT in the log; its neighbors are
+    assert rows == [_rows(1)[0], _rows(1, start=5)[0],
+                    _rows(1, start=6)[0]]
+    # ChaosDropped subclasses ConnectionError: the engine's replay-sink
+    # handler catches it as OSError and counts replay_dropped_total
+    assert issubclass(ChaosDropped, OSError)
+
+
+@pytest.mark.chaos
+def test_chaos_replay_append_corrupt_drives_quarantine(tmp_path):
+    w = ReplayWriter(str(tmp_path), segment_records=2)
+    plan = FaultPlan(seed=0, faults=[
+        {"type": "corrupt", "site": "replay_append", "at": 1}])
+    with chaos_plan(plan):
+        for r in _rows(2):
+            w.append(r)
+    (path,) = scan_segments(str(tmp_path))
+    # the sealed segment carries the flipped record: tail-time
+    # validation quarantines the whole segment, no torn batch
+    assert load_segment(path) == []
+    assert os.path.exists(path + ".bad")
+
+
+@pytest.mark.chaos
+def test_chaos_replay_tail_corrupt_drives_quarantine(tmp_path):
+    w = ReplayWriter(str(tmp_path), segment_records=2)
+    for r in _rows(2):
+        w.append(r)
+    (path,) = scan_segments(str(tmp_path))
+    plan = FaultPlan(seed=0, faults=[
+        {"type": "corrupt", "site": "replay_tail", "at": 1}])
+    with chaos_plan(plan):
+        assert load_segment(path) == []
+    assert plan.hits("replay_tail") == 1
+    assert os.path.exists(path + ".bad")
+
+
+# ------------------------------------------------- streaming master
+def test_stream_wait_extend_dedupe_and_end(tmp_path):
+    m = MasterService(store=FileStore(str(tmp_path / "ledger.snap")),
+                      chunks_per_task=1, straggle_after_s=None)
+    m.open_stream()
+    # an open stream with nothing queued answers "wait", never "end"
+    status, task = m.get_task(0, "t0")
+    assert status == "wait" and task is None
+    assert m.extend_dataset(["seg-a", "seg-b"]) == 2
+    # dedupe is by chunk VALUE: re-scanning the same files adds nothing
+    assert m.extend_dataset(["seg-a", "seg-b"]) == 0
+    assert m.extend_dataset(["seg-b", "seg-c"]) == 1
+    served = []
+    for _ in range(3):
+        status, t = m.get_task(0, "t0")
+        assert status == "task"
+        served.append(t["chunks"][0])
+        m.task_finished(t["id"], "t0")
+    assert served == ["seg-a", "seg-b", "seg-c"]
+    # drained but stream open: "wait" (the tail may still grow)...
+    status, _ = m.get_task(0, "t0")
+    assert status == "wait"
+    # ...and the task ids never collide across extends
+    assert m.extend_dataset(["seg-d"]) == 1
+    status, t = m.get_task(0, "t0")
+    assert status == "task" and t["chunks"] == ["seg-d"]
+    m.task_finished(t["id"], "t0")
+    m.end_stream()
+    # stream closed + everything done: the pass ends normally
+    status, _ = m.get_task(0, "t0")
+    assert status == "end"
+
+
+def test_stream_flag_and_tasks_survive_recovery(tmp_path):
+    snap = str(tmp_path / "ledger.snap")
+    m1 = MasterService(store=FileStore(snap), chunks_per_task=1,
+                       straggle_after_s=None)
+    m1.open_stream()
+    m1.extend_dataset(["seg-a", "seg-b"])
+    status, t = m1.get_task(0, "t0")
+    assert status == "task"
+    m1.task_finished(t["id"], "t0")
+
+    # a recovered master (same snapshot) still holds the open stream:
+    # a drained queue answers "wait", and extend dedupes against the
+    # recovered done/todo sets
+    m2 = MasterService(store=FileStore(snap), chunks_per_task=1,
+                       straggle_after_s=None)
+    assert m2.extend_dataset(["seg-a", "seg-b"]) == 0
+    status, t2 = m2.get_task(0, "t0")
+    assert status == "task" and t2["chunks"] == ["seg-b"]
+    m2.task_finished(t2["id"], "t0")
+    assert m2.get_task(0, "t0")[0] == "wait"
+    m2.end_stream()
+    assert m2.get_task(0, "t0")[0] == "end"
+
+    # the CLOSED flag also survives recovery
+    m3 = MasterService(store=FileStore(snap), chunks_per_task=1,
+                       straggle_after_s=None)
+    assert m3.get_task(0, "t0")[0] == "end"
+
+
+def test_extend_requires_open_stream(tmp_path):
+    m = MasterService(store=FileStore(str(tmp_path / "s.snap")),
+                      chunks_per_task=1, straggle_after_s=None)
+    with pytest.raises(RuntimeError):
+        m.extend_dataset(["seg-a"])
+
+
+# ------------------------------------------------------- the tailer
+def test_tailer_end_to_end_exactly_once(tmp_path):
+    replay = tmp_path / "replay"
+    w = ReplayWriter(str(replay), segment_records=4)
+    for r in _rows(8):
+        w.append(r)
+
+    tailer = ReplayTailer(str(replay), batch_rows=2, scan_period_s=0.05,
+                          poll_s=0.01)
+    tailer.start()
+    tailer.end_stream()  # drain mode: all traffic pre-sealed
+    batches = list(tailer.reader())
+    tailer.close()
+    # 8 rows, 2 segments, re-batched at 2 rows/batch, in order
+    assert batches == [_rows(2), _rows(2, start=2),
+                       _rows(2, start=4), _rows(2, start=6)]
+    # every segment committed exactly once: a second pass call over the
+    # same (closed, fully-consumed) stream ends immediately
+    assert list(tailer.reader(0)) == []
+
+
+def test_tailer_quarantined_segment_skips_not_fails(tmp_path):
+    replay = tmp_path / "replay"
+    w = ReplayWriter(str(replay), segment_records=2)
+    for r in _rows(6):
+        w.append(r)
+    a, b, c = scan_segments(str(replay))
+    raw = bytearray(open(b, "rb").read())
+    raw[len(raw) - 2] ^= 0xFF
+    open(b, "wb").write(bytes(raw))
+
+    tailer = ReplayTailer(str(replay), batch_rows=2, poll_s=0.01)
+    tailer.start()
+    tailer.end_stream()
+    batches = list(tailer.reader())
+    tailer.close()
+    # the corrupt middle segment contributed NOTHING (its task
+    # completed empty after quarantine); neighbors trained in full
+    assert batches == [_rows(2), _rows(2, start=4)]
+    assert os.path.exists(str(b) + ".bad")
+
+
+def test_tailer_start_tolerates_preclosed_stream(tmp_path):
+    replay = tmp_path / "replay"
+    w = ReplayWriter(str(replay), segment_records=2)
+    for r in _rows(2):
+        w.append(r)
+    t1 = ReplayTailer(str(replay), batch_rows=2, poll_s=0.01)
+    t1.start()
+    t1.end_stream()
+    assert list(t1.reader()) == [_rows(2)]
+    t1.close()
+    # a rebuilt tailer over the same (fully-consumed) directory:
+    # __init__ reopens the stream; closing it again and starting must
+    # not raise even though extend has nothing fresh
+    t2 = ReplayTailer(str(replay), batch_rows=2, poll_s=0.01)
+    t2.end_stream()
+    t2.start()
+    assert list(t2.reader(0)) == []
+    t2.close()

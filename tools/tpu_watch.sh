@@ -18,8 +18,11 @@
 # capture window records them all for free: input_pipeline, zero1,
 # pipeline, serving, decode, (r13) fleet — the AOT cold-start A/B,
 # which on a real chip measures the tunnel's multi-minute XLA compiles
-# against a millisecond cache deserialize — and (r19) quant: the
+# against a millisecond cache deserialize — (r19) quant: the
 # fp32/bf16/int8 serving three-way with the warmup accuracy gate
+# asserted in-bench — and (r20) serve_train: the closed online loop
+# (fleet under open-loop load, replay-tailed training, rolling
+# publishes) with the error trajectory and zero-recompile guards
 # asserted in-bench.
 #
 # Usage: bash tools/tpu_watch.sh [round_tag]   (default r04)
