@@ -40,6 +40,15 @@ looser schema):
   ``{"programs": {name: {field: int >= 0, ...}, ...}}`` with a
   non-empty programs map — a malformed snapshot is a finding, not a
   silently unplottable file.
+- ``WORKLOAD_*`` (committed request traces, the ``bench.py
+  --autotune`` record / ``tests/test_workload_replay.py`` replay pair):
+  ``{"workload": str, "version": 1, "n_events": int, "duration_s":
+  num >= 0, "events": [...]}`` with a NON-EMPTY events list whose
+  length matches ``n_events``, every event carrying the full replay
+  key set (``serving/workload.py:EVENT_KEYS``), a ``kind`` in
+  {score, generate}, and numeric ``t >= 0`` in monotone non-decreasing
+  order — a trace that cannot be re-offered at its recorded offsets
+  tunes nothing.
 - ``BENCH_*`` (shape-sniffed among its real generations):
   **metric style** (r07+, also BENCH_LIVE) ``{"metric": str,
   "platform": str, ...}`` where every ``*_vs_*`` ratio key must be a
@@ -68,7 +77,16 @@ looser schema):
   ``quant_bf16_p50_ms`` / ``quant_int8_p50_ms``), FINITE gate deltas
   (``quant_gate_delta_bf16`` / ``quant_gate_delta_int8``) and the
   bool ``quant_gate_passed`` — an un-gated speedup is not evidence.
-  Metrics starting with ``serve_train`` (BENCH_r20, the online
+  Metrics starting with ``serving_autotune`` (BENCH_r21, the
+  self-tuning loop) must carry ``autotune_workloads`` — a non-empty
+  list of ``WORKLOAD_*.json`` filenames each resolving to a file NEXT
+  TO the artifact (the trace/score JOIN: a tune score whose trace is
+  gone is unreplayable evidence), the per-mix A/B score sides
+  (``autotune_<mix>_default_score`` / ``..._tuned_score``), each mix's
+  ``autotune_<mix>_replay_drift`` within the declared
+  ``autotune_drift_bound``, and the int ``fleet_failed_non_shed``
+  summed over every replay. Metrics starting with ``serve_train``
+  (BENCH_r20, the online
   learning loop) must carry ``serve_train_error_trajectory`` (a
   non-empty list of finite held-out error numbers, one per published
   version — the does-online-training-actually-learn evidence), the
@@ -210,6 +228,47 @@ def check_bench_file(path: str, rel: str) -> List[Finding]:
                     bad(f"events[{i}] missing numeric 'loss'")
                 # non-finite losses are caught by the global
                 # finite-number walk below, with their exact path
+    elif base.startswith("WORKLOAD_"):
+        # a committed request trace (serving/workload.py): replayable
+        # by construction or a finding — the tuner's scores are only
+        # evidence while the trace they came from still re-offers
+        from paddle_tpu.serving.workload import (EVENT_KEYS,
+                                                 WORKLOAD_VERSION)
+        if not (isinstance(data.get("workload"), str)
+                and data.get("workload")):
+            bad("workload artifact needs a non-empty str 'workload'")
+        if data.get("version") != WORKLOAD_VERSION:
+            bad(f"workload artifact version {data.get('version')!r} != "
+                f"{WORKLOAD_VERSION}")
+        events = data.get("events")
+        if not (isinstance(events, list) and events):
+            bad("workload artifact needs a non-empty 'events' list "
+                "(a trace with no offers replays nothing)")
+        else:
+            if data.get("n_events") != len(events):
+                bad(f"workload artifact n_events {data.get('n_events')!r}"
+                    f" != {len(events)} events present (truncated?)")
+            last_t = None
+            for i, e in enumerate(events):
+                if not isinstance(e, dict):
+                    bad(f"events[{i}] must be an object")
+                    continue
+                missing = [k for k in EVENT_KEYS if k not in e]
+                if missing:
+                    bad(f"events[{i}] missing replay key(s) {missing}")
+                if e.get("kind") not in ("score", "generate"):
+                    bad(f"events[{i}] unknown kind {e.get('kind')!r}")
+                t = e.get("t")
+                if (not isinstance(t, (int, float))
+                        or isinstance(t, bool) or t < 0):
+                    bad(f"events[{i}] needs numeric 't' >= 0 (the "
+                        "recorded arrival offset)")
+                elif last_t is not None and t < last_t:
+                    bad(f"events[{i}] breaks monotone arrival order "
+                        f"(t {t} < previous {last_t}) — the recorder "
+                        "snapshot sorts by offset")
+                else:
+                    last_t = t
     elif base.startswith("MEM_"):
         # a pass-5 memory-manifest trend snapshot
         progs = data.get("programs")
@@ -293,6 +352,63 @@ def check_bench_file(path: str, rel: str) -> List[Finding]:
             if not isinstance(data.get("quant_gate_passed"), bool):
                 bad("quant artifact missing bool 'quant_gate_passed' "
                     "(the in-bench warmup gate verdict)")
+        if str(data.get("metric", "")).startswith("serving_autotune"):
+            # the r21 self-tuning generation (BENCH_r21): a tune score
+            # is only evidence joined to the trace it replayed — the
+            # listed WORKLOAD_*.json files must exist beside the
+            # artifact and each mix must carry both A/B score sides,
+            # its determinism drift inside the declared bound, and the
+            # zero-drop counter summed over every replay
+            wls = data.get("autotune_workloads")
+            if (not isinstance(wls, list) or not wls
+                    or not all(isinstance(w, str)
+                               and w.startswith("WORKLOAD_")
+                               for w in wls)):
+                bad("autotune artifact missing 'autotune_workloads' "
+                    "(non-empty list of WORKLOAD_*.json filenames — "
+                    "the trace/score join)")
+            else:
+                art_dir = os.path.dirname(os.path.abspath(path))
+                for w in wls:
+                    if not os.path.exists(os.path.join(art_dir, w)):
+                        bad(f"autotune artifact cites trace {w!r} which "
+                            "does not exist beside it — an unjoined "
+                            "tune score is unreplayable evidence")
+            bound = data.get("autotune_drift_bound")
+            if not isinstance(bound, (int, float)) or isinstance(
+                    bound, bool):
+                bad("autotune artifact missing numeric "
+                    "'autotune_drift_bound' (the declared score "
+                    "tolerance its determinism claim cites)")
+            mixes_ = data.get("autotune_mixes")
+            if (not isinstance(mixes_, list) or not mixes_
+                    or not all(isinstance(m, str) for m in mixes_)):
+                bad("autotune artifact missing 'autotune_mixes' "
+                    "(non-empty list of mix names)")
+            else:
+                for m in mixes_:
+                    for k in (f"autotune_{m}_default_score",
+                              f"autotune_{m}_tuned_score",
+                              f"autotune_{m}_replay_drift"):
+                        v = data.get(k)
+                        if not isinstance(v, (int, float)) or isinstance(
+                                v, bool):
+                            bad(f"autotune artifact missing numeric "
+                                f"{k!r} (the per-mix A/B + determinism "
+                                "evidence)")
+                    drift = data.get(f"autotune_{m}_replay_drift")
+                    if (isinstance(drift, (int, float))
+                            and not isinstance(drift, bool)
+                            and isinstance(bound, (int, float))
+                            and not isinstance(bound, bool)
+                            and drift > bound):
+                        bad(f"autotune mix {m!r} replay drift {drift} "
+                            f"exceeds its own declared bound {bound} — "
+                            "the determinism claim fails its artifact")
+            v = data.get("fleet_failed_non_shed")
+            if not isinstance(v, int) or isinstance(v, bool):
+                bad("autotune artifact missing int "
+                    "'fleet_failed_non_shed' summed over every replay")
         if str(data.get("metric", "")).startswith("serve_train"):
             # the r20 online-learning generation (BENCH_r20): an
             # online-loop claim is only evidence with the held-out
@@ -386,7 +502,8 @@ def run_schema_check(root: str,
                                                 "ACCURACY_*.json",
                                                 "MEM_*.json",
                                                 "TRACE_*.json",
-                                                "HEALTH_*.json")
+                                                "HEALTH_*.json",
+                                                "WORKLOAD_*.json")
                      ) -> List[Finding]:
     findings: List[Finding] = []
     for pattern in patterns:

@@ -36,7 +36,19 @@ C API loads) served over HTTP with
   router HA via a warm standby adopting the fleet over an epoch-fenced
   ``RoleLease`` (a partitioned old active provably stops dispatching),
   and load-driven autoscaling with hysteresis inside
-  ``[--min_replicas, --max_replicas]``.
+  ``[--min_replicas, --max_replicas]``,
+- a self-tuning tier (``serving/tuner.py`` + ``serving/workload.py``):
+  one typed hot-reconfig path (``FleetConfig`` deltas through
+  ``apply_config`` / ``POST /admin/config`` — validate-then-commit,
+  off-menu values refused with a typed 409 ``ConfigRejected`` while
+  the incumbent keeps serving), a deterministic trace-replay harness
+  (record the admission stream as a ``WORKLOAD_*.json`` artifact,
+  replay it against an in-process fleet, score p50/p99/throughput/
+  shed/deadline-miss against a declared ``SLOTarget``), an offline
+  coordinate-descent ``GridTuner`` over the replay score, and an
+  online ``SLOController`` applying bounded nudges with
+  Autoscaler-style hysteresis — every decision a ``tune_decision``
+  flight event.
 
 Entry points: ``python -m paddle_tpu.trainer.cli --job=serve`` (flags
 ``--port --batch_timeout_ms --max_batch --queue_depth --replicas
@@ -55,10 +67,10 @@ from paddle_tpu.serving.aot_cache import AOTCache  # noqa: F401
 from paddle_tpu.serving.batcher import ServingEngine  # noqa: F401
 from paddle_tpu.serving.client import ServingClient  # noqa: F401
 from paddle_tpu.serving.errors import (BadRequest,  # noqa: F401
-                                       DeadlineExceeded, Overloaded,
-                                       QuantGateError, ReloadRejected,
-                                       ServingError, ShuttingDown,
-                                       Unavailable)
+                                       ConfigRejected, DeadlineExceeded,
+                                       Overloaded, QuantGateError,
+                                       ReloadRejected, ServingError,
+                                       ShuttingDown, Unavailable)
 from paddle_tpu.serving.metrics import (RouterMetrics,  # noqa: F401
                                         ServingMetrics)
 from paddle_tpu.serving.predictor import ServingPredictor  # noqa: F401
@@ -71,3 +83,9 @@ from paddle_tpu.serving.router import (EngineTransport,  # noqa: F401
 from paddle_tpu.serving.supervisor import (Autoscaler,  # noqa: F401
                                            InProcessFleet,
                                            ReplicaSupervisor)
+from paddle_tpu.serving.tuner import (FleetConfig,  # noqa: F401
+                                      GridTuner, SLOController,
+                                      SLOTarget, slo_score)
+from paddle_tpu.serving.workload import (Workload,  # noqa: F401
+                                         WorkloadRecorder, replay,
+                                         replay_score)

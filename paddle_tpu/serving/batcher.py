@@ -50,9 +50,9 @@ from typing import Dict, List, Optional
 
 from paddle_tpu.obs import flight as _flight
 from paddle_tpu.obs import trace as _trace
-from paddle_tpu.serving.errors import (BadRequest, DeadlineExceeded,
-                                       Overloaded, ServingError,
-                                       ShuttingDown)
+from paddle_tpu.serving.errors import (BadRequest, ConfigRejected,
+                                       DeadlineExceeded, Overloaded,
+                                       ServingError, ShuttingDown)
 from paddle_tpu.serving.metrics import ServingMetrics
 from paddle_tpu.testing import chaos as _chaos
 from paddle_tpu.utils.log import get_logger
@@ -97,7 +97,7 @@ class ServingEngine:
                  default_deadline_ms: Optional[float] = None,
                  continuous_batching: bool = False,
                  metrics: Optional[ServingMetrics] = None,
-                 replay_sink=None):
+                 replay_sink=None, workload_recorder=None):
         self.predictor = predictor
         # the online loop's serving→training edge: successfully-answered
         # score rows are appended here (``online/replay.py:ReplayWriter``
@@ -105,6 +105,11 @@ class ServingEngine:
         # contract: a failed append is counted and shed, never an error
         # to the caller whose request DID get answered.
         self.replay_sink = replay_sink
+        # admission-stream tap (``serving/workload.py:WorkloadRecorder``)
+        # — records every offered request (admitted AND shed) for the
+        # trace-replay harness. Off the latency path like the replay
+        # sink: one lock-free deque append, outside the engine lock.
+        self.workload_recorder = workload_recorder
         self.max_batch = int(max_batch or predictor.batch_buckets[-1])
         if self.max_batch > predictor.batch_buckets[-1]:
             raise ValueError(
@@ -222,6 +227,101 @@ class ServingEngine:
             h["aot_cache"] = dict(cache.stats)
         return h
 
+    # ------------------------------------------------------- hot reconfig
+    def current_config(self) -> dict:
+        """The incumbent knob values — the before/after halves of every
+        ``apply_config`` answer, and the rollback anchor the router's
+        fan-out uses when a later replica refuses the delta."""
+        return {
+            "max_batch": self.max_batch,
+            "batch_timeout_ms": self.batch_timeout_ms,
+            "queue_depth": self.queue_depth,
+            "shed_watermark": self.shed_watermark,
+            "default_deadline_ms": self.default_deadline_ms,
+            "decode_chunk": getattr(self.predictor, "gen_decode_chunk",
+                                    None),
+        }
+
+    def apply_config(self, cfg) -> dict:
+        """Apply a :class:`~paddle_tpu.serving.tuner.FleetConfig` delta
+        to the live engine. Validate-then-commit: every value is checked
+        BEFORE anything mutates, so a refusal leaves the incumbent
+        config serving untouched (typed 409
+        :class:`~paddle_tpu.serving.errors.ConfigRejected`).
+
+        The load-bearing refusal is the warmed-menu check: a
+        ``max_batch`` above ``predictor.batch_buckets[-1]`` (or any
+        ``decode_chunk`` change — the chunk length is compiled into the
+        warmed decode programs) would drive the hardened
+        ``RecompileGuard`` into a worker-fatal ``RecompileError``
+        mid-traffic, so it is refused HERE, with the warmed menu on
+        ``allowed``. Admissible knobs mutate under the engine lock in
+        one step (the worker's ``_collect`` reads them there), then the
+        event/metric emission happens outside it."""
+        from paddle_tpu.serving.tuner import FleetConfig, \
+            record_tune_decision
+        cfg = FleetConfig.coerce(cfg)
+        changes = cfg.engine_items()
+        before = self.current_config()
+        if not changes:
+            return {"status": "ok", "before": before, "after": before}
+
+        def reject(reason: str, allowed=None):
+            self.metrics.inc("config_rejected_total")
+            record_tune_decision(action="apply_rejected", reason=reason,
+                                 requested=dict(changes), before=before)
+            raise ConfigRejected(
+                f"{reason}; incumbent config keeps serving",
+                allowed=allowed)
+
+        cap = self.predictor.batch_buckets[-1]
+        new_max = int(changes.get("max_batch", self.max_batch))
+        if not 1 <= new_max <= cap:
+            reject(f"max_batch {new_max} is outside the warmed "
+                   f"batch-bucket menu (largest warmed bucket {cap}); "
+                   "an off-menu batch would recompile mid-traffic",
+                   allowed={"max_batch": list(self.predictor
+                                              .batch_buckets)})
+        if "decode_chunk" in changes:
+            warmed = getattr(self.predictor, "gen_decode_chunk", None)
+            if changes["decode_chunk"] != warmed:
+                reject("decode_chunk is compiled into the warmed decode "
+                       f"programs (warmed: {warmed}); changing it needs "
+                       "a reload (/admin/reload), not a knob nudge",
+                       allowed={"decode_chunk": [warmed]})
+        new_qd = int(changes.get("queue_depth", self.queue_depth))
+        if new_qd < 1:
+            reject(f"queue_depth {new_qd} must be >= 1")
+        new_to = float(changes.get("batch_timeout_ms",
+                                   self.batch_timeout_ms))
+        if new_to < 0:
+            reject(f"batch_timeout_ms {new_to} must be >= 0")
+        new_sw = changes.get("shed_watermark", self.shed_watermark)
+        if new_sw is not None and int(new_sw) < 1:
+            reject(f"shed_watermark {new_sw} must be >= 1")
+        # a present-but-None entry is the wire's "disable" (<= 0)
+        new_dl = (changes["default_deadline_ms"]
+                  if "default_deadline_ms" in changes
+                  else self.default_deadline_ms)
+        with self._cond:
+            self.max_batch = new_max
+            self.batch_timeout_ms = new_to
+            self.queue_depth = new_qd
+            # the constructor's invariant, re-established: the watermark
+            # never exceeds the (possibly new) queue bound
+            self.shed_watermark = min(int(new_sw or new_qd), new_qd)
+            self.default_deadline_ms = new_dl
+            self._cond.notify_all()
+        after = self.current_config()
+        self.metrics.inc("config_applies_total")
+        if _flight._ACTIVE is not None:
+            _flight._ACTIVE.record("config_applied",
+                                   changed=",".join(sorted(changes)),
+                                   before=before, after=after)
+        logger.info("serving: config applied (%s)",
+                    {k: after[k] for k in changes})
+        return {"status": "ok", "before": before, "after": after}
+
     def begin_drain(self):
         """Close admission; queued and in-flight work still completes.
         The SIGTERM handler calls this (``serving/server.py``)."""
@@ -284,25 +384,40 @@ class ServingEngine:
         deadline = (time.perf_counter() + float(deadline_ms) / 1e3
                     if deadline_ms else None)
         req = _Request(tuple(sample), kind, deadline)
-        with self._cond:
-            if self.fatal is not None:
-                # re-check under the lock: a request racing the worker's
-                # death must not land in a queue nothing drains
-                raise ServingError(
-                    f"serving worker died: {self.fatal!r}")
-            if self._draining:
-                raise ShuttingDown(
-                    "server is draining; retry elsewhere",
-                    retry_after_ms=self._retry_after_ms())
-            if len(self._queue) >= self.shed_watermark:
-                self.metrics.inc("shed_total")
-                raise Overloaded(
-                    f"queue depth {len(self._queue)} at the shed "
-                    f"watermark {self.shed_watermark}",
-                    retry_after_ms=self._retry_after_ms())
-            self._queue.append(req)
-            self.metrics.inc("requests_total")
-            self._cond.notify_all()
+        rec = self.workload_recorder
+        try:
+            with self._cond:
+                if self.fatal is not None:
+                    # re-check under the lock: a request racing the
+                    # worker's death must not land in a queue nothing
+                    # drains
+                    raise ServingError(
+                        f"serving worker died: {self.fatal!r}")
+                if self._draining:
+                    raise ShuttingDown(
+                        "server is draining; retry elsewhere",
+                        retry_after_ms=self._retry_after_ms())
+                if len(self._queue) >= self.shed_watermark:
+                    self.metrics.inc("shed_total")
+                    raise Overloaded(
+                        f"queue depth {len(self._queue)} at the shed "
+                        f"watermark {self.shed_watermark}",
+                        retry_after_ms=self._retry_after_ms())
+                self._queue.append(req)
+                self.metrics.inc("requests_total")
+                self._cond.notify_all()
+        except Overloaded as e:  # includes ShuttingDown
+            # the shed is part of the offered stream too — a replayed
+            # trace must re-offer it (outside the lock, lock-free append)
+            if rec is not None:
+                rec.observe(req.sample, kind=kind,
+                            deadline_ms=deadline_ms, beam_size=beam_size,
+                            max_length=max_length, outcome=e.code)
+            raise
+        if rec is not None:
+            rec.observe(req.sample, kind=kind, deadline_ms=deadline_ms,
+                        beam_size=beam_size, max_length=max_length,
+                        outcome="admitted")
         return req
 
     def infer(self, sample, *, kind: str = "score",

@@ -256,6 +256,17 @@ class ServingClient:
             body["deadline_ms"] = deadline_ms
         return self._request("POST", "/v1/generate", body)
 
+    def apply_config(self, config: dict) -> dict:
+        """Hot-apply a knob delta (``POST /admin/config``). The body is
+        a :class:`~paddle_tpu.serving.tuner.FleetConfig` dict; a 409
+        refusal raises the typed
+        :class:`~paddle_tpu.serving.errors.ConfigRejected` (NOT retried
+        — neither an overload nor a connection error: the incumbent
+        config is still serving and a re-send would refuse
+        identically); 200 returns the before/after knob values."""
+        body = config if isinstance(config, dict) else config.to_dict()
+        return self._request("POST", "/admin/config", body)
+
     def healthz(self) -> dict:
         return self._request("GET", "/healthz")
 

@@ -127,6 +127,19 @@ class ReloadRejected(ServingError):
     code = "reload_rejected"
 
 
+class ConfigRejected(ServingError):
+    """A runtime knob change was refused at ``apply_config`` time: the
+    incumbent config keeps serving (the :class:`ReloadRejected` pattern
+    applied to knobs). The canonical case is a ``max_batch`` above the
+    warmed bucket menu — admitting it would drive the hardened
+    ``RecompileGuard`` into a worker-fatal ``RecompileError`` mid-
+    traffic, so the refusal happens here, typed, with the warmed menu
+    on ``allowed``. 409."""
+
+    status = 409
+    code = "config_rejected"
+
+
 def from_wire(body: dict, status: int) -> ServingError:
     """Client side: rebuild the typed error from a JSON error body."""
     err = (body or {}).get("error", {})
@@ -139,6 +152,7 @@ def from_wire(body: dict, status: int) -> ServingError:
         Unavailable.code: Unavailable,
         QuantGateError.code: QuantGateError,
         ReloadRejected.code: ReloadRejected,
+        ConfigRejected.code: ConfigRejected,
     }.get(code, ServingError)
     e = cls(err.get("message", f"HTTP {status}"),
             retry_after_ms=err.get("retry_after_ms"),

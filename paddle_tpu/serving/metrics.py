@@ -92,7 +92,12 @@ class ServingMetrics:
                 "shed_total", "deadline_exceeded_total",
                 "bad_request_total", "internal_error_total",
                 "decode_chunks_total", "continuous_admissions_total",
-                "decode_steps_total", "decode_steps_saved_total")
+                "decode_steps_total", "decode_steps_saved_total",
+                # hot-reconfig plane (r21): knob deltas applied vs
+                # refused typed (off-menu max_batch etc.), and SLO-
+                # controller decisions when one targets this engine
+                "config_applies_total", "config_rejected_total",
+                "tune_decisions_total")
 
     def __init__(self, window: int = 4096):
         self._lock = threading.Lock()
@@ -200,7 +205,12 @@ class RouterMetrics:
                 # fleet adoptions, autoscale actions, supervisor kills
                 "fenced_total", "adoptions_total",
                 "scale_up_total", "scale_down_total",
-                "replica_kills_total", "lease_renew_lost_total")
+                "replica_kills_total", "lease_renew_lost_total",
+                # hot-reconfig plane (r21): fleet-wide knob deltas
+                # applied vs refused (fan-out rolled back), and SLO-
+                # controller decisions when one targets this router
+                "config_applies_total", "config_rejected_total",
+                "tune_decisions_total")
 
     def __init__(self, window: int = 4096):
         self._lock = threading.Lock()

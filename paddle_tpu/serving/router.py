@@ -77,9 +77,10 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from paddle_tpu.obs import flight as _flight
 from paddle_tpu.obs import trace as _trace
-from paddle_tpu.serving.errors import (BadRequest, DeadlineExceeded,
-                                       Overloaded, ServingError,
-                                       ShuttingDown, Unavailable)
+from paddle_tpu.serving.errors import (BadRequest, ConfigRejected,
+                                       DeadlineExceeded, Overloaded,
+                                       ServingError, ShuttingDown,
+                                       Unavailable)
 from paddle_tpu.serving.metrics import RouterMetrics
 from paddle_tpu.serving.server import JSONHandler
 from paddle_tpu.testing import chaos as _chaos
@@ -204,6 +205,11 @@ class EngineTransport:
         is answered (the zero-drop half of rolling reload)."""
         self.engine.shutdown(drain=True, timeout=timeout)
 
+    def apply_config(self, cfg) -> dict:
+        """Apply an engine-knob delta to this replica (typed refusal
+        propagates to the router's fan-out rollback)."""
+        return self.engine.apply_config(cfg)
+
 
 class HTTPTransport:
     """A replica reached over HTTP — a separately-launched single-
@@ -322,6 +328,15 @@ class HTTPTransport:
             except (ProcessLookupError, OSError):
                 pass  # already gone — drain_wait reaps
 
+    def apply_config(self, cfg) -> dict:
+        """Forward an engine-knob delta to the remote replica's
+        ``POST /admin/config``. A 409 comes back as the typed
+        :class:`~paddle_tpu.serving.errors.ConfigRejected` via
+        ``from_wire`` — the router's rollback branches on it exactly
+        like the in-process case."""
+        body = cfg if isinstance(cfg, dict) else cfg.to_dict()
+        return self._client._request_once("POST", "/admin/config", body)
+
     def drain_wait(self, timeout: float = 60.0):
         """Block until every queued + in-flight request is answered.
         With a process handle the drained replica is then SIGTERMed and
@@ -430,6 +445,12 @@ class ReplicaRouter:
         # a drained-away "r2" and a later scale-up replica can never be
         # confused in logs/metrics/provenance
         self._next_id = len(self.replicas)
+        # optional attachments for the hot-reconfig / tuning plane:
+        # an Autoscaler whose watermarks apply_config may retarget, and
+        # a WorkloadRecorder tapping the admission stream (both plain
+        # attrs — set by the owner, read without the lock)
+        self.autoscaler = None
+        self.workload_recorder = None
 
     # ------------------------------------------------------------ control
     def start(self, poll_now: bool = True) -> "ReplicaRouter":
@@ -722,6 +743,13 @@ class ReplicaRouter:
                   beam_size, max_length) -> Tuple[dict, dict]:
         if kind not in ("score", "generate"):
             raise BadRequest(f"unknown request kind {kind!r}")
+        rec = self.workload_recorder
+        if rec is not None:
+            # admission-stream tap for the trace-replay harness: one
+            # lock-free deque append, off the latency path (the r20
+            # replay-sink discipline applied at the front tier)
+            rec.observe(sample, kind=kind, deadline_ms=deadline_ms,
+                        beam_size=beam_size, max_length=max_length)
         if self.fence is not None and not self.fence.valid():
             # fenced: we lost (or never held) the active-role lease —
             # a zombie active must NOT keep dispatching while a standby
@@ -995,6 +1023,98 @@ class ReplicaRouter:
                     "halted (earlier replicas are on the new "
                     "version)")
             time.sleep(0.01)
+
+    # ------------------------------------------------------ hot reconfig
+    def current_config(self) -> dict:
+        """The router's own incumbent knob values (the replicas' live
+        via their ``current_config``/``/admin/config`` answers)."""
+        return {"hedge_ms": self.hedge_ms,
+                "max_hedges": self.max_hedges}
+
+    def apply_config(self, cfg) -> dict:
+        """Apply a :class:`~paddle_tpu.serving.tuner.FleetConfig` delta
+        fleet-wide: engine knobs fan out to every non-dead replica's
+        transport, router knobs (``hedge_ms``, ``max_hedges``) commit
+        locally, autoscale watermarks retarget the attached
+        ``Autoscaler``.
+
+        All-or-nothing like a rolling reload: local knobs validate
+        BEFORE the fan-out, and when replica K refuses the delta (typed
+        409 — e.g. an off-menu ``max_batch``), replicas 0..K-1 are
+        rolled back to their incumbent values and the call raises
+        :class:`~paddle_tpu.serving.errors.ConfigRejected` — no replica
+        serves the refused config, the fleet stays on the incumbent."""
+        from paddle_tpu.serving.tuner import (FleetConfig,
+                                              record_tune_decision,
+                                              rollback_delta)
+        cfg = FleetConfig.coerce(cfg)
+        before = self.current_config()
+
+        def reject(reason: str, allowed=None, cause=None):
+            self.metrics.inc("config_rejected_total")
+            record_tune_decision(action="apply_rejected", reason=reason,
+                                 requested=cfg.to_dict(), before=before)
+            raise ConfigRejected(
+                f"{reason}; incumbent config keeps serving",
+                allowed=allowed) from cause
+
+        # ---- validate the locally-owned knobs before any side effect
+        router_changes = cfg.router_items()
+        if "max_hedges" in router_changes \
+                and router_changes["max_hedges"] < 0:
+            reject(f"max_hedges {router_changes['max_hedges']} must "
+                   "be >= 0")
+        auto = cfg.autoscale_items()
+        scaler = self.autoscaler
+        if auto:
+            if scaler is None:
+                reject("autoscale watermarks were sent but this router "
+                       "has no autoscaler attached")
+            scaler.check_config(auto)  # raises ConfigRejected itself
+        # ---- fan the engine knobs out, rollback on refusal
+        engine_cfg = cfg.engine_subset()
+        applied: List[Tuple[Replica, dict]] = []
+        if engine_cfg.set_fields():
+            with self._lock:
+                targets = [r for r in self.replicas if r.state != DEAD]
+            for rep in targets:
+                try:
+                    res = rep.transport.apply_config(engine_cfg)
+                except ServingError as e:
+                    for prep, prior in applied:
+                        try:
+                            prep.transport.apply_config(prior)
+                        except Exception as re:  # noqa: BLE001
+                            logger.error(
+                                "config rollback of %s failed: %r "
+                                "(replica may hold the refused delta)",
+                                prep.id, re)
+                    reject(f"replica {rep.id} refused the config ({e}); "
+                           f"{len(applied)} earlier replica(s) rolled "
+                           "back", allowed=e.allowed, cause=e)
+                applied.append((rep, rollback_delta(
+                    res.get("before", {}), engine_cfg.set_fields())))
+        # ---- commit the local knobs (plain attrs, read per-dispatch)
+        if "hedge_ms" in router_changes:
+            self.hedge_ms = router_changes["hedge_ms"]
+        if "max_hedges" in router_changes:
+            self.max_hedges = int(router_changes["max_hedges"])
+        if auto:
+            scaler.commit_config(auto)
+        after = self.current_config()
+        changed = cfg.set_fields()
+        self.metrics.inc("config_applies_total")
+        if _flight._ACTIVE is not None:
+            _flight._ACTIVE.record("config_applied", tier="router",
+                                   changed=",".join(changed),
+                                   replicas=len(applied),
+                                   before=before, after=after)
+        log_event(logger, "config_applied",
+                  "router: config applied (%s) to %d replica(s)",
+                  changed, len(applied), level=20,
+                  changed=",".join(changed), replicas=len(applied))
+        return {"status": "ok", "before": before, "after": after,
+                "replicas": len(applied), "applied": cfg.to_dict()}
 
     # ------------------------------------------------------ elastic fleet
     def set_transport(self, replica_id: str, transport,
@@ -1424,6 +1544,9 @@ class _RouterHandler(JSONHandler):
         if path == "/admin/reload":
             self._admin_reload()
             return
+        if path == "/admin/config":
+            self._admin_config()
+            return
         kind = {"/v1/score": "score", "/v1/generate": "generate"}.get(path)
         if kind is None:
             self._send(404, {"error": {"code": "not_found",
@@ -1500,6 +1623,23 @@ class _RouterHandler(JSONHandler):
                     "no answer within the server wait bound").to_wire()
                 any_err[0] = True
         self._send(200 if not any_err[0] else 207, {"results": results})
+
+    def _admin_config(self):
+        """Fleet-wide hot reconfig: the body is a
+        :class:`~paddle_tpu.serving.tuner.FleetConfig` knob delta.
+        Synchronous; 200 carries before/after, a refusal answers the
+        typed 409 ``config_rejected`` with the incumbent still serving
+        on every replica (``ReplicaRouter.apply_config`` rolled back
+        any partially-applied fan-out)."""
+        try:
+            self._send(200, self.server.router.apply_config(
+                self._body()))
+        except ServingError as e:
+            self._send_error(e)
+        except Exception as e:  # noqa: BLE001
+            logger.error("config apply failed: %r", e)
+            self._send(500, {"error": {"code": "config_failed",
+                                       "message": repr(e)}})
 
     def _admin_reload(self):
         """Rolling hot-swap to a new merged model: ``{"model_path":

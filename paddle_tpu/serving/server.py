@@ -174,6 +174,21 @@ class _Handler(JSONHandler):
             engine.begin_drain()
             self._send(200, engine.health())
             return
+        if path == "/admin/config":
+            # typed hot reconfig: the body is a FleetConfig knob delta
+            # (serving/tuner.py). Validate-then-commit — a refusal
+            # (off-menu max_batch, decode_chunk change) answers the
+            # typed 409 config_rejected and the incumbent knobs keep
+            # serving; 200 carries before/after.
+            try:
+                self._send(200, engine.apply_config(self._body()))
+            except ServingError as e:
+                self._send_error(e)
+            except Exception as e:  # noqa: BLE001
+                logger.error("config apply failed: %r", e)
+                self._send(500, {"error": {"code": "config_failed",
+                                           "message": repr(e)}})
+            return
         kind = {"/v1/score": "score", "/v1/generate": "generate"}.get(path)
         if kind is None:
             self._send(404, {"error": {"code": "not_found",

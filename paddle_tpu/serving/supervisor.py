@@ -574,6 +574,12 @@ class InProcessFleet:
     def load_backlog_ms(self) -> Optional[float]:
         return self.router.load_backlog_ms()
 
+    def apply_config(self, cfg) -> dict:
+        """Fleet-wide hot reconfig — delegates to the router's fan-out
+        (engine knobs to every replica with rollback-on-refusal, router
+        knobs local, autoscale watermarks to the attached scaler)."""
+        return self.router.apply_config(cfg)
+
 
 class Autoscaler:
     """Metrics-driven elastic capacity with hysteresis.
@@ -645,6 +651,43 @@ class Autoscaler:
                 self.observe()
             except Exception as e:  # noqa: BLE001 — the loop must live
                 logger.error("autoscaler tick crashed: %r", e)
+
+    # ------------------------------------------------------- hot reconfig
+    def check_config(self, auto: dict):
+        """Validate an autoscale-watermark delta WITHOUT committing it
+        (the router's all-or-nothing apply validates local knobs before
+        fanning engine knobs out). The constructor's band invariant must
+        survive a partial delta, so the unchanged half participates."""
+        from paddle_tpu.serving.errors import ConfigRejected
+        up = float(auto.get("autoscale_up_backlog_ms",
+                            self.up_backlog_ms))
+        down = float(auto.get("autoscale_down_backlog_ms",
+                              self.down_backlog_ms))
+        if not (0 <= down < up):
+            raise ConfigRejected(
+                f"autoscale watermarks must satisfy 0 <= down < up, got "
+                f"down={down} up={up} (the hysteresis band would "
+                "collapse); incumbent config keeps serving")
+
+    def commit_config(self, auto: dict):
+        """Commit a delta :meth:`check_config` already admitted. Plain
+        attribute writes the policy loop reads per tick; ordered so
+        ``down < up`` holds at every instant (raise the ceiling before
+        the floor, lower the floor before the ceiling) — the loop can
+        never observe a collapsed band mid-commit."""
+        up = float(auto.get("autoscale_up_backlog_ms",
+                            self.up_backlog_ms))
+        down = float(auto.get("autoscale_down_backlog_ms",
+                              self.down_backlog_ms))
+        if up >= self.up_backlog_ms:
+            self.up_backlog_ms = up
+            self.down_backlog_ms = down
+        else:
+            self.down_backlog_ms = down
+            self.up_backlog_ms = up
+        logger.info("autoscaler: watermarks retargeted (down %.1f ms, "
+                    "up %.1f ms)", self.down_backlog_ms,
+                    self.up_backlog_ms)
 
     # ------------------------------------------------------------ policy
     def _record(self, now: float, n: int):

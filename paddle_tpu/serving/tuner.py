@@ -1,0 +1,535 @@
+"""Self-tuning serving fleet: the typed hot-reconfig contract and the
+SLO controller over it.
+
+The fleet's performance knobs — ``batch_timeout_ms``, ``max_batch``,
+``hedge_ms``, ``shed_watermark``, the autoscale watermarks — were
+constructor-frozen: re-tuning for a shifted workload mix meant a
+restart. This module makes serving configuration part of the SYSTEM
+rather than of the operator (the TensorFlow-paper production stance,
+recast at the fleet layer):
+
+- :class:`FleetConfig` — the one typed knob-change payload. Every field
+  is optional; ``None`` means "leave unchanged", so a config is a DELTA
+  against the incumbent. Parsed with a closed key set (an unknown knob
+  is a typed 400, never silently dropped). Applied via
+  ``ServingEngine.apply_config`` / ``ReplicaRouter.apply_config`` /
+  ``POST /admin/config`` — all three validate-then-commit: an
+  inadmissible value (the canonical case: ``max_batch`` above the
+  warmed bucket menu, which would drive the hardened ``RecompileGuard``
+  into a worker-fatal ``RecompileError`` mid-traffic) is refused with a
+  typed 409 :class:`~paddle_tpu.serving.errors.ConfigRejected` and the
+  INCUMBENT config keeps serving (the rolling-reload rollback pattern
+  applied to knobs).
+- :class:`GridTuner` — offline mode: coordinate descent over a bounded
+  knob grid, each candidate scored by deterministically replaying a
+  recorded workload trace (``serving/workload.py``) against a live
+  fleet. Determinism is what makes the comparison meaningful; the
+  scorer carries best-of-R semantics so the 1-core host's ±50% drift
+  cannot invert a structural ordering.
+- :class:`SLOController` — online mode: bounded nudges with hysteresis
+  EXACTLY like the r14 ``Autoscaler`` (EWMA signal, sustain clocks that
+  reset inside the band, a cooldown after every action, hard clamps),
+  fed by the same metrics plane and targeting a declared
+  :class:`SLOTarget`. A nudge the fleet refuses (typed 409) clamps the
+  controller's own bound — the controller LEARNS the menu edge instead
+  of hammering it.
+
+Every decision — applied, refused, or clamped — emits a
+``tune_decision`` flight event with before/after knob values and the
+triggering signal, so a bad tune is postmortem-able from
+``tools/blackbox.py`` alone.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, fields
+from typing import Callable, Dict, List, Optional, Tuple
+
+from paddle_tpu.obs import flight as _flight
+from paddle_tpu.serving.errors import (BadRequest, ConfigRejected,
+                                       ServingError)
+from paddle_tpu.utils.log import event as log_event
+from paddle_tpu.utils.log import get_logger
+
+logger = get_logger("serving.tuner")
+
+# knob ownership: which component applies each field (docs/serving.md
+# carries the operator-facing table; this is the programmatic split)
+ENGINE_KNOBS = ("max_batch", "batch_timeout_ms", "queue_depth",
+                "shed_watermark", "default_deadline_ms", "decode_chunk")
+ROUTER_KNOBS = ("hedge_ms", "max_hedges")
+AUTOSCALE_KNOBS = ("autoscale_up_backlog_ms", "autoscale_down_backlog_ms")
+
+_INT_KNOBS = ("max_batch", "queue_depth", "shed_watermark", "max_hedges",
+              "decode_chunk")
+# knobs where the incumbent value may legitimately be None ("off"): a
+# delta cannot say None (that means "unchanged"), so <= 0 encodes "off"
+_NULLABLE_KNOBS = ("default_deadline_ms", "hedge_ms", "decode_chunk")
+
+
+@dataclass
+class FleetConfig:
+    """One typed knob delta. ``None`` = leave unchanged. For the
+    nullable knobs (``default_deadline_ms``, ``hedge_ms``,
+    ``decode_chunk``) a value ``<= 0`` means "disable" (the incumbent
+    may be None, and a delta needs a way to say so on the wire)."""
+
+    max_batch: Optional[int] = None
+    batch_timeout_ms: Optional[float] = None
+    queue_depth: Optional[int] = None
+    shed_watermark: Optional[int] = None
+    default_deadline_ms: Optional[float] = None
+    decode_chunk: Optional[int] = None
+    hedge_ms: Optional[float] = None
+    max_hedges: Optional[int] = None
+    autoscale_up_backlog_ms: Optional[float] = None
+    autoscale_down_backlog_ms: Optional[float] = None
+
+    # ------------------------------------------------------------ parse
+    @classmethod
+    def from_dict(cls, d: dict) -> "FleetConfig":
+        """Closed-key parse: an unknown knob or a non-numeric value is
+        a typed 400 (``BadRequest``) — a config typo must never be
+        silently dropped (the operator would believe it applied)."""
+        if not isinstance(d, dict):
+            raise BadRequest("config must be a JSON object of knobs")
+        known = {f.name for f in fields(cls)}
+        unknown = sorted(set(d) - known)
+        if unknown:
+            raise BadRequest(
+                f"unknown config knob(s) {unknown}; "
+                f"the knob menu is {sorted(known)}",
+                allowed={"knobs": sorted(known)})
+        kw = {}
+        for k, v in d.items():
+            if v is None:
+                continue  # wire None == omitted == unchanged
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                raise BadRequest(
+                    f"config knob {k!r} must be a number, got {v!r}")
+            kw[k] = int(v) if k in _INT_KNOBS else float(v)
+        return cls(**kw)
+
+    @classmethod
+    def coerce(cls, obj) -> "FleetConfig":
+        if isinstance(obj, cls):
+            return obj
+        return cls.from_dict(obj)
+
+    # ------------------------------------------------------------ views
+    def to_dict(self) -> dict:
+        """Only the SET fields — the wire payload stays a delta."""
+        return {f.name: getattr(self, f.name) for f in fields(self)
+                if getattr(self, f.name) is not None}
+
+    def set_fields(self) -> List[str]:
+        return sorted(self.to_dict())
+
+    def _items(self, names) -> Dict[str, object]:
+        out = {}
+        for k in names:
+            v = getattr(self, k)
+            if v is None:
+                continue
+            if k in _NULLABLE_KNOBS and v <= 0:
+                v = None  # "disable" on the wire -> stored None
+            out[k] = v
+        return out
+
+    def engine_items(self) -> Dict[str, object]:
+        return self._items(ENGINE_KNOBS)
+
+    def router_items(self) -> Dict[str, object]:
+        return self._items(ROUTER_KNOBS)
+
+    def autoscale_items(self) -> Dict[str, object]:
+        return self._items(AUTOSCALE_KNOBS)
+
+    def engine_subset(self) -> "FleetConfig":
+        return FleetConfig(**{k: getattr(self, k) for k in ENGINE_KNOBS
+                              if getattr(self, k) is not None})
+
+
+def rollback_delta(before: dict, changed_keys) -> dict:
+    """Build the delta that restores ``changed_keys`` to their
+    ``before`` values — the router's fan-out rollback payload. A
+    nullable knob whose incumbent was None maps to the wire's
+    "disable" spelling (``0``)."""
+    out = {}
+    for k in changed_keys:
+        v = before.get(k)
+        if v is None and k in _NULLABLE_KNOBS:
+            v = 0
+        if v is not None:
+            out[k] = v
+    return out
+
+
+def record_tune_decision(**fields_):
+    """One ``tune_decision`` flight event (applied / refused / clamped
+    nudges all land here — the blackbox postmortem trail). Callers hold
+    no locks (the obs plane never nests under a subsystem lock)."""
+    if _flight._ACTIVE is not None:
+        _flight._ACTIVE.record("tune_decision", **fields_)
+
+
+# --------------------------------------------------------------- scoring
+
+@dataclass
+class SLOTarget:
+    """The declared SLO a config is scored against: p99 e2e latency at
+    most ``p99_ms`` with at most ``max_shed_rate`` of offered requests
+    shed (and deadline misses counted against goodput)."""
+
+    p99_ms: float
+    max_shed_rate: float = 0.0
+
+    def to_dict(self) -> dict:
+        return {"p99_ms": self.p99_ms,
+                "max_shed_rate": self.max_shed_rate}
+
+
+def slo_score(summary: dict, slo: SLOTarget) -> float:
+    """Score one replay summary against the SLO. Bounded [0, 1] and
+    structurally dominated: goodput (answered in time / offered) times
+    a latency factor that only discounts when p99 exceeds the SLO, plus
+    a shed penalty past the allowance. Drift in absolute latencies
+    moves the score smoothly; shed/miss counts — the structural part —
+    move it in steps."""
+    n = max(1, int(summary.get("offered", 0)))
+    ok = int(summary.get("ok", 0))
+    shed = int(summary.get("shed", 0))
+    goodput = ok / n
+    p99 = summary.get("p99_ms")
+    lat = 1.0
+    if p99 is not None and p99 > 0:
+        lat = min(1.0, float(slo.p99_ms) / float(p99))
+    shed_rate = shed / n
+    over_shed = max(0.0, shed_rate - float(slo.max_shed_rate))
+    return max(0.0, goodput * lat - over_shed)
+
+
+# ------------------------------------------------------------ offline
+
+class GridTuner:
+    """Coordinate descent over a bounded knob grid, scored by a
+    deterministic replay. ``score_fn(config_dict) -> float`` (higher is
+    better; the caller owns applying the config to its fleet and
+    replaying the trace). Ties keep the incumbent — determinism of the
+    search itself, not just of each score. Every score is cached by
+    config, so revisited points cost nothing and the search terminates
+    after a sweep that improves nothing."""
+
+    def __init__(self, grid: Dict[str, List], score_fn: Callable[[dict], float],
+                 *, base: Optional[dict] = None, sweeps: int = 2):
+        if not grid:
+            raise ValueError("grid must name at least one knob")
+        for k, vals in grid.items():
+            if not vals:
+                raise ValueError(f"grid knob {k!r} has no candidates")
+        self.grid = {k: list(v) for k, v in grid.items()}
+        self.score_fn = score_fn
+        self.base = dict(base or {})
+        self.sweeps = int(sweeps)
+        self.history: List[dict] = []
+        self._cache: Dict[tuple, float] = {}
+
+    def _key(self, cfg: dict) -> tuple:
+        return tuple(sorted(cfg.items()))
+
+    def _score(self, cfg: dict) -> float:
+        key = self._key(cfg)
+        if key not in self._cache:
+            self._cache[key] = float(self.score_fn(dict(cfg)))
+        return self._cache[key]
+
+    def tune(self) -> Tuple[dict, float]:
+        """Run the descent; returns ``(best_config, best_score)``."""
+        best = dict(self.base)
+        for k, vals in self.grid.items():
+            best.setdefault(k, vals[0])
+        best_score = self._score(best)
+        for sweep in range(self.sweeps):
+            improved = False
+            for knob in sorted(self.grid):
+                for cand in self.grid[knob]:
+                    if cand == best[knob]:
+                        continue
+                    trial = dict(best)
+                    trial[knob] = cand
+                    s = self._score(trial)
+                    decision = {"sweep": sweep, "knob": knob,
+                                "candidate": cand, "score": round(s, 4),
+                                "incumbent": best[knob],
+                                "incumbent_score": round(best_score, 4),
+                                "accepted": s > best_score}
+                    self.history.append(decision)
+                    record_tune_decision(
+                        action="grid_accept" if s > best_score
+                        else "grid_reject", knob=knob,
+                        before=best[knob], after=cand,
+                        score=round(s, 4),
+                        incumbent_score=round(best_score, 4))
+                    if s > best_score:
+                        best[knob] = cand
+                        best_score = s
+                        improved = True
+            if not improved:
+                break
+        return best, best_score
+
+
+# ------------------------------------------------------------- online
+
+class SLOController:
+    """Online closed-loop nudging with hysteresis — the ``Autoscaler``
+    policy shape pointed at latency knobs instead of replica count.
+
+    Signal: ``signal()`` (or an injected dict) yields ``p99_ms`` and
+    ``shed_rate``. The p99 is EWMA-smoothed; the band is
+    ``[band_lo * slo.p99_ms, slo.p99_ms]``:
+
+    - **above the SLO** (or shedding past the allowance) sustained for
+      ``sustain_high_s`` and not cooling: halve ``batch_timeout_ms``
+      (less coalescing wait, lower latency), clamped at
+      ``timeout_lo_ms``. Already at the clamp and still shedding:
+      escalate ``max_batch`` one doubling (more rows per launch) — the
+      fleet refuses an off-menu value with a typed 409, which the
+      controller records and converts into its own learned upper bound.
+    - **far below the SLO** sustained for ``sustain_low_s``: double
+      ``batch_timeout_ms`` back toward ``timeout_hi_ms`` (recover batch
+      occupancy when latency headroom is abundant).
+    - **inside the band**: both sustain clocks reset — a flap into the
+      band forfeits its progress (the Autoscaler's anti-thrash rule).
+
+    Single-writer like the Autoscaler: state is touched only by the
+    loop thread or a test driving :meth:`observe` with an explicit
+    clock, so the controller adds no lock-order edges.
+    """
+
+    def __init__(self, target, slo: SLOTarget, *,
+                 signal: Optional[Callable[[], Optional[dict]]] = None,
+                 timeout_ms: float = 5.0,
+                 timeout_lo_ms: float = 0.5,
+                 timeout_hi_ms: float = 50.0,
+                 max_batch: Optional[int] = None,
+                 max_batch_cap: Optional[int] = None,
+                 step: float = 2.0,
+                 band_lo: float = 0.4,
+                 sustain_high_s: float = 0.5,
+                 sustain_low_s: float = 2.0,
+                 cooldown_s: float = 1.0,
+                 poll_ms: float = 200.0,
+                 ewma_alpha: float = 0.3):
+        if not (0 < timeout_lo_ms <= timeout_ms <= timeout_hi_ms):
+            raise ValueError("need timeout_lo_ms <= timeout_ms <= "
+                             "timeout_hi_ms (all > 0)")
+        if step <= 1.0:
+            raise ValueError("step must be > 1 (a multiplicative nudge)")
+        if not (0.0 < band_lo < 1.0):
+            raise ValueError("band_lo must sit in (0, 1) — it is the "
+                             "hysteresis band's lower edge")
+        self.target = target
+        self.slo = slo
+        self.signal = signal
+        self.timeout_ms = float(timeout_ms)
+        self.timeout_lo_ms = float(timeout_lo_ms)
+        self.timeout_hi_ms = float(timeout_hi_ms)
+        self.max_batch = max_batch if max_batch is None else int(max_batch)
+        # learned menu edge: a refused max_batch nudge pins this
+        self.max_batch_cap = (None if max_batch_cap is None
+                              else int(max_batch_cap))
+        self.step = float(step)
+        self.band_lo = float(band_lo)
+        self.sustain_high_s = float(sustain_high_s)
+        self.sustain_low_s = float(sustain_low_s)
+        self.cooldown_s = float(cooldown_s)
+        self.poll_ms = float(poll_ms)
+        self.ewma_alpha = float(ewma_alpha)
+        self.ewma: Optional[float] = None
+        self._high_since: Optional[float] = None
+        self._low_since: Optional[float] = None
+        self._last_action_t: Optional[float] = None
+        self._t0: Optional[float] = None
+        self.decisions = 0
+        self.rejections = 0
+        # [(seconds-since-start, {knob: value})] — the tune trajectory
+        self.trajectory: List[Tuple[float, dict]] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ----------------------------------------------------------- control
+    def start(self) -> "SLOController":
+        self._thread = threading.Thread(target=self._loop,
+                                        name="slo-controller", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def _loop(self):
+        while not self._stop.wait(self.poll_ms / 1e3):
+            try:
+                self.observe()
+            except Exception as e:  # noqa: BLE001 — the loop must live
+                logger.error("SLO controller tick crashed: %r", e)
+
+    # ------------------------------------------------------------ policy
+    def _knobs(self) -> dict:
+        k = {"batch_timeout_ms": round(self.timeout_ms, 3)}
+        if self.max_batch is not None:
+            k["max_batch"] = self.max_batch
+        return k
+
+    def _record(self, now: float):
+        if self._t0 is None:
+            self._t0 = now
+        self.trajectory.append((round(now - self._t0, 3), self._knobs()))
+
+    def _cooling(self, now: float) -> bool:
+        return (self._last_action_t is not None
+                and now - self._last_action_t < self.cooldown_s)
+
+    def _inc_metric(self, name: str):
+        m = getattr(self.target, "metrics", None)
+        if m is not None and name in getattr(m, "counters", {}):
+            m.inc(name)
+
+    def _apply(self, action: str, knob: str, before, after,
+               sig: dict, now: float) -> bool:
+        """One bounded nudge through the typed hot-reconfig path. A
+        refusal (409) is recorded, counted, and — for max_batch — pins
+        the controller's learned cap. Returns True when applied."""
+        self.decisions += 1
+        self._inc_metric("tune_decisions_total")
+        try:
+            self.target.apply_config(FleetConfig(**{knob: after}))
+        except ConfigRejected as e:
+            self.rejections += 1
+            if knob == "max_batch":
+                self.max_batch_cap = before
+            record_tune_decision(
+                action="apply_rejected", knob=knob, before=before,
+                after=after, reason=str(e)[:200],
+                signal_p99_ms=sig.get("p99_ms"),
+                signal_shed_rate=sig.get("shed_rate"),
+                ewma_p99_ms=(round(self.ewma, 2)
+                             if self.ewma is not None else None))
+            log_event(logger, "tune_rejected",
+                      "SLO controller: %s nudge %s -> %s REFUSED (%s); "
+                      "bound learned", knob, before, after, e,
+                      knob=knob, before=before, after=after)
+            return False
+        record_tune_decision(
+            action=action, knob=knob, before=before, after=after,
+            signal_p99_ms=sig.get("p99_ms"),
+            signal_shed_rate=sig.get("shed_rate"),
+            ewma_p99_ms=(round(self.ewma, 2)
+                         if self.ewma is not None else None))
+        log_event(logger, "tune_nudge",
+                  "SLO controller: %s %s %s -> %s (ewma p99 %.1f ms, "
+                  "SLO %.1f ms)", action, knob, before, after,
+                  self.ewma if self.ewma is not None else -1.0,
+                  self.slo.p99_ms, level=20, knob=knob,
+                  before=before, after=after)
+        self._last_action_t = now
+        self._record(now)
+        return True
+
+    def observe(self, signal: Optional[dict] = None,
+                now: Optional[float] = None):
+        """One policy tick. ``signal``/``now`` injectable so tests
+        drive the hysteresis deterministically (the Autoscaler test
+        pattern)."""
+        now = time.monotonic() if now is None else now
+        if not self.trajectory:
+            self._record(now)
+        if signal is None:
+            signal = self.signal() if self.signal is not None else None
+        if not signal or signal.get("p99_ms") is None:
+            return  # no load observation yet — no policy, no clocks
+        p99 = float(signal["p99_ms"])
+        shed_rate = float(signal.get("shed_rate") or 0.0)
+        self.ewma = (p99 if self.ewma is None
+                     else self.ewma_alpha * p99
+                     + (1 - self.ewma_alpha) * self.ewma)
+        shedding = shed_rate > self.slo.max_shed_rate
+        if self.ewma > self.slo.p99_ms or shedding:
+            self._low_since = None
+            if self._high_since is None:
+                self._high_since = now
+            if (now - self._high_since >= self.sustain_high_s
+                    and not self._cooling(now)):
+                if self.timeout_ms > self.timeout_lo_ms:
+                    new = max(self.timeout_lo_ms,
+                              self.timeout_ms / self.step)
+                    if self._apply("nudge_timeout_down",
+                                   "batch_timeout_ms", self.timeout_ms,
+                                   new, signal, now):
+                        self.timeout_ms = new
+                        self._high_since = None
+                elif shedding and self.max_batch is not None:
+                    # timeout already floored and still shedding: widen
+                    # the batch (more rows per launch). The fleet — not
+                    # this controller — owns the menu edge: a 409 pins
+                    # max_batch_cap so the bound is learned, not guessed
+                    new = self.max_batch * 2
+                    if (self.max_batch_cap is not None
+                            and new > self.max_batch_cap):
+                        self._high_since = None  # clamped: nothing to do
+                        return
+                    if self._apply("widen_max_batch", "max_batch",
+                                   self.max_batch, new, signal, now):
+                        self.max_batch = new
+                    self._high_since = None
+        elif self.ewma < self.band_lo * self.slo.p99_ms and not shedding:
+            self._high_since = None
+            if self._low_since is None:
+                self._low_since = now
+            if (now - self._low_since >= self.sustain_low_s
+                    and not self._cooling(now)
+                    and self.timeout_ms < self.timeout_hi_ms):
+                new = min(self.timeout_hi_ms, self.timeout_ms * self.step)
+                if self._apply("nudge_timeout_up", "batch_timeout_ms",
+                               self.timeout_ms, new, signal, now):
+                    self.timeout_ms = new
+                    self._low_since = None
+        else:
+            # inside the hysteresis band: both sustain clocks reset —
+            # a flap back into the band forfeits its progress
+            self._high_since = None
+            self._low_since = None
+
+
+def engine_signal(engine) -> Callable[[], Optional[dict]]:
+    """Metrics-plane signal for :class:`SLOController` over a live
+    :class:`~paddle_tpu.serving.engine.ServingEngine`: p99 comes from
+    the rolling latency window, shed_rate from counter DELTAS between
+    ticks (snapshot counters are process-lifetime totals — the
+    controller must react to the current window, not the whole run).
+    Returns ``None`` until traffic has been observed and on quiet ticks
+    (no new offers since the last tick), so the hysteresis clocks only
+    run under load."""
+    last = {"shed": 0, "offered": 0, "primed": False}
+
+    def _signal() -> Optional[dict]:
+        snap = engine.metrics.snapshot()
+        shed = int(snap.get("shed_total") or 0)
+        offered = int(snap.get("requests_total") or 0) + shed
+        d_shed = shed - last["shed"]
+        d_offered = offered - last["offered"]
+        primed = last["primed"]
+        last.update(shed=shed, offered=offered, primed=True)
+        total = snap.get("latency_ms", {}).get("total") or {}
+        p99 = total.get("p99_ms")
+        if not primed or p99 is None or d_offered <= 0:
+            return None
+        return {"p99_ms": float(p99),
+                "shed_rate": d_shed / float(d_offered)}
+
+    return _signal

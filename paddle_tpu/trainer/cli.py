@@ -312,6 +312,27 @@ def parse_args(argv=None):
                    default=5.0,
                    help="--job=serve_fleet: EWMA fleet backlog below "
                         "this (sustained) scales down")
+    p.add_argument("--slo_p99_ms", type=float, default=0,
+                   help="--job=serve: attach the online SLO controller "
+                        "(serving/tuner.py:SLOController) targeting "
+                        "this end-to-end p99; it nudges "
+                        "batch_timeout_ms (and, when shedding at the "
+                        "floor, max_batch within the warmed bucket "
+                        "menu) through the same typed apply_config "
+                        "path operators use, with Autoscaler-style "
+                        "hysteresis. 0 (default) = off")
+    p.add_argument("--slo_max_shed_rate", type=float, default=0.0,
+                   help="--slo_p99_ms: shed-rate budget of the SLO "
+                        "target — a windowed shed rate above this "
+                        "counts as an SLO breach even when p99 is "
+                        "inside target")
+    p.add_argument("--workload_record", default=None,
+                   help="--job=serve: tap the admission path "
+                        "(serving/workload.py:WorkloadRecorder) and "
+                        "write the offered stream — admitted AND shed "
+                        "— to this WORKLOAD_*.json artifact at "
+                        "shutdown, replayable via replay()/GridTuner "
+                        "for offline tuning")
     # --job=serve_train (paddle_tpu/online): the online learning loop —
     # serving traffic streams into the trainer, publishes roll back out
     p.add_argument("--replay_dir", default=None,
@@ -1226,7 +1247,33 @@ def cmd_serve(ns, args):
             model_path=getattr(args, "model_path", None))
     from paddle_tpu.serving import serve_forever
     engine = build_serving_engine(ns, args)
-    return serve_forever(engine, host=args.host, port=args.port)
+    recorder = controller = None
+    if getattr(args, "workload_record", None):
+        from paddle_tpu.serving.workload import WorkloadRecorder
+        recorder = WorkloadRecorder()
+        engine.workload_recorder = recorder
+    if getattr(args, "slo_p99_ms", 0):
+        from paddle_tpu.serving.tuner import (SLOController, SLOTarget,
+                                              engine_signal)
+        controller = SLOController(
+            engine,
+            SLOTarget(p99_ms=args.slo_p99_ms,
+                      max_shed_rate=args.slo_max_shed_rate),
+            signal=engine_signal(engine),
+            timeout_ms=args.batch_timeout_ms,
+            timeout_lo_ms=min(0.5, args.batch_timeout_ms),
+            timeout_hi_ms=max(50.0, args.batch_timeout_ms),
+            max_batch=args.max_batch).start()
+    try:
+        return serve_forever(engine, host=args.host, port=args.port)
+    finally:
+        if controller is not None:
+            controller.stop()
+        if recorder is not None:
+            engine.workload_recorder = None
+            recorder.snapshot(
+                os.path.splitext(os.path.basename(
+                    args.workload_record))[0]).save(args.workload_record)
 
 
 def main(argv=None):

@@ -1186,6 +1186,106 @@ def test_pt401_serve_train_artifact_requires_learning_evidence(tmp_path):
     assert data["publishes_total"] >= 2
 
 
+def test_pt401_workload_artifact_family(tmp_path):
+    """The r21 trace family: a ``WORKLOAD_*`` artifact must be
+    replayable by construction — non-empty monotone events carrying
+    the full replay key set, with ``n_events`` matching."""
+    events = [{"t": 0.0, "kind": "score", "sample": [[0.1, 0.2], 1],
+               "deadline_ms": None, "beam_size": None,
+               "max_length": None, "outcome": "admitted"},
+              {"t": 0.05, "kind": "generate", "sample": [[1.0, -1.0]],
+               "deadline_ms": 50.0, "beam_size": 2,
+               "max_length": 16, "outcome": "overloaded"}]
+    base = {"workload": "mix", "version": 1, "n_events": 2,
+            "duration_s": 0.05, "events": events}
+    good = tmp_path / "WORKLOAD_good.json"
+    good.write_text(json.dumps(base))
+    assert check_bench_file(str(good), "WORKLOAD_good.json") == []
+
+    # truncation, a shuffled offset, a missing replay key, a bad kind
+    bad = dict(base, n_events=4,
+               events=[dict(events[1], t=0.05),
+                       dict(events[0], t=0.0, kind="mystery"),
+                       {"t": 0.1, "kind": "score"}])
+    badf = tmp_path / "WORKLOAD_bad.json"
+    badf.write_text(json.dumps(bad))
+    fs = check_bench_file(str(badf), "WORKLOAD_bad.json")
+    assert {f.rule for f in fs} == {"PT401"}
+    assert any("n_events" in f.message for f in fs)
+    assert any("monotone arrival" in f.message for f in fs)
+    assert any("missing replay key" in f.message for f in fs)
+    assert any("unknown kind" in f.message for f in fs)
+
+    empty = tmp_path / "WORKLOAD_empty.json"
+    empty.write_text(json.dumps(dict(base, events=[], n_events=0)))
+    fs = check_bench_file(str(empty), "WORKLOAD_empty.json")
+    assert any("non-empty 'events'" in f.message for f in fs)
+
+
+def test_pt401_autotune_artifact_joins_trace_to_score(tmp_path):
+    """The r21 tune-score family: a ``serving_autotune*`` metric must
+    JOIN to the traces it replayed (the cited ``WORKLOAD_*.json`` files
+    exist beside it), carry both A/B score sides per mix, keep each
+    mix's replay drift inside its own declared bound, and sum the
+    zero-drop counter over every replay."""
+    trace = {"workload": "short_burst", "version": 1, "n_events": 1,
+             "duration_s": 0.0,
+             "events": [{"t": 0.0, "kind": "score",
+                         "sample": [[0.1], 1], "deadline_ms": None,
+                         "beam_size": None, "max_length": None,
+                         "outcome": "admitted"}]}
+    (tmp_path / "WORKLOAD_r21_short_burst.json").write_text(
+        json.dumps(trace))
+    base = {"metric": "serving_autotune_ab", "platform": "cpu",
+            "autotune_mixes": ["short_burst"],
+            "autotune_workloads": ["WORKLOAD_r21_short_burst.json"],
+            "autotune_drift_bound": 0.25,
+            "autotune_short_burst_default_score": 0.44,
+            "autotune_short_burst_tuned_score": 1.0,
+            "autotune_short_burst_tuned_vs_default_score": 2.29,
+            "autotune_short_burst_replay_drift": 0.0,
+            "fleet_failed_non_shed": 0}
+    good = tmp_path / "BENCH_at.json"
+    good.write_text(json.dumps(base))
+    assert check_bench_file(str(good), "BENCH_at.json") == []
+
+    # a dangling trace join, a drift past the declared bound, a
+    # missing A/B side, a missing drop counter
+    bad = dict(base)
+    bad["autotune_workloads"] = ["WORKLOAD_r21_gone.json"]
+    bad["autotune_short_burst_replay_drift"] = 0.5
+    del bad["autotune_short_burst_default_score"]
+    del bad["fleet_failed_non_shed"]
+    badf = tmp_path / "BENCH_at_bad.json"
+    badf.write_text(json.dumps(bad))
+    fs = check_bench_file(str(badf), "BENCH_at_bad.json")
+    assert {f.rule for f in fs} == {"PT401"}
+    assert any("does not exist beside it" in f.message for f in fs)
+    assert any("exceeds its own declared bound" in f.message for f in fs)
+    assert any("default_score" in f.message for f in fs)
+    assert any("fleet_failed_non_shed" in f.message for f in fs)
+
+    # the committed r21 artifact itself carries the tentpole evidence:
+    # both mixes' traces join, the tuned config beats the hand-set
+    # defaults on the declared SLO score on BOTH mixes, the replays
+    # dropped nothing anywhere, and the determinism drift stayed
+    # inside the declared bound (also pinned by the schema above)
+    import os as _os
+    root = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    r21 = _os.path.join(root, "BENCH_r21.json")
+    assert check_bench_file(r21, "BENCH_r21.json") == []
+    data = json.loads(open(r21).read())
+    assert len(data["autotune_mixes"]) >= 2
+    for m in data["autotune_mixes"]:
+        assert (data[f"autotune_{m}_tuned_score"]
+                > data[f"autotune_{m}_default_score"])
+        assert (data[f"autotune_{m}_replay_drift"]
+                <= data["autotune_drift_bound"])
+    assert data["fleet_failed_non_shed"] == 0
+    for w in data["autotune_workloads"]:
+        assert check_bench_file(_os.path.join(root, w), w) == []
+
+
 def test_pass4_overlap_spelling_budgets_identically():
     """The sync->async flip must budget IDENTICALLY: the overlap chain
     is an ``optimization_barrier`` spelling of the SAME gathers, so the

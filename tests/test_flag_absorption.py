@@ -136,7 +136,7 @@ def test_training_health_table_is_machine_mapped():
 def _x_rows():
     rows = []
     for line in DOC.read_text().splitlines():
-        m = re.match(r"\|\s*X(\d+)\s*\|\s*`--([a-z_]+)`\s*\|\s*"
+        m = re.match(r"\|\s*X(\d+)\s*\|\s*`--([a-z0-9_]+)`\s*\|\s*"
                      r"\*{0,2}(spelled|absorbed|N/A-on-TPU)", line)
         if m:
             rows.append((int(m.group(1)), m.group(2), m.group(3)))
@@ -151,7 +151,8 @@ def test_fsdp_row_is_machine_mapped():
     rows = _x_rows()
     assert [name for _, name, _ in rows] == [
         "fsdp", "quantize", "replay_dir", "publish_every",
-        "serve_train_batches"]
+        "serve_train_batches", "slo_p99_ms", "slo_max_shed_rate",
+        "workload_record"]
     assert all(st == "spelled" for _, _, st in rows)
     from paddle_tpu.trainer import cli
     args = cli.parse_args(["--config", "x.py", "--fsdp"])
@@ -161,6 +162,24 @@ def test_fsdp_row_is_machine_mapped():
                            "--quantize_tol", "0.05"])
     assert args.quantize == "int8"
     assert args.quantize_tol == pytest.approx(0.05)
+
+
+def test_tuning_flags_are_machine_mapped():
+    """The round-21 self-tuning flag family parses as one serve-job
+    surface: the SLO target pair and the trace-record path, with the
+    documented defaults (controller off, zero shed budget)."""
+    from paddle_tpu.trainer import cli
+    args = cli.parse_args([
+        "--config", "x.py", "--job", "serve",
+        "--slo_p99_ms", "80",
+        "--slo_max_shed_rate", "0.02",
+        "--workload_record", "/tmp/WORKLOAD_x.json"])
+    assert args.slo_p99_ms == pytest.approx(80.0)
+    assert args.slo_max_shed_rate == pytest.approx(0.02)
+    assert args.workload_record == "/tmp/WORKLOAD_x.json"
+    dflt = cli.parse_args(["--config", "x.py"])
+    assert dflt.slo_p99_ms == 0 and dflt.slo_max_shed_rate == 0.0
+    assert dflt.workload_record is None
 
 
 def test_serve_train_flags_are_machine_mapped():

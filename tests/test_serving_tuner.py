@@ -1,0 +1,564 @@
+"""Self-tuning serving fleet (r21): typed hot reconfig + SLO controller.
+
+The acceptance spine: every batching/hedging knob changes at runtime
+through ONE typed path (``FleetConfig`` → ``apply_config`` →
+``POST /admin/config``) with validate-then-commit semantics — an
+off-menu ``max_batch`` is refused with a typed 409 and the INCUMBENT
+config keeps serving (the RecompileGuard worker-fatal is prevented at
+apply time, not discovered mid-traffic); the router's fan-out is
+all-or-nothing with rollback; the online ``SLOController`` nudges the
+knobs with Autoscaler-style hysteresis (sustain clocks, cooldown,
+clamps, learned menu edge on refusal) and leaves a ``tune_decision``
+flight trail ``tools/blackbox.py`` can merge; and a full online tune
+sequence causes ZERO hot-path recompiles (``engine.fatal is None`` +
+``check_guards()``).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from paddle_tpu.config import dsl
+from paddle_tpu.data import dense_vector, integer_value
+from paddle_tpu.obs import flight
+from paddle_tpu.serving import (BadRequest, ConfigRejected,
+                                EngineTransport, FleetConfig, GridTuner,
+                                ReplicaRouter, SLOController, SLOTarget,
+                                ServingClient, ServingEngine,
+                                ServingPredictor, make_server)
+from paddle_tpu.serving.supervisor import Autoscaler
+from paddle_tpu.serving.tuner import rollback_delta, slo_score
+
+DIM, CLASSES = 8, 4
+SAMPLE = ((np.arange(DIM, dtype=float) / DIM).tolist(), 1)
+
+
+def _classifier(seed: int = 0):
+    dsl.reset()
+    x = dsl.data(name="x", size=DIM)
+    lab = dsl.data(name="label", size=CLASSES)
+    hid = dsl.fc(input=x, size=12, act="relu", name="hid")
+    out = dsl.fc(input=hid, size=CLASSES, act="softmax", name="out")
+    dsl.classification_cost(input=out, label=lab, name="cost")
+    graph = dsl.current_graph()
+    from paddle_tpu.core.network import Network
+    params = Network(graph, outputs=["out"]).init_params(
+        jax.random.PRNGKey(seed))
+    feeding = {"x": dense_vector(DIM), "label": integer_value(CLASSES)}
+    return graph, params, feeding
+
+
+@pytest.fixture(scope="module")
+def served(tmp_path_factory):
+    """One warmed engine (menu [1, 2, 4]) + its HTTP frontend. Module-
+    scoped: the 1-core host cannot afford per-test warmup. Tests that
+    mutate knobs restore them (the fixture's current_config is the
+    incumbent every test starts from)."""
+    graph, params, feeding = _classifier()
+    pred = ServingPredictor(graph, params, ["out"], feeding,
+                            batch_buckets=[1, 2, 4])
+    eng = ServingEngine(pred, max_batch=4, batch_timeout_ms=1.0,
+                        queue_depth=32).start(warmup=True)
+    server = make_server(eng, port=0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    client = ServingClient(port=server.server_address[1])
+    baseline = eng.current_config()
+    yield {"graph": graph, "params": params, "feeding": feeding,
+           "engine": eng, "server": server, "client": client,
+           "baseline": baseline}
+    server.shutdown()
+    eng.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _restore_knobs(request):
+    """Every test leaves the module engine on its baseline knobs."""
+    yield
+    if "served" in request.fixturenames:
+        served = request.getfixturevalue("served")
+        served["engine"].apply_config(
+            {k: v for k, v in served["baseline"].items()
+             if v is not None})
+
+
+# ------------------------------------------------------------ the payload
+def test_fleet_config_closed_key_parse():
+    """An unknown knob or a non-numeric value is a typed 400 carrying
+    the knob menu — a config typo must never be silently dropped."""
+    with pytest.raises(BadRequest) as ei:
+        FleetConfig.from_dict({"batch_timeout": 5.0})  # typo'd name
+    assert "max_batch" in ei.value.allowed["knobs"]
+    assert "hedge_ms" in ei.value.allowed["knobs"]
+    with pytest.raises(BadRequest):
+        FleetConfig.from_dict({"max_batch": True})  # bool is not a count
+    with pytest.raises(BadRequest):
+        FleetConfig.from_dict({"hedge_ms": "fast"})
+    with pytest.raises(BadRequest):
+        FleetConfig.from_dict([1, 2])
+
+    cfg = FleetConfig.from_dict({"max_batch": 2.0, "hedge_ms": 0,
+                                 "batch_timeout_ms": 3})
+    assert cfg.max_batch == 2 and isinstance(cfg.max_batch, int)
+    # wire <= 0 on a nullable knob means "disable" -> stored None
+    assert cfg.router_items() == {"hedge_ms": None}
+    # to_dict stays a delta: only the set fields travel
+    assert sorted(cfg.to_dict()) == ["batch_timeout_ms", "hedge_ms",
+                                     "max_batch"]
+    # wire None == omitted == unchanged
+    assert FleetConfig.from_dict({"max_batch": None}).to_dict() == {}
+
+    # the rollback payload maps an incumbent None back to the wire's
+    # "disable" spelling for nullable knobs
+    back = rollback_delta({"hedge_ms": None, "max_batch": 2},
+                          ["hedge_ms", "max_batch"])
+    assert back == {"hedge_ms": 0, "max_batch": 2}
+
+
+# ------------------------------------------------------- engine hot apply
+def test_engine_apply_config_commits_and_serves(served):
+    eng = served["engine"]
+    applies0 = eng.metrics.counters["config_applies_total"]
+    res = eng.apply_config({"max_batch": 2, "batch_timeout_ms": 0.5,
+                            "queue_depth": 16})
+    assert res["status"] == "ok"
+    assert res["before"]["max_batch"] == 4
+    assert res["after"]["max_batch"] == 2
+    assert eng.max_batch == 2 and eng.batch_timeout_ms == 0.5
+    assert eng.queue_depth == 16
+    # the shed watermark re-clamps to the new queue bound
+    assert eng.shed_watermark <= 16
+    assert eng.metrics.counters["config_applies_total"] == applies0 + 1
+    # the reconfigured engine still answers, and answers identically
+    got = eng.infer(SAMPLE)
+    direct, _ = eng.predictor.predict_rows([SAMPLE])
+    np.testing.assert_array_equal(np.asarray(got["outputs"]["out"]),
+                                  direct["out"][0])
+
+
+def test_engine_off_menu_max_batch_refused_incumbent_serves(served):
+    """The load-bearing refusal: a max_batch above the warmed bucket
+    menu is a typed 409 AT APPLY TIME (not a worker-fatal
+    RecompileError mid-traffic), the allowed menu rides the error, and
+    the incumbent keeps serving — including the OTHER fields of the
+    same delta (validate-then-commit, no partial apply)."""
+    eng = served["engine"]
+    before = eng.current_config()
+    rejected0 = eng.metrics.counters["config_rejected_total"]
+    with pytest.raises(ConfigRejected) as ei:
+        eng.apply_config({"max_batch": 64, "batch_timeout_ms": 9.0})
+    assert ei.value.status == 409
+    assert ei.value.allowed == {"max_batch": [1, 2, 4]}
+    # nothing moved — not even the admissible half of the delta
+    assert eng.current_config() == before
+    assert eng.batch_timeout_ms == before["batch_timeout_ms"]
+    assert (eng.metrics.counters["config_rejected_total"]
+            == rejected0 + 1)
+    assert "outputs" in eng.infer(SAMPLE)  # incumbent serves
+    assert eng.fatal is None  # and its worker never saw the bad value
+
+    for bad in ({"queue_depth": 0}, {"batch_timeout_ms": -1.0},
+                {"shed_watermark": 0}, {"max_batch": 0}):
+        with pytest.raises(ConfigRejected):
+            eng.apply_config(bad)
+    assert eng.current_config() == before
+
+
+def test_engine_decode_chunk_change_refused(served):
+    """decode_chunk is compiled into the warmed decode programs — ANY
+    change is refused toward /admin/reload (a knob nudge cannot retrace
+    the menu)."""
+    eng = served["engine"]
+    with pytest.raises(ConfigRejected) as ei:
+        eng.apply_config({"decode_chunk": 4})
+    assert "reload" in str(ei.value)
+    assert ei.value.allowed == {"decode_chunk": [None]}
+    # the no-op spelling (disable on a predictor with no decode chunk)
+    # is admissible: nothing changes
+    res = eng.apply_config({"decode_chunk": 0})
+    assert res["after"]["decode_chunk"] is None
+
+
+def test_http_admin_config_roundtrip(served):
+    """POST /admin/config: 200 with before/after on success; the 409
+    refusal comes back as the TYPED ConfigRejected (from_wire) and is
+    not retried."""
+    client = served["client"]
+    res = client.apply_config({"batch_timeout_ms": 2.0})
+    assert res["status"] == "ok"
+    assert res["after"]["batch_timeout_ms"] == 2.0
+    assert served["engine"].batch_timeout_ms == 2.0
+    with pytest.raises(ConfigRejected) as ei:
+        client.apply_config({"max_batch": 99})
+    assert ei.value.status == 409
+    assert ei.value.allowed == {"max_batch": [1, 2, 4]}
+    with pytest.raises(BadRequest) as ei:
+        client.apply_config({"no_such_knob": 1})
+    assert "knobs" in ei.value.allowed
+    assert "outputs" in client.score(SAMPLE)  # incumbent serves
+
+
+# ------------------------------------------------------- router fan-out
+def test_router_fanout_all_or_nothing(served):
+    """Replica 1's menu tops out at 2: a fleet-wide max_batch=4 is
+    refused by it, and replica 0 — which already accepted — is ROLLED
+    BACK to its incumbent. No replica serves the refused config."""
+    graph, params, feeding = (served["graph"], served["params"],
+                              served["feeding"])
+
+    def build(buckets):
+        pred = ServingPredictor(graph, params, ["out"], feeding,
+                                batch_buckets=buckets)
+        return ServingEngine(pred, max_batch=buckets[-1],
+                             batch_timeout_ms=1.0,
+                             queue_depth=32).start(warmup=True)
+
+    wide, narrow = build([1, 2, 4]), build([1, 2])
+    router = ReplicaRouter([EngineTransport(wide),
+                            EngineTransport(narrow)],
+                           health_poll_ms=1e6)
+    router.poll_once()
+    try:
+        wide.apply_config({"max_batch": 2})  # distinct incumbent
+        with pytest.raises(ConfigRejected) as ei:
+            router.apply_config({"max_batch": 4,
+                                 "batch_timeout_ms": 7.0})
+        assert "rolled back" in str(ei.value)
+        assert ei.value.allowed == {"max_batch": [1, 2]}
+        assert wide.max_batch == 2  # rolled back, not left at 4
+        assert wide.batch_timeout_ms == 1.0
+        assert narrow.max_batch == 2
+        assert (router.metrics.counters["config_rejected_total"] == 1)
+
+        # an admissible fleet-wide delta lands on every replica
+        res = router.apply_config({"max_batch": 1, "hedge_ms": 5.0})
+        assert res["replicas"] == 2
+        assert wide.max_batch == 1 and narrow.max_batch == 1
+        assert router.hedge_ms == 5.0
+        # the nullable disable spelling
+        router.apply_config({"hedge_ms": 0})
+        assert router.hedge_ms is None
+        with pytest.raises(ConfigRejected):
+            router.apply_config({"max_hedges": -1})
+        # autoscale watermarks need an attached autoscaler
+        with pytest.raises(ConfigRejected) as ei:
+            router.apply_config({"autoscale_up_backlog_ms": 80.0})
+        assert "autoscaler" in str(ei.value)
+    finally:
+        router.shutdown()
+        wide.shutdown()
+        narrow.shutdown()
+
+
+def test_autoscaler_watermark_retarget_keeps_band():
+    """Autoscale watermarks retarget through check/commit: a collapsed
+    band (down >= up) is refused with the incumbent intact; a valid
+    delta commits on the attached scaler through the router path."""
+
+    class _Fleet:
+        def replica_count(self):
+            return 1
+
+        def scale_up(self):
+            return False
+
+        def scale_down(self):
+            return False
+
+        def load_backlog_ms(self):
+            return None
+
+    scaler = Autoscaler(_Fleet(), up_backlog_ms=50.0,
+                        down_backlog_ms=5.0)
+    with pytest.raises(ConfigRejected):
+        scaler.check_config({"autoscale_down_backlog_ms": 60.0})
+    with pytest.raises(ConfigRejected):
+        scaler.check_config({"autoscale_up_backlog_ms": 4.0})
+    assert scaler.up_backlog_ms == 50.0 and scaler.down_backlog_ms == 5.0
+
+    router = ReplicaRouter([], health_poll_ms=1e6)
+    router.autoscaler = scaler
+    try:
+        res = router.apply_config({"autoscale_up_backlog_ms": 80.0,
+                                   "autoscale_down_backlog_ms": 10.0})
+        assert res["status"] == "ok"
+        assert scaler.up_backlog_ms == 80.0
+        assert scaler.down_backlog_ms == 10.0
+        # partial delta: the unchanged half still guards the band
+        with pytest.raises(ConfigRejected):
+            router.apply_config({"autoscale_down_backlog_ms": 90.0})
+        assert scaler.down_backlog_ms == 10.0
+    finally:
+        router.shutdown()
+
+
+# --------------------------------------------------------- the controller
+class FakeTarget:
+    """Scripted apply_config target: records deltas, refuses max_batch
+    above ``menu_cap`` with the typed 409 (the engine's refusal
+    contract, distilled)."""
+
+    def __init__(self, menu_cap=None):
+        self.menu_cap = menu_cap
+        self.applied = []
+
+    def apply_config(self, cfg):
+        d = cfg.to_dict()
+        if (self.menu_cap is not None
+                and d.get("max_batch", 0) > self.menu_cap):
+            raise ConfigRejected(
+                f"max_batch {d['max_batch']} off menu",
+                allowed={"max_batch": [self.menu_cap]})
+        self.applied.append(d)
+        return {"status": "ok"}
+
+
+HIGH = {"p99_ms": 100.0, "shed_rate": 0.0}
+LOW = {"p99_ms": 1.0, "shed_rate": 0.0}
+BAND = {"p99_ms": 30.0, "shed_rate": 0.0}  # inside [0.4*50, 50]
+SHED = {"p99_ms": 100.0, "shed_rate": 0.5}
+
+
+def _ctl(target, **kw):
+    # alpha=1 makes the injected signal literal (no EWMA smear), so the
+    # matrix below tests the CLOCKS, not the filter
+    kw.setdefault("ewma_alpha", 1.0)
+    kw.setdefault("timeout_ms", 8.0)
+    kw.setdefault("timeout_lo_ms", 1.0)
+    kw.setdefault("timeout_hi_ms", 32.0)
+    kw.setdefault("sustain_high_s", 0.5)
+    kw.setdefault("sustain_low_s", 2.0)
+    kw.setdefault("cooldown_s", 1.0)
+    return SLOController(target, SLOTarget(p99_ms=50.0), **kw)
+
+
+def test_controller_sustain_and_cooldown():
+    """The hysteresis matrix, on a synthetic clock: no action before
+    the sustain window, no action inside the cooldown, and an action
+    resets its own sustain clock."""
+    tgt = FakeTarget()
+    c = _ctl(tgt)
+    c.observe(HIGH, now=0.0)     # clock starts
+    c.observe(HIGH, now=0.4)     # sustained only 0.4s < 0.5 — no action
+    assert tgt.applied == []
+    c.observe(HIGH, now=0.6)     # sustained — halve the timeout
+    assert tgt.applied == [{"batch_timeout_ms": 4.0}]
+    assert c.timeout_ms == 4.0
+    c.observe(HIGH, now=0.8)     # clock restarted at 0.8
+    c.observe(HIGH, now=1.4)     # sustained again BUT cooling (1.4-0.6)
+    assert len(tgt.applied) == 1
+    c.observe(HIGH, now=1.7)     # sustained (0.9s) and cooled (1.1s)
+    assert tgt.applied[-1] == {"batch_timeout_ms": 2.0}
+    assert c.decisions == 2 and c.rejections == 0
+    # the trajectory recorded the initial knobs + one point per nudge
+    assert len(c.trajectory) == 3
+
+
+def test_controller_inside_band_resets_clocks():
+    """A flap back into the band forfeits sustain progress — the
+    Autoscaler anti-thrash rule."""
+    tgt = FakeTarget()
+    c = _ctl(tgt)
+    c.observe(HIGH, now=0.0)
+    c.observe(BAND, now=0.4)     # back in band: clock forfeited
+    c.observe(HIGH, now=0.5)     # restart
+    c.observe(HIGH, now=0.9)     # only 0.4s since restart — no action
+    assert tgt.applied == []
+    c.observe(HIGH, now=1.1)     # 0.6s sustained — now it fires
+    assert len(tgt.applied) == 1
+
+
+def test_controller_low_side_recovers_occupancy():
+    """Far below the band sustained for sustain_low_s: the timeout
+    doubles back toward the ceiling (recover batch occupancy), clamped
+    at timeout_hi_ms."""
+    tgt = FakeTarget()
+    c = _ctl(tgt, timeout_ms=16.0)
+    c.observe(LOW, now=0.0)
+    c.observe(LOW, now=1.9)      # 1.9s < 2.0 — not yet
+    assert tgt.applied == []
+    c.observe(LOW, now=2.1)
+    assert tgt.applied == [{"batch_timeout_ms": 32.0}]
+    assert c.timeout_ms == 32.0
+    # at the ceiling: low pressure is a no-op, no decision spam
+    c.observe(LOW, now=5.0)
+    c.observe(LOW, now=8.0)
+    assert len(tgt.applied) == 1
+
+
+def test_controller_learns_menu_edge_from_409():
+    """Timeout already floored and still shedding ⇒ widen max_batch;
+    the fleet's 409 pins the controller's learned cap and the refused
+    value is never hammered again."""
+    tgt = FakeTarget(menu_cap=4)
+    c = _ctl(tgt, timeout_ms=1.0, max_batch=2)  # already at the floor
+    c.observe(SHED, now=0.0)
+    c.observe(SHED, now=0.6)     # widen 2 -> 4: admissible
+    assert tgt.applied == [{"max_batch": 4}]
+    assert c.max_batch == 4
+    c.observe(SHED, now=2.0)
+    c.observe(SHED, now=2.6)     # widen 4 -> 8: REFUSED, cap learned
+    assert c.rejections == 1
+    assert c.max_batch_cap == 4 and c.max_batch == 4
+    n_applied = len(tgt.applied)
+    c.observe(SHED, now=4.0)
+    c.observe(SHED, now=4.6)     # clamped: no further attempt
+    assert len(tgt.applied) == n_applied
+    assert c.rejections == 1
+
+
+def test_controller_ignores_empty_signal_and_validates_bounds():
+    tgt = FakeTarget()
+    c = _ctl(tgt)
+    c.observe(None, now=0.0)
+    c.observe({}, now=1.0)
+    c.observe({"p99_ms": None}, now=2.0)
+    assert tgt.applied == [] and c.ewma is None
+    with pytest.raises(ValueError):
+        _ctl(tgt, timeout_ms=0.5, timeout_lo_ms=1.0)
+    with pytest.raises(ValueError):
+        _ctl(tgt, step=1.0)
+    with pytest.raises(ValueError):
+        _ctl(tgt, band_lo=1.5)
+
+
+# ---------------------------------------------------------- grid tuner
+def test_grid_tuner_deterministic_descent():
+    """Coordinate descent: finds the grid optimum of a deterministic
+    score surface, caches every scored point (revisits are free), and
+    ties keep the incumbent."""
+    calls = []
+
+    def score(cfg):
+        calls.append(dict(cfg))
+        # optimum at timeout=2, batch=4; a tie ridge at timeout 2 vs 8
+        # for batch=2 exercises ties-keep-incumbent
+        table = {(1.0, 2): 0.5, (2.0, 2): 0.7, (8.0, 2): 0.7,
+                 (1.0, 4): 0.6, (2.0, 4): 0.9, (8.0, 4): 0.4}
+        return table[(cfg["batch_timeout_ms"], cfg["max_batch"])]
+
+    tuner = GridTuner({"batch_timeout_ms": [1.0, 2.0, 8.0],
+                       "max_batch": [2, 4]}, score)
+    best, best_score = tuner.tune()
+    assert best == {"batch_timeout_ms": 2.0, "max_batch": 4}
+    assert best_score == 0.9
+    # cache: no config scored twice
+    keys = [tuple(sorted(c.items())) for c in calls]
+    assert len(keys) == len(set(keys))
+    assert tuner.history  # every candidate left a decision record
+    accepted = [h for h in tuner.history if h["accepted"]]
+    assert all(h["score"] > h["incumbent_score"] for h in accepted)
+
+    # the tie: from base (8.0, 2), candidate (2.0, 2) scores EQUAL and
+    # must NOT be accepted (determinism of the search itself)
+    tuner2 = GridTuner({"batch_timeout_ms": [8.0, 2.0]}, score,
+                       base={"max_batch": 2})
+    best2, _ = tuner2.tune()
+    assert best2["batch_timeout_ms"] == 8.0
+
+
+def test_slo_score_structure():
+    """The score is bounded [0,1], monotone in goodput, and discounts
+    latency only past the SLO."""
+    slo = SLOTarget(p99_ms=50.0, max_shed_rate=0.1)
+    perfect = {"offered": 10, "ok": 10, "shed": 0, "p99_ms": 20.0}
+    assert slo_score(perfect, slo) == 1.0
+    slow = {"offered": 10, "ok": 10, "shed": 0, "p99_ms": 100.0}
+    assert slo_score(slow, slo) == pytest.approx(0.5)
+    shed = {"offered": 10, "ok": 5, "shed": 5, "p99_ms": 20.0}
+    assert slo_score(shed, slo) == pytest.approx(0.5 - 0.4)
+    empty = {"offered": 0, "ok": 0, "shed": 0, "p99_ms": None}
+    assert 0.0 <= slo_score(empty, slo) <= 1.0
+
+
+# ----------------------------------------------- online loop, end to end
+def test_online_tune_sequence_zero_recompiles_with_flight_trail(
+        served, tmp_path):
+    """A full online tune sequence against the LIVE engine — nudges
+    down under pressure, a max_batch widen refused at the menu edge,
+    traffic flowing throughout — causes ZERO hot-path recompiles
+    (``fatal is None`` + ``check_guards``), and every decision (applied
+    AND refused) is a ``tune_decision`` flight event that
+    ``tools/blackbox.py`` merges into the postmortem timeline."""
+    from tools import blackbox
+    eng = served["engine"]
+    rec = flight.FlightRecorder(service="serve")
+    prev = flight.install(rec)
+    try:
+        c = SLOController(eng, SLOTarget(p99_ms=50.0, max_shed_rate=0.0),
+                          timeout_ms=1.0, timeout_lo_ms=1.0,
+                          timeout_hi_ms=8.0, max_batch=4,
+                          sustain_high_s=0.2, sustain_low_s=0.2,
+                          cooldown_s=0.0, ewma_alpha=1.0)
+        shed = {"p99_ms": 200.0, "shed_rate": 0.5}
+        for i, sig in enumerate([shed, shed,   # widen 4 -> 8: REFUSED
+                                 LOW, LOW,     # timeout 1 -> 2
+                                 LOW, LOW]):   # timeout 2 -> 4
+            c.observe(sig, now=0.3 * i)
+            assert "outputs" in eng.infer(SAMPLE)  # traffic interleaved
+        assert c.rejections == 1 and c.max_batch_cap == 4
+        assert eng.batch_timeout_ms == 4.0
+        # liveness: the worker never died, the hardened guard never saw
+        # a hot-path compile across the whole sequence
+        assert eng.fatal is None
+        eng.predictor.check_guards()
+        assert "outputs" in eng.infer(SAMPLE)
+
+        decisions = rec.events("tune_decision")
+        actions = [e["action"] for e in decisions]
+        assert "apply_rejected" in actions
+        assert "nudge_timeout_up" in actions
+        applied = rec.events("config_applied")
+        assert applied and "batch_timeout_ms" in applied[-1]["changed"]
+
+        # the blackbox merge: dump the ring, merge the dir, find the
+        # tune trail in the human timeline
+        rec.dump_jsonl(str(tmp_path / "flight-serve-1.jsonl"))
+        merged = blackbox.merge_dir(str(tmp_path))
+        assert [e for e in merged if e["event"] == "tune_decision"]
+        text = blackbox.format_timeline(merged)
+        assert "tune_decision" in text and "apply_rejected" in text
+    finally:
+        flight.install(prev)
+
+
+def test_engine_signal_windows_counter_deltas():
+    """The CLI's metrics-plane signal: shed_rate comes from counter
+    DELTAS between ticks (not lifetime totals), the priming tick and
+    quiet ticks (no new offers) yield None so the controller's clocks
+    only run under load."""
+    from paddle_tpu.serving.tuner import engine_signal
+
+    class StubMetrics:
+        def __init__(self):
+            self.p99 = None
+            self.shed = 0
+            self.admitted = 0
+
+        def snapshot(self):
+            total = {"p99_ms": self.p99} if self.p99 is not None else {}
+            return {"latency_ms": {"total": total},
+                    "shed_total": self.shed,
+                    "requests_total": self.admitted}
+
+    class StubEngine:
+        def __init__(self):
+            self.metrics = StubMetrics()
+
+    eng = StubEngine()
+    sig = engine_signal(eng)
+    assert sig() is None  # priming tick: no baseline yet
+    eng.metrics.admitted, eng.metrics.shed = 8, 2
+    eng.metrics.p99 = 12.0
+    s = sig()
+    assert s == {"p99_ms": 12.0, "shed_rate": pytest.approx(0.2)}
+    assert sig() is None  # quiet tick: no new offers
+    eng.metrics.admitted = 18  # +10 admitted, no new sheds
+    s = sig()
+    assert s == {"p99_ms": 12.0, "shed_rate": 0.0}
+    eng.metrics.p99 = None  # window drained: no p99 -> no signal
+    eng.metrics.admitted = 20
+    assert sig() is None

@@ -23,7 +23,10 @@
 # asserted in-bench — and (r20) serve_train: the closed online loop
 # (fleet under open-loop load, replay-tailed training, rolling
 # publishes) with the error trajectory and zero-recompile guards
-# asserted in-bench.
+# asserted in-bench — and (r21) autotune: the defaults-vs-tuned A/B
+# over the committed WORKLOAD_r21_* traces (record -> grid-tune ->
+# replay-score), with replay determinism, tuned-beats-defaults and
+# zero non-shed failures asserted in-bench.
 #
 # Usage: bash tools/tpu_watch.sh [round_tag]   (default r04)
 set -u
